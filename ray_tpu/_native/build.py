@@ -1,0 +1,46 @@
+"""Compile-on-demand for the native components.
+
+The .so is built with g++ the first time it is needed and cached next to the
+source keyed by a content hash, so `pip install`-style build steps are never
+required and edits to the .cpp invalidate the cache automatically.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_DIR, "_build")
+_lock = threading.Lock()
+_loaded: dict[str, ctypes.CDLL] = {}
+
+
+def _source_hash(src_path: str) -> str:
+    with open(src_path, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()[:16]
+
+
+def load_native(name: str) -> ctypes.CDLL:
+    """Build (if needed) and dlopen ray_tpu/_native/<name>.cpp."""
+    with _lock:
+        if name in _loaded:
+            return _loaded[name]
+        src = os.path.join(_DIR, f"{name}.cpp")
+        tag = _source_hash(src)
+        so_path = os.path.join(_BUILD_DIR, f"{name}-{tag}.so")
+        if not os.path.exists(so_path):
+            os.makedirs(_BUILD_DIR, exist_ok=True)
+            tmp = so_path + f".tmp{os.getpid()}"
+            cmd = [
+                "g++", "-O2", "-fPIC", "-shared", "-pthread",
+                "-std=c++17", "-o", tmp, src,
+            ]
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+            os.replace(tmp, so_path)  # atomic: concurrent builders race safely
+        lib = ctypes.CDLL(so_path)
+        _loaded[name] = lib
+        return lib

@@ -1,0 +1,107 @@
+"""Central config table with environment override.
+
+Parity: reference `src/ray/common/ray_config_def.h` (RAY_CONFIG X-macro table,
+223 flags, overridable via `RAY_<name>` env vars) and
+`python/ray/_private/ray_constants.py`. Here the table is a typed dict; every
+entry can be overridden with `RAY_TPU_<NAME>` in the environment or a
+`_system_config` dict passed to `ray_tpu.init()`, and the resolved table is
+inherited by spawned worker processes through the environment.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+ENV_PREFIX = "RAY_TPU_"
+
+# name -> (type, default, help)
+_CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
+    # --- object store ---
+    "object_store_memory_bytes": (int, 0, "shm arena size; 0 = auto (30% RAM, capped)"),
+    "object_store_auto_cap_bytes": (int, 20 * 2**30, "cap for auto-sized arena"),
+    "object_store_hash_slots": (int, 1 << 16, "object index slots in shm"),
+    "max_inline_object_bytes": (int, 100 * 1024, "results <= this are returned inline"),
+    "object_spill_dir": (str, "", "directory for spilled objects; '' = <session>/spill"),
+    "object_spill_threshold": (float, 0.8, "spill when arena usage exceeds this"),
+    # --- workers / scheduling ---
+    "num_workers": (int, 0, "worker pool size; 0 = num_cpus"),
+    "worker_startup_timeout_s": (float, 60.0, "time to wait for a worker to boot"),
+    "worker_idle_timeout_s": (float, 300.0, "idle workers above pool size are reaped"),
+    "max_pending_lease_requests": (int, 10, "in-flight lease requests per scheduling key"),
+    "task_max_retries_default": (int, 3, "default retries for idempotent tasks"),
+    "actor_max_restarts_default": (int, 0, "default actor restarts"),
+    # --- control plane ---
+    "health_check_period_ms": (int, 1000, "node health-check interval"),
+    "health_check_failure_threshold": (int, 5, "missed checks before a node is dead"),
+    "gcs_port": (int, 0, "GCS TCP port; 0 = pick free port"),
+    # --- fault injection (test leverage, parity: rpc_chaos.h) ---
+    "testing_rpc_failure": (str, "", "'method=max_failures' comma list; drops messages"),
+    "testing_delay_us": (str, "", "'method=min:max' comma list; injects delays"),
+    # --- observability ---
+    "event_stats": (bool, False, "record per-handler event-loop stats"),
+    "task_events_buffer_size": (int, 10000, "ring buffer of task state transitions"),
+    "metrics_report_interval_ms": (int, 10000, "metrics flush interval"),
+    # --- logging ---
+    "log_dir": (str, "", "session log dir; '' = <session>/logs"),
+}
+
+
+def _coerce(ty: type, raw: str):
+    if ty is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    if ty is int:
+        return int(raw)
+    if ty is float:
+        return float(raw)
+    return raw
+
+
+class Config:
+    """Resolved config. Priority: explicit system_config > env > default."""
+
+    def __init__(self, system_config: dict[str, Any] | None = None):
+        self._values: dict[str, Any] = {}
+        overrides = dict(system_config or {})
+        for name, (ty, default, _help) in _CONFIG_DEFS.items():
+            if name in overrides:
+                self._values[name] = overrides.pop(name)
+            else:
+                raw = os.environ.get(ENV_PREFIX + name.upper())
+                self._values[name] = _coerce(ty, raw) if raw is not None else default
+        if overrides:
+            raise ValueError(f"unknown config keys: {sorted(overrides)}")
+
+    def __getattr__(self, name: str):
+        try:
+            return self._values[name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+    def to_dict(self) -> dict[str, Any]:
+        return dict(self._values)
+
+    def to_env(self) -> dict[str, str]:
+        """Serialize for inheritance by child processes."""
+        return {ENV_PREFIX + "SYSTEM_CONFIG": json.dumps(self._values)}
+
+    @classmethod
+    def from_env(cls) -> "Config":
+        raw = os.environ.get(ENV_PREFIX + "SYSTEM_CONFIG")
+        return cls(json.loads(raw)) if raw else cls()
+
+
+_global_config: Config | None = None
+
+
+def get_config() -> Config:
+    global _global_config
+    if _global_config is None:
+        _global_config = Config.from_env()
+    return _global_config
+
+
+def set_config(cfg: Config):
+    global _global_config
+    _global_config = cfg

@@ -1,0 +1,272 @@
+"""Python client for the shared-memory object store.
+
+Parity: reference `src/ray/object_manager/plasma/client.h` (create/seal/get/
+release/delete) and `python/ray/_private/serialization.py` (zero-copy numpy).
+Every process on a node maps the same shm file; `get` returns memoryviews that
+alias store memory (zero-copy), with pickle-5 out-of-band buffers laid out
+contiguously after the pickle stream so numpy/jax arrays deserialize without a
+copy.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import pickle
+import struct
+import time
+
+from ray_tpu._native.build import load_native
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.status import (
+    GetTimeoutError,
+    ObjectStoreFullError,
+    RayTpuError,
+)
+
+OK = 0
+ERR_NOTFOUND = -1
+ERR_AGAIN = -2
+ERR_EXISTS = -3
+ERR_FULL = -4
+ERR_TABLE_FULL = -5
+ERR_BUSY = -6
+
+_ALIGN = 64
+
+
+def _lib():
+    lib = load_native("object_store")
+    if not getattr(lib, "_sigs_set", False):
+        u64 = ctypes.c_uint64
+        p = ctypes.c_void_p
+        b = ctypes.c_char_p
+        lib.store_init.argtypes = [p, u64, u64]
+        lib.store_validate.argtypes = [p]
+        lib.store_create.argtypes = [p, b, u64, u64, ctypes.POINTER(u64)]
+        lib.store_seal.argtypes = [p, b]
+        lib.store_get.argtypes = [p, b, ctypes.POINTER(u64), ctypes.POINTER(u64), ctypes.POINTER(u64)]
+        lib.store_release.argtypes = [p, b]
+        lib.store_contains.argtypes = [p, b]
+        lib.store_abort.argtypes = [p, b]
+        lib.store_delete.argtypes = [p, b]
+        lib.store_stats.argtypes = [p] + [ctypes.POINTER(u64)] * 4
+        lib.store_header_size.restype = u64
+        lib._sigs_set = True
+    return lib
+
+
+class ObjectBuffer:
+    """Writable view into a created-but-unsealed object."""
+
+    __slots__ = ("store", "object_id", "data", "meta_view", "_sealed")
+
+    def __init__(self, store, object_id, data, meta_view):
+        self.store = store
+        self.object_id = object_id
+        self.data = data
+        self.meta_view = meta_view
+        self._sealed = False
+
+    def seal(self):
+        self.data.release()
+        self.meta_view.release()
+        self.store._seal(self.object_id)
+        self._sealed = True
+
+    def abort(self):
+        if not self._sealed:
+            self.data.release()
+            self.meta_view.release()
+            self.store._abort(self.object_id)
+
+
+class SharedMemoryStore:
+    """One node's object store; head creates, workers attach."""
+
+    def __init__(self, path: str, size: int = 0, num_slots: int = 1 << 16,
+                 create: bool = False):
+        self.path = path
+        self._lib = _lib()
+        if create:
+            fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
+            try:
+                os.ftruncate(fd, size)
+                self._mm = mmap.mmap(fd, size)
+            finally:
+                os.close(fd)
+            self._base = ctypes.addressof(ctypes.c_char.from_buffer(self._mm))
+            rc = self._lib.store_init(self._base, size, num_slots)
+            if rc != OK:
+                raise RayTpuError(f"store_init failed: {rc}")
+        else:
+            fd = os.open(path, os.O_RDWR)
+            try:
+                size = os.fstat(fd).st_size
+                self._mm = mmap.mmap(fd, size)
+            finally:
+                os.close(fd)
+            self._base = ctypes.addressof(ctypes.c_char.from_buffer(self._mm))
+            if self._lib.store_validate(self._base) != OK:
+                raise RayTpuError(f"attached store at {path} is corrupt")
+        self.size = size
+
+    # -- raw object interface --
+
+    def create(self, object_id: ObjectID, data_size: int, meta: bytes = b"") -> ObjectBuffer:
+        off = ctypes.c_uint64()
+        rc = self._lib.store_create(self._base, object_id.binary(), data_size,
+                                    len(meta), ctypes.byref(off))
+        if rc == ERR_EXISTS:
+            raise RayTpuError(f"object {object_id} already exists")
+        if rc in (ERR_FULL, ERR_TABLE_FULL):
+            raise ObjectStoreFullError(
+                f"object store full creating {data_size} bytes (rc={rc})")
+        mv = memoryview(self._mm)
+        data = mv[off.value : off.value + data_size]
+        meta_view = mv[off.value + data_size : off.value + data_size + len(meta)]
+        if meta:
+            meta_view[:] = meta
+        mv.release()
+        return ObjectBuffer(self, object_id, data, meta_view)
+
+    def _seal(self, object_id: ObjectID):
+        self._lib.store_seal(self._base, object_id.binary())
+
+    def _abort(self, object_id: ObjectID):
+        self._lib.store_abort(self._base, object_id.binary())
+
+    def get_raw(self, object_id: ObjectID, timeout: float | None = None):
+        """Returns (data_view, meta_bytes) or None if absent after timeout.
+
+        Takes a store reference; call release() when views are dropped.
+        """
+        off = ctypes.c_uint64()
+        dsz = ctypes.c_uint64()
+        msz = ctypes.c_uint64()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        delay = 1e-6
+        while True:
+            rc = self._lib.store_get(self._base, object_id.binary(),
+                                     ctypes.byref(off), ctypes.byref(dsz),
+                                     ctypes.byref(msz))
+            if rc == OK:
+                mv = memoryview(self._mm)
+                data = mv[off.value : off.value + dsz.value]
+                meta = bytes(mv[off.value + dsz.value : off.value + dsz.value + msz.value])
+                mv.release()
+                return data, meta
+            if rc == ERR_NOTFOUND and timeout == 0:
+                return None
+            if deadline is not None and time.monotonic() > deadline:
+                if rc == ERR_AGAIN:
+                    raise GetTimeoutError(f"object {object_id} never sealed")
+                return None
+            time.sleep(delay)
+            delay = min(delay * 2, 1e-3)
+
+    def release(self, object_id: ObjectID):
+        self._lib.store_release(self._base, object_id.binary())
+
+    def contains(self, object_id: ObjectID) -> bool:
+        return bool(self._lib.store_contains(self._base, object_id.binary()))
+
+    def delete(self, object_id: ObjectID):
+        self._lib.store_delete(self._base, object_id.binary())
+
+    def stats(self) -> dict:
+        a, c, n, e = (ctypes.c_uint64() for _ in range(4))
+        self._lib.store_stats(self._base, *(ctypes.byref(x) for x in (a, c, n, e)))
+        return {"allocated": a.value, "capacity": c.value,
+                "num_objects": n.value, "num_evictions": e.value}
+
+    # -- serialized-value interface (pickle5 + out-of-band buffers) --
+    #
+    # Object layout: [u32 npickle][pickle bytes][pad to 64]
+    #                [u32 nbufs][u64 len]*nbufs [pad to 64][buf (64-aligned)]*
+
+    def put_serialized(self, object_id: ObjectID, value) -> int:
+        """Serialize value into the store; returns total bytes."""
+        buffers: list[pickle.PickleBuffer] = []
+        payload = pickle.dumps(value, protocol=5, buffer_callback=buffers.append)
+        raw = [b.raw() for b in buffers]
+        lens = [len(r) for r in raw]
+        head = 4 + len(payload)
+        head_pad = (-head) % _ALIGN
+        idx = 4 + 8 * len(raw)
+        idx_pad = (-idx) % _ALIGN
+        total = head + head_pad + idx + idx_pad
+        offsets = []
+        for ln in lens:
+            offsets.append(total)
+            total += ln + ((-ln) % _ALIGN)
+        buf = self.create(object_id, total)
+        try:
+            d = buf.data
+            struct.pack_into("<I", d, 0, len(payload))
+            d[4 : 4 + len(payload)] = payload
+            base = head + head_pad
+            struct.pack_into("<I", d, base, len(raw))
+            for i, ln in enumerate(lens):
+                struct.pack_into("<Q", d, base + 4 + 8 * i, ln)
+            for off, r in zip(offsets, raw):
+                d[off : off + len(r)] = r
+            buf.seal()
+        except BaseException:
+            buf.abort()
+            raise
+        return total
+
+    def get_deserialized(self, object_id: ObjectID, timeout: float | None = None):
+        """Returns (found, value). Zero-copy: out-of-band buffers alias shm."""
+        res = self.get_raw(object_id, timeout)
+        if res is None:
+            return False, None
+        data, _meta = res
+        try:
+            (npickle,) = struct.unpack_from("<I", data, 0)
+            payload = data[4 : 4 + npickle]
+            head = 4 + npickle
+            base = head + ((-head) % _ALIGN)
+            (nbufs,) = struct.unpack_from("<I", data, base)
+            lens = struct.unpack_from(f"<{nbufs}Q", data, base + 4) if nbufs else ()
+            idx = 4 + 8 * nbufs
+            off = base + idx + ((-idx) % _ALIGN)
+            bufs = []
+            for ln in lens:
+                bufs.append(data[off : off + ln])
+                off += ln + ((-ln) % _ALIGN)
+            value = pickle.loads(payload, buffers=bufs)
+            return True, value
+        finally:
+            # Store ref stays held for the lifetime of this mapping; the
+            # deserialized value may alias shm. The owner-side reference
+            # counter decides when to release/delete.
+            pass
+
+    def close(self):
+        # Views into self._mm may still be alive (zero-copy values); the mmap
+        # stays mapped until the process exits in that case.
+        try:
+            self._mm.close()
+        except BufferError:
+            pass
+
+    def unlink(self):
+        try:
+            os.unlink(self.path)
+        except FileNotFoundError:
+            pass
+
+
+def default_store_size(config) -> int:
+    explicit = config.object_store_memory_bytes
+    if explicit:
+        return explicit
+    try:
+        import psutil
+        avail = psutil.virtual_memory().available
+    except Exception:
+        avail = 8 * 2**30
+    return min(int(avail * 0.3), config.object_store_auto_cap_bytes)
