@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Core microbenchmark vs the reference's checked-in numbers.
+
+Mirrors the reference's `python/ray/_private/ray_perf.py:93` suite (the
+regression-gate metrics in BASELINE.md). Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+where vs_baseline is the geometric mean of (ours / reference) across the
+core metrics. Detail per-metric numbers go to stderr.
+"""
+
+import json
+import math
+import sys
+import time
+
+import numpy as np
+
+import ray_tpu
+
+# Reference numbers from BASELINE.md (release 2.44.0, 64-CPU instance).
+BASELINE = {
+    "single_client_tasks_sync": 969.8,
+    "single_client_tasks_async": 7931.9,
+    "1_1_actor_calls_sync": 1959.2,
+    "1_1_actor_calls_async": 8173.7,
+    "1_1_async_actor_calls_async": 4284.4,
+    "n_n_actor_calls_async": 27209.7,
+    "single_client_put_calls": 4968.8,
+    "single_client_get_calls": 10529.2,
+    "single_client_put_gigabytes": 17.80,
+}
+
+
+def timeit(fn, number) -> float:
+    t0 = time.perf_counter()
+    fn(number)
+    return number / (time.perf_counter() - t0)
+
+
+def main():
+    import os
+    # 4GB arena: large puts recycle warm pages instead of faulting fresh ones.
+    ray_tpu.init(num_cpus=max(4, (os.cpu_count() or 1)),
+                 object_store_memory=4 << 30)
+    results = {}
+
+    @ray_tpu.remote
+    def nop():
+        pass
+
+    ray_tpu.get(nop.remote(), timeout=60)  # warm the pool
+
+    def tasks_sync(n):
+        for _ in range(n):
+            ray_tpu.get(nop.remote(), timeout=60)
+
+    results["single_client_tasks_sync"] = timeit(tasks_sync, 2000)
+
+    def tasks_async(n):
+        ray_tpu.get([nop.remote() for _ in range(n)], timeout=120)
+
+    results["single_client_tasks_async"] = timeit(tasks_async, 10000)
+
+    @ray_tpu.remote
+    class Sink:
+        def ping(self):
+            pass
+
+    a = Sink.remote()
+    ray_tpu.get(a.ping.remote(), timeout=60)
+
+    def actor_sync(n):
+        for _ in range(n):
+            ray_tpu.get(a.ping.remote(), timeout=60)
+
+    results["1_1_actor_calls_sync"] = timeit(actor_sync, 2000)
+
+    def actor_async(n):
+        ray_tpu.get([a.ping.remote() for _ in range(n)], timeout=120)
+
+    results["1_1_actor_calls_async"] = timeit(actor_async, 10000)
+
+    @ray_tpu.remote
+    class AsyncSink:
+        async def ping(self):
+            pass
+
+    aa = AsyncSink.remote()
+    ray_tpu.get(aa.ping.remote(), timeout=60)
+
+    def async_actor_async(n):
+        ray_tpu.get([aa.ping.remote() for _ in range(n)], timeout=120)
+
+    results["1_1_async_actor_calls_async"] = timeit(async_actor_async, 5000)
+
+    n_actors = min(8, max(2, (os.cpu_count() or 2)))
+    sinks = [Sink.remote() for _ in range(n_actors)]
+    ray_tpu.get([s.ping.remote() for s in sinks], timeout=60)
+
+    def n_n_actor_calls(n):
+        per = n // n_actors
+        refs = []
+        for s in sinks:
+            refs.extend(s.ping.remote() for _ in range(per))
+        ray_tpu.get(refs, timeout=120)
+
+    results["n_n_actor_calls_async"] = timeit(n_n_actor_calls, 10000)
+
+    small = np.zeros(1024, dtype=np.uint8)
+
+    def put_calls(n):
+        for _ in range(n):
+            ray_tpu.put(small)
+
+    results["single_client_put_calls"] = timeit(put_calls, 10000)
+
+    ref = ray_tpu.put(small)
+
+    def get_calls(n):
+        for _ in range(n):
+            ray_tpu.get(ref, timeout=60)
+
+    results["single_client_get_calls"] = timeit(get_calls, 10000)
+
+    gb = np.zeros(1 << 30, dtype=np.uint8)
+
+    def put_gb(n):
+        for _ in range(n):
+            ray_tpu.put(gb)
+
+    put_gb(3)  # fault in + warm the arena pages
+    results["single_client_put_gigabytes"] = timeit(put_gb, 8)
+
+    ratios = []
+    for k, base in BASELINE.items():
+        ours = results[k]
+        ratios.append(ours / base)
+        print(f"{k}: {ours:.1f} (ref {base}, {ours / base:.2f}x)",
+              file=sys.stderr)
+    geomean = math.exp(sum(math.log(r) for r in ratios) / len(ratios))
+
+    ray_tpu.shutdown()
+    print(json.dumps({
+        "metric": "core_microbenchmark_geomean_vs_ray",
+        "value": round(geomean, 3),
+        "unit": "x (geomean of 9 core metrics vs Ray 2.44 on 64-CPU)",
+        "vs_baseline": round(geomean, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,1 +1,41 @@
+"""ray_tpu: a TPU-native distributed AI framework.
 
+Core primitives (tasks, actors, objects, placement groups) with the
+capabilities of the reference's L7 API, plus a JAX/XLA-first compute stack:
+device meshes, GSPMD shardings, ICI collectives, Pallas kernels, and the AI
+libraries (data, train, tune, serve, rllib) built purely on those primitives.
+"""
+
+from ray_tpu._version import version as __version__
+from ray_tpu.core.api import (
+    available_resources,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    method,
+    put,
+    remote,
+    shutdown,
+    timeline,
+    wait,
+)
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.status import (
+    ActorDiedError,
+    GetTimeoutError,
+    ObjectLostError,
+    RayTpuError,
+    TaskError,
+    WorkerCrashedError,
+)
+
+__all__ = [
+    "__version__", "init", "shutdown", "is_initialized", "remote", "method",
+    "get", "put", "wait", "kill", "get_actor", "cluster_resources",
+    "available_resources", "timeline", "ObjectRef", "RayTpuError",
+    "TaskError", "ActorDiedError", "WorkerCrashedError", "ObjectLostError",
+    "GetTimeoutError",
+]

@@ -111,7 +111,11 @@ static int64_t alloc_block(Header* h, uint64_t need) {
     FreeBlock* fb = (FreeBlock*)(arena(h) + cur);
     if (fb->size >= need) {
       uint64_t rem = fb->size - need;
-      if (rem >= MIN_BLOCK) {
+      // All sizes are ALIGN multiples, so rem is 0 or >= ALIGN: a nonzero
+      // remainder is always splittable and the absorb branch only fires at
+      // rem == 0 (so freeing align_up(data+meta) later returns exactly what
+      // was allocated — no leaked tail).
+      if (rem >= ALIGN) {
         uint64_t newoff = cur + need;
         FreeBlock* nb = (FreeBlock*)(arena(h) + newoff);
         nb->size = rem;

@@ -1,0 +1,48 @@
+"""TPU chip detection and topology helpers.
+
+Parity: reference `python/ray/_private/accelerators/tpu.py:109`
+(TPUAcceleratorManager; /dev/accel* & /dev/vfio detection at :135,
+TPU_VISIBLE_CHIPS, pod-slice `TPU-{type}-head` resource at :422). TPUs are
+first-class schedulable resources here: the head counts chips at boot and the
+mesh layer (ray_tpu.parallel) maps logical TPU resource slots to jax devices.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+
+_GKE_TPU_ENV = "TPU_WORKER_ID"
+
+
+def detect_tpus() -> int:
+    """Number of TPU chips attached to this host (0 if none)."""
+    env = os.environ.get("RAY_TPU_NUM_TPUS")
+    if env:
+        return int(env)
+    visible = os.environ.get("TPU_VISIBLE_CHIPS")
+    if visible:
+        return len([c for c in visible.split(",") if c.strip()])
+    accel = glob.glob("/dev/accel*")
+    if accel:
+        return len(accel)
+    vfio = glob.glob("/dev/vfio/[0-9]*")
+    if vfio:
+        return len(vfio)
+    return 0
+
+
+def tpu_pod_name() -> str | None:
+    """Pod-slice identity for gang scheduling (parity: tpu.py:422 and
+    `ray.util.accelerators.tpu.get_current_pod_name`)."""
+    name = os.environ.get("TPU_NAME") or os.environ.get("TPU_POD_NAME")
+    return name or None
+
+
+def tpu_accelerator_type() -> str | None:
+    return os.environ.get("TPU_ACCELERATOR_TYPE") or None
+
+
+def tpu_worker_count() -> int:
+    return int(os.environ.get("TPU_WORKER_HOSTNAMES", "").count(",") + 1) \
+        if os.environ.get("TPU_WORKER_HOSTNAMES") else 1

@@ -1,0 +1,125 @@
+"""Public API: init/shutdown, @remote, get/put/wait, actors, introspection.
+
+Parity: reference `python/ray/_private/worker.py` (ray.init:1285, get:2684,
+put:2820, wait:2885, shutdown:1901) and the `@ray.remote` entry points.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+from ray_tpu.core.actor import ActorClass, ActorHandle, method  # noqa: F401
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.remote_function import RemoteFunction
+from ray_tpu.core.status import RayTpuError
+
+
+def init(num_cpus=None, num_tpus=None, resources=None,
+         object_store_memory=None, _system_config=None, ignore_reinit_error=True,
+         **_ignored):
+    """Boot the head runtime in this process (driver)."""
+    from ray_tpu.core import runtime as rt_mod
+    if rt_mod._runtime is not None:
+        if ignore_reinit_error:
+            return rt_mod._runtime
+        raise RayTpuError("ray_tpu.init() called twice")
+    return rt_mod.init_runtime(
+        num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
+        object_store_memory=object_store_memory, system_config=_system_config)
+
+
+def shutdown():
+    from ray_tpu.core import runtime as rt_mod
+    rt_mod.shutdown_runtime()
+
+
+def is_initialized() -> bool:
+    from ray_tpu.core.runtime import current_runtime
+    return current_runtime() is not None
+
+
+def remote(*args, **options):
+    """@remote decorator for functions (tasks) and classes (actors)."""
+    def decorate(obj):
+        if inspect.isclass(obj):
+            return ActorClass(obj, **options)
+        return RemoteFunction(obj, **options)
+
+    if len(args) == 1 and not options and (inspect.isfunction(args[0])
+                                           or inspect.isclass(args[0])):
+        return decorate(args[0])
+    if args:
+        raise TypeError("@remote takes keyword options only")
+    return decorate
+
+
+def get(refs, *, timeout=None):
+    from ray_tpu.core.runtime import get_runtime
+    if isinstance(refs, list):
+        bad = [r for r in refs if not isinstance(r, ObjectRef)]
+        if bad:
+            raise TypeError(f"get() takes ObjectRefs, got {type(bad[0])}")
+    elif not isinstance(refs, ObjectRef):
+        raise TypeError(f"get() takes an ObjectRef or list, got {type(refs)}")
+    return get_runtime().get(refs, timeout=timeout)
+
+
+def put(value):
+    from ray_tpu.core.runtime import get_runtime
+    return get_runtime().put(value)
+
+
+def wait(refs, *, num_returns=1, timeout=None):
+    from ray_tpu.core.runtime import get_runtime
+    return get_runtime().wait(refs, num_returns=num_returns, timeout=timeout)
+
+
+def kill(actor: ActorHandle, *, no_restart=True):
+    from ray_tpu.core.runtime import Runtime, get_runtime
+    rt = get_runtime()
+    if isinstance(rt, Runtime):
+        rt.kill_actor_by_id(actor._actor_id, no_restart=no_restart)
+    else:
+        rt.request("kill_actor", actor._actor_id)
+
+
+def get_actor(name: str) -> ActorHandle:
+    from ray_tpu.core.runtime import Runtime, get_runtime
+    rt = get_runtime()
+    if isinstance(rt, Runtime):
+        aid = rt.named_actors.get(name)
+        if aid is None:
+            raise ValueError(f"no actor named {name!r}")
+        st = rt.actors[aid]
+        return ActorHandle(aid, name, st.cspec.methods_meta or {})
+    resp = rt.request("get_actor", name)
+    if resp is None:
+        raise ValueError(f"no actor named {name!r}")
+    aid, _ = resp
+    # methods meta travels with the head's record; ask for a full handle
+    meta = rt.request("actor_methods", aid)
+    return ActorHandle(aid, name, meta or {})
+
+
+def cluster_resources() -> dict:
+    from ray_tpu.core.runtime import Runtime, get_runtime
+    rt = get_runtime()
+    if isinstance(rt, Runtime):
+        return rt.cluster_resources()
+    return rt.request("cluster_resources")
+
+
+def available_resources() -> dict:
+    from ray_tpu.core.runtime import Runtime, get_runtime
+    rt = get_runtime()
+    if isinstance(rt, Runtime):
+        return rt.available_resources()
+    return rt.request("available_resources")
+
+
+def timeline():
+    from ray_tpu.core.runtime import Runtime, get_runtime
+    rt = get_runtime()
+    if isinstance(rt, Runtime):
+        return rt.timeline()
+    raise RayTpuError("timeline() is head-only")

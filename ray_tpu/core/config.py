@@ -105,3 +105,9 @@ def get_config() -> Config:
 def set_config(cfg: Config):
     global _global_config
     _global_config = cfg
+    # Chaos-injection specs live in the config; invalidate the cached injector.
+    try:
+        from ray_tpu.core import transport
+        transport._chaos = None
+    except ImportError:
+        pass

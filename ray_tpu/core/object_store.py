@@ -82,6 +82,52 @@ class ObjectBuffer:
             self.store._abort(self.object_id)
 
 
+class _ReleaseHandle:
+    """Shared countdown: releases the store reference when every tracked
+    buffer of one get_deserialized call has been dropped."""
+
+    __slots__ = ("store", "object_id", "data", "remaining")
+
+    def __init__(self, store, object_id, data, remaining):
+        self.store = store
+        self.object_id = object_id
+        self.data = data
+        self.remaining = remaining
+
+    def drop_one(self):
+        self.remaining -= 1
+        if self.remaining == 0:
+            try:
+                self.data.release()
+            except BufferError:
+                pass  # a raw slice escaped; mmap keeps it valid
+            self.store.release(self.object_id)
+
+
+class _TrackedBuffer:
+    """PEP-688 buffer wrapper: consumers (numpy et al.) hold this object via
+    the buffer protocol, so its destruction marks the buffer unused."""
+
+    __slots__ = ("_view", "_handle")
+
+    def __init__(self, view, handle):
+        self._view = view
+        self._handle = handle
+
+    def __buffer__(self, flags):
+        return memoryview(self._view)
+
+    def __del__(self):
+        h = self._handle
+        if h is not None:
+            self._handle = None
+            try:
+                self._view.release()
+            except BufferError:
+                pass
+            h.drop_one()
+
+
 class SharedMemoryStore:
     """One node's object store; head creates, workers attach."""
 
@@ -157,8 +203,8 @@ class SharedMemoryStore:
                 meta = bytes(mv[off.value + dsz.value : off.value + dsz.value + msz.value])
                 mv.release()
                 return data, meta
-            if rc == ERR_NOTFOUND and timeout == 0:
-                return None
+            if timeout == 0 and rc in (ERR_NOTFOUND, ERR_AGAIN):
+                return None  # not-ready probe: unsealed counts as absent
             if deadline is not None and time.monotonic() > deadline:
                 if rc == ERR_AGAIN:
                     raise GetTimeoutError(f"object {object_id} never sealed")
@@ -219,31 +265,43 @@ class SharedMemoryStore:
         return total
 
     def get_deserialized(self, object_id: ObjectID, timeout: float | None = None):
-        """Returns (found, value). Zero-copy: out-of-band buffers alias shm."""
+        """Returns (found, value). Zero-copy: out-of-band buffers alias shm.
+
+        The store reference taken by the read is dropped when the deserialized
+        value is garbage-collected: each out-of-band buffer is handed to
+        pickle wrapped in a _TrackedBuffer whose destruction releases the
+        shared handle (numpy/jax keep the wrapper alive via the buffer
+        protocol). Values with no out-of-band buffers are fully copied by
+        pickle, so the reference is dropped immediately.
+        """
         res = self.get_raw(object_id, timeout)
         if res is None:
             return False, None
         data, _meta = res
-        try:
-            (npickle,) = struct.unpack_from("<I", data, 0)
-            payload = data[4 : 4 + npickle]
-            head = 4 + npickle
-            base = head + ((-head) % _ALIGN)
-            (nbufs,) = struct.unpack_from("<I", data, base)
-            lens = struct.unpack_from(f"<{nbufs}Q", data, base + 4) if nbufs else ()
-            idx = 4 + 8 * nbufs
-            off = base + idx + ((-idx) % _ALIGN)
-            bufs = []
-            for ln in lens:
-                bufs.append(data[off : off + ln])
-                off += ln + ((-ln) % _ALIGN)
-            value = pickle.loads(payload, buffers=bufs)
+        (npickle,) = struct.unpack_from("<I", data, 0)
+        payload = data[4 : 4 + npickle]
+        head = 4 + npickle
+        base = head + ((-head) % _ALIGN)
+        (nbufs,) = struct.unpack_from("<I", data, base)
+        lens = struct.unpack_from(f"<{nbufs}Q", data, base + 4) if nbufs else ()
+        idx = 4 + 8 * nbufs
+        off = base + idx + ((-idx) % _ALIGN)
+        if nbufs == 0:
+            try:
+                value = pickle.loads(payload)
+            finally:
+                payload.release()
+                data.release()
+                self.release(object_id)
             return True, value
-        finally:
-            # Store ref stays held for the lifetime of this mapping; the
-            # deserialized value may alias shm. The owner-side reference
-            # counter decides when to release/delete.
-            pass
+        handle = _ReleaseHandle(self, object_id, data, nbufs)
+        bufs = []
+        for ln in lens:
+            bufs.append(_TrackedBuffer(data[off : off + ln], handle))
+            off += ln + ((-ln) % _ALIGN)
+        value = pickle.loads(payload, buffers=bufs)
+        payload.release()
+        return True, value
 
     def close(self):
         # Views into self._mm may still be alive (zero-copy values); the mmap

@@ -1,0 +1,99 @@
+"""@remote function machinery.
+
+Parity: reference `python/ray/remote_function.py:303` (RemoteFunction._remote):
+serialize args (inline small, shm for large), record contained ObjectRefs as
+dependencies, create deterministic return ids, and hand the spec to the local
+runtime (head) or ship it over the worker socket.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ray_tpu.core import serialization
+from ray_tpu.core.config import get_config
+from ray_tpu.core.ids import ObjectID, TaskID
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.task import TaskSpec
+
+_LARGE_ARG_THRESHOLD = 1024 * 1024  # promote args above this to the shm store
+
+
+class RemoteFunction:
+    def __init__(self, fn, **default_options):
+        self._fn = fn
+        self._options = default_options
+        self._fn_id = None
+        self._fn_blob = None
+        self._exported_in: set[int] = set()  # pids this fn was exported from
+        self.__name__ = getattr(fn, "__name__", "remote_fn")
+
+    def _ensure_serialized(self):
+        if self._fn_id is None:
+            self._fn_id, self._fn_blob = serialization.serialize_function(self._fn)
+        return self._fn_id, self._fn_blob
+
+    def options(self, **opts):
+        merged = {**self._options, **opts}
+        clone = RemoteFunction(self._fn, **merged)
+        clone._fn_id, clone._fn_blob = self._ensure_serialized()
+        clone._exported_in = self._exported_in
+        return clone
+
+    def remote(self, *args, **kwargs):
+        return self._remote(args, kwargs, self._options)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function {self.__name__} cannot be called directly; "
+            f"use {self.__name__}.remote().")
+
+    def _remote(self, args, kwargs, opts):
+        from ray_tpu.core.runtime import Runtime, get_runtime
+        rt = get_runtime()
+        fn_id, fn_blob = self._ensure_serialized()
+
+        # Large plain args go to the shm store so the payload frame stays small.
+        args = [_promote_large(rt, a) for a in args]
+        kwargs = {k: _promote_large(rt, v) for k, v in kwargs.items()}
+
+        payload, buffers, refs = serialization.serialize_args(args, kwargs)
+        num_returns = opts.get("num_returns", 1)
+        task_id = TaskID.from_random()
+        return_ids = [os.urandom(16) for _ in range(num_returns)]
+        max_retries = opts.get("max_retries", get_config().task_max_retries_default)
+        spec = TaskSpec(
+            task_id=task_id.binary(),
+            fn_id=fn_id,
+            name=self.__name__,
+            payload=payload,
+            buffers=buffers,
+            return_ids=return_ids,
+            num_cpus=opts.get("num_cpus", 1),
+            num_tpus=opts.get("num_tpus", 0),
+            resources=opts.get("resources"),
+            max_retries=max_retries,
+            retries_left=max_retries,
+            scheduling_strategy=opts.get("scheduling_strategy"),
+            dependencies=[r.id.binary() for r in refs],
+        )
+        if isinstance(rt, Runtime):
+            rt.submit_task(spec, fn_blob)
+        else:
+            if os.getpid() not in self._exported_in:
+                rt.send(("export_fn", fn_id, fn_blob))
+                self._exported_in.add(os.getpid())
+            rt.submit(spec)
+        out = [ObjectRef(ObjectID(rid)) for rid in return_ids]
+        return out[0] if num_returns == 1 else out
+
+
+def _promote_large(rt, value):
+    """ray.put large array-like args implicitly (parity: remote_function.py
+    inlines <100KB, ray.put's the rest)."""
+    if isinstance(value, ObjectRef):
+        return value
+    nbytes = getattr(value, "nbytes", None)
+    if isinstance(nbytes, (int, float)) and nbytes > _LARGE_ARG_THRESHOLD:
+        return rt.put(value)
+    return value

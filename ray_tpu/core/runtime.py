@@ -1,0 +1,911 @@
+"""Head runtime: object directory, scheduler, worker pool, actor lifecycle.
+
+This process plays the roles that the reference splits across three daemons:
+- GCS (`src/ray/gcs/gcs_server/`): actor lifecycle FSM + restarts
+  (gcs_actor_manager.h:328), named-actor registry, KV.
+- raylet (`src/ray/raylet/`): worker pool with prestart + idle cache
+  (worker_pool.h:228), local scheduler with resource accounting
+  (local_task_manager.h:65), dependency manager (dependency_manager.h).
+- core worker submission side (`src/ray/core_worker/transport/`): task queues,
+  inlined-dependency resolution (dependency_resolver.h), actor call ordering
+  (actor_task_submitter.h:78), retries + owner failure handling
+  (task_manager.h:216).
+
+Single-node they share one event loop (the listener thread) + one lock, which
+removes two process hops from the reference's submit path; the multi-node
+split reintroduces a GCS process but keeps this object as the per-node brain.
+"""
+
+from __future__ import annotations
+
+import collections
+import concurrent.futures
+import os
+import selectors
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import uuid
+
+from ray_tpu.core import serialization
+from ray_tpu.core.config import Config, get_config, set_config
+from ray_tpu.core.ids import ActorID, ObjectID, WorkerID
+from ray_tpu.core.object_store import SharedMemoryStore, default_store_size
+from ray_tpu.core.refcount import ReferenceCounter
+from ray_tpu.core.status import (
+    ActorDiedError,
+    GetTimeoutError,
+    RayTpuError,
+    ResourceError,
+    TaskError,
+    WorkerCrashedError,
+)
+from ray_tpu.core.task import ActorCreationSpec, TaskSpec
+from ray_tpu.core.transport import FrameBuffer, send_msg
+
+def _reap_stale_stores(shm_dir: str):
+    """Unlink arenas whose head process died without shutdown()."""
+    import glob as _glob
+    for path in _glob.glob(os.path.join(shm_dir, "ray_tpu_*")):
+        parts = os.path.basename(path).split("_")
+        if len(parts) < 3:
+            continue
+        try:
+            pid = int(parts[2])
+        except ValueError:
+            continue  # old unversioned name; leave it
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        except PermissionError:
+            pass  # alive, owned by someone else
+
+
+IDLE, BUSY, ASSIGNED_ACTOR, DEAD = "idle", "busy", "actor", "dead"
+A_PENDING, A_ALIVE, A_RESTARTING, A_DEAD = "pending", "alive", "restarting", "dead"
+
+
+class WorkerHandle:
+    def __init__(self, worker_id: WorkerID, sock, proc):
+        self.worker_id = worker_id
+        self.sock = sock
+        self.send_lock = threading.Lock()
+        self.proc = proc
+        self.state = IDLE
+        self.connected = threading.Event()
+        self.registered_fns: set[bytes] = set()
+        self.current_task: TaskSpec | None = None
+        self.actor_id: bytes | None = None
+        self.buffer = FrameBuffer()
+
+    def send(self, msg):
+        send_msg(self.sock, msg, self.send_lock)
+
+
+class ActorState:
+    def __init__(self, cspec: ActorCreationSpec):
+        self.cspec = cspec
+        self.state = A_PENDING
+        self.worker: WorkerHandle | None = None
+        self.queued: collections.deque[TaskSpec] = collections.deque()
+        self.inflight: dict[bytes, TaskSpec] = {}  # task_id -> spec
+        self.death_cause = None
+        self.seq = 0
+
+
+class ObjectDirectory:
+    """Owner's object table: where every object is and who is waiting.
+
+    Parity: memory store + ownership-based object directory
+    (`store_provider/memory_store/memory_store.h`,
+    `ownership_based_object_directory.h:39`).
+    """
+
+    def __init__(self):
+        self.entries: dict[bytes, tuple] = {}  # oid -> ("inline", v)|("shm",)|("err", e)
+        self.callbacks: dict[bytes, list] = {}
+        self.lock = threading.Lock()
+
+    def put(self, oid: bytes, entry: tuple):
+        with self.lock:
+            self.entries[oid] = entry
+            cbs = self.callbacks.pop(oid, [])
+        for cb in cbs:
+            cb(entry)
+
+    def lookup(self, oid: bytes):
+        with self.lock:
+            return self.entries.get(oid)
+
+    def on_ready(self, oid: bytes, cb):
+        with self.lock:
+            entry = self.entries.get(oid)
+            if entry is None:
+                self.callbacks.setdefault(oid, []).append(cb)
+                return None
+        cb(entry)
+        return entry
+
+    def discard(self, oid: bytes):
+        with self.lock:
+            self.entries.pop(oid, None)
+
+
+class TaskEventBuffer:
+    """Bounded ring of task state transitions (parity: task_event_buffer.h:225)."""
+
+    def __init__(self, maxlen: int):
+        self.events = collections.deque(maxlen=maxlen)
+
+    def record(self, task_id: bytes, name: str, state: str):
+        self.events.append((time.time(), task_id, name, state))
+
+    def summary(self) -> dict:
+        counts: dict[str, int] = {}
+        for _, _, name, state in self.events:
+            counts[f"{name}:{state}"] = counts.get(f"{name}:{state}", 0) + 1
+        return counts
+
+
+class Runtime:
+    """The head-node runtime singleton (driver side)."""
+
+    def __init__(self, num_cpus=None, num_tpus=None, resources=None,
+                 object_store_memory=None, system_config=None):
+        cfg = Config(system_config)
+        set_config(cfg)
+        self.config = cfg
+        self.session_id = uuid.uuid4().hex[:12]
+        self.session_dir = os.path.join(
+            tempfile.gettempdir(), "ray_tpu", f"session_{self.session_id}")
+        os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
+
+        store_size = object_store_memory or default_store_size(cfg)
+        shm_dir = "/dev/shm" if os.path.isdir("/dev/shm") else self.session_dir
+        _reap_stale_stores(shm_dir)
+        # pid in the name lets the next init reap arenas of crashed drivers.
+        self.store_path = os.path.join(
+            shm_dir, f"ray_tpu_{os.getpid()}_{self.session_id}")
+        self.store = SharedMemoryStore(
+            self.store_path, size=store_size,
+            num_slots=cfg.object_store_hash_slots, create=True)
+
+        # logical resources (parity: scheduling/resource_set.h)
+        from ray_tpu.core.accelerators import detect_tpus
+        detected_tpus = detect_tpus()
+        self.total_resources: dict[str, float] = {
+            "CPU": float(num_cpus if num_cpus is not None else (os.cpu_count() or 1)),
+            "TPU": float(num_tpus if num_tpus is not None else detected_tpus),
+        }
+        for k, v in (resources or {}).items():
+            self.total_resources[k] = float(v)
+        self.available = dict(self.total_resources)
+
+        self.directory = ObjectDirectory()
+        self.refcount = ReferenceCounter(free_callback=self._free_object)
+        self.task_events = TaskEventBuffer(cfg.task_events_buffer_size)
+
+        self.lock = threading.RLock()
+        self.workers: dict[bytes, WorkerHandle] = {}
+        self.idle: collections.deque[WorkerHandle] = collections.deque()
+        self.task_queue: collections.deque[TaskSpec] = collections.deque()
+        self.waiting_deps: dict[bytes, list] = {}  # oid -> [pending items]
+        self.actors: dict[bytes, ActorState] = {}
+        self.named_actors: dict[str, bytes] = {}
+        self.fn_table: dict[bytes, bytes] = {}  # fn_id -> blob
+        self.remote_subs: dict[bytes, list[bytes]] = {}  # oid -> [worker ids]
+        self.pending_actor_assign: collections.deque[bytes] = collections.deque()
+        self._shutdown = False
+        self.kv: dict[tuple, bytes] = {}  # internal KV (parity: gcs_kv_manager.h)
+
+        self._selector = selectors.DefaultSelector()
+        self._sel_lock = threading.Lock()
+        self._listener = threading.Thread(
+            target=self._listen_loop, daemon=True, name="rtpu-listener")
+        self._listener.start()
+
+        pool = cfg.num_workers or int(self.total_resources["CPU"])
+        self.pool_size = max(1, pool)
+        for _ in range(self.pool_size):
+            self._spawn_worker()
+
+    # ---------------- worker pool ----------------
+
+    def _spawn_worker(self) -> WorkerHandle:
+        if self._shutdown:
+            return None
+        import socket as socket_mod
+        parent, child = socket_mod.socketpair(socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+        worker_id = WorkerID.from_random()
+        env = dict(os.environ)
+        env.update(self.config.to_env())
+        env.setdefault("PYTHONPATH", "")
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = repo_root + os.pathsep + env["PYTHONPATH"]
+        # Workers see only logical TPU slots via env; the mesh layer assigns chips.
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.worker",
+             self.store_path, worker_id.hex(), str(child.fileno())],
+            pass_fds=[child.fileno()], env=env, close_fds=True,
+            stdout=open(os.path.join(self.session_dir, "logs",
+                                     f"worker-{worker_id.hex()[:8]}.out"), "ab"),
+            stderr=subprocess.STDOUT)
+        child.close()
+        handle = WorkerHandle(worker_id, parent, proc)
+        with self.lock:
+            self.workers[worker_id.binary()] = handle
+        with self._sel_lock:
+            self._selector.register(parent, selectors.EVENT_READ, handle)
+        return handle
+
+    def _replenish_pool_async(self):
+        def run():
+            with self.lock:
+                n_pool = sum(1 for w in self.workers.values()
+                             if w.state in (IDLE, BUSY))
+                need = self.pool_size - n_pool
+            for _ in range(max(0, need)):
+                self._spawn_worker()
+        threading.Thread(target=run, daemon=True).start()
+
+    # ---------------- listener / message handling ----------------
+
+    def _listen_loop(self):
+        while not self._shutdown:
+            with self._sel_lock:
+                try:
+                    events = self._selector.select(timeout=0.05)
+                except OSError:
+                    continue
+            for key, _mask in events:
+                handle: WorkerHandle = key.data
+                try:
+                    data = key.fileobj.recv(1 << 20)
+                except (BlockingIOError, InterruptedError):
+                    continue
+                except OSError:
+                    data = b""
+                if not data:
+                    self._on_worker_death(handle)
+                    continue
+                handle.buffer.feed(data)
+                for msg in handle.buffer.frames():
+                    try:
+                        self._handle_msg(handle, msg)
+                    except Exception:
+                        import traceback
+                        traceback.print_exc()
+
+    def _handle_msg(self, w: WorkerHandle, msg):
+        op = msg[0]
+        if op == "done":
+            self._on_task_done(w, msg[1], msg[2], msg[3])
+        elif op == "ready":
+            w.connected.set()
+            with self.lock:
+                if self.pending_actor_assign:
+                    aid = self.pending_actor_assign.popleft()
+                    self._assign_actor_locked(self.actors[aid], w)
+                    return
+                w.state = IDLE
+                self.idle.append(w)
+            self._schedule()
+        elif op == "wait_obj":
+            oid = msg[1]
+            wid = w.worker_id.binary()
+
+            def push(entry, oid=oid, wid=wid):
+                self._push_obj_to_worker(wid, oid, entry)
+
+            self.directory.on_ready(oid, push)
+        elif op == "put_notify":
+            self.directory.put(msg[1], ("shm",))
+            self._on_object_ready(msg[1])
+        elif op == "submit":
+            spec: TaskSpec = msg[1]
+            self.submit_task(spec, fn_blob=None)
+        elif op == "export_fn":
+            _, fn_id, blob = msg
+            with self.lock:
+                self.fn_table[fn_id] = blob
+        elif op == "create_actor":
+            self.create_actor(msg[1])
+        elif op == "actor_ready":
+            self._on_actor_ready(msg[1])
+        elif op == "actor_err":
+            self._on_actor_init_error(msg[1], msg[2], msg[3])
+        elif op == "request":
+            self._on_request(w, msg[1], msg[2], msg[3])
+        else:
+            raise RayTpuError(f"head: unknown message {op}")
+
+    def _on_request(self, w: WorkerHandle, req_id, what, arg):
+        """Small synchronous control-plane queries from workers."""
+        if what == "get_actor":
+            aid = self.named_actors.get(arg)
+            resp = None
+            if aid is not None:
+                st = self.actors.get(aid)
+                resp = (aid, st.cspec.name if st else "")
+        elif what == "kv_get":
+            resp = self.kv.get(arg)
+        elif what == "kv_put":
+            self.kv[arg[0]] = arg[1]
+            resp = True
+        elif what == "kv_del":
+            self.kv.pop(arg, None)
+            resp = True
+        elif what == "kill_actor":
+            self.kill_actor_by_id(arg, no_restart=True)
+            resp = True
+        elif what == "actor_methods":
+            st = self.actors.get(arg)
+            resp = (st.cspec.methods_meta or {}) if st else {}
+        elif what == "cluster_resources":
+            resp = dict(self.total_resources)
+        elif what == "available_resources":
+            with self.lock:
+                resp = dict(self.available)
+        else:
+            resp = RayTpuError(f"unknown request {what}")
+        w.send(("resp", req_id, resp))
+
+    def _push_obj_to_worker(self, wid: bytes, oid: bytes, entry):
+        w = self.workers.get(wid)
+        if w is None or w.state == DEAD:
+            return
+        kind = entry[0]
+        if kind == "raw":
+            w.send(("obj", oid, "inline" if entry[3] else "err",
+                    entry[1], entry[2]))
+        elif kind == "inline":
+            payload, bufs, _ = serialization.serialize_value(entry[1])
+            w.send(("obj", oid, "inline", payload, bufs))
+        elif kind == "err":
+            payload, bufs, _ = serialization.serialize_value(entry[1])
+            w.send(("obj", oid, "err", payload, bufs))
+        else:
+            w.send(("obj", oid, "shm", None, None))
+
+    # ---------------- object plane ----------------
+
+    def put(self, value) -> "ObjectRef":
+        from ray_tpu.core.object_ref import ObjectRef
+        oid = ObjectID.from_random()
+        self.store.put_serialized(oid, value)
+        self.directory.put(oid.binary(), ("shm",))
+        return ObjectRef(oid)
+
+    def get(self, refs, timeout=None):
+        from ray_tpu.core.object_ref import ObjectRef
+        single = isinstance(refs, ObjectRef)
+        if single:
+            refs = [refs]
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = []
+        for r in refs:
+            remain = None if deadline is None else max(0.0, deadline - time.monotonic())
+            out.append(self._get_one(r, remain))
+        return out[0] if single else out
+
+    def _get_one(self, ref, timeout=None):
+        entry = self.directory.lookup(ref.id.binary())
+        if entry is None:
+            ev = threading.Event()
+            box = []
+
+            def cb(e):
+                box.append(e)
+                ev.set()
+
+            self.directory.on_ready(ref.id.binary(), cb)
+            if not ev.wait(timeout):
+                raise GetTimeoutError(f"get() timed out on {ref}")
+            entry = box[0]
+        return self._entry_value(ref, entry)
+
+    def _entry_value(self, ref, entry):
+        kind = entry[0]
+        if kind == "raw":
+            value = serialization.deserialize(entry[1], entry[2])
+            if entry[3]:
+                return value
+            entry = ("err", value)
+            kind = "err"
+        if kind == "inline":
+            return entry[1]
+        if kind == "err":
+            e = entry[1]
+            if isinstance(e, TaskError) and e.cause is not None:
+                raise e.cause
+            raise e
+        found, value = self.store.get_deserialized(ref.id, timeout=5.0)
+        if not found:
+            from ray_tpu.core.status import ObjectLostError
+            raise ObjectLostError(ref.id)
+        return value
+
+    def wait(self, refs, num_returns=1, timeout=None):
+        if num_returns > len(refs):
+            raise ValueError("num_returns > len(refs)")
+        cv = threading.Condition()
+        ready_set: set[bytes] = set()
+
+        def mk_cb(oid):
+            def cb(_entry):
+                with cv:
+                    ready_set.add(oid)
+                    cv.notify_all()
+            return cb
+
+        for r in refs:
+            self.directory.on_ready(r.id.binary(), mk_cb(r.id.binary()))
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with cv:
+            while len(ready_set) < num_returns:
+                remain = None if deadline is None else deadline - time.monotonic()
+                if remain is not None and remain <= 0:
+                    break
+                cv.wait(remain if remain is not None else 0.1)
+        ready = [r for r in refs if r.id.binary() in ready_set]
+        not_ready = [r for r in refs if r.id.binary() not in ready_set]
+        overflow = ready[num_returns:]
+        return ready[:num_returns], overflow + not_ready
+
+    def as_future(self, ref) -> concurrent.futures.Future:
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def cb(entry):
+            try:
+                fut.set_result(self._entry_value(ref, entry))
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        self.directory.on_ready(ref.id.binary(), cb)
+        return fut
+
+    def _free_object(self, oid: bytes):
+        self.directory.discard(oid)
+        self.store.delete(ObjectID(oid))
+
+    def _on_object_ready(self, oid: bytes):
+        """Unblock tasks waiting on this dependency + remote subscribers."""
+        with self.lock:
+            waiters = self.waiting_deps.pop(oid, [])
+        for item in waiters:
+            item["pending"] -= 1
+            if item["pending"] == 0:
+                self._enqueue_ready(item)
+        self._schedule()
+
+    # ---------------- task submission / scheduling ----------------
+
+    def export_function(self, fn_id: bytes, blob: bytes):
+        with self.lock:
+            self.fn_table[fn_id] = blob
+
+    def submit_task(self, spec: TaskSpec, fn_blob: bytes | None = None):
+        if fn_blob is not None:
+            self.export_function(spec.fn_id, fn_blob)
+        self.task_events.record(spec.task_id, spec.describe(), "SUBMITTED")
+        # Pin dependencies for the task's lifetime so the owner cannot free
+        # them between submit and execution (conservative borrower counting).
+        for oid in spec.dependencies or []:
+            self.refcount.pin(oid)
+        item = {"kind": "task", "spec": spec, "pending": 0}
+        self._gate_on_deps(item, spec.dependencies or [])
+
+    def _unpin_deps(self, spec: TaskSpec):
+        for oid in spec.dependencies or []:
+            self.refcount.unpin(oid)
+
+    def _gate_on_deps(self, item, deps):
+        with self.lock:
+            for oid in deps:
+                entry = self.directory.lookup(oid)
+                if entry is None:
+                    item["pending"] += 1
+                    self.waiting_deps.setdefault(oid, []).append(item)
+            ready = item["pending"] == 0
+        if ready:
+            self._enqueue_ready(item)
+
+    def _enqueue_ready(self, item):
+        if item["kind"] == "task":
+            spec = item["spec"]
+            self._inline_ready_deps(spec)
+            if spec.actor_id is not None:
+                self._submit_actor_task(spec)
+                return
+            with self.lock:
+                self.task_queue.append(spec)
+            self._schedule()
+        else:
+            self._create_actor_now(item["cspec"])
+
+    def _inline_ready_deps(self, spec: TaskSpec):
+        """Ship owner-memory values with the spec (parity: dependency_resolver.h
+        inlines small owner-local objects into the TaskSpec)."""
+        for oid in spec.dependencies or []:
+            entry = self.directory.lookup(oid)
+            if entry is None:
+                continue
+            if entry[0] == "raw":
+                spec.inline_deps[oid] = (entry[1], entry[2])
+            elif entry[0] in ("inline", "err"):
+                payload, bufs, _ = serialization.serialize_value(entry[1])
+                spec.inline_deps[oid] = (payload, bufs)
+
+    def _resources_of(self, spec: TaskSpec) -> dict[str, float]:
+        req = dict(spec.resources or {})
+        if spec.num_cpus:
+            req["CPU"] = req.get("CPU", 0.0) + spec.num_cpus
+        if spec.num_tpus:
+            req["TPU"] = req.get("TPU", 0.0) + spec.num_tpus
+        return req
+
+    def _try_reserve(self, req: dict[str, float]) -> bool:
+        for k, v in req.items():
+            if self.available.get(k, 0.0) + 1e-9 < v:
+                return False
+        for k, v in req.items():
+            self.available[k] -= v
+        return True
+
+    def _release(self, req: dict[str, float]):
+        for k, v in req.items():
+            self.available[k] = self.available.get(k, 0.0) + v
+
+    def _check_feasible(self, req: dict[str, float], what: str):
+        for k, v in req.items():
+            if self.total_resources.get(k, 0.0) < v:
+                raise ResourceError(
+                    f"{what} requires {{{k}: {v}}} but the cluster total is "
+                    f"{{{k}: {self.total_resources.get(k, 0.0)}}}")
+
+    def _schedule(self):
+        """Dispatch every feasible queued task to an idle worker."""
+        dispatches = []
+        with self.lock:
+            remaining = collections.deque()
+            while self.task_queue:
+                spec = self.task_queue.popleft()
+                if not self.idle:
+                    remaining.append(spec)
+                    break
+                req = self._resources_of(spec)
+                if not self._try_reserve(req):
+                    remaining.append(spec)
+                    continue
+                w = self.idle.popleft()
+                w.state = BUSY
+                w.current_task = spec
+                dispatches.append((w, spec, req))
+            remaining.extend(self.task_queue)
+            self.task_queue = remaining
+        for w, spec, req in dispatches:
+            self._dispatch(w, spec)
+
+    def _dispatch(self, w: WorkerHandle, spec: TaskSpec):
+        self.task_events.record(spec.task_id, spec.describe(), "RUNNING")
+        if spec.fn_id and spec.fn_id not in w.registered_fns:
+            blob = self.fn_table.get(spec.fn_id)
+            if blob is None:
+                self._fail_returns(spec, RayTpuError(
+                    f"function {spec.fn_id.hex()} was never exported"))
+                with self.lock:  # return the reserved worker + resources
+                    self._release(self._resources_of(spec))
+                    w.current_task = None
+                    w.state = IDLE
+                    self.idle.append(w)
+                return
+            w.send(("reg_fn", spec.fn_id, blob))
+            w.registered_fns.add(spec.fn_id)
+        w.send(("exec", spec))
+
+    def _on_task_done(self, w: WorkerHandle, task_id: bytes,
+                      actor_id: bytes | None, outs):
+        for rid, status, payload, bufs in outs:
+            # Inline payloads stay pickled until someone reads them — the
+            # listener thread must not burn CPU deserializing results that may
+            # only ever be forwarded to another worker.
+            if status == "inline":
+                self.directory.put(rid, ("raw", payload, bufs, True))
+            elif status == "err":
+                self.directory.put(rid, ("raw", payload, bufs, False))
+            else:
+                self.directory.put(rid, ("shm",))
+            self._on_object_ready(rid)
+        if actor_id is not None:
+            st = self.actors.get(actor_id)
+            if st is not None:
+                spec = st.inflight.pop(task_id, None)
+                if spec is not None:
+                    self.task_events.record(task_id, spec.describe(), "FINISHED")
+                    self._unpin_deps(spec)
+            return
+        spec = w.current_task
+        if spec is not None:
+            self.task_events.record(task_id, spec.describe(), "FINISHED")
+            self._unpin_deps(spec)
+            req = self._resources_of(spec)
+            with self.lock:
+                self._release(req)
+                w.current_task = None
+                w.state = IDLE
+                self.idle.append(w)
+        self._schedule()
+
+    def _fail_returns(self, spec: TaskSpec, exc: Exception):
+        err = exc if isinstance(exc, TaskError) else TaskError(
+            exc, str(exc), spec.describe())
+        self._unpin_deps(spec)
+        for rid in spec.return_ids:
+            self.directory.put(rid, ("err", err))
+            self._on_object_ready(rid)
+
+    # ---------------- actors ----------------
+
+    def create_actor(self, cspec: ActorCreationSpec, fn_blob: bytes | None = None,
+                     dependencies=None):
+        if fn_blob is not None:
+            self.export_function(cspec.cls_id, fn_blob)
+        req = {"CPU": cspec.num_cpus or 0.0, "TPU": cspec.num_tpus or 0.0,
+               **(cspec.resources or {})}
+        self._check_feasible({k: v for k, v in req.items() if v}, cspec.name)
+        st = ActorState(cspec)
+        with self.lock:
+            self.actors[cspec.actor_id] = st
+            if cspec.name:
+                if cspec.name in self.named_actors:
+                    raise RayTpuError(f"actor name {cspec.name!r} already taken")
+                self.named_actors[cspec.name] = cspec.actor_id
+        item = {"kind": "actor", "cspec": cspec, "pending": 0}
+        self._gate_on_deps(item, dependencies or cspec.dependencies or [])
+
+    def _create_actor_now(self, cspec: ActorCreationSpec):
+        st = self.actors[cspec.actor_id]
+        with self.lock:
+            w = self.idle.popleft() if self.idle else None
+            if w is not None:
+                self._assign_actor_locked(st, w)
+                spawn_new = True
+            else:
+                self.pending_actor_assign.append(cspec.actor_id)
+                spawn_new = False
+        # Keep the pool at size for plain tasks; new process feeds the pool
+        # (or picks up the pending assignment on connect).
+        if spawn_new:
+            self._replenish_pool_async()
+        else:
+            threading.Thread(target=self._spawn_worker, daemon=True).start()
+
+    def _assign_actor_locked(self, st: ActorState, w: WorkerHandle):
+        cspec = st.cspec
+        w.state = ASSIGNED_ACTOR
+        w.actor_id = cspec.actor_id
+        st.worker = w
+        blob = self.fn_table.get(cspec.cls_id)
+        w.send(("reg_fn", cspec.cls_id, blob))
+        w.registered_fns.add(cspec.cls_id)
+        w.send(("create_actor", cspec))
+
+    def _on_actor_ready(self, actor_id: bytes):
+        st = self.actors.get(actor_id)
+        if st is None:
+            return
+        with self.lock:
+            st.state = A_ALIVE
+            queued = list(st.queued)
+            st.queued.clear()
+        for spec in queued:
+            self._send_actor_task(st, spec)
+
+    def _on_actor_init_error(self, actor_id: bytes, payload, bufs):
+        st = self.actors.get(actor_id)
+        if st is None:
+            return
+        err = serialization.deserialize(payload, bufs)
+        st.state = A_DEAD
+        st.death_cause = err
+        for spec in list(st.queued):
+            self._fail_returns(spec, err)
+        st.queued.clear()
+        with self.lock:
+            name = st.cspec.name
+            if name and self.named_actors.get(name) == st.cspec.actor_id:
+                del self.named_actors[name]
+
+    def _submit_actor_task(self, spec: TaskSpec):
+        st = self.actors.get(spec.actor_id)
+        if st is None or st.state == A_DEAD:
+            cause = st.death_cause if st else None
+            self._fail_returns(spec, cause if isinstance(cause, Exception)
+                               else ActorDiedError(msg="actor is dead"))
+            return
+        self.task_events.record(spec.task_id, spec.describe(), "SUBMITTED")
+        with self.lock:
+            spec.seq_no = st.seq
+            st.seq += 1
+            if spec.retries_left is None or spec.retries_left == 0:
+                spec.retries_left = st.cspec.max_task_retries or 0
+            if st.state in (A_PENDING, A_RESTARTING):
+                st.queued.append(spec)
+                return
+        self._send_actor_task(st, spec)
+
+    def _send_actor_task(self, st: ActorState, spec: TaskSpec):
+        st.inflight[spec.task_id] = spec
+        self.task_events.record(spec.task_id, spec.describe(), "RUNNING")
+        st.worker.send(("exec", spec))
+
+    def kill_actor_by_id(self, actor_id: bytes, no_restart=True):
+        st = self.actors.get(actor_id)
+        if st is None:
+            return
+        st.cspec.max_restarts = 0 if no_restart else st.cspec.max_restarts
+        w = st.worker
+        if w is not None and w.proc is not None:
+            try:
+                w.proc.kill()
+            except ProcessLookupError:
+                pass
+
+    # ---------------- failure handling ----------------
+
+    def _on_worker_death(self, w: WorkerHandle):
+        if w.state == DEAD:
+            return
+        with self._sel_lock:
+            try:
+                self._selector.unregister(w.sock)
+            except (KeyError, ValueError):
+                pass
+        try:
+            w.sock.close()
+        except OSError:
+            pass
+        prev_state = w.state
+        w.state = DEAD
+        with self.lock:
+            try:
+                self.idle.remove(w)
+            except ValueError:
+                pass
+        if prev_state == BUSY and w.current_task is not None:
+            spec = w.current_task
+            with self.lock:
+                self._release(self._resources_of(spec))
+            if (spec.retries_left or 0) > 0:
+                spec.retries_left -= 1
+                self.task_events.record(spec.task_id, spec.describe(), "RETRY")
+                with self.lock:
+                    self.task_queue.appendleft(spec)
+            else:
+                self._fail_returns(spec, WorkerCrashedError(
+                    f"worker died executing {spec.describe()}"))
+        if w.actor_id is not None:
+            self._on_actor_worker_death(w.actor_id)
+        if prev_state in (IDLE, BUSY) and not self._shutdown:
+            self._replenish_pool_async()
+        self._schedule()
+
+    def _on_actor_worker_death(self, actor_id: bytes):
+        st = self.actors.get(actor_id)
+        if st is None or st.state == A_DEAD:
+            return
+        cspec = st.cspec
+        inflight = list(st.inflight.values())
+        st.inflight.clear()
+        if cspec.restarts_used < (cspec.max_restarts or 0):
+            cspec.restarts_used += 1
+            st.state = A_RESTARTING
+            st.worker = None
+            retried = []
+            for spec in inflight:
+                if (spec.retries_left or 0) > 0:
+                    spec.retries_left -= 1
+                    retried.append(spec)
+                else:
+                    self._fail_returns(spec, ActorDiedError(
+                        msg=f"actor {cspec.name} died; call retries exhausted"))
+            # Replay ahead of anything queued later, preserving submission order.
+            st.queued.extendleft(reversed(retried))
+            with self.lock:
+                self.pending_actor_assign.append(actor_id)
+            threading.Thread(target=self._spawn_worker, daemon=True).start()
+        else:
+            st.state = A_DEAD
+            st.death_cause = ActorDiedError(msg=f"actor {cspec.name} died")
+            for spec in inflight:
+                self._fail_returns(spec, st.death_cause)
+            for spec in list(st.queued):
+                self._fail_returns(spec, st.death_cause)
+            st.queued.clear()
+            with self.lock:
+                if cspec.name and self.named_actors.get(cspec.name) == actor_id:
+                    del self.named_actors[cspec.name]
+
+    # ---------------- introspection ----------------
+
+    def cluster_resources(self) -> dict[str, float]:
+        return dict(self.total_resources)
+
+    def available_resources(self) -> dict[str, float]:
+        with self.lock:
+            return dict(self.available)
+
+    def get_actor_state(self, actor_id: bytes) -> str:
+        st = self.actors.get(actor_id)
+        return st.state if st else "unknown"
+
+    def timeline(self):
+        return list(self.task_events.events)
+
+    # ---------------- shutdown ----------------
+
+    def shutdown(self):
+        if self._shutdown:
+            return
+        self._shutdown = True
+        for w in list(self.workers.values()):
+            if w.state != DEAD:
+                try:
+                    w.send(("shutdown",))
+                except OSError:
+                    pass
+        deadline = time.monotonic() + 2.0
+        for w in list(self.workers.values()):
+            if w.proc is None:
+                continue
+            try:
+                w.proc.wait(timeout=max(0.05, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                w.proc.kill()
+        self.store.close()
+        self.store.unlink()
+
+
+# ---------------- global runtime plumbing ----------------
+
+_runtime: Runtime | None = None
+_worker_runtime = None
+
+
+def set_worker_runtime(rt):
+    global _worker_runtime
+    _worker_runtime = rt
+
+
+def current_runtime():
+    """Driver Runtime, WorkerRuntime, or None — whatever this process has."""
+    return _worker_runtime if _worker_runtime is not None else _runtime
+
+
+def get_runtime():
+    rt = current_runtime()
+    if rt is None:
+        from ray_tpu.core.status import RuntimeNotInitializedError
+        raise RuntimeNotInitializedError()
+    return rt
+
+
+def init_runtime(**kw) -> Runtime:
+    global _runtime
+    if _runtime is not None:
+        return _runtime
+    _runtime = Runtime(**kw)
+    return _runtime
+
+
+def shutdown_runtime():
+    global _runtime
+    if _runtime is not None:
+        _runtime.shutdown()
+        _runtime = None

@@ -1,0 +1,64 @@
+"""Argument/value serialization with ObjectRef capture.
+
+Parity: reference `python/ray/_private/serialization.py` (SerializationContext):
+pickle-5 for values, cloudpickle for functions/classes, and ObjectRefs found
+anywhere inside a value are recorded so the submitter can (a) wait on them as
+dependencies and (b) ship inline values for refs that only exist in the
+owner's in-process memory store.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import pickle
+
+import cloudpickle
+
+from ray_tpu.core.object_ref import ObjectRef
+
+
+class _CollectingPickler(pickle.Pickler):
+    """Pickles a value while recording every ObjectRef inside it."""
+
+    def __init__(self, file, buffer_callback=None):
+        super().__init__(file, protocol=5, buffer_callback=buffer_callback)
+        self.contained_refs: list[ObjectRef] = []
+
+    def reducer_override(self, obj):
+        if isinstance(obj, ObjectRef):
+            self.contained_refs.append(obj)
+            return obj.__reduce__()
+        return NotImplemented
+
+
+def serialize_args(args, kwargs):
+    """Returns (payload_bytes, buffers, contained_refs)."""
+    buffers: list[pickle.PickleBuffer] = []
+    f = io.BytesIO()
+    p = _CollectingPickler(f, buffer_callback=buffers.append)
+    p.dump((args, kwargs))
+    return f.getvalue(), [b.raw() for b in buffers], p.contained_refs
+
+
+def serialize_value(value):
+    """Returns (payload_bytes, buffers, contained_refs)."""
+    buffers: list[pickle.PickleBuffer] = []
+    f = io.BytesIO()
+    p = _CollectingPickler(f, buffer_callback=buffers.append)
+    p.dump(value)
+    return f.getvalue(), [b.raw() for b in buffers], p.contained_refs
+
+
+def deserialize(payload: bytes, buffers=()):
+    return pickle.loads(payload, buffers=buffers)
+
+
+def serialize_function(fn) -> tuple[bytes, bytes]:
+    """Returns (function_id, pickled). Deterministic id so workers cache."""
+    blob = cloudpickle.dumps(fn)
+    return hashlib.sha256(blob).digest()[:16], blob
+
+
+def total_nbytes(payload: bytes, buffers) -> int:
+    return len(payload) + sum(len(b) for b in buffers)

@@ -44,6 +44,15 @@ class TaskError(RayTpuError):
         tb = "".join(traceback.format_exception(type(exc), exc, exc.__traceback__))
         return cls(exc, tb, task_desc)
 
+    def __reduce__(self):
+        import pickle
+        try:
+            pickle.dumps(self.cause)
+            cause = self.cause
+        except Exception:
+            cause = None  # unpicklable cause: keep the formatted traceback only
+        return (TaskError, (cause, self.tb_str, self.task_desc))
+
 
 class WorkerCrashedError(RayTpuError):
     pass

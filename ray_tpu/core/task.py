@@ -1,0 +1,75 @@
+"""Task specification shipped from submitter to executor.
+
+Parity: reference `src/ray/common/task/task_spec.h` / `common.proto` TaskSpec.
+Kept as a __slots__ class pickled whole — the single-node transport is pickle
+frames, so a protobuf round trip would only add overhead.
+"""
+
+from __future__ import annotations
+
+
+class TaskSpec:
+    __slots__ = (
+        "task_id",        # bytes
+        "fn_id",          # bytes (sha of cloudpickled fn / class)
+        "name",           # human-readable
+        "payload",        # pickled (args, kwargs)
+        "buffers",        # out-of-band buffers
+        "inline_deps",    # {oid_bytes: (payload, buffers)} values only the owner had
+        "return_ids",     # [bytes]
+        "num_cpus",
+        "num_tpus",
+        "resources",      # {name: amount}
+        "max_retries",
+        "retries_left",
+        "actor_id",       # bytes | None — actor task if set
+        "method_name",    # str | None
+        "seq_no",         # per-actor submission order
+        "owner",          # worker_id bytes of submitter (None = driver)
+        "scheduling_strategy",
+        "dependencies",   # [oid_bytes] that must be ready before dispatch
+    )
+
+    def __init__(self, **kw):
+        for s in self.__slots__:
+            setattr(self, s, kw.get(s))
+        if self.resources is None:
+            self.resources = {}
+        if self.inline_deps is None:
+            self.inline_deps = {}
+
+    def __reduce__(self):
+        return (TaskSpec._from_tuple, (tuple(getattr(self, s) for s in self.__slots__),))
+
+    @staticmethod
+    def _from_tuple(t):
+        obj = TaskSpec.__new__(TaskSpec)
+        for s, v in zip(TaskSpec.__slots__, t):
+            object.__setattr__(obj, s, v)
+        return obj
+
+    def describe(self) -> str:
+        if self.actor_id is not None:
+            return f"{self.name}.{self.method_name}"
+        return self.name or "task"
+
+
+class ActorCreationSpec:
+    """Constructor spec kept by the control plane for restarts.
+
+    Parity: `gcs_actor_manager.h:328` (GCS owns the actor lifecycle FSM and
+    replays creation on restart).
+    """
+
+    __slots__ = ("actor_id", "cls_id", "cls_blob", "name", "payload", "buffers",
+                 "max_restarts", "restarts_used", "max_concurrency", "is_async",
+                 "num_cpus", "num_tpus", "resources", "max_task_retries",
+                 "placement_group_id", "bundle_index", "runtime_env",
+                 "dependencies", "methods_meta")
+
+    def __init__(self, **kw):
+        for s in self.__slots__:
+            setattr(self, s, kw.get(s))
+        if self.resources is None:
+            self.resources = {}
+        self.restarts_used = self.restarts_used or 0

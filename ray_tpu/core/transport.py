@@ -1,0 +1,141 @@
+"""Framed message transport over unix sockets.
+
+Parity: reference `src/ray/rpc/` (GrpcServer/GrpcClient) — but single-node IPC
+here is a length-prefixed pickle frame over a socketpair, which is the latency
+floor for Python peers; the multi-node path (ray_tpu.core.cluster) layers the
+same frames over TCP. Fault-injection hooks (`testing_rpc_failure`,
+`testing_delay_us` config, parity `src/ray/rpc/rpc_chaos.h:23`) live here so
+every message path is chaos-testable.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import random
+import socket
+import struct
+import threading
+import time
+
+_HDR = struct.Struct("<Q")
+
+
+class ChaosInjector:
+    """Drops or delays messages by op name, per config flags."""
+
+    def __init__(self, failure_spec: str = "", delay_spec: str = ""):
+        self._fail: dict[str, int] = {}
+        self._delay: dict[str, tuple[float, float]] = {}
+        for part in filter(None, failure_spec.split(",")):
+            meth, n = part.split("=")
+            self._fail[meth] = int(n)
+        for part in filter(None, delay_spec.split(",")):
+            meth, rng = part.split("=")
+            lo, hi = rng.split(":")
+            self._delay[meth] = (float(lo) / 1e6, float(hi) / 1e6)
+
+    def maybe_drop(self, op: str) -> bool:
+        left = self._fail.get(op)
+        if left:
+            self._fail[op] = left - 1
+            return True
+        return False
+
+    def maybe_delay(self, op: str):
+        rng = self._delay.get(op)
+        if rng:
+            time.sleep(random.uniform(*rng))
+
+
+_chaos: ChaosInjector | None = None
+
+
+def get_chaos() -> ChaosInjector:
+    global _chaos
+    if _chaos is None:
+        from ray_tpu.core.config import get_config
+        cfg = get_config()
+        _chaos = ChaosInjector(cfg.testing_rpc_failure, cfg.testing_delay_us)
+    return _chaos
+
+
+def send_msg(sock: socket.socket, msg, lock: threading.Lock | None = None):
+    op = msg[0] if isinstance(msg, tuple) and msg else ""
+    chaos = get_chaos()
+    chaos.maybe_delay(op)
+    if chaos.maybe_drop(op):
+        return
+    payload = pickle.dumps(msg, protocol=5)
+    data = _HDR.pack(len(payload)) + payload
+    if lock:
+        with lock:
+            sock.sendall(data)
+    else:
+        sock.sendall(data)
+
+
+def recv_msg(sock: socket.socket):
+    """Blocking receive of one frame; returns None on clean EOF."""
+    hdr = _recv_exact(sock, _HDR.size)
+    if hdr is None:
+        return None
+    (n,) = _HDR.unpack(hdr)
+    payload = _recv_exact(sock, n)
+    if payload is None:
+        return None
+    return pickle.loads(payload)
+
+
+def _recv_exact(sock: socket.socket, n: int):
+    chunks = []
+    while n:
+        try:
+            chunk = sock.recv(n)
+        except (ConnectionResetError, OSError):
+            return None
+        if not chunk:
+            return None
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+class FrameBuffer:
+    """Incremental frame decoder for the driver's selector loop."""
+
+    def __init__(self):
+        self._buf = bytearray()
+
+    def feed(self, data: bytes):
+        self._buf.extend(data)
+
+    def frames(self):
+        out = []
+        while True:
+            if len(self._buf) < _HDR.size:
+                break
+            (n,) = _HDR.unpack_from(self._buf, 0)
+            if len(self._buf) < _HDR.size + n:
+                break
+            payload = bytes(self._buf[_HDR.size : _HDR.size + n])
+            del self._buf[: _HDR.size + n]
+            out.append(pickle.loads(payload))
+        return out
+
+
+def make_socketpair():
+    a, b = socket.socketpair(socket.AF_UNIX, socket.SOCK_STREAM)
+    return a, b
+
+
+def socket_from_fd(fd: int) -> socket.socket:
+    return socket.socket(socket.AF_UNIX, socket.SOCK_STREAM, fileno=fd)
+
+
+def free_tcp_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port

@@ -1,0 +1,408 @@
+"""Worker process: executes tasks and hosts actors.
+
+Parity: reference `python/ray/_private/workers/default_worker.py` +
+`src/ray/core_worker/` execution side (`transport/task_receiver.h`,
+`actor_scheduling_queue.h`, async-actor fibers `transport/fiber.h`) and the
+task-execution callback `python/ray/_raylet.pyx:1727 execute_task`.
+
+One socket to the head multiplexes: inbound task dispatch, and outbound
+API calls (nested task submission, object waits) + results. A receiver
+thread routes frames; execution happens on the main executor thread, a
+thread pool (threaded actors), or an asyncio loop (async actors).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import inspect
+import os
+import sys
+import threading
+import traceback
+
+import cloudpickle
+
+from ray_tpu.core import serialization
+from ray_tpu.core.config import Config, set_config, get_config
+from ray_tpu.core.ids import ObjectID, WorkerID
+from ray_tpu.core.object_store import SharedMemoryStore
+from ray_tpu.core.status import TaskError
+from ray_tpu.core.task import TaskSpec
+from ray_tpu.core.transport import recv_msg, send_msg, socket_from_fd
+
+
+class _NoopRefCounter:
+    """Borrower-side refcounting is conservative: the owner pins objects for
+    the lifetime of tasks that reference them (runtime.submit_task), so
+    borrower handles do not count."""
+
+    def add_local_ref(self, object_id):
+        pass
+
+    def remove_local_ref(self, object_id):
+        pass
+
+
+class WorkerRuntime:
+    """Per-worker client runtime; the worker-side half of the core API."""
+
+    def __init__(self, sock, worker_id: WorkerID, store_path: str):
+        self.sock = sock
+        self.send_lock = threading.Lock()
+        self.worker_id = worker_id
+        self.store_path = store_path
+        self._store: SharedMemoryStore | None = None
+        self.functions: dict[bytes, object] = {}
+        self.object_cache: dict[bytes, object] = {}
+        self.object_errors: dict[bytes, object] = {}
+        self._pending_waits: dict[bytes, list[threading.Event]] = {}
+        self._wait_lock = threading.Lock()
+        self.task_queue: "queue.Queue" = None  # set in main
+        self.actor_instance = None
+        self.actor_id: bytes | None = None
+        self.shutdown = threading.Event()
+        self.current_task_name = ""
+        self.refcount = _NoopRefCounter()
+        self._req_lock = threading.Lock()
+        self._req_seq = 0
+        self._req_futures: dict[int, "concurrent.futures.Future"] = {}
+
+    # -- object plane --
+
+    @property
+    def store(self) -> SharedMemoryStore:
+        if self._store is None:
+            self._store = SharedMemoryStore(self.store_path)
+        return self._store
+
+    def put(self, value):
+        from ray_tpu.core.object_ref import ObjectRef
+        oid = ObjectID.from_random()
+        self.store.put_serialized(oid, value)
+        self.send(("put_notify", oid.binary()))
+        return ObjectRef(oid, owner=self.worker_id.binary(), _add_ref=False)
+
+    def get(self, refs, timeout=None):
+        from ray_tpu.core.object_ref import ObjectRef
+        single = isinstance(refs, ObjectRef)
+        if single:
+            refs = [refs]
+        out = [self._get_one(r, timeout) for r in refs]
+        return out[0] if single else out
+
+    def _get_one(self, ref, timeout=None):
+        oid = ref.id.binary()
+        if oid in self.object_cache:
+            return self._raise_if_error(self.object_cache[oid])
+        found, value = self.store.get_deserialized(ref.id, timeout=0)
+        if found:
+            return value
+        # Ask the owner; block until the push arrives.
+        ev = threading.Event()
+        with self._wait_lock:
+            self._pending_waits.setdefault(oid, []).append(ev)
+        self.send(("wait_obj", oid))
+        if not ev.wait(timeout):
+            from ray_tpu.core.status import GetTimeoutError
+            raise GetTimeoutError(f"get() timed out on {ref}")
+        if oid in self.object_cache:
+            return self._raise_if_error(self.object_cache[oid])
+        found, value = self.store.get_deserialized(ref.id, timeout=5.0)
+        if found:
+            return value
+        from ray_tpu.core.status import ObjectLostError
+        raise ObjectLostError(ref.id)
+
+    @staticmethod
+    def _raise_if_error(value):
+        if isinstance(value, TaskError):
+            raise value.cause if value.cause is not None else value
+        if isinstance(value, Exception):
+            raise value
+        return value
+
+    def wait(self, refs, num_returns=1, timeout=None):
+        import time as _t
+        if num_returns > len(refs):
+            raise ValueError("num_returns > len(refs)")
+        deadline = None if timeout is None else _t.monotonic() + timeout
+        subscribed: dict[bytes, threading.Event] = {}
+
+        def is_ready(r) -> bool:
+            oid = r.id.binary()
+            if oid in self.object_cache or self.store.contains(r.id):
+                return True
+            ev = subscribed.get(oid)
+            if ev is not None and ev.is_set():
+                return True
+            if ev is None:  # subscribe exactly once per ref
+                ev = threading.Event()
+                subscribed[oid] = ev
+                with self._wait_lock:
+                    self._pending_waits.setdefault(oid, []).append(ev)
+                self.send(("wait_obj", oid))
+            return False
+
+        while True:
+            ready = [r for r in refs if is_ready(r)]
+            if len(ready) >= num_returns:
+                break
+            if deadline is not None and _t.monotonic() > deadline:
+                break
+            _t.sleep(0.002)
+        ready_set = {r.id.binary() for r in ready[:num_returns]}
+        ready = [r for r in refs if r.id.binary() in ready_set]
+        not_ready = [r for r in refs if r.id.binary() not in ready_set]
+        return ready, not_ready
+
+    # -- task submission from inside a worker --
+
+    def submit(self, spec: TaskSpec):
+        self.send(("submit", spec))
+
+    def send(self, msg):
+        send_msg(self.sock, msg, self.send_lock)
+
+    def request(self, what, arg=None, timeout=30.0):
+        """Synchronous control-plane query to the head."""
+        fut = concurrent.futures.Future()
+        with self._req_lock:
+            self._req_seq += 1
+            req_id = self._req_seq
+            self._req_futures[req_id] = fut
+        self.send(("request", req_id, what, arg))
+        result = fut.result(timeout)
+        if isinstance(result, Exception):
+            raise result
+        return result
+
+    # -- frame routing --
+
+    def handle_push(self, msg):
+        op = msg[0]
+        if op == "obj":
+            _, oid, status, payload, bufs = msg
+            if status == "inline":
+                self.object_cache[oid] = serialization.deserialize(payload, bufs)
+            elif status == "err":
+                self.object_cache[oid] = serialization.deserialize(payload, bufs)
+            # "shm": value readable from the store
+            with self._wait_lock:
+                for ev in self._pending_waits.pop(oid, []):
+                    ev.set()
+        elif op == "reg_fn":
+            _, fn_id, blob = msg
+            self.functions[fn_id] = cloudpickle.loads(blob)
+        elif op == "resp":
+            _, req_id, result = msg
+            with self._req_lock:
+                fut = self._req_futures.pop(req_id, None)
+            if fut is not None:
+                fut.set_result(result)
+        else:
+            raise RuntimeError(f"worker: unknown push {op}")
+
+
+GLOBAL: WorkerRuntime | None = None
+
+
+def _resolve_arg(rt: WorkerRuntime, obj):
+    from ray_tpu.core.object_ref import ObjectRef
+    if isinstance(obj, ObjectRef):
+        return rt._get_one(obj, timeout=60.0)
+    return obj
+
+
+def _execute(rt: WorkerRuntime, spec: TaskSpec, fn):
+    """Runs one task; returns ('ok'|'err', value_or_TaskError)."""
+    for oid, (payload, bufs) in spec.inline_deps.items():
+        rt.object_cache[oid] = serialization.deserialize(payload, bufs)
+    try:
+        args, kwargs = serialization.deserialize(spec.payload, spec.buffers)
+        args = [_resolve_arg(rt, a) for a in args]
+        kwargs = {k: _resolve_arg(rt, v) for k, v in kwargs.items()}
+        rt.current_task_name = spec.describe()
+        result = fn(*args, **kwargs)
+        if inspect.iscoroutine(result):
+            result = asyncio.get_event_loop().run_until_complete(result)
+        return "ok", result
+    except BaseException as e:  # noqa: BLE001 — errors cross the wire
+        return "err", TaskError.from_exception(e, spec.describe())
+
+
+def _reply_result(rt: WorkerRuntime, spec: TaskSpec, status, result):
+    cfg = get_config()
+    n_returns = len(spec.return_ids)
+    if status == "ok" and n_returns > 1:
+        results = list(result) if isinstance(result, (tuple, list)) else [result]
+        if len(results) != n_returns:
+            status = "err"
+            result = TaskError.from_exception(
+                ValueError(f"task returned {len(results)} values, expected {n_returns}"),
+                spec.describe())
+    if status == "err":
+        payload, bufs, _ = serialization.serialize_value(result)
+        rt.send(("done", spec.task_id, spec.actor_id,
+                 [(rid, "err", payload, bufs) for rid in spec.return_ids]))
+        return
+    values = results if n_returns > 1 else [result]
+    outs = []
+    for rid, value in zip(spec.return_ids, values):
+        payload, bufs, _ = serialization.serialize_value(value)
+        if serialization.total_nbytes(payload, bufs) <= cfg.max_inline_object_bytes:
+            outs.append((rid, "inline", payload, bufs))
+        else:
+            rt.store.put_serialized(ObjectID(rid), value)
+            outs.append((rid, "shm", None, None))
+    rt.send(("done", spec.task_id, spec.actor_id, outs))
+
+
+async def _execute_async(rt, spec, fn):
+    for oid, (payload, bufs) in spec.inline_deps.items():
+        rt.object_cache[oid] = serialization.deserialize(payload, bufs)
+    try:
+        args, kwargs = serialization.deserialize(spec.payload, spec.buffers)
+        loop = asyncio.get_running_loop()
+        args = [await loop.run_in_executor(None, _resolve_arg, rt, a) for a in args]
+        kwargs = {k: await loop.run_in_executor(None, _resolve_arg, rt, v)
+                  for k, v in kwargs.items()}
+        result = fn(*args, **kwargs)
+        if inspect.iscoroutine(result):
+            result = await result
+        return "ok", result
+    except BaseException as e:  # noqa: BLE001
+        return "err", TaskError.from_exception(e, spec.describe())
+
+
+def _run_actor_async(rt: WorkerRuntime, max_concurrency: int):
+    """Asyncio executor for async actors (parity: fiber.h async actors)."""
+    import queue as q
+
+    async def main():
+        sem = asyncio.Semaphore(max_concurrency or 1000)
+        loop = asyncio.get_running_loop()
+
+        async def run_one(spec, fn):
+            async with sem:
+                status, result = await _execute_async(rt, spec, fn)
+                await loop.run_in_executor(None, _reply_result, rt, spec, status, result)
+
+        while not rt.shutdown.is_set():
+            try:
+                spec = await loop.run_in_executor(None, rt.task_queue.get, True, 0.1)
+            except q.Empty:
+                continue
+            if spec is None:
+                break
+            fn = _actor_method(rt, spec)
+            asyncio.ensure_future(run_one(spec, fn))
+
+    asyncio.run(main())
+
+
+def _actor_method(rt: WorkerRuntime, spec: TaskSpec):
+    method = getattr(rt.actor_instance, spec.method_name)
+    return method
+
+
+def main():
+    store_path = sys.argv[1]
+    worker_id = WorkerID.from_hex(sys.argv[2])
+    fd = int(sys.argv[3])
+    set_config(Config.from_env())
+    sock = socket_from_fd(fd)
+
+    import queue
+    rt = WorkerRuntime(sock, worker_id, store_path)
+    rt.task_queue = queue.Queue()
+    global GLOBAL
+    GLOBAL = rt
+    # Route the public API inside this process to the worker runtime.
+    from ray_tpu.core import runtime as runtime_mod
+    runtime_mod.set_worker_runtime(rt)
+
+    rt.send(("ready", worker_id.binary(), os.getpid()))
+
+    actor_cfg = {}
+    executor_threads: list[threading.Thread] = []
+
+    def receiver():
+        while True:
+            msg = recv_msg(sock)
+            if msg is None:
+                rt.shutdown.set()
+                rt.task_queue.put(None)
+                os._exit(0)
+            op = msg[0]
+            if op == "exec":
+                rt.task_queue.put(msg[1])
+            elif op == "create_actor":
+                actor_cfg["spec"] = msg[1]
+                rt.task_queue.put(("__create_actor__", msg[1]))
+            elif op == "shutdown":
+                rt.shutdown.set()
+                rt.task_queue.put(None)
+            else:
+                rt.handle_push(msg)
+
+    threading.Thread(target=receiver, daemon=True, name="rtpu-recv").start()
+
+    def create_actor(cspec):
+        try:
+            cls = rt.functions[cspec.cls_id]
+            args, kwargs = serialization.deserialize(cspec.payload, cspec.buffers)
+            args = [_resolve_arg(rt, a) for a in args]
+            kwargs = {k: _resolve_arg(rt, v) for k, v in kwargs.items()}
+            rt.actor_instance = cls(*args, **kwargs)
+            rt.actor_id = cspec.actor_id
+            rt.send(("actor_ready", cspec.actor_id))
+            return cspec
+        except BaseException as e:  # noqa: BLE001
+            err = TaskError.from_exception(e, f"{cspec.name}.__init__")
+            payload, bufs, _ = serialization.serialize_value(err)
+            rt.send(("actor_err", cspec.actor_id, payload, bufs))
+            return None
+
+    # Main executor loop. Plain workers and sync actors execute inline;
+    # threaded actors fan out to a pool; async actors switch to asyncio.
+    pool: concurrent.futures.ThreadPoolExecutor | None = None
+    while not rt.shutdown.is_set():
+        item = rt.task_queue.get()
+        if item is None:
+            break
+        if isinstance(item, tuple) and item[0] == "__create_actor__":
+            cspec = create_actor(item[1])
+            if cspec is None:
+                continue
+            if cspec.is_async:
+                _run_actor_async(rt, cspec.max_concurrency)
+                break
+            if cspec.max_concurrency and cspec.max_concurrency > 1:
+                pool = concurrent.futures.ThreadPoolExecutor(cspec.max_concurrency)
+            continue
+        spec: TaskSpec = item
+        if spec.actor_id is not None:
+            fn = _actor_method(rt, spec)
+        else:
+            fn = rt.functions.get(spec.fn_id)
+            if fn is None:
+                err = TaskError.from_exception(
+                    RuntimeError(f"function {spec.fn_id.hex()} not registered"),
+                    spec.describe())
+                _reply_result(rt, spec, "err", err)
+                continue
+        if pool is not None and spec.actor_id is not None:
+            def run(sp=spec, f=fn):
+                status, result = _execute(rt, sp, f)
+                _reply_result(rt, sp, status, result)
+            pool.submit(run)
+        else:
+            status, result = _execute(rt, spec, fn)
+            _reply_result(rt, spec, status, result)
+
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

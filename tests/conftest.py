@@ -1,0 +1,36 @@
+"""Shared fixtures.
+
+Parity: reference `python/ray/tests/conftest.py` (ray_start_regular:580 boots a
+real node per test). JAX tests run on a virtual 8-device CPU mesh
+(XLA_FLAGS=--xla_force_host_platform_device_count=8), the TPU-world analogue
+of the reference's fake multi-node cluster.
+"""
+
+import os
+
+# Must be set before jax is imported anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def ray_start_regular():
+    """A real head runtime with a small worker pool, shared per module."""
+    import ray_tpu
+    rt = ray_tpu.init(num_cpus=4)
+    yield rt
+    ray_tpu.shutdown()
+
+
+@pytest.fixture()
+def ray_start_isolated():
+    """A fresh runtime per test (for failure-injection tests)."""
+    import ray_tpu
+    rt = ray_tpu.init(num_cpus=2)
+    yield rt
+    ray_tpu.shutdown()

