@@ -85,7 +85,10 @@ class ActorClass:
             max_concurrency=opts.get(
                 "max_concurrency", 1000 if has_async else 1),
             is_async=has_async,
-            num_cpus=opts.get("num_cpus", 1),
+            # Parity with the reference: an actor holds 0 CPUs for its
+            # lifetime unless asked (actor.py default) — a 1-CPU default
+            # would starve the cluster as long-lived actors accumulate.
+            num_cpus=opts.get("num_cpus", 0),
             num_tpus=opts.get("num_tpus", 0),
             resources=opts.get("resources"),
             placement_group_id=_pg_id(opts),

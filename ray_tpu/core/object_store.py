@@ -86,17 +86,21 @@ class _ReleaseHandle:
     """Shared countdown: releases the store reference when every tracked
     buffer of one get_deserialized call has been dropped."""
 
-    __slots__ = ("store", "object_id", "data", "remaining")
+    __slots__ = ("store", "object_id", "data", "remaining", "_lock")
 
     def __init__(self, store, object_id, data, remaining):
         self.store = store
         self.object_id = object_id
         self.data = data
         self.remaining = remaining
+        import threading
+        self._lock = threading.Lock()
 
     def drop_one(self):
-        self.remaining -= 1
-        if self.remaining == 0:
+        with self._lock:  # __del__ may run on any thread
+            self.remaining -= 1
+            done = self.remaining == 0
+        if done:
             try:
                 self.data.release()
             except BufferError:
@@ -115,7 +119,9 @@ class _TrackedBuffer:
         self._handle = handle
 
     def __buffer__(self, flags):
-        return memoryview(self._view)
+        # Read-only: sealed objects are immutable; a writable view would let
+        # np.frombuffer consumers mutate the shared arena in place.
+        return memoryview(self._view).toreadonly()
 
     def __del__(self):
         h = self._handle

@@ -97,6 +97,7 @@ class ActorState:
         self.inflight: dict[bytes, TaskSpec] = {}  # task_id -> spec
         self.death_cause = None
         self.seq = 0
+        self.resources_reserved: dict[str, float] = {}
 
 
 class ObjectDirectory:
@@ -201,6 +202,7 @@ class Runtime:
         self.fn_table: dict[bytes, bytes] = {}  # fn_id -> blob
         self.remote_subs: dict[bytes, list[bytes]] = {}  # oid -> [worker ids]
         self.pending_actor_assign: collections.deque[bytes] = collections.deque()
+        self.actors_waiting_resources: collections.deque[bytes] = collections.deque()
         self._shutdown = False
         self.kv: dict[tuple, bytes] = {}  # internal KV (parity: gcs_kv_manager.h)
 
@@ -315,7 +317,7 @@ class Runtime:
             with self.lock:
                 self.fn_table[fn_id] = blob
         elif op == "create_actor":
-            self.create_actor(msg[1])
+            self.create_actor(msg[1], from_worker=True)
         elif op == "actor_ready":
             self._on_actor_ready(msg[1])
         elif op == "actor_err":
@@ -476,12 +478,16 @@ class Runtime:
 
     def _on_object_ready(self, oid: bytes):
         """Unblock tasks waiting on this dependency + remote subscribers."""
+        ready_items = []
         with self.lock:
-            waiters = self.waiting_deps.pop(oid, [])
-        for item in waiters:
-            item["pending"] -= 1
-            if item["pending"] == 0:
-                self._enqueue_ready(item)
+            for item in self.waiting_deps.pop(oid, []):
+                # Decrement under the lock: listener and driver threads can
+                # complete different deps of the same item concurrently.
+                item["pending"] -= 1
+                if item["pending"] == 0:
+                    ready_items.append(item)
+        for item in ready_items:
+            self._enqueue_ready(item)
         self._schedule()
 
     # ---------------- task submission / scheduling ----------------
@@ -561,6 +567,15 @@ class Runtime:
     def _release(self, req: dict[str, float]):
         for k, v in req.items():
             self.available[k] = self.available.get(k, 0.0) + v
+        # Freed capacity may unblock a queued actor creation. (Caller holds
+        # the runtime lock; hand the retry to a thread to avoid re-entrancy.)
+        if self.actors_waiting_resources:
+            aid = self.actors_waiting_resources.popleft()
+            st = self.actors.get(aid)
+            if st is not None:
+                threading.Thread(
+                    target=self._create_actor_now, args=(st.cspec,),
+                    daemon=True).start()
 
     def _check_feasible(self, req: dict[str, float], what: str):
         for k, v in req.items():
@@ -652,26 +667,49 @@ class Runtime:
 
     # ---------------- actors ----------------
 
-    def create_actor(self, cspec: ActorCreationSpec, fn_blob: bytes | None = None,
-                     dependencies=None):
-        if fn_blob is not None:
-            self.export_function(cspec.cls_id, fn_blob)
+    def _actor_resources(self, cspec: ActorCreationSpec) -> dict[str, float]:
         req = {"CPU": cspec.num_cpus or 0.0, "TPU": cspec.num_tpus or 0.0,
                **(cspec.resources or {})}
-        self._check_feasible({k: v for k, v in req.items() if v}, cspec.name)
-        st = ActorState(cspec)
-        with self.lock:
-            self.actors[cspec.actor_id] = st
-            if cspec.name:
-                if cspec.name in self.named_actors:
-                    raise RayTpuError(f"actor name {cspec.name!r} already taken")
-                self.named_actors[cspec.name] = cspec.actor_id
+        return {k: v for k, v in req.items() if v}
+
+    def create_actor(self, cspec: ActorCreationSpec, fn_blob: bytes | None = None,
+                     dependencies=None, from_worker: bool = False):
+        if fn_blob is not None:
+            self.export_function(cspec.cls_id, fn_blob)
+        try:
+            self._check_feasible(self._actor_resources(cspec), cspec.name)
+            with self.lock:
+                if cspec.name and cspec.name in self.named_actors:
+                    raise RayTpuError(
+                        f"actor name {cspec.name!r} already taken")
+                st = ActorState(cspec)
+                self.actors[cspec.actor_id] = st
+                if cspec.name:
+                    self.named_actors[cspec.name] = cspec.actor_id
+        except RayTpuError as e:
+            if not from_worker:
+                raise
+            # Worker-originated create: record a dead actor so the caller's
+            # method calls fail fast with the real cause instead of hanging.
+            st = ActorState(cspec)
+            st.state = A_DEAD
+            st.death_cause = e
+            with self.lock:
+                self.actors.setdefault(cspec.actor_id, st)
+            return
         item = {"kind": "actor", "cspec": cspec, "pending": 0}
         self._gate_on_deps(item, dependencies or cspec.dependencies or [])
 
     def _create_actor_now(self, cspec: ActorCreationSpec):
         st = self.actors[cspec.actor_id]
         with self.lock:
+            # Actors hold their resources for their lifetime; queue the
+            # creation until the reservation fits (released on death/kill).
+            req = self._actor_resources(cspec)
+            if not self._try_reserve(req):
+                self.actors_waiting_resources.append(cspec.actor_id)
+                return
+            st.resources_reserved = req
             w = self.idle.popleft() if self.idle else None
             if w is not None:
                 self._assign_actor_locked(st, w)
@@ -721,6 +759,17 @@ class Runtime:
             name = st.cspec.name
             if name and self.named_actors.get(name) == st.cspec.actor_id:
                 del self.named_actors[name]
+            if st.resources_reserved:
+                self._release(st.resources_reserved)
+                st.resources_reserved = {}
+        # Reclaim the worker process: its only job was this actor.
+        w = st.worker
+        st.worker = None
+        if w is not None and w.state != DEAD:
+            try:
+                w.send(("shutdown",))
+            except OSError:
+                pass
 
     def _submit_actor_task(self, spec: TaskSpec):
         st = self.actors.get(spec.actor_id)
@@ -741,9 +790,15 @@ class Runtime:
         self._send_actor_task(st, spec)
 
     def _send_actor_task(self, st: ActorState, spec: TaskSpec):
-        st.inflight[spec.task_id] = spec
+        with self.lock:
+            w = st.worker
+            if w is None or st.state != A_ALIVE:
+                # Raced with a death/restart: park the call for replay.
+                st.queued.append(spec)
+                return
+            st.inflight[spec.task_id] = spec
         self.task_events.record(spec.task_id, spec.describe(), "RUNNING")
-        st.worker.send(("exec", spec))
+        w.send(("exec", spec))
 
     def kill_actor_by_id(self, actor_id: bytes, no_restart=True):
         st = self.actors.get(actor_id)
@@ -823,6 +878,7 @@ class Runtime:
         else:
             st.state = A_DEAD
             st.death_cause = ActorDiedError(msg=f"actor {cspec.name} died")
+            st.worker = None
             for spec in inflight:
                 self._fail_returns(spec, st.death_cause)
             for spec in list(st.queued):
@@ -831,6 +887,9 @@ class Runtime:
             with self.lock:
                 if cspec.name and self.named_actors.get(cspec.name) == actor_id:
                     del self.named_actors[cspec.name]
+                if st.resources_reserved:
+                    self._release(st.resources_reserved)
+                    st.resources_reserved = {}
 
     # ---------------- introspection ----------------
 

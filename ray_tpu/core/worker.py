@@ -32,6 +32,46 @@ from ray_tpu.core.task import TaskSpec
 from ray_tpu.core.transport import recv_msg, send_msg, socket_from_fd
 
 
+class _LRUCache:
+    """Bounded oid->value cache. A long-lived worker sees millions of inline
+    values; on miss the value is re-fetched from the head (directory/shm), so
+    eviction is always safe."""
+
+    def __init__(self, cap: int = 4096):
+        import collections
+        self._d = collections.OrderedDict()
+        self._cap = cap
+        self._lock = threading.Lock()
+
+    def __contains__(self, key):
+        with self._lock:
+            return key in self._d
+
+    def __getitem__(self, key):
+        with self._lock:
+            val = self._d[key]
+            self._d.move_to_end(key)
+            return val
+
+    def __setitem__(self, key, val):
+        with self._lock:
+            self._d[key] = val
+            self._d.move_to_end(key)
+            while len(self._d) > self._cap:
+                self._d.popitem(last=False)
+
+    def pop(self, key, default=None):
+        with self._lock:
+            return self._d.pop(key, default)
+
+    def get(self, key, default=None):
+        with self._lock:
+            if key not in self._d:
+                return default
+            self._d.move_to_end(key)
+            return self._d[key]
+
+
 class _NoopRefCounter:
     """Borrower-side refcounting is conservative: the owner pins objects for
     the lifetime of tasks that reference them (runtime.submit_task), so
@@ -54,7 +94,7 @@ class WorkerRuntime:
         self.store_path = store_path
         self._store: SharedMemoryStore | None = None
         self.functions: dict[bytes, object] = {}
-        self.object_cache: dict[bytes, object] = {}
+        self.object_cache = _LRUCache()
         self.object_errors: dict[bytes, object] = {}
         self._pending_waits: dict[bytes, list[threading.Event]] = {}
         self._wait_lock = threading.Lock()
@@ -93,8 +133,10 @@ class WorkerRuntime:
 
     def _get_one(self, ref, timeout=None):
         oid = ref.id.binary()
-        if oid in self.object_cache:
-            return self._raise_if_error(self.object_cache[oid])
+        _MISS = object()
+        cached = self.object_cache.get(oid, _MISS)
+        if cached is not _MISS:
+            return self._raise_if_error(cached)
         found, value = self.store.get_deserialized(ref.id, timeout=0)
         if found:
             return value
@@ -106,8 +148,9 @@ class WorkerRuntime:
         if not ev.wait(timeout):
             from ray_tpu.core.status import GetTimeoutError
             raise GetTimeoutError(f"get() timed out on {ref}")
-        if oid in self.object_cache:
-            return self._raise_if_error(self.object_cache[oid])
+        cached = self.object_cache.get(oid, _MISS)
+        if cached is not _MISS:
+            return self._raise_if_error(cached)
         found, value = self.store.get_deserialized(ref.id, timeout=5.0)
         if found:
             return value

@@ -1,0 +1,18 @@
+"""Model family: Llama-style decoder transformers, dense and MoE.
+
+Functional style (pure pytrees + apply fns), not a port of the reference's
+torch models: parameters carry logical sharding axes so one model definition
+lowers to DP/FSDP/TP/SP/EP via the rules table in ray_tpu.parallel.sharding.
+"""
+
+from ray_tpu.models.transformer import (
+    ModelConfig,
+    forward,
+    init_params,
+    loss_fn,
+    param_logical_axes,
+)
+from ray_tpu.models import configs
+
+__all__ = ["ModelConfig", "init_params", "forward", "loss_fn",
+           "param_logical_axes", "configs"]

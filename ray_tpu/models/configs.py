@@ -1,0 +1,36 @@
+"""Named model configs (tiny test configs through Llama-3-8B class)."""
+
+from __future__ import annotations
+
+from ray_tpu.models.transformer import ModelConfig
+
+
+def tiny(**kw) -> ModelConfig:
+    """CPU-test scale."""
+    return ModelConfig(vocab=256, d_model=64, n_layers=2, n_heads=4,
+                       n_kv_heads=2, d_ff=128, **kw)
+
+
+def tiny_moe(**kw) -> ModelConfig:
+    return ModelConfig(vocab=256, d_model=64, n_layers=2, n_heads=4,
+                       n_kv_heads=4, d_ff=128, moe_experts=4, moe_top_k=2,
+                       **kw)
+
+
+def llama3_8b(**kw) -> ModelConfig:
+    """Llama-3-8B geometry (BASELINE north-star FSDP config)."""
+    return ModelConfig(vocab=128256, d_model=4096, n_layers=32, n_heads=32,
+                       n_kv_heads=8, d_ff=14336, rope_theta=500000.0,
+                       dtype="bfloat16", remat=True, **kw)
+
+
+def llama3_1b(**kw) -> ModelConfig:
+    return ModelConfig(vocab=128256, d_model=2048, n_layers=16, n_heads=32,
+                       n_kv_heads=8, d_ff=8192, rope_theta=500000.0,
+                       dtype="bfloat16", **kw)
+
+
+def bench_125m(**kw) -> ModelConfig:
+    """Single-chip bench scale (GPT-small geometry)."""
+    return ModelConfig(vocab=32000, d_model=768, n_layers=12, n_heads=12,
+                       n_kv_heads=12, d_ff=3072, dtype="bfloat16", **kw)

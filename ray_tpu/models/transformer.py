@@ -1,0 +1,232 @@
+"""Llama-family decoder: RMSNorm + RoPE + GQA attention + SwiGLU (or MoE).
+
+TPU-first choices:
+- layers stacked on a leading axis and iterated with lax.scan: one compiled
+  layer body regardless of depth (fast compiles, remat-friendly);
+- attention pluggable: pallas flash (single shard), ring (sp over ICI ring),
+  ulysses (sp all-to-all) — long-context parallelism is a config, not a fork;
+- MoE in GSPMD dense form: experts on the "ep" mesh axis, einsum over the
+  expert dimension so the partitioner places each expert's FLOPs on its
+  owner device;
+- bfloat16 params/activations, fp32 logits + softmax accumulation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import flash_attention
+from ray_tpu.ops.layers import apply_rope, rmsnorm, rope
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    vocab: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 8
+    d_ff: int = 1408
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-6
+    # MoE: 0 = dense. When > 0 every layer is a top-k MoE layer.
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    dtype: str = "float32"
+    remat: bool = False
+    attn_impl: str = "auto"  # auto|pallas|reference|interpret|ring|ulysses
+    tie_embeddings: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def jdtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def init_params(config: ModelConfig, key) -> dict:
+    c = config
+    dt = c.jdtype
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+    d, hd = c.d_model, c.head_dim
+
+    def norm_init(shape):
+        return jnp.ones(shape, dt)
+
+    def dense_init(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(dt)
+
+    L = c.n_layers
+    ks = jax.random.split(k_layers, 8)
+    layer = {
+        "attn_norm": norm_init((L, d)),
+        "wq": dense_init(ks[0], (L, d, c.n_heads * hd), d),
+        "wk": dense_init(ks[1], (L, d, c.n_kv_heads * hd), d),
+        "wv": dense_init(ks[2], (L, d, c.n_kv_heads * hd), d),
+        "wo": dense_init(ks[3], (L, c.n_heads * hd, d), c.n_heads * hd),
+        "mlp_norm": norm_init((L, d)),
+    }
+    if c.moe_experts:
+        X = c.moe_experts
+        layer.update({
+            "router": dense_init(ks[4], (L, d, X), d),
+            "wg": dense_init(ks[5], (L, X, d, c.d_ff), d),
+            "wu": dense_init(ks[6], (L, X, d, c.d_ff), d),
+            "wd": dense_init(ks[7], (L, X, c.d_ff, d), c.d_ff),
+        })
+    else:
+        layer.update({
+            "wg": dense_init(ks[5], (L, d, c.d_ff), d),
+            "wu": dense_init(ks[6], (L, d, c.d_ff), d),
+            "wd": dense_init(ks[7], (L, c.d_ff, d), c.d_ff),
+        })
+    params = {
+        "embed": (jax.random.normal(k_embed, (c.vocab, d), jnp.float32)
+                  * 0.02).astype(dt),
+        "layers": layer,
+        "final_norm": norm_init((d,)),
+    }
+    if not c.tie_embeddings:
+        params["lm_head"] = dense_init(k_head, (d, c.vocab), d)
+    return params
+
+
+def param_logical_axes(config: ModelConfig) -> dict:
+    """Logical sharding axes per param (leading scan axis = "layer")."""
+    c = config
+    layer = {
+        "attn_norm": ("layer", None),
+        "wq": ("layer", "embed", "heads"),
+        "wk": ("layer", "embed", "kv_heads"),
+        "wv": ("layer", "embed", "kv_heads"),
+        "wo": ("layer", "heads", "embed"),
+        "mlp_norm": ("layer", None),
+    }
+    if c.moe_experts:
+        layer.update({
+            "router": ("layer", "embed", None),
+            "wg": ("layer", "expert", "embed", "mlp"),
+            "wu": ("layer", "expert", "embed", "mlp"),
+            "wd": ("layer", "expert", "mlp", "embed"),
+        })
+    else:
+        layer.update({
+            "wg": ("layer", "embed", "mlp"),
+            "wu": ("layer", "embed", "mlp"),
+            "wd": ("layer", "mlp", "embed"),
+        })
+    axes = {
+        "embed": ("vocab", "embed"),
+        "layers": layer,
+        "final_norm": (None,),
+    }
+    if not c.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+def _attention(x, lp, c: ModelConfig, sin, cos, mesh):
+    b, s, d = x.shape
+    h, hkv, hd = c.n_heads, c.n_kv_heads, c.head_dim
+    q = jnp.einsum("bsd,dk->bsk", x, lp["wq"]).reshape(b, s, h, hd)
+    k = jnp.einsum("bsd,dk->bsk", x, lp["wk"]).reshape(b, s, hkv, hd)
+    v = jnp.einsum("bsd,dk->bsk", x, lp["wv"]).reshape(b, s, hkv, hd)
+    q = apply_rope(q, sin, cos)
+    k = apply_rope(k, sin, cos)
+    if c.attn_impl in ("ring", "ulysses"):
+        if hkv != h:  # GQA broadcast before the sp collective
+            rep = h // hkv
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        if c.attn_impl == "ring":
+            from ray_tpu.parallel.ring_attention import ring_attention
+            o = ring_attention(q, k, v, mesh, causal=True)
+        else:
+            from ray_tpu.parallel.ulysses import ulysses_attention
+            o = ulysses_attention(q, k, v, mesh, causal=True)
+    else:
+        o = flash_attention(q, k, v, causal=True, impl=c.attn_impl)
+    o = o.reshape(b, s, h * hd)
+    return jnp.einsum("bsk,kd->bsd", o, lp["wo"])
+
+
+def _moe(x, lp, c: ModelConfig):
+    """Top-k MoE in GSPMD dense form: every expert computes, the router's
+    top-k weights zero the rest; the "expert" einsum axis shards over "ep"."""
+    probs = jax.nn.softmax(
+        jnp.einsum("bsd,dx->bsx", x, lp["router"],
+                   preferred_element_type=jnp.float32), axis=-1)
+    top_w, top_i = jax.lax.top_k(probs, c.moe_top_k)          # [b,s,k]
+    top_w = top_w / jnp.sum(top_w, axis=-1, keepdims=True)
+    gate = jnp.zeros_like(probs).at[
+        jnp.arange(probs.shape[0])[:, None, None],
+        jnp.arange(probs.shape[1])[None, :, None],
+        top_i].set(top_w.astype(probs.dtype))                  # [b,s,X]
+    h = jnp.einsum("bsd,xdf->bsxf", x, lp["wg"],
+                   preferred_element_type=jnp.float32)
+    u = jnp.einsum("bsd,xdf->bsxf", x, lp["wu"],
+                   preferred_element_type=jnp.float32)
+    act = (jax.nn.silu(h) * u).astype(x.dtype)
+    y = jnp.einsum("bsxf,xfd->bsxd", act, lp["wd"],
+                   preferred_element_type=jnp.float32)
+    return jnp.einsum("bsxd,bsx->bsd", y, gate.astype(jnp.float32)
+                      ).astype(x.dtype)
+
+
+def _mlp(x, lp):
+    g = jnp.einsum("bsd,df->bsf", x, lp["wg"],
+                   preferred_element_type=jnp.float32)
+    u = jnp.einsum("bsd,df->bsf", x, lp["wu"],
+                   preferred_element_type=jnp.float32)
+    h = (jax.nn.silu(g) * u).astype(x.dtype)
+    return jnp.einsum("bsf,fd->bsd", h, lp["wd"],
+                      preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+def forward(params, tokens, config: ModelConfig, mesh=None):
+    """tokens [batch, seq] -> logits [batch, seq, vocab] (fp32)."""
+    c = config
+    x = jnp.take(params["embed"], tokens, axis=0)
+    positions = jnp.arange(tokens.shape[1])
+    sin, cos = rope(positions, c.head_dim, c.rope_theta)
+
+    def layer_body(x, lp):
+        h = x + _attention(rmsnorm(x, lp["attn_norm"], c.norm_eps),
+                           lp, c, sin, cos, mesh)
+        normed = rmsnorm(h, lp["mlp_norm"], c.norm_eps)
+        out = h + (_moe(normed, lp, c) if c.moe_experts else _mlp(normed, lp))
+        return out, None
+
+    body = layer_body
+    if c.remat:
+        body = jax.checkpoint(layer_body)
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = rmsnorm(x, params["final_norm"], c.norm_eps)
+    head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
+    logits = jnp.einsum("bsd,dv->bsv", x.astype(jnp.float32),
+                        head.astype(jnp.float32))
+    return logits
+
+
+def loss_fn(params, batch, config: ModelConfig, mesh=None):
+    """Next-token cross entropy; batch = {"tokens": [b, s+1]} or
+    {"inputs": [b,s], "targets": [b,s]}."""
+    if "tokens" in batch:
+        inputs = batch["tokens"][:, :-1]
+        targets = batch["tokens"][:, 1:]
+    else:
+        inputs, targets = batch["inputs"], batch["targets"]
+    logits = forward(params, inputs, config, mesh)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = batch.get("mask")
+    if mask is None:
+        return -jnp.mean(ll)
+    return -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)

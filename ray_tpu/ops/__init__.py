@@ -1,0 +1,13 @@
+"""TPU kernels (Pallas) and fused ops.
+
+Policy: XLA fuses elementwise chains into matmuls on its own — only ops where
+a hand schedule beats the compiler get Pallas kernels (flash attention's
+online-softmax tiling). Everything else stays jnp so the compiler keeps
+freedom to fuse (SURVEY north-star: "let XLA fuse — don't hand-schedule what
+the compiler already does").
+"""
+
+from ray_tpu.ops.attention import flash_attention
+from ray_tpu.ops.layers import rmsnorm, rope, apply_rope, swiglu
+
+__all__ = ["flash_attention", "rmsnorm", "rope", "apply_rope", "swiglu"]

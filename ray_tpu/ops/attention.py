@@ -1,0 +1,169 @@
+"""Flash attention: Pallas TPU kernel with online softmax.
+
+The hot op of the model family (SURVEY §2.4 / pallas_guide.md). Tiled for the
+MXU: grid = (batch*heads, q_blocks, k_blocks), fp32 accumulators in VMEM
+scratch that persist across the innermost k dimension, causal blocks
+predicated with @pl.when so fully-masked tiles cost nothing. Falls back to a
+jnp reference off-TPU (tests run the kernel in interpret mode to check the
+exact same code path).
+
+Backward: custom_vjp with recompute (flash-style) expressed in jnp — XLA
+fuses it well; a Pallas backward kernel is a later optimization.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                scale: float, causal: bool, bq: int, bk: int, nk: int):
+    ki = pl.program_id(2)
+    qi = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # For causal attention, blocks strictly above the diagonal contribute
+    # nothing; predicate them out entirely.
+    run = True if not causal else (ki * bk <= qi * bq + bq - 1)
+
+    @pl.when(run)
+    def _compute():
+        # bf16 straight into the MXU; fp32 comes out via preferred_element_type.
+        s = jax.lax.dot_general(
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale       # [bq, bk] f32
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + qi * bq
+            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ki * bk
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_prev = m_scr[:, :1]                                  # [bq, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)             # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                                 # [bq, bk] f32
+        l_new = l_scr[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        o_ref[0] = (acc_scr[:] /
+                    jnp.maximum(l_scr[:, :1], 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, scale, causal, bq, bk, interpret):
+    """q,k,v: [BH, S, D] -> out [BH, S, D]."""
+    bh, s, d = q.shape
+    bq = min(bq, s)
+    bk = min(bk, s)
+    nq = pl.cdiv(s, bq)
+    nk = pl.cdiv(s, bk)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               bq=bq, bk=bk, nk=nk)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),   # running max
+            pltpu.VMEM((bq, 128), jnp.float32),   # running sum
+            pltpu.VMEM((bq, d), jnp.float32),     # output accumulator
+        ],
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * bh * s * s * d // (2 if causal else 1),
+            bytes_accessed=3 * bh * s * d * q.dtype.itemsize,
+            transcendentals=bh * s * s),
+    )(q, k, v)
+
+
+def _reference(q, k, v, scale, causal):
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        lq, lk = q.shape[1], k.shape[1]
+        rows = jax.lax.broadcasted_iota(jnp.int32, (lq, lk), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (lq, lk), 1)
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, scale, causal, impl):
+    return _flash_dispatch(q, k, v, scale, causal, impl)
+
+
+def _flash_dispatch(q, k, v, scale, causal, impl):
+    if impl == "reference":
+        return _reference(q, k, v, scale, causal)
+    return _flash_fwd(q, k, v, scale, causal, bq=512, bk=512,
+                      interpret=(impl == "interpret"))
+
+
+def _flash_vjp_fwd(q, k, v, scale, causal, impl):
+    return _flash_dispatch(q, k, v, scale, causal, impl), (q, k, v)
+
+
+def _flash_vjp_bwd(scale, causal, impl, res, g):
+    q, k, v = res
+    # Recompute-based backward in jnp; correct and XLA-fused.
+    _, vjp = jax.vjp(lambda q, k, v: _reference(q, k, v, scale, causal),
+                     q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = True, scale: float | None = None,
+                    impl: str = "auto"):
+    """q: [B, S, H, D], k/v: [B, S, Hkv, D] (GQA broadcast inside).
+
+    impl: "auto" (pallas on TPU, reference elsewhere), "pallas",
+    "interpret" (pallas interpreter — used by CPU tests), "reference".
+    """
+    b, s, h, d = q.shape
+    hkv = k.shape[2]
+    if hkv != h:
+        rep = h // hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = scale if scale is not None else d ** -0.5
+    if impl == "auto":
+        impl = "pallas" if _on_tpu() else "reference"
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    out = _flash(qt, kt, vt, scale, causal, impl)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)

@@ -1,0 +1,53 @@
+"""Transformer layer ops (jnp; XLA-fused on TPU).
+
+Kept as plain jnp on purpose: RMSNorm/RoPE/SwiGLU are bandwidth-bound
+elementwise chains that XLA fuses into neighboring matmuls; a Pallas kernel
+here would only pin the schedule. fp32 accumulation where it matters.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rmsnorm(x, weight, eps: float = 1e-6):
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    out = xf * jax.lax.rsqrt(var + eps)
+    return (out * weight.astype(jnp.float32)).astype(dtype)
+
+
+def rope(positions, head_dim: int, theta: float = 10000.0):
+    """Rotary embedding tables. positions: [..., seq] -> (sin, cos) each
+    [..., seq, head_dim/2]."""
+    half = head_dim // 2
+    freqs = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    angles = positions.astype(jnp.float32)[..., None] * freqs
+    return jnp.sin(angles), jnp.cos(angles)
+
+
+def apply_rope(x, sin, cos):
+    """x: [batch, seq, heads, head_dim]; sin/cos: [batch?, seq, head_dim/2]."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    if sin.ndim == 2:  # [seq, half] -> broadcast over batch
+        sin = sin[None]
+        cos = cos[None]
+    sin = sin[:, :, None, :]  # [batch, seq, 1, half]
+    cos = cos[:, :, None, :]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def swiglu(x, w_gate, w_up, w_down):
+    """SwiGLU MLP: silu(x@Wg) * (x@Wu) @ Wd, fp32 matmul accumulation."""
+    g = jnp.einsum("bse,ef->bsf", x, w_gate,
+                   preferred_element_type=jnp.float32)
+    u = jnp.einsum("bse,ef->bsf", x, w_up,
+                   preferred_element_type=jnp.float32)
+    h = (jax.nn.silu(g) * u).astype(x.dtype)
+    return jnp.einsum("bsf,fe->bse", h, w_down,
+                      preferred_element_type=jnp.float32).astype(x.dtype)

@@ -1,0 +1,24 @@
+"""Parallelism layer: device meshes, GSPMD shardings, ICI collectives,
+sequence/context parallelism (ring attention, Ulysses), pipeline stages.
+
+This layer replaces the reference's NCCL/GLOO collective plane
+(`python/ray/util/collective/`) with XLA collectives over ICI: everything runs
+inside jit over a `jax.sharding.Mesh`, so XLA lowers communication to ICI
+transfers and overlaps it with compute.
+"""
+
+from ray_tpu.parallel.mesh import MeshConfig, get_abstract_mesh, make_mesh
+from ray_tpu.parallel.sharding import (
+    ShardingRules,
+    logical_to_physical,
+    shard_params,
+    with_sharding,
+)
+from ray_tpu.parallel.ring_attention import ring_attention
+from ray_tpu.parallel.ulysses import ulysses_attention
+
+__all__ = [
+    "MeshConfig", "make_mesh", "get_abstract_mesh", "ShardingRules",
+    "logical_to_physical", "shard_params", "with_sharding",
+    "ring_attention", "ulysses_attention",
+]

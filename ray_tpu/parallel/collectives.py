@@ -1,0 +1,73 @@
+"""Collective layer: XLA collectives inside jit + host-side rendezvous.
+
+Replaces the reference's `ray.util.collective` NCCL/GLOO groups
+(`util/collective/collective.py:123`, `nccl_collective_group.py:128`): dense
+math communication happens INSIDE compiled programs via jax.lax collectives
+(ICI); only control-plane rendezvous (actors joining a mesh, barriers) goes
+through the object/KV plane, mirroring how the reference uses GCS KV for
+NCCL unique-id exchange.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+
+# ---- in-program collectives (use inside jit/shard_map) ----
+
+psum = jax.lax.psum
+pmean = jax.lax.pmean
+pmax = jax.lax.pmax
+ppermute = jax.lax.ppermute
+all_gather = jax.lax.all_gather
+all_to_all = jax.lax.all_to_all
+
+
+def reduce_scatter(x, axis_name: str, scatter_dimension: int = 0):
+    return jax.lax.psum_scatter(
+        x, axis_name, scatter_dimension=scatter_dimension, tiled=True)
+
+
+# ---- host-side rendezvous over the runtime KV (control plane) ----
+
+class Barrier:
+    """N-party named barrier over the head KV store.
+
+    Used by actor groups gang-entering a jitted SPMD program (the
+    "SPMD-vs-actor impedance" in SURVEY.md §7): every member must arrive
+    before any proceeds.
+    """
+
+    def __init__(self, name: str, world_size: int):
+        self.name = name
+        self.world_size = world_size
+        self._round = 0
+
+    def wait(self, timeout: float = 300.0):
+        from ray_tpu.core.runtime import get_runtime, Runtime
+        rt = get_runtime()
+        self._round += 1
+        key = ("barrier", self.name, self._round)
+
+        def kv_incr():
+            if isinstance(rt, Runtime):
+                cur = int(rt.kv.get(key, b"0"))
+                rt.kv[key] = str(cur + 1).encode()
+                return cur + 1
+            cur = rt.request("kv_get", key)
+            n = int(cur or b"0") + 1
+            rt.request("kv_put", (key, str(n).encode()))
+            return n
+
+        def kv_read():
+            if isinstance(rt, Runtime):
+                return int(rt.kv.get(key, b"0"))
+            return int(rt.request("kv_get", key) or b"0")
+
+        kv_incr()
+        deadline = time.monotonic() + timeout
+        while kv_read() < self.world_size:
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"barrier {self.name} timed out")
+            time.sleep(0.005)

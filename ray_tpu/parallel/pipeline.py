@@ -1,0 +1,90 @@
+"""Pipeline parallelism: GPipe microbatch schedule over the "pp" mesh axis.
+
+Parity note: the reference only consumes a vLLM pipeline_parallel_size for
+placement (`vllm_models.py:127`) and offers compiled-graph NCCL channels as a
+substrate (`dag/compiled_dag_node.py:805`). Here PP is a compiler-visible
+program: stage parameters are sharded over "pp", activations flow between
+stages with `jax.lax.ppermute` inside a `lax.scan`, and reverse-mode autodiff
+through the scan + ppermute yields the backward schedule for free (XLA
+overlaps the permutes with stage compute).
+
+Schedule: plain GPipe — M microbatches drain through S stages in M+S-1 ticks;
+bubble fraction (S-1)/(M+S-1).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def gpipe_inner(stage_fn, stage_params, microbatches, axis_name: str,
+                num_stages: int):
+    """Run inside shard_map over `axis_name` ("pp").
+
+    stage_fn: (params, x) -> y, the per-stage computation.
+    stage_params: this stage's parameter shard (leading stage axis removed).
+    microbatches: [M, ...] all microbatch inputs (same on every stage; only
+      stage 0 reads them).
+    Returns [M, ...] stage outputs, valid on the LAST stage (zeros elsewhere).
+    """
+    stage = jax.lax.axis_index(axis_name)
+    m = microbatches.shape[0]
+    ticks = m + num_stages - 1
+    perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+
+    y0 = jax.eval_shape(lambda x: stage_fn(stage_params, x), microbatches[0])
+    out_buf = jnp.zeros((m,) + y0.shape, y0.dtype)
+
+    def tick(carry, t):
+        incoming, out_buf = carry
+        mb_idx = t - stage  # which microbatch this stage works on at tick t
+        active = (mb_idx >= 0) & (mb_idx < m)
+        # Stage 0 reads from the input queue, others from the wire.
+        feed = jax.lax.cond(
+            stage == 0,
+            lambda: jax.lax.dynamic_index_in_dim(
+                microbatches, jnp.clip(mb_idx, 0, m - 1), keepdims=False),
+            lambda: incoming)
+        y = stage_fn(stage_params, feed)
+        y = jnp.where(active, y, jnp.zeros_like(y))
+        # Last stage banks its result; everyone forwards along the ring.
+        out_buf = jax.lax.cond(
+            active & (stage == num_stages - 1),
+            lambda b: jax.lax.dynamic_update_index_in_dim(
+                b, y.astype(b.dtype), jnp.clip(mb_idx, 0, m - 1), axis=0),
+            lambda b: b,
+            out_buf)
+        nxt = jax.lax.ppermute(y, axis_name, perm)
+        return (nxt, out_buf), None
+
+    incoming0 = jnp.zeros(y0.shape, y0.dtype)
+    (_, out_buf), _ = jax.lax.scan(
+        tick, (incoming0, out_buf), jnp.arange(ticks))
+    return out_buf
+
+
+def gpipe(stage_fn, stacked_params, microbatches, mesh, axis_name: str = "pp"):
+    """stacked_params: pytree with leading stage axis sharded over pp.
+
+    Returns per-microbatch outputs replicated... outputs live on the last
+    stage; callers typically compute the loss inside stage_fn of the last
+    stage and psum. For generic use we broadcast the last stage's buffer.
+    """
+    from jax import shard_map
+    s = mesh.shape[axis_name]
+
+    def inner(params, mbs):
+        params = jax.tree.map(lambda x: x[0], params)  # drop stage axis
+        out = gpipe_inner(stage_fn, params, mbs, axis_name, s)
+        # Broadcast final-stage outputs to all stages (psum of one-hot).
+        return jax.lax.psum(out, axis_name)
+
+    return shard_map(
+        inner, mesh=mesh,
+        in_specs=(jax.tree.map(lambda _: P(axis_name), stacked_params),
+                  P()),
+        out_specs=P(), check_vma=False)(stacked_params, microbatches)

@@ -1,0 +1,130 @@
+"""Ring attention: blockwise attention with KV rotation over an ICI ring.
+
+Greenfield per SURVEY.md §5.7 — the reference has no sequence/context
+parallelism (grep-verified, SURVEY.md:149). Design follows blockwise ring
+attention (Liu et al.): the sequence is sharded over the "sp" mesh axis; each
+step every device computes flash-style online-softmax attention of its local Q
+block against the KV block currently resident, then rotates KV to the next
+ring neighbor with `jax.lax.ppermute` (lowered to ICI collective-permute, so
+the transfer overlaps the next block's compute under XLA's scheduler).
+
+Communication cost: (sp-1) ppermutes of the local KV block — bandwidth-optimal
+for full attention; numerics identical to unsharded attention (same
+log-sum-exp accumulation as flash attention, fp32 accumulators).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, scale, mask):
+    """One Q-block x KV-block flash step. Returns (partial_out, rowmax, rowsum).
+
+    q: [B, Lq, H, D]  k,v: [B, Lk, H, D]  mask: [Lq, Lk] or None (True=keep).
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None, :, :], s, NEG_INF)
+    m = jnp.max(s, axis=-1)                     # [B,H,Lq]
+    # Rows with no visible keys: keep m finite so exp() underflows to 0.
+    m_safe = jnp.maximum(m, NEG_INF / 2)
+    p = jnp.exp(s - m_safe[..., None])          # [B,H,Lq,Lk]
+    l = jnp.sum(p, axis=-1)                     # [B,H,Lq]
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32),
+                   preferred_element_type=jnp.float32)
+    return o, m_safe, l
+
+
+def _merge(acc, o, m, l):
+    """Merge a new block into the running (out, max, sum) accumulator."""
+    acc_o, acc_m, acc_l = acc
+    new_m = jnp.maximum(acc_m, m)
+    alpha = jnp.exp(acc_m - new_m)              # rescale old
+    beta = jnp.exp(m - new_m)                   # rescale new
+    new_l = acc_l * alpha + l * beta
+    new_o = (acc_o * alpha[..., None].transpose(0, 2, 1, 3)
+             + o * beta[..., None].transpose(0, 2, 1, 3))
+    return new_o, new_m, new_l
+
+
+def ring_attention_inner(q, k, v, axis_name: str, axis_size: int,
+                         causal: bool = True, scale: float | None = None):
+    """Call inside shard_map with seq sharded over `axis_name`.
+
+    q, k, v: [batch, seq_local, heads, head_dim] (kv heads must equal q heads
+    here; GQA repeat happens before the call). `axis_size` must be the static
+    ring size — the ppermute permutation table is built at trace time.
+    """
+    n = axis_size
+    idx = jax.lax.axis_index(axis_name)
+    lq = q.shape[1]
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    qf = q.astype(jnp.float32)
+
+    rows = jax.lax.broadcasted_iota(jnp.int32, (lq, lq), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (lq, lq), 1)
+    diag_mask = rows >= cols  # causal mask within the diagonal block
+
+    acc_o = jnp.zeros(q.shape[:3] + (v.shape[-1],), jnp.float32)
+    acc_m = jnp.full(q.shape[:1] + (q.shape[2], lq), NEG_INF, jnp.float32)
+    acc_l = jnp.zeros_like(acc_m)
+
+    def step(t, carry):
+        acc, cur_k, cur_v = carry
+        src_block = (idx - t) % n  # global block id of the resident KV
+        if causal:
+            # Full mask when src < idx, diagonal mask when ==, all-hidden when >.
+            keep_all = src_block < idx
+            keep_diag = src_block == idx
+            mask = jnp.where(
+                keep_all, jnp.ones_like(diag_mask),
+                jnp.where(keep_diag, diag_mask, jnp.zeros_like(diag_mask)))
+        else:
+            mask = None
+        o, m, l = _block_attn(qf, cur_k, cur_v, scale, mask)
+        acc = _merge(acc, o, m, l)
+        # Rotate KV around the ring (ICI collective-permute).
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        nxt_k = jax.lax.ppermute(cur_k, axis_name, perm)
+        nxt_v = jax.lax.ppermute(cur_v, axis_name, perm)
+        return acc, nxt_k, nxt_v
+
+    carry = ((acc_o, acc_m, acc_l), k.astype(jnp.float32), v.astype(jnp.float32))
+    (acc_o, acc_m, acc_l), _, _ = jax.lax.fori_loop(0, n, step, carry)
+    out = acc_o / jnp.maximum(acc_l, 1e-30)[..., None].transpose(0, 2, 1, 3)
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, axis_name: str = "sp", causal: bool = True,
+                   q_spec: P | None = None):
+    """shard_map wrapper: q/k/v sharded [batch, seq/sp, heads, head_dim]."""
+    from jax import shard_map
+    spec = q_spec if q_spec is not None else P(None, axis_name, None, None)
+    fn = functools.partial(ring_attention_inner, axis_name=axis_name,
+                           axis_size=mesh.shape[axis_name], causal=causal)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)(q, k, v)
+
+
+def reference_attention(q, k, v, causal: bool = True,
+                        scale: float | None = None):
+    """Unsharded reference for tests: same math, single device."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        lq, lk = q.shape[1], k.shape[1]
+        rows = jax.lax.broadcasted_iota(jnp.int32, (lq, lk), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (lq, lk), 1)
+        s = jnp.where((rows >= cols)[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)

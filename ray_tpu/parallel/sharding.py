@@ -1,0 +1,79 @@
+"""Logical-axis sharding rules → PartitionSpecs (GSPMD lowering).
+
+The scaling-book recipe: annotate arrays with *logical* axis names
+("batch", "seq", "embed", "mlp", "heads", "vocab", "expert", ...), map those
+to mesh axes with a rules table, and let GSPMD insert collectives. FSDP is
+just "embed→fsdp on params + gather before use"; TP is "mlp/heads→tp";
+sequence parallelism is "seq→sp".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass
+class ShardingRules:
+    """logical name -> mesh axis (or None = replicated)."""
+
+    rules: dict[str, str | tuple[str, ...] | None]
+
+    @classmethod
+    def default(cls) -> "ShardingRules":
+        return cls({
+            # activations
+            "batch": ("dp", "fsdp"),
+            "seq": "sp",
+            "embed_act": None,
+            # params
+            "embed": "fsdp",       # ZeRO-3: shard the "long" param axis
+            "mlp": "tp",
+            "heads": "tp",
+            "kv_heads": "tp",
+            "head_dim": None,
+            "vocab": "tp",
+            "expert": "ep",
+            "stage": "pp",
+        })
+
+    def spec(self, logical_axes: tuple[str | None, ...]) -> P:
+        out = []
+        used: set[str] = set()
+        for name in logical_axes:
+            axis = None if name is None else self.rules.get(name)
+            if isinstance(axis, tuple):
+                axis = tuple(a for a in axis if a not in used)
+                used.update(axis)
+                out.append(axis if axis else None)
+            else:
+                if axis in used:
+                    axis = None
+                if axis is not None:
+                    used.add(axis)
+                out.append(axis)
+        return P(*out)
+
+
+def logical_to_physical(rules: ShardingRules, logical_tree):
+    """Map a pytree of logical-axis tuples to a pytree of PartitionSpecs."""
+    return jax.tree.map(
+        lambda axes: rules.spec(axes),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple) and all(
+            a is None or isinstance(a, str) for a in x),
+    )
+
+
+def shard_params(params, logical_tree, rules: ShardingRules, mesh: Mesh):
+    """Device-put a param pytree with its sharding (for init / restore)."""
+    specs = logical_to_physical(rules, logical_tree)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs)
+
+
+def with_sharding(x, mesh: Mesh, spec: P):
+    """Sharding constraint inside jit (GSPMD hint)."""
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

@@ -1,0 +1,44 @@
+"""Ulysses-style sequence parallelism: all-to-all head<->sequence resharding.
+
+Greenfield per SURVEY.md §5.7/§2.4. Instead of rotating KV (ring), each device
+trades its sequence shard for a head shard with one `jax.lax.all_to_all`
+(ICI), runs full-sequence attention on heads/sp local heads, and trades back.
+Cheaper than ring when heads >= sp and sequence fits per-device after the
+swap; ring wins for extreme context lengths. Both are exposed as
+`context_parallel_attention` strategies in the trainer.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.parallel.ring_attention import reference_attention
+
+
+def ulysses_attention_inner(q, k, v, axis_name: str, causal: bool = True):
+    """Inside shard_map: q/k/v [batch, seq_local, heads, head_dim]."""
+    # seq-sharded -> head-sharded: split heads axis (2), gather seq axis (1).
+    def swap_in(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def swap_out(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    qh, kh, vh = swap_in(q), swap_in(k), swap_in(v)   # [B, S_full, H/sp, D]
+    out = reference_attention(qh, kh, vh, causal=causal)
+    return swap_out(out)                               # [B, S/sp, H, D]
+
+
+def ulysses_attention(q, k, v, mesh, axis_name: str = "sp",
+                      causal: bool = True):
+    from jax import shard_map
+    spec = P(None, axis_name, None, None)
+    fn = functools.partial(ulysses_attention_inner, axis_name=axis_name,
+                           causal=causal)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)(q, k, v)

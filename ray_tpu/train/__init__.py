@@ -1,0 +1,17 @@
+"""Distributed training library (JaxTrainer).
+
+Parity: reference `python/ray/train/` (v2 architecture: controller FSM +
+worker group, `v2/_internal/execution/controller/controller.py:91`) — but the
+backend is GSPMD over a device mesh instead of torch DDP process groups:
+DP/FSDP/TP/SP/EP are sharding configs lowered by XLA, not collective calls.
+"""
+
+from ray_tpu.train.step import TrainState, make_train_step
+from ray_tpu.train.trainer import JaxTrainer, ScalingConfig, RunConfig
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train import session
+from ray_tpu.train.session import report, get_checkpoint, get_dataset_shard
+
+__all__ = ["JaxTrainer", "ScalingConfig", "RunConfig", "TrainState",
+           "make_train_step", "Checkpoint", "CheckpointManager", "session",
+           "report", "get_checkpoint", "get_dataset_shard"]

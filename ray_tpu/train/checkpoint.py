@@ -1,0 +1,93 @@
+"""Checkpoints: directory handles + orbax-backed pytree state.
+
+Parity: reference `python/ray/train/_checkpoint.py:56` (Checkpoint = dir +
+fs URI), `train/_internal/checkpoint_manager.py` (keep-top-K),
+`train/_internal/storage.py:358` (StorageContext). TPU-first addition:
+`save_state/restore_state` use orbax (async-capable, sharding-aware), so a
+GSPMD-sharded TrainState checkpoints without gathering to one host.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import time
+from typing import Any
+
+
+class Checkpoint:
+    """A handle to a checkpoint directory."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    @classmethod
+    def from_dict(cls, data: dict, storage_dir: str, step: int = 0) -> "Checkpoint":
+        path = os.path.join(storage_dir, f"checkpoint_{step:06d}_{int(time.time()*1e3)}")
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "data.pkl"), "wb") as f:
+            pickle.dump(data, f, protocol=5)
+        return cls(path)
+
+    def to_dict(self) -> dict:
+        with open(os.path.join(self.path, "data.pkl"), "rb") as f:
+            return pickle.load(f)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+
+def save_state(state, path: str):
+    """Orbax save of a (possibly sharded) pytree; gathers per-shard files."""
+    import orbax.checkpoint as ocp
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.abspath(path), state, force=True)
+    ckptr.wait_until_finished()
+
+
+def restore_state(path: str, target=None):
+    import orbax.checkpoint as ocp
+    ckptr = ocp.StandardCheckpointer()
+    return ckptr.restore(os.path.abspath(path), target)
+
+
+class CheckpointManager:
+    """Keep-top-K checkpoint retention with a metrics index."""
+
+    def __init__(self, storage_dir: str, keep: int = 2,
+                 metric: str | None = None, mode: str = "min"):
+        self.storage_dir = storage_dir
+        self.keep = keep
+        self.metric = metric
+        self.mode = mode
+        self.entries: list[tuple[float, str]] = []
+        os.makedirs(storage_dir, exist_ok=True)
+
+    def register(self, checkpoint: Checkpoint, metrics: dict | None = None):
+        score = 0.0
+        if self.metric and metrics and self.metric in metrics:
+            score = float(metrics[self.metric])
+            if self.mode == "max":
+                score = -score
+        else:
+            score = -time.time()  # newest wins
+        self.entries.append((score, checkpoint.path))
+        self.entries.sort()
+        while len(self.entries) > self.keep:
+            _, path = self.entries.pop()
+            shutil.rmtree(path, ignore_errors=True)
+        self._write_index(metrics)
+
+    def _write_index(self, metrics):
+        with open(os.path.join(self.storage_dir, "index.json"), "w") as f:
+            json.dump({"checkpoints": [p for _, p in self.entries],
+                       "latest_metrics": metrics}, f)
+
+    def best(self) -> Checkpoint | None:
+        return Checkpoint(self.entries[0][1]) if self.entries else None

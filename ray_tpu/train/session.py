@@ -1,0 +1,81 @@
+"""In-loop training session API.
+
+Parity: reference `python/ray/train/_internal/session.py` —
+`ray.train.report(:672)`, `get_checkpoint(:786)`, `get_dataset_shard(:1114)`.
+The session lives inside each training worker actor; report() hands metrics
+(+ optional checkpoint data) to the worker's mailbox, which the controller
+polls.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+
+class TrainSession:
+    def __init__(self, rank: int, world_size: int, storage_dir: str,
+                 checkpoint=None, dataset_shards: dict | None = None):
+        self.rank = rank
+        self.world_size = world_size
+        self.storage_dir = storage_dir
+        self.resume_checkpoint = checkpoint
+        self.dataset_shards = dataset_shards or {}
+        self.reports: list[dict] = []
+        self.latest_checkpoint = None
+        self.finished = False
+        self.error: BaseException | None = None
+        self._lock = threading.Lock()
+
+    def report(self, metrics: dict, checkpoint=None):
+        with self._lock:
+            entry = {"metrics": dict(metrics), "rank": self.rank}
+            if checkpoint is not None and self.rank == 0:
+                from ray_tpu.train.checkpoint import Checkpoint
+                if not isinstance(checkpoint, Checkpoint):
+                    checkpoint = Checkpoint.from_dict(
+                        checkpoint, self.storage_dir,
+                        step=metrics.get("step", len(self.reports)))
+                self.latest_checkpoint = checkpoint
+                entry["checkpoint"] = checkpoint.path
+            self.reports.append(entry)
+
+    def drain_reports(self) -> list[dict]:
+        with self._lock:
+            out = self.reports
+            self.reports = []
+            return out
+
+
+_session: TrainSession | None = None
+
+
+def _set_session(s: TrainSession | None):
+    global _session
+    _session = s
+
+
+def get_session() -> TrainSession:
+    if _session is None:
+        raise RuntimeError("not inside a ray_tpu.train training loop")
+    return _session
+
+
+def report(metrics: dict, checkpoint=None):
+    get_session().report(metrics, checkpoint)
+
+
+def get_checkpoint():
+    return get_session().resume_checkpoint
+
+
+def get_dataset_shard(name: str = "train"):
+    return get_session().dataset_shards.get(name)
+
+
+def get_world_rank() -> int:
+    return get_session().rank
+
+
+def get_world_size() -> int:
+    return get_session().world_size

@@ -8,12 +8,22 @@ of the reference's fake multi-node cluster.
 
 import os
 
-# Must be set before jax is imported anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before jax is imported anywhere in the test process. Tests
+# always run on the virtual CPU mesh, even when a real TPU is attached —
+# override, don't setdefault (the env presets JAX_PLATFORMS to the tpu
+# platform).
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The environment's sitecustomize imports jax at interpreter startup (before
+# this conftest), so jax.config has already latched JAX_PLATFORMS from the
+# outer env; update the live config too.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,99 @@
+"""Kernel tests: pallas flash attention (interpret mode = same code path as
+TPU), layer ops vs hand math."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.ops import apply_rope, flash_attention, rmsnorm, rope, swiglu
+from ray_tpu.ops.attention import _reference
+
+
+def _qkv(key, b=1, s=128, h=2, d=64, dtype=jnp.float32):
+    ks = jax.random.split(key, 3)
+    return [jax.random.normal(k, (b, s, h, d), dtype) for k in ks]
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_attention_interpret_matches_reference(causal):
+    q, k, v = _qkv(jax.random.PRNGKey(0))
+    ref = flash_attention(q, k, v, causal=causal, impl="reference")
+    got = flash_attention(q, k, v, causal=causal, impl="interpret")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_attention_multiblock():
+    # sequence longer than one block in interpret mode with small blocks
+    from ray_tpu.ops import attention as A
+    q, k, v = _qkv(jax.random.PRNGKey(1), s=64, d=32)
+    ref = flash_attention(q, k, v, causal=True, impl="reference")
+    got = A._flash_fwd(
+        q.transpose(0, 2, 1, 3).reshape(2, 64, 32),
+        k.transpose(0, 2, 1, 3).reshape(2, 64, 32),
+        v.transpose(0, 2, 1, 3).reshape(2, 64, 32),
+        scale=32 ** -0.5, causal=True, bq=16, bk=16, interpret=True)
+    got = got.reshape(1, 2, 64, 32).transpose(0, 2, 1, 3)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_attention_gqa():
+    key = jax.random.PRNGKey(2)
+    ks = jax.random.split(key, 3)
+    q = jax.random.normal(ks[0], (1, 32, 8, 16))
+    k = jax.random.normal(ks[1], (1, 32, 2, 16))
+    v = jax.random.normal(ks[2], (1, 32, 2, 16))
+    out = flash_attention(q, k, v, impl="reference")
+    assert out.shape == q.shape
+
+
+def test_flash_attention_grads():
+    q, k, v = _qkv(jax.random.PRNGKey(3), s=32, d=16)
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, impl="reference") ** 2)
+
+    g = jax.grad(loss)(q, k, v)
+    assert all(jnp.all(jnp.isfinite(x)) for x in g)
+
+
+def test_rmsnorm():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 16))
+    w = jnp.ones(16)
+    out = rmsnorm(x, w)
+    norms = np.sqrt((np.asarray(out) ** 2).mean(-1))
+    np.testing.assert_allclose(norms, 1.0, rtol=1e-3)
+
+
+def test_rope_rotation_preserves_norm():
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, 8, 2, 16))
+    sin, cos = rope(jnp.arange(8), 16)
+    out = apply_rope(x, sin, cos)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(out), axis=-1),
+        np.linalg.norm(np.asarray(x), axis=-1), rtol=1e-5)
+
+
+def test_rope_relative_property():
+    # dot(rope(q,m), rope(k,n)) depends only on m-n: shift both by 3.
+    d = 8
+    q = jax.random.normal(jax.random.PRNGKey(2), (1, 1, 1, d))
+    k = jax.random.normal(jax.random.PRNGKey(3), (1, 1, 1, d))
+    def dot_at(m, n):
+        sin_m, cos_m = rope(jnp.array([m]), d)
+        sin_n, cos_n = rope(jnp.array([n]), d)
+        qm = apply_rope(q, sin_m, cos_m)
+        kn = apply_rope(k, sin_n, cos_n)
+        return float(jnp.sum(qm * kn))
+    np.testing.assert_allclose(dot_at(5, 2), dot_at(8, 5), rtol=1e-5)
+
+
+def test_swiglu_shapes():
+    x = jax.random.normal(jax.random.PRNGKey(4), (2, 4, 8))
+    wg = jax.random.normal(jax.random.PRNGKey(5), (8, 16))
+    wu = jax.random.normal(jax.random.PRNGKey(6), (8, 16))
+    wd = jax.random.normal(jax.random.PRNGKey(7), (16, 8))
+    out = swiglu(x, wg, wu, wd)
+    assert out.shape == x.shape and out.dtype == x.dtype
