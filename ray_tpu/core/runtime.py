@@ -22,6 +22,7 @@ import collections
 import concurrent.futures
 import os
 import selectors
+import signal
 import subprocess
 import sys
 import tempfile
@@ -86,6 +87,146 @@ class WorkerHandle:
 
     def send(self, msg):
         send_msg(self.sock, msg, self.send_lock)
+
+
+class _ForkedProc:
+    """Popen-shaped handle for a worker forked by the zygote. We are not its
+    parent: kills are routed through the zygote, which only signals pids that
+    are still its own un-reaped children (pid-recycling safe). Zombies count
+    as alive for os.kill(pid, 0), so poll()/wait() treat 'zygote gone' as
+    exited rather than polling the pid."""
+
+    def __init__(self, pid: int, zygote: "_Zygote"):
+        self.pid = pid
+        self._zygote = zygote
+
+    def kill(self):
+        self._zygote.kill(self.pid)
+
+    terminate = kill
+
+    def poll(self):
+        if self._zygote._dead:
+            return 0
+        try:
+            os.kill(self.pid, 0)
+            return None
+        except (ProcessLookupError, PermissionError):
+            return 0
+
+    def wait(self, timeout=None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self.poll() is None:
+            if deadline is not None and time.monotonic() > deadline:
+                raise subprocess.TimeoutExpired("forked-worker", timeout)
+            time.sleep(0.01)
+        return 0
+
+
+class _Zygote:
+    """Forkserver client. One subprocess pays the interpreter+jax import once;
+    each worker spawn is then a fork (~ms) instead of a cold exec (~2s, worse
+    under concurrent-import CPU contention). Spawn protocol: JSON request +
+    SCM_RIGHTS socket fd out, 4-byte child pid back."""
+
+    def __init__(self, session_dir: str, store_path: str, env: dict):
+        import socket as socket_mod
+        parent, child = socket_mod.socketpair(
+            socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.worker", "--zygote",
+             store_path, str(child.fileno())],
+            pass_fds=[child.fileno()], env=env, close_fds=True,
+            stdout=open(os.path.join(session_dir, "logs", "zygote.out"), "ab"),
+            stderr=subprocess.STDOUT)
+        child.close()
+        self.sock = parent
+        self.lock = threading.Lock()
+        self._ready = threading.Event()
+        self._dead = False
+        threading.Thread(target=self._wait_ready, daemon=True,
+                         name="rtpu-zygote-ready").start()
+
+    def _recv_exact(self, n: int) -> bytes | None:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _wait_ready(self):
+        try:
+            if self._recv_exact(4) == b"RDY0":
+                self._ready.set()
+            else:
+                self._dead = True
+        except OSError:
+            self._dead = True
+
+    def _roundtrip(self, req: bytes, rights=None) -> int | None:
+        import struct
+        with self.lock:
+            if self._dead:
+                return None
+            try:
+                # Bounded: a wedged zygote must not freeze spawning/kills
+                # forever while we hold the lock — poison and fall back.
+                self.sock.settimeout(15.0)
+                self.sock.sendmsg([req], rights or [])
+                buf = self._recv_exact(4)
+                if buf is None:
+                    self._dead = True
+                    return None
+                return struct.unpack("<I", buf)[0]
+            except OSError:
+                self._dead = True
+                return None
+
+    def _wait_usable(self, timeout: float) -> bool:
+        if self._dead:
+            return False
+        if not self._ready.wait(timeout):
+            # Hung during import: poison so later spawns fall back immediately.
+            self._dead = True
+            return False
+        return not self._dead
+
+    def spawn(self, worker_id_hex: str, child_sock, log_path: str,
+              timeout: float = 60.0) -> int | None:
+        if not self._wait_usable(timeout):
+            return None
+        import array
+        import json
+        import socket as socket_mod
+        req = json.dumps({"worker_id": worker_id_hex, "log": log_path}).encode()
+        rights = [(socket_mod.SOL_SOCKET, socket_mod.SCM_RIGHTS,
+                   array.array("i", [child_sock.fileno()]).tobytes())]
+        return self._roundtrip(req, rights)
+
+    def kill(self, pid: int):
+        """Ask the zygote to SIGKILL its child; no-ops on recycled pids."""
+        import json
+        if self._roundtrip(json.dumps({"kill": pid}).encode()) is None:
+            # Zygote gone: its children were reparented; signal directly as a
+            # last resort (small recycle risk only in this rare path).
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+    def close(self):
+        self._dead = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        try:
+            self.proc.kill()
+            self.proc.wait(timeout=2.0)
+        except Exception:  # noqa: BLE001
+            pass
 
 
 class ActorState:
@@ -214,33 +355,63 @@ class Runtime:
 
         pool = cfg.num_workers or int(self.total_resources["CPU"])
         self.pool_size = max(1, pool)
-        for _ in range(self.pool_size):
-            self._spawn_worker()
+        self._zygote = _Zygote(self.session_dir, self.store_path,
+                               self._worker_env())
+        threading.Thread(
+            target=lambda: [self._spawn_worker() for _ in range(self.pool_size)],
+            daemon=True, name="rtpu-pool-prestart").start()
 
     # ---------------- worker pool ----------------
 
-    def _spawn_worker(self) -> WorkerHandle:
-        if self._shutdown:
-            return None
-        import socket as socket_mod
-        parent, child = socket_mod.socketpair(socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
-        worker_id = WorkerID.from_random()
+    def _worker_env(self) -> dict:
         env = dict(os.environ)
         env.update(self.config.to_env())
         env.setdefault("PYTHONPATH", "")
         repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         env["PYTHONPATH"] = repo_root + os.pathsep + env["PYTHONPATH"]
-        # Workers see only logical TPU slots via env; the mesh layer assigns chips.
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.worker",
-             self.store_path, worker_id.hex(), str(child.fileno())],
-            pass_fds=[child.fileno()], env=env, close_fds=True,
-            stdout=open(os.path.join(self.session_dir, "logs",
-                                     f"worker-{worker_id.hex()[:8]}.out"), "ab"),
-            stderr=subprocess.STDOUT)
+        return env
+
+    def _spawn_worker(self) -> WorkerHandle:
+        if self._shutdown:
+            return None
+        import socket as socket_mod
+        worker_id = WorkerID.from_random()
+        log_path = os.path.join(self.session_dir, "logs",
+                                f"worker-{worker_id.hex()[:8]}.out")
+        # Fast path: fork from the warm zygote. Fallback: cold exec — on a
+        # FRESH socketpair, since a zygote that died mid-spawn may have forked
+        # a child that already holds the first pair's worker end.
+        parent = child = proc = None
+        if self._zygote is not None:
+            parent, child = socket_mod.socketpair(
+                socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+            pid = self._zygote.spawn(worker_id.hex(), child, log_path)
+            if pid:
+                proc = _ForkedProc(pid, self._zygote)
+            else:
+                parent.close()
+                child.close()
+                parent = child = None
+        if proc is None:
+            parent, child = socket_mod.socketpair(
+                socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+            # Workers see only logical TPU slots via env; the mesh layer
+            # assigns chips.
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu.core.worker",
+                 self.store_path, worker_id.hex(), str(child.fileno())],
+                pass_fds=[child.fileno()], env=self._worker_env(),
+                close_fds=True, stdout=open(log_path, "ab"),
+                stderr=subprocess.STDOUT)
         child.close()
         handle = WorkerHandle(worker_id, parent, proc)
         with self.lock:
+            if self._shutdown:
+                # Raced with shutdown(): it won't see this handle, so clean
+                # up here instead of leaking an orphan worker.
+                proc.kill()
+                parent.close()
+                return None
             self.workers[worker_id.binary()] = handle
         with self._sel_lock:
             self._selector.register(parent, selectors.EVENT_READ, handle)
@@ -792,13 +963,37 @@ class Runtime:
     def _send_actor_task(self, st: ActorState, spec: TaskSpec):
         with self.lock:
             w = st.worker
-            if w is None or st.state != A_ALIVE:
-                # Raced with a death/restart: park the call for replay.
+            if st.state == A_DEAD:
+                dead_cause = st.death_cause
+            elif w is None or st.state != A_ALIVE:
+                # Raced with a restart: park the call for replay.
                 st.queued.append(spec)
                 return
-            st.inflight[spec.task_id] = spec
+            else:
+                st.inflight[spec.task_id] = spec
+                dead_cause = None
+        if dead_cause is not None or st.state == A_DEAD:
+            # Death handler already ran and drained the queue; fail here.
+            self._fail_returns(
+                spec, dead_cause if isinstance(dead_cause, Exception)
+                else ActorDiedError(msg="actor is dead"))
+            return
         self.task_events.record(spec.task_id, spec.describe(), "RUNNING")
-        w.send(("exec", spec))
+        try:
+            w.send(("exec", spec))
+        except OSError:
+            # Raced with the worker dying (socket already closed). Park the
+            # call; the death handler replays/fails it with the actor's fate.
+            # If that handler already ran, fail the call here instead — nobody
+            # will drain the queue again.
+            with self.lock:
+                st.inflight.pop(spec.task_id, None)
+                if st.state != A_DEAD:
+                    st.queued.append(spec)
+                    return
+            cause = st.death_cause
+            self._fail_returns(spec, cause if isinstance(cause, Exception)
+                               else ActorDiedError(msg="actor is dead"))
 
     def kill_actor_by_id(self, actor_id: bytes, no_restart=True):
         st = self.actors.get(actor_id)
@@ -910,9 +1105,13 @@ class Runtime:
     # ---------------- shutdown ----------------
 
     def shutdown(self):
-        if self._shutdown:
-            return
-        self._shutdown = True
+        with self.lock:
+            if self._shutdown:
+                return
+            # Under the lock: any in-flight _spawn_worker either registered
+            # its handle (we see it below) or will observe the flag and
+            # self-clean.
+            self._shutdown = True
         for w in list(self.workers.values()):
             if w.state != DEAD:
                 try:
@@ -927,6 +1126,8 @@ class Runtime:
                 w.proc.wait(timeout=max(0.05, deadline - time.monotonic()))
             except subprocess.TimeoutExpired:
                 w.proc.kill()
+        if self._zygote is not None:
+            self._zygote.close()
         self.store.close()
         self.store.unlink()
 

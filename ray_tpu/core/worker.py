@@ -350,9 +350,97 @@ def _actor_method(rt: WorkerRuntime, spec: TaskSpec):
 
 
 def main():
-    store_path = sys.argv[1]
-    worker_id = WorkerID.from_hex(sys.argv[2])
-    fd = int(sys.argv[3])
+    if sys.argv[1] == "--zygote":
+        return zygote_main(sys.argv[2], int(sys.argv[3]))
+    _worker_main(sys.argv[1], WorkerID.from_hex(sys.argv[2]), int(sys.argv[3]))
+
+
+def zygote_main(store_path: str, ctrl_fd: int):
+    """Forkserver: pays the interpreter+jax import cost once, then forks a
+    ready-to-run worker in milliseconds per head request.
+
+    Parity note: the reference amortizes worker startup with prestarted idle
+    workers (`src/ray/raylet/worker_pool.h:228` prestart + idle cache); on this
+    runtime a fork zygote additionally makes cold spawns (actor bursts, pool
+    replenish after OOM kills) cheap. Protocol: head sends one JSON line plus
+    one SCM_RIGHTS fd per spawn; zygote replies with the child pid.
+    """
+    import array
+    import json
+    import signal
+    import socket as socket_mod
+    import struct
+
+    try:  # usually already loaded via sitecustomize; make the warmup explicit
+        import jax  # noqa: F401
+    except ImportError:
+        pass
+
+    # Live children (pid stays a zombie — unrecyclable — until we reap it
+    # here, so a "kill" request can never hit a recycled pid).
+    live: set[int] = set()
+
+    def _reap(_sig=None, _frame=None):
+        while True:
+            try:
+                pid, _ = os.waitpid(-1, os.WNOHANG)
+            except ChildProcessError:
+                return
+            if pid == 0:
+                return
+            live.discard(pid)
+
+    signal.signal(signal.SIGCHLD, _reap)
+    ctrl = socket_from_fd(ctrl_fd)
+    ctrl.sendall(b"RDY0")
+    fdsize = array.array("i").itemsize
+    while True:
+        fds = array.array("i")
+        try:
+            msg, ancdata, _flags, _addr = ctrl.recvmsg(
+                4096, socket_mod.CMSG_LEN(fdsize))
+        except OSError:
+            os._exit(0)
+        if not msg:
+            os._exit(0)
+        for level, ctype, data in ancdata:
+            if level == socket_mod.SOL_SOCKET and ctype == socket_mod.SCM_RIGHTS:
+                fds.frombytes(data[: len(data) - (len(data) % fdsize)])
+        req = json.loads(msg)
+        if "kill" in req:
+            pid = req["kill"]
+            if pid in live:
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+            ctrl.sendall(struct.pack("<I", 0))
+            continue
+        fd = fds[0]
+        # Block SIGCHLD so a fast-exiting child can't be reaped before it is
+        # in `live` (which would leave a stale pid eligible for os.kill).
+        signal.pthread_sigmask(signal.SIG_BLOCK, {signal.SIGCHLD})
+        pid = os.fork()
+        if pid == 0:
+            signal.signal(signal.SIGCHLD, signal.SIG_DFL)
+            signal.pthread_sigmask(signal.SIG_UNBLOCK, {signal.SIGCHLD})
+            ctrl.close()
+            logf = os.open(req["log"], os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                           0o644)
+            os.dup2(logf, 1)
+            os.dup2(logf, 2)
+            os.close(logf)
+            try:
+                _worker_main(store_path, WorkerID.from_hex(req["worker_id"]), fd)
+            finally:
+                os._exit(0)
+        live.add(pid)
+        signal.pthread_sigmask(signal.SIG_UNBLOCK, {signal.SIGCHLD})
+        os.close(fd)
+        ctrl.sendall(struct.pack("<I", pid))
+
+
+def _worker_main(store_path: str, worker_id: WorkerID, fd: int):
     set_config(Config.from_env())
     sock = socket_from_fd(fd)
 

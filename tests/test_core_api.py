@@ -191,6 +191,7 @@ def test_async_actor(ray_start_regular):
             return v
 
     a = AsyncWorker.remote()
+    ray_tpu.get(a.work.remote(0.0, 0), timeout=60)  # wait out actor startup
     # Submitted in slow-first order; concurrent execution means both finish
     # within the slow call's latency, not the sum.
     t0 = time.monotonic()
