@@ -28,6 +28,7 @@ import sys
 import tempfile
 import threading
 import time
+import traceback
 import uuid
 
 from ray_tpu.core import serialization
@@ -92,9 +93,10 @@ class WorkerHandle:
 class _ForkedProc:
     """Popen-shaped handle for a worker forked by the zygote. We are not its
     parent: kills are routed through the zygote, which only signals pids that
-    are still its own un-reaped children (pid-recycling safe). Zombies count
-    as alive for os.kill(pid, 0), so poll()/wait() treat 'zygote gone' as
-    exited rather than polling the pid."""
+    are still its own live-or-unreaped children (pid-recycling safe). poll()
+    probes the pid directly — it can momentarily mis-report a recycled pid as
+    'our' worker, so it is only used in bounded wait loops (shutdown), never
+    for kill decisions."""
 
     def __init__(self, pid: int, zygote: "_Zygote"):
         self.pid = pid
@@ -106,8 +108,6 @@ class _ForkedProc:
     terminate = kill
 
     def poll(self):
-        if self._zygote._dead:
-            return 0
         try:
             os.kill(self.pid, 0)
             return None
@@ -357,9 +357,16 @@ class Runtime:
         self.pool_size = max(1, pool)
         self._zygote = _Zygote(self.session_dir, self.store_path,
                                self._worker_env())
-        threading.Thread(
-            target=lambda: [self._spawn_worker() for _ in range(self.pool_size)],
-            daemon=True, name="rtpu-pool-prestart").start()
+
+        def prestart():
+            for _ in range(self.pool_size):
+                try:
+                    self._spawn_worker()
+                except Exception:  # noqa: BLE001 — keep filling the pool
+                    traceback.print_exc()
+
+        threading.Thread(target=prestart, daemon=True,
+                         name="rtpu-pool-prestart").start()
 
     # ---------------- worker pool ----------------
 
@@ -498,6 +505,15 @@ class Runtime:
         else:
             raise RayTpuError(f"head: unknown message {op}")
 
+    def kv_incr(self, key) -> int:
+        """Atomic counter increment (serialized by the head lock); the
+        primitive behind barriers/rendezvous — a get-then-put from N workers
+        would lose counts."""
+        with self.lock:
+            n = int(self.kv.get(key, b"0")) + 1
+            self.kv[key] = str(n).encode()
+            return n
+
     def _on_request(self, w: WorkerHandle, req_id, what, arg):
         """Small synchronous control-plane queries from workers."""
         if what == "get_actor":
@@ -514,6 +530,8 @@ class Runtime:
         elif what == "kv_del":
             self.kv.pop(arg, None)
             resp = True
+        elif what == "kv_incr":
+            resp = self.kv_incr(arg)
         elif what == "kill_actor":
             self.kill_actor_by_id(arg, no_restart=True)
             resp = True
@@ -738,15 +756,22 @@ class Runtime:
     def _release(self, req: dict[str, float]):
         for k, v in req.items():
             self.available[k] = self.available.get(k, 0.0) + v
-        # Freed capacity may unblock a queued actor creation. (Caller holds
-        # the runtime lock; hand the retry to a thread to avoid re-entrancy.)
+        # Freed capacity may unblock queued actor creations — retry ALL of
+        # them, not just one: the freed block may fit several small waiters
+        # and no later release is guaranteed to come. _create_actor_now
+        # re-queues any that still don't fit. (Caller holds the runtime lock;
+        # hand the retries to a thread to avoid re-entrancy.)
         if self.actors_waiting_resources:
-            aid = self.actors_waiting_resources.popleft()
-            st = self.actors.get(aid)
-            if st is not None:
-                threading.Thread(
-                    target=self._create_actor_now, args=(st.cspec,),
-                    daemon=True).start()
+            waiters = list(self.actors_waiting_resources)
+            self.actors_waiting_resources.clear()
+
+            def retry():
+                for aid in waiters:
+                    st = self.actors.get(aid)
+                    if st is not None and st.state != A_DEAD:
+                        self._create_actor_now(st.cspec)
+
+            threading.Thread(target=retry, daemon=True).start()
 
     def _check_feasible(self, req: dict[str, float], what: str):
         for k, v in req.items():
@@ -874,6 +899,8 @@ class Runtime:
     def _create_actor_now(self, cspec: ActorCreationSpec):
         st = self.actors[cspec.actor_id]
         with self.lock:
+            if st.state == A_DEAD:  # killed while the creation was queued
+                return
             # Actors hold their resources for their lifetime; queue the
             # creation until the reservation fits (released on death/kill).
             req = self._actor_resources(cspec)
@@ -909,10 +936,22 @@ class Runtime:
         st = self.actors.get(actor_id)
         if st is None:
             return
+        dead_worker = None
         with self.lock:
-            st.state = A_ALIVE
-            queued = list(st.queued)
-            st.queued.clear()
+            if st.state == A_DEAD:
+                # Killed while starting up: do not resurrect; stop the worker
+                # (outside the lock — zygote kills round-trip).
+                dead_worker = st.worker
+                queued = []
+            else:
+                st.state = A_ALIVE
+                queued = list(st.queued)
+                st.queued.clear()
+        if dead_worker is not None and dead_worker.proc is not None:
+            try:
+                dead_worker.proc.kill()
+            except ProcessLookupError:
+                pass
         for spec in queued:
             self._send_actor_task(st, spec)
 
@@ -1000,12 +1039,50 @@ class Runtime:
         if st is None:
             return
         st.cspec.max_restarts = 0 if no_restart else st.cspec.max_restarts
-        w = st.worker
+        with self.lock:
+            # Read the worker under the lock: a kill racing the pending
+            # assignment (listener setting st.worker) must see it, or we'd
+            # take the no-worker branch and the actor would come alive later.
+            w = st.worker
         if w is not None and w.proc is not None:
             try:
                 w.proc.kill()
             except ProcessLookupError:
                 pass
+            return
+        # No worker yet: the creation is still queued (waiting on resources
+        # or a pending assignment). Mark it dead so the queued create is
+        # skipped, and fail anything already parked on it.
+        with self.lock:
+            if st.state == A_DEAD or st.worker is not None:
+                # Re-check: assignment may have won the race after our read;
+                # retry through the worker-kill branch.
+                if st.worker is not None and st.state != A_DEAD:
+                    w = st.worker
+                    if w.proc is not None:
+                        try:
+                            w.proc.kill()
+                        except ProcessLookupError:
+                            pass
+                return
+            st.state = A_DEAD
+            st.death_cause = ActorDiedError(
+                msg=f"actor {st.cspec.name} was killed before it started")
+            try:
+                self.actors_waiting_resources.remove(actor_id)
+            except ValueError:
+                pass
+            try:
+                self.pending_actor_assign.remove(actor_id)
+            except ValueError:
+                pass
+            if st.resources_reserved:
+                self._release(st.resources_reserved)
+                st.resources_reserved = {}
+            queued = list(st.queued)
+            st.queued.clear()
+        for spec in queued:
+            self._fail_returns(spec, st.death_cause)
 
     # ---------------- failure handling ----------------
 

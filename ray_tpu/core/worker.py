@@ -432,8 +432,10 @@ def zygote_main(store_path: str, ctrl_fd: int):
             os.close(logf)
             try:
                 _worker_main(store_path, WorkerID.from_hex(req["worker_id"]), fd)
-            finally:
-                os._exit(0)
+            except BaseException:  # noqa: BLE001 — log then die nonzero;
+                traceback.print_exc()  # os._exit skips the excepthook
+                os._exit(1)
+            os._exit(0)
         live.add(pid)
         signal.pthread_sigmask(signal.SIG_UNBLOCK, {signal.SIGCHLD})
         os.close(fd)

@@ -51,14 +51,11 @@ class Barrier:
         key = ("barrier", self.name, self._round)
 
         def kv_incr():
+            # Atomic on the head (runtime.kv_incr): a get-then-put here would
+            # lose counts when members arrive concurrently.
             if isinstance(rt, Runtime):
-                cur = int(rt.kv.get(key, b"0"))
-                rt.kv[key] = str(cur + 1).encode()
-                return cur + 1
-            cur = rt.request("kv_get", key)
-            n = int(cur or b"0") + 1
-            rt.request("kv_put", (key, str(n).encode()))
-            return n
+                return rt.kv_incr(key)
+            return rt.request("kv_incr", key)
 
         def kv_read():
             if isinstance(rt, Runtime):

@@ -74,23 +74,36 @@ def make_mesh(config: MeshConfig | None = None, devices=None,
     return Mesh(arr, axis_names)
 
 
-def make_hybrid_mesh(config: MeshConfig, ici_axes=("fsdp", "sp", "tp"),
-                     dcn_axes=("dp", "pp")) -> Mesh:
+def make_hybrid_mesh(config: MeshConfig, dcn_axes=("dp", "pp")) -> Mesh:
     """Multi-slice mesh: DCN-crossing axes outermost, ICI axes within a slice.
 
     Uses mesh_utils.create_hybrid_device_mesh so slow DCN hops only carry the
     dp/pp traffic (gradient psum, stage boundaries), never tp/sp collectives.
     """
     from jax.experimental import mesh_utils
-    sizes = config.resolve(len(jax.devices()))
-    ici_shape = [sizes[a] for a in AXES if a not in dcn_axes]
-    dcn_shape = [sizes[a] if a in dcn_axes else 1 for a in AXES]
-    try:
-        arr = mesh_utils.create_hybrid_device_mesh(
-            tuple(sizes[a] for a in AXES), dcn_mesh_shape=tuple(dcn_shape))
-    except Exception:  # single-slice / cpu fallback
-        arr = np.asarray(jax.devices()).reshape(tuple(sizes[a] for a in AXES))
-    del ici_shape
+    devices = jax.devices()
+    num_slices = len({getattr(d, "slice_index", 0) for d in devices})
+    if num_slices <= 1:
+        return make_mesh(config, devices=devices)
+    sizes = config.resolve(len(devices))
+    # Split each dcn axis between slices (outer) and within-slice (inner):
+    # the slice count must factor entirely into the dcn axes, otherwise an
+    # ICI axis would be forced across DCN — refuse rather than mis-lay.
+    dcn = {a: 1 for a in AXES}
+    rem = num_slices
+    for a in dcn_axes:
+        g = math.gcd(sizes[a], rem)
+        dcn[a] = g
+        rem //= g
+    if rem != 1:
+        raise ValueError(
+            f"{num_slices} slices do not factor into dcn axes "
+            f"{({a: sizes[a] for a in dcn_axes})}; an ICI axis "
+            f"({[a for a in AXES if a not in dcn_axes]}) would cross DCN")
+    ici_shape = tuple(sizes[a] // dcn[a] for a in AXES)
+    arr = mesh_utils.create_hybrid_device_mesh(
+        ici_shape, dcn_mesh_shape=tuple(dcn[a] for a in AXES),
+        devices=devices)
     return Mesh(arr, AXES)
 
 

@@ -58,19 +58,30 @@ def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
         return TrainState(params=params, opt_state=opt_state,
                           step=jnp.zeros((), jnp.int32))
 
-    # Optimizer state mirrors param sharding where leaves match param shapes.
+    # Optimizer state mirrors param sharding: optax moment trees (adam mu/nu,
+    # momentum trace, ...) have the params' tree STRUCTURE, so substitute the
+    # param shardings wholesale at any matching subtree. Shape-based matching
+    # would mis-assign when differently-sharded params share a shape (e.g.
+    # wq P(None,'fsdp','tp') vs wo P(None,'tp','fsdp'), both (L,d,d)).
     def opt_shardings(opt_state, params):
-        flat_params = jax.tree.leaves(params)
-        shapes = {id(p): s for p, s in zip(
-            flat_params, jax.tree.leaves(param_shardings))}
+        param_treedef = jax.tree.structure(params)
+        if param_treedef.num_leaves <= 1:
+            # Degenerate single-leaf params: every leaf "matches" the
+            # structure, so fall back to shape matching (no ambiguity with
+            # one param) to avoid sharding adam's scalar count.
+            p_shape = getattr(jax.tree.leaves(params)[0], "shape", None)
+            p_shard = jax.tree.leaves(param_shardings)[0]
+            return jax.tree.map(
+                lambda leaf: p_shard
+                if getattr(leaf, "shape", None) == p_shape else repl,
+                opt_state)
 
-        def guess(leaf):
-            for p, s in zip(flat_params, jax.tree.leaves(param_shardings)):
-                if getattr(leaf, "shape", None) == p.shape:
-                    return s
-            return repl
-        del shapes
-        return jax.tree.map(guess, opt_state)
+        def is_param_tree(node):
+            return jax.tree.structure(node) == param_treedef
+
+        return jax.tree.map(
+            lambda sub: param_shardings if is_param_tree(sub) else repl,
+            opt_state, is_leaf=is_param_tree)
 
     def step_fn(state: TrainState, batch):
         loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)

@@ -111,3 +111,28 @@ def test_chaos_message_delay():
         assert ray_tpu.get(f.remote(), timeout=60) == 1
     finally:
         ray_tpu.shutdown()
+
+
+def test_kill_actor_queued_on_resources(ray_start_isolated):
+    """Killing an actor whose creation is parked waiting for resources must
+    cancel the queued create and fail parked calls, not start it later."""
+
+    @ray_tpu.remote(num_cpus=2)
+    class Hog:
+        def ping(self):
+            return 1
+
+    # The isolated cluster has 2 CPUs: the first actor takes both, the
+    # second parks in actors_waiting_resources.
+    first = Hog.remote()
+    assert ray_tpu.get(first.ping.remote(), timeout=120) == 1
+    second = Hog.remote()
+    parked = second.ping.remote()
+    ray_tpu.kill(second)
+    with pytest.raises(ray_tpu.RayTpuError):
+        ray_tpu.get(parked, timeout=30)
+    # The killed actor must never come alive when capacity frees up.
+    ray_tpu.kill(first)
+    time.sleep(0.5)
+    with pytest.raises(ray_tpu.RayTpuError):
+        ray_tpu.get(second.ping.remote(), timeout=30)

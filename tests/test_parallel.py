@@ -105,3 +105,25 @@ def test_gpipe_grads_flow(sp_mesh):
     g_seq = jax.grad(loss_seq)(ws)
     np.testing.assert_allclose(np.asarray(g_pp), np.asarray(g_seq),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_barrier_concurrent_arrivals():
+    """N actors gang-entering Barrier.wait: the kv increment must be atomic
+    or concurrent arrivals lose counts and the barrier hangs."""
+    import ray_tpu
+    from ray_tpu.parallel.collectives import Barrier
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        @ray_tpu.remote
+        class Member:
+            def go(self, rounds):
+                b = Barrier("gang", 4)
+                for _ in range(rounds):
+                    b.wait(timeout=60)
+                return True
+
+        members = [Member.remote() for _ in range(4)]
+        assert all(ray_tpu.get([m.go.remote(5) for m in members], timeout=120))
+    finally:
+        ray_tpu.shutdown()
