@@ -32,10 +32,12 @@ from ray_tpu.core.status import (
     WorkerCrashedError,
 )
 
+from ray_tpu import util  # noqa: E402,F401  (parity: ray.util auto-import)
+
 __all__ = [
     "__version__", "init", "shutdown", "is_initialized", "remote", "method",
     "get", "put", "wait", "kill", "get_actor", "cluster_resources",
     "available_resources", "timeline", "ObjectRef", "RayTpuError",
     "TaskError", "ActorDiedError", "WorkerCrashedError", "ObjectLostError",
-    "GetTimeoutError",
+    "GetTimeoutError", "util",
 ]

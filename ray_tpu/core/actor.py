@@ -93,6 +93,7 @@ class ActorClass:
             resources=opts.get("resources"),
             placement_group_id=_pg_id(opts),
             bundle_index=_pg_bundle(opts),
+            scheduling_strategy=opts.get("scheduling_strategy"),
             dependencies=[r.id.binary() for r in refs],
         )
         cspec.methods_meta = self._meta

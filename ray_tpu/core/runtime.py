@@ -279,6 +279,36 @@ class ObjectDirectory:
             self.entries.pop(oid, None)
 
 
+class PlacementGroupState:
+    """Head-side record of a placement group.
+
+    Parity: `gcs_placement_group_manager.h:232` (lifecycle) +
+    `gcs_placement_group_scheduler.h:288` (2PC reserve, collapsed to one
+    atomic carve-out on the single-node pool). `bundle_avail` tracks the
+    unconsumed remainder of each bundle's reservation.
+    """
+
+    __slots__ = ("pg_id", "bundles", "strategy", "name", "state",
+                 "bundle_avail", "ready_oid")
+
+    def __init__(self, pg_id: bytes, bundles, strategy: str, name: str):
+        self.pg_id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+        self.name = name
+        self.state = "PENDING"  # PENDING/CREATED/REMOVED/INFEASIBLE
+        self.bundle_avail = [dict(b) for b in bundles]
+        self.ready_oid = os.urandom(16)
+
+
+def _sum_bundles(bundles) -> dict[str, float]:
+    total: dict[str, float] = {}
+    for b in bundles:
+        for k, v in b.items():
+            total[k] = total.get(k, 0.0) + v
+    return total
+
+
 class TaskEventBuffer:
     """Bounded ring of task state transitions (parity: task_event_buffer.h:225)."""
 
@@ -346,6 +376,9 @@ class Runtime:
         self.actors_waiting_resources: collections.deque[bytes] = collections.deque()
         self._shutdown = False
         self.kv: dict[tuple, bytes] = {}  # internal KV (parity: gcs_kv_manager.h)
+        self.placement_groups: dict[bytes, PlacementGroupState] = {}
+        self.pgs_waiting: collections.deque[bytes] = collections.deque()
+        self._reservations: dict[bytes, tuple] = {}  # task_id -> token
 
         self._selector = selectors.DefaultSelector()
         self._sel_lock = threading.Lock()
@@ -538,6 +571,14 @@ class Runtime:
         elif what == "actor_methods":
             st = self.actors.get(arg)
             resp = (st.cspec.methods_meta or {}) if st else {}
+        elif what == "create_pg":
+            pg_id, bundles, strategy, name = arg
+            resp = self.create_placement_group(pg_id, bundles, strategy, name)
+        elif what == "remove_pg":
+            self.remove_placement_group(arg)
+            resp = True
+        elif what == "pg_table":
+            resp = self.placement_group_table()
         elif what == "cluster_resources":
             resp = dict(self.total_resources)
         elif what == "available_resources":
@@ -753,9 +794,103 @@ class Runtime:
             self.available[k] -= v
         return True
 
+    @staticmethod
+    def _pg_of(strategy) -> tuple[bytes | None, int]:
+        """(pg_id, bundle_index) from a scheduling strategy, if any."""
+        pg = getattr(strategy, "placement_group", None)
+        if pg is None:
+            return None, -1
+        bidx = getattr(strategy, "placement_group_bundle_index", -1)
+        return pg.id.binary(), (-1 if bidx is None else bidx)
+
+    def _try_reserve_pg(self, pg_id: bytes, bidx: int,
+                        req: dict[str, float]):
+        """Reserve `req` out of a placement-group bundle. Returns a token,
+        None (retry when capacity frees / the PG finishes creating), or
+        raises when the request can never be satisfied."""
+        st = self.placement_groups.get(pg_id)
+        if st is None or st.state == "REMOVED":
+            raise RayTpuError(
+                f"placement group {pg_id.hex()[:12]} was removed or never "
+                f"created")
+        if st.state == "INFEASIBLE":
+            raise ResourceError(
+                f"placement group {pg_id.hex()[:12]} is infeasible on this "
+                f"cluster (strategy={st.strategy}, bundles={st.bundles})")
+        if st.state != "CREATED":
+            return None
+        if bidx < -1 or bidx >= len(st.bundles):
+            raise RayTpuError(
+                f"bundle_index {bidx} out of range for placement group with "
+                f"{len(st.bundles)} bundles")
+        idxs = range(len(st.bundles)) if bidx == -1 else [bidx]
+        if not any(all(st.bundles[i].get(k, 0.0) + 1e-9 >= v
+                       for k, v in req.items())
+                   for i in idxs):
+            raise ResourceError(
+                f"request {req} exceeds every candidate bundle spec of "
+                f"placement group {pg_id.hex()[:12]}")
+        for i in idxs:
+            b = st.bundle_avail[i]
+            if all(b.get(k, 0.0) + 1e-9 >= v for k, v in req.items()):
+                for k, v in req.items():
+                    b[k] = b.get(k, 0.0) - v
+                return ("pg", pg_id, i, req)
+        return None
+
+    def _try_reserve_strategy(self, strategy, req: dict[str, float]):
+        """Reserve `req` per a scheduling strategy (global pool or PG bundle).
+        Returns a release token, None to retry later, or raises."""
+        pg_id, bidx = self._pg_of(strategy)
+        if pg_id is None:
+            return ("global", req) if self._try_reserve(req) else None
+        return self._try_reserve_pg(pg_id, bidx, req)
+
+    def _try_reserve_spec(self, spec: TaskSpec):
+        return self._try_reserve_strategy(
+            spec.scheduling_strategy, self._resources_of(spec))
+
+    def _release_token(self, token):
+        if not token:
+            return
+        if token[0] == "global":
+            self._release(token[1])
+            return
+        _, pg_id, i, req = token
+        st = self.placement_groups.get(pg_id)
+        if st is not None and st.state == "CREATED":
+            b = st.bundle_avail[i]
+            for k, v in req.items():
+                b[k] = b.get(k, 0.0) + v
+            # Freed bundle capacity may unblock queued PG tasks/actors.
+            self._release({})
+        else:
+            # PG gone: its carve-out returns to the global pool piecewise as
+            # consumers finish.
+            self._release(req)
+
     def _release(self, req: dict[str, float]):
         for k, v in req.items():
             self.available[k] = self.available.get(k, 0.0) + v
+        # Freed capacity may unblock queued placement groups — they reserve
+        # whole bundles atomically, so retry them first (FIFO).
+        created_pgs = []
+        if self.pgs_waiting:
+            still = collections.deque()
+            for pg_id in self.pgs_waiting:
+                st = self.placement_groups.get(pg_id)
+                if st is None or st.state != "PENDING":
+                    continue
+                if self._try_create_pg_locked(st):
+                    created_pgs.append(st)
+                else:
+                    still.append(pg_id)
+            self.pgs_waiting = still
+        if created_pgs:
+            def fulfill():
+                for st in created_pgs:
+                    self._fulfill_pg_ready(st)
+            threading.Thread(target=fulfill, daemon=True).start()
         # Freed capacity may unblock queued actor creations — retry ALL of
         # them, not just one: the freed block may fit several small waiters
         # and no later release is guaranteed to come. _create_actor_now
@@ -773,6 +908,104 @@ class Runtime:
 
             threading.Thread(target=retry, daemon=True).start()
 
+    # ---------------- placement groups ----------------
+
+    def create_placement_group(self, pg_id: bytes, bundles, strategy: str,
+                               name: str = "") -> bytes:
+        """Reserve `bundles` atomically; returns the ready-ObjectRef id.
+
+        On one node STRICT_SPREAD with >1 bundle can never be satisfied
+        (each bundle needs a distinct node) — marked INFEASIBLE, mirroring
+        the reference's forever-pending semantics but failing ready() fast.
+        """
+        st = PlacementGroupState(pg_id, bundles, strategy, name)
+        # The PG record owns its ready-object for the PG's lifetime; without
+        # the pin the first ready() handle to be GC'd would free the entry.
+        self.refcount.pin(st.ready_oid)
+        created = False
+        with self.lock:
+            self.placement_groups[pg_id] = st
+            total = _sum_bundles(bundles)
+            infeasible = any(self.total_resources.get(k, 0.0) < v
+                             for k, v in total.items())
+            if strategy == "STRICT_SPREAD" and len(bundles) > 1:
+                infeasible = True
+            if infeasible:
+                st.state = "INFEASIBLE"
+            else:
+                created = self._try_create_pg_locked(st)
+                if not created and st.state == "PENDING":
+                    self.pgs_waiting.append(pg_id)
+        if created:
+            self._fulfill_pg_ready(st)
+        elif st.state == "INFEASIBLE":
+            self.directory.put(st.ready_oid, ("err", ResourceError(
+                f"placement group (strategy={strategy}, bundles={bundles}) "
+                f"is infeasible: cluster total is {self.total_resources}")))
+            self._on_object_ready(st.ready_oid)
+        return st.ready_oid
+
+    def _try_create_pg_locked(self, st: PlacementGroupState) -> bool:
+        total = _sum_bundles(st.bundles)
+        for k, v in total.items():
+            if self.available.get(k, 0.0) + 1e-9 < v:
+                return False
+        for k, v in total.items():
+            self.available[k] -= v
+        st.state = "CREATED"
+        st.bundle_avail = [dict(b) for b in st.bundles]
+        return True
+
+    def _fulfill_pg_ready(self, st: PlacementGroupState):
+        self.directory.put(st.ready_oid, ("inline", True))
+        self._on_object_ready(st.ready_oid)
+        with self.lock:
+            self._release({})  # kick waiting actors/tasks gated on this PG
+
+    def remove_placement_group(self, pg_id: bytes):
+        with self.lock:
+            st = self.placement_groups.get(pg_id)
+            if st is None or st.state == "REMOVED":
+                return
+            was = st.state
+            if was == "CREATED":
+                # Return the unconsumed remainder now; amounts held by
+                # running tasks/actors flow back via _release_token.
+                for b in st.bundle_avail:
+                    for k, v in b.items():
+                        self.available[k] = self.available.get(k, 0.0) + v
+            try:
+                self.pgs_waiting.remove(pg_id)
+            except ValueError:
+                pass
+            st.state = "REMOVED"
+            st.bundle_avail = [{} for _ in st.bundles]
+        if was != "CREATED":
+            self.directory.put(st.ready_oid, ("err", RayTpuError(
+                "placement group was removed before it was created")))
+            self._on_object_ready(st.ready_oid)
+        # Drop the PG's lifetime pin; free the ready object outright once no
+        # user handle still references it (avoids one leaked directory entry
+        # per create/remove cycle).
+        self.refcount.unpin(st.ready_oid)
+        if not self.refcount.has_refs(st.ready_oid):
+            self._free_object(st.ready_oid)
+        with self.lock:
+            self._release({})
+        self._schedule()
+
+    def placement_group_table(self) -> dict:
+        with self.lock:
+            return {
+                pg_id.hex(): {
+                    "name": st.name,
+                    "strategy": st.strategy,
+                    "state": st.state,
+                    "bundles": {i: dict(b) for i, b in enumerate(st.bundles)},
+                }
+                for pg_id, st in self.placement_groups.items()
+            }
+
     def _check_feasible(self, req: dict[str, float], what: str):
         for k, v in req.items():
             if self.total_resources.get(k, 0.0) < v:
@@ -783,6 +1016,7 @@ class Runtime:
     def _schedule(self):
         """Dispatch every feasible queued task to an idle worker."""
         dispatches = []
+        failures = []
         with self.lock:
             remaining = collections.deque()
             while self.task_queue:
@@ -790,17 +1024,24 @@ class Runtime:
                 if not self.idle:
                     remaining.append(spec)
                     break
-                req = self._resources_of(spec)
-                if not self._try_reserve(req):
+                try:
+                    token = self._try_reserve_spec(spec)
+                except RayTpuError as e:
+                    failures.append((spec, e))
+                    continue
+                if token is None:
                     remaining.append(spec)
                     continue
+                self._reservations[spec.task_id] = token
                 w = self.idle.popleft()
                 w.state = BUSY
                 w.current_task = spec
-                dispatches.append((w, spec, req))
+                dispatches.append((w, spec))
             remaining.extend(self.task_queue)
             self.task_queue = remaining
-        for w, spec, req in dispatches:
+        for spec, e in failures:
+            self._fail_returns(spec, e)
+        for w, spec in dispatches:
             self._dispatch(w, spec)
 
     def _dispatch(self, w: WorkerHandle, spec: TaskSpec):
@@ -811,7 +1052,7 @@ class Runtime:
                 self._fail_returns(spec, RayTpuError(
                     f"function {spec.fn_id.hex()} was never exported"))
                 with self.lock:  # return the reserved worker + resources
-                    self._release(self._resources_of(spec))
+                    self._release_token(self._reservations.pop(spec.task_id, None))
                     w.current_task = None
                     w.state = IDLE
                     self.idle.append(w)
@@ -845,9 +1086,8 @@ class Runtime:
         if spec is not None:
             self.task_events.record(task_id, spec.describe(), "FINISHED")
             self._unpin_deps(spec)
-            req = self._resources_of(spec)
             with self.lock:
-                self._release(req)
+                self._release_token(self._reservations.pop(spec.task_id, None))
                 w.current_task = None
                 w.state = IDLE
                 self.idle.append(w)
@@ -904,10 +1144,28 @@ class Runtime:
             # Actors hold their resources for their lifetime; queue the
             # creation until the reservation fits (released on death/kill).
             req = self._actor_resources(cspec)
-            if not self._try_reserve(req):
+            try:
+                if cspec.placement_group_id is not None:
+                    bidx = cspec.bundle_index
+                    token = self._try_reserve_pg(
+                        cspec.placement_group_id,
+                        -1 if bidx is None else bidx, req)
+                else:
+                    token = ("global", req) if self._try_reserve(req) else None
+            except RayTpuError as e:
+                st.state = A_DEAD
+                st.death_cause = e
+                if cspec.name and self.named_actors.get(cspec.name) == cspec.actor_id:
+                    del self.named_actors[cspec.name]
+                queued = list(st.queued)
+                st.queued.clear()
+                for qspec in queued:
+                    self._fail_returns(qspec, e)
+                return
+            if token is None:
                 self.actors_waiting_resources.append(cspec.actor_id)
                 return
-            st.resources_reserved = req
+            st.resources_reserved = token
             w = self.idle.popleft() if self.idle else None
             if w is not None:
                 self._assign_actor_locked(st, w)
@@ -970,8 +1228,8 @@ class Runtime:
             if name and self.named_actors.get(name) == st.cspec.actor_id:
                 del self.named_actors[name]
             if st.resources_reserved:
-                self._release(st.resources_reserved)
-                st.resources_reserved = {}
+                self._release_token(st.resources_reserved)
+                st.resources_reserved = None
         # Reclaim the worker process: its only job was this actor.
         w = st.worker
         st.worker = None
@@ -1077,8 +1335,8 @@ class Runtime:
             except ValueError:
                 pass
             if st.resources_reserved:
-                self._release(st.resources_reserved)
-                st.resources_reserved = {}
+                self._release_token(st.resources_reserved)
+                st.resources_reserved = None
             queued = list(st.queued)
             st.queued.clear()
         for spec in queued:
@@ -1108,7 +1366,7 @@ class Runtime:
         if prev_state == BUSY and w.current_task is not None:
             spec = w.current_task
             with self.lock:
-                self._release(self._resources_of(spec))
+                self._release_token(self._reservations.pop(spec.task_id, None))
             if (spec.retries_left or 0) > 0:
                 spec.retries_left -= 1
                 self.task_events.record(spec.task_id, spec.describe(), "RETRY")
@@ -1160,8 +1418,8 @@ class Runtime:
                 if cspec.name and self.named_actors.get(cspec.name) == actor_id:
                     del self.named_actors[cspec.name]
                 if st.resources_reserved:
-                    self._release(st.resources_reserved)
-                    st.resources_reserved = {}
+                    self._release_token(st.resources_reserved)
+                    st.resources_reserved = None
 
     # ---------------- introspection ----------------
 

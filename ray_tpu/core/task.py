@@ -65,7 +65,7 @@ class ActorCreationSpec:
                  "max_restarts", "restarts_used", "max_concurrency", "is_async",
                  "num_cpus", "num_tpus", "resources", "max_task_retries",
                  "placement_group_id", "bundle_index", "runtime_env",
-                 "dependencies", "methods_meta")
+                 "dependencies", "methods_meta", "scheduling_strategy")
 
     def __init__(self, **kw):
         for s in self.__slots__:

@@ -266,12 +266,22 @@ def _execute(rt: WorkerRuntime, spec: TaskSpec, fn):
         args = [_resolve_arg(rt, a) for a in args]
         kwargs = {k: _resolve_arg(rt, v) for k, v in kwargs.items()}
         rt.current_task_name = spec.describe()
+        # Read by util.placement_group.get_current_placement_group(); lives
+        # on the runtime object because this module is __main__ in workers.
+        # Actor methods carry no per-task strategy — fall back to the
+        # strategy the actor itself was created with.
+        rt.current_scheduling_strategy = (
+            spec.scheduling_strategy
+            or getattr(rt, "actor_scheduling_strategy", None))
         result = fn(*args, **kwargs)
         if inspect.iscoroutine(result):
             result = asyncio.get_event_loop().run_until_complete(result)
         return "ok", result
     except BaseException as e:  # noqa: BLE001 — errors cross the wire
         return "err", TaskError.from_exception(e, spec.describe())
+    finally:
+        rt.current_scheduling_strategy = getattr(
+            rt, "actor_scheduling_strategy", None)
 
 
 def _reply_result(rt: WorkerRuntime, spec: TaskSpec, status, result):
@@ -487,6 +497,9 @@ def _worker_main(store_path: str, worker_id: WorkerID, fd: int):
             args, kwargs = serialization.deserialize(cspec.payload, cspec.buffers)
             args = [_resolve_arg(rt, a) for a in args]
             kwargs = {k: _resolve_arg(rt, v) for k, v in kwargs.items()}
+            # Set before __init__ so get_current_placement_group() works
+            # inside the constructor too.
+            rt.actor_scheduling_strategy = cspec.scheduling_strategy
             rt.actor_instance = cls(*args, **kwargs)
             rt.actor_id = cspec.actor_id
             rt.send(("actor_ready", cspec.actor_id))

@@ -1,0 +1,227 @@
+"""Placement group + scheduling strategy tests.
+
+Parity: reference `python/ray/tests/test_placement_group*.py` — create/ready/
+remove, bundle reservations gating tasks and actors, strategy validation,
+infeasible handling, ActorPool.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.status import ResourceError
+from ray_tpu.util import (
+    PlacementGroup,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+
+@ray_tpu.remote
+def whoami():
+    from ray_tpu.util.placement_group import get_current_placement_group
+    pg = get_current_placement_group()
+    return None if pg is None else pg.id.hex()
+
+
+@ray_tpu.remote
+def hold(t):
+    time.sleep(t)
+    return 1
+
+
+@ray_tpu.remote
+class Sleeper:
+    def ping(self):
+        return "pong"
+
+
+def test_create_ready_remove(ray_start_regular):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert ray_tpu.get(pg.ready(), timeout=10) is True
+    assert pg.wait(5)
+    table = placement_group_table()
+    ent = table[pg.id.hex()]
+    assert ent["state"] == "CREATED"
+    assert ent["strategy"] == "PACK"
+    avail = ray_tpu.available_resources()
+    assert avail["CPU"] <= ray_tpu.cluster_resources()["CPU"] - 2
+    remove_placement_group(pg)
+    time.sleep(0.2)
+    assert placement_group_table()[pg.id.hex()]["state"] == "REMOVED"
+    avail2 = ray_tpu.available_resources()
+    assert avail2["CPU"] >= avail["CPU"] + 2 - 1e-9
+
+
+def test_task_in_bundle(ray_start_regular):
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.wait(10)
+    strat = PlacementGroupSchedulingStrategy(
+        placement_group=pg, placement_group_bundle_index=0)
+    ref = whoami.options(scheduling_strategy=strat).remote()
+    assert ray_tpu.get(ref, timeout=15) == pg.id.hex()
+    remove_placement_group(pg)
+
+
+def test_bundle_gates_concurrency(ray_start_regular):
+    # A 1-CPU bundle serializes two 1-CPU tasks even though the cluster has 4.
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.wait(10)
+    strat = PlacementGroupSchedulingStrategy(placement_group=pg)
+    t0 = time.monotonic()
+    refs = [hold.options(scheduling_strategy=strat).remote(0.4)
+            for _ in range(2)]
+    assert ray_tpu.get(refs, timeout=20) == [1, 1]
+    assert time.monotonic() - t0 >= 0.8
+    remove_placement_group(pg)
+
+
+def test_task_exceeding_bundle_fails(ray_start_regular):
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.wait(10)
+    strat = PlacementGroupSchedulingStrategy(placement_group=pg)
+    ref = hold.options(num_cpus=2, scheduling_strategy=strat).remote(0.01)
+    with pytest.raises(ResourceError):
+        ray_tpu.get(ref, timeout=10)
+    remove_placement_group(pg)
+
+
+def test_actor_in_bundle(ray_start_regular):
+    pg = placement_group([{"CPU": 1}], strategy="SPREAD")
+    assert pg.wait(10)
+    strat = PlacementGroupSchedulingStrategy(
+        placement_group=pg, placement_group_bundle_index=0)
+    a = Sleeper.options(num_cpus=1, scheduling_strategy=strat).remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=15) == "pong"
+    # Bundle is fully consumed: a second 1-CPU actor in the PG must queue.
+    b = Sleeper.options(num_cpus=1, scheduling_strategy=strat).remote()
+    ref = b.ping.remote()
+    ready, not_ready = ray_tpu.wait([ref], timeout=0.5)
+    assert not ready
+    ray_tpu.kill(a)
+    assert ray_tpu.get(ref, timeout=15) == "pong"
+    ray_tpu.kill(b)
+    remove_placement_group(pg)
+
+
+def test_pending_pg_waits_for_capacity(ray_start_regular):
+    # Grab the whole cluster with pg1; pg2 must pend, then create on removal.
+    total = int(ray_tpu.cluster_resources()["CPU"])
+    pg1 = placement_group([{"CPU": total}])
+    assert pg1.wait(10)
+    pg2 = placement_group([{"CPU": total}])
+    assert not pg2.wait(0.3)
+    remove_placement_group(pg1)
+    assert pg2.wait(10)
+    remove_placement_group(pg2)
+
+
+def test_infeasible_pg(ray_start_regular):
+    pg = placement_group([{"CPU": 10_000}])
+    with pytest.raises(ResourceError):
+        ray_tpu.get(pg.ready(), timeout=5)
+    # STRICT_SPREAD needs one node per bundle; single node -> infeasible.
+    pg2 = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    with pytest.raises(ResourceError):
+        ray_tpu.get(pg2.ready(), timeout=5)
+
+
+def test_strategy_validation(ray_start_regular):
+    with pytest.raises(ValueError):
+        placement_group([{"CPU": 1}], strategy="DIAGONAL")
+    with pytest.raises(ValueError):
+        placement_group([])
+    with pytest.raises(ValueError):
+        placement_group([{"CPU": -1}])
+
+
+def test_pg_from_worker_task(ray_start_regular):
+    # Placement groups can be created from inside a task (worker process).
+    @ray_tpu.remote
+    def make_pg():
+        inner = placement_group([{"CPU": 1}], name="from-worker")
+        ok = inner.wait(10)
+        remove_placement_group(inner)
+        return ok
+
+    assert ray_tpu.get(make_pg.remote(), timeout=30) is True
+
+
+def test_pg_handle_pickles(ray_start_regular):
+    import pickle
+    pg = placement_group([{"CPU": 1}], strategy="ICI_CONTIGUOUS")
+    assert pg.wait(10)
+    pg2 = pickle.loads(pickle.dumps(pg))
+    assert isinstance(pg2, PlacementGroup)
+    assert pg2.id.binary() == pg.id.binary()
+    remove_placement_group(pg)
+
+
+def test_actor_pg_context(ray_start_regular):
+    # get_current_placement_group() inside actor methods returns the PG the
+    # actor was created with (methods carry no per-task strategy).
+    @ray_tpu.remote
+    class Who:
+        def pg(self):
+            from ray_tpu.util.placement_group import get_current_placement_group
+            p = get_current_placement_group()
+            return None if p is None else p.id.hex()
+
+    pg = placement_group([{"CPU": 1}])
+    assert pg.wait(10)
+    strat = PlacementGroupSchedulingStrategy(placement_group=pg)
+    a = Who.options(num_cpus=1, scheduling_strategy=strat).remote()
+    assert ray_tpu.get(a.pg.remote(), timeout=15) == pg.id.hex()
+    ray_tpu.kill(a)
+    remove_placement_group(pg)
+
+
+def test_queued_actor_calls_fail_on_pg_removal(ray_start_regular):
+    # Actor queued behind a pending PG + queued method call: removing the PG
+    # must fail the queued call, not hang it.
+    total = int(ray_tpu.cluster_resources()["CPU"])
+    pg1 = placement_group([{"CPU": total}])
+    assert pg1.wait(10)
+    pg2 = placement_group([{"CPU": 1}])  # pends behind pg1
+    strat = PlacementGroupSchedulingStrategy(placement_group=pg2)
+    a = Sleeper.options(num_cpus=1, scheduling_strategy=strat).remote()
+    ref = a.ping.remote()
+    remove_placement_group(pg2)
+    remove_placement_group(pg1)
+    with pytest.raises(Exception):
+        ray_tpu.get(ref, timeout=10)
+
+
+def test_bad_bundle_index(ray_start_regular):
+    pg = placement_group([{"CPU": 1}])
+    assert pg.wait(10)
+    for bad in (-2, 5):
+        strat = PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=bad)
+        with pytest.raises(ray_tpu.RayTpuError):
+            ray_tpu.get(hold.options(scheduling_strategy=strat).remote(0.01),
+                        timeout=10)
+    remove_placement_group(pg)
+
+
+def test_zero_bundle_rejected(ray_start_regular):
+    with pytest.raises(ValueError):
+        placement_group([{"CPU": 0}])
+
+
+def test_actor_pool(ray_start_regular):
+    @ray_tpu.remote
+    class Doubler:
+        def double(self, x):
+            return 2 * x
+
+    from ray_tpu.util import ActorPool
+    pool = ActorPool([Doubler.remote() for _ in range(2)])
+    assert list(pool.map(lambda a, v: a.double.remote(v), [1, 2, 3, 4])) == \
+        [2, 4, 6, 8]
+    got = sorted(pool.map_unordered(
+        lambda a, v: a.double.remote(v), [5, 6, 7]))
+    assert got == [10, 12, 14]
