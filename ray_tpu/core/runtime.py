@@ -980,16 +980,15 @@ class Runtime:
                 pass
             st.state = "REMOVED"
             st.bundle_avail = [{} for _ in st.bundles]
-        if was != "CREATED":
-            self.directory.put(st.ready_oid, ("err", RayTpuError(
-                "placement group was removed before it was created")))
-            self._on_object_ready(st.ready_oid)
-        # Drop the PG's lifetime pin; free the ready object outright once no
-        # user handle still references it (avoids one leaked directory entry
-        # per create/remove cycle).
-        self.refcount.unpin(st.ready_oid)
-        if not self.refcount.has_refs(st.ready_oid):
-            self._free_object(st.ready_oid)
+        # Overwrite the ready entry with an error so any ready()/wait() call
+        # issued after removal resolves instead of hanging. The entry stays
+        # pinned for the runtime's lifetime — freeing it would strand handles
+        # created later (ready() makes its ObjectRef lazily); the ~100-byte
+        # tombstone per PG mirrors the reference keeping REMOVED rows in the
+        # placement-group table.
+        self.directory.put(st.ready_oid, ("err", RayTpuError(
+            "placement group was removed")))
+        self._on_object_ready(st.ready_oid)
         with self.lock:
             self._release({})
         self._schedule()

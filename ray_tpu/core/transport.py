@@ -60,31 +60,87 @@ def get_chaos() -> ChaosInjector:
     return _chaos
 
 
+# Frame: <Q payload_len><I nbufs>[<Q buf_len>...]<payload><buffers...>
+# Out-of-band pickle-5 buffers (numpy arrays, memoryviews from the shm
+# store) travel unpickled — no copy into the pickle stream on send.
+_NBUF = struct.Struct("<I")
+_BLEN = struct.Struct("<Q")
+
+
+def _load_buf(b):
+    return b if isinstance(b, memoryview) else memoryview(b)
+
+
+class _MsgPickler(pickle.Pickler):
+    """Routes bare memoryviews (task-arg/result buffers riding inside specs)
+    out-of-band instead of failing — pickle refuses raw memoryviews."""
+
+    def reducer_override(self, obj):
+        if isinstance(obj, memoryview):
+            return (_load_buf, (pickle.PickleBuffer(obj),))
+        return NotImplemented
+
+
+def _encode(msg) -> list:
+    import io
+    pbufs: list[pickle.PickleBuffer] = []
+    f = io.BytesIO()
+    _MsgPickler(f, protocol=5, buffer_callback=pbufs.append).dump(msg)
+    payload = f.getvalue()
+    raws = [b.raw() for b in pbufs]
+    parts = [_HDR.pack(len(payload)), _NBUF.pack(len(raws))]
+    parts += [_BLEN.pack(r.nbytes) for r in raws]
+    parts.append(payload)
+    parts += raws
+    return parts
+
+
 def send_msg(sock: socket.socket, msg, lock: threading.Lock | None = None):
     op = msg[0] if isinstance(msg, tuple) and msg else ""
     chaos = get_chaos()
     chaos.maybe_delay(op)
     if chaos.maybe_drop(op):
         return
-    payload = pickle.dumps(msg, protocol=5)
-    data = _HDR.pack(len(payload)) + payload
+    parts = _encode(msg)
+    # Header/lengths coalesce into one small write; buffers are sent as-is —
+    # joining would copy every large tensor a second time.
+    head = b"".join(p for p in parts if isinstance(p, bytes))
+    bufs = [p for p in parts if not isinstance(p, bytes)]
     if lock:
         with lock:
-            sock.sendall(data)
+            sock.sendall(head)
+            for b in bufs:
+                sock.sendall(b)
     else:
-        sock.sendall(data)
+        sock.sendall(head)
+        for b in bufs:
+            sock.sendall(b)
 
 
 def recv_msg(sock: socket.socket):
     """Blocking receive of one frame; returns None on clean EOF."""
-    hdr = _recv_exact(sock, _HDR.size)
+    hdr = _recv_exact(sock, _HDR.size + _NBUF.size)
     if hdr is None:
         return None
-    (n,) = _HDR.unpack(hdr)
+    (n,) = _HDR.unpack_from(hdr, 0)
+    (nbufs,) = _NBUF.unpack_from(hdr, _HDR.size)
+    blens = []
+    if nbufs:
+        lens = _recv_exact(sock, _BLEN.size * nbufs)
+        if lens is None:
+            return None
+        blens = [_BLEN.unpack_from(lens, i * _BLEN.size)[0]
+                 for i in range(nbufs)]
     payload = _recv_exact(sock, n)
     if payload is None:
         return None
-    return pickle.loads(payload)
+    bufs = []
+    for bl in blens:
+        b = _recv_exact(sock, bl)
+        if b is None:
+            return None
+        bufs.append(b)
+    return pickle.loads(payload, buffers=bufs)
 
 
 def _recv_exact(sock: socket.socket, n: int):
@@ -113,14 +169,27 @@ class FrameBuffer:
     def frames(self):
         out = []
         while True:
-            if len(self._buf) < _HDR.size:
+            pre = _HDR.size + _NBUF.size
+            if len(self._buf) < pre:
                 break
             (n,) = _HDR.unpack_from(self._buf, 0)
-            if len(self._buf) < _HDR.size + n:
+            (nbufs,) = _NBUF.unpack_from(self._buf, _HDR.size)
+            lens_end = pre + _BLEN.size * nbufs
+            if len(self._buf) < lens_end:
                 break
-            payload = bytes(self._buf[_HDR.size : _HDR.size + n])
-            del self._buf[: _HDR.size + n]
-            out.append(pickle.loads(payload))
+            blens = [_BLEN.unpack_from(self._buf, pre + i * _BLEN.size)[0]
+                     for i in range(nbufs)]
+            total = lens_end + n + sum(blens)
+            if len(self._buf) < total:
+                break
+            payload = bytes(self._buf[lens_end:lens_end + n])
+            bufs = []
+            off = lens_end + n
+            for bl in blens:
+                bufs.append(bytes(self._buf[off:off + bl]))
+                off += bl
+            del self._buf[:total]
+            out.append(pickle.loads(payload, buffers=bufs))
         return out
 
 

@@ -42,11 +42,21 @@ class ActorPool:
         if self._next_return_index >= self._next_task_index:
             raise ValueError("It is not allowed to call get_next() after "
                              "get_next_unordered().")
-        future = self._index_to_future.pop(self._next_return_index)
+        future = self._index_to_future[self._next_return_index]
+        if timeout is not None:
+            # Probe first: a timeout must leave the pool untouched so the
+            # caller can retry (mutating before the get would lose the result
+            # and hand the still-busy actor back to the idle list).
+            ready, _ = ray_tpu.wait([future], timeout=timeout)
+            if not ready:
+                from ray_tpu.core.status import GetTimeoutError
+                raise GetTimeoutError(
+                    f"get_next timed out after {timeout}s")
+        del self._index_to_future[self._next_return_index]
         self._next_return_index += 1
         _, actor = self._future_to_actor.pop(future)
         self._return_actor(actor)
-        return ray_tpu.get(future, timeout=timeout)
+        return ray_tpu.get(future)
 
     def get_next_unordered(self, timeout=None):
         if not self.has_next():

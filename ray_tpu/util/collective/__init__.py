@@ -1,0 +1,34 @@
+"""Collective communication between actors/tasks outside the object path.
+
+Parity: reference `python/ray/util/collective/collective.py:123`
+(init_collective_group, allreduce:268, barrier, reduce, broadcast,
+allgather, reducescatter, send/recv) with its NCCL
+(`collective_group/nccl_collective_group.py:128`) and GLOO
+(`gloo_collective_group.py:184`) backends.
+
+TPU-native stance (SURVEY §5.8): dense-math communication belongs INSIDE
+jit-compiled programs as jax.lax collectives over ICI
+(`ray_tpu.parallel.collectives`). This module is the HOST-side backend —
+the analogue of the reference's GLOO group — used for control-plane
+exchange (weight broadcast to env-runners, metric reduction, rendezvous):
+small payloads ride the head KV, large tensors ride the shared-memory
+object plane, with KV-sequenced rendezvous.
+"""
+
+from ray_tpu.util.collective.collective import (  # noqa: F401
+    ReduceOp,
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    destroy_collective_group,
+    get_collective_group_size,
+    get_rank,
+    init_collective_group,
+    is_group_initialized,
+    join_group,
+    recv,
+    reduce,
+    reducescatter,
+    send,
+)

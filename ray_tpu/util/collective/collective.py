@@ -1,0 +1,288 @@
+"""Host-side collective groups over the KV + shared-memory object plane.
+
+Protocol: every member of a group calls collectives in the same order (the
+standard collective contract, same as the reference's NCCL groups). Each
+call takes a fresh sequence number; contributions are published under
+(group, seq, rank) — small ones directly in the head KV, large ones in the
+shm object store with the KV carrying the ObjectRef — and a done-counter
+deletes the round's keys after every member has read them.
+
+Parity: reference `util/collective/collective.py` API surface;
+`gloo_collective_group.py:184` role (CPU/host backend). The rendezvous-
+via-KV design mirrors how the reference exchanges NCCL unique ids through
+the GCS KV.
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+
+import numpy as np
+
+
+
+class ReduceOp:
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+
+
+_REDUCERS = {
+    ReduceOp.SUM: lambda vals: np.sum(vals, axis=0),
+    ReduceOp.PRODUCT: lambda vals: np.prod(vals, axis=0),
+    ReduceOp.MIN: lambda vals: np.min(vals, axis=0),
+    ReduceOp.MAX: lambda vals: np.max(vals, axis=0),
+}
+
+
+class _KV:
+    """Uniform KV client: direct dict on the head, request RPC on workers."""
+
+    def __init__(self):
+        from ray_tpu.core.runtime import Runtime, get_runtime
+        self._rt = get_runtime()
+        self._head = isinstance(self._rt, Runtime)
+
+    def put(self, key, value: bytes):
+        if self._head:
+            with self._rt.lock:
+                self._rt.kv[key] = value
+        else:
+            self._rt.request("kv_put", (key, value))
+
+    def get(self, key):
+        if self._head:
+            return self._rt.kv.get(key)
+        return self._rt.request("kv_get", key)
+
+    def delete(self, key):
+        if self._head:
+            self._rt.kv.pop(key, None)
+        else:
+            self._rt.request("kv_del", key)
+
+    def incr(self, key) -> int:
+        if self._head:
+            return self._rt.kv_incr(key)
+        return self._rt.request("kv_incr", key)
+
+    def wait(self, key, timeout: float = 300.0) -> bytes:
+        deadline = time.monotonic() + timeout
+        delay = 0.0005
+        while True:
+            v = self.get(key)
+            if v is not None:
+                return v
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"collective rendezvous timed out on {key}")
+            time.sleep(delay)
+            delay = min(delay * 2, 0.01)
+
+
+def _blob(value) -> bytes:
+    """Serialize a contribution. Values ride the KV directly: the transport
+    frames numpy buffers out-of-band, the head holds each round's bytes only
+    until the done-counter deletes them, and no object-store ref lifetime is
+    in play (an earlier shm-ref design freed contributions before peers read
+    them)."""
+    return pickle.dumps(np.asarray(value), protocol=5)
+
+
+def _unblob(blob: bytes):
+    return pickle.loads(blob)
+
+
+class _Group:
+    def __init__(self, name: str, world_size: int, rank: int, backend: str):
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        self.backend = backend
+        self.seq = 0
+        self.p2p_seq: dict[tuple[int, int], int] = {}
+        self.kv = _KV()
+
+    def next_seq(self) -> int:
+        self.seq += 1
+        return self.seq
+
+    # -- rounds ----------------------------------------------------------
+
+    def _key(self, *parts):
+        return ("coll", self.name) + parts
+
+    def exchange(self, value, participate: bool = True):
+        """All-to-all publish+read for one round; returns all contributions
+        in rank order. Cleanup by the last member to finish reading."""
+        seq = self.next_seq()
+        if participate:
+            self.kv.put(self._key(seq, "d", self.rank), _blob(value))
+        vals = [
+            _unblob(self.kv.wait(self._key(seq, "d", r)))
+            for r in range(self.world_size)
+        ]
+        if self.kv.incr(self._key(seq, "done")) == self.world_size:
+            for r in range(self.world_size):
+                self.kv.delete(self._key(seq, "d", r))
+            self.kv.delete(self._key(seq, "done"))
+        return vals
+
+    def one_to_all(self, value, src_rank: int):
+        seq = self.next_seq()
+        if self.rank == src_rank:
+            self.kv.put(self._key(seq, "b"), _blob(value))
+        out = _unblob(self.kv.wait(self._key(seq, "b")))
+        if self.kv.incr(self._key(seq, "done")) == self.world_size:
+            self.kv.delete(self._key(seq, "b"))
+            self.kv.delete(self._key(seq, "done"))
+        return out
+
+    def barrier(self, timeout: float = 300.0):
+        seq = self.next_seq()
+        key = self._key(seq, "bar")
+        self.kv.incr(key)
+        deadline = time.monotonic() + timeout
+        while int(self.kv.get(key) or b"0") < self.world_size:
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"barrier on group {self.name} timed out")
+            time.sleep(0.001)
+        if self.kv.incr(self._key(seq, "bar_done")) == self.world_size:
+            self.kv.delete(key)
+            self.kv.delete(self._key(seq, "bar_done"))
+
+
+_groups: dict[str, _Group] = {}
+
+
+def init_collective_group(world_size: int, rank: int,
+                          backend: str = "shm",
+                          group_name: str = "default") -> None:
+    """Join a named collective group (parity: collective.py:123). Call once
+    per member process with a distinct rank in [0, world_size)."""
+    if backend not in ("shm", "kv", "gloo"):
+        raise ValueError(f"unknown backend {backend!r}; host backend is 'shm'")
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} out of range for world {world_size}")
+    if group_name in _groups:
+        raise RuntimeError(f"group {group_name!r} already initialized here")
+    _groups[group_name] = _Group(group_name, world_size, rank, backend)
+
+
+def join_group(group_name: str, world_size: int,
+               backend: str = "shm", timeout: float = 300.0) -> int:
+    """Rank-free join: arrival order assigns ranks via an atomic KV counter,
+    then a barrier gang-releases the full group. The actor-mesh rendezvous
+    primitive (SURVEY §7 hard-part 3: SPMD-vs-actor impedance)."""
+    kv = _KV()
+    rank = kv.incr(("coll", group_name, "join")) - 1
+    if rank >= world_size:
+        raise RuntimeError(
+            f"group {group_name!r} already has {world_size} members")
+    init_collective_group(world_size, rank, backend, group_name)
+    g = _groups[group_name]
+    g.barrier(timeout)
+    # Last member out of the barrier retires the join counter so the group
+    # name is reusable by a later generation.
+    if kv.incr(("coll", group_name, "join_done")) == world_size:
+        kv.delete(("coll", group_name, "join"))
+        kv.delete(("coll", group_name, "join_done"))
+    return rank
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    return group_name in _groups
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    _groups.pop(group_name, None)
+
+
+def _group(group_name: str) -> _Group:
+    g = _groups.get(group_name)
+    if g is None:
+        raise RuntimeError(
+            f"collective group {group_name!r} is not initialized in this "
+            f"process; call init_collective_group() first")
+    return g
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _group(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _group(group_name).world_size
+
+
+def _writeback(tensor, result):
+    """In-place semantics for writable numpy tensors (parity: the reference
+    mutates torch tensors); jax/immutable inputs rely on the return value."""
+    if isinstance(tensor, np.ndarray) and tensor.flags.writeable:
+        tensor[...] = result
+    return result
+
+
+def allreduce(tensor, group_name: str = "default", op: str = ReduceOp.SUM):
+    g = _group(group_name)
+    vals = g.exchange(tensor)
+    return _writeback(tensor, _REDUCERS[op](np.stack(
+        [np.asarray(v) for v in vals])))
+
+
+def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
+           op: str = ReduceOp.SUM):
+    g = _group(group_name)
+    vals = g.exchange(tensor)
+    if g.rank != dst_rank:
+        return tensor
+    return _writeback(tensor, _REDUCERS[op](np.stack(
+        [np.asarray(v) for v in vals])))
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    g = _group(group_name)
+    out = g.one_to_all(tensor, src_rank)
+    return _writeback(tensor, out)
+
+
+def allgather(tensor_list, tensor, group_name: str = "default"):
+    """Gather every rank's `tensor` into `tensor_list` (reference
+    signature); also returns the list."""
+    g = _group(group_name)
+    vals = g.exchange(tensor)
+    if tensor_list is not None:
+        tensor_list[:] = vals
+    return vals
+
+
+def reducescatter(tensor, tensor_list, group_name: str = "default",
+                  op: str = ReduceOp.SUM):
+    """Reduce the concatenation of every rank's `tensor_list` and scatter:
+    rank i receives the reduction of everyone's tensor_list[i]."""
+    g = _group(group_name)
+    vals = g.exchange(tensor_list)
+    mine = _REDUCERS[op](np.stack([np.asarray(v[g.rank]) for v in vals]))
+    return _writeback(tensor, mine)
+
+
+def barrier(group_name: str = "default", timeout: float = 300.0):
+    _group(group_name).barrier(timeout)
+
+
+def send(tensor, dst_rank: int, group_name: str = "default"):
+    g = _group(group_name)
+    pair = (g.rank, dst_rank)
+    seq = g.p2p_seq[pair] = g.p2p_seq.get(pair, 0) + 1
+    g.kv.put(g._key("p2p", g.rank, dst_rank, seq), _blob(tensor))
+
+
+def recv(tensor, src_rank: int, group_name: str = "default"):
+    g = _group(group_name)
+    pair = (src_rank, g.rank)
+    seq = g.p2p_seq[pair] = g.p2p_seq.get(pair, 0) + 1
+    key = g._key("p2p", src_rank, g.rank, seq)
+    out = _unblob(g.kv.wait(key))
+    g.kv.delete(key)
+    return _writeback(tensor, out)

@@ -1,0 +1,152 @@
+"""Host-side collective group tests.
+
+Parity: reference `python/ray/util/collective/tests/` — groups of actors
+doing allreduce/allgather/broadcast/reducescatter/barrier/send-recv through
+the host backend.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util.collective import ReduceOp
+
+
+@ray_tpu.remote
+class Member:
+    def __init__(self, rank, world):
+        from ray_tpu.util import collective as col
+        col.init_collective_group(world, rank, group_name="g")
+        self.rank = rank
+        self.world = world
+
+    def allreduce(self, value):
+        from ray_tpu.util import collective as col
+        return col.allreduce(np.array(value, dtype=np.float32),
+                             group_name="g")
+
+    def allgather(self):
+        from ray_tpu.util import collective as col
+        out = []
+        col.allgather(out, np.array([self.rank], dtype=np.int32),
+                      group_name="g")
+        return [int(x[0]) for x in out]
+
+    def broadcast(self):
+        from ray_tpu.util import collective as col
+        val = (np.array([42.0], dtype=np.float32) if self.rank == 1
+               else np.zeros(1, dtype=np.float32))
+        return float(col.broadcast(val, src_rank=1, group_name="g")[0])
+
+    def reducescatter(self):
+        from ray_tpu.util import collective as col
+        shard = np.zeros(1, dtype=np.float32)
+        chunks = [np.array([float(i + self.rank)]) for i in range(self.world)]
+        return float(col.reducescatter(shard, chunks, group_name="g")[0])
+
+    def reduce_max(self, value):
+        from ray_tpu.util import collective as col
+        out = col.reduce(np.array([value], dtype=np.float32), dst_rank=0,
+                         group_name="g", op=ReduceOp.MAX)
+        return float(out[0])
+
+    def barrier_then(self, x):
+        from ray_tpu.util import collective as col
+        col.barrier(group_name="g")
+        return x
+
+    def p2p(self):
+        from ray_tpu.util import collective as col
+        if self.rank == 0:
+            col.send(np.array([7.0]), dst_rank=1, group_name="g")
+            return None
+        if self.rank == 1:
+            return float(col.recv(np.zeros(1), src_rank=0, group_name="g")[0])
+        return None
+
+    def big_allreduce(self):
+        # > inline limit: rides the shm object plane.
+        from ray_tpu.util import collective as col
+        arr = np.full((1 << 17,), self.rank + 1, dtype=np.float32)  # 512 KiB
+        out = col.allreduce(arr, group_name="g")
+        return float(out[0]), out.shape[0]
+
+
+WORLD = 3
+
+
+@pytest.fixture(scope="module")
+def members(ray_start_regular):
+    ms = [Member.remote(r, WORLD) for r in range(WORLD)]
+    yield ms
+    for m in ms:
+        ray_tpu.kill(m)
+
+
+def test_allreduce(members):
+    out = ray_tpu.get([m.allreduce.remote(i) for m, i in
+                       zip(members, [[1.0], [2.0], [3.0]])], timeout=60)
+    for o in out:
+        assert float(o[0]) == 6.0
+
+
+def test_allgather(members):
+    out = ray_tpu.get([m.allgather.remote() for m in members], timeout=60)
+    assert out == [[0, 1, 2]] * WORLD
+
+
+def test_broadcast(members):
+    out = ray_tpu.get([m.broadcast.remote() for m in members], timeout=60)
+    assert out == [42.0] * WORLD
+
+
+def test_reducescatter(members):
+    out = ray_tpu.get([m.reducescatter.remote() for m in members], timeout=60)
+    # rank i gets sum_r (i + r) = WORLD*i + 0+1+2
+    assert out == [3.0 * i + 3.0 for i in range(WORLD)]
+
+
+def test_reduce(members):
+    out = ray_tpu.get([m.reduce_max.remote(float(10 * (i + 1)))
+                       for i, m in enumerate(members)], timeout=60)
+    assert out[0] == 30.0
+
+
+def test_barrier(members):
+    assert ray_tpu.get([m.barrier_then.remote(i)
+                        for i, m in enumerate(members)], timeout=60) == [0, 1, 2]
+
+
+def test_send_recv(members):
+    out = ray_tpu.get([m.p2p.remote() for m in members], timeout=60)
+    assert out[1] == 7.0
+
+
+def test_big_payload_allreduce(members):
+    out = ray_tpu.get([m.big_allreduce.remote() for m in members], timeout=120)
+    for first, n in out:
+        assert first == 6.0  # 1+2+3
+        assert n == 1 << 17
+
+
+def test_join_group(ray_start_regular):
+    @ray_tpu.remote
+    class Joiner:
+        def join(self):
+            from ray_tpu.util import collective as col
+            rank = col.join_group("mesh0", 3)
+            return rank
+
+    actors = [Joiner.remote() for _ in range(3)]
+    ranks = sorted(ray_tpu.get([a.join.remote() for a in actors], timeout=60))
+    assert ranks == [0, 1, 2]
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+def test_errors(ray_start_regular):
+    from ray_tpu.util import collective as col
+    with pytest.raises(RuntimeError):
+        col.allreduce(np.zeros(1), group_name="nope")
+    with pytest.raises(ValueError):
+        col.init_collective_group(2, 5, group_name="bad")

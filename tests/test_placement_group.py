@@ -212,6 +212,33 @@ def test_zero_bundle_rejected(ray_start_regular):
         placement_group([{"CPU": 0}])
 
 
+def test_ready_after_remove_resolves(ray_start_regular):
+    # ready() first called after removal must error, not hang.
+    pg = placement_group([{"CPU": 1}])
+    assert pg.wait(10)
+    remove_placement_group(pg)
+    time.sleep(0.1)
+    with pytest.raises(ray_tpu.RayTpuError):
+        ray_tpu.get(pg.ready(), timeout=5)
+
+
+def test_actor_pool_timeout_retryable(ray_start_regular):
+    @ray_tpu.remote
+    class Slow:
+        def work(self, t):
+            time.sleep(t)
+            return "done"
+
+    from ray_tpu.core.status import GetTimeoutError
+    from ray_tpu.util import ActorPool
+    pool = ActorPool([Slow.remote()])
+    pool.submit(lambda a, v: a.work.remote(v), 1.0)
+    with pytest.raises(GetTimeoutError):
+        pool.get_next(timeout=0.05)
+    # Pool state intact: the same result is still retrievable.
+    assert pool.get_next(timeout=30) == "done"
+
+
 def test_actor_pool(ray_start_regular):
     @ray_tpu.remote
     class Doubler:
