@@ -18,8 +18,13 @@ import cloudpickle
 from ray_tpu.core.object_ref import ObjectRef
 
 
-class _CollectingPickler(pickle.Pickler):
-    """Pickles a value while recording every ObjectRef inside it."""
+class _CollectingPickler(cloudpickle.Pickler):
+    """Pickles a value while recording every ObjectRef inside it.
+
+    cloudpickle-based so closures/lambdas inside task args serialize (the
+    reference routes all task payloads through cloudpickle too) — the Data
+    library passes UDFs as plain arguments.
+    """
 
     def __init__(self, file, buffer_callback=None):
         super().__init__(file, protocol=5, buffer_callback=buffer_callback)
@@ -29,7 +34,7 @@ class _CollectingPickler(pickle.Pickler):
         if isinstance(obj, ObjectRef):
             self.contained_refs.append(obj)
             return obj.__reduce__()
-        return NotImplemented
+        return super().reducer_override(obj)
 
 
 def serialize_args(args, kwargs):

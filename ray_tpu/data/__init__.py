@@ -1,0 +1,35 @@
+"""ray_tpu.data: streaming distributed datasets over the object plane.
+
+Parity: reference `python/ray/data/` (Dataset `dataset.py:154`, streaming
+executor `_internal/execution/streaming_executor.py:48`, read_api, grouped
+data, DataContext). Blocks are pyarrow Tables; transforms run as tasks with
+windowed backpressure; consumption feeds numpy/torch/jax batches.
+"""
+
+from ray_tpu.data import aggregate  # noqa: F401
+from ray_tpu.data.context import DataContext  # noqa: F401
+from ray_tpu.data.dataset import (  # noqa: F401
+    DataIterator,
+    Dataset,
+    Schema,
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+)
+from ray_tpu.data.datasource import (  # noqa: F401
+    read_binary_files,
+    read_csv,
+    read_json,
+    read_numpy,
+    read_parquet,
+    read_text,
+)
+
+__all__ = [
+    "Dataset", "DataIterator", "DataContext", "Schema", "aggregate",
+    "range", "from_items", "from_pandas", "from_numpy", "from_arrow",
+    "read_parquet", "read_csv", "read_json", "read_text",
+    "read_binary_files", "read_numpy",
+]

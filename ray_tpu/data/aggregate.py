@@ -1,0 +1,47 @@
+"""AggregateFn helpers for GroupedData.aggregate.
+
+Parity: reference `data/aggregate.py` (AggregateFn, Sum/Min/Max/Mean/Std/
+Count classes).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow.compute as pc
+
+
+class AggregateFn:
+    def __init__(self, name: str, apply):
+        self.name = name
+        self.apply = apply  # (sub_table) -> scalar
+
+
+def Sum(on: str, alias_name: str | None = None) -> AggregateFn:
+    return AggregateFn(alias_name or f"sum({on})",
+                       lambda t: pc.sum(t.column(on)).as_py())
+
+
+def Min(on: str, alias_name: str | None = None) -> AggregateFn:
+    return AggregateFn(alias_name or f"min({on})",
+                       lambda t: pc.min(t.column(on)).as_py())
+
+
+def Max(on: str, alias_name: str | None = None) -> AggregateFn:
+    return AggregateFn(alias_name or f"max({on})",
+                       lambda t: pc.max(t.column(on)).as_py())
+
+
+def Mean(on: str, alias_name: str | None = None) -> AggregateFn:
+    return AggregateFn(alias_name or f"mean({on})",
+                       lambda t: pc.mean(t.column(on)).as_py())
+
+
+def Std(on: str, ddof: int = 1, alias_name: str | None = None) -> AggregateFn:
+    def apply(t):
+        vals = t.column(on).to_numpy(zero_copy_only=False)
+        return float(np.std(vals, ddof=ddof)) if len(vals) > ddof else None
+    return AggregateFn(alias_name or f"std({on})", apply)
+
+
+def Count(alias_name: str | None = None) -> AggregateFn:
+    return AggregateFn(alias_name or "count()", lambda t: t.num_rows)

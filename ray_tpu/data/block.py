@@ -1,0 +1,161 @@
+"""Blocks: the unit of distributed data, as Arrow tables in the object plane.
+
+Parity: reference `python/ray/data/block.py` (Block/BlockAccessor/
+BlockMetadata) and `_internal/arrow_block.py`. Blocks are pyarrow Tables —
+columnar, zero-copy to numpy, and therefore directly `jax.device_put`-able
+for TPU input pipelines.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterator
+
+import numpy as np
+import pyarrow as pa
+
+
+@dataclasses.dataclass
+class BlockMetadata:
+    num_rows: int
+    size_bytes: int
+    schema: Any = None          # pa.Schema
+    input_files: list = dataclasses.field(default_factory=list)
+
+
+def _to_table(data) -> pa.Table:
+    """Normalize rows/batch/pandas/arrow into a pyarrow Table."""
+    if isinstance(data, pa.Table):
+        return data
+    if isinstance(data, dict):          # column batch: {name: array}
+        cols = {}
+        for k, v in data.items():
+            arr = np.asarray(v)
+            if arr.ndim > 1:
+                # Tensor column: store as fixed-size-list of flattened rows.
+                flat = arr.reshape(arr.shape[0], -1)
+                inner = pa.array(flat.ravel())
+                fsl = pa.FixedSizeListArray.from_arrays(inner, flat.shape[1])
+                cols[k] = fsl
+                cols.setdefault("__shape__" + k, pa.array(
+                    [list(arr.shape[1:])] * arr.shape[0]))
+            else:
+                cols[k] = pa.array(arr)
+        return pa.table(cols)
+    if hasattr(data, "to_dict") and hasattr(data, "columns"):  # DataFrame
+        return pa.Table.from_pandas(data, preserve_index=False)
+    if isinstance(data, list):
+        if not data:
+            return pa.table({})
+        if isinstance(data[0], dict):
+            return pa.Table.from_pylist(data)
+        return pa.table({"item": pa.array(data)})
+    raise TypeError(f"cannot make a block from {type(data)}")
+
+
+def _tensor_columns(table: pa.Table) -> dict[str, tuple]:
+    """{col: shape} for tensor columns stored as fixed-size lists."""
+    out = {}
+    for name in table.column_names:
+        if name.startswith("__shape__"):
+            base = name[len("__shape__"):]
+            shape = table.column(name)[0].as_py() if table.num_rows else []
+            out[base] = tuple(shape)
+    return out
+
+
+class BlockAccessor:
+    """Uniform view over a block (parity: data/block.py BlockAccessor)."""
+
+    def __init__(self, table: pa.Table):
+        self._t = table
+
+    @staticmethod
+    def of(block) -> "BlockAccessor":
+        return BlockAccessor(_to_table(block))
+
+    @property
+    def table(self) -> pa.Table:
+        return self._t
+
+    def num_rows(self) -> int:
+        return self._t.num_rows
+
+    def size_bytes(self) -> int:
+        return self._t.nbytes
+
+    def schema(self):
+        return self._t.schema
+
+    def metadata(self, input_files=None) -> BlockMetadata:
+        return BlockMetadata(
+            num_rows=self.num_rows(), size_bytes=self.size_bytes(),
+            schema=self._t.schema, input_files=input_files or [])
+
+    # ---- conversions ----
+
+    def to_batch(self) -> dict[str, np.ndarray]:
+        """Columnar numpy batch (the "numpy"/default batch format)."""
+        tens = _tensor_columns(self._t)
+        out = {}
+        for name in self._t.column_names:
+            if name.startswith("__shape__"):
+                continue
+            col = self._t.column(name)
+            if name in tens:
+                flat = np.asarray(col.combine_chunks().flatten())
+                out[name] = flat.reshape((self._t.num_rows,) + tens[name])
+            else:
+                out[name] = col.to_numpy(zero_copy_only=False)
+        return out
+
+    def to_pandas(self):
+        drop = [c for c in self._t.column_names if c.startswith("__shape__")]
+        return self._t.drop_columns(drop).to_pandas()
+
+    def to_rows(self) -> list[dict]:
+        batch = self.to_batch()
+        names = list(batch)
+        return [
+            {n: _item(batch[n][i]) for n in names}
+            for i in range(self.num_rows())
+        ]
+
+    def iter_rows(self) -> Iterator[dict]:
+        yield from self.to_rows()
+
+    def slice(self, start: int, end: int) -> pa.Table:
+        return self._t.slice(start, end - start)
+
+    def take_indices(self, idx) -> pa.Table:
+        return self._t.take(pa.array(idx))
+
+    def sample(self, n: int, key: str):
+        k = min(n, self._t.num_rows)
+        if k == 0:
+            return []
+        idx = np.random.default_rng(0).choice(self._t.num_rows, k,
+                                               replace=False)
+        return [v for v in self._t.column(key).take(pa.array(idx)).to_pylist()]
+
+
+def _item(v):
+    if isinstance(v, np.generic):
+        return v.item()
+    return v
+
+
+def concat_blocks(tables: list[pa.Table]) -> pa.Table:
+    tables = [t for t in tables if t is not None and t.num_rows >= 0]
+    nonempty = [t for t in tables if t.num_columns]
+    if not nonempty:
+        return pa.table({})
+    return pa.concat_tables(nonempty, promote_options="default")
+
+
+def block_from_batch(batch) -> pa.Table:
+    return _to_table(batch)
+
+
+def block_from_rows(rows: list) -> pa.Table:
+    return _to_table(rows)

@@ -1,0 +1,26 @@
+"""DataContext: execution knobs (parity: reference `data/context.py`)."""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+
+@dataclasses.dataclass
+class DataContext:
+    target_max_block_size: int = 128 * 1024 * 1024
+    target_min_block_size: int = 1 * 1024 * 1024
+    read_parallelism: int = 8          # default override_num_blocks for reads
+    max_tasks_in_flight: int = 8       # per-operator streaming window
+    eager_free: bool = True
+    verbose_progress: bool = False
+
+    _local = threading.local()
+
+    @staticmethod
+    def get_current() -> "DataContext":
+        ctx = getattr(DataContext._local, "ctx", None)
+        if ctx is None:
+            ctx = DataContext()
+            DataContext._local.ctx = ctx
+        return ctx

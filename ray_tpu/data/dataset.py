@@ -1,0 +1,547 @@
+"""Dataset: lazy, distributed data transformed by tasks over the object plane.
+
+Parity: reference `python/ray/data/dataset.py:154` — lazy logical plan,
+transforms (map/map_batches/filter/flat_map/...), all-to-all ops
+(sort/shuffle/repartition/groupby), consumption (take/iter_batches/
+iter_torch_batches), split/streaming_split for Train, and write_* sinks.
+Blocks are pyarrow Tables (block.py); execution is the windowed streaming
+executor (execution.py).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, Iterator
+
+import numpy as np
+import pyarrow as pa
+
+import ray_tpu
+from ray_tpu.data import plan as plan_mod
+from ray_tpu.data.block import (
+    BlockAccessor,
+    block_from_batch,
+    block_from_rows,
+    concat_blocks,
+)
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.execution import execute
+
+
+def _batch_of(table: pa.Table, fmt: str):
+    acc = BlockAccessor.of(table)
+    if fmt in ("numpy", "default", None):
+        return acc.to_batch()
+    if fmt == "pandas":
+        return acc.to_pandas()
+    if fmt == "pyarrow":
+        return table
+    raise ValueError(f"unknown batch_format {fmt!r}")
+
+
+def _table_of(batch) -> pa.Table:
+    return block_from_batch(batch)
+
+
+class Dataset:
+    def __init__(self, logical_plan: plan_mod.LogicalPlan):
+        self._plan = logical_plan
+
+    # ------------- transforms (lazy) -------------
+
+    def _with(self, op) -> "Dataset":
+        return Dataset(self._plan.with_op(op))
+
+    def map(self, fn: Callable[[dict], dict], **_kw) -> "Dataset":
+        def _map_rows(table):
+            rows = BlockAccessor.of(table).to_rows()
+            return block_from_rows([fn(r) for r in rows])
+        return self._with(plan_mod.MapBlocks(name="Map", fn=_map_rows))
+
+    def map_batches(self, fn, *, batch_size: int | None = None,
+                    batch_format: str = "numpy", compute=None,
+                    concurrency=None, fn_constructor_args=(),
+                    **_kw) -> "Dataset":
+        is_class = isinstance(fn, type)
+        if is_class:
+            ctor_args = tuple(fn_constructor_args)
+
+            def ctor(fn=fn, ctor_args=ctor_args):
+                return fn(*ctor_args)
+
+            def chain(instance, block, batch_size=batch_size,
+                      batch_format=batch_format):
+                return _apply_batches(instance, block, batch_size,
+                                      batch_format)
+            size = concurrency if isinstance(concurrency, int) else 2
+            return self._with(plan_mod.MapBlocks(
+                name="MapBatches", fn=chain, compute=size,
+                fn_constructor=ctor))
+
+        def _mb(table, fn=fn):
+            return _apply_batches(fn, table, batch_size, batch_format)
+        return self._with(plan_mod.MapBlocks(name="MapBatches", fn=_mb))
+
+    def flat_map(self, fn: Callable[[dict], list]) -> "Dataset":
+        def _fm(table):
+            out = []
+            for r in BlockAccessor.of(table).to_rows():
+                out.extend(fn(r))
+            return block_from_rows(out)
+        return self._with(plan_mod.MapBlocks(name="FlatMap", fn=_fm))
+
+    def filter(self, fn: Callable[[dict], bool]) -> "Dataset":
+        def _flt(table):
+            rows = BlockAccessor.of(table).to_rows()
+            return block_from_rows([r for r in rows if fn(r)])
+        return self._with(plan_mod.MapBlocks(name="Filter", fn=_flt))
+
+    def add_column(self, name: str, fn) -> "Dataset":
+        def _ac(table):
+            batch = BlockAccessor.of(table).to_batch()
+            batch[name] = np.asarray(fn(batch))
+            return _table_of(batch)
+        return self._with(plan_mod.MapBlocks(name="AddColumn", fn=_ac))
+
+    def drop_columns(self, cols: list[str]) -> "Dataset":
+        def _dc(table):
+            drop = [c for c in table.column_names
+                    if c in cols or any(c == "__shape__" + x for x in cols)]
+            return table.drop_columns(drop)
+        return self._with(plan_mod.MapBlocks(name="DropColumns", fn=_dc))
+
+    def select_columns(self, cols: list[str]) -> "Dataset":
+        def _sc(table):
+            keep = [c for c in table.column_names
+                    if c in cols or any(c == "__shape__" + x for x in cols)]
+            return table.select(keep)
+        return self._with(plan_mod.MapBlocks(name="SelectColumns", fn=_sc))
+
+    def rename_columns(self, mapping: dict[str, str]) -> "Dataset":
+        def _rc(table):
+            names = [mapping.get(c, c) for c in table.column_names]
+            return table.rename_columns(names)
+        return self._with(plan_mod.MapBlocks(name="RenameColumns", fn=_rc))
+
+    # ------------- all-to-all -------------
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._with(plan_mod.AllToAll(
+            name="Repartition", kind="repartition",
+            args={"num_blocks": num_blocks}))
+
+    def random_shuffle(self, *, seed: int | None = None,
+                       num_blocks: int | None = None) -> "Dataset":
+        if seed is None:
+            # Fresh entropy per plan so every epoch's shuffle differs.
+            seed = int(np.random.SeedSequence().entropy % (2 ** 31))
+        return self._with(plan_mod.AllToAll(
+            name="RandomShuffle", kind="shuffle",
+            args={"seed": seed, "num_blocks": num_blocks}))
+
+    def randomize_block_order(self, *, seed: int | None = None) -> "Dataset":
+        # Cheap shuffle: permute block order only (parity: dataset.py
+        # randomize_block_order). Applied at execution time.
+        refs = list(self.iter_internal())
+        rng = np.random.default_rng(seed)
+        order = rng.permutation(len(refs))
+        return Dataset(plan_mod.LogicalPlan(
+            [plan_mod.InputData(name="RandomizeBlocks",
+                                refs=[refs[i] for i in order])]))
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        return self._with(plan_mod.AllToAll(
+            name="Sort", kind="sort",
+            args={"key": key, "descending": descending}))
+
+    def groupby(self, key: str):
+        from ray_tpu.data.grouped import GroupedData
+        return GroupedData(self, key)
+
+    def limit(self, n: int) -> "Dataset":
+        return self._with(plan_mod.Limit(name="Limit", n=n))
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        return self._with(plan_mod.Union(
+            name="Union", others=[o._plan for o in others]))
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        return self._with(plan_mod.Zip(name="Zip", other=other._plan))
+
+    # ------------- execution / consumption -------------
+
+    def iter_internal(self) -> Iterator[tuple]:
+        return execute(self._plan)
+
+    def materialize(self) -> "Dataset":
+        refs = list(self.iter_internal())
+        return Dataset(plan_mod.LogicalPlan(
+            [plan_mod.InputData(name="Materialized", refs=refs)]))
+
+    def count(self) -> int:
+        return sum(meta.num_rows for _ref, meta in self.iter_internal())
+
+    def num_blocks(self) -> int:
+        return sum(1 for _ in self.iter_internal())
+
+    def size_bytes(self) -> int:
+        return sum(meta.size_bytes for _ref, meta in self.iter_internal())
+
+    def schema(self):
+        for _ref, meta in self.iter_internal():
+            if meta.schema is not None and len(meta.schema) > 0:
+                return Schema(meta.schema)
+        return None
+
+    def columns(self) -> list[str]:
+        s = self.schema()
+        return s.names if s else []
+
+    def take(self, n: int = 20) -> list[dict]:
+        out = []
+        for bref, _meta in self.limit(n).iter_internal():
+            out.extend(BlockAccessor.of(
+                ray_tpu.get(bref, timeout=600)).to_rows())
+            if len(out) >= n:
+                break
+        return out[:n]
+
+    def take_all(self) -> list[dict]:
+        out = []
+        for bref, _meta in self.iter_internal():
+            out.extend(BlockAccessor.of(
+                ray_tpu.get(bref, timeout=600)).to_rows())
+        return out
+
+    def take_batch(self, batch_size: int = 20, *,
+                   batch_format: str = "numpy"):
+        table = concat_blocks([
+            BlockAccessor.of(ray_tpu.get(b, timeout=600)).table
+            for b, _m in self.limit(batch_size).iter_internal()])
+        return _batch_of(table, batch_format)
+
+    def show(self, limit: int = 20) -> None:
+        for row in self.take(limit):
+            print(row)
+
+    def iter_rows(self) -> Iterator[dict]:
+        for bref, _meta in self.iter_internal():
+            yield from BlockAccessor.of(
+                ray_tpu.get(bref, timeout=600)).iter_rows()
+
+    def iter_batches(self, *, batch_size: int | None = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False,
+                     local_shuffle_buffer_size: int | None = None,
+                     local_shuffle_seed: int | None = None) -> Iterator:
+        """Re-batches across block boundaries to exact batch_size. With
+        local_shuffle_buffer_size, each batch is drawn uniformly from a
+        buffer kept at >= that many rows (parity: iterator shuffle buffer) —
+        rows move across batch boundaries, unlike a per-batch permute."""
+        carry: pa.Table | None = None
+        rng = (np.random.default_rng(local_shuffle_seed)
+               if local_shuffle_buffer_size else None)
+
+        def draw(table: pa.Table, k: int):
+            idx = rng.choice(table.num_rows, k, replace=False)
+            rest = np.setdiff1d(np.arange(table.num_rows), idx,
+                                assume_unique=True)
+            return table.take(pa.array(idx)), table.take(pa.array(rest))
+
+        min_buffer = local_shuffle_buffer_size or 0
+        for bref, _meta in self.iter_internal():
+            t = BlockAccessor.of(ray_tpu.get(bref, timeout=600)).table
+            carry = t if carry is None else concat_blocks([carry, t])
+            if batch_size is None:
+                yield _batch_of(carry, batch_format)
+                carry = None
+                continue
+            while carry.num_rows >= batch_size + min_buffer:
+                if rng is not None:
+                    head, carry = draw(carry, batch_size)
+                else:
+                    head = carry.slice(0, batch_size)
+                    carry = carry.slice(batch_size)
+                yield _batch_of(head, batch_format)
+        if carry is not None and batch_size is not None:
+            # Stream exhausted: drain the shuffle buffer.
+            while carry.num_rows >= batch_size:
+                if rng is not None:
+                    head, carry = draw(carry, batch_size)
+                else:
+                    head = carry.slice(0, batch_size)
+                    carry = carry.slice(batch_size)
+                yield _batch_of(head, batch_format)
+            if carry.num_rows and not drop_last:
+                if rng is not None:
+                    carry = carry.take(
+                        pa.array(rng.permutation(carry.num_rows)))
+                yield _batch_of(carry, batch_format)
+
+    def iter_torch_batches(self, *, batch_size: int | None = 256,
+                           drop_last: bool = False,
+                           device=None, dtypes=None) -> Iterator:
+        import torch
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy",
+                                       drop_last=drop_last):
+            out = {}
+            for k, v in batch.items():
+                tv = torch.as_tensor(np.ascontiguousarray(v))
+                if dtypes is not None:
+                    tv = tv.to(dtypes.get(k) if isinstance(dtypes, dict)
+                               else dtypes)
+                if device is not None:
+                    tv = tv.to(device)
+                out[k] = tv
+            yield out
+
+    def to_pandas(self, limit: int | None = None):
+        ds = self.limit(limit) if limit else self
+        tables = [BlockAccessor.of(ray_tpu.get(b, timeout=600)).table
+                  for b, _m in ds.iter_internal()]
+        return BlockAccessor.of(concat_blocks(tables)).to_pandas()
+
+    def to_arrow_refs(self) -> list:
+        return [b for b, _m in self.iter_internal()]
+
+    # ------------- splits -------------
+
+    def split(self, n: int, *, equal: bool = False) -> list["Dataset"]:
+        refs = list(self.iter_internal())
+        if equal:
+            # Exact equal-row shards: lockstep SPMD consumers
+            # (streaming_split in Train) need identical iteration counts,
+            # so boundaries slice through blocks where needed.
+            total = sum(m.num_rows for _b, m in refs)
+            cuts = [total * i // n for i in _brange(1, n)]
+            from ray_tpu.data.execution import split_refs_at
+            shards = split_refs_at(refs, cuts)
+        else:
+            shards = [[] for _ in _brange(n)]
+            for i, pair in enumerate(refs):
+                shards[i % n].append(pair)
+        return [Dataset(plan_mod.LogicalPlan(
+            [plan_mod.InputData(name=f"Split{i}", refs=s)]))
+            for i, s in enumerate(shards)]
+
+    def split_at_indices(self, indices: list[int]) -> list["Dataset"]:
+        rows = self.take_all()
+        cuts = [0] + list(indices) + [len(rows)]
+        out = []
+        for i in _brange(len(cuts) - 1):
+            out.append(from_items(rows[cuts[i]:cuts[i + 1]]))
+        return out
+
+    def train_test_split(self, test_size: float, *,
+                         shuffle: bool = False,
+                         seed: int | None = None) -> tuple:
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        total = ds.count()
+        n_test = (int(test_size) if test_size >= 1
+                  else int(total * test_size))
+        a, b = ds.split_at_indices([total - n_test])
+        return a, b
+
+    def streaming_split(self, n: int, *, equal: bool = False,
+                        locality_hints=None) -> list["DataIterator"]:
+        """Parity: dataset.py streaming_split — the Train ingest path."""
+        return [DataIterator(s) for s in self.split(n, equal=equal)]
+
+    def iterator(self) -> "DataIterator":
+        return DataIterator(self)
+
+    # ------------- aggregates -------------
+
+    def sum(self, on: str):
+        return self._simple_agg("sum", on)
+
+    def min(self, on: str):
+        return self._simple_agg("min", on)
+
+    def max(self, on: str):
+        return self._simple_agg("max", on)
+
+    def mean(self, on: str):
+        s = self._stats(on)
+        return s["sum"] / s["n"] if s["n"] else None
+
+    def std(self, on: str, ddof: int = 1):
+        s = self._stats(on)
+        n = s["n"]
+        if n <= ddof:
+            return None
+        var = (s["sumsq"] - s["sum"] ** 2 / n) / (n - ddof)
+        return float(np.sqrt(max(var, 0.0)))
+
+    def _simple_agg(self, op: str, on: str):
+        import pyarrow.compute as pc
+        vals = []
+        for bref, _m in self.iter_internal():
+            t = BlockAccessor.of(ray_tpu.get(bref, timeout=600)).table
+            if t.num_rows:
+                vals.append(getattr(pc, op)(t.column(on)).as_py())
+        if not vals:
+            return None
+        if op == "sum":
+            return sum(vals)
+        return min(vals) if op == "min" else max(vals)
+
+    def _stats(self, on: str):
+        import pyarrow.compute as pc
+        n = 0
+        total = 0.0
+        sumsq = 0.0
+        for bref, _m in self.iter_internal():
+            t = BlockAccessor.of(ray_tpu.get(bref, timeout=600)).table
+            if t.num_rows:
+                col = t.column(on)
+                n += len(col)
+                total += pc.sum(col).as_py()
+                sumsq += pc.sum(pc.multiply(col, col)).as_py()
+        return {"n": n, "sum": total, "sumsq": sumsq}
+
+    # ------------- writes -------------
+
+    def write_parquet(self, path: str) -> None:
+        self._write(path, "parquet")
+
+    def write_csv(self, path: str) -> None:
+        self._write(path, "csv")
+
+    def write_json(self, path: str) -> None:
+        self._write(path, "json")
+
+    def _write(self, path: str, fmt: str) -> None:
+        import os
+        os.makedirs(path, exist_ok=True)
+        from ray_tpu.data.datasource import write_block_task
+        refs = []
+        for i, (bref, _m) in enumerate(self.iter_internal()):
+            refs.append(write_block_task.remote(bref, path, i, fmt))
+        ray_tpu.get(refs, timeout=600)
+
+    # ------------- misc -------------
+
+    def stats(self) -> str:
+        return f"Dataset(plan: {self._plan.describe()})"
+
+    def __repr__(self):
+        return f"Dataset({self._plan.describe()})"
+
+
+class Schema:
+    def __init__(self, arrow_schema: pa.Schema):
+        self.base_schema = arrow_schema
+        self.names = [n for n in arrow_schema.names
+                      if not n.startswith("__shape__")]
+        self.types = [arrow_schema.field(n).type for n in self.names]
+
+    def __repr__(self):
+        cols = ", ".join(f"{n}: {t}" for n, t in zip(self.names, self.types))
+        return f"Schema({cols})"
+
+
+class DataIterator:
+    """Parity: reference `data/iterator.py` DataIterator — the object Train
+    workers consume via get_dataset_shard."""
+
+    def __init__(self, ds: Dataset):
+        self._ds = ds
+
+    def iter_batches(self, **kw):
+        return self._ds.iter_batches(**kw)
+
+    def iter_torch_batches(self, **kw):
+        return self._ds.iter_torch_batches(**kw)
+
+    def iter_rows(self):
+        return self._ds.iter_rows()
+
+    def materialize(self) -> Dataset:
+        return self._ds.materialize()
+
+    def count(self) -> int:
+        return self._ds.count()
+
+
+def _apply_batches(fn, table, batch_size, batch_format):
+    t = BlockAccessor.of(table).table
+    outs = []
+    n = t.num_rows
+    step = batch_size or max(n, 1)
+    for start in _brange(0, max(n, 1), step):
+        batch = _batch_of(t.slice(start, step), batch_format)
+        out = fn(batch)
+        outs.append(_table_of(out))
+    return concat_blocks(outs)
+
+
+# ------------- sources (parity: data/read_api.py) -------------
+
+
+_brange = __import__("builtins").range  # `range` below shadows the builtin
+
+
+def range(n: int, *, override_num_blocks: int | None = None,
+          parallelism: int | None = None) -> Dataset:
+    k = override_num_blocks or parallelism or \
+        min(DataContext.get_current().read_parallelism, max(n, 1))
+    cuts = [n * i // k for i in _brange(k + 1)]
+    fns = []
+    for i in _brange(k):
+        lo, hi = cuts[i], cuts[i + 1]
+
+        def read(lo=lo, hi=hi):
+            return pa.table({"id": pa.array(np.arange(lo, hi))})
+        fns.append(read)
+    return Dataset(plan_mod.LogicalPlan(
+        [plan_mod.Read(name="ReadRange", read_fns=fns)]))
+
+
+def from_items(items: list, *, override_num_blocks: int | None = None
+               ) -> Dataset:
+    k = override_num_blocks or min(
+        DataContext.get_current().read_parallelism, max(len(items), 1))
+    rows = [it if isinstance(it, dict) else {"item": it} for it in items]
+    refs = []
+    for i in _brange(k):
+        chunk = rows[len(rows) * i // k: len(rows) * (i + 1) // k]
+        table = block_from_rows(chunk)
+        ref = ray_tpu.put(table)
+        refs.append((ref, BlockAccessor.of(table).metadata()))
+    return Dataset(plan_mod.LogicalPlan(
+        [plan_mod.InputData(name="FromItems", refs=refs)]))
+
+
+def from_pandas(dfs) -> Dataset:
+    if not isinstance(dfs, list):
+        dfs = [dfs]
+    refs = []
+    for df in dfs:
+        table = pa.Table.from_pandas(df, preserve_index=False)
+        refs.append((ray_tpu.put(table),
+                     BlockAccessor.of(table).metadata()))
+    return Dataset(plan_mod.LogicalPlan(
+        [plan_mod.InputData(name="FromPandas", refs=refs)]))
+
+
+def from_numpy(arrays) -> Dataset:
+    if not isinstance(arrays, list):
+        arrays = [arrays]
+    refs = []
+    for arr in arrays:
+        table = block_from_batch({"data": np.asarray(arr)})
+        refs.append((ray_tpu.put(table),
+                     BlockAccessor.of(table).metadata()))
+    return Dataset(plan_mod.LogicalPlan(
+        [plan_mod.InputData(name="FromNumpy", refs=refs)]))
+
+
+def from_arrow(tables) -> Dataset:
+    if not isinstance(tables, list):
+        tables = [tables]
+    refs = [(ray_tpu.put(t), BlockAccessor.of(t).metadata())
+            for t in tables]
+    return Dataset(plan_mod.LogicalPlan(
+        [plan_mod.InputData(name="FromArrow", refs=refs)]))

@@ -1,0 +1,111 @@
+"""File datasources and sinks.
+
+Parity: reference `data/_internal/datasource/` (parquet/csv/json/text/
+binary readers, one read task per file shard) and the write path
+(`dataset.py write_parquet/...` — one file per block).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable
+
+import pyarrow as pa
+
+import ray_tpu
+from ray_tpu.data import plan as plan_mod
+from ray_tpu.data.dataset import Dataset
+
+
+def _expand_paths(paths) -> list[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for name in sorted(os.listdir(p)):
+                f = os.path.join(p, name)
+                if os.path.isfile(f) and not name.startswith((".", "_")):
+                    out.append(f)
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no input files under {paths}")
+    return out
+
+
+def _make_read(paths, one_file: Callable[[str], pa.Table],
+               name: str) -> Dataset:
+    files = _expand_paths(paths)
+
+    def mk(f):
+        def read(f=f):
+            return one_file(f)
+        return read
+
+    return Dataset(plan_mod.LogicalPlan(
+        [plan_mod.Read(name=name, read_fns=[mk(f) for f in files])]))
+
+
+def read_parquet(paths, **_kw) -> Dataset:
+    import pyarrow.parquet as pq
+    return _make_read(paths, lambda f: pq.read_table(f), "ReadParquet")
+
+
+def read_csv(paths, **_kw) -> Dataset:
+    from pyarrow import csv as pacsv
+    return _make_read(paths, lambda f: pacsv.read_csv(f), "ReadCSV")
+
+
+def read_json(paths, **_kw) -> Dataset:
+    from pyarrow import json as pajson
+    return _make_read(paths, lambda f: pajson.read_json(f), "ReadJSON")
+
+
+def read_text(paths, **_kw) -> Dataset:
+    def one(f):
+        with open(f, "r") as fh:
+            lines = [ln.rstrip("\n") for ln in fh]
+        return pa.table({"text": pa.array(lines)})
+    return _make_read(paths, one, "ReadText")
+
+
+def read_binary_files(paths, *, include_paths: bool = False,
+                      **_kw) -> Dataset:
+    def one(f):
+        with open(f, "rb") as fh:
+            data = fh.read()
+        cols = {"bytes": pa.array([data], type=pa.binary())}
+        if include_paths:
+            cols["path"] = pa.array([f])
+        return pa.table(cols)
+    return _make_read(paths, one, "ReadBinary")
+
+
+def read_numpy(paths, **_kw) -> Dataset:
+    import numpy as np
+
+    def one(f):
+        arr = np.load(f)
+        from ray_tpu.data.block import block_from_batch
+        return block_from_batch({"data": arr})
+    return _make_read(paths, one, "ReadNumpy")
+
+
+@ray_tpu.remote
+def write_block_task(block, path: str, index: int, fmt: str) -> str:
+    from ray_tpu.data.block import BlockAccessor
+    t = BlockAccessor.of(block).table
+    out = os.path.join(path, f"part-{index:05d}.{fmt}")
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+        pq.write_table(t, out)
+    elif fmt == "csv":
+        from pyarrow import csv as pacsv
+        pacsv.write_csv(t, out)
+    elif fmt == "json":
+        BlockAccessor.of(t).to_pandas().to_json(
+            out, orient="records", lines=True)
+    else:
+        raise ValueError(f"unknown write format {fmt}")
+    return out

@@ -1,0 +1,385 @@
+"""Streaming executor: runs a logical plan as windowed task pipelines.
+
+Parity: reference `data/_internal/execution/streaming_executor.py:48` —
+blocks stream through operator stages with bounded in-flight work per stage
+(backpressure), map stages run as tasks (TaskPoolMapOperator) or actor pools
+(ActorPoolMapOperator, for class UDFs), and all-to-all ops (repartition /
+random_shuffle / sort / groupby) run the split+reduce exchange of
+`data/_internal/planner/exchange/`.
+
+Design deviation (TPU-first single-driver): instead of the reference's
+dedicated scheduling thread + operator-selection loop
+(`streaming_executor_state.py:542`), stages are generator pipelines pulled
+by the consumer; each stage keeps at most `max_tasks_in_flight` tasks
+outstanding, which bounds memory the same way while removing a thread.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Iterator
+
+import numpy as np
+import pyarrow as pa
+
+import ray_tpu
+from ray_tpu.data import plan as plan_mod
+from ray_tpu.data.block import BlockAccessor, BlockMetadata, concat_blocks
+from ray_tpu.data.context import DataContext
+
+# ---------------- remote task bodies ----------------
+
+
+@ray_tpu.remote(num_returns=2)
+def _read_task(read_fn):
+    table = read_fn()
+    return table, BlockAccessor.of(table).metadata()
+
+
+@ray_tpu.remote(num_returns=2)
+def _map_task(fn, block):
+    out = fn(block)
+    return out, BlockAccessor.of(out).metadata()
+
+
+@ray_tpu.remote(num_returns=2)
+def _slice_task(block, start, end):
+    out = BlockAccessor.of(block).slice(start, end)
+    return out, BlockAccessor.of(out).metadata()
+
+
+@ray_tpu.remote
+def _sample_task(block, key, n):
+    return BlockAccessor.of(block).sample(n, key)
+
+
+@ray_tpu.remote
+def _split_task(fn, block, n, kind, key, boundaries, seed, descending,
+                block_index=0, block_start=0):
+    """Split one block into n partition pieces (the 'map' half of the
+    exchange). kind: repartition | shuffle | sort-range."""
+    if fn is not None:
+        block = fn(block)
+    t = BlockAccessor.of(block).table
+    if kind == "repartition":
+        # Order-preserving: output j owns global rows
+        # [boundaries[j], boundaries[j+1]); this block covers
+        # [block_start, block_start + rows).
+        rows = t.num_rows
+        pieces = []
+        for j in range(n):
+            lo = max(boundaries[j] - block_start, 0)
+            hi = max(min(boundaries[j + 1] - block_start, rows), lo)
+            pieces.append(t.slice(lo, hi - lo))
+    elif kind == "shuffle":
+        # Distinct stream per block (seed, block_index) — one shared stream
+        # would give every equally-sized block identical assignments.
+        rng = np.random.default_rng((seed, 0, block_index))
+        assign = rng.integers(0, n, t.num_rows)
+        pieces = [t.take(pa.array(np.nonzero(assign == i)[0]))
+                  for i in range(n)]
+    else:  # sort-range partition by key against boundaries
+        col = t.column(key).to_numpy(zero_copy_only=False)
+        part = np.searchsorted(np.asarray(boundaries), col,
+                               side="right")
+        if descending:
+            part = (n - 1) - part
+        pieces = [t.take(pa.array(np.nonzero(part == i)[0]))
+                  for i in range(n)]
+    return tuple(pieces) if n > 1 else pieces[0]
+
+
+@ray_tpu.remote(num_returns=2)
+def _reduce_task(kind, key, descending, aggregate, seed, part_index,
+                 *pieces):
+    t = concat_blocks([BlockAccessor.of(p).table for p in pieces])
+    if kind == "shuffle" and t.num_rows:
+        # Rows landed in input order; permute within the output partition.
+        rng = np.random.default_rng((seed, 1, part_index))
+        t = t.take(pa.array(rng.permutation(t.num_rows)))
+    if kind in ("sort", "groupby") and t.num_rows and key is not None:
+        t = t.sort_by([(key, "descending" if descending else "ascending")])
+    if kind == "groupby" and aggregate is not None:
+        t = aggregate(t)
+    return t, BlockAccessor.of(t).metadata()
+
+
+@ray_tpu.remote(num_returns=2)
+def _zip_pair_task(left_block, slices, *right_blocks):
+    """Zip one left block against the right-side row range it lines up
+    with; `slices` = [(right_block_pos, start, end), ...]."""
+    left = BlockAccessor.of(left_block).table
+    right = concat_blocks([
+        BlockAccessor.of(right_blocks[pos]).table.slice(s, e - s)
+        for pos, s, e in slices])
+    if left.num_rows != right.num_rows:
+        raise ValueError(
+            f"zip alignment bug: {left.num_rows} vs {right.num_rows}")
+    for name in right.column_names:
+        out_name = name if name not in left.column_names else name + "_1"
+        left = left.append_column(out_name, right.column(name))
+    return left, BlockAccessor.of(left).metadata()
+
+
+# ---------------- actor-pool map (class UDFs) ----------------
+
+
+@ray_tpu.remote
+class _MapWorker:
+    """Parity: ActorPoolMapOperator worker — constructs the class UDF once,
+    applies it per block."""
+
+    def __init__(self, ctor):
+        self._fn = ctor()
+
+    def apply(self, chain_fn, block):
+        out = chain_fn(self._fn, block)
+        return out, BlockAccessor.of(out).metadata()
+
+
+# ---------------- the executor ----------------
+
+
+def execute(logical_plan: plan_mod.LogicalPlan,
+            ctx: DataContext | None = None) -> Iterator[tuple]:
+    """Yields (block_ref, BlockMetadata) in order."""
+    ctx = ctx or DataContext.get_current()
+    plan = logical_plan.optimized()
+    stream: Iterator[tuple] | None = None
+    for op in plan.ops:
+        stream = _apply_op(op, stream, ctx)
+    return stream if stream is not None else iter(())
+
+
+def _apply_op(op, upstream, ctx: DataContext):
+    if isinstance(op, plan_mod.Read):
+        return _read_stage(op, ctx)
+    if isinstance(op, plan_mod.InputData):
+        return iter(op.refs)
+    if isinstance(op, plan_mod.MapBlocks):
+        if op.fn_constructor is not None:
+            return _actor_map_stage(op, upstream, ctx)
+        return _task_map_stage(op, upstream, ctx)
+    if isinstance(op, plan_mod.AllToAll):
+        return _all_to_all_stage(op, upstream, ctx)
+    if isinstance(op, plan_mod.Limit):
+        return _limit_stage(op, upstream)
+    if isinstance(op, plan_mod.Union):
+        return _union_stage(op, upstream, ctx)
+    if isinstance(op, plan_mod.Zip):
+        return _zip_stage(op, upstream, ctx)
+    raise TypeError(f"unknown logical op {op}")
+
+
+def _finish(pair):
+    bref, mref = pair
+    return bref, ray_tpu.get(mref, timeout=600)
+
+
+def _windowed(submits, window: int):
+    """Submit lazily, keep <= window tasks in flight, yield in order."""
+    pending = collections.deque()
+    for submit in submits:
+        while len(pending) >= window:
+            yield _finish(pending.popleft())
+        pending.append(submit())
+    while pending:
+        yield _finish(pending.popleft())
+
+
+def _read_stage(op: plan_mod.Read, ctx):
+    return _windowed(
+        ((lambda fn=fn: _read_task.remote(fn)) for fn in op.read_fns),
+        ctx.max_tasks_in_flight)
+
+
+def _task_map_stage(op: plan_mod.MapBlocks, upstream, ctx):
+    return _windowed(
+        ((lambda bref=bref: _map_task.remote(op.fn, bref))
+         for bref, _meta in upstream),
+        ctx.max_tasks_in_flight)
+
+
+def _actor_map_stage(op: plan_mod.MapBlocks, upstream, ctx):
+    size = op.compute if isinstance(op.compute, int) else 2
+
+    def gen():
+        workers = [_MapWorker.remote(op.fn_constructor) for _ in range(size)]
+        try:
+            pending = collections.deque()
+            rr = 0
+            for bref, _meta in upstream:
+                while len(pending) >= max(size, 1):
+                    yield _finish_actor(pending.popleft())
+                w = workers[rr % size]
+                rr += 1
+                pending.append(w.apply.options(num_returns=2)
+                               .remote(op.fn, bref))
+            while pending:
+                yield _finish_actor(pending.popleft())
+        finally:
+            for w in workers:
+                ray_tpu.kill(w)
+
+    def _finish_actor(refs):
+        bref, mref = refs
+        return bref, ray_tpu.get(mref, timeout=600)
+
+    return gen()
+
+
+def _all_to_all_stage(op: plan_mod.AllToAll, upstream, ctx):
+    kind = op.kind
+    args = op.args
+    inputs = list(upstream)  # materialization barrier (exchange needs all)
+    if not inputs:
+        return iter(())
+    n_out = args.get("num_blocks") or len(inputs)
+    key = args.get("key")
+    descending = bool(args.get("descending"))
+    aggregate = args.get("aggregate")
+    pre_fn = args.get("pre_fn")
+    boundaries = None
+    block_starts = [0] * len(inputs)
+    split_kind = {"repartition": "repartition", "shuffle": "shuffle",
+                  "sort": "sort", "groupby": "sort"}[kind]
+    if split_kind == "repartition":
+        total = sum(m.num_rows for _b, m in inputs)
+        boundaries = [total * j // n_out for j in range(n_out + 1)]
+        off = 0
+        for i, (_b, m) in enumerate(inputs):
+            block_starts[i] = off
+            off += m.num_rows
+    if split_kind == "sort":
+        samples = ray_tpu.get(
+            [_sample_task.remote(bref, key, 16) for bref, _ in inputs],
+            timeout=600)
+        flat = sorted(s for block in samples for s in block)
+        if not flat:
+            boundaries = []
+            n_out = 1
+        else:
+            idx = [len(flat) * i // n_out for i in range(1, n_out)]
+            boundaries = [flat[i] for i in idx]
+
+    def submit_split(bref, idx):
+        return _split_task.options(num_returns=n_out).remote(
+            pre_fn, bref, n_out, split_kind, key, boundaries,
+            args.get("seed"), descending, idx, block_starts[idx])
+
+    piece_refs = []  # [n_inputs][n_out]
+    for idx, (bref, _meta) in enumerate(inputs):
+        out = submit_split(bref, idx)
+        piece_refs.append([out] if n_out == 1 else list(out))
+
+    reduce_kind = "sort" if kind == "sort" else kind
+
+    def submits():
+        for j in range(n_out):
+            cols = [piece_refs[i][j] for i in range(len(inputs))]
+            yield (lambda c=cols, j=j: _reduce_task.remote(
+                reduce_kind, key, descending, aggregate,
+                args.get("seed"), j, *c))
+
+    return _windowed(submits(), ctx.max_tasks_in_flight)
+
+
+def split_refs_at(refs: list, cuts: list[int]) -> list[list]:
+    """Partition materialized (ref, meta) pairs at global row indices,
+    slicing blocks that straddle a boundary."""
+    shards = []
+    cur: list = []
+    cuts = list(cuts)
+    pos = 0
+    for bref, meta in refs:
+        start, end = pos, pos + meta.num_rows
+        pos = end
+        while cuts and start <= cuts[0] <= end:
+            cut = cuts.pop(0)
+            if cut > start:
+                sref, smref = _slice_task.remote(bref, 0, cut - start)
+                cur.append((sref, ray_tpu.get(smref, timeout=600)))
+            shards.append(cur)
+            cur = []
+            if cut < end:
+                sref, smref = _slice_task.remote(
+                    bref, cut - start, end - start)
+                bref = sref
+                meta = ray_tpu.get(smref, timeout=600)
+                start = cut
+            else:
+                bref = None
+                break
+        if bref is not None and meta.num_rows > 0:
+            cur.append((bref, meta))
+    shards.append(cur)
+    return shards
+
+
+def _limit_stage(op: plan_mod.Limit, upstream):
+    def gen():
+        remaining = op.n
+        for bref, meta in upstream:
+            if remaining <= 0:
+                break
+            if meta.num_rows <= remaining:
+                remaining -= meta.num_rows
+                yield bref, meta
+            else:
+                sref, smref = _slice_task.remote(bref, 0, remaining)
+                yield sref, ray_tpu.get(smref, timeout=600)
+                remaining = 0
+                break
+    return gen()
+
+
+def _union_stage(op: plan_mod.Union, upstream, ctx):
+    def gen():
+        yield from upstream
+        for other in op.others:
+            yield from execute(other, ctx)
+    return gen()
+
+
+def _zip_stage(op: plan_mod.Zip, upstream, ctx):
+    """Block-pairwise zip: each left block zips against the right-side row
+    range it aligns with — stays distributed, preserves left's block layout
+    (parity: data ZipOperator aligning bundles by row)."""
+    def gen():
+        left = list(upstream)
+        right = list(execute(op.other, ctx))
+        n_left = sum(m.num_rows for _b, m in left)
+        n_right = sum(m.num_rows for _b, m in right)
+        if n_left != n_right:
+            raise ValueError(
+                f"zip requires equal row counts, got {n_left} vs {n_right}")
+        # Global row offsets of each right block.
+        r_starts = []
+        off = 0
+        for _b, m in right:
+            r_starts.append(off)
+            off += m.num_rows
+
+        def right_range(a, b):
+            out = []
+            for j, (rb, rm) in enumerate(right):
+                s, e = r_starts[j], r_starts[j] + rm.num_rows
+                lo, hi = max(a, s), min(b, e)
+                if lo < hi:
+                    out.append((j, lo - s, hi - s))
+            return out
+
+        def submits():
+            a = 0
+            for lb, lm in left:
+                b = a + lm.num_rows
+                slices = right_range(a, b)
+                rrefs = [right[j][0] for j, _s, _e in slices]
+                local = [(k, s, e)
+                         for k, (_j, s, e) in enumerate(slices)]
+                a = b
+                yield (lambda lb=lb, local=local, rrefs=rrefs:
+                       _zip_pair_task.remote(lb, local, *rrefs))
+
+        yield from _windowed(submits(), ctx.max_tasks_in_flight)
+    return gen()

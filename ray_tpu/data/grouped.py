@@ -1,0 +1,115 @@
+"""GroupedData: groupby aggregations and map_groups.
+
+Parity: reference `data/grouped_data.py` — sort-based shuffle colocates each
+key's rows in one partition (range partition on the key), then per-partition
+pyarrow group_by aggregates / per-group UDFs run as reduce tasks.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+import pyarrow as pa
+
+from ray_tpu.data import plan as plan_mod
+from ray_tpu.data.block import BlockAccessor, block_from_rows, concat_blocks
+
+
+_AGG_NAME = {"sum": "sum", "min": "min", "max": "max", "mean": "mean",
+             "count": "count", "stddev": "stddev"}
+
+
+class GroupedData:
+    def __init__(self, ds, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _agg_dataset(self, aggregate: Callable):
+        from ray_tpu.data.dataset import Dataset
+        return Dataset(self._ds._plan.with_op(plan_mod.AllToAll(
+            name="GroupByAgg", kind="groupby",
+            args={"key": self._key, "aggregate": aggregate})))
+
+    def _column_agg(self, op: str, on):
+        key = self._key
+        cols = [on] if isinstance(on, str) else list(on or [])
+
+        def aggregate(table: pa.Table):
+            use = cols or [c for c in table.column_names
+                           if c != key and not c.startswith("__shape__")]
+            pa_op = {"sum": "sum", "min": "min", "max": "max",
+                     "mean": "mean", "count": "count",
+                     "stddev": "stddev"}[op]
+            aggs = [(c, pa_op) for c in use]
+            out = table.group_by(key).aggregate(aggs)
+            # pyarrow names outputs "col_op"; reference style is "op(col)".
+            renames = {f"{c}_{pa_op}": f"{op}({c})" for c in use}
+            return out.rename_columns(
+                [renames.get(n, n) for n in out.column_names])
+        return self._agg_dataset(aggregate)
+
+    def sum(self, on=None):
+        return self._column_agg("sum", on)
+
+    def min(self, on=None):
+        return self._column_agg("min", on)
+
+    def max(self, on=None):
+        return self._column_agg("max", on)
+
+    def mean(self, on=None):
+        return self._column_agg("mean", on)
+
+    def std(self, on=None):
+        return self._column_agg("stddev", on)
+
+    def count(self):
+        key = self._key
+
+        def aggregate(table: pa.Table):
+            out = table.group_by(key).aggregate([(key, "count")])
+            return out.rename_columns(
+                ["count()" if n == f"{key}_count" else n
+                 for n in out.column_names])
+        return self._agg_dataset(aggregate)
+
+    def aggregate(self, *aggs):
+        """AggregateFn-style: each agg is (name, init, accumulate, merge,
+        finalize) packaged by ray_tpu.data.aggregate helpers."""
+        key = self._key
+
+        def aggregate_fn(table: pa.Table):
+            rows = []
+            for kv, group in _iter_groups(table, key):
+                row = {key: kv}
+                for agg in aggs:
+                    row[agg.name] = agg.apply(group)
+                rows.append(row)
+            return BlockAccessor.of(block_from_rows(rows)).table
+        return self._agg_dataset(aggregate_fn)
+
+    def map_groups(self, fn: Callable, *, batch_format: str = "numpy"):
+        key = self._key
+
+        def aggregate_fn(table: pa.Table):
+            from ray_tpu.data.dataset import _batch_of, _table_of
+            outs = []
+            for _kv, group in _iter_groups(table, key):
+                out = fn(_batch_of(group, batch_format))
+                outs.append(_table_of(out))
+            return concat_blocks(outs)
+        return self._agg_dataset(aggregate_fn)
+
+
+def _iter_groups(table: pa.Table, key: str):
+    """Yield (key_value, sub_table) from a table sorted by key."""
+    if table.num_rows == 0:
+        return
+    col = table.column(key).to_numpy(zero_copy_only=False)
+    # Boundaries where the key changes (table arrives sorted by key).
+    change = np.nonzero(col[1:] != col[:-1])[0] + 1
+    starts = np.concatenate([[0], change])
+    ends = np.concatenate([change, [len(col)]])
+    for s, e in zip(starts, ends):
+        yield col[s], table.slice(s, e - s)

@@ -1,0 +1,94 @@
+"""Logical plan + optimizer for Datasets.
+
+Parity: reference `data/_internal/logical/` (LogicalPlan `interfaces/
+logical_plan.py:10`, operators in `logical/operators/`, rule-based optimizer
+`logical/optimizers.py`). Ops are lazy records; the optimizer fuses adjacent
+block transforms so a fused chain runs as ONE task per block (the reference's
+OperatorFusionRule), which is the main thing that keeps the object plane
+out of the per-row path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+
+@dataclasses.dataclass
+class LogicalOp:
+    name: str
+
+
+@dataclasses.dataclass
+class Read(LogicalOp):
+    """N read tasks, each () -> pa.Table."""
+    read_fns: list  # list[Callable[[], pa.Table]]
+
+
+@dataclasses.dataclass
+class InputData(LogicalOp):
+    """Pre-materialized blocks (from_items/from_pandas/...)."""
+    refs: list      # list[(ObjectRef, BlockMetadata)]
+
+
+@dataclasses.dataclass
+class MapBlocks(LogicalOp):
+    """One block in, one block out (map/map_batches/filter/flat_map...)."""
+    fn: Callable    # pa.Table -> pa.Table
+    compute: Any = None          # None = task pool; int = actor pool size
+    fn_constructor: Any = None   # class UDF: constructed once per actor
+
+
+@dataclasses.dataclass
+class AllToAll(LogicalOp):
+    """Materializing exchange: repartition/shuffle/sort/groupby."""
+    kind: str       # "repartition" | "shuffle" | "sort" | "groupby"
+    args: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class Limit(LogicalOp):
+    n: int = 0
+
+
+@dataclasses.dataclass
+class Union(LogicalOp):
+    others: list = dataclasses.field(default_factory=list)  # [LogicalPlan]
+
+
+@dataclasses.dataclass
+class Zip(LogicalOp):
+    other: Any = None  # LogicalPlan
+
+
+class LogicalPlan:
+    def __init__(self, ops: list[LogicalOp]):
+        self.ops = ops
+
+    def with_op(self, op: LogicalOp) -> "LogicalPlan":
+        return LogicalPlan(self.ops + [op])
+
+    def optimized(self) -> "LogicalPlan":
+        """Fuse adjacent MapBlocks (task-pool ones) into single chains."""
+        out: list[LogicalOp] = []
+        for op in self.ops:
+            if (isinstance(op, MapBlocks) and out
+                    and isinstance(out[-1], MapBlocks)
+                    and out[-1].compute is None and op.compute is None):
+                prev = out.pop()
+                pf, nf = prev.fn, op.fn
+                out.append(MapBlocks(
+                    name=f"{prev.name}->{op.name}",
+                    fn=_compose(pf, nf)))
+            else:
+                out.append(op)
+        return LogicalPlan(out)
+
+    def describe(self) -> str:
+        return " -> ".join(op.name for op in self.ops)
+
+
+def _compose(f, g):
+    def fused(table):
+        return g(f(table))
+    return fused

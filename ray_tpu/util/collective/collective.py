@@ -113,16 +113,20 @@ class _Group:
     def _key(self, *parts):
         return ("coll", self.name) + parts
 
-    def exchange(self, value, participate: bool = True):
+    def exchange(self, value, fetch: bool = True):
         """All-to-all publish+read for one round; returns all contributions
-        in rank order. Cleanup by the last member to finish reading."""
+        in rank order (None when fetch=False — rooted ops like reduce() skip
+        the O(world) download on non-root ranks). Cleanup by the member whose
+        done-increment completes the round: a rank only increments after it
+        has finished reading, so keys are never deleted under a reader."""
         seq = self.next_seq()
-        if participate:
-            self.kv.put(self._key(seq, "d", self.rank), _blob(value))
-        vals = [
-            _unblob(self.kv.wait(self._key(seq, "d", r)))
-            for r in range(self.world_size)
-        ]
+        self.kv.put(self._key(seq, "d", self.rank), _blob(value))
+        vals = None
+        if fetch:
+            vals = [
+                _unblob(self.kv.wait(self._key(seq, "d", r)))
+                for r in range(self.world_size)
+            ]
         if self.kv.incr(self._key(seq, "done")) == self.world_size:
             for r in range(self.world_size):
                 self.kv.delete(self._key(seq, "d", r))
@@ -140,17 +144,9 @@ class _Group:
         return out
 
     def barrier(self, timeout: float = 300.0):
-        seq = self.next_seq()
-        key = self._key(seq, "bar")
-        self.kv.incr(key)
-        deadline = time.monotonic() + timeout
-        while int(self.kv.get(key) or b"0") < self.world_size:
-            if time.monotonic() > deadline:
-                raise TimeoutError(f"barrier on group {self.name} timed out")
-            time.sleep(0.001)
-        if self.kv.incr(self._key(seq, "bar_done")) == self.world_size:
-            self.kv.delete(key)
-            self.kv.delete(self._key(seq, "bar_done"))
+        # A barrier is exchange(None): publish arrival, wait for all, with
+        # _KV.wait's backoff and the shared cleanup protocol.
+        self.exchange(None)
 
 
 _groups: dict[str, _Group] = {}
@@ -234,7 +230,7 @@ def allreduce(tensor, group_name: str = "default", op: str = ReduceOp.SUM):
 def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
            op: str = ReduceOp.SUM):
     g = _group(group_name)
-    vals = g.exchange(tensor)
+    vals = g.exchange(tensor, fetch=(g.rank == dst_rank))
     if g.rank != dst_rank:
         return tensor
     return _writeback(tensor, _REDUCERS[op](np.stack(
