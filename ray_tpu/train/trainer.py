@@ -117,11 +117,14 @@ class TrainWorker:
         s = self._session
         if s is None:
             return [], False, None
+        # Read finished BEFORE draining: the loop thread appends its final
+        # report before setting finished, so this order can't lose it.
+        finished = s.finished
         reports = s.drain_reports()
         err = None
         if s.error is not None:
             err = repr(s.error)
-        return reports, s.finished, err
+        return reports, finished, err
 
     def latest_checkpoint_path(self):
         s = self._session

@@ -1,0 +1,40 @@
+"""ray_tpu.tune: hyperparameter search over trial actors.
+
+Parity: reference `python/ray/tune/` — Tuner.fit (`tuner.py:43,312`),
+TuneController (`execution/tune_controller.py:68`), search spaces
+(`search/sample.py`, basic variant generation), schedulers ASHA/PBT/FIFO
+(`schedulers/`), tune.report via the shared train session, experiment
+checkpoint/resume (`execution/experiment_state.py`).
+"""
+
+from ray_tpu.train.checkpoint import Checkpoint  # noqa: F401
+from ray_tpu.train.session import (  # noqa: F401
+    get_checkpoint,
+    report,
+)
+from ray_tpu.tune.schedulers import (  # noqa: F401
+    ASHAScheduler,
+    FIFOScheduler,
+    PopulationBasedTraining,
+)
+from ray_tpu.tune.search import (  # noqa: F401
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    uniform,
+)
+from ray_tpu.tune.tuner import (  # noqa: F401
+    Result,
+    ResultGrid,
+    TuneConfig,
+    Tuner,
+    with_resources,
+)
+
+__all__ = [
+    "Tuner", "TuneConfig", "Result", "ResultGrid", "with_resources",
+    "report", "get_checkpoint", "Checkpoint",
+    "grid_search", "uniform", "loguniform", "randint", "choice",
+    "ASHAScheduler", "FIFOScheduler", "PopulationBasedTraining",
+]

@@ -1,0 +1,124 @@
+"""Trial schedulers: FIFO, ASHA, PBT.
+
+Parity: reference `tune/schedulers/` — `async_hyperband.py` (ASHA:
+asynchronous successive halving with rungs at r*eta^k, stop a trial at a
+rung if its metric is below the rung's top-1/eta quantile) and `pbt.py`
+(PopulationBasedTraining: at each perturbation interval, bottom-quantile
+trials clone a top-quantile trial's checkpoint with mutated hyperparams).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    def on_result(self, trial, metrics: dict) -> str:
+        return CONTINUE
+
+
+class ASHAScheduler:
+    def __init__(self, *, metric: str | None = None, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: int = 3):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.grace = grace_period
+        self.eta = reduction_factor
+        # rung milestones: grace * eta^k up to max_t
+        self.rungs: list[int] = []
+        t = grace_period
+        while t < max_t:
+            self.rungs.append(t)
+            t *= reduction_factor
+        # rung value histories: {milestone: [metric, ...]}
+        self._recorded: dict[int, list[float]] = {r: [] for r in self.rungs}
+
+    def on_result(self, trial, metrics: dict) -> str:
+        t = metrics.get(self.time_attr)
+        val = metrics.get(self.metric)
+        if t is None or val is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP  # ran to completion budget
+        decision = CONTINUE
+        for rung in reversed(self.rungs):
+            if t < rung:
+                continue
+            recorded = self._recorded[rung]
+            if rung in trial.rungs_hit:
+                break  # already judged at this rung
+            trial.rungs_hit.add(rung)
+            recorded.append(val if self.mode == "max" else -val)
+            recorded.sort(reverse=True)
+            k = max(1, len(recorded) // self.eta)
+            cutoff = recorded[k - 1]
+            mine = val if self.mode == "max" else -val
+            if len(recorded) >= self.eta and mine < cutoff:
+                decision = STOP
+            break
+        return decision
+
+
+class PopulationBasedTraining:
+    def __init__(self, *, metric: str | None = None, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 perturbation_interval: int = 5,
+                 hyperparam_mutations: dict | None = None,
+                 quantile_fraction: float = 0.25,
+                 seed: int | None = None):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self._rng = random.Random(seed)
+        self._latest: dict[Any, tuple[float, Any]] = {}  # trial id -> (score, trial)
+
+    def on_result(self, trial, metrics: dict) -> str:
+        t = metrics.get(self.time_attr)
+        val = metrics.get(self.metric)
+        if t is None or val is None:
+            return CONTINUE
+        score = val if self.mode == "max" else -val
+        self._latest[trial.id] = (score, trial)
+        if t - trial.last_perturb < self.interval:
+            return CONTINUE
+        trial.last_perturb = t
+        ranked = sorted(self._latest.values(), key=lambda x: x[0])
+        n = len(ranked)
+        if n < 2:
+            return CONTINUE
+        k = max(1, int(n * self.quantile))
+        bottom = [tr for _s, tr in ranked[:k]]
+        top = [tr for _s, tr in ranked[-k:]]
+        if trial in bottom:
+            donor = self._rng.choice(top)
+            if donor is not trial and donor.latest_checkpoint:
+                trial.exploit_from = donor
+                return "EXPLOIT"
+        return CONTINUE
+
+    def mutate(self, config: dict) -> dict:
+        out = dict(config)
+        for key, spec in self.mutations.items():
+            if callable(spec):
+                out[key] = spec()
+            elif isinstance(spec, list):
+                out[key] = self._rng.choice(spec)
+            else:  # Domain
+                out[key] = spec.sample(self._rng)
+            # Standard PBT: either resample (above) or perturb 0.8x/1.2x.
+            if isinstance(out.get(key), (int, float)) and \
+                    self._rng.random() < 0.5 and key in config \
+                    and isinstance(config[key], (int, float)):
+                out[key] = config[key] * self._rng.choice([0.8, 1.2])
+        return out

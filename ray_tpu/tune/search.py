@@ -1,0 +1,104 @@
+"""Search spaces + variant generation.
+
+Parity: reference `tune/search/` — `grid_search` markers, sampling
+distributions (`tune/search/sample.py`: uniform/loguniform/randint/choice),
+and the BasicVariantGenerator (grid cross-product x num_samples random
+draws, `tune/search/basic_variant.py`).
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Uniform(Domain):
+    def __init__(self, lo: float, hi: float):
+        self.lo, self.hi = lo, hi
+
+    def sample(self, rng):
+        return rng.uniform(self.lo, self.hi)
+
+
+class LogUniform(Domain):
+    def __init__(self, lo: float, hi: float):
+        import math
+        self.llo, self.lhi = math.log(lo), math.log(hi)
+
+    def sample(self, rng):
+        import math
+        return math.exp(rng.uniform(self.llo, self.lhi))
+
+
+class RandInt(Domain):
+    def __init__(self, lo: int, hi: int):
+        self.lo, self.hi = lo, hi
+
+    def sample(self, rng):
+        return rng.randrange(self.lo, self.hi)
+
+
+class Choice(Domain):
+    def __init__(self, options):
+        self.options = list(options)
+
+    def sample(self, rng):
+        return rng.choice(self.options)
+
+
+def uniform(lo: float, hi: float) -> Uniform:
+    return Uniform(lo, hi)
+
+
+def loguniform(lo: float, hi: float) -> LogUniform:
+    return LogUniform(lo, hi)
+
+
+def randint(lo: int, hi: int) -> RandInt:
+    return RandInt(lo, hi)
+
+
+def choice(options) -> Choice:
+    return Choice(options)
+
+
+class _GridSearch:
+    def __init__(self, values):
+        self.values = list(values)
+
+
+def grid_search(values) -> _GridSearch:
+    return _GridSearch(values)
+
+
+def generate_variants(param_space: dict, num_samples: int,
+                      seed: int | None = None) -> list[dict]:
+    """Cross-product of grid_search axes x num_samples draws of Domains.
+
+    Parity: BasicVariantGenerator semantics — each grid combination is run
+    num_samples times, with Domain params re-sampled per variant.
+    """
+    rng = random.Random(seed)
+    grid_keys = [k for k, v in param_space.items()
+                 if isinstance(v, _GridSearch)]
+    grid_values = [param_space[k].values for k in grid_keys]
+    combos = list(itertools.product(*grid_values)) if grid_keys else [()]
+    variants = []
+    for _ in range(num_samples):
+        for combo in combos:
+            cfg = {}
+            for k, v in param_space.items():
+                if isinstance(v, _GridSearch):
+                    cfg[k] = combo[grid_keys.index(k)]
+                elif isinstance(v, Domain):
+                    cfg[k] = v.sample(rng)
+                else:
+                    cfg[k] = v
+            variants.append(cfg)
+    return variants

@@ -1,0 +1,389 @@
+"""Tuner + trial controller: trials as actors, schedulers, experiment state.
+
+Parity: reference `tune/tuner.py:43,312` (Tuner.fit), the TuneController
+event loop (`tune/execution/tune_controller.py:68,666` — trials run as
+actors, results polled, scheduler decisions applied), trial-level fault
+handling, and experiment checkpointing/resume
+(`tune/execution/experiment_state.py`, `Tuner.restore`).
+
+Trials run the user function in a trial-runner actor with the train-session
+mailbox (the same mechanism JaxTrainer workers use), so `tune.report` and
+`tune.get_checkpoint` behave identically inside both libraries.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tempfile
+import time
+from typing import Any, Callable
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.tune import schedulers as sched_mod
+from ray_tpu.tune.search import generate_variants
+
+PENDING, RUNNING, TERMINATED, ERRORED = \
+    "PENDING", "RUNNING", "TERMINATED", "ERRORED"
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    metric: str | None = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: int | None = None
+    scheduler: Any = None
+    seed: int | None = None
+
+
+@dataclasses.dataclass
+class Result:
+    metrics: dict
+    config: dict
+    path: str
+    checkpoint: Any = None
+    error: str | None = None
+    metrics_history: list = dataclasses.field(default_factory=list)
+
+
+class ResultGrid:
+    def __init__(self, results: list[Result]):
+        self._results = results
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i) -> Result:
+        return self._results[i]
+
+    @property
+    def errors(self):
+        return [r.error for r in self._results if r.error]
+
+    def get_best_result(self, metric: str | None = None,
+                        mode: str | None = None) -> Result:
+        metric = metric or self._default_metric
+        mode = mode or self._default_mode
+        ok = [r for r in self._results
+              if r.error is None and metric in (r.metrics or {})]
+        if not ok:
+            raise RuntimeError("no successful trial reported "
+                               f"metric {metric!r}")
+        return (max if mode == "max" else min)(
+            ok, key=lambda r: r.metrics[metric])
+
+    def get_dataframe(self):
+        import pandas as pd
+        rows = []
+        for r in self._results:
+            row = dict(r.metrics or {})
+            row.update({f"config/{k}": v for k, v in r.config.items()})
+            rows.append(row)
+        return pd.DataFrame(rows)
+
+    _default_metric: str | None = None
+    _default_mode: str = "max"
+
+
+def with_resources(trainable: Callable, resources: dict) -> Callable:
+    """Attach per-trial resources (parity: tune.with_resources)."""
+    trainable._tune_resources = dict(resources)
+    return trainable
+
+
+class Trial:
+    def __init__(self, trial_id: str, config: dict, storage_dir: str):
+        self.id = trial_id
+        self.config = config
+        self.storage_dir = storage_dir
+        self.state = PENDING
+        self.runner = None
+        self.iteration = 0
+        self.last_metrics: dict = {}
+        self.history: list[dict] = []
+        self.latest_checkpoint: str | None = None
+        self.error: str | None = None
+        self.rungs_hit: set = set()
+        self.last_perturb = 0
+        self.exploit_from: "Trial | None" = None
+        self.restore_from: str | None = None
+
+    def snapshot(self) -> dict:
+        return {
+            "id": self.id, "config": _jsonable(self.config),
+            "state": self.state, "iteration": self.iteration,
+            "last_metrics": _jsonable(self.last_metrics),
+            "checkpoint": self.latest_checkpoint, "error": self.error,
+        }
+
+
+def _jsonable(obj):
+    try:
+        json.dumps(obj)
+        return obj
+    except (TypeError, ValueError):
+        return {k: repr(v) for k, v in obj.items()} \
+            if isinstance(obj, dict) else repr(obj)
+
+
+@ray_tpu.remote
+class _TrialRunner:
+    """Hosts one trial's user function + session mailbox."""
+
+    def __init__(self, storage_dir: str):
+        self.storage_dir = storage_dir
+        self._session = None
+        self._thread = None
+
+    def start(self, fn_bytes: bytes, config: dict,
+              checkpoint_path: str | None):
+        import threading
+        import traceback
+        from ray_tpu.train import session as session_mod
+        from ray_tpu.train.checkpoint import Checkpoint
+        fn = cloudpickle.loads(fn_bytes)
+        ckpt = Checkpoint(checkpoint_path) if checkpoint_path else None
+        self._session = session_mod.TrainSession(
+            0, 1, self.storage_dir, checkpoint=ckpt)
+        session_mod._set_session(self._session)
+        s = self._session
+
+        def target():
+            try:
+                out = fn(config)
+                if isinstance(out, dict):  # final-dict trainable style
+                    s.report(out)
+            except BaseException:  # noqa: BLE001 — ship to controller
+                s.reports.append({"error": traceback.format_exc()})
+                s.error = True
+            finally:
+                s.finished = True
+
+        self._thread = threading.Thread(target=target, daemon=True)
+        self._thread.start()
+        return True
+
+    def poll(self):
+        s = self._session
+        if s is None:
+            return [], False
+        # Read finished BEFORE draining: the loop thread appends its final
+        # report before setting finished, so this order can't lose it
+        # (drain-then-read could: drain empty -> report lands -> read True).
+        finished = s.finished
+        return s.drain_reports(), finished
+
+
+class TuneController:
+    """Parity: tune_controller.py step loop, single-threaded driver."""
+
+    def __init__(self, trainable, trials: list[Trial], *,
+                 tune_config: TuneConfig, run_config,
+                 experiment_dir: str):
+        self.trainable = trainable
+        self.fn_bytes = cloudpickle.dumps(trainable)
+        self.trials = trials
+        self.cfg = tune_config
+        self.run_config = run_config
+        self.experiment_dir = experiment_dir
+        self.scheduler = tune_config.scheduler or sched_mod.FIFOScheduler()
+        if getattr(self.scheduler, "metric", None) is None and \
+                hasattr(self.scheduler, "metric"):
+            self.scheduler.metric = tune_config.metric
+        self.resources = getattr(trainable, "_tune_resources", {"cpu": 1})
+
+    # ---- lifecycle ----
+
+    def _launch(self, trial: Trial):
+        opts = {"num_cpus": float(self.resources.get("cpu", 1)),
+                "num_tpus": float(self.resources.get("tpu", 0))}
+        trial.runner = _TrialRunner.options(**opts).remote(trial.storage_dir)
+        ckpt = trial.restore_from or trial.latest_checkpoint
+        trial.runner.start.remote(
+            self.fn_bytes, trial.config, ckpt)
+        trial.state = RUNNING
+
+    def _stop_runner(self, trial: Trial):
+        if trial.runner is not None:
+            try:
+                ray_tpu.kill(trial.runner)
+            except Exception:  # noqa: BLE001
+                pass
+            trial.runner = None
+
+    def _should_stop(self, metrics: dict) -> bool:
+        stop = getattr(self.run_config, "stop", None)
+        if not stop:
+            return False
+        if callable(stop):
+            return stop(metrics)
+        return any(metrics.get(k, float("-inf")) >= v
+                   for k, v in stop.items())
+
+    # ---- main loop ----
+
+    def run(self) -> list[Trial]:
+        max_conc = self.cfg.max_concurrent_trials or max(
+            1, int(ray_tpu.cluster_resources().get("CPU", 2)) - 1)
+        while True:
+            running = [t for t in self.trials if t.state == RUNNING]
+            pending = [t for t in self.trials if t.state == PENDING]
+            if not running and not pending:
+                break
+            while pending and len(running) < max_conc:
+                t = pending.pop(0)
+                self._launch(t)
+                running.append(t)
+            polls = [(t, t.runner.poll.remote()) for t in running
+                     if t.runner is not None]
+            for trial, ref in polls:
+                try:
+                    reports, finished = ray_tpu.get(ref, timeout=60)
+                except Exception as e:  # noqa: BLE001 — runner died
+                    trial.state = ERRORED
+                    trial.error = f"trial runner died: {e}"
+                    self._stop_runner(trial)
+                    continue
+                self._process_reports(trial, reports)
+                if finished and trial.state == RUNNING:
+                    trial.state = (ERRORED if trial.error else TERMINATED)
+                    self._stop_runner(trial)
+            self._save_experiment_state()
+            time.sleep(0.02)
+        self._save_experiment_state()
+        return self.trials
+
+    def _process_reports(self, trial: Trial, reports: list[dict]):
+        for rep in reports:
+            if "error" in rep:
+                trial.error = rep["error"]
+                continue
+            metrics = dict(rep.get("metrics", {}))
+            trial.iteration += 1
+            metrics.setdefault("training_iteration", trial.iteration)
+            if rep.get("checkpoint"):
+                trial.latest_checkpoint = rep["checkpoint"]
+            trial.last_metrics = metrics
+            trial.history.append(metrics)
+            if trial.state != RUNNING:
+                continue
+            if self._should_stop(metrics):
+                trial.state = TERMINATED
+                self._stop_runner(trial)
+                continue
+            decision = self.scheduler.on_result(trial, metrics)
+            if decision == sched_mod.STOP:
+                trial.state = TERMINATED
+                self._stop_runner(trial)
+            elif decision == "EXPLOIT":
+                donor = trial.exploit_from
+                trial.exploit_from = None
+                if donor is not None and donor.latest_checkpoint:
+                    # PBT: restart from the donor's checkpoint with
+                    # mutated hyperparams (tune/schedulers/pbt.py).
+                    self._stop_runner(trial)
+                    trial.config = self.scheduler.mutate(donor.config)
+                    trial.restore_from = donor.latest_checkpoint
+                    trial.state = PENDING
+
+    def _save_experiment_state(self):
+        state = {
+            "timestamp": time.time(),
+            "trials": [t.snapshot() for t in self.trials],
+        }
+        path = os.path.join(self.experiment_dir, "experiment_state.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f, indent=1)
+        os.replace(tmp, path)
+
+
+class Tuner:
+    def __init__(self, trainable=None, *, param_space: dict | None = None,
+                 tune_config: TuneConfig | None = None, run_config=None,
+                 _trials: list[Trial] | None = None):
+        from ray_tpu.train.trainer import RunConfig
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig(name="tune_run")
+        self._preloaded_trials = _trials
+
+    def _experiment_dir(self) -> str:
+        base = getattr(self.run_config, "storage_path", None) or os.path.join(
+            tempfile.gettempdir(), "ray_tpu_results")
+        d = os.path.join(base, getattr(self.run_config, "name", "tune_run"))
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def fit(self) -> ResultGrid:
+        if self.trainable is None:
+            raise ValueError("Tuner needs a trainable")
+        exp_dir = self._experiment_dir()
+        if self._preloaded_trials is not None:
+            trials = self._preloaded_trials
+        else:
+            variants = generate_variants(
+                self.param_space, self.tune_config.num_samples,
+                seed=self.tune_config.seed)
+            trials = []
+            for i, cfg in enumerate(variants):
+                tdir = os.path.join(exp_dir, f"trial_{i:04d}")
+                os.makedirs(tdir, exist_ok=True)
+                trials.append(Trial(f"trial_{i:04d}", cfg, tdir))
+        controller = TuneController(
+            self.trainable, trials, tune_config=self.tune_config,
+            run_config=self.run_config, experiment_dir=exp_dir)
+        done = controller.run()
+        results = []
+        for t in done:
+            from ray_tpu.train.checkpoint import Checkpoint
+            results.append(Result(
+                metrics=t.last_metrics, config=t.config,
+                path=t.storage_dir,
+                checkpoint=(Checkpoint(t.latest_checkpoint)
+                            if t.latest_checkpoint else None),
+                error=t.error, metrics_history=t.history))
+        grid = ResultGrid(results)
+        grid._default_metric = self.tune_config.metric
+        grid._default_mode = self.tune_config.mode
+        return grid
+
+    @classmethod
+    def restore(cls, path: str, trainable, *,
+                restart_errored: bool = False,
+                tune_config: TuneConfig | None = None,
+                run_config=None) -> "Tuner":
+        """Resume an interrupted experiment from experiment_state.json."""
+        with open(os.path.join(path, "experiment_state.json")) as f:
+            state = json.load(f)
+        trials = []
+        for snap in state["trials"]:
+            t = Trial(snap["id"], snap["config"],
+                      os.path.join(path, snap["id"]))
+            t.iteration = snap.get("iteration", 0)
+            t.last_metrics = snap.get("last_metrics") or {}
+            t.latest_checkpoint = snap.get("checkpoint")
+            t.error = snap.get("error")
+            st = snap["state"]
+            if st == TERMINATED:
+                t.state = TERMINATED
+            elif st == ERRORED and not restart_errored:
+                t.state = ERRORED
+            else:
+                # PENDING/RUNNING (interrupted) or restarted ERRORED:
+                # rerun from the latest checkpoint.
+                t.state = PENDING
+                t.restore_from = t.latest_checkpoint
+                t.error = None
+            trials.append(t)
+        from ray_tpu.train.trainer import RunConfig
+        rc = run_config or RunConfig(
+            name=os.path.basename(path.rstrip("/")),
+            storage_path=os.path.dirname(path.rstrip("/")))
+        return cls(trainable, tune_config=tune_config, run_config=rc,
+                   _trials=trials)
