@@ -1,0 +1,24 @@
+"""ray_tpu.serve — online model serving.
+
+Parity: reference `python/ray/serve/` (controller reconciliation loop,
+replica FSM with rolling updates, pow-2 routing, HTTP proxy, queue-based
+autoscaling, batching, multiplexing, handle-DAG composition).
+"""
+
+from ray_tpu.serve.api import (  # noqa: F401
+    delete,
+    get_app_handle,
+    get_deployment_handle,
+    run,
+    shutdown,
+    status,
+)
+from ray_tpu.serve.batching import batch  # noqa: F401
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig  # noqa: F401
+from ray_tpu.serve.deployment import Application, Deployment, deployment  # noqa: F401
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse  # noqa: F401
+from ray_tpu.serve.multiplex import (  # noqa: F401
+    get_multiplexed_model_id,
+    multiplexed,
+)
+from ray_tpu.serve.proxy import Request  # noqa: F401

@@ -1,0 +1,136 @@
+"""serve public API: run / delete / status / shutdown / handles.
+
+Parity: reference `python/ray/serve/api.py` (serve.run:591, serve.delete,
+serve.status, serve.shutdown, get_deployment_handle/get_app_handle).
+"""
+
+from __future__ import annotations
+
+import time
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.core.status import RayTpuError
+from ray_tpu.serve.config import CONTROLLER_NAME, DEFAULT_HTTP_PORT
+from ray_tpu.serve.controller import ServeController
+from ray_tpu.serve.deployment import Application, BoundDeployment
+from ray_tpu.serve.handle import DeploymentHandle
+
+
+def _get_or_create_controller(http_port=DEFAULT_HTTP_PORT):
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        pass
+    return ray_tpu.remote(ServeController).options(
+        name=CONTROLLER_NAME, num_cpus=0).remote(http_port)
+
+
+def _get_controller():
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        raise RayTpuError("Serve is not running (no controller); call serve.run")
+
+
+def run(app: Application, *, name: str = "default",
+        route_prefix: str | None = "/", http_port: int = DEFAULT_HTTP_PORT,
+        blocking_timeout_s: float = 60.0, _blocking: bool = True
+        ) -> DeploymentHandle:
+    """Deploy an application and return a handle to its ingress deployment."""
+    if not isinstance(app, Application):
+        raise TypeError("serve.run takes an Application (deployment.bind(...))")
+    controller = _get_or_create_controller(http_port)
+
+    deployments = {}
+    for bound in app.walk():
+        # Composition: bound-deployment init args become handles.
+        def swap(v):
+            if isinstance(v, Application):
+                return DeploymentHandle(name, v.root.name)
+            if isinstance(v, BoundDeployment):
+                return DeploymentHandle(name, v.name)
+            return v
+        init_args = tuple(swap(a) for a in bound.init_args)
+        init_kwargs = {k: swap(v) for k, v in bound.init_kwargs.items()}
+        deployments[bound.name] = {
+            "def_blob": cloudpickle.dumps(bound.deployment.func_or_class),
+            "init_args_blob": cloudpickle.dumps((init_args, init_kwargs)),
+            "config": bound.deployment.config,
+        }
+    ray_tpu.get(controller.deploy_application.remote(
+        name, route_prefix, app.root.name, deployments), timeout=30)
+    handle = DeploymentHandle(name, app.root.name)
+    if _blocking:
+        _wait_running(controller, name, blocking_timeout_s)
+    return handle
+
+
+def _wait_running(controller, app_name, timeout_s):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        st = ray_tpu.get(controller.get_status.remote(), timeout=10)
+        app = st.get(app_name)
+        if app is not None and app["status"] == "RUNNING":
+            return
+        time.sleep(0.1)
+    raise TimeoutError(
+        f"application {app_name!r} did not reach RUNNING in {timeout_s}s: "
+        f"{ray_tpu.get(controller.get_status.remote(), timeout=10)}")
+
+
+def status() -> dict:
+    """Cluster-wide serve status (parity: serve.status)."""
+    try:
+        controller = _get_controller()
+    except RayTpuError:
+        return {}
+    return ray_tpu.get(controller.get_status.remote(), timeout=10)
+
+
+def delete(name: str, *, blocking_timeout_s: float = 30.0):
+    controller = _get_controller()
+    ray_tpu.get(controller.delete_application.remote(name), timeout=10)
+    deadline = time.monotonic() + blocking_timeout_s
+    while time.monotonic() < deadline:
+        if name not in ray_tpu.get(controller.get_status.remote(), timeout=10):
+            return
+        time.sleep(0.1)
+    raise TimeoutError(f"application {name!r} did not delete")
+
+
+def get_deployment_handle(deployment_name: str, app_name: str = "default"
+                          ) -> DeploymentHandle:
+    return DeploymentHandle(app_name, deployment_name)
+
+
+def get_app_handle(app_name: str = "default") -> DeploymentHandle:
+    controller = _get_controller()
+    st = ray_tpu.get(controller.get_status.remote(), timeout=10)
+    if app_name not in st:
+        raise ValueError(f"no serve application named {app_name!r}")
+    return DeploymentHandle(app_name, st[app_name]["ingress"])
+
+
+def shutdown():
+    """Tear down all applications and the controller/proxy."""
+    try:
+        controller = _get_controller()
+    except RayTpuError:
+        return
+    try:
+        ray_tpu.get(controller.graceful_shutdown.remote(), timeout=30)
+    except RayTpuError:
+        pass
+    try:
+        ray_tpu.kill(controller)
+    except Exception:
+        pass
+    from ray_tpu.serve.config import PROXY_NAME
+    try:
+        ray_tpu.kill(ray_tpu.get_actor(PROXY_NAME))
+    except Exception:
+        pass

@@ -1,0 +1,112 @@
+"""@serve.batch — adaptive request batching inside a replica.
+
+Parity: reference `python/ray/serve/batching.py` (_BatchQueue + @serve.batch):
+decorated async method receives a list of requests; individual callers each
+get their own element of the returned list back.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import inspect
+
+
+class _BatchQueue:
+    def __init__(self, fn, max_batch_size: int, batch_wait_timeout_s: float):
+        self.fn = fn
+        self.max_batch_size = max_batch_size
+        self.batch_wait_timeout_s = batch_wait_timeout_s
+        self.queue: list = []          # [(args_tuple, future)]
+        self._flusher = None
+
+    async def submit(self, instance, args, kwargs):
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self.queue.append(((instance, args, kwargs), fut))
+        if len(self.queue) >= self.max_batch_size:
+            await self._flush()
+        elif self._flusher is None:
+            self._flusher = asyncio.ensure_future(self._timed_flush())
+        return await fut
+
+    async def _timed_flush(self):
+        await asyncio.sleep(self.batch_wait_timeout_s)
+        self._flusher = None
+        await self._flush()
+
+    async def _flush(self):
+        if self._flusher is not None:
+            self._flusher.cancel()
+            self._flusher = None
+        batch, self.queue = self.queue, []
+        if not batch:
+            return
+        (instance, args0, kwargs0), _ = batch[0]
+        try:
+            # Each positional/keyword parameter becomes a list across the
+            # batch; all calls in a batch must share the same shape.
+            arg_lists = [[] for _ in args0]
+            kw_lists = {k: [] for k in kwargs0}
+            for (inst, args, kwargs), _fut in batch:
+                if len(args) != len(arg_lists) or set(kwargs) != set(kw_lists):
+                    raise TypeError(
+                        "@serve.batch calls in one batch must pass the same "
+                        f"parameters; got {len(args)} positional/"
+                        f"{sorted(kwargs)} vs {len(arg_lists)}/"
+                        f"{sorted(kw_lists)}")
+                for i, a in enumerate(args):
+                    arg_lists[i].append(a)
+                for k, v in kwargs.items():
+                    kw_lists[k].append(v)
+            if instance is not None:
+                out = self.fn(instance, *arg_lists, **kw_lists)
+            else:
+                out = self.fn(*arg_lists, **kw_lists)
+            if inspect.iscoroutine(out):
+                out = await out
+            if not isinstance(out, list) or len(out) != len(batch):
+                raise TypeError(
+                    f"@serve.batch function must return a list of "
+                    f"{len(batch)} results, got {type(out).__name__}")
+            for (_, fut), res in zip(batch, out):
+                if not fut.done():
+                    fut.set_result(res)
+        except Exception as e:
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+
+
+def batch(_fn=None, *, max_batch_size: int = 10,
+          batch_wait_timeout_s: float = 0.01):
+    """Decorator: batch concurrent calls into one list-in/list-out call."""
+
+    def wrap(fn):
+        queues: dict = {}  # instance id -> _BatchQueue
+
+        if not inspect.iscoroutinefunction(fn):
+            raise TypeError("@serve.batch requires an async def function")
+
+        sig = inspect.signature(fn)
+        is_method = list(sig.parameters) and list(sig.parameters)[0] == "self"
+
+        @functools.wraps(fn)
+        async def wrapper(*args, **kwargs):
+            if is_method:
+                instance, call_args = args[0], args[1:]
+            else:
+                instance, call_args = None, args
+            q = queues.get(id(instance))
+            if q is None:
+                q = _BatchQueue(fn, max_batch_size, batch_wait_timeout_s)
+                queues[id(instance)] = q
+            return await q.submit(instance if is_method else None,
+                                  call_args, kwargs)
+
+        wrapper._is_serve_batch = True
+        return wrapper
+
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap

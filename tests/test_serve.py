@@ -1,0 +1,312 @@
+"""Serve: deployments, handles, composition, HTTP proxy, autoscaling,
+rolling updates, batching, multiplexing.
+
+Parity model: reference python/ray/serve/tests/ (test_handle.py,
+test_proxy.py, test_autoscaling_policy.py, test_batching.py).
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+HTTP_PORT = 8123
+
+
+@pytest.fixture(scope="module")
+def serve_instance():
+    ray_tpu.init(num_cpus=8)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def http_get(path, port=HTTP_PORT, timeout=10):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+        return r.status, r.read()
+
+
+def http_post(path, body, port=HTTP_PORT, timeout=10):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=body, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+def test_basic_deploy_and_handle(serve_instance):
+    @serve.deployment
+    class Doubler:
+        def __call__(self, x):
+            return 2 * x
+
+    h = serve.run(Doubler.bind(), name="doubler", route_prefix="/double",
+                  http_port=HTTP_PORT)
+    assert h.remote(21).result() == 42
+    assert serve.status()["doubler"]["status"] == "RUNNING"
+    serve.delete("doubler")
+
+
+def test_function_deployment(serve_instance):
+    @serve.deployment
+    def add_one(x):
+        return x + 1
+
+    h = serve.run(add_one.bind(), name="addone", route_prefix=None,
+                  http_port=HTTP_PORT)
+    assert h.remote(41).result() == 42
+    serve.delete("addone")
+
+
+def test_num_replicas_and_methods(serve_instance):
+    @serve.deployment(num_replicas=3)
+    class Counter:
+        def __init__(self):
+            self.count = 0
+
+        def incr(self):
+            self.count += 1
+            return self.count
+
+        def pid(self):
+            import os
+            return os.getpid()
+
+    h = serve.run(Counter.bind(), name="counter", route_prefix=None,
+                  http_port=HTTP_PORT)
+    pids = {h.pid.remote().result() for _ in range(20)}
+    assert len(pids) > 1, "3 replicas should span processes"
+    st = serve.status()["counter"]["deployments"]["Counter"]
+    assert st["running_replicas"] == 3
+    serve.delete("counter")
+
+
+def test_http_proxy_and_routes(serve_instance):
+    @serve.deployment
+    class Echo:
+        def __call__(self, request):
+            if request.method == "POST":
+                return {"got": request.json()}
+            return {"path": request.path, "q": request.query_params}
+
+    serve.run(Echo.bind(), name="echo", route_prefix="/echo",
+              http_port=HTTP_PORT)
+    status, body = http_get("/echo/sub?a=1")
+    assert status == 200
+    data = json.loads(body)
+    assert data["path"] == "/sub" and data["q"] == {"a": "1"}
+
+    status, body = http_post("/echo", json.dumps({"k": "v"}).encode())
+    assert json.loads(body) == {"got": {"k": "v"}}
+
+    status, body = http_get("/-/healthz")
+    assert status == 200 and body == b"success"
+
+    status, body = http_get("/-/routes")
+    assert "/echo" in json.loads(body)
+
+    with pytest.raises(urllib.error.HTTPError) as err:
+        http_get("/nothing-here")
+    assert err.value.code == 404
+    serve.delete("echo")
+
+
+def test_composition(serve_instance):
+    @serve.deployment
+    class Adder:
+        def __init__(self, increment):
+            self.increment = increment
+
+        def __call__(self, x):
+            return x + self.increment
+
+    @serve.deployment
+    class Combiner:
+        def __init__(self, a, b):
+            self.a = a
+            self.b = b
+
+        def __call__(self, x):
+            r1 = self.a.remote(x)
+            r2 = self.b.remote(x)
+            return r1.result() + r2.result()
+
+    app = Combiner.bind(Adder.options(name="A1").bind(1),
+                        Adder.options(name="A2").bind(2))
+    h = serve.run(app, name="combo", route_prefix=None, http_port=HTTP_PORT)
+    assert h.remote(10).result() == 23  # (10+1) + (10+2)
+    serve.delete("combo")
+
+
+def test_user_config_reconfigure(serve_instance):
+    @serve.deployment(user_config={"threshold": 5})
+    class Thresholder:
+        def __init__(self):
+            self.threshold = None
+
+        def reconfigure(self, config):
+            self.threshold = config["threshold"]
+
+        def __call__(self, x):
+            return x > self.threshold
+
+    d = Thresholder.bind()
+    h = serve.run(d, name="thresh", route_prefix=None, http_port=HTTP_PORT)
+    assert h.remote(6).result() is True
+    assert h.remote(4).result() is False
+
+    # Lightweight update: same code, new user_config -> reconfigure in place.
+    d2 = Thresholder.options(user_config={"threshold": 100}).bind()
+    serve.run(d2, name="thresh", route_prefix=None, http_port=HTTP_PORT)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if h.remote(6).result() is False:
+            break
+        time.sleep(0.2)
+    assert h.remote(6).result() is False
+    serve.delete("thresh")
+
+
+def test_autoscaling_scale_up(serve_instance):
+    @serve.deployment(autoscaling_config=serve.AutoscalingConfig(
+        min_replicas=1, max_replicas=3, target_ongoing_requests=1,
+        upscale_delay_s=0.5, downscale_delay_s=60))
+    class Slow:
+        def __call__(self):
+            time.sleep(0.4)
+            return "ok"
+
+    h = serve.run(Slow.bind(), name="slow", route_prefix=None,
+                  http_port=HTTP_PORT)
+    # Fire enough concurrent traffic to push queue depth over target.
+    deadline = time.monotonic() + 25
+    responses = []
+    scaled = False
+    while time.monotonic() < deadline and not scaled:
+        responses.extend(h.remote() for _ in range(6))
+        st = serve.status()["slow"]["deployments"]["Slow"]
+        scaled = st["target_num_replicas"] > 1
+        responses = responses[-50:]
+        time.sleep(0.2)
+    assert scaled, "queue pressure should trigger scale-up"
+    for r in responses[-5:]:
+        assert r.result(timeout_s=30) == "ok"
+    serve.delete("slow")
+
+
+def test_replica_recovery(serve_instance):
+    @serve.deployment(num_replicas=1, health_check_period_s=0.3)
+    class Fragile:
+        def die(self):
+            import os
+            os._exit(1)
+
+        def ping(self):
+            return "pong"
+
+    h = serve.run(Fragile.bind(), name="fragile", route_prefix=None,
+                  http_port=HTTP_PORT)
+    assert h.ping.remote().result() == "pong"
+    try:
+        h.die.remote().result(timeout_s=5)
+    except Exception:
+        pass
+    deadline = time.monotonic() + 30
+    ok = False
+    while time.monotonic() < deadline:
+        try:
+            if h.ping.remote().result(timeout_s=5) == "pong":
+                ok = True
+                break
+        except Exception:
+            time.sleep(0.3)
+    assert ok, "controller should replace the dead replica"
+    serve.delete("fragile")
+
+
+def test_batching(serve_instance):
+    @serve.deployment(max_ongoing_requests=32)
+    class Batched:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.1)
+        async def handle_batch(self, xs):
+            self.batch_sizes.append(len(xs))
+            return [x * 10 for x in xs]
+
+        async def __call__(self, x):
+            return await self.handle_batch(x)
+
+        def max_batch_seen(self):
+            return max(self.batch_sizes or [0])
+
+    h = serve.run(Batched.bind(), name="batched", route_prefix=None,
+                  http_port=HTTP_PORT)
+    responses = [h.remote(i) for i in range(16)]
+    assert [r.result(timeout_s=30) for r in responses] == [
+        i * 10 for i in range(16)]
+    assert h.max_batch_seen.remote().result() > 1, "calls should coalesce"
+    serve.delete("batched")
+
+
+def test_multiplexed_model_id_via_handle(serve_instance):
+    @serve.deployment
+    class MultiModel:
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id):
+            return f"loaded-{model_id}"
+
+        async def __call__(self):
+            mid = serve.get_multiplexed_model_id()
+            return await self.get_model(mid)
+
+    h = serve.run(MultiModel.bind(), name="mm", route_prefix=None,
+                  http_port=HTTP_PORT)
+    r = h.options(multiplexed_model_id="m1").remote().result()
+    assert r == "loaded-m1"
+    r = h.options(multiplexed_model_id="m2").remote().result()
+    assert r == "loaded-m2"
+    serve.delete("mm")
+
+
+def test_batch_kwargs(serve_instance):
+    import asyncio
+
+    @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.05)
+    async def scale(xs, factor=None):
+        return [x * f for x, f in zip(xs, factor)]
+
+    async def scenario():
+        return await asyncio.gather(
+            scale(1, factor=2), scale(2, factor=3), scale(3, factor=4))
+
+    assert asyncio.run(scenario()) == [2, 6, 12]
+
+
+def test_multiplexed_lru():
+    import asyncio
+
+    loads = []
+
+    class Host:
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id):
+            loads.append(model_id)
+            return f"model-{model_id}"
+
+    host = Host()
+
+    async def scenario():
+        assert await host.get_model("a") == "model-a"
+        assert await host.get_model("b") == "model-b"
+        assert await host.get_model("a") == "model-a"  # cached
+        assert await host.get_model("c") == "model-c"  # evicts b
+        assert await host.get_model("b") == "model-b"  # reload
+
+    asyncio.run(scenario())
+    assert loads == ["a", "b", "c", "b"]
