@@ -12,10 +12,12 @@ from ray_tpu.core.api import (
     cluster_resources,
     get,
     get_actor,
+    get_node_id,
     init,
     is_initialized,
     kill,
     method,
+    nodes,
     put,
     remote,
     shutdown,
@@ -37,7 +39,7 @@ from ray_tpu import util  # noqa: E402,F401  (parity: ray.util auto-import)
 __all__ = [
     "__version__", "init", "shutdown", "is_initialized", "remote", "method",
     "get", "put", "wait", "kill", "get_actor", "cluster_resources",
-    "available_resources", "timeline", "ObjectRef", "RayTpuError",
-    "TaskError", "ActorDiedError", "WorkerCrashedError", "ObjectLostError",
-    "GetTimeoutError", "util",
+    "available_resources", "nodes", "get_node_id", "timeline", "ObjectRef",
+    "RayTpuError", "TaskError", "ActorDiedError", "WorkerCrashedError",
+    "ObjectLostError", "GetTimeoutError", "util",
 ]

@@ -117,6 +117,31 @@ def available_resources() -> dict:
     return rt.request("available_resources")
 
 
+def nodes() -> list:
+    """The cluster node table (parity: ray.nodes())."""
+    from ray_tpu.core.runtime import Runtime, get_runtime
+    rt = get_runtime()
+    if isinstance(rt, Runtime):
+        return rt.nodes_table()
+    return rt.request("nodes")
+
+
+def get_node_id() -> str:
+    """Hex id of the node this process runs on (parity:
+    ray.get_runtime_context().get_node_id())."""
+    import os
+
+    from ray_tpu.core.runtime import Runtime, get_runtime
+    env = os.environ.get("RAY_TPU_NODE_ID")
+    if env:
+        return env
+    rt = get_runtime()
+    if isinstance(rt, Runtime):
+        return rt.head_node_id.hex()
+    # Head-node worker (spawned before multi-node was enabled).
+    return ""
+
+
 def timeline():
     from ray_tpu.core.runtime import Runtime, get_runtime
     rt = get_runtime()

@@ -1,0 +1,323 @@
+"""Per-node agent daemon: the raylet-equivalent for multi-node clusters.
+
+Runs one per node (parity: `src/ray/raylet/main.cc:136`). Owns the node's
+shared-memory object store (parity: plasma runs inside the raylet,
+`store_runner.h:29`) and its worker pool (parity: `worker_pool.h:228` —
+zygote prestart + on-demand growth), registers with the head over TCP
+(parity: raylet registering with the GCS), relays worker<->head frames, and
+serves cross-node object pulls over a peer port (parity: the object-manager
+push/pull plane, `object_manager.h:119`).
+
+Scheduling stays centralized at the head — the agent is deliberately a thin
+data/lifecycle plane. On one machine the test harness
+(`ray_tpu.cluster_utils.Cluster`) starts several agents to emulate a
+multi-node cluster, mirroring the reference's `cluster_utils.Cluster:135`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import selectors
+import signal
+import socket
+import tempfile
+import threading
+import time
+import traceback
+import uuid
+
+from ray_tpu.core import objxfer
+from ray_tpu.core.config import Config, set_config
+from ray_tpu.core.ids import ObjectID, WorkerID
+from ray_tpu.core.object_store import SharedMemoryStore, default_store_size
+from ray_tpu.core.runtime import (
+    _Zygote,
+    _reap_stale_stores,
+    build_worker_env,
+    spawn_worker_process,
+)
+from ray_tpu.core.transport import FrameBuffer, recv_msg, send_msg
+
+
+class _AgentWorker:
+    def __init__(self, worker_id: WorkerID, sock, proc):
+        self.worker_id = worker_id
+        self.sock = sock
+        self.send_lock = threading.Lock()
+        self.proc = proc
+        self.buffer = FrameBuffer()
+
+
+class NodeAgent:
+    def __init__(self, head_addr: str, num_cpus=None, num_tpus=0,
+                 resources=None, object_store_memory=None, node_ip="127.0.0.1"):
+        cfg = Config.from_env()
+        set_config(cfg)
+        self.config = cfg
+        self.node_id = os.urandom(8)
+        self.session_dir = os.path.join(
+            tempfile.gettempdir(), "ray_tpu",
+            f"node_{uuid.uuid4().hex[:12]}")
+        os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
+
+        shm_dir = "/dev/shm" if os.path.isdir("/dev/shm") else self.session_dir
+        _reap_stale_stores(shm_dir)
+        self.store_path = os.path.join(
+            shm_dir, f"ray_tpu_{os.getpid()}_{uuid.uuid4().hex[:12]}")
+        self.store = SharedMemoryStore(
+            self.store_path, size=object_store_memory or default_store_size(cfg),
+            num_slots=cfg.object_store_hash_slots, create=True)
+
+        self.resources = {
+            "CPU": float(num_cpus if num_cpus is not None
+                         else (os.cpu_count() or 1)),
+            "TPU": float(num_tpus or 0),
+        }
+        for k, v in (resources or {}).items():
+            self.resources[k] = float(v)
+
+        # Peer port: serves whole-object pulls to sibling agents and the head.
+        self.peer_srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.peer_srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.peer_srv.bind((node_ip, 0))
+        self.peer_srv.listen(64)
+        self.peer_srv.setblocking(False)
+        self.peer_addr = self.peer_srv.getsockname()
+
+        host, port = head_addr.rsplit(":", 1)
+        self.head_sock = socket.create_connection((host, int(port)))
+        self.head_lock = threading.Lock()
+        self.head_buffer = FrameBuffer()
+        self._send_head(("register_node", self.node_id, self.resources,
+                         self.peer_addr, socket.gethostname(), os.getpid()))
+
+        self.workers: dict[bytes, _AgentWorker] = {}
+        self.pool_size = max(1, cfg.num_workers or int(self.resources["CPU"]))
+        self.max_workers = self.pool_size * 2 + 8
+        self._shutdown = False
+        self._selector = selectors.DefaultSelector()
+        self._sel_lock = threading.Lock()
+        self._selector.register(self.head_sock, selectors.EVENT_READ,
+                                ("head", None))
+        self._selector.register(self.peer_srv, selectors.EVENT_READ,
+                                ("peer_accept", None))
+        self.zygote = _Zygote(self.session_dir, self.store_path,
+                              self._worker_env())
+
+        threading.Thread(target=self._prestart, daemon=True).start()
+        threading.Thread(target=self._heartbeat_loop, daemon=True).start()
+
+    # ---------------- workers ----------------
+
+    def _worker_env(self) -> dict:
+        return build_worker_env(self.config, self.node_id.hex())
+
+    def _prestart(self):
+        for _ in range(self.pool_size):
+            try:
+                self._spawn_worker()
+            except Exception:  # noqa: BLE001 — keep filling the pool
+                traceback.print_exc()
+
+    def _spawn_worker(self):
+        if self._shutdown:
+            return
+        worker_id = WorkerID.from_random()
+        parent, proc = spawn_worker_process(
+            worker_id, self.store_path, self._worker_env(), self.zygote,
+            self.session_dir)
+        w = _AgentWorker(worker_id, parent, proc)
+        self.workers[worker_id.binary()] = w
+        with self._sel_lock:
+            self._selector.register(parent, selectors.EVENT_READ,
+                                    ("worker", w))
+
+    def _on_worker_eof(self, w: _AgentWorker):
+        with self._sel_lock:
+            try:
+                self._selector.unregister(w.sock)
+            except (KeyError, ValueError):
+                pass
+        try:
+            w.sock.close()
+        except OSError:
+            pass
+        if self.workers.pop(w.worker_id.binary(), None) is None:
+            return
+        self._send_head(("worker_death", w.worker_id.binary()))
+        if not self._shutdown and len(self.workers) < self.pool_size:
+            threading.Thread(target=self._spawn_worker, daemon=True).start()
+
+    # ---------------- head link ----------------
+
+    def _send_head(self, msg):
+        try:
+            send_msg(self.head_sock, msg, self.head_lock)
+        except OSError:
+            self._die()
+
+    def _heartbeat_loop(self):
+        period = self.config.health_check_period_ms / 1000.0
+        while not self._shutdown:
+            time.sleep(period)
+            self._send_head(("heartbeat", self.node_id))
+
+    def _handle_head_msg(self, msg):
+        op = msg[0]
+        if op == "to_worker":
+            _, wid, inner = msg
+            w = self.workers.get(wid)
+            if w is not None:
+                try:
+                    send_msg(w.sock, inner, w.send_lock)
+                except OSError:
+                    pass
+        elif op == "spawn_worker":
+            if len(self.workers) < self.max_workers:
+                threading.Thread(target=self._spawn_worker,
+                                 daemon=True).start()
+        elif op == "kill_worker":
+            w = self.workers.get(msg[1])
+            if w is not None and w.proc is not None:
+                try:
+                    w.proc.kill()
+                except ProcessLookupError:
+                    pass
+        elif op == "fetch":
+            _, oid, src_addr, attempt = msg
+            threading.Thread(target=self._fetch_object,
+                             args=(oid, tuple(src_addr), attempt),
+                             daemon=True).start()
+        elif op == "free_obj":
+            try:
+                self.store.delete(ObjectID(msg[1]))
+            except Exception:  # noqa: BLE001
+                pass
+        elif op == "node_ack":
+            pass
+        elif op == "shutdown_node":
+            self._die()
+
+    # ---------------- object plane ----------------
+
+    def _fetch_object(self, oid: bytes, src_addr, attempt=None):
+        """Pull `oid` from a peer's store into ours (parity: pull_manager)."""
+        ok = False
+        try:
+            ok = objxfer.fetch_from_peer(self.store, src_addr, oid)
+        except Exception:  # noqa: BLE001
+            traceback.print_exc()
+        self._send_head(("fetched", oid, ok, attempt))
+
+    def _serve_peer(self, conn: socket.socket):
+        """One peer connection: answer obj_req frames until EOF."""
+        try:
+            while True:
+                msg = recv_msg(conn)
+                if msg is None:
+                    return
+                if msg[0] != "obj_req":
+                    continue
+                objxfer.send_blob(self.store, lambda m: send_msg(conn, m),
+                                  msg[1])
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # ---------------- main loop ----------------
+
+    def run(self):
+        while not self._shutdown:
+            with self._sel_lock:
+                try:
+                    events = self._selector.select(timeout=0.05)
+                except OSError:
+                    continue
+            for key, _mask in events:
+                kind, w = key.data
+                if kind == "peer_accept":
+                    try:
+                        conn, _addr = key.fileobj.accept()
+                    except OSError:
+                        continue
+                    conn.setblocking(True)
+                    threading.Thread(target=self._serve_peer, args=(conn,),
+                                     daemon=True).start()
+                    continue
+                try:
+                    data = key.fileobj.recv(1 << 20)
+                except (BlockingIOError, InterruptedError):
+                    continue
+                except OSError:
+                    data = b""
+                if kind == "head":
+                    if not data:
+                        self._die()
+                        return
+                    self.head_buffer.feed(data)
+                    for msg in self.head_buffer.frames():
+                        try:
+                            self._handle_head_msg(msg)
+                        except Exception:
+                            traceback.print_exc()
+                else:  # worker
+                    if not data:
+                        self._on_worker_eof(w)
+                        continue
+                    w.buffer.feed(data)
+                    for msg in w.buffer.frames():
+                        self._send_head(
+                            ("wmsg", w.worker_id.binary(), msg))
+
+    def _die(self):
+        if self._shutdown:
+            return
+        self._shutdown = True
+        for w in list(self.workers.values()):
+            if w.proc is not None:
+                try:
+                    w.proc.kill()
+                except ProcessLookupError:
+                    pass
+        if self.zygote is not None:
+            self.zygote.close()
+        try:
+            self.store.close()
+            self.store.unlink()
+        except Exception:  # noqa: BLE001
+            pass
+        os._exit(0)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="ray_tpu node agent (raylet)")
+    p.add_argument("--head", required=True, help="head host:port")
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--num-tpus", type=float, default=0)
+    p.add_argument("--resources", type=str, default="{}",
+                   help="extra resources as JSON")
+    p.add_argument("--object-store-memory", type=int, default=0)
+    p.add_argument("--node-ip", type=str, default="127.0.0.1")
+    args = p.parse_args(argv)
+    agent = NodeAgent(
+        args.head, num_cpus=args.num_cpus, num_tpus=args.num_tpus,
+        resources=json.loads(args.resources),
+        object_store_memory=args.object_store_memory or None,
+        node_ip=args.node_ip)
+
+    def _sig(_s, _f):
+        agent._die()
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    agent.run()
+
+
+if __name__ == "__main__":
+    main()

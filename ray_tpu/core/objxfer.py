@@ -1,0 +1,79 @@
+"""Whole-object blob transfer between node-local shm stores.
+
+Shared by the head runtime and node agents (parity: the push/pull protocol
+of `src/ray/object_manager/` — object_manager.h:119, pull_manager.h:57 —
+collapsed to single-frame whole-blob transfers over per-pull peer
+connections; the pickle-5 out-of-band framing in transport.py keeps the
+blob itself zero-copy on the send side).
+
+Wire: requester connects to the source's peer port, sends ("obj_req", oid),
+receives ("obj_blob", oid, ok, data).
+"""
+
+from __future__ import annotations
+
+import socket
+
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.transport import recv_msg, send_msg
+
+
+def write_blob(store, oid: bytes, blob) -> None:
+    """Store one raw serialized object blob (idempotent — concurrent
+    duplicate pulls of the same object race contains()/create(), and the
+    loser's 'already exists' means the object is materialized: success)."""
+    from ray_tpu.core.status import RayTpuError
+    if store.contains(ObjectID(oid)):
+        return
+    try:
+        buf = store.create(ObjectID(oid), len(blob))
+    except RayTpuError:
+        if store.contains(ObjectID(oid)):
+            return
+        res = None
+        try:
+            res = store.get_raw(ObjectID(oid), timeout=10.0)  # winner sealing
+        except Exception:  # noqa: BLE001 — GetTimeoutError: winner aborted
+            pass
+        if res is not None:
+            res[0].release()
+            store.release(ObjectID(oid))
+            return
+        raise
+    try:
+        buf.data[:] = blob
+        buf.seal()
+    except BaseException:
+        buf.abort()
+        raise
+
+
+def send_blob(store, sender, oid: bytes) -> None:
+    """Answer one obj_req: sender(msg) transmits the obj_blob reply."""
+    res = None
+    try:
+        res = store.get_raw(ObjectID(oid), timeout=5.0)
+    except Exception:  # noqa: BLE001 — absent/evicted objects reply ok=False
+        pass
+    if res is None:
+        sender(("obj_blob", oid, False, b""))
+        return
+    data, _meta = res
+    try:
+        sender(("obj_blob", oid, True, data))
+    finally:
+        data.release()
+        store.release(ObjectID(oid))
+
+
+def fetch_from_peer(store, addr, oid: bytes, timeout: float = 60.0) -> bool:
+    """Pull one object from a peer's port into `store`. Returns success."""
+    if store.contains(ObjectID(oid)):
+        return True
+    with socket.create_connection(tuple(addr), timeout=timeout) as s:
+        send_msg(s, ("obj_req", oid))
+        reply = recv_msg(s)
+    if reply is not None and reply[0] == "obj_blob" and reply[2]:
+        write_blob(store, oid, reply[3])
+        return True
+    return False

@@ -23,6 +23,7 @@ import concurrent.futures
 import os
 import selectors
 import signal
+import socket
 import subprocess
 import sys
 import tempfile
@@ -73,12 +74,61 @@ IDLE, BUSY, ASSIGNED_ACTOR, DEAD = "idle", "busy", "actor", "dead"
 A_PENDING, A_ALIVE, A_RESTARTING, A_DEAD = "pending", "alive", "restarting", "dead"
 
 
+def build_worker_env(config, node_id_hex: str) -> dict:
+    """Environment for spawned worker processes (shared head/agent)."""
+    env = dict(os.environ)
+    env.update(config.to_env())
+    env["RAY_TPU_NODE_ID"] = node_id_hex
+    env.setdefault("PYTHONPATH", "")
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env["PYTHONPATH"]
+    return env
+
+
+def spawn_worker_process(worker_id: WorkerID, store_path: str, env: dict,
+                         zygote: "_Zygote | None", session_dir: str):
+    """Fork a worker from the warm zygote, or cold-exec as fallback.
+    Returns (parent_sock, proc). Shared by the head runtime and node agents
+    (parity: WorkerPool::StartWorkerProcess, worker_pool.h:228)."""
+    import socket as socket_mod
+    log_path = os.path.join(session_dir, "logs",
+                            f"worker-{worker_id.hex()[:8]}.out")
+    # Fallback runs on a FRESH socketpair: a zygote that died mid-spawn may
+    # have forked a child that already holds the first pair's worker end.
+    parent = child = proc = None
+    if zygote is not None:
+        parent, child = socket_mod.socketpair(
+            socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+        pid = zygote.spawn(worker_id.hex(), child, log_path)
+        if pid:
+            proc = _ForkedProc(pid, zygote)
+        else:
+            parent.close()
+            child.close()
+            parent = child = None
+    if proc is None:
+        parent, child = socket_mod.socketpair(
+            socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.worker",
+             store_path, worker_id.hex(), str(child.fileno())],
+            pass_fds=[child.fileno()], env=env,
+            close_fds=True, stdout=open(log_path, "ab"),
+            stderr=subprocess.STDOUT)
+    child.close()
+    return parent, proc
+
+
 class WorkerHandle:
-    def __init__(self, worker_id: WorkerID, sock, proc):
+    kind = "worker"
+
+    def __init__(self, worker_id: WorkerID, sock, proc, node_id: bytes = b""):
         self.worker_id = worker_id
         self.sock = sock
         self.send_lock = threading.Lock()
         self.proc = proc
+        self.node_id = node_id
         self.state = IDLE
         self.connected = threading.Event()
         self.registered_fns: set[bytes] = set()
@@ -88,6 +138,82 @@ class WorkerHandle:
 
     def send(self, msg):
         send_msg(self.sock, msg, self.send_lock)
+
+    def kill(self) -> bool:
+        """Force-kill the worker process. Returns True if a kill was issued."""
+        if self.proc is None:
+            return False
+        try:
+            self.proc.kill()
+        except ProcessLookupError:
+            pass
+        return True
+
+
+class RemoteWorkerHandle(WorkerHandle):
+    """A worker on another node; every message relays through its node agent
+    (parity: the reference pushes tasks to remote workers over the worker's
+    own gRPC service, `core_worker.proto:457` — here the per-node agent is
+    the remote endpoint and fans in/out to its local workers)."""
+
+    def __init__(self, worker_id: WorkerID, node_conn: "NodeConn",
+                 node_id: bytes):
+        super().__init__(worker_id, None, None, node_id)
+        self.node_conn = node_conn
+        self.connected.set()
+
+    def send(self, msg):
+        self.node_conn.send(("to_worker", self.worker_id.binary(), msg))
+
+    def kill(self) -> bool:
+        try:
+            self.node_conn.send(("kill_worker", self.worker_id.binary()))
+        except OSError:
+            pass
+        return True
+
+
+class NodeConn:
+    """Head-side handle for one node agent's TCP connection."""
+
+    kind = "node"
+
+    def __init__(self, sock):
+        self.sock = sock
+        self.send_lock = threading.Lock()
+        self.buffer = FrameBuffer()
+        self.node_id: bytes | None = None  # set on register_node
+
+    def send(self, msg):
+        send_msg(self.sock, msg, self.send_lock)
+
+
+class _Acceptor:
+    """Selector sentinel for the cluster's listening socket."""
+
+    kind = "accept"
+
+
+class NodeState:
+    """Per-node resource/worker bookkeeping (parity: a `GcsNodeManager` row
+    plus that node's view in `ClusterResourceManager`,
+    `scheduling/cluster_resource_data.h`)."""
+
+    def __init__(self, node_id: bytes, resources: dict, conn: NodeConn | None,
+                 peer_addr=None, hostname: str = "", pid: int = 0):
+        self.node_id = node_id
+        self.conn = conn  # None for the head node
+        self.peer_addr = peer_addr  # (host, port) serving cross-node pulls
+        self.hostname = hostname or socket.gethostname()
+        self.pid = pid
+        self.total = dict(resources)
+        self.available = dict(resources)
+        self.idle: collections.deque[WorkerHandle] = collections.deque()
+        self.workers: dict[bytes, WorkerHandle] = {}
+        self.pending_actor_assign: collections.deque[bytes] = collections.deque()
+        self.state = "ALIVE"
+        self.last_heartbeat = time.monotonic()
+        self.last_spawn_req = 0.0
 
 
 class _ForkedProc:
@@ -239,6 +365,7 @@ class ActorState:
         self.death_cause = None
         self.seq = 0
         self.resources_reserved: dict[str, float] = {}
+        self.node_id: bytes | None = None
 
 
 class ObjectDirectory:
@@ -265,6 +392,21 @@ class ObjectDirectory:
         with self.lock:
             return self.entries.get(oid)
 
+    def add_location(self, oid: bytes, node_id: bytes):
+        """Merge a replica location into a shm entry, creating it if absent.
+        No-op for non-shm entries (inline/err outrank locations)."""
+        with self.lock:
+            e = self.entries.get(oid)
+            if e is not None:
+                if e[0] == "shm":
+                    e[1].add(node_id)
+                return
+            entry = ("shm", {node_id})
+            self.entries[oid] = entry
+            cbs = self.callbacks.pop(oid, [])
+        for cb in cbs:
+            cb(entry)
+
     def on_ready(self, oid: bytes, cb):
         with self.lock:
             entry = self.entries.get(oid)
@@ -289,7 +431,7 @@ class PlacementGroupState:
     """
 
     __slots__ = ("pg_id", "bundles", "strategy", "name", "state",
-                 "bundle_avail", "ready_oid")
+                 "bundle_avail", "bundle_nodes", "ready_oid")
 
     def __init__(self, pg_id: bytes, bundles, strategy: str, name: str):
         self.pg_id = pg_id
@@ -298,6 +440,7 @@ class PlacementGroupState:
         self.name = name
         self.state = "PENDING"  # PENDING/CREATED/REMOVED/INFEASIBLE
         self.bundle_avail = [dict(b) for b in bundles]
+        self.bundle_nodes: list[bytes] = []  # bundle i -> hosting node id
         self.ready_oid = os.urandom(16)
 
 
@@ -357,22 +500,36 @@ class Runtime:
         }
         for k, v in (resources or {}).items():
             self.total_resources[k] = float(v)
-        self.available = dict(self.total_resources)
 
         self.directory = ObjectDirectory()
         self.refcount = ReferenceCounter(free_callback=self._free_object)
         self.task_events = TaskEventBuffer(cfg.task_events_buffer_size)
 
         self.lock = threading.RLock()
+        # --- node table (parity: gcs_node_manager) ---
+        self.head_node_id = os.urandom(8)
+        self.head_node = NodeState(self.head_node_id,
+                                   self.total_resources, conn=None,
+                                   pid=os.getpid())
+        self.nodes: dict[bytes, NodeState] = {self.head_node_id: self.head_node}
+        self._node_order: list[bytes] = [self.head_node_id]
+        self.cluster_addr: str | None = None
+        self._cluster_srv = None
+        self._spread_idx = 0
+        # (dest_nid, oid) -> {"cbs": [done cbs], "src": src_nid,
+        #                     "attempt": n} — attempt correlates completions
+        # to the live attempt so a stale failure from an aborted attempt
+        # can't kill a retried fetch.
+        self._fetches: dict[tuple, dict] = {}
+        self._fetch_attempts = 0
+
         self.workers: dict[bytes, WorkerHandle] = {}
-        self.idle: collections.deque[WorkerHandle] = collections.deque()
         self.task_queue: collections.deque[TaskSpec] = collections.deque()
         self.waiting_deps: dict[bytes, list] = {}  # oid -> [pending items]
         self.actors: dict[bytes, ActorState] = {}
         self.named_actors: dict[str, bytes] = {}
         self.fn_table: dict[bytes, bytes] = {}  # fn_id -> blob
         self.remote_subs: dict[bytes, list[bytes]] = {}  # oid -> [worker ids]
-        self.pending_actor_assign: collections.deque[bytes] = collections.deque()
         self.actors_waiting_resources: collections.deque[bytes] = collections.deque()
         self._shutdown = False
         self.kv: dict[tuple, bytes] = {}  # internal KV (parity: gcs_kv_manager.h)
@@ -404,47 +561,17 @@ class Runtime:
     # ---------------- worker pool ----------------
 
     def _worker_env(self) -> dict:
-        env = dict(os.environ)
-        env.update(self.config.to_env())
-        env.setdefault("PYTHONPATH", "")
-        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-        env["PYTHONPATH"] = repo_root + os.pathsep + env["PYTHONPATH"]
-        return env
+        return build_worker_env(self.config, self.head_node_id.hex())
 
     def _spawn_worker(self) -> WorkerHandle:
         if self._shutdown:
             return None
-        import socket as socket_mod
         worker_id = WorkerID.from_random()
-        log_path = os.path.join(self.session_dir, "logs",
-                                f"worker-{worker_id.hex()[:8]}.out")
-        # Fast path: fork from the warm zygote. Fallback: cold exec — on a
-        # FRESH socketpair, since a zygote that died mid-spawn may have forked
-        # a child that already holds the first pair's worker end.
-        parent = child = proc = None
-        if self._zygote is not None:
-            parent, child = socket_mod.socketpair(
-                socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
-            pid = self._zygote.spawn(worker_id.hex(), child, log_path)
-            if pid:
-                proc = _ForkedProc(pid, self._zygote)
-            else:
-                parent.close()
-                child.close()
-                parent = child = None
-        if proc is None:
-            parent, child = socket_mod.socketpair(
-                socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
-            # Workers see only logical TPU slots via env; the mesh layer
-            # assigns chips.
-            proc = subprocess.Popen(
-                [sys.executable, "-m", "ray_tpu.core.worker",
-                 self.store_path, worker_id.hex(), str(child.fileno())],
-                pass_fds=[child.fileno()], env=self._worker_env(),
-                close_fds=True, stdout=open(log_path, "ab"),
-                stderr=subprocess.STDOUT)
-        child.close()
-        handle = WorkerHandle(worker_id, parent, proc)
+        parent, proc = spawn_worker_process(
+            worker_id, self.store_path, self._worker_env(), self._zygote,
+            self.session_dir)
+        handle = WorkerHandle(worker_id, parent, proc,
+                              node_id=self.head_node_id)
         with self.lock:
             if self._shutdown:
                 # Raced with shutdown(): it won't see this handle, so clean
@@ -453,6 +580,7 @@ class Runtime:
                 parent.close()
                 return None
             self.workers[worker_id.binary()] = handle
+            self.head_node.workers[worker_id.binary()] = handle
         with self._sel_lock:
             self._selector.register(parent, selectors.EVENT_READ, handle)
         return handle
@@ -460,7 +588,8 @@ class Runtime:
     def _replenish_pool_async(self):
         def run():
             with self.lock:
-                n_pool = sum(1 for w in self.workers.values()
+                # Head-pool only: remote workers are the agents' business.
+                n_pool = sum(1 for w in self.head_node.workers.values()
                              if w.state in (IDLE, BUSY))
                 need = self.pool_size - n_pool
             for _ in range(max(0, need)):
@@ -477,13 +606,35 @@ class Runtime:
                 except OSError:
                     continue
             for key, _mask in events:
-                handle: WorkerHandle = key.data
+                handle = key.data
+                if handle.kind == "accept":
+                    try:
+                        conn_sock, _addr = key.fileobj.accept()
+                    except OSError:
+                        continue
+                    conn_sock.setblocking(True)
+                    nc = NodeConn(conn_sock)
+                    with self._sel_lock:
+                        self._selector.register(
+                            conn_sock, selectors.EVENT_READ, nc)
+                    continue
                 try:
                     data = key.fileobj.recv(1 << 20)
                 except (BlockingIOError, InterruptedError):
                     continue
                 except OSError:
                     data = b""
+                if handle.kind == "node":
+                    if not data:
+                        self._on_node_conn_closed(handle)
+                        continue
+                    handle.buffer.feed(data)
+                    for msg in handle.buffer.frames():
+                        try:
+                            self._handle_node_msg(handle, msg)
+                        except Exception:
+                            traceback.print_exc()
+                    continue
                 if not data:
                     self._on_worker_death(handle)
                     continue
@@ -492,7 +643,6 @@ class Runtime:
                     try:
                         self._handle_msg(handle, msg)
                     except Exception:
-                        import traceback
                         traceback.print_exc()
 
     def _handle_msg(self, w: WorkerHandle, msg):
@@ -502,12 +652,16 @@ class Runtime:
         elif op == "ready":
             w.connected.set()
             with self.lock:
-                if self.pending_actor_assign:
-                    aid = self.pending_actor_assign.popleft()
+                if w.state == DEAD:
+                    return
+                node = self.nodes.get(w.node_id)
+                if node is not None and node.pending_actor_assign:
+                    aid = node.pending_actor_assign.popleft()
                     self._assign_actor_locked(self.actors[aid], w)
                     return
                 w.state = IDLE
-                self.idle.append(w)
+                if node is not None:
+                    node.idle.append(w)
             self._schedule()
         elif op == "wait_obj":
             oid = msg[1]
@@ -518,7 +672,7 @@ class Runtime:
 
             self.directory.on_ready(oid, push)
         elif op == "put_notify":
-            self.directory.put(msg[1], ("shm",))
+            self.directory.add_location(msg[1], w.node_id)
             self._on_object_ready(msg[1])
         elif op == "submit":
             spec: TaskSpec = msg[1]
@@ -582,8 +736,9 @@ class Runtime:
         elif what == "cluster_resources":
             resp = dict(self.total_resources)
         elif what == "available_resources":
-            with self.lock:
-                resp = dict(self.available)
+            resp = self.available_resources()
+        elif what == "nodes":
+            resp = self.nodes_table()
         else:
             resp = RayTpuError(f"unknown request {what}")
         w.send(("resp", req_id, resp))
@@ -603,7 +758,328 @@ class Runtime:
             payload, bufs, _ = serialization.serialize_value(entry[1])
             w.send(("obj", oid, "err", payload, bufs))
         else:
-            w.send(("obj", oid, "shm", None, None))
+            locs = entry[1] if len(entry) > 1 else {self.head_node_id}
+            if w.node_id in locs:
+                w.send(("obj", oid, "shm", None, None))
+                return
+            node = self.nodes.get(w.node_id)
+            if node is None:
+                return
+
+            def done(ok, err, wid=wid, oid=oid, nid=w.node_id):
+                if ok:
+                    self._push_obj_to_worker(wid, oid, ("shm", {nid}))
+                else:
+                    w2 = self.workers.get(wid)
+                    if w2 is not None and w2.state != DEAD:
+                        from ray_tpu.core.status import ObjectLostError
+                        payload, bufs, _ = serialization.serialize_value(
+                            err or ObjectLostError(ObjectID(oid)))
+                        w2.send(("obj", oid, "err", payload, bufs))
+
+            self._fetch_to_node(node, oid, done)
+
+    # ---------------- cluster plane (multi-node) ----------------
+    #
+    # Parity map: enable_cluster ≈ the GCS server socket
+    # (gcs_server_main.cc:50); node agents ≈ raylets registering over gRPC;
+    # the heartbeat monitor ≈ GcsHealthCheckManager
+    # (gcs_health_check_manager.h:45); cross-node object movement ≈
+    # PullManager/PushManager chunked transfer (pull_manager.h:57,
+    # push_manager.h:32), carried here as whole-blob frames between
+    # node-local shm stores.
+
+    def enable_cluster(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        """Open the head's TCP endpoint for node agents; returns addr."""
+        with self.lock:
+            if self.cluster_addr:
+                return self.cluster_addr
+            srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            srv.bind((host, port or self.config.gcs_port))
+            srv.listen(128)
+            srv.setblocking(False)
+            self._cluster_srv = srv
+            self.cluster_addr = f"{host}:{srv.getsockname()[1]}"
+        with self._sel_lock:
+            self._selector.register(srv, selectors.EVENT_READ, _Acceptor())
+        threading.Thread(target=self._health_loop, daemon=True,
+                         name="rtpu-node-health").start()
+        return self.cluster_addr
+
+    def _health_loop(self):
+        period = self.config.health_check_period_ms / 1000.0
+        deadline = period * self.config.health_check_failure_threshold
+        while not self._shutdown:
+            time.sleep(period)
+            now = time.monotonic()
+            for node in list(self.nodes.values()):
+                if (node.conn is not None and node.state == "ALIVE"
+                        and now - node.last_heartbeat > deadline):
+                    self._on_node_death(node)
+
+    def _handle_node_msg(self, conn: NodeConn, msg):
+        op = msg[0]
+        if op == "wmsg":
+            _, wid, inner = msg
+            w = self.workers.get(wid)
+            if w is None:
+                if conn.node_id is None:
+                    return  # agent never registered
+                w = RemoteWorkerHandle(WorkerID(wid), conn, conn.node_id)
+                with self.lock:
+                    self.workers[wid] = w
+                    node = self.nodes.get(conn.node_id)
+                    if node is not None:
+                        node.workers[wid] = w
+            self._handle_msg(w, inner)
+        elif op == "register_node":
+            _, nid, resources, peer_addr, hostname, pid = msg
+            node = NodeState(nid, resources, conn=conn, peer_addr=peer_addr,
+                             hostname=hostname, pid=pid)
+            conn.node_id = nid
+            with self.lock:
+                self.nodes[nid] = node
+                self._node_order.append(nid)
+                for k, v in resources.items():
+                    self.total_resources[k] = (
+                        self.total_resources.get(k, 0.0) + v)
+                # New capacity may unblock queued PGs/actors.
+                self._kick_waiters()
+            conn.send(("node_ack", self.head_node_id))
+            self._schedule()
+        elif op == "heartbeat":
+            node = self.nodes.get(conn.node_id)
+            if node is not None:
+                node.last_heartbeat = time.monotonic()
+        elif op == "worker_death":
+            w = self.workers.get(msg[1])
+            if w is not None:
+                self._on_worker_death(w)
+        elif op == "fetched":
+            _, oid, ok, attempt = msg
+            nid = conn.node_id
+            err = None
+            if ok:
+                self.directory.add_location(oid, nid)
+            else:
+                from ray_tpu.core.status import ObjectLostError
+                err = ObjectLostError(ObjectID(oid))
+            self._finish_fetch((nid, oid), ok, err, attempt=attempt)
+        elif op == "obj_req":
+            # A peer agent pulling an object whose source is the head store.
+            threading.Thread(target=self._serve_obj_req,
+                             args=(conn, msg[1]), daemon=True).start()
+        else:
+            raise RayTpuError(f"head: unknown node message {op}")
+
+    def _serve_obj_req(self, conn: NodeConn, oid: bytes):
+        from ray_tpu.core import objxfer
+        try:
+            objxfer.send_blob(self.store, conn.send, oid)
+        except OSError:
+            pass
+
+    def _fetch_to_node(self, dest: NodeState, oid: bytes, done_cb):
+        """Materialize `oid` in `dest`'s store; done_cb(ok, err) when done.
+        Non-blocking; safe to call from the listener thread."""
+        with self.lock:
+            key = (dest.node_id, oid)
+            info = self._fetches.get(key)
+            if info is not None:
+                info["cbs"].append(done_cb)
+                return
+            self._fetch_attempts += 1
+            info = {"cbs": [done_cb], "src": None,
+                    "attempt": self._fetch_attempts}
+            self._fetches[key] = info
+        entry = self.directory.lookup(oid)
+        from ray_tpu.core.status import ObjectLostError
+        if entry is None or entry[0] != "shm":
+            self._finish_fetch(key, False, ObjectLostError(ObjectID(oid)))
+            return
+        locs = entry[1] if len(entry) > 1 else {self.head_node_id}
+        srcs = [n for nid in locs
+                if (n := self.nodes.get(nid)) is not None
+                and n.state == "ALIVE"]
+        if not srcs:
+            self._finish_fetch(key, False, ObjectLostError(ObjectID(oid)))
+            return
+        src = srcs[0]
+        info["src"] = src.node_id
+        try:
+            if dest.conn is None:
+                # Head-bound pull rides the source's dedicated peer port (a
+                # per-pull connection), NOT the agent's control link — a big
+                # blob on the control link would head-of-line-block every
+                # worker message relay on that node.
+                threading.Thread(target=self._pull_via_peer,
+                                 args=(src, oid, info["attempt"]),
+                                 daemon=True).start()
+            else:
+                if src.conn is not None:
+                    src_addr = tuple(src.peer_addr)
+                else:
+                    host, p = self.cluster_addr.rsplit(":", 1)
+                    src_addr = (host, int(p))
+                dest.conn.send(("fetch", oid, src_addr, info["attempt"]))
+        except OSError as e:
+            self._finish_fetch(key, False, e)
+
+    def _pull_via_peer(self, src: NodeState, oid: bytes, attempt=None):
+        """Worker thread: pull one object from src's peer port to the head
+        store (parity: PullManager issuing a chunked pull)."""
+        from ray_tpu.core import objxfer
+        from ray_tpu.core.status import ObjectLostError
+        key = (self.head_node_id, oid)
+        ok, err = False, None
+        try:
+            if objxfer.fetch_from_peer(self.store, src.peer_addr, oid):
+                self.directory.add_location(oid, self.head_node_id)
+                ok = True
+            else:
+                err = ObjectLostError(ObjectID(oid))
+        except Exception as e:  # noqa: BLE001 — conn reset, store full, ...
+            err = e
+        self._finish_fetch(key, ok, err, attempt=attempt)
+
+    def _finish_fetch(self, key, ok: bool, err=None, attempt=None):
+        with self.lock:
+            info = self._fetches.get(key)
+            if info is None:
+                return
+            if attempt is not None and info.get("attempt") != attempt:
+                return  # stale completion from a superseded attempt
+            self._fetches.pop(key, None)
+        for cb in (info["cbs"] if info else []):
+            try:
+                cb(ok, err)
+            except Exception:  # noqa: BLE001
+                traceback.print_exc()
+
+    def _pull_to_head(self, oid: bytes, timeout: float | None = None):
+        """Blocking: fetch a remote object into the head store (driver get).
+        Honors the caller's get() timeout (None = wait for the transfer —
+        fetch *failures* still resolve promptly via node-death callbacks).
+        Must NOT run on the listener thread (see as_future)."""
+        ev = threading.Event()
+        box = []
+
+        def done(ok, err):
+            box.append((ok, err))
+            ev.set()
+
+        self._fetch_to_node(self.head_node, oid, done)
+        if not ev.wait(timeout):
+            # Abandon only THIS caller: the transfer (and any co-waiters)
+            # stay live; popping the whole record would fail them spuriously.
+            with self.lock:
+                info = self._fetches.get((self.head_node_id, oid))
+                if info is not None:
+                    try:
+                        info["cbs"].remove(done)
+                    except ValueError:
+                        pass
+            raise GetTimeoutError(
+                f"timed out pulling object {oid.hex()[:16]} to the head")
+        ok, err = box[0]
+        if not ok:
+            from ray_tpu.core.status import ObjectLostError
+            raise err if isinstance(err, Exception) else ObjectLostError(
+                ObjectID(oid))
+
+    def _on_node_conn_closed(self, conn: NodeConn):
+        with self._sel_lock:
+            try:
+                self._selector.unregister(conn.sock)
+            except (KeyError, ValueError):
+                pass
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+        if conn.node_id is not None:
+            node = self.nodes.get(conn.node_id)
+            if node is not None:
+                self._on_node_death(node)
+
+    def _on_node_death(self, node: NodeState):
+        """Node failure: fail/retry its tasks, restart its actors elsewhere,
+        scrub its object locations (parity: GCS node-death publish +
+        owner-side recovery, gcs_health_check_manager.h:45)."""
+        with self.lock:
+            if node.state == "DEAD":
+                return
+            node.state = "DEAD"
+            for k, v in node.total.items():
+                self.total_resources[k] = max(
+                    0.0, self.total_resources.get(k, 0.0) - v)
+            orphaned_assigns = list(node.pending_actor_assign)
+            node.pending_actor_assign.clear()
+        for w in list(node.workers.values()):
+            self._on_worker_death(w)
+        # Actors queued for assignment on this node never get a worker now:
+        # release their dead-node reservation and re-place them.
+        for aid in orphaned_assigns:
+            st = self.actors.get(aid)
+            if st is None or st.state == A_DEAD:
+                continue
+            with self.lock:
+                if st.resources_reserved:
+                    self._release_token(st.resources_reserved)
+                    st.resources_reserved = None
+            threading.Thread(target=self._create_actor_now,
+                             args=(st.cspec,), daemon=True).start()
+        # Scrub object locations; sole-copy objects are lost.
+        from ray_tpu.core.status import ObjectLostError
+        lost = []
+        with self.directory.lock:
+            for oid, e in self.directory.entries.items():
+                if e[0] == "shm" and len(e) > 1 and node.node_id in e[1]:
+                    e[1].discard(node.node_id)
+                    if not e[1]:
+                        lost.append(oid)
+        for oid in lost:
+            self.directory.put(oid, ("err", ObjectLostError(ObjectID(oid))))
+            self._on_object_ready(oid)
+        # In-flight fetches: dest died -> fail them; source died -> retry
+        # from a surviving replica (directory is already scrubbed).
+        with self.lock:
+            stale_dest = [k for k in self._fetches if k[0] == node.node_id]
+            stale_src = [k for k, info in self._fetches.items()
+                         if info.get("src") == node.node_id
+                         and k[0] != node.node_id]
+        for key in stale_dest:
+            self._finish_fetch(key, False, ObjectLostError(ObjectID(key[1])))
+        for key in stale_src:
+            with self.lock:
+                info = self._fetches.pop(key, None)
+            if info is None:
+                continue
+            dest = self.nodes.get(key[0])
+            if dest is None or dest.state != "ALIVE":
+                for cb in info["cbs"]:
+                    cb(False, ObjectLostError(ObjectID(key[1])))
+                continue
+            for cb in info["cbs"]:
+                self._fetch_to_node(dest, key[1], cb)
+        self._schedule()
+
+    def nodes_table(self) -> list[dict]:
+        out = []
+        for nid in list(self._node_order):
+            node = self.nodes.get(nid)
+            if node is None:
+                continue
+            out.append({
+                "node_id": nid.hex(),
+                "alive": node.state == "ALIVE",
+                "is_head": node.conn is None,
+                "hostname": node.hostname,
+                "resources": dict(node.total),
+                "available": dict(node.available),
+            })
+        return out
 
     # ---------------- object plane ----------------
 
@@ -611,7 +1087,7 @@ class Runtime:
         from ray_tpu.core.object_ref import ObjectRef
         oid = ObjectID.from_random()
         self.store.put_serialized(oid, value)
-        self.directory.put(oid.binary(), ("shm",))
+        self.directory.put(oid.binary(), ("shm", {self.head_node_id}))
         return ObjectRef(oid)
 
     def get(self, refs, timeout=None):
@@ -627,6 +1103,7 @@ class Runtime:
         return out[0] if single else out
 
     def _get_one(self, ref, timeout=None):
+        deadline = None if timeout is None else time.monotonic() + timeout
         entry = self.directory.lookup(ref.id.binary())
         if entry is None:
             ev = threading.Event()
@@ -640,9 +1117,11 @@ class Runtime:
             if not ev.wait(timeout):
                 raise GetTimeoutError(f"get() timed out on {ref}")
             entry = box[0]
-        return self._entry_value(ref, entry)
+        remain = (None if deadline is None
+                  else max(1e-3, deadline - time.monotonic()))
+        return self._entry_value(ref, entry, timeout=remain)
 
-    def _entry_value(self, ref, entry):
+    def _entry_value(self, ref, entry, timeout=None):
         kind = entry[0]
         if kind == "raw":
             value = serialization.deserialize(entry[1], entry[2])
@@ -657,6 +1136,9 @@ class Runtime:
             if isinstance(e, TaskError) and e.cause is not None:
                 raise e.cause
             raise e
+        locs = entry[1] if len(entry) > 1 else {self.head_node_id}
+        if self.head_node_id not in locs:
+            self._pull_to_head(ref.id.binary(), timeout=timeout)
         found, value = self.store.get_deserialized(ref.id, timeout=5.0)
         if not found:
             from ray_tpu.core.status import ObjectLostError
@@ -694,17 +1176,37 @@ class Runtime:
         fut: concurrent.futures.Future = concurrent.futures.Future()
 
         def cb(entry):
-            try:
-                fut.set_result(self._entry_value(ref, entry))
-            except BaseException as e:  # noqa: BLE001
-                fut.set_exception(e)
+            def resolve():
+                try:
+                    fut.set_result(self._entry_value(ref, entry))
+                except BaseException as e:  # noqa: BLE001
+                    fut.set_exception(e)
+
+            # A remote-only shm entry makes _entry_value block in
+            # _pull_to_head; the ready-callback may be running on the
+            # listener thread, which must stay free to process the pull's
+            # completion — hand the blocking resolve to a thread.
+            if (entry[0] == "shm" and len(entry) > 1
+                    and self.head_node_id not in entry[1]):
+                threading.Thread(target=resolve, daemon=True).start()
+            else:
+                resolve()
 
         self.directory.on_ready(ref.id.binary(), cb)
         return fut
 
     def _free_object(self, oid: bytes):
+        entry = self.directory.lookup(oid)
         self.directory.discard(oid)
         self.store.delete(ObjectID(oid))
+        if entry is not None and entry[0] == "shm" and len(entry) > 1:
+            for nid in entry[1]:
+                n = self.nodes.get(nid)
+                if n is not None and n.conn is not None:
+                    try:
+                        n.conn.send(("free_obj", oid))
+                    except OSError:
+                        pass
 
     def _on_object_ready(self, oid: bytes):
         """Unblock tasks waiting on this dependency + remote subscribers."""
@@ -786,12 +1288,72 @@ class Runtime:
             req["TPU"] = req.get("TPU", 0.0) + spec.num_tpus
         return req
 
-    def _try_reserve(self, req: dict[str, float]) -> bool:
+    @staticmethod
+    def _fits(avail: dict[str, float], req: dict[str, float]) -> bool:
+        return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in req.items())
+
+    def _alive_nodes(self) -> list[NodeState]:
+        return [self.nodes[nid] for nid in self._node_order
+                if self.nodes[nid].state == "ALIVE"]
+
+    def _pick_node(self, strategy, req: dict[str, float],
+                   deps=None) -> NodeState | None:
+        """Scheduling policy (parity: policy/hybrid_scheduling_policy.h:50,
+        spread_scheduling_policy.h:27, node-affinity). Hybrid order: data
+        locality (most deps already node-local) > head-local > most
+        available CPU. Raises for a hard affinity to a dead node."""
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy)
+        if isinstance(strategy, NodeAffinitySchedulingStrategy):
+            nid = strategy.node_id
+            if isinstance(nid, str):
+                nid = bytes.fromhex(nid)
+            node = self.nodes.get(nid)
+            if node is not None and node.state == "ALIVE":
+                if self._fits(node.available, req):
+                    return node
+                if not self._fits(node.total, req) and not strategy.soft:
+                    raise ResourceError(
+                        f"request {req} exceeds the pinned node's total "
+                        f"{node.total} (hard NodeAffinity)")
+                if not strategy.soft:
+                    return None  # wait for capacity on the pinned node
+            elif not strategy.soft:
+                raise ResourceError(
+                    f"node {strategy.node_id} is dead or unknown "
+                    f"(hard NodeAffinity)")
+            # soft affinity to a dead node: fall through to hybrid
+        candidates = [n for n in self._alive_nodes()
+                      if self._fits(n.available, req)]
+        if not candidates:
+            return None
+        if strategy == "SPREAD":
+            self._spread_idx += 1
+            return candidates[self._spread_idx % len(candidates)]
+        if deps:
+            def local_deps(n):
+                c = 0
+                for oid in deps:
+                    e = self.directory.lookup(oid)
+                    if (e is not None and e[0] == "shm" and len(e) > 1
+                            and n.node_id in e[1]):
+                        c += 1
+                return c
+            return max(candidates, key=lambda n: (
+                local_deps(n), n.node_id == self.head_node_id,
+                n.available.get("CPU", 0.0)))
+        for n in candidates:
+            if n.node_id == self.head_node_id:
+                return n
+        return max(candidates, key=lambda n: n.available.get("CPU", 0.0))
+
+    def _try_reserve_on(self, node: NodeState, req: dict[str, float]) -> bool:
+        if node is None or node.state != "ALIVE":
+            return False
+        if not self._fits(node.available, req):
+            return False
         for k, v in req.items():
-            if self.available.get(k, 0.0) + 1e-9 < v:
-                return False
-        for k, v in req.items():
-            self.available[k] -= v
+            node.available[k] = node.available.get(k, 0.0) - v
         return True
 
     @staticmethod
@@ -838,23 +1400,38 @@ class Runtime:
                 return ("pg", pg_id, i, req)
         return None
 
-    def _try_reserve_strategy(self, strategy, req: dict[str, float]):
-        """Reserve `req` per a scheduling strategy (global pool or PG bundle).
-        Returns a release token, None to retry later, or raises."""
+    def _reserve_placement(self, strategy, req: dict[str, float], deps=None):
+        """Reserve `req` per a scheduling strategy. Returns (node, token),
+        None to retry later, or raises when never satisfiable. Caller must
+        hold the runtime lock."""
         pg_id, bidx = self._pg_of(strategy)
         if pg_id is None:
-            return ("global", req) if self._try_reserve(req) else None
-        return self._try_reserve_pg(pg_id, bidx, req)
-
-    def _try_reserve_spec(self, spec: TaskSpec):
-        return self._try_reserve_strategy(
-            spec.scheduling_strategy, self._resources_of(spec))
+            node = self._pick_node(strategy, req, deps)
+            if node is None:
+                return None
+            for k, v in req.items():
+                node.available[k] = node.available.get(k, 0.0) - v
+            return node, ("node", node.node_id, req)
+        token = self._try_reserve_pg(pg_id, bidx, req)
+        if token is None:
+            return None
+        st = self.placement_groups[pg_id]
+        node = self.nodes.get(st.bundle_nodes[token[2]])
+        if node is None or node.state != "ALIVE":
+            # The bundle's node died; PG rescheduling is not yet implemented,
+            # so surface the loss instead of dispatching into the void.
+            self._release_token(token)
+            raise ResourceError(
+                f"placement group {pg_id.hex()[:12]} bundle {token[2]} was "
+                f"on a dead node")
+        return node, token
 
     def _release_token(self, token):
         if not token:
             return
-        if token[0] == "global":
-            self._release(token[1])
+        if token[0] == "node":
+            _, nid, req = token
+            self._release_on(nid, req)
             return
         _, pg_id, i, req = token
         st = self.placement_groups.get(pg_id)
@@ -863,15 +1440,22 @@ class Runtime:
             for k, v in req.items():
                 b[k] = b.get(k, 0.0) + v
             # Freed bundle capacity may unblock queued PG tasks/actors.
-            self._release({})
+            self._kick_waiters()
         else:
-            # PG gone: its carve-out returns to the global pool piecewise as
+            # PG gone: its carve-out returns to the hosting node piecewise as
             # consumers finish.
-            self._release(req)
+            nid = (st.bundle_nodes[i] if st is not None and st.bundle_nodes
+                   else self.head_node_id)
+            self._release_on(nid, req)
 
-    def _release(self, req: dict[str, float]):
-        for k, v in req.items():
-            self.available[k] = self.available.get(k, 0.0) + v
+    def _release_on(self, node_id: bytes, req: dict[str, float]):
+        node = self.nodes.get(node_id)
+        if node is not None and node.state == "ALIVE":
+            for k, v in req.items():
+                node.available[k] = node.available.get(k, 0.0) + v
+        self._kick_waiters()
+
+    def _kick_waiters(self):
         # Freed capacity may unblock queued placement groups — they reserve
         # whole bundles atomically, so retry them first (FIFO).
         created_pgs = []
@@ -925,11 +1509,22 @@ class Runtime:
         created = False
         with self.lock:
             self.placement_groups[pg_id] = st
-            total = _sum_bundles(bundles)
-            infeasible = any(self.total_resources.get(k, 0.0) < v
-                             for k, v in total.items())
-            if strategy == "STRICT_SPREAD" and len(bundles) > 1:
+            alive = self._alive_nodes()
+            infeasible = any(
+                not any(self._fits(n.total, b) for n in alive)
+                for b in bundles)
+            if strategy == "STRICT_SPREAD" and len(bundles) > len(alive):
                 infeasible = True
+            if strategy == "STRICT_PACK":
+                # All bundles must fit ONE node together.
+                total = _sum_bundles(bundles)
+                if not any(self._fits(n.total, total) for n in alive):
+                    infeasible = True
+            if infeasible and self.cluster_addr is not None:
+                # Multi-node mode: nodes may still join (add_node/autoscaler
+                # race) — stay PENDING like the reference instead of failing
+                # against a point-in-time node snapshot.
+                infeasible = False
             if infeasible:
                 st.state = "INFEASIBLE"
             else:
@@ -945,13 +1540,82 @@ class Runtime:
             self._on_object_ready(st.ready_oid)
         return st.ready_oid
 
-    def _try_create_pg_locked(self, st: PlacementGroupState) -> bool:
-        total = _sum_bundles(st.bundles)
-        for k, v in total.items():
-            if self.available.get(k, 0.0) + 1e-9 < v:
+    def _place_bundles(self, bundles, strategy: str) -> list[bytes] | None:
+        """Map bundles onto alive nodes per the PG strategy against current
+        availability (parity: bundle_scheduling_policy.h:31-106; 2PC
+        collapses to one atomic assignment under the head lock).
+        ICI_CONTIGUOUS places bundles on a topologically contiguous run of
+        TPU nodes (registration order ~ ICI ring order)."""
+        alive = self._alive_nodes()
+        avail = {n.node_id: dict(n.available) for n in alive}
+
+        def take(nid, b):
+            a = avail[nid]
+            if not self._fits(a, b):
                 return False
-        for k, v in total.items():
-            self.available[k] -= v
+            for k, v in b.items():
+                a[k] = a.get(k, 0.0) - v
+            return True
+
+        def pack_on_one():
+            for n in alive:
+                saved = dict(avail[n.node_id])
+                if all(take(n.node_id, b) for b in bundles):
+                    return [n.node_id] * len(bundles)
+                avail[n.node_id] = saved
+            return None
+
+        if strategy in ("PACK", "STRICT_PACK"):
+            assign = pack_on_one()
+            if assign is not None or strategy == "STRICT_PACK":
+                return assign
+            # PACK fallback: greedy first-fit across nodes.
+            assign = []
+            for b in bundles:
+                nid = next((n.node_id for n in alive if take(n.node_id, b)),
+                           None)
+                if nid is None:
+                    return None
+                assign.append(nid)
+            return assign
+        if strategy in ("SPREAD", "STRICT_SPREAD"):
+            assign, used = [], set()
+            for b in bundles:
+                fresh = [n for n in alive if n.node_id not in used]
+                pool = fresh if strategy == "STRICT_SPREAD" else (
+                    fresh + [n for n in alive if n.node_id in used])
+                nid = next((n.node_id for n in pool if take(n.node_id, b)),
+                           None)
+                if nid is None:
+                    return None
+                used.add(nid)
+                assign.append(nid)
+            return assign
+        if strategy == "ICI_CONTIGUOUS":
+            tpu_nodes = [n for n in alive if n.total.get("TPU", 0.0) > 0] or alive
+            one = pack_on_one()
+            if one is not None:
+                return one
+            # Sliding window of distinct consecutive TPU nodes.
+            k = len(bundles)
+            for s in range(len(tpu_nodes) - k + 1):
+                win = tpu_nodes[s:s + k]
+                saved = {n.node_id: dict(avail[n.node_id]) for n in win}
+                if all(take(n.node_id, b) for n, b in zip(win, bundles)):
+                    return [n.node_id for n in win]
+                avail.update(saved)
+            return None
+        return pack_on_one()
+
+    def _try_create_pg_locked(self, st: PlacementGroupState) -> bool:
+        assign = self._place_bundles(st.bundles, st.strategy)
+        if assign is None:
+            return False
+        for i, nid in enumerate(assign):
+            na = self.nodes[nid].available
+            for k, v in st.bundles[i].items():
+                na[k] = na.get(k, 0.0) - v
+        st.bundle_nodes = assign
         st.state = "CREATED"
         st.bundle_avail = [dict(b) for b in st.bundles]
         return True
@@ -960,7 +1624,7 @@ class Runtime:
         self.directory.put(st.ready_oid, ("inline", True))
         self._on_object_ready(st.ready_oid)
         with self.lock:
-            self._release({})  # kick waiting actors/tasks gated on this PG
+            self._kick_waiters()  # kick waiting actors/tasks gated on this PG
 
     def remove_placement_group(self, pg_id: bytes):
         with self.lock:
@@ -971,9 +1635,12 @@ class Runtime:
             if was == "CREATED":
                 # Return the unconsumed remainder now; amounts held by
                 # running tasks/actors flow back via _release_token.
-                for b in st.bundle_avail:
+                for i, b in enumerate(st.bundle_avail):
+                    node = self.nodes.get(st.bundle_nodes[i])
+                    if node is None or node.state != "ALIVE":
+                        continue
                     for k, v in b.items():
-                        self.available[k] = self.available.get(k, 0.0) + v
+                        node.available[k] = node.available.get(k, 0.0) + v
             try:
                 self.pgs_waiting.remove(pg_id)
             except ValueError:
@@ -990,7 +1657,7 @@ class Runtime:
             "placement group was removed")))
         self._on_object_ready(st.ready_oid)
         with self.lock:
-            self._release({})
+            self._kick_waiters()
         self._schedule()
 
     def placement_group_table(self) -> dict:
@@ -1006,11 +1673,19 @@ class Runtime:
             }
 
     def _check_feasible(self, req: dict[str, float], what: str):
+        """A request must fit on some single node's total (not the cluster
+        sum — a 8-CPU task cannot run on two 4-CPU nodes). Fail-fast only in
+        single-node mode: with clustering on, a bigger node may register any
+        moment and _kick_waiters will place the queued work."""
+        if self.cluster_addr is not None:
+            return
         for k, v in req.items():
-            if self.total_resources.get(k, 0.0) < v:
+            best = max((n.total.get(k, 0.0) for n in self._alive_nodes()),
+                       default=0.0)
+            if best < v:
                 raise ResourceError(
-                    f"{what} requires {{{k}: {v}}} but the cluster total is "
-                    f"{{{k}: {self.total_resources.get(k, 0.0)}}}")
+                    f"{what} requires {{{k}: {v}}} but the largest node has "
+                    f"{{{k}: {best}}}")
 
     def _schedule(self):
         """Dispatch every feasible queued task to an idle worker."""
@@ -1020,19 +1695,28 @@ class Runtime:
             remaining = collections.deque()
             while self.task_queue:
                 spec = self.task_queue.popleft()
-                if not self.idle:
-                    remaining.append(spec)
-                    break
                 try:
-                    token = self._try_reserve_spec(spec)
+                    res = self._reserve_placement(
+                        spec.scheduling_strategy, self._resources_of(spec),
+                        spec.dependencies)
                 except RayTpuError as e:
                     failures.append((spec, e))
                     continue
-                if token is None:
+                if res is None:
                     remaining.append(spec)
                     continue
+                node, token = res
+                if not node.idle:
+                    # Resources fit but no free worker on that node: roll
+                    # back, ask the node for another worker, keep scanning.
+                    # Quiet revert — no _kick_waiters churn: the reservation
+                    # was taken microseconds ago, nothing new was freed.
+                    self._rollback_token_locked(token)
+                    remaining.append(spec)
+                    self._request_worker_locked(node)
+                    continue
                 self._reservations[spec.task_id] = token
-                w = self.idle.popleft()
+                w = node.idle.popleft()
                 w.state = BUSY
                 w.current_task = spec
                 dispatches.append((w, spec))
@@ -1042,6 +1726,45 @@ class Runtime:
             self._fail_returns(spec, e)
         for w, spec in dispatches:
             self._dispatch(w, spec)
+
+    def _rollback_token_locked(self, token):
+        """Undo a just-taken reservation without waking PG/actor waiters."""
+        if not token:
+            return
+        if token[0] == "node":
+            node = self.nodes.get(token[1])
+            if node is not None and node.state == "ALIVE":
+                for k, v in token[2].items():
+                    node.available[k] = node.available.get(k, 0.0) + v
+            return
+        _, pg_id, i, req = token
+        st = self.placement_groups.get(pg_id)
+        if st is not None and st.state == "CREATED":
+            b = st.bundle_avail[i]
+            for k, v in req.items():
+                b[k] = b.get(k, 0.0) + v
+        else:
+            self._rollback_token_locked(
+                ("node",
+                 st.bundle_nodes[i] if st is not None and st.bundle_nodes
+                 else self.head_node_id, req))
+
+    def _request_worker_locked(self, node: NodeState):
+        """Grow a node's worker pool on demand (rate-limited)."""
+        now = time.monotonic()
+        if now - node.last_spawn_req < 0.5:
+            return
+        node.last_spawn_req = now
+        if node.conn is None:
+            alive = sum(1 for w in node.workers.values() if w.state != DEAD)
+            if alive < self.pool_size * 2 + 8:
+                threading.Thread(target=self._spawn_worker,
+                                 daemon=True).start()
+        else:
+            try:
+                node.conn.send(("spawn_worker",))
+            except OSError:
+                pass
 
     def _dispatch(self, w: WorkerHandle, spec: TaskSpec):
         self.task_events.record(spec.task_id, spec.describe(), "RUNNING")
@@ -1053,8 +1776,11 @@ class Runtime:
                 with self.lock:  # return the reserved worker + resources
                     self._release_token(self._reservations.pop(spec.task_id, None))
                     w.current_task = None
-                    w.state = IDLE
-                    self.idle.append(w)
+                    if w.state != DEAD:
+                        w.state = IDLE
+                        node = self.nodes.get(w.node_id)
+                        if node is not None:
+                            node.idle.append(w)
                 return
             w.send(("reg_fn", spec.fn_id, blob))
             w.registered_fns.add(spec.fn_id)
@@ -1071,7 +1797,7 @@ class Runtime:
             elif status == "err":
                 self.directory.put(rid, ("raw", payload, bufs, False))
             else:
-                self.directory.put(rid, ("shm",))
+                self.directory.add_location(rid, w.node_id)
             self._on_object_ready(rid)
         if actor_id is not None:
             st = self.actors.get(actor_id)
@@ -1088,8 +1814,11 @@ class Runtime:
             with self.lock:
                 self._release_token(self._reservations.pop(spec.task_id, None))
                 w.current_task = None
-                w.state = IDLE
-                self.idle.append(w)
+                if w.state != DEAD:  # death may have raced this 'done'
+                    w.state = IDLE
+                    node = self.nodes.get(w.node_id)
+                    if node is not None:
+                        node.idle.append(w)
         self._schedule()
 
     def _fail_returns(self, spec: TaskSpec, exc: Exception):
@@ -1149,8 +1878,18 @@ class Runtime:
                     token = self._try_reserve_pg(
                         cspec.placement_group_id,
                         -1 if bidx is None else bidx, req)
+                    node = None
+                    if token is not None:
+                        pg = self.placement_groups[cspec.placement_group_id]
+                        node = self.nodes.get(pg.bundle_nodes[token[2]])
+                        if node is None or node.state != "ALIVE":
+                            self._release_token(token)
+                            token = None
                 else:
-                    token = ("global", req) if self._try_reserve(req) else None
+                    strategy = getattr(cspec, "scheduling_strategy",
+                                       None) or "DEFAULT"
+                    res = self._reserve_placement(strategy, req, None)
+                    node, token = (None, None) if res is None else res
             except RayTpuError as e:
                 st.state = A_DEAD
                 st.death_cause = e
@@ -1165,16 +1904,22 @@ class Runtime:
                 self.actors_waiting_resources.append(cspec.actor_id)
                 return
             st.resources_reserved = token
-            w = self.idle.popleft() if self.idle else None
+            st.node_id = node.node_id
+            w = node.idle.popleft() if node.idle else None
             if w is not None:
                 self._assign_actor_locked(st, w)
                 spawn_new = True
             else:
-                self.pending_actor_assign.append(cspec.actor_id)
+                node.pending_actor_assign.append(cspec.actor_id)
                 spawn_new = False
         # Keep the pool at size for plain tasks; new process feeds the pool
         # (or picks up the pending assignment on connect).
-        if spawn_new:
+        if node.conn is not None:
+            try:
+                node.conn.send(("spawn_worker",))
+            except OSError:
+                pass
+        elif spawn_new:
             self._replenish_pool_async()
         else:
             threading.Thread(target=self._spawn_worker, daemon=True).start()
@@ -1204,11 +1949,8 @@ class Runtime:
                 st.state = A_ALIVE
                 queued = list(st.queued)
                 st.queued.clear()
-        if dead_worker is not None and dead_worker.proc is not None:
-            try:
-                dead_worker.proc.kill()
-            except ProcessLookupError:
-                pass
+        if dead_worker is not None:
+            dead_worker.kill()
         for spec in queued:
             self._send_actor_task(st, spec)
 
@@ -1301,11 +2043,7 @@ class Runtime:
             # assignment (listener setting st.worker) must see it, or we'd
             # take the no-worker branch and the actor would come alive later.
             w = st.worker
-        if w is not None and w.proc is not None:
-            try:
-                w.proc.kill()
-            except ProcessLookupError:
-                pass
+        if w is not None and w.kill():
             return
         # No worker yet: the creation is still queued (waiting on resources
         # or a pending assignment). Mark it dead so the queued create is
@@ -1315,12 +2053,7 @@ class Runtime:
                 # Re-check: assignment may have won the race after our read;
                 # retry through the worker-kill branch.
                 if st.worker is not None and st.state != A_DEAD:
-                    w = st.worker
-                    if w.proc is not None:
-                        try:
-                            w.proc.kill()
-                        except ProcessLookupError:
-                            pass
+                    st.worker.kill()
                 return
             st.state = A_DEAD
             st.death_cause = ActorDiedError(
@@ -1329,10 +2062,11 @@ class Runtime:
                 self.actors_waiting_resources.remove(actor_id)
             except ValueError:
                 pass
-            try:
-                self.pending_actor_assign.remove(actor_id)
-            except ValueError:
-                pass
+            for node in self.nodes.values():
+                try:
+                    node.pending_actor_assign.remove(actor_id)
+                except ValueError:
+                    pass
             if st.resources_reserved:
                 self._release_token(st.resources_reserved)
                 st.resources_reserved = None
@@ -1346,22 +2080,29 @@ class Runtime:
     def _on_worker_death(self, w: WorkerHandle):
         if w.state == DEAD:
             return
-        with self._sel_lock:
+        if w.sock is not None:
+            with self._sel_lock:
+                try:
+                    self._selector.unregister(w.sock)
+                except (KeyError, ValueError):
+                    pass
             try:
-                self._selector.unregister(w.sock)
-            except (KeyError, ValueError):
+                w.sock.close()
+            except OSError:
                 pass
-        try:
-            w.sock.close()
-        except OSError:
-            pass
-        prev_state = w.state
-        w.state = DEAD
         with self.lock:
-            try:
-                self.idle.remove(w)
-            except ValueError:
-                pass
+            prev_state = w.state
+            if prev_state == DEAD:
+                return
+            w.state = DEAD
+            self.workers.pop(w.worker_id.binary(), None)
+            node = self.nodes.get(w.node_id)
+            if node is not None:
+                try:
+                    node.idle.remove(w)
+                except ValueError:
+                    pass
+                node.workers.pop(w.worker_id.binary(), None)
         if prev_state == BUSY and w.current_task is not None:
             spec = w.current_task
             with self.lock:
@@ -1376,7 +2117,9 @@ class Runtime:
                     f"worker died executing {spec.describe()}"))
         if w.actor_id is not None:
             self._on_actor_worker_death(w.actor_id)
-        if prev_state in (IDLE, BUSY) and not self._shutdown:
+        if (prev_state in (IDLE, BUSY) and not self._shutdown
+                and w.node_id == self.head_node_id):
+            # Remote nodes replenish their own pools agent-side.
             self._replenish_pool_async()
         self._schedule()
 
@@ -1401,9 +2144,16 @@ class Runtime:
                         msg=f"actor {cspec.name} died; call retries exhausted"))
             # Replay ahead of anything queued later, preserving submission order.
             st.queued.extendleft(reversed(retried))
+            # Release the old placement and re-run node selection: the death
+            # may have been the node itself, so the restart must be free to
+            # land anywhere (parity: GCS actor FSM re-schedules on restart,
+            # gcs_actor_manager.h:328).
             with self.lock:
-                self.pending_actor_assign.append(actor_id)
-            threading.Thread(target=self._spawn_worker, daemon=True).start()
+                if st.resources_reserved:
+                    self._release_token(st.resources_reserved)
+                    st.resources_reserved = None
+            threading.Thread(target=self._create_actor_now,
+                             args=(cspec,), daemon=True).start()
         else:
             st.state = A_DEAD
             st.death_cause = ActorDiedError(msg=f"actor {cspec.name} died")
@@ -1427,7 +2177,11 @@ class Runtime:
 
     def available_resources(self) -> dict[str, float]:
         with self.lock:
-            return dict(self.available)
+            out: dict[str, float] = {}
+            for n in self._alive_nodes():
+                for k, v in n.available.items():
+                    out[k] = out.get(k, 0.0) + v
+            return out
 
     def get_actor_state(self, actor_id: bytes) -> str:
         st = self.actors.get(actor_id)
@@ -1446,8 +2200,19 @@ class Runtime:
             # its handle (we see it below) or will observe the flag and
             # self-clean.
             self._shutdown = True
+        for node in list(self.nodes.values()):
+            if node.conn is not None and node.state == "ALIVE":
+                try:
+                    node.conn.send(("shutdown_node",))
+                except OSError:
+                    pass
+        if self._cluster_srv is not None:
+            try:
+                self._cluster_srv.close()
+            except OSError:
+                pass
         for w in list(self.workers.values()):
-            if w.state != DEAD:
+            if w.state != DEAD and w.sock is not None:
                 try:
                     w.send(("shutdown",))
                 except OSError:

@@ -1,0 +1,62 @@
+"""Node-failure tests: own module so the cluster fixture of
+test_cluster.py is finalized before these build their own clusters."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+
+def test_node_death_object_loss_and_task_retry():
+    """Kill a node: sole-copy objects are lost; running retriable tasks
+    are retried elsewhere; actors restart on surviving nodes."""
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    n1 = c.add_node(num_cpus=2)
+    c.add_node(num_cpus=2)
+    c.wait_for_nodes(3)
+    try:
+        target = n1.node_id
+
+        @ray_tpu.remote(num_cpus=1)
+        def make_big():
+            return np.ones(500_000, dtype=np.float32)
+
+        strat = NodeAffinitySchedulingStrategy(node_id=target, soft=False)
+        ref = make_big.options(scheduling_strategy=strat).remote()
+        ray_tpu.wait([ref], timeout=60)
+
+        @ray_tpu.remote(num_cpus=1, max_restarts=1, max_task_retries=1)
+        class Survivor:
+            def __init__(self):
+                self.boot = time.time()
+
+            def node(self):
+                return ray_tpu.get_node_id()
+
+        s = Survivor.options(scheduling_strategy=NodeAffinitySchedulingStrategy(
+            node_id=target, soft=True)).remote()
+        first = ray_tpu.get(s.node.remote(), timeout=60)
+        assert first == target
+
+        c.remove_node(n1)
+
+        # Sole-copy object on the dead node is lost.
+        with pytest.raises(ray_tpu.RayTpuError):
+            ray_tpu.get(ref, timeout=30)
+
+        # The actor restarts on a surviving node.
+        deadline = time.monotonic() + 60
+        relocated = None
+        while time.monotonic() < deadline:
+            try:
+                relocated = ray_tpu.get(s.node.remote(), timeout=30)
+                break
+            except ray_tpu.RayTpuError:
+                time.sleep(0.5)
+        assert relocated is not None and relocated != target
+    finally:
+        c.shutdown()
