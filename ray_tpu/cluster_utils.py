@@ -45,7 +45,8 @@ class Cluster:
                  resources: dict | None = None,
                  object_store_memory: int | None = None,
                  wait: bool = True, timeout: float = 60.0) -> NodeHandle:
-        before = {n["node_id"] for n in self.rt.nodes_table()}
+        import uuid
+        node_id = uuid.uuid4().hex[:16]  # assigned here: exact attribution
         env = dict(os.environ)
         env.update(self.rt.config.to_env())
         repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -54,7 +55,8 @@ class Cluster:
                "--head", self.address,
                "--num-cpus", str(num_cpus),
                "--num-tpus", str(num_tpus),
-               "--resources", json.dumps(resources or {})]
+               "--resources", json.dumps(resources or {}),
+               "--node-id", node_id]
         if object_store_memory:
             cmd += ["--object-store-memory", str(object_store_memory)]
         with open(os.path.join(self.rt.session_dir, "logs",
@@ -62,15 +64,13 @@ class Cluster:
                   "ab") as log:
             proc = subprocess.Popen(cmd, env=env, stdout=log,
                                     stderr=subprocess.STDOUT)
-        handle = NodeHandle(proc)
+        handle = NodeHandle(proc, node_id)
         self.nodes.append(handle)
         if wait:
             deadline = time.monotonic() + timeout
             while time.monotonic() < deadline:
-                now = [n for n in self.rt.nodes_table()
-                       if n["node_id"] not in before and n["alive"]]
-                if now:
-                    handle.node_id = now[0]["node_id"]
+                if any(n["node_id"] == node_id and n["alive"]
+                       for n in self.rt.nodes_table()):
                     return handle
                 if proc.poll() is not None:
                     raise RuntimeError(

@@ -52,11 +52,12 @@ class _AgentWorker:
 
 class NodeAgent:
     def __init__(self, head_addr: str, num_cpus=None, num_tpus=0,
-                 resources=None, object_store_memory=None, node_ip="127.0.0.1"):
+                 resources=None, object_store_memory=None,
+                 node_ip="127.0.0.1", node_id: bytes | None = None):
         cfg = Config.from_env()
         set_config(cfg)
         self.config = cfg
-        self.node_id = os.urandom(8)
+        self.node_id = node_id or os.urandom(8)
         self.session_dir = os.path.join(
             tempfile.gettempdir(), "ray_tpu",
             f"node_{uuid.uuid4().hex[:12]}")
@@ -304,12 +305,16 @@ def main(argv=None):
                    help="extra resources as JSON")
     p.add_argument("--object-store-memory", type=int, default=0)
     p.add_argument("--node-ip", type=str, default="127.0.0.1")
+    p.add_argument("--node-id", type=str, default="",
+                   help="hex node id (assigned by the launcher; random if "
+                        "empty)")
     args = p.parse_args(argv)
     agent = NodeAgent(
         args.head, num_cpus=args.num_cpus, num_tpus=args.num_tpus,
         resources=json.loads(args.resources),
         object_store_memory=args.object_store_memory or None,
-        node_ip=args.node_ip)
+        node_ip=args.node_ip,
+        node_id=bytes.fromhex(args.node_id) if args.node_id else None)
 
     def _sig(_s, _f):
         agent._die()

@@ -1307,7 +1307,12 @@ class Runtime:
         if isinstance(strategy, NodeAffinitySchedulingStrategy):
             nid = strategy.node_id
             if isinstance(nid, str):
-                nid = bytes.fromhex(nid)
+                try:
+                    nid = bytes.fromhex(nid)
+                except ValueError:
+                    raise ResourceError(
+                        f"malformed node_id {strategy.node_id!r} in "
+                        f"NodeAffinitySchedulingStrategy") from None
             node = self.nodes.get(nid)
             if node is not None and node.state == "ALIVE":
                 if self._fits(node.available, req):
@@ -1699,7 +1704,8 @@ class Runtime:
                     res = self._reserve_placement(
                         spec.scheduling_strategy, self._resources_of(spec),
                         spec.dependencies)
-                except RayTpuError as e:
+                except Exception as e:  # noqa: BLE001 — an escaping error
+                    # would drop the whole scanned queue, hanging every get()
                     failures.append((spec, e))
                     continue
                 if res is None:
@@ -1883,8 +1889,13 @@ class Runtime:
                         pg = self.placement_groups[cspec.placement_group_id]
                         node = self.nodes.get(pg.bundle_nodes[token[2]])
                         if node is None or node.state != "ALIVE":
+                            # PG rescheduling is not implemented: nothing can
+                            # ever revive this bundle, so fail loudly like
+                            # the task path does instead of parking forever.
                             self._release_token(token)
-                            token = None
+                            raise ResourceError(
+                                f"placement group bundle {token[2]} was on "
+                                f"a dead node")
                 else:
                     strategy = getattr(cspec, "scheduling_strategy",
                                        None) or "DEFAULT"

@@ -383,6 +383,7 @@ def zygote_main(store_path: str, ctrl_fd: int):
 
     try:  # usually already loaded via sitecustomize; make the warmup explicit
         import jax  # noqa: F401
+        _honor_platform_env(jax)
     except ImportError:
         pass
 
@@ -452,8 +453,26 @@ def zygote_main(store_path: str, ctrl_fd: int):
         ctrl.sendall(struct.pack("<I", pid))
 
 
+def _honor_platform_env(jax_mod):
+    """Make jax honor JAX_PLATFORMS even though the environment's
+    sitecustomize force-registers the TPU backend at interpreter start.
+    Without this, a CPU-platform driver (tests, dryruns) gets workers whose
+    matmuls run on the TPU backend — subtly different numerics."""
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        try:
+            jax_mod.config.update("jax_platforms", want)
+        except Exception:  # noqa: BLE001 — backend already locked in
+            pass
+
+
 def _worker_main(store_path: str, worker_id: WorkerID, fd: int):
     set_config(Config.from_env())
+    try:
+        import jax as _jax
+        _honor_platform_env(_jax)
+    except ImportError:
+        pass
     sock = socket_from_fd(fd)
 
     import queue

@@ -1,0 +1,21 @@
+"""ray_tpu.rllib: reinforcement learning at scale, jax-first.
+
+Parity map to the reference's `rllib/` (new API stack only — the old
+policy/rollout-worker stack is intentionally not reproduced):
+- RLModule (core/rl_module.py)  <- rllib/core/rl_module/rl_module.py:260
+- Learner/LearnerGroup (core/learner.py) <- rllib/core/learner/
+- EnvRunner/Group (env/env_runner.py) <- rllib/env/single_agent_env_runner.py:68
+- AlgorithmConfig/Algorithm (algorithms/) <- rllib/algorithms/
+- PPO, DQN, IMPALA <- rllib/algorithms/{ppo,dqn,impala}/
+"""
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+
+__all__ = [
+    "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN", "DQNConfig",
+    "IMPALA", "IMPALAConfig",
+]

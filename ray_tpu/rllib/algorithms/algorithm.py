@@ -1,0 +1,117 @@
+"""Algorithm: the trainable driver of the RL stack.
+
+Parity: reference `rllib/algorithms/algorithm.py:198` (a Tune Trainable
+whose `train()` runs one `training_step` over EnvRunnerGroup +
+LearnerGroup, per §3.6 of the survey). Checkpointing follows the
+reference's Checkpointable shape: weights + config dict.
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+
+import gymnasium as gym
+import numpy as np
+
+from ray_tpu.rllib.core.learner import LearnerGroup
+from ray_tpu.rllib.core.rl_module import module_for_env
+from ray_tpu.rllib.env.env_runner import EnvRunnerGroup
+
+
+class Algorithm:
+    """Subclasses define `loss_fn`, `module_kind`, `training_step()`."""
+
+    module_kind = "actor_critic"
+
+    def __init__(self, config):
+        self.config = config
+        if config.env is None:
+            raise ValueError("config.environment(env=...) is required")
+        probe = gym.make(config.env, **config.env_config)
+        self.module = module_for_env(
+            probe, hidden=tuple(config.model.get("hidden", (64, 64))),
+            kind=self.module_kind)
+        probe.close()
+        self.env_runner_group = EnvRunnerGroup(
+            config.env, self.module,
+            num_env_runners=config.num_env_runners,
+            num_envs_per_env_runner=config.num_envs_per_env_runner,
+            seed=config.seed, env_config=config.env_config,
+            restart_failed=config.restart_failed_env_runners)
+        self.learner_group = LearnerGroup(
+            self.module, self._loss_fn(),
+            num_learners=config.num_learners,
+            config={"lr": config.lr, "grad_clip": config.grad_clip,
+                    "seed": config.seed, "loss_cfg": self._loss_cfg()})
+        self.iteration = 0
+        self._timesteps = 0
+
+    # ---- subclass hooks ----
+
+    def _loss_fn(self):
+        raise NotImplementedError
+
+    def _loss_cfg(self) -> dict:
+        return {}
+
+    def training_step(self) -> dict:
+        raise NotImplementedError
+
+    # ---- Trainable surface ----
+
+    def train(self) -> dict:
+        t0 = time.perf_counter()
+        self.iteration += 1
+        result = self.training_step()
+        metrics = self.env_runner_group.aggregate_metrics()
+        result.update(metrics)
+        result.update({
+            "training_iteration": self.iteration,
+            "num_env_steps_sampled_lifetime": self._timesteps,
+            "time_this_iter_s": time.perf_counter() - t0,
+        })
+        return result
+
+    def get_weights(self):
+        return self.learner_group.get_weights()
+
+    def save_to_path(self, path: str):
+        import os
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "algorithm_state.pkl"), "wb") as f:
+            pickle.dump({"weights": self.get_weights(),
+                         "iteration": self.iteration,
+                         "timesteps": self._timesteps,
+                         "config": self.config.to_dict()}, f)
+        return path
+
+    def restore_from_path(self, path: str):
+        import os
+        with open(os.path.join(path, "algorithm_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        if self.learner_group.local is not None:
+            self.learner_group.local.set_weights(state["weights"])
+        else:
+            import ray_tpu
+            ray_tpu.get([r.set_weights.remote(state["weights"])
+                         for r in self.learner_group.remotes], timeout=120)
+        self.iteration = state["iteration"]
+        self._timesteps = state["timesteps"]
+
+    def stop(self):
+        self.env_runner_group.stop()
+        self.learner_group.stop()
+
+    # ---- shared helpers ----
+
+    def _concat_fragments(self, fragments: list[dict]) -> dict:
+        """[T,B,...] fragments from every runner -> flat [N,...] batch,
+        after per-fragment advantage computation by the subclass."""
+        out = {}
+        for k in fragments[0]:
+            if k == "last_values":
+                continue
+            out[k] = np.concatenate(
+                [f[k].reshape(-1, *f[k].shape[2:]) for f in fragments])
+        return out

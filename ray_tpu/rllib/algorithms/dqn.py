@@ -1,0 +1,123 @@
+"""DQN — double/dueling deep Q-learning with a replay buffer.
+
+Parity: reference `rllib/algorithms/dqn/dqn.py` (new stack: sample ->
+replay buffer -> TD update -> periodic target sync). TPU-native: the TD
+loss + double-Q target is one jit-compiled function over the online and
+target param trees; exploration is Boltzmann over Q (see QModule) instead
+of a stateful epsilon connector.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.utils.replay_buffer import ReplayBuffer
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=DQN)
+        self.replay_buffer_capacity = 50_000
+        self.num_steps_sampled_before_learning_starts = 1000
+        self.target_network_update_freq = 500  # env steps
+        self.double_q = True
+        self.lr = 1e-3
+        self.train_batch_size = 32
+        self.num_updates_per_iter = 32
+
+    def training(self, *, replay_buffer_capacity=None,
+                 num_steps_sampled_before_learning_starts=None,
+                 target_network_update_freq=None, double_q=None,
+                 num_updates_per_iter=None, **kw):
+        super().training(**kw)
+        for k, v in (("replay_buffer_capacity", replay_buffer_capacity),
+                     ("num_steps_sampled_before_learning_starts",
+                      num_steps_sampled_before_learning_starts),
+                     ("target_network_update_freq",
+                      target_network_update_freq),
+                     ("double_q", double_q),
+                     ("num_updates_per_iter", num_updates_per_iter)):
+            if v is not None:
+                setattr(self, k, v)
+        return self
+
+
+def dqn_loss(params, batch, *, module, gamma, double_q):
+    """TD loss; batch carries the target tree under 'target_params' --
+    it rides the batch so the jitted signature stays (params, batch)."""
+    q = module.forward_train(params, batch["obs"])
+    q_a = jnp.take_along_axis(
+        q, batch["actions"][..., None].astype(jnp.int32), -1)[..., 0]
+    q_next_target = module.forward_train(batch["target_params"],
+                                         batch["next_obs"])
+    if double_q:
+        q_next_online = module.forward_train(params, batch["next_obs"])
+        best = jnp.argmax(q_next_online, axis=-1)
+        q_next = jnp.take_along_axis(
+            q_next_target, best[..., None], -1)[..., 0]
+    else:
+        q_next = q_next_target.max(axis=-1)
+    target = batch["rewards"] + gamma * (1.0 - batch["dones"]) * q_next
+    td = q_a - jax.lax.stop_gradient(target)
+    loss = jnp.square(td).mean()
+    return loss, {"td_error_mean": jnp.abs(td).mean(),
+                  "q_mean": q_a.mean()}
+
+
+class DQN(Algorithm):
+    module_kind = "q"
+
+    def __init__(self, config):
+        if config.num_learners:
+            raise ValueError(
+                "DQN runs a single (device-mesh) learner: the target tree "
+                "rides the batch and cannot be row-sharded across learner "
+                "actors")
+        super().__init__(config)
+        self.buffer = ReplayBuffer(config.replay_buffer_capacity,
+                                   seed=config.seed)
+        self.target_params = jax.tree_util.tree_map(
+            lambda x: x, self.learner_group.get_weights())
+        self._last_target_sync = 0
+
+    def _loss_fn(self):
+        return functools.partial(dqn_loss, module=self.module)
+
+    def _loss_cfg(self):
+        return {"gamma": self.config.gamma,
+                "double_q": self.config.double_q}
+
+    def training_step(self) -> dict:
+        c = self.config
+        params = self.learner_group.get_weights()
+        frags = self.env_runner_group.sample(params,
+                                             c.rollout_fragment_length)
+        for f in frags:
+            T, B = f["rewards"].shape
+            next_obs = np.concatenate(
+                [f["obs"][1:], f["final_obs"][None]], axis=0)
+            self.buffer.add_batch({
+                "obs": f["obs"].reshape(T * B, -1),
+                "actions": f["actions"].reshape(-1),
+                "rewards": f["rewards"].reshape(-1),
+                "dones": f["dones"].reshape(-1),
+                "next_obs": next_obs.reshape(T * B, -1),
+            })
+            self._timesteps += T * B
+        metrics = {}
+        if self._timesteps >= c.num_steps_sampled_before_learning_starts:
+            for _ in range(c.num_updates_per_iter):
+                batch = self.buffer.sample(c.train_batch_size)
+                batch["target_params"] = self.target_params
+                metrics = self.learner_group.update(batch)
+        if (self._timesteps - self._last_target_sync
+                >= c.target_network_update_freq):
+            self.target_params = self.learner_group.get_weights()
+            self._last_target_sync = self._timesteps
+        return metrics

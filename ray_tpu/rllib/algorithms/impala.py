@@ -1,0 +1,160 @@
+"""IMPALA — asynchronous actor-learner with V-trace off-policy correction.
+
+Parity: reference `rllib/algorithms/impala/impala.py:599` (async sample
+queues feeding GPU learners). TPU-native: each env runner keeps exactly one
+sample request in flight (the queue is the object plane itself — refs are
+futures); the learner consumes fragments as they land and V-trace
+(importance-weighted value targets, Espeholt et al. 2018) is a jitted
+`lax.scan` like PPO's GAE.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=IMPALA)
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.clip_rho_threshold = 1.0
+        self.clip_pg_rho_threshold = 1.0
+        self.num_env_runners = 2  # async needs remote runners
+        self.broadcast_interval = 1  # updates between weight broadcasts
+
+    def training(self, *, vf_loss_coeff=None, entropy_coeff=None,
+                 clip_rho_threshold=None, clip_pg_rho_threshold=None,
+                 broadcast_interval=None, **kw):
+        super().training(**kw)
+        for k, v in (("vf_loss_coeff", vf_loss_coeff),
+                     ("entropy_coeff", entropy_coeff),
+                     ("clip_rho_threshold", clip_rho_threshold),
+                     ("clip_pg_rho_threshold", clip_pg_rho_threshold),
+                     ("broadcast_interval", broadcast_interval)):
+            if v is not None:
+                setattr(self, k, v)
+        return self
+
+
+@functools.partial(jax.jit, static_argnames=("gamma", "rho_bar", "c_bar"))
+def _vtrace(behavior_logp, target_logp, rewards, values, dones, last_values,
+            *, gamma, rho_bar=1.0, c_bar=1.0):
+    """V-trace targets/advantages over [T, B] (lax.scan, time-reversed)."""
+    rho = jnp.exp(target_logp - behavior_logp)
+    rho_c = jnp.minimum(rho_bar, rho)
+    c = jnp.minimum(c_bar, rho)
+    v_next = jnp.concatenate([values[1:], last_values[None]], axis=0)
+    deltas = rho_c * (rewards + gamma * v_next * (1.0 - dones) - values)
+
+    def step(carry, xs):
+        delta, c_t, d = xs
+        acc = delta + gamma * (1.0 - d) * c_t * carry
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(
+        step, jnp.zeros_like(last_values), (deltas, c, dones), reverse=True)
+    vs = vs_minus_v + values
+    vs_next = jnp.concatenate([vs[1:], last_values[None]], axis=0)
+    pg_adv = rho_c * (rewards + gamma * vs_next * (1.0 - dones) - values)
+    return vs, pg_adv
+
+
+def impala_loss(params, batch, *, module, vf_coef, ent_coef):
+    logits, value = module.forward_train(params, batch["obs"])
+    logp_all = jax.nn.log_softmax(logits)
+    logp = jnp.take_along_axis(
+        logp_all, batch["actions"][..., None].astype(jnp.int32), -1)[..., 0]
+    pi_loss = -(batch["pg_advantages"] * logp).mean()
+    vf_loss = jnp.square(value - batch["vs"]).mean()
+    entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+    total = pi_loss + vf_coef * vf_loss - ent_coef * entropy
+    return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,
+                   "entropy": entropy}
+
+
+class IMPALA(Algorithm):
+    def __init__(self, config):
+        if config.num_env_runners < 1:
+            raise ValueError("IMPALA needs remote env runners (async)")
+        super().__init__(config)
+        self._inflight: dict = {}  # ref -> runner index
+        self._target_logp = jax.jit(
+            lambda p, obs, act: jnp.take_along_axis(
+                jax.nn.log_softmax(self.module.forward(p, obs)[0]),
+                act[..., None].astype(jnp.int32), -1)[..., 0])
+        self._updates_since_broadcast = 0
+        self._params_ref = None
+
+    def _loss_fn(self):
+        return functools.partial(impala_loss, module=self.module)
+
+    def _loss_cfg(self):
+        c = self.config
+        return {"vf_coef": c.vf_loss_coeff, "ent_coef": c.entropy_coeff}
+
+    def _broadcast(self):
+        self._params_ref = ray_tpu.put(self.learner_group.get_weights())
+
+    def _launch(self, idx: int):
+        runner = self.env_runner_group.remotes[idx]
+        ref = runner.sample.remote(self._params_ref,
+                                   self.config.rollout_fragment_length)
+        self._inflight[ref] = idx
+
+    def training_step(self) -> dict:
+        c = self.config
+        if self._params_ref is None:
+            self._broadcast()
+            for i in range(len(self.env_runner_group.remotes)):
+                self._launch(i)
+        params = self.learner_group.get_weights()
+        metrics = {}
+        steps = 0
+        while steps < c.train_batch_size:
+            ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1,
+                                    timeout=120)
+            if not ready:
+                raise TimeoutError("no sample fragment arrived in 120s")
+            ref = ready[0]
+            idx = self._inflight.pop(ref)
+            try:
+                f = ray_tpu.get(ref, timeout=60)
+            except ray_tpu.RayTpuError:
+                self.env_runner_group._replace(idx)
+                self._launch(idx)
+                continue
+            # Relaunch immediately: the runner never waits on the learner.
+            self._launch(idx)
+            target_logp = self._target_logp(
+                params, jnp.asarray(f["obs"]), jnp.asarray(f["actions"]))
+            vs, pg_adv = _vtrace(
+                jnp.asarray(f["logp"]), target_logp,
+                jnp.asarray(f["rewards"]), jnp.asarray(f["values"]),
+                jnp.asarray(f["dones"]), jnp.asarray(f["last_values"]),
+                gamma=c.gamma, rho_bar=c.clip_rho_threshold,
+                c_bar=c.clip_pg_rho_threshold)
+            T, B = f["rewards"].shape
+            batch = {
+                "obs": f["obs"].reshape(T * B, -1),
+                "actions": f["actions"].reshape(-1),
+                "vs": np.asarray(vs).reshape(-1),
+                "pg_advantages": np.asarray(pg_adv).reshape(-1),
+            }
+            metrics = self.learner_group.update(batch)
+            params = self.learner_group.get_weights()
+            steps += T * B
+            self._updates_since_broadcast += 1
+            if self._updates_since_broadcast >= c.broadcast_interval:
+                self._broadcast()
+                self._updates_since_broadcast = 0
+        self._timesteps += steps
+        return metrics

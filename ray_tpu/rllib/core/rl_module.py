@@ -1,0 +1,152 @@
+"""RLModule: the network abstraction of the RL stack.
+
+Parity: reference `rllib/core/rl_module/rl_module.py:260` (forward_train /
+forward_exploration / forward_inference over a framework-specific network).
+TPU-native redesign: a module is a *pure-function spec* — `init(key)` builds
+a param pytree, `forward(params, obs)` is a jit-compiled pure function — so
+the same module runs unmodified inside `jax.jit`, `pjit` over a learner
+mesh, or on an env-runner's CPU backend. No nn.Module state, no framework
+switch (reference carries torch+tf2 twins, torch_rl_module.py/tf_rl_module.py).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _dense_init(key, shape, scale=None):
+    fan_in = shape[0]
+    scale = scale if scale is not None else 1.0 / math.sqrt(fan_in)
+    return jax.random.uniform(key, shape, jnp.float32, -scale, scale)
+
+
+@dataclass
+class MLPSpec:
+    """Shared MLP torso spec."""
+
+    obs_dim: int
+    hidden: tuple = (64, 64)
+    activation: str = "tanh"
+
+    def init(self, key):
+        params = []
+        dims = [self.obs_dim, *self.hidden]
+        for i in range(len(dims) - 1):
+            key, k1, k2 = jax.random.split(key, 3)
+            params.append({"w": _dense_init(k1, (dims[i], dims[i + 1])),
+                           "b": jnp.zeros((dims[i + 1],))})
+        return params
+
+    def apply(self, params, x):
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+        for layer in params:
+            x = act(x @ layer["w"] + layer["b"])
+        return x
+
+
+@dataclass
+class ActorCriticModule:
+    """Policy + value heads over a shared-or-split MLP torso (the default
+    module for PPO/IMPALA, parity: rllib's default PPO RLModule/catalog)."""
+
+    obs_dim: int
+    num_actions: int
+    hidden: tuple = (64, 64)
+    free_log_std: bool = False  # continuous-action variant flag
+
+    def init(self, key) -> dict:
+        kp, kv, k1, k2 = jax.random.split(key, 4)
+        pi_torso = MLPSpec(self.obs_dim, self.hidden)
+        vf_torso = MLPSpec(self.obs_dim, self.hidden)
+        return {
+            "pi": pi_torso.init(kp),
+            "vf": vf_torso.init(kv),
+            "pi_head": {"w": _dense_init(k1, (self.hidden[-1], self.num_actions), 0.01),
+                        "b": jnp.zeros((self.num_actions,))},
+            "vf_head": {"w": _dense_init(k2, (self.hidden[-1], 1), 1.0),
+                        "b": jnp.zeros((1,))},
+        }
+
+    def forward(self, params, obs):
+        """Returns (logits, value). Pure; safe under jit/pjit/vmap."""
+        torso = MLPSpec(self.obs_dim, self.hidden)
+        hp = torso.apply(params["pi"], obs)
+        hv = torso.apply(params["vf"], obs)
+        logits = hp @ params["pi_head"]["w"] + params["pi_head"]["b"]
+        value = (hv @ params["vf_head"]["w"] + params["vf_head"]["b"])[..., 0]
+        return logits, value
+
+    # --- the three forward modes (parity: rl_module.py:260) ---
+
+    def forward_inference(self, params, obs):
+        logits, _ = self.forward(params, obs)
+        return jnp.argmax(logits, axis=-1)
+
+    def forward_exploration(self, params, obs, key):
+        logits, value = self.forward(params, obs)
+        action = jax.random.categorical(key, logits)
+        logp = jax.nn.log_softmax(logits)
+        logp_a = jnp.take_along_axis(logp, action[..., None], -1)[..., 0]
+        return action, logp_a, value
+
+    def forward_train(self, params, obs):
+        return self.forward(params, obs)
+
+
+@dataclass
+class QModule:
+    """Q-network for DQN (online + target param trees)."""
+
+    obs_dim: int
+    num_actions: int
+    hidden: tuple = (64, 64)
+    dueling: bool = True
+
+    def init(self, key) -> dict:
+        kt, ka, kv = jax.random.split(key, 3)
+        torso = MLPSpec(self.obs_dim, self.hidden)
+        p = {"torso": torso.init(kt),
+             "adv": {"w": _dense_init(ka, (self.hidden[-1], self.num_actions)),
+                     "b": jnp.zeros((self.num_actions,))}}
+        if self.dueling:
+            p["val"] = {"w": _dense_init(kv, (self.hidden[-1], 1)),
+                        "b": jnp.zeros((1,))}
+        return p
+
+    def forward(self, params, obs):
+        torso = MLPSpec(self.obs_dim, self.hidden)
+        h = torso.apply(params["torso"], obs)
+        adv = h @ params["adv"]["w"] + params["adv"]["b"]
+        if self.dueling:
+            val = h @ params["val"]["w"] + params["val"]["b"]
+            return val + adv - adv.mean(axis=-1, keepdims=True)
+        return adv
+
+    forward_train = forward
+
+    def forward_inference(self, params, obs):
+        return jnp.argmax(self.forward(params, obs), axis=-1)
+
+    def forward_exploration(self, params, obs, key, tau: float = 1.0):
+        """Boltzmann exploration over Q values (fits the shared env-runner
+        interface; the reference's epsilon-greedy schedule is a stateful
+        connector — softmax exploration needs no schedule plumbing)."""
+        q = self.forward(params, obs)
+        action = jax.random.categorical(key, q / tau)
+        logp = jax.nn.log_softmax(q / tau)
+        logp_a = jnp.take_along_axis(logp, action[..., None], -1)[..., 0]
+        return action, logp_a, q.max(axis=-1)
+
+
+def module_for_env(env_like, hidden=(64, 64), kind="actor_critic"):
+    """Build the default module from (obs_space, action_space) shapes."""
+    obs_dim = int(np.prod(env_like.observation_space.shape))
+    num_actions = int(env_like.action_space.n)
+    if kind == "q":
+        return QModule(obs_dim, num_actions, hidden)
+    return ActorCriticModule(obs_dim, num_actions, hidden)

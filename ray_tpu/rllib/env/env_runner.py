@@ -1,0 +1,187 @@
+"""EnvRunner: vectorized-environment sampling actor.
+
+Parity: reference `rllib/env/single_agent_env_runner.py:68` (gymnasium
+vector envs + ConnectorV2 pipelines) inside `EnvRunnerGroup`
+(`env/env_runner_group.py:71`). TPU split kept from the reference: env
+stepping is CPU-bound actor work; only the learner touches the accelerator.
+The runner does batched policy inference with jitted module forwards on its
+local (CPU) jax backend.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import ray_tpu
+
+
+def _flat(obs):
+    return np.asarray(obs, dtype=np.float32).reshape(len(obs), -1)
+
+
+class SingleAgentEnvRunner:
+    """Steps `num_envs` copies of a gymnasium env, collecting fixed-length
+    rollout fragments (PPO/IMPALA) or transition batches (DQN)."""
+
+    def __init__(self, env_name: str, module, num_envs: int = 1,
+                 seed: int = 0, env_config: dict | None = None):
+        import gymnasium as gym
+        import jax
+
+        self.env = gym.make_vec(env_name, num_envs=num_envs,
+                                vectorization_mode="sync",
+                                **(env_config or {}))
+        self.num_envs = num_envs
+        self.module = module
+        self._key = jax.random.PRNGKey(seed)
+        self._explore = jax.jit(module.forward_exploration)
+        self._infer = jax.jit(module.forward_inference)
+        obs, _ = self.env.reset(seed=seed)
+        self.obs = _flat(obs)
+        # Per-env accumulators for completed-episode returns.
+        self._ep_ret = np.zeros(num_envs, dtype=np.float64)
+        self._ep_len = np.zeros(num_envs, dtype=np.int64)
+        self.completed_returns: list[float] = []
+        self.completed_lengths: list[int] = []
+
+    def sample(self, params, num_steps: int, explore: bool = True) -> dict:
+        """Collect a [T, B, ...] fragment. Returns numpy arrays (they ride
+        the object plane zero-copy)."""
+        import jax
+
+        T, B = num_steps, self.num_envs
+        obs_buf = np.empty((T, B, self.obs.shape[-1]), np.float32)
+        act_buf = np.empty((T, B), np.int64)
+        logp_buf = np.empty((T, B), np.float32)
+        val_buf = np.empty((T, B), np.float32)
+        rew_buf = np.empty((T, B), np.float32)
+        done_buf = np.empty((T, B), np.float32)
+        for t in range(T):
+            self._key, sub = jax.random.split(self._key)
+            if explore:
+                action, logp, value = self._explore(params, self.obs, sub)
+            else:
+                action = self._infer(params, self.obs)
+                logp = value = np.zeros(B, np.float32)
+            action = np.asarray(action)
+            obs_buf[t] = self.obs
+            act_buf[t] = action
+            logp_buf[t] = np.asarray(logp)
+            val_buf[t] = np.asarray(value)
+            nxt, rew, term, trunc, _ = self.env.step(action)
+            done = np.logical_or(term, trunc)
+            rew_buf[t] = rew
+            done_buf[t] = done
+            self._ep_ret += rew
+            self._ep_len += 1
+            for i in np.nonzero(done)[0]:
+                self.completed_returns.append(float(self._ep_ret[i]))
+                self.completed_lengths.append(int(self._ep_len[i]))
+                self._ep_ret[i] = 0.0
+                self._ep_len[i] = 0
+            self.obs = _flat(nxt)
+        # Bootstrap value for the final obs (used by GAE/V-trace).
+        self._key, sub = jax.random.split(self._key)
+        _, _, last_val = self._explore(params, self.obs, sub)
+        return {
+            "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
+            "values": val_buf, "rewards": rew_buf, "dones": done_buf,
+            "last_values": np.asarray(last_val),
+            "final_obs": self.obs.copy(),  # next_obs tail for TD targets
+        }
+
+    def get_metrics(self) -> dict:
+        out = {
+            "episode_return_mean": (float(np.mean(self.completed_returns[-100:]))
+                                    if self.completed_returns else float("nan")),
+            "episode_len_mean": (float(np.mean(self.completed_lengths[-100:]))
+                                 if self.completed_lengths else float("nan")),
+            "num_episodes": len(self.completed_returns),
+        }
+        return out
+
+    def ping(self):
+        return "ok"
+
+
+class EnvRunnerGroup:
+    """N remote env-runner actors, or one local runner when
+    num_env_runners == 0 (parity: env_runner_group.py:71 local-worker mode).
+    Fault-aware: dead runners are replaced on the next sample round
+    (parity: restart_failed_env_runners / FaultAwareApply, env_runner.py:32).
+    """
+
+    def __init__(self, env_name: str, module, *, num_env_runners: int = 0,
+                 num_envs_per_env_runner: int = 1, seed: int = 0,
+                 env_config: dict | None = None, restart_failed: bool = True):
+        self._args = (env_name, module)
+        self._kw = dict(num_envs=num_envs_per_env_runner,
+                        env_config=env_config)
+        self.restart_failed = restart_failed
+        self.num_env_runners = num_env_runners
+        self._seed = seed
+        if num_env_runners == 0:
+            self.local = SingleAgentEnvRunner(env_name, module, seed=seed,
+                                              **self._kw)
+            self.remotes = []
+        else:
+            self.local = None
+            cls = ray_tpu.remote(num_cpus=1)(SingleAgentEnvRunner)
+            self._cls = cls
+            self.remotes = [
+                cls.remote(env_name, module, seed=seed + i, **self._kw)
+                for i in range(num_env_runners)]
+
+    def _replace(self, idx: int):
+        self.remotes[idx] = self._cls.remote(
+            self._args[0], self._args[1], seed=self._seed + 1000 + idx,
+            **self._kw)
+
+    def sample(self, params, num_steps: int) -> list[dict]:
+        if self.local is not None:
+            return [self.local.sample(params, num_steps)]
+        params_ref = ray_tpu.put(params)
+        refs = [(i, r.sample.remote(params_ref, num_steps))
+                for i, r in enumerate(self.remotes)]
+        out = []
+        for i, ref in refs:
+            try:
+                out.append(ray_tpu.get(ref, timeout=120))
+            except ray_tpu.RayTpuError:
+                if not self.restart_failed:
+                    raise
+                self._replace(i)
+        return out
+
+    def sample_async(self, params_ref, num_steps: int):
+        """One in-flight sample request per runner (IMPALA-style)."""
+        return [(i, r.sample.remote(params_ref, num_steps))
+                for i, r in enumerate(self.remotes)]
+
+    def aggregate_metrics(self) -> dict:
+        if self.local is not None:
+            return self.local.get_metrics()
+        rets, lens, n = [], [], 0
+        for i, r in enumerate(self.remotes):
+            try:
+                m = ray_tpu.get(r.get_metrics.remote(), timeout=60)
+            except ray_tpu.RayTpuError:
+                if self.restart_failed:
+                    self._replace(i)
+                continue
+            if m["num_episodes"]:
+                rets.append(m["episode_return_mean"])
+                lens.append(m["episode_len_mean"])
+                n += m["num_episodes"]
+        return {
+            "episode_return_mean": float(np.mean(rets)) if rets else float("nan"),
+            "episode_len_mean": float(np.mean(lens)) if lens else float("nan"),
+            "num_episodes": n,
+        }
+
+    def stop(self):
+        for r in self.remotes:
+            try:
+                ray_tpu.kill(r)
+            except Exception:  # noqa: BLE001
+                pass
