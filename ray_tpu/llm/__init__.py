@@ -1,0 +1,20 @@
+"""ray_tpu.llm — LLM batch inference and serving.
+
+Parity map to the reference's `python/ray/llm/`:
+- engine.py     <- the vLLM engine role (continuous batching, KV cache),
+                   redesigned as jit-compiled static-shape JAX
+- serve.py      <- _internal/serve/ (LLMServer deployment, OpenAI router,
+                   LoRA multiplexing)
+- batch.py      <- _internal/batch/ (processor stage over Data)
+- config.py     <- configs (LLMConfig; TP -> mesh axis, not PG bundles)
+"""
+
+from ray_tpu.llm.batch import build_llm_processor
+from ray_tpu.llm.config import EngineConfig, LLMConfig, LoraConfig
+from ray_tpu.llm.engine import InferenceEngine
+from ray_tpu.llm.serve import build_llm_deployment, build_openai_app
+
+__all__ = [
+    "InferenceEngine", "EngineConfig", "LLMConfig", "LoraConfig",
+    "build_llm_processor", "build_llm_deployment", "build_openai_app",
+]

@@ -1,0 +1,58 @@
+"""Batch LLM inference over ray_tpu.data datasets.
+
+Parity: reference `python/ray/llm/_internal/batch/` (Processor /
+vLLMEngineStage over Ray Data). Here the stage is a class UDF holding one
+continuous-batching engine per actor; `build_llm_processor` returns a
+Dataset -> Dataset transform.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.llm.config import LLMConfig
+
+
+class _EngineUDF:
+    """map_batches class UDF: one engine per worker, reused across blocks."""
+
+    def __init__(self, llm_config: LLMConfig, input_col: str,
+                 output_col: str, max_new_tokens, temperature):
+        from ray_tpu.llm.engine import InferenceEngine
+        from ray_tpu.llm.serve import _wire_eos
+        from ray_tpu.llm.tokenizer import get_tokenizer
+        self.tokenizer = get_tokenizer(llm_config.tokenizer)
+        self.engine = InferenceEngine(
+            llm_config.resolve_model(),
+            _wire_eos(llm_config.engine, self.tokenizer),
+            seed=llm_config.seed)
+        self.input_col = input_col
+        self.output_col = output_col
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+
+    def __call__(self, batch: dict) -> dict:
+        prompts = [str(p) for p in batch[self.input_col]]
+        token_lists = [self.tokenizer.encode(p) for p in prompts]
+        outs = self.engine.generate(token_lists, self.max_new_tokens,
+                                    self.temperature)
+        batch[self.output_col] = np.array(
+            [self.tokenizer.decode(o) for o in outs], dtype=object)
+        return batch
+
+
+def build_llm_processor(llm_config: LLMConfig, *, input_col: str = "prompt",
+                        output_col: str = "generated",
+                        max_new_tokens: int | None = None,
+                        temperature: float | None = None,
+                        batch_size: int = 32, concurrency: int = 1):
+    """Returns Dataset -> Dataset applying continuous-batched generation."""
+
+    def processor(ds):
+        return ds.map_batches(
+            _EngineUDF,
+            fn_constructor_args=(llm_config, input_col, output_col,
+                                 max_new_tokens, temperature),
+            batch_size=batch_size, concurrency=concurrency)
+
+    return processor

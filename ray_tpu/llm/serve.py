@@ -1,0 +1,223 @@
+"""LLM serving: engine-backed deployment + OpenAI-compatible router.
+
+Parity: reference `python/ray/llm/_internal/serve/` — `LLMServer`
+deployment wrapping the engine (`deployments/llm/`), OpenAI-compatible
+ingress (`deployments/routers/router.py`, /v1/chat/completions etc.), LoRA
+multiplexing (`deployments/llm/multiplex/`). The engine here is the
+in-process jit-compiled continuous-batching engine (engine.py), not an
+external vLLM process; TP is a mesh inside the replica.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+import uuid
+
+from ray_tpu import serve
+from ray_tpu.llm.config import LLMConfig
+from ray_tpu.llm.engine import EngineConfig, InferenceEngine
+from ray_tpu.llm.lora import init_lora, merge_lora
+from ray_tpu.llm.tokenizer import get_tokenizer
+
+
+def _wire_eos(engine_cfg: EngineConfig, tokenizer) -> EngineConfig:
+    """Stop on the TOKENIZER's eos unless the user overrode the default."""
+    import dataclasses
+    eos = getattr(tokenizer, "eos_id", None)
+    if eos is not None and engine_cfg.eos_token == EngineConfig().eos_token:
+        return dataclasses.replace(engine_cfg, eos_token=eos)
+    return engine_cfg
+
+
+class _LLMServerImpl:
+    """One engine per replica; a background thread pumps engine.step() and
+    resolves per-request futures (continuous batching across concurrent
+    HTTP callers)."""
+
+    def __init__(self, llm_config: LLMConfig):
+        import jax
+
+        self.cfg = llm_config
+        model_cfg = llm_config.resolve_model()
+        mesh = None
+        if llm_config.tensor_parallelism > 1:
+            from ray_tpu.parallel import MeshConfig, make_mesh
+            mesh = make_mesh(MeshConfig(tp=llm_config.tensor_parallelism,
+                                        fsdp=1))
+        self.tokenizer = get_tokenizer(llm_config.tokenizer)
+        engine_cfg = _wire_eos(llm_config.engine, self.tokenizer)
+        self.engine = InferenceEngine(
+            model_cfg, engine_cfg, mesh=mesh, seed=llm_config.seed)
+        self.model_cfg = model_cfg
+        self._base_params = self.engine.params
+        self._adapters: dict[str, object] = {}
+        self._waiters: dict[int, tuple] = {}  # rid -> (loop, future)
+        self._lock = threading.Lock()
+        self._stop = False
+        self._pump = threading.Thread(target=self._loop, daemon=True,
+                                      name="llm-engine-pump")
+        self._pump.start()
+
+    # ---- engine pump ----
+
+    def _loop(self):
+        while not self._stop:
+            if not self.engine.has_work():
+                time.sleep(0.002)
+                continue
+            try:
+                self.engine.step()
+            except Exception:  # noqa: BLE001 — a dead pump hangs every
+                # pending AND future request on the replica; log and go on.
+                import traceback
+                traceback.print_exc()
+                time.sleep(0.1)
+                continue
+            done = []
+            with self._lock:
+                for rid, (loop, fut) in list(self._waiters.items()):
+                    req = self.engine.finished.pop(rid, None)
+                    if req is not None:
+                        done.append((loop, fut, req))
+                        del self._waiters[rid]
+            for loop, fut, req in done:
+                loop.call_soon_threadsafe(fut.set_result, req)
+
+    async def _submit(self, prompt_ids, max_new_tokens, temperature):
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        with self._lock:
+            rid = self.engine.add_request(prompt_ids, max_new_tokens,
+                                          temperature)
+            self._waiters[rid] = (loop, fut)
+        return await fut
+
+    # ---- model multiplexing (LoRA) ----
+
+    def load_adapter(self, model_id: str, lora_tree=None, alpha=None):
+        """Register a LoRA adapter under `model_id`. None = random demo
+        adapter (tests); production passes trained factors."""
+        import jax
+        cfg = self.cfg.lora
+        if cfg is None:
+            raise ValueError("llm_config.lora is not configured")
+        if len(self._adapters) >= cfg.max_adapters_per_replica:
+            self._adapters.pop(next(iter(self._adapters)))
+        if lora_tree is None:
+            lora_tree = init_lora(self.model_cfg, cfg.rank,
+                                  jax.random.PRNGKey(hash(model_id) % 2**31))
+        merged = merge_lora(self._base_params, lora_tree,
+                            alpha or cfg.alpha, cfg.rank)
+        self._adapters[model_id] = merged
+        return list(self._adapters)
+
+    def _params_for(self, model: str | None):
+        if model is None or model == self.cfg.model_id:
+            return self._base_params
+        merged = self._adapters.get(model)
+        if merged is None:
+            raise ValueError(f"model {model!r} is not loaded on this replica")
+        return merged
+
+    # ---- request API (called via handle) ----
+
+    async def completions(self, prompt: str, *, max_tokens=None,
+                          temperature=None, model=None) -> dict:
+        # Adapter swap: engine params are per-step state, so point the
+        # engine at the requested tree. Mixed-adapter batches decode with
+        # the most recent selection (documented simplification).
+        self.engine.params = self._params_for(model)
+        ids = self.tokenizer.encode(prompt)
+        req = await self._submit(ids, max_tokens, temperature)
+        text = self.tokenizer.decode(req.generated)
+        return {
+            "id": f"cmpl-{uuid.uuid4().hex[:24]}",
+            "object": "text_completion",
+            "model": model or self.cfg.model_id,
+            "choices": [{"index": 0, "text": text,
+                         "finish_reason": "stop"}],
+            "usage": {"prompt_tokens": len(ids),
+                      "completion_tokens": len(req.generated),
+                      "total_tokens": len(ids) + len(req.generated)},
+        }
+
+    async def chat(self, messages: list, *, max_tokens=None,
+                   temperature=None, model=None) -> dict:
+        prompt = "".join(
+            f"<|{m.get('role', 'user')}|>{m.get('content', '')}"
+            for m in messages) + "<|assistant|>"
+        out = await self.completions(prompt, max_tokens=max_tokens,
+                                     temperature=temperature, model=model)
+        return {
+            "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
+            "object": "chat.completion",
+            "model": out["model"],
+            "choices": [{"index": 0,
+                         "message": {"role": "assistant",
+                                     "content": out["choices"][0]["text"]},
+                         "finish_reason": "stop"}],
+            "usage": out["usage"],
+        }
+
+    def model_ids(self) -> list:
+        return [self.cfg.model_id, *self._adapters]
+
+    def __del__(self):
+        self._stop = True
+
+
+class _OpenAiRouterImpl:
+    """OpenAI-surface ingress: /v1/models, /v1/completions,
+    /v1/chat/completions (parity: deployments/routers/router.py)."""
+
+    def __init__(self, server_handle):
+        self.server = server_handle
+
+    async def __call__(self, request):
+        import json
+        path = request.path
+        if path == "/v1/models":
+            ids = await self.server.model_ids.remote()
+            return {"object": "list",
+                    "data": [{"id": i, "object": "model"} for i in ids]}
+        if request.method != "POST":
+            return 405, {"error": "method not allowed"}
+        try:
+            body = json.loads(request.body or b"{}")
+        except json.JSONDecodeError:
+            return 400, {"error": "invalid JSON body"}
+        try:
+            if path == "/v1/completions":
+                return await self.server.completions.remote(
+                    body.get("prompt", ""),
+                    max_tokens=body.get("max_tokens"),
+                    temperature=body.get("temperature"),
+                    model=body.get("model"))
+            if path == "/v1/chat/completions":
+                return await self.server.chat.remote(
+                    body.get("messages", []),
+                    max_tokens=body.get("max_tokens"),
+                    temperature=body.get("temperature"),
+                    model=body.get("model"))
+        except Exception as e:  # noqa: BLE001 — surface as API error
+            return 400, {"error": str(e)}
+        return 404, {"error": f"no route {path}"}
+
+
+def build_llm_deployment(llm_config: LLMConfig):
+    d = serve.deployment(
+        _LLMServerImpl, name=f"LLMServer:{llm_config.model_id}")
+    return d.options(
+        num_replicas=llm_config.num_replicas,
+        ray_actor_options={"num_tpus": llm_config.num_tpus_per_replica},
+    ).bind(llm_config)
+
+
+def build_openai_app(llm_config: LLMConfig):
+    """Parity: reference `build_openai_app` — OpenAI router in front of an
+    engine deployment; `serve.run(app)` serves it over HTTP."""
+    server = build_llm_deployment(llm_config)
+    router = serve.deployment(_OpenAiRouterImpl, name="OpenAiRouter")
+    return router.bind(server)

@@ -116,8 +116,17 @@ class IMPALA(Algorithm):
             self._broadcast()
             for i in range(len(self.env_runner_group.remotes)):
                 self._launch(i)
-        params = self.learner_group.get_weights()
         metrics = {}
+
+        def live_params():
+            # Target-logp wants the freshest params; with a local learner
+            # use its device tree directly (no device->host round trip —
+            # get_weights() would copy the full tree per fragment).
+            if self.learner_group.local is not None:
+                return self.learner_group.local.params
+            return self.learner_group.get_weights()
+
+        params = live_params()
         steps = 0
         while steps < c.train_batch_size:
             ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1,
@@ -150,7 +159,7 @@ class IMPALA(Algorithm):
                 "pg_advantages": np.asarray(pg_adv).reshape(-1),
             }
             metrics = self.learner_group.update(batch)
-            params = self.learner_group.get_weights()
+            params = live_params()
             steps += T * B
             self._updates_since_broadcast += 1
             if self._updates_since_broadcast >= c.broadcast_interval:

@@ -29,7 +29,7 @@ class Learner:
 
     def __init__(self, module, loss_fn, *, lr=3e-4, seed=0,
                  grad_clip: float | None = None, optimizer=None,
-                 loss_cfg: dict | None = None, mesh=None):
+                 loss_cfg: dict | None = None, mesh=None, fused=True):
         self.module = module
         self.params = module.init(jax.random.PRNGKey(seed))
         tx = [optax.clip_by_global_norm(grad_clip)] if grad_clip else []
@@ -38,6 +38,11 @@ class Learner:
         self.opt_state = self.tx.init(self.params)
         self.mesh = mesh
         loss_cfg = dict(loss_cfg or {})
+        if not fused:
+            # Subclasses that split grad/allreduce/apply skip the fused jit
+            # (it would just hold a dead second copy of the pipeline).
+            self._update = None
+            return
 
         def _update(params, opt_state, batch):
             (loss, aux), grads = jax.value_and_grad(
@@ -84,7 +89,7 @@ class _CollectiveLearner(Learner):
         from ray_tpu.util import collective
         self.rank, self.world, self.group = rank, world, group
         collective.init_collective_group(world, rank, group_name=group)
-        super().__init__(module, loss_fn, **kw)
+        super().__init__(module, loss_fn, fused=False, **kw)
         # Split update: grads computed jitted, allreduced host-side, applied.
         loss_cfg = dict(kw.get("loss_cfg") or {})
         self._grad_fn = jax.jit(

@@ -16,6 +16,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import sys
 import tempfile
 import time
 from typing import Any, Callable
@@ -107,6 +108,7 @@ class Trial:
         self.history: list[dict] = []
         self.latest_checkpoint: str | None = None
         self.error: str | None = None
+        self.last_poll_seq = 0
         self.rungs_hit: set = set()
         self.last_perturb = 0
         self.exploit_from: "Trial | None" = None
@@ -158,8 +160,7 @@ class _TrialRunner:
                 if isinstance(out, dict):  # final-dict trainable style
                     s.report(out)
             except BaseException:  # noqa: BLE001 — ship to controller
-                s.reports.append({"error": traceback.format_exc()})
-                s.error = True
+                s.error = traceback.format_exc()
             finally:
                 s.finished = True
 
@@ -168,14 +169,22 @@ class _TrialRunner:
         return True
 
     def poll(self):
+        """Returns {"reports", "finished", "error", "seq"}. The error is
+        TERMINAL SESSION STATE, not a drained report: a lost/duplicated
+        poll reply then cannot lose it — the next poll re-reads it. `seq`
+        counts executed polls so the controller can spot replies that were
+        executed but never consumed (message-loss diagnostics)."""
         s = self._session
         if s is None:
-            return [], False
+            return {"reports": [], "finished": False, "error": None,
+                    "seq": 0}
         # Read finished BEFORE draining: the loop thread appends its final
         # report before setting finished, so this order can't lose it
         # (drain-then-read could: drain empty -> report lands -> read True).
         finished = s.finished
-        return s.drain_reports(), finished
+        self._poll_seq = getattr(self, "_poll_seq", 0) + 1
+        return {"reports": s.drain_reports(), "finished": finished,
+                "error": s.error, "seq": self._poll_seq}
 
 
 class TuneController:
@@ -202,6 +211,8 @@ class TuneController:
         opts = {"num_cpus": float(self.resources.get("cpu", 1)),
                 "num_tpus": float(self.resources.get("tpu", 0))}
         trial.runner = _TrialRunner.options(**opts).remote(trial.storage_dir)
+        trial.last_poll_seq = 0  # fresh runner, fresh poll stream
+        trial.error = None  # a relaunch (PBT exploit) supersedes old errors
         ckpt = trial.restore_from or trial.latest_checkpoint
         trial.runner.start.remote(
             self.fn_bytes, trial.config, ckpt)
@@ -242,14 +253,22 @@ class TuneController:
                      if t.runner is not None]
             for trial, ref in polls:
                 try:
-                    reports, finished = ray_tpu.get(ref, timeout=60)
+                    poll = ray_tpu.get(ref, timeout=60)
                 except Exception as e:  # noqa: BLE001 — runner died
                     trial.state = ERRORED
                     trial.error = f"trial runner died: {e}"
                     self._stop_runner(trial)
                     continue
-                self._process_reports(trial, reports)
-                if finished and trial.state == RUNNING:
+                seq = poll.get("seq", 0)
+                if trial.last_poll_seq and seq > trial.last_poll_seq + 1:
+                    print(f"tune: WARNING trial {trial.id} poll seq jumped "
+                          f"{trial.last_poll_seq}->{seq}: a poll reply was "
+                          f"executed but never consumed", file=sys.stderr)
+                trial.last_poll_seq = seq
+                if poll.get("error") and not trial.error:
+                    trial.error = poll["error"]
+                self._process_reports(trial, poll["reports"])
+                if poll["finished"] and trial.state == RUNNING:
                     trial.state = (ERRORED if trial.error else TERMINATED)
                     self._stop_runner(trial)
             self._save_experiment_state()
@@ -259,9 +278,6 @@ class TuneController:
 
     def _process_reports(self, trial: Trial, reports: list[dict]):
         for rep in reports:
-            if "error" in rep:
-                trial.error = rep["error"]
-                continue
             metrics = dict(rep.get("metrics", {}))
             trial.iteration += 1
             metrics.setdefault("training_iteration", trial.iteration)
