@@ -164,6 +164,11 @@ class ActorMethod:
         out = [ObjectRef(ObjectID(rid)) for rid in return_ids]
         return out[0] if num_returns == 1 else out
 
+    def bind(self, *args, **kwargs):
+        """DAG-building edge (parity: dag/class_node.py bind)."""
+        from ray_tpu.dag import ClassMethodNode
+        return ClassMethodNode(self._handle, self._name, args, kwargs)
+
     def __call__(self, *a, **kw):
         raise TypeError(f"Actor method {self._name} must be called with .remote()")
 

@@ -43,6 +43,12 @@ class RemoteFunction:
     def remote(self, *args, **kwargs):
         return self._remote(args, kwargs, self._options)
 
+    def bind(self, *args, **kwargs):
+        """Task-DAG edge (parity: dag/function_node.py bind; consumed by
+        ray_tpu.workflow)."""
+        from ray_tpu.workflow import FunctionNode
+        return FunctionNode(self, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"Remote function {self.__name__} cannot be called directly; "

@@ -355,6 +355,14 @@ def _run_actor_async(rt: WorkerRuntime, max_concurrency: int):
 
 
 def _actor_method(rt: WorkerRuntime, spec: TaskSpec):
+    if spec.method_name == "__run_with_instance__":
+        # Escape hatch used by compiled graphs (ray_tpu.dag): the first task
+        # argument is a pickled fn(instance, *rest) executed against the
+        # live actor instance (parity: the injected do_exec_tasks loop,
+        # reference dag/compiled_dag_node.py:193).
+        def run(fn, *args, **kwargs):
+            return fn(rt.actor_instance, *args, **kwargs)
+        return run
     method = getattr(rt.actor_instance, spec.method_name)
     return method
 

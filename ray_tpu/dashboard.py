@@ -1,0 +1,95 @@
+"""Dashboard: HTTP introspection endpoints + Prometheus scrape target.
+
+Parity: reference `python/ray/dashboard/` (aiohttp head server, head.py:64,
+with node/job/metrics/state modules and a React frontend). Scope here: the
+machine-facing surface — JSON state endpoints the reference's frontend and
+`ray status` consume, plus /metrics for Prometheus (metrics module) and a
+minimal human landing page. Runs as a daemon thread in the head process.
+
+Routes: /api/cluster_status /api/nodes /api/actors /api/tasks /api/objects
+        /api/workers /api/placement_groups /api/jobs /metrics /
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _send(self, status: int, body: bytes, ctype: str):
+        self.send_response(status)
+        self.send_header("content-type", ctype)
+        self.send_header("content-length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, obj):
+        self._send(200, json.dumps(obj, default=str).encode(),
+                   "application/json")
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        from ray_tpu.util import state
+        from ray_tpu.util.metrics import prometheus_text
+        try:
+            path = self.path.split("?")[0]
+            if path == "/metrics":
+                self._send(200, prometheus_text().encode(),
+                           "text/plain; version=0.0.4")
+            elif path == "/api/cluster_status":
+                self._json(state.cluster_status())
+            elif path == "/api/nodes":
+                self._json(state.list_nodes())
+            elif path == "/api/actors":
+                self._json(state.list_actors())
+            elif path == "/api/tasks":
+                self._json(state.list_tasks())
+            elif path == "/api/objects":
+                self._json(state.list_objects())
+            elif path == "/api/workers":
+                self._json(state.list_workers())
+            elif path == "/api/placement_groups":
+                self._json(state.list_placement_groups())
+            elif path == "/api/jobs":
+                from ray_tpu import job_submission
+                self._json([j.to_dict()
+                            for j in job_submission.list_jobs()])
+            elif path == "/":
+                body = ("<html><body><h2>ray_tpu dashboard</h2><ul>" +
+                        "".join(f'<li><a href="{r}">{r}</a></li>' for r in (
+                            "/api/cluster_status", "/api/nodes",
+                            "/api/actors", "/api/tasks", "/api/objects",
+                            "/api/workers", "/api/placement_groups",
+                            "/api/jobs", "/metrics")) +
+                        "</ul></body></html>").encode()
+                self._send(200, body, "text/html")
+            else:
+                self._send(404, b"not found", "text/plain")
+        except Exception as e:  # noqa: BLE001 — a broken route must not
+            self._send(500, str(e).encode(), "text/plain")
+
+
+_server = None
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 0) -> str:
+    """Start (or return) the dashboard; returns its address."""
+    global _server
+    if _server is not None:
+        return "{}:{}".format(*_server.server_address)
+    _server = ThreadingHTTPServer((host, port), _Handler)
+    threading.Thread(target=_server.serve_forever, daemon=True,
+                     name="rtpu-dashboard").start()
+    return "{}:{}".format(*_server.server_address)
+
+
+def stop_dashboard():
+    global _server
+    if _server is not None:
+        _server.shutdown()
+        _server.server_close()
+        _server = None

@@ -1,0 +1,145 @@
+"""Mutable shared-memory channels (seqlock + per-reader acks).
+
+Parity: reference experimental mutable plasma objects + shm channels
+(`src/ray/core_worker/experimental_mutable_object_manager.h:44`,
+`python/ray/experimental/channel/shared_memory_channel.py`) — the data
+plane under Compiled Graphs. One writer, a fixed set of readers; a version
+seqlock (odd = write in progress) makes reads lock-free, and per-reader ack
+slots give the writer backpressure (it blocks until every reader consumed
+the previous value — same flow control as the reference's mutable-object
+WriteAcquire waiting on ReadRelease). Same-node only (the region is a
+/dev/shm mmap); cross-node edges belong to the object plane.
+
+Layout: [u64 version][u64 payload_len][u64 n_readers][u64 ack x 8][payload]
+Each ack slot is written by exactly one reader (its last-read version), so
+there are no cross-process read-modify-write races.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import pickle
+import struct
+import time
+import uuid
+
+MAX_READERS = 8
+_HDR = struct.Struct(f"<QQQ{MAX_READERS}Q")
+
+_CLOSE = b"\x00__ray_tpu_channel_closed__"
+
+
+class ChannelClosedError(RuntimeError):
+    pass
+
+
+class Channel:
+    """One writer, n_readers consumers. The writer constructs with
+    create=True; each reader opens a cursor with its assigned reader_idx."""
+
+    def __init__(self, path: str | None = None, capacity: int = 1 << 20,
+                 create: bool = False, n_readers: int = 1,
+                 reader_idx: int = 0):
+        if n_readers > MAX_READERS:
+            raise ValueError(f"at most {MAX_READERS} readers per channel")
+        shm_dir = "/dev/shm" if os.path.isdir("/dev/shm") else "/tmp"
+        self.path = path or os.path.join(
+            shm_dir, f"ray_tpu_chan_{uuid.uuid4().hex[:16]}")
+        self.capacity = capacity
+        self.reader_idx = reader_idx
+        total = _HDR.size + capacity
+        if create:
+            fd = os.open(self.path, os.O_CREAT | os.O_RDWR | os.O_EXCL,
+                         0o600)
+            os.ftruncate(fd, total)
+        else:
+            fd = os.open(self.path, os.O_RDWR)
+            total = os.fstat(fd).st_size
+            self.capacity = total - _HDR.size
+        try:
+            self._mm = mmap.mmap(fd, total)
+        finally:
+            os.close(fd)
+        if create:
+            struct.pack_into("<Q", self._mm, 16, n_readers)
+        self._last_version = 0
+
+    def _hdr(self):
+        vals = _HDR.unpack_from(self._mm, 0)
+        return vals[0], vals[1], vals[2], vals[3:3 + MAX_READERS]
+
+    # -- writer side --
+
+    def write(self, value, timeout: float | None = 60.0):
+        self.write_bytes(pickle.dumps(value, protocol=5), timeout)
+
+    def write_bytes(self, payload: bytes, timeout: float | None = 60.0):
+        if len(payload) > self.capacity:
+            raise ValueError(
+                f"value of {len(payload)} bytes exceeds channel capacity "
+                f"{self.capacity}")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        delay = 5e-5
+        while True:  # backpressure: all readers must have consumed
+            version, _, n_readers, acks = self._hdr()
+            if version == 0 or all(a >= version
+                                   for a in acks[:n_readers]):
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"channel write blocked on slow readers ({self.path})")
+            time.sleep(delay)
+            delay = min(delay * 2, 1e-3)
+        struct.pack_into("<Q", self._mm, 0, version + 1)  # odd: writing
+        self._mm[_HDR.size:_HDR.size + len(payload)] = payload
+        struct.pack_into("<QQ", self._mm, 0, version + 2, len(payload))
+
+    def close_writer(self, timeout: float | None = 10.0):
+        """Signal EOF to readers."""
+        try:
+            self.write_bytes(_CLOSE, timeout)
+        except (ValueError, OSError, TimeoutError):
+            pass
+
+    # -- reader side --
+
+    def read(self, timeout: float | None = 60.0):
+        """Block until a version newer than this cursor's last read; ack it
+        so the writer may proceed."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        delay = 5e-5
+        while True:
+            version, length, _n, _acks = self._hdr()
+            if version > self._last_version and version % 2 == 0:
+                payload = bytes(self._mm[_HDR.size:_HDR.size + length])
+                v2, = struct.unpack_from("<Q", self._mm, 0)
+                if v2 == version:  # seqlock: no concurrent write observed
+                    self._last_version = version
+                    struct.pack_into("<Q", self._mm,
+                                     24 + 8 * self.reader_idx, version)
+                    if payload == _CLOSE:
+                        raise ChannelClosedError(self.path)
+                    return pickle.loads(payload)
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"channel read timed out ({self.path})")
+            time.sleep(delay)
+            delay = min(delay * 2, 1e-3)
+
+    # -- lifecycle --
+
+    def close(self):
+        try:
+            self._mm.close()
+        except BufferError:
+            pass
+
+    def unlink(self):
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+    def __reduce__(self):
+        return (Channel, (self.path, self.capacity, False, 1,
+                          self.reader_idx))

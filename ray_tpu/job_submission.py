@@ -1,0 +1,185 @@
+"""Job submission: run driver entrypoints as supervised cluster jobs.
+
+Parity: reference `python/ray/dashboard/modules/job/` — `JobManager`
+(job_manager.py:60) spawns a per-job `JobSupervisor` actor
+(job_supervisor.py:55) that runs the entrypoint as a subprocess, captures
+logs, and reports a PENDING/RUNNING/SUCCEEDED/FAILED/STOPPED status FSM.
+The supervisor here is the same shape: an actor owning the subprocess, so
+job lifetime detaches from the submitting client.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+import uuid
+
+import ray_tpu
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+SUCCEEDED = "SUCCEEDED"
+FAILED = "FAILED"
+STOPPED = "STOPPED"
+
+
+@dataclasses.dataclass
+class JobDetails:
+    submission_id: str
+    entrypoint: str
+    status: str
+    start_time: float
+    end_time: float | None = None
+    message: str = ""
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class JobSupervisor:
+    """One per job; owns the entrypoint subprocess."""
+
+    def __init__(self, submission_id: str, entrypoint: str,
+                 runtime_env: dict | None, log_path: str):
+        import subprocess
+        self.submission_id = submission_id
+        self.entrypoint = entrypoint
+        self.log_path = log_path
+        self.start_time = time.time()
+        self.end_time = None
+        self.stopped = False
+        env = dict(os.environ)
+        env.update((runtime_env or {}).get("env_vars", {}))
+        cwd = (runtime_env or {}).get("working_dir") or None
+        self.log_f = open(log_path, "ab")
+        self.proc = subprocess.Popen(
+            entrypoint, shell=True, env=env, cwd=cwd,
+            stdout=self.log_f, stderr=subprocess.STDOUT,
+            start_new_session=True)  # own pgid: stop() kills the tree
+
+    def status(self) -> dict:
+        rc = self.proc.poll()
+        if self.stopped:
+            status, msg = STOPPED, "stopped by user"
+        elif rc is None:
+            status, msg = RUNNING, ""
+        elif rc == 0:
+            status, msg = SUCCEEDED, ""
+        else:
+            status, msg = FAILED, f"entrypoint exited with code {rc}"
+        if rc is not None and self.end_time is None:
+            self.end_time = time.time()
+        return {"status": status, "message": msg,
+                "start_time": self.start_time, "end_time": self.end_time}
+
+    def stop(self) -> bool:
+        import signal
+        if self.proc.poll() is None:
+            self.stopped = True
+            try:
+                os.killpg(os.getpgid(self.proc.pid), signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+            try:
+                self.proc.wait(timeout=5)
+            except Exception:  # noqa: BLE001
+                try:
+                    os.killpg(os.getpgid(self.proc.pid), signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+        return True
+
+    def logs(self) -> str:
+        self.log_f.flush()
+        try:
+            with open(self.log_path, "rb") as f:
+                return f.read().decode(errors="replace")
+        except FileNotFoundError:
+            return ""
+
+
+class JobSubmissionClient:
+    """Parity: ray.job_submission.JobSubmissionClient (in-cluster mode —
+    the client talks to supervisor actors through the head, the way the
+    reference's REST head fronts JobManager)."""
+
+    def __init__(self, address: str | None = None):
+        from ray_tpu.core.runtime import get_runtime
+        self._rt = get_runtime()  # job table = the head KV ("job", id) rows
+
+    def submit_job(self, *, entrypoint: str, submission_id: str | None = None,
+                   runtime_env: dict | None = None) -> str:
+        submission_id = submission_id or f"raysubmit_{uuid.uuid4().hex[:16]}"
+        log_dir = os.path.join(self._rt.session_dir, "logs")
+        log_path = os.path.join(log_dir, f"job-{submission_id}.log")
+        sup_cls = ray_tpu.remote(num_cpus=0)(JobSupervisor)
+        actor = sup_cls.options(name=f"_job_supervisor:{submission_id}").remote(
+            submission_id, entrypoint, runtime_env, log_path)
+        ray_tpu.get(actor.status.remote(), timeout=60)  # started
+        self._rt.kv[("job", submission_id)] = entrypoint.encode()
+        return submission_id
+
+    def _supervisor(self, submission_id: str):
+        return ray_tpu.get_actor(f"_job_supervisor:{submission_id}")
+
+    def get_job_status(self, submission_id: str) -> str:
+        try:
+            st = ray_tpu.get(
+                self._supervisor(submission_id).status.remote(), timeout=60)
+        except (ValueError, ray_tpu.RayTpuError):
+            return FAILED  # supervisor gone
+        return st["status"]
+
+    def get_job_info(self, submission_id: str) -> JobDetails:
+        entry = self._rt.kv.get(("job", submission_id), b"").decode()
+        try:
+            st = ray_tpu.get(
+                self._supervisor(submission_id).status.remote(), timeout=60)
+        except (ValueError, ray_tpu.RayTpuError):
+            st = {"status": FAILED, "message": "supervisor dead",
+                  "start_time": 0.0, "end_time": None}
+        return JobDetails(submission_id, entry, st["status"],
+                          st["start_time"], st["end_time"], st["message"])
+
+    def get_job_logs(self, submission_id: str) -> str:
+        return ray_tpu.get(self._supervisor(submission_id).logs.remote(),
+                           timeout=60)
+
+    def stop_job(self, submission_id: str) -> bool:
+        return ray_tpu.get(self._supervisor(submission_id).stop.remote(),
+                           timeout=60)
+
+    def delete_job(self, submission_id: str):
+        self.stop_job(submission_id)
+        try:
+            ray_tpu.kill(self._supervisor(submission_id))
+        except ValueError:
+            pass
+        self._rt.kv.pop(("job", submission_id), None)
+
+    def list_jobs(self) -> list[JobDetails]:
+        out = []
+        for key in list(self._rt.kv):
+            if isinstance(key, tuple) and key[0] == "job":
+                out.append(self.get_job_info(key[1]))
+        return out
+
+    def tail_job_logs(self, submission_id: str):
+        """Generator yielding log increments until the job finishes."""
+        seen = 0
+        while True:
+            logs = self.get_job_logs(submission_id)
+            if len(logs) > seen:
+                yield logs[seen:]
+                seen = len(logs)
+            if self.get_job_status(submission_id) not in (PENDING, RUNNING):
+                logs = self.get_job_logs(submission_id)
+                if len(logs) > seen:
+                    yield logs[seen:]
+                return
+            time.sleep(0.2)
+
+
+def list_jobs() -> list[JobDetails]:
+    return JobSubmissionClient().list_jobs()

@@ -267,12 +267,19 @@ class InferenceEngine:
     # ---- scheduling ----
 
     def _bucket(self, n: int) -> int:
-        for b in self.e.prompt_buckets:
+        # Buckets above max_len are unusable: their prefill KV could not be
+        # spliced into the [.., max_len, ..] cache.
+        usable = [b for b in self.e.prompt_buckets if b <= self.e.max_len]
+        limit = min(max(usable, default=0), self.e.max_len - 1)
+        if n > limit:
+            raise ValueError(
+                f"prompt of {n} tokens exceeds the engine limit {limit} "
+                f"(buckets={self.e.prompt_buckets}, "
+                f"max_len={self.e.max_len})")
+        for b in usable:
             if n <= b:
                 return b
-        raise ValueError(
-            f"prompt of {n} tokens exceeds the largest bucket "
-            f"{self.e.prompt_buckets[-1]}")
+        raise ValueError(f"no prompt bucket fits {n} tokens")
 
     def _admit(self) -> dict[int, int]:
         admitted: dict[int, int] = {}

@@ -108,8 +108,10 @@ class _LLMServerImpl:
         if lora_tree is None:
             lora_tree = init_lora(self.model_cfg, cfg.rank,
                                   jax.random.PRNGKey(hash(model_id) % 2**31))
+        # rank inferred from the tree itself: a trained adapter's rank wins
+        # over the config default (wrong rank silently mis-scales).
         merged = merge_lora(self._base_params, lora_tree,
-                            alpha or cfg.alpha, cfg.rank)
+                            alpha or cfg.alpha)
         self._adapters[model_id] = merged
         return list(self._adapters)
 
@@ -118,7 +120,14 @@ class _LLMServerImpl:
             return self._base_params
         merged = self._adapters.get(model)
         if merged is None:
-            raise ValueError(f"model {model!r} is not loaded on this replica")
+            if self.cfg.lora is None:
+                raise ValueError(
+                    f"model {model!r} unknown and LoRA is not configured")
+            # Lazy load-on-request: every replica can serve every adapter
+            # (LRU-capped), so the pow-2 router needs no replica pinning
+            # (parity: serve multiplexing pulling models on demand).
+            self.load_adapter(model)
+            merged = self._adapters[model]
         return merged
 
     # ---- request API (called via handle) ----

@@ -34,3 +34,8 @@ def bench_125m(**kw) -> ModelConfig:
     """Single-chip bench scale (GPT-small geometry)."""
     return ModelConfig(vocab=32000, d_model=768, n_layers=12, n_heads=12,
                        n_kv_heads=12, d_ff=3072, dtype="bfloat16", **kw)
+
+
+def llama_125m(**kw) -> ModelConfig:
+    """Default serving scale (alias of the bench geometry)."""
+    return bench_125m(**kw)

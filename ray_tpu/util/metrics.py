@@ -1,0 +1,148 @@
+"""Application metrics: Counter/Gauge/Histogram + Prometheus exposition.
+
+Parity: reference `python/ray/util/metrics.py` (user-defined metrics via
+the Cython metric bridge) and the per-node metrics agent's Prometheus
+endpoint (`_private/metrics_agent.py:492`, `prometheus_exporter.py`). Here
+metrics registered in the driver process are rendered straight into the
+Prometheus text format by the dashboard's /metrics route.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_REGISTRY: dict[str, "Metric"] = {}
+_LOCK = threading.Lock()
+
+
+class Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: tuple = ()):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+        with _LOCK:
+            _REGISTRY[name] = self
+
+    def _key(self, tags: dict | None) -> tuple:
+        tags = tags or {}
+        return tuple(str(tags.get(k, "")) for k in self.tag_keys)
+
+    def _fmt_labels(self, key: tuple) -> str:
+        if not self.tag_keys:
+            return ""
+        inner = ",".join(f'{k}="{v}"' for k, v in zip(self.tag_keys, key))
+        return "{" + inner + "}"
+
+    def expose(self) -> list[str]:
+        lines = [f"# HELP {self.name} {self.description}",
+                 f"# TYPE {self.name} {self.kind}"]
+        with self._lock:
+            items = list(self._values.items()) or [((), 0.0)] \
+                if not self.tag_keys else list(self._values.items())
+        for key, v in items:
+            lines.append(f"{self.name}{self._fmt_labels(key)} {v}")
+        return lines
+
+
+class Counter(Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0, tags: dict | None = None):
+        k = self._key(tags)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+
+
+class Gauge(Metric):
+    kind = "gauge"
+
+    def set(self, value: float, tags: dict | None = None):
+        with self._lock:
+            self._values[self._key(tags)] = float(value)
+
+
+class Histogram(Metric):
+    kind = "histogram"
+
+    def __init__(self, name, description="", boundaries=(0.1, 1, 10, 100),
+                 tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = tuple(boundaries)
+        self._buckets: dict[tuple, list] = {}
+        self._sums: dict[tuple, float] = {}
+        self._counts: dict[tuple, int] = {}
+
+    def observe(self, value: float, tags: dict | None = None):
+        k = self._key(tags)
+        with self._lock:
+            b = self._buckets.setdefault(k, [0] * (len(self.boundaries) + 1))
+            for i, bound in enumerate(self.boundaries):
+                if value <= bound:
+                    b[i] += 1
+                    break
+            else:
+                b[-1] += 1
+            self._sums[k] = self._sums.get(k, 0.0) + value
+            self._counts[k] = self._counts.get(k, 0) + 1
+
+    def expose(self) -> list[str]:
+        lines = [f"# HELP {self.name} {self.description}",
+                 f"# TYPE {self.name} histogram"]
+        with self._lock:
+            for k, buckets in self._buckets.items():
+                base = self._fmt_labels(k)[1:-1] if self.tag_keys else ""
+                cum = 0
+                for bound, n in zip(self.boundaries, buckets):
+                    cum += n
+                    sep = "," if base else ""
+                    lines.append(
+                        f'{self.name}_bucket{{{base}{sep}le="{bound}"}} '
+                        f'{cum}')
+                cum += buckets[-1]
+                sep = "," if base else ""
+                lines.append(
+                    f'{self.name}_bucket{{{base}{sep}le="+Inf"}} {cum}')
+                suffix = "{" + base + "}" if base else ""
+                lines.append(f"{self.name}_sum{suffix} {self._sums[k]}")
+                lines.append(f"{self.name}_count{suffix} {self._counts[k]}")
+        return lines
+
+
+def _system_lines() -> list[str]:
+    """Built-in cluster gauges rendered at scrape time (parity: the ~90
+    C++ metric defs, stats/metric_defs.cc — the high-signal subset)."""
+    from ray_tpu.core.runtime import Runtime, current_runtime
+    rt = current_runtime()
+    lines = []
+    if not isinstance(rt, Runtime):
+        return lines
+    stats = rt.store.stats()
+    rows = [
+        ("ray_tpu_object_store_allocated_bytes", stats["allocated"]),
+        ("ray_tpu_object_store_capacity_bytes", stats["capacity"]),
+        ("ray_tpu_object_store_num_objects", stats["num_objects"]),
+        ("ray_tpu_object_store_num_evictions", stats["num_evictions"]),
+        ("ray_tpu_pending_tasks", len(rt.task_queue)),
+        ("ray_tpu_alive_nodes",
+         sum(1 for n in rt.nodes_table() if n["alive"])),
+        ("ray_tpu_workers", len(rt.workers)),
+        ("ray_tpu_actors_alive",
+         sum(1 for a in rt.actors.values() if a.state == "alive")),
+    ]
+    for name, v in rows:
+        lines += [f"# TYPE {name} gauge", f"{name} {v}"]
+    return lines
+
+
+def prometheus_text() -> str:
+    with _LOCK:
+        metrics = list(_REGISTRY.values())
+    lines: list[str] = _system_lines()
+    for m in metrics:
+        lines += m.expose()
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,120 @@
+"""State API: cluster introspection (list/summarize live entities).
+
+Parity: reference `python/ray/util/state/` (`ray list
+tasks/actors/objects/nodes/workers`, `ray summary tasks` — backed by
+`state_manager.py:107` fanning out to GCS + agents). Here the head runtime
+IS the control plane, so listing reads its tables directly; remote callers
+go through the worker request channel.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def _rt():
+    from ray_tpu.core.runtime import Runtime, get_runtime
+    rt = get_runtime()
+    if not isinstance(rt, Runtime):
+        raise RuntimeError("the state API runs on the driver (head) process")
+    return rt
+
+
+def list_nodes() -> list[dict]:
+    return _rt().nodes_table()
+
+
+def list_workers() -> list[dict]:
+    rt = _rt()
+    out = []
+    for wid, w in list(rt.workers.items()):
+        out.append({
+            "worker_id": wid.hex(),
+            "node_id": w.node_id.hex() if w.node_id else "",
+            "state": w.state,
+            "is_actor": w.actor_id is not None,
+            "pid": getattr(w.proc, "pid", None),
+        })
+    return out
+
+
+def list_actors() -> list[dict]:
+    rt = _rt()
+    registered = {aid: name for name, aid in rt.named_actors.items()}
+    out = []
+    for aid, st in list(rt.actors.items()):
+        out.append({
+            "actor_id": aid.hex(),
+            "class_name": st.cspec.name,
+            "state": st.state.upper(),
+            "name": registered.get(aid, ""),
+            "node_id": st.node_id.hex() if st.node_id else "",
+            "restarts": st.cspec.restarts_used,
+            "pending_calls": len(st.queued) + len(st.inflight),
+        })
+    return out
+
+
+def list_tasks(limit: int = 1000) -> list[dict]:
+    """Recent task state transitions, newest last (backed by the head's
+    task-event ring, parity: gcs_task_manager.h:94 bounded storage)."""
+    rt = _rt()
+    latest: dict[bytes, dict] = {}
+    for ts, task_id, name, state in rt.task_events.events:
+        latest[task_id] = {"task_id": task_id.hex(), "name": name,
+                           "state": state, "ts": ts}
+    rows = sorted(latest.values(), key=lambda r: r["ts"])
+    return rows[-limit:]
+
+
+def list_objects(limit: int = 1000) -> list[dict]:
+    rt = _rt()
+    out = []
+    with rt.directory.lock:
+        items = list(rt.directory.entries.items())[:limit]
+    for oid, entry in items:
+        kind = entry[0]
+        locs = []
+        if kind == "shm" and len(entry) > 1:
+            locs = [nid.hex() for nid in entry[1]]
+        out.append({"object_id": oid.hex(), "kind": kind,
+                    "locations": locs})
+    return out
+
+
+def list_placement_groups() -> list[dict]:
+    rt = _rt()
+    table = rt.placement_group_table()
+    return [{"placement_group_id": pg_id, **row}
+            for pg_id, row in table.items()]
+
+
+def summarize_tasks() -> dict:
+    rt = _rt()
+    by_state: dict[str, int] = {}
+    for row in list_tasks(limit=100000):
+        by_state[row["state"]] = by_state.get(row["state"], 0) + 1
+    return {"by_state": by_state, "by_name": rt.task_events.summary()}
+
+
+def summarize_actors() -> dict:
+    by_state: dict[str, int] = {}
+    for row in list_actors():
+        by_state[row["state"]] = by_state.get(row["state"], 0) + 1
+    return {"by_state": by_state}
+
+
+def cluster_status() -> dict:
+    """One-call overview (what `ray status` prints)."""
+    rt = _rt()
+    return {
+        "timestamp": time.time(),
+        "nodes": {"alive": sum(1 for n in rt.nodes_table() if n["alive"]),
+                  "dead": sum(1 for n in rt.nodes_table()
+                              if not n["alive"])},
+        "resources": {"total": rt.cluster_resources(),
+                      "available": rt.available_resources()},
+        "pending_tasks": len(rt.task_queue),
+        "actors": summarize_actors()["by_state"],
+        "store": rt.store.stats(),
+    }

@@ -1,0 +1,229 @@
+"""Durable workflows: task DAGs whose step results persist and resume.
+
+Parity: reference `python/ray/workflow/` — `workflow.run` executes a DAG of
+tasks with every step result durably stored (`workflow_storage.py`), so a
+crashed/resumed workflow skips completed steps (`workflow_executor.py`,
+`workflow_state_from_dag.py`). Steps are plain `@ray_tpu.remote` tasks
+composed with `.bind()`; the executor dispatches every ready step to the
+cluster (parallel where the DAG allows), checkpointing each result.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import tempfile
+import threading
+import time
+
+import ray_tpu
+
+_DEFAULT_STORE = os.path.join(tempfile.gettempdir(), "ray_tpu_workflows")
+_storage_dir = None
+
+
+def init(storage: str | None = None):
+    global _storage_dir
+    _storage_dir = storage or _DEFAULT_STORE
+    os.makedirs(_storage_dir, exist_ok=True)
+
+
+def _store() -> str:
+    if _storage_dir is None:
+        init()
+    return _storage_dir
+
+
+class FunctionNode:
+    """A step: remote function + bound args (parity: dag/function_node.py)."""
+
+    def __init__(self, remote_fn, args, kwargs):
+        self.remote_fn = remote_fn
+        self.args = args
+        self.kwargs = kwargs
+
+    def _deps(self):
+        return ([a for a in self.args if isinstance(a, FunctionNode)]
+                + [v for v in self.kwargs.values()
+                   if isinstance(v, FunctionNode)])
+
+
+class WorkflowStorage:
+    """Filesystem layout: <root>/<workflow_id>/{status.json, steps/<id>.pkl}
+    (parity: workflow_storage.py step-result persistence)."""
+
+    def __init__(self, workflow_id: str):
+        self.root = os.path.join(_store(), workflow_id)
+        os.makedirs(os.path.join(self.root, "steps"), exist_ok=True)
+
+    def _step_path(self, step_id: str) -> str:
+        return os.path.join(self.root, "steps", f"{step_id}.pkl")
+
+    def has_step(self, step_id: str) -> bool:
+        return os.path.exists(self._step_path(step_id))
+
+    def load_step(self, step_id: str):
+        with open(self._step_path(step_id), "rb") as f:
+            return pickle.load(f)
+
+    def save_step(self, step_id: str, value):
+        tmp = self._step_path(step_id) + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(value, f)
+        os.replace(tmp, self._step_path(step_id))  # atomic: crash-safe
+
+    def set_status(self, status: str, **extra):
+        tmp = os.path.join(self.root, "status.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump({"status": status, "ts": time.time(), **extra}, f)
+        os.replace(tmp, os.path.join(self.root, "status.json"))
+
+    def get_status(self) -> dict:
+        try:
+            with open(os.path.join(self.root, "status.json")) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return {"status": "NOT_FOUND"}
+
+    def save_dag(self, dag: FunctionNode):
+        import cloudpickle
+        with open(os.path.join(self.root, "dag.pkl"), "wb") as f:
+            cloudpickle.dump(dag, f)
+
+    def load_dag(self) -> FunctionNode:
+        import cloudpickle
+        with open(os.path.join(self.root, "dag.pkl"), "rb") as f:
+            return cloudpickle.load(f)
+
+
+def _step_ids(dag: FunctionNode) -> dict[int, str]:
+    """Deterministic step ids: topo index + function name (stable across
+    resumes of the same DAG)."""
+    order: list[FunctionNode] = []
+    seen: set[int] = set()
+
+    def visit(n: FunctionNode):
+        if id(n) in seen:
+            return
+        seen.add(id(n))
+        for d in n._deps():
+            visit(d)
+        order.append(n)
+
+    visit(dag)
+    ids = {}
+    for i, n in enumerate(order):
+        name = getattr(n.remote_fn, "__name__", "step")
+        ids[id(n)] = f"{i:04d}_{hashlib.sha1(name.encode()).hexdigest()[:8]}"
+    return ids, order
+
+
+def _execute(workflow_id: str, dag: FunctionNode):
+    storage = WorkflowStorage(workflow_id)
+    storage.set_status("RUNNING")
+    ids, order = _step_ids(dag)
+    results: dict[int, object] = {}
+    pending = {id(n): n for n in order}
+    inflight: dict[int, tuple] = {}  # node id -> (ref, step_id)
+    try:
+        while pending or inflight:
+            # Launch every ready step (parallelism across DAG branches).
+            for nid, n in list(pending.items()):
+                if any(id(d) not in results for d in n._deps()):
+                    continue
+                step_id = ids[nid]
+                if storage.has_step(step_id):
+                    results[nid] = storage.load_step(step_id)
+                    del pending[nid]
+                    continue
+                args = [results[id(a)] if isinstance(a, FunctionNode) else a
+                        for a in n.args]
+                kwargs = {k: results[id(v)] if isinstance(v, FunctionNode)
+                          else v for k, v in n.kwargs.items()}
+                inflight[nid] = (n.remote_fn.remote(*args, **kwargs),
+                                 step_id)
+                del pending[nid]
+            if not inflight:
+                continue
+            refs = [ref for ref, _ in inflight.values()]
+            ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=300)
+            for nid, (ref, step_id) in list(inflight.items()):
+                if ref in ready:
+                    value = ray_tpu.get(ref, timeout=60)
+                    storage.save_step(step_id, value)
+                    results[nid] = value
+                    del inflight[nid]
+    except Exception as e:
+        storage.set_status("FAILED", error=str(e))
+        raise
+    out = results[id(dag)]
+    storage.set_status("SUCCESSFUL")
+    storage.save_step("__output__", out)
+    return out
+
+
+# ---------------- public API ----------------
+
+
+def run(dag: FunctionNode, *, workflow_id: str | None = None):
+    """Execute a task DAG durably; returns the output (parity:
+    workflow.run)."""
+    workflow_id = workflow_id or f"wf-{int(time.time() * 1000):x}"
+    storage = WorkflowStorage(workflow_id)
+    storage.save_dag(dag)
+    return _execute(workflow_id, dag)
+
+
+def run_async(dag: FunctionNode, *, workflow_id: str | None = None):
+    workflow_id = workflow_id or f"wf-{int(time.time() * 1000):x}"
+    WorkflowStorage(workflow_id).save_dag(dag)
+    box = {}
+
+    def target():
+        try:
+            box["result"] = _execute(workflow_id, dag)
+        except Exception as e:  # noqa: BLE001
+            box["error"] = e
+
+    t = threading.Thread(target=target, daemon=True)
+    t.start()
+    box["thread"] = t
+    box["workflow_id"] = workflow_id
+    return box
+
+
+def resume(workflow_id: str):
+    """Re-run a stored workflow; completed steps load from storage
+    (parity: workflow.resume)."""
+    storage = WorkflowStorage(workflow_id)
+    if storage.get_status().get("status") == "SUCCESSFUL":
+        return storage.load_step("__output__")
+    dag = storage.load_dag()
+    return _execute(workflow_id, dag)
+
+
+def get_status(workflow_id: str) -> str:
+    return WorkflowStorage(workflow_id).get_status().get("status")
+
+
+def get_output(workflow_id: str):
+    storage = WorkflowStorage(workflow_id)
+    if storage.get_status().get("status") != "SUCCESSFUL":
+        raise ValueError(f"workflow {workflow_id} has not succeeded")
+    return storage.load_step("__output__")
+
+
+def list_all() -> list[tuple[str, str]]:
+    root = _store()
+    out = []
+    for wid in sorted(os.listdir(root)):
+        st = WorkflowStorage(wid).get_status().get("status")
+        out.append((wid, st))
+    return out
+
+
+def delete(workflow_id: str):
+    import shutil
+    shutil.rmtree(os.path.join(_store(), workflow_id), ignore_errors=True)
