@@ -95,6 +95,7 @@ class ActorClass:
             bundle_index=_pg_bundle(opts),
             scheduling_strategy=opts.get("scheduling_strategy"),
             dependencies=[r.id.binary() for r in refs],
+            runtime_env=opts.get("runtime_env"),
         )
         cspec.methods_meta = self._meta
         if isinstance(rt, Runtime):

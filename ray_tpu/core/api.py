@@ -142,9 +142,30 @@ def get_node_id() -> str:
     return ""
 
 
-def timeline():
+def timeline(filename: str | None = None):
+    """Task state transitions; with `filename`, export a chrome://tracing
+    JSON (parity: ray.timeline(), _private/state.py:965)."""
     from ray_tpu.core.runtime import Runtime, get_runtime
     rt = get_runtime()
-    if isinstance(rt, Runtime):
-        return rt.timeline()
-    raise RayTpuError("timeline() is head-only")
+    if not isinstance(rt, Runtime):
+        raise RayTpuError("timeline() is head-only")
+    events = rt.timeline()
+    if filename is None:
+        return events
+    import json
+    # Pair RUNNING->FINISHED per task into complete ("X") trace events.
+    running: dict = {}
+    trace = []
+    for ts, task_id, name, state in events:
+        if state == "RUNNING":
+            running[task_id] = ts
+        elif state in ("FINISHED", "RETRY") and task_id in running:
+            t0 = running.pop(task_id)
+            trace.append({
+                "name": name, "cat": "task", "ph": "X",
+                "ts": t0 * 1e6, "dur": (ts - t0) * 1e6,
+                "pid": "ray_tpu", "tid": task_id.hex()[:8],
+            })
+    with open(filename, "w") as f:
+        json.dump(trace, f)
+    return trace

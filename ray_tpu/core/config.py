@@ -32,6 +32,9 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "max_pending_lease_requests": (int, 10, "in-flight lease requests per scheduling key"),
     "task_max_retries_default": (int, 3, "default retries for idempotent tasks"),
     "actor_max_restarts_default": (int, 0, "default actor restarts"),
+    # --- memory / OOM (parity: memory_monitor.h + worker killing policy) ---
+    "memory_monitor_refresh_ms": (int, 0, "OOM monitor interval; 0 = off"),
+    "memory_usage_threshold": (float, 0.95, "kill a worker above this usage"),
     # --- control plane ---
     "health_check_period_ms": (int, 1000, "node health-check interval"),
     "health_check_failure_threshold": (int, 5, "missed checks before a node is dead"),

@@ -82,6 +82,7 @@ class RemoteFunction:
             retries_left=max_retries,
             scheduling_strategy=opts.get("scheduling_strategy"),
             dependencies=[r.id.binary() for r in refs],
+            runtime_env=opts.get("runtime_env"),
         )
         if isinstance(rt, Runtime):
             rt.submit_task(spec, fn_blob)

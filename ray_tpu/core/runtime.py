@@ -524,7 +524,9 @@ class Runtime:
         self._fetch_attempts = 0
 
         self.workers: dict[bytes, WorkerHandle] = {}
-        self.task_queue: collections.deque[TaskSpec] = collections.deque()
+        # Per-scheduling-key task queues (parity: normal_task_submitter.h:58
+        # SchedulingKey — one reserve probe covers every queued sibling).
+        self.task_queues: dict[tuple, collections.deque] = {}
         self.waiting_deps: dict[bytes, list] = {}  # oid -> [pending items]
         self.actors: dict[bytes, ActorState] = {}
         self.named_actors: dict[str, bytes] = {}
@@ -557,6 +559,55 @@ class Runtime:
 
         threading.Thread(target=prestart, daemon=True,
                          name="rtpu-pool-prestart").start()
+        if cfg.memory_monitor_refresh_ms > 0:
+            threading.Thread(target=self._memory_monitor_loop, daemon=True,
+                             name="rtpu-oom-monitor").start()
+
+    # ---------------- OOM monitor ----------------
+
+    @staticmethod
+    def _memory_usage() -> float:
+        """Fraction of system memory in use (parity: memory_monitor.h:52)."""
+        info = {}
+        with open("/proc/meminfo") as f:
+            for line in f:
+                k, _, rest = line.partition(":")
+                info[k] = int(rest.split()[0])
+        total = info.get("MemTotal", 1)
+        return 1.0 - info.get("MemAvailable", total) / total
+
+    def _memory_monitor_loop(self):
+        """Above the usage threshold, kill one busy worker whose task can
+        retry (parity: retriable-FIFO WorkerKillingPolicy,
+        worker_killing_policy_retriable_fifo.h:34 — the kill converts host
+        OOM death-by-kernel into a retryable task failure)."""
+        period = self.config.memory_monitor_refresh_ms / 1000.0
+        while not self._shutdown:
+            time.sleep(period)
+            try:
+                if self._memory_usage() < self.config.memory_usage_threshold:
+                    continue
+                with self.lock:
+                    busy = [(w, w.current_task)
+                            for w in self.head_node.workers.values()
+                            if w.state == BUSY and w.current_task is not None]
+                    retriable = [(w, t) for w, t in busy
+                                 if (t.retries_left or 0) > 0]
+                    pool = retriable or busy
+                    victim, vtask = pool[-1] if pool else (None, None)
+                    if victim is not None:
+                        # Still on the SELECTED task? A completion racing
+                        # this sweep must not get an unrelated worker (or a
+                        # fresh non-retriable task) killed in its place.
+                        if (victim.state != BUSY
+                                or victim.current_task is not vtask):
+                            victim = None
+                if victim is not None:
+                    self.task_events.record(vtask.task_id, vtask.describe(),
+                                            "OOM_KILLED")
+                    victim.kill()
+            except Exception:  # noqa: BLE001 — monitoring must not die
+                traceback.print_exc()
 
     # ---------------- worker pool ----------------
 
@@ -1262,7 +1313,7 @@ class Runtime:
                 self._submit_actor_task(spec)
                 return
             with self.lock:
-                self.task_queue.append(spec)
+                self._enqueue_task_locked(spec)
             self._schedule()
         else:
             self._create_actor_now(item["cspec"])
@@ -1692,42 +1743,73 @@ class Runtime:
                     f"{what} requires {{{k}: {v}}} but the largest node has "
                     f"{{{k}: {best}}}")
 
+    @staticmethod
+    def _sched_key(spec: TaskSpec) -> tuple:
+        req = {}
+        if spec.num_cpus:
+            req["CPU"] = req.get("CPU", 0.0) + spec.num_cpus
+        if spec.num_tpus:
+            req["TPU"] = req.get("TPU", 0.0) + spec.num_tpus
+        for k, v in (spec.resources or {}).items():
+            req[k] = req.get(k, 0.0) + v
+        strat = spec.scheduling_strategy
+        return (tuple(sorted(req.items())),
+                strat if isinstance(strat, str) or strat is None
+                else id(strat))
+
+    def _enqueue_task_locked(self, spec: TaskSpec, front: bool = False):
+        q = self.task_queues.setdefault(self._sched_key(spec),
+                                        collections.deque())
+        (q.appendleft if front else q.append)(spec)
+
+    @property
+    def task_queue(self) -> list:
+        """Flat view of all pending task specs (introspection/autoscaler)."""
+        return [s for q in self.task_queues.values() for s in q]
+
     def _schedule(self):
-        """Dispatch every feasible queued task to an idle worker."""
+        """Dispatch every feasible queued task to an idle worker.
+
+        Per-scheduling-key queues (parity: normal_task_submitter.h:58):
+        a pass costs O(keys + dispatches), not O(queued tasks) — one failed
+        reserve probe parks the entire key, so a 10k-task burst stays cheap
+        on every completion event."""
         dispatches = []
         failures = []
         with self.lock:
-            remaining = collections.deque()
-            while self.task_queue:
-                spec = self.task_queue.popleft()
-                try:
-                    res = self._reserve_placement(
-                        spec.scheduling_strategy, self._resources_of(spec),
-                        spec.dependencies)
-                except Exception as e:  # noqa: BLE001 — an escaping error
-                    # would drop the whole scanned queue, hanging every get()
-                    failures.append((spec, e))
-                    continue
-                if res is None:
-                    remaining.append(spec)
-                    continue
-                node, token = res
-                if not node.idle:
-                    # Resources fit but no free worker on that node: roll
-                    # back, ask the node for another worker, keep scanning.
-                    # Quiet revert — no _kick_waiters churn: the reservation
-                    # was taken microseconds ago, nothing new was freed.
-                    self._rollback_token_locked(token)
-                    remaining.append(spec)
-                    self._request_worker_locked(node)
-                    continue
-                self._reservations[spec.task_id] = token
-                w = node.idle.popleft()
-                w.state = BUSY
-                w.current_task = spec
-                dispatches.append((w, spec))
-            remaining.extend(self.task_queue)
-            self.task_queue = remaining
+            for sig in list(self.task_queues):
+                q = self.task_queues.get(sig)
+                while q:
+                    spec = q[0]
+                    try:
+                        res = self._reserve_placement(
+                            spec.scheduling_strategy,
+                            self._resources_of(spec), spec.dependencies)
+                    except Exception as e:  # noqa: BLE001 — an escaping
+                        # error would stall the queue, hanging every get()
+                        q.popleft()
+                        failures.append((spec, e))
+                        continue
+                    if res is None:
+                        break  # key blocked on resources; next key
+                    node, token = res
+                    if not node.idle:
+                        # Resources fit but no free worker on that node:
+                        # quiet rollback (no _kick_waiters churn), ask for a
+                        # worker, park the key. Every key still gets its own
+                        # probe this pass — a blocked key must not starve
+                        # feasible keys behind it.
+                        self._rollback_token_locked(token)
+                        self._request_worker_locked(node)
+                        break
+                    q.popleft()
+                    self._reservations[spec.task_id] = token
+                    w = node.idle.popleft()
+                    w.state = BUSY
+                    w.current_task = spec
+                    dispatches.append((w, spec))
+                if not self.task_queues.get(sig):
+                    self.task_queues.pop(sig, None)
         for spec, e in failures:
             self._fail_returns(spec, e)
         for w, spec in dispatches:
@@ -2122,7 +2204,7 @@ class Runtime:
                 spec.retries_left -= 1
                 self.task_events.record(spec.task_id, spec.describe(), "RETRY")
                 with self.lock:
-                    self.task_queue.appendleft(spec)
+                    self._enqueue_task_locked(spec, front=True)
             else:
                 self._fail_returns(spec, WorkerCrashedError(
                     f"worker died executing {spec.describe()}"))

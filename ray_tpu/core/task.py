@@ -28,6 +28,8 @@ class TaskSpec:
         "owner",          # worker_id bytes of submitter (None = driver)
         "scheduling_strategy",
         "dependencies",   # [oid_bytes] that must be ready before dispatch
+        "runtime_env",    # {"env_vars": {...}, "working_dir": str,
+                          #  "py_modules": [str]} | None
     )
 
     def __init__(self, **kw):

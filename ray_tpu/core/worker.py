@@ -257,10 +257,62 @@ def _resolve_arg(rt: WorkerRuntime, obj):
     return obj
 
 
+class _RuntimeEnv:
+    """Apply a per-task/actor runtime_env (parity: the runtime-env agent
+    materializing env_vars / working_dir / py_modules,
+    `_private/runtime_env/agent/runtime_env_agent.py:167`).
+    env_vars are node-independent; working_dir/py_modules are applied as
+    LOCAL paths and assume a shared filesystem across nodes (no packaging/
+    upload yet — a missing path fails the task with FileNotFoundError,
+    conda/container isolation out of scope). Context-manager use restores
+    state for tasks; actors enter() permanently."""
+
+    def __init__(self, renv: dict | None):
+        self.renv = renv or {}
+        self._saved_env: dict[str, str | None] = {}
+        self._saved_cwd = None
+        self._added_paths: list[str] = []
+
+    def __enter__(self):
+        import sys as _sys
+        for k, v in (self.renv.get("env_vars") or {}).items():
+            self._saved_env[k] = os.environ.get(k)
+            os.environ[k] = str(v)
+        wd = self.renv.get("working_dir")
+        if wd:
+            self._saved_cwd = os.getcwd()
+            os.chdir(wd)
+            if wd not in _sys.path:
+                _sys.path.insert(0, wd)
+                self._added_paths.append(wd)
+        for p in self.renv.get("py_modules") or []:
+            if p not in _sys.path:
+                _sys.path.insert(0, p)
+                self._added_paths.append(p)
+        return self
+
+    def __exit__(self, *exc):
+        import sys as _sys
+        for k, old in self._saved_env.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+        if self._saved_cwd is not None:
+            os.chdir(self._saved_cwd)
+        for p in self._added_paths:
+            try:
+                _sys.path.remove(p)
+            except ValueError:
+                pass
+        return False
+
+
 def _execute(rt: WorkerRuntime, spec: TaskSpec, fn):
     """Runs one task; returns ('ok'|'err', value_or_TaskError)."""
     for oid, (payload, bufs) in spec.inline_deps.items():
         rt.object_cache[oid] = serialization.deserialize(payload, bufs)
+    renv = _RuntimeEnv(getattr(spec, "runtime_env", None))
     try:
         args, kwargs = serialization.deserialize(spec.payload, spec.buffers)
         args = [_resolve_arg(rt, a) for a in args]
@@ -273,9 +325,10 @@ def _execute(rt: WorkerRuntime, spec: TaskSpec, fn):
         rt.current_scheduling_strategy = (
             spec.scheduling_strategy
             or getattr(rt, "actor_scheduling_strategy", None))
-        result = fn(*args, **kwargs)
-        if inspect.iscoroutine(result):
-            result = asyncio.get_event_loop().run_until_complete(result)
+        with renv:
+            result = fn(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = asyncio.get_event_loop().run_until_complete(result)
         return "ok", result
     except BaseException as e:  # noqa: BLE001 — errors cross the wire
         return "err", TaskError.from_exception(e, spec.describe())
@@ -527,6 +580,8 @@ def _worker_main(store_path: str, worker_id: WorkerID, fd: int):
             # Set before __init__ so get_current_placement_group() works
             # inside the constructor too.
             rt.actor_scheduling_strategy = cspec.scheduling_strategy
+            # Actors keep their runtime_env for life (no __exit__).
+            _RuntimeEnv(getattr(cspec, "runtime_env", None)).__enter__()
             rt.actor_instance = cls(*args, **kwargs)
             rt.actor_id = cspec.actor_id
             rt.send(("actor_ready", cspec.actor_id))

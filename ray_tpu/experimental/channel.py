@@ -96,10 +96,23 @@ class Channel:
         struct.pack_into("<QQ", self._mm, 0, version + 2, len(payload))
 
     def close_writer(self, timeout: float | None = 10.0):
-        """Signal EOF to readers."""
+        """Signal EOF to readers. If a slow reader never acks within the
+        timeout, FORCE the sentinel in (skipping backpressure): it may
+        clobber the reader's last unread value, but a dropped EOF would
+        leave exec loops busy-polling a dead channel forever."""
         try:
             self.write_bytes(_CLOSE, timeout)
-        except (ValueError, OSError, TimeoutError):
+            return
+        except TimeoutError:
+            pass
+        except (ValueError, OSError):
+            return
+        try:
+            version, _ = struct.unpack_from("<QQ", self._mm, 0)
+            struct.pack_into("<Q", self._mm, 0, version + 1)
+            self._mm[_HDR.size:_HDR.size + len(_CLOSE)] = _CLOSE
+            struct.pack_into("<QQ", self._mm, 0, version + 2, len(_CLOSE))
+        except (ValueError, OSError):
             pass
 
     # -- reader side --

@@ -96,37 +96,67 @@ class _LLMServerImpl:
 
     # ---- model multiplexing (LoRA) ----
 
+    @staticmethod
+    def _kv_get(key):
+        from ray_tpu.core.runtime import Runtime, get_runtime
+        rt = get_runtime()
+        if isinstance(rt, Runtime):
+            return rt.kv.get(key)
+        return rt.request("kv_get", key)
+
+    @staticmethod
+    def _kv_put(key, value):
+        from ray_tpu.core.runtime import Runtime, get_runtime
+        rt = get_runtime()
+        if isinstance(rt, Runtime):
+            rt.kv[key] = value
+        else:
+            rt.request("kv_put", (key, value))
+
     def load_adapter(self, model_id: str, lora_tree=None, alpha=None):
-        """Register a LoRA adapter under `model_id`. None = random demo
+        """Register a LoRA adapter under `model_id`, cluster-wide: the tree
+        is stored in the head KV so EVERY replica can lazily materialize it
+        (parity: the multiplex LoRA checkpoint store). None = random demo
         adapter (tests); production passes trained factors."""
+        import cloudpickle
         import jax
         cfg = self.cfg.lora
         if cfg is None:
             raise ValueError("llm_config.lora is not configured")
-        if len(self._adapters) >= cfg.max_adapters_per_replica:
-            self._adapters.pop(next(iter(self._adapters)))
         if lora_tree is None:
             lora_tree = init_lora(self.model_cfg, cfg.rank,
                                   jax.random.PRNGKey(hash(model_id) % 2**31))
+        self._kv_put(("llm_adapter", self.cfg.model_id, model_id),
+                     cloudpickle.dumps(
+                         (jax.device_get(lora_tree), alpha or cfg.alpha)))
+        self._materialize(model_id, lora_tree, alpha or cfg.alpha)
+        return list(self._adapters)
+
+    def _materialize(self, model_id: str, lora_tree, alpha):
+        cfg = self.cfg.lora
+        if len(self._adapters) >= cfg.max_adapters_per_replica:
+            self._adapters.pop(next(iter(self._adapters)))
         # rank inferred from the tree itself: a trained adapter's rank wins
         # over the config default (wrong rank silently mis-scales).
-        merged = merge_lora(self._base_params, lora_tree,
-                            alpha or cfg.alpha)
-        self._adapters[model_id] = merged
-        return list(self._adapters)
+        self._adapters[model_id] = merge_lora(self._base_params, lora_tree,
+                                              alpha)
 
     def _params_for(self, model: str | None):
         if model is None or model == self.cfg.model_id:
             return self._base_params
         merged = self._adapters.get(model)
         if merged is None:
-            if self.cfg.lora is None:
+            # Lazy load-on-request from the cluster-wide registry: every
+            # replica can serve every REGISTERED adapter; unknown ids fail
+            # (a typo must not silently get a random adapter).
+            import cloudpickle
+            blob = self._kv_get(("llm_adapter", self.cfg.model_id, model))
+            if blob is None:
                 raise ValueError(
-                    f"model {model!r} unknown and LoRA is not configured")
-            # Lazy load-on-request: every replica can serve every adapter
-            # (LRU-capped), so the pow-2 router needs no replica pinning
-            # (parity: serve multiplexing pulling models on demand).
-            self.load_adapter(model)
+                    f"model {model!r} is not a registered adapter of "
+                    f"{self.cfg.model_id!r}")
+            lora_tree, alpha = cloudpickle.loads(blob)
+            self._materialize(model, lora_tree, alpha)
             merged = self._adapters[model]
         return merged
 

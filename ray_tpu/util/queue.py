@@ -1,0 +1,63 @@
+"""Distributed FIFO queue backed by an actor.
+
+Parity: reference `python/ray/util/queue.py` (Queue actor wrapping
+asyncio.Queue). Blocking semantics ride the actor's async concurrency.
+"""
+
+from __future__ import annotations
+
+import ray_tpu
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        import asyncio
+        self.q = asyncio.Queue(maxsize=maxsize)
+
+    async def put(self, item, timeout=None):
+        import asyncio
+        await asyncio.wait_for(self.q.put(item), timeout)
+        return True
+
+    async def get(self, timeout=None):
+        import asyncio
+        return await asyncio.wait_for(self.q.get(), timeout)
+
+    async def qsize(self):
+        return self.q.qsize()
+
+    async def empty(self):
+        return self.q.empty()
+
+    async def full(self):
+        return self.q.full()
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0, actor_options: dict | None = None):
+        cls = ray_tpu.remote(**(actor_options or {"num_cpus": 0}))(
+            _QueueActor)
+        self.actor = cls.remote(maxsize)
+
+    def put(self, item, block: bool = True, timeout: float | None = None):
+        ray_tpu.get(self.actor.put.remote(
+            item, timeout if block else 0.001), timeout=None)
+
+    def get(self, block: bool = True, timeout: float | None = None):
+        return ray_tpu.get(self.actor.get.remote(
+            timeout if block else 0.001), timeout=None)
+
+    def put_async(self, item):
+        return self.actor.put.remote(item)
+
+    def get_async(self):
+        return self.actor.get.remote()
+
+    def qsize(self) -> int:
+        return ray_tpu.get(self.actor.qsize.remote(), timeout=60)
+
+    def empty(self) -> bool:
+        return ray_tpu.get(self.actor.empty.remote(), timeout=60)
+
+    def shutdown(self):
+        ray_tpu.kill(self.actor)

@@ -99,8 +99,11 @@ class WorkflowStorage:
 
 
 def _step_ids(dag: FunctionNode) -> dict[int, str]:
-    """Deterministic step ids: topo index + function name (stable across
-    resumes of the same DAG)."""
+    """Deterministic step ids: topo index + hash of (function name, bound
+    constants) — stable across resumes of the same DAG, but a DAG with
+    different inputs under a reused workflow_id gets different step ids
+    instead of silently replaying stale results."""
+    import cloudpickle
     order: list[FunctionNode] = []
     seen: set[int] = set()
 
@@ -116,7 +119,18 @@ def _step_ids(dag: FunctionNode) -> dict[int, str]:
     ids = {}
     for i, n in enumerate(order):
         name = getattr(n.remote_fn, "__name__", "step")
-        ids[id(n)] = f"{i:04d}_{hashlib.sha1(name.encode()).hexdigest()[:8]}"
+        const_args = [a if not isinstance(a, FunctionNode) else "__dep__"
+                      for a in n.args]
+        const_kwargs = {k: (v if not isinstance(v, FunctionNode)
+                            else "__dep__")
+                        for k, v in sorted(n.kwargs.items())}
+        try:
+            fingerprint = cloudpickle.dumps(
+                (name, const_args, const_kwargs))
+        except Exception:  # noqa: BLE001 — unpicklable constant: name-only
+            fingerprint = name.encode()
+        ids[id(n)] = (f"{i:04d}_"
+                      f"{hashlib.sha1(fingerprint).hexdigest()[:12]}")
     return ids, order
 
 

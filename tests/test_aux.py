@@ -1,0 +1,76 @@
+"""Aux subsystem tests: runtime_env, timeline export, util.Queue.
+
+Parity: reference runtime-env tests, ray.timeline, util/queue tests."""
+
+import json
+import os
+
+import ray_tpu
+
+
+def test_runtime_env_env_vars_task(ray_start_regular):
+    @ray_tpu.remote(runtime_env={"env_vars": {"MY_TOKEN": "s3cr3t"}})
+    def read_env():
+        return os.environ.get("MY_TOKEN")
+
+    @ray_tpu.remote
+    def read_plain():
+        return os.environ.get("MY_TOKEN")
+
+    assert ray_tpu.get(read_env.remote(), timeout=60) == "s3cr3t"
+    # restored after the task: the same worker must not leak the var
+    assert ray_tpu.get(read_plain.remote(), timeout=60) is None
+
+
+def test_runtime_env_working_dir(ray_start_regular, tmp_path):
+    d = tmp_path / "wd"
+    d.mkdir()
+    (d / "data.txt").write_text("payload")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(d)})
+    def read_file():
+        return open("data.txt").read()
+
+    assert ray_tpu.get(read_file.remote(), timeout=60) == "payload"
+
+
+def test_runtime_env_actor_persistent(ray_start_regular):
+    @ray_tpu.remote(runtime_env={"env_vars": {"ACTOR_FLAVOR": "tpu"}})
+    class A:
+        def flavor(self):
+            return os.environ.get("ACTOR_FLAVOR")
+
+    a = A.remote()
+    assert ray_tpu.get(a.flavor.remote(), timeout=60) == "tpu"
+    assert ray_tpu.get(a.flavor.remote(), timeout=60) == "tpu"
+    ray_tpu.kill(a)
+
+
+def test_timeline_chrome_export(ray_start_regular, tmp_path):
+    @ray_tpu.remote
+    def quick():
+        return 1
+
+    ray_tpu.get([quick.remote() for _ in range(3)], timeout=60)
+    out = str(tmp_path / "trace.json")
+    trace = ray_tpu.timeline(out)
+    assert os.path.exists(out)
+    loaded = json.load(open(out))
+    assert loaded == trace
+    assert any(e["ph"] == "X" and e["dur"] >= 0 for e in loaded)
+
+
+def test_util_queue(ray_start_regular):
+    from ray_tpu.util.queue import Queue
+
+    q = Queue(maxsize=4)
+    for i in range(4):
+        q.put(i)
+    assert q.qsize() == 4
+    assert [q.get() for _ in range(4)] == [0, 1, 2, 3]
+    assert q.empty()
+    # blocking get resolved by a later put
+    ref = q.get_async()
+    q.put("late")
+    assert ray_tpu.get(ref, timeout=60) == "late"
+    q.shutdown()

@@ -158,6 +158,45 @@ def test_openai_serve_app(ray_start_regular):
         serve_api.delete("llm")
 
 
+def test_serve_lora_adapters(ray_start_regular):
+    """Registered adapters serve on any replica; unknown ids 400."""
+    import urllib.error
+    import urllib.request
+
+    from ray_tpu import serve as serve_api
+    from ray_tpu.llm import LoraConfig, build_openai_app
+    from ray_tpu.serve.config import DEFAULT_HTTP_PORT
+
+    cfg = _llm_config()
+    cfg.lora = LoraConfig(rank=2)
+    app = build_openai_app(cfg)
+    serve_api.run(app, name="llm-lora", route_prefix="/lora")
+    base = f"http://127.0.0.1:{DEFAULT_HTTP_PORT}/lora"
+    try:
+        handle = serve_api.get_deployment_handle("LLMServer:tiny",
+                                                 "llm-lora")
+        handle.load_adapter.remote("tiny-ft").result(timeout_s=60)
+
+        req = urllib.request.Request(
+            base + "/v1/completions",
+            data=json.dumps({"prompt": "x", "max_tokens": 2,
+                             "model": "tiny-ft"}).encode(),
+            headers={"content-type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            out = json.load(r)
+        assert out["model"] == "tiny-ft"
+
+        bad = urllib.request.Request(
+            base + "/v1/completions",
+            data=json.dumps({"prompt": "x", "model": "no-such"}).encode(),
+            headers={"content-type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(bad, timeout=60)
+        assert e.value.code == 400
+    finally:
+        serve_api.delete("llm-lora")
+
+
 def test_batch_processor(ray_start_regular):
     import ray_tpu.data as rd
     from ray_tpu.llm import build_llm_processor
