@@ -74,11 +74,13 @@ IDLE, BUSY, ASSIGNED_ACTOR, DEAD = "idle", "busy", "actor", "dead"
 A_PENDING, A_ALIVE, A_RESTARTING, A_DEAD = "pending", "alive", "restarting", "dead"
 
 
-def build_worker_env(config, node_id_hex: str) -> dict:
+def build_worker_env(config, node_id_hex: str,
+                     is_head: bool = False) -> dict:
     """Environment for spawned worker processes (shared head/agent)."""
     env = dict(os.environ)
     env.update(config.to_env())
     env["RAY_TPU_NODE_ID"] = node_id_hex
+    env["RAY_TPU_IS_HEAD_NODE"] = "1" if is_head else "0"
     env.setdefault("PYTHONPATH", "")
     repo_root = os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
@@ -562,6 +564,144 @@ class Runtime:
         if cfg.memory_monitor_refresh_ms > 0:
             threading.Thread(target=self._memory_monitor_loop, daemon=True,
                              name="rtpu-oom-monitor").start()
+        self.spill_dir = cfg.object_spill_dir or os.path.join(
+            self.session_dir, "spill")
+        self._spilled: dict[bytes, str] = {}  # oid -> spill file path
+        # RLock: _restore_spilled holds it across write+add_location while
+        # its full-arena fallback re-enters _spill_bytes.
+        self._spill_lock = threading.RLock()
+        if cfg.object_spill_threshold < 1.0:
+            threading.Thread(target=self._spill_monitor_loop, daemon=True,
+                             name="rtpu-spill-monitor").start()
+
+    # ---------------- object spilling ----------------
+    #
+    # Parity: LocalObjectManager::SpillObjects -> ExternalStorage
+    # (raylet/local_object_manager.h:111, _private/external_storage.py) —
+    # the persistence tier of the object plane. The head spills its own
+    # store's oldest unpinned owner-tracked objects to files BEFORE the
+    # arena's last-resort LRU eviction would drop them, and restores on
+    # demand. Node-agent stores rely on arena eviction only (v1).
+
+    def _spill_monitor_loop(self):
+        """Keep arena usage under object_spill_threshold so bursty puts hit
+        prepared headroom instead of evicting live objects."""
+        while not self._shutdown:
+            time.sleep(1.0)
+            if self._shutdown:
+                return  # store is closing: its mmap must not be touched
+            try:
+                stats = self.store.stats()
+                cap = stats["capacity"] or 1
+                frac = stats["allocated"] / cap
+                threshold = self.config.object_spill_threshold
+                low_water = max(0.0, threshold - 0.2)
+                if frac > threshold:
+                    self._spill_bytes(int((frac - low_water) * cap))
+            except Exception:  # noqa: BLE001 — monitoring must not die
+                traceback.print_exc()
+
+    def _spill_bytes(self, needed: int) -> bool:
+        """Spill oldest unpinned head-local objects until `needed` bytes are
+        freed. Returns whether that much was freed."""
+        if needed <= 0:
+            return True
+        os.makedirs(self.spill_dir, exist_ok=True)
+        freed = 0
+        with self._spill_lock:
+            with self.directory.lock:
+                candidates = [
+                    oid for oid, e in self.directory.entries.items()
+                    if e[0] == "shm" and len(e) > 1
+                    and self.head_node_id in e[1]]
+            for oid in candidates:
+                if freed >= needed:
+                    break
+                with self.refcount._lock:
+                    if oid in self.refcount._pins:
+                        continue  # an in-flight task depends on it
+                prior = self._spilled.get(oid)
+                if prior is not None and os.path.exists(prior):
+                    # Restored earlier: the spill file is still valid, so
+                    # dropping the in-arena copy costs nothing.
+                    with self.directory.lock:
+                        e = self.directory.entries.get(oid)
+                        if e is None or e[0] != "shm":
+                            continue
+                        e[1].discard(self.head_node_id)
+                    self.store.delete(ObjectID(oid))
+                    freed += os.path.getsize(prior)
+                    continue
+                res = self.store.get_raw(ObjectID(oid), timeout=0)
+                if res is None:
+                    continue
+                data, _meta = res
+                path = os.path.join(self.spill_dir, oid.hex())
+                try:
+                    with open(path, "wb") as f:
+                        f.write(data)
+                finally:
+                    data.release()
+                    self.store.release(ObjectID(oid))
+                size = os.path.getsize(path)
+                with self.directory.lock:
+                    e = self.directory.entries.get(oid)
+                    if e is None or e[0] != "shm":
+                        os.unlink(path)
+                        continue
+                    self._spilled[oid] = path
+                    e[1].discard(self.head_node_id)
+                self.store.delete(ObjectID(oid))
+                freed += size
+        return freed >= needed
+
+    def _restore_spilled(self, oid: bytes) -> bool:
+        """Bring a spilled object back into the head store (blocking IO —
+        never call on the listener thread)."""
+        from ray_tpu.core import objxfer
+        path = self._spilled.get(oid)
+        if path is None:
+            return False
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+        except FileNotFoundError:
+            return False
+        # Under _spill_lock: a concurrent spill pass must not 'cheap-drop'
+        # the arena copy between our write and add_location (it would leave
+        # the directory claiming a head copy that is gone).
+        with self._spill_lock:
+            self._ensure_headroom(len(blob))
+            try:
+                objxfer.write_blob(self.store, oid, blob)
+            except Exception:  # noqa: BLE001 — arena full: make room, retry
+                if not self._spill_bytes(int(len(blob) * 1.2)):
+                    return False
+                objxfer.write_blob(self.store, oid, blob)
+            self.directory.add_location(oid, self.head_node_id)
+        return True
+
+    def _ensure_headroom(self, nbytes: int):
+        """Spill-BEFORE-pressure: the arena's last-resort LRU eviction
+        silently destroys owned objects, so every head-store write makes
+        room under the spill threshold first."""
+        stats = self.store.stats()
+        cap = stats["capacity"] or 1
+        limit = self.config.object_spill_threshold * cap
+        if stats["allocated"] + nbytes > limit:
+            self._spill_bytes(int(stats["allocated"] + nbytes - limit)
+                              + (4 << 20))
+
+    def put_in_store(self, oid: "ObjectID", value) -> None:
+        from ray_tpu.core.status import ObjectStoreFullError
+        approx = int(getattr(value, "nbytes", 0) or (1 << 20))
+        self._ensure_headroom(approx)
+        try:
+            self.store.put_serialized(oid, value)
+        except ObjectStoreFullError:
+            if not self._spill_bytes(int(approx * 1.5) + (1 << 20)):
+                raise
+            self.store.put_serialized(oid, value)
 
     # ---------------- OOM monitor ----------------
 
@@ -584,6 +724,8 @@ class Runtime:
         period = self.config.memory_monitor_refresh_ms / 1000.0
         while not self._shutdown:
             time.sleep(period)
+            if self._shutdown:
+                return
             try:
                 if self._memory_usage() < self.config.memory_usage_threshold:
                     continue
@@ -612,7 +754,8 @@ class Runtime:
     # ---------------- worker pool ----------------
 
     def _worker_env(self) -> dict:
-        return build_worker_env(self.config, self.head_node_id.hex())
+        return build_worker_env(self.config, self.head_node_id.hex(),
+                                is_head=True)
 
     def _spawn_worker(self) -> WorkerHandle:
         if self._shutdown:
@@ -770,6 +913,29 @@ class Runtime:
             resp = True
         elif what == "kv_incr":
             resp = self.kv_incr(arg)
+        elif what == "spill":
+            # Only head-node workers share the head's arena; a remote
+            # worker's store is its agent's (arena LRU eviction applies).
+            # Spilling is bulk disk IO — never run it on the listener
+            # thread (it would freeze the whole control plane); reply
+            # asynchronously from the spill thread.
+            if w.node_id != self.head_node_id:
+                w.send(("resp", req_id, False))
+                return
+
+            def spill_and_reply(n=int(arg), w=w, req_id=req_id):
+                try:
+                    ok = self._spill_bytes(n)
+                except Exception:  # noqa: BLE001
+                    traceback.print_exc()
+                    ok = False
+                try:
+                    w.send(("resp", req_id, ok))
+                except OSError:
+                    pass
+
+            threading.Thread(target=spill_and_reply, daemon=True).start()
+            return
         elif what == "kill_actor":
             self.kill_actor_by_id(arg, no_restart=True)
             resp = True
@@ -954,6 +1120,23 @@ class Runtime:
                 if (n := self.nodes.get(nid)) is not None
                 and n.state == "ALIVE"]
         if not srcs:
+            if oid in self._spilled:
+                # Restore from disk off-thread, then re-route the fetch
+                # (the restored copy lands on the head).
+                def restore():
+                    if self._restore_spilled(oid):
+                        with self.lock:
+                            info2 = self._fetches.pop(key, None)
+                        for cb in (info2 or {}).get("cbs", []):
+                            if dest.node_id == self.head_node_id:
+                                cb(True, None)
+                            else:
+                                self._fetch_to_node(dest, oid, cb)
+                    else:
+                        self._finish_fetch(key, False,
+                                           ObjectLostError(ObjectID(oid)))
+                threading.Thread(target=restore, daemon=True).start()
+                return
             self._finish_fetch(key, False, ObjectLostError(ObjectID(oid)))
             return
         src = srcs[0]
@@ -985,6 +1168,7 @@ class Runtime:
         key = (self.head_node_id, oid)
         ok, err = False, None
         try:
+            self._ensure_headroom(1 << 20)  # size unknown until received
             if objxfer.fetch_from_peer(self.store, src.peer_addr, oid):
                 self.directory.add_location(oid, self.head_node_id)
                 ok = True
@@ -1088,7 +1272,7 @@ class Runtime:
             for oid, e in self.directory.entries.items():
                 if e[0] == "shm" and len(e) > 1 and node.node_id in e[1]:
                     e[1].discard(node.node_id)
-                    if not e[1]:
+                    if not e[1] and oid not in self._spilled:
                         lost.append(oid)
         for oid in lost:
             self.directory.put(oid, ("err", ObjectLostError(ObjectID(oid))))
@@ -1137,7 +1321,7 @@ class Runtime:
     def put(self, value) -> "ObjectRef":
         from ray_tpu.core.object_ref import ObjectRef
         oid = ObjectID.from_random()
-        self.store.put_serialized(oid, value)
+        self.put_in_store(oid, value)
         self.directory.put(oid.binary(), ("shm", {self.head_node_id}))
         return ObjectRef(oid)
 
@@ -1189,7 +1373,9 @@ class Runtime:
             raise e
         locs = entry[1] if len(entry) > 1 else {self.head_node_id}
         if self.head_node_id not in locs:
-            self._pull_to_head(ref.id.binary(), timeout=timeout)
+            if not (ref.id.binary() in self._spilled
+                    and self._restore_spilled(ref.id.binary())):
+                self._pull_to_head(ref.id.binary(), timeout=timeout)
         found, value = self.store.get_deserialized(ref.id, timeout=5.0)
         if not found:
             from ray_tpu.core.status import ObjectLostError
@@ -1250,6 +1436,12 @@ class Runtime:
         entry = self.directory.lookup(oid)
         self.directory.discard(oid)
         self.store.delete(ObjectID(oid))
+        path = self._spilled.pop(oid, None)
+        if path is not None:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
         if entry is not None and entry[0] == "shm" and len(entry) > 1:
             for nid in entry[1]:
                 n = self.nodes.get(nid)

@@ -118,8 +118,30 @@ class WorkerRuntime:
 
     def put(self, value):
         from ray_tpu.core.object_ref import ObjectRef
+        from ray_tpu.core.status import ObjectStoreFullError
         oid = ObjectID.from_random()
-        self.store.put_serialized(oid, value)
+        # Spill-before-pressure: arena LRU eviction silently destroys owned
+        # objects, so ask the head to make room BEFORE crossing the spill
+        # threshold. Head-node workers only — they share the head's arena;
+        # elsewhere the request would be a guaranteed no-op round trip and
+        # the agent arena's eviction is the pressure valve.
+        on_head = os.environ.get("RAY_TPU_IS_HEAD_NODE") == "1"
+        approx = int(getattr(value, "nbytes", 0) or (1 << 20))
+        if on_head:
+            stats = self.store.stats()
+            cap = stats["capacity"] or 1
+            limit = get_config().object_spill_threshold * cap
+            if stats["allocated"] + approx > limit:
+                self.request(
+                    "spill",
+                    int(stats["allocated"] + approx - limit) + (4 << 20))
+        try:
+            self.store.put_serialized(oid, value)
+        except ObjectStoreFullError:
+            if not on_head:
+                raise
+            self.request("spill", int(approx * 1.5) + (1 << 20))
+            self.store.put_serialized(oid, value)
         self.send(("put_notify", oid.binary()))
         return ObjectRef(oid, owner=self.worker_id.binary(), _add_ref=False)
 
@@ -275,20 +297,26 @@ class _RuntimeEnv:
 
     def __enter__(self):
         import sys as _sys
-        for k, v in (self.renv.get("env_vars") or {}).items():
-            self._saved_env[k] = os.environ.get(k)
-            os.environ[k] = str(v)
-        wd = self.renv.get("working_dir")
-        if wd:
-            self._saved_cwd = os.getcwd()
-            os.chdir(wd)
-            if wd not in _sys.path:
-                _sys.path.insert(0, wd)
-                self._added_paths.append(wd)
-        for p in self.renv.get("py_modules") or []:
-            if p not in _sys.path:
-                _sys.path.insert(0, p)
-                self._added_paths.append(p)
+        try:
+            for k, v in (self.renv.get("env_vars") or {}).items():
+                self._saved_env[k] = os.environ.get(k)
+                os.environ[k] = str(v)
+            wd = self.renv.get("working_dir")
+            if wd:
+                self._saved_cwd = os.getcwd()
+                os.chdir(wd)
+                if wd not in _sys.path:
+                    _sys.path.insert(0, wd)
+                    self._added_paths.append(wd)
+            for p in self.renv.get("py_modules") or []:
+                if p not in _sys.path:
+                    _sys.path.insert(0, p)
+                    self._added_paths.append(p)
+        except BaseException:
+            # __exit__ is not called when __enter__ raises: roll back here
+            # or the pooled worker keeps half-applied env forever.
+            self.__exit__()
+            raise
         return self
 
     def __exit__(self, *exc):
@@ -356,10 +384,27 @@ def _reply_result(rt: WorkerRuntime, spec: TaskSpec, status, result):
     outs = []
     for rid, value in zip(spec.return_ids, values):
         payload, bufs, _ = serialization.serialize_value(value)
-        if serialization.total_nbytes(payload, bufs) <= cfg.max_inline_object_bytes:
+        nbytes = serialization.total_nbytes(payload, bufs)
+        if nbytes <= cfg.max_inline_object_bytes:
             outs.append((rid, "inline", payload, bufs))
         else:
-            rt.store.put_serialized(ObjectID(rid), value)
+            from ray_tpu.core.status import ObjectStoreFullError
+            on_head = os.environ.get("RAY_TPU_IS_HEAD_NODE") == "1"
+            if on_head:
+                stats = rt.store.stats()
+                cap = stats["capacity"] or 1
+                limit = cfg.object_spill_threshold * cap
+                if stats["allocated"] + nbytes > limit:
+                    rt.request("spill",
+                               int(stats["allocated"] + nbytes - limit)
+                               + (4 << 20))
+            try:
+                rt.store.put_serialized(ObjectID(rid), value)
+            except ObjectStoreFullError:
+                if not on_head:
+                    raise
+                rt.request("spill", int(nbytes * 1.5) + (1 << 20))
+                rt.store.put_serialized(ObjectID(rid), value)
             outs.append((rid, "shm", None, None))
     rt.send(("done", spec.task_id, spec.actor_id, outs))
 

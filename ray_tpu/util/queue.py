@@ -40,12 +40,24 @@ class Queue:
         self.actor = cls.remote(maxsize)
 
     def put(self, item, block: bool = True, timeout: float | None = None):
-        ray_tpu.get(self.actor.put.remote(
-            item, timeout if block else 0.001), timeout=None)
+        """Raises queue.Full on a non-blocking/timed-out put (reference
+        ray.util.queue contract). Note block=False still costs one actor
+        round trip — the queue state lives in the actor."""
+        import queue as stdq
+        try:
+            ray_tpu.get(self.actor.put.remote(
+                item, timeout if block else 0.001), timeout=None)
+        except TimeoutError:  # asyncio.TimeoutError is this alias
+            raise stdq.Full from None
 
     def get(self, block: bool = True, timeout: float | None = None):
-        return ray_tpu.get(self.actor.get.remote(
-            timeout if block else 0.001), timeout=None)
+        """Raises queue.Empty on a non-blocking/timed-out get."""
+        import queue as stdq
+        try:
+            return ray_tpu.get(self.actor.get.remote(
+                timeout if block else 0.001), timeout=None)
+        except TimeoutError:
+            raise stdq.Empty from None
 
     def put_async(self, item):
         return self.actor.put.remote(item)

@@ -116,17 +116,29 @@ def _step_ids(dag: FunctionNode) -> dict[int, str]:
         order.append(n)
 
     visit(dag)
+
+    def canonical(obj):
+        """Order-stable structure for fingerprinting: raw pickle bytes of a
+        set/dict depend on insertion/hash order, which varies across
+        processes (PYTHONHASHSEED) — a resume would then miss its own
+        checkpoints."""
+        if isinstance(obj, FunctionNode):
+            return "__dep__"
+        if isinstance(obj, dict):
+            return ("d", sorted((repr(k), canonical(v))
+                                for k, v in obj.items()))
+        if isinstance(obj, (set, frozenset)):
+            return ("s", sorted(repr(x) for x in obj))
+        if isinstance(obj, (list, tuple)):
+            return ("l", [canonical(x) for x in obj])
+        return repr(obj)
+
     ids = {}
     for i, n in enumerate(order):
         name = getattr(n.remote_fn, "__name__", "step")
-        const_args = [a if not isinstance(a, FunctionNode) else "__dep__"
-                      for a in n.args]
-        const_kwargs = {k: (v if not isinstance(v, FunctionNode)
-                            else "__dep__")
-                        for k, v in sorted(n.kwargs.items())}
         try:
             fingerprint = cloudpickle.dumps(
-                (name, const_args, const_kwargs))
+                (name, canonical(list(n.args)), canonical(n.kwargs)))
         except Exception:  # noqa: BLE001 — unpicklable constant: name-only
             fingerprint = name.encode()
         ids[id(n)] = (f"{i:04d}_"
