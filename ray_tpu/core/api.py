@@ -14,11 +14,24 @@ from ray_tpu.core.remote_function import RemoteFunction
 from ray_tpu.core.status import RayTpuError
 
 
-def init(num_cpus=None, num_tpus=None, resources=None,
+def init(address=None, *, num_cpus=None, num_tpus=None, resources=None,
          object_store_memory=None, _system_config=None, ignore_reinit_error=True,
          **_ignored):
-    """Boot the head runtime in this process (driver)."""
+    """Boot the head runtime in this process (driver), or — with
+    `address="host:port"` — connect this process as a remote client driver
+    (parity: ray.init("ray://...") client mode)."""
     from ray_tpu.core import runtime as rt_mod
+    if address is not None:
+        if address.startswith("ray://"):
+            address = address[len("ray://"):]
+        if rt_mod.current_runtime() is not None:
+            if ignore_reinit_error:
+                return rt_mod.current_runtime()
+            raise RayTpuError("ray_tpu.init() called twice")
+        from ray_tpu.util.client import ClientRuntime
+        client = ClientRuntime(address)
+        rt_mod.set_worker_runtime(client)
+        return client
     if rt_mod._runtime is not None:
         if ignore_reinit_error:
             return rt_mod._runtime
@@ -30,6 +43,11 @@ def init(num_cpus=None, num_tpus=None, resources=None,
 
 def shutdown():
     from ray_tpu.core import runtime as rt_mod
+    rt = rt_mod.current_runtime()
+    if rt is not None and getattr(rt, "is_client", False):
+        rt.disconnect()
+        rt_mod.set_worker_runtime(None)
+        return
     rt_mod.shutdown_runtime()
 
 

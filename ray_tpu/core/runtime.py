@@ -185,6 +185,7 @@ class NodeConn:
         self.send_lock = threading.Lock()
         self.buffer = FrameBuffer()
         self.node_id: bytes | None = None  # set on register_node
+        self.client_handle = None  # set on client_hello (client mode)
 
     def send(self, msg):
         send_msg(self.sock, msg, self.send_lock)
@@ -567,6 +568,9 @@ class Runtime:
         self.spill_dir = cfg.object_spill_dir or os.path.join(
             self.session_dir, "spill")
         self._spilled: dict[bytes, str] = {}  # oid -> spill file path
+        # oid -> monotonic restore time; the spill pass leaves freshly
+        # restored objects alone so their pending reader can finish.
+        self._restored_at: dict[bytes, float] = {}
         # RLock: _restore_spilled holds it across write+add_location while
         # its full-arena fallback re-enters _spill_bytes.
         self._spill_lock = threading.RLock()
@@ -623,7 +627,12 @@ class Runtime:
                 prior = self._spilled.get(oid)
                 if prior is not None and os.path.exists(prior):
                     # Restored earlier: the spill file is still valid, so
-                    # dropping the in-arena copy costs nothing.
+                    # dropping the in-arena copy costs nothing — EXCEPT for
+                    # a just-restored object whose reader (a get/push that
+                    # triggered the restore) may not have read it yet.
+                    if time.monotonic() - self._restored_at.get(
+                            oid, 0.0) < 10.0:
+                        continue
                     with self.directory.lock:
                         e = self.directory.entries.get(oid)
                         if e is None or e[0] != "shm":
@@ -678,6 +687,7 @@ class Runtime:
                 if not self._spill_bytes(int(len(blob) * 1.2)):
                     return False
                 objxfer.write_blob(self.store, oid, blob)
+            self._restored_at[oid] = time.monotonic()
             self.directory.add_location(oid, self.head_node_id)
         return True
 
@@ -825,7 +835,10 @@ class Runtime:
                     handle.buffer.feed(data)
                     for msg in handle.buffer.frames():
                         try:
-                            self._handle_node_msg(handle, msg)
+                            if handle.client_handle is not None:
+                                self._handle_msg(handle.client_handle, msg)
+                            else:
+                                self._handle_node_msg(handle, msg)
                         except Exception:
                             traceback.print_exc()
                     continue
@@ -936,6 +949,39 @@ class Runtime:
 
             threading.Thread(target=spill_and_reply, daemon=True).start()
             return
+        elif what == "client_put":
+            # Deserialize + store off the listener thread; reply async.
+            def put_and_reply(arg=arg, w=w, req_id=req_id):
+                try:
+                    value = serialization.deserialize(arg[0], arg[1])
+                    oid = ObjectID.from_random()
+                    self.put_in_store(oid, value)
+                    self.directory.put(oid.binary(),
+                                       ("shm", {self.head_node_id}))
+                    resp = oid.binary()
+                except Exception as e:  # noqa: BLE001 — ship to client
+                    resp = RayTpuError(f"client_put failed: {e}")
+                try:
+                    w.send(("resp", req_id, resp))
+                except OSError:
+                    pass
+
+            threading.Thread(target=put_and_reply, daemon=True).start()
+            return
+        elif what == "client_wait":
+            def wait_and_reply(arg=arg, w=w, req_id=req_id):
+                oids, num_returns, timeout = arg
+                try:
+                    resp = self._wait_oids(oids, num_returns, timeout)
+                except Exception as e:  # noqa: BLE001
+                    resp = RayTpuError(f"client_wait failed: {e}")
+                try:
+                    w.send(("resp", req_id, resp))
+                except OSError:
+                    pass
+
+            threading.Thread(target=wait_and_reply, daemon=True).start()
+            return
         elif what == "kill_actor":
             self.kill_actor_by_id(arg, no_restart=True)
             resp = True
@@ -975,6 +1021,12 @@ class Runtime:
             payload, bufs, _ = serialization.serialize_value(entry[1])
             w.send(("obj", oid, "err", payload, bufs))
         else:
+            if getattr(w, "is_client", False):
+                # Clients have no store: materialize on the head and ship
+                # the value inline (off-thread — may restore/fetch + read).
+                threading.Thread(target=self._push_inline_to_client,
+                                 args=(w, oid), daemon=True).start()
+                return
             locs = entry[1] if len(entry) > 1 else {self.head_node_id}
             if w.node_id in locs:
                 w.send(("obj", oid, "shm", None, None))
@@ -995,6 +1047,29 @@ class Runtime:
                         w2.send(("obj", oid, "err", payload, bufs))
 
             self._fetch_to_node(node, oid, done)
+
+    def _push_inline_to_client(self, w: WorkerHandle, oid: bytes):
+        try:
+            entry = self.directory.lookup(oid)
+            if entry is None or entry[0] != "shm":
+                raise RayTpuError("object entry changed under the push")
+            locs = entry[1] if len(entry) > 1 else {self.head_node_id}
+            if self.head_node_id not in locs:
+                if not (oid in self._spilled and self._restore_spilled(oid)):
+                    self._pull_to_head(oid, timeout=60.0)
+            found, value = self.store.get_deserialized(ObjectID(oid),
+                                                       timeout=5.0)
+            if not found:
+                from ray_tpu.core.status import ObjectLostError
+                raise ObjectLostError(ObjectID(oid))
+            payload, bufs, _ = serialization.serialize_value(value)
+            w.send(("obj", oid, "inline", payload, bufs))
+        except Exception as e:  # noqa: BLE001 — ship the failure inline
+            try:
+                payload, bufs, _ = serialization.serialize_value(e)
+                w.send(("obj", oid, "err", payload, bufs))
+            except OSError:
+                pass
 
     # ---------------- cluster plane (multi-node) ----------------
     #
@@ -1087,6 +1162,19 @@ class Runtime:
             # A peer agent pulling an object whose source is the head store.
             threading.Thread(target=self._serve_obj_req,
                              args=(conn, msg[1]), daemon=True).start()
+        elif op == "client_hello":
+            # A client-mode driver (parity: Ray Client `ray://` sessions):
+            # acts like a worker whose every object value travels inline.
+            wid = msg[1]
+            w = WorkerHandle(WorkerID(wid), conn.sock, None,
+                             node_id=self.head_node_id)
+            w.send_lock = conn.send_lock  # one TCP writer lock
+            w.state = "client"  # never enters the idle pool
+            w.is_client = True
+            w.connected.set()
+            conn.client_handle = w
+            with self.lock:
+                self.workers[wid] = w
         else:
             raise RayTpuError(f"head: unknown node message {op}")
 
@@ -1233,6 +1321,9 @@ class Runtime:
             conn.sock.close()
         except OSError:
             pass
+        if conn.client_handle is not None:
+            self._on_worker_death(conn.client_handle)
+            return
         if conn.node_id is not None:
             node = self.nodes.get(conn.node_id)
             if node is not None:
@@ -1381,6 +1472,31 @@ class Runtime:
             from ray_tpu.core.status import ObjectLostError
             raise ObjectLostError(ref.id)
         return value
+
+    def _wait_oids(self, oids: list, num_returns: int,
+                   timeout) -> list:
+        """wait() over raw oid bytes (client mode)."""
+        cv = threading.Condition()
+        ready_set: set = set()
+
+        def mk_cb(oid):
+            def cb(_entry):
+                with cv:
+                    ready_set.add(oid)
+                    cv.notify_all()
+            return cb
+
+        for oid in oids:
+            self.directory.on_ready(oid, mk_cb(oid))
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with cv:
+            while len(ready_set) < num_returns:
+                remain = (None if deadline is None
+                          else deadline - time.monotonic())
+                if remain is not None and remain <= 0:
+                    break
+                cv.wait(remain if remain is not None else 0.1)
+        return [oid for oid in oids if oid in ready_set]
 
     def wait(self, refs, num_returns=1, timeout=None):
         if num_returns > len(refs):

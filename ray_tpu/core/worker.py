@@ -118,30 +118,9 @@ class WorkerRuntime:
 
     def put(self, value):
         from ray_tpu.core.object_ref import ObjectRef
-        from ray_tpu.core.status import ObjectStoreFullError
         oid = ObjectID.from_random()
-        # Spill-before-pressure: arena LRU eviction silently destroys owned
-        # objects, so ask the head to make room BEFORE crossing the spill
-        # threshold. Head-node workers only — they share the head's arena;
-        # elsewhere the request would be a guaranteed no-op round trip and
-        # the agent arena's eviction is the pressure valve.
-        on_head = os.environ.get("RAY_TPU_IS_HEAD_NODE") == "1"
-        approx = int(getattr(value, "nbytes", 0) or (1 << 20))
-        if on_head:
-            stats = self.store.stats()
-            cap = stats["capacity"] or 1
-            limit = get_config().object_spill_threshold * cap
-            if stats["allocated"] + approx > limit:
-                self.request(
-                    "spill",
-                    int(stats["allocated"] + approx - limit) + (4 << 20))
-        try:
-            self.store.put_serialized(oid, value)
-        except ObjectStoreFullError:
-            if not on_head:
-                raise
-            self.request("spill", int(approx * 1.5) + (1 << 20))
-            self.store.put_serialized(oid, value)
+        _put_with_spill(self, oid, value,
+                        int(getattr(value, "nbytes", 0) or (1 << 20)))
         self.send(("put_notify", oid.binary()))
         return ObjectRef(oid, owner=self.worker_id.binary(), _add_ref=False)
 
@@ -269,6 +248,30 @@ class WorkerRuntime:
             raise RuntimeError(f"worker: unknown push {op}")
 
 
+def _put_with_spill(rt: "WorkerRuntime", oid: ObjectID, value, nbytes: int):
+    """Store a value with the spill-before-pressure policy: arena LRU
+    eviction silently destroys owned objects, so a head-node worker asks
+    the head to make room BEFORE crossing the spill threshold (and retries
+    once on full). On other nodes the head could not help — the request is
+    skipped and the agent arena's eviction is the pressure valve."""
+    from ray_tpu.core.status import ObjectStoreFullError
+    on_head = os.environ.get("RAY_TPU_IS_HEAD_NODE") == "1"
+    if on_head:
+        stats = rt.store.stats()
+        cap = stats["capacity"] or 1
+        limit = get_config().object_spill_threshold * cap
+        if stats["allocated"] + nbytes > limit:
+            rt.request("spill",
+                       int(stats["allocated"] + nbytes - limit) + (4 << 20))
+    try:
+        rt.store.put_serialized(oid, value)
+    except ObjectStoreFullError:
+        if not on_head:
+            raise
+        rt.request("spill", int(nbytes * 1.5) + (1 << 20))
+        rt.store.put_serialized(oid, value)
+
+
 GLOBAL: WorkerRuntime | None = None
 
 
@@ -388,23 +391,7 @@ def _reply_result(rt: WorkerRuntime, spec: TaskSpec, status, result):
         if nbytes <= cfg.max_inline_object_bytes:
             outs.append((rid, "inline", payload, bufs))
         else:
-            from ray_tpu.core.status import ObjectStoreFullError
-            on_head = os.environ.get("RAY_TPU_IS_HEAD_NODE") == "1"
-            if on_head:
-                stats = rt.store.stats()
-                cap = stats["capacity"] or 1
-                limit = cfg.object_spill_threshold * cap
-                if stats["allocated"] + nbytes > limit:
-                    rt.request("spill",
-                               int(stats["allocated"] + nbytes - limit)
-                               + (4 << 20))
-            try:
-                rt.store.put_serialized(ObjectID(rid), value)
-            except ObjectStoreFullError:
-                if not on_head:
-                    raise
-                rt.request("spill", int(nbytes * 1.5) + (1 << 20))
-                rt.store.put_serialized(ObjectID(rid), value)
+            _put_with_spill(rt, ObjectID(rid), value, nbytes)
             outs.append((rid, "shm", None, None))
     rt.send(("done", spec.task_id, spec.actor_id, outs))
 

@@ -1,0 +1,126 @@
+"""Client mode: remote drivers over TCP (the `ray://` role).
+
+Parity: reference `python/ray/util/client/` — a driver process OUTSIDE the
+cluster speaks to the head over one TCP connection and gets the full task/
+actor/object API. Redesign: instead of a dedicated gRPC proxy server
+(`util/client/server/`), the client speaks the native worker frame protocol
+over the head's existing cluster endpoint; the head inlines every object
+value over the wire (a client has no node-local shm store).
+
+    import ray_tpu
+    ray_tpu.init(address="10.0.0.1:6379")   # from any machine
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+from ray_tpu.core import serialization
+from ray_tpu.core.ids import ObjectID, WorkerID
+from ray_tpu.core.status import RayTpuError
+from ray_tpu.core.transport import recv_msg, send_msg
+from ray_tpu.core.worker import WorkerRuntime
+
+
+class ClientRuntime(WorkerRuntime):
+    """Store-free WorkerRuntime over TCP: all values travel inline."""
+
+    is_client = True
+
+    def __init__(self, address: str):
+        host, _, port = address.rpartition(":")
+        sock = socket.create_connection((host or "127.0.0.1", int(port)))
+        super().__init__(sock, WorkerID.from_random(), store_path="")
+        self._connected = True
+        send_msg(sock, ("client_hello", self.worker_id.binary()),
+                 self.send_lock)
+        self._receiver = threading.Thread(target=self._recv_loop,
+                                          daemon=True, name="rtpu-client-rx")
+        self._receiver.start()
+
+    def _recv_loop(self):
+        while True:
+            try:
+                msg = recv_msg(self.sock)
+            except OSError:
+                msg = None
+            if msg is None:
+                self._connected = False
+                # Unblock every waiter with a connection error.
+                with self._wait_lock:
+                    pending = list(self._pending_waits.items())
+                    self._pending_waits.clear()
+                for oid, evs in pending:
+                    self.object_cache[oid] = RayTpuError(
+                        "client connection to the head was lost")
+                    for ev in evs:
+                        ev.set()
+                with self._req_lock:
+                    futs = list(self._req_futures.values())
+                    self._req_futures.clear()
+                for fut in futs:
+                    fut.set_exception(RayTpuError(
+                        "client connection to the head was lost"))
+                return
+            try:
+                self.handle_push(msg)
+            except Exception:  # noqa: BLE001
+                import traceback
+                traceback.print_exc()
+
+    # -- store-free object plane --
+
+    @property
+    def store(self):
+        raise RayTpuError("client mode has no local object store")
+
+    def request(self, what, arg=None, timeout=30.0):
+        if not self._connected:
+            raise RayTpuError("client connection to the head was lost")
+        return super().request(what, arg, timeout)
+
+    def put(self, value):
+        from ray_tpu.core.object_ref import ObjectRef
+        payload, bufs, _refs = serialization.serialize_value(value)
+        oid = self.request("client_put", (payload, bufs), timeout=120.0)
+        return ObjectRef(ObjectID(oid), _add_ref=False)
+
+    def _get_one(self, ref, timeout=None):
+        oid = ref.id.binary()
+        _MISS = object()
+        cached = self.object_cache.get(oid, _MISS)
+        if cached is not _MISS:
+            return self._raise_if_error(cached)
+        if not self._connected:
+            raise RayTpuError("client connection to the head was lost")
+        ev = threading.Event()
+        with self._wait_lock:
+            self._pending_waits.setdefault(oid, []).append(ev)
+        self.send(("wait_obj", oid))
+        if not ev.wait(timeout):
+            from ray_tpu.core.status import GetTimeoutError
+            raise GetTimeoutError(f"get() timed out on {ref}")
+        cached = self.object_cache.get(oid, _MISS)
+        if cached is not _MISS:
+            return self._raise_if_error(cached)
+        raise RayTpuError(f"head pushed no value for {ref}")
+
+    def wait(self, refs, num_returns=1, timeout=None):
+        if num_returns > len(refs):
+            raise ValueError("num_returns > len(refs)")
+        ready_ids = self.request(
+            "client_wait",
+            ([r.id.binary() for r in refs], num_returns, timeout),
+            timeout=None if timeout is None else timeout + 10.0)
+        ready_set = set(ready_ids)
+        ready = [r for r in refs if r.id.binary() in ready_set]
+        not_ready = [r for r in refs if r.id.binary() not in ready_set]
+        return ready[:num_returns], ready[num_returns:] + not_ready
+
+    def disconnect(self):
+        self._connected = False
+        try:
+            self.sock.close()
+        except OSError:
+            pass

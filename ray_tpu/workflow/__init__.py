@@ -117,30 +117,33 @@ def _step_ids(dag: FunctionNode) -> dict[int, str]:
 
     visit(dag)
 
-    def canonical(obj):
-        """Order-stable structure for fingerprinting: raw pickle bytes of a
-        set/dict depend on insertion/hash order, which varies across
-        processes (PYTHONHASHSEED) — a resume would then miss its own
-        checkpoints."""
+    def fp(obj) -> bytes:
+        """Order- and process-stable fingerprint bytes. Containers are
+        canonicalized (set/dict iteration order varies with PYTHONHASHSEED);
+        everything else goes through cloudpickle, which is stable for plain
+        instances — default repr would embed a memory address and change
+        the step id on every resume."""
         if isinstance(obj, FunctionNode):
-            return "__dep__"
+            return b"__dep__"
         if isinstance(obj, dict):
-            return ("d", sorted((repr(k), canonical(v))
-                                for k, v in obj.items()))
+            return (b"d(" + b",".join(sorted(
+                fp(k) + b":" + fp(v) for k, v in obj.items())) + b")")
         if isinstance(obj, (set, frozenset)):
-            return ("s", sorted(repr(x) for x in obj))
+            return b"s(" + b",".join(sorted(fp(x) for x in obj)) + b")"
         if isinstance(obj, (list, tuple)):
-            return ("l", [canonical(x) for x in obj])
-        return repr(obj)
+            return b"l(" + b",".join(fp(x) for x in obj) + b")"
+        if obj is None or isinstance(obj, (str, bytes, int, float, bool)):
+            return repr(obj).encode()
+        try:
+            return cloudpickle.dumps(obj)
+        except Exception:  # noqa: BLE001 — last resort, may be unstable
+            return repr(obj).encode()
 
     ids = {}
     for i, n in enumerate(order):
         name = getattr(n.remote_fn, "__name__", "step")
-        try:
-            fingerprint = cloudpickle.dumps(
-                (name, canonical(list(n.args)), canonical(n.kwargs)))
-        except Exception:  # noqa: BLE001 — unpicklable constant: name-only
-            fingerprint = name.encode()
+        fingerprint = (name.encode() + b"|" + fp(list(n.args))
+                       + b"|" + fp(n.kwargs))
         ids[id(n)] = (f"{i:04d}_"
                       f"{hashlib.sha1(fingerprint).hexdigest()[:12]}")
     return ids, order

@@ -1,0 +1,112 @@
+"""Client-mode tests: a remote driver process over TCP.
+
+Parity: reference python/ray/util/client tests (ray:// sessions)."""
+
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import ray_tpu
+
+CLIENT_SCRIPT = textwrap.dedent("""
+    import sys
+    import numpy as np
+    import ray_tpu
+
+    address = sys.argv[1]
+    ray_tpu.init(address=address)
+
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    assert ray_tpu.get(double.remote(21), timeout=60) == 42
+
+    # object plane: put from the client, pass by ref, get back
+    arr = np.arange(200_000, dtype=np.float32)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(double.remote(ref), timeout=60)
+    np.testing.assert_allclose(out, arr * 2)
+
+    # actors
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+        def incr(self, k):
+            self.n += k
+            return self.n
+
+    c = Counter.remote()
+    assert ray_tpu.get([c.incr.remote(2) for _ in range(3)],
+                       timeout=60) == [2, 4, 6]
+
+    # wait
+    refs = [double.remote(i) for i in range(4)]
+    ready, rest = ray_tpu.wait(refs, num_returns=4, timeout=60)
+    assert len(ready) == 4 and not rest
+
+    # introspection through the request channel
+    assert ray_tpu.cluster_resources()["CPU"] >= 2
+    assert any(n["is_head"] for n in ray_tpu.nodes())
+
+    ray_tpu.kill(c)
+    ray_tpu.shutdown()
+    print("CLIENT-OK")
+""")
+
+
+@pytest.fixture(scope="module")
+def head_with_endpoint():
+    rt = ray_tpu.init(num_cpus=2)
+    addr = rt.enable_cluster()
+    yield rt, addr
+    ray_tpu.shutdown()
+
+
+def test_remote_client_driver(head_with_endpoint, tmp_path):
+    rt, addr = head_with_endpoint
+    script = tmp_path / "client.py"
+    script.write_text(CLIENT_SCRIPT)
+    import os
+    env = dict(os.environ)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, str(script), addr], env=env,
+        capture_output=True, text=True, timeout=180)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "CLIENT-OK" in out.stdout
+
+
+def test_client_disconnect_leaves_head_healthy(head_with_endpoint, tmp_path):
+    rt, addr = head_with_endpoint
+    # A client that connects and dies abruptly must not hurt the head.
+    script = tmp_path / "abrupt.py"
+    script.write_text(textwrap.dedent(f"""
+        import os, sys
+        import ray_tpu
+        ray_tpu.init(address={addr!r})
+
+        @ray_tpu.remote
+        def f():
+            return 1
+        assert ray_tpu.get(f.remote(), timeout=60) == 1
+        os._exit(0)  # no clean shutdown
+    """))
+    import os
+    env = dict(os.environ)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run([sys.executable, str(script)], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+    # Head still serves local work afterwards.
+    @ray_tpu.remote
+    def ping():
+        return "pong"
+
+    assert ray_tpu.get(ping.remote(), timeout=60) == "pong"
