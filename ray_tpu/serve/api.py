@@ -38,11 +38,19 @@ def _get_controller():
 
 def run(app: Application, *, name: str = "default",
         route_prefix: str | None = "/", http_port: int = DEFAULT_HTTP_PORT,
-        blocking_timeout_s: float = 60.0, _blocking: bool = True
-        ) -> DeploymentHandle:
-    """Deploy an application and return a handle to its ingress deployment."""
+        blocking_timeout_s: float = 60.0, _blocking: bool = True,
+        local_testing_mode: bool = False) -> DeploymentHandle:
+    """Deploy an application and return a handle to its ingress deployment.
+
+    local_testing_mode=True runs every deployment in-process with no
+    cluster, controller, or HTTP proxy (parity:
+    serve/_private/local_testing_mode.py) — unit-test an app's composition
+    logic with plain function calls."""
     if not isinstance(app, Application):
         raise TypeError("serve.run takes an Application (deployment.bind(...))")
+    if local_testing_mode:
+        from ray_tpu.serve.local_testing import run_local
+        return run_local(app)
     controller = _get_or_create_controller(http_port)
 
     deployments = {}

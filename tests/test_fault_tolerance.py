@@ -113,6 +113,41 @@ def test_chaos_message_delay():
         ray_tpu.shutdown()
 
 
+def test_chaos_heartbeat_drop_triggers_node_death():
+    """Dropping an agent's heartbeats (testing_rpc_failure, parity:
+    rpc_chaos.h) must trip the head's health check and mark the node dead
+    while its TCP connection is still up."""
+    import time
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(initialize_head=True, head_node_args={
+        "num_cpus": 1,
+        "_system_config": {
+            # Agents inherit this config via env: they drop their first
+            # 10k outgoing 'heartbeat' frames (the op exists only on the
+            # agent->head path, so nothing else is affected).
+            "testing_rpc_failure": "heartbeat=10000",
+            "health_check_period_ms": 200,
+            "health_check_failure_threshold": 3,
+        }})
+    try:
+        node = c.add_node(num_cpus=1)
+        deadline = time.monotonic() + 30
+        dead = False
+        while time.monotonic() < deadline:
+            row = next((n for n in ray_tpu.nodes()
+                        if n["node_id"] == node.node_id), None)
+            if row is not None and not row["alive"]:
+                dead = True
+                break
+            time.sleep(0.2)
+        assert dead, "head never declared the silent node dead"
+    finally:
+        c.shutdown()
+
+
 def test_kill_actor_queued_on_resources(ray_start_isolated):
     """Killing an actor whose creation is parked waiting for resources must
     cancel the queued create and fail parked calls, not start it later."""

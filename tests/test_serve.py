@@ -14,6 +14,34 @@ import pytest
 import ray_tpu
 from ray_tpu import serve
 
+
+def test_local_testing_mode_no_cluster():
+    """serve.run(..., local_testing_mode=True): full composition with no
+    cluster, controller, or proxy (parity: local_testing_mode.py)."""
+
+    @serve.deployment
+    class Doubler:
+        def __call__(self, x):
+            return x * 2
+
+        def describe(self):
+            return "doubler"
+
+    @serve.deployment
+    class Ingress:
+        def __init__(self, inner):
+            self.inner = inner
+
+        async def __call__(self, x):
+            return await self.inner.remote(x) + 1
+
+    app = Ingress.bind(Doubler.bind())
+    handle = serve.run(app, local_testing_mode=True)
+    assert handle.remote(20).result() == 41
+    # Named-method calls on the composed deployment work too.
+    inner = serve.run(Doubler.bind(), local_testing_mode=True)
+    assert inner.describe.remote().result() == "doubler"
+
 HTTP_PORT = 8123
 
 
