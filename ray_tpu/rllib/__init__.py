@@ -11,11 +11,13 @@ policy/rollout-worker stack is intentionally not reproduced):
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
+from ray_tpu.rllib.algorithms.bc import BC, BCConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 
 __all__ = [
-    "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN", "DQNConfig",
-    "IMPALA", "IMPALAConfig",
+    "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "APPO", "APPOConfig",
+    "BC", "BCConfig", "DQN", "DQNConfig", "IMPALA", "IMPALAConfig",
 ]

@@ -1,0 +1,69 @@
+"""APPO — asynchronous PPO (IMPALA's actor-learner loop + clipped loss).
+
+Parity: reference `rllib/algorithms/appo/appo.py` (async sampling with
+V-trace off-policy correction and the PPO clipped surrogate on the
+corrected advantages).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
+
+
+class APPOConfig(IMPALAConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = APPO
+        self.clip_param = 0.2
+
+    def training(self, *, clip_param=None, **kw):
+        super().training(**kw)
+        if clip_param is not None:
+            self.clip_param = clip_param
+        return self
+
+
+def appo_loss(params, batch, *, module, clip, vf_coef, ent_coef):
+    logits, value = module.forward_train(params, batch["obs"])
+    logp_all = jax.nn.log_softmax(logits)
+    logp = jnp.take_along_axis(
+        logp_all, batch["actions"][..., None].astype(jnp.int32), -1)[..., 0]
+    ratio = jnp.exp(logp - batch["behavior_logp"])
+    adv = batch["pg_advantages"]
+    surr = jnp.minimum(ratio * adv,
+                       jnp.clip(ratio, 1.0 - clip, 1.0 + clip) * adv)
+    pi_loss = -surr.mean()
+    vf_loss = jnp.square(value - batch["vs"]).mean()
+    entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+    total = pi_loss + vf_coef * vf_loss - ent_coef * entropy
+    return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,
+                   "entropy": entropy}
+
+
+class APPO(IMPALA):
+    """IMPALA's async machinery; the learner applies the clipped surrogate
+    against the behavior policy's log-probs."""
+
+    def _loss_fn(self):
+        return functools.partial(appo_loss, module=self.module)
+
+    def _loss_cfg(self):
+        c = self.config
+        return {"clip": c.clip_param, "vf_coef": c.vf_loss_coeff,
+                "ent_coef": c.entropy_coeff}
+
+    def _make_batch(self, f, vs, pg_adv):
+        import numpy as np
+        T, B = f["rewards"].shape
+        return {
+            "obs": f["obs"].reshape(T * B, -1),
+            "actions": f["actions"].reshape(-1),
+            "behavior_logp": f["logp"].reshape(-1),
+            "vs": np.asarray(vs).reshape(-1),
+            "pg_advantages": np.asarray(pg_adv).reshape(-1),
+        }

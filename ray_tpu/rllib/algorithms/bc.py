@@ -1,0 +1,80 @@
+"""BC — behavior cloning from offline (obs, action) data.
+
+Parity: reference `rllib/algorithms/bc/bc.py` (offline RL entry point:
+supervised policy learning over recorded episodes, the base of MARWIL).
+Offline data arrives as a ray_tpu.data Dataset (or a list of dicts) with
+"obs" and "actions" columns — the same shape the reference reads from its
+offline JSON/Parquet episode files.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+
+
+class BCConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=BC)
+        self.input_ = None  # Dataset | list[dict] with obs/actions
+
+    def offline_data(self, *, input_=None, **_compat):
+        if input_ is not None:
+            self.input_ = input_
+        return self
+
+
+def bc_loss(params, batch, *, module):
+    logits, _ = module.forward_train(params, batch["obs"])
+    logp = jax.nn.log_softmax(logits)
+    ll = jnp.take_along_axis(
+        logp, batch["actions"][..., None].astype(jnp.int32), -1)[..., 0]
+    loss = -ll.mean()
+    return loss, {"neg_logp": loss}
+
+
+class BC(Algorithm):
+    """Supervised: no env sampling; evaluation uses a local env runner."""
+
+    def __init__(self, config):
+        if config.input_ is None:
+            raise ValueError("BCConfig.offline_data(input_=...) is required")
+        config.num_env_runners = 0  # evaluation-only local runner
+        super().__init__(config)
+        rows = config.input_
+        if hasattr(rows, "take_all"):  # a ray_tpu.data Dataset
+            rows = rows.take_all()
+        self._obs = np.asarray([r["obs"] for r in rows], np.float32)
+        self._actions = np.asarray([r["actions"] for r in rows], np.int64)
+        self._rng = np.random.default_rng(config.seed)
+
+    def _loss_fn(self):
+        return functools.partial(bc_loss, module=self.module)
+
+    def training_step(self) -> dict:
+        c = self.config
+        n = len(self._obs)
+        metrics = {}
+        for _ in range(c.num_epochs):
+            idx = self._rng.permutation(n)
+            floor = max(2, c.num_learners or 1)  # every learner needs rows
+            for s in range(0, n, c.minibatch_size):
+                sel = idx[s:s + c.minibatch_size]
+                if len(sel) < floor:
+                    continue
+                metrics = self.learner_group.update(
+                    {"obs": self._obs[sel], "actions": self._actions[sel]})
+        self._timesteps += n * c.num_epochs
+        return metrics
+
+    def evaluate(self, num_steps: int = 500) -> dict:
+        """Roll the cloned policy greedily for a return estimate."""
+        self.env_runner_group.sample(self.learner_group.get_weights(),
+                                     num_steps)
+        return self.env_runner_group.aggregate_metrics()

@@ -101,6 +101,15 @@ class IMPALA(Algorithm):
         c = self.config
         return {"vf_coef": c.vf_loss_coeff, "ent_coef": c.entropy_coeff}
 
+    def _make_batch(self, f, vs, pg_adv) -> dict:
+        T, B = f["rewards"].shape
+        return {
+            "obs": f["obs"].reshape(T * B, -1),
+            "actions": f["actions"].reshape(-1),
+            "vs": np.asarray(vs).reshape(-1),
+            "pg_advantages": np.asarray(pg_adv).reshape(-1),
+        }
+
     def _broadcast(self):
         self._params_ref = ray_tpu.put(self.learner_group.get_weights())
 
@@ -152,12 +161,7 @@ class IMPALA(Algorithm):
                 gamma=c.gamma, rho_bar=c.clip_rho_threshold,
                 c_bar=c.clip_pg_rho_threshold)
             T, B = f["rewards"].shape
-            batch = {
-                "obs": f["obs"].reshape(T * B, -1),
-                "actions": f["actions"].reshape(-1),
-                "vs": np.asarray(vs).reshape(-1),
-                "pg_advantages": np.asarray(pg_adv).reshape(-1),
-            }
+            batch = self._make_batch(f, vs, pg_adv)
             metrics = self.learner_group.update(batch)
             params = live_params()
             steps += T * B

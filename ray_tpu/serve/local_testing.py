@@ -67,7 +67,7 @@ class LocalDeploymentHandle:
             raise AttributeError(item)
         return LocalDeploymentHandle(self._target, item, self._model_id)
 
-    def options(self, *, method_name: str | None = None,
+    def options(self, method_name: str | None = None, *,
                 multiplexed_model_id: str | None = None, **_ignored):
         return LocalDeploymentHandle(
             self._target, method_name or self._method,
@@ -84,7 +84,14 @@ class LocalDeploymentHandle:
             async def run_async():
                 token = _current_model_id.set(model_id)
                 try:
-                    return await fn(*args, **kwargs)
+                    out = await fn(*args, **kwargs)
+                    # Same materialization as the sync path — but inline:
+                    # _materialize's .result() would deadlock ON the loop.
+                    if inspect.isasyncgen(out):
+                        return [x async for x in out]
+                    if inspect.isgenerator(out):
+                        return list(out)
+                    return out
                 finally:
                     _current_model_id.reset(token)
             fut = asyncio.run_coroutine_threadsafe(run_async(), loop)
@@ -129,14 +136,15 @@ def run_local(app: Application) -> LocalDeploymentHandle:
         target = bound.deployment.func_or_class
         if inspect.isclass(target):
             target = target(*args, **kwargs)
-            user_config = bound.deployment.config.user_config
-            if user_config is not None:
-                # Same contract as ReplicaActor._apply_user_config.
-                if not hasattr(target, "reconfigure"):
-                    raise ValueError(
-                        f"deployment {bound.name} got user_config but "
-                        f"defines no reconfigure()")
-                target.reconfigure(user_config)
+        user_config = bound.deployment.config.user_config
+        if user_config is not None:
+            # Same contract as ReplicaActor._apply_user_config — function
+            # deployments must fail here too, not only at real deploy time.
+            if not hasattr(target, "reconfigure"):
+                raise ValueError(
+                    f"deployment {bound.name} got user_config but "
+                    f"defines no reconfigure()")
+            target.reconfigure(user_config)
         handle = LocalDeploymentHandle(target)
         memo[id(bound)] = handle
         return handle
