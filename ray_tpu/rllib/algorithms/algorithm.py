@@ -76,6 +76,14 @@ class Algorithm:
     def get_weights(self):
         return self.learner_group.get_weights()
 
+    def _extra_state(self) -> dict:
+        """Algorithm-specific checkpoint payload (SAC: target nets, alpha,
+        optimizer states). Base: nothing."""
+        return {}
+
+    def _load_extra_state(self, extra: dict, weights):
+        pass
+
     def save_to_path(self, path: str):
         import os
         os.makedirs(path, exist_ok=True)
@@ -83,6 +91,7 @@ class Algorithm:
             pickle.dump({"weights": self.get_weights(),
                          "iteration": self.iteration,
                          "timesteps": self._timesteps,
+                         "extra": self._extra_state(),
                          "config": self.config.to_dict()}, f)
         return path
 
@@ -96,8 +105,31 @@ class Algorithm:
             import ray_tpu
             ray_tpu.get([r.set_weights.remote(state["weights"])
                          for r in self.learner_group.remotes], timeout=120)
+        self._load_extra_state(state.get("extra", {}), state["weights"])
         self.iteration = state["iteration"]
         self._timesteps = state["timesteps"]
+
+    @staticmethod
+    def _replay_rows(f, *, actions_2d: bool) -> dict:
+        """Fragment -> flat replay transitions, bootstrapping through time
+        limits: truncated-not-terminated rows are dropped (their next_obs
+        is the auto-reset observation) and dones carry terminateds only."""
+        import numpy as np
+        T, B = f["rewards"].shape
+        next_obs = np.concatenate([f["obs"][1:], f["final_obs"][None]],
+                                  axis=0)
+        dones = f["dones"].reshape(-1)
+        terms = f["terminateds"].reshape(-1)
+        keep = ~((dones > 0) & (terms == 0))
+        actions = (f["actions"].reshape(T * B, -1) if actions_2d
+                   else f["actions"].reshape(-1))
+        return {
+            "obs": f["obs"].reshape(T * B, -1)[keep],
+            "actions": actions[keep],
+            "rewards": f["rewards"].reshape(-1).astype(np.float32)[keep],
+            "dones": terms.astype(np.float32)[keep],
+            "next_obs": next_obs.reshape(T * B, -1)[keep],
+        }
 
     def stop(self):
         self.env_runner_group.stop()

@@ -99,17 +99,8 @@ class DQN(Algorithm):
         frags = self.env_runner_group.sample(params,
                                              c.rollout_fragment_length)
         for f in frags:
-            T, B = f["rewards"].shape
-            next_obs = np.concatenate(
-                [f["obs"][1:], f["final_obs"][None]], axis=0)
-            self.buffer.add_batch({
-                "obs": f["obs"].reshape(T * B, -1),
-                "actions": f["actions"].reshape(-1),
-                "rewards": f["rewards"].reshape(-1),
-                "dones": f["dones"].reshape(-1),
-                "next_obs": next_obs.reshape(T * B, -1),
-            })
-            self._timesteps += T * B
+            self.buffer.add_batch(self._replay_rows(f, actions_2d=False))
+            self._timesteps += f["rewards"].size
         metrics = {}
         if self._timesteps >= c.num_steps_sampled_before_learning_starts:
             for _ in range(c.num_updates_per_iter):

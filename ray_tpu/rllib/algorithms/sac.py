@@ -1,0 +1,176 @@
+"""SAC — soft actor-critic for continuous control.
+
+Parity: reference `rllib/algorithms/sac/sac.py` (off-policy maximum-entropy
+RL: twin Q critics with a soft TD target, reparameterized actor, and
+auto-tuned temperature). TPU-native: the three updates (critic, actor,
+alpha) fuse into ONE jit over the online/target trees — the module is the
+squashed-Gaussian spec in rl_module.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.utils.replay_buffer import ReplayBuffer
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=SAC)
+        self.replay_buffer_capacity = 100_000
+        self.num_steps_sampled_before_learning_starts = 500
+        self.tau = 0.005               # polyak target update
+        self.initial_alpha = 0.2
+        self.target_entropy = None     # None -> -action_dim
+        self.lr = 3e-4
+        self.train_batch_size = 64
+        self.num_updates_per_iter = 32
+        self.rollout_fragment_length = 16
+
+    def training(self, *, replay_buffer_capacity=None, tau=None,
+                 initial_alpha=None, target_entropy=None,
+                 num_steps_sampled_before_learning_starts=None,
+                 num_updates_per_iter=None, **kw):
+        super().training(**kw)
+        for k, v in (("replay_buffer_capacity", replay_buffer_capacity),
+                     ("tau", tau), ("initial_alpha", initial_alpha),
+                     ("target_entropy", target_entropy),
+                     ("num_steps_sampled_before_learning_starts",
+                      num_steps_sampled_before_learning_starts),
+                     ("num_updates_per_iter", num_updates_per_iter)):
+            if v is not None:
+                setattr(self, k, v)
+        return self
+
+
+class SAC(Algorithm):
+    """Owns its own fused update (critic+actor+alpha in one jit) instead of
+    the generic LearnerGroup single-loss path."""
+
+    module_kind = "sac"
+
+    def __init__(self, config):
+        config.num_learners = 0  # the fused update IS the learner
+        super().__init__(config)
+        c = config
+        m = self.module
+        if getattr(m, "action_kind", "discrete") != "continuous":
+            raise ValueError("SAC needs a continuous (Box) action space")
+        self.buffer = ReplayBuffer(c.replay_buffer_capacity, seed=c.seed)
+        self.params = self.learner_group.get_weights()
+        self.target_params = jax.tree_util.tree_map(lambda x: x, self.params)
+        self.log_alpha = jnp.asarray(np.log(c.initial_alpha), jnp.float32)
+        self.target_entropy = (c.target_entropy
+                               if c.target_entropy is not None
+                               else -float(m.action_dim))
+        self.tx = optax.adam(c.lr)
+        self.opt_state = self.tx.init(self.params)
+        self.alpha_tx = optax.adam(c.lr)
+        self.alpha_opt_state = self.alpha_tx.init(self.log_alpha)
+        self._key = jax.random.PRNGKey(c.seed + 7)
+
+        gamma, tau, tgt_ent = c.gamma, c.tau, self.target_entropy
+
+        def update(params, target_params, opt_state, log_alpha,
+                   alpha_opt_state, batch, key):
+            k1, k2 = jax.random.split(key)
+            alpha = jnp.exp(log_alpha)
+
+            # soft TD target from the target critics
+            next_a, next_logp = m.sample(params, batch["next_obs"], k1)
+            tq1, tq2 = m.q_values(target_params, batch["next_obs"], next_a)
+            tq = jnp.minimum(tq1, tq2) - alpha * next_logp
+            target = batch["rewards"] + gamma * (1 - batch["dones"]) * tq
+            target = jax.lax.stop_gradient(target)
+
+            def critic_loss(p):
+                q1, q2 = m.q_values(p, batch["obs"], batch["actions"])
+                return (jnp.square(q1 - target).mean()
+                        + jnp.square(q2 - target).mean())
+
+            def actor_loss(p):
+                a, logp = m.sample(p, batch["obs"], k2)
+                q1, q2 = m.q_values(jax.lax.stop_gradient(p), batch["obs"],
+                                    a)
+                return (alpha * logp - jnp.minimum(q1, q2)).mean(), logp
+
+            closs, cgrads = jax.value_and_grad(critic_loss)(params)
+            (aloss, logp), agrads = jax.value_and_grad(
+                actor_loss, has_aux=True)(params)
+            # Critic grads touch q*, actor grads touch pi*: sum is safe.
+            grads = jax.tree_util.tree_map(lambda a_, b_: a_ + b_,
+                                           cgrads, agrads)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+
+            def alpha_loss(la):
+                return (-jnp.exp(la)
+                        * (jax.lax.stop_gradient(logp) + tgt_ent)).mean()
+
+            al, agrad = jax.value_and_grad(alpha_loss)(log_alpha)
+            aupd, alpha_opt_state = self.alpha_tx.update(
+                agrad, alpha_opt_state, log_alpha)
+            log_alpha = optax.apply_updates(log_alpha, aupd)
+
+            target_params = jax.tree_util.tree_map(
+                lambda t, p: (1 - tau) * t + tau * p, target_params, params)
+            aux = {"critic_loss": closs, "actor_loss": aloss,
+                   "alpha": jnp.exp(log_alpha),
+                   "entropy": -logp.mean()}
+            return (params, target_params, opt_state, log_alpha,
+                    alpha_opt_state, aux)
+
+        self._update = jax.jit(update)
+
+    def _loss_fn(self):
+        # The generic learner is only a parameter container for SAC.
+        return lambda params, batch: (jnp.float32(0.0), {})
+
+    def training_step(self) -> dict:
+        c = self.config
+        frags = self.env_runner_group.sample(self.params,
+                                             c.rollout_fragment_length)
+        for f in frags:
+            self.buffer.add_batch(self._replay_rows(f, actions_2d=True))
+            self._timesteps += f["rewards"].size
+        metrics = {}
+        if self._timesteps >= c.num_steps_sampled_before_learning_starts:
+            for _ in range(c.num_updates_per_iter):
+                batch = {k: jnp.asarray(v)
+                         for k, v in self.buffer.sample(
+                             c.train_batch_size).items()}
+                self._key, sub = jax.random.split(self._key)
+                (self.params, self.target_params, self.opt_state,
+                 self.log_alpha, self.alpha_opt_state, aux) = self._update(
+                    self.params, self.target_params, self.opt_state,
+                    self.log_alpha, self.alpha_opt_state, batch, sub)
+                metrics = {k: float(v) for k, v in aux.items()}
+        return metrics
+
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+    def _extra_state(self) -> dict:
+        return {
+            "target_params": jax.device_get(self.target_params),
+            "log_alpha": float(self.log_alpha),
+            "opt_state": jax.device_get(self.opt_state),
+            "alpha_opt_state": jax.device_get(self.alpha_opt_state),
+            "key": jax.device_get(self._key),
+        }
+
+    def _load_extra_state(self, extra: dict, weights):
+        # SAC trains from self.params, not the learner group — apply the
+        # checkpointed weights here or restore would be a no-op.
+        self.params = jax.device_put(weights)
+        if extra:
+            self.target_params = jax.device_put(extra["target_params"])
+            self.log_alpha = jnp.asarray(extra["log_alpha"], jnp.float32)
+            self.opt_state = jax.device_put(extra["opt_state"])
+            self.alpha_opt_state = jax.device_put(extra["alpha_opt_state"])
+            self._key = jnp.asarray(extra["key"])

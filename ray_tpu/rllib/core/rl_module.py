@@ -143,10 +143,116 @@ class QModule:
         return action, logp_a, q.max(axis=-1)
 
 
+@dataclass
+class SquashedGaussianModule:
+    """Tanh-squashed Gaussian policy + twin Q critics for continuous
+    control (the SAC module; parity: rllib's default SAC RLModule).
+    Actions live in [low, high] via tanh rescaling; log-probs carry the
+    tanh change-of-variables correction."""
+
+    obs_dim: int
+    action_dim: int
+    low: tuple
+    high: tuple
+    hidden: tuple = (64, 64)
+
+    action_kind = "continuous"
+    LOG_STD_MIN = -10.0
+    LOG_STD_MAX = 2.0
+
+    def _scale(self):
+        low = jnp.asarray(self.low)
+        high = jnp.asarray(self.high)
+        return (high - low) / 2.0, (high + low) / 2.0
+
+    def init(self, key) -> dict:
+        kp, kh, k1 = jax.random.split(key, 3)
+        torso = MLPSpec(self.obs_dim, self.hidden, activation="relu")
+        qspec = MLPSpec(self.obs_dim + self.action_dim, self.hidden,
+                        activation="relu")
+        kq1, kq2, kh1, kh2 = jax.random.split(kh, 4)
+        return {
+            "pi": torso.init(kp),
+            "pi_head": {"w": _dense_init(k1, (self.hidden[-1],
+                                              2 * self.action_dim), 0.01),
+                        "b": jnp.zeros((2 * self.action_dim,))},
+            "q1": qspec.init(kq1),
+            "q1_head": {"w": _dense_init(kh1, (self.hidden[-1], 1)),
+                        "b": jnp.zeros((1,))},
+            "q2": qspec.init(kq2),
+            "q2_head": {"w": _dense_init(kh2, (self.hidden[-1], 1)),
+                        "b": jnp.zeros((1,))},
+        }
+
+    def pi(self, params, obs):
+        torso = MLPSpec(self.obs_dim, self.hidden, activation="relu")
+        h = torso.apply(params["pi"], obs)
+        out = h @ params["pi_head"]["w"] + params["pi_head"]["b"]
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        log_std = jnp.clip(log_std, self.LOG_STD_MIN, self.LOG_STD_MAX)
+        return mean, log_std
+
+    def sample(self, params, obs, key):
+        """Reparameterized sample -> (action in env bounds, logp)."""
+        mean, log_std = self.pi(params, obs)
+        std = jnp.exp(log_std)
+        eps = jax.random.normal(key, mean.shape)
+        pre_tanh = mean + std * eps
+        tanh_a = jnp.tanh(pre_tanh)
+        # N(mean, std) logp minus the tanh Jacobian (numerically stable).
+        logp = (-0.5 * (eps ** 2) - log_std
+                - 0.5 * np.log(2 * np.pi)).sum(-1)
+        logp -= (2 * (np.log(2.0) - pre_tanh
+                      - jax.nn.softplus(-2 * pre_tanh))).sum(-1)
+        scale, shift = self._scale()
+        # Affine rescale to env bounds has its own Jacobian: |d a/d tanh| =
+        # scale per dim.
+        logp -= jnp.log(scale).sum()
+        return tanh_a * scale + shift, logp
+
+    def q_values(self, params, obs, action):
+        qspec = MLPSpec(self.obs_dim + self.action_dim, self.hidden,
+                        activation="relu")
+        x = jnp.concatenate([obs, action], axis=-1)
+        h1 = qspec.apply(params["q1"], x)
+        h2 = qspec.apply(params["q2"], x)
+        q1 = (h1 @ params["q1_head"]["w"] + params["q1_head"]["b"])[..., 0]
+        q2 = (h2 @ params["q2_head"]["w"] + params["q2_head"]["b"])[..., 0]
+        return q1, q2
+
+    # --- env-runner interface ---
+
+    def forward_exploration(self, params, obs, key):
+        action, logp = self.sample(params, obs, key)
+        return action, logp, jnp.zeros(obs.shape[0])
+
+    def forward_inference(self, params, obs):
+        mean, _ = self.pi(params, obs)
+        scale, shift = self._scale()
+        return jnp.tanh(mean) * scale + shift
+
+
 def module_for_env(env_like, hidden=(64, 64), kind="actor_critic"):
-    """Build the default module from (obs_space, action_space) shapes."""
+    """Build the default module from (obs_space, action_space) shapes;
+    Box action spaces get the continuous (squashed-Gaussian) module."""
+    import gymnasium as gym
     obs_dim = int(np.prod(env_like.observation_space.shape))
-    num_actions = int(env_like.action_space.n)
+    space = env_like.action_space
+    if isinstance(space, gym.spaces.Box):
+        if kind != "sac":
+            raise ValueError(
+                f"only SAC supports continuous (Box) action spaces so far; "
+                f"{kind!r} modules need a Discrete space (got {space})")
+        low = np.asarray(space.low, np.float32).ravel()
+        high = np.asarray(space.high, np.float32).ravel()
+        if not (np.isfinite(low).all() and np.isfinite(high).all()):
+            raise ValueError(
+                f"continuous control needs bounded actions; got Box with "
+                f"low={space.low}, high={space.high}")
+        return SquashedGaussianModule(
+            obs_dim, int(np.prod(space.shape)),
+            tuple(low.tolist()), tuple(high.tolist()), hidden)
+    num_actions = int(space.n)
     if kind == "q":
         return QModule(obs_dim, num_actions, hidden)
     return ActorCriticModule(obs_dim, num_actions, hidden)

@@ -51,11 +51,15 @@ class SingleAgentEnvRunner:
 
         T, B = num_steps, self.num_envs
         obs_buf = np.empty((T, B, self.obs.shape[-1]), np.float32)
-        act_buf = np.empty((T, B), np.int64)
+        if getattr(self.module, "action_kind", "discrete") == "continuous":
+            act_buf = np.empty((T, B, self.module.action_dim), np.float32)
+        else:
+            act_buf = np.empty((T, B), np.int64)
         logp_buf = np.empty((T, B), np.float32)
         val_buf = np.empty((T, B), np.float32)
         rew_buf = np.empty((T, B), np.float32)
         done_buf = np.empty((T, B), np.float32)
+        term_buf = np.empty((T, B), np.float32)
         for t in range(T):
             self._key, sub = jax.random.split(self._key)
             if explore:
@@ -72,6 +76,8 @@ class SingleAgentEnvRunner:
             done = np.logical_or(term, trunc)
             rew_buf[t] = rew
             done_buf[t] = done
+            term_buf[t] = term  # truncation is NOT termination: TD targets
+            # bootstrap through time limits (dones only cut episodes)
             self._ep_ret += rew
             self._ep_len += 1
             for i in np.nonzero(done)[0]:
@@ -86,6 +92,7 @@ class SingleAgentEnvRunner:
         return {
             "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
             "values": val_buf, "rewards": rew_buf, "dones": done_buf,
+            "terminateds": term_buf,
             "last_values": np.asarray(last_val),
             "final_obs": self.obs.copy(),  # next_obs tail for TD targets
         }
