@@ -15,11 +15,12 @@ from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
+from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "APPO", "APPOConfig",
     "BC", "BCConfig", "DQN", "DQNConfig", "IMPALA", "IMPALAConfig",
-    "SAC", "SACConfig",
+    "MARWIL", "MARWILConfig", "SAC", "SACConfig",
 ]

@@ -50,12 +50,20 @@ class BC(Algorithm):
         rows = config.input_
         if hasattr(rows, "take_all"):  # a ray_tpu.data Dataset
             rows = rows.take_all()
+        if not rows:
+            self.stop()  # groups already exist: don't leak their actors
+            raise ValueError("offline input is empty")
+        self._rows = rows  # materialized ONCE; subclasses read from here
         self._obs = np.asarray([r["obs"] for r in rows], np.float32)
         self._actions = np.asarray([r["actions"] for r in rows], np.int64)
         self._rng = np.random.default_rng(config.seed)
 
     def _loss_fn(self):
         return functools.partial(bc_loss, module=self.module)
+
+    def _batch(self, sel) -> dict:
+        """Minibatch for the learner; subclasses (MARWIL) add columns."""
+        return {"obs": self._obs[sel], "actions": self._actions[sel]}
 
     def training_step(self) -> dict:
         c = self.config
@@ -68,8 +76,7 @@ class BC(Algorithm):
                 sel = idx[s:s + c.minibatch_size]
                 if len(sel) < floor:
                     continue
-                metrics = self.learner_group.update(
-                    {"obs": self._obs[sel], "actions": self._actions[sel]})
+                metrics = self.learner_group.update(self._batch(sel))
         self._timesteps += n * c.num_epochs
         return metrics
 

@@ -1,0 +1,77 @@
+"""MARWIL — monotonic advantage re-weighted imitation learning.
+
+Parity: reference `rllib/algorithms/marwil/marwil.py` (offline RL between
+BC and RL: clone actions weighted by exp(beta * advantage), advantage =
+observed return minus the learned value baseline; beta=0 reduces to BC).
+Shares BC's offline-data plumbing; rows additionally carry "returns"
+(rewards-to-go).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithms.bc import BC, BCConfig
+
+
+class MARWILConfig(BCConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = MARWIL
+        self.beta = 1.0
+        self.vf_coeff = 1.0
+
+    def training(self, *, beta=None, vf_coeff=None, **kw):
+        super().training(**kw)
+        if beta is not None:
+            self.beta = beta
+        if vf_coeff is not None:
+            self.vf_coeff = vf_coeff
+        return self
+
+
+def marwil_loss(params, batch, *, module, beta, vf_coeff):
+    logits, value = module.forward_train(params, batch["obs"])
+    logp_all = jax.nn.log_softmax(logits)
+    logp = jnp.take_along_axis(
+        logp_all, batch["actions"][..., None].astype(jnp.int32), -1)[..., 0]
+    adv = batch["returns"] - value
+    # Scale-normalize before exponentiating (parity: the reference divides
+    # by a running sqrt(E[adv^2]) — raw returns in the hundreds would
+    # overflow float32 exp and NaN the whole tree); the clip bounds the
+    # symmetric underflow (all-w=0 -> silent zero gradient).
+    adv_sg = jax.lax.stop_gradient(adv)
+    rms = jnp.sqrt(jnp.mean(jnp.square(adv_sg)) + 1e-8)
+    w = jnp.exp(jnp.clip(beta * adv_sg / rms, -10.0, 10.0))
+    w = w / jnp.maximum(w.mean(), 1e-8)
+    pi_loss = -(w * logp).mean()
+    vf_loss = jnp.square(adv).mean()
+    return pi_loss + vf_coeff * vf_loss, {
+        "policy_loss": pi_loss, "vf_loss": vf_loss,
+        "mean_advantage": adv.mean()}
+
+
+class MARWIL(BC):
+    def __init__(self, config):
+        super().__init__(config)
+        # self._rows is the ONE materialization done by BC — re-running a
+        # lazy Dataset here could reorder rows and misalign returns.
+        if "returns" not in self._rows[0]:
+            self.stop()  # groups already exist: don't leak their actors
+            raise ValueError(
+                "MARWIL offline rows need 'returns' (rewards-to-go)")
+        self._returns = np.asarray([r["returns"] for r in self._rows],
+                                   np.float32)
+
+    def _loss_fn(self):
+        return functools.partial(
+            marwil_loss, module=self.module, beta=self.config.beta,
+            vf_coeff=self.config.vf_coeff)
+
+    def _batch(self, sel) -> dict:
+        return {"obs": self._obs[sel], "actions": self._actions[sel],
+                "returns": self._returns[sel]}
