@@ -37,6 +37,12 @@ class ScalingConfig:
     use_tpu: bool = False
     resources_per_worker: dict | None = None
     chips_per_worker: int = 0     # 0 = all chips on the host
+    # Elastic lower bound (parity: Train v2 ScalingPolicy,
+    # scaling_policy.py:29): None = fixed size; set to let a run start (or
+    # RESTART after failures/preemptions) with however many workers
+    # currently fit the cluster, down to this floor. TPU fleets are
+    # preemption-heavy — resuming smaller beats not resuming.
+    min_workers: int | None = None
 
 
 @dataclasses.dataclass
@@ -166,23 +172,85 @@ class JaxTrainer:
         os.makedirs(path, exist_ok=True)
         return path
 
-    def _make_group(self, storage_dir: str):
-        n = self.scaling.num_workers
+    def _per_worker_req(self) -> dict:
+        """Every resource one worker consumes (custom resources included) —
+        the ONE definition sizing and group creation both use."""
         res = dict(self.scaling.resources_per_worker or {})
-        num_tpus = res.pop("TPU", self.scaling.chips_per_worker
-                           if self.scaling.use_tpu else 0)
-        num_cpus = res.pop("CPU", 1)
+        req = dict(res)
+        req["CPU"] = res.get("CPU", 1)
+        tpus = res.get("TPU", self.scaling.chips_per_worker
+                       if self.scaling.use_tpu else 0)
+        if tpus:
+            req["TPU"] = tpus
+        else:
+            req.pop("TPU", None)
+        return req
+
+    def _fit_now(self) -> int:
+        """Workers placeable RIGHT NOW, summed per node (aggregate totals
+        would mis-fit fragmented clusters: 4+4 free TPUs cannot host an
+        8-TPU worker)."""
+        req = self._per_worker_req()
+        total = 0
+        for row in ray_tpu.nodes():
+            if not row["alive"]:
+                continue
+            avail = row["available"]
+            total += min((int(avail.get(k, 0.0) // v)
+                          for k, v in req.items() if v > 0), default=0)
+        return total
+
+    def _elastic_size(self, wait_s: float = 0.0) -> int:
+        """Workers for this (re)start: fixed, or fitted to what the cluster
+        offers (elastic ScalingPolicy). On restarts the previous gang's
+        kills release resources asynchronously — wait for capacity to
+        settle instead of snapshotting mid-teardown and shrinking to the
+        floor for no reason."""
+        n = self.scaling.num_workers
+        lo = self.scaling.min_workers
+        if lo is None:
+            return n
+        deadline = time.monotonic() + wait_s
+        best = self._fit_now()
+        while best < n and time.monotonic() < deadline:
+            time.sleep(0.1)
+            best = max(best, self._fit_now())
+        if best < lo:
+            from ray_tpu.core.status import ResourceError
+            raise ResourceError(
+                f"elastic run needs at least min_workers={lo} x "
+                f"{self._per_worker_req()} but the cluster currently fits "
+                f"{best} (fail-fast beats burning the failure budget on "
+                f"placement timeouts)")
+        return min(best, n)
+
+    def _make_group(self, storage_dir: str, n: int):
+        req = self._per_worker_req()
+        num_cpus = req.get("CPU", 1)
+        num_tpus = req.get("TPU", 0)
+        custom = {k: v for k, v in req.items() if k not in ("CPU", "TPU")}
         env = {}
         WorkerCls = ray_tpu.remote(TrainWorker).options(
-            num_cpus=num_cpus, num_tpus=num_tpus, resources=res or None)
+            num_cpus=num_cpus, num_tpus=num_tpus,
+            resources=custom or None)
         workers = [
             WorkerCls.remote(rank=i, world_size=n, storage_dir=storage_dir,
                              coordinator=None, env=env)
             for i in range(n)
         ]
-        # Gang rendezvous (SPMD impedance, SURVEY §7 hard-part 3).
-        ray_tpu.get([w.setup_distributed.remote() for w in workers],
-                    timeout=300)
+        try:
+            # Gang rendezvous (SPMD impedance, SURVEY §7 hard-part 3).
+            ray_tpu.get([w.setup_distributed.remote() for w in workers],
+                        timeout=300)
+        except BaseException:
+            # A partial gang must not leak: surviving actors would hold
+            # their reservations forever and starve every retry.
+            for w in workers:
+                try:
+                    ray_tpu.kill(w)
+                except Exception:  # noqa: BLE001
+                    pass
+            raise
         return workers
 
     def fit(self) -> Result:
@@ -196,16 +264,31 @@ class JaxTrainer:
         latest_metrics: dict = {}
         latest_ckpt_path = resume_path
 
+        first_start = True
         while True:
             self.state = RUNNING
-            workers = self._make_group(storage_dir)
-            shards = self._split_datasets()
-            ray_tpu.get([
-                w.run.remote(loop_bytes, self.loop_config, latest_ckpt_path,
-                             shards[i])
-                for i, w in enumerate(workers)], timeout=300)
+            # Restarts wait for the previous gang's resources to release.
+            n = self._elastic_size(wait_s=0.0 if first_start else 5.0)
+            first_start = False
             error = None
+            workers = []
             try:
+                # Group setup and gang start can also lose a worker (crash
+                # in the first steps races the start RPC; a shrunk cluster
+                # can kill placement) — all of it is FailurePolicy territory.
+                workers = self._make_group(storage_dir, n)
+                shards = self._split_datasets(n)
+                ray_tpu.get([
+                    w.run.remote(loop_bytes, self.loop_config,
+                                 latest_ckpt_path, shards[i])
+                    for i, w in enumerate(workers)], timeout=300)
+            except _WorkerGroupError as e:
+                error = e
+            except ray_tpu.RayTpuError as e:
+                error = _WorkerGroupError(f"worker group start failed: {e}")
+            try:
+                if error is not None:
+                    raise error
                 latest_metrics, history_part, latest_ckpt_path = (
                     self._poll_until_done(workers, latest_ckpt_path))
                 history.extend(history_part)
@@ -238,9 +321,8 @@ class JaxTrainer:
             checkpoint=Checkpoint(latest_ckpt_path) if latest_ckpt_path else None,
             path=storage_dir, metrics_history=history)
 
-    def _split_datasets(self):
+    def _split_datasets(self, n: int):
         """Per-worker dataset shards (parity: get_dataset_shard/streaming_split)."""
-        n = self.scaling.num_workers
         shards = [dict() for _ in range(n)]
         for name, ds in self.datasets.items():
             if hasattr(ds, "split"):
@@ -257,8 +339,14 @@ class JaxTrainer:
         done = [False] * len(workers)
         while not all(done):
             time.sleep(0.05)
-            polls = ray_tpu.get(
-                [w.poll.remote() for w in workers], timeout=600)
+            try:
+                polls = ray_tpu.get(
+                    [w.poll.remote() for w in workers], timeout=600)
+            except ray_tpu.RayTpuError as e:
+                # A hard-crashed worker (OOM kill, preempted host, os._exit)
+                # dies as an actor, not as an error report — that is still
+                # a worker-group failure the FailurePolicy must see.
+                raise _WorkerGroupError(f"worker actor died: {e}") from e
             for i, (reports, finished, err) in enumerate(polls):
                 for r in reports:
                     if "error" in r:
