@@ -9,6 +9,7 @@ libraries (data, train, tune, serve, rllib) built purely on those primitives.
 from ray_tpu._version import version as __version__
 from ray_tpu.core.api import (
     available_resources,
+    cancel,
     cluster_resources,
     get,
     get_actor,
@@ -27,6 +28,7 @@ from ray_tpu.core.api import (
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.status import (
     ActorDiedError,
+    TaskCancelledError,
     GetTimeoutError,
     ObjectLostError,
     RayTpuError,
@@ -38,8 +40,8 @@ from ray_tpu import util  # noqa: E402,F401  (parity: ray.util auto-import)
 
 __all__ = [
     "__version__", "init", "shutdown", "is_initialized", "remote", "method",
-    "get", "put", "wait", "kill", "get_actor", "cluster_resources",
+    "get", "put", "wait", "kill", "cancel", "get_actor", "cluster_resources",
     "available_resources", "nodes", "get_node_id", "timeline", "ObjectRef",
-    "RayTpuError", "TaskError", "ActorDiedError", "WorkerCrashedError",
+    "RayTpuError", "TaskError", "TaskCancelledError", "ActorDiedError", "WorkerCrashedError",
     "ObjectLostError", "GetTimeoutError", "util",
 ]

@@ -92,6 +92,17 @@ def wait(refs, *, num_returns=1, timeout=None):
     return get_runtime().wait(refs, num_returns=num_returns, timeout=timeout)
 
 
+def cancel(ref: ObjectRef, *, force: bool = False) -> bool:
+    """Cancel the task behind `ref` (parity: ray.cancel). Queued tasks fail
+    with TaskCancelledError; running tasks are only interrupted with
+    force=True. Returns whether a cancellation took effect."""
+    from ray_tpu.core.runtime import Runtime, get_runtime
+    rt = get_runtime()
+    if isinstance(rt, Runtime):
+        return rt.cancel_task(ref.id.binary(), force=force)
+    return rt.request("cancel", (ref.id.binary(), force))
+
+
 def kill(actor: ActorHandle, *, no_restart=True):
     from ray_tpu.core.runtime import Runtime, get_runtime
     rt = get_runtime()

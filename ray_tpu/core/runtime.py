@@ -530,6 +530,10 @@ class Runtime:
         # Per-scheduling-key task queues (parity: normal_task_submitter.h:58
         # SchedulingKey — one reserve probe covers every queued sibling).
         self.task_queues: dict[tuple, collections.deque] = {}
+        # return-oid -> live TaskSpec (cancel() resolves refs to tasks);
+        # entries drop when the task finishes or fails.
+        self._rid_to_spec: dict[bytes, TaskSpec] = {}
+        self._cancelled: set[bytes] = set()  # task_ids
         self.waiting_deps: dict[bytes, list] = {}  # oid -> [pending items]
         self.actors: dict[bytes, ActorState] = {}
         self.named_actors: dict[str, bytes] = {}
@@ -982,6 +986,8 @@ class Runtime:
 
             threading.Thread(target=wait_and_reply, daemon=True).start()
             return
+        elif what == "cancel":
+            resp = self.cancel_task(arg[0], force=arg[1])
         elif what == "kill_actor":
             self.kill_actor_by_id(arg, no_restart=True)
             resp = True
@@ -1591,12 +1597,107 @@ class Runtime:
         if fn_blob is not None:
             self.export_function(spec.fn_id, fn_blob)
         self.task_events.record(spec.task_id, spec.describe(), "SUBMITTED")
+        with self.lock:
+            for rid in spec.return_ids:
+                self._rid_to_spec[rid] = spec
         # Pin dependencies for the task's lifetime so the owner cannot free
         # them between submit and execution (conservative borrower counting).
         for oid in spec.dependencies or []:
             self.refcount.pin(oid)
         item = {"kind": "task", "spec": spec, "pending": 0}
         self._gate_on_deps(item, spec.dependencies or [])
+
+    def cancel_task(self, rid: bytes, force: bool = False) -> bool:
+        """Cancel the task owning return-oid `rid` (parity: ray.cancel,
+        core_worker.h CancelTask). Queued/dep-gated tasks (and actor calls
+        still parked in the actor's queue) fail immediately with
+        TaskCancelledError; a RUNNING plain task is only interrupted with
+        force=True (its worker is killed; the task does not retry). A
+        no-effect call (already finished / running without force / actor
+        call already executing) returns False WITHOUT mutating the task."""
+        from ray_tpu.core.status import TaskCancelledError
+        err = None
+        notify_worker = None  # socket I/O deferred until the lock drops
+        kill_worker = None
+        with self.lock:
+            spec = self._rid_to_spec.get(rid)
+            if spec is None:
+                return False  # already finished (or not a task ref)
+            if spec.actor_id is not None:
+                # Actor call: definite cancel while parked head-side
+                # (actor PENDING/RESTARTING) or still dep-gated;
+                # best-effort once pushed to the worker — it drops the call
+                # if not yet started (interrupting a RUNNING method would
+                # mean killing the actor, so that stays out of scope).
+                st = self.actors.get(spec.actor_id)
+                if st is None:
+                    return False
+                try:
+                    st.queued.remove(spec)
+                    err = TaskCancelledError(
+                        f"actor task {spec.describe()} was cancelled")
+                except ValueError:
+                    if (spec.task_id in st.inflight
+                            and st.worker is not None
+                            and st.worker.state != DEAD):
+                        notify_worker = st.worker
+                    elif self.directory.lookup(rid) is None:
+                        # Dep-gated actor call: tombstone drops it when the
+                        # deps arrive (same path as plain tasks).
+                        self._cancelled.add(spec.task_id)
+                        err = TaskCancelledError(
+                            f"actor task {spec.describe()} was cancelled")
+                    else:
+                        return False  # already finished
+            else:
+                q = self.task_queues.get(self._sched_key(spec))
+                queued = False
+                if q is not None:
+                    try:
+                        q.remove(spec)
+                        queued = True
+                    except ValueError:
+                        pass
+                if queued:
+                    err = TaskCancelledError(
+                        f"task {spec.describe()} was cancelled")
+                else:
+                    running = next(
+                        (w for w in self.workers.values()
+                         if w.state == BUSY and w.current_task is not None
+                         and w.current_task.task_id == spec.task_id), None)
+                    if running is not None:
+                        if self.directory.lookup(rid) is not None:
+                            # Completed; the worker just hasn't been marked
+                            # idle yet — killing it would murder a healthy
+                            # process over a finished task.
+                            return False
+                        if not force:
+                            return False  # running; nothing was mutated
+                        # Force: mark so the death handler fails (not
+                        # retries) it, then kill the worker.
+                        self._cancelled.add(spec.task_id)
+                        spec.retries_left = 0
+                        kill_worker = running
+                    elif self.directory.lookup(rid) is not None:
+                        return False  # completed while we looked
+                    else:
+                        # Dep-gated: tombstone so _enqueue_ready drops it
+                        # when its deps arrive (returns fail right now).
+                        self._cancelled.add(spec.task_id)
+                        err = TaskCancelledError(
+                            f"task {spec.describe()} was cancelled")
+        if notify_worker is not None:
+            try:
+                notify_worker.send(("cancel_task", spec.task_id))
+            except OSError:
+                return False
+            return True  # best-effort; the worker reports the fate
+        if kill_worker is not None:
+            kill_worker.kill()
+            return True
+        self._fail_returns(spec, err)
+        return True
 
     def _unpin_deps(self, spec: TaskSpec):
         for oid in spec.dependencies or []:
@@ -1617,11 +1718,22 @@ class Runtime:
         if item["kind"] == "task":
             spec = item["spec"]
             self._inline_ready_deps(spec)
+            with self.lock:
+                # Tombstone check atomic with the enqueue: a cancel racing
+                # this either lands its tombstone before the check (we drop
+                # here) or finds the spec already in its queue (it removes
+                # it there) — no window where both miss.
+                if spec.task_id in self._cancelled:
+                    # Returns already failed (and deps already unpinned by
+                    # that failure); running it anyway would overwrite the
+                    # cancellation error.
+                    self._cancelled.discard(spec.task_id)
+                    return
+                if spec.actor_id is None:
+                    self._enqueue_task_locked(spec)
             if spec.actor_id is not None:
                 self._submit_actor_task(spec)
                 return
-            with self.lock:
-                self._enqueue_task_locked(spec)
             self._schedule()
         else:
             self._create_actor_now(item["cspec"])
@@ -2195,6 +2307,10 @@ class Runtime:
             else:
                 self.directory.add_location(rid, w.node_id)
             self._on_object_ready(rid)
+        with self.lock:
+            for rid, _s, _p, _b in outs:
+                self._rid_to_spec.pop(rid, None)
+            self._cancelled.discard(task_id)  # force-cancel lost the race
         if actor_id is not None:
             st = self.actors.get(actor_id)
             if st is not None:
@@ -2221,6 +2337,11 @@ class Runtime:
         err = exc if isinstance(exc, TaskError) else TaskError(
             exc, str(exc), spec.describe())
         self._unpin_deps(spec)
+        with self.lock:
+            # NOTE: _cancelled is NOT cleared here — a dep-gated cancelled
+            # task still needs its tombstone when the deps arrive.
+            for rid in spec.return_ids:
+                self._rid_to_spec.pop(rid, None)
         for rid in spec.return_ids:
             self.directory.put(rid, ("err", err))
             self._on_object_ready(rid)
@@ -2513,6 +2634,11 @@ class Runtime:
                 self.task_events.record(spec.task_id, spec.describe(), "RETRY")
                 with self.lock:
                     self._enqueue_task_locked(spec, front=True)
+            elif spec.task_id in self._cancelled:
+                from ray_tpu.core.status import TaskCancelledError
+                self._fail_returns(spec, TaskCancelledError(
+                    f"task {spec.describe()} was cancelled"))
+                self._cancelled.discard(spec.task_id)
             else:
                 self._fail_returns(spec, WorkerCrashedError(
                     f"worker died executing {spec.describe()}"))

@@ -30,6 +30,11 @@ class ObjectLostError(RayTpuError):
         super().__init__(f"Object {object_id} lost{': ' + msg if msg else ''}")
 
 
+class TaskCancelledError(RayTpuError):
+    """The task was cancelled via ray_tpu.cancel() (parity:
+    ray.exceptions.TaskCancelledError)."""
+
+
 class TaskError(RayTpuError):
     """Wraps an exception raised inside a remote task; re-raised at ray_tpu.get()."""
 

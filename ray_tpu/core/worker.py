@@ -99,6 +99,7 @@ class WorkerRuntime:
         self._pending_waits: dict[bytes, list[threading.Event]] = {}
         self._wait_lock = threading.Lock()
         self.task_queue: "queue.Queue" = None  # set in main
+        self.cancelled_tasks: set = set()  # dropped before execution
         self.actor_instance = None
         self.actor_id: bytes | None = None
         self.shutdown = threading.Event()
@@ -368,6 +369,13 @@ def _execute(rt: WorkerRuntime, spec: TaskSpec, fn):
             rt, "actor_scheduling_strategy", None)
 
 
+def _reply_cancelled(rt: WorkerRuntime, spec: TaskSpec):
+    from ray_tpu.core.status import TaskCancelledError
+    _reply_result(rt, spec, "err", TaskError.from_exception(
+        TaskCancelledError(f"task {spec.describe()} was cancelled"),
+        spec.describe()))
+
+
 def _reply_result(rt: WorkerRuntime, spec: TaskSpec, status, result):
     cfg = get_config()
     n_returns = len(spec.return_ids)
@@ -433,6 +441,10 @@ def _run_actor_async(rt: WorkerRuntime, max_concurrency: int):
                 continue
             if spec is None:
                 break
+            if spec.task_id in rt.cancelled_tasks:
+                rt.cancelled_tasks.discard(spec.task_id)
+                await loop.run_in_executor(None, _reply_cancelled, rt, spec)
+                continue
             fn = _actor_method(rt, spec)
             asyncio.ensure_future(run_one(spec, fn))
 
@@ -595,6 +607,14 @@ def _worker_main(store_path: str, worker_id: WorkerID, fd: int):
             elif op == "create_actor":
                 actor_cfg["spec"] = msg[1]
                 rt.task_queue.put(("__create_actor__", msg[1]))
+            elif op == "cancel_task":
+                # Best-effort: the executor drops the task if it has not
+                # started yet (parity: CancelTask on the receiving worker).
+                # Bounded — a cancel that lost the race to an already-
+                # started call would otherwise leak its entry forever.
+                if len(rt.cancelled_tasks) > 1024:
+                    rt.cancelled_tasks.pop()
+                rt.cancelled_tasks.add(msg[1])
             elif op == "shutdown":
                 rt.shutdown.set()
                 rt.task_queue.put(None)
@@ -642,6 +662,10 @@ def _worker_main(store_path: str, worker_id: WorkerID, fd: int):
                 pool = concurrent.futures.ThreadPoolExecutor(cspec.max_concurrency)
             continue
         spec: TaskSpec = item
+        if spec.task_id in rt.cancelled_tasks:
+            rt.cancelled_tasks.discard(spec.task_id)
+            _reply_cancelled(rt, spec)
+            continue
         if spec.actor_id is not None:
             fn = _actor_method(rt, spec)
         else:

@@ -190,14 +190,18 @@ class JaxTrainer:
         """Workers placeable RIGHT NOW, summed per node (aggregate totals
         would mis-fit fragmented clusters: 4+4 free TPUs cannot host an
         8-TPU worker)."""
-        req = self._per_worker_req()
+        req = {k: v for k, v in self._per_worker_req().items() if v > 0}
+        if not req:
+            # Zero-resource workers (co-location pattern): nothing bounds
+            # placement, so the full requested size always fits.
+            return self.scaling.num_workers
         total = 0
         for row in ray_tpu.nodes():
             if not row["alive"]:
                 continue
             avail = row["available"]
-            total += min((int(avail.get(k, 0.0) // v)
-                          for k, v in req.items() if v > 0), default=0)
+            total += min(int(avail.get(k, 0.0) // v)
+                         for k, v in req.items())
         return total
 
     def _elastic_size(self, wait_s: float = 0.0) -> int:
@@ -267,8 +271,23 @@ class JaxTrainer:
         first_start = True
         while True:
             self.state = RUNNING
-            # Restarts wait for the previous gang's resources to release.
-            n = self._elastic_size(wait_s=0.0 if first_start else 5.0)
+            try:
+                # Restarts wait for the previous gang's resources to
+                # release first.
+                n = self._elastic_size(wait_s=0.0 if first_start else 5.0)
+            except RayTpuError as e:
+                if first_start:
+                    raise  # misconfigured from the start: surface raw
+                # Below the elastic floor on a RESTART: end the run with
+                # the normal Result contract (error + last checkpoint +
+                # history) instead of leaking a raw exception.
+                self.state = ERRORED
+                from ray_tpu.train.checkpoint import Checkpoint
+                return Result(
+                    metrics=latest_metrics,
+                    checkpoint=Checkpoint(latest_ckpt_path)
+                    if latest_ckpt_path else None,
+                    path=storage_dir, error=e, metrics_history=history)
             first_start = False
             error = None
             workers = []

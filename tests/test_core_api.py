@@ -216,3 +216,111 @@ def test_infeasible_task_raises(ray_start_regular):
     # for actors. For tasks we assert the queue does not block other work.
     r = add.remote(1, 1)
     assert ray_tpu.get(r, timeout=60) == 2
+
+
+def test_cancel_queued_task(ray_start_isolated):
+    """Cancelling a queued task fails its ref with TaskCancelledError."""
+    import time
+
+    @ray_tpu.remote(num_cpus=2)
+    def hog():
+        time.sleep(3)
+        return "hogged"
+
+    @ray_tpu.remote(num_cpus=2)
+    def queued():
+        return "ran"
+
+    h = hog.remote()          # takes the whole 2-CPU isolated head
+    q = queued.remote()       # parks in the scheduling queue
+    time.sleep(0.3)
+    assert ray_tpu.cancel(q) is True
+    with pytest.raises(ray_tpu.TaskCancelledError):
+        ray_tpu.get(q, timeout=30)
+    assert ray_tpu.get(h, timeout=30) == "hogged"  # unaffected
+
+
+def test_cancel_running_task_force(ray_start_isolated, tmp_path):
+    import os
+    import time
+
+    marker = str(tmp_path / "started")
+
+    @ray_tpu.remote
+    def sleeper(m):
+        open(m, "w").close()
+        time.sleep(60)
+        return "done"
+
+    ref = sleeper.remote(marker)
+    deadline = time.monotonic() + 30
+    while not os.path.exists(marker):  # wait until it is RUNNING
+        assert time.monotonic() < deadline
+        time.sleep(0.05)
+    assert ray_tpu.cancel(ref) is False          # running, not forced
+    assert ray_tpu.cancel(ref, force=True) is True
+    with pytest.raises(ray_tpu.TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+
+
+def test_cancel_finished_task_is_noop(ray_start_isolated):
+    @ray_tpu.remote
+    def quick():
+        return 7
+
+    ref = quick.remote()
+    assert ray_tpu.get(ref, timeout=30) == 7
+    assert ray_tpu.cancel(ref) is False
+    assert ray_tpu.get(ref, timeout=30) == 7
+
+
+def test_cancel_dep_gated_task(ray_start_isolated):
+    """Cancelling a task waiting on deps must stick: when the dep arrives
+    the cancelled task is dropped, not executed."""
+    import time
+
+    @ray_tpu.remote(num_cpus=2)
+    def slow_dep():
+        time.sleep(1.5)
+        return 1
+
+    @ray_tpu.remote
+    def consumer(x):
+        return x + 100
+
+    dep = slow_dep.remote()
+    t = consumer.remote(dep)
+    time.sleep(0.2)
+    assert ray_tpu.cancel(t) is True
+    with pytest.raises(ray_tpu.TaskCancelledError):
+        ray_tpu.get(t, timeout=30)
+    assert ray_tpu.get(dep, timeout=30) == 1
+    time.sleep(0.5)  # dep arrival must NOT revive the cancelled task
+    with pytest.raises(ray_tpu.TaskCancelledError):
+        ray_tpu.get(t, timeout=30)
+
+
+def test_cancel_queued_actor_task(ray_start_isolated):
+    """An actor call still parked behind a long-running call cancels; the
+    running call and later calls are unaffected."""
+    import time
+
+    @ray_tpu.remote
+    class Worker:
+        def slow(self):
+            time.sleep(1.5)
+            return "slow"
+
+        def quick(self, tag):
+            return tag
+
+    a = Worker.remote()
+    busy = a.slow.remote()
+    time.sleep(0.3)  # slow is executing; next calls park in the queue
+    parked = a.quick.remote("parked")
+    assert ray_tpu.cancel(parked) is True
+    with pytest.raises(ray_tpu.TaskCancelledError):
+        ray_tpu.get(parked, timeout=30)
+    assert ray_tpu.get(busy, timeout=30) == "slow"
+    assert ray_tpu.get(a.quick.remote("later"), timeout=30) == "later"
+    ray_tpu.kill(a)
