@@ -141,8 +141,11 @@ class ActorMethod:
         args = [_promote_large(rt, a) for a in args]
         kwargs = {k: _promote_large(rt, v) for k, v in kwargs.items()}
         payload, buffers, refs = serialization.serialize_args(args, kwargs)
-        task_id = TaskID.from_random()
-        return_ids = [os.urandom(16) for _ in range(num_returns)]
+        # One entropy read for every id this call needs.
+        rnd = os.urandom(16 + 16 * num_returns)
+        task_id = TaskID(rnd[:16])
+        return_ids = [rnd[16 + 16 * i : 32 + 16 * i]
+                      for i in range(num_returns)]
         spec = TaskSpec(
             task_id=task_id.binary(),
             fn_id=None,

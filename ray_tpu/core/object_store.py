@@ -53,20 +53,41 @@ def _lib():
         lib.store_delete.argtypes = [p, b]
         lib.store_stats.argtypes = [p] + [ctypes.POINTER(u64)] * 4
         lib.store_header_size.restype = u64
+        lib.store_memcpy.argtypes = [p, p, u64, ctypes.c_int]
         lib._sigs_set = True
     return lib
+
+
+# Copies above this size bypass memoryview slice assignment (CPython's buffer
+# copy runs at ~half memcpy speed) for a raw memcpy; above _MT_COPY_MIN the
+# native store_memcpy fans the copy out across cores.
+_FAST_COPY_MIN = 256 << 10
+_MT_COPY_MIN = 32 << 20
+_COPY_THREADS = min(8, os.cpu_count() or 1)
+
+
+def _buf_address(buf):
+    """Raw pointer to a (possibly read-only) contiguous buffer, or None when
+    numpy (the only stdlib-adjacent way to take the address of a read-only
+    buffer) is unavailable — callers fall back to a memoryview copy."""
+    try:
+        import numpy as np
+    except ImportError:
+        return None
+    return np.frombuffer(buf, dtype=np.uint8).ctypes.data
 
 
 class ObjectBuffer:
     """Writable view into a created-but-unsealed object."""
 
-    __slots__ = ("store", "object_id", "data", "meta_view", "_sealed")
+    __slots__ = ("store", "object_id", "data", "meta_view", "offset", "_sealed")
 
-    def __init__(self, store, object_id, data, meta_view):
+    def __init__(self, store, object_id, data, meta_view, offset=0):
         self.store = store
         self.object_id = object_id
         self.data = data
         self.meta_view = meta_view
+        self.offset = offset  # absolute offset of data from the mmap base
         self._sealed = False
 
     def seal(self):
@@ -181,7 +202,7 @@ class SharedMemoryStore:
         if meta:
             meta_view[:] = meta
         mv.release()
-        return ObjectBuffer(self, object_id, data, meta_view)
+        return ObjectBuffer(self, object_id, data, meta_view, off.value)
 
     def _seal(self, object_id: ObjectID):
         self._lib.store_seal(self._base, object_id.binary())
@@ -262,8 +283,17 @@ class SharedMemoryStore:
             struct.pack_into("<I", d, base, len(raw))
             for i, ln in enumerate(lens):
                 struct.pack_into("<Q", d, base + 4 + 8 * i, ln)
+            dst_base = self._base + buf.offset
             for off, r in zip(offsets, raw):
-                d[off : off + len(r)] = r
+                ln = len(r)
+                src = _buf_address(r) if ln >= _FAST_COPY_MIN else None
+                if src is not None:
+                    threads = (_COPY_THREADS if ln >= _MT_COPY_MIN else 1)
+                    self._lib.store_memcpy(
+                        ctypes.c_void_p(dst_base + off),
+                        ctypes.c_void_p(src), ln, threads)
+                else:
+                    d[off : off + ln] = r
             buf.seal()
         except BaseException:
             buf.abort()

@@ -65,8 +65,10 @@ class RemoteFunction:
 
         payload, buffers, refs = serialization.serialize_args(args, kwargs)
         num_returns = opts.get("num_returns", 1)
-        task_id = TaskID.from_random()
-        return_ids = [os.urandom(16) for _ in range(num_returns)]
+        rnd = os.urandom(16 + 16 * num_returns)
+        task_id = TaskID(rnd[:16])
+        return_ids = [rnd[16 + 16 * i : 32 + 16 * i]
+                      for i in range(num_returns)]
         max_retries = opts.get("max_retries", get_config().task_max_retries_default)
         spec = TaskSpec(
             task_id=task_id.binary(),

@@ -456,18 +456,35 @@ def _sum_bundles(bundles) -> dict[str, float]:
 
 
 class TaskEventBuffer:
-    """Bounded ring of task state transitions (parity: task_event_buffer.h:225)."""
+    """Bounded ring of task state transitions (parity: task_event_buffer.h:225).
+
+    `record` sits on the per-call hot path, so it stores the spec's two name
+    fields (not the spec itself — that would pin payload/buffer memory in
+    the ring) and defers string formatting to read time (`snapshot`)."""
 
     def __init__(self, maxlen: int):
         self.events = collections.deque(maxlen=maxlen)
 
-    def record(self, task_id: bytes, name: str, state: str):
+    def record(self, task_id: bytes, spec, state: str):
+        name = spec if isinstance(spec, str) else (spec.name, spec.method_name)
         self.events.append((time.time(), task_id, name, state))
+
+    @staticmethod
+    def _name(name) -> str:
+        if isinstance(name, str):
+            return name
+        base, method = name
+        return f"{base}.{method}" if method else (base or "task")
+
+    def snapshot(self) -> list:
+        """Events with names formatted: [(ts, task_id, name, state)]."""
+        return [(ts, tid, self._name(s), st) for ts, tid, s, st in self.events]
 
     def summary(self) -> dict:
         counts: dict[str, int] = {}
-        for _, _, name, state in self.events:
-            counts[f"{name}:{state}"] = counts.get(f"{name}:{state}", 0) + 1
+        for _, _, s, state in self.events:
+            key = f"{self._name(s)}:{state}"
+            counts[key] = counts.get(key, 0) + 1
         return counts
 
 
@@ -759,7 +776,7 @@ class Runtime:
                                 or victim.current_task is not vtask):
                             victim = None
                 if victim is not None:
-                    self.task_events.record(vtask.task_id, vtask.describe(),
+                    self.task_events.record(vtask.task_id, vtask,
                                             "OOM_KILLED")
                     victim.kill()
             except Exception:  # noqa: BLE001 — monitoring must not die
@@ -1596,7 +1613,7 @@ class Runtime:
     def submit_task(self, spec: TaskSpec, fn_blob: bytes | None = None):
         if fn_blob is not None:
             self.export_function(spec.fn_id, fn_blob)
-        self.task_events.record(spec.task_id, spec.describe(), "SUBMITTED")
+        self.task_events.record(spec.task_id, spec, "SUBMITTED")
         with self.lock:
             for rid in spec.return_ids:
                 self._rid_to_spec[rid] = spec
@@ -2275,7 +2292,7 @@ class Runtime:
                 pass
 
     def _dispatch(self, w: WorkerHandle, spec: TaskSpec):
-        self.task_events.record(spec.task_id, spec.describe(), "RUNNING")
+        self.task_events.record(spec.task_id, spec, "RUNNING")
         if spec.fn_id and spec.fn_id not in w.registered_fns:
             blob = self.fn_table.get(spec.fn_id)
             if blob is None:
@@ -2316,12 +2333,12 @@ class Runtime:
             if st is not None:
                 spec = st.inflight.pop(task_id, None)
                 if spec is not None:
-                    self.task_events.record(task_id, spec.describe(), "FINISHED")
+                    self.task_events.record(task_id, spec, "FINISHED")
                     self._unpin_deps(spec)
             return
         spec = w.current_task
         if spec is not None:
-            self.task_events.record(task_id, spec.describe(), "FINISHED")
+            self.task_events.record(task_id, spec, "FINISHED")
             self._unpin_deps(spec)
             with self.lock:
                 self._release_token(self._reservations.pop(spec.task_id, None))
@@ -2509,7 +2526,6 @@ class Runtime:
             self._fail_returns(spec, cause if isinstance(cause, Exception)
                                else ActorDiedError(msg="actor is dead"))
             return
-        self.task_events.record(spec.task_id, spec.describe(), "SUBMITTED")
         with self.lock:
             spec.seq_no = st.seq
             st.seq += 1
@@ -2538,7 +2554,7 @@ class Runtime:
                 spec, dead_cause if isinstance(dead_cause, Exception)
                 else ActorDiedError(msg="actor is dead"))
             return
-        self.task_events.record(spec.task_id, spec.describe(), "RUNNING")
+        self.task_events.record(spec.task_id, spec, "RUNNING")
         try:
             w.send(("exec", spec))
         except OSError:
@@ -2631,7 +2647,7 @@ class Runtime:
                 self._release_token(self._reservations.pop(spec.task_id, None))
             if (spec.retries_left or 0) > 0:
                 spec.retries_left -= 1
-                self.task_events.record(spec.task_id, spec.describe(), "RETRY")
+                self.task_events.record(spec.task_id, spec, "RETRY")
                 with self.lock:
                     self._enqueue_task_locked(spec, front=True)
             elif spec.task_id in self._cancelled:
@@ -2715,7 +2731,7 @@ class Runtime:
         return st.state if st else "unknown"
 
     def timeline(self):
-        return list(self.task_events.events)
+        return self.task_events.snapshot()
 
     # ---------------- shutdown ----------------
 

@@ -37,8 +37,15 @@ class _CollectingPickler(cloudpickle.Pickler):
         return super().reducer_override(obj)
 
 
+# Hot-path constant: argless calls (actor pings, nullary tasks) skip the
+# cloudpickle machinery entirely.
+_EMPTY_ARGS_PAYLOAD = pickle.dumps(((), {}), protocol=5)
+
+
 def serialize_args(args, kwargs):
     """Returns (payload_bytes, buffers, contained_refs)."""
+    if not args and not kwargs:
+        return _EMPTY_ARGS_PAYLOAD, [], []
     buffers: list[pickle.PickleBuffer] = []
     f = io.BytesIO()
     p = _CollectingPickler(f, buffer_callback=buffers.append)
@@ -46,8 +53,13 @@ def serialize_args(args, kwargs):
     return f.getvalue(), [b.raw() for b in buffers], p.contained_refs
 
 
+_NONE_PAYLOAD = pickle.dumps(None, protocol=5)
+
+
 def serialize_value(value):
     """Returns (payload_bytes, buffers, contained_refs)."""
+    if value is None:
+        return _NONE_PAYLOAD, [], []
     buffers: list[pickle.PickleBuffer] = []
     f = io.BytesIO()
     p = _CollectingPickler(f, buffer_callback=buffers.append)

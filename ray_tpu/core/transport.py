@@ -81,9 +81,45 @@ class _MsgPickler(pickle.Pickler):
         return NotImplemented
 
 
+def _carries_raw_buffers(msg) -> bool:
+    """Cheap probe for bare memoryviews in the known message shapes (specs
+    with out-of-band buffers, 'done' result tuples) — those need the custom
+    pickler, and attempting the fast path first would serialize the payload
+    twice."""
+    if type(msg) is not tuple:
+        return False
+    for x in msg:
+        if isinstance(x, memoryview):
+            return True
+        bufs = getattr(x, "buffers", None)  # TaskSpec / ActorCreationSpec
+        if bufs or getattr(x, "inline_deps", None):
+            return True
+        if type(x) is list:  # 'done' outs: [(rid, status, payload, bufs)]
+            for e in x:
+                if type(e) is tuple and any(
+                        isinstance(v, (memoryview, list)) and v
+                        for v in e):
+                    return True
+    return False
+
+
 def _encode(msg) -> list:
     import io
     pbufs: list[pickle.PickleBuffer] = []
+    if not _carries_raw_buffers(msg):
+        try:
+            # C pickler fast path; raises TypeError on bare memoryviews the
+            # probe missed — only the custom pickler routes those out-of-band.
+            payload = pickle.dumps(msg, protocol=5,
+                                   buffer_callback=pbufs.append)
+            raws = [b.raw() for b in pbufs]
+            parts = [_HDR.pack(len(payload)), _NBUF.pack(len(raws))]
+            parts += [_BLEN.pack(r.nbytes) for r in raws]
+            parts.append(payload)
+            parts += raws
+            return parts
+        except (TypeError, AttributeError, pickle.PicklingError):
+            pbufs = []
     f = io.BytesIO()
     _MsgPickler(f, protocol=5, buffer_callback=pbufs.append).dump(msg)
     payload = f.getvalue()

@@ -29,7 +29,7 @@ from ray_tpu.core.ids import ObjectID, WorkerID
 from ray_tpu.core.object_store import SharedMemoryStore
 from ray_tpu.core.status import TaskError
 from ray_tpu.core.task import TaskSpec
-from ray_tpu.core.transport import recv_msg, send_msg, socket_from_fd
+from ray_tpu.core.transport import FrameBuffer, send_msg, socket_from_fd
 
 
 class _LRUCache:
@@ -103,7 +103,7 @@ class WorkerRuntime:
         self.actor_instance = None
         self.actor_id: bytes | None = None
         self.shutdown = threading.Event()
-        self.current_task_name = ""
+        self.current_task = None
         self.refcount = _NoopRefCounter()
         self._req_lock = threading.Lock()
         self._req_seq = 0
@@ -344,12 +344,12 @@ def _execute(rt: WorkerRuntime, spec: TaskSpec, fn):
     """Runs one task; returns ('ok'|'err', value_or_TaskError)."""
     for oid, (payload, bufs) in spec.inline_deps.items():
         rt.object_cache[oid] = serialization.deserialize(payload, bufs)
-    renv = _RuntimeEnv(getattr(spec, "runtime_env", None))
+    renv_spec = getattr(spec, "runtime_env", None)
     try:
         args, kwargs = serialization.deserialize(spec.payload, spec.buffers)
         args = [_resolve_arg(rt, a) for a in args]
         kwargs = {k: _resolve_arg(rt, v) for k, v in kwargs.items()}
-        rt.current_task_name = spec.describe()
+        rt.current_task = spec  # describe() formatted lazily on demand
         # Read by util.placement_group.get_current_placement_group(); lives
         # on the runtime object because this module is __main__ in workers.
         # Actor methods carry no per-task strategy — fall back to the
@@ -357,10 +357,15 @@ def _execute(rt: WorkerRuntime, spec: TaskSpec, fn):
         rt.current_scheduling_strategy = (
             spec.scheduling_strategy
             or getattr(rt, "actor_scheduling_strategy", None))
-        with renv:
+        if renv_spec is None:
             result = fn(*args, **kwargs)
             if inspect.iscoroutine(result):
                 result = asyncio.get_event_loop().run_until_complete(result)
+        else:
+            with _RuntimeEnv(renv_spec):
+                result = fn(*args, **kwargs)
+                if inspect.iscoroutine(result):
+                    result = asyncio.get_event_loop().run_until_complete(result)
         return "ok", result
     except BaseException as e:  # noqa: BLE001 — errors cross the wire
         return "err", TaskError.from_exception(e, spec.describe())
@@ -595,12 +600,25 @@ def _worker_main(store_path: str, worker_id: WorkerID, fd: int):
     executor_threads: list[threading.Thread] = []
 
     def receiver():
+        # Buffered framing: one big recv drains many queued messages (the
+        # head pipelines actor calls), halving syscalls vs per-frame reads.
+        fb = FrameBuffer()
+        pending = []
         while True:
-            msg = recv_msg(sock)
-            if msg is None:
-                rt.shutdown.set()
-                rt.task_queue.put(None)
-                os._exit(0)
+            if not pending:
+                try:
+                    data = sock.recv(1 << 20)
+                except OSError:
+                    data = b""
+                if not data:
+                    rt.shutdown.set()
+                    rt.task_queue.put(None)
+                    os._exit(0)
+                fb.feed(data)
+                pending = fb.frames()
+                if not pending:
+                    continue
+            msg = pending.pop(0)
             op = msg[0]
             if op == "exec":
                 rt.task_queue.put(msg[1])

@@ -60,7 +60,7 @@ def list_tasks(limit: int = 1000) -> list[dict]:
     task-event ring, parity: gcs_task_manager.h:94 bounded storage)."""
     rt = _rt()
     latest: dict[bytes, dict] = {}
-    for ts, task_id, name, state in rt.task_events.events:
+    for ts, task_id, name, state in rt.task_events.snapshot():
         latest[task_id] = {"task_id": task_id.hex(), "name": name,
                            "state": state, "ts": ts}
     rows = sorted(latest.values(), key=lambda r: r["ts"])
