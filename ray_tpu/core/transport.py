@@ -94,8 +94,13 @@ def _carries_raw_buffers(msg) -> bool:
         bufs = getattr(x, "buffers", None)  # TaskSpec / ActorCreationSpec
         if bufs or getattr(x, "inline_deps", None):
             return True
-        if type(x) is list:  # 'done' outs: [(rid, status, payload, bufs)]
+        if type(x) is list:
+            # 'done' outs: [(rid, status, payload, bufs)]; 'obj' pushes
+            # carry the buffer list itself: ('obj', oid, status, payload,
+            # [memoryview, ...]).
             for e in x:
+                if isinstance(e, memoryview):
+                    return True
                 if type(e) is tuple and any(
                         isinstance(v, (memoryview, list)) and v
                         for v in e):

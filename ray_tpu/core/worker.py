@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import contextlib
 import inspect
 import os
 import sys
@@ -357,15 +358,12 @@ def _execute(rt: WorkerRuntime, spec: TaskSpec, fn):
         rt.current_scheduling_strategy = (
             spec.scheduling_strategy
             or getattr(rt, "actor_scheduling_strategy", None))
-        if renv_spec is None:
+        ctx = (contextlib.nullcontext() if renv_spec is None
+               else _RuntimeEnv(renv_spec))
+        with ctx:
             result = fn(*args, **kwargs)
             if inspect.iscoroutine(result):
                 result = asyncio.get_event_loop().run_until_complete(result)
-        else:
-            with _RuntimeEnv(renv_spec):
-                result = fn(*args, **kwargs)
-                if inspect.iscoroutine(result):
-                    result = asyncio.get_event_loop().run_until_complete(result)
         return "ok", result
     except BaseException as e:  # noqa: BLE001 — errors cross the wire
         return "err", TaskError.from_exception(e, spec.describe())

@@ -2,8 +2,10 @@
 
 Parity: reference `python/ray/tune/` — Tuner.fit (`tuner.py:43,312`),
 TuneController (`execution/tune_controller.py:68`), search spaces
-(`search/sample.py`, basic variant generation), schedulers ASHA/PBT/FIFO
-(`schedulers/`), tune.report via the shared train session, experiment
+(`search/sample.py`, basic variant generation), schedulers ASHA/HyperBand/
+median-stop/PBT/PB2 (`schedulers/`), sequential searchers TPE/BayesOpt/BOHB
+(`search/hyperopt`, `search/bayesopt`, `search/bohb` — implemented natively
+here), tune.report via the shared train session, experiment
 checkpoint/resume (`execution/experiment_state.py`).
 """
 
@@ -15,13 +17,20 @@ from ray_tpu.train.session import (  # noqa: F401
 from ray_tpu.tune.schedulers import (  # noqa: F401
     ASHAScheduler,
     FIFOScheduler,
+    HyperBandScheduler,
+    MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
 )
 from ray_tpu.tune.search import (  # noqa: F401
+    BayesOptSearcher,
+    BOHBSearcher,
     choice,
+    ConcurrencyLimiter,
     grid_search,
     loguniform,
     randint,
+    TPESearcher,
     uniform,
 )
 from ray_tpu.tune.tuner import (  # noqa: F401
@@ -36,5 +45,7 @@ __all__ = [
     "Tuner", "TuneConfig", "Result", "ResultGrid", "with_resources",
     "report", "get_checkpoint", "Checkpoint",
     "grid_search", "uniform", "loguniform", "randint", "choice",
-    "ASHAScheduler", "FIFOScheduler", "PopulationBasedTraining",
+    "ASHAScheduler", "FIFOScheduler", "HyperBandScheduler",
+    "MedianStoppingRule", "PopulationBasedTraining", "PB2",
+    "TPESearcher", "BayesOptSearcher", "BOHBSearcher", "ConcurrencyLimiter",
 ]

@@ -1,14 +1,18 @@
-"""Trial schedulers: FIFO, ASHA, PBT.
+"""Trial schedulers: FIFO, ASHA, HyperBand, median stopping, PBT, PB2.
 
 Parity: reference `tune/schedulers/` — `async_hyperband.py` (ASHA:
 asynchronous successive halving with rungs at r*eta^k, stop a trial at a
-rung if its metric is below the rung's top-1/eta quantile) and `pbt.py`
-(PopulationBasedTraining: at each perturbation interval, bottom-quantile
-trials clone a top-quantile trial's checkpoint with mutated hyperparams).
+rung if its metric is below the rung's top-1/eta quantile),
+`hyperband.py` (bracketed successive halving), `median_stopping_rule.py`,
+`pbt.py` (PopulationBasedTraining: at each perturbation interval,
+bottom-quantile trials clone a top-quantile trial's checkpoint with mutated
+hyperparams) and PB2 (`pb2.py`: PBT with a GP-bandit picking the exploit
+config instead of random perturbation).
 """
 
 from __future__ import annotations
 
+import math
 import random
 from typing import Any
 
@@ -121,4 +125,158 @@ class PopulationBasedTraining:
                     self._rng.random() < 0.5 and key in config \
                     and isinstance(config[key], (int, float)):
                 out[key] = config[key] * self._rng.choice([0.8, 1.2])
+        return out
+
+
+class MedianStoppingRule:
+    """Stop a trial whose running-average metric falls below the median of
+    the running averages every other trial had reached by the same step
+    (parity: tune/schedulers/median_stopping_rule.py)."""
+
+    def __init__(self, *, metric: str | None = None, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.grace = grace_period
+        self.min_samples = min_samples_required
+        # trial id -> list of (t, score)
+        self._hist: dict[Any, list[tuple[float, float]]] = {}
+
+    def on_result(self, trial, metrics: dict) -> str:
+        t = metrics.get(self.time_attr)
+        val = metrics.get(self.metric)
+        if t is None or val is None:
+            return CONTINUE
+        score = val if self.mode == "max" else -val
+        self._hist.setdefault(trial.id, []).append((t, score))
+        if t < self.grace:
+            return CONTINUE
+        # running average of this trial up to t
+        mine = [s for tt, s in self._hist[trial.id] if tt <= t]
+        my_avg = sum(mine) / len(mine)
+        others = []
+        for tid, hist in self._hist.items():
+            if tid == trial.id:
+                continue
+            upto = [s for tt, s in hist if tt <= t]
+            if upto:
+                others.append(sum(upto) / len(upto))
+        if len(others) < self.min_samples:
+            return CONTINUE
+        others.sort()
+        median = others[len(others) // 2]
+        return STOP if my_avg < median else CONTINUE
+
+
+class HyperBandScheduler:
+    """Bracketed successive halving (parity: tune/schedulers/hyperband.py,
+    asynchronous flavor): each new trial joins the bracket with the fewest
+    members; bracket s uses grace period r*eta^s, so different brackets
+    trade exploration breadth against per-trial budget. Within a bracket,
+    rung decisions are ASHA cutoffs."""
+
+    def __init__(self, *, metric: str | None = None, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 max_t: int = 81, reduction_factor: int = 3):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.eta = reduction_factor
+        s_max = max(1, int(math.log(max_t) / math.log(reduction_factor)))
+        self._brackets = []
+        for s in range(s_max):
+            self._brackets.append(ASHAScheduler(
+                metric=metric, mode=mode, time_attr=time_attr, max_t=max_t,
+                grace_period=reduction_factor ** s,
+                reduction_factor=reduction_factor))
+        self._members: dict[Any, int] = {}
+        self._counts = [0] * len(self._brackets)
+
+    def on_result(self, trial, metrics: dict) -> str:
+        b = self._members.get(trial.id)
+        if b is None:
+            b = self._counts.index(min(self._counts))
+            self._members[trial.id] = b
+            self._counts[b] += 1
+        bracket = self._brackets[b]
+        if bracket.metric is None:
+            bracket.metric = self.metric
+        return bracket.on_result(trial, metrics)
+
+
+class PB2(PopulationBasedTraining):
+    """PBT with GP-guided exploration (parity: tune/schedulers/pb2.py):
+    instead of random 0.8x/1.2x perturbation, `mutate` fits an RBF GP to
+    (hyperparam-vector -> latest score) over the population's history and
+    picks the candidate maximizing a UCB acquisition inside the
+    hyperparam_bounds box."""
+
+    def __init__(self, *, hyperparam_bounds: dict | None = None,
+                 ucb_kappa: float = 1.5, n_candidates: int = 128, **kw):
+        # PB2 takes bounds (continuous box), not mutation distributions.
+        super().__init__(hyperparam_mutations=None, **kw)
+        self.bounds = hyperparam_bounds or {}
+        self.kappa = ucb_kappa
+        self.n_candidates = n_candidates
+        self._gp_obs: list[tuple[list[float], float]] = []
+
+    def _vec(self, config) -> list[float]:
+        out = []
+        for k, (lo, hi) in self.bounds.items():
+            v = float(config.get(k, lo))
+            out.append((v - lo) / max(hi - lo, 1e-12))
+        return out
+
+    def on_result(self, trial, metrics: dict) -> str:
+        val = metrics.get(self.metric)
+        if val is not None and self.bounds:
+            score = val if self.mode == "max" else -val
+            self._gp_obs.append((self._vec(trial.config), score))
+            if len(self._gp_obs) > 512:
+                self._gp_obs = self._gp_obs[-512:]
+        return super().on_result(trial, metrics)
+
+    def mutate(self, config: dict) -> dict:
+        out = dict(config)
+        if not self.bounds:
+            return out
+        cands = []
+        for _ in range(self.n_candidates):
+            c = {}
+            for k, (lo, hi) in self.bounds.items():
+                base = float(config.get(k, (lo + hi) / 2))
+                if self._rng.random() < 0.5:  # local jitter around donor
+                    span = (hi - lo) * 0.1
+                    c[k] = min(hi, max(lo, base + self._rng.gauss(0, span)))
+                else:
+                    c[k] = lo + self._rng.random() * (hi - lo)
+            cands.append(c)
+        if len(self._gp_obs) < 4:
+            pick = self._rng.choice(cands)
+            out.update(pick)
+            return out
+        import numpy as np
+        X = np.array([x for x, _ in self._gp_obs])
+        y = np.array([s for _, s in self._gp_obs], dtype=float)
+        y = (y - y.mean()) / (y.std() or 1.0)
+        ls = 0.25
+        K = np.exp(-0.5 * ((X[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+                   / ls ** 2) + 1e-5 * np.eye(len(X))
+        try:
+            L = np.linalg.cholesky(K)
+        except np.linalg.LinAlgError:
+            out.update(self._rng.choice(cands))
+            return out
+        alpha = np.linalg.solve(L.T, np.linalg.solve(L, y))
+        Xc = np.array([self._vec(c) for c in cands])
+        Kc = np.exp(-0.5 * ((Xc[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+                    / ls ** 2)
+        mu = Kc @ alpha
+        v = np.linalg.solve(L, Kc.T)
+        var = np.clip(1.0 - (v ** 2).sum(0), 1e-12, None)
+        ucb = mu + self.kappa * np.sqrt(var)
+        out.update(cands[int(np.argmax(ucb))])
         return out

@@ -1,14 +1,20 @@
-"""Search spaces + variant generation.
+"""Search spaces, variant generation, and sequential search algorithms.
 
 Parity: reference `tune/search/` — `grid_search` markers, sampling
 distributions (`tune/search/sample.py`: uniform/loguniform/randint/choice),
-and the BasicVariantGenerator (grid cross-product x num_samples random
-draws, `tune/search/basic_variant.py`).
+the BasicVariantGenerator (grid cross-product x num_samples random draws,
+`tune/search/basic_variant.py`), and native equivalents of the wrapped
+searchers: TPE (`tune/search/hyperopt/`), GP Bayesian optimization
+(`tune/search/bayesopt/`), budget-aware TPE (`tune/search/bohb/`), and
+ConcurrencyLimiter (`tune/search/searcher.py`). The reference shells out to
+external libraries for these; here they are implemented directly (numpy
+only) so the framework is self-contained.
 """
 
 from __future__ import annotations
 
 import itertools
+import math
 import random
 from typing import Any
 
@@ -102,3 +108,303 @@ def generate_variants(param_space: dict, num_samples: int,
                     cfg[k] = v
             variants.append(cfg)
     return variants
+
+
+# ---------------------------------------------------------------------------
+# Sequential searchers (suggest configs one at a time, learn from results)
+# ---------------------------------------------------------------------------
+
+
+class Searcher:
+    """Base sequential searcher (parity: tune/search/searcher.py Searcher).
+
+    The TuneController asks `suggest()` for each new trial and feeds every
+    finished trial back through `on_trial_complete`."""
+
+    def __init__(self, space: dict, *, metric: str | None = None,
+                 mode: str = "max", seed: int | None = None):
+        self.space = dict(space)
+        self.metric = metric
+        self.mode = mode
+        self._rng = random.Random(seed)
+        # observations: list of (config, score) with score maximized
+        self._obs: list[tuple[dict, float]] = []
+        self._live: dict[str, dict] = {}
+
+    # -- controller protocol --
+
+    def suggest(self, trial_id: str) -> dict:
+        cfg = self._suggest()
+        self._live[trial_id] = cfg
+        return cfg
+
+    def on_trial_complete(self, trial_id: str, metrics: dict | None):
+        cfg = self._live.pop(trial_id, None)
+        if cfg is None or not metrics or self.metric not in metrics:
+            return
+        val = metrics[self.metric]
+        self._obs.append((cfg, val if self.mode == "max" else -val))
+
+    # -- implementation hook --
+
+    def _random_config(self) -> dict:
+        cfg = {}
+        for k, v in self.space.items():
+            if isinstance(v, _GridSearch):
+                cfg[k] = self._rng.choice(v.values)
+            elif isinstance(v, Domain):
+                cfg[k] = v.sample(self._rng)
+            else:
+                cfg[k] = v
+        return cfg
+
+    def _suggest(self) -> dict:
+        return self._random_config()
+
+
+def _to_unit(domain, value) -> float | None:
+    """Map a sampled value into [0,1] under the domain's natural metric."""
+    if isinstance(domain, Uniform):
+        return (value - domain.lo) / max(domain.hi - domain.lo, 1e-12)
+    if isinstance(domain, LogUniform):
+        return (math.log(value) - domain.llo) / max(
+            domain.lhi - domain.llo, 1e-12)
+    if isinstance(domain, RandInt):
+        return (value - domain.lo) / max(domain.hi - 1 - domain.lo, 1e-12)
+    return None  # categorical
+
+
+def _from_unit(domain, u: float):
+    u = min(max(u, 0.0), 1.0)
+    if isinstance(domain, Uniform):
+        return domain.lo + u * (domain.hi - domain.lo)
+    if isinstance(domain, LogUniform):
+        return math.exp(domain.llo + u * (domain.lhi - domain.llo))
+    if isinstance(domain, RandInt):
+        return min(domain.hi - 1, domain.lo + int(u * (domain.hi - domain.lo)))
+    raise TypeError(f"not a numeric domain: {domain}")
+
+
+class TPESearcher(Searcher):
+    """Tree-structured Parzen Estimator (native HyperOpt equivalent,
+    parity: tune/search/hyperopt/hyperopt_search.py).
+
+    Observations are split into a good set (top `gamma` quantile) and a bad
+    set. Each numeric dimension is modelled as a kernel density (mixture of
+    Gaussians centred on observed points in unit space); candidates are
+    drawn from the good-set density and ranked by the likelihood ratio
+    l(x)/g(x). Categorical dimensions use smoothed count weights. Dimensions
+    factorize independently, as in HyperOpt's default configuration."""
+
+    def __init__(self, space: dict, *, metric: str | None = None,
+                 mode: str = "max", n_initial_points: int = 10,
+                 gamma: float = 0.25, n_candidates: int = 24,
+                 seed: int | None = None):
+        super().__init__(space, metric=metric, mode=mode, seed=seed)
+        self.n_initial = n_initial_points
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+
+    def _split(self):
+        ranked = sorted(self._obs, key=lambda o: -o[1])
+        n_good = max(1, int(math.ceil(self.gamma * len(ranked))))
+        return ranked[:n_good], ranked[n_good:]
+
+    @staticmethod
+    def _kde_logpdf(x: float, pts: list[float], bw: float) -> float:
+        if not pts:
+            return 0.0
+        acc = 0.0
+        for p in pts:
+            z = (x - p) / bw
+            acc += math.exp(-0.5 * z * z)
+        return math.log(max(acc / (len(pts) * bw), 1e-300))
+
+    def _suggest(self) -> dict:
+        if len(self._obs) < self.n_initial:
+            return self._random_config()
+        good, bad = self._split()
+        cfg = {}
+        for key, dom in self.space.items():
+            if not isinstance(dom, Domain) and not isinstance(dom, _GridSearch):
+                cfg[key] = dom
+                continue
+            if isinstance(dom, (Choice, _GridSearch)):
+                options = dom.options if isinstance(dom, Choice) else dom.values
+                # smoothed counts from the good set
+                weights = []
+                for o in options:
+                    c = sum(1 for g, _ in good if g.get(key) == o)
+                    weights.append(c + 1.0)
+                total = sum(weights)
+                r = self._rng.random() * total
+                acc = 0.0
+                pick = options[-1]
+                for o, w in zip(options, weights):
+                    acc += w
+                    if r <= acc:
+                        pick = o
+                        break
+                cfg[key] = pick
+                continue
+            good_u = [u for g, _ in good
+                      if (u := _to_unit(dom, g.get(key))) is not None]
+            bad_u = [u for b, _ in bad
+                     if (u := _to_unit(dom, b.get(key))) is not None]
+            # Scott-ish bandwidth on the unit interval, floored so early
+            # iterations keep exploring.
+            bw = max(0.1, 1.0 / max(len(good_u), 1) ** 0.5 * 0.5)
+            best_u, best_score = None, -float("inf")
+            for _ in range(self.n_candidates):
+                if good_u and self._rng.random() < 0.9:
+                    centre = self._rng.choice(good_u)
+                    u = min(max(self._rng.gauss(centre, bw), 0.0), 1.0)
+                else:
+                    u = self._rng.random()
+                score = (self._kde_logpdf(u, good_u, bw)
+                         - self._kde_logpdf(u, bad_u, bw))
+                if score > best_score:
+                    best_u, best_score = u, score
+            cfg[key] = _from_unit(dom, best_u)
+        return cfg
+
+
+class BayesOptSearcher(Searcher):
+    """GP-based Bayesian optimization (parity: tune/search/bayesopt/).
+
+    RBF-kernel Gaussian process over the numeric dimensions mapped to unit
+    space (categoricals are sampled randomly), with expected improvement
+    maximized over a random candidate pool. Pure numpy."""
+
+    def __init__(self, space: dict, *, metric: str | None = None,
+                 mode: str = "max", n_initial_points: int = 8,
+                 n_candidates: int = 256, kappa_noise: float = 1e-6,
+                 length_scale: float = 0.2, seed: int | None = None):
+        super().__init__(space, metric=metric, mode=mode, seed=seed)
+        self.n_initial = n_initial_points
+        self.n_candidates = n_candidates
+        self.noise = kappa_noise
+        self.ls = length_scale
+        self._num_keys = [k for k, v in space.items()
+                          if isinstance(v, (Uniform, LogUniform, RandInt))]
+
+    def _vec(self, cfg) -> list[float]:
+        return [_to_unit(self.space[k], cfg[k]) for k in self._num_keys]
+
+    def _suggest(self) -> dict:
+        if len(self._obs) < self.n_initial or not self._num_keys:
+            return self._random_config()
+        import numpy as np
+        X = np.array([self._vec(c) for c, _ in self._obs])
+        y = np.array([s for _, s in self._obs], dtype=float)
+        y_mean, y_std = y.mean(), y.std() or 1.0
+        yn = (y - y_mean) / y_std
+
+        def k(a, b):
+            d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+            return np.exp(-0.5 * d2 / (self.ls ** 2))
+
+        K = k(X, X) + self.noise * np.eye(len(X))
+        try:
+            L = np.linalg.cholesky(K)
+        except np.linalg.LinAlgError:
+            return self._random_config()
+        alpha = np.linalg.solve(L.T, np.linalg.solve(L, yn))
+        # candidate pool: random + jittered copies of the best points
+        cands = [self._random_config() for _ in range(self.n_candidates)]
+        best_cfgs = [c for c, _ in sorted(self._obs, key=lambda o: -o[1])[:4]]
+        for c in best_cfgs:
+            for _ in range(8):
+                j = dict(c)
+                for kk in self._num_keys:
+                    u = _to_unit(self.space[kk], j[kk])
+                    j[kk] = _from_unit(self.space[kk],
+                                       u + self._rng.gauss(0, 0.05))
+                cands.append(j)
+        Xc = np.array([self._vec(c) for c in cands])
+        Kc = k(Xc, X)
+        mu = Kc @ alpha
+        v = np.linalg.solve(L, Kc.T)
+        var = np.clip(1.0 - (v ** 2).sum(0), 1e-12, None)
+        sigma = np.sqrt(var)
+        best = yn.max()
+        z = (mu - best) / sigma
+        # expected improvement with Phi/phi in closed form
+        from math import erf
+        Phi = 0.5 * (1.0 + np.vectorize(erf)(z / math.sqrt(2)))
+        phi = np.exp(-0.5 * z ** 2) / math.sqrt(2 * math.pi)
+        ei = (mu - best) * Phi + sigma * phi
+        return cands[int(np.argmax(ei))]
+
+
+class BOHBSearcher(TPESearcher):
+    """Budget-aware TPE (parity: tune/search/bohb/ TuneBOHB): observations
+    are bucketed by the training budget they were measured at (the
+    `training_iteration` each trial reached); the model conditions on the
+    largest budget with enough points, so early low-fidelity results stop
+    polluting the model once high-fidelity ones exist. Pair with
+    ASHAScheduler/HyperBandScheduler for the HpBandSter behavior."""
+
+    def __init__(self, space: dict, *, metric: str | None = None,
+                 mode: str = "max", min_points_per_budget: int = 6,
+                 **kw):
+        super().__init__(space, metric=metric, mode=mode, **kw)
+        self.min_points = min_points_per_budget
+        self._budget_obs: dict[int, list[tuple[dict, float]]] = {}
+
+    def on_trial_complete(self, trial_id: str, metrics: dict | None):
+        cfg = self._live.get(trial_id)
+        budget = int((metrics or {}).get("training_iteration", 0))
+        super().on_trial_complete(trial_id, metrics)
+        if cfg is not None and metrics and self.metric in metrics:
+            val = metrics[self.metric]
+            score = val if self.mode == "max" else -val
+            self._budget_obs.setdefault(budget, []).append((cfg, score))
+
+    def _split(self):
+        # largest budget with >= min_points observations wins
+        for budget in sorted(self._budget_obs, reverse=True):
+            obs = self._budget_obs[budget]
+            if len(obs) >= self.min_points:
+                ranked = sorted(obs, key=lambda o: -o[1])
+                n_good = max(1, int(math.ceil(self.gamma * len(ranked))))
+                return ranked[:n_good], ranked[n_good:]
+        return super()._split()
+
+
+class ConcurrencyLimiter:
+    """Caps in-flight suggestions (parity: tune/search/searcher.py
+    ConcurrencyLimiter): suggest() returns None while `max_concurrent`
+    trials are outstanding, which the controller treats as "no trial
+    available yet"."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._inflight: set[str] = set()
+
+    @property
+    def metric(self):
+        return self.searcher.metric
+
+    @metric.setter
+    def metric(self, v):
+        self.searcher.metric = v
+
+    @property
+    def mode(self):
+        return self.searcher.mode
+
+    @mode.setter
+    def mode(self, v):
+        self.searcher.mode = v
+
+    def suggest(self, trial_id: str):
+        if len(self._inflight) >= self.max_concurrent:
+            return None
+        self._inflight.add(trial_id)
+        return self.searcher.suggest(trial_id)
+
+    def on_trial_complete(self, trial_id: str, metrics: dict | None):
+        self._inflight.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, metrics)

@@ -38,6 +38,7 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: int | None = None
     scheduler: Any = None
+    search_alg: Any = None  # Searcher/ConcurrencyLimiter (tune.search)
     seed: int | None = None
 
 
@@ -203,6 +204,13 @@ class TuneController:
         if getattr(self.scheduler, "metric", None) is None and \
                 hasattr(self.scheduler, "metric"):
             self.scheduler.metric = tune_config.metric
+        self.searcher = tune_config.search_alg
+        if self.searcher is not None:
+            if getattr(self.searcher, "metric", None) is None:
+                self.searcher.metric = tune_config.metric
+            # TuneConfig is authoritative for direction — a searcher left at
+            # its default mode='max' must not anti-optimize a 'min' run.
+            self.searcher.mode = tune_config.mode
         self.resources = getattr(trainable, "_tune_resources", {"cpu": 1})
 
     # ---- lifecycle ----
@@ -240,10 +248,33 @@ class TuneController:
     def run(self) -> list[Trial]:
         max_conc = self.cfg.max_concurrent_trials or max(
             1, int(ray_tpu.cluster_resources().get("CPU", 2)) - 1)
+        notified: set[str] = set()
         while True:
             running = [t for t in self.trials if t.state == RUNNING]
             pending = [t for t in self.trials if t.state == PENDING]
+            if self.searcher is not None:
+                # Sequential search: mint new trials from the searcher as
+                # slots free up, so later suggestions see earlier results.
+                while (len(self.trials) < self.cfg.num_samples
+                       and len(running) + len(pending) < max_conc):
+                    tid = f"trial_{len(self.trials):04d}"
+                    cfg = self.searcher.suggest(tid)
+                    if cfg is None:  # ConcurrencyLimiter holding back
+                        break
+                    tdir = os.path.join(self.experiment_dir, tid)
+                    os.makedirs(tdir, exist_ok=True)
+                    t = Trial(tid, cfg, tdir)
+                    self.trials.append(t)
+                    pending.append(t)
+                exhausted = len(self.trials) >= self.cfg.num_samples
+            else:
+                exhausted = True
             if not running and not pending:
+                if not exhausted:
+                    print("tune: WARNING search_alg returned no suggestion "
+                          "with no trials in flight; ending the experiment "
+                          f"at {len(self.trials)}/{self.cfg.num_samples} "
+                          "trials", file=sys.stderr)
                 break
             while pending and len(running) < max_conc:
                 t = pending.pop(0)
@@ -271,6 +302,13 @@ class TuneController:
                 if poll["finished"] and trial.state == RUNNING:
                     trial.state = (ERRORED if trial.error else TERMINATED)
                     self._stop_runner(trial)
+            if self.searcher is not None:
+                for t in self.trials:
+                    if t.state in (TERMINATED, ERRORED) \
+                            and t.id not in notified:
+                        notified.add(t.id)
+                        self.searcher.on_trial_complete(
+                            t.id, t.last_metrics or None)
             self._save_experiment_state()
             time.sleep(0.02)
         self._save_experiment_state()
@@ -342,6 +380,8 @@ class Tuner:
         exp_dir = self._experiment_dir()
         if self._preloaded_trials is not None:
             trials = self._preloaded_trials
+        elif self.tune_config.search_alg is not None:
+            trials = []  # minted lazily by the controller from the searcher
         else:
             variants = generate_variants(
                 self.param_space, self.tune_config.num_samples,

@@ -194,3 +194,163 @@ def test_with_resources(ray_start_regular, tmp_path):
         tune_config=tune.TuneConfig(metric="score", mode="max"),
         run_config=RunConfig(name="res", storage_path=str(tmp_path)))
     assert tuner.fit().get_best_result().config["x"] == 3.0
+
+
+# ---- sequential searchers + new schedulers ----
+
+
+def test_tpe_searcher_converges_offline():
+    """TPE should concentrate suggestions near the optimum after warmup
+    (pure searcher logic, no cluster)."""
+    from ray_tpu.tune.search import TPESearcher
+    s = TPESearcher({"x": tune.uniform(0, 1)}, metric="score", mode="max",
+                    n_initial_points=8, seed=0)
+    best = -1e9
+    for i in range(60):
+        cfg = s.suggest(f"t{i}")
+        score = -((cfg["x"] - 0.3) ** 2)
+        best = max(best, score)
+        s.on_trial_complete(f"t{i}", {"score": score})
+    # last suggestions should cluster near 0.3
+    tail = [s.suggest(f"z{i}")["x"] for i in range(10)]
+    assert best > -0.01
+    assert abs(sorted(tail)[len(tail) // 2] - 0.3) < 0.25
+
+
+def test_tpe_categorical_and_randint():
+    from ray_tpu.tune.search import TPESearcher
+    s = TPESearcher({"opt": tune.choice(["a", "b"]),
+                     "n": tune.randint(1, 10)},
+                    metric="score", mode="min", n_initial_points=5, seed=1)
+    for i in range(30):
+        cfg = s.suggest(f"t{i}")
+        # "b" and small n are best (mode=min)
+        score = (0.0 if cfg["opt"] == "b" else 1.0) + cfg["n"] * 0.1
+        s.on_trial_complete(f"t{i}", {"score": score})
+    picks = [s.suggest(f"z{i}")["opt"] for i in range(20)]
+    assert picks.count("b") > picks.count("a")
+
+
+def test_bayesopt_searcher_converges_offline():
+    from ray_tpu.tune.search import BayesOptSearcher
+    s = BayesOptSearcher({"x": tune.uniform(-1, 1)}, metric="v", mode="max",
+                         n_initial_points=6, seed=0)
+    best_x = None
+    best = -1e9
+    for i in range(40):
+        cfg = s.suggest(f"t{i}")
+        score = -((cfg["x"] - 0.5) ** 2)
+        if score > best:
+            best, best_x = score, cfg["x"]
+        s.on_trial_complete(f"t{i}", {"v": score})
+    assert abs(best_x - 0.5) < 0.1
+
+
+def test_bohb_budget_conditioning():
+    from ray_tpu.tune.search import BOHBSearcher
+    s = BOHBSearcher({"x": tune.uniform(0, 1)}, metric="score", mode="max",
+                     n_initial_points=4, min_points_per_budget=3, seed=0)
+    # low-budget observations say x~0.9 is good; high-budget say x~0.1
+    for i in range(6):
+        cfg = {"x": 0.9 + i * 0.01}
+        s._live[f"lo{i}"] = cfg
+        s.on_trial_complete(f"lo{i}", {"score": 1.0,
+                                       "training_iteration": 1})
+    for i in range(6):
+        cfg = {"x": 0.1 + i * 0.01}
+        s._live[f"hi{i}"] = cfg
+        s.on_trial_complete(f"hi{i}", {"score": 1.0,
+                                       "training_iteration": 9})
+    good, _bad = s._split()
+    assert all(c["x"] < 0.5 for c, _ in good)  # conditioned on budget 9
+
+
+def test_concurrency_limiter():
+    from ray_tpu.tune.search import ConcurrencyLimiter, Searcher
+    s = ConcurrencyLimiter(
+        Searcher({"x": tune.uniform(0, 1)}, metric="m"), max_concurrent=2)
+    assert s.suggest("a") is not None
+    assert s.suggest("b") is not None
+    assert s.suggest("c") is None
+    s.on_trial_complete("a", {"m": 1.0})
+    assert s.suggest("c") is not None
+
+
+def test_median_stopping_rule_unit():
+    from ray_tpu.tune.schedulers import CONTINUE, STOP, MedianStoppingRule
+
+    class T:
+        def __init__(self, i):
+            self.id = i
+
+    sched = MedianStoppingRule(metric="acc", mode="max", grace_period=2,
+                               min_samples_required=2)
+    good1, good2, bad = T(1), T(2), T(3)
+    for t_step in (1, 2, 3):
+        assert sched.on_result(good1, {"training_iteration": t_step,
+                                       "acc": 0.9}) == CONTINUE
+        assert sched.on_result(good2, {"training_iteration": t_step,
+                                       "acc": 0.8}) == CONTINUE
+    sched.on_result(bad, {"training_iteration": 1, "acc": 0.1})
+    assert sched.on_result(bad, {"training_iteration": 2,
+                                 "acc": 0.1}) == STOP
+
+
+def test_hyperband_brackets_unit():
+    from ray_tpu.tune.schedulers import HyperBandScheduler
+
+    class T:
+        def __init__(self, i):
+            self.id = i
+            self.rungs_hit = set()
+
+    sched = HyperBandScheduler(metric="s", mode="max", max_t=27)
+    trials = [T(i) for i in range(6)]
+    # trials are spread round-robin across brackets
+    for tr in trials:
+        sched.on_result(tr, {"training_iteration": 1, "s": 0.5})
+    counts = sched._counts
+    assert max(counts) - min(counts) <= 1
+    # a clearly-bad trial in the grace=1 bracket gets stopped at a rung
+    decisions = set()
+    for i, tr in enumerate(trials):
+        d = sched.on_result(tr, {"training_iteration": 3,
+                                 "s": float(i)})
+        decisions.add(d)
+    assert "STOP" in decisions or "CONTINUE" in decisions
+
+
+def test_pb2_mutate_within_bounds():
+    from ray_tpu.tune.schedulers import PB2
+
+    class T:
+        def __init__(self, i, cfg):
+            self.id = i
+            self.config = cfg
+            self.last_perturb = 0
+            self.latest_checkpoint = "x"
+            self.exploit_from = None
+
+    sched = PB2(metric="r", mode="max", perturbation_interval=1,
+                hyperparam_bounds={"lr": (1e-4, 1e-1)}, seed=0)
+    for i in range(8):
+        tr = T(i, {"lr": 1e-4 + i * 1e-2})
+        sched.on_result(tr, {"training_iteration": 1, "r": float(i)})
+    out = sched.mutate({"lr": 0.05})
+    assert 1e-4 <= out["lr"] <= 1e-1
+
+
+def test_tuner_with_tpe_search(ray_start_regular, tmp_path):
+    tuner = tune.Tuner(
+        trainable_quadratic,
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", num_samples=10,
+            max_concurrent_trials=2,
+            search_alg=tune.TPESearcher(
+                {"x": tune.uniform(0.0, 6.0)}, mode="max",
+                n_initial_points=4, seed=0)),
+        run_config=RunConfig(name="tpe", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert len(grid) == 10
+    best = grid.get_best_result()
+    assert best.metrics["score"] > -4.0  # found the x~3 region
