@@ -26,6 +26,9 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "object_spill_dir": (str, "", "directory for spilled objects; '' = <session>/spill"),
     "object_spill_threshold": (float, 0.8, "spill when arena usage exceeds this"),
     # --- workers / scheduling ---
+    "worker_jax_platform": (str, "cpu", "jax backend for pooled workers; "
+                            "tasks with num_tpus>0 re-latch onto the host "
+                            "platform ('' = inherit the driver's)"),
     "num_workers": (int, 0, "worker pool size; 0 = num_cpus"),
     "worker_startup_timeout_s": (float, 60.0, "time to wait for a worker to boot"),
     "worker_idle_timeout_s": (float, 300.0, "idle workers above pool size are reaped"),

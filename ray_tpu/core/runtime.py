@@ -81,6 +81,15 @@ def build_worker_env(config, node_id_hex: str,
     env.update(config.to_env())
     env["RAY_TPU_NODE_ID"] = node_id_hex
     env["RAY_TPU_IS_HEAD_NODE"] = "1" if is_head else "0"
+    # Accelerator visibility (parity: the reference assigns
+    # CUDA_VISIBLE_DEVICES / TPU_VISIBLE_CHIPS per worker): pooled workers
+    # default to the CPU backend — a CPU-bound task must not grab (or crash
+    # on) the host's TPU runtime. The driver's platform is preserved so a
+    # worker executing a num_tpus>0 task can re-latch onto it.
+    platform = config.worker_jax_platform
+    if platform:
+        env["RAY_TPU_HOST_JAX_PLATFORMS"] = env.get("JAX_PLATFORMS", "")
+        env["JAX_PLATFORMS"] = platform
     env.setdefault("PYTHONPATH", "")
     repo_root = os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))

@@ -454,6 +454,31 @@ def _run_actor_async(rt: WorkerRuntime, max_concurrency: int):
     asyncio.run(main())
 
 
+def _ensure_accelerator_platform(num_tpus):
+    """Re-latch this worker onto the host's jax platform for TPU work.
+
+    Pooled workers boot with JAX_PLATFORMS=cpu (accelerator visibility,
+    parity: per-worker CUDA_VISIBLE_DEVICES/TPU_VISIBLE_CHIPS assignment);
+    the first task/actor that actually reserves TPU chips flips the worker
+    back to the driver's platform. Must happen before the worker's first
+    jax computation — jax latches its backend on first use."""
+    if not num_tpus:
+        return
+    host = os.environ.get("RAY_TPU_HOST_JAX_PLATFORMS")
+    if host is None:  # visibility control disabled
+        return
+    if os.environ.get("JAX_PLATFORMS", "") == host:
+        return
+    os.environ["JAX_PLATFORMS"] = host
+    try:
+        import jax
+        jax.config.update("jax_platforms", host or None)
+    except Exception as e:  # noqa: BLE001
+        raise RuntimeError(
+            f"worker could not switch to host jax platform {host!r} for a "
+            f"TPU task (was the CPU backend already initialized?): {e}")
+
+
 def _actor_method(rt: WorkerRuntime, spec: TaskSpec):
     if spec.method_name == "__run_with_instance__":
         # Escape hatch used by compiled graphs (ray_tpu.dag): the first task
@@ -641,6 +666,7 @@ def _worker_main(store_path: str, worker_id: WorkerID, fd: int):
 
     def create_actor(cspec):
         try:
+            _ensure_accelerator_platform(getattr(cspec, "num_tpus", 0))
             cls = rt.functions[cspec.cls_id]
             args, kwargs = serialization.deserialize(cspec.payload, cspec.buffers)
             args = [_resolve_arg(rt, a) for a in args]
@@ -682,6 +708,8 @@ def _worker_main(store_path: str, worker_id: WorkerID, fd: int):
             rt.cancelled_tasks.discard(spec.task_id)
             _reply_cancelled(rt, spec)
             continue
+        if getattr(spec, "num_tpus", 0):
+            _ensure_accelerator_platform(spec.num_tpus)
         if spec.actor_id is not None:
             fn = _actor_method(rt, spec)
         else:

@@ -6,21 +6,34 @@ policy/rollout-worker stack is intentionally not reproduced):
 - Learner/LearnerGroup (core/learner.py) <- rllib/core/learner/
 - EnvRunner/Group (env/env_runner.py) <- rllib/env/single_agent_env_runner.py:68
 - AlgorithmConfig/Algorithm (algorithms/) <- rllib/algorithms/
-- PPO, DQN, IMPALA <- rllib/algorithms/{ppo,dqn,impala}/
+- PPO, DQN, IMPALA, SAC, CQL, BC/MARWIL <- rllib/algorithms/
+- MultiAgentEnv + multi-agent PPO (env/multi_agent.py) <- rllib/env/multi_agent_env.py
+- offline record/load (offline.py) <- rllib/offline/
 """
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig
+from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
+from ray_tpu.rllib.algorithms.multi_agent_ppo import (
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+)
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
+from ray_tpu.rllib.env.multi_agent import (
+    MultiAgentEnv,
+    MultiAgentEnvRunner,
+)
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "APPO", "APPOConfig",
     "BC", "BCConfig", "DQN", "DQNConfig", "IMPALA", "IMPALAConfig",
-    "MARWIL", "MARWILConfig", "SAC", "SACConfig",
+    "MARWIL", "MARWILConfig", "SAC", "SACConfig", "CQL", "CQLConfig",
+    "MultiAgentPPO", "MultiAgentPPOConfig", "MultiAgentEnv",
+    "MultiAgentEnvRunner",
 ]

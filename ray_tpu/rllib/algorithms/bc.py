@@ -47,9 +47,8 @@ class BC(Algorithm):
             raise ValueError("BCConfig.offline_data(input_=...) is required")
         config.num_env_runners = 0  # evaluation-only local runner
         super().__init__(config)
-        rows = config.input_
-        if hasattr(rows, "take_all"):  # a ray_tpu.data Dataset
-            rows = rows.take_all()
+        from ray_tpu.rllib.offline import load_offline
+        rows = load_offline(config.input_)  # Dataset | rows | path/glob
         if not rows:
             self.stop()  # groups already exist: don't leak their actors
             raise ValueError("offline input is empty")

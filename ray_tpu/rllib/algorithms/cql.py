@@ -1,0 +1,183 @@
+"""CQL — conservative Q-learning for offline continuous control.
+
+Parity: reference `rllib/algorithms/cql/cql.py` (SAC trained purely from a
+recorded dataset, with the CQL(H) conservative regularizer pushing Q down
+on out-of-distribution actions so the policy cannot exploit extrapolation
+error). TPU-native like SAC: the whole update — conservative critic, actor,
+temperature, polyak — is ONE jit; the OOD action sampling (uniform +
+current-policy) happens inside the same compiled program.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
+from ray_tpu.rllib.offline import load_offline, rows_to_arrays
+
+
+class CQLConfig(SACConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = CQL
+        self.input_ = None            # rows | Dataset | path/glob
+        self.cql_alpha = 1.0          # conservative penalty weight
+        self.num_ood_actions = 4      # sampled actions per state
+        self.bc_iters = 0             # optional BC warmup iterations
+
+    def offline_data(self, *, input_=None, **_compat):
+        if input_ is not None:
+            self.input_ = input_
+        return self
+
+    def training(self, *, cql_alpha=None, num_ood_actions=None,
+                 bc_iters=None, **kw):
+        super().training(**kw)
+        if cql_alpha is not None:
+            self.cql_alpha = cql_alpha
+        if num_ood_actions is not None:
+            self.num_ood_actions = num_ood_actions
+        if bc_iters is not None:
+            self.bc_iters = bc_iters
+        return self
+
+
+class CQL(SAC):
+    """SAC machinery + conservative critic, trained from offline data only
+    (no env sampling; the env is used for evaluation rollouts)."""
+
+    def __init__(self, config):
+        if config.input_ is None:
+            raise ValueError("CQLConfig.offline_data(input_=...) is required")
+        super().__init__(config)
+        rows = load_offline(config.input_)
+        if not rows:
+            self.stop()
+            raise ValueError("offline input is empty")
+        self._data = rows_to_arrays(rows, continuous=True)
+        if "next_obs" not in self._data:
+            self.stop()
+            raise ValueError("CQL needs next_obs in the offline data")
+        self._rebuild_update()
+
+    def _rebuild_update(self):
+        """Replace SAC's fused update with the conservative variant."""
+        c = self.config
+        m = self.module
+        gamma, tau, tgt_ent = c.gamma, c.tau, self.target_entropy
+        n_ood = int(c.num_ood_actions)
+        cql_alpha = float(c.cql_alpha)
+        low = jnp.asarray(m.low)
+        high = jnp.asarray(m.high)
+
+        def update(params, target_params, opt_state, log_alpha,
+                   alpha_opt_state, batch, key, *, bc_mode=False):
+            k1, k2, k3, k4 = jax.random.split(key, 4)
+            alpha = jnp.exp(log_alpha)
+            B = batch["obs"].shape[0]
+
+            next_a, next_logp = m.sample(params, batch["next_obs"], k1)
+            tq1, tq2 = m.q_values(target_params, batch["next_obs"], next_a)
+            tq = jnp.minimum(tq1, tq2) - alpha * next_logp
+            target = batch["rewards"] + gamma * (1 - batch["dones"]) * tq
+            target = jax.lax.stop_gradient(target)
+
+            def critic_loss(p):
+                q1, q2 = m.q_values(p, batch["obs"], batch["actions"])
+                bellman = (jnp.square(q1 - target).mean()
+                           + jnp.square(q2 - target).mean())
+                # CQL(H): push down logsumexp Q over sampled actions, push
+                # up Q on dataset actions. Samples: uniform-random actions
+                # (importance weight = volume) + current-policy actions.
+                ks = jax.random.split(k3, n_ood)
+                rand_a = jax.random.uniform(
+                    k2, (n_ood, B, m.action_dim),
+                    minval=low, maxval=high)
+                pol = [m.sample(jax.lax.stop_gradient(p), batch["obs"], kk)
+                       for kk in ks]
+                pol_a = jnp.stack([a for a, _ in pol])
+                pol_logp = jnp.stack([lp for _, lp in pol])
+
+                def q_on(p_, acts):
+                    qa1, qa2 = jax.vmap(
+                        lambda a_: m.q_values(p_, batch["obs"], a_))(acts)
+                    return qa1, qa2
+
+                r1, r2 = q_on(p, rand_a)
+                p1, p2 = q_on(p, pol_a)
+                log_vol = jnp.log(high - low).sum()
+                cat1 = jnp.concatenate([r1 + log_vol, p1 - pol_logp], 0)
+                cat2 = jnp.concatenate([r2 + log_vol, p2 - pol_logp], 0)
+                cql1 = (jax.nn.logsumexp(cat1, axis=0) - q1).mean()
+                cql2 = (jax.nn.logsumexp(cat2, axis=0) - q2).mean()
+                return bellman + cql_alpha * (cql1 + cql2), bellman
+
+            def actor_loss(p):
+                a, logp = m.sample(p, batch["obs"], k4)
+                if bc_mode:
+                    # BC warmup (bc_iters): clone dataset actions before
+                    # trusting the conservative critic.
+                    lp_data = m.log_prob(p, batch["obs"], batch["actions"])
+                    return (alpha * logp - lp_data).mean(), logp
+                q1, q2 = m.q_values(jax.lax.stop_gradient(p),
+                                    batch["obs"], a)
+                return (alpha * logp - jnp.minimum(q1, q2)).mean(), logp
+
+            (closs, bellman), cgrads = jax.value_and_grad(
+                critic_loss, has_aux=True)(params)
+            (aloss, logp), agrads = jax.value_and_grad(
+                actor_loss, has_aux=True)(params)
+            grads = jax.tree_util.tree_map(lambda a_, b_: a_ + b_,
+                                           cgrads, agrads)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+
+            def alpha_loss(la):
+                return (-jnp.exp(la)
+                        * (jax.lax.stop_gradient(logp) + tgt_ent)).mean()
+
+            al, agrad = jax.value_and_grad(alpha_loss)(log_alpha)
+            aupd, alpha_opt_state = self.alpha_tx.update(
+                agrad, alpha_opt_state, log_alpha)
+            log_alpha = optax.apply_updates(log_alpha, aupd)
+
+            target_params = jax.tree_util.tree_map(
+                lambda t, p: (1 - tau) * t + tau * p, target_params, params)
+            aux = {"critic_loss": closs, "bellman_loss": bellman,
+                   "actor_loss": aloss, "alpha": jnp.exp(log_alpha),
+                   "entropy": -logp.mean()}
+            return (params, target_params, opt_state, log_alpha,
+                    alpha_opt_state, aux)
+
+        import functools
+        self._update = jax.jit(functools.partial(update, bc_mode=False))
+        self._update_bc = (jax.jit(functools.partial(update, bc_mode=True))
+                           if c.bc_iters else None)
+
+    def training_step(self) -> dict:
+        c = self.config
+        n = len(self._data["obs"])
+        rng = np.random.default_rng(c.seed + self.iteration)
+        metrics = {}
+        # train() bumps iteration before calling us: 1-based
+        step = (self._update_bc
+                if self._update_bc is not None and self.iteration <= c.bc_iters
+                else self._update)
+        for _ in range(c.num_updates_per_iter):
+            sel = rng.integers(0, n, size=c.train_batch_size)
+            batch = {k: jnp.asarray(v[sel]) for k, v in self._data.items()}
+            self._key, sub = jax.random.split(self._key)
+            (self.params, self.target_params, self.opt_state,
+             self.log_alpha, self.alpha_opt_state, aux) = step(
+                self.params, self.target_params, self.opt_state,
+                self.log_alpha, self.alpha_opt_state, batch, sub)
+            metrics = {k: float(v) for k, v in aux.items()}
+        self._timesteps += c.num_updates_per_iter * c.train_batch_size
+        return metrics
+
+    def evaluate(self, num_steps: int = 500) -> dict:
+        self.env_runner_group.sample(self.params, num_steps)
+        return self.env_runner_group.aggregate_metrics()

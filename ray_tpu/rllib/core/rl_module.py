@@ -210,6 +210,21 @@ class SquashedGaussianModule:
         logp -= jnp.log(scale).sum()
         return tanh_a * scale + shift, logp
 
+    def log_prob(self, params, obs, action):
+        """log pi(action | obs) for env-bounded actions (inverse of
+        `sample`'s squash-and-rescale; used by CQL's BC warmup)."""
+        scale, shift = self._scale()
+        tanh_a = jnp.clip((action - shift) / scale, -0.999999, 0.999999)
+        pre_tanh = jnp.arctanh(tanh_a)
+        mean, log_std = self.pi(params, obs)
+        std = jnp.exp(log_std)
+        logp = (-0.5 * jnp.square((pre_tanh - mean) / std) - log_std
+                - 0.5 * np.log(2 * np.pi)).sum(-1)
+        logp -= (2 * (np.log(2.0) - pre_tanh
+                      - jax.nn.softplus(-2 * pre_tanh))).sum(-1)
+        logp -= jnp.log(scale).sum()
+        return logp
+
     def q_values(self, params, obs, action):
         qspec = MLPSpec(self.obs_dim + self.action_dim, self.hidden,
                         activation="relu")

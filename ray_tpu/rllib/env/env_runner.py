@@ -111,38 +111,36 @@ class SingleAgentEnvRunner:
         return "ok"
 
 
-class EnvRunnerGroup:
-    """N remote env-runner actors, or one local runner when
-    num_env_runners == 0 (parity: env_runner_group.py:71 local-worker mode).
-    Fault-aware: dead runners are replaced on the next sample round
-    (parity: restart_failed_env_runners / FaultAwareApply, env_runner.py:32).
-    """
+class RunnerGroupBase:
+    """Shared local/remote dispatch + fault handling for runner groups
+    (parity: env_runner_group.py:71 local-worker mode; fault-awareness per
+    restart_failed_env_runners / FaultAwareApply, env_runner.py:32).
 
-    def __init__(self, env_name: str, module, *, num_env_runners: int = 0,
-                 num_envs_per_env_runner: int = 1, seed: int = 0,
-                 env_config: dict | None = None, restart_failed: bool = True):
-        self._args = (env_name, module)
-        self._kw = dict(num_envs=num_envs_per_env_runner,
-                        env_config=env_config)
+    Subclasses set `runner_cls` and call `_init_runners(args, kw, ...)`;
+    dead remote runners are replaced on the next sample round."""
+
+    runner_cls: type = None
+
+    def _init_runners(self, args: tuple, kw: dict, *, num_env_runners: int,
+                      seed: int, restart_failed: bool):
+        self._args = args
+        self._kw = kw
         self.restart_failed = restart_failed
         self.num_env_runners = num_env_runners
         self._seed = seed
         if num_env_runners == 0:
-            self.local = SingleAgentEnvRunner(env_name, module, seed=seed,
-                                              **self._kw)
+            self.local = self.runner_cls(*args, seed=seed, **kw)
             self.remotes = []
         else:
             self.local = None
-            cls = ray_tpu.remote(num_cpus=1)(SingleAgentEnvRunner)
-            self._cls = cls
+            self._cls = ray_tpu.remote(num_cpus=1)(self.runner_cls)
             self.remotes = [
-                cls.remote(env_name, module, seed=seed + i, **self._kw)
+                self._cls.remote(*args, seed=seed + i, **kw)
                 for i in range(num_env_runners)]
 
     def _replace(self, idx: int):
         self.remotes[idx] = self._cls.remote(
-            self._args[0], self._args[1], seed=self._seed + 1000 + idx,
-            **self._kw)
+            *self._args, seed=self._seed + 1000 + idx, **self._kw)
 
     def sample(self, params, num_steps: int) -> list[dict]:
         if self.local is not None:
@@ -192,3 +190,16 @@ class EnvRunnerGroup:
                 ray_tpu.kill(r)
             except Exception:  # noqa: BLE001
                 pass
+
+
+class EnvRunnerGroup(RunnerGroupBase):
+    runner_cls = SingleAgentEnvRunner
+
+    def __init__(self, env_name: str, module, *, num_env_runners: int = 0,
+                 num_envs_per_env_runner: int = 1, seed: int = 0,
+                 env_config: dict | None = None, restart_failed: bool = True):
+        self._init_runners(
+            (env_name, module),
+            dict(num_envs=num_envs_per_env_runner, env_config=env_config),
+            num_env_runners=num_env_runners, seed=seed,
+            restart_failed=restart_failed)

@@ -1,0 +1,98 @@
+"""Offline RL data path: record rollouts to files, load them back.
+
+Parity: reference `rllib/offline/` (offline data writers/readers feeding
+BC/MARWIL/CQL — the reference records episodes to JSON/Parquet and reads
+them through Ray Data; here transitions ride ray_tpu.data the same way).
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+
+import numpy as np
+
+
+def record_transitions(env_name: str, module, params, *, num_steps: int,
+                       path: str | None = None, fmt: str = "parquet",
+                       seed: int = 0, env_config: dict | None = None,
+                       explore: bool = True):
+    """Roll `module` (with `params`) in `env_name` and record flat
+    transitions {obs, actions, rewards, next_obs, dones}.
+
+    Returns the row list; with `path`, also writes one parquet/json file
+    per call (the reference's output writer shape).
+    """
+    from ray_tpu.rllib.algorithms.algorithm import Algorithm
+    from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
+
+    runner = SingleAgentEnvRunner(env_name, module, seed=seed,
+                                  env_config=env_config)
+    frag = runner.sample(params, num_steps, explore=explore)
+    actions_2d = getattr(module, "action_kind", "discrete") == "continuous"
+    cols = Algorithm._replay_rows(frag, actions_2d=actions_2d)
+    n = len(cols["obs"])
+    rows = [{k: cols[k][i].tolist() if cols[k][i].ndim else cols[k][i].item()
+             for k in cols} for i in range(n)]
+    if path is not None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        if fmt == "parquet":
+            import pyarrow as pa
+            import pyarrow.parquet as pq
+            pq.write_table(pa.Table.from_pylist(rows), path)
+        elif fmt == "json":
+            import json
+            with open(path, "w") as f:
+                for r in rows:
+                    f.write(json.dumps(r) + "\n")
+        else:
+            raise ValueError(f"unknown offline format {fmt!r}")
+    return rows
+
+
+def load_offline(input_):
+    """Normalize any offline input into a row list.
+
+    Accepts: a list of dicts, a ray_tpu.data Dataset, or a path/glob to
+    parquet/jsonl files (parity: the reference's `input_` config accepting
+    dataset paths).
+    """
+    if input_ is None:
+        return None
+    if isinstance(input_, list):
+        return input_
+    if hasattr(input_, "take_all"):  # ray_tpu.data Dataset
+        return input_.take_all()
+    if isinstance(input_, str):
+        paths = sorted(_glob.glob(input_)) or [input_]
+        rows = []
+        for p in paths:
+            if p.endswith(".parquet"):
+                import pyarrow.parquet as pq
+                rows.extend(pq.read_table(p).to_pylist())
+            else:
+                import json
+                with open(p) as f:
+                    for line in f:
+                        line = line.strip()
+                        if line:
+                            rows.append(json.loads(line))
+        return rows
+    raise TypeError(f"cannot load offline input of type {type(input_)}")
+
+
+def rows_to_arrays(rows: list[dict], *, continuous: bool = False) -> dict:
+    """Columnar numpy views of a row list for replay/minibatching."""
+    out = {
+        "obs": np.asarray([r["obs"] for r in rows], np.float32),
+        "rewards": np.asarray([r.get("rewards", 0.0) for r in rows],
+                              np.float32),
+        "dones": np.asarray([r.get("dones", 0.0) for r in rows], np.float32),
+    }
+    acts = [r["actions"] for r in rows]
+    out["actions"] = (np.asarray(acts, np.float32) if continuous
+                      else np.asarray(acts, np.int64))
+    if rows and "next_obs" in rows[0]:
+        out["next_obs"] = np.asarray([r["next_obs"] for r in rows],
+                                     np.float32)
+    return out

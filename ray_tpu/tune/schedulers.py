@@ -185,13 +185,19 @@ class HyperBandScheduler:
         self.time_attr = time_attr
         self.max_t = max_t
         self.eta = reduction_factor
-        s_max = max(1, int(math.log(max_t) / math.log(reduction_factor)))
+        # one bracket per grace period eta^s < max_t (integer loop — a
+        # float log would drop the top bracket at exact powers of eta)
         self._brackets = []
-        for s in range(s_max):
+        grace = 1
+        while grace < max_t:
             self._brackets.append(ASHAScheduler(
                 metric=metric, mode=mode, time_attr=time_attr, max_t=max_t,
-                grace_period=reduction_factor ** s,
-                reduction_factor=reduction_factor))
+                grace_period=grace, reduction_factor=reduction_factor))
+            grace *= reduction_factor
+        if not self._brackets:
+            self._brackets.append(ASHAScheduler(
+                metric=metric, mode=mode, time_attr=time_attr, max_t=max_t,
+                grace_period=1, reduction_factor=reduction_factor))
         self._members: dict[Any, int] = {}
         self._counts = [0] * len(self._brackets)
 

@@ -122,9 +122,10 @@ class Searcher:
     finished trial back through `on_trial_complete`."""
 
     def __init__(self, space: dict, *, metric: str | None = None,
-                 mode: str = "max", seed: int | None = None):
+                 mode: str | None = None, seed: int | None = None):
         self.space = dict(space)
         self.metric = metric
+        # None = "inherit from TuneConfig"; standalone use defaults to max.
         self.mode = mode
         self._rng = random.Random(seed)
         # observations: list of (config, score) with score maximized
@@ -143,7 +144,7 @@ class Searcher:
         if cfg is None or not metrics or self.metric not in metrics:
             return
         val = metrics[self.metric]
-        self._obs.append((cfg, val if self.mode == "max" else -val))
+        self._obs.append((cfg, -val if self.mode == "min" else val))
 
     # -- implementation hook --
 
@@ -197,7 +198,7 @@ class TPESearcher(Searcher):
     factorize independently, as in HyperOpt's default configuration."""
 
     def __init__(self, space: dict, *, metric: str | None = None,
-                 mode: str = "max", n_initial_points: int = 10,
+                 mode: str | None = None, n_initial_points: int = 10,
                  gamma: float = 0.25, n_candidates: int = 24,
                  seed: int | None = None):
         super().__init__(space, metric=metric, mode=mode, seed=seed)
@@ -277,7 +278,7 @@ class BayesOptSearcher(Searcher):
     maximized over a random candidate pool. Pure numpy."""
 
     def __init__(self, space: dict, *, metric: str | None = None,
-                 mode: str = "max", n_initial_points: int = 8,
+                 mode: str | None = None, n_initial_points: int = 8,
                  n_candidates: int = 256, kappa_noise: float = 1e-6,
                  length_scale: float = 0.2, seed: int | None = None):
         super().__init__(space, metric=metric, mode=mode, seed=seed)
@@ -346,7 +347,7 @@ class BOHBSearcher(TPESearcher):
     ASHAScheduler/HyperBandScheduler for the HpBandSter behavior."""
 
     def __init__(self, space: dict, *, metric: str | None = None,
-                 mode: str = "max", min_points_per_budget: int = 6,
+                 mode: str | None = None, min_points_per_budget: int = 6,
                  **kw):
         super().__init__(space, metric=metric, mode=mode, **kw)
         self.min_points = min_points_per_budget
@@ -358,7 +359,7 @@ class BOHBSearcher(TPESearcher):
         super().on_trial_complete(trial_id, metrics)
         if cfg is not None and metrics and self.metric in metrics:
             val = metrics[self.metric]
-            score = val if self.mode == "max" else -val
+            score = -val if self.mode == "min" else val
             self._budget_obs.setdefault(budget, []).append((cfg, score))
 
     def _split(self):

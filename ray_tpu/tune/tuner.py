@@ -208,9 +208,15 @@ class TuneController:
         if self.searcher is not None:
             if getattr(self.searcher, "metric", None) is None:
                 self.searcher.metric = tune_config.metric
-            # TuneConfig is authoritative for direction — a searcher left at
-            # its default mode='max' must not anti-optimize a 'min' run.
-            self.searcher.mode = tune_config.mode
+            # Searchers default mode=None ("inherit"); an explicit searcher
+            # mode that contradicts TuneConfig is a config error, not a
+            # silent override.
+            if getattr(self.searcher, "mode", None) is None:
+                self.searcher.mode = tune_config.mode
+            elif self.searcher.mode != tune_config.mode:
+                raise ValueError(
+                    f"search_alg mode={self.searcher.mode!r} contradicts "
+                    f"TuneConfig mode={tune_config.mode!r}")
         self.resources = getattr(trainable, "_tune_resources", {"cpu": 1})
 
     # ---- lifecycle ----
@@ -381,6 +387,11 @@ class Tuner:
         if self._preloaded_trials is not None:
             trials = self._preloaded_trials
         elif self.tune_config.search_alg is not None:
+            if self.param_space:
+                raise ValueError(
+                    "pass the search space to the searcher "
+                    "(e.g. TPESearcher(space)), not Tuner(param_space=...) "
+                    "— providing both is ambiguous")
             trials = []  # minted lazily by the controller from the searcher
         else:
             variants = generate_variants(

@@ -373,3 +373,234 @@ def test_algorithm_checkpoint_roundtrip(tmp_path):
         algo2.stop()
     finally:
         algo.stop()
+
+
+# ---- offline data path, CQL, multi-agent ----
+
+
+def test_offline_record_and_load(tmp_path):
+    """record_transitions -> parquet -> load_offline roundtrip."""
+    from ray_tpu.rllib.core.rl_module import module_for_env
+    from ray_tpu.rllib.offline import (
+        load_offline,
+        record_transitions,
+        rows_to_arrays,
+    )
+    import gymnasium as gym
+    import jax
+
+    probe = gym.make("CartPole-v1")
+    module = module_for_env(probe)
+    probe.close()
+    params = module.init(jax.random.PRNGKey(0))
+    path = str(tmp_path / "ep.parquet")
+    rows = record_transitions("CartPole-v1", module, params,
+                              num_steps=64, path=path)
+    assert rows and {"obs", "actions", "rewards", "next_obs",
+                     "dones"} <= set(rows[0])
+    loaded = load_offline(path)
+    assert len(loaded) == len(rows)
+    arrs = rows_to_arrays(loaded)
+    assert arrs["obs"].shape[0] == len(rows)
+    assert arrs["obs"].dtype == np.float32
+    # glob form also resolves
+    assert len(load_offline(str(tmp_path / "*.parquet"))) == len(rows)
+
+
+def test_bc_from_file_path(tmp_path):
+    """BCConfig.offline_data accepts a parquet path (the reference's
+    input_ config shape)."""
+    from ray_tpu.rllib import BCConfig
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(0)
+    obs = rng.normal(size=(256, 4)).astype(np.float32)
+    actions = (obs[:, 1] > 0).astype(np.int64)
+    path = str(tmp_path / "expert.parquet")
+    pq.write_table(pa.Table.from_pylist(
+        [{"obs": o.tolist(), "actions": int(a)}
+         for o, a in zip(obs, actions)]), path)
+    config = (BCConfig()
+              .environment(env="CartPole-v1")
+              .offline_data(input_=path)
+              .training(lr=1e-2, minibatch_size=64, num_epochs=3))
+    algo = config.build_algo()
+    try:
+        for _ in range(4):
+            metrics = algo.train()
+        assert metrics["neg_logp"] < 0.4
+    finally:
+        algo.stop()
+
+
+def test_cql_learns_conservatively_offline():
+    """CQL trains purely from recorded Pendulum data; the conservative
+    penalty keeps dataset-action Q above sampled-action logsumexp over
+    training (critic_loss > bellman_loss), and losses stay finite."""
+    from ray_tpu.rllib import CQLConfig
+    from ray_tpu.rllib.core.rl_module import module_for_env
+    from ray_tpu.rllib.offline import record_transitions
+    import gymnasium as gym
+    import jax
+
+    probe = gym.make("Pendulum-v1")
+    module = module_for_env(probe, kind="sac")
+    probe.close()
+    params = module.init(jax.random.PRNGKey(0))
+    rows = record_transitions("Pendulum-v1", module, params, num_steps=256)
+    config = (CQLConfig()
+              .environment(env="Pendulum-v1")
+              .offline_data(input_=rows)
+              .training(lr=3e-4, train_batch_size=64,
+                        num_updates_per_iter=8, cql_alpha=1.0,
+                        num_ood_actions=3)
+              .debugging(seed=0))
+    algo = config.build_algo()
+    try:
+        for _ in range(3):
+            metrics = algo.train()
+        assert np.isfinite(metrics["critic_loss"])
+        assert np.isfinite(metrics["actor_loss"])
+        # the conservative term is active: total critic loss exceeds the
+        # pure bellman part
+        assert metrics["critic_loss"] > metrics["bellman_loss"]
+    finally:
+        algo.stop()
+
+
+class _TargetMatchEnv:
+    """Tiny cooperative MultiAgentEnv: each agent sees a one-hot target and
+    is rewarded for choosing the matching action; episode length 8."""
+
+    possible_agents = ["a0", "a1"]
+
+    def __init__(self, n: int = 4, seed: int = 0):
+        import gymnasium as gym
+
+        self.n = n
+        self._rng = np.random.default_rng(seed)
+        box = gym.spaces.Box(low=0.0, high=1.0, shape=(n,), dtype=np.float32)
+        self.observation_spaces = {a: box for a in self.possible_agents}
+        self.action_spaces = {a: gym.spaces.Discrete(n)
+                              for a in self.possible_agents}
+        self._t = 0
+
+    def _obs(self):
+        out = {}
+        self._targets = {}
+        for a in self.possible_agents:
+            tgt = int(self._rng.integers(self.n))
+            self._targets[a] = tgt
+            v = np.zeros(self.n, np.float32)
+            v[tgt] = 1.0
+            out[a] = v
+        return out
+
+    def reset(self, *, seed=None, options=None):
+        self._t = 0
+        return self._obs(), {}
+
+    def step(self, action_dict):
+        rew = {a: float(action_dict[a] == self._targets[a])
+               for a in self.possible_agents}
+        self._t += 1
+        done = self._t >= 8
+        obs = self._obs()
+        terms = {a: done for a in self.possible_agents}
+        terms["__all__"] = done
+        truncs = {a: False for a in self.possible_agents}
+        truncs["__all__"] = False
+        return obs, rew, terms, truncs, {}
+
+    def close(self):
+        pass
+
+
+def test_multi_agent_ppo_learns():
+    """Two agents, separate policies: both must learn to match targets
+    (mean reward/step -> well above the 1/n random baseline)."""
+    from ray_tpu.rllib import MultiAgentPPOConfig
+
+    config = (MultiAgentPPOConfig()
+              .environment(env=_TargetMatchEnv)
+              .multi_agent(policy_mapping_fn=lambda aid: aid)
+              .env_runners(num_env_runners=0,
+                           rollout_fragment_length=128)
+              .training(lr=3e-3, minibatch_size=64, num_epochs=4)
+              .debugging(seed=0))
+    algo = config.build_algo()
+    try:
+        last = {}
+        for _ in range(25):
+            last = algo.train()
+        # per-step reward for 2 agents over 8 steps: max 16/ep; random ~4
+        assert last["episode_return_mean"] > 9.0, last
+        assert "a0/total_loss" in last and "a1/total_loss" in last
+    finally:
+        algo.stop()
+
+
+def test_multi_agent_shared_policy():
+    """Parameter sharing: one policy for both agents still learns."""
+    from ray_tpu.rllib import MultiAgentPPOConfig
+
+    config = (MultiAgentPPOConfig()
+              .environment(env=_TargetMatchEnv)
+              .multi_agent(policies=["shared"],
+                           policy_mapping_fn=lambda aid: "shared")
+              .env_runners(num_env_runners=0,
+                           rollout_fragment_length=128)
+              .training(lr=3e-3, minibatch_size=64, num_epochs=4)
+              .debugging(seed=1))
+    algo = config.build_algo()
+    try:
+        for _ in range(25):
+            last = algo.train()
+        assert last["episode_return_mean"] > 9.0, last
+        assert set(algo.learners) == {"shared"}
+    finally:
+        algo.stop()
+
+
+def test_squashed_gaussian_log_prob_matches_sample():
+    """log_prob(sample(obs)) must equal the logp `sample` returns."""
+    from ray_tpu.rllib.core.rl_module import SquashedGaussianModule
+    import jax
+
+    m = SquashedGaussianModule(obs_dim=3, action_dim=2,
+                               low=(-2.0, -1.0), high=(2.0, 1.0))
+    params = m.init(jax.random.PRNGKey(0))
+    obs = jnp.asarray(np.random.default_rng(0).normal(size=(5, 3)),
+                      jnp.float32)
+    a, logp = m.sample(params, obs, jax.random.PRNGKey(1))
+    lp2 = m.log_prob(params, obs, a)
+    np.testing.assert_allclose(np.asarray(logp), np.asarray(lp2),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_cql_bc_warmup_runs():
+    from ray_tpu.rllib import CQLConfig
+    from ray_tpu.rllib.core.rl_module import module_for_env
+    from ray_tpu.rllib.offline import record_transitions
+    import gymnasium as gym
+    import jax
+
+    probe = gym.make("Pendulum-v1")
+    module = module_for_env(probe, kind="sac")
+    probe.close()
+    params = module.init(jax.random.PRNGKey(0))
+    rows = record_transitions("Pendulum-v1", module, params, num_steps=128)
+    config = (CQLConfig()
+              .environment(env="Pendulum-v1")
+              .offline_data(input_=rows)
+              .training(train_batch_size=32, num_updates_per_iter=4,
+                        bc_iters=1, num_ood_actions=2)
+              .debugging(seed=0))
+    algo = config.build_algo()
+    try:
+        m1 = algo.train()   # iteration 1: BC warmup path
+        m2 = algo.train()   # iteration 2: conservative path
+        assert np.isfinite(m1["actor_loss"]) and np.isfinite(m2["actor_loss"])
+    finally:
+        algo.stop()
