@@ -7,11 +7,18 @@ DP/FSDP/TP/SP/EP are sharding configs lowered by XLA, not collective calls.
 """
 
 from ray_tpu.train.step import TrainState, make_train_step
+from ray_tpu.train.backend import Backend, JaxDistributedConfig
 from ray_tpu.train.trainer import JaxTrainer, ScalingConfig, RunConfig
 from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
 from ray_tpu.train import session
-from ray_tpu.train.session import report, get_checkpoint, get_dataset_shard
+from ray_tpu.train.session import (
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+)
 
 __all__ = ["JaxTrainer", "ScalingConfig", "RunConfig", "TrainState",
            "make_train_step", "Checkpoint", "CheckpointManager", "session",
-           "report", "get_checkpoint", "get_dataset_shard"]
+           "report", "get_checkpoint", "get_context", "get_dataset_shard",
+           "Backend", "JaxDistributedConfig"]

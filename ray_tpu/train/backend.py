@@ -1,0 +1,56 @@
+"""Framework backends for the train worker group.
+
+Parity: reference `train/_internal/backend_executor.py:73` driving
+`Backend.on_start` hooks (`train/backend.py` in the reference; torch's
+`train/torch/config.py` runs `dist.init_process_group`). The JAX path needs
+no backend object — multi-host SPMD setup is `jax.distributed.initialize`,
+done inline by the worker — so Backend exists for the frameworks that DO
+carry process-group state (torch today; anything gloo/mpi-shaped tomorrow).
+"""
+
+from __future__ import annotations
+
+
+class Backend:
+    """Worker-group framework hooks, executed inside each worker actor."""
+
+    #: whether _make_group must mint a rendezvous address for the gang
+    needs_coordinator: bool = False
+
+    def on_worker_start(self, rank: int, world_size: int,
+                        coordinator: str | None):
+        """Called on every worker before the user loop starts."""
+
+    def on_worker_shutdown(self):
+        """Called when the worker group is torn down (best effort)."""
+
+
+class JaxDistributedConfig(Backend):
+    """Cross-host SPMD gang: every worker joins one jax runtime via
+    `jax.distributed.initialize` (coordinator = rank 0's node), so the
+    workers' local devices form a single global mesh. Pass as
+    `JaxTrainer(..., jax_config=JaxDistributedConfig())` for multi-host
+    runs; without it workers run independent single-host jax (data-parallel
+    via the host collective layer)."""
+
+    needs_coordinator = True
+
+    def __init__(self, *, local_device_ids=None):
+        self.local_device_ids = local_device_ids
+
+    def on_worker_start(self, rank: int, world_size: int,
+                        coordinator: str | None):
+        if world_size <= 1 or coordinator is None:
+            return
+        import jax
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=world_size, process_id=rank,
+            local_device_ids=self.local_device_ids)
+
+    def on_worker_shutdown(self):
+        import jax
+        try:
+            jax.distributed.shutdown()
+        except Exception:  # noqa: BLE001 — already down
+            pass

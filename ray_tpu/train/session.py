@@ -79,3 +79,27 @@ def get_world_rank() -> int:
 
 def get_world_size() -> int:
     return get_session().world_size
+
+
+class TrainContext:
+    """Parity: ray.train.get_context() (TrainContext in the reference) —
+    a read-only view over the worker's session."""
+
+    def get_world_rank(self) -> int:
+        return get_world_rank()
+
+    def get_world_size(self) -> int:
+        return get_world_size()
+
+    def get_local_rank(self) -> int:
+        # One worker actor per host (SURVEY §7 design stance), so the
+        # local rank of the actor's process is always 0.
+        return 0
+
+    def get_trial_dir(self) -> str:
+        return get_session().storage_dir
+
+
+def get_context() -> TrainContext:
+    get_session()  # raises outside a training loop
+    return TrainContext()

@@ -1,0 +1,158 @@
+"""TorchTrainer: the reference's flagship trainer surface, on this runtime.
+
+Parity: reference `train/torch/torch_trainer.py:11` (TorchTrainer),
+`train/torch/config.py` (TorchConfig -> dist.init_process_group) and
+`train/torch/train_loop_utils.py` (prepare_model / prepare_data_loader).
+
+Role in a TPU-first framework: the migration path. Users arriving from the
+reference keep their torch training loops running (CPU gloo DDP across
+worker actors on this runtime) while porting the model to JaxTrainer for
+the TPU compute path — torch-on-TPU (torch-xla) is not shipped in this
+environment, so `get_device()` is CPU and the speed lives in JaxTrainer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+from ray_tpu.train.backend import Backend
+from ray_tpu.train.trainer import (
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+)
+
+
+@dataclasses.dataclass
+class TorchConfig(Backend):
+    """Parity: train/torch/config.py TorchConfig."""
+
+    backend: str = "gloo"          # CPU image: gloo (nccl has no place here)
+    init_timeout_s: float = 120.0
+
+    needs_coordinator = True
+
+    def on_worker_start(self, rank: int, world_size: int,
+                        coordinator: str | None):
+        if world_size <= 1 or coordinator is None:
+            return  # single worker: no process group needed
+        import datetime
+
+        import torch.distributed as dist
+        if dist.is_initialized():
+            return
+        dist.init_process_group(
+            backend=self.backend,
+            init_method=f"tcp://{coordinator}",
+            rank=rank, world_size=world_size,
+            timeout=datetime.timedelta(seconds=self.init_timeout_s))
+
+    def on_worker_shutdown(self):
+        import torch.distributed as dist
+        if dist.is_initialized():
+            dist.destroy_process_group()
+
+
+class TorchTrainer(JaxTrainer):
+    """Same controller/worker-group/failure machinery as JaxTrainer, with a
+    torch process-group backend set up before the user loop."""
+
+    def __init__(self, train_loop_per_worker: Callable, *,
+                 train_loop_config: dict | None = None,
+                 torch_config: TorchConfig | None = None,
+                 scaling_config: ScalingConfig | None = None,
+                 run_config: RunConfig | None = None,
+                 datasets: dict | None = None,
+                 resume_from_checkpoint=None):
+        super().__init__(train_loop_per_worker,
+                         train_loop_config=train_loop_config,
+                         scaling_config=scaling_config,
+                         run_config=run_config, datasets=datasets,
+                         resume_from_checkpoint=resume_from_checkpoint)
+        self.backend = torch_config or TorchConfig()
+
+
+def get_device():
+    """Parity: ray.train.torch.get_device (CPU in this environment)."""
+    import torch
+    return torch.device("cpu")
+
+
+def prepare_model(model, *, wrap_ddp: bool = True):
+    """Wrap the model for the worker group (parity: train_loop_utils.py
+    prepare_model): DDP when a multi-worker process group is up."""
+    import torch.distributed as dist
+    if wrap_ddp and dist.is_available() and dist.is_initialized() \
+            and dist.get_world_size() > 1:
+        from torch.nn.parallel import DistributedDataParallel
+        return DistributedDataParallel(model)
+    return model
+
+
+class _EpochSteppingLoader:
+    """DataLoader wrapper that bumps DistributedSampler.set_epoch on every
+    full iteration, so multi-epoch loops reshuffle per epoch without the
+    user having to call set_epoch themselves (the reference's
+    prepare_data_loader wraps the iterator the same way)."""
+
+    def __init__(self, loader, sampler):
+        self._loader = loader
+        self._sampler = sampler
+        self._epoch = 0
+
+    def __iter__(self):
+        self._sampler.set_epoch(self._epoch)
+        self._epoch += 1
+        return iter(self._loader)
+
+    def __len__(self):
+        return len(self._loader)
+
+    def __getattr__(self, name):
+        return getattr(self._loader, name)
+
+
+def prepare_data_loader(data_loader):
+    """Shard a DataLoader across the worker group with a DistributedSampler
+    (parity: train_loop_utils.py prepare_data_loader).
+
+    Loaders that already carry a custom sampling scheme are left alone: a
+    batch_sampler= loader (batch_size is None) or a non-default sampler
+    (e.g. WeightedRandomSampler) cannot be re-sharded without changing the
+    user's sampling distribution."""
+    import torch.distributed as dist
+    if not (dist.is_available() and dist.is_initialized()
+            and dist.get_world_size() > 1):
+        return data_loader
+    from torch.utils.data import (
+        DataLoader,
+        RandomSampler,
+        SequentialSampler,
+    )
+    from torch.utils.data.distributed import DistributedSampler
+    if data_loader.batch_size is None:  # batch_sampler= construction
+        return data_loader
+    if not isinstance(data_loader.sampler,
+                      (RandomSampler, SequentialSampler)):
+        return data_loader  # custom sampler: keep the user's distribution
+    shuffle = isinstance(data_loader.sampler, RandomSampler)
+    sampler = DistributedSampler(data_loader.dataset,
+                                 num_replicas=dist.get_world_size(),
+                                 rank=dist.get_rank(), shuffle=shuffle)
+    loader = DataLoader(
+        data_loader.dataset,
+        batch_size=data_loader.batch_size,
+        sampler=sampler,
+        num_workers=data_loader.num_workers,
+        collate_fn=data_loader.collate_fn,
+        pin_memory=data_loader.pin_memory,
+        drop_last=data_loader.drop_last,
+        timeout=data_loader.timeout,
+        worker_init_fn=data_loader.worker_init_fn,
+    )
+    return _EpochSteppingLoader(loader, sampler)
+
+
+__all__ = ["TorchTrainer", "TorchConfig", "get_device", "prepare_model",
+           "prepare_data_loader"]

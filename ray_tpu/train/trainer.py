@@ -74,7 +74,8 @@ class TrainWorker:
     """Actor hosting the user training loop (one per host)."""
 
     def __init__(self, rank: int, world_size: int, storage_dir: str,
-                 coordinator: str | None, env: dict):
+                 coordinator: str | None, env: dict,
+                 backend_bytes: bytes | None = None):
         os.environ.update(env)
         self.rank = rank
         self.world_size = world_size
@@ -82,10 +83,34 @@ class TrainWorker:
         self.coordinator = coordinator
         self._thread = None
         self._session = None
+        self._backend = None
+        if backend_bytes is not None:
+            import cloudpickle
+            self._backend = cloudpickle.loads(backend_bytes)
 
-    def setup_distributed(self):
-        """Join the multi-host jax runtime (no-op for world_size 1)."""
-        if self.world_size > 1 and self.coordinator:
+    def get_address(self) -> str:
+        """Rendezvous address minted on THIS worker's node (rank 0 binds
+        it), so multi-node gangs don't chase the controller's loopback."""
+        import socket
+        try:
+            ip = socket.gethostbyname(socket.gethostname())
+        except OSError:
+            ip = "127.0.0.1"
+        s = socket.socket()
+        s.bind((ip if ip != "127.0.0.1" else "", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return f"{ip}:{port}"
+
+    def setup_distributed(self, coordinator: str | None = None):
+        """Join the gang: framework Backend hook (torch process group etc.)
+        or the default multi-host jax runtime (no-op for world_size 1)."""
+        if coordinator is not None:
+            self.coordinator = coordinator
+        if self._backend is not None:
+            self._backend.on_worker_start(self.rank, self.world_size,
+                                          self.coordinator)
+        elif self.world_size > 1 and self.coordinator:
             import jax
             jax.distributed.initialize(
                 coordinator_address=self.coordinator,
@@ -139,6 +164,11 @@ class TrainWorker:
         return None
 
     def shutdown(self):
+        if self._backend is not None:
+            try:
+                self._backend.on_worker_shutdown()
+            except Exception:  # noqa: BLE001 — teardown is best effort
+                pass
         return True
 
 
@@ -156,13 +186,17 @@ class JaxTrainer:
                  scaling_config: ScalingConfig | None = None,
                  run_config: RunConfig | None = None,
                  datasets: dict | None = None,
-                 resume_from_checkpoint=None):
+                 resume_from_checkpoint=None,
+                 jax_config=None):
         self.train_loop = train_loop_per_worker
         self.loop_config = train_loop_config or {}
         self.scaling = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
         self.datasets = datasets or {}
         self.resume_from_checkpoint = resume_from_checkpoint
+        # Framework Backend: TorchTrainer sets TorchConfig; pass
+        # jax_config=JaxDistributedConfig() for a cross-host SPMD gang.
+        self.backend = jax_config
         self.state = INIT
 
     def _storage_dir(self) -> str:
@@ -234,18 +268,31 @@ class JaxTrainer:
         num_tpus = req.get("TPU", 0)
         custom = {k: v for k, v in req.items() if k not in ("CPU", "TPU")}
         env = {}
+        backend_bytes = None
+        needs_coordinator = n > 1 and (
+            getattr(self.backend, "needs_coordinator", False))
+        if self.backend is not None:
+            import cloudpickle
+            backend_bytes = cloudpickle.dumps(self.backend)
         WorkerCls = ray_tpu.remote(TrainWorker).options(
             num_cpus=num_cpus, num_tpus=num_tpus,
             resources=custom or None)
         workers = [
             WorkerCls.remote(rank=i, world_size=n, storage_dir=storage_dir,
-                             coordinator=None, env=env)
+                             coordinator=None, env=env,
+                             backend_bytes=backend_bytes)
             for i in range(n)
         ]
         try:
+            coordinator = None
+            if needs_coordinator:
+                # Rank 0 mints the rendezvous address on ITS node — it is
+                # the process that binds it.
+                coordinator = ray_tpu.get(
+                    workers[0].get_address.remote(), timeout=60)
             # Gang rendezvous (SPMD impedance, SURVEY §7 hard-part 3).
-            ray_tpu.get([w.setup_distributed.remote() for w in workers],
-                        timeout=300)
+            ray_tpu.get([w.setup_distributed.remote(coordinator)
+                         for w in workers], timeout=300)
         except BaseException:
             # A partial gang must not leak: surviving actors would hold
             # their reservations forever and starve every retry.
@@ -314,6 +361,13 @@ class JaxTrainer:
                 self.state = FINISHED
             except _WorkerGroupError as e:
                 error = e
+            # Backend teardown hook (best effort, bounded), then hard kill.
+            if workers:
+                try:
+                    ray_tpu.get([w.shutdown.remote() for w in workers],
+                                timeout=5)
+                except Exception:  # noqa: BLE001 — wedged workers
+                    pass
             for w in workers:
                 try:
                     ray_tpu.kill(w)
