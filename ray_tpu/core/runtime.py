@@ -464,6 +464,10 @@ def _sum_bundles(bundles) -> dict[str, float]:
     return total
 
 
+def _kv_key_bytes(k) -> bytes:
+    return k.encode() if isinstance(k, str) else k
+
+
 class TaskEventBuffer:
     """Bounded ring of task state transitions (parity: task_event_buffer.h:225).
 
@@ -929,6 +933,13 @@ class Runtime:
         else:
             raise RayTpuError(f"head: unknown message {op}")
 
+    def kv_keys(self, prefix=b"") -> list:
+        with self.lock:
+            return [k for k in self.kv
+                    if isinstance(k, (bytes, str))
+                    and (not prefix or _kv_key_bytes(k).startswith(
+                        _kv_key_bytes(prefix)))]
+
     def kv_incr(self, key) -> int:
         """Atomic counter increment (serialized by the head lock); the
         primitive behind barriers/rendezvous — a get-then-put from N workers
@@ -956,6 +967,8 @@ class Runtime:
             resp = True
         elif what == "kv_incr":
             resp = self.kv_incr(arg)
+        elif what == "kv_keys":
+            resp = self.kv_keys(arg)
         elif what == "spill":
             # Only head-node workers share the head's arena; a remote
             # worker's store is its agent's (arena LRU eviction applies).

@@ -15,9 +15,12 @@ from typing import Any
 
 class TrainSession:
     def __init__(self, rank: int, world_size: int, storage_dir: str,
-                 checkpoint=None, dataset_shards: dict | None = None):
+                 checkpoint=None, dataset_shards: dict | None = None,
+                 local_rank: int = 0, local_world_size: int = 1):
         self.rank = rank
         self.world_size = world_size
+        self.local_rank = local_rank
+        self.local_world_size = local_world_size
         self.storage_dir = storage_dir
         self.resume_checkpoint = checkpoint
         self.dataset_shards = dataset_shards or {}
@@ -92,9 +95,10 @@ class TrainContext:
         return get_world_size()
 
     def get_local_rank(self) -> int:
-        # One worker actor per host (SURVEY §7 design stance), so the
-        # local rank of the actor's process is always 0.
-        return 0
+        return get_session().local_rank
+
+    def get_local_world_size(self) -> int:
+        return get_session().local_world_size
 
     def get_trial_dir(self) -> str:
         return get_session().storage_dir

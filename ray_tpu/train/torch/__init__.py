@@ -140,8 +140,7 @@ def prepare_data_loader(data_loader):
     sampler = DistributedSampler(data_loader.dataset,
                                  num_replicas=dist.get_world_size(),
                                  rank=dist.get_rank(), shuffle=shuffle)
-    loader = DataLoader(
-        data_loader.dataset,
+    kw = dict(
         batch_size=data_loader.batch_size,
         sampler=sampler,
         num_workers=data_loader.num_workers,
@@ -150,7 +149,13 @@ def prepare_data_loader(data_loader):
         drop_last=data_loader.drop_last,
         timeout=data_loader.timeout,
         worker_init_fn=data_loader.worker_init_fn,
+        generator=data_loader.generator,
+        persistent_workers=data_loader.persistent_workers,
+        multiprocessing_context=data_loader.multiprocessing_context,
     )
+    if data_loader.num_workers > 0:  # only valid with loader workers
+        kw["prefetch_factor"] = data_loader.prefetch_factor
+    loader = DataLoader(data_loader.dataset, **kw)
     return _EpochSteppingLoader(loader, sampler)
 
 

@@ -83,6 +83,8 @@ class TrainWorker:
         self.coordinator = coordinator
         self._thread = None
         self._session = None
+        self.local_rank = 0
+        self.local_world_size = 1
         self._backend = None
         if backend_bytes is not None:
             import cloudpickle
@@ -92,29 +94,45 @@ class TrainWorker:
         """Rendezvous address minted on THIS worker's node (rank 0 binds
         it), so multi-node gangs don't chase the controller's loopback."""
         import socket
+        ip = None
         try:
-            ip = socket.gethostbyname(socket.gethostname())
+            # Outbound-route probe: a UDP connect sends no packets but
+            # resolves the interface IP other nodes can reach — hostname
+            # lookup often lands on 127.0.1.1 (Debian /etc/hosts).
+            probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            probe.connect(("8.8.8.8", 80))
+            ip = probe.getsockname()[0]
+            probe.close()
         except OSError:
-            ip = "127.0.0.1"
+            pass
+        if ip is None or ip.startswith("127."):
+            try:
+                ip = socket.gethostbyname(socket.gethostname())
+            except OSError:
+                ip = "127.0.0.1"
         s = socket.socket()
-        s.bind((ip if ip != "127.0.0.1" else "", 0))
+        s.bind(("" if ip.startswith("127.") else ip, 0))
         port = s.getsockname()[1]
         s.close()
         return f"{ip}:{port}"
 
+    def get_node_id(self) -> str:
+        import ray_tpu
+        return ray_tpu.get_node_id()
+
+    def set_local_rank(self, local_rank: int, local_world_size: int):
+        self.local_rank = local_rank
+        self.local_world_size = local_world_size
+        return True
+
     def setup_distributed(self, coordinator: str | None = None):
-        """Join the gang: framework Backend hook (torch process group etc.)
-        or the default multi-host jax runtime (no-op for world_size 1)."""
+        """Join the gang via the framework Backend hook (torch process
+        group, JaxDistributedConfig multi-host jax); no-op without one."""
         if coordinator is not None:
             self.coordinator = coordinator
         if self._backend is not None:
             self._backend.on_worker_start(self.rank, self.world_size,
                                           self.coordinator)
-        elif self.world_size > 1 and self.coordinator:
-            import jax
-            jax.distributed.initialize(
-                coordinator_address=self.coordinator,
-                num_processes=self.world_size, process_id=self.rank)
         return self.rank
 
     def run(self, loop_fn_bytes: bytes, loop_config: dict,
@@ -126,7 +144,8 @@ class TrainWorker:
         ckpt = Checkpoint(checkpoint_path) if checkpoint_path else None
         self._session = session_mod.TrainSession(
             self.rank, self.world_size, self.storage_dir, checkpoint=ckpt,
-            dataset_shards=dataset_shards)
+            dataset_shards=dataset_shards, local_rank=self.local_rank,
+            local_world_size=self.local_world_size)
         session_mod._set_session(self._session)
 
         def target():
@@ -284,6 +303,19 @@ class JaxTrainer:
             for i in range(n)
         ]
         try:
+            # Local ranks: position of each worker among the workers
+            # co-located on its node (torch-style LOCAL_RANK semantics).
+            node_ids = ray_tpu.get(
+                [w.get_node_id.remote() for w in workers], timeout=60)
+            per_node: dict = {}
+            assignments = []
+            for nid in node_ids:
+                assignments.append(per_node.get(nid, 0))
+                per_node[nid] = per_node.get(nid, 0) + 1
+            ray_tpu.get(
+                [w.set_local_rank.remote(assignments[i],
+                                         per_node[node_ids[i]])
+                 for i, w in enumerate(workers)], timeout=60)
             coordinator = None
             if needs_coordinator:
                 # Rank 0 mints the rendezvous address on ITS node — it is

@@ -1,0 +1,81 @@
+"""joblib backend: scikit-learn's Parallel(...) on the cluster.
+
+Parity: reference `python/ray/util/joblib/` (`register_ray` +
+`ray_backend.py`). After `register_ray()`, `with
+joblib.parallel_backend("ray_tpu"):` routes every joblib batch (e.g. a
+scikit-learn grid search) through task submission.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import ray_tpu
+
+
+_backend_cls = None
+
+
+def register_ray():
+    """Register the 'ray_tpu' joblib parallel backend."""
+    global _backend_cls
+    from joblib import register_parallel_backend
+    if _backend_cls is None:
+        _backend_cls = _make_backend_class()
+    register_parallel_backend("ray_tpu", _backend_cls)
+
+
+class _BatchResult:
+    def __init__(self, ref, callback):
+        self._ref = ref
+        if callback is not None:
+            def run():
+                try:
+                    callback(self.get())
+                except BaseException:  # noqa: BLE001 — joblib retries
+                    pass
+            threading.Thread(target=run, daemon=True).start()
+
+    def get(self, timeout=None):
+        return ray_tpu.get(self._ref, timeout=timeout)
+
+
+def _make_backend_class():
+    from joblib._parallel_backends import ParallelBackendBase
+
+    class RayTpuBackend(ParallelBackendBase):
+        supports_timeout = True
+        uses_threads = False
+        supports_sharedmem = False
+
+        def configure(self, n_jobs=1, parallel=None, **_kw):
+            self.parallel = parallel
+            if not ray_tpu.is_initialized():
+                ray_tpu.init()
+            return self.effective_n_jobs(n_jobs)
+
+        def effective_n_jobs(self, n_jobs):
+            if n_jobs == 0:
+                raise ValueError("n_jobs == 0 has no meaning")
+            cpus = max(1, int(ray_tpu.cluster_resources().get("CPU", 1)))
+            if n_jobs is None or n_jobs < 0:
+                return cpus
+            return min(n_jobs, cpus)
+
+        def apply_async(self, func, callback=None):
+            # func is a joblib BatchedCalls: zero-arg callable returning a
+            # list of results; it pickles via cloudpickle like any task arg.
+            @ray_tpu.remote
+            def _run_batch(f):
+                return f()
+
+            return _BatchResult(_run_batch.remote(func), callback)
+
+        def abort_everything(self, ensure_ready=True):
+            if ensure_ready:
+                self.configure(n_jobs=self.parallel.n_jobs,
+                               parallel=self.parallel)
+
+    return RayTpuBackend
+
+

@@ -1,0 +1,196 @@
+"""multiprocessing.Pool shim over ray_tpu tasks.
+
+Parity: reference `python/ray/util/multiprocessing/pool.py` — the stdlib
+Pool surface (apply/apply_async/map/map_async/starmap/imap/imap_unordered)
+with every call running as a task on the cluster instead of a forked local
+process.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Callable, Iterable
+
+import ray_tpu
+
+
+class AsyncResult:
+    """stdlib-shaped handle over one or many object refs."""
+
+    def __init__(self, refs, single: bool, callback=None,
+                 error_callback=None):
+        self._refs = refs
+        self._single = single
+        self._result = None
+        self._error = None
+        self._done = threading.Event()
+
+        def waiter():
+            try:
+                out = ray_tpu.get(self._refs, timeout=None)
+                self._result = out[0] if single else out
+                if callback is not None:
+                    callback(self._result)
+            except BaseException as e:  # noqa: BLE001 — stored for .get()
+                self._error = e
+                if error_callback is not None:
+                    error_callback(e)
+            finally:
+                self._done.set()
+
+        threading.Thread(target=waiter, daemon=True).start()
+
+    def get(self, timeout: float | None = None):
+        if not self._done.wait(timeout):
+            raise TimeoutError("AsyncResult.get timed out")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def wait(self, timeout: float | None = None):
+        self._done.wait(timeout)
+
+    def ready(self) -> bool:
+        return self._done.is_set()
+
+    def successful(self) -> bool:
+        if not self._done.is_set():
+            raise ValueError("result not ready")
+        return self._error is None
+
+
+class Pool:
+    """Task-backed process pool (parity: ray.util.multiprocessing.Pool).
+
+    `processes` bounds in-flight chunks, not OS processes — the runtime's
+    worker pool does the actual process management.
+    """
+
+    def __init__(self, processes: int | None = None, initializer=None,
+                 initargs=(), ray_address: str | None = None):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(address=ray_address) if ray_address \
+                else ray_tpu.init()
+        self._processes = processes or max(
+            1, int(ray_tpu.cluster_resources().get("CPU", 1)))
+        self._closed = False
+        self._initializer = initializer
+        self._initargs = tuple(initargs)
+
+        init = self._initializer
+        iargs = self._initargs
+
+        @ray_tpu.remote
+        def _run_chunk(fn, chunk, star):
+            if init is not None:
+                # Stdlib runs the initializer once per process; worker
+                # reuse makes per-chunk idempotent initializers the
+                # equivalent here.
+                init(*iargs)
+            if star:
+                return [fn(*args) for args in chunk]
+            return [fn(*args) if isinstance(args, tuple) else fn(args)
+                    for args in chunk]
+
+        self._run_chunk = _run_chunk
+
+    # -- helpers --
+
+    def _check_open(self):
+        if self._closed:
+            raise ValueError("Pool is closed")
+
+    def _chunks(self, iterable: Iterable, chunksize: int | None):
+        items = list(iterable)
+        if chunksize is None:
+            chunksize = max(1, len(items) // (self._processes * 4) or 1)
+        return [items[i:i + chunksize]
+                for i in range(0, len(items), chunksize)], len(items)
+
+    def _submit(self, fn, chunks, star: bool):
+        return [self._run_chunk.remote(fn, c, star) for c in chunks]
+
+    # -- stdlib surface --
+
+    def apply(self, fn: Callable, args=(), kwds=None) -> Any:
+        return self.apply_async(fn, args, kwds).get()
+
+    def apply_async(self, fn: Callable, args=(), kwds=None,
+                    callback=None, error_callback=None) -> AsyncResult:
+        self._check_open()
+        kwds = kwds or {}
+
+        @ray_tpu.remote
+        def _run_one():
+            return fn(*args, **kwds)
+
+        return AsyncResult([_run_one.remote()], single=True,
+                           callback=callback, error_callback=error_callback)
+
+    def map(self, fn: Callable, iterable: Iterable,
+            chunksize: int | None = None) -> list:
+        return self.map_async(fn, iterable, chunksize).get()
+
+    def map_async(self, fn, iterable, chunksize=None, callback=None,
+                  error_callback=None) -> AsyncResult:
+        self._check_open()
+        chunks, _n = self._chunks(iterable, chunksize)
+        refs = self._submit(fn, chunks, star=False)
+
+        flat_cb = None
+        if callback is not None:
+            def flat_cb(parts):
+                callback(list(itertools.chain.from_iterable(parts)))
+        res = AsyncResult(refs, single=False, callback=flat_cb,
+                          error_callback=error_callback)
+        orig_get = res.get
+
+        def get(timeout=None):
+            return list(itertools.chain.from_iterable(orig_get(timeout)))
+        res.get = get
+        return res
+
+    def starmap(self, fn: Callable, iterable: Iterable,
+                chunksize: int | None = None) -> list:
+        self._check_open()
+        chunks, _n = self._chunks(iterable, chunksize)
+        refs = self._submit(fn, chunks, star=True)
+        parts = ray_tpu.get(refs, timeout=None)
+        return list(itertools.chain.from_iterable(parts))
+
+    def imap(self, fn: Callable, iterable: Iterable,
+             chunksize: int = 1):
+        self._check_open()
+        chunks, _n = self._chunks(iterable, chunksize)
+        refs = self._submit(fn, chunks, star=False)
+        for ref in refs:  # submission order
+            yield from ray_tpu.get(ref, timeout=None)
+
+    def imap_unordered(self, fn: Callable, iterable: Iterable,
+                       chunksize: int = 1):
+        self._check_open()
+        chunks, _n = self._chunks(iterable, chunksize)
+        refs = self._submit(fn, chunks, star=False)
+        pending = list(refs)
+        while pending:
+            ready, pending = ray_tpu.wait(pending, num_returns=1,
+                                          timeout=None)
+            for ref in ready:
+                yield from ray_tpu.get(ref, timeout=None)
+
+    def close(self):
+        self._closed = True
+
+    def terminate(self):
+        self._closed = True
+
+    def join(self):
+        if not self._closed:
+            raise ValueError("Pool is still open")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.terminate()

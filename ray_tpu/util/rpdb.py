@@ -1,0 +1,85 @@
+"""Remote pdb: breakpoints inside worker processes.
+
+Parity: reference `python/ray/util/rpdb.py` (`ray.util.pdb.set_trace`) —
+a worker has no terminal, so `set_trace()` opens a TCP listener and runs
+pdb over the socket; connect with `nc <host> <port>` (the address is
+printed to the worker's log and stored in the head KV under
+`__rpdb__:<pid>`).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import sys
+
+
+class _SocketIO:
+    """File-like adapter pdb can read/write through."""
+
+    def __init__(self, conn: socket.socket):
+        self._conn = conn
+        self._rfile = conn.makefile("r")
+        self._wfile = conn.makefile("w")
+
+    def readline(self):
+        return self._rfile.readline()
+
+    def write(self, data):
+        self._wfile.write(data)
+        return len(data)
+
+    def flush(self):
+        self._wfile.flush()
+
+    def close(self):
+        for f in (self._rfile, self._wfile):
+            try:
+                f.close()
+            except OSError:
+                pass
+        try:
+            self._conn.close()
+        except OSError:
+            pass
+
+
+def set_trace(breakpoint_uuid: str | None = None):
+    """Block until a debugger client connects, then drop into pdb."""
+    import pdb
+
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+    host, port = lsock.getsockname()
+    addr = f"{host}:{port}"
+    tag = breakpoint_uuid or str(os.getpid())
+    print(f"rpdb: waiting for debugger on {addr} "
+          f"(connect with: nc {host} {port})", flush=True)
+    try:
+        from ray_tpu.experimental.internal_kv import _internal_kv_put
+        _internal_kv_put(f"__rpdb__:{tag}", addr.encode())
+    except Exception:  # noqa: BLE001 — KV is advisory
+        pass
+    conn, _peer = lsock.accept()
+    lsock.close()
+    io = _SocketIO(conn)
+    debugger = pdb.Pdb(stdin=io, stdout=io)
+    debugger.prompt = "(rpdb) "
+    frame = sys._getframe().f_back
+    debugger.set_trace(frame)
+
+
+def list_breakpoints() -> dict:
+    """Active rpdb listeners (driver-side helper): {tag: 'host:port'}."""
+    from ray_tpu.experimental.internal_kv import (
+        _internal_kv_get,
+        _internal_kv_list,
+    )
+    out = {}
+    for k in _internal_kv_list("__rpdb__:"):
+        key = k.decode() if isinstance(k, bytes) else k
+        v = _internal_kv_get(k)
+        out[key.split(":", 1)[1]] = (v.decode()
+                                     if isinstance(v, bytes) else v)
+    return out

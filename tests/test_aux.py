@@ -5,6 +5,8 @@ Parity: reference runtime-env tests, ray.timeline, util/queue tests."""
 import json
 import os
 
+import pytest
+
 import ray_tpu
 
 
@@ -74,3 +76,92 @@ def test_util_queue(ray_start_regular):
     q.put("late")
     assert ray_tpu.get(ref, timeout=60) == "late"
     q.shutdown()
+
+
+# ---- util shims: multiprocessing.Pool, joblib, tqdm_ray, internal_kv ----
+
+
+def _sq(x):
+    return x * x
+
+
+def _addmul(a, b):
+    return a * 10 + b
+
+
+def test_multiprocessing_pool(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(processes=2) as p:
+        assert p.map(_sq, range(10)) == [x * x for x in range(10)]
+        assert p.apply(_addmul, (3, 4)) == 34
+        ar = p.apply_async(_sq, (7,))
+        assert ar.get(timeout=30) == 49
+        assert sorted(p.imap_unordered(_sq, range(6))) == \
+            [0, 1, 4, 9, 16, 25]
+        assert list(p.imap(_sq, range(6))) == [0, 1, 4, 9, 16, 25]
+        assert p.starmap(_addmul, [(1, 2), (3, 4)]) == [12, 34]
+        mr = p.map_async(_sq, range(4))
+        assert mr.get(timeout=30) == [0, 1, 4, 9]
+
+
+def test_multiprocessing_pool_error_propagates(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+
+    def boom(x):
+        raise ValueError("nope")
+
+    with Pool(processes=1) as p:
+        with pytest.raises(Exception):
+            p.map(boom, [1, 2])
+
+
+def test_joblib_backend(ray_start_regular):
+    import joblib
+
+    from ray_tpu.util.joblib import register_ray
+
+    register_ray()
+    with joblib.parallel_backend("ray_tpu", n_jobs=2):
+        out = joblib.Parallel()(joblib.delayed(_sq)(i) for i in range(8))
+    assert out == [i * i for i in range(8)]
+
+
+def test_tqdm_ray_driver_and_kv(ray_start_regular):
+    from ray_tpu.util import tqdm_ray
+
+    total = 0
+    for x in tqdm_ray.tqdm(range(5), desc="t"):
+        total += x
+    assert total == 10
+    tqdm_ray.safe_print("safe", "print")
+
+
+def test_internal_kv_roundtrip(ray_start_regular):
+    from ray_tpu.experimental import internal_kv as kv
+
+    assert kv._internal_kv_initialized()
+    existed = kv._internal_kv_put(b"ik:a", b"1")
+    assert existed is False
+    assert kv._internal_kv_put(b"ik:a", b"2") is True
+    assert kv._internal_kv_get(b"ik:a") == b"2"
+    kv._internal_kv_put(b"ik:a", b"3", overwrite=False)
+    assert kv._internal_kv_get(b"ik:a") == b"2"
+    kv._internal_kv_put(b"ik:b", b"x")
+    keys = kv._internal_kv_list(b"ik:")
+    assert set(keys) >= {b"ik:a", b"ik:b"}
+    kv._internal_kv_del(b"ik:a")
+    assert not kv._internal_kv_exists(b"ik:a")
+
+
+def test_internal_kv_from_worker(ray_start_regular):
+    @ray_tpu.remote
+    def put_and_list():
+        from ray_tpu.experimental import internal_kv as kv
+        kv._internal_kv_put(b"wk:x", b"99")
+        return (kv._internal_kv_get(b"wk:x"),
+                sorted(kv._internal_kv_list(b"wk:")))
+
+    got, keys = ray_tpu.get(put_and_list.remote(), timeout=60)
+    assert got == b"99"
+    assert keys == [b"wk:x"]
