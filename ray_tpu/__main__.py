@@ -1,0 +1,3 @@
+from ray_tpu.cli import main
+
+main()

@@ -1,0 +1,329 @@
+"""The `ray_tpu` command line (python -m ray_tpu ...).
+
+Parity: reference `ray` CLI — `ray start/stop/status`
+(`python/ray/scripts/scripts.py`), the state CLI `ray list/summary`
+(`util/state/state_cli.py`), `ray timeline`, and `ray job submit/...`
+(`dashboard/modules/job/cli.py`).
+
+`start --head` boots a head runtime with the cluster plane enabled and
+records its address + pid under /tmp/ray_tpu/ (the reference's
+/tmp/ray/ray_current_cluster); every other subcommand connects to that
+address (or --address) as a client driver.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+_STATE_DIR = os.environ.get("RAY_TPU_STATE_DIR", "/tmp/ray_tpu")
+_ADDR_FILE = os.path.join(_STATE_DIR, "ray_current_address")
+_PID_FILE = os.path.join(_STATE_DIR, "ray_head_pids")
+
+
+def _write_cluster_files(address: str, pids: list[int]):
+    os.makedirs(_STATE_DIR, exist_ok=True)
+    with open(_ADDR_FILE, "w") as f:
+        f.write(address)
+    with open(_PID_FILE, "w") as f:
+        f.write(json.dumps(pids))
+
+
+def _resolve_address(args) -> str:
+    addr = getattr(args, "address", None) or os.environ.get(
+        "RAY_TPU_ADDRESS")
+    if addr:
+        return addr
+    try:
+        with open(_ADDR_FILE) as f:
+            return f.read().strip()
+    except FileNotFoundError:
+        sys.exit("no running cluster found: pass --address or run "
+                 "`ray_tpu start --head` first")
+
+
+def _connect(args):
+    import ray_tpu
+    ray_tpu.init(address=_resolve_address(args))
+
+
+def _cmd_start(args):
+    import ray_tpu
+    if not args.head:
+        if not args.address:
+            sys.exit("start: pass --head (start a head) or "
+                     "--address host:port (join as a node)")
+        cmd = [sys.executable, "-m", "ray_tpu.core.node_agent",
+               "--head", args.address,
+               "--num-cpus", str(args.num_cpus or os.cpu_count() or 1),
+               "--num-tpus", str(args.num_tpus)]
+        if args.block:
+            os.execv(sys.executable, cmd)
+        proc = subprocess.Popen(cmd, start_new_session=True)
+        print(f"node agent started (pid {proc.pid}), joined {args.address}")
+        return
+    if args.block:
+        rt = ray_tpu.init(num_cpus=args.num_cpus, num_tpus=args.num_tpus,
+                          object_store_memory=args.object_store_memory
+                          or None)
+        address = rt.enable_cluster(port=args.port)
+        _write_cluster_files(address, [os.getpid()])
+        print(f"ray_tpu head running at {address}\n"
+              f"connect with: ray_tpu.init(address={address!r})")
+        # `ray_tpu stop` sends SIGTERM: run the clean shutdown (unlinks the
+        # shm arena) instead of dying mid-flight.
+        signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            ray_tpu.shutdown()
+        return
+    # Detach: re-exec ourselves with --block as a session leader. A stale
+    # address file from a crashed head must not be mistaken for the new
+    # head's publication.
+    try:
+        os.unlink(_ADDR_FILE)
+    except FileNotFoundError:
+        pass
+    cmd = [sys.executable, "-m", "ray_tpu", "start", "--head", "--block",
+           "--port", str(args.port),
+           "--num-tpus", str(args.num_tpus)]
+    if args.num_cpus:
+        cmd += ["--num-cpus", str(args.num_cpus)]
+    if args.object_store_memory:
+        cmd += ["--object-store-memory", str(args.object_store_memory)]
+    proc = subprocess.Popen(cmd, start_new_session=True,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    # Wait for the head to publish its address.
+    deadline = time.monotonic() + 120
+    addr = None
+    while time.monotonic() < deadline:
+        try:
+            with open(_ADDR_FILE) as f:
+                addr = f.read().strip()
+            if addr:
+                break
+        except FileNotFoundError:
+            pass
+        if proc.poll() is not None:
+            sys.exit("head process exited during startup")
+        time.sleep(0.1)
+    if not addr:
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+        except OSError:
+            pass
+        sys.exit("head did not publish its address within 120s")
+    _write_cluster_files(addr, [proc.pid])
+    print(f"ray_tpu head started at {addr} (pid {proc.pid})\n"
+          f"stop with: python -m ray_tpu stop")
+
+
+def _cmd_stop(_args):
+    try:
+        with open(_PID_FILE) as f:
+            pids = json.loads(f.read())
+    except FileNotFoundError:
+        print("no recorded head process")
+        return
+    for pid in pids:
+        try:
+            os.killpg(os.getpgid(pid), signal.SIGTERM)
+        except (ProcessLookupError, PermissionError, OSError):
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except OSError:
+                pass
+        print(f"stopped pid {pid}")
+    for p in (_PID_FILE, _ADDR_FILE):
+        try:
+            os.unlink(p)
+        except FileNotFoundError:
+            pass
+
+
+def _cmd_status(args):
+    _connect(args)
+    from ray_tpu.util import state
+    s = state.cluster_status()
+    res = s["resources"]
+    print(f"nodes: {s['nodes']['alive']} alive, {s['nodes']['dead']} dead")
+    print("resources:")
+    for k, total in sorted(res["total"].items()):
+        avail = res["available"].get(k, 0.0)
+        print(f"  {k}: {total - avail:g}/{total:g} used")
+    print(f"pending tasks: {s['pending_tasks']}")
+    if s["actors"]:
+        print("actors:", ", ".join(f"{k}={v}"
+                                   for k, v in sorted(s["actors"].items())))
+    st = s["store"]
+    print(f"object store: {st['allocated'] / 2**20:.1f}/"
+          f"{st['capacity'] / 2**20:.1f} MiB, "
+          f"{st['num_objects']} objects, {st['num_evictions']} evictions")
+
+
+def _print_rows(rows: list[dict], fmt: str):
+    if fmt == "json":
+        print(json.dumps(rows, indent=1, default=repr))
+        return
+    if not rows:
+        print("(empty)")
+        return
+    cols = list(rows[0].keys())
+    widths = {c: max(len(c), *(len(str(r.get(c, ""))) for r in rows))
+              for c in cols}
+    print("  ".join(c.ljust(widths[c]) for c in cols))
+    for r in rows:
+        print("  ".join(str(r.get(c, "")).ljust(widths[c]) for c in cols))
+
+
+def _cmd_list(args):
+    _connect(args)
+    from ray_tpu.util import state
+    fns = {
+        "nodes": state.list_nodes,
+        "workers": state.list_workers,
+        "actors": state.list_actors,
+        "tasks": state.list_tasks,
+        "objects": state.list_objects,
+        "placement-groups": state.list_placement_groups,
+    }
+    if args.entity == "jobs":
+        from ray_tpu.job_submission import JobSubmissionClient
+        rows = [j.to_dict() for j in
+                JobSubmissionClient().list_jobs()]  # via _connect above
+    else:
+        rows = fns[args.entity]()
+    _print_rows(rows, args.format)
+
+
+def _cmd_summary(args):
+    _connect(args)
+    from ray_tpu.util import state
+    out = (state.summarize_tasks() if args.entity == "tasks"
+           else state.summarize_actors())
+    print(json.dumps(out, indent=1, default=repr))
+
+
+def _cmd_timeline(args):
+    _connect(args)
+    import ray_tpu
+    # timeline() is head-only; remote callers get the events via state and
+    # format the chrome trace locally.
+    from ray_tpu.core.runtime import Runtime, get_runtime
+    rt = get_runtime()
+    if isinstance(rt, Runtime):
+        ray_tpu.timeline(args.output)
+    else:
+        rows = rt.request("state", ("tasks", 100000))
+        trace = [{"name": r["name"], "cat": "task", "ph": "i",
+                  "ts": r["ts"] * 1e6, "pid": "ray_tpu",
+                  "tid": r["task_id"][:8], "s": "t"} for r in rows]
+        with open(args.output, "w") as f:
+            json.dump(trace, f)
+    print(f"wrote {args.output}")
+
+
+def _cmd_job(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+    client = JobSubmissionClient(_resolve_address(args))
+    if args.job_cmd == "submit":
+        entry = args.entrypoint
+        if entry and entry[0] == "--":  # argparse REMAINDER keeps the "--"
+            entry = entry[1:]
+        sid = client.submit_job(
+            entrypoint=" ".join(entry),
+            submission_id=args.submission_id or None)
+        print(sid)
+        if args.wait:
+            status = client.get_job_status(sid)
+            while status in ("PENDING", "RUNNING"):
+                time.sleep(0.5)
+                status = client.get_job_status(sid)
+            print(status)
+            print(client.get_job_logs(sid), end="")
+            sys.exit(0 if status == "SUCCEEDED" else 1)
+    elif args.job_cmd == "status":
+        print(client.get_job_status(args.submission_id))
+    elif args.job_cmd == "logs":
+        print(client.get_job_logs(args.submission_id), end="")
+    elif args.job_cmd == "stop":
+        print(client.stop_job(args.submission_id))
+    elif args.job_cmd == "list":
+        _print_rows([j.to_dict() for j in client.list_jobs()], "table")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="ray_tpu", description="ray_tpu cluster CLI")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("start", help="start a head node or join a cluster")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address", help="head host:port to join (non-head)")
+    sp.add_argument("--port", type=int, default=0)
+    sp.add_argument("--num-cpus", type=float, default=None)
+    sp.add_argument("--num-tpus", type=float, default=0)
+    sp.add_argument("--object-store-memory", type=int, default=0)
+    sp.add_argument("--block", action="store_true",
+                    help="run in the foreground")
+    sp.set_defaults(fn=_cmd_start)
+
+    sp = sub.add_parser("stop", help="stop the recorded head node")
+    sp.set_defaults(fn=_cmd_stop)
+
+    for name, fn in (("status", _cmd_status),):
+        sp = sub.add_parser(name)
+        sp.add_argument("--address")
+        sp.set_defaults(fn=fn)
+
+    sp = sub.add_parser("list", help="list cluster entities")
+    sp.add_argument("entity", choices=["nodes", "workers", "actors",
+                                       "tasks", "objects",
+                                       "placement-groups", "jobs"])
+    sp.add_argument("--address")
+    sp.add_argument("--format", choices=["table", "json"], default="table")
+    sp.set_defaults(fn=_cmd_list)
+
+    sp = sub.add_parser("summary", help="summarize tasks/actors")
+    sp.add_argument("entity", choices=["tasks", "actors"])
+    sp.add_argument("--address")
+    sp.set_defaults(fn=_cmd_summary)
+
+    sp = sub.add_parser("timeline", help="export a chrome trace")
+    sp.add_argument("--output", "-o", default="timeline.json")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=_cmd_timeline)
+
+    sp = sub.add_parser("job", help="job submission")
+    jsub = sp.add_subparsers(dest="job_cmd", required=True)
+    j = jsub.add_parser("submit")
+    j.add_argument("--address")
+    j.add_argument("--submission-id", default="")
+    j.add_argument("--wait", action="store_true")
+    j.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    j.set_defaults(fn=_cmd_job)
+    for name in ("status", "logs", "stop"):
+        j = jsub.add_parser(name)
+        j.add_argument("submission_id")
+        j.add_argument("--address")
+        j.set_defaults(fn=_cmd_job)
+    j = jsub.add_parser("list")
+    j.add_argument("--address")
+    j.set_defaults(fn=_cmd_job)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

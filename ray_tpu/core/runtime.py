@@ -969,6 +969,24 @@ class Runtime:
             resp = self.kv_incr(arg)
         elif what == "kv_keys":
             resp = self.kv_keys(arg)
+        elif what == "state":
+            # Heavy queries (100k-row task lists) must not stall the
+            # listener thread — compute + pickle the reply off-thread
+            # (same rule as the spill branch below).
+            def state_and_reply(arg=arg, w=w, req_id=req_id):
+                from ray_tpu.util.state import _dispatch
+                kind, sarg = arg
+                try:
+                    resp = _dispatch(self, kind, sarg)
+                except Exception as e:  # noqa: BLE001 — report, don't die
+                    resp = RayTpuError(f"state query {kind!r} failed: {e}")
+                try:
+                    w.send(("resp", req_id, resp))
+                except OSError:
+                    pass
+
+            threading.Thread(target=state_and_reply, daemon=True).start()
+            return
         elif what == "spill":
             # Only head-node workers share the head's arena; a remote
             # worker's store is its agent's (arena LRU eviction applies).

@@ -41,8 +41,15 @@ class JobSupervisor:
     """One per job; owns the entrypoint subprocess."""
 
     def __init__(self, submission_id: str, entrypoint: str,
-                 runtime_env: dict | None, log_path: str):
+                 runtime_env: dict | None, log_path: str = ""):
         import subprocess
+        if not log_path:
+            # Client-mode submitters have no head session dir; the
+            # supervisor picks a stable per-job path on its own node.
+            import tempfile
+            d = os.path.join(tempfile.gettempdir(), "ray_tpu_job_logs")
+            os.makedirs(d, exist_ok=True)
+            log_path = os.path.join(d, f"job-{submission_id}.log")
         self.submission_id = submission_id
         self.entrypoint = entrypoint
         self.log_path = log_path
@@ -105,19 +112,36 @@ class JobSubmissionClient:
     reference's REST head fronts JobManager)."""
 
     def __init__(self, address: str | None = None):
-        from ray_tpu.core.runtime import get_runtime
-        self._rt = get_runtime()  # job table = the head KV ("job", id) rows
+        # Works from the head driver AND from remote clients: the job
+        # table lives in the head KV under "job:<id>" string keys.
+        if ray_tpu.is_initialized():
+            if address is not None:
+                raise ValueError(
+                    "this process is already connected to a cluster; omit "
+                    "`address` (jobs go to the connected cluster) or create "
+                    "the client in a fresh process")
+        elif address is not None:
+            ray_tpu.init(address=address)
+        else:
+            raise RuntimeError(
+                "no cluster connection: call ray_tpu.init(...) first or "
+                "pass JobSubmissionClient(address='host:port')")
 
     def submit_job(self, *, entrypoint: str, submission_id: str | None = None,
                    runtime_env: dict | None = None) -> str:
+        from ray_tpu.core.runtime import Runtime, get_runtime
+        from ray_tpu.experimental.internal_kv import _internal_kv_put
         submission_id = submission_id or f"raysubmit_{uuid.uuid4().hex[:16]}"
-        log_dir = os.path.join(self._rt.session_dir, "logs")
-        log_path = os.path.join(log_dir, f"job-{submission_id}.log")
+        rt = get_runtime()
+        log_path = ""
+        if isinstance(rt, Runtime):
+            log_dir = os.path.join(rt.session_dir, "logs")
+            log_path = os.path.join(log_dir, f"job-{submission_id}.log")
         sup_cls = ray_tpu.remote(num_cpus=0)(JobSupervisor)
         actor = sup_cls.options(name=f"_job_supervisor:{submission_id}").remote(
             submission_id, entrypoint, runtime_env, log_path)
         ray_tpu.get(actor.status.remote(), timeout=60)  # started
-        self._rt.kv[("job", submission_id)] = entrypoint.encode()
+        _internal_kv_put(f"job:{submission_id}", entrypoint.encode())
         return submission_id
 
     def _supervisor(self, submission_id: str):
@@ -132,7 +156,8 @@ class JobSubmissionClient:
         return st["status"]
 
     def get_job_info(self, submission_id: str) -> JobDetails:
-        entry = self._rt.kv.get(("job", submission_id), b"").decode()
+        from ray_tpu.experimental.internal_kv import _internal_kv_get
+        entry = (_internal_kv_get(f"job:{submission_id}") or b"").decode()
         try:
             st = ray_tpu.get(
                 self._supervisor(submission_id).status.remote(), timeout=60)
@@ -156,13 +181,15 @@ class JobSubmissionClient:
             ray_tpu.kill(self._supervisor(submission_id))
         except ValueError:
             pass
-        self._rt.kv.pop(("job", submission_id), None)
+        from ray_tpu.experimental.internal_kv import _internal_kv_del
+        _internal_kv_del(f"job:{submission_id}")
 
     def list_jobs(self) -> list[JobDetails]:
+        from ray_tpu.experimental.internal_kv import _internal_kv_list
         out = []
-        for key in list(self._rt.kv):
-            if isinstance(key, tuple) and key[0] == "job":
-                out.append(self.get_job_info(key[1]))
+        for key in _internal_kv_list("job:"):
+            key = key.decode() if isinstance(key, bytes) else key
+            out.append(self.get_job_info(key.split(":", 1)[1]))
         return out
 
     def tail_job_logs(self, submission_id: str):

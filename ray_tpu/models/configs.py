@@ -39,3 +39,27 @@ def bench_125m(**kw) -> ModelConfig:
 def llama_125m(**kw) -> ModelConfig:
     """Default serving scale (alias of the bench geometry)."""
     return bench_125m(**kw)
+
+
+def llama3_70b(**kw) -> ModelConfig:
+    """Llama-3-70B geometry (multi-slice FSDP+TP target)."""
+    return ModelConfig(vocab=128256, d_model=8192, n_layers=80, n_heads=64,
+                       n_kv_heads=8, d_ff=28672, rope_theta=500000.0,
+                       dtype="bfloat16", remat=True, **kw)
+
+
+def mixtral_8x7b(**kw) -> ModelConfig:
+    """Mixtral-8x7B geometry: 8-expert top-2 MoE (the EP mesh-axis
+    flagship)."""
+    return ModelConfig(vocab=32000, d_model=4096, n_layers=32, n_heads=32,
+                       n_kv_heads=8, d_ff=14336, rope_theta=1e6,
+                       moe_experts=8, moe_top_k=2,
+                       dtype="bfloat16", remat=True, **kw)
+
+
+def qwen2_7b(**kw) -> ModelConfig:
+    """Qwen-2-7B-class geometry (GQA, untied head)."""
+    return ModelConfig(vocab=152064, d_model=3584, n_layers=28, n_heads=28,
+                       n_kv_heads=4, d_ff=18944, rope_theta=1e6,
+                       dtype="bfloat16", remat=True, tie_embeddings=False,
+                       **kw)

@@ -4,7 +4,8 @@ Parity: reference `python/ray/util/state/` (`ray list
 tasks/actors/objects/nodes/workers`, `ray summary tasks` — backed by
 `state_manager.py:107` fanning out to GCS + agents). Here the head runtime
 IS the control plane, so listing reads its tables directly; remote callers
-go through the worker request channel.
+(workers, `ray_tpu.init(address=...)` clients, the CLI) go through the
+head's request channel ("state" op).
 """
 
 from __future__ import annotations
@@ -12,20 +13,68 @@ from __future__ import annotations
 import time
 
 
-def _rt():
+def _query(kind: str, arg=None):
     from ray_tpu.core.runtime import Runtime, get_runtime
     rt = get_runtime()
-    if not isinstance(rt, Runtime):
-        raise RuntimeError("the state API runs on the driver (head) process")
-    return rt
+    if isinstance(rt, Runtime):
+        return _dispatch(rt, kind, arg)
+    return rt.request("state", (kind, arg))
+
+
+def _dispatch(rt, kind: str, arg=None):
+    """Head-side execution of a state query (also invoked by the head's
+    request handler for remote callers)."""
+    fn = _HANDLERS[kind]
+    return fn(rt) if arg is None else fn(rt, arg)
 
 
 def list_nodes() -> list[dict]:
-    return _rt().nodes_table()
+    return _query("nodes")
 
 
 def list_workers() -> list[dict]:
-    rt = _rt()
+    return _query("workers")
+
+
+def list_actors() -> list[dict]:
+    return _query("actors")
+
+
+def list_tasks(limit: int = 1000) -> list[dict]:
+    """Recent task state transitions, newest last (backed by the head's
+    task-event ring, parity: gcs_task_manager.h:94 bounded storage)."""
+    return _query("tasks", limit)
+
+
+def list_objects(limit: int = 1000) -> list[dict]:
+    return _query("objects", limit)
+
+
+def list_placement_groups() -> list[dict]:
+    return _query("placement_groups")
+
+
+def summarize_tasks() -> dict:
+    return _query("summarize_tasks")
+
+
+def summarize_actors() -> dict:
+    return _query("summarize_actors")
+
+
+def cluster_status() -> dict:
+    """One-call overview (what `ray status` prints)."""
+    return _query("status")
+
+
+# ---- head-side implementations ----
+
+
+def _nodes(rt) -> list[dict]:
+    return rt.nodes_table()
+
+
+def _workers(rt) -> list[dict]:
     out = []
     for wid, w in list(rt.workers.items()):
         out.append({
@@ -38,8 +87,7 @@ def list_workers() -> list[dict]:
     return out
 
 
-def list_actors() -> list[dict]:
-    rt = _rt()
+def _actors(rt) -> list[dict]:
     registered = {aid: name for name, aid in rt.named_actors.items()}
     out = []
     for aid, st in list(rt.actors.items()):
@@ -55,10 +103,7 @@ def list_actors() -> list[dict]:
     return out
 
 
-def list_tasks(limit: int = 1000) -> list[dict]:
-    """Recent task state transitions, newest last (backed by the head's
-    task-event ring, parity: gcs_task_manager.h:94 bounded storage)."""
-    rt = _rt()
+def _tasks(rt, limit: int = 1000) -> list[dict]:
     latest: dict[bytes, dict] = {}
     for ts, task_id, name, state in rt.task_events.snapshot():
         latest[task_id] = {"task_id": task_id.hex(), "name": name,
@@ -67,8 +112,7 @@ def list_tasks(limit: int = 1000) -> list[dict]:
     return rows[-limit:]
 
 
-def list_objects(limit: int = 1000) -> list[dict]:
-    rt = _rt()
+def _objects(rt, limit: int = 1000) -> list[dict]:
     out = []
     with rt.directory.lock:
         items = list(rt.directory.entries.items())[:limit]
@@ -82,31 +126,27 @@ def list_objects(limit: int = 1000) -> list[dict]:
     return out
 
 
-def list_placement_groups() -> list[dict]:
-    rt = _rt()
+def _placement_groups(rt) -> list[dict]:
     table = rt.placement_group_table()
     return [{"placement_group_id": pg_id, **row}
             for pg_id, row in table.items()]
 
 
-def summarize_tasks() -> dict:
-    rt = _rt()
+def _summarize_tasks(rt) -> dict:
     by_state: dict[str, int] = {}
-    for row in list_tasks(limit=100000):
+    for row in _tasks(rt, limit=100000):
         by_state[row["state"]] = by_state.get(row["state"], 0) + 1
     return {"by_state": by_state, "by_name": rt.task_events.summary()}
 
 
-def summarize_actors() -> dict:
+def _summarize_actors(rt) -> dict:
     by_state: dict[str, int] = {}
-    for row in list_actors():
+    for row in _actors(rt):
         by_state[row["state"]] = by_state.get(row["state"], 0) + 1
     return {"by_state": by_state}
 
 
-def cluster_status() -> dict:
-    """One-call overview (what `ray status` prints)."""
-    rt = _rt()
+def _status(rt) -> dict:
     return {
         "timestamp": time.time(),
         "nodes": {"alive": sum(1 for n in rt.nodes_table() if n["alive"]),
@@ -115,6 +155,19 @@ def cluster_status() -> dict:
         "resources": {"total": rt.cluster_resources(),
                       "available": rt.available_resources()},
         "pending_tasks": len(rt.task_queue),
-        "actors": summarize_actors()["by_state"],
+        "actors": _summarize_actors(rt)["by_state"],
         "store": rt.store.stats(),
     }
+
+
+_HANDLERS = {
+    "nodes": _nodes,
+    "workers": _workers,
+    "actors": _actors,
+    "tasks": _tasks,
+    "objects": _objects,
+    "placement_groups": _placement_groups,
+    "summarize_tasks": _summarize_tasks,
+    "summarize_actors": _summarize_actors,
+    "status": _status,
+}

@@ -5,6 +5,7 @@ Parity: reference tests for util/state, dashboard modules/job, and
 test_autoscaler_fake_multinode.py."""
 
 import json
+import os
 import time
 import urllib.request
 
@@ -165,3 +166,54 @@ def test_autoscaler_scales_up_and_down():
     finally:
         scaler.stop()
         ray_tpu.shutdown()
+
+
+def test_cli_end_to_end(tmp_path):
+    """ray_tpu start --head / status / list / job submit / stop (parity:
+    the reference's `ray start` + state CLI + `ray job` smoke tests)."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    # Isolated cluster files: the test must never stop a real cluster on
+    # this machine (or race a concurrent test run).
+    state_dir = str(tmp_path / "cli_state")
+    env["RAY_TPU_STATE_DIR"] = state_dir
+    addr_file = os.path.join(state_dir, "ray_current_address")
+
+    def cli(*args, timeout=120):
+        return subprocess.run(
+            [sys.executable, "-m", "ray_tpu", *args],
+            capture_output=True, text=True, env=env, timeout=timeout)
+
+    r = cli("start", "--head", "--num-cpus", "2")
+    try:
+        assert r.returncode == 0, r.stderr
+        assert "started at" in r.stdout
+        address = open(addr_file).read().strip()
+        assert ":" in address
+
+        r = cli("status", "--address", address)
+        assert r.returncode == 0, r.stderr
+        assert "nodes: 1 alive" in r.stdout
+        assert "CPU" in r.stdout
+
+        r = cli("list", "nodes", "--address", address, "--format", "json")
+        assert r.returncode == 0, r.stderr
+        rows = json.loads(r.stdout)
+        assert len(rows) == 1
+
+        r = cli("job", "submit", "--address", address, "--wait", "--",
+                "python -c 'print(6*7)'")
+        assert r.returncode == 0, r.stderr + r.stdout
+        assert "42" in r.stdout and "SUCCEEDED" in r.stdout
+
+        r = cli("list", "jobs", "--address", address, "--format", "json")
+        assert r.returncode == 0, r.stderr
+        assert len(json.loads(r.stdout)) == 1
+    finally:
+        r = cli("stop")
+        assert "stopped pid" in r.stdout or "no recorded" in r.stdout
