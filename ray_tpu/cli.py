@@ -136,8 +136,15 @@ def _cmd_stop(_args):
         print("no recorded head process")
         return
     for pid in pids:
+        # Only kill a whole process group the CLI itself created (the
+        # detached head runs as its own session leader, pgid == pid). A
+        # foreground `--block` head inherits the user's group — killing
+        # that group would take the user's script down with it.
         try:
-            os.killpg(os.getpgid(pid), signal.SIGTERM)
+            if os.getpgid(pid) == pid:
+                os.killpg(pid, signal.SIGTERM)
+            else:
+                os.kill(pid, signal.SIGTERM)
         except (ProcessLookupError, PermissionError, OSError):
             try:
                 os.kill(pid, signal.SIGTERM)
@@ -216,20 +223,16 @@ def _cmd_summary(args):
 
 def _cmd_timeline(args):
     _connect(args)
-    import ray_tpu
-    # timeline() is head-only; remote callers get the events via state and
-    # format the chrome trace locally.
-    from ray_tpu.core.runtime import Runtime, get_runtime
-    rt = get_runtime()
-    if isinstance(rt, Runtime):
-        ray_tpu.timeline(args.output)
-    else:
-        rows = rt.request("state", ("tasks", 100000))
-        trace = [{"name": r["name"], "cat": "task", "ph": "i",
-                  "ts": r["ts"] * 1e6, "pid": "ray_tpu",
-                  "tid": r["task_id"][:8], "s": "t"} for r in rows]
-        with open(args.output, "w") as f:
-            json.dump(trace, f)
+    from ray_tpu.util import state
+    # The CLI is a remote client: fetch the event rows through the state
+    # API and format instant events locally (the head-side ray_tpu.timeline
+    # pairs RUNNING/FINISHED, which needs the raw multi-event stream).
+    rows = state.list_tasks(limit=100000)
+    trace = [{"name": r["name"], "cat": "task", "ph": "i",
+              "ts": r["ts"] * 1e6, "pid": "ray_tpu",
+              "tid": r["task_id"][:8], "s": "t"} for r in rows]
+    with open(args.output, "w") as f:
+        json.dump(trace, f)
     print(f"wrote {args.output}")
 
 

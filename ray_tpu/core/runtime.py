@@ -890,6 +890,11 @@ class Runtime:
         op = msg[0]
         if op == "done":
             self._on_task_done(w, msg[1], msg[2], msg[3])
+        elif op == "done_batch":
+            # Coalesced replies from a pipelined sync actor (worker-side
+            # _flush_replies): one frame, many task completions.
+            for task_id, actor_id, outs in msg[1]:
+                self._on_task_done(w, task_id, actor_id, outs)
         elif op == "ready":
             w.connected.set()
             with self.lock:

@@ -379,7 +379,11 @@ def _reply_cancelled(rt: WorkerRuntime, spec: TaskSpec):
         spec.describe()))
 
 
-def _reply_result(rt: WorkerRuntime, spec: TaskSpec, status, result):
+def _reply_result(rt: WorkerRuntime, spec: TaskSpec, status, result,
+                  batcher: "_ReplyBatcher | None" = None):
+    """Report task results. With `batcher`, the reply rides the coalescing
+    flusher (one "done_batch" frame per burst of pipelined actor calls)
+    instead of its own frame."""
     cfg = get_config()
     n_returns = len(spec.return_ids)
     if status == "ok" and n_returns > 1:
@@ -391,20 +395,81 @@ def _reply_result(rt: WorkerRuntime, spec: TaskSpec, status, result):
                 spec.describe())
     if status == "err":
         payload, bufs, _ = serialization.serialize_value(result)
-        rt.send(("done", spec.task_id, spec.actor_id,
-                 [(rid, "err", payload, bufs) for rid in spec.return_ids]))
+        outs = [(rid, "err", payload, bufs) for rid in spec.return_ids]
+    else:
+        values = results if n_returns > 1 else [result]
+        outs = []
+        for rid, value in zip(spec.return_ids, values):
+            payload, bufs, _ = serialization.serialize_value(value)
+            nbytes = serialization.total_nbytes(payload, bufs)
+            if nbytes <= cfg.max_inline_object_bytes:
+                outs.append((rid, "inline", payload, bufs))
+            else:
+                _put_with_spill(rt, ObjectID(rid), value, nbytes)
+                outs.append((rid, "shm", None, None))
+    if batcher is not None:
+        batcher.add(spec.task_id, spec.actor_id, outs)
         return
-    values = results if n_returns > 1 else [result]
-    outs = []
-    for rid, value in zip(spec.return_ids, values):
-        payload, bufs, _ = serialization.serialize_value(value)
-        nbytes = serialization.total_nbytes(payload, bufs)
-        if nbytes <= cfg.max_inline_object_bytes:
-            outs.append((rid, "inline", payload, bufs))
-        else:
-            _put_with_spill(rt, ObjectID(rid), value, nbytes)
-            outs.append((rid, "shm", None, None))
     rt.send(("done", spec.task_id, spec.actor_id, outs))
+
+
+class _ReplyBatcher:
+    """Coalesces sync-actor completion frames with a BOUNDED delay.
+
+    A burst of pipelined fast calls flushes as one "done_batch"; a result
+    never waits on the NEXT call's execution (the flusher thread sends it
+    within `max_delay` regardless) and flushes immediately when the task
+    queue is drained — so get(timeout)/wait progress semantics hold even
+    when a slow call sits behind a fast one."""
+
+    def __init__(self, rt: WorkerRuntime, max_delay: float = 0.001,
+                 max_batch: int = 64):
+        self.rt = rt
+        self.max_delay = max_delay
+        self.max_batch = max_batch
+        self._cv = threading.Condition()
+        self._batch: list = []
+        self._urgent = False
+        threading.Thread(target=self._loop, daemon=True,
+                         name="rtpu-reply-flush").start()
+
+    def add(self, task_id, actor_id, outs):
+        with self._cv:
+            self._batch.append((task_id, actor_id, outs))
+            if (len(self._batch) >= self.max_batch
+                    or self.rt.task_queue.empty()):
+                self._urgent = True
+            self._cv.notify()
+
+    def flush_now(self):
+        with self._cv:
+            self._urgent = True
+            self._cv.notify()
+
+    def _send(self, batch: list):
+        if len(batch) == 1:
+            task_id, actor_id, outs = batch[0]
+            self.rt.send(("done", task_id, actor_id, outs))
+        else:
+            self.rt.send(("done_batch", batch))
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                while not self._batch:
+                    self._urgent = False
+                    self._cv.wait()
+                if not self._urgent:
+                    # Let a burst accumulate, but never longer than
+                    # max_delay past the first pending reply.
+                    self._cv.wait(self.max_delay)
+                batch = self._batch
+                self._batch = []
+                self._urgent = False
+            try:
+                self._send(batch)
+            except OSError:
+                return  # head gone; the worker is about to exit anyway
 
 
 async def _execute_async(rt, spec, fn):
@@ -688,10 +753,13 @@ def _worker_main(store_path: str, worker_id: WorkerID, fd: int):
 
     # Main executor loop. Plain workers and sync actors execute inline;
     # threaded actors fan out to a pool; async actors switch to asyncio.
+    # Sync actor replies coalesce through the bounded-delay _ReplyBatcher.
     pool: concurrent.futures.ThreadPoolExecutor | None = None
+    batcher = _ReplyBatcher(rt)
     while not rt.shutdown.is_set():
         item = rt.task_queue.get()
         if item is None:
+            batcher.flush_now()
             break
         if isinstance(item, tuple) and item[0] == "__create_actor__":
             cspec = create_actor(item[1])
@@ -727,7 +795,11 @@ def _worker_main(store_path: str, worker_id: WorkerID, fd: int):
             pool.submit(run)
         else:
             status, result = _execute(rt, spec, fn)
-            _reply_result(rt, spec, status, result)
+            # Plain tasks reply directly: the scheduler leases one task at
+            # a time and waits for the done to re-idle this worker.
+            _reply_result(rt, spec, status, result,
+                          batcher=batcher if spec.actor_id is not None
+                          else None)
 
     os._exit(0)
 
