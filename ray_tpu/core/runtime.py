@@ -945,6 +945,12 @@ class Runtime:
                     and (not prefix or _kv_key_bytes(k).startswith(
                         _kv_key_bytes(prefix)))]
 
+    def kv_take(self, key):
+        """Atomic get+delete: exactly one caller consumes a one-shot value
+        (the primitive behind workflow event consumption)."""
+        with self.lock:
+            return self.kv.pop(key, None)
+
     def kv_incr(self, key) -> int:
         """Atomic counter increment (serialized by the head lock); the
         primitive behind barriers/rendezvous — a get-then-put from N workers
@@ -972,6 +978,8 @@ class Runtime:
             resp = True
         elif what == "kv_incr":
             resp = self.kv_incr(arg)
+        elif what == "kv_take":
+            resp = self.kv_take(arg)
         elif what == "kv_keys":
             resp = self.kv_keys(arg)
         elif what == "state":

@@ -442,9 +442,18 @@ class _ReplyBatcher:
             self._cv.notify()
 
     def flush_now(self):
+        """Synchronous drain — used at shutdown, where waking the daemon
+        flusher would race os._exit. Entries are popped under the lock, so
+        a concurrent flusher pass and this call each send disjoint sets."""
         with self._cv:
-            self._urgent = True
-            self._cv.notify()
+            batch = self._batch
+            self._batch = []
+            self._urgent = False
+        if batch:
+            try:
+                self._send(batch)
+            except OSError:
+                pass
 
     def _send(self, batch: list):
         if len(batch) == 1:

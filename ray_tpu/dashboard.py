@@ -59,18 +59,70 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json([j.to_dict()
                             for j in job_submission.list_jobs()])
             elif path == "/":
-                body = ("<html><body><h2>ray_tpu dashboard</h2><ul>" +
-                        "".join(f'<li><a href="{r}">{r}</a></li>' for r in (
-                            "/api/cluster_status", "/api/nodes",
-                            "/api/actors", "/api/tasks", "/api/objects",
-                            "/api/workers", "/api/placement_groups",
-                            "/api/jobs", "/metrics")) +
-                        "</ul></body></html>").encode()
-                self._send(200, body, "text/html")
+                self._send(200, _INDEX_HTML, "text/html")
             else:
                 self._send(404, b"not found", "text/plain")
         except Exception as e:  # noqa: BLE001 — a broken route must not
             self._send(500, str(e).encode(), "text/plain")
+
+
+# Single-file frontend (parity role: dashboard/client React app, at the
+# scale this dashboard needs): fetches the JSON routes and renders a live
+# overview + tables, refreshing every 2s.
+_INDEX_HTML = b"""<!doctype html>
+<html><head><title>ray_tpu dashboard</title><style>
+ body{font-family:system-ui,sans-serif;margin:1.5rem;background:#fafafa}
+ h1{font-size:1.2rem} h2{font-size:1rem;margin:1.2rem 0 .4rem}
+ table{border-collapse:collapse;font-size:.85rem;background:#fff}
+ td,th{border:1px solid #ddd;padding:.25rem .6rem;text-align:left}
+ th{background:#f0f0f0} .cards{display:flex;gap:1rem;flex-wrap:wrap}
+ .card{background:#fff;border:1px solid #ddd;border-radius:6px;
+       padding:.6rem 1rem;min-width:8rem}
+ .card b{display:block;font-size:1.3rem} .muted{color:#888;font-size:.8rem}
+</style></head><body>
+<h1>ray_tpu dashboard</h1><div class=cards id=cards></div>
+<h2>Nodes</h2><table id=nodes></table>
+<h2>Actors</h2><table id=actors></table>
+<h2>Recent tasks</h2><table id=tasks></table>
+<h2>Jobs</h2><table id=jobs></table>
+<p class=muted>raw: <a href=/api/cluster_status>/api/cluster_status</a>
+ <a href=/api/nodes>/api/nodes</a> <a href=/api/actors>/api/actors</a>
+ <a href=/api/tasks>/api/tasks</a> <a href=/api/objects>/api/objects</a>
+ <a href=/api/workers>/api/workers</a>
+ <a href=/api/placement_groups>/api/placement_groups</a>
+ <a href=/api/jobs>/api/jobs</a> <a href=/metrics>/metrics</a></p>
+<script>
+function esc(s){return String(s).replace(/&/g,'&amp;').replace(/</g,'&lt;')
+  .replace(/>/g,'&gt;').replace(/"/g,'&quot;')}
+function table(el, rows){
+  if(!rows.length){el.innerHTML='<tr><td class=muted>(empty)</td></tr>';return}
+  const cols=Object.keys(rows[0]);
+  el.innerHTML='<tr>'+cols.map(c=>'<th>'+esc(c)+'</th>').join('')+'</tr>'+
+    rows.map(r=>'<tr>'+cols.map(c=>'<td>'+esc(JSON.stringify(r[c]))+'</td>')
+    .join('')+'</tr>').join('');
+}
+async function j(p){return (await fetch(p)).json()}
+async function refresh(){
+  try{
+    const s=await j('/api/cluster_status');
+    const used=k=>((s.resources.total[k]||0)-(s.resources.available[k]||0));
+    document.getElementById('cards').innerHTML=
+      '<div class=card><b>'+s.nodes.alive+'</b>nodes alive</div>'+
+      '<div class=card><b>'+used('CPU')+'/'+(s.resources.total.CPU||0)+
+        '</b>CPUs used</div>'+
+      '<div class=card><b>'+used('TPU')+'/'+(s.resources.total.TPU||0)+
+        '</b>TPUs used</div>'+
+      '<div class=card><b>'+s.pending_tasks+'</b>pending tasks</div>'+
+      '<div class=card><b>'+(s.store.num_objects||0)+'</b>objects ('+
+        Math.round((s.store.allocated||0)/1048576)+' MiB)</div>';
+    table(document.getElementById('nodes'), await j('/api/nodes'));
+    table(document.getElementById('actors'), await j('/api/actors'));
+    table(document.getElementById('tasks'), (await j('/api/tasks')).slice(-20).reverse());
+    table(document.getElementById('jobs'), await j('/api/jobs'));
+  }catch(e){console.log(e)}
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
 
 
 _server = None

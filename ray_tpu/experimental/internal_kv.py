@@ -63,6 +63,15 @@ def _internal_kv_del(key):
         rt.request("kv_del", key)
 
 
+def _internal_kv_take(key):
+    """Atomic get+delete; returns None if absent (exactly one of N
+    concurrent callers receives a present value)."""
+    rt = _rt()
+    if _is_head(rt):
+        return rt.kv_take(key)
+    return rt.request("kv_take", key)
+
+
 def _internal_kv_list(prefix=b"") -> list:
     rt = _rt()
     if _is_head(rt):

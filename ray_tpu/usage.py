@@ -1,0 +1,55 @@
+"""Usage stats: opt-out, local-only recording.
+
+Parity: reference `python/ray/_private/usage/usage_lib.py` — the reference
+collects cluster metadata and (unless RAY_USAGE_STATS_ENABLED=0) reports
+it to a telemetry endpoint. This environment is zero-egress by design, so
+the equivalent records the same shape of report to the session directory
+only; `usage_stats_enabled()` honors the same opt-out env var.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+_ENV = "RAY_TPU_USAGE_STATS_ENABLED"
+
+
+def usage_stats_enabled() -> bool:
+    return os.environ.get(_ENV, "1") not in ("0", "false", "False")
+
+
+def build_report(rt) -> dict:
+    """The reference's report shape: versions, cluster size, library use."""
+    import sys
+    report = {
+        "schema_version": "0.1",
+        "source": "ray_tpu",
+        "session_start": time.time(),
+        "python_version": sys.version.split()[0],
+        "os": os.uname().sysname.lower(),
+        "total_num_cpus": rt.cluster_resources().get("CPU", 0),
+        "total_num_tpus": rt.cluster_resources().get("TPU", 0),
+        "num_nodes": sum(1 for n in rt.nodes_table() if n["alive"]),
+    }
+    try:
+        import jax
+        report["jax_version"] = jax.__version__
+    except ImportError:
+        pass
+    return report
+
+
+def record_usage(rt):
+    """Write the report under the session dir (no egress); no-op when the
+    user opted out."""
+    if not usage_stats_enabled():
+        return None
+    path = os.path.join(rt.session_dir, "usage_stats.json")
+    try:
+        with open(path, "w") as f:
+            json.dump(build_report(rt), f, indent=1)
+        return path
+    except OSError:
+        return None

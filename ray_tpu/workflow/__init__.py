@@ -256,3 +256,69 @@ def list_all() -> list[tuple[str, str]]:
 def delete(workflow_id: str):
     import shutil
     shutil.rmtree(os.path.join(_store(), workflow_id), ignore_errors=True)
+
+
+# ---------------- events (parity: workflow/event_listener.py) ----------------
+
+
+class EventListener:
+    """Pluggable external-event source: subclass and implement
+    `poll_for_event` (parity: workflow.wait_for_event's EventListener —
+    the reference awaits it on the event loop; here it polls in the step's
+    worker until an event arrives)."""
+
+    def poll_for_event(self, *args, **kwargs):
+        raise NotImplementedError
+
+
+class KVEventListener(EventListener):
+    """Default listener: waits for a key in the head KV (the in-cluster
+    analogue of the reference's HTTP event hook — publish with
+    `workflow.publish_event(key, value)` from anywhere)."""
+
+    def poll_for_event(self, key, poll_interval_s: float = 0.1):
+        from ray_tpu.experimental.internal_kv import _internal_kv_take
+        while True:
+            # Atomic take: with several waiters on one key, exactly one
+            # consumes each published event (get-then-delete would let two
+            # waiters race — one double-consume, one hung).
+            v = _internal_kv_take(f"__wf_event__:{key}")
+            if v is not None:
+                return pickle.loads(v)
+            time.sleep(poll_interval_s)
+
+
+def publish_event(key: str, value=None):
+    """Fire an event that a wait_for_event step is (or will be) polling."""
+    from ray_tpu.experimental.internal_kv import _internal_kv_put
+    _internal_kv_put(f"__wf_event__:{key}", pickle.dumps(value))
+
+
+def wait_for_event(listener_cls=KVEventListener, *args, **kwargs):
+    """A workflow step that completes when the listener observes its event;
+    the event VALUE is the step result (durably stored like any step, so a
+    resumed workflow does not re-await an already-received event).
+
+    Listener args must be concrete values: they ride nested inside the
+    step's payload, where upstream FunctionNode outputs cannot be
+    substituted."""
+    import ray_tpu as _rt
+
+    for v in (*args, *kwargs.values()):
+        if isinstance(v, FunctionNode):
+            raise ValueError(
+                "wait_for_event listener args must be concrete values, not "
+                "workflow steps — compute the value first and pass it via "
+                "publish_event, or restructure the DAG so the event gate "
+                "runs before the dependent step")
+
+    @_rt.remote
+    def _await_event(cls_blob, a, kw):
+        import cloudpickle
+        listener = cloudpickle.loads(cls_blob)()
+        return listener.poll_for_event(*a, **kw)
+
+    import cloudpickle
+    _await_event.__name__ = "wait_for_event"  # stable step-id fingerprint
+    return FunctionNode(_await_event,
+                        (cloudpickle.dumps(listener_cls), args, kwargs), {})

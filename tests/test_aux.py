@@ -165,3 +165,17 @@ def test_internal_kv_from_worker(ray_start_regular):
     got, keys = ray_tpu.get(put_and_list.remote(), timeout=60)
     assert got == b"99"
     assert keys == [b"wk:x"]
+
+
+def test_internal_kv_take_atomic(ray_start_regular):
+    from ray_tpu.experimental import internal_kv as kv
+
+    kv._internal_kv_put(b"take:one", b"v")
+
+    @ray_tpu.remote
+    def taker():
+        from ray_tpu.experimental.internal_kv import _internal_kv_take
+        return _internal_kv_take(b"take:one")
+
+    results = ray_tpu.get([taker.remote() for _ in range(4)], timeout=60)
+    assert sorted(r for r in results if r is not None) == [b"v"]

@@ -217,3 +217,30 @@ def test_cli_end_to_end(tmp_path):
     finally:
         r = cli("stop")
         assert "stopped pid" in r.stdout or "no recorded" in r.stdout
+
+
+def test_usage_stats_recording(tooling_cluster):
+    from ray_tpu import usage
+
+    assert usage.usage_stats_enabled()
+    path = usage.record_usage(tooling_cluster)
+    assert path and os.path.exists(path)
+    report = json.load(open(path))
+    assert report["total_num_cpus"] == 2.0
+    assert report["num_nodes"] == 1
+    os.environ["RAY_TPU_USAGE_STATS_ENABLED"] = "0"
+    try:
+        assert not usage.usage_stats_enabled()
+        assert usage.record_usage(tooling_cluster) is None
+    finally:
+        os.environ.pop("RAY_TPU_USAGE_STATS_ENABLED")
+
+
+def test_dashboard_index_page(tooling_cluster):
+    from ray_tpu.dashboard import start_dashboard
+
+    addr = start_dashboard()
+    with urllib.request.urlopen(f"http://{addr}/", timeout=10) as r:
+        body = r.read().decode()
+    assert "ray_tpu dashboard" in body
+    assert "/api/cluster_status" in body

@@ -7,6 +7,8 @@ import os
 
 import pytest
 
+import time
+
 import ray_tpu
 from ray_tpu import workflow
 
@@ -83,3 +85,41 @@ def test_resume_of_successful_workflow_returns_output(ray_start_regular):
 
     workflow.delete("w4")
     assert workflow.get_status("w4") == "NOT_FOUND"
+
+
+def test_wait_for_event(ray_start_regular):
+    """A wait_for_event step blocks until publish_event fires; the event
+    value becomes the step result and persists like any step."""
+    import threading
+
+    from ray_tpu import workflow
+
+    @ray_tpu.remote
+    def add_one(x):
+        return x + 1
+
+    workflow.init()
+    dag = add_one.bind(workflow.wait_for_event(
+        workflow.KVEventListener, "evt-key"))
+
+    def fire():
+        time.sleep(0.5)
+        workflow.publish_event("evt-key", 41)
+
+    threading.Thread(target=fire, daemon=True).start()
+    wid = f"wf-evt-{int(time.time()*1000):x}"
+    assert workflow.run(dag, workflow_id=wid) == 42
+    # resume replays from storage without re-awaiting the event
+    assert workflow.resume(wid) == 42
+
+
+def test_custom_event_listener(ray_start_regular):
+    from ray_tpu import workflow
+
+    class Immediate(workflow.EventListener):
+        def poll_for_event(self, v):
+            return v * 2
+
+    workflow.init()
+    dag = workflow.wait_for_event(Immediate, 21)
+    assert workflow.run(dag) == 42
