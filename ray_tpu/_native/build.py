@@ -19,25 +19,33 @@ _lock = threading.Lock()
 _loaded: dict[str, ctypes.CDLL] = {}
 
 
-def _source_hash(src_path: str) -> str:
-    with open(src_path, "rb") as f:
-        return hashlib.sha256(f.read()).hexdigest()[:16]
+def _source_hash(paths) -> str:
+    h = hashlib.sha256()
+    for p in paths:
+        with open(p, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
 
 
-def load_native(name: str) -> ctypes.CDLL:
-    """Build (if needed) and dlopen ray_tpu/_native/<name>.cpp."""
+def load_native(name: str, sources: tuple = ()) -> ctypes.CDLL:
+    """Build (if needed) and dlopen a native lib from ray_tpu/_native/.
+
+    Default source is <name>.cpp; `sources` names additional .cpp files
+    compiled into the same .so (the hash covers all of them, so editing
+    any source invalidates the cache)."""
     with _lock:
         if name in _loaded:
             return _loaded[name]
-        src = os.path.join(_DIR, f"{name}.cpp")
-        tag = _source_hash(src)
+        srcs = [os.path.join(_DIR, f"{name}.cpp")]
+        srcs += [os.path.join(_DIR, s) for s in sources]
+        tag = _source_hash(srcs)
         so_path = os.path.join(_BUILD_DIR, f"{name}-{tag}.so")
         if not os.path.exists(so_path):
             os.makedirs(_BUILD_DIR, exist_ok=True)
             tmp = so_path + f".tmp{os.getpid()}"
             cmd = [
                 "g++", "-O2", "-fPIC", "-shared", "-pthread",
-                "-std=c++17", "-o", tmp, src,
+                "-std=c++17", "-o", tmp, *srcs,
             ]
             subprocess.run(cmd, check=True, capture_output=True, text=True)
             os.replace(tmp, so_path)  # atomic: concurrent builders race safely

@@ -38,7 +38,7 @@ from ray_tpu.core.runtime import (
     build_worker_env,
     spawn_worker_process,
 )
-from ray_tpu.core.transport import FrameBuffer, recv_msg, send_msg
+from ray_tpu.core.transport import FrameBuffer, send_msg
 
 
 class _AgentWorker:
@@ -79,13 +79,11 @@ class NodeAgent:
         for k, v in (resources or {}).items():
             self.resources[k] = float(v)
 
-        # Peer port: serves whole-object pulls to sibling agents and the head.
-        self.peer_srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self.peer_srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self.peer_srv.bind((node_ip, 0))
-        self.peer_srv.listen(64)
-        self.peer_srv.setblocking(False)
-        self.peer_addr = self.peer_srv.getsockname()
+        # Peer port: serves whole-object pulls to sibling agents and the
+        # head — native C++ threads reading the arena directly (Python
+        # fallback speaks the same binary protocol).
+        self.peer_server = objxfer.start_peer_server(self.store, node_ip)
+        self.peer_addr = (node_ip, self.peer_server.port)
 
         host, port = head_addr.rsplit(":", 1)
         self.head_sock = socket.create_connection((host, int(port)))
@@ -102,8 +100,6 @@ class NodeAgent:
         self._sel_lock = threading.Lock()
         self._selector.register(self.head_sock, selectors.EVENT_READ,
                                 ("head", None))
-        self._selector.register(self.peer_srv, selectors.EVENT_READ,
-                                ("peer_accept", None))
         self.zygote = _Zygote(self.session_dir, self.store_path,
                               self._worker_env())
 
@@ -212,25 +208,6 @@ class NodeAgent:
             traceback.print_exc()
         self._send_head(("fetched", oid, ok, attempt))
 
-    def _serve_peer(self, conn: socket.socket):
-        """One peer connection: answer obj_req frames until EOF."""
-        try:
-            while True:
-                msg = recv_msg(conn)
-                if msg is None:
-                    return
-                if msg[0] != "obj_req":
-                    continue
-                objxfer.send_blob(self.store, lambda m: send_msg(conn, m),
-                                  msg[1])
-        except OSError:
-            pass
-        finally:
-            try:
-                conn.close()
-            except OSError:
-                pass
-
     # ---------------- main loop ----------------
 
     def run(self):
@@ -242,15 +219,6 @@ class NodeAgent:
                     continue
             for key, _mask in events:
                 kind, w = key.data
-                if kind == "peer_accept":
-                    try:
-                        conn, _addr = key.fileobj.accept()
-                    except OSError:
-                        continue
-                    conn.setblocking(True)
-                    threading.Thread(target=self._serve_peer, args=(conn,),
-                                     daemon=True).start()
-                    continue
                 try:
                     data = key.fileobj.recv(1 << 20)
                 except (BlockingIOError, InterruptedError):
@@ -289,6 +257,8 @@ class NodeAgent:
         if self.zygote is not None:
             self.zygote.close()
         try:
+            # Peer server first: native threads read the arena mmap raw.
+            self.peer_server.stop()
             self.store.close()
             self.store.unlink()
         except Exception:  # noqa: BLE001

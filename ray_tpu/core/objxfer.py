@@ -1,69 +1,214 @@
-"""Whole-object blob transfer between node-local shm stores.
+"""Cross-node object transfer over per-node peer ports.
 
-Shared by the head runtime and node agents (parity: the push/pull protocol
-of `src/ray/object_manager/` — object_manager.h:119, pull_manager.h:57 —
-collapsed to single-frame whole-blob transfers over per-pull peer
-connections; the pickle-5 out-of-band framing in transport.py keeps the
-blob itself zero-copy on the send side).
+Parity: reference `src/ray/object_manager/` (object_manager.h:119 push/pull,
+pull_manager.h:57) — collapsed to pull-driven whole-object transfers over
+persistent peer connections.
 
-Wire: requester connects to the source's peer port, sends ("obj_req", oid),
-receives ("obj_blob", oid, ok, data).
+The serving side is NATIVE by default: `ray_tpu/_native/peer_server.cpp`
+answers pulls straight out of the shm arena in C++ threads (no GIL on the
+send path); `start_peer_server` falls back to a Python thread server
+speaking the identical binary protocol if the native build is unavailable.
+The pulling side receives straight into the destination arena buffer
+(`recv_into` on the created object) — no intermediate blob copy. Clients
+open one connection per pull (the server loop also supports reuse, should
+a cached-connection pull manager want it later).
+
+Wire protocol (little endian):
+  request:  16-byte object id
+  response: u8 ok; if ok: u64 data_size, u64 meta_size, meta bytes, data
 """
 
 from __future__ import annotations
 
 import socket
+import struct
+import threading
 
 from ray_tpu.core.ids import ObjectID
-from ray_tpu.core.transport import recv_msg, send_msg
+
+_SIZES = struct.Struct("<QQ")
 
 
-def write_blob(store, oid: bytes, blob) -> None:
-    """Store one raw serialized object blob (idempotent — concurrent
-    duplicate pulls of the same object race contains()/create(), and the
-    loser's 'already exists' means the object is materialized: success)."""
+# ---------------- server ----------------
+
+
+class PeerServer:
+    """Handle over a running peer server: `.port`, `.kind` ("native" /
+    "python"), `.stop()`. Stop MUST run before the arena is unmapped —
+    native threads read it raw (no BufferError safety net)."""
+
+    def __init__(self, port: int, kind: str, stop_fn):
+        self.port = port
+        self.kind = kind
+        self._stop = stop_fn
+
+    def stop(self, timeout_ms: int = 2000):
+        if self._stop is not None:
+            stop, self._stop = self._stop, None
+            try:
+                stop(timeout_ms)
+            except Exception:  # noqa: BLE001 — teardown best effort
+                pass
+
+
+def start_peer_server(store, bind_ip: str, port: int = 0) -> PeerServer:
+    """Start the node's peer server bound to `store`'s arena."""
+    import sys
+    try:
+        import ctypes
+
+        from ray_tpu._native.build import load_native
+        lib = load_native("peer_server", sources=("object_store.cpp",))
+        lib.peer_server_start.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_void_p)]
+        lib.peer_server_start.restype = ctypes.c_int
+        lib.peer_server_stop.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        handle = ctypes.c_void_p()
+        got = lib.peer_server_start(store._base, bind_ip.encode(), port,
+                                    ctypes.byref(handle))
+        if got > 0:
+            return PeerServer(
+                got, "native",
+                lambda t_ms: lib.peer_server_stop(handle, t_ms))
+    except Exception as e:  # noqa: BLE001 — toolchain missing/build failed
+        print(f"ray_tpu: native peer server unavailable ({e!r}); "
+              "falling back to the Python (GIL-bound) transfer path",
+              file=sys.stderr)
+    return _start_python_peer_server(store, bind_ip, port)
+
+
+def _start_python_peer_server(store, bind_ip: str, port: int = 0) -> PeerServer:
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind((bind_ip, port))
+    srv.listen(64)
+    conns: set = set()
+    lock = threading.Lock()
+
+    def accept_loop():
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            with lock:
+                conns.add(conn)
+
+            def serve(conn=conn):
+                try:
+                    _serve_conn(store, conn)
+                finally:
+                    with lock:
+                        conns.discard(conn)
+
+            threading.Thread(target=serve, daemon=True).start()
+
+    threading.Thread(target=accept_loop, daemon=True,
+                     name="rtpu-peer-srv").start()
+
+    def stop(_t_ms):
+        try:
+            srv.close()
+        except OSError:
+            pass
+        with lock:
+            live = list(conns)
+        for c in live:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+    return PeerServer(srv.getsockname()[1], "python", stop)
+
+
+def _serve_conn(store, conn: socket.socket):
+    """Python fallback for one peer connection (same wire protocol)."""
+    conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    try:
+        while True:
+            oid = _recv_exact(conn, 16)
+            if oid is None:
+                return
+            res = None
+            try:
+                res = store.get_raw(ObjectID(oid), timeout=0)
+            except Exception:  # noqa: BLE001 — absent => ok=0
+                pass
+            if res is None:
+                conn.sendall(b"\x00")
+                continue
+            data, meta = res
+            try:
+                conn.sendall(b"\x01" + _SIZES.pack(len(data), len(meta)))
+                if meta:
+                    conn.sendall(meta)
+                conn.sendall(data)
+            finally:
+                data.release()
+                store.release(ObjectID(oid))
+    except OSError:
+        pass
+    finally:
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+
+# ---------------- client ----------------
+
+
+def _recv_exact(sock: socket.socket, n: int):
+    chunks = []
+    while n:
+        try:
+            c = sock.recv(n)
+        except OSError:
+            return None
+        if not c:
+            return None
+        chunks.append(c)
+        n -= len(c)
+    return b"".join(chunks)
+
+
+def _recv_into_exact(sock: socket.socket, view) -> bool:
+    off, n = 0, len(view)
+    while off < n:
+        try:
+            r = sock.recv_into(view[off:], n - off)
+        except OSError:
+            return False
+        if r == 0:
+            return False
+        off += r
+    return True
+
+
+def _create_for_write(store, oid: bytes, size: int, meta: bytes):
+    """Create the destination object, handling the concurrent-pull race:
+    returns the ObjectBuffer, or None when another puller already
+    materialized (or is materializing) the object."""
     from ray_tpu.core.status import RayTpuError
     if store.contains(ObjectID(oid)):
-        return
+        return None
     try:
-        buf = store.create(ObjectID(oid), len(blob))
+        return store.create(ObjectID(oid), size, meta=meta)
     except RayTpuError:
         if store.contains(ObjectID(oid)):
-            return
+            return None
         res = None
         try:
-            res = store.get_raw(ObjectID(oid), timeout=10.0)  # winner sealing
-        except Exception:  # noqa: BLE001 — GetTimeoutError: winner aborted
+            res = store.get_raw(ObjectID(oid), timeout=10.0)  # winner seals
+        except Exception:  # noqa: BLE001 — winner aborted
             pass
         if res is not None:
             res[0].release()
             store.release(ObjectID(oid))
-            return
+            return None
         raise
-    try:
-        buf.data[:] = blob
-        buf.seal()
-    except BaseException:
-        buf.abort()
-        raise
-
-
-def send_blob(store, sender, oid: bytes) -> None:
-    """Answer one obj_req: sender(msg) transmits the obj_blob reply."""
-    res = None
-    try:
-        res = store.get_raw(ObjectID(oid), timeout=5.0)
-    except Exception:  # noqa: BLE001 — absent/evicted objects reply ok=False
-        pass
-    if res is None:
-        sender(("obj_blob", oid, False, b""))
-        return
-    data, _meta = res
-    try:
-        sender(("obj_blob", oid, True, data))
-    finally:
-        data.release()
-        store.release(ObjectID(oid))
 
 
 def fetch_from_peer(store, addr, oid: bytes, timeout: float = 60.0) -> bool:
@@ -71,9 +216,45 @@ def fetch_from_peer(store, addr, oid: bytes, timeout: float = 60.0) -> bool:
     if store.contains(ObjectID(oid)):
         return True
     with socket.create_connection(tuple(addr), timeout=timeout) as s:
-        send_msg(s, ("obj_req", oid))
-        reply = recv_msg(s)
-    if reply is not None and reply[0] == "obj_blob" and reply[2]:
-        write_blob(store, oid, reply[3])
-        return True
-    return False
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        s.sendall(oid)
+        ok = _recv_exact(s, 1)
+        if ok != b"\x01":
+            return False
+        sizes = _recv_exact(s, _SIZES.size)
+        if sizes is None:
+            return False
+        data_size, meta_size = _SIZES.unpack(sizes)
+        meta = b""
+        if meta_size:
+            meta = _recv_exact(s, meta_size)
+            if meta is None:
+                return False
+        buf = _create_for_write(store, oid, data_size, meta)
+        if buf is None:
+            return True  # a concurrent pull won the race
+        try:
+            if not _recv_into_exact(s, buf.data):
+                buf.abort()
+                return False
+            buf.seal()
+        except BaseException:
+            buf.abort()
+            raise
+    return True
+
+
+# ---------------- blob helpers (spill restore, tests) ----------------
+
+
+def write_blob(store, oid: bytes, blob) -> None:
+    """Store one raw serialized object blob (idempotent)."""
+    buf = _create_for_write(store, oid, len(blob), b"")
+    if buf is None:
+        return
+    try:
+        buf.data[:] = blob
+        buf.seal()
+    except BaseException:
+        buf.abort()
+        raise

@@ -1169,6 +1169,12 @@ class Runtime:
             srv.setblocking(False)
             self._cluster_srv = srv
             self.cluster_addr = f"{host}:{srv.getsockname()[1]}"
+            # The head serves its own objects to nodes over a dedicated
+            # peer port (native C++ server; big blobs must never ride the
+            # control link).
+            from ray_tpu.core import objxfer
+            self._peer_server = objxfer.start_peer_server(self.store, host)
+            self.head_peer_addr = (host, self._peer_server.port)
         with self._sel_lock:
             self._selector.register(srv, selectors.EVENT_READ, _Acceptor())
         threading.Thread(target=self._health_loop, daemon=True,
@@ -1234,10 +1240,6 @@ class Runtime:
                 from ray_tpu.core.status import ObjectLostError
                 err = ObjectLostError(ObjectID(oid))
             self._finish_fetch((nid, oid), ok, err, attempt=attempt)
-        elif op == "obj_req":
-            # A peer agent pulling an object whose source is the head store.
-            threading.Thread(target=self._serve_obj_req,
-                             args=(conn, msg[1]), daemon=True).start()
         elif op == "client_hello":
             # A client-mode driver (parity: Ray Client `ray://` sessions):
             # acts like a worker whose every object value travels inline.
@@ -1253,13 +1255,6 @@ class Runtime:
                 self.workers[wid] = w
         else:
             raise RayTpuError(f"head: unknown node message {op}")
-
-    def _serve_obj_req(self, conn: NodeConn, oid: bytes):
-        from ray_tpu.core import objxfer
-        try:
-            objxfer.send_blob(self.store, conn.send, oid)
-        except OSError:
-            pass
 
     def _fetch_to_node(self, dest: NodeState, oid: bytes, done_cb):
         """Materialize `oid` in `dest`'s store; done_cb(ok, err) when done.
@@ -1318,8 +1313,7 @@ class Runtime:
                 if src.conn is not None:
                     src_addr = tuple(src.peer_addr)
                 else:
-                    host, p = self.cluster_addr.rsplit(":", 1)
-                    src_addr = (host, int(p))
+                    src_addr = self.head_peer_addr
                 dest.conn.send(("fetch", oid, src_addr, info["attempt"]))
         except OSError as e:
             self._finish_fetch(key, False, e)
@@ -2823,6 +2817,10 @@ class Runtime:
                 w.proc.kill()
         if self._zygote is not None:
             self._zygote.close()
+        # Stop the peer server BEFORE unmapping the arena: its native
+        # threads read the mmap raw.
+        if getattr(self, "_peer_server", None) is not None:
+            self._peer_server.stop()
         self.store.close()
         self.store.unlink()
 
