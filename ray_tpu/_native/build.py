@@ -27,6 +27,54 @@ def _source_hash(paths) -> str:
     return h.hexdigest()[:16]
 
 
+# Sanitizer builds (parity: the reference's bazel --config=tsan/asan for
+# the C++ runtime, .bazelrc:112-155): RAY_TPU_NATIVE_SANITIZER=thread|
+# address compiles the native components under TSan/ASan. Sanitized .so's
+# are cached under a distinct tag; loading an ASan lib into a regular
+# python needs LD_PRELOAD of the asan runtime — build_native() compiles
+# without loading for CI-style race hunts.
+_SANITIZE_ENV = "RAY_TPU_NATIVE_SANITIZER"
+
+
+def _sanitizer_flags(sanitizer: str | None) -> tuple[list, str]:
+    san = (sanitizer if sanitizer is not None
+           else os.environ.get(_SANITIZE_ENV, ""))
+    if san in ("thread", "tsan"):
+        return ["-fsanitize=thread", "-g", "-O1"], "-tsan"
+    if san in ("address", "asan"):
+        return ["-fsanitize=address", "-g", "-O1"], "-asan"
+    return [], ""
+
+
+def build_native(name: str, sources: tuple = (),
+                 sanitizer: str | None = None) -> str:
+    """Compile (if needed) and return the .so path WITHOUT loading it.
+
+    `sanitizer` overrides the env var ("thread"/"address"/""/None) — passed
+    through as a parameter, never by mutating process-global env (a
+    concurrent load_native in another thread must not pick it up)."""
+    return _build(name, sources, sanitizer=sanitizer)
+
+
+def _build(name: str, sources: tuple = (),
+           sanitizer: str | None = None) -> str:
+    srcs = [os.path.join(_DIR, f"{name}.cpp")]
+    srcs += [os.path.join(_DIR, s) for s in sources]
+    extra, san_tag = _sanitizer_flags(sanitizer)
+    tag = _source_hash(srcs) + san_tag
+    so_path = os.path.join(_BUILD_DIR, f"{name}-{tag}.so")
+    if not os.path.exists(so_path):
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        tmp = so_path + f".tmp{os.getpid()}"
+        cmd = [
+            "g++", "-O2", "-fPIC", "-shared", "-pthread",
+            "-std=c++17", *extra, "-o", tmp, *srcs,
+        ]
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, so_path)  # atomic: concurrent builders race safely
+    return so_path
+
+
 def load_native(name: str, sources: tuple = ()) -> ctypes.CDLL:
     """Build (if needed) and dlopen a native lib from ray_tpu/_native/.
 
@@ -36,19 +84,6 @@ def load_native(name: str, sources: tuple = ()) -> ctypes.CDLL:
     with _lock:
         if name in _loaded:
             return _loaded[name]
-        srcs = [os.path.join(_DIR, f"{name}.cpp")]
-        srcs += [os.path.join(_DIR, s) for s in sources]
-        tag = _source_hash(srcs)
-        so_path = os.path.join(_BUILD_DIR, f"{name}-{tag}.so")
-        if not os.path.exists(so_path):
-            os.makedirs(_BUILD_DIR, exist_ok=True)
-            tmp = so_path + f".tmp{os.getpid()}"
-            cmd = [
-                "g++", "-O2", "-fPIC", "-shared", "-pthread",
-                "-std=c++17", "-o", tmp, *srcs,
-            ]
-            subprocess.run(cmd, check=True, capture_output=True, text=True)
-            os.replace(tmp, so_path)  # atomic: concurrent builders race safely
-        lib = ctypes.CDLL(so_path)
+        lib = ctypes.CDLL(_build(name, sources))
         _loaded[name] = lib
         return lib

@@ -90,7 +90,9 @@ static void* conn_main(void* arg) {
     uint64_t off = 0, dsize = 0, msize = 0;
     int rc = store_get(base, oid, &off, &dsize, &msize);
     if (rc != 0) {
-      uint8_t ok = 0;
+      // -2 (ERR_AGAIN) = created but not yet sealed: tell the client to
+      // retry shortly instead of reporting the object absent.
+      uint8_t ok = (rc == -2) ? 2 : 0;
       if (write_all(fd, &ok, 1) != 0) break;
       continue;
     }
@@ -105,11 +107,14 @@ static void* conn_main(void* arg) {
     store_release(base, oid);
     if (err) break;
   }
-  close(fd);
   {
+    // Erase BEFORE close: once closed, the fd number can be reused by a
+    // brand-new accepted connection — erasing after would delete the live
+    // connection's entry and hide it from peer_server_stop.
     std::lock_guard<std::mutex> g(st->conn_mu);
     st->conn_fds.erase(fd);
   }
+  close(fd);
   st->active.fetch_sub(1);
   return nullptr;
 }

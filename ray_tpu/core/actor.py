@@ -141,6 +141,8 @@ class ActorMethod:
         args = [_promote_large(rt, a) for a in args]
         kwargs = {k: _promote_large(rt, v) for k, v in kwargs.items()}
         payload, buffers, refs = serialization.serialize_args(args, kwargs)
+        from ray_tpu.util import tracing as _tracing
+        trace_ctx = _tracing.inject_context() if _tracing._enabled else None
         # One entropy read for every id this call needs.
         rnd = os.urandom(16 + 16 * num_returns)
         task_id = TaskID(rnd[:16])
@@ -160,6 +162,7 @@ class ActorMethod:
             max_retries=0,
             retries_left=0,
             dependencies=[r.id.binary() for r in refs],
+            trace_ctx=trace_ctx,
         )
         if isinstance(rt, Runtime):
             rt.submit_task(spec)

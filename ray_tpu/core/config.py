@@ -47,10 +47,14 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "testing_delay_us": (str, "", "'method=min:max' comma list; injects delays"),
     # --- observability ---
     "event_stats": (bool, False, "record per-handler event-loop stats"),
+    "export_events": (bool, False, "append task/actor/node state "
+                      "transitions as JSONL under <session>/export_events"),
     "task_events_buffer_size": (int, 10000, "ring buffer of task state transitions"),
     "metrics_report_interval_ms": (int, 10000, "metrics flush interval"),
     # --- logging ---
     "log_dir": (str, "", "session log dir; '' = <session>/logs"),
+    "log_to_driver": (bool, True, "stream worker log lines to the driver "
+                      "stdout (parity: log_monitor.py + log_to_driver)"),
 }
 
 

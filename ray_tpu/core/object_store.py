@@ -245,6 +245,19 @@ class SharedMemoryStore:
     def contains(self, object_id: ObjectID) -> bool:
         return bool(self._lib.store_contains(self._base, object_id.binary()))
 
+    def probe(self, object_id: ObjectID) -> str:
+        """'sealed' | 'unsealed' | 'absent' (non-blocking, no ref taken)."""
+        off = ctypes.c_uint64()
+        dsz = ctypes.c_uint64()
+        msz = ctypes.c_uint64()
+        rc = self._lib.store_get(self._base, object_id.binary(),
+                                 ctypes.byref(off), ctypes.byref(dsz),
+                                 ctypes.byref(msz))
+        if rc == OK:
+            self._lib.store_release(self._base, object_id.binary())
+            return "sealed"
+        return "unsealed" if rc == ERR_AGAIN else "absent"
+
     def delete(self, object_id: ObjectID):
         self._lib.store_delete(self._base, object_id.binary())
 

@@ -137,7 +137,10 @@ def _serve_conn(store, conn: socket.socket):
             except Exception:  # noqa: BLE001 — absent => ok=0
                 pass
             if res is None:
-                conn.sendall(b"\x00")
+                # 2 = created-but-unsealed: client retries shortly (the
+                # old blob path waited server-side for in-flight seals).
+                state = store.probe(ObjectID(oid))
+                conn.sendall(b"\x02" if state == "unsealed" else b"\x00")
                 continue
             data, meta = res
             try:
@@ -211,14 +214,26 @@ def _create_for_write(store, oid: bytes, size: int, meta: bytes):
         raise
 
 
-def fetch_from_peer(store, addr, oid: bytes, timeout: float = 60.0) -> bool:
-    """Pull one object from a peer's port into `store`. Returns success."""
+def fetch_from_peer(store, addr, oid: bytes, timeout: float = 60.0,
+                    unsealed_wait_s: float = 5.0) -> bool:
+    """Pull one object from a peer's port into `store`. Returns success.
+
+    A created-but-unsealed object at the source (reply 2) is retried on the
+    same connection for up to `unsealed_wait_s` — a concurrent writer there
+    is about to seal it."""
+    import time
     if store.contains(ObjectID(oid)):
         return True
     with socket.create_connection(tuple(addr), timeout=timeout) as s:
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        s.sendall(oid)
-        ok = _recv_exact(s, 1)
+        deadline = time.monotonic() + unsealed_wait_s
+        while True:
+            s.sendall(oid)
+            ok = _recv_exact(s, 1)
+            if ok == b"\x02" and time.monotonic() < deadline:
+                time.sleep(0.05)
+                continue
+            break
         if ok != b"\x01":
             return False
         sizes = _recv_exact(s, _SIZES.size)

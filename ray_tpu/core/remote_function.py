@@ -65,6 +65,8 @@ class RemoteFunction:
 
         payload, buffers, refs = serialization.serialize_args(args, kwargs)
         num_returns = opts.get("num_returns", 1)
+        from ray_tpu.util import tracing as _tracing
+        trace_ctx = _tracing.inject_context() if _tracing._enabled else None
         rnd = os.urandom(16 + 16 * num_returns)
         task_id = TaskID(rnd[:16])
         return_ids = [rnd[16 + 16 * i : 32 + 16 * i]
@@ -84,6 +86,7 @@ class RemoteFunction:
             retries_left=max_retries,
             scheduling_strategy=opts.get("scheduling_strategy"),
             dependencies=[r.id.binary() for r in refs],
+            trace_ctx=trace_ctx,
             runtime_env=opts.get("runtime_env"),
         )
         if isinstance(rt, Runtime):

@@ -475,12 +475,17 @@ class TaskEventBuffer:
     fields (not the spec itself — that would pin payload/buffer memory in
     the ring) and defers string formatting to read time (`snapshot`)."""
 
-    def __init__(self, maxlen: int):
+    def __init__(self, maxlen: int, export=None):
         self.events = collections.deque(maxlen=maxlen)
+        self._export = export  # ExportEventWriter | None (off the hot path
+        # unless the export_events config flag is set)
 
     def record(self, task_id: bytes, spec, state: str):
         name = spec if isinstance(spec, str) else (spec.name, spec.method_name)
         self.events.append((time.time(), task_id, name, state))
+        if self._export is not None:
+            self._export.emit("TASK", task_id=task_id.hex(),
+                              name=self._name(name), state=state)
 
     @staticmethod
     def _name(name) -> str:
@@ -536,7 +541,14 @@ class Runtime:
 
         self.directory = ObjectDirectory()
         self.refcount = ReferenceCounter(free_callback=self._free_object)
-        self.task_events = TaskEventBuffer(cfg.task_events_buffer_size)
+        # Export API (parity: export_api/ durable event stream): opt-in
+        # JSONL writer fed by task/actor/node state transitions.
+        self.export_events = None
+        if cfg.export_events:
+            from ray_tpu.util.event_export import ExportEventWriter
+            self.export_events = ExportEventWriter(self.session_dir)
+        self.task_events = TaskEventBuffer(cfg.task_events_buffer_size,
+                                           export=self.export_events)
 
         self.lock = threading.RLock()
         # --- node table (parity: gcs_node_manager) ---
@@ -596,6 +608,12 @@ class Runtime:
 
         threading.Thread(target=prestart, daemon=True,
                          name="rtpu-pool-prestart").start()
+        # Stream worker logs to the driver (parity: log_monitor.py).
+        self._log_monitor = None
+        if cfg.log_to_driver:
+            from ray_tpu.core.log_monitor import LogMonitor
+            self._log_monitor = LogMonitor(
+                os.path.join(self.session_dir, "logs")).start()
         if cfg.memory_monitor_refresh_ms > 0:
             threading.Thread(target=self._memory_monitor_loop, daemon=True,
                              name="rtpu-oom-monitor").start()
@@ -1221,6 +1239,9 @@ class Runtime:
                 # New capacity may unblock queued PGs/actors.
                 self._kick_waiters()
             conn.send(("node_ack", self.head_node_id))
+            if self.export_events is not None:
+                self.export_events.emit("NODE", node_id=nid.hex(),
+                                        state="ALIVE", hostname=hostname)
             self._schedule()
         elif op == "heartbeat":
             node = self.nodes.get(conn.node_id)
@@ -1412,6 +1433,9 @@ class Runtime:
                     0.0, self.total_resources.get(k, 0.0) - v)
             orphaned_assigns = list(node.pending_actor_assign)
             node.pending_actor_assign.clear()
+        if self.export_events is not None:
+            self.export_events.emit("NODE", node_id=node.node_id.hex(),
+                                    state="DEAD")
         for w in list(node.workers.values()):
             self._on_worker_death(w)
         # Actors queued for assignment on this node never get a worker now:
@@ -2438,6 +2462,7 @@ class Runtime:
             # method calls fail fast with the real cause instead of hanging.
             st = ActorState(cspec)
             st.state = A_DEAD
+            self._export_actor(st, "DEAD")
             st.death_cause = e
             with self.lock:
                 self.actors.setdefault(cspec.actor_id, st)
@@ -2478,6 +2503,7 @@ class Runtime:
                     node, token = (None, None) if res is None else res
             except RayTpuError as e:
                 st.state = A_DEAD
+                self._export_actor(st, "DEAD")
                 st.death_cause = e
                 if cspec.name and self.named_actors.get(cspec.name) == cspec.actor_id:
                     del self.named_actors[cspec.name]
@@ -2520,6 +2546,12 @@ class Runtime:
         w.registered_fns.add(cspec.cls_id)
         w.send(("create_actor", cspec))
 
+    def _export_actor(self, st: "ActorState", state: str):
+        if self.export_events is not None:
+            self.export_events.emit("ACTOR",
+                                    actor_id=st.cspec.actor_id.hex(),
+                                    name=st.cspec.name, state=state)
+
     def _on_actor_ready(self, actor_id: bytes):
         st = self.actors.get(actor_id)
         if st is None:
@@ -2535,6 +2567,8 @@ class Runtime:
                 st.state = A_ALIVE
                 queued = list(st.queued)
                 st.queued.clear()
+        if st.state == A_ALIVE:
+            self._export_actor(st, "ALIVE")
         if dead_worker is not None:
             dead_worker.kill()
         for spec in queued:
@@ -2546,6 +2580,7 @@ class Runtime:
             return
         err = serialization.deserialize(payload, bufs)
         st.state = A_DEAD
+        self._export_actor(st, "DEAD")
         st.death_cause = err
         for spec in list(st.queued):
             self._fail_returns(spec, err)
@@ -2641,6 +2676,7 @@ class Runtime:
                     st.worker.kill()
                 return
             st.state = A_DEAD
+            self._export_actor(st, "DEAD")
             st.death_cause = ActorDiedError(
                 msg=f"actor {st.cspec.name} was killed before it started")
             try:
@@ -2746,6 +2782,7 @@ class Runtime:
                              args=(cspec,), daemon=True).start()
         else:
             st.state = A_DEAD
+            self._export_actor(st, "DEAD")
             st.death_cause = ActorDiedError(msg=f"actor {cspec.name} died")
             st.worker = None
             for spec in inflight:
@@ -2821,6 +2858,10 @@ class Runtime:
         # threads read the mmap raw.
         if getattr(self, "_peer_server", None) is not None:
             self._peer_server.stop()
+        if self.export_events is not None:
+            self.export_events.close()
+        if self._log_monitor is not None:
+            self._log_monitor.stop()
         self.store.close()
         self.store.unlink()
 

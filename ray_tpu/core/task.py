@@ -30,6 +30,7 @@ class TaskSpec:
         "dependencies",   # [oid_bytes] that must be ready before dispatch
         "runtime_env",    # {"env_vars": {...}, "working_dir": str,
                           #  "py_modules": [str]} | None
+        "trace_ctx",      # W3C traceparent carrier dict | None (tracing)
     )
 
     def __init__(self, **kw):

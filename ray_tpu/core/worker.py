@@ -360,7 +360,11 @@ def _execute(rt: WorkerRuntime, spec: TaskSpec, fn):
             or getattr(rt, "actor_scheduling_strategy", None))
         ctx = (contextlib.nullcontext() if renv_spec is None
                else _RuntimeEnv(renv_spec))
-        with ctx:
+        from ray_tpu.util import tracing as _tracing
+        span = (_tracing.execute_span(spec.describe(),
+                                      getattr(spec, "trace_ctx", None))
+                if _tracing._enabled else contextlib.nullcontext())
+        with ctx, span:
             result = fn(*args, **kwargs)
             if inspect.iscoroutine(result):
                 result = asyncio.get_event_loop().run_until_complete(result)
@@ -681,6 +685,9 @@ def _worker_main(store_path: str, worker_id: WorkerID, fd: int):
     except ImportError:
         pass
     sock = socket_from_fd(fd)
+
+    from ray_tpu.util import tracing as _tracing
+    _tracing.maybe_setup_from_env()
 
     import queue
     rt = WorkerRuntime(sock, worker_id, store_path)

@@ -13,6 +13,9 @@ import os
 # override, don't setdefault (the env presets JAX_PLATFORMS to the tpu
 # platform).
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Keep pytest output clean: worker log streaming is exercised by its own
+# unit test, not by every fixture cluster.
+os.environ.setdefault("RAY_TPU_LOG_TO_DRIVER", "0")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
