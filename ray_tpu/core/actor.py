@@ -136,6 +136,14 @@ class ActorMethod:
         return m
 
     def _remote(self, args, kwargs, num_returns=1):
+        from ray_tpu.util import tracing as _tr
+        if _tr._enabled:
+            with _tr.submit_span(f"{self._handle._name}.{self._name}",
+                                 "actor_task"):
+                return self._remote_inner(args, kwargs, num_returns)
+        return self._remote_inner(args, kwargs, num_returns)
+
+    def _remote_inner(self, args, kwargs, num_returns=1):
         from ray_tpu.core.runtime import Runtime, get_runtime
         rt = get_runtime()
         args = [_promote_large(rt, a) for a in args]

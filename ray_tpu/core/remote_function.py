@@ -55,6 +55,15 @@ class RemoteFunction:
             f"use {self.__name__}.remote().")
 
     def _remote(self, args, kwargs, opts):
+        from ray_tpu.util import tracing as _tr
+        if _tr._enabled:
+            # The submit span parents the worker-side execute span via the
+            # carrier injected below (parity: tracing_helper decorators).
+            with _tr.submit_span(self.__name__, "task"):
+                return self._remote_inner(args, kwargs, opts)
+        return self._remote_inner(args, kwargs, opts)
+
+    def _remote_inner(self, args, kwargs, opts):
         from ray_tpu.core.runtime import Runtime, get_runtime
         rt = get_runtime()
         fn_id, fn_blob = self._ensure_serialized()

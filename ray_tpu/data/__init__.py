@@ -19,8 +19,12 @@ from ray_tpu.data.dataset import (  # noqa: F401
     range,
 )
 from ray_tpu.data.datasource import (  # noqa: F401
+    decode_image,
+    from_huggingface,
+    from_torch,
     read_binary_files,
     read_csv,
+    read_images,
     read_json,
     read_numpy,
     read_parquet,
@@ -31,5 +35,6 @@ __all__ = [
     "Dataset", "DataIterator", "DataContext", "Schema", "aggregate",
     "range", "from_items", "from_pandas", "from_numpy", "from_arrow",
     "read_parquet", "read_csv", "read_json", "read_text",
-    "read_binary_files", "read_numpy",
+    "read_binary_files", "read_numpy", "read_images",
+    "from_huggingface", "from_torch", "decode_image",
 ]

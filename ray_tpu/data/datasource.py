@@ -109,3 +109,86 @@ def write_block_task(block, path: str, index: int, fmt: str) -> str:
     else:
         raise ValueError(f"unknown write format {fmt}")
     return out
+
+
+def read_images(paths, *, include_paths: bool = False, mode: str | None = None,
+                size: tuple | None = None, **_kw) -> Dataset:
+    """Decode image files into {"image": HWC uint8 ndarray} rows (parity:
+    data/_internal/datasource/image_datasource.py; PIL decode per file)."""
+
+    def one_file(f: str) -> pa.Table:
+        import numpy as np
+        from PIL import Image
+        with Image.open(f) as img:
+            if mode is not None:
+                img = img.convert(mode)
+            if size is not None:
+                img = img.resize(size)
+            arr = np.asarray(img)
+        # Raw bytes + shape + dtype (nested arrow lists would force per-
+        # pixel python objects); decode_image(row) rebuilds the ndarray.
+        row = {"image": [arr.tobytes()],
+               "shape": [list(arr.shape)],
+               "dtype": [str(arr.dtype)]}
+        if include_paths:
+            row["path"] = [f]
+        return pa.table(row)
+
+    return _make_read(paths, one_file, "ReadImages")
+
+
+def decode_image(row: dict):
+    """Rebuild the HWC ndarray from a read_images row."""
+    import numpy as np
+    return np.frombuffer(row["image"], dtype=row["dtype"]).reshape(
+        [int(s) for s in row["shape"]])
+
+
+def from_huggingface(hf_dataset) -> Dataset:
+    """Ingest a HuggingFace datasets.Dataset (parity: ray.data.from_huggingface;
+    arrow-backed, zero-copy via the dataset's arrow table)."""
+    from ray_tpu.data.dataset import from_arrow, from_items
+    if getattr(hf_dataset, "_indices", None) is not None:
+        # filter()/shuffle()/select() keep an index mapping over the raw
+        # arrow table — materialize it or we'd return the wrong rows.
+        hf_dataset = hf_dataset.flatten_indices()
+    table = getattr(hf_dataset, "data", None)
+    if table is not None and hasattr(table, "table"):
+        return from_arrow(table.table)  # datasets.table.Table
+    # IterableDataset / fallback: materialize rows
+    return from_items([dict(r) for r in hf_dataset])
+
+
+def _torch_plain(v):
+    if hasattr(v, "detach"):  # torch.Tensor -> list/scalar
+        v = v.detach().cpu().numpy()
+        return v.item() if v.ndim == 0 else v.tolist()
+    if isinstance(v, (tuple, list)):
+        return [_torch_plain(x) for x in v]
+    return v
+
+
+def from_torch(torch_dataset, *, override_num_blocks: int | None = None
+               ) -> Dataset:
+    """Ingest a torch map-style Dataset (parity: ray.data.from_torch):
+    one row per item, under the "item" column.
+
+    Lazy like the file readers: the dataset ships to read tasks which
+    materialize index ranges — the driver never holds the whole dataset's
+    rows (the dataset object itself must be picklable)."""
+    from ray_tpu.data.context import DataContext
+    n = len(torch_dataset)
+    k = override_num_blocks or min(
+        DataContext.get_current().read_parallelism, max(n, 1))
+    bounds = [(n * i // k, n * (i + 1) // k) for i in range(k)]
+
+    def mk(lo, hi):
+        def read(lo=lo, hi=hi):
+            rows = [{"item": _torch_plain(torch_dataset[i])}
+                    for i in range(lo, hi)]
+            return pa.Table.from_pylist(rows)
+        return read
+
+    return Dataset(plan_mod.LogicalPlan(
+        [plan_mod.Read(name="ReadTorch",
+                       read_fns=[mk(lo, hi) for lo, hi in bounds if hi > lo])]))

@@ -15,6 +15,11 @@ from ray_tpu.serve.api import (  # noqa: F401
 )
 from ray_tpu.serve.batching import batch  # noqa: F401
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig  # noqa: F401
+from ray_tpu.serve.grpc_proxy import (  # noqa: F401
+    grpc_call,
+    start_grpc_proxy,
+    stop_grpc_proxy,
+)
 from ray_tpu.serve.deployment import Application, Deployment, deployment  # noqa: F401
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse  # noqa: F401
 from ray_tpu.serve.multiplex import (  # noqa: F401

@@ -1,0 +1,127 @@
+"""gRPC ingress for Serve.
+
+Parity: reference `python/ray/serve/_private/proxy.py` gRPC side (the
+proxy serves user-defined gRPC services next to HTTP). Design departure:
+the reference compiles user protos into the proxy; here a
+GenericRpcHandler accepts ANY unary-unary method and routes by the
+method's service path — `/<app_name>/<method_name>` — handing the raw
+request bytes to the deployment. Apps that speak protobuf decode their
+own messages (bytes in, bytes/str out); plain-python clients can use the
+pickle-based `grpc_call` helper.
+"""
+
+from __future__ import annotations
+
+import pickle
+from concurrent import futures
+
+import ray_tpu
+
+PICKLE_METHOD = "__pickle__"
+
+
+class _GenericHandler:
+    """grpc.GenericRpcHandler routing every unary call into serve."""
+
+    def __init__(self, allow_pickle: bool):
+        import grpc
+        self._grpc = grpc
+        self._allow_pickle = allow_pickle
+        self._handlers: dict = {}
+
+    def service(self, handler_call_details):
+        grpc = self._grpc
+        path = handler_call_details.method  # "/<app>/<method>"
+        h = self._handlers.get(path)
+        if h is not None:
+            return h
+        try:
+            _, app, method = path.split("/", 2)
+        except ValueError:
+            return None
+
+        def unary_unary(request: bytes, context):
+            from ray_tpu.core.status import RayTpuError
+            from ray_tpu.serve.api import get_app_handle
+            # Gates abort OUTSIDE the handler try: context.abort raises to
+            # unwind, and a blanket except would re-abort it as INTERNAL.
+            if method == PICKLE_METHOD and not self._allow_pickle:
+                context.abort(
+                    grpc.StatusCode.PERMISSION_DENIED,
+                    "pickle route disabled (start_grpc_proxy("
+                    "allow_pickle=True) enables it for trusted "
+                    "networks only)")
+                return b""
+            try:
+                handle = get_app_handle(app)
+            except (KeyError, ValueError, RayTpuError) as e:
+                context.abort(grpc.StatusCode.NOT_FOUND,
+                              f"no serve app {app!r}: {e}")
+                return b""
+            try:
+                if method == PICKLE_METHOD:
+                    args, kwargs = pickle.loads(request)
+                    out = handle.remote(*args, **kwargs).result(timeout_s=60)
+                    return pickle.dumps(out)
+                target = (handle if method == "__call__"
+                          else getattr(handle, method))
+                out = target.remote(request).result(timeout_s=60)
+                if isinstance(out, bytes):
+                    return out
+                if isinstance(out, str):
+                    return out.encode()
+                return pickle.dumps(out)
+            except Exception as e:  # noqa: BLE001 — surface to the client
+                context.abort(grpc.StatusCode.INTERNAL, repr(e))
+                return b""
+
+        h = grpc.unary_unary_rpc_method_handler(
+            unary_unary,
+            request_deserializer=None,   # raw bytes through
+            response_serializer=None)
+        self._handlers[path] = h
+        return h
+
+
+_server = None
+
+
+def start_grpc_proxy(host: str = "127.0.0.1", port: int = 0,
+                     allow_pickle: bool = False) -> str:
+    """Start (or return) the serve gRPC ingress; returns 'host:port'.
+
+    SECURITY: `allow_pickle=True` enables the `__pickle__` convenience
+    route (used by `grpc_call`), which unpickles client bytes — arbitrary
+    code execution for anyone who can reach the port. Enable it only on
+    trusted networks; the raw-bytes routes are always safe."""
+    global _server
+    import grpc
+    if _server is not None:
+        return _server[1]
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=16))
+    server.add_generic_rpc_handlers((_GenericHandler(allow_pickle),))
+    bound = server.add_insecure_port(f"{host}:{port}")
+    server.start()
+    addr = f"{host}:{bound}"
+    _server = (server, addr)
+    return addr
+
+
+def stop_grpc_proxy():
+    global _server
+    if _server is not None:
+        _server[0].stop(grace=1.0)
+        _server = None
+
+
+def grpc_call(addr: str, app: str, *args, timeout_s: float = 60.0,
+              **kwargs):
+    """Python-client helper: pickled unary call to `app`'s __call__."""
+    import grpc
+    with grpc.insecure_channel(addr) as channel:
+        fn = channel.unary_unary(
+            f"/{app}/{PICKLE_METHOD}",
+            request_serializer=None,
+            response_deserializer=None)
+        out = fn(pickle.dumps((args, kwargs)), timeout=timeout_s)
+    return pickle.loads(out)

@@ -224,6 +224,7 @@ def test_tracing_spans_submit_and_execute(ray_start_regular):
 def test_tracing_api_only_smoke(ray_start_regular):
     """Without the otel SDK, tracing enablement must be harmless: tasks
     still run; spans are non-recording."""
+    pytest.importorskip("opentelemetry")
     from ray_tpu.util import tracing
 
     tracing.setup_tracing()

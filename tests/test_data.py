@@ -258,3 +258,47 @@ def test_empty_dataset(ray_start_regular):
     ds = rd.from_items([])
     assert ds.count() == 0
     assert ds.take_all() == []
+
+
+def test_read_images(ray_start_regular, tmp_path):
+    import numpy as np
+    from PIL import Image
+
+    for i in range(3):
+        arr = np.full((8, 8, 3), i * 40, dtype=np.uint8)
+        Image.fromarray(arr).save(tmp_path / f"img{i}.png")
+    import ray_tpu.data as rd
+    from ray_tpu.data.datasource import decode_image
+    ds = rd.read_images(str(tmp_path), include_paths=True)
+    rows = ds.take_all()
+    assert len(rows) == 3
+    img = decode_image(rows[0])
+    assert img.shape == (8, 8, 3) and img.dtype == np.uint8
+    assert img[0, 0, 0] == 0
+    assert rows[0]["path"].endswith("img0.png")
+
+
+def test_from_huggingface(ray_start_regular):
+    import datasets as hf
+
+    import ray_tpu.data as rd
+    d = hf.Dataset.from_dict({"x": [1, 2, 3], "y": ["a", "b", "c"]})
+    ds = rd.from_huggingface(d)
+    rows = ds.take_all()
+    assert [r["x"] for r in rows] == [1, 2, 3]
+    assert rows[2]["y"] == "c"
+    # filtered HF datasets keep an _indices mapping: rows must honor it
+    filt = rd.from_huggingface(d.filter(lambda r: r["x"] > 1))
+    assert [r["x"] for r in filt.take_all()] == [2, 3]
+
+
+def test_from_torch(ray_start_regular):
+    import torch
+    from torch.utils.data import TensorDataset
+
+    import ray_tpu.data as rd
+    td = TensorDataset(torch.arange(4))
+    ds = rd.from_torch(td)
+    rows = ds.take_all()
+    assert len(rows) == 4
+    assert int(rows[3]["item"][0]) == 3  # plain list after tensor conversion

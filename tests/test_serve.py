@@ -338,3 +338,49 @@ def test_multiplexed_lru():
 
     asyncio.run(scenario())
     assert loads == ["a", "b", "c", "b"]
+
+
+def test_grpc_ingress(serve_instance):
+    """gRPC ingress routes /<app>/<method> to the app's handle; the pickle
+    helper covers python clients, raw bytes cover proto-speaking apps."""
+    import grpc
+
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, data):
+            if isinstance(data, bytes):
+                return data.upper()
+            return {"got": data}
+
+        def double(self, data: bytes):
+            return data * 2
+
+    serve.run(Echo.bind(), name="grpcapp")
+    addr = serve.start_grpc_proxy(allow_pickle=True)
+    try:
+        # pickle helper (python clients)
+        out = serve.grpc_call(addr, "grpcapp", {"x": 1})
+        assert out == {"got": {"x": 1}}
+        # raw-bytes path (proto-style clients decode their own messages)
+        with grpc.insecure_channel(addr) as ch:
+            fn = ch.unary_unary("/grpcapp/__call__",
+                                request_serializer=None,
+                                response_deserializer=None)
+            assert fn(b"abc", timeout=30) == b"ABC"
+            fn2 = ch.unary_unary("/grpcapp/double",
+                                 request_serializer=None,
+                                 response_deserializer=None)
+            assert fn2(b"xy", timeout=30) == b"xyxy"
+        # unknown app -> NOT_FOUND
+        with grpc.insecure_channel(addr) as ch:
+            fn = ch.unary_unary("/nosuchapp/__call__")
+            try:
+                fn(b"", timeout=30)
+                raise AssertionError("expected NOT_FOUND")
+            except grpc.RpcError as e:
+                assert e.code() == grpc.StatusCode.NOT_FOUND
+    finally:
+        serve.stop_grpc_proxy()
+        serve.delete("grpcapp")
