@@ -123,7 +123,9 @@ def read_images(paths, *, include_paths: bool = False, mode: str | None = None,
             if mode is not None:
                 img = img.convert(mode)
             if size is not None:
-                img = img.resize(size)
+                # API takes (height, width) like ray.data.read_images;
+                # PIL's resize wants (width, height).
+                img = img.resize((size[1], size[0]))
             arr = np.asarray(img)
         # Raw bytes + shape + dtype (nested arrow lists would force per-
         # pixel python objects); decode_image(row) rebuilds the ndarray.
@@ -181,11 +183,15 @@ def from_torch(torch_dataset, *, override_num_blocks: int | None = None
     k = override_num_blocks or min(
         DataContext.get_current().read_parallelism, max(n, 1))
     bounds = [(n * i // k, n * (i + 1) // k) for i in range(k)]
+    # Ship the dataset ONCE through the object plane; each read task
+    # closes over the ref (k closures capturing the dataset itself would
+    # pickle it k times into k task payloads).
+    ds_ref = ray_tpu.put(torch_dataset)
 
     def mk(lo, hi):
-        def read(lo=lo, hi=hi):
-            rows = [{"item": _torch_plain(torch_dataset[i])}
-                    for i in range(lo, hi)]
+        def read(lo=lo, hi=hi, ds_ref=ds_ref):
+            ds = ray_tpu.get(ds_ref, timeout=120)
+            rows = [{"item": _torch_plain(ds[i])} for i in range(lo, hi)]
             return pa.Table.from_pylist(rows)
         return read
 

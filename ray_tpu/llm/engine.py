@@ -51,6 +51,8 @@ class Request:
     prompt: list
     max_new_tokens: int
     temperature: float
+    top_p: float = 1.0     # 1.0 = no nucleus truncation
+    top_k: int = 0         # 0 = no top-k truncation
     generated: list = dataclasses.field(default_factory=list)
     done: bool = False
 
@@ -181,11 +183,37 @@ def decode_step(params, cache_k, cache_v, tokens, lengths, active,
     return logits, cache_k, cache_v
 
 
-def sample(logits, temperature, key):
-    """Per-row temperature; 0 = greedy. logits [B, V] fp32."""
+def sample(logits, temperature, key, top_p=None, top_k=None):
+    """Per-row temperature (0 = greedy) with optional nucleus (top_p) and
+    top_k truncation — all branch-free under jit.
+
+    top_p/top_k are per-row arrays; top_p=1.0 / top_k=0 disable the
+    respective filter for that row."""
     greedy = jnp.argmax(logits, axis=-1)
     temp = jnp.maximum(temperature, 1e-6)[:, None]
-    sampled = jax.random.categorical(key, logits / temp, axis=-1)
+    scaled = logits / temp
+    neg = jnp.finfo(scaled.dtype).min
+    if top_k is not None:
+        V = scaled.shape[-1]
+        # rank of each logit within its row (0 = largest)
+        order = jnp.argsort(scaled, axis=-1)[:, ::-1]
+        ranks = jnp.zeros_like(order).at[
+            jnp.arange(order.shape[0])[:, None], order].set(
+            jnp.arange(V)[None, :])
+        k = jnp.where(top_k > 0, top_k, V)[:, None]
+        scaled = jnp.where(ranks < k, scaled, neg)
+    if top_p is not None:
+        sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with cumulative mass >= top_p; <= (not
+        # <) so the argmax survives even top_p == 0 (cum - probs is exactly
+        # 0 for the first sorted element)
+        keep_sorted = (cum - probs) <= top_p[:, None]
+        cutoff = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf),
+                         axis=-1, keepdims=True)
+        scaled = jnp.where(scaled >= cutoff, scaled, neg)
+    sampled = jax.random.categorical(key, scaled, axis=-1)
     return jnp.where(temperature > 0, sampled, greedy)
 
 
@@ -229,7 +257,13 @@ class InferenceEngine:
         self._prefill = jax.jit(partial(prefill, config=c))
         self._insert = jax.jit(insert_kv)
         self._decode = jax.jit(partial(decode_step, config=c))
+        # Two compiled samplers: the plain one (no sorts) serves the
+        # default top_k=0/top_p=1 case on the hot decode loop; the
+        # truncating one compiles the top-k/top-p masking only when some
+        # request asks for it.
         self._sample = jax.jit(sample)
+        self._sample_trunc = jax.jit(
+            lambda lg, t, k, p, tk: sample(lg, t, k, top_p=p, top_k=tk))
         self._key = jax.random.PRNGKey(seed + 1)
 
         # host-side slot state
@@ -246,7 +280,8 @@ class InferenceEngine:
     # ---- request API ----
 
     def add_request(self, prompt_tokens, max_new_tokens=None,
-                    temperature=None) -> int:
+                    temperature=None, top_p: float = 1.0,
+                    top_k: int = 0) -> int:
         # Validate at submission, in the CALLER's thread: an invalid prompt
         # must fail its own request, not blow up the shared engine pump.
         self._bucket(len(prompt_tokens))
@@ -257,7 +292,7 @@ class InferenceEngine:
             rid, list(map(int, prompt_tokens)),
             max_new_tokens or self.e.default_max_new_tokens,
             self.e.default_temperature if temperature is None
-            else temperature)
+            else temperature, top_p=float(top_p), top_k=int(top_k))
         self.queue.append(req)
         return rid
 
@@ -293,9 +328,16 @@ class InferenceEngine:
             toks[0, :n] = req.prompt
             logits, ks, vs = self._prefill(self.params, jnp.asarray(toks))
             self._key, sub = jax.random.split(self._key)
-            first = int(self._sample(
-                logits[n - 1][None],
-                jnp.asarray([req.temperature], jnp.float32), sub)[0])
+            if req.top_k == 0 and req.top_p >= 1.0:
+                first = int(self._sample(
+                    logits[n - 1][None],
+                    jnp.asarray([req.temperature], jnp.float32), sub)[0])
+            else:
+                first = int(self._sample_trunc(
+                    logits[n - 1][None],
+                    jnp.asarray([req.temperature], jnp.float32), sub,
+                    jnp.asarray([req.top_p], jnp.float32),
+                    jnp.asarray([req.top_k], jnp.int32))[0])
             self.cache_k, self.cache_v = self._insert(
                 self.cache_k, self.cache_v, ks, vs, slot, n)
             req.generated.append(first)
@@ -328,12 +370,24 @@ class InferenceEngine:
         temps = np.array(
             [self.slot_req[i].temperature if self.slot_req[i] else 0.0
              for i in range(self.e.max_slots)], np.float32)
+        top_ps = np.array(
+            [self.slot_req[i].top_p if self.slot_req[i] else 1.0
+             for i in range(self.e.max_slots)], np.float32)
+        top_ks = np.array(
+            [self.slot_req[i].top_k if self.slot_req[i] else 0
+             for i in range(self.e.max_slots)], np.int32)
         logits, self.cache_k, self.cache_v = self._decode(
             self.params, self.cache_k, self.cache_v,
             jnp.asarray(self.last_tokens), jnp.asarray(self.lengths),
             jnp.asarray(self.active))
         self._key, sub = jax.random.split(self._key)
-        tokens = np.asarray(self._sample(logits, jnp.asarray(temps), sub))
+        if (top_ks == 0).all() and (top_ps >= 1.0).all():
+            tokens = np.asarray(self._sample(logits, jnp.asarray(temps),
+                                             sub))
+        else:
+            tokens = np.asarray(self._sample_trunc(
+                logits, jnp.asarray(temps), sub,
+                jnp.asarray(top_ps), jnp.asarray(top_ks)))
         for i in range(self.e.max_slots):
             if not self.active[i]:
                 continue

@@ -85,12 +85,14 @@ class _LLMServerImpl:
             for loop, fut, req in done:
                 loop.call_soon_threadsafe(fut.set_result, req)
 
-    async def _submit(self, prompt_ids, max_new_tokens, temperature):
+    async def _submit(self, prompt_ids, max_new_tokens, temperature,
+                      top_p=1.0, top_k=0):
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
         with self._lock:
             rid = self.engine.add_request(prompt_ids, max_new_tokens,
-                                          temperature)
+                                          temperature, top_p=top_p,
+                                          top_k=top_k)
             self._waiters[rid] = (loop, fut)
         return await fut
 
@@ -163,13 +165,15 @@ class _LLMServerImpl:
     # ---- request API (called via handle) ----
 
     async def completions(self, prompt: str, *, max_tokens=None,
-                          temperature=None, model=None) -> dict:
+                          temperature=None, top_p: float = 1.0,
+                          top_k: int = 0, model=None) -> dict:
         # Adapter swap: engine params are per-step state, so point the
         # engine at the requested tree. Mixed-adapter batches decode with
         # the most recent selection (documented simplification).
         self.engine.params = self._params_for(model)
         ids = self.tokenizer.encode(prompt)
-        req = await self._submit(ids, max_tokens, temperature)
+        req = await self._submit(ids, max_tokens, temperature,
+                                 top_p=top_p, top_k=top_k)
         text = self.tokenizer.decode(req.generated)
         return {
             "id": f"cmpl-{uuid.uuid4().hex[:24]}",
@@ -183,12 +187,14 @@ class _LLMServerImpl:
         }
 
     async def chat(self, messages: list, *, max_tokens=None,
-                   temperature=None, model=None) -> dict:
+                   temperature=None, top_p: float = 1.0, top_k: int = 0,
+                   model=None) -> dict:
         prompt = "".join(
             f"<|{m.get('role', 'user')}|>{m.get('content', '')}"
             for m in messages) + "<|assistant|>"
         out = await self.completions(prompt, max_tokens=max_tokens,
-                                     temperature=temperature, model=model)
+                                     temperature=temperature, top_p=top_p,
+                                     top_k=top_k, model=model)
         return {
             "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
             "object": "chat.completion",

@@ -23,18 +23,34 @@ PICKLE_METHOD = "__pickle__"
 class _GenericHandler:
     """grpc.GenericRpcHandler routing every unary call into serve."""
 
+    HANDLE_TTL_S = 10.0
+
     def __init__(self, allow_pickle: bool):
+        import threading
         import grpc
         self._grpc = grpc
         self._allow_pickle = allow_pickle
-        self._handlers: dict = {}
+        # app -> (handle, fetched_at); bounded by the number of REAL apps
+        # (unknown apps abort before caching)
+        self._handles: dict = {}
+        self._hlock = threading.Lock()
+
+    def _handle_for(self, app: str):
+        import time
+        from ray_tpu.serve.api import get_app_handle
+        now = time.monotonic()
+        with self._hlock:
+            hit = self._handles.get(app)
+            if hit is not None and now - hit[1] < self.HANDLE_TTL_S:
+                return hit[0]
+        handle = get_app_handle(app)  # raises for unknown apps
+        with self._hlock:
+            self._handles[app] = (handle, now)
+        return handle
 
     def service(self, handler_call_details):
         grpc = self._grpc
         path = handler_call_details.method  # "/<app>/<method>"
-        h = self._handlers.get(path)
-        if h is not None:
-            return h
         try:
             _, app, method = path.split("/", 2)
         except ValueError:
@@ -42,7 +58,6 @@ class _GenericHandler:
 
         def unary_unary(request: bytes, context):
             from ray_tpu.core.status import RayTpuError
-            from ray_tpu.serve.api import get_app_handle
             # Gates abort OUTSIDE the handler try: context.abort raises to
             # unwind, and a blanket except would re-abort it as INTERNAL.
             if method == PICKLE_METHOD and not self._allow_pickle:
@@ -53,7 +68,7 @@ class _GenericHandler:
                     "networks only)")
                 return b""
             try:
-                handle = get_app_handle(app)
+                handle = self._handle_for(app)
             except (KeyError, ValueError, RayTpuError) as e:
                 context.abort(grpc.StatusCode.NOT_FOUND,
                               f"no serve app {app!r}: {e}")
@@ -75,12 +90,12 @@ class _GenericHandler:
                 context.abort(grpc.StatusCode.INTERNAL, repr(e))
                 return b""
 
-        h = grpc.unary_unary_rpc_method_handler(
+        # Handlers are NOT cached: the closure is cheap to build and a
+        # cache keyed by client-supplied paths would grow without bound.
+        return grpc.unary_unary_rpc_method_handler(
             unary_unary,
             request_deserializer=None,   # raw bytes through
             response_serializer=None)
-        self._handlers[path] = h
-        return h
 
 
 _server = None
@@ -97,13 +112,17 @@ def start_grpc_proxy(host: str = "127.0.0.1", port: int = 0,
     global _server
     import grpc
     if _server is not None:
+        if _server[2] != allow_pickle:
+            raise ValueError(
+                f"gRPC proxy already running with allow_pickle="
+                f"{_server[2]}; stop_grpc_proxy() first to change it")
         return _server[1]
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=16))
     server.add_generic_rpc_handlers((_GenericHandler(allow_pickle),))
     bound = server.add_insecure_port(f"{host}:{port}")
     server.start()
     addr = f"{host}:{bound}"
-    _server = (server, addr)
+    _server = (server, addr, allow_pickle)
     return addr
 
 

@@ -230,3 +230,45 @@ def test_batch_processor(ray_start_regular):
     rows = processor(ds).take_all()
     assert len(rows) == 6
     assert all("generated" in r for r in rows)
+
+
+def test_top_k_top_p_sampling_masks():
+    """top_k=1 must reduce to greedy even at high temperature; top_p ~0
+    likewise (the nucleus keeps only the argmax)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.llm.engine import sample
+
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(4, 32)), jnp.float32)
+    greedy = np.asarray(jnp.argmax(logits, -1))
+    key = jax.random.PRNGKey(0)
+    hot = jnp.full((4,), 5.0)  # temperature 5: near-uniform without masks
+    out_k1 = np.asarray(sample(logits, hot, key,
+                               jnp.ones(4), jnp.full((4,), 1)))
+    assert (out_k1 == greedy).all()
+    out_p0 = np.asarray(sample(logits, hot, key,
+                               jnp.full((4,), 1e-6), jnp.zeros(4, jnp.int32)))
+    assert (out_p0 == greedy).all()
+    # unconstrained hot sampling really does deviate (sanity)
+    outs = set()
+    for i in range(8):
+        k = jax.random.PRNGKey(i)
+        outs.add(tuple(np.asarray(sample(
+            logits, hot, k, jnp.ones(4), jnp.zeros(4, jnp.int32)))))
+    assert len(outs) > 1
+
+
+def test_engine_top_k_request(tiny_params):
+    """Engine threads per-request top_k through prefill + decode."""
+    eng = InferenceEngine(
+        TINY, EngineConfig(max_slots=2, max_len=64, prompt_buckets=(16,),
+                           eos_token=-1), params=tiny_params)
+    rid = eng.add_request([1, 2, 3], max_new_tokens=4, temperature=2.0,
+                          top_k=1)
+    while eng.has_work():
+        eng.step()
+    req = eng.finished.pop(rid)
+    assert len(req.generated) >= 1
