@@ -146,6 +146,13 @@ class ActorMethod:
     def _remote_inner(self, args, kwargs, num_returns=1):
         from ray_tpu.core.runtime import Runtime, get_runtime
         rt = get_runtime()
+        streaming = num_returns == "streaming"
+        if streaming:
+            if not isinstance(rt, Runtime):
+                raise ValueError(
+                    "streaming actor calls can only be submitted from "
+                    "the driver")
+            num_returns = 0
         args = [_promote_large(rt, a) for a in args]
         kwargs = {k: _promote_large(rt, v) for k, v in kwargs.items()}
         payload, buffers, refs = serialization.serialize_args(args, kwargs)
@@ -171,11 +178,15 @@ class ActorMethod:
             retries_left=0,
             dependencies=[r.id.binary() for r in refs],
             trace_ctx=trace_ctx,
+            streaming=streaming,
         )
         if isinstance(rt, Runtime):
             rt.submit_task(spec)
         else:
             rt.send(("submit", spec))
+        if streaming:
+            from ray_tpu.core.object_ref import ObjectRefGenerator
+            return ObjectRefGenerator(task_id.binary(), rt)
         out = [ObjectRef(ObjectID(rid)) for rid in return_ids]
         return out[0] if num_returns == 1 else out
 

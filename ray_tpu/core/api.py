@@ -92,15 +92,19 @@ def wait(refs, *, num_returns=1, timeout=None):
     return get_runtime().wait(refs, num_returns=num_returns, timeout=timeout)
 
 
-def cancel(ref: ObjectRef, *, force: bool = False) -> bool:
+def cancel(ref, *, force: bool = False) -> bool:
     """Cancel the task behind `ref` (parity: ray.cancel). Queued tasks fail
     with TaskCancelledError; running tasks are only interrupted with
-    force=True. Returns whether a cancellation took effect."""
+    force=True. Accepts an ObjectRef or an ObjectRefGenerator (streaming
+    tasks resolve by task id). Returns whether a cancellation took effect."""
+    from ray_tpu.core.object_ref import ObjectRefGenerator
     from ray_tpu.core.runtime import Runtime, get_runtime
     rt = get_runtime()
+    key = (ref._task_id if isinstance(ref, ObjectRefGenerator)
+           else ref.id.binary())
     if isinstance(rt, Runtime):
-        return rt.cancel_task(ref.id.binary(), force=force)
-    return rt.request("cancel", (ref.id.binary(), force))
+        return rt.cancel_task(key, force=force)
+    return rt.request("cancel", (key, force))
 
 
 def kill(actor: ActorHandle, *, no_restart=True):

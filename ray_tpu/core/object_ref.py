@@ -69,3 +69,53 @@ class ObjectRef:
 
 def _deserialize_ref(id_bytes: bytes, owner):
     return ObjectRef(ObjectID(id_bytes), owner, _add_ref=False)
+
+
+class ObjectRefGenerator:
+    """Iterator over a streaming task's yields.
+
+    Parity: reference `python/ray/_raylet.pyx:280,295` (ObjectRefGenerator
+    for `num_returns="streaming"` tasks): each `next()` blocks until the
+    executing task yields its next value and returns an ObjectRef for it;
+    iteration ends when the task returns (StopIteration) and re-raises the
+    task's error if it failed mid-stream."""
+
+    def __init__(self, task_id: bytes, runtime):
+        self._task_id = task_id
+        self._rt = runtime
+        self._next_idx = 0
+        self._closed = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> ObjectRef:
+        if self._closed:
+            raise StopIteration
+        rid = self._rt.next_stream_item(self._task_id, self._next_idx)
+        if rid is None:
+            self._closed = True
+            raise StopIteration
+        self._next_idx += 1
+        return ObjectRef(ObjectID(rid), _add_ref=False)
+
+    def completed(self) -> bool:
+        return self._rt.stream_finished(self._task_id)
+
+    def close(self):
+        """Release the stream: unconsumed/future yields are discarded and
+        the producing task is cancelled best-effort. Called automatically
+        when the generator is garbage-collected."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._rt.release_stream(self._task_id)
+        except Exception:  # noqa: BLE001 — cleanup must not raise
+            pass
+
+    def __del__(self):
+        self.close()
+
+    def __repr__(self):
+        return f"ObjectRefGenerator({self._task_id.hex()[:12]})"

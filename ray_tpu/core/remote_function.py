@@ -74,13 +74,23 @@ class RemoteFunction:
 
         payload, buffers, refs = serialization.serialize_args(args, kwargs)
         num_returns = opts.get("num_returns", 1)
+        streaming = num_returns == "streaming"
+        if streaming:
+            # Generator task (parity: num_returns="streaming"): yields
+            # stream back one at a time; no fixed return ids. Retries are
+            # off — a half-streamed task must not silently replay.
+            if not isinstance(rt, Runtime):
+                raise ValueError(
+                    "streaming tasks can only be submitted from the driver")
+            num_returns = 0
         from ray_tpu.util import tracing as _tracing
         trace_ctx = _tracing.inject_context() if _tracing._enabled else None
         rnd = os.urandom(16 + 16 * num_returns)
         task_id = TaskID(rnd[:16])
         return_ids = [rnd[16 + 16 * i : 32 + 16 * i]
                       for i in range(num_returns)]
-        max_retries = opts.get("max_retries", get_config().task_max_retries_default)
+        max_retries = (0 if streaming else opts.get(
+            "max_retries", get_config().task_max_retries_default))
         spec = TaskSpec(
             task_id=task_id.binary(),
             fn_id=fn_id,
@@ -96,6 +106,7 @@ class RemoteFunction:
             scheduling_strategy=opts.get("scheduling_strategy"),
             dependencies=[r.id.binary() for r in refs],
             trace_ctx=trace_ctx,
+            streaming=streaming,
             runtime_env=opts.get("runtime_env"),
         )
         if isinstance(rt, Runtime):
@@ -105,6 +116,9 @@ class RemoteFunction:
                 rt.send(("export_fn", fn_id, fn_blob))
                 self._exported_in.add(os.getpid())
             rt.submit(spec)
+        if streaming:
+            from ray_tpu.core.object_ref import ObjectRefGenerator
+            return ObjectRefGenerator(task_id.binary(), rt)
         out = [ObjectRef(ObjectID(rid)) for rid in return_ids]
         return out[0] if num_returns == 1 else out
 

@@ -576,6 +576,7 @@ class Runtime:
         # entries drop when the task finishes or fails.
         self._rid_to_spec: dict[bytes, TaskSpec] = {}
         self._cancelled: set[bytes] = set()  # task_ids
+        self._streams: dict[bytes, dict] = {}  # streaming task state
         self.waiting_deps: dict[bytes, list] = {}  # oid -> [pending items]
         self.actors: dict[bytes, ActorState] = {}
         self.named_actors: dict[str, bytes] = {}
@@ -913,6 +914,16 @@ class Runtime:
             # _flush_replies): one frame, many task completions.
             for task_id, actor_id, outs in msg[1]:
                 self._on_task_done(w, task_id, actor_id, outs)
+        elif op == "stream_item":
+            # One yield from a streaming (generator) task.
+            task_id, (rid, status, payload, bufs) = msg[1], msg[2]
+            if status == "inline":
+                self.directory.put(rid, ("raw", payload, bufs, True))
+            elif status == "err":
+                self.directory.put(rid, ("raw", payload, bufs, False))
+            else:
+                self.directory.add_location(rid, w.node_id)
+            self._stream_append(task_id, rid)
         elif op == "ready":
             w.connected.set()
             with self.lock:
@@ -1685,6 +1696,12 @@ class Runtime:
         if fn_blob is not None:
             self.export_function(spec.fn_id, fn_blob)
         self.task_events.record(spec.task_id, spec, "SUBMITTED")
+        if spec.streaming:
+            self._register_stream(spec.task_id)
+            with self.lock:
+                # Keyed by task_id (no return ids): ray_tpu.cancel on the
+                # generator resolves through the same table.
+                self._rid_to_spec[spec.task_id] = spec
         with self.lock:
             for rid in spec.return_ids:
                 self._rid_to_spec[rid] = spec
@@ -1694,6 +1711,88 @@ class Runtime:
             self.refcount.pin(oid)
         item = {"kind": "task", "spec": spec, "pending": 0}
         self._gate_on_deps(item, spec.dependencies or [])
+
+    # ---------------- streaming tasks (ObjectRefGenerator) ----------------
+    #
+    # Parity: reference `num_returns="streaming"` generator tasks
+    # (_raylet.pyx:280,295 ObjectRefGenerator). The executing worker sends
+    # one "stream_item" per yield; the consumer's generator blocks in
+    # next_stream_item until the item lands (or the stream closes).
+
+    def _register_stream(self, task_id: bytes):
+        with self.lock:
+            self._streams[task_id] = {
+                "items": [], "done": False, "consumed": 0,
+                "abandoned": False,
+                "cv": threading.Condition(self.lock),
+            }
+
+    def _stream_append(self, task_id: bytes, rid: bytes):
+        with self.lock:
+            st = self._streams.get(task_id)
+            if st is None or st["abandoned"]:
+                # No consumer will ever read this yield: drop it now so an
+                # abandoned stream cannot grow driver memory unboundedly.
+                self.directory.discard(rid)
+                return
+            st["items"].append(rid)
+            st["cv"].notify_all()
+
+    def release_stream(self, task_id: bytes):
+        """Consumer dropped its ObjectRefGenerator: discard unconsumed
+        yields, drop future ones on arrival, and (best effort) cancel the
+        producing task."""
+        with self.lock:
+            st = self._streams.get(task_id)
+            if st is None:
+                return
+            st["abandoned"] = True
+            unread = st["items"][st["consumed"]:]
+            st["cv"].notify_all()
+        for rid in unread:
+            self.directory.discard(rid)
+        try:
+            self.cancel_task(task_id, force=False)
+        except Exception:  # noqa: BLE001 — cleanup is best effort
+            pass
+        with self.lock:
+            st = self._streams.get(task_id)
+            if st is not None and st["done"]:
+                self._streams.pop(task_id, None)
+
+    def _stream_close(self, task_id: bytes):
+        with self.lock:
+            st = self._streams.get(task_id)
+            if st is None:
+                return
+            st["done"] = True
+            st["cv"].notify_all()
+
+    def next_stream_item(self, task_id: bytes, idx: int,
+                         timeout: float | None = None):
+        """Blocks until yield #idx exists; returns its rid, or None when
+        the stream closed before producing it."""
+        with self.lock:
+            st = self._streams.get(task_id)
+            if st is None:
+                return None  # fully consumed + closed earlier
+            while len(st["items"]) <= idx and not st["done"]:
+                if not st["cv"].wait(timeout):
+                    from ray_tpu.core.status import GetTimeoutError
+                    raise GetTimeoutError(
+                        f"streaming task {task_id.hex()[:12]} produced no "
+                        f"item #{idx} in time")
+            if idx < len(st["items"]):
+                st["consumed"] = max(st["consumed"], idx + 1)
+                return st["items"][idx]
+            # closed and exhausted: drop the state
+            self._streams.pop(task_id, None)
+            return None
+
+    def stream_finished(self, task_id: bytes) -> bool:
+        with self.lock:
+            st = self._streams.get(task_id)
+            return st is None or st["done"]
 
     def cancel_task(self, rid: bytes, force: bool = False) -> bool:
         """Cancel the task owning return-oid `rid` (parity: ray.cancel,
@@ -2399,6 +2498,10 @@ class Runtime:
             for rid, _s, _p, _b in outs:
                 self._rid_to_spec.pop(rid, None)
             self._cancelled.discard(task_id)  # force-cancel lost the race
+        if task_id in self._streams:
+            self._stream_close(task_id)
+            with self.lock:
+                self._rid_to_spec.pop(task_id, None)
         if actor_id is not None:
             st = self.actors.get(actor_id)
             if st is not None:
@@ -2425,6 +2528,14 @@ class Runtime:
         err = exc if isinstance(exc, TaskError) else TaskError(
             exc, str(exc), spec.describe())
         self._unpin_deps(spec)
+        if spec.streaming:
+            # Surface the failure as the stream's final item, then close —
+            # the consumer's next() returns a ref whose get() raises.
+            rid = os.urandom(16)
+            payload, bufs, _ = serialization.serialize_value(err)
+            self.directory.put(rid, ("raw", payload, bufs, False))
+            self._stream_append(spec.task_id, rid)
+            self._stream_close(spec.task_id)
         with self.lock:
             # NOTE: _cancelled is NOT cleared here — a dep-gated cancelled
             # task still needs its tombstone when the deps arrive.

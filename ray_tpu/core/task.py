@@ -31,6 +31,7 @@ class TaskSpec:
         "runtime_env",    # {"env_vars": {...}, "working_dir": str,
                           #  "py_modules": [str]} | None
         "trace_ctx",      # W3C traceparent carrier dict | None (tracing)
+        "streaming",      # True = generator task (num_returns="streaming")
     )
 
     def __init__(self, **kw):

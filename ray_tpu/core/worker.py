@@ -376,6 +376,62 @@ def _execute(rt: WorkerRuntime, spec: TaskSpec, fn):
             rt, "actor_scheduling_strategy", None)
 
 
+def _execute_streaming(rt: WorkerRuntime, spec: TaskSpec, fn):
+    """Run a generator task: one "stream_item" per yield, then a normal
+    empty "done" (which closes the stream and re-idles this worker).
+    Parity: reference streaming generator execution (_raylet.pyx
+    execute_task's streaming path)."""
+    cfg = get_config()
+
+    def entry_for(value, status="inline-or-shm"):
+        rid = os.urandom(16)
+        payload, bufs, _ = serialization.serialize_value(value)
+        if status == "err":
+            return (rid, "err", payload, bufs)
+        nbytes = serialization.total_nbytes(payload, bufs)
+        if nbytes <= cfg.max_inline_object_bytes:
+            return (rid, "inline", payload, bufs)
+        _put_with_spill(rt, ObjectID(rid), value, nbytes)
+        return (rid, "shm", None, None)
+
+    renv_spec = getattr(spec, "runtime_env", None)
+    try:
+        for oid, (payload, bufs) in spec.inline_deps.items():
+            rt.object_cache[oid] = serialization.deserialize(payload, bufs)
+        args, kwargs = serialization.deserialize(spec.payload, spec.buffers)
+        args = [_resolve_arg(rt, a) for a in args]
+        kwargs = {k: _resolve_arg(rt, v) for k, v in kwargs.items()}
+        rt.current_task = spec
+        rt.current_scheduling_strategy = (
+            spec.scheduling_strategy
+            or getattr(rt, "actor_scheduling_strategy", None))
+        from ray_tpu.util import tracing as _tracing
+        ctx = (contextlib.nullcontext() if renv_spec is None
+               else _RuntimeEnv(renv_spec))
+        span = (_tracing.execute_span(spec.describe(),
+                                      getattr(spec, "trace_ctx", None))
+                if _tracing._enabled else contextlib.nullcontext())
+        with ctx, span:
+            gen = fn(*args, **kwargs)
+            if inspect.isasyncgen(gen):
+                raise TypeError(
+                    "async-generator streaming methods are not supported; "
+                    "use a sync generator (yield from an asyncio loop via "
+                    "run_until_complete if needed)")
+            for value in gen:
+                rt.send(("stream_item", spec.task_id, entry_for(value)))
+    except BaseException as e:  # noqa: BLE001 — errors ride the stream
+        err = TaskError.from_exception(e, spec.describe())
+        try:
+            rt.send(("stream_item", spec.task_id, entry_for(err, "err")))
+        except OSError:
+            pass
+    finally:
+        rt.current_scheduling_strategy = getattr(
+            rt, "actor_scheduling_strategy", None)
+    rt.send(("done", spec.task_id, spec.actor_id, []))
+
+
 def _reply_cancelled(rt: WorkerRuntime, spec: TaskSpec):
     from ray_tpu.core.status import TaskCancelledError
     _reply_result(rt, spec, "err", TaskError.from_exception(
@@ -527,6 +583,13 @@ def _run_actor_async(rt: WorkerRuntime, max_concurrency: int):
                 await loop.run_in_executor(None, _reply_cancelled, rt, spec)
                 continue
             fn = _actor_method(rt, spec)
+            if getattr(spec, "streaming", False):
+                # Sync-generator streaming works on async actors too: the
+                # generator runs on an executor thread (async generators
+                # are rejected inside _execute_streaming).
+                asyncio.ensure_future(loop.run_in_executor(
+                    None, _execute_streaming, rt, spec, fn))
+                continue
             asyncio.ensure_future(run_one(spec, fn))
 
     asyncio.run(main())
@@ -804,6 +867,9 @@ def _worker_main(store_path: str, worker_id: WorkerID, fd: int):
                     spec.describe())
                 _reply_result(rt, spec, "err", err)
                 continue
+        if getattr(spec, "streaming", False):
+            _execute_streaming(rt, spec, fn)
+            continue
         if pool is not None and spec.actor_id is not None:
             def run(sp=spec, f=fn):
                 status, result = _execute(rt, sp, f)

@@ -239,12 +239,16 @@ class _OpenAiRouterImpl:
                     body.get("prompt", ""),
                     max_tokens=body.get("max_tokens"),
                     temperature=body.get("temperature"),
+                    top_p=body.get("top_p", 1.0),
+                    top_k=body.get("top_k", 0),
                     model=body.get("model"))
             if path == "/v1/chat/completions":
                 return await self.server.chat.remote(
                     body.get("messages", []),
                     max_tokens=body.get("max_tokens"),
                     temperature=body.get("temperature"),
+                    top_p=body.get("top_p", 1.0),
+                    top_k=body.get("top_k", 0),
                     model=body.get("model"))
         except Exception as e:  # noqa: BLE001 — surface as API error
             return 400, {"error": str(e)}

@@ -324,3 +324,151 @@ def test_cancel_queued_actor_task(ray_start_isolated):
     assert ray_tpu.get(busy, timeout=30) == "slow"
     assert ray_tpu.get(a.quick.remote("later"), timeout=30) == "later"
     ray_tpu.kill(a)
+
+
+# ---- streaming (generator) tasks: ObjectRefGenerator ----
+
+
+def test_streaming_task_yields(ray_start_isolated):
+    @ray_tpu.remote(num_returns="streaming")
+    def counter(n):
+        for i in range(n):
+            yield i * 10
+
+    gen = counter.remote(4)
+    vals = [ray_tpu.get(ref, timeout=60) for ref in gen]
+    assert vals == [0, 10, 20, 30]
+    assert gen.completed()
+
+
+def test_streaming_large_yields_ride_shm(ray_start_isolated):
+    import numpy as np
+
+    @ray_tpu.remote(num_returns="streaming")
+    def big(n):
+        for i in range(n):
+            yield np.full(300_000, i, dtype=np.int32)  # > inline limit
+
+    out = [ray_tpu.get(r, timeout=60) for r in big.remote(3)]
+    assert [int(a[0]) for a in out] == [0, 1, 2]
+    assert all(a.nbytes == 1_200_000 for a in out)
+
+
+def test_streaming_midstream_error(ray_start_isolated):
+    @ray_tpu.remote(num_returns="streaming")
+    def flaky():
+        yield 1
+        raise ValueError("stream broke")
+
+    gen = flaky.remote()
+    refs = list(gen)
+    assert ray_tpu.get(refs[0], timeout=60) == 1
+    with pytest.raises(ValueError, match="stream broke"):
+        ray_tpu.get(refs[1], timeout=60)
+
+
+def test_streaming_consumer_overlaps_producer(ray_start_isolated):
+    """next() unblocks per yield — the consumer need not wait for the
+    whole task (the defining property vs num_returns=N)."""
+    import time
+
+    @ray_tpu.remote(num_returns="streaming")
+    def slow():
+        yield "first"
+        time.sleep(3)
+        yield "second"
+
+    @ray_tpu.remote
+    def warmup():
+        pass
+
+    ray_tpu.get(warmup.remote(), timeout=60)  # cold spawn is seconds here
+    gen = slow.remote()
+    t0 = time.monotonic()
+    first = ray_tpu.get(next(gen), timeout=60)
+    dt = time.monotonic() - t0
+    assert first == "first"
+    assert dt < 2.0, f"first item blocked on the whole task ({dt:.1f}s)"
+    assert ray_tpu.get(next(gen), timeout=60) == "second"
+
+
+def test_streaming_actor_method(ray_start_isolated):
+    @ray_tpu.remote
+    class Gen:
+        @ray_tpu.method(num_returns="streaming")
+        def stream(self, n):
+            for i in range(n):
+                yield i + 100
+
+    g = Gen.remote()
+    vals = [ray_tpu.get(r, timeout=60) for r in g.stream.remote(3)]
+    assert vals == [100, 101, 102]
+    # the actor keeps serving normal calls afterwards
+    assert [ray_tpu.get(r, timeout=60)
+            for r in g.stream.remote(1)] == [100]
+
+
+def test_streaming_abandoned_generator_drops_items(ray_start_isolated):
+    """Dropping the generator discards unconsumed yields (no unbounded
+    driver growth) and best-effort cancels the producer."""
+    import gc
+    import time
+
+    from ray_tpu.core.runtime import get_runtime
+
+    @ray_tpu.remote(num_returns="streaming")
+    def firehose():
+        for i in range(50):
+            yield i
+            time.sleep(0.02)
+
+    gen = firehose.remote()
+    ray_tpu.get(next(gen), timeout=60)  # consume one
+    task_id = gen._task_id
+    gen.close()
+    del gen
+    gc.collect()
+    rt = get_runtime()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        with rt.lock:
+            st = rt._streams.get(task_id)
+            abandoned = st is None or st["abandoned"]
+        if abandoned:
+            break
+        time.sleep(0.05)
+    assert abandoned
+    # later yields are not accumulating in the directory
+    with rt.lock:
+        st = rt._streams.get(task_id)
+        kept = len(st["items"]) if st else 0
+    assert kept <= 2
+
+
+def test_streaming_runtime_env(ray_start_isolated):
+    import os as _os
+
+    @ray_tpu.remote(num_returns="streaming",
+                    runtime_env={"env_vars": {"STREAM_VAR": "zz"}})
+    def env_stream():
+        yield _os.environ.get("STREAM_VAR")
+
+    vals = [ray_tpu.get(r, timeout=60) for r in env_stream.remote()]
+    assert vals == ["zz"]
+
+
+def test_streaming_on_async_actor(ray_start_isolated):
+    @ray_tpu.remote
+    class Mixed:
+        async def regular(self):
+            return "async-ok"
+
+        @ray_tpu.method(num_returns="streaming")
+        def stream(self, n):
+            for i in range(n):
+                yield i
+
+    m = Mixed.remote()
+    assert ray_tpu.get(m.regular.remote(), timeout=60) == "async-ok"
+    vals = [ray_tpu.get(r, timeout=60) for r in m.stream.remote(3)]
+    assert vals == [0, 1, 2]
