@@ -39,6 +39,12 @@ def timeit(fn, number) -> float:
 
 def main():
     import os
+    # TPU train-step bench first (owns the chip before workers spawn).
+    try:
+        import bench_tpu
+        tpu = bench_tpu.run()
+    except Exception as e:  # never let the TPU section kill the core bench
+        tpu = {"skipped": f"bench_tpu crashed: {str(e)[:200]}"}
     # 4GB arena: large puts recycle warm pages instead of faulting fresh ones.
     ray_tpu.init(num_cpus=max(4, (os.cpu_count() or 1)),
                  object_store_memory=4 << 30)
@@ -140,11 +146,15 @@ def main():
     geomean = math.exp(sum(math.log(r) for r in ratios) / len(ratios))
 
     ray_tpu.shutdown()
+    mfu = max((c["mfu_pct"] for c in tpu.get("configs", [])
+               if "mfu_pct" in c), default=None)
     print(json.dumps({
         "metric": "core_microbenchmark_geomean_vs_ray",
         "value": round(geomean, 3),
         "unit": "x (geomean of 9 core metrics vs Ray 2.44 on 64-CPU)",
         "vs_baseline": round(geomean, 3),
+        "tpu_mfu_pct": mfu,
+        "tpu": tpu,
     }))
 
 

@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from ray_tpu.ops.attention import flash_attention
-from ray_tpu.ops.layers import apply_rope, rmsnorm, rope
+from ray_tpu.ops.layers import apply_rope, rmsnorm, rope, swiglu
 
 
 @dataclasses.dataclass(frozen=True)
@@ -169,29 +169,19 @@ def _moe(x, lp, c: ModelConfig):
         jnp.arange(probs.shape[0])[:, None, None],
         jnp.arange(probs.shape[1])[None, :, None],
         top_i].set(top_w.astype(probs.dtype))                  # [b,s,X]
-    h = jnp.einsum("bsd,xdf->bsxf", x, lp["wg"],
-                   preferred_element_type=jnp.float32)
-    u = jnp.einsum("bsd,xdf->bsxf", x, lp["wu"],
-                   preferred_element_type=jnp.float32)
-    act = (jax.nn.silu(h) * u).astype(x.dtype)
-    y = jnp.einsum("bsxf,xfd->bsxd", act, lp["wd"],
-                   preferred_element_type=jnp.float32)
-    return jnp.einsum("bsxd,bsx->bsd", y, gate.astype(jnp.float32)
-                      ).astype(x.dtype)
+    h = jnp.einsum("bsd,xdf->bsxf", x, lp["wg"])
+    u = jnp.einsum("bsd,xdf->bsxf", x, lp["wu"])
+    act = jax.nn.silu(h) * u
+    y = jnp.einsum("bsxf,xfd->bsxd", act, lp["wd"])
+    return jnp.einsum("bsxd,bsx->bsd", y, gate.astype(x.dtype))
 
 
 def _mlp(x, lp):
-    g = jnp.einsum("bsd,df->bsf", x, lp["wg"],
-                   preferred_element_type=jnp.float32)
-    u = jnp.einsum("bsd,df->bsf", x, lp["wu"],
-                   preferred_element_type=jnp.float32)
-    h = (jax.nn.silu(g) * u).astype(x.dtype)
-    return jnp.einsum("bsf,fd->bsd", h, lp["wd"],
-                      preferred_element_type=jnp.float32).astype(x.dtype)
+    return swiglu(x, lp["wg"], lp["wu"], lp["wd"])
 
 
-def forward(params, tokens, config: ModelConfig, mesh=None):
-    """tokens [batch, seq] -> logits [batch, seq, vocab] (fp32)."""
+def hidden_states(params, tokens, config: ModelConfig, mesh=None):
+    """tokens [batch, seq] -> final-norm hidden states [batch, seq, d]."""
     c = config
     x = jnp.take(params["embed"], tokens, axis=0)
     positions = jnp.arange(tokens.shape[1])
@@ -208,24 +198,65 @@ def forward(params, tokens, config: ModelConfig, mesh=None):
     if c.remat:
         body = jax.checkpoint(layer_body)
     x, _ = jax.lax.scan(body, x, params["layers"])
-    x = rmsnorm(x, params["final_norm"], c.norm_eps)
-    head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
-    logits = jnp.einsum("bsd,dv->bsv", x.astype(jnp.float32),
-                        head.astype(jnp.float32))
-    return logits
+    return rmsnorm(x, params["final_norm"], c.norm_eps)
 
 
-def loss_fn(params, batch, config: ModelConfig, mesh=None):
+def forward(params, tokens, config: ModelConfig, mesh=None):
+    """tokens [batch, seq] -> logits [batch, seq, vocab] (fp32).
+
+    The head matmul keeps bf16 inputs with an fp32 accumulator
+    (preferred_element_type): full MXU rate, fp32 logits out — upcasting the
+    operands first would run the largest matmul in the model at fp32 rate.
+    """
+    x = hidden_states(params, tokens, config, mesh)
+    head = (params["embed"].T if config.tie_embeddings else params["lm_head"])
+    return jnp.einsum("bsd,dv->bsv", x, head,
+                      preferred_element_type=jnp.float32)
+
+
+def _xent(x, head, targets):
+    """Cross entropy of one sequence chunk; logits never leave this scope.
+
+    Gathers target logits and subtracts the row logsumexp directly rather
+    than materializing the full log-softmax tensor (which would double the
+    [b, s, vocab] fp32 footprint)."""
+    logits = jnp.einsum("bsd,dv->bsv", x, head,
+                        preferred_element_type=jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return tgt - lse
+
+
+def loss_fn(params, batch, config: ModelConfig, mesh=None,
+            loss_chunk: int = 512):
     """Next-token cross entropy; batch = {"tokens": [b, s+1]} or
-    {"inputs": [b,s], "targets": [b,s]}."""
+    {"inputs": [b,s], "targets": [b,s]}.
+
+    The [b, s, vocab] fp32 logits tensor dominates training HBM at scale, so
+    the head+softmax runs in rematerialized sequence chunks: peak logits
+    memory is b*loss_chunk*vocab and the backward recomputes each chunk.
+    """
     if "tokens" in batch:
         inputs = batch["tokens"][:, :-1]
         targets = batch["tokens"][:, 1:]
     else:
         inputs, targets = batch["inputs"], batch["targets"]
-    logits = forward(params, inputs, config, mesh)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    x = hidden_states(params, inputs, config, mesh)
+    head = (params["embed"].T if config.tie_embeddings else params["lm_head"])
+    b, s, d = x.shape
+    # Chunk only when the full fp32 logits tensor would be large enough to
+    # matter (>1 GiB); below that the extra scan costs more than it saves.
+    if (s % loss_chunk == 0 and s > loss_chunk
+            and 4 * b * s * config.vocab > (1 << 30)):
+        nc = s // loss_chunk
+        xc = x.reshape(b, nc, loss_chunk, d).transpose(1, 0, 2, 3)
+        tc = targets.reshape(b, nc, loss_chunk).transpose(1, 0, 2)
+        ll = jax.lax.map(
+            jax.checkpoint(lambda args: _xent(args[0], head, args[1])),
+            (xc, tc))                                # [nc, b, loss_chunk]
+        ll = ll.transpose(1, 0, 2).reshape(b, s)
+    else:
+        ll = _xent(x, head, targets)
     mask = batch.get("mask")
     if mask is None:
         return -jnp.mean(ll)

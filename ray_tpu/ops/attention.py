@@ -1,14 +1,18 @@
-"""Flash attention: Pallas TPU kernel with online softmax.
+"""Flash attention: Pallas TPU kernels (forward + backward) with online
+softmax.
 
 The hot op of the model family (SURVEY §2.4 / pallas_guide.md). Tiled for the
 MXU: grid = (batch*heads, q_blocks, k_blocks), fp32 accumulators in VMEM
-scratch that persist across the innermost k dimension, causal blocks
+scratch that persist across the innermost grid dimension, causal blocks
 predicated with @pl.when so fully-masked tiles cost nothing. Falls back to a
-jnp reference off-TPU (tests run the kernel in interpret mode to check the
+jnp reference off-TPU (tests run the kernels in interpret mode to check the
 exact same code path).
 
-Backward: custom_vjp with recompute (flash-style) expressed in jnp — XLA
-fuses it well; a Pallas backward kernel is a later optimization.
+Backward: flash-style recompute in two Pallas kernels (dq; dkv), bf16 matmul
+inputs with fp32 MXU accumulation. The forward saves the per-row logsumexp
+(replicated along a 128-lane minor dim so both backward kernels read it in
+their natural layout without in-kernel relayouts). A jnp recompute backward
+(`impl="reference"`) remains as the numerics oracle.
 """
 
 from __future__ import annotations
@@ -23,8 +27,12 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-                scale: float, causal: bool, bq: int, bk: int, nk: int):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale: float, causal: bool,
+                bq: int, bk: int, nk: int, with_lse: bool):
+    if with_lse:
+        lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        m_scr, l_scr, acc_scr = rest
     ki = pl.program_id(2)
     qi = pl.program_id(1)
 
@@ -62,29 +70,43 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
 
     @pl.when(ki == nk - 1)
     def _finalize():
-        o_ref[0] = (acc_scr[:] /
-                    jnp.maximum(l_scr[:, :1], 1e-30)).astype(o_ref.dtype)
+        l = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        if with_lse:
+            # logsumexp per row, replicated along the 128-lane minor dim so
+            # the backward kernels read it without relayouts.
+            lse_ref[0] = jnp.broadcast_to(m_scr[:, :1] + jnp.log(l),
+                                          lse_ref.shape[1:])
 
 
-def _flash_fwd(q, k, v, scale, causal, bq, bk, interpret):
-    """q,k,v: [BH, S, D] -> out [BH, S, D]."""
+def _flash_fwd(q, k, v, scale, causal, bq, bk, interpret, with_lse=True):
+    """q,k,v: [BH, S, D] -> (out [BH, S, D], lse [BH, S, 128] f32) when
+    with_lse, else out alone (primal-only path: a pallas_call output cannot
+    be DCE'd, so the inference path must not emit the lse at all)."""
     bh, s, d = q.shape
     bq = min(bq, s)
     bk = min(bk, s)
     nq = pl.cdiv(s, bq)
     nk = pl.cdiv(s, bk)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               bq=bq, bk=bk, nk=nk)
+                               bq=bq, bk=bk, nk=nk, with_lse=with_lse)
+    out_shape = jax.ShapeDtypeStruct((bh, s, d), q.dtype)
+    out_spec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))
+    if with_lse:
+        out_shape = (out_shape,
+                     jax.ShapeDtypeStruct((bh, s, 128), jnp.float32))
+        out_spec = (out_spec,
+                    pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)))
     return pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        out_shape=out_shape,
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        out_specs=out_spec,
         scratch_shapes=[
             pltpu.VMEM((bq, 128), jnp.float32),   # running max
             pltpu.VMEM((bq, 128), jnp.float32),   # running sum
@@ -98,6 +120,155 @@ def _flash_fwd(q, k, v, scale, causal, bq, bk, interpret):
             bytes_accessed=3 * bh * s * d * q.dtype.itemsize,
             transcendentals=bh * s * s),
     )(q, k, v)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, scale: float, causal: bool, bq: int, bk: int,
+                   nk: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    run = True if not causal else (ki * bk <= qi * bq + bq - 1)
+
+    @pl.when(run)
+    def _compute():
+        s = jax.lax.dot_general(
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale       # [bq, bk]
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + qi * bq
+            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ki * bk
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, :1])                     # [bq, bk]
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)                # [bq, bk]
+        ds = p * (dp - delta_ref[0][:, :1]) * scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                # [bq, d]
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
+                    causal: bool, bq: int, bk: int, nq: int):
+    ki = pl.program_id(1)
+    qj = pl.program_id(2)
+
+    @pl.when(qj == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    # q blocks entirely before this k block contribute nothing under causal.
+    run = True if not causal else (qj * bq + bq - 1 >= ki * bk)
+
+    @pl.when(run)
+    def _compute():
+        # Work in the transposed orientation [bk, bq]: the per-q-row lse and
+        # delta then broadcast along sublanes, which is free on TPU.
+        st = jax.lax.dot_general(
+            k_ref[0], q_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale        # [bk, bq]
+        if causal:
+            krows = jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 0) + ki * bk
+            qcols = jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 1) + qj * bq
+            st = jnp.where(qcols >= krows, st, NEG_INF)
+        pt = jnp.exp(st - lse_ref[0][:1])                      # [bk, bq]
+        dpt = jax.lax.dot_general(
+            v_ref[0], do_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)                # [bk, bq]
+        dst = pt * (dpt - delta_ref[0][:1]) * scale
+        dv_scr[:] += jax.lax.dot_general(
+            pt.astype(do_ref.dtype), do_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                # [bk, d]
+        dk_scr[:] += jax.lax.dot_general(
+            dst.astype(q_ref.dtype), q_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                # [bk, d]
+
+    @pl.when(qj == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, g, scale, causal, bq, bk, interpret):
+    """Backward via flash-style recompute. lse: flat [BH, S] from forward."""
+    bh, s, d = q.shape
+    bq = min(bq, s)
+    bk = min(bk, s)
+    nq = pl.cdiv(s, bq)
+    nk = pl.cdiv(s, bk)
+    # delta_i = rowsum(dO_i * O_i). Both lse and delta are fed to the dq
+    # kernel lane-replicated [BH, S, 128] and to the dkv kernel transposed
+    # [BH, 8, S] (seq along lanes) — each kernel reads its natural layout.
+    delta_flat = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
+                         axis=-1)                              # [BH, S]
+    delta = jnp.broadcast_to(delta_flat[..., None], (bh, s, 128))
+    lse_rep = jnp.broadcast_to(lse[..., None], (bh, s, 128))
+    lse_t = jnp.broadcast_to(lse[:, None, :], (bh, 8, s))
+    delta_t = jnp.broadcast_to(delta_flat[:, None, :], (bh, 8, s))
+    g = g.astype(q.dtype)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nk=nk),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),    # q
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),    # k
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),    # v
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),    # do
+            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),  # lse
+            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),  # delta
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        cost_estimate=pl.CostEstimate(
+            flops=6 * bh * s * s * d // (2 if causal else 1),
+            bytes_accessed=4 * bh * s * d * q.dtype.itemsize,
+            transcendentals=bh * s * s),
+    )(q, k, v, g, lse_rep, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nq=nq),
+        out_shape=(jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, s, d), v.dtype)),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0)),    # k
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0)),    # v
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, j, 0)),    # q
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, j, 0)),    # do
+            pl.BlockSpec((1, 8, bq), lambda b, i, j: (b, 0, j)),    # lse_t
+            pl.BlockSpec((1, 8, bq), lambda b, i, j: (b, 0, j)),    # delta_t
+        ],
+        out_specs=(pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0)),
+                   pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0))),
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        cost_estimate=pl.CostEstimate(
+            flops=6 * bh * s * s * d // (2 if causal else 1),
+            bytes_accessed=4 * bh * s * d * q.dtype.itemsize,
+            transcendentals=bh * s * s),
+    )(k, v, q, g, lse_t, delta_t)
+    return dq, dk, dv
 
 
 def _reference(q, k, v, scale, causal):
@@ -119,28 +290,37 @@ def _on_tpu() -> bool:
         return False
 
 
+_BQ = 512
+_BK = 512
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _flash(q, k, v, scale, causal, impl):
-    return _flash_dispatch(q, k, v, scale, causal, impl)
-
-
-def _flash_dispatch(q, k, v, scale, causal, impl):
     if impl == "reference":
         return _reference(q, k, v, scale, causal)
-    return _flash_fwd(q, k, v, scale, causal, bq=512, bk=512,
-                      interpret=(impl == "interpret"))
+    return _flash_fwd(q, k, v, scale, causal, bq=_BQ, bk=_BK,
+                      interpret=(impl == "interpret"), with_lse=False)
 
 
 def _flash_vjp_fwd(q, k, v, scale, causal, impl):
-    return _flash_dispatch(q, k, v, scale, causal, impl), (q, k, v)
+    if impl == "reference":
+        return _reference(q, k, v, scale, causal), (q, k, v, None, None)
+    out, lse = _flash_fwd(q, k, v, scale, causal, bq=_BQ, bk=_BK,
+                          interpret=(impl == "interpret"))
+    # Save the flat [BH, S] logsumexp — the lane-replicated form would
+    # multiply the per-layer residual footprint by 128.
+    return out, (q, k, v, out, lse[:, :, 0])
 
 
 def _flash_vjp_bwd(scale, causal, impl, res, g):
-    q, k, v = res
-    # Recompute-based backward in jnp; correct and XLA-fused.
-    _, vjp = jax.vjp(lambda q, k, v: _reference(q, k, v, scale, causal),
-                     q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    if impl == "reference":
+        # jnp recompute backward — the numerics oracle.
+        _, vjp = jax.vjp(lambda q, k, v: _reference(q, k, v, scale, causal),
+                         q, k, v)
+        return vjp(g)
+    return _flash_bwd(q, k, v, o, lse, g, scale, causal, bq=_BQ, bk=_BK,
+                      interpret=(impl == "interpret"))
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -162,6 +342,11 @@ def flash_attention(q, k, v, causal: bool = True, scale: float | None = None,
     scale = scale if scale is not None else d ** -0.5
     if impl == "auto":
         impl = "pallas" if _on_tpu() else "reference"
+    if impl in ("pallas", "interpret") and (s % min(_BQ, s) or s % min(_BK, s)):
+        # The kernels assume the sequence tiles exactly into the block size
+        # (partial pallas blocks are padded with undefined values, which the
+        # dkv accumulation would fold into valid rows).
+        impl = "reference"
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
     kt = k.transpose(0, 2, 1, 3).reshape(b * h, s, d)
     vt = v.transpose(0, 2, 1, 3).reshape(b * h, s, d)

@@ -43,11 +43,10 @@ def apply_rope(x, sin, cos):
 
 
 def swiglu(x, w_gate, w_up, w_down):
-    """SwiGLU MLP: silu(x@Wg) * (x@Wu) @ Wd, fp32 matmul accumulation."""
-    g = jnp.einsum("bse,ef->bsf", x, w_gate,
-                   preferred_element_type=jnp.float32)
-    u = jnp.einsum("bse,ef->bsf", x, w_up,
-                   preferred_element_type=jnp.float32)
-    h = (jax.nn.silu(g) * u).astype(x.dtype)
-    return jnp.einsum("bsf,fe->bse", h, w_down,
-                      preferred_element_type=jnp.float32).astype(x.dtype)
+    """SwiGLU MLP: silu(x@Wg) * (x@Wu) @ Wd. Outputs stay in x.dtype — the
+    MXU accumulates in fp32 regardless, and fp32 outputs double HBM traffic
+    and the AD-saved residual footprint."""
+    g = jnp.einsum("bse,ef->bsf", x, w_gate)
+    u = jnp.einsum("bse,ef->bsf", x, w_up)
+    h = jax.nn.silu(g) * u
+    return jnp.einsum("bsf,fe->bse", h, w_down)

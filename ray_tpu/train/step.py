@@ -91,6 +91,13 @@ def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
         return TrainState(new_params, new_opt, state.step + 1), loss
 
     def compile_for(state: TrainState, sample_batch):
+        if mesh.devices.size == 1:
+            # Single-chip: every NamedSharding is the trivial one, so skip the
+            # annotations entirely. Semantically identical, and measurably
+            # faster on backends where sharded executables take a slower
+            # dispatch path (the axon-tunneled chip round-trips buffers per
+            # call when in/out shardings are present: ~25x step-time blowup).
+            return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
         state_shardings = TrainState(
             params=param_shardings,
             opt_state=opt_shardings(state.opt_state, state.params),
