@@ -980,6 +980,17 @@ class Runtime:
         with self.lock:
             return self.kv.pop(key, None)
 
+    def kv_putnx(self, key, value) -> bool:
+        """Atomic put-if-absent; returns True if the key already existed
+        (and was left untouched). The worker-side overwrite=False path must
+        go through this — a get-then-put over two RPCs lets two workers
+        both observe absence and both write."""
+        with self.lock:
+            existed = key in self.kv
+            if not existed:
+                self.kv[key] = value
+            return existed
+
     def kv_incr(self, key) -> int:
         """Atomic counter increment (serialized by the head lock); the
         primitive behind barriers/rendezvous — a get-then-put from N workers
@@ -1000,8 +1011,11 @@ class Runtime:
         elif what == "kv_get":
             resp = self.kv.get(arg)
         elif what == "kv_put":
-            self.kv[arg[0]] = arg[1]
-            resp = True
+            with self.lock:
+                resp = arg[0] in self.kv  # 'existed', the API's return value
+                self.kv[arg[0]] = arg[1]
+        elif what == "kv_putnx":
+            resp = self.kv_putnx(arg[0], arg[1])
         elif what == "kv_del":
             self.kv.pop(arg, None)
             resp = True
@@ -1767,6 +1781,11 @@ class Runtime:
                 return
             st["done"] = True
             st["cv"].notify_all()
+            if st.get("abandoned"):
+                # The consumer already dropped its generator; nobody will
+                # ever read this stream again — drop the state now or it
+                # leaks for the life of the driver.
+                self._streams.pop(task_id, None)
 
     def next_stream_item(self, task_id: bytes, idx: int,
                          timeout: float | None = None):
@@ -2541,6 +2560,9 @@ class Runtime:
             # task still needs its tombstone when the deps arrive.
             for rid in spec.return_ids:
                 self._rid_to_spec.pop(rid, None)
+            if spec.streaming:
+                # Streaming specs are keyed by task_id, not return ids.
+                self._rid_to_spec.pop(spec.task_id, None)
         for rid in spec.return_ids:
             self.directory.put(rid, ("err", err))
             self._on_object_ready(rid)

@@ -105,6 +105,16 @@ def _carries_raw_buffers(msg) -> bool:
                         isinstance(v, (memoryview, list)) and v
                         for v in e):
                     return True
+        elif type(x) is tuple:
+            # ('stream_item', task_id, (rid, status, payload, bufs)) — the
+            # entry tuple is a direct element of msg; missing it here means
+            # every large streaming yield pickles twice (fast path raises
+            # TypeError on the memoryview, then re-serializes).
+            for v in x:
+                if isinstance(v, memoryview) or (
+                        type(v) is list and v and
+                        any(isinstance(b, memoryview) for b in v)):
+                    return True
     return False
 
 

@@ -28,7 +28,9 @@ def _internal_kv_initialized() -> bool:
 
 
 def _internal_kv_put(key, value, overwrite: bool = True) -> bool:
-    """Returns True if the key already existed."""
+    """Returns True if the key already existed. overwrite=False is atomic
+    (single head-side check-and-set, like the reference's GCS KV PUT) —
+    concurrent writers cannot both win."""
     rt = _rt()
     if _is_head(rt):
         with rt.lock:
@@ -36,10 +38,9 @@ def _internal_kv_put(key, value, overwrite: bool = True) -> bool:
             if overwrite or not existed:
                 rt.kv[key] = value
         return existed
-    existed = rt.request("kv_get", key) is not None
-    if overwrite or not existed:
-        rt.request("kv_put", (key, value))
-    return existed
+    if overwrite:
+        return rt.request("kv_put", (key, value))
+    return rt.request("kv_putnx", (key, value))
 
 
 def _internal_kv_get(key):

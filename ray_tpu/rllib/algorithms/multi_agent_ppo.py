@@ -123,7 +123,8 @@ class MultiAgentPPO:
                 adv, ret = _gae(
                     jnp.asarray(f["rewards"]), jnp.asarray(f["values"]),
                     jnp.asarray(f["dones"]), jnp.asarray(f["last_values"]),
-                    gamma=c.gamma, lam=c.lambda_)
+                    gamma=c.gamma, lam=c.lambda_,
+                    bootstrap=jnp.asarray(f["bootstrap"]))
                 f["advantages"] = np.asarray(adv)
                 f["returns"] = np.asarray(ret)
                 parts.append(f)

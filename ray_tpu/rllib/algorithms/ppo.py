@@ -42,19 +42,30 @@ class PPOConfig(AlgorithmConfig):
 
 
 @functools.partial(jax.jit, static_argnames=("gamma", "lam"))
-def _gae(rewards, values, dones, last_values, *, gamma, lam):
+def _gae(rewards, values, dones, last_values, *, gamma, lam,
+         bootstrap=None):
     """Generalized advantage estimation over [T, B] via lax.scan
-    (time-reversed; no Python loop under jit)."""
+    (time-reversed; no Python loop under jit).
+
+    `dones` marks episode boundaries (terminated OR truncated): the lambda
+    chain always cuts there. `bootstrap`, when given, holds the value of the
+    post-step state at boundary rows — zero for true terminations, V(s_next)
+    for truncations — so truncated episodes are bootstrapped instead of
+    treated as if the return were zero."""
+    if bootstrap is None:
+        bootstrap = jnp.zeros_like(rewards)
+
     def step(carry, xs):
-        r, v, d, v_next = xs
-        delta = r + gamma * v_next * (1.0 - d) - v
+        r, v, d, v_next, bv = xs
+        v_eff = (1.0 - d) * v_next + d * bv
+        delta = r + gamma * v_eff - v
         adv = delta + gamma * lam * (1.0 - d) * carry
         return adv, adv
 
     v_next = jnp.concatenate([values[1:], last_values[None]], axis=0)
     _, advs = jax.lax.scan(
         step, jnp.zeros_like(last_values),
-        (rewards, values, dones, v_next), reverse=True)
+        (rewards, values, dones, v_next, bootstrap), reverse=True)
     return advs, advs + values
 
 

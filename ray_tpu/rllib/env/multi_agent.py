@@ -101,7 +101,11 @@ class MultiAgentEnvRunner:
                 "values": np.empty((T, n), np.float32),
                 "rewards": np.empty((T, n), np.float32),
                 "dones": np.empty((T, n), np.float32),
-                "terminateds": np.empty((T, n), np.float32),
+                # Value of the post-step state at episode boundaries: zero
+                # for terminations, V(final next obs) for truncations — the
+                # GAE bootstrap (a truncated episode must not be value-cut
+                # to zero as if it had ended).
+                "bootstrap": np.zeros((T, n), np.float32),
             }
         for t in range(T):
             action_dict = {}
@@ -125,10 +129,19 @@ class MultiAgentEnvRunner:
                 for i, aid in enumerate(agents):
                     b["rewards"][t, i] = rew.get(aid, 0.0)
                     b["dones"][t, i] = float(done_all)
-                    b["terminateds"][t, i] = float(term_all)
             self._ep_ret += sum(rew.values())
             self._ep_len += 1
             if done_all:
+                if not term_all:
+                    # Truncated, not terminated: bootstrap with the value of
+                    # the final next obs (evaluated before the reset wipes
+                    # it).
+                    self._obs = nxt
+                    for pid, agents in self.policy_agents.items():
+                        self._key, sub = jax.random.split(self._key)
+                        _, _, bval = self._explore[pid](
+                            params[pid], self._stack(pid), sub)
+                        bufs[pid]["bootstrap"][t] = np.asarray(bval)
                 self.completed_returns.append(self._ep_ret)
                 self.completed_lengths.append(self._ep_len)
                 self._ep_ret, self._ep_len = 0.0, 0

@@ -44,14 +44,32 @@ class _SocketIO:
             pass
 
 
+def _node_ip() -> str:
+    """The IP other nodes can reach this worker on (outbound-route probe:
+    a UDP connect sends no packets but resolves the egress interface —
+    hostname lookup often lands on 127.0.1.1)."""
+    try:
+        probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        probe.connect(("8.8.8.8", 80))
+        ip = probe.getsockname()[0]
+        probe.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"
+
+
 def set_trace(breakpoint_uuid: str | None = None):
     """Block until a debugger client connects, then drop into pdb."""
     import pdb
 
     lsock = socket.socket()
-    lsock.bind(("127.0.0.1", 0))
+    # Bind all interfaces and advertise the node's routable IP: on a
+    # non-head node a 127.0.0.1 address would be unreachable from the
+    # driver (reference rpdb advertises the node IP the same way).
+    lsock.bind(("0.0.0.0", 0))
     lsock.listen(1)
-    host, port = lsock.getsockname()
+    _, port = lsock.getsockname()
+    host = _node_ip()
     addr = f"{host}:{port}"
     tag = breakpoint_uuid or str(os.getpid())
     print(f"rpdb: waiting for debugger on {addr} "
