@@ -148,10 +148,8 @@ class ActorMethod:
         rt = get_runtime()
         streaming = num_returns == "streaming"
         if streaming:
-            if not isinstance(rt, Runtime):
-                raise ValueError(
-                    "streaming actor calls can only be submitted from "
-                    "the driver")
+            # Submittable from the driver or any worker: workers consume
+            # the stream through head-side stream_next RPCs.
             num_returns = 0
         args = [_promote_large(rt, a) for a in args]
         kwargs = {k: _promote_large(rt, v) for k, v in kwargs.items()}

@@ -33,8 +33,17 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "worker_startup_timeout_s": (float, 60.0, "time to wait for a worker to boot"),
     "worker_idle_timeout_s": (float, 300.0, "idle workers above pool size are reaped"),
     "max_pending_lease_requests": (int, 10, "in-flight lease requests per scheduling key"),
+    "max_tasks_in_flight_per_worker": (int, 4, "same-key tasks pipelined "
+                                      "onto one busy worker (depth-K "
+                                      "dispatch; 1 disables pipelining)"),
     "task_max_retries_default": (int, 3, "default retries for idempotent tasks"),
     "actor_max_restarts_default": (int, 0, "default actor restarts"),
+    # --- lineage reconstruction (parity: object_recovery_manager.h:43,
+    #     task_manager.h:216 lineage resubmission) ---
+    "max_object_reconstructions": (int, 3, "times a task is re-executed to "
+                                   "recover its lost plasma-tier outputs"),
+    "lineage_cache_entries": (int, 50000, "max finished-task specs retained "
+                              "for reconstruction; 0 disables lineage"),
     # --- memory / OOM (parity: memory_monitor.h + worker killing policy) ---
     "memory_monitor_refresh_ms": (int, 0, "OOM monitor interval; 0 = off"),
     "memory_usage_threshold": (float, 0.95, "kill a worker above this usage"),

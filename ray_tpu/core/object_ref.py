@@ -63,7 +63,16 @@ class ObjectRef:
     def __reduce__(self):
         # Crossing a process boundary: the receiver becomes a borrower; it
         # reconstructs a weak ref and resolves the value through the shm store
-        # (or the inline-deps table shipped with the task).
+        # (or the inline-deps table shipped with the task). An owned ref that
+        # escapes this way must never be eagerly freed by its owner again.
+        try:
+            from ray_tpu.core.runtime import current_runtime
+            rt = current_runtime()
+            mark = getattr(getattr(rt, "refcount", None), "mark_escaped", None)
+            if mark is not None:
+                mark(self.id)
+        except Exception:  # noqa: BLE001 — marking is safety, not liveness
+            pass
         return (_deserialize_ref, (self.id.binary(), self.owner))
 
 

@@ -46,6 +46,10 @@ class ReferenceCounter:
         if free and self._free_callback:
             self._free_callback(key)
 
+    def is_pinned(self, key: bytes) -> bool:
+        with self._lock:
+            return key in self._pins
+
     def pin(self, key: bytes):
         with self._lock:
             self._pins[key] = self._pins.get(key, 0) + 1

@@ -78,10 +78,8 @@ class RemoteFunction:
         if streaming:
             # Generator task (parity: num_returns="streaming"): yields
             # stream back one at a time; no fixed return ids. Retries are
-            # off — a half-streamed task must not silently replay.
-            if not isinstance(rt, Runtime):
-                raise ValueError(
-                    "streaming tasks can only be submitted from the driver")
+            # off — a half-streamed task must not silently replay. Workers
+            # consume the stream through head-side stream_next RPCs.
             num_returns = 0
         from ray_tpu.util import tracing as _tracing
         trace_ctx = _tracing.inject_context() if _tracing._enabled else None

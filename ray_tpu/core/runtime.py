@@ -143,9 +143,16 @@ class WorkerHandle:
         self.state = IDLE
         self.connected = threading.Event()
         self.registered_fns: set[bytes] = set()
-        self.current_task: TaskSpec | None = None
+        # FIFO of specs dispatched to this worker and not yet completed:
+        # [0] is executing, the rest are pipelined behind it (depth-K
+        # dispatch, parity: max_tasks_in_flight_per_worker lease reuse).
+        self.assigned: collections.deque[TaskSpec] = collections.deque()
         self.actor_id: bytes | None = None
         self.buffer = FrameBuffer()
+
+    @property
+    def current_task(self) -> "TaskSpec | None":
+        return self.assigned[0] if self.assigned else None
 
     def send(self, msg):
         send_msg(self.sock, msg, self.send_lock)
@@ -572,10 +579,22 @@ class Runtime:
         # Per-scheduling-key task queues (parity: normal_task_submitter.h:58
         # SchedulingKey — one reserve probe covers every queued sibling).
         self.task_queues: dict[tuple, collections.deque] = {}
+        # scheduling-key -> busy workers executing that key (pipelining
+        # candidates); pruned lazily as workers go idle/die.
+        self._sig_workers: dict[tuple, set] = {}
         # return-oid -> live TaskSpec (cancel() resolves refs to tasks);
         # entries drop when the task finishes or fails.
         self._rid_to_spec: dict[bytes, TaskSpec] = {}
         self._cancelled: set[bytes] = set()  # task_ids
+        # --- lineage (parity: reference_count.h:72 lineage pinning,
+        #     object_recovery_manager.h:43): specs of FINISHED normal tasks,
+        #     retained so lost plasma-tier outputs can be recomputed.
+        self._lineage: dict[bytes, TaskSpec] = {}        # return-oid -> spec
+        self._lineage_live: dict[bytes, set] = {}        # task_id -> live rids
+        self._lineage_pins: dict[bytes, int] = {}        # oid -> #dependents
+        self._lineage_freed: set[bytes] = set()          # freed while pinned
+        self._reconstructing: set[bytes] = set()         # task_ids in flight
+        self._reconstruct_count: dict[bytes, int] = {}   # task_id -> attempts
         self._streams: dict[bytes, dict] = {}  # streaming task state
         self.waiting_deps: dict[bytes, list] = {}  # oid -> [pending items]
         self.actors: dict[bytes, ActorState] = {}
@@ -949,6 +968,12 @@ class Runtime:
         elif op == "put_notify":
             self.directory.add_location(msg[1], w.node_id)
             self._on_object_ready(msg[1])
+        elif op == "free_put":
+            # Owning worker dropped the last local handle of its own put()
+            # and the ref never escaped — safe to free cluster-wide, unless
+            # a task referencing it is in flight (pinned).
+            if not self.refcount.is_pinned(msg[1]):
+                self._free_object(msg[1])
         elif op == "submit":
             spec: TaskSpec = msg[1]
             self.submit_task(spec, fn_blob=None)
@@ -1016,6 +1041,25 @@ class Runtime:
                 self.kv[arg[0]] = arg[1]
         elif what == "kv_putnx":
             resp = self.kv_putnx(arg[0], arg[1])
+        elif what == "stream_next":
+            # Parked callback, not a thread: the reply fires from
+            # _stream_append/_stream_close when the yield lands (one parked
+            # entry per consumed item instead of one thread per RPC).
+            task_id, idx, _timeout = arg
+
+            def reply(rid, w=w, req_id=req_id):
+                try:
+                    w.send(("resp", req_id, rid))
+                except OSError:
+                    pass
+
+            self.stream_item_or_park(task_id, idx, reply)
+            return
+        elif what == "stream_finished":
+            resp = self.stream_finished(arg)
+        elif what == "stream_release":
+            self.release_stream(arg)
+            resp = True
         elif what == "kv_del":
             self.kv.pop(arg, None)
             resp = True
@@ -1302,6 +1346,35 @@ class Runtime:
         else:
             raise RayTpuError(f"head: unknown node message {op}")
 
+    def _park_fetch_for_reconstruction(self, dest: NodeState, oid: bytes,
+                                       key) -> bool:
+        """If `oid` is being recomputed from lineage, park this fetch's
+        callbacks until the fresh copy lands, then re-route them. Returns
+        True when parked (the caller must not fail the fetch)."""
+        with self.lock:
+            spec = self._lineage.get(oid)
+            if spec is None or spec.task_id not in self._reconstructing:
+                return False
+            info = self._fetches.pop(key, None)
+        cbs = (info or {}).get("cbs", [])
+        if not cbs:
+            return True
+
+        def on_entry(entry, dest=dest, oid=oid, cbs=cbs):
+            from ray_tpu.core.status import ObjectLostError
+            for cb in cbs:
+                if entry[0] == "shm":
+                    self._fetch_to_node(dest, oid, cb)
+                elif entry[0] == "err":
+                    cb(False, entry[1])
+                else:
+                    # Deterministic re-execution should reproduce the same
+                    # storage tier; a raw/inline rebirth is unexpected here.
+                    cb(False, ObjectLostError(ObjectID(oid)))
+
+        self.directory.on_ready(oid, on_entry)
+        return True
+
     def _fetch_to_node(self, dest: NodeState, oid: bytes, done_cb):
         """Materialize `oid` in `dest`'s store; done_cb(ok, err) when done.
         Non-blocking; safe to call from the listener thread."""
@@ -1318,6 +1391,9 @@ class Runtime:
         entry = self.directory.lookup(oid)
         from ray_tpu.core.status import ObjectLostError
         if entry is None or entry[0] != "shm":
+            if entry is None and self._park_fetch_for_reconstruction(
+                    dest, oid, key):
+                return
             self._finish_fetch(key, False, ObjectLostError(ObjectID(oid)))
             return
         locs = entry[1] if len(entry) > 1 else {self.head_node_id}
@@ -1342,6 +1418,21 @@ class Runtime:
                                            ObjectLostError(ObjectID(oid)))
                 threading.Thread(target=restore, daemon=True).start()
                 return
+            # Discard BEFORE deciding (same ordering as the node-death
+            # path): a reconstruction completing mid-decision re-adds its
+            # fresh entry after, instead of having it wiped.
+            self.directory.discard(oid)
+            if self._maybe_reconstruct(oid):
+                if self._park_fetch_for_reconstruction(dest, oid, key):
+                    return
+                # Raced to completion between the two calls: re-drive.
+                with self.lock:
+                    info2 = self._fetches.pop(key, None)
+                for cb in (info2 or {}).get("cbs", []):
+                    self._fetch_to_node(dest, oid, cb)
+                return
+            self.directory.put(oid, ("err", ObjectLostError(ObjectID(oid))))
+            self._on_object_ready(oid)
             self._finish_fetch(key, False, ObjectLostError(ObjectID(oid)))
             return
         src = srcs[0]
@@ -1475,7 +1566,8 @@ class Runtime:
                     st.resources_reserved = None
             threading.Thread(target=self._create_actor_now,
                              args=(st.cspec,), daemon=True).start()
-        # Scrub object locations; sole-copy objects are lost.
+        # Scrub object locations; sole-copy objects are lost — recompute
+        # them from lineage where possible, else poison their entries.
         from ray_tpu.core.status import ObjectLostError
         lost = []
         with self.directory.lock:
@@ -1485,6 +1577,12 @@ class Runtime:
                     if not e[1] and oid not in self._spilled:
                         lost.append(oid)
         for oid in lost:
+            # Drop the location-less entry first: readers block on the
+            # absent entry while reconstruction decides/runs, and a sibling
+            # reconstruction finishing mid-loop re-adds it afterwards.
+            self.directory.discard(oid)
+            if self._maybe_reconstruct(oid):
+                continue
             self.directory.put(oid, ("err", ObjectLostError(ObjectID(oid))))
             self._on_object_ready(oid)
         # In-flight fetches: dest died -> fail them; source died -> retry
@@ -1620,25 +1718,37 @@ class Runtime:
     def wait(self, refs, num_returns=1, timeout=None):
         if num_returns > len(refs):
             raise ValueError("num_returns > len(refs)")
-        cv = threading.Condition()
+        # Fast path: enough refs already resolved — a plain dict probe per
+        # ref, no callback registration. Wait-in-a-loop patterns (pop one
+        # ready ref per call over N refs) would otherwise register O(N^2)
+        # ghost callbacks across the loop.
         ready_set: set[bytes] = set()
-
-        def mk_cb(oid):
-            def cb(_entry):
-                with cv:
-                    ready_set.add(oid)
-                    cv.notify_all()
-            return cb
-
         for r in refs:
-            self.directory.on_ready(r.id.binary(), mk_cb(r.id.binary()))
-        deadline = None if timeout is None else time.monotonic() + timeout
-        with cv:
-            while len(ready_set) < num_returns:
-                remain = None if deadline is None else deadline - time.monotonic()
-                if remain is not None and remain <= 0:
-                    break
-                cv.wait(remain if remain is not None else 0.1)
+            if self.directory.lookup(r.id.binary()) is not None:
+                ready_set.add(r.id.binary())
+        if len(ready_set) < num_returns:
+            cv = threading.Condition()
+
+            def mk_cb(oid):
+                def cb(_entry):
+                    with cv:
+                        ready_set.add(oid)
+                        cv.notify_all()
+                return cb
+
+            for r in refs:
+                if r.id.binary() not in ready_set:
+                    self.directory.on_ready(r.id.binary(),
+                                            mk_cb(r.id.binary()))
+            deadline = (None if timeout is None
+                        else time.monotonic() + timeout)
+            with cv:
+                while len(ready_set) < num_returns:
+                    remain = (None if deadline is None
+                              else deadline - time.monotonic())
+                    if remain is not None and remain <= 0:
+                        break
+                    cv.wait(remain if remain is not None else 0.1)
         ready = [r for r in refs if r.id.binary() in ready_set]
         not_ready = [r for r in refs if r.id.binary() not in ready_set]
         overflow = ready[num_returns:]
@@ -1685,6 +1795,108 @@ class Runtime:
                         n.conn.send(("free_obj", oid))
                     except OSError:
                         pass
+        self._lineage_release(oid)
+
+    # ---------------- lineage reconstruction ----------------
+    #
+    # Parity map: _lineage_register ≈ lineage retention in the owner's
+    # ReferenceCounter (reference_count.h:72); _maybe_reconstruct ≈
+    # ObjectRecoveryManager::RecoverObject (object_recovery_manager.h:43)
+    # driving TaskManager lineage resubmission (task_manager.h:216). Specs of
+    # finished normal tasks are retained while any of their return objects
+    # (or a downstream retained spec's dependency chain) is alive; when a
+    # node death wipes the only copy of a plasma-tier object, the producing
+    # task is transparently re-executed — recursively, since its own inputs
+    # may be gone too.
+
+    def _lineage_register(self, spec: TaskSpec):
+        """Retain a finished task's spec for object recovery."""
+        cap = self.config.lineage_cache_entries
+        if not cap or spec.actor_id is not None:
+            return
+        with self.lock:
+            first = spec.task_id not in self._lineage_live
+            if first and len(self._lineage) >= cap:
+                return  # cache full — outputs are simply not recoverable
+            live = self._lineage_live.setdefault(spec.task_id, set())
+            for rid in spec.return_ids:
+                self._lineage[rid] = spec
+                live.add(rid)
+            if first:
+                for d in spec.dependencies or []:
+                    self._lineage_pins[d] = self._lineage_pins.get(d, 0) + 1
+
+    def _lineage_release(self, oid: bytes):
+        """The object was freed (refcount zero): its lineage entry can go —
+        unless a retained downstream spec still lists it as a dependency, in
+        which case the drop is deferred (lineage pinning)."""
+        with self.lock:
+            if self._lineage_pins.get(oid, 0) > 0:
+                if oid in self._lineage:
+                    self._lineage_freed.add(oid)
+                return
+            self._drop_lineage_locked(oid)
+
+    def _drop_lineage_locked(self, oid: bytes):
+        self._lineage_freed.discard(oid)
+        spec = self._lineage.pop(oid, None)
+        if spec is None:
+            return
+        live = self._lineage_live.get(spec.task_id)
+        if live is not None:
+            live.discard(oid)
+            if live:
+                return
+        # Spec fully dead: unpin its dependencies (cascading drops for deps
+        # that were themselves freed while pinned).
+        self._lineage_live.pop(spec.task_id, None)
+        self._reconstruct_count.pop(spec.task_id, None)
+        for d in spec.dependencies or []:
+            n = self._lineage_pins.get(d, 0) - 1
+            if n <= 0:
+                self._lineage_pins.pop(d, None)
+                if d in self._lineage_freed:
+                    self._drop_lineage_locked(d)
+            else:
+                self._lineage_pins[d] = n
+
+    def _maybe_reconstruct(self, oid: bytes) -> bool:
+        """Try to recover a lost plasma-tier object by re-executing its
+        producing task. Returns True if a reconstruction is running (the
+        object's directory entry must then stay absent so readers block
+        until the re-execution lands a fresh copy)."""
+        with self.lock:
+            spec = self._lineage.get(oid)
+            if spec is None:
+                return False
+            if spec.task_id in self._reconstructing:
+                return True
+            n = self._reconstruct_count.get(spec.task_id, 0)
+            if n >= self.config.max_object_reconstructions:
+                return False
+            self._reconstruct_count[spec.task_id] = n + 1
+            self._reconstructing.add(spec.task_id)
+        # Inputs may be gone too (freed after use, or lost in the same node
+        # death): kick their recovery first. An unrecoverable dep means the
+        # resubmitted task would gate forever — give up on this object.
+        for d in spec.dependencies or []:
+            if d in self._spilled:
+                continue  # restorable from the spill tier, not lost
+            entry = self.directory.lookup(d)
+            missing = entry is None or (entry[0] == "shm" and len(entry) > 1
+                                        and not entry[1])
+            if missing:
+                if entry is not None:
+                    self.directory.discard(d)
+                if not self._maybe_reconstruct(d):
+                    with self.lock:
+                        self._reconstructing.discard(spec.task_id)
+                    return False
+        # Fresh worker-crash retry budget for the re-execution.
+        spec.retries_left = spec.max_retries
+        self.task_events.record(spec.task_id, spec, "RECONSTRUCTING")
+        self.submit_task(spec)
+        return True
 
     def _on_object_ready(self, oid: bytes):
         """Unblock tasks waiting on this dependency + remote subscribers."""
@@ -1739,6 +1951,7 @@ class Runtime:
                 "items": [], "done": False, "consumed": 0,
                 "abandoned": False,
                 "cv": threading.Condition(self.lock),
+                "parked": [],  # [(idx, cb)] worker-side stream_next waiters
             }
 
     def _stream_append(self, task_id: bytes, rid: bytes):
@@ -1751,6 +1964,44 @@ class Runtime:
                 return
             st["items"].append(rid)
             st["cv"].notify_all()
+            fired = self._pop_parked_locked(st)
+        for cb, rid_or_none in fired:
+            cb(rid_or_none)
+
+    def _pop_parked_locked(self, st) -> list:
+        """Collect parked stream_next callbacks that can now be answered
+        (item arrived, or the stream closed). Fire OUTSIDE the lock."""
+        ready, still = [], []
+        for idx, cb in st["parked"]:
+            if idx < len(st["items"]):
+                st["consumed"] = max(st["consumed"], idx + 1)
+                ready.append((cb, st["items"][idx]))
+            elif st["done"]:
+                ready.append((cb, None))
+            else:
+                still.append((idx, cb))
+        st["parked"] = still
+        return ready
+
+    def stream_item_or_park(self, task_id: bytes, idx: int, cb):
+        """Non-blocking next_stream_item: answer immediately when possible,
+        else park `cb` until the yield lands or the stream closes. One
+        parked entry replaces the thread-per-RPC a blocking wait would
+        need (stream_next arrives once per consumed item)."""
+        with self.lock:
+            st = self._streams.get(task_id)
+            if st is None:
+                rid = None
+            elif idx < len(st["items"]):
+                st["consumed"] = max(st["consumed"], idx + 1)
+                rid = st["items"][idx]
+            elif st["done"]:
+                self._streams.pop(task_id, None)  # exhausted
+                rid = None
+            else:
+                st["parked"].append((idx, cb))
+                return
+        cb(rid)
 
     def release_stream(self, task_id: bytes):
         """Consumer dropped its ObjectRefGenerator: discard unconsumed
@@ -1763,6 +2014,10 @@ class Runtime:
             st["abandoned"] = True
             unread = st["items"][st["consumed"]:]
             st["cv"].notify_all()
+            fired = [(cb, None) for _i, cb in st["parked"]]
+            st["parked"] = []
+        for cb, none in fired:
+            cb(none)
         for rid in unread:
             self.directory.discard(rid)
         try:
@@ -1781,11 +2036,14 @@ class Runtime:
                 return
             st["done"] = True
             st["cv"].notify_all()
+            fired = self._pop_parked_locked(st)
             if st.get("abandoned"):
                 # The consumer already dropped its generator; nobody will
                 # ever read this stream again — drop the state now or it
                 # leaks for the life of the driver.
                 self._streams.pop(task_id, None)
+        for cb, rid_or_none in fired:
+            cb(rid_or_none)
 
     def next_stream_item(self, task_id: bytes, idx: int,
                          timeout: float | None = None):
@@ -1868,11 +2126,26 @@ class Runtime:
                     err = TaskCancelledError(
                         f"task {spec.describe()} was cancelled")
                 else:
-                    running = next(
-                        (w for w in self.workers.values()
-                         if w.state == BUSY and w.current_task is not None
-                         and w.current_task.task_id == spec.task_id), None)
-                    if running is not None:
+                    holder, is_running = None, False
+                    for w in self.workers.values():
+                        if w.state != BUSY:
+                            continue
+                        for i, t in enumerate(w.assigned):
+                            if t.task_id == spec.task_id:
+                                holder, is_running = w, (i == 0)
+                                break
+                        if holder is not None:
+                            break
+                    if holder is not None and not is_running:
+                        # Pipelined behind the worker's running task — it
+                        # never started: definite cancel. The worker's
+                        # cancelled-set drops it when it reaches the front.
+                        holder.assigned.remove(spec)
+                        self._cancelled.add(spec.task_id)
+                        notify_worker = holder
+                        err = TaskCancelledError(
+                            f"task {spec.describe()} was cancelled")
+                    elif holder is not None:
                         if self.directory.lookup(rid) is not None:
                             # Completed; the worker just hasn't been marked
                             # idle yet — killing it would murder a healthy
@@ -1884,7 +2157,7 @@ class Runtime:
                         # retries) it, then kill the worker.
                         self._cancelled.add(spec.task_id)
                         spec.retries_left = 0
-                        kill_worker = running
+                        kill_worker = holder
                     elif self.directory.lookup(rid) is not None:
                         return False  # completed while we looked
                     else:
@@ -1897,8 +2170,10 @@ class Runtime:
             try:
                 notify_worker.send(("cancel_task", spec.task_id))
             except OSError:
-                return False
-            return True  # best-effort; the worker reports the fate
+                if err is None:
+                    return False
+            if err is None:
+                return True  # best-effort; the worker reports the fate
         if kill_worker is not None:
             kill_worker.kill()
             return True
@@ -2390,8 +2665,16 @@ class Runtime:
 
     @property
     def task_queue(self) -> list:
-        """Flat view of all pending task specs (introspection/autoscaler)."""
-        return [s for q in self.task_queues.values() for s in q]
+        """Flat view of all pending task specs (introspection/autoscaler).
+        Includes pipelined-but-not-started tasks queued on busy workers:
+        they are real unmet demand — hiding them would stop the autoscaler
+        from scaling out under a pipelined backlog."""
+        with self.lock:
+            out = [s for q in self.task_queues.values() for s in q]
+            for w in list(self.workers.values()):
+                if w.state == BUSY and len(w.assigned) > 1:
+                    out.extend(list(w.assigned)[1:])
+            return out
 
     def _schedule(self):
         """Dispatch every feasible queued task to an idle worker.
@@ -2417,7 +2700,11 @@ class Runtime:
                         failures.append((spec, e))
                         continue
                     if res is None:
-                        break  # key blocked on resources; next key
+                        # Key blocked on resources: pipeline the backlog
+                        # onto busy same-key workers (they ride those
+                        # workers' existing reservations), then next key.
+                        self._pipeline_locked(sig, q, dispatches)
+                        break
                     node, token = res
                     if not node.idle:
                         # Resources fit but no free worker on that node:
@@ -2426,20 +2713,108 @@ class Runtime:
                         # probe this pass — a blocked key must not starve
                         # feasible keys behind it.
                         self._rollback_token_locked(token)
+                        self._pipeline_locked(sig, q, dispatches)
                         self._request_worker_locked(node)
                         break
                     q.popleft()
                     self._reservations[spec.task_id] = token
                     w = node.idle.popleft()
                     w.state = BUSY
-                    w.current_task = spec
+                    w.assigned.append(spec)
+                    self._sig_workers.setdefault(sig, set()).add(w)
                     dispatches.append((w, spec))
                 if not self.task_queues.get(sig):
                     self.task_queues.pop(sig, None)
         for spec, e in failures:
             self._fail_returns(spec, e)
+        # Coalesce per-worker: one frame carries every spec headed to the
+        # same worker this pass (one sendall instead of K).
+        per_worker: dict = {}
+        order: list = []
         for w, spec in dispatches:
-            self._dispatch(w, spec)
+            if w not in per_worker:
+                per_worker[w] = []
+                order.append(w)
+            per_worker[w].append(spec)
+        for w in order:
+            self._dispatch_many(w, per_worker[w])
+        if self._steal_for_idle():
+            self._schedule()
+
+    def _steal_for_idle(self) -> bool:
+        """Anti-straggler: with idle workers and empty queues, reclaim
+        pipelined tasks that have not started (queued behind a long task on
+        a busy worker) back into the scheduling queues. The origin worker is
+        told to drop them silently; a lost race (task started between the
+        steal and the drop) means a benign duplicate execution of an
+        idempotent task, never a poisoned result."""
+        stolen: list[tuple] = []
+        with self.lock:
+            if any(self.task_queues.values()):
+                return False
+            idle = sum(len(n.idle) for n in self.nodes.values()
+                       if n.state == "ALIVE")
+            if not idle:
+                return False
+            for w in self.workers.values():
+                if w.state != BUSY or len(w.assigned) <= 1:
+                    continue
+                while len(w.assigned) > 1 and idle > 0:
+                    spec = w.assigned[-1]
+                    if (spec.max_retries or 0) <= 0:
+                        # A lost drop race duplicates execution; tasks the
+                        # user marked non-retriable must never risk that.
+                        break
+                    # Steal only what can actually be placed RIGHT NOW on a
+                    # node with a free worker — otherwise the spec would
+                    # bounce queue -> pipeline -> steal forever.
+                    try:
+                        res = self._reserve_placement(
+                            spec.scheduling_strategy,
+                            self._resources_of(spec), spec.dependencies)
+                    except Exception:  # noqa: BLE001 — unplaceable: leave it
+                        break
+                    if res is None:
+                        break
+                    node, token = res
+                    self._rollback_token_locked(token)
+                    if not node.idle:
+                        break
+                    w.assigned.pop()
+                    stolen.append((w, spec))
+                    idle -= 1
+                if idle <= 0:
+                    break
+            for w, spec in reversed(stolen):
+                self._enqueue_task_locked(spec, front=True)
+        for w, spec in stolen:
+            try:
+                w.send(("drop_task", spec.task_id))
+            except OSError:
+                pass
+        return bool(stolen)
+
+    def _pipeline_locked(self, sig, q, dispatches):
+        """Assign queued same-key tasks to busy workers already executing
+        that key, up to max_tasks_in_flight_per_worker each. Pipelined tasks
+        take no new reservation — the completion handler hands the running
+        task's token to the next one in the worker's queue."""
+        depth = self.config.max_tasks_in_flight_per_worker
+        if depth <= 1 or not q:
+            return
+        cands = self._sig_workers.get(sig)
+        if not cands:
+            return
+        for w in list(cands):
+            if w.state != BUSY or not w.assigned:
+                cands.discard(w)
+                continue
+            while q and len(w.assigned) < depth:
+                spec = q.popleft()
+                w.assigned.append(spec)
+                dispatches.append((w, spec))
+            if not q:
+                break
 
     def _rollback_token_locked(self, token):
         """Undo a just-taken reservation without waking PG/actor waiters."""
@@ -2481,24 +2856,62 @@ class Runtime:
                 pass
 
     def _dispatch(self, w: WorkerHandle, spec: TaskSpec):
-        self.task_events.record(spec.task_id, spec, "RUNNING")
-        if spec.fn_id and spec.fn_id not in w.registered_fns:
-            blob = self.fn_table.get(spec.fn_id)
-            if blob is None:
-                self._fail_returns(spec, RayTpuError(
-                    f"function {spec.fn_id.hex()} was never exported"))
-                with self.lock:  # return the reserved worker + resources
-                    self._release_token(self._reservations.pop(spec.task_id, None))
-                    w.current_task = None
-                    if w.state != DEAD:
-                        w.state = IDLE
-                        node = self.nodes.get(w.node_id)
-                        if node is not None:
-                            node.idle.append(w)
-                return
-            w.send(("reg_fn", spec.fn_id, blob))
-            w.registered_fns.add(spec.fn_id)
-        w.send(("exec", spec))
+        self._dispatch_many(w, [spec])
+
+    def _dispatch_many(self, w: WorkerHandle, specs: list):
+        """Ship a run of specs to one worker as a single frame."""
+        frames = []
+        for spec in specs:
+            if spec.fn_id and spec.fn_id not in w.registered_fns:
+                blob = self.fn_table.get(spec.fn_id)
+                if blob is None:
+                    self._pop_assignment(w, spec.task_id)
+                    self._fail_returns(spec, RayTpuError(
+                        f"function {spec.fn_id.hex()} was never exported"))
+                    continue
+                frames.append(("reg_fn", spec.fn_id, blob))
+                w.registered_fns.add(spec.fn_id)
+            self.task_events.record(spec.task_id, spec, "RUNNING")
+            frames.append(("exec", spec))
+        if not frames:
+            return
+        if len(frames) == 1:
+            w.send(frames[0])
+        else:
+            w.send(("batch", frames))
+
+    def _pop_assignment(self, w: WorkerHandle, task_id: bytes):
+        """Remove a finished/failed task from the worker's in-flight queue.
+        Its reservation is handed to the next pipelined task on the worker
+        (which was dispatched without one); the worker goes back to the idle
+        pool when the queue drains. Returns the spec, or None."""
+        with self.lock:
+            spec = None
+            if w.assigned and w.assigned[0].task_id == task_id:
+                spec = w.assigned.popleft()
+            else:
+                for t in w.assigned:
+                    if t.task_id == task_id:
+                        spec = t
+                        w.assigned.remove(t)
+                        break
+            if spec is None:
+                return None
+            token = self._reservations.pop(task_id, None)
+            if (w.assigned and w.state != DEAD and token is not None
+                    and w.assigned[0].task_id not in self._reservations):
+                self._reservations[w.assigned[0].task_id] = token
+                token = None
+            self._release_token(token)
+            if not w.assigned:
+                self._sig_workers.get(
+                    self._sched_key(spec), set()).discard(w)
+                if w.state != DEAD:
+                    w.state = IDLE
+                    node = self.nodes.get(w.node_id)
+                    if node is not None:
+                        node.idle.append(w)
+            return spec
 
     def _on_task_done(self, w: WorkerHandle, task_id: bytes,
                       actor_id: bytes | None, outs):
@@ -2517,6 +2930,7 @@ class Runtime:
             for rid, _s, _p, _b in outs:
                 self._rid_to_spec.pop(rid, None)
             self._cancelled.discard(task_id)  # force-cancel lost the race
+            self._reconstructing.discard(task_id)
         if task_id in self._streams:
             self._stream_close(task_id)
             with self.lock:
@@ -2529,24 +2943,20 @@ class Runtime:
                     self.task_events.record(task_id, spec, "FINISHED")
                     self._unpin_deps(spec)
             return
-        spec = w.current_task
+        spec = self._pop_assignment(w, task_id)
         if spec is not None:
             self.task_events.record(task_id, spec, "FINISHED")
+            if not spec.streaming:
+                self._lineage_register(spec)
             self._unpin_deps(spec)
-            with self.lock:
-                self._release_token(self._reservations.pop(spec.task_id, None))
-                w.current_task = None
-                if w.state != DEAD:  # death may have raced this 'done'
-                    w.state = IDLE
-                    node = self.nodes.get(w.node_id)
-                    if node is not None:
-                        node.idle.append(w)
         self._schedule()
 
     def _fail_returns(self, spec: TaskSpec, exc: Exception):
         err = exc if isinstance(exc, TaskError) else TaskError(
             exc, str(exc), spec.describe())
         self._unpin_deps(spec)
+        with self.lock:
+            self._reconstructing.discard(spec.task_id)
         if spec.streaming:
             # Surface the failure as the stream's final item, then close —
             # the consumer's next() returns a ref whose get() raises.
@@ -2857,23 +3267,43 @@ class Runtime:
                 except ValueError:
                     pass
                 node.workers.pop(w.worker_id.binary(), None)
-        if prev_state == BUSY and w.current_task is not None:
-            spec = w.current_task
+        if prev_state == BUSY and w.assigned:
+            assigned = list(w.assigned)
+            w.assigned.clear()
             with self.lock:
-                self._release_token(self._reservations.pop(spec.task_id, None))
-            if (spec.retries_left or 0) > 0:
-                spec.retries_left -= 1
-                self.task_events.record(spec.task_id, spec, "RETRY")
-                with self.lock:
-                    self._enqueue_task_locked(spec, front=True)
-            elif spec.task_id in self._cancelled:
-                from ray_tpu.core.status import TaskCancelledError
-                self._fail_returns(spec, TaskCancelledError(
-                    f"task {spec.describe()} was cancelled"))
-                self._cancelled.discard(spec.task_id)
-            else:
-                self._fail_returns(spec, WorkerCrashedError(
-                    f"worker died executing {spec.describe()}"))
+                self._sig_workers.get(
+                    self._sched_key(assigned[0]), set()).discard(w)
+                for spec in assigned:
+                    self._release_token(
+                        self._reservations.pop(spec.task_id, None))
+            # Requeue retriable tasks at the FRONT in original order
+            # (reversed appendleft); the rest fail. Pipelined tasks queued
+            # behind the running one never started — they requeue without
+            # consuming a retry.
+            running_id = assigned[0].task_id
+            for spec in reversed(assigned):
+                if spec.task_id != running_id:
+                    if spec.task_id in self._cancelled:
+                        from ray_tpu.core.status import TaskCancelledError
+                        self._fail_returns(spec, TaskCancelledError(
+                            f"task {spec.describe()} was cancelled"))
+                        self._cancelled.discard(spec.task_id)
+                        continue
+                    with self.lock:
+                        self._enqueue_task_locked(spec, front=True)
+                elif (spec.retries_left or 0) > 0:
+                    spec.retries_left -= 1
+                    self.task_events.record(spec.task_id, spec, "RETRY")
+                    with self.lock:
+                        self._enqueue_task_locked(spec, front=True)
+                elif spec.task_id in self._cancelled:
+                    from ray_tpu.core.status import TaskCancelledError
+                    self._fail_returns(spec, TaskCancelledError(
+                        f"task {spec.describe()} was cancelled"))
+                    self._cancelled.discard(spec.task_id)
+                else:
+                    self._fail_returns(spec, WorkerCrashedError(
+                        f"worker died executing {spec.describe()}"))
         if w.actor_id is not None:
             self._on_actor_worker_death(w.actor_id)
         if (prev_state in (IDLE, BUSY) and not self._shutdown

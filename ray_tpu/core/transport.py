@@ -97,14 +97,18 @@ def _carries_raw_buffers(msg) -> bool:
         if type(x) is list:
             # 'done' outs: [(rid, status, payload, bufs)]; 'obj' pushes
             # carry the buffer list itself: ('obj', oid, status, payload,
-            # [memoryview, ...]).
+            # [memoryview, ...]); 'batch' frames nest ('exec', spec) tuples
+            # whose specs hold out-of-band buffers.
             for e in x:
                 if isinstance(e, memoryview):
                     return True
-                if type(e) is tuple and any(
-                        isinstance(v, (memoryview, list)) and v
-                        for v in e):
-                    return True
+                if type(e) is tuple:
+                    for v in e:
+                        if isinstance(v, (memoryview, list)) and v:
+                            return True
+                        if (getattr(v, "buffers", None)
+                                or getattr(v, "inline_deps", None)):
+                            return True
         elif type(x) is tuple:
             # ('stream_item', task_id, (rid, status, payload, bufs)) — the
             # entry tuple is a direct element of msg; missing it here means

@@ -73,16 +73,56 @@ class _LRUCache:
             return self._d[key]
 
 
-class _NoopRefCounter:
-    """Borrower-side refcounting is conservative: the owner pins objects for
-    the lifetime of tasks that reference them (runtime.submit_task), so
-    borrower handles do not count."""
+class _WorkerRefCounter:
+    """Worker-side counting for objects THIS worker owns (its own put()s);
+    borrowed refs stay uncounted — the head pins those for the lifetime of
+    tasks that reference them (runtime.submit_task).
+
+    An owned ref that gets serialized (into a return value, a task arg, a
+    nested put) has "escaped" to an unknown borrower and is never freed from
+    here; the overwhelmingly common temporary — put, use locally, drop —
+    frees eagerly instead of leaking into the shared arena until eviction."""
+
+    def __init__(self, free_fn):
+        self._owned: dict[bytes, int] = {}
+        self._escaped: set[bytes] = set()
+        self._lock = threading.Lock()
+        self._free_fn = free_fn
+
+    def register_owned(self, object_id):
+        """Call BEFORE constructing the first (strong) ObjectRef: the ref's
+        own add_local_ref provides the initial count."""
+        with self._lock:
+            self._owned[object_id.binary()] = 0
 
     def add_local_ref(self, object_id):
-        pass
+        key = object_id.binary()
+        with self._lock:
+            if key in self._owned:
+                self._owned[key] += 1
 
     def remove_local_ref(self, object_id):
-        pass
+        key = object_id.binary()
+        free = False
+        with self._lock:
+            if key not in self._owned:
+                return
+            self._owned[key] -= 1
+            if self._owned[key] <= 0:
+                del self._owned[key]
+                free = key not in self._escaped
+                self._escaped.discard(key)
+        if free:
+            try:
+                self._free_fn(key)
+            except Exception:  # noqa: BLE001 — freeing is best effort
+                pass
+
+    def mark_escaped(self, object_id):
+        key = object_id.binary()
+        with self._lock:
+            if key in self._owned:
+                self._escaped.add(key)
 
 
 class WorkerRuntime:
@@ -101,11 +141,13 @@ class WorkerRuntime:
         self._wait_lock = threading.Lock()
         self.task_queue: "queue.Queue" = None  # set in main
         self.cancelled_tasks: set = set()  # dropped before execution
+        self.dropped_tasks: set = set()    # stolen back; skip silently
         self.actor_instance = None
         self.actor_id: bytes | None = None
         self.shutdown = threading.Event()
         self.current_task = None
-        self.refcount = _NoopRefCounter()
+        self.refcount = _WorkerRefCounter(
+            lambda key: self.send(("free_put", key)))
         self._req_lock = threading.Lock()
         self._req_seq = 0
         self._req_futures: dict[int, "concurrent.futures.Future"] = {}
@@ -124,7 +166,8 @@ class WorkerRuntime:
         _put_with_spill(self, oid, value,
                         int(getattr(value, "nbytes", 0) or (1 << 20)))
         self.send(("put_notify", oid.binary()))
-        return ObjectRef(oid, owner=self.worker_id.binary(), _add_ref=False)
+        self.refcount.register_owned(oid)
+        return ObjectRef(oid, owner=self.worker_id.binary())
 
     def get(self, refs, timeout=None):
         from ray_tpu.core.object_ref import ObjectRef
@@ -209,6 +252,24 @@ class WorkerRuntime:
 
     def send(self, msg):
         send_msg(self.sock, msg, self.send_lock)
+
+    # -- streaming (ObjectRefGenerator consumed from a worker) --
+
+    def next_stream_item(self, task_id: bytes, idx: int,
+                         timeout: float | None = None):
+        """Blocks until yield #idx of a streaming task exists; None = the
+        stream closed first. The head parks the request off-thread."""
+        return self.request("stream_next", (task_id, idx, timeout),
+                            timeout=None if timeout is None else timeout + 10)
+
+    def stream_finished(self, task_id: bytes) -> bool:
+        return self.request("stream_finished", task_id)
+
+    def release_stream(self, task_id: bytes):
+        try:
+            self.request("stream_release", task_id)
+        except Exception:  # noqa: BLE001 — release is best effort
+            pass
 
     def request(self, what, arg=None, timeout=30.0):
         """Synchronous control-plane query to the head."""
@@ -787,6 +848,11 @@ def _worker_main(store_path: str, worker_id: WorkerID, fd: int):
                     continue
             msg = pending.pop(0)
             op = msg[0]
+            if op == "batch":
+                # One head-side sendall carrying several dispatch frames
+                # (pipelined same-key tasks); unpack in order.
+                pending[0:0] = msg[1]
+                continue
             if op == "exec":
                 rt.task_queue.put(msg[1])
             elif op == "create_actor":
@@ -800,6 +866,13 @@ def _worker_main(store_path: str, worker_id: WorkerID, fd: int):
                 if len(rt.cancelled_tasks) > 1024:
                     rt.cancelled_tasks.pop()
                 rt.cancelled_tasks.add(msg[1])
+            elif op == "drop_task":
+                # Stolen back by the scheduler (re-dispatched elsewhere):
+                # skip WITHOUT a cancelled reply — a reply would poison the
+                # re-dispatched task's return objects.
+                if len(rt.dropped_tasks) > 1024:
+                    rt.dropped_tasks.pop()
+                rt.dropped_tasks.add(msg[1])
             elif op == "shutdown":
                 rt.shutdown.set()
                 rt.task_queue.put(None)
@@ -851,6 +924,9 @@ def _worker_main(store_path: str, worker_id: WorkerID, fd: int):
                 pool = concurrent.futures.ThreadPoolExecutor(cspec.max_concurrency)
             continue
         spec: TaskSpec = item
+        if spec.task_id in rt.dropped_tasks:
+            rt.dropped_tasks.discard(spec.task_id)
+            continue
         if spec.task_id in rt.cancelled_tasks:
             rt.cancelled_tasks.discard(spec.task_id)
             _reply_cancelled(rt, spec)

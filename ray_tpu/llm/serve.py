@@ -54,6 +54,7 @@ class _LLMServerImpl:
         self._base_params = self.engine.params
         self._adapters: dict[str, object] = {}
         self._waiters: dict[int, tuple] = {}  # rid -> (loop, future)
+        self._token_subs: dict[int, "queue.Queue"] = {}  # rid -> token queue
         self._lock = threading.Lock()
         self._stop = False
         self._pump = threading.Thread(target=self._loop, daemon=True,
@@ -68,7 +69,7 @@ class _LLMServerImpl:
                 time.sleep(0.002)
                 continue
             try:
-                self.engine.step()
+                emitted = self.engine.step()
             except Exception:  # noqa: BLE001 — a dead pump hangs every
                 # pending AND future request on the replica; log and go on.
                 import traceback
@@ -77,11 +78,20 @@ class _LLMServerImpl:
                 continue
             done = []
             with self._lock:
+                # Per-token fanout to streaming subscribers.
+                for rid, tok in (emitted or {}).items():
+                    sub = self._token_subs.get(rid)
+                    if sub is not None:
+                        sub.put(int(tok))
                 for rid, (loop, fut) in list(self._waiters.items()):
                     req = self.engine.finished.pop(rid, None)
                     if req is not None:
                         done.append((loop, fut, req))
                         del self._waiters[rid]
+                for rid in list(self._token_subs):
+                    if rid in self.engine.finished:
+                        self.engine.finished.pop(rid)
+                        self._token_subs[rid].put(None)  # end of stream
             for loop, fut, req in done:
                 loop.call_soon_threadsafe(fut.set_result, req)
 
@@ -206,6 +216,42 @@ class _LLMServerImpl:
             "usage": out["usage"],
         }
 
+    def completions_stream(self, prompt: str, max_tokens=None,
+                           temperature=None, top_p: float = 1.0,
+                           top_k: int = 0, model=None):
+        """Per-token stream: yields incremental text deltas as the engine
+        decodes (sync generator — runs as a streaming actor method next to
+        the replica's asyncio loop)."""
+        import queue as _queue
+
+        self.engine.params = self._params_for(model)
+        ids = self.tokenizer.encode(prompt)
+        sub: "_queue.Queue" = _queue.Queue()
+        with self._lock:
+            rid = self.engine.add_request(ids, max_tokens, temperature,
+                                          top_p=top_p, top_k=top_k)
+            self._token_subs[rid] = sub
+        try:
+            generated: list[int] = []
+            sent = ""
+            while True:
+                tok = sub.get(timeout=300)
+                if tok is None:
+                    break
+                generated.append(tok)
+                # Incremental decode of the full sequence keeps multi-token
+                # merges correct; emit only the unseen suffix.
+                text = self.tokenizer.decode(generated)
+                if len(text) > len(sent):
+                    delta, sent = text[len(sent):], text
+                    yield delta
+        finally:
+            with self._lock:
+                self._token_subs.pop(rid, None)
+                # A timed-out/abandoned stream must not strand the finished
+                # record (nobody else will pop it for this rid).
+                self.engine.finished.pop(rid, None)
+
     def model_ids(self) -> list:
         return [self.cfg.model_id, *self._adapters]
 
@@ -215,10 +261,48 @@ class _LLMServerImpl:
 
 class _OpenAiRouterImpl:
     """OpenAI-surface ingress: /v1/models, /v1/completions,
-    /v1/chat/completions (parity: deployments/routers/router.py)."""
+    /v1/chat/completions — stream=true serves SSE deltas
+    (parity: deployments/routers/router.py; the OpenAI surface is
+    stream-first in practice)."""
 
     def __init__(self, server_handle):
         self.server = server_handle
+
+    def __stream__(self, request):
+        """SSE for {"stream": true} requests: one OpenAI chunk per text
+        delta, then data: [DONE]. The proxy routes stream-requesting
+        requests here; everything else goes through __call__."""
+        import json
+        path = request.path
+        try:
+            body = json.loads(request.body or b"{}")
+        except json.JSONDecodeError:
+            yield 'data: {"error": "invalid JSON body"}\n\n'
+            return
+        chat = path == "/v1/chat/completions"
+        if chat:
+            prompt = "".join(
+                f"<|{m.get('role', 'user')}|>{m.get('content', '')}"
+                for m in body.get("messages", [])) + "<|assistant|>"
+        else:
+            prompt = body.get("prompt", "")
+        rid = (f"chatcmpl-{uuid.uuid4().hex[:24]}" if chat
+               else f"cmpl-{uuid.uuid4().hex[:24]}")
+        model = body.get("model")
+        deltas = self.server.completions_stream.remote_streaming(
+            prompt, body.get("max_tokens"), body.get("temperature"),
+            body.get("top_p", 1.0), body.get("top_k", 0), model)
+        obj = "chat.completion.chunk" if chat else "text_completion"
+        for delta in deltas:
+            if chat:
+                choice = {"index": 0, "delta": {"content": delta},
+                          "finish_reason": None}
+            else:
+                choice = {"index": 0, "text": delta, "finish_reason": None}
+            yield "data: " + json.dumps(
+                {"id": rid, "object": obj, "model": model,
+                 "choices": [choice]}) + "\n\n"
+        yield "data: [DONE]\n\n"
 
     async def __call__(self, request):
         import json

@@ -6,6 +6,7 @@ serve.status, serve.shutdown, get_deployment_handle/get_app_handle).
 
 from __future__ import annotations
 
+import inspect
 import time
 
 import cloudpickle
@@ -64,10 +65,28 @@ def run(app: Application, *, name: str = "default",
             return v
         init_args = tuple(swap(a) for a in bound.init_args)
         init_kwargs = {k: swap(v) for k, v in bound.init_kwargs.items()}
+        target = bound.deployment.func_or_class
+        call = (target if not inspect.isclass(target)
+                else getattr(target, "__call__", None))
+
+        def _is_gen(fn):
+            return fn is not None and (inspect.isgeneratorfunction(fn)
+                                       or inspect.isasyncgenfunction(fn))
+        # Streaming modes (parity: serve/_private/proxy.py:420 generator
+        # path): a generator __call__ ALWAYS streams; a __stream__ method
+        # streams per request (SSE accept header / {"stream": true} body).
+        if _is_gen(call):
+            streaming = "always"
+        elif (inspect.isclass(target)
+              and _is_gen(getattr(target, "__stream__", None))):
+            streaming = "opt-in"
+        else:
+            streaming = ""
         deployments[bound.name] = {
             "def_blob": cloudpickle.dumps(bound.deployment.func_or_class),
             "init_args_blob": cloudpickle.dumps((init_args, init_kwargs)),
             "config": bound.deployment.config,
+            "streaming": streaming,
         }
     ray_tpu.get(controller.deploy_application.remote(
         name, route_prefix, app.root.name, deployments), timeout=30)

@@ -210,9 +210,15 @@ class ServeController:
         return out
 
     async def get_http_routes(self):
-        return {app["route_prefix"]: (name, app["ingress"])
-                for name, app in self.apps.items()
-                if app["route_prefix"] is not None and app["deployments"]}
+        out = {}
+        for name, app in self.apps.items():
+            if app["route_prefix"] is None or not app["deployments"]:
+                continue
+            ingress = app["ingress"]
+            ds = app["deployments"].get(ingress)
+            streaming = (ds.spec.get("streaming") or "") if ds else ""
+            out[app["route_prefix"]] = (name, ingress, streaming)
+        return out
 
     async def graceful_shutdown(self):
         self._shutdown = True

@@ -151,6 +151,27 @@ class Router:
             return chosen
 
     # -- request path ------------------------------------------------------
+    def assign_streaming(self, method_name, args, kwargs,
+                         multiplexed_model_id: str = ""):
+        """Streaming request: returns the raw ObjectRefGenerator of the
+        replica's handle_streaming_request (parity: the generator path of
+        serve/_private/proxy.py:420)."""
+        info = self._pick()
+        h = self._handle_for(info)
+        gen = h.handle_streaming_request.options(
+            num_returns="streaming").remote(
+                method_name, list(args), dict(kwargs), multiplexed_model_id)
+        # In-flight accounting: streaming requests count until the stream
+        # closes; the drain loop cannot watch a generator, so decrement in
+        # the generator wrapper's close path instead.
+        return gen, info.replica_id
+
+    def release_streaming(self, replica_id):
+        with self._lock:
+            if replica_id in self._inflight and self._inflight[replica_id] > 0:
+                self._inflight[replica_id] -= 1
+        self._maybe_push_metrics()
+
     def assign(self, method_name, args, kwargs,
                multiplexed_model_id: str = "") -> DeploymentResponse:
         info = self._pick()
@@ -257,6 +278,23 @@ class DeploymentHandle:
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         return self._get_router().assign(
             self._method, args, kwargs, self._model_id)
+
+    def remote_streaming(self, *args, **kwargs):
+        """Call a generator deployment: yields each streamed value as it is
+        produced (first item arrives before the generator finishes)."""
+        router = self._get_router()
+        gen, replica_id = router.assign_streaming(
+            self._method, args, kwargs, self._model_id)
+
+        def value_iter():
+            try:
+                for ref in gen:
+                    yield ray_tpu.get(ref, timeout=300)
+            finally:
+                gen.close()
+                router.release_streaming(replica_id)
+
+        return value_iter()
 
     def __reduce__(self):
         return (DeploymentHandle, (self._app, self._deployment, self._method,

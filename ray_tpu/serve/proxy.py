@@ -87,8 +87,26 @@ class ProxyActor:
             if path == norm or path.startswith(
                     norm + "/") or norm == "/":
                 if best is None or len(norm) > len(best[0]):
+                    # Route tuples grew a streaming mode; tolerate cached
+                    # 2-tuples from an older controller snapshot.
+                    if len(target) == 2:
+                        target = (*target, "")
                     best = (norm, target)
         return best
+
+    @staticmethod
+    def _wants_stream(req: "Request") -> bool:
+        """Opt-in probe: SSE accept header, or an OpenAI-style JSON body
+        with "stream": true."""
+        if "text/event-stream" in req.headers.get("accept", ""):
+            return True
+        body = req.body or b""
+        if b'"stream"' in body and len(body) < (1 << 20):
+            try:
+                return bool(json.loads(body).get("stream"))
+            except (json.JSONDecodeError, AttributeError):
+                return False
+        return False
 
     async def _serve_conn(self, reader, writer):
         try:
@@ -97,8 +115,14 @@ class ProxyActor:
                 if req is None:
                     break
                 self._num_requests += 1
-                status, headers, body = await self._dispatch(req)
+                out = await self._dispatch(req)
                 keep_alive = req.headers.get("connection", "").lower() != "close"
+                if out[0] == "stream":
+                    # Chunked/SSE: items are written as they arrive; the
+                    # connection closes afterwards (no content-length).
+                    await self._write_streaming_response(writer, out[1])
+                    break
+                status, headers, body = out
                 await self._write_response(
                     writer, status, headers, body, keep_alive)
                 if not keep_alive:
@@ -143,13 +167,13 @@ class ProxyActor:
         if req.path == "/-/healthz":
             return 200, {}, b"success"
         if req.path == "/-/routes":
-            table = {p: f"{a}:{d}" for p, (a, d) in self._routes.items()}
+            table = {p: f"{t[0]}:{t[1]}" for p, t in self._routes.items()}
             return 200, {"content-type": "application/json"}, json.dumps(
                 table).encode()
         m = self._match(req.path)
         if m is None:
             return 404, {}, b"no deployment route matches"
-        prefix, (app_name, ingress) = m
+        prefix, (app_name, ingress, streaming) = m
         sub = req.path[len(prefix):] if prefix != "/" else req.path
         inner = Request(req.method, sub or "/", req.query_params,
                         req.headers, req.body)
@@ -161,6 +185,13 @@ class ProxyActor:
         try:
             # Router.assign can block (replica wait, controller RPC): keep it
             # off the event loop so other connections and healthz stay live.
+            if streaming == "always" or (streaming == "opt-in"
+                                         and self._wants_stream(req)):
+                if streaming == "opt-in":
+                    handle = handle.options(method_name="__stream__")
+                it = await loop.run_in_executor(
+                    None, lambda: handle.remote_streaming(inner))
+                return ("stream", it)
             out = await loop.run_in_executor(
                 None, lambda: handle.remote(inner).result(timeout_s=60))
             return self._encode(out)
@@ -180,6 +211,47 @@ class ProxyActor:
                             }, payload.encode()
         return status, {"content-type": "application/json"}, json.dumps(
             payload).encode()
+
+    async def _write_streaming_response(self, writer, value_iter):
+        """Chunked transfer encoding, one chunk per streamed item; str
+        items pass through as-is (SSE framing is the deployment's job)."""
+        head = ("HTTP/1.1 200 OK\r\n"
+                "content-type: text/event-stream\r\n"
+                "cache-control: no-cache\r\n"
+                "transfer-encoding: chunked\r\n"
+                "connection: close\r\n\r\n")
+        writer.write(head.encode())
+        await writer.drain()
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+        _END = object()
+
+        def pump():
+            try:
+                for item in value_iter:
+                    loop.call_soon_threadsafe(q.put_nowait, item)
+            except Exception as e:  # noqa: BLE001 — surface mid-stream
+                loop.call_soon_threadsafe(q.put_nowait, e)
+            loop.call_soon_threadsafe(q.put_nowait, _END)
+
+        import threading
+        threading.Thread(target=pump, daemon=True).start()
+        while True:
+            item = await q.get()
+            if item is _END:
+                break
+            if isinstance(item, Exception):
+                chunk = f"error: {item}\n".encode()
+            elif isinstance(item, bytes):
+                chunk = item
+            elif isinstance(item, str):
+                chunk = item.encode()
+            else:
+                chunk = (json.dumps(item) + "\n").encode()
+            writer.write(f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n")
+            await writer.drain()
+        writer.write(b"0\r\n\r\n")
+        await writer.drain()
 
     @staticmethod
     async def _write_response(writer, status, headers, body, keep_alive):

@@ -65,6 +65,50 @@ class ReplicaActor:
             _current_model_id.reset(token)
             self._num_ongoing -= 1
 
+    def handle_streaming_request(self, method_name, args, kwargs,
+                                 multiplexed_model_id: str = ""):
+        """Streaming entry: each item the user's (async) generator yields
+        becomes one stream item (parity: the reference replica's generator
+        path feeding the proxy, serve/_private/proxy.py:420). Declared as a
+        sync generator — the worker runs it in an executor thread next to
+        the replica's asyncio loop; async generators are driven through a
+        private event loop in that thread."""
+        import asyncio as _asyncio
+
+        from ray_tpu.serve.multiplex import _current_model_id
+        self._num_ongoing += 1
+        self._num_total += 1
+        token = _current_model_id.set(multiplexed_model_id)
+        try:
+            if self._is_function:
+                target = self._callable
+            else:
+                target = getattr(self._callable, method_name or "__call__")
+            out = target(*args, **kwargs)
+            if inspect.iscoroutine(out):
+                loop = _asyncio.new_event_loop()
+                try:
+                    out = loop.run_until_complete(out)
+                finally:
+                    loop.close()
+            if inspect.isgenerator(out):
+                yield from out
+            elif inspect.isasyncgen(out):
+                loop = _asyncio.new_event_loop()
+                try:
+                    while True:
+                        try:
+                            yield loop.run_until_complete(out.__anext__())
+                        except StopAsyncIteration:
+                            break
+                finally:
+                    loop.close()
+            else:
+                yield out
+        finally:
+            _current_model_id.reset(token)
+            self._num_ongoing -= 1
+
     async def reconfigure(self, user_config):
         self._apply_user_config(user_config)
 

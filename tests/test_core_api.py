@@ -472,3 +472,19 @@ def test_streaming_on_async_actor(ray_start_isolated):
     assert ray_tpu.get(m.regular.remote(), timeout=60) == "async-ok"
     vals = [ray_tpu.get(r, timeout=60) for r in m.stream.remote(3)]
     assert vals == [0, 1, 2]
+
+
+def test_streaming_consumed_from_worker(ray_start_isolated):
+    """A worker can submit a streaming task/actor call and iterate it
+    (stream_next RPCs through the head) — the substrate for serve's
+    proxy-side token streaming."""
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * 2
+
+    @ray_tpu.remote
+    def consume():
+        return [ray_tpu.get(r, timeout=30) for r in gen.remote(4)]
+
+    assert ray_tpu.get(consume.remote(), timeout=60) == [0, 2, 4, 6]

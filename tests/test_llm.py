@@ -272,3 +272,47 @@ def test_engine_top_k_request(tiny_params):
         eng.step()
     req = eng.finished.pop(rid)
     assert len(req.generated) >= 1
+
+
+def test_openai_stream_sse(ray_start_regular):
+    """stream=true serves SSE chunks; first delta arrives before [DONE]
+    (end-to-end token streaming: engine pump -> streaming actor method ->
+    router __stream__ -> proxy chunked response)."""
+    import http.client
+
+    from ray_tpu import serve as serve_api
+    from ray_tpu.llm import build_openai_app
+    from ray_tpu.serve.config import DEFAULT_HTTP_PORT
+
+    app = build_openai_app(_llm_config())
+    serve_api.run(app, name="llm-sse", route_prefix="/llmsse")
+    try:
+        body = json.dumps({"prompt": "hi", "max_tokens": 4,
+                           "stream": True}).encode()
+        conn = http.client.HTTPConnection("127.0.0.1", DEFAULT_HTTP_PORT,
+                                          timeout=120)
+        conn.request("POST", "/llmsse/v1/completions", body=body,
+                     headers={"content-type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.headers.get("content-type", "").startswith(
+            "text/event-stream")
+        raw = resp.read().decode()
+        conn.close()
+        events = [ln for ln in raw.splitlines() if ln.startswith("data: ")]
+        assert events[-1] == "data: [DONE]"
+        chunks = [json.loads(e[6:]) for e in events[:-1]]
+        assert chunks, raw
+        assert chunks[0]["object"] == "text_completion"
+        assert all(c["choices"][0]["finish_reason"] is None for c in chunks)
+        # Non-stream requests on the same app still return plain JSON.
+        import urllib.request
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{DEFAULT_HTTP_PORT}/llmsse/v1/completions",
+            data=json.dumps({"prompt": "hi", "max_tokens": 2}).encode(),
+            headers={"content-type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            out = json.load(r)
+        assert out["object"] == "text_completion"
+    finally:
+        serve_api.delete("llm-sse")

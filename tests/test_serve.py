@@ -384,3 +384,57 @@ def test_grpc_ingress(serve_instance):
     finally:
         serve.stop_grpc_proxy()
         serve.delete("grpcapp")
+
+
+def test_streaming_sse_first_chunk_before_completion(serve_instance):
+    """End-to-end token streaming: generator deployment -> replica stream ->
+    router -> HTTP chunked response; the FIRST chunk must arrive while the
+    generator is still producing (parity: serve/_private/proxy.py:420
+    generator path)."""
+    import http.client
+
+    @serve.deployment
+    def ticker(request):
+        def gen():
+            for i in range(4):
+                yield f"data: tick-{i}\n\n"
+                time.sleep(0.4)
+        return gen()
+
+    # A generator FUNCTION deployment streams directly.
+    @serve.deployment
+    def sse(request):
+        for i in range(4):
+            yield f"data: tok{i}\n\n"
+            time.sleep(0.4)
+
+    serve.run(sse.bind(), name="sse", route_prefix="/sse",
+              http_port=HTTP_PORT, blocking_timeout_s=90)
+    # Proxy boot + route propagation are async to app RUNNING.
+    resp = None
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", HTTP_PORT, timeout=30)
+            t0 = time.monotonic()
+            conn.request("GET", "/sse")
+            resp = conn.getresponse()
+            if resp.status == 200:
+                break
+            conn.close()
+        except OSError:
+            pass
+        time.sleep(0.5)
+    assert resp is not None and resp.status == 200
+    assert resp.headers.get("content-type", "").startswith("text/event-stream")
+    first = resp.read(12)  # exactly the first chunk's decoded payload
+    t_first = time.monotonic() - t0
+    rest = resp.read()
+    t_all = time.monotonic() - t0
+    conn.close()
+    body = first + rest
+    assert b"tok0" in body and b"tok3" in body
+    # 4 ticks x 0.4s: completion takes >=1.2s; the first chunk must beat it.
+    assert t_first < t_all - 0.6, (t_first, t_all)
+    serve.delete("sse")
