@@ -120,6 +120,7 @@ class Autoscaler:
         self.managed: dict[str, str] = {}  # node_id -> node_type
         self._idle_since: dict[str, float] = {}
         self._hints: list[dict] = []
+        self._slice_requests: set[str] = set()  # pg ids with a slice launched
         self._stop = False
         self._thread = None
         self._lock = threading.Lock()
@@ -145,6 +146,8 @@ class Autoscaler:
             for pg_id in list(rt.pgs_waiting):
                 st = rt.placement_groups.get(pg_id)
                 if st is not None and st.state == "PENDING":
+                    if self._slice_eligible(st):
+                        continue  # served whole by _tpu_slice_demand
                     demand.extend(dict(b) for b in st.bundles)
         with self._lock:
             demand.extend(self._hints)
@@ -152,7 +155,65 @@ class Autoscaler:
 
     # ---- reconcile ----
 
+    def _slice_eligible(self, st) -> bool:
+        """Can this pending PG be served whole by a TPU slice launch?
+        Must be false for anything launch_slice would reject — an eligible
+        PG is EXCLUDED from bin-pack demand, so a wrong True starves it."""
+        if (not hasattr(self.provider, "launch_slice")
+                or st.strategy != "ICI_CONTIGUOUS"):
+            return False
+        import math
+
+        from ray_tpu.autoscaler.tpu import GENERATIONS, pick_slice_type
+        generation = getattr(self.provider, "generation", "")
+        gen = GENERATIONS.get(generation)
+        if gen is None:
+            return False
+        chips = sum(b.get("TPU", 0.0) for b in st.bundles)
+        if chips <= 0:
+            return False
+        if any(b.get("TPU", 0.0) > gen["chips_per_host"]
+               for b in st.bundles):
+            return False  # a bundle cannot span hosts
+        return pick_slice_type(generation, math.ceil(chips)) is not None
+
+    def _tpu_slice_demand(self):
+        """ICI-aware fast path (SURVEY §7 item 11): a pending
+        ICI_CONTIGUOUS placement group asking for N TPU chips launches one
+        contiguous slice of the right type, rather than bin-packing its
+        bundles onto arbitrary node types."""
+        if not hasattr(self.provider, "launch_slice"):
+            return
+        import math
+        rt = self.rt
+        with rt.lock:
+            pending = [rt.placement_groups.get(pg_id)
+                       for pg_id in list(rt.pgs_waiting)]
+        for st in pending:
+            if (st is None or st.state != "PENDING"
+                    or not self._slice_eligible(st)):
+                continue
+            chips = math.ceil(sum(b.get("TPU", 0.0) for b in st.bundles))
+            key = st.pg_id.hex()
+            with self._lock:
+                if key in self._slice_requests:
+                    continue
+                self._slice_requests.add(key)
+
+            def launch_bg(key=key, chips=chips):
+                # launch_slice blocks until every host registers (up to
+                # minutes); the reconcile loop must keep serving other
+                # demand meanwhile.
+                try:
+                    self.provider.launch_slice(chips)
+                except Exception:  # noqa: BLE001 — retry next reconcile
+                    with self._lock:
+                        self._slice_requests.discard(key)
+
+            threading.Thread(target=launch_bg, daemon=True).start()
+
     def reconcile_once(self):
+        self._tpu_slice_demand()
         demand = self._demand()
         nodes = self.rt.nodes_table()
         alive = [n for n in nodes if n["alive"]]

@@ -68,6 +68,9 @@ def _cmd_start(args):
         print(f"node agent started (pid {proc.pid}), joined {args.address}")
         return
     if args.block:
+        if getattr(args, "persistence_path", ""):
+            os.environ["RAY_TPU_HEAD_PERSISTENCE_PATH"] = \
+                args.persistence_path
         rt = ray_tpu.init(num_cpus=args.num_cpus, num_tpus=args.num_tpus,
                           object_store_memory=args.object_store_memory
                           or None)
@@ -100,6 +103,8 @@ def _cmd_start(args):
         cmd += ["--num-cpus", str(args.num_cpus)]
     if args.object_store_memory:
         cmd += ["--object-store-memory", str(args.object_store_memory)]
+    if getattr(args, "persistence_path", ""):
+        cmd += ["--persistence-path", args.persistence_path]
     proc = subprocess.Popen(cmd, start_new_session=True,
                             stdout=subprocess.DEVNULL,
                             stderr=subprocess.DEVNULL)
@@ -277,6 +282,11 @@ def main(argv=None):
     sp.add_argument("--num-cpus", type=float, default=None)
     sp.add_argument("--num-tpus", type=float, default=0)
     sp.add_argument("--object-store-memory", type=int, default=0)
+    sp.add_argument("--persistence-path", default="",
+                    help="journal file for head fault tolerance: a head "
+                         "restarted on the same port with the same journal "
+                         "restores KV/actors/PGs and re-queues pending "
+                         "tasks; reconnecting agents re-adopt live actors")
     sp.add_argument("--block", action="store_true",
                     help="run in the foreground")
     sp.set_defaults(fn=_cmd_start)

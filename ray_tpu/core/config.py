@@ -51,6 +51,15 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "health_check_period_ms": (int, 1000, "node health-check interval"),
     "health_check_failure_threshold": (int, 5, "missed checks before a node is dead"),
     "gcs_port": (int, 0, "GCS TCP port; 0 = pick free port"),
+    # --- head fault tolerance (parity: redis_store_client.h:111 +
+    #     gcs_init_data.h reload; raylet reconnect/resync) ---
+    "head_persistence_path": (str, "", "journal file for head tables "
+                              "(kv/fns/actors/pgs/tasks); '' = volatile"),
+    "agent_reconnect_grace_s": (float, 15.0, "node agent retries the head "
+                                "connection this long before dying"),
+    "head_restart_adopt_grace_s": (float, 10.0, "restored actors wait this "
+                                   "long for their old worker to be "
+                                   "re-registered before respawning"),
     # --- fault injection (test leverage, parity: rpc_chaos.h) ---
     "testing_rpc_failure": (str, "", "'method=max_failures' comma list; drops messages"),
     "testing_delay_us": (str, "", "'method=min:max' comma list; injects delays"),

@@ -86,13 +86,15 @@ class NodeAgent:
         self.peer_addr = (node_ip, self.peer_server.port)
 
         host, port = head_addr.rsplit(":", 1)
+        self.head_host, self.head_port = host, int(port)
         self.head_sock = socket.create_connection((host, int(port)))
         self.head_lock = threading.Lock()
         self.head_buffer = FrameBuffer()
-        self._send_head(("register_node", self.node_id, self.resources,
-                         self.peer_addr, socket.gethostname(), os.getpid()))
-
+        self._reconnecting = False
+        self._reconnect_lock = threading.Lock()
+        self.worker_actor: dict[bytes, bytes] = {}  # wid -> hosted actor id
         self.workers: dict[bytes, _AgentWorker] = {}
+        self._register()
         self.pool_size = max(1, cfg.num_workers or int(self.resources["CPU"]))
         self.max_workers = self.pool_size * 2 + 8
         self._shutdown = False
@@ -143,17 +145,79 @@ class NodeAgent:
             pass
         if self.workers.pop(w.worker_id.binary(), None) is None:
             return
+        self.worker_actor.pop(w.worker_id.binary(), None)
         self._send_head(("worker_death", w.worker_id.binary()))
         if not self._shutdown and len(self.workers) < self.pool_size:
             threading.Thread(target=self._spawn_worker, daemon=True).start()
 
     # ---------------- head link ----------------
 
+    def _register(self):
+        """(Re-)introduce this node to the head, with a worker inventory so
+        a restarted head can adopt surviving workers/actors (parity:
+        raylets resyncing with a restarted GCS)."""
+        inventory = [(wid, self.worker_actor.get(wid))
+                     for wid in list(self.workers)]
+        send_msg(self.head_sock,
+                 ("register_node", self.node_id, self.resources,
+                  self.peer_addr, socket.gethostname(), os.getpid(),
+                  inventory),
+                 self.head_lock)
+
     def _send_head(self, msg):
         try:
             send_msg(self.head_sock, msg, self.head_lock)
         except OSError:
+            self._reconnect_or_die()
+
+    def _reconnect_or_die(self):
+        """The head link dropped: retry for the configured grace (a head
+        restart with persistence comes back on the same port), else die as
+        before. Frames sent during the outage are dropped — workers' RPC
+        futures time out and retry."""
+        with self._reconnect_lock:
+            if self._shutdown or self._reconnecting:
+                return
+            self._reconnecting = True
+        try:
+            with self._sel_lock:
+                try:
+                    self._selector.unregister(self.head_sock)
+                except (KeyError, ValueError):
+                    pass
+            try:
+                self.head_sock.close()
+            except OSError:
+                pass
+            deadline = time.monotonic() + self.config.agent_reconnect_grace_s
+            while not self._shutdown and time.monotonic() < deadline:
+                try:
+                    sock = socket.create_connection(
+                        (self.head_host, self.head_port), timeout=2.0)
+                except OSError:
+                    time.sleep(0.5)
+                    continue
+                self.head_sock = sock
+                self.head_buffer = FrameBuffer()
+                try:
+                    self._register()
+                except OSError:
+                    # Raced another drop: clean THIS socket fully before
+                    # retrying, or its later EOF would tear down the next
+                    # (healthy) link.
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    continue
+                with self._sel_lock:
+                    self._selector.register(sock, selectors.EVENT_READ,
+                                            ("head", None))
+                return
             self._die()
+        finally:
+            with self._reconnect_lock:
+                self._reconnecting = False
 
     def _heartbeat_loop(self):
         period = self.config.health_check_period_ms / 1000.0
@@ -227,8 +291,10 @@ class NodeAgent:
                     data = b""
                 if kind == "head":
                     if not data:
-                        self._die()
-                        return
+                        self._reconnect_or_die()
+                        if self._shutdown:
+                            return
+                        continue
                     self.head_buffer.feed(data)
                     for msg in self.head_buffer.frames():
                         try:
@@ -241,6 +307,11 @@ class NodeAgent:
                         continue
                     w.buffer.feed(data)
                     for msg in w.buffer.frames():
+                        if msg[0] == "actor_ready":
+                            # Track which worker hosts which actor — the
+                            # re-registration inventory needs it for
+                            # head-restart adoption.
+                            self.worker_actor[w.worker_id.binary()] = msg[1]
                         self._send_head(
                             ("wmsg", w.worker_id.binary(), msg))
 

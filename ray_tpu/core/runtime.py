@@ -374,6 +374,59 @@ class _Zygote:
             pass
 
 
+def _journal_safe_spec(spec):
+    """Copy a task/actor spec with memoryview buffers flattened to bytes so
+    it can ride the plain-pickle persistence journal."""
+    import copy
+    out = copy.copy(spec)
+    if getattr(out, "buffers", None):
+        out.buffers = [bytes(b) for b in out.buffers]
+    if getattr(out, "inline_deps", None):
+        out.inline_deps = {
+            k: (p, [bytes(b) for b in (bufs or [])])
+            for k, (p, bufs) in out.inline_deps.items()}
+    return out
+
+
+class _JournaledDict(dict):
+    """Dict that writes every mutation through to the head's persistence
+    store (a no-op append when persistence is off). Covers the direct
+    `rt.kv[...] = v` mutation style used across the control plane."""
+
+    def __init__(self, table: str, store):
+        super().__init__()
+        self._table = table
+        self._store = store
+
+    def __setitem__(self, key, value):
+        dict.__setitem__(self, key, value)
+        self._store.append(self._table, key, value)
+
+    def __delitem__(self, key):
+        dict.__delitem__(self, key)
+        self._store.delete(self._table, key)
+
+    def pop(self, key, *default):
+        had = key in self
+        out = dict.pop(self, key, *default)
+        if had:
+            self._store.delete(self._table, key)
+        return out
+
+    def setdefault(self, key, default=None):
+        if key not in self:
+            self[key] = default
+        return dict.__getitem__(self, key)
+
+    def update(self, *args, **kw):
+        for k, v in dict(*args, **kw).items():
+            self[k] = v
+
+    def load_silent(self, entries: dict):
+        """Restore replayed state without re-journaling it."""
+        dict.update(self, entries)
+
+
 class ActorState:
     def __init__(self, cspec: ActorCreationSpec):
         self.cspec = cspec
@@ -385,6 +438,10 @@ class ActorState:
         self.seq = 0
         self.resources_reserved: dict[str, float] = {}
         self.node_id: bytes | None = None
+        # True for actors rebuilt from the persistence journal after a head
+        # restart: they sit in RESTARTING until an agent re-registration
+        # adopts their still-running worker (or the adopt grace expires).
+        self.restored = False
 
 
 class ObjectDirectory:
@@ -597,13 +654,21 @@ class Runtime:
         self._reconstruct_count: dict[bytes, int] = {}   # task_id -> attempts
         self._streams: dict[bytes, dict] = {}  # streaming task state
         self.waiting_deps: dict[bytes, list] = {}  # oid -> [pending items]
+        # Pluggable head persistence (parity: gcs store_client tier):
+        # journaled dicts write through; everything else stays volatile.
+        from ray_tpu.core.persistence import FileStore, NullStore
+        self._persist = bool(cfg.head_persistence_path)
+        self._pstore = (FileStore(cfg.head_persistence_path)
+                        if self._persist else NullStore())
         self.actors: dict[bytes, ActorState] = {}
-        self.named_actors: dict[str, bytes] = {}
-        self.fn_table: dict[bytes, bytes] = {}  # fn_id -> blob
+        self.named_actors: dict[str, bytes] = _JournaledDict(
+            "named", self._pstore)
+        self.fn_table: dict[bytes, bytes] = _JournaledDict(
+            "fn", self._pstore)  # fn_id -> blob
         self.remote_subs: dict[bytes, list[bytes]] = {}  # oid -> [worker ids]
         self.actors_waiting_resources: collections.deque[bytes] = collections.deque()
         self._shutdown = False
-        self.kv: dict[tuple, bytes] = {}  # internal KV (parity: gcs_kv_manager.h)
+        self.kv: dict = _JournaledDict("kv", self._pstore)  # gcs_kv_manager.h
         self.placement_groups: dict[bytes, PlacementGroupState] = {}
         self.pgs_waiting: collections.deque[bytes] = collections.deque()
         self._reservations: dict[bytes, tuple] = {}  # task_id -> token
@@ -649,6 +714,101 @@ class Runtime:
         if cfg.object_spill_threshold < 1.0:
             threading.Thread(target=self._spill_monitor_loop, daemon=True,
                              name="rtpu-spill-monitor").start()
+        if self._persist:
+            self._restore_persisted()
+
+    # ---------------- head restart / persistence restore ----------------
+
+    def _restore_persisted(self):
+        """Replay the persistence journal into head tables (parity:
+        GcsInitData reload, gcs_init_data.h). Restored actors sit in
+        RESTARTING until an agent re-registration adopts their still-running
+        worker; unclaimed ones respawn after the adopt grace."""
+        tables = self._pstore.load()
+        if not tables:
+            return
+        import cloudpickle
+        self.kv.load_silent(tables.get("kv", {}))
+        self.fn_table.load_silent(tables.get("fn", {}))
+        self.named_actors.load_silent(tables.get("named", {}))
+        restored_actors = []
+        for aid, blob in tables.get("actor", {}).items():
+            try:
+                cspec = cloudpickle.loads(blob)
+            except Exception:  # noqa: BLE001 — skip unloadable actors
+                continue
+            st = ActorState(cspec)
+            st.state = A_RESTARTING
+            st.restored = True
+            self.actors[aid] = st
+            restored_actors.append(aid)
+        for pg_id, (bundles, strategy, name) in tables.get("pg", {}).items():
+            try:
+                self.create_placement_group(pg_id, bundles, strategy, name)
+            except Exception:  # noqa: BLE001 — infeasible until nodes rejoin
+                pass
+        for tid, spec in tables.get("task", {}).items():
+            if spec.dependencies:
+                # The object directory died with the old head: a replayed
+                # task would gate on oids nothing can ever resolve. Drop it
+                # (and its journal record) instead of hanging silently —
+                # the owner resubmits from its side on failure.
+                self._pstore.delete("task", tid)
+                continue
+            try:
+                self.submit_task(spec)
+            except Exception:  # noqa: BLE001 — drop unreplayable tasks
+                pass
+        if restored_actors:
+            grace = self.config.head_restart_adopt_grace_s
+
+            def respawn_unclaimed():
+                time.sleep(grace)
+                for aid in restored_actors:
+                    st = self.actors.get(aid)
+                    if (st is not None and st.restored
+                            and st.state == A_RESTARTING
+                            and st.worker is None):
+                        st.restored = False
+                        threading.Thread(target=self._create_actor_now,
+                                         args=(st.cspec,),
+                                         daemon=True).start()
+
+            threading.Thread(target=respawn_unclaimed, daemon=True).start()
+
+    def _adopt_actor_worker(self, aid: bytes, w: "WorkerHandle") -> bool:
+        """An agent re-registered a worker that still hosts `aid`: wire it
+        back in as ALIVE without restarting (the in-memory actor state in
+        the worker process survived the head restart). Returns False when
+        the actor is not adoptable — e.g. it was already restarted
+        elsewhere, leaving this worker a stale duplicate."""
+        st = self.actors.get(aid)
+        if st is None or not (st.restored and st.state == A_RESTARTING):
+            return st is not None and st.worker is w
+        w.actor_id = aid
+        with self.lock:
+            st.worker = w
+            st.node_id = w.node_id
+            st.state = A_ALIVE
+            st.restored = False
+            # Re-reserve the actor's resources on its node so scheduling
+            # accounting stays truthful after the restart — EXCEPT for
+            # actors living inside a placement group: the journal-restored
+            # PG re-carves its bundles itself, and a node-level reservation
+            # here would double-count and park the PG in PENDING forever.
+            if getattr(st.cspec, "placement_group_id", None) is None:
+                node = self.nodes.get(w.node_id)
+                req = self._actor_resources(st.cspec)
+                if node is not None:
+                    for k, v in req.items():
+                        node.available[k] = node.available.get(k, 0.0) - v
+                    st.resources_reserved = ("node", w.node_id, req)
+            queued = list(st.queued)
+            st.queued.clear()
+        self._export_actor(st, "ALIVE")
+        for spec in queued:
+            self._send_actor_task(st, spec)
+        return True
 
     # ---------------- object spilling ----------------
     #
@@ -1295,18 +1455,60 @@ class Runtime:
                         node.workers[wid] = w
             self._handle_msg(w, inner)
         elif op == "register_node":
-            _, nid, resources, peer_addr, hostname, pid = msg
-            node = NodeState(nid, resources, conn=conn, peer_addr=peer_addr,
-                             hostname=hostname, pid=pid)
-            conn.node_id = nid
+            _, nid, resources, peer_addr, hostname, pid = msg[:6]
+            inventory = msg[6] if len(msg) > 6 else []
             with self.lock:
-                self.nodes[nid] = node
-                self._node_order.append(nid)
-                for k, v in resources.items():
-                    self.total_resources[k] = (
-                        self.total_resources.get(k, 0.0) + v)
+                prev = self.nodes.get(nid)
+                if prev is not None and prev.state == "ALIVE":
+                    # Re-registration (agent reconnected after a head
+                    # restart or link flap): adopt the connection without
+                    # double-counting resources. Every existing worker
+                    # handle must follow — they route through the node conn.
+                    prev.conn = conn
+                    conn.node_id = nid
+                    node = prev
+                    for wh in prev.workers.values():
+                        if isinstance(wh, RemoteWorkerHandle):
+                            wh.node_conn = conn
+                else:
+                    node = NodeState(nid, resources, conn=conn,
+                                     peer_addr=peer_addr, hostname=hostname,
+                                     pid=pid)
+                    conn.node_id = nid
+                    self.nodes[nid] = node
+                    if nid not in self._node_order:
+                        self._node_order.append(nid)
+                    for k, v in resources.items():
+                        self.total_resources[k] = (
+                            self.total_resources.get(k, 0.0) + v)
                 # New capacity may unblock queued PGs/actors.
                 self._kick_waiters()
+            # Worker inventory: rebuild handles for surviving workers and
+            # adopt the actors they still host (head-restart resync,
+            # parity: raylets resyncing with a restarted GCS).
+            for wid, aid in inventory:
+                w = self.workers.get(wid)
+                if w is None:
+                    w = RemoteWorkerHandle(WorkerID(wid), conn, nid)
+                    w.connected.set()
+                    with self.lock:
+                        self.workers[wid] = w
+                        node.workers[wid] = w
+                        if not aid:
+                            # Surviving pool worker: back into the idle
+                            # pool (a mid-task worker just queues behind
+                            # its current work).
+                            w.state = IDLE
+                            node.idle.append(w)
+                if aid and not self._adopt_actor_worker(aid, w):
+                    # Not adoptable: the actor was restarted elsewhere (or
+                    # permanently died) while this node was away — its old
+                    # worker is a stale duplicate that must not keep
+                    # mutating state.
+                    try:
+                        conn.send(("kill_worker", wid))
+                    except OSError:
+                        pass
             conn.send(("node_ack", self.head_node_id))
             if self.export_events is not None:
                 self.export_events.emit("NODE", node_id=nid.hex(),
@@ -1533,7 +1735,9 @@ class Runtime:
             return
         if conn.node_id is not None:
             node = self.nodes.get(conn.node_id)
-            if node is not None:
+            # A reconnected agent already swapped in a fresh conn: the OLD
+            # socket's EOF must not kill the re-registered live node.
+            if node is not None and node.conn is conn:
                 self._on_node_death(node)
 
     def _on_node_death(self, node: NodeState):
@@ -1921,6 +2125,12 @@ class Runtime:
     def submit_task(self, spec: TaskSpec, fn_blob: bytes | None = None):
         if fn_blob is not None:
             self.export_function(spec.fn_id, fn_blob)
+        if self._persist and spec.actor_id is None and not spec.streaming:
+            # Journal normal tasks so a restarted head re-queues them
+            # (removed again on completion/failure). Out-of-band buffers
+            # become plain bytes for the pickle journal.
+            self._pstore.append("task", spec.task_id,
+                                _journal_safe_spec(spec))
         self.task_events.record(spec.task_id, spec, "SUBMITTED")
         if spec.streaming:
             self._register_stream(spec.task_id)
@@ -2463,6 +2673,8 @@ class Runtime:
         # The PG record owns its ready-object for the PG's lifetime; without
         # the pin the first ready() handle to be GC'd would free the entry.
         self.refcount.pin(st.ready_oid)
+        if self._persist:
+            self._pstore.append("pg", pg_id, (list(bundles), strategy, name))
         created = False
         with self.lock:
             self.placement_groups[pg_id] = st
@@ -2584,6 +2796,7 @@ class Runtime:
             self._kick_waiters()  # kick waiting actors/tasks gated on this PG
 
     def remove_placement_group(self, pg_id: bytes):
+        self._pstore.delete("pg", pg_id)
         with self.lock:
             st = self.placement_groups.get(pg_id)
             if st is None or st.state == "REMOVED":
@@ -2946,6 +3159,8 @@ class Runtime:
         spec = self._pop_assignment(w, task_id)
         if spec is not None:
             self.task_events.record(task_id, spec, "FINISHED")
+            if self._persist and spec.actor_id is None and not spec.streaming:
+                self._pstore.delete("task", task_id)
             if not spec.streaming:
                 self._lineage_register(spec)
             self._unpin_deps(spec)
@@ -2955,6 +3170,8 @@ class Runtime:
         err = exc if isinstance(exc, TaskError) else TaskError(
             exc, str(exc), spec.describe())
         self._unpin_deps(spec)
+        if self._persist and spec.actor_id is None and not spec.streaming:
+            self._pstore.delete("task", spec.task_id)
         with self.lock:
             self._reconstructing.discard(spec.task_id)
         if spec.streaming:
@@ -2998,6 +3215,11 @@ class Runtime:
                 self.actors[cspec.actor_id] = st
                 if cspec.name:
                     self.named_actors[cspec.name] = cspec.actor_id
+            if self._persist:
+                import cloudpickle
+                self._pstore.append(
+                    "actor", cspec.actor_id,
+                    cloudpickle.dumps(_journal_safe_spec(cspec)))
         except RayTpuError as e:
             if not from_worker:
                 raise
@@ -3090,6 +3312,10 @@ class Runtime:
         w.send(("create_actor", cspec))
 
     def _export_actor(self, st: "ActorState", state: str):
+        if state == "DEAD":
+            # Permanently dead actors leave the persistence journal (every
+            # terminal transition funnels through this export).
+            self._pstore.delete("actor", st.cspec.actor_id)
         if self.export_events is not None:
             self.export_events.emit("ACTOR",
                                     actor_id=st.cspec.actor_id.hex(),

@@ -12,6 +12,11 @@ class DataContext:
     target_min_block_size: int = 1 * 1024 * 1024
     read_parallelism: int = 8          # default override_num_blocks for reads
     max_tasks_in_flight: int = 8       # per-operator streaming window
+    # Global byte budget for in-flight operator outputs across the whole
+    # pipeline (parity: execution/resource_manager.py + backpressure
+    # policies). 0 = unlimited. Liveness rule: a stage with nothing in
+    # flight may always submit one task regardless of the budget.
+    memory_budget_bytes: int = 0
     eager_free: bool = True
     verbose_progress: bool = False
 

@@ -140,26 +140,60 @@ class _MapWorker:
 # ---------------- the executor ----------------
 
 
+class _MemoryBudget:
+    """Pipeline-global byte accounting for in-flight operator outputs
+    (parity: the reference's per-op ResourceManager + backpressure policies,
+    concept of streaming_executor_state.py:542). try_acquire never blocks —
+    the window loop falls back to draining its own completions, and the
+    liveness rule (one task per starved stage) rides the `force` path so a
+    budget smaller than one block can never deadlock the pipeline."""
+
+    def __init__(self, limit: int):
+        self.limit = limit
+        self.used = 0
+        self.peak = 0
+        self._lock = __import__("threading").Lock()
+
+    def try_acquire(self, nbytes: int, force: bool = False) -> bool:
+        if not self.limit:
+            return True
+        with self._lock:
+            if not force and self.used + nbytes > self.limit:
+                return False
+            self.used += nbytes
+            self.peak = max(self.peak, self.used)
+            return True
+
+    def release(self, nbytes: int):
+        if not self.limit:
+            return
+        with self._lock:
+            self.used = max(0, self.used - nbytes)
+
+
 def execute(logical_plan: plan_mod.LogicalPlan,
             ctx: DataContext | None = None) -> Iterator[tuple]:
     """Yields (block_ref, BlockMetadata) in order."""
     ctx = ctx or DataContext.get_current()
     plan = logical_plan.optimized()
+    budget = _MemoryBudget(ctx.memory_budget_bytes)
+    ctx._budget = budget  # observable by tests/metrics
     stream: Iterator[tuple] | None = None
     for op in plan.ops:
-        stream = _apply_op(op, stream, ctx)
+        stream = _apply_op(op, stream, ctx, budget)
     return stream if stream is not None else iter(())
 
 
-def _apply_op(op, upstream, ctx: DataContext):
+def _apply_op(op, upstream, ctx: DataContext, budget=None):
+    budget = budget or _MemoryBudget(0)
     if isinstance(op, plan_mod.Read):
-        return _read_stage(op, ctx)
+        return _read_stage(op, ctx, budget)
     if isinstance(op, plan_mod.InputData):
         return iter(op.refs)
     if isinstance(op, plan_mod.MapBlocks):
         if op.fn_constructor is not None:
             return _actor_map_stage(op, upstream, ctx)
-        return _task_map_stage(op, upstream, ctx)
+        return _task_map_stage(op, upstream, ctx, budget)
     if isinstance(op, plan_mod.AllToAll):
         return _all_to_all_stage(op, upstream, ctx)
     if isinstance(op, plan_mod.Limit):
@@ -176,28 +210,53 @@ def _finish(pair):
     return bref, ray_tpu.get(mref, timeout=600)
 
 
-def _windowed(submits, window: int):
-    """Submit lazily, keep <= window tasks in flight, yield in order."""
-    pending = collections.deque()
-    for submit in submits:
+def _windowed(submits, window: int, budget=None, est_bytes=None):
+    """Submit lazily, keep <= window tasks in flight, yield in order.
+
+    With a budget, a submit additionally needs `est` bytes of the global
+    budget; a starved stage first drains its own completions, and a stage
+    with nothing in flight submits anyway (liveness — the pipeline always
+    makes progress even when one block exceeds the whole budget)."""
+    pending = collections.deque()  # (task_refs, acquired_bytes)
+
+    def finish_one():
+        refs, nbytes = pending.popleft()
+        out = _finish(refs)
+        if budget is not None:
+            budget.release(nbytes)
+        return out
+
+    for item in submits:
+        submit, est = (item if isinstance(item, tuple) else (item, 0))
+        if est_bytes is not None:
+            est = est_bytes
         while len(pending) >= window:
-            yield _finish(pending.popleft())
-        pending.append(submit())
+            yield finish_one()
+        if budget is not None:
+            while (pending and not budget.try_acquire(est)):
+                yield finish_one()
+            if not pending:
+                budget.try_acquire(est, force=True)  # liveness
+        pending.append((submit(), est))
     while pending:
-        yield _finish(pending.popleft())
+        yield finish_one()
 
 
-def _read_stage(op: plan_mod.Read, ctx):
+def _read_stage(op: plan_mod.Read, ctx, budget=None):
     return _windowed(
         ((lambda fn=fn: _read_task.remote(fn)) for fn in op.read_fns),
-        ctx.max_tasks_in_flight)
+        ctx.max_tasks_in_flight, budget=budget,
+        est_bytes=ctx.target_min_block_size)
 
 
-def _task_map_stage(op: plan_mod.MapBlocks, upstream, ctx):
+def _task_map_stage(op: plan_mod.MapBlocks, upstream, ctx, budget=None):
+    # Estimate each output at its input block's size (metadata is exact for
+    # the upstream block; maps are usually size-preserving or shrinking).
     return _windowed(
-        ((lambda bref=bref: _map_task.remote(op.fn, bref))
-         for bref, _meta in upstream),
-        ctx.max_tasks_in_flight)
+        (((lambda bref=bref: _map_task.remote(op.fn, bref)),
+          int(meta.size_bytes or ctx.target_min_block_size))
+         for bref, meta in upstream),
+        ctx.max_tasks_in_flight, budget=budget)
 
 
 def _actor_map_stage(op: plan_mod.MapBlocks, upstream, ctx):

@@ -69,17 +69,28 @@ class LogicalPlan:
         return LogicalPlan(self.ops + [op])
 
     def optimized(self) -> "LogicalPlan":
-        """Fuse adjacent MapBlocks (task-pool ones) into single chains."""
+        """Operator fusion (parity: the reference's rule-based optimizer
+        fusing read->map and map->map chains into single tasks): adjacent
+        task-pool MapBlocks compose; a task-pool MapBlocks directly after a
+        Read folds into the read tasks themselves — one task reads AND
+        transforms, halving task count and intermediate block traffic."""
         out: list[LogicalOp] = []
         for op in self.ops:
-            if (isinstance(op, MapBlocks) and out
-                    and isinstance(out[-1], MapBlocks)
-                    and out[-1].compute is None and op.compute is None):
+            fusable_map = (isinstance(op, MapBlocks) and op.compute is None
+                           and op.fn_constructor is None)
+            if (fusable_map and out and isinstance(out[-1], MapBlocks)
+                    and out[-1].compute is None
+                    and out[-1].fn_constructor is None):
                 prev = out.pop()
-                pf, nf = prev.fn, op.fn
                 out.append(MapBlocks(
                     name=f"{prev.name}->{op.name}",
-                    fn=_compose(pf, nf)))
+                    fn=_compose(prev.fn, op.fn)))
+            elif fusable_map and out and isinstance(out[-1], Read):
+                prev = out.pop()
+                out.append(Read(
+                    name=f"{prev.name}->{op.name}",
+                    read_fns=[_compose_read(rf, op.fn)
+                              for rf in prev.read_fns]))
             else:
                 out.append(op)
         return LogicalPlan(out)
@@ -92,3 +103,9 @@ def _compose(f, g):
     def fused(table):
         return g(f(table))
     return fused
+
+
+def _compose_read(read_fn, map_fn):
+    def fused_read():
+        return map_fn(read_fn())
+    return fused_read

@@ -244,3 +244,54 @@ def test_dashboard_index_page(tooling_cluster):
         body = r.read().decode()
     assert "ray_tpu dashboard" in body
     assert "/api/cluster_status" in body
+
+
+def test_tpu_slice_provider_ici_scaleup():
+    """A pending ICI_CONTIGUOUS placement group of N TPU chips makes the
+    autoscaler launch the SMALLEST slice type that holds N chips (as one
+    agent per host), after which the PG schedules on the contiguous hosts
+    (SURVEY §7 item 11; reference tpu.py:422 TPU-{type}-head generalized)."""
+    from ray_tpu.autoscaler import Autoscaler, AutoscalingConfig
+    from ray_tpu.autoscaler.tpu import (TPUSliceProvider, pick_slice_type,
+                                        slice_hosts)
+    from ray_tpu.util.placement_group import (placement_group,
+                                              placement_group_table,
+                                              remove_placement_group)
+
+    # Pure selection logic first.
+    assert pick_slice_type("v5litepod", 12) == "v5litepod-16"
+    assert pick_slice_type("v5litepod", 8) == "v5litepod-8"
+    assert pick_slice_type("v4", 9) == "v4-16"
+    hosts = slice_hosts("v5litepod-16")
+    assert [h["TPU"] for h in hosts] == [8.0, 8.0]
+    assert hosts[0]["TPU-v5litepod-16-head"] == 1.0
+    assert "TPU-v5litepod-16-head" not in hosts[1]
+
+    rt = ray_tpu.init(num_cpus=1)
+    provider = TPUSliceProvider(rt, generation="v5litepod")
+    scaler = Autoscaler(
+        AutoscalingConfig(node_types={}, reconcile_interval_s=0.25),
+        provider, rt)
+    scaler.start()
+    try:
+        # 12 chips across 2 bundles -> needs a v5litepod-16 (2 hosts x 8).
+        pg = placement_group([{"TPU": 8}, {"TPU": 4}],
+                             strategy="ICI_CONTIGUOUS")
+        assert pg.wait(timeout_seconds=120)
+        # launch_slice records the slice after ALL hosts register; the PG
+        # can win that race by a beat.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not provider.slices:
+            time.sleep(0.2)
+        assert len(provider.slices) == 1
+        name = next(iter(provider.slices))
+        assert name.startswith("v5litepod-16-")
+        assert len(provider.slices[name]) == 2
+        table = placement_group_table()[pg.id.hex()]
+        assert table["state"] == "CREATED"
+        remove_placement_group(pg)
+    finally:
+        scaler.stop()
+        for name in list(provider.slices):
+            provider.terminate_slice(name)
+        ray_tpu.shutdown()
