@@ -49,6 +49,9 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "memory_usage_threshold": (float, 0.95, "kill a worker above this usage"),
     # --- control plane ---
     "health_check_period_ms": (int, 1000, "node health-check interval"),
+    "fetch_retry_timeout_s": (float, 10.0, "re-drive a cross-node object "
+                              "fetch with no reply after this long "
+                              "(<=0 disables; 3 retries then lost)"),
     "health_check_failure_threshold": (int, 5, "missed checks before a node is dead"),
     "gcs_port": (int, 0, "GCS TCP port; 0 = pick free port"),
     # --- head fault tolerance (parity: redis_store_client.h:111 +

@@ -35,6 +35,7 @@ from ray_tpu.core.object_store import SharedMemoryStore, default_store_size
 from ray_tpu.core.runtime import (
     _Zygote,
     _reap_stale_stores,
+    apply_pip_env,
     build_worker_env,
     spawn_worker_process,
 )
@@ -93,6 +94,7 @@ class NodeAgent:
         self._reconnecting = False
         self._reconnect_lock = threading.Lock()
         self.worker_actor: dict[bytes, bytes] = {}  # wid -> hosted actor id
+        self.worker_env_key: dict[bytes, str] = {}  # wid -> pip env pool
         self.workers: dict[bytes, _AgentWorker] = {}
         self._register()
         self.pool_size = max(1, cfg.num_workers or int(self.resources["CPU"]))
@@ -120,14 +122,18 @@ class NodeAgent:
             except Exception:  # noqa: BLE001 — keep filling the pool
                 traceback.print_exc()
 
-    def _spawn_worker(self):
+    def _spawn_worker(self, pip: list | None = None):
         if self._shutdown:
             return
         worker_id = WorkerID.from_random()
+        env, zygote, env_key = apply_pip_env(
+            self._worker_env(), self.zygote, pip)
         parent, proc = spawn_worker_process(
-            worker_id, self.store_path, self._worker_env(), self.zygote,
+            worker_id, self.store_path, env, zygote,
             self.session_dir)
         w = _AgentWorker(worker_id, parent, proc)
+        if env_key:
+            self.worker_env_key[worker_id.binary()] = env_key
         self.workers[worker_id.binary()] = w
         with self._sel_lock:
             self._selector.register(parent, selectors.EVENT_READ,
@@ -146,6 +152,7 @@ class NodeAgent:
         if self.workers.pop(w.worker_id.binary(), None) is None:
             return
         self.worker_actor.pop(w.worker_id.binary(), None)
+        self.worker_env_key.pop(w.worker_id.binary(), None)
         self._send_head(("worker_death", w.worker_id.binary()))
         if not self._shutdown and len(self.workers) < self.pool_size:
             threading.Thread(target=self._spawn_worker, daemon=True).start()
@@ -156,7 +163,8 @@ class NodeAgent:
         """(Re-)introduce this node to the head, with a worker inventory so
         a restarted head can adopt surviving workers/actors (parity:
         raylets resyncing with a restarted GCS)."""
-        inventory = [(wid, self.worker_actor.get(wid))
+        inventory = [(wid, self.worker_actor.get(wid),
+                      self.worker_env_key.get(wid))
                      for wid in list(self.workers)]
         send_msg(self.head_sock,
                  ("register_node", self.node_id, self.resources,
@@ -236,9 +244,10 @@ class NodeAgent:
                 except OSError:
                     pass
         elif op == "spawn_worker":
+            pip = msg[1] if len(msg) > 1 else None
             if len(self.workers) < self.max_workers:
                 threading.Thread(target=self._spawn_worker,
-                                 daemon=True).start()
+                                 kwargs={"pip": pip}, daemon=True).start()
         elif op == "kill_worker":
             w = self.workers.get(msg[1])
             if w is not None and w.proc is not None:

@@ -97,6 +97,21 @@ def build_worker_env(config, node_id_hex: str,
     return env
 
 
+def apply_pip_env(env: dict, zygote, pip: list | None):
+    """Prepare a worker spawn for a pip runtime env: build/reuse the env,
+    point the worker at it, and force a cold spawn (the zygote's env is
+    baked at fork-server start). Returns (env, zygote, env_key). Shared by
+    the head runtime and node agents."""
+    if not pip:
+        return env, zygote, None
+    from ray_tpu.core.runtime_env import ensure_pip_env, pip_env_key
+    env = dict(env)
+    env["RAY_TPU_VENV_SITE"] = ensure_pip_env(pip)
+    env_key = pip_env_key(pip)
+    env["RAY_TPU_ENV_KEY"] = env_key
+    return env, None, env_key
+
+
 def spawn_worker_process(worker_id: WorkerID, store_path: str, env: dict,
                          zygote: "_Zygote | None", session_dir: str):
     """Fork a worker from the warm zygote, or cold-exec as fallback.
@@ -148,6 +163,9 @@ class WorkerHandle:
         # dispatch, parity: max_tasks_in_flight_per_worker lease reuse).
         self.assigned: collections.deque[TaskSpec] = collections.deque()
         self.actor_id: bytes | None = None
+        # Per-env worker pools (parity: worker_pool.h:228): None = default
+        # pool; otherwise the pip env key the worker booted with.
+        self.env_key: str | None = None
         self.buffer = FrameBuffer()
 
     @property
@@ -372,6 +390,13 @@ class _Zygote:
             self.proc.wait(timeout=2.0)
         except Exception:  # noqa: BLE001
             pass
+
+
+def _pip_key_of(spec) -> str | None:
+    """Per-env worker-pool key of a spec (None = the default pool)."""
+    from ray_tpu.core.runtime_env import pip_env_key, pip_requirements
+    pip = pip_requirements(getattr(spec, "runtime_env", None))
+    return pip_env_key(pip) if pip else None
 
 
 def _journal_safe_spec(spec):
@@ -999,15 +1024,18 @@ class Runtime:
         return build_worker_env(self.config, self.head_node_id.hex(),
                                 is_head=True)
 
-    def _spawn_worker(self) -> WorkerHandle:
+    def _spawn_worker(self, pip: list | None = None) -> WorkerHandle:
         if self._shutdown:
             return None
         worker_id = WorkerID.from_random()
+        env, zygote, env_key = apply_pip_env(
+            self._worker_env(), self._zygote, pip)
         parent, proc = spawn_worker_process(
-            worker_id, self.store_path, self._worker_env(), self._zygote,
+            worker_id, self.store_path, env, zygote,
             self.session_dir)
         handle = WorkerHandle(worker_id, parent, proc,
                               node_id=self.head_node_id)
+        handle.env_key = env_key
         with self.lock:
             if self._shutdown:
                 # Raced with shutdown(): it won't see this handle, so clean
@@ -1024,9 +1052,11 @@ class Runtime:
     def _replenish_pool_async(self):
         def run():
             with self.lock:
-                # Head-pool only: remote workers are the agents' business.
+                # Head DEFAULT pool only: remote workers are the agents'
+                # business, env-pool workers are demand-spawned.
                 n_pool = sum(1 for w in self.head_node.workers.values()
-                             if w.state in (IDLE, BUSY))
+                             if w.state in (IDLE, BUSY)
+                             and w.env_key is None)
                 need = self.pool_size - n_pool
             for _ in range(max(0, need)):
                 self._spawn_worker()
@@ -1105,14 +1135,23 @@ class Runtime:
             self._stream_append(task_id, rid)
         elif op == "ready":
             w.connected.set()
+            if len(msg) > 3 and msg[3]:
+                w.env_key = msg[3]  # env-pool worker (remote agents spawn
+                # them; the key rides the ready frame)
             with self.lock:
                 if w.state == DEAD:
                     return
                 node = self.nodes.get(w.node_id)
                 if node is not None and node.pending_actor_assign:
-                    aid = node.pending_actor_assign.popleft()
-                    self._assign_actor_locked(self.actors[aid], w)
-                    return
+                    # First pending actor whose env pool matches this
+                    # worker (default actors <-> default workers).
+                    for i, aid in enumerate(node.pending_actor_assign):
+                        st = self.actors.get(aid)
+                        if (st is not None and
+                                _pip_key_of(st.cspec) == w.env_key):
+                            del node.pending_actor_assign[i]
+                            self._assign_actor_locked(st, w)
+                            return
                 w.state = IDLE
                 if node is not None:
                     node.idle.append(w)
@@ -1486,11 +1525,15 @@ class Runtime:
             # Worker inventory: rebuild handles for surviving workers and
             # adopt the actors they still host (head-restart resync,
             # parity: raylets resyncing with a restarted GCS).
-            for wid, aid in inventory:
+            for item in inventory:
+                wid, aid = item[0], item[1]
+                env_key = item[2] if len(item) > 2 else None
                 w = self.workers.get(wid)
                 if w is None:
                     w = RemoteWorkerHandle(WorkerID(wid), conn, nid)
                     w.connected.set()
+                    w.env_key = env_key  # adopted env workers keep their
+                    # pip pool — a default task must not land on them
                     with self.lock:
                         self.workers[wid] = w
                         node.workers[wid] = w
@@ -1656,6 +1699,67 @@ class Runtime:
                 dest.conn.send(("fetch", oid, src_addr, info["attempt"]))
         except OSError as e:
             self._finish_fetch(key, False, e)
+            return
+        if dest.conn is not None:
+            # Frame-based agent fetch only: the head-bound peer pull runs in
+            # its own thread and always resolves itself.
+            self._arm_fetch_watchdog(key, info["attempt"])
+
+    def _arm_fetch_watchdog(self, key, attempt):
+        """A fetch whose frame (or reply) was dropped would otherwise park
+        every co-waiter forever. RESEND the frame periodically (bounded,
+        same attempt id — a slow but healthy transfer keeps its attempt and
+        its eventual completion stays valid; a duplicate pull on the agent
+        is idempotent). Truly-lost objects are failed by the node-death /
+        no-source paths, never by the watchdog itself."""
+        period = self.config.fetch_retry_timeout_s
+        if period <= 0:
+            return
+
+        def check():
+            from ray_tpu.core.status import ObjectLostError
+            with self.lock:
+                info = self._fetches.get(key)
+                if info is None or info["attempt"] != attempt:
+                    return  # completed or superseded
+                retries = info.get("retries", 0)
+                info["retries"] = retries + 1
+            dest = self.nodes.get(key[0])
+            if dest is None or dest.state != "ALIVE" or dest.conn is None:
+                # Dest died between pops and probes: fail the waiters —
+                # the stale-dest sweep may already have missed this entry.
+                with self.lock:
+                    info2 = self._fetches.pop(key, None)
+                for cb in (info2 or {}).get("cbs", []):
+                    cb(False, ObjectLostError(ObjectID(key[1])))
+                return
+            if retries >= 5:
+                return  # stop resending; other failure paths own it now
+            entry = self.directory.lookup(key[1])
+            src = None
+            if entry is not None and entry[0] == "shm" and len(entry) > 1:
+                src = next((n for nid in entry[1]
+                            if (n := self.nodes.get(nid)) is not None
+                            and n.state == "ALIVE"), None)
+            if src is None:
+                # No live source anymore: re-drive through the normal path
+                # (spill restore / reconstruction / loss).
+                with self.lock:
+                    info2 = self._fetches.pop(key, None)
+                for cb in (info2 or {}).get("cbs", []):
+                    self._fetch_to_node(dest, key[1], cb)
+                return
+            try:
+                src_addr = (tuple(src.peer_addr) if src.conn is not None
+                            else self.head_peer_addr)
+                dest.conn.send(("fetch", key[1], src_addr, attempt))
+            except OSError:
+                pass
+            self._arm_fetch_watchdog(key, attempt)
+
+        t = threading.Timer(period, check)
+        t.daemon = True
+        t.start()
 
     def _pull_via_peer(self, src: NodeState, oid: bytes, attempt=None):
         """Worker thread: pull one object from src's peer port to the head
@@ -2869,7 +2973,13 @@ class Runtime:
         strat = spec.scheduling_strategy
         return (tuple(sorted(req.items())),
                 strat if isinstance(strat, str) or strat is None
-                else id(strat))
+                else id(strat),
+                _pip_key_of(spec))
+
+    @staticmethod
+    def _pip_env_of(spec) -> list | None:
+        from ray_tpu.core.runtime_env import pip_requirements
+        return pip_requirements(getattr(spec, "runtime_env", None))
 
     def _enqueue_task_locked(self, spec: TaskSpec, front: bool = False):
         q = self.task_queues.setdefault(self._sched_key(spec),
@@ -2919,19 +3029,23 @@ class Runtime:
                         self._pipeline_locked(sig, q, dispatches)
                         break
                     node, token = res
-                    if not node.idle:
-                        # Resources fit but no free worker on that node:
-                        # quiet rollback (no _kick_waiters churn), ask for a
-                        # worker, park the key. Every key still gets its own
-                        # probe this pass — a blocked key must not starve
-                        # feasible keys behind it.
+                    env_key = sig[2]
+                    w = self._take_idle_locked(node, env_key)
+                    if w is None:
+                        # Resources fit but no free matching worker on that
+                        # node: quiet rollback (no _kick_waiters churn), ask
+                        # for a worker (of the right env pool), park the
+                        # key. Every key still gets its own probe this pass
+                        # — a blocked key must not starve feasible keys
+                        # behind it.
                         self._rollback_token_locked(token)
                         self._pipeline_locked(sig, q, dispatches)
-                        self._request_worker_locked(node)
+                        self._request_worker_locked(
+                            node, pip=self._pip_env_of(spec)
+                            if env_key else None)
                         break
                     q.popleft()
                     self._reservations[spec.task_id] = token
-                    w = node.idle.popleft()
                     w.state = BUSY
                     w.assigned.append(spec)
                     self._sig_workers.setdefault(sig, set()).add(w)
@@ -2991,7 +3105,10 @@ class Runtime:
                         break
                     node, token = res
                     self._rollback_token_locked(token)
-                    if not node.idle:
+                    # The idle worker must be from the spec's env pool —
+                    # stealing onto a mismatched pool parks the task.
+                    ek = _pip_key_of(spec)
+                    if not any(iw.env_key == ek for iw in node.idle):
                         break
                     w.assigned.pop()
                     stolen.append((w, spec))
@@ -3006,6 +3123,17 @@ class Runtime:
             except OSError:
                 pass
         return bool(stolen)
+
+    @staticmethod
+    def _take_idle_locked(node: NodeState, env_key: str | None):
+        """Pop an idle worker from the right env pool: env tasks need an
+        exact env match; default tasks run on default-pool workers only
+        (keeps env workers available for their env)."""
+        for i, w in enumerate(node.idle):
+            if w.env_key == env_key:
+                del node.idle[i]
+                return w
+        return None
 
     def _pipeline_locked(self, sig, q, dispatches):
         """Assign queued same-key tasks to busy workers already executing
@@ -3051,8 +3179,9 @@ class Runtime:
                  st.bundle_nodes[i] if st is not None and st.bundle_nodes
                  else self.head_node_id, req))
 
-    def _request_worker_locked(self, node: NodeState):
-        """Grow a node's worker pool on demand (rate-limited)."""
+    def _request_worker_locked(self, node: NodeState, pip: list | None = None):
+        """Grow a node's worker pool on demand (rate-limited). With `pip`,
+        the new worker boots into that env's pool (worker_pool.h:228)."""
         now = time.monotonic()
         if now - node.last_spawn_req < 0.5:
             return
@@ -3061,10 +3190,11 @@ class Runtime:
             alive = sum(1 for w in node.workers.values() if w.state != DEAD)
             if alive < self.pool_size * 2 + 8:
                 threading.Thread(target=self._spawn_worker,
-                                 daemon=True).start()
+                                 kwargs={"pip": pip}, daemon=True).start()
         else:
             try:
-                node.conn.send(("spawn_worker",))
+                node.conn.send(("spawn_worker", pip)
+                               if pip else ("spawn_worker",))
             except OSError:
                 pass
 
@@ -3282,7 +3412,10 @@ class Runtime:
                 return
             st.resources_reserved = token
             st.node_id = node.node_id
-            w = node.idle.popleft() if node.idle else None
+            # Env-pool matching (worker_pool.h:228): an actor with a pip
+            # runtime_env needs a worker from that env's pool, a default
+            # actor must not consume (or contaminate itself on) one.
+            w = self._take_idle_locked(node, _pip_key_of(cspec))
             if w is not None:
                 self._assign_actor_locked(st, w)
                 spawn_new = True
@@ -3291,15 +3424,22 @@ class Runtime:
                 spawn_new = False
         # Keep the pool at size for plain tasks; new process feeds the pool
         # (or picks up the pending assignment on connect).
+        pip = self._pip_env_of(cspec)
         if node.conn is not None:
             try:
-                node.conn.send(("spawn_worker",))
+                # When the actor is still waiting, the spawned worker must
+                # come from its env pool; when it was assigned, replenish
+                # the default pool.
+                node.conn.send(("spawn_worker", pip)
+                               if pip and not spawn_new
+                               else ("spawn_worker",))
             except OSError:
                 pass
         elif spawn_new:
             self._replenish_pool_async()
         else:
-            threading.Thread(target=self._spawn_worker, daemon=True).start()
+            threading.Thread(target=self._spawn_worker,
+                             kwargs={"pip": pip}, daemon=True).start()
 
     def _assign_actor_locked(self, st: ActorState, w: WorkerHandle):
         cspec = st.cspec

@@ -1,0 +1,95 @@
+"""Runtime environments: pip package sets with URI-cache semantics and
+per-env worker pools.
+
+Parity: reference `python/ray/_private/runtime_env/pip.py` (pip envs built
+once per content hash, cached under a URI key) served by the runtime-env
+agent (`agent/runtime_env_agent.py:167`), and per-env worker pools keyed by
+the env in `WorkerPool` (`worker_pool.h:228`).
+
+TPU-first simplification: instead of full virtualenvs + a per-node agent
+service, a pip env is a `pip install --target` directory keyed by the
+sha256 of its requirement list. Workers spawned for the env prepend the
+directory to sys.path at boot (before any task runs), giving the
+requirement set import precedence over the host env; the scheduler keys
+worker pools by the env so tasks only ever land on matching workers.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+
+_build_lock = threading.Lock()
+_build_counts: dict[str, int] = {}  # env key -> builds performed (tests)
+
+
+def pip_requirements(runtime_env: dict | None) -> list[str] | None:
+    """Normalized pip requirement list of a runtime_env, or None."""
+    if not runtime_env:
+        return None
+    pip = runtime_env.get("pip")
+    if not pip:
+        return None
+    if isinstance(pip, dict):  # reference accepts {"packages": [...]}
+        pip = pip.get("packages", [])
+    return [str(p) for p in pip]
+
+
+def pip_env_key(pip: list[str]) -> str:
+    """Content hash of the requirement list (+ interpreter version): the
+    URI-cache key AND the worker-pool key."""
+    h = hashlib.sha256()
+    h.update(sys.version.split()[0].encode())
+    for req in sorted(pip):
+        h.update(req.encode())
+        h.update(b"\0")
+    return h.hexdigest()[:16]
+
+
+def env_cache_dir() -> str:
+    return os.environ.get(
+        "RAY_TPU_ENV_CACHE",
+        os.path.join(tempfile.gettempdir(), "ray_tpu", "pip_envs"))
+
+
+def ensure_pip_env(pip: list[str], timeout: float = 600.0) -> str:
+    """Build (or reuse) the env for `pip`; returns its site directory.
+
+    Cache-hit = a `.ready` marker exists for the content hash; a crashed
+    half-build (dir without marker) is rebuilt from scratch.
+    """
+    key = pip_env_key(pip)
+    target = os.path.join(env_cache_dir(), key)
+    marker = os.path.join(target, ".ready")
+    with _build_lock:  # one build per process; cross-process rebuilds are
+        # idempotent (same content hash -> same bits)
+        if os.path.exists(marker):
+            return target
+        if os.path.isdir(target):
+            # Crashed half-build: pip --target does NOT replace existing
+            # package dirs, so building on top would cache a corrupt env
+            # behind a fresh marker. Start clean.
+            import shutil
+            shutil.rmtree(target, ignore_errors=True)
+        os.makedirs(target, exist_ok=True)
+        cmd = [sys.executable, "-m", "pip", "install", "--quiet",
+               "--target", target, *pip]
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"pip env build failed ({' '.join(pip)}):\n{proc.stderr}")
+        with open(marker, "w") as f:
+            f.write(" ".join(sorted(pip)))
+        _build_counts[key] = _build_counts.get(key, 0) + 1
+        return target
+
+
+def build_count(pip: list[str]) -> int:
+    """How many times THIS process built the env (0 = every use was a
+    cache hit)."""
+    return _build_counts.get(pip_env_key(pip), 0)

@@ -803,6 +803,11 @@ def _honor_platform_env(jax_mod):
 
 def _worker_main(store_path: str, worker_id: WorkerID, fd: int):
     set_config(Config.from_env())
+    venv_site = os.environ.get("RAY_TPU_VENV_SITE")
+    if venv_site:
+        # Env-pool worker: the pip env's packages shadow the host env for
+        # every task this worker runs (parity: pip runtime_env activation).
+        sys.path.insert(0, venv_site)
     try:
         import jax as _jax
         _honor_platform_env(_jax)
@@ -822,7 +827,8 @@ def _worker_main(store_path: str, worker_id: WorkerID, fd: int):
     from ray_tpu.core import runtime as runtime_mod
     runtime_mod.set_worker_runtime(rt)
 
-    rt.send(("ready", worker_id.binary(), os.getpid()))
+    rt.send(("ready", worker_id.binary(), os.getpid(),
+             os.environ.get("RAY_TPU_ENV_KEY") or None))
 
     actor_cfg = {}
     executor_threads: list[threading.Thread] = []

@@ -149,3 +149,124 @@ def test_object_loss_without_lineage_budget():
             ray_tpu.get(ref, timeout=30)
     finally:
         c.shutdown()
+
+
+def test_agent_death_mid_transfer_reconstructs():
+    """Kill the source agent WHILE a cross-node pull is in flight: the
+    in-flight fetch fails over to lineage reconstruction instead of
+    surfacing ObjectLostError (mid-transfer death matrix)."""
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    n1 = c.add_node(num_cpus=2)
+    c.add_node(num_cpus=2)
+    c.wait_for_nodes(3)
+    try:
+        prefer = NodeAffinitySchedulingStrategy(node_id=n1.node_id, soft=True)
+
+        @ray_tpu.remote(num_cpus=1)
+        def big():
+            return np.full(2_000_000, 7.0, dtype=np.float32)  # 8 MB
+
+        ref = big.options(scheduling_strategy=prefer).remote()
+        ray_tpu.wait([ref], timeout=60)
+
+        import threading
+        killer = threading.Timer(0.05, lambda: c.remove_node(n1))
+        killer.start()
+        val = ray_tpu.get(ref, timeout=120)  # pull races the kill
+        killer.join()
+        assert float(val[0]) == 7.0
+    finally:
+        c.shutdown()
+
+
+def test_pg_create_racing_node_death():
+    """A 2-bundle STRICT_SPREAD placement group whose creation races a
+    node death must not wedge: it re-places once capacity returns."""
+    from ray_tpu.util.placement_group import (placement_group,
+                                              remove_placement_group)
+
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    n1 = c.add_node(num_cpus=2)
+    c.add_node(num_cpus=2)
+    c.wait_for_nodes(3)
+    try:
+        import threading
+        killer = threading.Timer(0.01, lambda: c.remove_node(n1))
+        killer.start()
+        pg = placement_group([{"CPU": 1}, {"CPU": 1}],
+                             strategy="STRICT_SPREAD")
+        killer.join()
+        if not pg.wait(timeout_seconds=10):
+            # Lost the race to the death: capacity returning must unwedge.
+            c.add_node(num_cpus=2)
+            assert pg.wait(timeout_seconds=60)
+        remove_placement_group(pg)
+    finally:
+        c.shutdown()
+
+
+def test_spill_file_corruption_surfaces_error():
+    """A corrupted spill file must fail the read loudly (not hang and not
+    return garbage)."""
+    import glob
+    import os
+
+    rt = ray_tpu.init(num_cpus=2, object_store_memory=48 << 20,
+                      ignore_reinit_error=False)
+    try:
+        refs = [ray_tpu.put(np.random.rand(1_000_000)) for _ in range(10)]
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not rt._spilled:
+            time.sleep(0.2)
+        assert rt._spilled, "nothing spilled under memory pressure"
+        # Corrupt every spill file: truncate to a few bytes.
+        for path in glob.glob(os.path.join(rt.spill_dir, "*")):
+            with open(path, "wb") as f:
+                f.write(b"garbage")
+        spilled_oid = next(iter(rt._spilled))
+        from ray_tpu.core.object_ref import ObjectRef
+        from ray_tpu.core.ids import ObjectID
+        with pytest.raises(Exception):
+            ray_tpu.get(ObjectRef(ObjectID(spilled_oid), _add_ref=False),
+                        timeout=30)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_chaos_dropped_fetch_frame_retries():
+    """Fault injection on the object-transfer path: the first cross-node
+    fetch frame is dropped (testing_rpc_failure), the fetch watchdog
+    re-drives it, and the get still completes."""
+    c = Cluster(initialize_head=True,
+                head_node_args={"num_cpus": 1,
+                                "_system_config": {
+                                    "testing_rpc_failure": "fetch=1",
+                                    "fetch_retry_timeout_s": 1.0}})
+    n1 = c.add_node(num_cpus=2)
+    n2 = c.add_node(num_cpus=2)
+    c.wait_for_nodes(3)
+    try:
+        on_n1 = NodeAffinitySchedulingStrategy(node_id=n1.node_id, soft=True)
+        on_n2 = NodeAffinitySchedulingStrategy(node_id=n2.node_id, soft=False)
+
+        @ray_tpu.remote(num_cpus=1)
+        def make():
+            return np.full(500_000, 3.0, dtype=np.float32)
+
+        @ray_tpu.remote(num_cpus=1)
+        def consume(x):
+            return float(x[0])
+
+        ref = make.options(scheduling_strategy=on_n1).remote()
+        ray_tpu.wait([ref], timeout=60)
+        # Agent-destined fetch: the head's ("fetch", ...) frame to n2's
+        # agent is the one the chaos config drops.
+        t0 = time.monotonic()
+        out = ray_tpu.get(
+            consume.options(scheduling_strategy=on_n2).remote(ref),
+            timeout=120)
+        assert out == 3.0
+        # The drop cost at least one watchdog period.
+        assert time.monotonic() - t0 >= 0.9
+    finally:
+        c.shutdown()

@@ -488,3 +488,58 @@ def test_streaming_consumed_from_worker(ray_start_isolated):
         return [ray_tpu.get(r, timeout=30) for r in gen.remote(4)]
 
     assert ray_tpu.get(consume.remote(), timeout=60) == [0, 2, 4, 6]
+
+
+def test_runtime_env_pip_per_env_worker_pool(ray_start_isolated, tmp_path):
+    """runtime_env={"pip": [...]} builds a cached env and runs the task in
+    a per-env worker pool (parity: runtime_env/pip.py URI cache +
+    worker_pool.h:228 per-env pools): the task imports a package absent
+    from the host env; a second use hits the cache (no rebuild)."""
+    import os
+    import textwrap
+
+    from ray_tpu.core import runtime_env as renv
+
+    pkg = tmp_path / "rtpu_probe_pkg"
+    pkg.mkdir()
+    (pkg / "setup.py").write_text(textwrap.dedent("""
+        from setuptools import setup
+        setup(name="rtpu_probe_pkg", version="1.0",
+              py_modules=["rtpu_probe_pkg"])
+    """))
+    (pkg / "rtpu_probe_pkg.py").write_text('VALUE = "it-works"\n')
+
+    with pytest.raises(ImportError):
+        import rtpu_probe_pkg  # noqa: F401 — must NOT exist on the host
+
+    pip = ["--no-index", "--no-build-isolation", str(pkg)]
+    # Isolated cache dir so reruns of this test measure builds honestly.
+    os.environ["RAY_TPU_ENV_CACHE"] = str(tmp_path / "envcache")
+    try:
+        @ray_tpu.remote(runtime_env={"pip": pip})
+        def probe():
+            import rtpu_probe_pkg
+            return rtpu_probe_pkg.VALUE, os.environ.get("RAY_TPU_ENV_KEY")
+
+        value, key = ray_tpu.get(probe.remote(), timeout=120)
+        assert value == "it-works"
+        assert key == renv.pip_env_key(pip)
+        assert renv.build_count(pip) == 1
+
+        # Second use: same env key -> cache hit, no rebuild.
+        value2, key2 = ray_tpu.get(probe.remote(), timeout=120)
+        assert (value2, key2) == (value, key)
+        assert renv.build_count(pip) == 1
+
+        # Default-pool tasks are unaffected (no cross-env leakage).
+        @ray_tpu.remote
+        def host_probe():
+            try:
+                import rtpu_probe_pkg  # noqa: F401
+                return "leaked"
+            except ImportError:
+                return "clean"
+
+        assert ray_tpu.get(host_probe.remote(), timeout=60) == "clean"
+    finally:
+        os.environ.pop("RAY_TPU_ENV_CACHE", None)
