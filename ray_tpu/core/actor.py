@@ -181,7 +181,26 @@ class ActorMethod:
         if isinstance(rt, Runtime):
             rt.submit_task(spec)
         else:
-            rt.send(("submit", spec))
+            # Direct path (parity: actor_task_submitter.h:78 direct gRPC):
+            # a worker on an agent node ships the call straight to the
+            # actor's agent, skipping the head relay entirely. The agent
+            # falls back to the head on stale locations / dead peers.
+            loc = None
+            if (not streaming and not refs
+                    and getattr(rt, "on_agent_node", False)
+                    and get_config().direct_actor_calls):
+                # Ref args need the head's dependency gating/pinning: a
+                # direct delivery would block the actor in arg resolution
+                # (head-of-line) and skip the owner's borrow pin.
+                loc = rt.resolve_actor_location(self._handle._actor_id)
+            if loc is not None:
+                # The resolution carries whether the actor permits task
+                # retries: a direct call whose channel dies mid-flight may
+                # have executed, and only retry-permitted calls replay.
+                spec.retries_left = 1 if (len(loc) > 2 and loc[2]) else 0
+                rt.send(("direct_actor", loc[0], loc[1], spec))
+            else:
+                rt.send(("submit", spec))
         if streaming:
             from ray_tpu.core.object_ref import ObjectRefGenerator
             return ObjectRefGenerator(task_id.binary(), rt)

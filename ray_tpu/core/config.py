@@ -52,6 +52,9 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "fetch_retry_timeout_s": (float, 10.0, "re-drive a cross-node object "
                               "fetch with no reply after this long "
                               "(<=0 disables; 3 retries then lost)"),
+    "direct_actor_calls": (bool, True, "worker->actor calls between agent "
+                           "nodes ride direct agent<->agent channels, "
+                           "bypassing the head relay"),
     "health_check_failure_threshold": (int, 5, "missed checks before a node is dead"),
     "gcs_port": (int, 0, "GCS TCP port; 0 = pick free port"),
     # --- head fault tolerance (parity: redis_store_client.h:111 +

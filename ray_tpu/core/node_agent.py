@@ -51,6 +51,49 @@ class _AgentWorker:
         self.buffer = FrameBuffer()
 
 
+class _PeerConn:
+    """One agent<->agent control channel (its own reader thread; frames
+    are ordered per channel, which is what gives per-caller actor-call
+    ordering on the direct path)."""
+
+    def __init__(self, agent: "NodeAgent", sock, nid: bytes | None):
+        self.agent = agent
+        self.sock = sock
+        self.nid = nid
+        self.send_lock = threading.Lock()
+        self.alive = True
+        self.inflight: dict[bytes, tuple] = {}  # task_id -> (wid, spec)
+
+    def send(self, msg):
+        send_msg(self.sock, msg, self.send_lock)
+
+    def start(self):
+        threading.Thread(target=self._read_loop, daemon=True,
+                         name="rtpu-peer").start()
+
+    def _read_loop(self):
+        fb = FrameBuffer()
+        while True:
+            try:
+                data = self.sock.recv(1 << 20)
+            except OSError:
+                data = b""
+            if not data:
+                self.alive = False
+                try:
+                    self.sock.close()
+                except OSError:
+                    pass
+                self.agent._on_peer_eof(self)
+                return
+            fb.feed(data)
+            for msg in fb.frames():
+                try:
+                    self.agent._on_peer_frame(self, msg)
+                except Exception:  # noqa: BLE001 — keep the channel alive
+                    traceback.print_exc()
+
+
 class NodeAgent:
     def __init__(self, head_addr: str, num_cpus=None, num_tpus=0,
                  resources=None, object_store_memory=None,
@@ -86,6 +129,27 @@ class NodeAgent:
         self.peer_server = objxfer.start_peer_server(self.store, node_ip)
         self.peer_addr = (node_ip, self.peer_server.port)
 
+        # Peer CONTROL listener: direct agent<->agent actor-call frames
+        # bypass the head relay (parity: worker-to-worker gRPC,
+        # actor_task_submitter.h:78 — hoisted to one channel per agent
+        # pair; per-caller ordering rides the single TCP stream).
+        self.ctrl_srv = socket.socket()
+        self.ctrl_srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.ctrl_srv.bind((node_ip, 0))
+        self.ctrl_srv.listen(64)
+        self.ctrl_addr = (node_ip, self.ctrl_srv.getsockname()[1])
+        self._peer_conns: dict[bytes, "_PeerConn"] = {}   # nid -> conn
+        self._dial_pending: dict[bytes, list] = {}        # nid -> queued
+        self._peer_lock = threading.Lock()
+        # Executor-side routing of direct calls:
+        # task_id -> (origin conn | None-if-local, origin_wid, spec,
+        #             target_wid) — spec/target retained so a target-worker
+        # death can fail the call back instead of orphaning the caller.
+        self._routed: dict[bytes, tuple] = {}
+        self._agent_req_lock = threading.Lock()
+        self._agent_req_seq = 0
+        self._agent_req_futs: dict[int, "object"] = {}
+
         host, port = head_addr.rsplit(":", 1)
         self.head_host, self.head_port = host, int(port)
         self.head_sock = socket.create_connection((host, int(port)))
@@ -109,6 +173,8 @@ class NodeAgent:
 
         threading.Thread(target=self._prestart, daemon=True).start()
         threading.Thread(target=self._heartbeat_loop, daemon=True).start()
+        threading.Thread(target=self._ctrl_accept_loop, daemon=True,
+                         name="rtpu-peer-accept").start()
 
     # ---------------- workers ----------------
 
@@ -151,9 +217,24 @@ class NodeAgent:
             pass
         if self.workers.pop(w.worker_id.binary(), None) is None:
             return
-        self.worker_actor.pop(w.worker_id.binary(), None)
-        self.worker_env_key.pop(w.worker_id.binary(), None)
-        self._send_head(("worker_death", w.worker_id.binary()))
+        wid = w.worker_id.binary()
+        self.worker_actor.pop(wid, None)
+        self.worker_env_key.pop(wid, None)
+        # Direct calls delivered to the dead worker must fail back to their
+        # origin — the head never saw them, so no one else can.
+        for task_id, route in list(self._routed.items()):
+            conn, origin_wid, spec, target_wid = route
+            if target_wid != wid:
+                continue
+            self._routed.pop(task_id, None)
+            if conn is None:
+                self._direct_fallback(origin_wid, spec, maybe_executed=True)
+            else:
+                try:
+                    conn.send(("peer_fail", origin_wid, spec, True))
+                except OSError:
+                    pass
+        self._send_head(("worker_death", wid))
         if not self._shutdown and len(self.workers) < self.pool_size:
             threading.Thread(target=self._spawn_worker, daemon=True).start()
 
@@ -169,8 +250,22 @@ class NodeAgent:
         send_msg(self.head_sock,
                  ("register_node", self.node_id, self.resources,
                   self.peer_addr, socket.gethostname(), os.getpid(),
-                  inventory),
+                  inventory, self.ctrl_addr),
                  self.head_lock)
+
+    def _head_request(self, what, arg, timeout=10.0):
+        """Synchronous agent->head query (peer ctrl-address discovery)."""
+        import concurrent.futures
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        with self._agent_req_lock:
+            self._agent_req_seq += 1
+            req_id = self._agent_req_seq
+            self._agent_req_futs[req_id] = fut
+        self._send_head(("agent_req", req_id, what, arg))
+        try:
+            return fut.result(timeout)
+        finally:
+            self._agent_req_futs.pop(req_id, None)
 
     def _send_head(self, msg):
         try:
@@ -265,10 +360,229 @@ class NodeAgent:
                 self.store.delete(ObjectID(msg[1]))
             except Exception:  # noqa: BLE001
                 pass
+        elif op == "agent_resp":
+            fut = self._agent_req_futs.get(msg[1])
+            if fut is not None and not fut.done():
+                fut.set_result(msg[2])
         elif op == "node_ack":
             pass
         elif op == "shutdown_node":
             self._die()
+
+    # ---------------- direct agent<->agent actor calls ----------------
+
+    def _ctrl_accept_loop(self):
+        while not self._shutdown:
+            try:
+                sock, _addr = self.ctrl_srv.accept()
+            except OSError:
+                return
+            _PeerConn(self, sock, nid=None).start()
+
+    def _dial_peer(self, nid: bytes):
+        """Dial a peer agent's ctrl port WITHOUT publishing the channel —
+        the dial thread publishes only after draining its pending queue,
+        keeping per-caller ordering across the dial window."""
+        try:
+            addr = self._head_request("node_ctrl_addr", nid)
+            if not addr:
+                return None
+            sock = socket.create_connection(tuple(addr), timeout=5.0)
+        except Exception:  # noqa: BLE001 — fall back to head
+            return None
+        conn = _PeerConn(self, sock, nid=nid)
+        conn.send(("peer_hello", self.node_id))
+        conn.start()
+        return conn
+
+    def _route_direct(self, w: _AgentWorker, msg):
+        """A local worker asked for a direct actor call: deliver to the
+        target worker on this node or over the peer channel; on any miss,
+        fall back to the head path and tell the caller to re-resolve.
+
+        Runs on the agent's main loop thread: it must NEVER block on the
+        head (the reply would be read by this very loop). Cached channels
+        send inline; a missing channel queues the call and dials on a side
+        thread, flushing the queue in order once connected."""
+        _, target_nid, target_wid, spec = msg
+        origin_wid = w.worker_id.binary()
+        if target_nid == self.node_id:
+            tw = self.workers.get(target_wid)
+            if tw is None:
+                self._direct_fallback(origin_wid, spec)
+                return
+            self._routed[spec.task_id] = (None, origin_wid, spec, target_wid)
+            try:
+                send_msg(tw.sock, ("exec", spec), tw.send_lock)
+            except OSError:
+                self._routed.pop(spec.task_id, None)
+                self._direct_fallback(origin_wid, spec)
+            return
+        with self._peer_lock:
+            conn = self._peer_conns.get(target_nid)
+            if conn is None or not conn.alive:
+                pend = self._dial_pending.get(target_nid)
+                if pend is not None:
+                    pend.append((origin_wid, target_wid, spec))
+                    return
+                self._dial_pending[target_nid] = [
+                    (origin_wid, target_wid, spec)]
+                threading.Thread(target=self._dial_and_flush,
+                                 args=(target_nid,), daemon=True).start()
+                return
+        self._peer_send(conn, origin_wid, target_wid, spec)
+
+    def _peer_send(self, conn: "_PeerConn", origin_wid, target_wid, spec):
+        conn.inflight[spec.task_id] = (origin_wid, spec)
+        try:
+            conn.send(("peer_exec", target_wid, spec, self.node_id,
+                       origin_wid))
+        except OSError:
+            conn.inflight.pop(spec.task_id, None)
+            self._direct_fallback(origin_wid, spec)
+
+    def _dial_and_flush(self, target_nid: bytes):
+        """Side thread: resolve + dial the peer, then flush the queued
+        calls in submission order. The channel is published only once the
+        queue is drained — a new call racing the flush keeps appending to
+        _dial_pending (the entry stays present until the final pass), so
+        nothing can jump ahead of older queued calls."""
+        conn = self._dial_peer(target_nid)
+        while True:
+            with self._peer_lock:
+                pend = self._dial_pending.get(target_nid) or []
+                if not pend:
+                    self._dial_pending.pop(target_nid, None)
+                    if conn is not None and conn.alive:
+                        self._peer_conns[target_nid] = conn
+                    break
+                self._dial_pending[target_nid] = []
+            for origin_wid, target_wid, spec in pend:
+                if conn is not None and conn.alive:
+                    self._peer_send(conn, origin_wid, target_wid, spec)
+                else:
+                    self._direct_fallback(origin_wid, spec)
+
+    def _direct_fallback(self, origin_wid: bytes, spec,
+                         maybe_executed: bool = False):
+        """Stale/unreachable target: submit through the head (correct,
+        slower) and poison the caller's location cache.
+
+        maybe_executed=True means the exec may have reached the actor
+        (channel died after delivery): resubmitting would break at-most-once
+        semantics, so the call only retries when the user allowed actor-task
+        retries — otherwise its returns fail with the ambiguity spelled
+        out (matching the head path's actor-death behavior)."""
+        if maybe_executed and (spec.retries_left or 0) <= 0:
+            self._fail_direct_call(origin_wid, spec)
+        else:
+            if maybe_executed:
+                spec.retries_left -= 1
+            self._send_head(("wmsg", origin_wid, ("submit", spec)))
+        w = self.workers.get(origin_wid)
+        if w is not None:
+            try:
+                send_msg(w.sock, ("actor_moved", spec.actor_id),
+                         w.send_lock)
+            except OSError:
+                pass
+
+    def _fail_direct_call(self, origin_wid: bytes, spec):
+        """Resolve the caller's returns with an error (no retry budget)."""
+        from ray_tpu.core import serialization
+        from ray_tpu.core.status import ActorDiedError
+        err = ActorDiedError(
+            msg=f"direct actor call {spec.describe()} lost its channel "
+            "mid-flight; it may or may not have executed (set "
+            "max_task_retries to allow replay)")
+        try:
+            payload, bufs, _ = serialization.serialize_value(err)
+        except Exception:  # noqa: BLE001
+            return
+        w = self.workers.get(origin_wid)
+        if w is None:
+            return
+        for rid in spec.return_ids or []:
+            try:
+                send_msg(w.sock, ("obj", rid, "err", payload, bufs),
+                         w.send_lock)
+            except OSError:
+                return
+
+    def _deliver_direct_done(self, origin_wid: bytes, done_msg):
+        """Resolve the caller's futures locally: inline/err outs become obj
+        pushes into the caller's cache; shm-tier outs resolve through the
+        normal head pull on first get."""
+        w = self.workers.get(origin_wid)
+        if w is None:
+            return
+        for rid, status, payload, bufs in done_msg[3]:
+            if status in ("inline", "err"):
+                try:
+                    send_msg(w.sock, ("obj", rid, status, payload, bufs),
+                             w.send_lock)
+                except OSError:
+                    return
+
+    def _on_peer_frame(self, conn: "_PeerConn", msg):
+        op = msg[0]
+        if op == "peer_hello":
+            conn.nid = msg[1]
+            with self._peer_lock:
+                self._peer_conns.setdefault(msg[1], conn)
+        elif op == "peer_exec":
+            _, wid, spec, origin_nid, origin_wid = msg
+            tw = self.workers.get(wid)
+            if tw is None:
+                conn.send(("peer_fail", origin_wid, spec))
+                return
+            self._routed[spec.task_id] = (conn, origin_wid, spec, wid)
+            try:
+                send_msg(tw.sock, ("exec", spec), tw.send_lock)
+            except OSError:
+                self._routed.pop(spec.task_id, None)
+                conn.send(("peer_fail", origin_wid, spec))
+        elif op == "peer_done":
+            _, origin_wid, done_msg = msg
+            conn.inflight.pop(done_msg[1], None)
+            self._deliver_direct_done(origin_wid, done_msg)
+        elif op == "peer_fail":
+            _, origin_wid, spec = msg[:3]
+            maybe_executed = bool(msg[3]) if len(msg) > 3 else False
+            conn.inflight.pop(spec.task_id, None)
+            self._direct_fallback(origin_wid, spec,
+                                  maybe_executed=maybe_executed)
+
+    def _on_peer_eof(self, conn: "_PeerConn"):
+        with self._peer_lock:
+            if conn.nid is not None and self._peer_conns.get(
+                    conn.nid) is conn:
+                self._peer_conns.pop(conn.nid, None)
+        # Calls in flight on the dead channel MAY have executed (the exec
+        # frame was sent): only retry-permitted calls replay via the head.
+        for task_id, (origin_wid, spec) in list(conn.inflight.items()):
+            conn.inflight.pop(task_id, None)
+            self._direct_fallback(origin_wid, spec, maybe_executed=True)
+
+    def _maybe_route_done(self, w: _AgentWorker, msg) -> None:
+        """Executor-side: a done for a direct-routed task also flows back
+        over its peer channel (the head copy keeps the directory/metrics
+        truthful)."""
+        entries = ([msg[1:]] if msg[0] == "done"
+                   else [e for e in msg[1]])
+        for task_id, _aid, _outs in entries:
+            route = self._routed.pop(task_id, None)
+            if route is None:
+                continue
+            conn, origin_wid = route[0], route[1]
+            done_msg = ("done", task_id, _aid, _outs)
+            if conn is None:
+                self._deliver_direct_done(origin_wid, done_msg)
+            else:
+                try:
+                    conn.send(("peer_done", origin_wid, done_msg))
+                except OSError:
+                    pass
 
     # ---------------- object plane ----------------
 
@@ -316,11 +630,24 @@ class NodeAgent:
                         continue
                     w.buffer.feed(data)
                     for msg in w.buffer.frames():
-                        if msg[0] == "actor_ready":
+                        op0 = msg[0]
+                        if op0 == "actor_ready":
                             # Track which worker hosts which actor — the
                             # re-registration inventory needs it for
                             # head-restart adoption.
                             self.worker_actor[w.worker_id.binary()] = msg[1]
+                        elif op0 == "direct_actor":
+                            # Direct-call fast path: never touches the head.
+                            try:
+                                self._route_direct(w, msg)
+                            except Exception:
+                                traceback.print_exc()
+                            continue
+                        elif op0 in ("done", "done_batch") and self._routed:
+                            try:
+                                self._maybe_route_done(w, msg)
+                            except Exception:
+                                traceback.print_exc()
                         self._send_head(
                             ("wmsg", w.worker_id.binary(), msg))
 
@@ -336,6 +663,10 @@ class NodeAgent:
                     pass
         if self.zygote is not None:
             self.zygote.close()
+        try:
+            self.ctrl_srv.close()
+        except OSError:
+            pass
         try:
             # Peer server first: native threads read the arena mmap raw.
             self.peer_server.stop()

@@ -237,10 +237,16 @@ class NodeState:
     `scheduling/cluster_resource_data.h`)."""
 
     def __init__(self, node_id: bytes, resources: dict, conn: NodeConn | None,
-                 peer_addr=None, hostname: str = "", pid: int = 0):
+                 peer_addr=None, hostname: str = "", pid: int = 0,
+                 ctrl_addr=None):
         self.node_id = node_id
         self.conn = conn  # None for the head node
         self.peer_addr = peer_addr  # (host, port) serving cross-node pulls
+        # (host, port) of the agent's peer CONTROL listener: direct
+        # agent<->agent actor-call frames ride it (parity: the reference's
+        # worker-to-worker CoreWorkerService gRPC, actor_task_submitter.h:78
+        # — here hoisted to one channel per agent pair).
+        self.ctrl_addr = ctrl_addr
         self.hostname = hostname or socket.gethostname()
         self.pid = pid
         self.total = dict(resources)
@@ -1350,6 +1356,18 @@ class Runtime:
         elif what == "actor_methods":
             st = self.actors.get(arg)
             resp = (st.cspec.methods_meta or {}) if st else {}
+        elif what == "actor_location":
+            # Direct-call resolution (parity: the GCS actor-table lookup
+            # that seeds actor_task_submitter.h:78): (node_id, worker_id)
+            # of a live actor on an AGENT node, else None (head-local
+            # actors and unstable states go through the head path).
+            st = self.actors.get(arg)
+            resp = None
+            if (st is not None and st.state == A_ALIVE
+                    and st.worker is not None and st.worker.state != DEAD
+                    and st.worker.node_id != self.head_node_id):
+                resp = (st.worker.node_id, st.worker.worker_id.binary(),
+                        bool(st.cspec.max_task_retries))
         elif what == "create_pg":
             pg_id, bundles, strategy, name = arg
             resp = self.create_placement_group(pg_id, bundles, strategy, name)
@@ -1496,6 +1514,7 @@ class Runtime:
         elif op == "register_node":
             _, nid, resources, peer_addr, hostname, pid = msg[:6]
             inventory = msg[6] if len(msg) > 6 else []
+            ctrl_addr = msg[7] if len(msg) > 7 else None
             with self.lock:
                 prev = self.nodes.get(nid)
                 if prev is not None and prev.state == "ALIVE":
@@ -1506,13 +1525,15 @@ class Runtime:
                     prev.conn = conn
                     conn.node_id = nid
                     node = prev
+                    if ctrl_addr:
+                        prev.ctrl_addr = ctrl_addr
                     for wh in prev.workers.values():
                         if isinstance(wh, RemoteWorkerHandle):
                             wh.node_conn = conn
                 else:
                     node = NodeState(nid, resources, conn=conn,
                                      peer_addr=peer_addr, hostname=hostname,
-                                     pid=pid)
+                                     pid=pid, ctrl_addr=ctrl_addr)
                     conn.node_id = nid
                     self.nodes[nid] = node
                     if nid not in self._node_order:
@@ -1561,6 +1582,19 @@ class Runtime:
             node = self.nodes.get(conn.node_id)
             if node is not None:
                 node.last_heartbeat = time.monotonic()
+        elif op == "agent_req":
+            # Small synchronous agent->head queries (peer discovery).
+            _, req_id, what, arg = msg
+            resp = None
+            if what == "node_ctrl_addr":
+                n = self.nodes.get(arg)
+                if (n is not None and n.state == "ALIVE"
+                        and n.ctrl_addr):
+                    resp = tuple(n.ctrl_addr)
+            try:
+                conn.send(("agent_resp", req_id, resp))
+            except OSError:
+                pass
         elif op == "worker_death":
             w = self.workers.get(msg[1])
             if w is not None:

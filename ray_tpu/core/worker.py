@@ -148,6 +148,11 @@ class WorkerRuntime:
         self.current_task = None
         self.refcount = _WorkerRefCounter(
             lambda key: self.send(("free_put", key)))
+        # Actor location cache for the direct agent<->agent call path
+        # (parity: the resolved actor address inside
+        # actor_task_submitter.h:78); poisoned by "actor_moved" pushes.
+        self.actor_locations: dict[bytes, tuple] = {}
+        self.on_agent_node = os.environ.get("RAY_TPU_IS_HEAD_NODE") == "0"
         self._req_lock = threading.Lock()
         self._req_seq = 0
         self._req_futures: dict[int, "concurrent.futures.Future"] = {}
@@ -253,6 +258,24 @@ class WorkerRuntime:
     def send(self, msg):
         send_msg(self.sock, msg, self.send_lock)
 
+    _HEAD_HOSTED = ("head", b"")  # negative-cache sentinel
+
+    def resolve_actor_location(self, actor_id: bytes):
+        """(node_id, worker_id) of a live remote actor, or None. Cached —
+        including the negative result (head-hosted/unstable actors must not
+        pay a resolution round-trip on EVERY call); a stale entry of either
+        kind is dropped by the agent's actor_moved push."""
+        loc = self.actor_locations.get(actor_id)
+        if loc is not None:
+            return None if loc == self._HEAD_HOSTED else loc
+        try:
+            loc = self.request("actor_location", actor_id, timeout=10.0)
+        except Exception:  # noqa: BLE001 — resolution is an optimization
+            return None
+        self.actor_locations[actor_id] = (tuple(loc) if loc is not None
+                                          else self._HEAD_HOSTED)
+        return tuple(loc) if loc is not None else None
+
     # -- streaming (ObjectRefGenerator consumed from a worker) --
 
     def next_stream_item(self, task_id: bytes, idx: int,
@@ -307,6 +330,8 @@ class WorkerRuntime:
                 fut = self._req_futures.pop(req_id, None)
             if fut is not None:
                 fut.set_result(result)
+        elif op == "actor_moved":
+            self.actor_locations.pop(msg[1], None)
         else:
             raise RuntimeError(f"worker: unknown push {op}")
 

@@ -131,3 +131,49 @@ def test_strict_spread_pg_multi_node(cluster):
     assert len(set(spots)) == 3
     from ray_tpu.util.placement_group import remove_placement_group
     remove_placement_group(pg)
+
+
+def test_direct_actor_calls_bypass_head():
+    """Worker->actor calls between agent nodes ride the direct
+    agent<->agent channel (parity: actor_task_submitter.h:78): results are
+    correct AND the head never records the calls (proof of bypass)."""
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    n1 = c.add_node(num_cpus=2)
+    n2 = c.add_node(num_cpus=2)
+    c.wait_for_nodes(3)
+    try:
+        on_n1 = NodeAffinitySchedulingStrategy(node_id=n1.node_id, soft=False)
+        on_n2 = NodeAffinitySchedulingStrategy(node_id=n2.node_id, soft=False)
+
+        @ray_tpu.remote(num_cpus=1)
+        class Cnt:
+            def __init__(self):
+                self.n = 0
+
+            def add(self, k):
+                self.n += k
+                return self.n
+
+        a = Cnt.options(scheduling_strategy=on_n2,
+                        name="direct-cnt").remote()
+        ray_tpu.get(a.add.remote(0), timeout=60)  # driver call: head path
+
+        @ray_tpu.remote(num_cpus=1)
+        def caller(h, n):
+            return [ray_tpu.get(h.add.remote(1), timeout=60)
+                    for _ in range(n)]
+
+        out = ray_tpu.get(
+            caller.options(scheduling_strategy=on_n1).remote(a, 20),
+            timeout=120)
+        assert out == list(range(1, 21))
+
+        # Bypass evidence: the head's task-event buffer saw the driver's
+        # warmup call but NONE of the 20 direct worker->actor calls.
+        from ray_tpu.core.runtime import get_runtime
+        rt = get_runtime()
+        add_ids = {tid for _ts, tid, name, _st in rt.task_events.snapshot()
+                   if name.endswith(".add")}
+        assert len(add_ids) == 1, f"head saw {len(add_ids)} .add calls"
+    finally:
+        c.shutdown()

@@ -270,3 +270,39 @@ def test_chaos_dropped_fetch_frame_retries():
         assert time.monotonic() - t0 >= 0.9
     finally:
         c.shutdown()
+
+
+def test_direct_actor_call_survives_peer_death():
+    """Kill the actor's node while direct worker->actor calls are in
+    flight: the peer-channel EOF falls every in-flight call back to the
+    head, which replays them once the actor restarts elsewhere."""
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    n1 = c.add_node(num_cpus=2)
+    n2 = c.add_node(num_cpus=2)
+    c.wait_for_nodes(3)
+    try:
+        on_n1 = NodeAffinitySchedulingStrategy(node_id=n1.node_id, soft=False)
+        on_n2 = NodeAffinitySchedulingStrategy(node_id=n2.node_id, soft=True)
+
+        @ray_tpu.remote(num_cpus=1, max_restarts=2, max_task_retries=2)
+        class Slow:
+            def work(self, i):
+                time.sleep(0.1)
+                return i * 10
+
+        a = Slow.options(scheduling_strategy=on_n2,
+                         name="peer-death-actor").remote()
+        ray_tpu.get(a.work.remote(0), timeout=60)
+
+        @ray_tpu.remote(num_cpus=1)
+        def caller(h, n):
+            return [ray_tpu.get(h.work.remote(i), timeout=180)
+                    for i in range(n)]
+
+        ref = caller.options(scheduling_strategy=on_n1).remote(a, 25)
+        time.sleep(0.8)  # a few direct calls in flight
+        c.remove_node(n2)
+        out = ray_tpu.get(ref, timeout=300)
+        assert out == [i * 10 for i in range(25)]
+    finally:
+        c.shutdown()
