@@ -11,6 +11,17 @@ Parity: reference `util/collective/collective.py` API surface;
 `gloo_collective_group.py:184` role (CPU/host backend). The rendezvous-
 via-KV design mirrors how the reference exchanges NCCL unique ids through
 the GCS KV.
+
+SCOPE BOUNDARY (read before putting tensors through this): these are
+CONTROL-PLANE collectives — rendezvous, barriers, small-state exchange
+(gradients-of-metadata, rank tables, broadcast of a few MB). Small
+payloads round-trip the head's KV (O(world) head hops per op) and large
+payloads ride the shm object plane through head-coordinated pulls; either
+way the head is on the path, so throughput does NOT scale with world
+size. Dense-math collectives (allreduce of model tensors, all-to-all of
+activations) belong INSIDE jit as jax.lax collectives over ICI — that is
+the framework's data plane, and it never touches this module (SURVEY
+§5.8: the collective plane is XLA's, not a library's).
 """
 
 from __future__ import annotations
