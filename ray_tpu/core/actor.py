@@ -185,10 +185,27 @@ class ActorMethod:
             # a worker on an agent node ships the call straight to the
             # actor's agent, skipping the head relay entirely. The agent
             # falls back to the head on stale locations / dead peers.
+            direct_capable = (getattr(rt, "on_agent_node", False)
+                              and get_config().direct_actor_calls)
+            if direct_capable and all(
+                    r.id.binary() in rt.object_cache
+                    or rt.store.contains(r.id) for r in refs):
+                # This caller may interleave direct and head-path calls to
+                # the same actor (ref-arg/streaming calls must ride the
+                # head). The two transports race, so calls carry a
+                # per-(caller, actor) sequence number and the executing
+                # node's agent restores submission order before delivery
+                # (parity: actor_task_submitter.h:78 sequence numbers).
+                # Like the reference, the slot is claimed only once the
+                # call's deps are locally resolved (dependency_resolver.h:
+                # seq numbers are assigned post-resolution) — a call gated
+                # at the head on a still-pending ref orders at the time
+                # its deps resolve instead of stalling later calls.
+                spec.owner = rt.worker_id.binary()
+                spec.caller_seq = rt.next_actor_call_seq(
+                    self._handle._actor_id)
             loc = None
-            if (not streaming and not refs
-                    and getattr(rt, "on_agent_node", False)
-                    and get_config().direct_actor_calls):
+            if not streaming and not refs and direct_capable:
                 # Ref args need the head's dependency gating/pinning: a
                 # direct delivery would block the actor in arg resolution
                 # (head-of-line) and skip the owner's borrow pin.

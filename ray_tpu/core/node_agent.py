@@ -17,6 +17,7 @@ multi-node cluster, mirroring the reference's `cluster_utils.Cluster:135`.
 from __future__ import annotations
 
 import argparse
+import collections
 import json
 import os
 import selectors
@@ -146,6 +147,14 @@ class NodeAgent:
         #             target_wid) — spec/target retained so a target-worker
         # death can fail the call back instead of orphaning the caller.
         self._routed: dict[bytes, tuple] = {}
+        # Per-(caller worker, actor) in-order delivery gate: direct-path
+        # frames (peer channel) and head-relayed frames race, so execs
+        # carrying spec.caller_seq are buffered here until their turn
+        # (parity: actor_task_submitter.h:78 sequence enforcement).
+        # key -> [next_seq, buf, out deque, draining, last_used, seen_any]
+        self._order: dict[tuple, list] = {}
+        self._order_lock = threading.Lock()
+        self._order_buffered = 0  # frames parked waiting for a gap
         self._agent_req_lock = threading.Lock()
         self._agent_req_seq = 0
         self._agent_req_futs: dict[int, "object"] = {}
@@ -220,6 +229,7 @@ class NodeAgent:
         wid = w.worker_id.binary()
         self.worker_actor.pop(wid, None)
         self.worker_env_key.pop(wid, None)
+        self._drop_ordered_for_worker(wid)
         # Direct calls delivered to the dead worker must fail back to their
         # origin — the head never saw them, so no one else can.
         for task_id, route in list(self._routed.items()):
@@ -327,6 +337,8 @@ class NodeAgent:
         while not self._shutdown:
             time.sleep(period)
             self._send_head(("heartbeat", self.node_id))
+            if self._order:
+                self._sweep_order_keys()
 
     def _handle_head_msg(self, msg):
         op = msg[0]
@@ -334,6 +346,20 @@ class NodeAgent:
             _, wid, inner = msg
             w = self.workers.get(wid)
             if w is not None:
+                if (inner[0] == "exec"
+                        and getattr(inner[1], "caller_seq", None) is not None):
+                    # Head-relayed actor call from a caller that also uses
+                    # the direct path: hold for per-caller order. A drop
+                    # (worker death while buffered) needs no handler — the
+                    # head replays its inflight specs on worker_death.
+                    def deliver(w=w, inner=inner):
+                        try:
+                            send_msg(w.sock, inner, w.send_lock)
+                        except OSError:
+                            pass
+
+                    self._exec_in_order(inner[1], wid, deliver)
+                    return
                 try:
                     send_msg(w.sock, inner, w.send_lock)
                 except OSError:
@@ -411,12 +437,19 @@ class NodeAgent:
             if tw is None:
                 self._direct_fallback(origin_wid, spec)
                 return
-            self._routed[spec.task_id] = (None, origin_wid, spec, target_wid)
-            try:
-                send_msg(tw.sock, ("exec", spec), tw.send_lock)
-            except OSError:
-                self._routed.pop(spec.task_id, None)
-                self._direct_fallback(origin_wid, spec)
+
+            def deliver():
+                self._routed[spec.task_id] = (
+                    None, origin_wid, spec, target_wid)
+                try:
+                    send_msg(tw.sock, ("exec", spec), tw.send_lock)
+                except OSError:
+                    self._routed.pop(spec.task_id, None)
+                    self._direct_fallback(origin_wid, spec)
+
+            self._exec_in_order(
+                spec, target_wid, deliver,
+                on_drop=lambda: self._direct_fallback(origin_wid, spec))
             return
         with self._peer_lock:
             conn = self._peer_conns.get(target_nid)
@@ -431,6 +464,139 @@ class NodeAgent:
                                  args=(target_nid,), daemon=True).start()
                 return
         self._peer_send(conn, origin_wid, target_wid, spec)
+
+    # ------------- per-caller actor-call ordering (executor side) -------------
+
+    _ORDER_GAP_TIMEOUT = 5.0   # s to wait for a missing mid-stream seq
+    # A brand-new key can't tell "actor migrated here mid-stream" (lowest
+    # in-flight seq is the caller's live counter, adopt it) from "the
+    # caller's first-ever calls raced and the head relay is behind" (seq 0
+    # is coming, wait for it). 2s covers any realistic head-relay lag so
+    # first-call inversion needs a pathologically stalled head, while a
+    # post-migration resync costs at most one 2s hiccup.
+    _ORDER_FRESH_TIMEOUT = 2.0
+    _ORDER_KEY_TTL = 600.0      # s of inactivity before a key is swept
+
+    def _exec_in_order(self, spec, target_wid: bytes, deliver, on_drop=None):
+        """Deliver an actor exec in per-(caller, actor) submission order.
+
+        `deliver()` performs the actual send + route bookkeeping; `on_drop()`
+        fails the call back to its origin if the target worker dies while the
+        frame is buffered (None = the head replays it itself). A sequence gap
+        that never fills — a call failed before reaching this node — resyncs
+        after a timeout so one lost call can't wedge the actor; a brand-new
+        key (actor just placed/restarted here) adopts the lowest arriving
+        seq after a much shorter window, since the caller's counter survives
+        actor migrations.
+
+        Release order is protected by a per-key drain: the thread that frees
+        entries appends them to the key's out-queue and only one thread
+        drains it at a time, so a concurrent arrival can never overtake a
+        released-but-not-yet-sent earlier frame.
+        """
+        seq = getattr(spec, "caller_seq", None)
+        if seq is None or spec.owner is None or spec.actor_id is None:
+            deliver()
+            return
+        key = (spec.owner, spec.actor_id)
+        now = time.monotonic()
+        with self._order_lock:
+            st = self._order.get(key)
+            if st is None:
+                # [next_seq, buf {seq: (deliver, on_drop, wid, deadline)},
+                #  out deque, draining flag, last_used, delivered_any]
+                st = self._order[key] = [0, {}, collections.deque(),
+                                        False, now, False]
+            st[4] = now
+            if seq > st[0]:
+                timeout = (self._ORDER_GAP_TIMEOUT if st[5]
+                           else self._ORDER_FRESH_TIMEOUT)
+                if seq not in st[1]:  # dup = head-path retry of a buffered
+                    self._order_buffered += 1  # frame; keep one count
+                st[1][seq] = (deliver, on_drop, target_wid, now + timeout)
+                return
+            st[2].append(deliver)
+            st[5] = True
+            if seq == st[0]:
+                st[0] += 1
+                while st[0] in st[1]:
+                    d, _f, _w, _dl = st[1].pop(st[0])
+                    self._order_buffered -= 1
+                    st[2].append(d)
+                    st[0] += 1
+            # seq < st[0]: a replay of an already-consumed slot (head-path
+            # retry after a fallback) — deliver in queue order.
+        self._drain_order_key(st)
+
+    def _drain_order_key(self, st):
+        """Single-drainer: deliver the key's released frames in order."""
+        with self._order_lock:
+            if st[3] or not st[2]:
+                return
+            st[3] = True
+        while True:
+            with self._order_lock:
+                if not st[2]:
+                    st[3] = False
+                    return
+                d = st[2].popleft()
+            try:
+                d()
+            except Exception:  # noqa: BLE001
+                traceback.print_exc()
+
+    def _flush_expired_order_gaps(self):
+        """A buffered seq waited past its deadline: the missing call died
+        en route (e.g. failed at the head) or predates this key (actor
+        migrated here mid-stream). Resync to the lowest buffered seq."""
+        now = time.monotonic()
+        drain = []
+        with self._order_lock:
+            for st in self._order.values():
+                buf = st[1]
+                if not buf or min(e[3] for e in buf.values()) > now:
+                    continue
+                st[0] = min(buf)
+                while st[0] in buf:
+                    d, _f, _w, _dl = buf.pop(st[0])
+                    self._order_buffered -= 1
+                    st[2].append(d)
+                    st[0] += 1
+                st[5] = True
+                drain.append(st)
+        for st in drain:
+            self._drain_order_key(st)
+
+    def _drop_ordered_for_worker(self, wid: bytes):
+        """Target worker died: flush its buffered execs to their drop
+        handlers (direct calls fall back through the head; head-path calls
+        are simply dropped — the head replays them on worker_death). Keys
+        survive the death: a restart on this node continues the caller's
+        counter seamlessly; elsewhere, the new node's fresh key adopts the
+        live counter after _ORDER_FRESH_TIMEOUT."""
+        dropped = []
+        with self._order_lock:
+            for key, st in list(self._order.items()):
+                for seq, entry in list(st[1].items()):
+                    if entry[2] == wid:
+                        del st[1][seq]
+                        self._order_buffered -= 1
+                        dropped.append(entry[1])
+        for on_drop in dropped:
+            if on_drop is not None:
+                try:
+                    on_drop()
+                except Exception:  # noqa: BLE001
+                    traceback.print_exc()
+
+    def _sweep_order_keys(self):
+        """Heartbeat-paced TTL sweep of idle ordering keys (callers and
+        actors come and go; the gate must not grow without bound)."""
+        cutoff = time.monotonic() - self._ORDER_KEY_TTL
+        with self._order_lock:
+            for key, st in list(self._order.items()):
+                if st[4] < cutoff and not st[1] and not st[2]:
+                    del self._order[key]
 
     def _peer_send(self, conn: "_PeerConn", origin_wid, target_wid, spec):
         conn.inflight[spec.task_id] = (origin_wid, spec)
@@ -536,12 +702,25 @@ class NodeAgent:
             if tw is None:
                 conn.send(("peer_fail", origin_wid, spec))
                 return
-            self._routed[spec.task_id] = (conn, origin_wid, spec, wid)
-            try:
-                send_msg(tw.sock, ("exec", spec), tw.send_lock)
-            except OSError:
-                self._routed.pop(spec.task_id, None)
-                conn.send(("peer_fail", origin_wid, spec))
+
+            def deliver(tw=tw, wid=wid, spec=spec, origin_wid=origin_wid):
+                self._routed[spec.task_id] = (conn, origin_wid, spec, wid)
+                try:
+                    send_msg(tw.sock, ("exec", spec), tw.send_lock)
+                except OSError:
+                    self._routed.pop(spec.task_id, None)
+                    try:
+                        conn.send(("peer_fail", origin_wid, spec))
+                    except OSError:
+                        pass
+
+            def on_drop(spec=spec, origin_wid=origin_wid):
+                try:
+                    conn.send(("peer_fail", origin_wid, spec))
+                except OSError:
+                    pass
+
+            self._exec_in_order(spec, wid, deliver, on_drop=on_drop)
         elif op == "peer_done":
             _, origin_wid, done_msg = msg
             conn.inflight.pop(done_msg[1], None)
@@ -604,6 +783,8 @@ class NodeAgent:
                     events = self._selector.select(timeout=0.05)
                 except OSError:
                     continue
+            if self._order_buffered:
+                self._flush_expired_order_gaps()
             for key, _mask in events:
                 kind, w = key.data
                 try:

@@ -1156,7 +1156,10 @@ class Runtime:
                         if (st is not None and
                                 _pip_key_of(st.cspec) == w.env_key):
                             del node.pending_actor_assign[i]
-                            self._assign_actor_locked(st, w)
+                            if not self._assign_actor_locked(st, w):
+                                # Worker died on handoff: re-park in place;
+                                # the death path replenishes the pool.
+                                node.pending_actor_assign.insert(i, aid)
                             return
                 w.state = IDLE
                 if node is not None:
@@ -3450,12 +3453,11 @@ class Runtime:
             # runtime_env needs a worker from that env's pool, a default
             # actor must not consume (or contaminate itself on) one.
             w = self._take_idle_locked(node, _pip_key_of(cspec))
-            if w is not None:
-                self._assign_actor_locked(st, w)
-                spawn_new = True
-            else:
+            spawn_new = w is not None and self._assign_actor_locked(st, w)
+            if not spawn_new:
+                # No idle worker (or the popped one was already dead):
+                # park; the next ready worker picks the assignment up.
                 node.pending_actor_assign.append(cspec.actor_id)
-                spawn_new = False
         # Keep the pool at size for plain tasks; new process feeds the pool
         # (or picks up the pending assignment on connect).
         pip = self._pip_env_of(cspec)
@@ -3475,15 +3477,27 @@ class Runtime:
             threading.Thread(target=self._spawn_worker,
                              kwargs={"pip": pip}, daemon=True).start()
 
-    def _assign_actor_locked(self, st: ActorState, w: WorkerHandle):
+    def _assign_actor_locked(self, st: ActorState, w: WorkerHandle) -> bool:
+        """Hand the actor creation to `w`. Returns False if the worker died
+        between pool-pop and the handoff (send hit a closed pipe): the
+        assignment is rolled back so the death notification reaps a plain
+        worker — no restart budget consumed, no BrokenPipeError escaping
+        into the caller's thread — and the caller re-parks the actor."""
         cspec = st.cspec
         w.state = ASSIGNED_ACTOR
         w.actor_id = cspec.actor_id
         st.worker = w
         blob = self.fn_table.get(cspec.cls_id)
-        w.send(("reg_fn", cspec.cls_id, blob))
-        w.registered_fns.add(cspec.cls_id)
-        w.send(("create_actor", cspec))
+        try:
+            w.send(("reg_fn", cspec.cls_id, blob))
+            w.registered_fns.add(cspec.cls_id)
+            w.send(("create_actor", cspec))
+        except OSError:
+            w.state = IDLE
+            w.actor_id = None
+            st.worker = None
+            return False
+        return True
 
     def _export_actor(self, st: "ActorState", state: str):
         if state == "DEAD":

@@ -32,6 +32,11 @@ class TaskSpec:
                           #  "py_modules": [str]} | None
         "trace_ctx",      # W3C traceparent carrier dict | None (tracing)
         "streaming",      # True = generator task (num_returns="streaming")
+        "caller_seq",     # per-(caller, actor) submission index; stamped by
+                          # workers that may mix the direct agent<->agent
+                          # path with the head relay, enforced at the
+                          # executing node's agent (parity: the sequence
+                          # numbers of actor_task_submitter.h:78)
     )
 
     def __init__(self, **kw):

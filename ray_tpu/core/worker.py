@@ -153,6 +153,13 @@ class WorkerRuntime:
         # actor_task_submitter.h:78); poisoned by "actor_moved" pushes.
         self.actor_locations: dict[bytes, tuple] = {}
         self.on_agent_node = os.environ.get("RAY_TPU_IS_HEAD_NODE") == "0"
+        # Per-target-actor submission counter: stamped on every actor call
+        # this worker submits (direct OR head path) so the executing agent
+        # can restore per-caller order across the two transports.
+        self._actor_seq_lock = threading.Lock()
+        import collections as _collections
+        self._actor_call_seq: "_collections.OrderedDict[bytes, int]" = (
+            _collections.OrderedDict())
         self._req_lock = threading.Lock()
         self._req_seq = 0
         self._req_futures: dict[int, "concurrent.futures.Future"] = {}
@@ -257,6 +264,18 @@ class WorkerRuntime:
 
     def send(self, msg):
         send_msg(self.sock, msg, self.send_lock)
+
+    def next_actor_call_seq(self, actor_id: bytes) -> int:
+        with self._actor_seq_lock:
+            n = self._actor_call_seq.get(actor_id, 0)
+            self._actor_call_seq[actor_id] = n + 1
+            self._actor_call_seq.move_to_end(actor_id)
+            if len(self._actor_call_seq) > 4096:
+                # Bounded, LRU: evicting an idle counter restarts that
+                # pair at 0, which the executing agent treats as an
+                # immediate replay slot — order degrades gracefully.
+                self._actor_call_seq.popitem(last=False)
+            return n
 
     _HEAD_HOSTED = ("head", b"")  # negative-cache sentinel
 

@@ -63,13 +63,21 @@ def set_trace(breakpoint_uuid: str | None = None):
     import pdb
 
     lsock = socket.socket()
-    # Bind all interfaces and advertise the node's routable IP: on a
-    # non-head node a 127.0.0.1 address would be unreachable from the
-    # driver (reference rpdb advertises the node IP the same way).
-    lsock.bind(("0.0.0.0", 0))
+    # Security default matches the reference (REMOTE_PDB_HOST /
+    # RAY_DEBUGGER_EXTERNAL): pdb is arbitrary code execution, so bind
+    # loopback unless the operator explicitly opts into external access
+    # (needed when the driver debugs a worker on another node).
+    external = os.environ.get("RAY_TPU_DEBUGGER_EXTERNAL", "0") not in (
+        "0", "", "false", "False")
+    bind_host = "0.0.0.0" if external else \
+        os.environ.get("REMOTE_PDB_HOST", "127.0.0.1")
+    lsock.bind((bind_host, 0))
     lsock.listen(1)
     _, port = lsock.getsockname()
-    host = _node_ip()
+    # Advertise an address that actually reaches the bound interface.
+    host = _node_ip() if external else \
+        ("127.0.0.1" if bind_host in ("127.0.0.1", "localhost")
+         else bind_host)
     addr = f"{host}:{port}"
     tag = breakpoint_uuid or str(os.getpid())
     print(f"rpdb: waiting for debugger on {addr} "

@@ -177,3 +177,50 @@ def test_direct_actor_calls_bypass_head():
         assert len(add_ids) == 1, f"head saw {len(add_ids)} .add calls"
     finally:
         c.shutdown()
+
+
+def test_mixed_path_actor_calls_stay_ordered():
+    """A caller that interleaves direct-path calls (no-ref args) with
+    head-path calls (ref args) to the same actor must still execute in
+    submission order: every call carries a per-(caller, actor) sequence
+    number enforced at the executing node's agent (parity: the sequence
+    numbers of actor_task_submitter.h:78)."""
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    n1 = c.add_node(num_cpus=2)
+    n2 = c.add_node(num_cpus=2)
+    c.wait_for_nodes(3)
+    try:
+        on_n1 = NodeAffinitySchedulingStrategy(node_id=n1.node_id, soft=False)
+        on_n2 = NodeAffinitySchedulingStrategy(node_id=n2.node_id, soft=False)
+
+        @ray_tpu.remote(num_cpus=1)
+        class Recorder:
+            def __init__(self):
+                self.seen = []
+
+            def rec(self, x):
+                self.seen.append(x)
+
+            def dump(self):
+                return self.seen
+
+        a = Recorder.options(scheduling_strategy=on_n2).remote()
+
+        @ray_tpu.remote(num_cpus=1)
+        def caller(h, n):
+            # Every 3rd call ships a ref arg (head relay); the rest ride
+            # the direct agent<->agent channel. Fire-and-forget, then a
+            # final direct call fences before the dump.
+            for i in range(n):
+                if i % 3 == 0:
+                    h.rec.remote(ray_tpu.put(i))
+                else:
+                    h.rec.remote(i)
+            return ray_tpu.get(h.dump.remote(), timeout=60)
+
+        seen = ray_tpu.get(
+            caller.options(scheduling_strategy=on_n1).remote(a, 30),
+            timeout=120)
+        assert seen == list(range(30)), seen
+    finally:
+        c.shutdown()

@@ -171,3 +171,40 @@ def test_kill_actor_queued_on_resources(ray_start_isolated):
     time.sleep(0.5)
     with pytest.raises(ray_tpu.RayTpuError):
         ray_tpu.get(second.ping.remote(), timeout=30)
+
+
+def test_actor_assign_survives_worker_death_on_handoff(ray_start_isolated):
+    """A worker dying between pool-pop and the create_actor handoff must
+    not kill the actor or consume restart budget: the assignment rolls
+    back and re-parks for the next ready worker (reference: a rejected
+    worker lease reroutes the actor creation, gcs_actor_scheduler.cc:112).
+
+    Half-close every idle worker's head-side socket so the very next
+    send() into it raises BrokenPipeError while the pool still believes
+    the worker is alive — the exact window of the race.
+    """
+    import socket as _socket
+
+    from ray_tpu.core.runtime import get_runtime
+    rt = get_runtime()
+    deadline = time.monotonic() + 30
+    idle = []
+    while time.monotonic() < deadline and not idle:
+        with rt.lock:
+            idle = [w for n in rt.nodes.values() for w in n.idle
+                    if w.sock is not None]
+        if not idle:
+            time.sleep(0.05)
+    assert idle, "worker pool never came up"
+    for w in idle:
+        w.sock.shutdown(_socket.SHUT_WR)
+
+    @ray_tpu.remote(max_restarts=0)
+    class Fragile:
+        def ping(self):
+            return "ok"
+
+    # max_restarts=0: if the handoff race consumed a restart (or leaked
+    # the BrokenPipeError), this actor would be DEAD and get() would fail.
+    a = Fragile.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=120) == "ok"
