@@ -392,4 +392,23 @@ void store_stats(void* base, uint64_t* out_allocated, uint64_t* out_capacity,
 
 uint64_t store_header_size() { return sizeof(Header); }
 
+// Write the 16-byte ids of all sealed objects into `out` (room for
+// max_ids). Returns the number written. Used to rebuild a restarted
+// head's object directory from each node's surviving arena (parity:
+// raylets resyncing object locations with a restarted GCS).
+int64_t store_list_ids(void* base, uint8_t* out, uint64_t max_ids) {
+  Header* h = (Header*)base;
+  lock(h);
+  Slot* tab = slots(h);
+  uint64_t n = 0;
+  for (uint64_t i = 0; i < h->num_slots && n < max_ids; i++) {
+    if (tab[i].state == SLOT_SEALED) {
+      memcpy(out + n * 16, tab[i].id, 16);
+      n++;
+    }
+  }
+  unlock(h);
+  return (int64_t)n;
+}
+
 }  // extern "C"

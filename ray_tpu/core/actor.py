@@ -187,20 +187,17 @@ class ActorMethod:
             # falls back to the head on stale locations / dead peers.
             direct_capable = (getattr(rt, "on_agent_node", False)
                               and get_config().direct_actor_calls)
-            if direct_capable and all(
-                    r.id.binary() in rt.object_cache
-                    or rt.store.contains(r.id) for r in refs):
+            if direct_capable:
                 # This caller may interleave direct and head-path calls to
                 # the same actor (ref-arg/streaming calls must ride the
-                # head). The two transports race, so calls carry a
+                # head). The two transports race, so every call carries a
                 # per-(caller, actor) sequence number and the executing
                 # node's agent restores submission order before delivery
-                # (parity: actor_task_submitter.h:78 sequence numbers).
-                # Like the reference, the slot is claimed only once the
-                # call's deps are locally resolved (dependency_resolver.h:
-                # seq numbers are assigned post-resolution) — a call gated
-                # at the head on a still-pending ref orders at the time
-                # its deps resolve instead of stalling later calls.
+                # (parity: actor_task_submitter.h:78 sequence numbers). A
+                # call the head parks on still-pending deps has its slot
+                # skip-released so it can't stall later calls: it orders
+                # at dep-resolution time, matching the reference (seq
+                # claimed post-resolution, dependency_resolver.h).
                 spec.owner = rt.worker_id.binary()
                 spec.caller_seq = rt.next_actor_call_seq(
                     self._handle._actor_id)

@@ -257,10 +257,19 @@ class NodeAgent:
         inventory = [(wid, self.worker_actor.get(wid),
                       self.worker_env_key.get(wid))
                      for wid in list(self.workers)]
+        # Object inventory: the arena outlives a head restart, so the new
+        # head rebuilds its object directory from what each node still
+        # holds — this is what lets journal-replayed tasks with object
+        # deps resolve instead of hanging (parity: location resync via
+        # ray_syncer after GCS reload, gcs_init_data.h).
+        try:
+            objects = self.store.list_object_ids()
+        except Exception:  # noqa: BLE001 — inventory is best effort
+            objects = []
         send_msg(self.head_sock,
                  ("register_node", self.node_id, self.resources,
                   self.peer_addr, socket.gethostname(), os.getpid(),
-                  inventory, self.ctrl_addr),
+                  inventory, self.ctrl_addr, objects),
                  self.head_lock)
 
     def _head_request(self, what, arg, timeout=10.0):
@@ -364,6 +373,9 @@ class NodeAgent:
                     send_msg(w.sock, inner, w.send_lock)
                 except OSError:
                     pass
+        elif op == "seq_skip":
+            _, owner, aid, seq = msg
+            self._skip_order_slot(owner, aid, seq)
         elif op == "spawn_worker":
             pip = msg[1] if len(msg) > 1 else None
             if len(self.workers) < self.max_workers:
@@ -501,31 +513,74 @@ class NodeAgent:
         key = (spec.owner, spec.actor_id)
         now = time.monotonic()
         with self._order_lock:
-            st = self._order.get(key)
-            if st is None:
-                # [next_seq, buf {seq: (deliver, on_drop, wid, deadline)},
-                #  out deque, draining flag, last_used, delivered_any]
-                st = self._order[key] = [0, {}, collections.deque(),
-                                        False, now, False]
-            st[4] = now
+            st = self._order_key_locked(key, now)
             if seq > st[0]:
                 timeout = (self._ORDER_GAP_TIMEOUT if st[5]
                            else self._ORDER_FRESH_TIMEOUT)
                 if seq not in st[1]:  # dup = head-path retry of a buffered
                     self._order_buffered += 1  # frame; keep one count
                 st[1][seq] = (deliver, on_drop, target_wid, now + timeout)
-                return
-            st[2].append(deliver)
-            st[5] = True
-            if seq == st[0]:
-                st[0] += 1
-                while st[0] in st[1]:
-                    d, _f, _w, _dl = st[1].pop(st[0])
-                    self._order_buffered -= 1
-                    st[2].append(d)
+                self._advance_order_locked(st)  # skips may gate the way
+            else:
+                st[2].append(deliver)
+                st[5] = True
+                if seq == st[0]:
                     st[0] += 1
-            # seq < st[0]: a replay of an already-consumed slot (head-path
-            # retry after a fallback) — deliver in queue order.
+                    self._advance_order_locked(st)
+                # seq < st[0]: a slot consumed earlier — a head-path retry
+                # after a fallback, or a dep-gated call the head skip-
+                # released (it orders at dep-resolution time) — deliver in
+                # queue order.
+        self._drain_order_key(st)
+
+    def _order_key_locked(self, key, now):
+        st = self._order.get(key)
+        if st is None:
+            # [next_seq, buf {seq: (deliver, on_drop, wid, deadline)},
+            #  out deque, draining flag, last_used, delivered_any,
+            #  skip-released slots]
+            st = self._order[key] = [0, {}, collections.deque(),
+                                    False, now, False, set()]
+        st[4] = now
+        return st
+
+    def _advance_order_locked(self, st):
+        """Release every consecutive buffered or skip-released slot from
+        st[0]; on progress, extend the remaining buffered deadlines — a
+        slow-but-advancing head relay is not a gap."""
+        progressed = False
+        while True:
+            if st[0] in st[1]:
+                d, _f, _w, _dl = st[1].pop(st[0])
+                self._order_buffered -= 1
+                st[2].append(d)
+                st[0] += 1
+                progressed = True
+            elif st[0] in st[6]:
+                st[6].discard(st[0])
+                st[0] += 1
+                progressed = True
+            else:
+                break
+        if progressed:
+            st[5] = True
+            if st[1]:
+                ddl = time.monotonic() + self._ORDER_GAP_TIMEOUT
+                for s, e in list(st[1].items()):
+                    st[1][s] = (e[0], e[1], e[2], ddl)
+
+    def _skip_order_slot(self, owner: bytes, actor_id: bytes, seq: int):
+        """Head notice: slot `seq` parked on pending deps at the head and
+        will arrive later (delivered at dep-resolution time, reference
+        semantics); release its successors now."""
+        with self._order_lock:
+            st = self._order_key_locked((owner, actor_id), time.monotonic())
+            if seq < st[0]:
+                return
+            st[6].add(seq)
+            if len(st[6]) > 4096:  # lost-call hygiene: skips are tiny ints
+                st[6] = {s for s in st[6] if s >= st[0]}
+            self._advance_order_locked(st)
         self._drain_order_key(st)
 
     def _drain_order_key(self, st):
@@ -557,12 +612,8 @@ class NodeAgent:
                 if not buf or min(e[3] for e in buf.values()) > now:
                     continue
                 st[0] = min(buf)
-                while st[0] in buf:
-                    d, _f, _w, _dl = buf.pop(st[0])
-                    self._order_buffered -= 1
-                    st[2].append(d)
-                    st[0] += 1
-                st[5] = True
+                st[6] = {s for s in st[6] if s > st[0]}
+                self._advance_order_locked(st)
                 drain.append(st)
         for st in drain:
             self._drain_order_key(st)

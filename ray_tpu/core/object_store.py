@@ -54,6 +54,8 @@ def _lib():
         lib.store_stats.argtypes = [p] + [ctypes.POINTER(u64)] * 4
         lib.store_header_size.restype = u64
         lib.store_memcpy.argtypes = [p, p, u64, ctypes.c_int]
+        lib.store_list_ids.argtypes = [p, p, u64]
+        lib.store_list_ids.restype = ctypes.c_int64
         lib._sigs_set = True
     return lib
 
@@ -241,6 +243,14 @@ class SharedMemoryStore:
 
     def release(self, object_id: ObjectID):
         self._lib.store_release(self._base, object_id.binary())
+
+    def list_object_ids(self, max_ids: int = 1 << 16) -> list[bytes]:
+        """Ids of every sealed object in the arena (inventory for a
+        restarted head's directory rebuild)."""
+        out = (ctypes.c_uint8 * (16 * max_ids))()
+        n = self._lib.store_list_ids(self._base, out, max_ids)
+        raw = bytes(out[: 16 * n])
+        return [raw[i:i + 16] for i in range(0, 16 * n, 16)]
 
     def contains(self, object_id: ObjectID) -> bool:
         return bool(self._lib.store_contains(self._base, object_id.binary()))

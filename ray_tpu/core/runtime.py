@@ -778,20 +778,30 @@ class Runtime:
                 self.create_placement_group(pg_id, bundles, strategy, name)
             except Exception:  # noqa: BLE001 — infeasible until nodes rejoin
                 pass
-        for tid, spec in tables.get("task", {}).items():
+        dep_tasks: list[tuple] = []
+        task_table = tables.get("task", {})
+        # Return ids the replay will actually (re-)produce: only tasks that
+        # really resubmitted may vouch for a dependent's dep — a producer
+        # whose replay failed must not, or its consumers hang ungated.
+        replayed_outputs: set[bytes] = set()
+        for tid, spec in task_table.items():
             if spec.dependencies:
-                # The object directory died with the old head: a replayed
-                # task would gate on oids nothing can ever resolve. Drop it
-                # (and its journal record) instead of hanging silently —
-                # the owner resubmits from its side on failure.
-                self._pstore.delete("task", tid)
+                # The object directory died with the old head. The deps may
+                # still exist (agents re-register with an arena inventory
+                # that rebuilds the directory) or be reproducible (their
+                # producer is also journaled and will re-run): park the
+                # task until the adopt grace has let nodes resync, then
+                # decide (parity: GCS reload + owner resubmission,
+                # gcs_init_data.h / task_manager.h:216).
+                dep_tasks.append((tid, spec))
                 continue
             try:
                 self.submit_task(spec)
+                replayed_outputs.update(spec.return_ids or [])
             except Exception:  # noqa: BLE001 — drop unreplayable tasks
                 pass
+        grace = self.config.head_restart_adopt_grace_s
         if restored_actors:
-            grace = self.config.head_restart_adopt_grace_s
 
             def respawn_unclaimed():
                 time.sleep(grace)
@@ -806,6 +816,55 @@ class Runtime:
                                          daemon=True).start()
 
             threading.Thread(target=respawn_unclaimed, daemon=True).start()
+        if dep_tasks:
+
+            def resolve_dep_tasks():
+                time.sleep(grace)
+                from ray_tpu.core.status import ObjectLostError
+                # A dep is satisfiable when it already exists (directory
+                # rebuilt from the agents' arena inventories) or a task
+                # that actually resubmitted will re-produce it (lineage
+                # re-execution repopulates the SAME return ids). Parked
+                # tasks may chain, so close over the promise set until
+                # fixpoint; the remainder is unrecoverable.
+                promised = set(replayed_outputs)
+                pending = list(dep_tasks)
+                submit = []
+                changed = True
+                while changed:
+                    changed = False
+                    for item in list(pending):
+                        _tid, spec = item
+                        if all(self.directory.lookup(d) is not None
+                               or d in promised
+                               for d in spec.dependencies):
+                            pending.remove(item)
+                            submit.append(spec)
+                            promised.update(spec.return_ids or [])
+                            changed = True
+                for spec in submit:
+                    try:
+                        self.submit_task(spec)
+                    except Exception as e:  # noqa: BLE001
+                        # Neither produced nor silently dropped: tombstone
+                        # so waiters see the resubmission failure.
+                        self._fail_returns(spec, e)
+                for _tid, spec in pending:
+                    # Unrecoverable: a dep lived only in the dead head's
+                    # arena (or its producer failed to replay). Tombstone
+                    # the returns so adopted workers blocked in get() fail
+                    # fast instead of hanging forever.
+                    lost = next(
+                        d for d in spec.dependencies
+                        if self.directory.lookup(d) is None
+                        and d not in promised)
+                    self._fail_returns(spec, ObjectLostError(
+                        ObjectID(lost),
+                        msg=f"dependency of journaled task "
+                            f"{spec.describe()} was lost with the old "
+                            f"head and cannot be re-executed"))
+
+            threading.Thread(target=resolve_dep_tasks, daemon=True).start()
 
     def _adopt_actor_worker(self, aid: bytes, w: "WorkerHandle") -> bool:
         """An agent re-registered a worker that still hosts `aid`: wire it
@@ -1518,6 +1577,7 @@ class Runtime:
             _, nid, resources, peer_addr, hostname, pid = msg[:6]
             inventory = msg[6] if len(msg) > 6 else []
             ctrl_addr = msg[7] if len(msg) > 7 else None
+            obj_inventory = msg[8] if len(msg) > 8 else []
             with self.lock:
                 prev = self.nodes.get(nid)
                 if prev is not None and prev.state == "ALIVE":
@@ -1576,6 +1636,11 @@ class Runtime:
                         conn.send(("kill_worker", wid))
                     except OSError:
                         pass
+            # Object inventory: merge surviving arena contents into the
+            # directory. On a fresh head this repopulates locations the
+            # journal could not carry, resolving replayed dep-gated tasks.
+            for oid in obj_inventory:
+                self.directory.add_location(oid, nid)
             conn.send(("node_ack", self.head_node_id))
             if self.export_events is not None:
                 self.export_events.emit("NODE", node_id=nid.hex(),
@@ -2287,7 +2352,26 @@ class Runtime:
         for oid in spec.dependencies or []:
             self.refcount.pin(oid)
         item = {"kind": "task", "spec": spec, "pending": 0}
-        self._gate_on_deps(item, spec.dependencies or [])
+        ready = self._gate_on_deps(item, spec.dependencies or [])
+        if (not ready and spec.actor_id is not None
+                and getattr(spec, "caller_seq", None) is not None):
+            # A seq-stamped actor call parked on pending deps: tell the
+            # executing agent to release the slot now so later calls from
+            # this caller don't stall behind it. The call itself delivers
+            # when its deps resolve — exactly the reference's semantics,
+            # where the submission slot is claimed at dependency
+            # resolution time (dependency_resolver.h), not submit time.
+            self._send_seq_skip(spec)
+
+    def _send_seq_skip(self, spec: TaskSpec):
+        st = self.actors.get(spec.actor_id)
+        node = self.nodes.get(st.node_id) if st is not None else None
+        if node is not None and node.conn is not None:
+            try:
+                node.conn.send(("seq_skip", spec.owner, spec.actor_id,
+                                spec.caller_seq))
+            except OSError:
+                pass  # gap timeout at the agent resyncs
 
     # ---------------- streaming tasks (ObjectRefGenerator) ----------------
     #
@@ -2535,7 +2619,9 @@ class Runtime:
         for oid in spec.dependencies or []:
             self.refcount.unpin(oid)
 
-    def _gate_on_deps(self, item, deps):
+    def _gate_on_deps(self, item, deps) -> bool:
+        """Returns True when the item was enqueued immediately (no pending
+        deps); False when it parked waiting for objects."""
         with self.lock:
             for oid in deps:
                 entry = self.directory.lookup(oid)
@@ -2545,6 +2631,7 @@ class Runtime:
             ready = item["pending"] == 0
         if ready:
             self._enqueue_ready(item)
+        return ready
 
     def _enqueue_ready(self, item):
         if item["kind"] == "task":

@@ -55,6 +55,10 @@ class TaskSpec:
         obj = TaskSpec.__new__(TaskSpec)
         for s, v in zip(TaskSpec.__slots__, t):
             object.__setattr__(obj, s, v)
+        # Slots appended after `t` was pickled (old journal/peer): leave
+        # them None rather than unset — __reduce__ reads every slot.
+        for s in TaskSpec.__slots__[len(t):]:
+            object.__setattr__(obj, s, None)
         return obj
 
     def describe(self) -> str:

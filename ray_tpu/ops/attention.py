@@ -28,7 +28,8 @@ NEG_INF = -1e30
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale: float, causal: bool,
-                bq: int, bk: int, nk: int, with_lse: bool):
+                bq: int, bk: int, nk: int, with_lse: bool,
+                kv_len: int | None):
     if with_lse:
         lse_ref, m_scr, l_scr, acc_scr = rest
     else:
@@ -56,6 +57,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale: float, causal: bool,
             rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + qi * bq
             cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ki * bk
             s = jnp.where(rows >= cols, s, NEG_INF)
+        if kv_len is not None:
+            # Sequence padded to the block multiple: hide the padded keys
+            # (padded QUERY rows produce garbage and are sliced off by the
+            # caller; under causal masking the padded keys sit above every
+            # real row's diagonal already, but non-causal needs this).
+            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ki * bk
+            s = jnp.where(cols < kv_len, s, NEG_INF)
         m_prev = m_scr[:, :1]                                  # [bq, 1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)             # [bq, 1]
         m_new = jnp.maximum(m_prev, m_cur)
@@ -79,7 +87,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale: float, causal: bool,
                                           lse_ref.shape[1:])
 
 
-def _flash_fwd(q, k, v, scale, causal, bq, bk, interpret, with_lse=True):
+def _flash_fwd(q, k, v, scale, causal, bq, bk, interpret, with_lse=True,
+               kv_len=None):
     """q,k,v: [BH, S, D] -> (out [BH, S, D], lse [BH, S, 128] f32) when
     with_lse, else out alone (primal-only path: a pallas_call output cannot
     be DCE'd, so the inference path must not emit the lse at all)."""
@@ -89,7 +98,8 @@ def _flash_fwd(q, k, v, scale, causal, bq, bk, interpret, with_lse=True):
     nq = pl.cdiv(s, bq)
     nk = pl.cdiv(s, bk)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               bq=bq, bk=bk, nk=nk, with_lse=with_lse)
+                               bq=bq, bk=bk, nk=nk, with_lse=with_lse,
+                               kv_len=kv_len)
     out_shape = jax.ShapeDtypeStruct((bh, s, d), q.dtype)
     out_spec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))
     if with_lse:
@@ -124,7 +134,7 @@ def _flash_fwd(q, k, v, scale, causal, bq, bk, interpret, with_lse=True):
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    dq_scr, *, scale: float, causal: bool, bq: int, bk: int,
-                   nk: int):
+                   nk: int, kv_len: int | None):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -143,6 +153,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + qi * bq
             cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ki * bk
             s = jnp.where(rows >= cols, s, NEG_INF)
+        if kv_len is not None:
+            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ki * bk
+            s = jnp.where(cols < kv_len, s, NEG_INF)
         p = jnp.exp(s - lse_ref[0][:, :1])                     # [bq, bk]
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
@@ -159,7 +172,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
-                    causal: bool, bq: int, bk: int, nq: int):
+                    causal: bool, bq: int, bk: int, nq: int,
+                    kv_len: int | None):
     ki = pl.program_id(1)
     qj = pl.program_id(2)
 
@@ -182,6 +196,9 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
             krows = jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 0) + ki * bk
             qcols = jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 1) + qj * bq
             st = jnp.where(qcols >= krows, st, NEG_INF)
+        if kv_len is not None:
+            krows = jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 0) + ki * bk
+            st = jnp.where(krows < kv_len, st, NEG_INF)
         pt = jnp.exp(st - lse_ref[0][:1])                      # [bk, bq]
         dpt = jax.lax.dot_general(
             v_ref[0], do_ref[0], (((1,), (1,)), ((), ())),
@@ -200,7 +217,8 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, o, lse, g, scale, causal, bq, bk, interpret):
+def _flash_bwd(q, k, v, o, lse, g, scale, causal, bq, bk, interpret,
+               kv_len=None):
     """Backward via flash-style recompute. lse: flat [BH, S] from forward."""
     bh, s, d = q.shape
     bq = min(bq, s)
@@ -220,7 +238,7 @@ def _flash_bwd(q, k, v, o, lse, g, scale, causal, bq, bk, interpret):
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nk=nk),
+                          bq=bq, bk=bk, nk=nk, kv_len=kv_len),
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
         grid=(bh, nq, nk),
         in_specs=[
@@ -244,7 +262,7 @@ def _flash_bwd(q, k, v, o, lse, g, scale, causal, bq, bk, interpret):
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nq=nq),
+                          bq=bq, bk=bk, nq=nq, kv_len=kv_len),
         out_shape=(jax.ShapeDtypeStruct((bh, s, d), k.dtype),
                    jax.ShapeDtypeStruct((bh, s, d), v.dtype)),
         grid=(bh, nk, nq),
@@ -294,33 +312,34 @@ _BQ = 512
 _BK = 512
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q, k, v, scale, causal, impl):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, impl, kv_len=None, blk=_BQ):
     if impl == "reference":
         return _reference(q, k, v, scale, causal)
-    return _flash_fwd(q, k, v, scale, causal, bq=_BQ, bk=_BK,
-                      interpret=(impl == "interpret"), with_lse=False)
+    return _flash_fwd(q, k, v, scale, causal, bq=blk, bk=blk,
+                      interpret=(impl == "interpret"), with_lse=False,
+                      kv_len=kv_len)
 
 
-def _flash_vjp_fwd(q, k, v, scale, causal, impl):
+def _flash_vjp_fwd(q, k, v, scale, causal, impl, kv_len=None, blk=_BQ):
     if impl == "reference":
         return _reference(q, k, v, scale, causal), (q, k, v, None, None)
-    out, lse = _flash_fwd(q, k, v, scale, causal, bq=_BQ, bk=_BK,
-                          interpret=(impl == "interpret"))
+    out, lse = _flash_fwd(q, k, v, scale, causal, bq=blk, bk=blk,
+                          interpret=(impl == "interpret"), kv_len=kv_len)
     # Save the flat [BH, S] logsumexp — the lane-replicated form would
     # multiply the per-layer residual footprint by 128.
     return out, (q, k, v, out, lse[:, :, 0])
 
 
-def _flash_vjp_bwd(scale, causal, impl, res, g):
+def _flash_vjp_bwd(scale, causal, impl, kv_len, blk, res, g):
     q, k, v, o, lse = res
     if impl == "reference":
         # jnp recompute backward — the numerics oracle.
         _, vjp = jax.vjp(lambda q, k, v: _reference(q, k, v, scale, causal),
                          q, k, v)
         return vjp(g)
-    return _flash_bwd(q, k, v, o, lse, g, scale, causal, bq=_BQ, bk=_BK,
-                      interpret=(impl == "interpret"))
+    return _flash_bwd(q, k, v, o, lse, g, scale, causal, bq=blk, bk=blk,
+                      interpret=(impl == "interpret"), kv_len=kv_len)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -342,13 +361,31 @@ def flash_attention(q, k, v, causal: bool = True, scale: float | None = None,
     scale = scale if scale is not None else d ** -0.5
     if impl == "auto":
         impl = "pallas" if _on_tpu() else "reference"
-    if impl in ("pallas", "interpret") and (s % min(_BQ, s) or s % min(_BK, s)):
+    kv_len = None
+    s_pad = s
+    blk = _BQ
+    if impl in ("pallas", "interpret"):
         # The kernels assume the sequence tiles exactly into the block size
-        # (partial pallas blocks are padded with undefined values, which the
-        # dkv accumulation would fold into valid rows).
-        impl = "reference"
-    qt = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    kt = k.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    vt = v.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    out = _flash(qt, kt, vt, scale, causal, impl)
-    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+        # (partial pallas blocks carry undefined values that the dkv
+        # accumulation would fold into valid rows). Pad to the next
+        # 128-lane multiple and mask the padded keys statically via kv_len
+        # instead of falling back to the O(S^2)-memory dense reference —
+        # at the lengths the kernel exists for, the fallback OOMs. The
+        # tile shrinks to whatever still divides the padded length (at
+        # most one 128-row tile of overhead, not a 512-multiple round-up).
+        import math as _math
+        blk = min(_BQ, s)
+        if s % blk or blk % 8:  # untileable or sublane-misaligned
+            s_pad = max(128, -(-s // 128) * 128)
+            blk = _math.gcd(s_pad, _BQ)
+            pad = [(0, 0), (0, s_pad - s), (0, 0), (0, 0)]
+            q = jnp.pad(q, pad)
+            k = jnp.pad(k, pad)
+            v = jnp.pad(v, pad)
+            kv_len = s
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, s_pad, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, s_pad, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, s_pad, d)
+    out = _flash(qt, kt, vt, scale, causal, impl, kv_len, blk)
+    out = out.reshape(b, h, s_pad, d).transpose(0, 2, 1, 3)
+    return out[:, :s] if s_pad != s else out

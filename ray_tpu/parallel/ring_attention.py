@@ -3,25 +3,48 @@
 Greenfield per SURVEY.md §5.7 — the reference has no sequence/context
 parallelism (grep-verified, SURVEY.md:149). Design follows blockwise ring
 attention (Liu et al.): the sequence is sharded over the "sp" mesh axis; each
-step every device computes flash-style online-softmax attention of its local Q
-block against the KV block currently resident, then rotates KV to the next
-ring neighbor with `jax.lax.ppermute` (lowered to ICI collective-permute, so
-the transfer overlaps the next block's compute under XLA's scheduler).
+step every device computes flash attention of its local Q block against the
+KV block currently resident, then rotates KV to the next ring neighbor with
+`jax.lax.ppermute` (lowered to ICI collective-permute, so the transfer
+overlaps the next block's compute under XLA's scheduler).
 
-Communication cost: (sp-1) ppermutes of the local KV block — bandwidth-optimal
-for full attention; numerics identical to unsharded attention (same
-log-sum-exp accumulation as flash attention, fp32 accumulators).
+The per-step inner attention runs the Pallas flash kernels from
+`ray_tpu.ops.attention` (fwd + bwd), so per-device live memory is
+O(kernel block), never O(chunk^2). Per-step partial results merge through
+normalized-output/logsumexp accumulation (identical math to flash
+attention's online softmax, fp32 accumulators).
+
+Backward is a ring-level custom VJP, not AD through the forward loop: the
+forward saves only (q, k, v, out, lse) — O(local block) residuals — and the
+backward re-rotates KV while dK/dV accumulators travel WITH their blocks,
+arriving home after the full ring pass. dQ accumulates locally.
+
+Communication cost: (sp-1) ppermutes of the local KV block forward,
+(sp-1) ppermutes of (KV, dKV) backward — bandwidth-optimal for full
+attention.
+
+A pure-jnp implementation (`impl="jnp"`) remains the CPU/numerics oracle;
+`impl="interpret"` runs the Pallas kernels in interpreter mode so CPU tests
+exercise the exact TPU code path.
 """
 
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ray_tpu.ops.attention import _flash_bwd, _flash_fwd, _on_tpu
+
 NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# jnp path (oracle; also the fallback for block-unfriendly local lengths)
+# ---------------------------------------------------------------------------
 
 
 def _block_attn(q, k, v, scale, mask):
@@ -55,14 +78,9 @@ def _merge(acc, o, m, l):
     return new_o, new_m, new_l
 
 
-def ring_attention_inner(q, k, v, axis_name: str, axis_size: int,
-                         causal: bool = True, scale: float | None = None):
-    """Call inside shard_map with seq sharded over `axis_name`.
-
-    q, k, v: [batch, seq_local, heads, head_dim] (kv heads must equal q heads
-    here; GQA repeat happens before the call). `axis_size` must be the static
-    ring size — the ppermute permutation table is built at trace time.
-    """
+def _ring_jnp_inner(q, k, v, axis_name: str, axis_size: int,
+                    causal: bool = True, scale: float | None = None):
+    """Pure-jnp ring pass (reverse-differentiable through the scan)."""
     n = axis_size
     idx = jax.lax.axis_index(axis_name)
     lq = q.shape[1]
@@ -103,13 +121,198 @@ def ring_attention_inner(q, k, v, axis_name: str, axis_size: int,
     return out.astype(q.dtype)
 
 
+# ---------------------------------------------------------------------------
+# Pallas path: flash kernels per ring step, ring-level custom VJP
+# ---------------------------------------------------------------------------
+
+
+def _ring_block(l_local: int) -> int:
+    """Kernel tile that divides the local chunk (<= the default 512)."""
+    return math.gcd(l_local, 512)
+
+
+def _step_fwd(q, k, v, scale, causal, blk, interpret):
+    """One ring step through the Pallas forward kernel.
+
+    q/k/v: [BH, L, D] -> (o normalized [BH, L, D] f32, lse [BH, L] f32).
+    """
+    out, lse = _flash_fwd(q, k, v, scale, causal, bq=blk, bk=blk,
+                          interpret=interpret, with_lse=True)
+    return out.astype(jnp.float32), lse[:, :, 0]
+
+
+def _merge_normalized(o_acc, lse_acc, o_t, lse_t):
+    """Merge two (normalized out, logsumexp) partials — flash math."""
+    m = jnp.maximum(lse_acc, lse_t)
+    a = jnp.exp(lse_acc - m)
+    b = jnp.exp(lse_t - m)
+    denom = jnp.maximum(a + b, 1e-30)
+    o = (o_acc * a[..., None] + o_t * b[..., None]) / denom[..., None]
+    return o, m + jnp.log(denom)
+
+
+def _ring_fwd_loop(q, k, v, axis_name, n, causal, scale, blk, interpret):
+    """q/k/v in kernel layout [BH, L, D]. Returns (out [BH,L,D], lse [BH,L])."""
+    idx = jax.lax.axis_index(axis_name)
+    qk = q  # kernels take the query's dtype straight to the MXU
+    o_acc = jnp.zeros(q.shape, jnp.float32)
+    lse_acc = jnp.full(q.shape[:2], NEG_INF, jnp.float32)
+
+    def skip_fn(_q, _k, _v):
+        return (jnp.zeros(_q.shape, jnp.float32),
+                jnp.full(_q.shape[:2], NEG_INF, jnp.float32))
+
+    full_fn = functools.partial(_step_fwd, scale=scale, causal=False,
+                                blk=blk, interpret=interpret)
+    diag_fn = functools.partial(_step_fwd, scale=scale, causal=True,
+                                blk=blk, interpret=interpret)
+
+    def step(t, carry):
+        o_acc, lse_acc, cur_k, cur_v = carry
+        src = (idx - t) % n
+        if causal:
+            mode = jnp.where(src < idx, 1, jnp.where(src == idx, 2, 0))
+        else:
+            mode = jnp.ones((), jnp.int32)
+        o_t, lse_t = jax.lax.switch(mode, [skip_fn, full_fn, diag_fn],
+                                    qk, cur_k, cur_v)
+        o_acc, lse_acc = _merge_normalized(o_acc, lse_acc, o_t, lse_t)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        cur_k = jax.lax.ppermute(cur_k, axis_name, perm)
+        cur_v = jax.lax.ppermute(cur_v, axis_name, perm)
+        return o_acc, lse_acc, cur_k, cur_v
+
+    o_acc, lse_acc, _, _ = jax.lax.fori_loop(
+        0, n, step, (o_acc, lse_acc, k, v))
+    return o_acc, lse_acc
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring_pallas(q, k, v, axis_name, n, causal, scale, blk, interpret):
+    out, _ = _ring_fwd_loop(q, k, v, axis_name, n, causal, scale, blk,
+                            interpret)
+    return out.astype(q.dtype)
+
+
+def _ring_pallas_fwd(q, k, v, axis_name, n, causal, scale, blk, interpret):
+    out, lse = _ring_fwd_loop(q, k, v, axis_name, n, causal, scale, blk,
+                              interpret)
+    out = out.astype(q.dtype)
+    # O(local block) residuals only — the rotated KV copies are recomputed
+    # by re-rotating in the backward pass, never stored.
+    return out, (q, k, v, out, lse)
+
+
+def _ring_pallas_bwd(axis_name, n, causal, scale, blk, interpret, res, g):
+    q, k, v, out, lse = res
+    idx = jax.lax.axis_index(axis_name)
+    g = g.astype(q.dtype)
+
+    def zeros_fn(_q, _k, _v, _o, _lse, _g):
+        return (jnp.zeros(_q.shape, jnp.float32),
+                jnp.zeros(_k.shape, jnp.float32),
+                jnp.zeros(_v.shape, jnp.float32))
+
+    def _step_bwd(causal_mode):
+        def run(qb, kb, vb, ob, lseb, gb):
+            dq, dk, dv = _flash_bwd(qb, kb, vb, ob, lseb, gb, scale,
+                                    causal_mode, bq=blk, bk=blk,
+                                    interpret=interpret)
+            return (dq.astype(jnp.float32), dk.astype(jnp.float32),
+                    dv.astype(jnp.float32))
+        return run
+
+    full_fn, diag_fn = _step_bwd(False), _step_bwd(True)
+
+    def step(t, carry):
+        dq_acc, cur_k, cur_v, dk_acc, dv_acc = carry
+        src = (idx - t) % n
+        if causal:
+            mode = jnp.where(src < idx, 1, jnp.where(src == idx, 2, 0))
+        else:
+            mode = jnp.ones((), jnp.int32)
+        dq_t, dk_t, dv_t = jax.lax.switch(
+            mode, [zeros_fn, full_fn, diag_fn], q, cur_k, cur_v, out, lse, g)
+        dq_acc = dq_acc + dq_t
+        dk_acc = dk_acc + dk_t
+        dv_acc = dv_acc + dv_t
+        # dK/dV travel WITH their KV blocks: after the full ring pass each
+        # block arrives home carrying every device's contribution.
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        cur_k = jax.lax.ppermute(cur_k, axis_name, perm)
+        cur_v = jax.lax.ppermute(cur_v, axis_name, perm)
+        dk_acc = jax.lax.ppermute(dk_acc, axis_name, perm)
+        dv_acc = jax.lax.ppermute(dv_acc, axis_name, perm)
+        return dq_acc, cur_k, cur_v, dk_acc, dv_acc
+
+    dq = jnp.zeros(q.shape, jnp.float32)
+    dk = jnp.zeros(k.shape, jnp.float32)
+    dv = jnp.zeros(v.shape, jnp.float32)
+    dq, _, _, dk, dv = jax.lax.fori_loop(0, n, step, (dq, k, v, dk, dv))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_pallas.defvjp(_ring_pallas_fwd, _ring_pallas_bwd)
+
+
+def _ring_kernel_inner(q, k, v, axis_name: str, axis_size: int,
+                       causal: bool = True, scale: float | None = None,
+                       impl: str = "pallas"):
+    """Pallas-kernel ring pass. q/k/v: [B, L, H, D] local chunks."""
+    b, l, h, d = q.shape
+    scale = scale if scale is not None else d ** -0.5
+    blk = _ring_block(l)
+    # Kernel layout [B*H, L, D] once; rotation happens in this layout too.
+    def to_k(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, l, d)
+    out = _ring_pallas(to_k(q), to_k(k), to_k(v), axis_name, axis_size,
+                       causal, scale, blk, impl == "interpret")
+    return out.reshape(b, h, l, d).transpose(0, 2, 1, 3)
+
+
+def ring_attention_inner(q, k, v, axis_name: str, axis_size: int,
+                         causal: bool = True, scale: float | None = None,
+                         impl: str = "jnp"):
+    """Call inside shard_map with seq sharded over `axis_name`.
+
+    q, k, v: [batch, seq_local, heads, head_dim] (kv heads must equal q heads
+    here; GQA repeat happens before the call). `axis_size` must be the static
+    ring size — the ppermute permutation table is built at trace time.
+    """
+    if impl in ("pallas", "interpret"):
+        return _ring_kernel_inner(q, k, v, axis_name, axis_size,
+                                  causal=causal, scale=scale, impl=impl)
+    return _ring_jnp_inner(q, k, v, axis_name, axis_size,
+                           causal=causal, scale=scale)
+
+
 def ring_attention(q, k, v, mesh, axis_name: str = "sp", causal: bool = True,
-                   q_spec: P | None = None):
-    """shard_map wrapper: q/k/v sharded [batch, seq/sp, heads, head_dim]."""
+                   q_spec: P | None = None, impl: str = "auto"):
+    """shard_map wrapper: q/k/v sharded [batch, seq/sp, heads, head_dim].
+
+    impl: "auto" (pallas kernels on TPU, jnp elsewhere), "pallas",
+    "interpret" (pallas interpreter — CPU tests take the kernel code path),
+    "jnp" (pure-jnp oracle).
+    """
     from jax import shard_map
+    n = mesh.shape[axis_name]
+    explicit = impl in ("pallas", "interpret")
+    if impl == "auto":
+        impl = "pallas" if _on_tpu() else "jnp"
+    if impl in ("pallas", "interpret") and _ring_block(q.shape[1] // n) < 8:
+        if explicit:
+            # Silent downgrade would reintroduce the O(chunk^2) score
+            # materialization at exactly the scale the kernel was asked
+            # for — fail loudly instead.
+            raise ValueError(
+                f"ring_attention(impl={impl!r}): local chunk "
+                f"{q.shape[1] // n} has no MXU-friendly tile divisor "
+                f"(gcd with 512 < 8); pad the sequence so seq/{n} is a "
+                f"multiple of 128")
+        impl = "jnp"  # auto on CPU-sized toys: jnp oracle is fine
     spec = q_spec if q_spec is not None else P(None, axis_name, None, None)
     fn = functools.partial(ring_attention_inner, axis_name=axis_name,
-                           axis_size=mesh.shape[axis_name], causal=causal)
+                           axis_size=n, causal=causal, impl=impl)
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                      out_specs=spec, check_vma=False)(q, k, v)
 

@@ -224,3 +224,63 @@ def test_mixed_path_actor_calls_stay_ordered():
         assert seen == list(range(30)), seen
     finally:
         c.shutdown()
+
+
+def test_dep_gated_actor_call_does_not_stall_direct_calls():
+    """A seq-stamped actor call parked at the head on a still-pending dep
+    must not stall the caller's later direct calls (the head skip-releases
+    its slot); the gated call lands when its dep resolves — the
+    reference's post-resolution ordering (dependency_resolver.h)."""
+    import time as _time
+
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    n1 = c.add_node(num_cpus=2)
+    n2 = c.add_node(num_cpus=2)
+    c.wait_for_nodes(3)
+    try:
+        on_n1 = NodeAffinitySchedulingStrategy(node_id=n1.node_id, soft=False)
+        on_n2 = NodeAffinitySchedulingStrategy(node_id=n2.node_id, soft=False)
+
+        @ray_tpu.remote(num_cpus=1)
+        class Recorder:
+            def __init__(self):
+                self.seen = []
+
+            def rec(self, x):
+                self.seen.append(x)
+
+            def dump(self):
+                return self.seen
+
+        a = Recorder.options(scheduling_strategy=on_n2).remote()
+
+        @ray_tpu.remote(num_cpus=1)
+        def slow():
+            _time.sleep(6)
+            return "gated"
+
+        @ray_tpu.remote(num_cpus=1)
+        def caller(h):
+            sref = slow.remote()
+            h.rec.remote(sref)          # parks at the head on sref
+            for i in range(10):
+                h.rec.remote(i)         # direct path
+            t0 = _time.monotonic()
+            first = ray_tpu.get(h.dump.remote(), timeout=60)
+            dt = _time.monotonic() - t0
+            ray_tpu.get(sref, timeout=60)
+            _time.sleep(1.5)            # let the released call deliver
+            final = ray_tpu.get(h.dump.remote(), timeout=60)
+            return first, dt, final
+
+        first, dt, final = ray_tpu.get(
+            caller.options(scheduling_strategy=on_n1).remote(a),
+            timeout=180)
+        # Direct calls flowed immediately (no 5s gap-timeout stall) and in
+        # order, without the gated call.
+        assert first == list(range(10)), first
+        assert dt < 4.0, f"direct calls stalled {dt:.1f}s behind a gated dep"
+        # The gated call delivered at dep-resolution time, after them.
+        assert final == list(range(10)) + ["gated"], final
+    finally:
+        c.shutdown()

@@ -147,3 +147,104 @@ def test_head_restart_adopts_actors_and_finishes_queued_task(tmp_path):
                     proc.wait(timeout=10)
                 except Exception:  # noqa: BLE001
                     pass
+
+
+def test_head_restart_recovers_dep_gated_tasks(tmp_path):
+    """Journal-replayed tasks WITH object deps must not be dropped:
+    a dep that survives in an agent's arena is re-discovered through the
+    agent's re-registration object inventory and the task completes; a
+    dep that lived only in the dead head gets its dependents tombstoned
+    with ObjectLostError so waiters fail fast instead of hanging
+    (parity: GCS reload + owner resubmission, gcs_init_data.h,
+    task_manager.h:216)."""
+    port = _free_port()
+    journal = str(tmp_path / "head_journal2.bin")
+    head = _spawn_head(port, journal)
+    agent = None
+    try:
+        assert _wait_port(port), "head never came up"
+        agent = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.node_agent",
+             "--head", f"127.0.0.1:{port}", "--num-cpus", "1",
+             "--resources", '{"agent": 1}'],
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            start_new_session=True,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+        ray_tpu.init(address=f"127.0.0.1:{port}")
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if any(n["alive"] and n["resources"].get("agent")
+                   for n in ray_tpu.nodes()):
+                break
+            time.sleep(0.5)
+        else:
+            raise AssertionError("agent node never registered")
+
+        @ray_tpu.remote(num_cpus=1, resources={"agent": 0.1})
+        def big():
+            # > max_inline_object_bytes: lands in the AGENT's arena, which
+            # survives the head crash.
+            return b"x" * (1 << 20)
+
+        dep_ref = big.remote()
+        assert len(ray_tpu.get(dep_ref, timeout=60)) == 1 << 20
+
+        # A small driver-side put travels inline through the head and dies
+        # with it: its dependents must be tombstoned, not hung.
+        lost_ref = ray_tpu.put(b"tiny")
+
+        @ray_tpu.remote(num_cpus=1, resources={"agent": 0.1},
+                        max_retries=3)
+        def hog():
+            time.sleep(6)
+            return "hogged"
+
+        @ray_tpu.remote(num_cpus=1, resources={"agent": 0.1},
+                        max_retries=3)
+        def consume(data):
+            return len(data)
+
+        h = hog.remote()  # noqa: F841 — occupies the agent's only CPU
+        c_ok = consume.remote(dep_ref)
+        c_lost = consume.remote(lost_ref)
+        ok_oid = c_ok.id.binary()
+        lost_oid = c_lost.id.binary()
+        time.sleep(1.0)
+
+        os.kill(head.pid, signal.SIGKILL)
+        head.wait(timeout=30)
+        try:
+            ray_tpu.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+
+        head = _spawn_head(port, journal)
+        assert _wait_port(port), "restarted head never came up"
+        time.sleep(2.0)
+
+        ray_tpu.init(address=f"127.0.0.1:{port}")
+        from ray_tpu.core.ids import ObjectID
+        from ray_tpu.core.object_ref import ObjectRef
+
+        # Surviving dep: the replayed task completes after the adopt grace.
+        out = ray_tpu.get(ObjectRef(ObjectID(ok_oid), _add_ref=False),
+                          timeout=120)
+        assert out == 1 << 20
+
+        # Lost dep: waiters fail fast with the loss spelled out.
+        with pytest.raises(ray_tpu.RayTpuError):
+            ray_tpu.get(ObjectRef(ObjectID(lost_oid), _add_ref=False),
+                        timeout=60)
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        for proc in (agent, head):
+            if proc is not None:
+                try:
+                    proc.kill()
+                    proc.wait(timeout=10)
+                except Exception:  # noqa: BLE001
+                    pass
