@@ -162,6 +162,49 @@ def bench_sp_ring(steps: int = 5, seq: int = 32768):
     return out
 
 
+def bench_llm_decode(layout: str, slots: int = 32, prompt_len: int = 128,
+                     gen: int = 64):
+    """Decode throughput at `slots` concurrent sequences (VERDICT r2 #2
+    done-criterion): tokens/s through the continuous-batching engine with
+    the given KV layout. Run for both layouts = the before/after."""
+    import jax
+    import numpy as np
+
+    from ray_tpu.llm import EngineConfig, InferenceEngine
+    from ray_tpu.models import configs
+
+    cfg = configs.bench_125m()
+    eng = InferenceEngine(
+        cfg, EngineConfig(
+            max_slots=slots, max_len=1024, prompt_buckets=(prompt_len,),
+            eos_token=-1, kv_layout=layout),
+        params=None, seed=0)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, cfg.vocab, prompt_len - 1).tolist()
+               for _ in range(slots)]
+    # Warm: a throwaway generation pays every compile (admission, decode
+    # windows) before the clock starts.
+    eng.generate(prompts[:slots], max_new_tokens=gen, temperature=0.0)
+    for p in prompts:
+        eng.add_request(p, max_new_tokens=gen, temperature=0.0)
+    t0 = time.time()
+    before = sum(len(r.generated) for r in eng.finished.values())
+    while eng.has_work():
+        eng.step_window()
+    toks = (sum(len(r.generated) for r in eng.finished.values())
+            - before)
+    dt = time.time() - t0
+    out = {
+        "config": f"llm_decode_{layout}", "slots": slots,
+        "prompt_len": prompt_len, "max_new_tokens": gen,
+        "decode_tokens_per_sec": round(toks / dt),
+    }
+    if layout == "paged":
+        out["kv"] = eng.kv_stats()
+    print(f"llm_decode[{layout}]: {out}", file=sys.stderr)
+    return out
+
+
 def run() -> dict:
     """Returns {"device": ..., "configs": [...]} or {"skipped": reason}."""
     try:
@@ -192,6 +235,13 @@ def run() -> dict:
         results["configs"].append(
             {"config": "sp_ring_32k", "error": str(e)[:200]})
         print(f"sp_ring: FAILED {e}", file=sys.stderr)
+    for layout in ("dense", "paged"):
+        try:
+            results["configs"].append(bench_llm_decode(layout))
+        except Exception as e:
+            results["configs"].append(
+                {"config": f"llm_decode_{layout}", "error": str(e)[:200]})
+            print(f"llm_decode[{layout}]: FAILED {e}", file=sys.stderr)
     return results
 
 

@@ -244,9 +244,11 @@ class SharedMemoryStore:
     def release(self, object_id: ObjectID):
         self._lib.store_release(self._base, object_id.binary())
 
-    def list_object_ids(self, max_ids: int = 1 << 16) -> list[bytes]:
+    def list_object_ids(self) -> list[bytes]:
         """Ids of every sealed object in the arena (inventory for a
-        restarted head's directory rebuild)."""
+        restarted head's directory rebuild). Sized from the live object
+        count so a large arena is never silently truncated."""
+        max_ids = int(self.stats()["num_objects"]) + 1024  # churn slack
         out = (ctypes.c_uint8 * (16 * max_ids))()
         n = self._lib.store_list_ids(self._base, out, max_ids)
         raw = bytes(out[: 16 * n])

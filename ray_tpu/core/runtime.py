@@ -853,11 +853,19 @@ class Runtime:
                     # Unrecoverable: a dep lived only in the dead head's
                     # arena (or its producer failed to replay). Tombstone
                     # the returns so adopted workers blocked in get() fail
-                    # fast instead of hanging forever.
+                    # fast instead of hanging forever. A node registering
+                    # between the fixpoint and here can resolve the dep
+                    # after all — submit in that case instead.
                     lost = next(
-                        d for d in spec.dependencies
-                        if self.directory.lookup(d) is None
-                        and d not in promised)
+                        (d for d in spec.dependencies
+                         if self.directory.lookup(d) is None
+                         and d not in promised), None)
+                    if lost is None:
+                        try:
+                            self.submit_task(spec)
+                        except Exception as e:  # noqa: BLE001
+                            self._fail_returns(spec, e)
+                        continue
                     self._fail_returns(spec, ObjectLostError(
                         ObjectID(lost),
                         msg=f"dependency of journaled task "

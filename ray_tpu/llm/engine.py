@@ -38,11 +38,19 @@ from ray_tpu.ops.layers import apply_rope, rmsnorm, rope
 @dataclasses.dataclass(frozen=True)
 class EngineConfig:
     max_slots: int = 8             # concurrent decoding sequences
-    max_len: int = 2048            # per-slot KV capacity (prompt + gen)
+    max_len: int = 2048            # per-sequence context bound (prompt + gen)
     prompt_buckets: tuple = (64, 256, 1024)  # prefill compile buckets
     eos_token: int = 2
     default_max_new_tokens: int = 128
     default_temperature: float = 0.0  # 0 = greedy
+    # --- KV layout (parity: vLLM's paged KV under the reference's llm
+    # stack, vllm_models.py:123-137; TPU-shaped: static page pool +
+    # bucketed gathers instead of CUDA page kernels) ---
+    kv_layout: str = "paged"       # "paged" | "dense" (legacy fixed slots)
+    page_size: int = 128           # tokens per KV page (TPU lane-friendly)
+    num_pages: int | None = None   # pool size; None = slots*ceil(max_len/
+    #                                page)+1 (capacity parity with dense)
+    prefix_cache: bool = True      # reuse full prompt pages across requests
 
 
 @dataclasses.dataclass
@@ -55,6 +63,10 @@ class Request:
     top_k: int = 0         # 0 = no top-k truncation
     generated: list = dataclasses.field(default_factory=list)
     done: bool = False
+    # Set when the request was preempted mid-decode: the token that was
+    # sampled but never fed back. Re-admission resumes from it instead of
+    # re-sampling the position.
+    resume_token: int | None = None
 
 
 # ---------------- pure model steps ----------------
@@ -183,6 +195,169 @@ def decode_step(params, cache_k, cache_v, tokens, lengths, active,
     return logits, cache_k, cache_v
 
 
+def prefill_with_prefix(params, tokens, pool_k, pool_v, prefix_pages,
+                        prefix_len, config: ModelConfig):
+    """Prefill only the SUFFIX of a prompt whose prefix pages are already
+    cached (prefix caching). tokens [1, S] = suffix (right-padded);
+    prefix_pages [Pp] page ids into the pool (0-padded); prefix_len the
+    true prefix token count. Cached K is stored post-RoPE at absolute
+    positions, so it is reused as-is; suffix positions offset by
+    prefix_len. Returns (suffix logits [S, vocab] f32, suffix k/v caches
+    [L, S, hkv, hd])."""
+    c = config
+    x = jnp.take(params["embed"], tokens, axis=0)
+    s = tokens.shape[1]
+    page = pool_k.shape[2]
+    pre_t = prefix_pages.shape[0] * page
+    positions = prefix_len + jnp.arange(s)
+    sin, cos = rope(positions, c.head_dim, c.rope_theta)
+    causal = jnp.tril(jnp.ones((s, s), jnp.bool_))
+    pre_mask = jnp.broadcast_to(
+        (jnp.arange(pre_t) < prefix_len)[None], (s, pre_t))
+    full_mask = jnp.concatenate([pre_mask, causal], axis=1)  # [S, preT+S]
+
+    def layer(x, scan_in):
+        lp, pk, pv = scan_in  # pk/pv [pages, page, hkv, hd]
+        normed = rmsnorm(x, lp["attn_norm"], c.norm_eps)
+        q, k, v = _qkv(normed, lp, c)
+        q = apply_rope(q, sin[None], cos[None])
+        k = apply_rope(k, sin[None], cos[None])
+        prek = pk[prefix_pages].reshape(1, pre_t, *pk.shape[2:])
+        prev = pv[prefix_pages].reshape(1, pre_t, *pv.shape[2:])
+        kk = jnp.concatenate([prek.astype(k.dtype), k], axis=1)
+        vv = jnp.concatenate([prev.astype(v.dtype), v], axis=1)
+        n_rep = c.n_heads // c.n_kv_heads
+        if n_rep > 1:
+            kk = jnp.repeat(kk, n_rep, axis=2)
+            vv = jnp.repeat(vv, n_rep, axis=2)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, kk) / np.sqrt(c.head_dim)
+        scores = jnp.where(full_mask[None, None],
+                           scores.astype(jnp.float32), -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vv)
+        attn = attn.reshape(1, s, c.n_heads * c.head_dim)
+        h = x + jnp.einsum("bsq,qd->bsd", attn, lp["wo"])
+        return _mlp_block(h, lp, c), (k[0], v[0])
+
+    x, (ks, vs) = jax.lax.scan(layer, x, (params["layers"], pool_k, pool_v))
+    x = rmsnorm(x, params["final_norm"], c.norm_eps)
+    head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
+    logits = jnp.einsum("sd,dv->sv", x[0].astype(jnp.float32),
+                        head.astype(jnp.float32))
+    return logits, ks, vs
+
+
+def insert_pages(pool_k, pool_v, ks, vs, page_ids, length):
+    """Scatter a prefill's suffix KV into its allocated pages. ks/vs
+    [L, S, hkv, hd] (S page-aligned start); page_ids [ceil(S/page)]
+    (0 = unused -> the reserved scratch page); zero the tail past
+    `length` so stale values can't alias later positions."""
+    L, S = ks.shape[:2]
+    page = pool_k.shape[2]
+    n_pages = page_ids.shape[0]
+    s_pad = n_pages * page
+    if s_pad != S:
+        padding = [(0, 0), (0, s_pad - S), (0, 0), (0, 0)]
+        ks = jnp.pad(ks, padding)
+        vs = jnp.pad(vs, padding)
+    mask = (jnp.arange(s_pad) < length)[None, :, None, None]
+    ks = jnp.where(mask, ks, 0).reshape(L, n_pages, page, *ks.shape[2:])
+    vs = jnp.where(mask, vs, 0).reshape(L, n_pages, page, *vs.shape[2:])
+    pool_k = pool_k.at[:, page_ids].set(ks.astype(pool_k.dtype))
+    pool_v = pool_v.at[:, page_ids].set(vs.astype(pool_v.dtype))
+    return pool_k, pool_v
+
+
+def decode_paged(params, pool_k, pool_v, tokens, lengths, active,
+                 page_tables, config: ModelConfig):
+    """One token for every slot against the paged pool. page_tables
+    [B, P] page ids in position order (0 = unused -> scratch page, whose
+    garbage the position mask hides). The new token's KV scatters into
+    (write_page, lengths % page); compute and gather scale with the
+    bucketed P, not the model's max context."""
+    c = config
+    B, P = page_tables.shape
+    page = pool_k.shape[2]
+    T = P * page
+    x = jnp.take(params["embed"], tokens, axis=0)[:, None, :]  # [B,1,d]
+    sin, cos = rope(lengths[:, None], c.head_dim, c.rope_theta)
+    n_rep = c.n_heads // c.n_kv_heads
+    pos_mask = jnp.arange(T)[None] <= lengths[:, None]  # [B,T] inclusive
+    w_idx = jnp.clip(lengths // page, 0, P - 1)
+    w_page = jnp.take_along_axis(page_tables, w_idx[:, None], 1)[:, 0]
+    w_page = jnp.where(active, w_page, 0)  # inactive -> scratch page
+    w_off = lengths % page
+
+    def layer(x, scan_in):
+        lp, pk, pv = scan_in  # [pages, page, hkv, hd]
+        normed = rmsnorm(x, lp["attn_norm"], c.norm_eps)
+        q, k, v = _qkv(normed, lp, c)
+        q = apply_rope(q, sin, cos)
+        k = apply_rope(k, sin, cos)
+        pk = pk.at[w_page, w_off].set(k[:, 0].astype(pk.dtype))
+        pv = pv.at[w_page, w_off].set(v[:, 0].astype(pv.dtype))
+        ck = pk[page_tables].reshape(B, T, *pk.shape[2:])
+        cv = pv[page_tables].reshape(B, T, *pv.shape[2:])
+        scores = _gqa_scores(q, ck, n_rep) / np.sqrt(c.head_dim)  # [B,h,T]
+        scores = jnp.where(pos_mask[:, None], scores.astype(jnp.float32),
+                           -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        cvv = jnp.repeat(cv, n_rep, axis=2) if n_rep > 1 else cv
+        attn = jnp.einsum("bht,bthd->bhd", probs, cvv)
+        attn = attn.reshape(B, 1, c.n_heads * c.head_dim)
+        h = x + jnp.einsum("bsq,qd->bsd", attn, lp["wo"])
+        return _mlp_block(h, lp, c), (pk, pv)
+
+    x, (pool_k, pool_v) = jax.lax.scan(
+        layer, x, (params["layers"], pool_k, pool_v))
+    x = rmsnorm(x, params["final_norm"], c.norm_eps)
+    head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
+    logits = jnp.einsum("bd,dv->bv", x[:, 0].astype(jnp.float32),
+                        head.astype(jnp.float32))
+    neg = jnp.full_like(logits, -1e30)
+    neg = neg.at[:, 0].set(0.0)
+    logits = jnp.where(active[:, None], logits, neg)
+    return logits, pool_k, pool_v
+
+
+def decode_window(params, pool_k, pool_v, tokens, lengths, active,
+                  page_tables, temps, top_ps, top_ks, key,
+                  config: ModelConfig, eos_token: int, n_steps: int,
+                  trunc: bool):
+    """`n_steps` decode+sample steps in ONE compiled program (lax.scan),
+    sampled tokens staying device-resident between steps. The host fences
+    once per window instead of once per token — essential when the
+    host<->device link has high latency (the axon tunnel's ~190ms RTT
+    would otherwise cap decode at ~5 steps/s regardless of model size).
+    EOS flips `active` on-device; the host discards any overshoot when it
+    reads the [n_steps, B] token block back.
+
+    Within a window page tables are frozen, so the caller bounds n_steps
+    by every active slot's remaining page room.
+    """
+
+    def one(carry, _):
+        pk, pv, toks, lens, act, key = carry
+        logits, pk, pv = decode_paged(params, pk, pv, toks, lens, act,
+                                      page_tables, config)
+        key, sub = jax.random.split(key)
+        if trunc:
+            nxt = sample(logits, temps, sub, top_p=top_ps, top_k=top_ks)
+        else:
+            nxt = sample(logits, temps, sub)
+        nxt = jnp.where(act, nxt.astype(jnp.int32), 0)
+        out = jnp.where(act, nxt, -1)  # -1 = slot emitted nothing
+        lens = jnp.where(act, lens + 1, lens)
+        if eos_token >= 0:
+            act = act & (nxt != eos_token)
+        return (pk, pv, nxt, lens, act, key), out
+
+    carry = (pool_k, pool_v, tokens, lengths, active, key)
+    (pool_k, pool_v, tokens, lengths, active, key), out_seq = jax.lax.scan(
+        one, carry, None, length=n_steps)
+    return pool_k, pool_v, tokens, lengths, active, key, out_seq
+
+
 def sample(logits, temperature, key, top_p=None, top_k=None):
     """Per-row temperature (0 = greedy) with optional nucleus (top_p) and
     top_k truncation — all branch-free under jit.
@@ -244,19 +419,75 @@ class InferenceEngine:
                                   rules, mesh)
         self.params = params
         c, e = self.c, self.e
-        kv_shape = (c.n_layers, e.max_slots, e.max_len, c.n_kv_heads,
-                    c.head_dim)
-        self.cache_k = jnp.zeros(kv_shape, c.jdtype)
-        self.cache_v = jnp.zeros(kv_shape, c.jdtype)
+        self.paged = e.kv_layout == "paged"
+        kv_sharding = None
         if mesh is not None and "tp" in mesh.axis_names:
             from jax.sharding import NamedSharding, PartitionSpec as P
-            kv_s = NamedSharding(mesh, P(None, None, None, "tp", None))
-            self.cache_k = jax.device_put(self.cache_k, kv_s)
-            self.cache_v = jax.device_put(self.cache_v, kv_s)
+            kv_sharding = NamedSharding(mesh, P(None, None, None, "tp",
+                                                None))
+        if self.paged:
+            # Paged pool (parity: vLLM paged KV, vllm_models.py:123-137):
+            # HBM tracks the pool size — actual token load — not
+            # slots x max_len; sequences grow page by page and shared
+            # prompt prefixes share pages. Page 0 is reserved scratch
+            # (unused page-table entries point at it).
+            page = e.page_size
+            self.pages_per_slot = -(-e.max_len // page)
+            self.num_pages = (e.num_pages
+                              or e.max_slots * self.pages_per_slot + 1)
+            kv_shape = (c.n_layers, self.num_pages, page, c.n_kv_heads,
+                        c.head_dim)
+            self.cache_k = jnp.zeros(kv_shape, c.jdtype)
+            self.cache_v = jnp.zeros(kv_shape, c.jdtype)
+            # page bookkeeping (host side)
+            self.free_pages: list[int] = list(range(1, self.num_pages))
+            self.page_refs: dict[int, int] = {}
+            self.page_hash: dict = {}          # prefix-hash -> page id
+            self.hash_of_page: dict[int, object] = {}
+            self.cached_lru: "collections.OrderedDict[int, object]" = (
+                collections.OrderedDict())     # ref-0 cached pages (LRU)
+            self.slot_pages: list[list[int]] = [[] for _ in
+                                                range(e.max_slots)]
+            self.slot_borrowed = [0] * e.max_slots
+            self.prefix_hits = 0
+            self.preemptions = 0
+            # decode compile buckets over pages-in-use: powers of two up
+            # to the per-slot page bound
+            pb, b = [], 1
+            while b < self.pages_per_slot:
+                pb.append(b)
+                b *= 2
+            pb.append(self.pages_per_slot)
+            self._page_buckets = pb
+            self._decode_paged: dict[int, object] = {}
+            self._prefill_pre: dict[tuple, object] = {}
+            self._window_fns: dict[tuple, object] = {}
+            self._win_buckets = (1, 2, 4, 8, 16, 32, 64)
+            # Device-resident decode state (uploaded only when the host
+            # view changed): high-latency links make per-step uploads as
+            # costly as downloads.
+            self._dev = None           # (tokens, lengths, active) on device
+            self._dev_dirty = True
+            self._dev_key = jax.random.PRNGKey(seed + 2)
+            self._dev_sampling = None  # (temps, top_ps, top_ks) device
+            self._dev_sampling_fp = None
+            # Donate the pool/cache: without donation every step round-trips
+            # the full KV through a fresh HBM allocation (~GBs/step).
+            self._insert_pages = jax.jit(insert_pages,
+                                         donate_argnums=(0, 1))
+        else:
+            kv_shape = (c.n_layers, e.max_slots, e.max_len, c.n_kv_heads,
+                        c.head_dim)
+            self.cache_k = jnp.zeros(kv_shape, c.jdtype)
+            self.cache_v = jnp.zeros(kv_shape, c.jdtype)
+            self._insert = jax.jit(insert_kv, donate_argnums=(0, 1))
+            self._decode = jax.jit(partial(decode_step, config=c),
+                                   donate_argnums=(1, 2))
+        if kv_sharding is not None:
+            self.cache_k = jax.device_put(self.cache_k, kv_sharding)
+            self.cache_v = jax.device_put(self.cache_v, kv_sharding)
 
         self._prefill = jax.jit(partial(prefill, config=c))
-        self._insert = jax.jit(insert_kv)
-        self._decode = jax.jit(partial(decode_step, config=c))
         # Two compiled samplers: the plain one (no sorts) serves the
         # default top_k=0/top_p=1 case on the hot decode loop; the
         # truncating one compiles the top-k/top-p masking only when some
@@ -316,7 +547,246 @@ class InferenceEngine:
                 return b
         raise ValueError(f"no prompt bucket fits {n} tokens")
 
+    # ---- page pool (paged layout only) ----
+
+    def _alloc_page(self) -> int | None:
+        """A free page, else evict the LRU ref-0 cached page, else None."""
+        if self.free_pages:
+            return self.free_pages.pop()
+        if self.cached_lru:
+            pid, h = self.cached_lru.popitem(last=False)
+            self.page_hash.pop(h, None)
+            self.hash_of_page.pop(pid, None)
+            self.page_refs.pop(pid, None)
+            return pid
+        return None
+
+    def _incref_page(self, pid: int):
+        self.page_refs[pid] = self.page_refs.get(pid, 0) + 1
+        self.cached_lru.pop(pid, None)  # in use: not evictable
+
+    def _decref_page(self, pid: int):
+        n = self.page_refs.get(pid, 0) - 1
+        if n > 0:
+            self.page_refs[pid] = n
+            return
+        self.page_refs.pop(pid, None)
+        h = self.hash_of_page.get(pid)
+        if h is not None:
+            # Keep the content cached for future prefix hits; evictable.
+            self.cached_lru[pid] = h
+        else:
+            self.free_pages.append(pid)
+
+    def _release_slot(self, slot: int):
+        for pid in self.slot_pages[slot]:
+            self._decref_page(pid)
+        self.slot_pages[slot] = []
+        self.slot_borrowed[slot] = 0
+
+    @staticmethod
+    def _prefix_hash(tokens: list) -> bytes:
+        """Exact key (the token bytes themselves): a non-cryptographic
+        hash collision would silently serve another prompt's KV."""
+        return np.asarray(tokens, np.int32).tobytes()
+
+    def _find_prefix(self, prompt: list) -> list[int]:
+        """Longest run of already-cached full prompt pages (at least one
+        token is always left to prefill — its logits seed sampling)."""
+        if not (self.paged and self.e.prefix_cache):
+            return []
+        page = self.e.page_size
+        full = len(prompt) // page
+        if full * page == len(prompt):
+            full -= 1
+        pages = []
+        for i in range(full):
+            pid = self.page_hash.get(
+                self._prefix_hash(prompt[:(i + 1) * page]))
+            if pid is None:
+                break
+            pages.append(pid)
+        return pages
+
+    def _preempt_victim(self, needer: int) -> bool:
+        """Pool exhausted mid-decode: requeue the youngest re-prefillable
+        active slot (vLLM recompute-preemption semantics); its generated
+        tokens become prompt tail on re-admission. Returns True if a page
+        was freed."""
+        candidates = []
+        for i in range(self.e.max_slots):
+            req = self.slot_req[i]
+            if not self.active[i] or req is None:
+                continue
+            total = len(req.prompt) + len(req.generated)
+            usable = [b for b in self.e.prompt_buckets
+                      if b <= self.e.max_len]
+            if total <= min(max(usable, default=0), self.e.max_len - 1):
+                candidates.append((len(req.generated), i))
+        if not candidates:
+            return False
+        _, victim = min(candidates)
+        req = self.slot_req[victim]
+        self._release_slot(victim)
+        self.active[victim] = False
+        self.slot_req[victim] = None
+        # Re-prefill everything the model has SEEN (prompt + all fed-back
+        # tokens); the final sampled-but-never-fed token resumes decoding
+        # exactly where it stopped, without re-sampling its position.
+        req.prompt = req.prompt + req.generated[:-1]
+        req.resume_token = req.generated[-1]
+        self.queue.appendleft(req)
+        self.preemptions += 1
+        return True
+
     def _admit(self) -> dict[int, int]:
+        return self._admit_paged() if self.paged else self._admit_dense()
+
+    def _admit_paged(self) -> dict[int, int]:
+        admitted: dict[int, int] = {}
+        pending: list[tuple] = []  # (slot, req, last-logits row) to sample
+        e = self.e
+        page = e.page_size
+        free = [i for i in range(e.max_slots) if not self.active[i]]
+        while free and self.queue:
+            req = self.queue.popleft()
+            slot = free[0]
+            n = len(req.prompt)
+            pre_pages = self._find_prefix(req.prompt)
+            hit = len(pre_pages)
+            suffix = req.prompt[hit * page:]
+            ns = len(suffix)
+            bucket = self._bucket(ns)
+            # Pin the matched prefix pages FIRST: they may sit ref-0 in
+            # the eviction LRU, and the suffix allocation below must not
+            # be able to evict and reuse them.
+            for pid in pre_pages:
+                self._incref_page(pid)
+            # Pages covering [hit*page, n): allocated up front; growth
+            # pages come later, one decode page at a time.
+            need = -(-n // page) - hit
+            new_pages = []
+            for _ in range(need):
+                pid = self._alloc_page()
+                if pid is None:
+                    break
+                new_pages.append(pid)
+            if len(new_pages) < need:
+                # Pool exhausted: put everything back and stop admitting.
+                self.free_pages.extend(new_pages)
+                for pid in pre_pages:
+                    self._decref_page(pid)
+                self.queue.appendleft(req)
+                break
+            for pid in new_pages:
+                self.page_refs[pid] = 1
+            if hit:
+                self.prefix_hits += 1
+            toks = np.zeros((1, bucket), np.int32)
+            toks[0, :ns] = suffix
+            if hit:
+                # Pad the page list to a power-of-two bucket (scratch page
+                # 0; pre_mask hides it) so compile variants stay bounded:
+                # one per (suffix bucket, prefix-page bucket) pair.
+                pre_bucket = 1
+                while pre_bucket < hit:
+                    pre_bucket *= 2
+                padded = np.zeros(pre_bucket, np.int32)
+                padded[:hit] = pre_pages
+                key = (bucket, pre_bucket)
+                fn = self._prefill_pre.get(key)
+                if fn is None:
+                    fn = jax.jit(partial(prefill_with_prefix, config=self.c))
+                    self._prefill_pre[key] = fn
+                logits, ks, vs = fn(
+                    self.params, jnp.asarray(toks), self.cache_k,
+                    self.cache_v, jnp.asarray(padded),
+                    jnp.int32(hit * page))
+            else:
+                logits, ks, vs = self._prefill(self.params,
+                                               jnp.asarray(toks))
+            # Scatter suffix KV into its pages (bucket padded with scratch)
+            n_tab = -(-bucket // page)
+            tab = np.zeros(n_tab, np.int32)
+            tab[:len(new_pages)] = new_pages
+            self.cache_k, self.cache_v = self._insert_pages(
+                self.cache_k, self.cache_v, ks, vs,
+                jnp.asarray(tab), jnp.int32(ns))
+            # Register the full suffix pages for future prefix hits.
+            if e.prefix_cache:
+                for i in range(hit, n // page):
+                    pid = new_pages[i - hit]
+                    h = self._prefix_hash(req.prompt[:(i + 1) * page])
+                    if h not in self.page_hash:
+                        self.page_hash[h] = pid
+                        self.hash_of_page[pid] = h
+            self.slot_pages[slot] = pre_pages + new_pages
+            self.slot_borrowed[slot] = hit
+            free.pop(0)
+            self.slot_req[slot] = req
+            self.lengths[slot] = n
+            self.active[slot] = True
+            if req.resume_token is not None:
+                first = req.resume_token  # already in req.generated
+                req.resume_token = None
+                self.last_tokens[slot] = first
+                self._maybe_finish(slot, first)
+            else:
+                # Defer the first-token sampling: one batched readback for
+                # the whole admission burst instead of a fence per prompt.
+                pending.append((slot, req, logits[ns - 1]))
+        self._dev_dirty = True
+        if pending:
+            stacked = jnp.stack([row for _s, _r, row in pending])
+            temps = jnp.asarray([r.temperature for _s, r, _l in pending],
+                                jnp.float32)
+            self._key, sub = jax.random.split(self._key)
+            if all(r.top_k == 0 and r.top_p >= 1.0
+                   for _s, r, _l in pending):
+                toks = self._sample(stacked, temps, sub)
+            else:
+                toks = self._sample_trunc(
+                    stacked, temps, sub,
+                    jnp.asarray([r.top_p for _s, r, _l in pending],
+                                jnp.float32),
+                    jnp.asarray([r.top_k for _s, r, _l in pending],
+                                jnp.int32))
+            toks = np.asarray(toks)  # one fence for the burst
+            for (slot, req, _l), tok in zip(pending, toks):
+                first = int(tok)
+                req.generated.append(first)
+                admitted[req.request_id] = first
+                self.last_tokens[slot] = first
+                self._maybe_finish(slot, first)
+        return admitted
+
+    def _sample_first(self, req: Request, logits, last_idx: int) -> int:
+        self._key, sub = jax.random.split(self._key)
+        if req.top_k == 0 and req.top_p >= 1.0:
+            return int(self._sample(
+                logits[last_idx - 1][None],
+                jnp.asarray([req.temperature], jnp.float32), sub)[0])
+        return int(self._sample_trunc(
+            logits[last_idx - 1][None],
+            jnp.asarray([req.temperature], jnp.float32), sub,
+            jnp.asarray([req.top_p], jnp.float32),
+            jnp.asarray([req.top_k], jnp.int32))[0])
+
+    def kv_stats(self) -> dict:
+        """Pool/HBM accounting for tests, the dashboard, and the bench."""
+        if not self.paged:
+            return {"layout": "dense"}
+        return {
+            "layout": "paged", "num_pages": self.num_pages,
+            "free_pages": len(self.free_pages),
+            "cached_pages": len(self.cached_lru),
+            "pages_in_use": self.num_pages - 1 - len(self.free_pages)
+            - len(self.cached_lru),
+            "prefix_hits": self.prefix_hits,
+            "preemptions": self.preemptions,
+        }
+
+    def _admit_dense(self) -> dict[int, int]:
         admitted: dict[int, int] = {}
         free = [i for i in range(self.e.max_slots) if not self.active[i]]
         while free and self.queue:
@@ -327,17 +797,7 @@ class InferenceEngine:
             toks = np.zeros((1, bucket), np.int32)
             toks[0, :n] = req.prompt
             logits, ks, vs = self._prefill(self.params, jnp.asarray(toks))
-            self._key, sub = jax.random.split(self._key)
-            if req.top_k == 0 and req.top_p >= 1.0:
-                first = int(self._sample(
-                    logits[n - 1][None],
-                    jnp.asarray([req.temperature], jnp.float32), sub)[0])
-            else:
-                first = int(self._sample_trunc(
-                    logits[n - 1][None],
-                    jnp.asarray([req.temperature], jnp.float32), sub,
-                    jnp.asarray([req.top_p], jnp.float32),
-                    jnp.asarray([req.top_k], jnp.int32))[0])
+            first = self._sample_first(req, logits, n)
             self.cache_k, self.cache_v = self._insert(
                 self.cache_k, self.cache_v, ks, vs, slot, n)
             req.generated.append(first)
@@ -359,6 +819,8 @@ class InferenceEngine:
             self.finished[req.request_id] = req
             self.active[slot] = False
             self.slot_req[slot] = None
+            if self.paged:
+                self._release_slot(slot)
 
     def step(self) -> dict[int, int]:
         """Admit queued prompts, run one decode step; returns
@@ -376,10 +838,15 @@ class InferenceEngine:
         top_ks = np.array(
             [self.slot_req[i].top_k if self.slot_req[i] else 0
              for i in range(self.e.max_slots)], np.int32)
-        logits, self.cache_k, self.cache_v = self._decode(
-            self.params, self.cache_k, self.cache_v,
-            jnp.asarray(self.last_tokens), jnp.asarray(self.lengths),
-            jnp.asarray(self.active))
+        if self.paged:
+            logits = self._decode_paged_step()
+            if logits is None:  # every active slot was preempted
+                return emitted
+        else:
+            logits, self.cache_k, self.cache_v = self._decode(
+                self.params, self.cache_k, self.cache_v,
+                jnp.asarray(self.last_tokens), jnp.asarray(self.lengths),
+                jnp.asarray(self.active))
         self._key, sub = jax.random.split(self._key)
         if (top_ks == 0).all() and (top_ps >= 1.0).all():
             tokens = np.asarray(self._sample(logits, jnp.asarray(temps),
@@ -398,6 +865,184 @@ class InferenceEngine:
             self.lengths[i] += 1
             self.last_tokens[i] = tok
             self._maybe_finish(i, tok)
+        self._dev_dirty = True  # single-step path mutates host-side state
+        return emitted
+
+    def _grow_pages(self, horizon: int = 1) -> bool:
+        """Ensure every active slot has pages for its next `horizon`
+        tokens, preempting when the pool is dry. Returns False if nothing
+        is left active."""
+        e = self.e
+        page = e.page_size
+        for i in range(e.max_slots):
+            if not self.active[i]:
+                continue
+            req = self.slot_req[i]
+            rem = min(horizon, req.max_new_tokens - len(req.generated) + 1)
+            last_pos = int(self.lengths[i]) + max(rem, 1) - 1
+            pi = min(last_pos, e.max_len - 1) // page
+            while pi >= len(self.slot_pages[i]):
+                pid = self._alloc_page()
+                if pid is None:
+                    if not self._preempt_victim(i):
+                        # Nothing preemptable: finish this request early
+                        # rather than deadlock the pump (pool too small
+                        # for even one sequence — a config error).
+                        req = self.slot_req[i]
+                        req.done = True
+                        self.finished[req.request_id] = req
+                        self.active[i] = False
+                        self.slot_req[i] = None
+                        self._release_slot(i)
+                        break
+                    if not self.active[i]:  # self-preempted
+                        break
+                    continue
+                self.page_refs[pid] = 1
+                self.slot_pages[i].append(pid)
+        self._dev_dirty = True
+        return bool(self.active.any())
+
+    def _decode_paged_step(self):
+        """Grow pages for slots whose next token starts a fresh page
+        (preempting if the pool is dry), build the bucketed page tables,
+        and run the decode jit for that bucket. Returns logits or None if
+        preemption drained every active slot."""
+        if not self._grow_pages(1):
+            return None
+        tables = self._build_tables()
+        p_bucket = tables.shape[1]
+        fn = self._decode_paged.get(p_bucket)
+        if fn is None:
+            fn = jax.jit(partial(decode_paged, config=self.c),
+                         donate_argnums=(1, 2))
+            self._decode_paged[p_bucket] = fn
+        logits, self.cache_k, self.cache_v = fn(
+            self.params, self.cache_k, self.cache_v,
+            jnp.asarray(self.last_tokens), jnp.asarray(self.lengths),
+            jnp.asarray(self.active), jnp.asarray(tables))
+        return logits
+
+    def _build_tables(self) -> np.ndarray:
+        e = self.e
+        p_need = max(
+            (len(self.slot_pages[i]) for i in range(e.max_slots)
+             if self.active[i]), default=1)
+        p_bucket = next(b for b in self._page_buckets if b >= p_need)
+        tables = np.zeros((e.max_slots, p_bucket), np.int32)
+        for i in range(e.max_slots):
+            if self.active[i]:
+                row = self.slot_pages[i][:p_bucket]
+                tables[i, :len(row)] = row
+        return tables
+
+    def _sync_device_state(self):
+        if self._dev_dirty or self._dev is None:
+            self._dev = (jnp.asarray(self.last_tokens),
+                         jnp.asarray(self.lengths),
+                         jnp.asarray(self.active))
+            self._dev_dirty = False
+
+    def _sync_sampling(self):
+        e = self.e
+        temps = np.array(
+            [self.slot_req[i].temperature if self.slot_req[i] else 0.0
+             for i in range(e.max_slots)], np.float32)
+        top_ps = np.array(
+            [self.slot_req[i].top_p if self.slot_req[i] else 1.0
+             for i in range(e.max_slots)], np.float32)
+        top_ks = np.array(
+            [self.slot_req[i].top_k if self.slot_req[i] else 0
+             for i in range(e.max_slots)], np.int32)
+        fp = (temps.tobytes(), top_ps.tobytes(), top_ks.tobytes())
+        if fp != self._dev_sampling_fp:
+            self._dev_sampling = (jnp.asarray(temps), jnp.asarray(top_ps),
+                                  jnp.asarray(top_ks))
+            self._dev_sampling_fp = fp
+        trunc = bool((top_ks != 0).any() or (top_ps < 1.0).any())
+        return trunc
+
+    def _run_window(self) -> dict[int, int]:
+        """Decode up to a bucketed number of tokens per slot in one
+        compiled dispatch + one host readback (see decode_window)."""
+        e = self.e
+        page = e.page_size
+        # Window size: the MAX remaining work across slots — slots that
+        # finish earlier keep "decoding" into scratch and the host
+        # discards their overshoot, which is far cheaper than paying the
+        # fence again. Only a pool-starved slot (growth failed) binds the
+        # window down to its real page room.
+        rems = [self.slot_req[i].max_new_tokens
+                - len(self.slot_req[i].generated)
+                for i in range(e.max_slots)
+                if self.active[i] and self.slot_req[i] is not None]
+        horizon = max(1, min(self._win_buckets[-1], max(rems, default=1)))
+        if not self._grow_pages(horizon):
+            return {}
+        limit = horizon
+        for i in range(e.max_slots):
+            if not self.active[i]:
+                continue
+            room = len(self.slot_pages[i]) * page - int(self.lengths[i])
+            rem = (self.slot_req[i].max_new_tokens
+                   - len(self.slot_req[i].generated))
+            if room < min(horizon, rem):
+                limit = min(limit, max(room, 1))
+        if limit == horizon:
+            # Round UP to one window: slots that finish early overshoot
+            # into discarded tokens, which is cheaper than another fence.
+            k_bucket = min(b for b in self._win_buckets if b >= limit)
+        else:
+            # Pool-starved slot: its room is a hard bound (tokens past it
+            # are garbage it still needs) — round DOWN.
+            k_bucket = max(b for b in self._win_buckets if b <= limit)
+        trunc = self._sync_sampling()
+        self._sync_device_state()
+        tables = self._build_tables()
+        key = (tables.shape[1], k_bucket, trunc)
+        fn = self._window_fns.get(key)
+        if fn is None:
+            fn = jax.jit(
+                partial(decode_window, config=self.c,
+                        eos_token=int(self.e.eos_token),
+                        n_steps=k_bucket, trunc=trunc),
+                donate_argnums=(1, 2, 3, 4, 5, 10))
+            self._window_fns[key] = fn
+        toks_d, lens_d, act_d = self._dev
+        temps_d, tps_d, tks_d = self._dev_sampling
+        (self.cache_k, self.cache_v, toks_d, lens_d, act_d,
+         self._dev_key, out_seq) = fn(
+            self.params, self.cache_k, self.cache_v, toks_d, lens_d,
+            act_d, jnp.asarray(tables), temps_d, tps_d, tks_d,
+            self._dev_key)
+        self._dev = (toks_d, lens_d, act_d)
+        out = np.asarray(out_seq)  # ONE fence per window
+        emitted: dict[int, int] = {}
+        for k in range(out.shape[0]):
+            for i in range(e.max_slots):
+                tok = int(out[k, i])
+                if tok < 0 or not self.active[i]:
+                    continue
+                req = self.slot_req[i]
+                req.generated.append(tok)
+                emitted[req.request_id] = tok
+                self.lengths[i] += 1
+                self.last_tokens[i] = tok
+                self._maybe_finish(i, tok)
+                if not self.active[i] and tok != e.eos_token:
+                    # Finished by max_new/max_len: the device still thinks
+                    # this slot is live — resync before the next window.
+                    self._dev_dirty = True
+        return emitted
+
+    def step_window(self) -> dict[int, int]:
+        """Admit queued prompts, then decode a whole window (paged layout
+        only; falls back to single-step elsewhere)."""
+        if not self.paged:
+            return self.step()
+        emitted = self._admit()
+        if self.active.any():
+            emitted.update(self._run_window())
         return emitted
 
     # ---- conveniences ----
@@ -410,7 +1055,7 @@ class InferenceEngine:
         ids = [self.add_request(p, max_new_tokens, temperature)
                for p in prompts]
         while self.has_work():
-            self.step()
+            self.step_window()
         out = []
         for rid in ids:
             req = self.finished.pop(rid)
