@@ -62,8 +62,13 @@ def flops_per_token(c, seq: int) -> float:
     return 3 * per_fwd
 
 
-def bench_config(tag, config, batch, seq, steps=5):
-    """Compile + run the train step; returns dict of metrics (or error)."""
+def bench_config(tag, config, batch, seq, steps=30):
+    """Compile + run the train step; returns dict of metrics (or error).
+
+    `steps` amortizes the single host fence: on the tunneled dev chip a
+    device->host read costs ~100-200ms regardless of size, so per-step
+    fencing would misreport MFU by tens of percent at small-model step
+    times (dispatches are async and effectively free)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -218,13 +223,14 @@ def run() -> dict:
     from ray_tpu.models import configs
     results = {"device": str(getattr(dev, "device_kind", dev)), "configs": []}
     plan = [
-        ("125m", configs.bench_125m(attn_impl="pallas"), 16, 1024),
+        ("125m", configs.bench_125m(attn_impl="pallas"), 16, 1024, 30),
         ("llama3_1b",
-         configs.llama3_1b(attn_impl="pallas", remat=True), 16, 1024),
+         configs.llama3_1b(attn_impl="pallas", remat=True), 16, 1024, 10),
     ]
-    for tag, cfg, batch, seq in plan:
+    for tag, cfg, batch, seq, steps in plan:
         try:
-            results["configs"].append(bench_config(tag, cfg, batch, seq))
+            results["configs"].append(
+                bench_config(tag, cfg, batch, seq, steps=steps))
         except Exception as e:
             results["configs"].append(
                 {"config": tag, "error": str(e)[:200]})

@@ -246,13 +246,16 @@ class SharedMemoryStore:
 
     def list_object_ids(self) -> list[bytes]:
         """Ids of every sealed object in the arena (inventory for a
-        restarted head's directory rebuild). Sized from the live object
-        count so a large arena is never silently truncated."""
+        restarted head's directory rebuild). The buffer grows until the
+        scan fits, so concurrent sealers can't silently truncate it."""
         max_ids = int(self.stats()["num_objects"]) + 1024  # churn slack
-        out = (ctypes.c_uint8 * (16 * max_ids))()
-        n = self._lib.store_list_ids(self._base, out, max_ids)
-        raw = bytes(out[: 16 * n])
-        return [raw[i:i + 16] for i in range(0, 16 * n, 16)]
+        while True:
+            out = (ctypes.c_uint8 * (16 * max_ids))()
+            n = self._lib.store_list_ids(self._base, out, max_ids)
+            if n < max_ids:
+                raw = bytes(out[: 16 * n])
+                return [raw[i:i + 16] for i in range(0, 16 * n, 16)]
+            max_ids *= 2
 
     def contains(self, object_id: ObjectID) -> bool:
         return bool(self._lib.store_contains(self._base, object_id.binary()))

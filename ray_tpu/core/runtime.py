@@ -1438,6 +1438,13 @@ class Runtime:
                     and st.worker.node_id != self.head_node_id):
                 resp = (st.worker.node_id, st.worker.worker_id.binary(),
                         bool(st.cspec.max_task_retries))
+        elif what == "my_peer_addr":
+            # The requester's node object-plane endpoint: p2p host
+            # collectives rendezvous through this once per group, then
+            # move every payload agent<->agent (util/collective).
+            node = self.nodes.get(w.node_id)
+            resp = tuple(node.peer_addr) if (
+                node is not None and node.peer_addr) else None
         elif what == "create_pg":
             pg_id, bundles, strategy, name = arg
             resp = self.create_placement_group(pg_id, bundles, strategy, name)
@@ -1549,6 +1556,9 @@ class Runtime:
             from ray_tpu.core import objxfer
             self._peer_server = objxfer.start_peer_server(self.store, host)
             self.head_peer_addr = (host, self._peer_server.port)
+            # Visible through the node table too (p2p collective ranks on
+            # the head resolve their endpoint the same way workers do).
+            self.head_node.peer_addr = self.head_peer_addr
         with self._sel_lock:
             self._selector.register(srv, selectors.EVENT_READ, _Acceptor())
         threading.Thread(target=self._health_loop, daemon=True,

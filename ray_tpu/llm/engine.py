@@ -735,7 +735,7 @@ class InferenceEngine:
                 # Defer the first-token sampling: one batched readback for
                 # the whole admission burst instead of a fence per prompt.
                 pending.append((slot, req, logits[ns - 1]))
-        self._dev_dirty = True
+            self._dev_dirty = True  # slot state changed by this admission
         if pending:
             stacked = jnp.stack([row for _s, _r, row in pending])
             temps = jnp.asarray([r.temperature for _s, r, _l in pending],
@@ -874,6 +874,7 @@ class InferenceEngine:
         is left active."""
         e = self.e
         page = e.page_size
+        changed = False
         for i in range(e.max_slots):
             if not self.active[i]:
                 continue
@@ -882,6 +883,7 @@ class InferenceEngine:
             last_pos = int(self.lengths[i]) + max(rem, 1) - 1
             pi = min(last_pos, e.max_len - 1) // page
             while pi >= len(self.slot_pages[i]):
+                changed = True
                 pid = self._alloc_page()
                 if pid is None:
                     if not self._preempt_victim(i):
@@ -900,7 +902,10 @@ class InferenceEngine:
                     continue
                 self.page_refs[pid] = 1
                 self.slot_pages[i].append(pid)
-        self._dev_dirty = True
+        if changed:
+            # Page growth changes only the tables, but a preemption inside
+            # the growth loop also changed slot state — resync both.
+            self._dev_dirty = True
         return bool(self.active.any())
 
     def _decode_paged_step(self):

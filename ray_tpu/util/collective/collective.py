@@ -12,16 +12,22 @@ Parity: reference `util/collective/collective.py` API surface;
 via-KV design mirrors how the reference exchanges NCCL unique ids through
 the GCS KV.
 
-SCOPE BOUNDARY (read before putting tensors through this): these are
-CONTROL-PLANE collectives — rendezvous, barriers, small-state exchange
-(gradients-of-metadata, rank tables, broadcast of a few MB). Small
-payloads round-trip the head's KV (O(world) head hops per op) and large
-payloads ride the shm object plane through head-coordinated pulls; either
-way the head is on the path, so throughput does NOT scale with world
-size. Dense-math collectives (allreduce of model tensors, all-to-all of
-activations) belong INSIDE jit as jax.lax collectives over ICI — that is
-the framework's data plane, and it never touches this module (SURVEY
-§5.8: the collective plane is XLA's, not a library's).
+Two transports, picked per op:
+- KV path: tiny payloads (< 32 KiB) round-trip the head's KV — one hop
+  beats ring latency for scalars/barriers.
+- P2P path (allreduce / broadcast / allgather of larger tensors): ring /
+  binary-tree topologies over each node's native peer server
+  (`util/collective/p2p.py`) — ZERO head messages per op after a one-time
+  rank->address rendezvous, bandwidth-optimal and flat as the world grows
+  (parity: the reference's p2p GLOO groups,
+  `gloo_collective_group.py:184`).
+
+SCOPE BOUNDARY: dense-math collectives INSIDE a jit-compiled program
+(allreduce of model tensors, all-to-all of activations) belong to
+jax.lax over ICI — that is the framework's data plane, and it never
+touches this module (SURVEY §5.8: the collective plane is XLA's, not a
+library's). This module is the HOST-side plane: weight broadcast to
+runners, rendezvous, barriers, metric exchange.
 """
 
 from __future__ import annotations
@@ -49,7 +55,12 @@ _REDUCERS = {
 
 
 class _KV:
-    """Uniform KV client: direct dict on the head, request RPC on workers."""
+    """Uniform KV client: direct dict on the head, request RPC on workers.
+
+    `ops` counts head round-trips issued by THIS process's collectives —
+    tests assert the p2p path leaves it untouched per op."""
+
+    ops = 0  # class-wide head-hop counter (per process)
 
     def __init__(self):
         from ray_tpu.core.runtime import Runtime, get_runtime
@@ -57,6 +68,7 @@ class _KV:
         self._head = isinstance(self._rt, Runtime)
 
     def put(self, key, value: bytes):
+        _KV.ops += 1
         if self._head:
             with self._rt.lock:
                 self._rt.kv[key] = value
@@ -64,17 +76,20 @@ class _KV:
             self._rt.request("kv_put", (key, value))
 
     def get(self, key):
+        _KV.ops += 1
         if self._head:
             return self._rt.kv.get(key)
         return self._rt.request("kv_get", key)
 
     def delete(self, key):
+        _KV.ops += 1
         if self._head:
             self._rt.kv.pop(key, None)
         else:
             self._rt.request("kv_del", key)
 
     def incr(self, key) -> int:
+        _KV.ops += 1
         if self._head:
             return self._rt.kv_incr(key)
         return self._rt.request("kv_incr", key)
@@ -114,10 +129,68 @@ class _Group:
         self.seq = 0
         self.p2p_seq: dict[tuple[int, int], int] = {}
         self.kv = _KV()
+        self._p2p = None         # lazy P2PTransport
+        self._p2p_failed = False
 
     def next_seq(self) -> int:
         self.seq += 1
         return self.seq
+
+    # -- p2p transport over the object plane ------------------------------
+
+    _P2P_MIN_BYTES = 32 << 10  # tiny payloads: one KV hop beats ring RTTs
+
+    def _my_peer_addr(self):
+        from ray_tpu.core.runtime import Runtime
+        rt = self.kv._rt
+        if isinstance(rt, Runtime):
+            return getattr(rt, "head_peer_addr", None)
+        try:
+            return rt.request("my_peer_addr")
+        except Exception:  # noqa: BLE001
+            return None
+
+    def p2p_for(self, arr, force: bool = False):
+        """The peer-to-peer transport, when this op should bypass the head:
+        payload large enough (or `force` — broadcast receivers may hold
+        placeholder buffers of any size, so its routing must not depend on
+        the local tensor), backend not pinned to 'kv', and every member
+        reachable over a peer endpoint. Symmetric-op routing relies on the
+        standard collective contract: allreduce/allgather contributions
+        have the same shape AND dtype on every rank, so the size gate
+        decides identically everywhere. The rank->address table is built
+        ONCE via the KV — the only head involvement p2p ops ever have."""
+        if self.backend == "kv" or self._p2p_failed:
+            return None
+        if not force and getattr(arr, "nbytes", 0) < self._P2P_MIN_BYTES:
+            return None
+        if self._p2p is None:
+            import os
+
+            from ray_tpu.util.collective import p2p
+            mine = self._my_peer_addr()
+            # Rank 0's nonce salts every object id: a re-created group
+            # (same name, fresh seq) must never alias a dead generation's
+            # leftover objects in a shared arena.
+            nonce = os.urandom(8).hex()
+            enc = ("" if mine is None
+                   else f"{mine[0]}:{int(mine[1])}|{nonce}")
+            table = self.exchange(enc)  # contributions ride as strings
+            decoded = [str(np.asarray(t).item()) for t in table]
+            if any(not a for a in decoded):
+                # A member without a peer endpoint (cluster server off):
+                # stay on the KV path for this group's lifetime.
+                self._p2p_failed = True
+                return None
+            gen = decoded[0].rsplit("|", 1)[1]
+            addrs = []
+            for a in decoded:
+                hostport = a.rsplit("|", 1)[0]
+                host, port = hostport.rsplit(":", 1)
+                addrs.append((host, int(port)))
+            self._p2p = p2p.P2PTransport(f"{self.name}#{gen}", self.rank,
+                                         addrs)
+        return self._p2p
 
     # -- rounds ----------------------------------------------------------
 
@@ -203,7 +276,9 @@ def is_group_initialized(group_name: str = "default") -> bool:
 
 
 def destroy_collective_group(group_name: str = "default") -> None:
-    _groups.pop(group_name, None)
+    g = _groups.pop(group_name, None)
+    if g is not None and g._p2p is not None:
+        g._p2p.destroy()
 
 
 def _group(group_name: str) -> _Group:
@@ -233,6 +308,13 @@ def _writeback(tensor, result):
 
 def allreduce(tensor, group_name: str = "default", op: str = ReduceOp.SUM):
     g = _group(group_name)
+    arr = np.asarray(tensor)
+    tp = g.p2p_for(arr)
+    if tp is not None:
+        from ray_tpu.util.collective import p2p
+        out = p2p.ring_allreduce(tp, g.next_seq(), arr, g.world_size,
+                                 _REDUCERS[op])
+        return _writeback(tensor, out)
     vals = g.exchange(tensor)
     return _writeback(tensor, _REDUCERS[op](np.stack(
         [np.asarray(v) for v in vals])))
@@ -250,6 +332,15 @@ def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
     g = _group(group_name)
+    arr = np.asarray(tensor)
+    # force=True: receivers legally hold placeholders of any size, so the
+    # routing decision must not read the local tensor.
+    tp = g.p2p_for(arr, force=True)
+    if tp is not None:
+        from ray_tpu.util.collective import p2p
+        out = p2p.tree_broadcast(tp, g.next_seq(), arr, src_rank,
+                                 g.world_size)
+        return _writeback(tensor, out)
     out = g.one_to_all(tensor, src_rank)
     return _writeback(tensor, out)
 
@@ -258,7 +349,13 @@ def allgather(tensor_list, tensor, group_name: str = "default"):
     """Gather every rank's `tensor` into `tensor_list` (reference
     signature); also returns the list."""
     g = _group(group_name)
-    vals = g.exchange(tensor)
+    arr = np.asarray(tensor)
+    tp = g.p2p_for(arr)
+    if tp is not None:
+        from ray_tpu.util.collective import p2p
+        vals = p2p.ring_allgather(tp, g.next_seq(), arr, g.world_size)
+    else:
+        vals = g.exchange(tensor)
     if tensor_list is not None:
         tensor_list[:] = vals
     return vals

@@ -1,0 +1,200 @@
+"""P2P host collectives over the native object plane (no head on the path).
+
+Parity: the reference's GLOO groups move tensors peer-to-peer
+(`util/collective/collective_group/gloo_collective_group.py:184`); here the
+transport is each node's native peer server (`_native/peer_server.cpp`) —
+the same zero-copy arena pulls the object plane already uses.
+
+Protocol: collective payloads are published into the publisher's LOCAL
+shared-memory arena under DETERMINISTIC object ids
+(sha256(group | seq | tag | rank)[:16]) that every member derives without
+communication. A consumer polls `objxfer.fetch_from_peer` against the
+publisher node's peer port until the object appears, pulls it into its own
+arena (same-node ranks short-circuit on `store.contains`), reads it, and
+moves on. The head is involved ONLY at group setup (one KV exchange builds
+the rank -> peer-address table); steady-state ops cost ZERO head messages.
+
+Lifetime/cleanup: every op ends with tiny "fin" tokens from each rank's
+direct consumers (ring successor / tree children) — peer traffic, not head
+traffic — so when an op returns, everything the rank published has been
+consumed. The next op's `begin_op` then deletes the previous generation
+from the local arena. Authoritative copies are always rank-keyed (a tree
+node RE-publishes the payload under its own id for its children), so one
+rank's cleanup can never delete an object another same-node rank still
+serves.
+
+Topologies:
+- broadcast: binary tree rooted at src — O(log n) depth, one tensor per
+  link, so bandwidth stays flat as the world grows.
+- allreduce / allgather: bandwidth-optimal ring (reduce-scatter +
+  allgather: 2*(n-1)/n x tensor per link regardless of world size).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import pickle
+import time
+
+import numpy as np
+
+from ray_tpu.core.ids import ObjectID
+
+
+def _oid(group: str, seq: int, tag: str, rank: int) -> bytes:
+    h = hashlib.sha256(
+        f"p2pcoll|{group}|{seq}|{tag}|{rank}".encode()).digest()
+    return h[:16]
+
+
+class P2PTransport:
+    """Store/peer plumbing for one group member."""
+
+    def __init__(self, group: str, rank: int, addrs: list):
+        from ray_tpu.core.runtime import get_runtime
+        rt = get_runtime()
+        self.group = group
+        self.rank = rank
+        self.addrs = addrs           # rank -> (host, port) peer endpoint
+        self.store = rt.store
+        self._held: list[bytes] = []        # current op's oids (own + pulled)
+        self._last_gen: list[bytes] = []    # previous op's oids (lazy free)
+
+    def begin_op(self):
+        """Free the previous op's objects: its fin acks proved every direct
+        consumer read them before that op returned."""
+        for oid in self._last_gen:
+            try:
+                self.store.delete(ObjectID(oid))
+            except Exception:  # noqa: BLE001 — freeing is best effort
+                pass
+        self._last_gen = self._held
+        self._held = []
+
+    def publish(self, oid: bytes, value) -> None:
+        blob = pickle.dumps(np.asarray(value), protocol=5)
+        self.store.put_serialized(ObjectID(oid), blob)
+        self._held.append(oid)
+
+    def fetch(self, oid: bytes, src_rank: int, timeout: float = 300.0):
+        """Poll the publisher's node until the object exists, pull it into
+        the local arena, and deserialize. Same-node publishers (including
+        self) short-circuit on the shared arena."""
+        from ray_tpu.core import objxfer
+        deadline = time.monotonic() + timeout
+        delay = 0.0005
+        addr = self.addrs[src_rank]
+        ref = ObjectID(oid)
+        while True:
+            if self.store.contains(ref):
+                break
+            try:
+                if addr is not None and objxfer.fetch_from_peer(
+                        self.store, tuple(addr), oid):
+                    break
+            except OSError:
+                pass  # peer restarting / transient — keep polling
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"p2p collective fetch timed out on rank {src_rank} "
+                    f"({self.group})")
+            time.sleep(delay)
+            delay = min(delay * 2, 0.01)
+        found, blob = self.store.get_deserialized(ref, timeout=5.0)
+        if not found:
+            raise RuntimeError("p2p collective object vanished mid-read")
+        val = pickle.loads(blob)
+        if oid not in self._held:
+            # Pulled copies are transient caches: free with this gen.
+            self._held.append(oid)
+        return val
+
+    def finish(self, seq: int, consumers: list[int]):
+        """End-of-op handshake: tell producers I consumed (publish my fin)
+        and wait for my direct consumers' fins — after this returns, every
+        object this rank published may be freed at the next begin_op."""
+        self.publish(_oid(self.group, seq, "fin", self.rank), 0)
+        for c in consumers:
+            self.fetch(_oid(self.group, seq, "fin", c), c)
+
+    def destroy(self):
+        for oid in self._last_gen + self._held:
+            try:
+                self.store.delete(ObjectID(oid))
+            except Exception:  # noqa: BLE001
+                pass
+        self._last_gen, self._held = [], []
+
+
+def _tree_children(vrank: int, world: int) -> list[int]:
+    return [c for c in (2 * vrank + 1, 2 * vrank + 2) if c < world]
+
+
+def tree_broadcast(tp: P2PTransport, seq: int, value, src_rank: int,
+                   world: int):
+    """Binary-tree broadcast re-rooted at src (virtual rank 0 == src)."""
+    tp.begin_op()
+    vrank = (tp.rank - src_rank) % world
+    if vrank == 0:
+        out = np.asarray(value)
+    else:
+        parent_v = (vrank - 1) // 2
+        parent = (parent_v + src_rank) % world
+        out = np.asarray(tp.fetch(_oid(tp.group, seq, "bc", parent),
+                                  parent))
+    children = [(c + src_rank) % world for c in _tree_children(vrank, world)]
+    if children:
+        # Authoritative copy for MY children under MY id: rank-keyed
+        # ownership keeps same-node ranks' cleanups independent.
+        tp.publish(_oid(tp.group, seq, "bc", tp.rank), out)
+    tp.finish(seq, children)
+    return out
+
+
+def ring_allreduce(tp: P2PTransport, seq: int, value, world: int,
+                   reducer):
+    """Bandwidth-optimal ring: reduce-scatter then allgather."""
+    tp.begin_op()
+    arr = np.asarray(value)
+    if world == 1:
+        return arr
+    chunks = np.array_split(arr.reshape(-1), world)
+    acc = [c.copy() for c in chunks]
+    r = tp.rank
+    prev = (r - 1) % world
+    nxt = (r + 1) % world
+    # reduce-scatter: at step t publish the chunk that entered the ring at
+    # rank (r - t); pull the one that entered at (prev - t).
+    for t in range(world - 1):
+        tp.publish(_oid(tp.group, seq, f"rs{t}", r), acc[(r - t) % world])
+        inc = tp.fetch(_oid(tp.group, seq, f"rs{t}", prev), prev)
+        c = (prev - t) % world
+        acc[c] = reducer([acc[c], np.asarray(inc)])
+    # allgather: rank r owns the fully-reduced chunk (r + 1) % world.
+    for t in range(world - 1):
+        tp.publish(_oid(tp.group, seq, f"ag{t}", r), acc[(r + 1 - t) % world])
+        acc[(r - t) % world] = np.asarray(
+            tp.fetch(_oid(tp.group, seq, f"ag{t}", prev), prev))
+    tp.finish(seq, [nxt])
+    out = np.concatenate([np.asarray(c) for c in acc])
+    return out.reshape(arr.shape).astype(arr.dtype, copy=False)
+
+
+def ring_allgather(tp: P2PTransport, seq: int, value, world: int) -> list:
+    """Each rank's tensor visits every other rank once around the ring."""
+    tp.begin_op()
+    out: list = [None] * world
+    out[tp.rank] = np.asarray(value)
+    if world == 1:
+        return out
+    r = tp.rank
+    prev = (r - 1) % world
+    cur = out[r]
+    src = r
+    for t in range(world - 1):
+        tp.publish(_oid(tp.group, seq, f"g{t}", r), cur)
+        cur = np.asarray(tp.fetch(_oid(tp.group, seq, f"g{t}", prev), prev))
+        src = (src - 1) % world
+        out[src] = cur
+    tp.finish(seq, [(r + 1) % world])
+    return out

@@ -284,3 +284,53 @@ def test_dep_gated_actor_call_does_not_stall_direct_calls():
         assert final == list(range(10)) + ["gated"], final
     finally:
         c.shutdown()
+
+
+def test_p2p_collectives_bypass_head():
+    """Large-payload allreduce/broadcast/allgather ride the object plane
+    peer-to-peer (ring/tree over the native peer servers): after the
+    one-time rendezvous, an op costs ZERO head round-trips (VERDICT r2 #6
+    done-criterion: O(1) head messages per op, here 0)."""
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    for _ in range(3):
+        c.add_node(num_cpus=1)
+    c.wait_for_nodes(4)
+    try:
+        @ray_tpu.remote(num_cpus=1)
+        class M:
+            def __init__(self, rank, world):
+                from ray_tpu.util import collective as col
+                col.init_collective_group(world, rank, group_name="pg")
+                self.rank = rank
+                self.world = world
+
+            def run(self):
+                from ray_tpu.util import collective as col
+                from ray_tpu.util.collective.collective import _KV
+                n = (1 << 16) + 13  # ragged chunks exercise array_split
+                arr = np.full(n, self.rank + 1, np.float32)
+                # Warmup builds the p2p transport (the only KV use).
+                col.allreduce(arr.copy(), group_name="pg")
+                before = _KV.ops
+                red = col.allreduce(arr.copy(), group_name="pg")
+                bc = col.broadcast(
+                    np.full(n, 7.0 if self.rank == 0 else 0.0, np.float32),
+                    src_rank=0, group_name="pg")
+                gathered = col.allgather(None, arr, group_name="pg")
+                hops = _KV.ops - before
+                ok = (float(red[0]) == 6.0 and float(red[-1]) == 6.0
+                      and float(bc[0]) == 7.0 and float(bc[-1]) == 7.0
+                      and len(gathered) == self.world
+                      and all(float(g[0]) == i + 1
+                              for i, g in enumerate(gathered)))
+                return ok, hops
+
+        ms = [M.remote(r, 3) for r in range(3)]
+        out = ray_tpu.get([m.run.remote() for m in ms], timeout=120)
+        for ok, hops in out:
+            assert ok
+            assert hops == 0, f"p2p op touched the head {hops} times"
+    finally:
+        c.shutdown()

@@ -150,3 +150,4 @@ def test_errors(ray_start_regular):
         col.allreduce(np.zeros(1), group_name="nope")
     with pytest.raises(ValueError):
         col.init_collective_group(2, 5, group_name="bad")
+
