@@ -349,30 +349,38 @@ class NodeAgent:
             if self._order:
                 self._sweep_order_keys()
 
-    def _handle_head_msg(self, msg):
-        op = msg[0]
-        if op == "to_worker":
-            _, wid, inner = msg
-            w = self.workers.get(wid)
-            if w is not None:
-                if (inner[0] == "exec"
-                        and getattr(inner[1], "caller_seq", None) is not None):
-                    # Head-relayed actor call from a caller that also uses
-                    # the direct path: hold for per-caller order. A drop
-                    # (worker death while buffered) needs no handler — the
-                    # head replays its inflight specs on worker_death.
-                    def deliver(w=w, inner=inner):
-                        try:
-                            send_msg(w.sock, inner, w.send_lock)
-                        except OSError:
-                            pass
-
-                    self._exec_in_order(inner[1], wid, deliver)
-                    return
+    def _to_worker(self, wid: bytes, inner):
+        w = self.workers.get(wid)
+        if w is None:
+            return
+        if (inner[0] == "exec"
+                and getattr(inner[1], "caller_seq", None) is not None):
+            # Head-relayed actor call from a caller that also uses
+            # the direct path: hold for per-caller order. A drop
+            # (worker death while buffered) needs no handler — the
+            # head replays its inflight specs on worker_death.
+            def deliver(w=w, inner=inner):
                 try:
                     send_msg(w.sock, inner, w.send_lock)
                 except OSError:
                     pass
+
+            self._exec_in_order(inner[1], wid, deliver)
+            return
+        try:
+            send_msg(w.sock, inner, w.send_lock)
+        except OSError:
+            pass
+
+    def _handle_head_msg(self, msg):
+        op = msg[0]
+        if op == "to_worker":
+            self._to_worker(msg[1], msg[2])
+        elif op == "relay_batch":
+            # One head sendall fanning dispatches to several local workers
+            # (the head's per-node batching under many-agent load).
+            for wid, inner in msg[1]:
+                self._to_worker(wid, inner)
         elif op == "seq_skip":
             _, owner, aid, seq = msg
             self._skip_order_slot(owner, aid, seq)

@@ -215,23 +215,35 @@ def _create_for_write(store, oid: bytes, size: int, meta: bytes):
 
 
 def fetch_from_peer(store, addr, oid: bytes, timeout: float = 60.0,
-                    unsealed_wait_s: float = 5.0) -> bool:
+                    unsealed_wait_s: float = 5.0,
+                    absent_wait_s: float = 0.0) -> bool:
     """Pull one object from a peer's port into `store`. Returns success.
 
     A created-but-unsealed object at the source (reply 2) is retried on the
     same connection for up to `unsealed_wait_s` — a concurrent writer there
-    is about to seal it."""
+    is about to seal it. `absent_wait_s` > 0 also polls a missing object
+    (reply 0) on the SAME connection — the p2p collectives wait for a peer
+    that has not published yet, and a reconnect per poll would churn
+    thousands of throwaway TCP connections per op."""
     import time
     if store.contains(ObjectID(oid)):
         return True
     with socket.create_connection(tuple(addr), timeout=timeout) as s:
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        deadline = time.monotonic() + unsealed_wait_s
+        start = time.monotonic()
+        unsealed_deadline = start + unsealed_wait_s
+        absent_deadline = start + absent_wait_s
+        delay = 0.001
         while True:
             s.sendall(oid)
             ok = _recv_exact(s, 1)
-            if ok == b"\x02" and time.monotonic() < deadline:
+            now = time.monotonic()
+            if ok == b"\x02" and now < unsealed_deadline:
                 time.sleep(0.05)
+                continue
+            if ok == b"\x00" and now < absent_deadline:
+                time.sleep(delay)
+                delay = min(delay * 2, 0.025)
                 continue
             break
         if ok != b"\x01":

@@ -3197,7 +3197,10 @@ class Runtime:
         for spec, e in failures:
             self._fail_returns(spec, e)
         # Coalesce per-worker: one frame carries every spec headed to the
-        # same worker this pass (one sendall instead of K).
+        # same worker this pass; then per-NODE: one sendall carries every
+        # worker's frame headed to the same agent (the head's send syscalls
+        # are its hottest loop under many-agent load — a 16-agent profile
+        # put ~2/3 of head CPU in sendall before this batching).
         per_worker: dict = {}
         order: list = []
         for w, spec in dispatches:
@@ -3205,8 +3208,26 @@ class Runtime:
                 per_worker[w] = []
                 order.append(w)
             per_worker[w].append(spec)
+        per_conn: dict = {}
+        conn_order: list = []
         for w in order:
-            self._dispatch_many(w, per_worker[w])
+            msg = self._dispatch_many(w, per_worker[w], defer_remote=True)
+            if msg is None:
+                continue
+            conn = w.node_conn
+            if conn not in per_conn:
+                per_conn[conn] = []
+                conn_order.append(conn)
+            per_conn[conn].append((w.worker_id.binary(), msg))
+        for conn in conn_order:
+            pairs = per_conn[conn]
+            try:
+                if len(pairs) == 1:
+                    conn.send(("to_worker", pairs[0][0], pairs[0][1]))
+                else:
+                    conn.send(("relay_batch", pairs))
+            except OSError:
+                pass  # node death handling reroutes via heartbeat/EOF
         if self._steal_for_idle():
             self._schedule()
 
@@ -3343,8 +3364,15 @@ class Runtime:
     def _dispatch(self, w: WorkerHandle, spec: TaskSpec):
         self._dispatch_many(w, [spec])
 
-    def _dispatch_many(self, w: WorkerHandle, specs: list):
-        """Ship a run of specs to one worker as a single frame."""
+    def _dispatch_many(self, w: WorkerHandle, specs: list,
+                       defer_remote: bool = False):
+        """Ship a run of specs to one worker as a single frame.
+
+        defer_remote=True: for workers behind a node agent, RETURN the
+        worker-bound message instead of sending so the caller can pack
+        several workers' frames into one agent sendall (_schedule's
+        per-node batching). Local workers always send directly (None is
+        returned)."""
         frames = []
         for spec in specs:
             if spec.fn_id and spec.fn_id not in w.registered_fns:
@@ -3359,11 +3387,12 @@ class Runtime:
             self.task_events.record(spec.task_id, spec, "RUNNING")
             frames.append(("exec", spec))
         if not frames:
-            return
-        if len(frames) == 1:
-            w.send(frames[0])
-        else:
-            w.send(("batch", frames))
+            return None
+        msg = frames[0] if len(frames) == 1 else ("batch", frames)
+        if defer_remote and isinstance(w, RemoteWorkerHandle):
+            return msg
+        w.send(msg)
+        return None
 
     def _pop_assignment(self, w: WorkerHandle, task_id: bytes):
         """Remove a finished/failed task from the worker's in-flight queue.

@@ -14,14 +14,13 @@ arena (same-node ranks short-circuit on `store.contains`), reads it, and
 moves on. The head is involved ONLY at group setup (one KV exchange builds
 the rank -> peer-address table); steady-state ops cost ZERO head messages.
 
-Lifetime/cleanup: every op ends with tiny "fin" tokens from each rank's
-direct consumers (ring successor / tree children) — peer traffic, not head
-traffic — so when an op returns, everything the rank published has been
-consumed. The next op's `begin_op` then deletes the previous generation
-from the local arena. Authoritative copies are always rank-keyed (a tree
-node RE-publishes the payload under its own id for its children), so one
-rank's cleanup can never delete an object another same-node rank still
-serves.
+Lifetime/cleanup: every op ends with a tiny rank-0-rooted fin barrier
+(peer traffic, not head traffic), so when an op returns EVERY member has
+finished it; with two generations retained, `begin_op` can only ever
+delete objects from an op the whole group left behind. Authoritative
+copies are always rank-keyed (a tree node RE-publishes the payload under
+its own id for its children), and user-facing results are copied out of
+the arena at the API boundary.
 
 Topologies:
 - broadcast: binary tree rooted at src — O(log n) depth, one tensor per
@@ -33,7 +32,6 @@ Topologies:
 from __future__ import annotations
 
 import hashlib
-import pickle
 import time
 
 import numpy as np
@@ -72,17 +70,25 @@ class P2PTransport:
         self._held = []
 
     def publish(self, oid: bytes, value) -> None:
-        blob = pickle.dumps(np.asarray(value), protocol=5)
-        self.store.put_serialized(ObjectID(oid), blob)
+        # Straight into the arena: numpy buffers ride pickle-5 out-of-band
+        # through put_serialized, so the payload is written once (no
+        # intermediate blob copy) and peers pull it zero-copy.
+        self.store.put_serialized(ObjectID(oid),
+                                  np.ascontiguousarray(value))
         self._held.append(oid)
 
     def fetch(self, oid: bytes, src_rank: int, timeout: float = 300.0):
         """Poll the publisher's node until the object exists, pull it into
         the local arena, and deserialize. Same-node publishers (including
-        self) short-circuit on the shared arena."""
+        self) short-circuit on the shared arena. The poll rides ONE
+        persistent peer connection per attempt (absent_wait_s), not a
+        reconnect per probe.
+
+        The returned array may alias the shared arena (zero-copy read);
+        internal consumers reduce out of it immediately, and user-facing
+        results are copied at the API boundary."""
         from ray_tpu.core import objxfer
         deadline = time.monotonic() + timeout
-        delay = 0.0005
         addr = self.addrs[src_rank]
         ref = ObjectID(oid)
         while True:
@@ -90,32 +96,40 @@ class P2PTransport:
                 break
             try:
                 if addr is not None and objxfer.fetch_from_peer(
-                        self.store, tuple(addr), oid):
+                        self.store, tuple(addr), oid,
+                        absent_wait_s=min(
+                            2.0, max(0.1,
+                                     deadline - time.monotonic()))):
                     break
             except OSError:
-                pass  # peer restarting / transient — keep polling
+                time.sleep(0.005)  # peer restarting — reconnect shortly
             if time.monotonic() > deadline:
                 raise TimeoutError(
                     f"p2p collective fetch timed out on rank {src_rank} "
                     f"({self.group})")
-            time.sleep(delay)
-            delay = min(delay * 2, 0.01)
-        found, blob = self.store.get_deserialized(ref, timeout=5.0)
+        found, val = self.store.get_deserialized(ref, timeout=5.0)
         if not found:
             raise RuntimeError("p2p collective object vanished mid-read")
-        val = pickle.loads(blob)
         if oid not in self._held:
             # Pulled copies are transient caches: free with this gen.
             self._held.append(oid)
         return val
 
-    def finish(self, seq: int, consumers: list[int]):
-        """End-of-op handshake: tell producers I consumed (publish my fin)
-        and wait for my direct consumers' fins — after this returns, every
-        object this rank published may be freed at the next begin_op."""
+    def finish(self, seq: int):
+        """End-of-op barrier, rank-0-rooted: everyone publishes a fin;
+        rank 0 collects all fins and publishes an all-done token; everyone
+        waits for it. After this returns, EVERY rank has finished the op,
+        so the op's objects are safely deletable one op later (two
+        generations are retained regardless). Per-rank cost is O(1) tiny
+        messages (rank 0 pays O(world) tiny fetches); no head involvement."""
         self.publish(_oid(self.group, seq, "fin", self.rank), 0)
-        for c in consumers:
-            self.fetch(_oid(self.group, seq, "fin", c), c)
+        world = len(self.addrs)
+        if self.rank == 0:
+            for r in range(1, world):
+                self.fetch(_oid(self.group, seq, "fin", r), r)
+            self.publish(_oid(self.group, seq, "alldone", 0), 0)
+        else:
+            self.fetch(_oid(self.group, seq, "alldone", 0), 0)
 
     def destroy(self):
         for oid in self._last_gen + self._held:
@@ -147,8 +161,10 @@ def tree_broadcast(tp: P2PTransport, seq: int, value, src_rank: int,
         # Authoritative copy for MY children under MY id: rank-keyed
         # ownership keeps same-node ranks' cleanups independent.
         tp.publish(_oid(tp.group, seq, "bc", tp.rank), out)
-    tp.finish(seq, children)
-    return out
+    tp.finish(seq)
+    # Boundary copy: the fetched array may alias the shared arena, whose
+    # object is freed an op later — the caller must own its result.
+    return np.array(out, copy=True)
 
 
 def ring_allreduce(tp: P2PTransport, seq: int, value, world: int,
@@ -175,7 +191,7 @@ def ring_allreduce(tp: P2PTransport, seq: int, value, world: int,
         tp.publish(_oid(tp.group, seq, f"ag{t}", r), acc[(r + 1 - t) % world])
         acc[(r - t) % world] = np.asarray(
             tp.fetch(_oid(tp.group, seq, f"ag{t}", prev), prev))
-    tp.finish(seq, [nxt])
+    tp.finish(seq)
     out = np.concatenate([np.asarray(c) for c in acc])
     return out.reshape(arr.shape).astype(arr.dtype, copy=False)
 
@@ -196,5 +212,6 @@ def ring_allgather(tp: P2PTransport, seq: int, value, world: int) -> list:
         cur = np.asarray(tp.fetch(_oid(tp.group, seq, f"g{t}", prev), prev))
         src = (src - 1) % world
         out[src] = cur
-    tp.finish(seq, [(r + 1) % world])
-    return out
+    tp.finish(seq)
+    # Boundary copies: gathered entries may alias the shared arena.
+    return [np.array(x, copy=True) for x in out]

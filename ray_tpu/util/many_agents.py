@@ -1,0 +1,51 @@
+"""Many-agent scheduling workload, shared by the bench and the test suite.
+
+One definition of the 16-agent fan-out (parity in spirit:
+`release/benchmarks/distributed/test_many_tasks.py`) so bench.py's metric
+and tests/test_cluster.py's correctness gate can never drift apart.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def run_many_agents(n_agents: int = 16, n_tasks: int = 400,
+                    spawn_timeout: float = 240.0) -> dict:
+    """Spin `n_agents` node agents on this machine, fan `n_tasks` trivial
+    tasks across them, and return {'rate': tasks/s, 'nodes_alive': int,
+    'nodes_used': int, 'correct': bool}. Caller owns no cluster before or
+    after (shuts down on exit)."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(initialize_head=True,
+                head_node_args={"num_cpus": 1,
+                                "object_store_memory": 64 << 20})
+    for _ in range(n_agents):
+        c.add_node(num_cpus=1, object_store_memory=32 << 20)
+    c.wait_for_nodes(n_agents + 1, timeout=spawn_timeout)
+    try:
+        @ray_tpu.remote(num_cpus=1)
+        def f(x):
+            return (x + 1, ray_tpu.get_node_id())
+
+        # Warm every node's pool before the clock starts.
+        ray_tpu.get([f.remote(i) for i in range(2 * n_agents)],
+                    timeout=spawn_timeout)
+        t0 = time.perf_counter()
+        out = ray_tpu.get([f.remote(i) for i in range(n_tasks)],
+                          timeout=300)
+        rate = n_tasks / (time.perf_counter() - t0)
+        from ray_tpu.core.runtime import get_runtime
+        rt = get_runtime()
+        return {
+            "rate": rate,
+            "nodes_alive": sum(1 for n in rt.nodes.values()
+                               if n.state == "ALIVE"),
+            "nodes_used": len({nid for _v, nid in out}),
+            "correct": [v for v, _nid in out] == list(
+                range(1, n_tasks + 1)),
+        }
+    finally:
+        c.shutdown()

@@ -334,3 +334,20 @@ def test_p2p_collectives_bypass_head():
             assert hops == 0, f"p2p op touched the head {hops} times"
     finally:
         c.shutdown()
+
+
+def test_sixteen_agent_scheduling():
+    """Many-agent scalability evidence (VERDICT r2 #9): 16 node agents on
+    one box, tasks spread across all of them, head-loop dispatch batched
+    per node. Correctness and fleet liveness are hard asserts; throughput
+    is reported but gated only in bench.py (a wall-clock assert here would
+    flake on loaded hosts — every process shares this machine's CPUs)."""
+    from ray_tpu.util.many_agents import run_many_agents
+
+    res = run_many_agents(n_agents=16, n_tasks=400)
+    print(f"16-agent scheduling: {res['rate']:.0f} tasks/s "
+          f"(reference many_nodes baseline: 215)")
+    assert res["correct"]
+    assert res["nodes_used"] >= 8, f"only {res['nodes_used']} nodes used"
+    assert res["nodes_alive"] >= 16, (
+        f"only {res['nodes_alive']}/17 nodes alive under load")
