@@ -29,6 +29,8 @@ from ray_tpu.data.datasource import (  # noqa: F401
     read_numpy,
     read_parquet,
     read_text,
+    read_tfrecord,
+    read_webdataset,
 )
 
 __all__ = [
@@ -36,5 +38,6 @@ __all__ = [
     "range", "from_items", "from_pandas", "from_numpy", "from_arrow",
     "read_parquet", "read_csv", "read_json", "read_text",
     "read_binary_files", "read_numpy", "read_images",
+    "read_tfrecord", "read_webdataset",
     "from_huggingface", "from_torch", "decode_image",
 ]

@@ -310,13 +310,17 @@ class Dataset:
     def split(self, n: int, *, equal: bool = False) -> list["Dataset"]:
         refs = list(self.iter_internal())
         if equal:
-            # Exact equal-row shards: lockstep SPMD consumers
-            # (streaming_split in Train) need identical iteration counts,
-            # so boundaries slice through blocks where needed.
+            # EXACT equal-row shards: lockstep SPMD consumers
+            # (streaming_split in Train) need identical iteration counts
+            # per rank — a one-row-ragged shard hangs the epoch-end
+            # collective. Like the reference's equal split, the remainder
+            # rows (total % n) are dropped; boundaries slice through
+            # blocks where needed.
             total = sum(m.num_rows for _b, m in refs)
-            cuts = [total * i // n for i in _brange(1, n)]
+            per = total // n
+            cuts = [per * i for i in _brange(1, n + 1)]
             from ray_tpu.data.execution import split_refs_at
-            shards = split_refs_at(refs, cuts)
+            shards = split_refs_at(refs, cuts)[:n]  # [n] = dropped tail
         else:
             shards = [[] for _ in _brange(n)]
             for i, pair in enumerate(refs):
@@ -411,6 +415,11 @@ class Dataset:
 
     def write_json(self, path: str) -> None:
         self._write(path, "json")
+
+    def write_tfrecord(self, path: str) -> None:
+        """One TFRecord shard per block; rows become tf.train.Examples
+        (dependency-free codec, readable by any TF input pipeline)."""
+        self._write(path, "tfrecord")
 
     def _write(self, path: str, fmt: str) -> None:
         import os

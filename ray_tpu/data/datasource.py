@@ -106,9 +106,90 @@ def write_block_task(block, path: str, index: int, fmt: str) -> str:
     elif fmt == "json":
         BlockAccessor.of(t).to_pandas().to_json(
             out, orient="records", lines=True)
+    elif fmt == "tfrecord":
+        from ray_tpu.data import tfrecord as tfr
+        rows = t.to_pylist()
+        tfr.write_records(
+            out, (tfr.encode_example(
+                {k: v for k, v in row.items() if v is not None})
+                for row in rows))
     else:
         raise ValueError(f"unknown write format {fmt}")
     return out
+
+
+def read_tfrecord(paths, *, verify_crc: bool = True,
+                  lists: bool | None = None, **_kw) -> Dataset:
+    """TFRecord files of tf.train.Example rows (parity:
+    data/_internal/datasource/tfrecords_datasource.py) — the binary
+    streaming format TPU input pipelines feed from. Dependency-free codec
+    (`ray_tpu/data/tfrecord.py`); one read task per shard file.
+
+    Column shapes: the Example format cannot distinguish a scalar from a
+    one-element list, so `lists=None` (default) infers PER FILE
+    (all-length-1 -> scalars, else lists). Variable-length features whose
+    lengths differ across shard files should pass `lists=True` for a
+    stable schema; `lists=False` forces scalars (first element)."""
+    from ray_tpu.data import tfrecord as tfr
+
+    def one(f: str) -> pa.Table:
+        rows = [tfr.parse_example(rec)
+                for rec in tfr.read_records(f, verify=verify_crc)]
+        if not rows:
+            return pa.table({})
+        names: list = []
+        for r in rows:  # union, first-seen order: no silent column loss
+            for name in r:
+                if name not in names:
+                    names.append(name)
+        cols: dict = {}
+        for name in names:
+            vals = [r.get(name) for r in rows]
+            as_list = (lists if lists is not None
+                       else not all(v is not None and len(v) == 1
+                                    for v in vals))
+            if as_list:
+                cols[name] = pa.array(vals)
+            else:
+                cols[name] = pa.array(
+                    [None if not v else v[0] for v in vals])
+        return pa.table(cols)
+
+    return _make_read(paths, one, "ReadTFRecord")
+
+
+def read_webdataset(paths, **_kw) -> Dataset:
+    """WebDataset tar shards (parity:
+    data/_internal/datasource/webdataset_datasource.py): files sharing a
+    basename form one sample; each extension becomes a bytes column plus
+    the sample's '__key__'. One read task per shard tar."""
+    import tarfile
+
+    def one(f: str) -> pa.Table:
+        samples: dict[str, dict] = {}
+        order: list[str] = []
+        with tarfile.open(f) as tf:
+            for m in tf.getmembers():
+                if not m.isfile():
+                    continue
+                # WebDataset keys are the PATH up to the basename's first
+                # dot — same-named files in different subdirectories are
+                # distinct samples.
+                d = os.path.dirname(m.name)
+                stem, _, ext = os.path.basename(m.name).partition(".")
+                key = f"{d}/{stem}" if d else stem
+                if key not in samples:
+                    samples[key] = {}
+                    order.append(key)
+                samples[key][ext] = tf.extractfile(m).read()
+        exts = sorted({e for s in samples.values() for e in s})
+        cols = {"__key__": pa.array(order)}
+        for e in exts:
+            cols[e] = pa.array([samples[k].get(e) for k in order],
+                               type=pa.binary())
+        return pa.table(cols)
+
+    return _make_read(paths, one, "ReadWebDataset")
 
 
 def read_images(paths, *, include_paths: bool = False, mode: str | None = None,

@@ -427,11 +427,14 @@ class JaxTrainer:
             path=storage_dir, metrics_history=history)
 
     def _split_datasets(self, n: int):
-        """Per-worker dataset shards (parity: get_dataset_shard/streaming_split)."""
+        """Per-worker dataset shards (parity: get_dataset_shard/
+        streaming_split). Equal-row shards: lockstep SPMD loops need
+        identical iteration counts per rank (streaming_split(equal=True)
+        semantics — a ragged shard would hang a collective at epoch end)."""
         shards = [dict() for _ in range(n)]
         for name, ds in self.datasets.items():
             if hasattr(ds, "split"):
-                parts = ds.split(n)
+                parts = ds.split(n, equal=True)
             else:
                 parts = [ds] * n
             for i in range(n):

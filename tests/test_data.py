@@ -227,9 +227,11 @@ def test_shuffle_varies_across_epochs(ray_start_regular):
 
 
 def test_equal_split_exact(ray_start_regular):
+    # equal=True means EXACTLY equal: the remainder row is dropped
+    # (lockstep SPMD consumers need identical iteration counts).
     shards = rd.range(10).split(3, equal=True)
     counts = sorted(s.count() for s in shards)
-    assert counts == [3, 3, 4]
+    assert counts == [3, 3, 3]
     its = rd.range(16).streaming_split(2, equal=True)
     assert [it.count() for it in its] == [8, 8]
 
@@ -355,3 +357,127 @@ def test_memory_budget_backpressure_no_deadlock(ray_start_regular):
         assert budget.peak <= budget.limit + 2 * (1 << 20), budget.peak
     finally:
         ctx.memory_budget_bytes = old
+
+
+def test_tfrecord_roundtrip(ray_start_regular, tmp_path):
+    """TFRecord write -> read round trip through the dependency-free
+    Example codec (parity: tfrecords_datasource.py), with the crc32c
+    table validated against the spec's known vector."""
+    import ray_tpu.data as rd
+    from ray_tpu.data import tfrecord as tfr
+
+    # RFC 3720 check value for crc32c("123456789").
+    assert tfr._crc32c(b"123456789") == 0xE3069283
+
+    rows = [{"idx": i, "name": f"row-{i}", "score": float(i) / 2,
+             "vec": [i, i + 1, i + 2]} for i in range(20)]
+    ds = rd.from_items(rows)
+    out = str(tmp_path / "tfr")
+    ds.write_tfrecord(out)
+
+    back = rd.read_tfrecord(out).take_all()
+    back.sort(key=lambda r: r["idx"])
+    for i, r in enumerate(back):
+        assert r["idx"] == i
+        assert r["name"] == f"row-{i}".encode()  # Example strings = bytes
+        assert abs(r["score"] - i / 2) < 1e-6
+        assert list(r["vec"]) == [i, i + 1, i + 2]
+
+
+def test_tfrecord_crc_detects_corruption(ray_start_regular, tmp_path):
+    import pytest as _pytest
+
+    import ray_tpu.data as rd
+    from ray_tpu.data import tfrecord as tfr
+
+    path = str(tmp_path / "one.tfrecord")
+    tfr.write_records(path, [tfr.encode_example({"x": 1})])
+    raw = bytearray(open(path, "rb").read())
+    raw[-1] ^= 0xFF  # flip a crc byte (payload itself stays parseable)
+    open(path, "wb").write(bytes(raw))
+    with _pytest.raises(Exception):
+        rd.read_tfrecord(path).take_all()
+    # verify_crc=False reads the (corrupt) record without checking.
+    assert len(rd.read_tfrecord(path, verify_crc=False).take_all()) == 1
+
+
+def test_webdataset_roundtrip(ray_start_regular, tmp_path):
+    """WebDataset tar shards: basename-grouped files become one row per
+    sample (parity: webdataset_datasource.py)."""
+    import tarfile
+
+    import ray_tpu.data as rd
+
+    shard = tmp_path / "shard-000.tar"
+    with tarfile.open(shard, "w") as tf:
+        for i in range(6):
+            for ext, payload in (("img", b"IMG%d" % i),
+                                 ("cls", str(i % 3).encode())):
+                data = payload
+                info = tarfile.TarInfo(name=f"sample{i:04d}.{ext}")
+                info.size = len(data)
+                import io
+                tf.addfile(info, io.BytesIO(data))
+    ds = rd.read_webdataset(str(shard))
+    rows = ds.take_all()
+    assert len(rows) == 6
+    rows.sort(key=lambda r: r["__key__"])
+    for i, r in enumerate(rows):
+        assert r["__key__"] == f"sample{i:04d}"
+        assert r["img"] == b"IMG%d" % i
+        assert int(r["cls"]) == i % 3
+
+
+def test_streaming_split_feeds_two_trainer_consumers(ray_start_regular,
+                                                     tmp_path):
+    """VERDICT r2 #10 done-criterion: a binary streaming source
+    (tfrecord) feeds TWO concurrent JaxTrainer workers through equal
+    streaming shards under a shared Data memory budget; together they see
+    every row exactly once."""
+    import ray_tpu.data as rd
+    from ray_tpu.data.context import DataContext
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    rows = [{"idx": i, "x": float(i)} for i in range(40)]
+    src = str(tmp_path / "train_tfr")
+    rd.from_items(rows).write_tfrecord(src)
+
+    DataContext.get_current().memory_budget_bytes = 1 << 20
+
+    seen_dir = tmp_path / "seen"
+    seen_dir.mkdir()
+
+    def loop(config):
+        from ray_tpu.train import session
+        shard = session.get_dataset_shard("train")
+        seen = [int(r["idx"]) for r in shard.iter_rows()]
+        rank = session.get_world_rank()
+        # Equal shards: a ragged split would desync SPMD loops.
+        assert len(seen) == 20, f"rank {rank} saw {len(seen)} rows"
+        with open(f"{config['seen_dir']}/rank{rank}.txt", "w") as f:
+            f.write(",".join(map(str, seen)))
+        session.report({"n": len(seen)})
+
+    trainer = JaxTrainer(
+        loop,
+        train_loop_config={"seen_dir": str(seen_dir)},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="tfr", storage_path=str(tmp_path)),
+        datasets={"train": rd.read_tfrecord(src)})
+    result = trainer.fit()
+    assert result.error is None
+    # The two concurrent consumers together saw every row exactly once.
+    seen_all = []
+    for f in sorted(seen_dir.iterdir()):
+        seen_all.extend(int(x) for x in f.read_text().split(","))
+    assert sorted(seen_all) == list(range(40))
+
+
+def test_equal_split_truncates_ragged_remainder(ray_start_regular):
+    """equal=True must give EXACTLY identical shard sizes (the remainder
+    is dropped, like the reference's equal streaming split) — a
+    one-row-ragged shard would hang a lockstep SPMD epoch."""
+    import ray_tpu.data as rd
+    parts = rd.range(41).split(2, equal=True)
+    counts = [p.count() for p in parts]
+    assert counts == [20, 20], counts
