@@ -1,0 +1,194 @@
+#include "raytpu_client.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+
+namespace raytpu_client {
+
+namespace {
+
+bool SendAll(int fd, const char* data, size_t n) {
+  while (n) {
+    ssize_t w = ::send(fd, data, n, 0);
+    if (w <= 0) return false;
+    data += w;
+    n -= w;
+  }
+  return true;
+}
+
+bool RecvAll(int fd, char* data, size_t n) {
+  while (n) {
+    ssize_t r = ::recv(fd, data, n, 0);
+    if (r <= 0) return false;
+    data += r;
+    n -= r;
+  }
+  return true;
+}
+
+}  // namespace
+
+Client::~Client() {
+  if (fd_ >= 0) ::close(fd_);
+}
+
+bool Client::Connect(const std::string& host, int port,
+                     const std::string& client_name) {
+  struct addrinfo hints = {};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  if (getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints,
+                  &res) != 0 || !res) {
+    error_ = "resolve failed";
+    return false;
+  }
+  fd_ = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  bool ok = fd_ >= 0 && ::connect(fd_, res->ai_addr, res->ai_addrlen) == 0;
+  freeaddrinfo(res);
+  if (!ok) {
+    error_ = "connect failed";
+    return false;
+  }
+  int one = 1;
+  setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+
+  raytpu::ClientRequest req;
+  auto* init = req.mutable_init();
+  init->set_client_name(client_name);
+  init->set_client_language("cpp");
+  raytpu::ClientReply reply;
+  if (!Rpc(&req, &reply)) return false;
+  for (const auto& kv : reply.init().cluster_resources())
+    resources_[kv.first] = kv.second;
+  return true;
+}
+
+bool Client::Rpc(raytpu::ClientRequest* req, raytpu::ClientReply* reply) {
+  req->set_req_id(next_req_id_++);
+  std::string body;
+  if (!req->SerializeToString(&body)) {
+    error_ = "serialize failed";
+    return false;
+  }
+  uint32_t len = body.size();
+  char hdr[4];
+  memcpy(hdr, &len, 4);  // little-endian hosts only (x86/arm)
+  if (!SendAll(fd_, hdr, 4) || !SendAll(fd_, body.data(), body.size())) {
+    error_ = "send failed";
+    return false;
+  }
+  if (!RecvAll(fd_, hdr, 4)) {
+    error_ = "recv failed";
+    return false;
+  }
+  memcpy(&len, hdr, 4);
+  std::string rbody(len, '\0');
+  if (!RecvAll(fd_, rbody.data(), len)) {
+    error_ = "recv failed";
+    return false;
+  }
+  if (!reply->ParseFromString(rbody)) {
+    error_ = "parse failed";
+    return false;
+  }
+  if (!reply->error().empty()) {
+    error_ = reply->error();
+    return false;
+  }
+  return true;
+}
+
+raytpu::Value Client::I64(int64_t v) {
+  raytpu::Value out;
+  out.set_format("i64");
+  out.set_data(std::string(reinterpret_cast<const char*>(&v), 8));
+  return out;
+}
+
+raytpu::Value Client::F64(double v) {
+  raytpu::Value out;
+  out.set_format("f64");
+  out.set_data(std::string(reinterpret_cast<const char*>(&v), 8));
+  return out;
+}
+
+raytpu::Value Client::Utf8(const std::string& s) {
+  raytpu::Value out;
+  out.set_format("utf8");
+  out.set_data(s);
+  return out;
+}
+
+raytpu::Value Client::Raw(const std::string& data) {
+  raytpu::Value out;
+  out.set_format("raw");
+  out.set_data(data);
+  return out;
+}
+
+std::string Client::Put(const raytpu::Value& value) {
+  raytpu::ClientRequest req;
+  req.mutable_put()->mutable_value()->CopyFrom(value);
+  raytpu::ClientReply reply;
+  if (!Rpc(&req, &reply)) return "";
+  return reply.put().object_id();
+}
+
+std::string Client::PutRaw(const std::string& d) { return Put(Raw(d)); }
+std::string Client::PutI64(int64_t v) { return Put(I64(v)); }
+std::string Client::PutF64(double v) { return Put(F64(v)); }
+std::string Client::PutUtf8(const std::string& s) { return Put(Utf8(s)); }
+
+raytpu::Value Client::Get(const std::string& object_id, double timeout_s,
+                          bool* found) {
+  raytpu::ClientRequest req;
+  req.mutable_get()->set_object_id(object_id);
+  req.mutable_get()->set_timeout_s(timeout_s);
+  raytpu::ClientReply reply;
+  if (!Rpc(&req, &reply)) {
+    if (found) *found = false;
+    return raytpu::Value();
+  }
+  if (found) *found = reply.get().found();
+  return reply.get().value();
+}
+
+std::vector<std::string> Client::Submit(
+    const std::string& fn_name, const std::vector<raytpu::Value>& args,
+    int num_returns) {
+  raytpu::ClientRequest req;
+  auto* sub = req.mutable_submit();
+  sub->set_fn_name(fn_name);
+  sub->set_num_returns(num_returns);
+  for (const auto& a : args) sub->add_args()->mutable_value()->CopyFrom(a);
+  raytpu::ClientReply reply;
+  if (!Rpc(&req, &reply)) return {};
+  return {reply.submit().return_ids().begin(),
+          reply.submit().return_ids().end()};
+}
+
+bool Client::KvPut(const std::string& key, const std::string& value) {
+  raytpu::ClientRequest req;
+  req.mutable_kv_put()->set_key(key);
+  req.mutable_kv_put()->set_value(value);
+  raytpu::ClientReply reply;
+  return Rpc(&req, &reply);
+}
+
+bool Client::KvGet(const std::string& key, std::string* value) {
+  raytpu::ClientRequest req;
+  req.mutable_kv_get()->set_key(key);
+  raytpu::ClientReply reply;
+  if (!Rpc(&req, &reply) || !reply.kv_get().found()) return false;
+  *value = reply.kv_get().value();
+  return true;
+}
+
+}  // namespace raytpu_client

@@ -1,0 +1,69 @@
+// Minimal C++ frontend for ray_tpu (parity: the reference's standalone C++
+// API, cpp/include/ray/api.h — Init/Put/Get/Task). Speaks the protobuf
+// client plane defined in ray_tpu/protocol/raytpu.proto over the head's
+// dedicated client port: 4-byte LE length + raytpu.ClientRequest frames.
+//
+// Cross-language tasks address Python functions by importable name
+// ("module.fn"); arguments and results are tagged raytpu.Value payloads,
+// so scalars/strings/bytes round-trip without any Python on this side.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <string>
+#include <vector>
+
+#include "raytpu.pb.h"
+
+namespace raytpu_client {
+
+class Client {
+ public:
+  ~Client();
+
+  // Connect + Init handshake. Returns false on any failure (see error()).
+  bool Connect(const std::string& host, int port,
+               const std::string& client_name = "cpp");
+
+  // Store a tagged value; returns the object id ("" on failure).
+  std::string Put(const raytpu::Value& value);
+  std::string PutRaw(const std::string& data);
+  std::string PutI64(int64_t v);
+  std::string PutF64(double v);
+  std::string PutUtf8(const std::string& s);
+
+  // Fetch an object's value. found=false if the wait timed out/errored.
+  raytpu::Value Get(const std::string& object_id, double timeout_s,
+                    bool* found);
+
+  // Submit a Python function by importable name with tagged-value args;
+  // returns the result object ids (empty on failure).
+  std::vector<std::string> Submit(const std::string& fn_name,
+                                  const std::vector<raytpu::Value>& args,
+                                  int num_returns = 1);
+
+  // KV convenience (the head's internal KV).
+  bool KvPut(const std::string& key, const std::string& value);
+  bool KvGet(const std::string& key, std::string* value);
+
+  const std::map<std::string, double>& cluster_resources() const {
+    return resources_;
+  }
+  const std::string& error() const { return error_; }
+
+  // Tagged-value helpers.
+  static raytpu::Value I64(int64_t v);
+  static raytpu::Value F64(double v);
+  static raytpu::Value Utf8(const std::string& s);
+  static raytpu::Value Raw(const std::string& data);
+
+ private:
+  bool Rpc(raytpu::ClientRequest* req, raytpu::ClientReply* reply);
+
+  int fd_ = -1;
+  uint64_t next_req_id_ = 1;
+  std::map<std::string, double> resources_;
+  std::string error_;
+};
+
+}  // namespace raytpu_client

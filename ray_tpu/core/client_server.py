@@ -1,0 +1,196 @@
+"""Protobuf client plane: the port non-Python frontends connect to.
+
+Parity: the reference's Ray Client server (`python/ray/util/client/server/`
+speaking `src/ray/protobuf/ray_client.proto`) and the role of the C++/Java
+frontends' connection to the cluster. Framing: 4-byte little-endian length
++ `raytpu.ClientRequest`; replies mirror with `raytpu.ClientReply`. One
+thread per connection; requests on a connection run sequentially (a client
+wanting parallelism opens more connections).
+
+Cross-language tasks: `SubmitRequest` addresses a PYTHON function by
+importable name ("pkg.module.fn", parity: the reference's cross-language
+function descriptors); args arrive as tagged Values, decoded head-side, and
+the task runs through the normal scheduler as `_xlang_call(fn_name, *args)`
+on any Python worker. Results flow back as tagged Values (scalars/str/bytes
+stay language-neutral; anything else is pickled and opaque to non-Python
+readers).
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import socket
+import struct
+import threading
+
+from ray_tpu.core import proto_wire, serialization
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.protocol import raytpu_pb2 as pb
+
+_LEN = struct.Struct("<I")
+
+
+def _xlang_call(fn_name: str, *args):
+    """Executed on a worker: resolve `pkg.module.fn` and call it."""
+    module, _, attr = fn_name.rpartition(".")
+    if not module:
+        raise ValueError(
+            f"cross-language function name {fn_name!r} must be "
+            f"'module.function'")
+    fn = getattr(importlib.import_module(module), attr)
+    return fn(*args)
+
+
+class ClientProtoServer:
+    """Accepts protobuf frontends on its own port (like the reference's
+    dedicated Ray Client port)."""
+
+    def __init__(self, runtime, host: str):
+        self.rt = runtime
+        self.srv = socket.socket()
+        self.srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.srv.bind((host, 0))
+        self.srv.listen(16)
+        self.addr = (host, self.srv.getsockname()[1])
+        self._stop = False
+        self._xlang_fn_id = None
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="rtpu-proto-clients").start()
+
+    def close(self):
+        self._stop = True
+        try:
+            self.srv.close()
+        except OSError:
+            pass
+
+    # ---------------- plumbing ----------------
+
+    def _accept_loop(self):
+        while not self._stop:
+            try:
+                conn, _ = self.srv.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket):
+        try:
+            while True:
+                hdr = self._recv(conn, _LEN.size)
+                if hdr is None:
+                    return
+                (n,) = _LEN.unpack(hdr)
+                body = self._recv(conn, n)
+                if body is None:
+                    return
+                req = pb.ClientRequest()
+                req.ParseFromString(body)
+                reply = pb.ClientReply(req_id=req.req_id)
+                try:
+                    self._handle(req, reply)
+                except Exception as e:  # noqa: BLE001 — ship to client
+                    reply.error = f"{type(e).__name__}: {e}"
+                out = reply.SerializeToString()
+                conn.sendall(_LEN.pack(len(out)) + out)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def _recv(conn, n):
+        chunks = []
+        while n:
+            try:
+                c = conn.recv(n)
+            except OSError:
+                return None
+            if not c:
+                return None
+            chunks.append(c)
+            n -= len(c)
+        return b"".join(chunks)
+
+    # ---------------- handlers ----------------
+
+    def _handle(self, req: pb.ClientRequest, reply: pb.ClientReply):
+        which = req.WhichOneof("req")
+        rt = self.rt
+        if which == "init":
+            reply.init.session_id = os.urandom(8)
+            reply.init.version = "ray_tpu-0.3"
+            for k, v in rt.cluster_resources().items():
+                reply.init.cluster_resources[k] = float(v)
+        elif which == "put":
+            value = proto_wire.decode_value(req.put.value)
+            oid = ObjectID.from_random()
+            rt.put_in_store(oid, value)
+            rt.directory.put(oid.binary(), ("shm", {rt.head_node_id}))
+            reply.put.object_id = oid.binary()
+        elif which == "get":
+            timeout = req.get.timeout_s or None
+            ref = ObjectRef(ObjectID(req.get.object_id), _add_ref=False)
+            value = rt._get_one(ref, timeout=timeout)
+            reply.get.value.CopyFrom(proto_wire.encode_value(value))
+            reply.get.found = True
+        elif which == "submit":
+            self._submit(req.submit, reply)
+        elif which == "wait":
+            ready, not_ready = rt._wait_oids(
+                list(req.wait.object_ids), req.wait.num_returns or 1,
+                req.wait.timeout_s or None)
+            reply.wait.ready.extend(ready)
+            reply.wait.not_ready.extend(not_ready)
+        elif which == "kv_put":
+            with rt.lock:
+                rt.kv[req.kv_put.key] = req.kv_put.value
+            reply.kv_put.ok = True
+        elif which == "kv_get":
+            with rt.lock:
+                v = rt.kv.get(req.kv_get.key)
+            reply.kv_get.found = v is not None
+            reply.kv_get.value = v or b""
+        else:
+            raise ValueError(f"unknown client request {which!r}")
+
+    def _submit(self, sub: pb.SubmitRequest, reply: pb.ClientReply):
+        from ray_tpu.core.task import TaskSpec
+        rt = self.rt
+        args = []
+        for a in sub.args:
+            if a.WhichOneof("arg") == "object_id":
+                args.append(ObjectRef(ObjectID(a.object_id),
+                                      _add_ref=False))
+            else:
+                args.append(proto_wire.decode_value(a.value))
+        if self._xlang_fn_id is None:
+            fn_id, blob = serialization.serialize_function(_xlang_call)
+            rt.export_function(fn_id, blob)
+            self._xlang_fn_id = fn_id
+        payload, buffers, refs = serialization.serialize_args(
+            [sub.fn_name] + args, {})
+        num_returns = sub.num_returns or 1
+        rnd = os.urandom(16 + 16 * num_returns)
+        spec = TaskSpec(
+            task_id=rnd[:16],
+            fn_id=self._xlang_fn_id,
+            name=f"xlang:{sub.fn_name}",
+            payload=payload,
+            buffers=buffers,
+            return_ids=[rnd[16 + 16 * i: 32 + 16 * i]
+                        for i in range(num_returns)],
+            num_cpus=sub.num_cpus or 1,
+            num_tpus=0,
+            resources=dict(sub.resources),
+            max_retries=0,
+            retries_left=0,
+            dependencies=[r.id.binary() for r in refs],
+        )
+        rt.submit_task(spec)
+        reply.submit.return_ids.extend(spec.return_ids)

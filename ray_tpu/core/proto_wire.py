@@ -1,0 +1,208 @@
+"""Protobuf wire codec for the head<->agent control envelope.
+
+Parity: the reference's L1 (`src/ray/protobuf/*.proto` + gRPC framing).
+The schema lives in `ray_tpu/protocol/raytpu.proto`; this module converts
+between the in-process tuple messages (unchanged — every handler keeps its
+shape) and `AgentFrame` protos on the wire. Messages whose payloads are
+Python objects (exec frames carrying pickled specs, object pushes) stay on
+the pickle framing — per the schema's contract, pickle is retained ONLY
+for Python object payloads; the control messages here are fully
+language-neutral.
+
+transport.send_msg consults `to_wire` first; the frame header's nbufs MSB
+marks a protobuf payload so receivers route to `from_wire`.
+"""
+
+from __future__ import annotations
+
+import pickle
+
+from ray_tpu.protocol import raytpu_pb2 as pb
+
+# Ops carried as protobuf on the wire (tuple-op -> encoder).
+
+
+def _value(obj) -> pb.Value:
+    if obj is None:
+        return pb.Value(data=b"", format="none")
+    return pb.Value(data=pickle.dumps(obj, protocol=5), format="pickle")
+
+
+def _unvalue(v: pb.Value):
+    if v.format == "none" or (not v.data and v.format == ""):
+        return None
+    if v.format == "pickle":
+        return pickle.loads(v.data)
+    if v.format == "raw":
+        return v.data
+    raise ValueError(f"unexpected control-plane value format {v.format!r}")
+
+
+def _addr_out(addr, host_field, port_field, msg):
+    if addr:
+        setattr(msg, host_field, addr[0])
+        setattr(msg, port_field, int(addr[1]))
+
+
+def _addr_in(msg, host_field, port_field):
+    host = getattr(msg, host_field)
+    return (host, getattr(msg, port_field)) if host else None
+
+
+# ---- client-plane tagged values (language-neutral) ----
+
+
+def encode_value(obj) -> pb.Value:
+    """Python value -> tagged Value a non-Python frontend can decode."""
+    import struct as _struct
+    if obj is None:
+        return pb.Value(data=b"", format="none")
+    if isinstance(obj, bool):
+        return pb.Value(data=b"\x01" if obj else b"\x00", format="bool")
+    if isinstance(obj, int):
+        try:
+            return pb.Value(data=_struct.pack("<q", obj), format="i64")
+        except _struct.error:  # outside signed-64 range: opaque fallback
+            return pb.Value(data=pickle.dumps(obj, protocol=5),
+                            format="pickle")
+    if isinstance(obj, float):
+        return pb.Value(data=_struct.pack("<d", obj), format="f64")
+    if isinstance(obj, str):
+        return pb.Value(data=obj.encode(), format="utf8")
+    if isinstance(obj, (bytes, bytearray, memoryview)):
+        return pb.Value(data=bytes(obj), format="raw")
+    return pb.Value(data=pickle.dumps(obj, protocol=5), format="pickle")
+
+
+def decode_value(v: pb.Value):
+    import struct as _struct
+    fmt = v.format
+    if fmt in ("none", ""):
+        return None
+    if fmt == "bool":
+        return v.data != b"\x00"
+    if fmt == "i64":
+        return _struct.unpack("<q", v.data)[0]
+    if fmt == "f64":
+        return _struct.unpack("<d", v.data)[0]
+    if fmt == "utf8":
+        return v.data.decode()
+    if fmt == "raw":
+        return v.data
+    if fmt == "pickle":
+        return pickle.loads(v.data)
+    if fmt == "json":
+        import json
+        return json.loads(v.data)
+    raise ValueError(f"unknown Value format {fmt!r}")
+
+
+def to_wire(msg) -> bytes | None:
+    """Tuple message -> serialized AgentFrame, or None (keep pickle)."""
+    op = msg[0]
+    f = pb.AgentFrame()
+    if op == "register_node":
+        (_, nid, resources, peer_addr, hostname, pid) = msg[:6]
+        inventory = msg[6] if len(msg) > 6 else []
+        ctrl_addr = msg[7] if len(msg) > 7 else None
+        objects = msg[8] if len(msg) > 8 else []
+        r = f.register_node
+        r.node_id = nid
+        for k, v in (resources or {}).items():
+            r.resources[k] = float(v)
+        _addr_out(peer_addr, "peer_host", "peer_port", r)
+        _addr_out(ctrl_addr, "ctrl_host", "ctrl_port", r)
+        r.hostname = hostname or ""
+        r.pid = int(pid or 0)
+        for item in inventory:
+            wid, aid = item[0], item[1]
+            env_key = item[2] if len(item) > 2 else None
+            e = r.inventory.add()
+            e.worker_id = wid
+            e.actor_id = aid or b""
+            e.env_key = env_key or ""
+        for oid in objects:
+            r.object_inventory.append(oid)
+    elif op == "heartbeat":
+        f.heartbeat.node_id = msg[1]
+    elif op == "node_ack":
+        f.node_ack.head_node_id = msg[1]
+    elif op == "worker_death":
+        f.worker_death.worker_id = msg[1]
+    elif op == "spawn_worker":
+        pip = msg[1] if len(msg) > 1 else None
+        f.spawn_worker.pip.CopyFrom(_value(pip))
+    elif op == "kill_worker":
+        f.kill_worker.worker_id = msg[1]
+    elif op == "fetch":
+        _, oid, src_addr, attempt = msg
+        f.fetch.object_id = oid
+        _addr_out(src_addr, "src_host", "src_port", f.fetch)
+        f.fetch.attempt = -1 if attempt is None else int(attempt)
+    elif op == "fetched":
+        _, oid, ok, attempt = msg
+        f.fetched.object_id = oid
+        f.fetched.ok = bool(ok)
+        f.fetched.attempt = -1 if attempt is None else int(attempt)
+    elif op == "free_obj":
+        f.free_object.object_id = msg[1]
+    elif op == "seq_skip":
+        _, owner, aid, seq = msg
+        f.seq_skip.owner = owner
+        f.seq_skip.actor_id = aid
+        f.seq_skip.seq = int(seq)
+    else:
+        return None
+    return f.SerializeToString()
+
+
+_PROTO_OPS = frozenset((
+    "register_node", "heartbeat", "node_ack", "worker_death",
+    "spawn_worker", "kill_worker", "fetch", "fetched", "free_obj",
+    "seq_skip"))
+
+
+def is_proto_op(op) -> bool:
+    return op in _PROTO_OPS
+
+
+def from_wire(data: bytes):
+    """Serialized AgentFrame -> the in-process tuple shape."""
+    f = pb.AgentFrame()
+    f.ParseFromString(data)
+    which = f.WhichOneof("msg")
+    if which == "register_node":
+        r = f.register_node
+        inventory = [
+            (e.worker_id, e.actor_id or None, e.env_key or None)
+            for e in r.inventory]
+        return ("register_node", r.node_id, dict(r.resources),
+                _addr_in(r, "peer_host", "peer_port"), r.hostname, r.pid,
+                inventory, _addr_in(r, "ctrl_host", "ctrl_port"),
+                list(r.object_inventory))
+    if which == "heartbeat":
+        return ("heartbeat", f.heartbeat.node_id)
+    if which == "node_ack":
+        return ("node_ack", f.node_ack.head_node_id)
+    if which == "worker_death":
+        return ("worker_death", f.worker_death.worker_id)
+    if which == "spawn_worker":
+        pip = _unvalue(f.spawn_worker.pip)
+        return ("spawn_worker",) if pip is None else ("spawn_worker", pip)
+    if which == "kill_worker":
+        return ("kill_worker", f.kill_worker.worker_id)
+    if which == "fetch":
+        m = f.fetch
+        return ("fetch", m.object_id,
+                _addr_in(m, "src_host", "src_port"),
+                None if m.attempt < 0 else m.attempt)
+    if which == "fetched":
+        m = f.fetched
+        return ("fetched", m.object_id, m.ok,
+                None if m.attempt < 0 else m.attempt)
+    if which == "free_object":
+        return ("free_obj", f.free_object.object_id)
+    if which == "seq_skip":
+        m = f.seq_skip
+        return ("seq_skip", m.owner, m.actor_id, m.seq)
+    raise ValueError(f"unknown AgentFrame payload {which!r}")

@@ -400,8 +400,8 @@ class _Zygote:
 
 def _pip_key_of(spec) -> str | None:
     """Per-env worker-pool key of a spec (None = the default pool)."""
-    from ray_tpu.core.runtime_env import pip_env_key, pip_requirements
-    pip = pip_requirements(getattr(spec, "runtime_env", None))
+    from ray_tpu.core.runtime_env import env_spec, pip_env_key
+    pip = env_spec(getattr(spec, "runtime_env", None))
     return pip_env_key(pip) if pip else None
 
 
@@ -654,6 +654,7 @@ class Runtime:
         self.nodes: dict[bytes, NodeState] = {self.head_node_id: self.head_node}
         self._node_order: list[bytes] = [self.head_node_id]
         self.cluster_addr: str | None = None
+        self.client_proto_addr: str | None = None
         self._cluster_srv = None
         self._spread_idx = 0
         # (dest_nid, oid) -> {"cbs": [done cbs], "src": src_nid,
@@ -1559,6 +1560,18 @@ class Runtime:
             # Visible through the node table too (p2p collective ranks on
             # the head resolve their endpoint the same way workers do).
             self.head_node.peer_addr = self.head_peer_addr
+            # Protobuf client plane on its own port (parity: the dedicated
+            # Ray Client server port): non-Python frontends connect here.
+            try:
+                from ray_tpu.core.client_server import ClientProtoServer
+                self._proto_clients = ClientProtoServer(self, host)
+                self.client_proto_addr = (
+                    f"{host}:{self._proto_clients.addr[1]}")
+            except Exception as e:  # noqa: BLE001 — protobuf runtime absent
+                import sys
+                print(f"ray_tpu: proto client plane unavailable ({e!r})",
+                      file=sys.stderr)
+                self.client_proto_addr = None
         with self._sel_lock:
             self._selector.register(srv, selectors.EVENT_READ, _Acceptor())
         threading.Thread(target=self._health_loop, daemon=True,
@@ -3119,9 +3132,9 @@ class Runtime:
                 _pip_key_of(spec))
 
     @staticmethod
-    def _pip_env_of(spec) -> list | None:
-        from ray_tpu.core.runtime_env import pip_requirements
-        return pip_requirements(getattr(spec, "runtime_env", None))
+    def _pip_env_of(spec):
+        from ray_tpu.core.runtime_env import env_spec
+        return env_spec(getattr(spec, "runtime_env", None))
 
     def _enqueue_task_locked(self, spec: TaskSpec, front: bool = False):
         q = self.task_queues.setdefault(self._sched_key(spec),
@@ -3949,6 +3962,8 @@ class Runtime:
                 self._cluster_srv.close()
             except OSError:
                 pass
+        if getattr(self, "_proto_clients", None) is not None:
+            self._proto_clients.close()
         for w in list(self.workers.values()):
             if w.state != DEAD and w.sock is not None:
                 try:

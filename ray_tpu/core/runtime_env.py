@@ -29,22 +29,43 @@ _build_counts: dict[str, int] = {}  # env key -> builds performed (tests)
 
 def pip_requirements(runtime_env: dict | None) -> list[str] | None:
     """Normalized pip requirement list of a runtime_env, or None."""
+    spec = env_spec(runtime_env)
+    return spec[1] if spec and spec[0] == "pip" else None
+
+
+def env_spec(runtime_env: dict | None):
+    """(tool, packages) of a runtime_env's package set, or None.
+
+    tool: "pip" or "uv" (parity: runtime_env/pip.py and runtime_env/uv.py
+    — uv builds the same content-hashed target dirs, just much faster)."""
     if not runtime_env:
         return None
-    pip = runtime_env.get("pip")
-    if not pip:
-        return None
-    if isinstance(pip, dict):  # reference accepts {"packages": [...]}
-        pip = pip.get("packages", [])
-    return [str(p) for p in pip]
+    for tool in ("pip", "uv"):
+        pkgs = runtime_env.get(tool)
+        if pkgs:
+            if isinstance(pkgs, dict):  # reference: {"packages": [...]}
+                pkgs = pkgs.get("packages", [])
+            return (tool, [str(p) for p in pkgs])
+    return None
 
 
-def pip_env_key(pip: list[str]) -> str:
-    """Content hash of the requirement list (+ interpreter version): the
+def _norm_spec(spec):
+    """Accept a bare requirement list (implied pip — the original API) or
+    a (tool, packages) tuple."""
+    if (isinstance(spec, tuple) and len(spec) == 2
+            and spec[0] in ("pip", "uv") and isinstance(spec[1], list)):
+        return spec
+    return ("pip", [str(p) for p in spec])
+
+
+def pip_env_key(spec) -> str:
+    """Content hash of (tool, requirement list, interpreter version): the
     URI-cache key AND the worker-pool key."""
+    tool, pkgs = _norm_spec(spec)
     h = hashlib.sha256()
+    h.update(tool.encode())
     h.update(sys.version.split()[0].encode())
-    for req in sorted(pip):
+    for req in sorted(pkgs):
         h.update(req.encode())
         h.update(b"\0")
     return h.hexdigest()[:16]
@@ -62,7 +83,8 @@ def ensure_pip_env(pip: list[str], timeout: float = 600.0) -> str:
     Cache-hit = a `.ready` marker exists for the content hash; a crashed
     half-build (dir without marker) is rebuilt from scratch.
     """
-    key = pip_env_key(pip)
+    tool, pkgs = _norm_spec(pip)
+    key = pip_env_key((tool, pkgs))
     target = os.path.join(env_cache_dir(), key)
     marker = os.path.join(target, ".ready")
     with _build_lock:  # one build per process; cross-process rebuilds are
@@ -76,15 +98,25 @@ def ensure_pip_env(pip: list[str], timeout: float = 600.0) -> str:
             import shutil
             shutil.rmtree(target, ignore_errors=True)
         os.makedirs(target, exist_ok=True)
-        cmd = [sys.executable, "-m", "pip", "install", "--quiet",
-               "--target", target, *pip]
+        if tool == "uv":
+            import shutil
+            if shutil.which("uv") is None:
+                raise RuntimeError(
+                    "runtime_env={'uv': ...} requires the uv binary on "
+                    "PATH; use {'pip': ...} instead")
+            cmd = ["uv", "pip", "install", "--quiet", "--target", target,
+                   "--python", sys.executable, *pkgs]
+        else:
+            cmd = [sys.executable, "-m", "pip", "install", "--quiet",
+                   "--target", target, *pkgs]
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=timeout)
         if proc.returncode != 0:
             raise RuntimeError(
-                f"pip env build failed ({' '.join(pip)}):\n{proc.stderr}")
+                f"{tool} env build failed ({' '.join(pkgs)}):\n"
+                f"{proc.stderr}")
         with open(marker, "w") as f:
-            f.write(" ".join(sorted(pip)))
+            f.write(" ".join(sorted(pkgs)))
         _build_counts[key] = _build_counts.get(key, 0) + 1
         return target
 

@@ -51,6 +51,26 @@ class ChaosInjector:
 _chaos: ChaosInjector | None = None
 
 
+def _decode_proto(payload: bytes):
+    try:
+        from ray_tpu.core import proto_wire
+    except Exception as e:  # noqa: BLE001
+        raise RuntimeError(
+            "peer sent a protobuf control frame but this process has no "
+            "usable protobuf runtime") from e
+    return proto_wire.from_wire(payload)
+
+
+def _is_proto_op(op) -> bool:
+    # Lazy import: keep transport importable before the runtime package
+    # wiring is complete (workers import this very early).
+    try:
+        from ray_tpu.core.proto_wire import is_proto_op
+    except Exception:  # noqa: BLE001 — protobuf runtime missing
+        return False
+    return is_proto_op(op)
+
+
 def get_chaos() -> ChaosInjector:
     global _chaos
     if _chaos is None:
@@ -63,8 +83,12 @@ def get_chaos() -> ChaosInjector:
 # Frame: <Q payload_len><I nbufs>[<Q buf_len>...]<payload><buffers...>
 # Out-of-band pickle-5 buffers (numpy arrays, memoryviews from the shm
 # store) travel unpickled — no copy into the pickle stream on send.
+# The nbufs MSB marks a PROTOBUF payload (an AgentFrame from
+# ray_tpu/protocol/raytpu.proto): language-neutral control messages ride
+# the schema; pickle remains only for Python object payloads.
 _NBUF = struct.Struct("<I")
 _BLEN = struct.Struct("<Q")
+_PROTO_FLAG = 0x80000000
 
 
 def _load_buf(b):
@@ -156,6 +180,18 @@ def send_msg(sock: socket.socket, msg, lock: threading.Lock | None = None):
     chaos.maybe_delay(op)
     if chaos.maybe_drop(op):
         return
+    if op and _is_proto_op(op):
+        from ray_tpu.core import proto_wire
+        payload = proto_wire.to_wire(msg)
+        if payload is not None:
+            head = (_HDR.pack(len(payload))
+                    + _NBUF.pack(_PROTO_FLAG) + payload)
+            if lock:
+                with lock:
+                    sock.sendall(head)
+            else:
+                sock.sendall(head)
+            return
     parts = _encode(msg)
     # Header/lengths coalesce into one small write; buffers are sent as-is —
     # joining would copy every large tensor a second time.
@@ -179,6 +215,11 @@ def recv_msg(sock: socket.socket):
         return None
     (n,) = _HDR.unpack_from(hdr, 0)
     (nbufs,) = _NBUF.unpack_from(hdr, _HDR.size)
+    if nbufs & _PROTO_FLAG:
+        payload = _recv_exact(sock, n)
+        if payload is None:
+            return None
+        return _decode_proto(payload)
     blens = []
     if nbufs:
         lens = _recv_exact(sock, _BLEN.size * nbufs)
@@ -229,6 +270,13 @@ class FrameBuffer:
                 break
             (n,) = _HDR.unpack_from(self._buf, 0)
             (nbufs,) = _NBUF.unpack_from(self._buf, _HDR.size)
+            if nbufs & _PROTO_FLAG:
+                if len(self._buf) < pre + n:
+                    break
+                payload = bytes(self._buf[pre:pre + n])
+                del self._buf[:pre + n]
+                out.append(_decode_proto(payload))
+                continue
             lens_end = pre + _BLEN.size * nbufs
             if len(self._buf) < lens_end:
                 break

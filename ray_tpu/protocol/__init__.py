@@ -1,0 +1,6 @@
+"""Protobuf wire schema (raytpu.proto) + generated bindings.
+
+Regenerate with:  protoc --python_out=. raytpu.proto  (from this dir).
+The C++ frontend compiles the same schema with protoc --cpp_out.
+"""
+from ray_tpu.protocol import raytpu_pb2  # noqa: F401

@@ -543,3 +543,40 @@ def test_runtime_env_pip_per_env_worker_pool(ray_start_isolated, tmp_path):
         assert ray_tpu.get(host_probe.remote(), timeout=60) == "clean"
     finally:
         os.environ.pop("RAY_TPU_ENV_CACHE", None)
+
+
+@pytest.mark.skipif(__import__("shutil").which("uv") is None,
+                    reason="uv binary not available")
+def test_runtime_env_uv(ray_start_isolated, tmp_path):
+    """runtime_env={"uv": [...]} builds the same content-hashed target dir
+    through uv (parity: runtime_env/uv.py) with its own pool key — pip and
+    uv envs of identical packages never share workers."""
+    import os
+    import textwrap
+
+    from ray_tpu.core import runtime_env as renv
+
+    pkg = tmp_path / "rtpu_uv_probe"
+    pkg.mkdir()
+    (pkg / "setup.py").write_text(textwrap.dedent("""
+        from setuptools import setup
+        setup(name="rtpu_uv_probe", version="1.0",
+              py_modules=["rtpu_uv_probe"])
+    """))
+    (pkg / "rtpu_uv_probe.py").write_text('VALUE = "uv-works"\n')
+
+    pkgs = ["--no-index", "--no-build-isolation", str(pkg)]
+    os.environ["RAY_TPU_ENV_CACHE"] = str(tmp_path / "envcache")
+    try:
+        @ray_tpu.remote(runtime_env={"uv": pkgs})
+        def probe():
+            import rtpu_uv_probe
+            return rtpu_uv_probe.VALUE, os.environ.get("RAY_TPU_ENV_KEY")
+
+        value, key = ray_tpu.get(probe.remote(), timeout=120)
+        assert value == "uv-works"
+        assert key == renv.pip_env_key(("uv", pkgs))
+        assert renv.pip_env_key(("uv", pkgs)) != renv.pip_env_key(pkgs)
+        assert renv.build_count(("uv", pkgs)) == 1
+    finally:
+        os.environ.pop("RAY_TPU_ENV_CACHE", None)
