@@ -487,6 +487,16 @@ class ObjectDirectory:
         self.entries: dict[bytes, tuple] = {}  # oid -> ("inline", v)|("shm",)|("err", e)
         self.callbacks: dict[bytes, list] = {}
         self.lock = threading.Lock()
+        # Global ready-event pulse: wait() re-probes on each pulse instead
+        # of registering per-ref callbacks — pop-one-ref wait loops over N
+        # refs would otherwise pile up O(N^2) ghost callbacks.
+        self.ready_cv = threading.Condition()
+        self.ready_gen = 0
+
+    def _pulse_ready(self):
+        with self.ready_cv:
+            self.ready_gen += 1
+            self.ready_cv.notify_all()
 
     def put(self, oid: bytes, entry: tuple):
         with self.lock:
@@ -494,10 +504,22 @@ class ObjectDirectory:
             cbs = self.callbacks.pop(oid, [])
         for cb in cbs:
             cb(entry)
+        self._pulse_ready()
 
     def lookup(self, oid: bytes):
         with self.lock:
             return self.entries.get(oid)
+
+    def split_ready(self, oids: list) -> tuple[list, list]:
+        """(ready, pending) under ONE lock acquisition, single pass —
+        wait() probes thousands of refs per call."""
+        ready: list = []
+        pending: list = []
+        with self.lock:
+            entries = self.entries
+            for o in oids:
+                (ready if o in entries else pending).append(o)
+        return ready, pending
 
     def add_location(self, oid: bytes, node_id: bytes):
         """Merge a replica location into a shm entry, creating it if absent.
@@ -513,6 +535,7 @@ class ObjectDirectory:
             cbs = self.callbacks.pop(oid, [])
         for cb in cbs:
             cb(entry)
+        self._pulse_ready()
 
     def on_ready(self, oid: bytes, cb):
         with self.lock:
@@ -2163,35 +2186,34 @@ class Runtime:
         # ref, no callback registration. Wait-in-a-loop patterns (pop one
         # ready ref per call over N refs) would otherwise register O(N^2)
         # ghost callbacks across the loop.
-        ready_set: set[bytes] = set()
-        for r in refs:
-            if self.directory.lookup(r.id.binary()) is not None:
-                ready_set.add(r.id.binary())
+        oids = [r.id.binary() for r in refs]
+        ready, pending = self.directory.split_ready(oids)
+        ready_set: set[bytes] = set(ready)
         if len(ready_set) < num_returns:
-            cv = threading.Condition()
-
-            def mk_cb(oid):
-                def cb(_entry):
-                    with cv:
-                        ready_set.add(oid)
-                        cv.notify_all()
-                return cb
-
-            for r in refs:
-                if r.id.binary() not in ready_set:
-                    self.directory.on_ready(r.id.binary(),
-                                            mk_cb(r.id.binary()))
+            # Slow path: sleep on the directory's global ready pulse and
+            # re-probe only the still-pending refs on each pulse (one lock
+            # per probe batch). No per-ref callbacks: a pop-one-ref wait
+            # loop over N refs costs O(N^2) cheap dict probes total, not
+            # O(N^2) callback registrations + firings.
             deadline = (None if timeout is None
                         else time.monotonic() + timeout)
+            cv = self.directory.ready_cv
             with cv:
-                while len(ready_set) < num_returns:
+                while True:
+                    gen = self.directory.ready_gen
+                    fresh, pending = self.directory.split_ready(pending)
+                    ready_set.update(fresh)
+                    if len(ready_set) >= num_returns:
+                        break
                     remain = (None if deadline is None
                               else deadline - time.monotonic())
                     if remain is not None and remain <= 0:
                         break
-                    cv.wait(remain if remain is not None else 0.1)
-        ready = [r for r in refs if r.id.binary() in ready_set]
-        not_ready = [r for r in refs if r.id.binary() not in ready_set]
+                    if self.directory.ready_gen == gen:
+                        cv.wait(min(remain, 0.1) if remain is not None
+                                else 0.1)
+        ready = [r for r, o in zip(refs, oids) if o in ready_set]
+        not_ready = [r for r, o in zip(refs, oids) if o not in ready_set]
         overflow = ready[num_returns:]
         return ready[:num_returns], overflow + not_ready
 
@@ -2680,11 +2702,23 @@ class Runtime:
                     self._cancelled.discard(spec.task_id)
                     return
                 if spec.actor_id is None:
-                    self._enqueue_task_locked(spec)
+                    fresh_key = self._enqueue_task_locked(spec)
+                    # Burst debounce: with no idle worker anywhere AND an
+                    # already-parked key, this enqueue cannot become
+                    # dispatchable until a completion (which always
+                    # reschedules) or a worker-ready event. A FRESH key
+                    # must still pass through _schedule — that is the only
+                    # path that requests a worker spawn for it. Skipping
+                    # the no-op passes keeps a 10k-submit burst
+                    # O(dispatches), not O(submissions * scan).
+                    has_idle = any(
+                        n.idle and n.state == "ALIVE"
+                        for n in self.nodes.values())
             if spec.actor_id is not None:
                 self._submit_actor_task(spec)
                 return
-            self._schedule()
+            if has_idle or fresh_key:
+                self._schedule()
         else:
             self._create_actor_now(item["cspec"])
 
@@ -3136,10 +3170,15 @@ class Runtime:
         from ray_tpu.core.runtime_env import env_spec
         return env_spec(getattr(spec, "runtime_env", None))
 
-    def _enqueue_task_locked(self, spec: TaskSpec, front: bool = False):
+    def _enqueue_task_locked(self, spec: TaskSpec,
+                             front: bool = False) -> bool:
+        """Returns True when this key's queue was empty (a fresh key must
+        always get a scheduling pass — it may need a worker spawned)."""
         q = self.task_queues.setdefault(self._sched_key(spec),
                                         collections.deque())
+        was_empty = not q
         (q.appendleft if front else q.append)(spec)
+        return was_empty
 
     @property
     def task_queue(self) -> list:
