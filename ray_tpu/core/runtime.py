@@ -1741,6 +1741,53 @@ class Runtime:
             w.state = "client"  # never enters the idle pool
             w.is_client = True
             w.connected.set()
+            # Client sends ride a dedicated writer thread: a large value
+            # push (a client get() of a GB object is one inline frame)
+            # must never run sendall on the LISTENER thread — it would
+            # stall the whole control plane for the transfer (parity: the
+            # reference chunks client values through a dedicated client
+            # server, util/client/server/).
+            import queue as _queue
+            outq: "_queue.Queue" = _queue.Queue(maxsize=256)
+            direct_send = w.send
+
+            def _client_writer(outq=outq, direct_send=direct_send,
+                               sock=conn.sock):
+                while True:
+                    m = outq.get()
+                    if m is None:
+                        return
+                    try:
+                        direct_send(m)
+                    except Exception:  # noqa: BLE001 — ANY failure ends
+                        # the stream: close the socket so the listener's
+                        # EOF path runs full client cleanup (a silently
+                        # dead writer would black-hole every later reply).
+                        try:
+                            sock.close()
+                        except OSError:
+                            pass
+                        return
+
+            threading.Thread(target=_client_writer, daemon=True,
+                             name="rtpu-client-tx").start()
+
+            def _client_send(m, outq=outq, sock=conn.sock):
+                try:
+                    # Bounded: a client that stops draining multi-GB
+                    # replies is disconnected rather than buffering the
+                    # head into OOM (sendall's old backpressure stalled
+                    # the listener instead; neither tail is kept).
+                    outq.put_nowait(m)
+                except _queue.Full:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    raise OSError("client send queue overflow")
+
+            w.send = _client_send
+            w._client_outq = outq
             conn.client_handle = w
             with self.lock:
                 self.workers[wid] = w
@@ -1991,6 +2038,9 @@ class Runtime:
         except OSError:
             pass
         if conn.client_handle is not None:
+            outq = getattr(conn.client_handle, "_client_outq", None)
+            if outq is not None:
+                outq.put(None)  # retire the dedicated writer thread
             self._on_worker_death(conn.client_handle)
             return
         if conn.node_id is not None:
@@ -2156,27 +2206,26 @@ class Runtime:
 
     def _wait_oids(self, oids: list, num_returns: int,
                    timeout) -> list:
-        """wait() over raw oid bytes (client mode)."""
-        cv = threading.Condition()
-        ready_set: set = set()
-
-        def mk_cb(oid):
-            def cb(_entry):
-                with cv:
-                    ready_set.add(oid)
-                    cv.notify_all()
-            return cb
-
-        for oid in oids:
-            self.directory.on_ready(oid, mk_cb(oid))
+        """wait() over raw oid bytes (client mode) — same ready-pulse
+        re-probe as Runtime.wait (no per-ref ghost callbacks)."""
+        ready, pending = self.directory.split_ready(oids)
+        ready_set: set = set(ready)
         deadline = None if timeout is None else time.monotonic() + timeout
+        cv = self.directory.ready_cv
         with cv:
             while len(ready_set) < num_returns:
+                gen = self.directory.ready_gen
+                fresh, pending = self.directory.split_ready(pending)
+                ready_set.update(fresh)
+                if len(ready_set) >= num_returns:
+                    break
                 remain = (None if deadline is None
                           else deadline - time.monotonic())
                 if remain is not None and remain <= 0:
                     break
-                cv.wait(remain if remain is not None else 0.1)
+                if self.directory.ready_gen == gen:
+                    cv.wait(min(remain, 0.1) if remain is not None
+                            else 0.1)
         return [oid for oid in oids if oid in ready_set]
 
     def wait(self, refs, num_returns=1, timeout=None):
@@ -2704,13 +2753,16 @@ class Runtime:
                 if spec.actor_id is None:
                     fresh_key = self._enqueue_task_locked(spec)
                     # Burst debounce: with no idle worker anywhere AND an
-                    # already-parked key, this enqueue cannot become
-                    # dispatchable until a completion (which always
-                    # reschedules) or a worker-ready event. A FRESH key
-                    # must still pass through _schedule — that is the only
-                    # path that requests a worker spawn for it. Skipping
-                    # the no-op passes keeps a 10k-submit burst
-                    # O(dispatches), not O(submissions * scan).
+                    # already-parked key, this enqueue waits for the next
+                    # completion (which always reschedules AND is the only
+                    # event that frees pipeline depth) or a worker-ready
+                    # event. A FRESH key must still pass through
+                    # _schedule — that is the only path that requests a
+                    # worker spawn for it. Skipping the no-op passes keeps
+                    # a 10k-submit burst O(dispatches), not
+                    # O(submissions * scan). NOTE: if a depth-freeing path
+                    # that does NOT reschedule is ever added, this skip
+                    # must learn about it.
                     has_idle = any(
                         n.idle and n.state == "ALIVE"
                         for n in self.nodes.values())

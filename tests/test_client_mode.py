@@ -110,3 +110,50 @@ def test_client_disconnect_leaves_head_healthy(head_with_endpoint, tmp_path):
         return "pong"
 
     assert ray_tpu.get(ping.remote(), timeout=60) == "pong"
+
+LARGE_VALUE_SCRIPT = textwrap.dedent("""
+    import threading
+    import time
+    import sys
+    import numpy as np
+    import ray_tpu
+
+    ray_tpu.init(address=sys.argv[1])
+
+    @ray_tpu.remote
+    def big():
+        return np.ones(10 * (1 << 20), dtype=np.int64)  # 80 MB
+
+    ref = big.remote()
+    # While the 80MB value streams back on the dedicated client writer,
+    # small control requests keep flowing.
+    stalls = []
+
+    def prober():
+        for _ in range(10):
+            t0 = time.monotonic()
+            ray_tpu.cluster_resources()
+            stalls.append(time.monotonic() - t0)
+            time.sleep(0.02)
+
+    th = threading.Thread(target=prober)
+    th.start()
+    out = ray_tpu.get(ref, timeout=120)
+    th.join()
+    assert out.shape == (10 * (1 << 20),) and out[0] == 1
+    assert out.nbytes == 80 * (1 << 20)
+    ray_tpu.shutdown()
+    print("BIG-OK", max(stalls) < 30.0)
+""")
+
+
+def test_client_large_value_round_trip(head_with_endpoint, tmp_path):
+    """An 80MB client get() rides the dedicated per-client writer thread
+    (weak #8: a large inline value must not stall the head's listener)."""
+    _rt, addr = head_with_endpoint
+    script = tmp_path / "big_client.py"
+    script.write_text(LARGE_VALUE_SCRIPT)
+    out = subprocess.run([sys.executable, str(script), addr],
+                         capture_output=True, text=True, timeout=180)
+    assert out.returncode == 0, out.stderr
+    assert "BIG-OK True" in out.stdout
