@@ -686,6 +686,9 @@ class Runtime:
         # can't kill a retried fetch.
         self._fetches: dict[tuple, dict] = {}
         self._fetch_attempts = 0
+        # On-demand worker profiling (dashboard /api/profile): token ->
+        # future resolved when the worker's sampler report arrives.
+        self._profile_futs: dict[bytes, "object"] = {}
 
         self.workers: dict[bytes, WorkerHandle] = {}
         # Per-scheduling-key task queues (parity: normal_task_submitter.h:58
@@ -1267,6 +1270,10 @@ class Runtime:
         elif op == "put_notify":
             self.directory.add_location(msg[1], w.node_id)
             self._on_object_ready(msg[1])
+        elif op == "profile_result":
+            entry = self._profile_futs.pop(msg[1], None)
+            if entry is not None:
+                entry[0].set_result(msg[2])
         elif op == "free_put":
             # Owning worker dropped the last local handle of its own put()
             # and the ref never escaped — safe to free cluster-wide, unless
@@ -2040,7 +2047,10 @@ class Runtime:
         if conn.client_handle is not None:
             outq = getattr(conn.client_handle, "_client_outq", None)
             if outq is not None:
-                outq.put(None)  # retire the dedicated writer thread
+                try:  # retire the writer; a full queue means it already
+                    outq.put_nowait(None)  # exited — never block the
+                except Exception:  # noqa: BLE001 — listener thread here
+                    pass
             self._on_worker_death(conn.client_handle)
             return
         if conn.node_id is not None:
@@ -3956,6 +3966,12 @@ class Runtime:
                 else:
                     self._fail_returns(spec, WorkerCrashedError(
                         f"worker died executing {spec.describe()}"))
+        for token, (fut, fwid) in list(self._profile_futs.items()):
+            if fwid == w.worker_id.binary():
+                self._profile_futs.pop(token, None)
+                if not fut.done():
+                    fut.set_exception(RayTpuError(
+                        "worker died while being profiled"))
         if w.actor_id is not None:
             self._on_actor_worker_death(w.actor_id)
         if (prev_state in (IDLE, BUSY) and not self._shutdown
@@ -4011,6 +4027,36 @@ class Runtime:
                 if st.resources_reserved:
                     self._release_token(st.resources_reserved)
                     st.resources_reserved = None
+
+    def profile_worker(self, worker_id_hex: str, duration_s: float = 1.0,
+                       hz: float = 100.0) -> dict:
+        """Sample a live worker's stacks on demand (parity: the dashboard
+        reporter's py-spy endpoint; here a built-in cooperative sampler —
+        ray_tpu/util/profiling.py). worker_id "head" samples this
+        process."""
+        import concurrent.futures
+
+        from ray_tpu.util.profiling import sample_stacks
+        if worker_id_hex in ("head", "driver", ""):
+            return sample_stacks(duration_s, hz)
+        wid = bytes.fromhex(worker_id_hex)
+        w = self.workers.get(wid)
+        if w is None or w.state == DEAD:
+            raise RayTpuError(f"no live worker {worker_id_hex}")
+        if getattr(w, "is_client", False):
+            raise RayTpuError(
+                f"{worker_id_hex} is a client-mode driver, not a worker")
+        token = os.urandom(8)
+        fut: "concurrent.futures.Future" = concurrent.futures.Future()
+        self._profile_futs[token] = (fut, wid)
+        try:
+            w.send(("profile", token, float(duration_s), float(hz)))
+            return fut.result(duration_s + 30.0)
+        except concurrent.futures.TimeoutError:
+            raise RayTpuError(
+                f"profiling {worker_id_hex} timed out") from None
+        finally:
+            self._profile_futs.pop(token, None)
 
     # ---------------- introspection ----------------
 

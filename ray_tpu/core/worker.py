@@ -923,6 +923,22 @@ def _worker_main(store_path: str, worker_id: WorkerID, fd: int):
                 if len(rt.dropped_tasks) > 1024:
                     rt.dropped_tasks.pop()
                 rt.dropped_tasks.add(msg[1])
+            elif op == "profile":
+                # On-demand stack sampling (parity: dashboard reporter's
+                # py-spy endpoint); runs on a side thread so the executor
+                # keeps working while being observed.
+                def _prof(token=msg[1], duration=msg[2], hz=msg[3]):
+                    from ray_tpu.util.profiling import sample_stacks
+                    try:
+                        report = sample_stacks(duration, hz)
+                    except Exception as e:  # noqa: BLE001
+                        report = {"error": str(e)}
+                    try:
+                        rt.send(("profile_result", token, report))
+                    except OSError:
+                        pass
+
+                threading.Thread(target=_prof, daemon=True).start()
             elif op == "shutdown":
                 rt.shutdown.set()
                 rt.task_queue.put(None)

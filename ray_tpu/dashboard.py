@@ -58,6 +58,24 @@ class _Handler(BaseHTTPRequestHandler):
                 from ray_tpu import job_submission
                 self._json([j.to_dict()
                             for j in job_submission.list_jobs()])
+            elif path == "/api/profile":
+                # On-demand stack sampling of a worker (or the head):
+                # /api/profile?worker=<hex|head>&duration=1&format=text
+                # (parity: dashboard/modules/reporter py-spy endpoints).
+                import urllib.parse
+                from ray_tpu.core.runtime import get_runtime
+                q = urllib.parse.parse_qs(
+                    self.path.partition("?")[2])
+                report = get_runtime().profile_worker(
+                    q.get("worker", ["head"])[0],
+                    float(q.get("duration", ["1.0"])[0]),
+                    float(q.get("hz", ["100"])[0]))
+                if q.get("format", ["json"])[0] == "text":
+                    from ray_tpu.util.profiling import format_report
+                    self._send(200, format_report(report).encode(),
+                               "text/plain")
+                else:
+                    self._json(report)
             elif path == "/":
                 self._send(200, _INDEX_HTML, "text/html")
             else:
