@@ -1,6 +1,8 @@
 """Protobuf wire schema (raytpu.proto) + generated bindings.
 
-Regenerate with:  protoc --python_out=. raytpu.proto  (from this dir).
+Regenerate with:  protoc --python_out=. ray_tpu/protocol/raytpu.proto
+(from the REPO ROOT — the package-pathed module name makes generated
+messages pickle by reference across worker processes).
 The C++ frontend compiles the same schema with protoc --cpp_out.
 """
 from ray_tpu.protocol import raytpu_pb2  # noqa: F401

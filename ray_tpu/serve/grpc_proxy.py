@@ -98,17 +98,89 @@ class _GenericHandler:
             response_serializer=None)
 
 
+class _RoutingServicer:
+    """Stands in for the user's Servicer when their generated
+    `add_XServicer_to_server` mounts onto the proxy: every service method
+    becomes a route into a Serve deployment (parity: the reference's
+    `grpc_servicer_functions`, serve/_private/proxy.py:1131). The gRPC
+    runtime decodes requests with the USER's proto classes before the
+    handler runs, so deployments receive and return real message objects
+    — no hand-decoding of bytes anywhere.
+
+    App selection: the `application` request-metadata key, defaulting to
+    Serve's "default" app (same convention as the reference)."""
+
+    def __init__(self, handler: "_GenericHandler"):
+        self._h = handler
+
+    def __getattr__(self, method_name: str):
+        if method_name.startswith("_"):
+            raise AttributeError(method_name)
+        h = self._h
+        grpc = h._grpc
+
+        def call(request, context):
+            from ray_tpu.core.status import RayTpuError
+            md = dict(context.invocation_metadata())
+            app = md.get("application", "default")
+            try:
+                handle = h._handle_for(app)
+            except (KeyError, ValueError, RayTpuError) as e:
+                context.abort(grpc.StatusCode.NOT_FOUND,
+                              f"no serve app {app!r}: {e}")
+                return None
+            try:
+                return getattr(handle, method_name).remote(
+                    request).result(timeout_s=60)
+            except Exception as e:  # noqa: BLE001 — surface to client
+                context.abort(grpc.StatusCode.INTERNAL, repr(e))
+                return None
+
+        return call
+
+
+class _MountServer:
+    """Shim handed to the user's add_XServicer_to_server: validates that
+    every mounted method is unary-unary (the routing servicer cannot
+    represent streaming RPCs — rejecting at mount time beats an opaque
+    call-time failure) and forwards everything else to the real server."""
+
+    def __init__(self, server):
+        self._server = server
+
+    def add_generic_rpc_handlers(self, handlers):
+        for h in handlers:
+            for svc_method, mh in getattr(h, "_method_handlers",
+                                          {}).items():
+                if mh.request_streaming or mh.response_streaming:
+                    raise ValueError(
+                        f"serve gRPC ingress: {svc_method!r} is a "
+                        f"streaming RPC; only unary-unary methods can "
+                        f"route to deployments")
+        self._server.add_generic_rpc_handlers(handlers)
+
+    def __getattr__(self, name):
+        return getattr(self._server, name)
+
+
 _server = None
 
 
 def start_grpc_proxy(host: str = "127.0.0.1", port: int = 0,
-                     allow_pickle: bool = False) -> str:
+                     allow_pickle: bool = False,
+                     servicer_functions: list | None = None) -> str:
     """Start (or return) the serve gRPC ingress; returns 'host:port'.
+
+    `servicer_functions`: generated `add_XServicer_to_server` callables
+    (or "module.add_XServicer_to_server" strings) mounting the user's own
+    proto services; their methods route to same-named deployment methods
+    with fully-decoded request/response messages. The generic raw-bytes
+    routes stay available alongside.
 
     SECURITY: `allow_pickle=True` enables the `__pickle__` convenience
     route (used by `grpc_call`), which unpickles client bytes — arbitrary
     code execution for anyone who can reach the port. Enable it only on
-    trusted networks; the raw-bytes routes are always safe."""
+    trusted networks; the raw-bytes and proto routes are always safe."""
     global _server
     import grpc
     if _server is not None:
@@ -116,9 +188,22 @@ def start_grpc_proxy(host: str = "127.0.0.1", port: int = 0,
             raise ValueError(
                 f"gRPC proxy already running with allow_pickle="
                 f"{_server[2]}; stop_grpc_proxy() first to change it")
+        if servicer_functions:
+            raise ValueError(
+                "gRPC proxy already running; stop_grpc_proxy() first to "
+                "mount additional servicer_functions")
         return _server[1]
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=16))
-    server.add_generic_rpc_handlers((_GenericHandler(allow_pickle),))
+    generic = _GenericHandler(allow_pickle)
+    routing = _RoutingServicer(generic)
+    mount = _MountServer(server)
+    for fn in servicer_functions or []:
+        if isinstance(fn, str):
+            import importlib
+            mod, _, attr = fn.rpartition(".")
+            fn = getattr(importlib.import_module(mod), attr)
+        fn(routing, mount)
+    server.add_generic_rpc_handlers((generic,))
     bound = server.add_insecure_port(f"{host}:{port}")
     server.start()
     addr = f"{host}:{bound}"

@@ -438,3 +438,53 @@ def test_streaming_sse_first_chunk_before_completion(serve_instance):
     # 4 ticks x 0.4s: completion takes >=1.2s; the first chunk must beat it.
     assert t_first < t_all - 0.6, (t_first, t_all)
     serve.delete("sse")
+
+
+def test_grpc_user_proto_service(serve_instance):
+    """User proto services mount with their own descriptors (parity:
+    grpc_servicer_functions, proxy.py:1131): the proxy decodes requests
+    with the user's message classes, deployments receive/return real
+    proto objects, and clients use their generated stubs — no
+    hand-decoding of bytes anywhere."""
+    import grpc
+
+    from ray_tpu import serve
+    from ray_tpu.protocol import raytpu_pb2 as pb
+
+    # What generated code's add_XServicer_to_server does, hand-rolled
+    # (grpc_tools is not installed in this image; the proxy only relies
+    # on the call convention, which is identical).
+    def add_EchoServicer_to_server(servicer, server):
+        handlers = {
+            "Shout": grpc.unary_unary_rpc_method_handler(
+                servicer.Shout,
+                request_deserializer=pb.Value.FromString,
+                response_serializer=lambda m: m.SerializeToString()),
+        }
+        server.add_generic_rpc_handlers((
+            grpc.method_handlers_generic_handler("test.Echo", handlers),))
+
+    @serve.deployment
+    class ProtoEcho:
+        def Shout(self, request):
+            # A REAL decoded message arrives; a real message goes back.
+            return pb.Value(data=request.data.upper(),
+                            format=request.format)
+
+    serve.run(ProtoEcho.bind(), name="default")
+    addr = serve.start_grpc_proxy(
+        servicer_functions=[add_EchoServicer_to_server])
+    try:
+        with grpc.insecure_channel(addr) as ch:
+            stub = ch.unary_unary(
+                "/test.Echo/Shout",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=pb.Value.FromString)
+            out = stub(pb.Value(data=b"hello", format="raw"), timeout=60)
+            assert out.data == b"HELLO" and out.format == "raw"
+            # `application` metadata routes to a named app explicitly.
+            out = stub(pb.Value(data=b"meta", format="raw"), timeout=60,
+                       metadata=(("application", "default"),))
+            assert out.data == b"META"
+    finally:
+        serve.stop_grpc_proxy()
