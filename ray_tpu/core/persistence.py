@@ -44,6 +44,92 @@ class NullStore:
         pass
 
 
+class SqliteStore:
+    """Transactional persistence tier (parity: the reference's
+    RedisStoreClient role, `redis_store_client.h:111` — a durable store a
+    RESTARTED-ELSEWHERE head can reload, minus the network server: SQLite
+    on shared storage gives the same restart-anywhere capability with
+    zero extra processes). Selected by a path ending in `.db`/`.sqlite`
+    or a `sqlite://` prefix.
+
+    Unlike the journal, writes are transactional upserts — no torn-tail
+    handling, no compaction; `load()` is a table scan."""
+
+    def __init__(self, path: str):
+        import sqlite3
+        if path.startswith("sqlite://"):
+            path = path[len("sqlite://"):]
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._lock = threading.Lock()
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS kv ("
+            " tbl TEXT NOT NULL, key BLOB NOT NULL, value BLOB,"
+            " PRIMARY KEY (tbl, key))")
+        # WAL + synchronous=NORMAL: no fsync per commit — durability
+        # target is head-process death, not power loss (the journal's
+        # documented posture); FULL would put a disk flush on every task
+        # submission.
+        self._db.execute("PRAGMA journal_mode=WAL")
+        self._db.execute("PRAGMA synchronous=NORMAL")
+        self._db.commit()
+
+    def append(self, table: str, key: bytes, value) -> None:
+        with self._lock:
+            self._db.execute(
+                "INSERT INTO kv (tbl, key, value) VALUES (?, ?, ?) "
+                "ON CONFLICT(tbl, key) DO UPDATE SET value=excluded.value",
+                (table, key, pickle.dumps(value,
+                                          protocol=pickle.HIGHEST_PROTOCOL)))
+            self._db.commit()
+
+    def delete(self, table: str, key: bytes) -> None:
+        with self._lock:
+            self._db.execute("DELETE FROM kv WHERE tbl=? AND key=?",
+                             (table, key))
+            self._db.commit()
+
+    def load(self) -> dict:
+        tables: dict[str, dict] = {}
+        with self._lock:
+            for tbl, key, value in self._db.execute(
+                    "SELECT tbl, key, value FROM kv"):
+                try:
+                    tables.setdefault(tbl, {})[key] = pickle.loads(value)
+                except Exception:  # noqa: BLE001 — skip corrupt record
+                    continue
+        return tables
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._db.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def make_store(path: str | None):
+    """Persistence backend for `path`: None -> NullStore; sqlite for
+    `.db`/`.sqlite`/`sqlite://` paths; the append-only journal otherwise
+    (parity: the reference's pluggable StoreClient,
+    `store_client/store_client.h`)."""
+    if not path:
+        return NullStore()
+    if (path.startswith("sqlite://") or path.endswith(".db")
+            or path.endswith(".sqlite")):
+        raw = path[len("sqlite://"):] if path.startswith("sqlite://") \
+            else path
+        try:  # a pre-existing JOURNAL at a .db path keeps its format —
+            with open(raw, "rb") as f:  # never corrupt prior state
+                if not f.read(16).startswith(b"SQLite format 3"):
+                    return FileStore(raw)
+        except FileNotFoundError:
+            pass
+        return SqliteStore(path)
+    return FileStore(path)
+
+
 class FileStore:
     """Append-only journal of (table, key, value|None) pickle records.
 

@@ -714,10 +714,9 @@ class Runtime:
         self.waiting_deps: dict[bytes, list] = {}  # oid -> [pending items]
         # Pluggable head persistence (parity: gcs store_client tier):
         # journaled dicts write through; everything else stays volatile.
-        from ray_tpu.core.persistence import FileStore, NullStore
+        from ray_tpu.core.persistence import make_store
         self._persist = bool(cfg.head_persistence_path)
-        self._pstore = (FileStore(cfg.head_persistence_path)
-                        if self._persist else NullStore())
+        self._pstore = make_store(cfg.head_persistence_path)
         self.actors: dict[bytes, ActorState] = {}
         self.named_actors: dict[str, bytes] = _JournaledDict(
             "named", self._pstore)

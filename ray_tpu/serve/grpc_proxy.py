@@ -130,11 +130,21 @@ class _RoutingServicer:
                               f"no serve app {app!r}: {e}")
                 return None
             try:
-                return getattr(handle, method_name).remote(
+                out = getattr(handle, method_name).remote(
                     request).result(timeout_s=60)
             except Exception as e:  # noqa: BLE001 — surface to client
                 context.abort(grpc.StatusCode.INTERNAL, repr(e))
                 return None
+            if not hasattr(out, "SerializeToString"):
+                # Clear abort beats the runtime's opaque 'Exception
+                # serializing response!' when a method returns a
+                # non-proto value.
+                context.abort(
+                    grpc.StatusCode.INTERNAL,
+                    f"deployment method {method_name!r} returned "
+                    f"{type(out).__name__}, not a protobuf message")
+                return None
+            return out
 
         return call
 
@@ -150,8 +160,17 @@ class _MountServer:
 
     def add_generic_rpc_handlers(self, handlers):
         for h in handlers:
-            for svc_method, mh in getattr(h, "_method_handlers",
-                                          {}).items():
+            methods = getattr(h, "_method_handlers", None)
+            if methods is None:
+                # Fail CLOSED: an uninspectable handler could smuggle a
+                # streaming method past the guard into an opaque
+                # call-time failure.
+                raise ValueError(
+                    "serve gRPC ingress: only handlers built by "
+                    "grpc.method_handlers_generic_handler (what "
+                    "generated add_XServicer_to_server code uses) can "
+                    "mount onto the proxy")
+            for svc_method, mh in methods.items():
                 if mh.request_streaming or mh.response_streaming:
                     raise ValueError(
                         f"serve gRPC ingress: {svc_method!r} is a "
