@@ -1,0 +1,828 @@
+"""Cluster launcher: bring up a ray_tpu cluster on real (or local) machines.
+
+Parity: the reference's `ray up`/`ray down`/`ray exec`/`ray rsync-up`
+tooling (`python/ray/autoscaler/_private/commands.py`), the SSH
+`CommandRunner` (`python/ray/autoscaler/_private/command_runner.py`), and
+the cloud `NodeProvider` plugins
+(`python/ray/autoscaler/_private/gcp/node_provider.py`, `aws/`,
+`local/node_provider.py`).
+
+Design departures from the reference:
+- Instances and in-cluster nodes are distinct layers. The launcher deals in
+  *instances* (machines reachable over a CommandRunner); once `start
+  --head` / `start --address` runs on them they register as nodes with the
+  head. The in-cluster `Autoscaler` (autoscaler/__init__.py) keeps
+  reconciling demand afterwards.
+- The GCE provider speaks the Compute/TPU REST APIs directly through an
+  injectable `transport` callable (no google-api-python-client dependency);
+  tests inject a fake transport and assert the exact REST traffic.
+- The local provider maps each "instance" onto a private workspace
+  directory + RAY_TPU_STATE_DIR on this machine, which makes the whole
+  up/exec/submit/down flow end-to-end testable with no cloud and no sshd
+  (the role of the reference's `local/node_provider.py` + fake multinode).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shlex
+import shutil
+import socket
+import subprocess
+import sys
+import time
+import uuid
+
+# ---------------------------------------------------------------------------
+# Cluster config
+# ---------------------------------------------------------------------------
+
+_DEFAULT_HEAD_START = [
+    "python -m ray_tpu stop || true",
+    "python -m ray_tpu start --head --port {head_port}",
+]
+_DEFAULT_WORKER_START = [
+    "python -m ray_tpu stop || true",
+    "python -m ray_tpu start --address {head_address}",
+]
+
+
+@dataclasses.dataclass
+class NodeTypeSpec:
+    name: str
+    resources: dict
+    node_config: dict = dataclasses.field(default_factory=dict)
+    min_workers: int = 0
+    max_workers: int = 0
+
+
+@dataclasses.dataclass
+class ClusterConfig:
+    """Validated form of the cluster YAML (reference: ray-schema.json)."""
+
+    cluster_name: str
+    provider: dict
+    available_node_types: dict  # name -> NodeTypeSpec
+    head_node_type: str
+    max_workers: int = 8
+    auth: dict = dataclasses.field(default_factory=dict)
+    file_mounts: dict = dataclasses.field(default_factory=dict)
+    initialization_commands: list = dataclasses.field(default_factory=list)
+    setup_commands: list = dataclasses.field(default_factory=list)
+    head_setup_commands: list = dataclasses.field(default_factory=list)
+    worker_setup_commands: list = dataclasses.field(default_factory=list)
+    head_start_ray_commands: list = dataclasses.field(
+        default_factory=lambda: list(_DEFAULT_HEAD_START))
+    worker_start_ray_commands: list = dataclasses.field(
+        default_factory=lambda: list(_DEFAULT_WORKER_START))
+    head_port: int = 6380
+
+    @staticmethod
+    def from_yaml(path: str) -> "ClusterConfig":
+        import yaml
+        with open(path) as f:
+            raw = yaml.safe_load(f)
+        return ClusterConfig.from_dict(raw)
+
+    @staticmethod
+    def from_dict(raw: dict) -> "ClusterConfig":
+        for key in ("cluster_name", "provider", "available_node_types",
+                    "head_node_type"):
+            if key not in raw:
+                raise ValueError(f"cluster config missing required "
+                                 f"key {key!r}")
+        if "type" not in raw["provider"]:
+            raise ValueError("provider config missing 'type'")
+        types = {}
+        for name, spec in raw["available_node_types"].items():
+            types[name] = NodeTypeSpec(
+                name=name,
+                resources=dict(spec.get("resources", {})),
+                node_config=dict(spec.get("node_config", {})),
+                min_workers=int(spec.get("min_workers", 0)),
+                max_workers=int(spec.get("max_workers",
+                                         spec.get("min_workers", 0))),
+            )
+        if raw["head_node_type"] not in types:
+            raise ValueError(
+                f"head_node_type {raw['head_node_type']!r} not in "
+                f"available_node_types {sorted(types)}")
+        cfg = ClusterConfig(
+            cluster_name=raw["cluster_name"],
+            provider=dict(raw["provider"]),
+            available_node_types=types,
+            head_node_type=raw["head_node_type"],
+            max_workers=int(raw.get("max_workers", 8)),
+            auth=dict(raw.get("auth", {})),
+            file_mounts=dict(raw.get("file_mounts", {})),
+            initialization_commands=list(
+                raw.get("initialization_commands", [])),
+            setup_commands=list(raw.get("setup_commands", [])),
+            head_setup_commands=list(raw.get("head_setup_commands", [])),
+            worker_setup_commands=list(raw.get("worker_setup_commands", [])),
+            head_port=int(raw.get("head_port", 6380)),
+        )
+        if "head_start_ray_commands" in raw:
+            cfg.head_start_ray_commands = list(raw["head_start_ray_commands"])
+        if "worker_start_ray_commands" in raw:
+            cfg.worker_start_ray_commands = list(
+                raw["worker_start_ray_commands"])
+        return cfg
+
+
+# ---------------------------------------------------------------------------
+# Command runners
+# ---------------------------------------------------------------------------
+
+class CommandRunner:
+    """Run shell commands / move files on one instance
+    (parity: command_runner.py CommandRunnerInterface)."""
+
+    def run(self, cmd: str, *, check: bool = True, capture: bool = False,
+            timeout: float = 600.0) -> tuple[int, str]:
+        raise NotImplementedError
+
+    def put(self, local_path: str, remote_path: str):
+        raise NotImplementedError
+
+    def get(self, remote_path: str, local_path: str):
+        raise NotImplementedError
+
+    def wait_ready(self, deadline_s: float = 120.0):
+        end = time.monotonic() + deadline_s
+        last = None
+        while time.monotonic() < end:
+            try:
+                rc, _ = self.run("true", check=False, timeout=15)
+                if rc == 0:
+                    return
+            except Exception as exc:  # noqa: BLE001 — retry until deadline
+                last = exc
+            time.sleep(2.0)
+        raise TimeoutError(f"instance never became reachable: {last}")
+
+
+_SSH_OPTS = [
+    "-o", "StrictHostKeyChecking=no",
+    "-o", "UserKnownHostsFile=/dev/null",
+    "-o", "LogLevel=ERROR",
+    "-o", "ConnectTimeout=10",
+    "-o", "ServerAliveInterval=5",
+    "-o", "ServerAliveCountMax=3",
+]
+
+
+class SSHCommandRunner(CommandRunner):
+    """ssh/rsync against a real machine (parity: SSHCommandRunner)."""
+
+    def __init__(self, ip: str, ssh_user: str = "", ssh_key: str = "",
+                 ssh_port: int = 22, env: dict | None = None):
+        self.ip = ip
+        self.user = ssh_user
+        self.key = ssh_key
+        self.port = ssh_port
+        self.env = dict(env or {})
+
+    def _ssh_base(self) -> list[str]:
+        cmd = ["ssh", *_SSH_OPTS, "-p", str(self.port)]
+        if self.key:
+            cmd += ["-i", self.key]
+        target = f"{self.user}@{self.ip}" if self.user else self.ip
+        return cmd + [target]
+
+    def remote_shell_command(self) -> list[str]:
+        """The argv for an interactive shell (used by `attach`)."""
+        return self._ssh_base()
+
+    def run(self, cmd: str, *, check=True, capture=False, timeout=600.0):
+        envp = "".join(f"export {k}={shlex.quote(str(v))}; "
+                       for k, v in self.env.items())
+        full = self._ssh_base() + [f"bash -c {shlex.quote(envp + cmd)}"]
+        proc = subprocess.run(
+            full, timeout=timeout, text=True,
+            capture_output=capture)
+        if check and proc.returncode != 0:
+            raise RuntimeError(
+                f"ssh command failed ({proc.returncode}): {cmd}\n"
+                f"{(proc.stderr or '') if capture else ''}")
+        return proc.returncode, (proc.stdout or "") if capture else ""
+
+    def _rsync_rsh(self) -> str:
+        parts = ["ssh", *_SSH_OPTS, "-p", str(self.port)]
+        if self.key:
+            parts += ["-i", self.key]
+        return " ".join(shlex.quote(p) for p in parts)
+
+    def put(self, local_path, remote_path):
+        target = (f"{self.user}@{self.ip}" if self.user else self.ip)
+        self.run(f"mkdir -p {shlex.quote(os.path.dirname(remote_path) or '.')}")
+        src = local_path + "/" if os.path.isdir(local_path) else local_path
+        subprocess.run(
+            ["rsync", "-az", "-e", self._rsync_rsh(), src,
+             f"{target}:{remote_path}"], check=True, timeout=600)
+
+    def get(self, remote_path, local_path):
+        target = (f"{self.user}@{self.ip}" if self.user else self.ip)
+        subprocess.run(
+            ["rsync", "-az", "-e", self._rsync_rsh(),
+             f"{target}:{remote_path}", local_path], check=True, timeout=600)
+
+
+class LocalCommandRunner(CommandRunner):
+    """An "instance" that is a workspace directory on this machine.
+
+    Remote absolute paths map under the workspace root; every command runs
+    with a private RAY_TPU_STATE_DIR so several local instances (head +
+    workers) coexist like separate machines.
+    """
+
+    def __init__(self, workspace: str, env: dict | None = None):
+        self.workspace = workspace
+        os.makedirs(workspace, exist_ok=True)
+        self.env = dict(env or {})
+        self.env.setdefault("RAY_TPU_STATE_DIR",
+                            os.path.join(workspace, "state"))
+        # A real machine has ray_tpu installed; the workspace "machine"
+        # borrows this process's copy.
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        self.env.setdefault(
+            "PYTHONPATH",
+            pkg_root + os.pathsep + os.environ.get("PYTHONPATH", ""))
+
+    def map_path(self, remote_path: str) -> str:
+        if os.path.isabs(remote_path):
+            return os.path.join(self.workspace, remote_path.lstrip("/"))
+        return os.path.join(self.workspace, remote_path)
+
+    def run(self, cmd: str, *, check=True, capture=False, timeout=600.0):
+        env = dict(os.environ)
+        env.update({k: str(v) for k, v in self.env.items()})
+        proc = subprocess.run(
+            ["bash", "-c", cmd], cwd=self.workspace, env=env,
+            timeout=timeout, text=True, capture_output=capture)
+        if check and proc.returncode != 0:
+            raise RuntimeError(
+                f"local command failed ({proc.returncode}): {cmd}\n"
+                f"{(proc.stderr or '') if capture else ''}")
+        return proc.returncode, (proc.stdout or "") if capture else ""
+
+    def put(self, local_path, remote_path):
+        dst = self.map_path(remote_path)
+        os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
+        if os.path.isdir(local_path):
+            shutil.copytree(local_path, dst, dirs_exist_ok=True)
+        else:
+            shutil.copy2(local_path, dst)
+
+    def get(self, remote_path, local_path):
+        src = self.map_path(remote_path)
+        if os.path.isdir(src):
+            shutil.copytree(src, local_path, dirs_exist_ok=True)
+        else:
+            os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)
+            shutil.copy2(src, local_path)
+
+    def remote_shell_command(self) -> list[str]:
+        return ["bash"]
+
+
+# ---------------------------------------------------------------------------
+# Instance providers
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Instance:
+    instance_id: str
+    ip: str
+    tags: dict
+    state: str = "running"
+
+
+class InstanceProvider:
+    """Launcher-side machine lifecycle (parity: NodeProvider plugins)."""
+
+    def __init__(self, provider_config: dict, cluster_name: str):
+        self.config = provider_config
+        self.cluster_name = cluster_name
+
+    def non_terminated_instances(self, tag_filters: dict) -> list[Instance]:
+        raise NotImplementedError
+
+    def create_instance(self, node_type: NodeTypeSpec, tags: dict,
+                        auth: dict) -> Instance:
+        raise NotImplementedError
+
+    def terminate_instance(self, instance_id: str):
+        raise NotImplementedError
+
+    def command_runner(self, inst: Instance, auth: dict) -> CommandRunner:
+        raise NotImplementedError
+
+
+class LocalProvider(InstanceProvider):
+    """Instances as workspace dirs on this machine (testable end to end)."""
+
+    def __init__(self, provider_config, cluster_name):
+        super().__init__(provider_config, cluster_name)
+        self.root = provider_config.get(
+            "workspace_root",
+            os.path.join("/tmp", "ray_tpu_launcher", cluster_name))
+        os.makedirs(self.root, exist_ok=True)
+        self._state_path = os.path.join(self.root, "instances.json")
+
+    def _load(self) -> dict:
+        try:
+            with open(self._state_path) as f:
+                return json.load(f)
+        except (FileNotFoundError, ValueError):
+            return {}
+
+    def _save(self, state: dict):
+        tmp = self._state_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f, indent=1)
+        os.replace(tmp, self._state_path)
+
+    def non_terminated_instances(self, tag_filters):
+        out = []
+        for iid, rec in self._load().items():
+            if rec.get("state") != "running":
+                continue
+            if all(rec["tags"].get(k) == v for k, v in tag_filters.items()):
+                out.append(Instance(iid, rec["ip"], dict(rec["tags"]),
+                                    rec["state"]))
+        return out
+
+    def create_instance(self, node_type, tags, auth):
+        iid = f"local-{uuid.uuid4().hex[:8]}"
+        state = self._load()
+        state[iid] = {"ip": "127.0.0.1", "tags": dict(tags),
+                      "state": "running", "node_type": node_type.name}
+        os.makedirs(os.path.join(self.root, iid), exist_ok=True)
+        self._save(state)
+        return Instance(iid, "127.0.0.1", dict(tags))
+
+    def terminate_instance(self, instance_id):
+        state = self._load()
+        rec = state.get(instance_id)
+        if rec is None:
+            return
+        runner = LocalCommandRunner(os.path.join(self.root, instance_id))
+        try:  # stop any head/agent processes this instance started
+            runner.run("python -m ray_tpu stop || true", check=False,
+                       timeout=30)
+        except Exception:  # noqa: BLE001 — teardown is best-effort
+            pass
+        rec["state"] = "terminated"
+        self._save(state)
+
+    def command_runner(self, inst, auth):
+        return LocalCommandRunner(os.path.join(self.root, inst.instance_id))
+
+
+class SSHProvider(InstanceProvider):
+    """A fixed inventory of machines reachable over SSH (parity:
+    `local/node_provider.py` with a `worker_ips` list)."""
+
+    def __init__(self, provider_config, cluster_name):
+        super().__init__(provider_config, cluster_name)
+        self.head_ip = provider_config.get("head_ip", "")
+        self.worker_ips = list(provider_config.get("worker_ips", []))
+        self._state_path = os.path.join(
+            "/tmp", "ray_tpu_launcher", cluster_name, "ssh_instances.json")
+        os.makedirs(os.path.dirname(self._state_path), exist_ok=True)
+
+    def _load(self):
+        try:
+            with open(self._state_path) as f:
+                return json.load(f)
+        except (FileNotFoundError, ValueError):
+            return {}
+
+    def _save(self, state):
+        with open(self._state_path, "w") as f:
+            json.dump(state, f)
+
+    def non_terminated_instances(self, tag_filters):
+        out = []
+        for iid, rec in self._load().items():
+            if rec.get("state") != "running":
+                continue
+            if all(rec["tags"].get(k) == v for k, v in tag_filters.items()):
+                out.append(Instance(iid, rec["ip"], dict(rec["tags"])))
+        return out
+
+    def create_instance(self, node_type, tags, auth):
+        state = self._load()
+        used = {rec["ip"] for rec in state.values()
+                if rec.get("state") == "running"}
+        if tags.get("node_kind") == "head":
+            if not self.head_ip:
+                raise RuntimeError("ssh provider needs provider.head_ip")
+            ip = self.head_ip
+        else:
+            free = [ip for ip in self.worker_ips if ip not in used]
+            if not free:
+                raise RuntimeError("ssh provider: no free worker_ips left")
+            ip = free[0]
+        iid = f"ssh-{ip.replace('.', '-')}"
+        state[iid] = {"ip": ip, "tags": dict(tags), "state": "running"}
+        self._save(state)
+        return Instance(iid, ip, dict(tags))
+
+    def terminate_instance(self, instance_id):
+        state = self._load()
+        if instance_id in state:
+            state[instance_id]["state"] = "terminated"
+            self._save(state)
+
+    def command_runner(self, inst, auth):
+        return SSHCommandRunner(
+            inst.ip, ssh_user=auth.get("ssh_user", ""),
+            ssh_key=auth.get("ssh_private_key", ""),
+            ssh_port=int(auth.get("ssh_port", 22)))
+
+
+class GCEProvider(InstanceProvider):
+    """GCE VMs + Cloud TPU VMs over the raw REST APIs.
+
+    Parity: `python/ray/autoscaler/_private/gcp/node_provider.py` (which
+    wraps google-api-python-client); here the HTTP layer is a single
+    injectable `transport(method, url, body) -> dict` so the provider is
+    unit-testable with zero egress and has no SDK dependency.
+
+    node_config keys understood:
+      machine_type, source_image, accelerator_type (TPU: e.g. "v5e-8" →
+      creates a TPU VM via tpu.googleapis.com v2), zone override.
+    """
+
+    COMPUTE = "https://compute.googleapis.com/compute/v1"
+    TPU = "https://tpu.googleapis.com/v2"
+
+    def __init__(self, provider_config, cluster_name, transport=None):
+        super().__init__(provider_config, cluster_name)
+        self.project = provider_config.get("project_id", "")
+        self.zone = provider_config.get("availability_zone",
+                                        provider_config.get("zone", ""))
+        self.transport = transport or self._default_transport
+        self._token = provider_config.get("access_token", "")
+
+    # -- auth/transport --------------------------------------------------
+
+    def _access_token(self) -> str:
+        if self._token:
+            return self._token
+        tok = os.environ.get("GCE_ACCESS_TOKEN", "")
+        if tok:
+            return tok
+        # On a GCE/TPU VM the metadata server vends a token.
+        import urllib.request
+        req = urllib.request.Request(
+            "http://metadata.google.internal/computeMetadata/v1/instance/"
+            "service-accounts/default/token",
+            headers={"Metadata-Flavor": "Google"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return json.loads(resp.read())["access_token"]
+
+    def _default_transport(self, method: str, url: str, body: dict | None):
+        import urllib.request
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            url, data=data, method=method,
+            headers={"Authorization": f"Bearer {self._access_token()}",
+                     "Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            payload = resp.read()
+        return json.loads(payload) if payload else {}
+
+    # -- REST helpers ----------------------------------------------------
+
+    def _wait_op(self, op: dict, deadline_s: float = 600.0):
+        """Poll a zonal compute/TPU operation until DONE."""
+        end = time.monotonic() + deadline_s
+        url = op.get("selfLink") or op.get("name", "")
+        if url and not url.startswith("http"):
+            url = f"{self.TPU}/{url}"  # TPU ops come back as names
+        while time.monotonic() < end:
+            cur = self.transport("GET", url, None)
+            status = cur.get("status", "")
+            if status == "DONE" or cur.get("done") is True:
+                err = cur.get("error")
+                if err:
+                    raise RuntimeError(f"cloud operation failed: {err}")
+                return cur
+            time.sleep(2.0)
+        raise TimeoutError(f"cloud operation did not finish: {url}")
+
+    def _instance_url(self, name: str) -> str:
+        return (f"{self.COMPUTE}/projects/{self.project}/zones/{self.zone}"
+                f"/instances/{name}")
+
+    # -- provider interface ----------------------------------------------
+
+    @staticmethod
+    def _tags_of(labels: dict) -> dict:
+        return {k.replace("ray-", "", 1).replace("-", "_"): v
+                for k, v in labels.items()}
+
+    def non_terminated_instances(self, tag_filters):
+        out = []
+        flt = (f"labels.ray-cluster-name={self.cluster_name}")
+        resp = self.transport(
+            "GET",
+            f"{self.COMPUTE}/projects/{self.project}/zones/{self.zone}"
+            f"/instances?filter={flt}", None)
+        for item in resp.get("items", []):
+            if item.get("status") not in ("RUNNING", "PROVISIONING",
+                                          "STAGING"):
+                continue
+            tags = self._tags_of(item.get("labels", {}))
+            if not all(tags.get(k) == v for k, v in tag_filters.items()):
+                continue
+            ip = ""
+            for iface in item.get("networkInterfaces", []):
+                ip = iface.get("networkIP", ip)
+                for ac in iface.get("accessConfigs", []):
+                    ip = ac.get("natIP", ip)
+            out.append(Instance(item["name"], ip, tags,
+                                item.get("status", "").lower()))
+        # TPU VMs live in the TPU API, not Compute — without this leg,
+        # `down` would leak slices and `up` would duplicate them.
+        resp = self.transport(
+            "GET",
+            f"{self.TPU}/projects/{self.project}/locations/{self.zone}"
+            f"/nodes", None)
+        for node in resp.get("nodes", []):
+            if node.get("state") not in ("READY", "CREATING", None):
+                continue
+            labels = node.get("labels", {})
+            if labels.get("ray-cluster-name") != self.cluster_name:
+                continue
+            tags = self._tags_of(labels)
+            if not all(tags.get(k) == v for k, v in tag_filters.items()):
+                continue
+            eps = node.get("networkEndpoints", [{}])
+            ip = (eps[0].get("accessConfig", {}).get("externalIp")
+                  or eps[0].get("ipAddress", ""))
+            name = node.get("name", "").rsplit("/", 1)[-1]
+            out.append(Instance(name, ip, tags,
+                                node.get("state", "").lower()))
+        return out
+
+    def create_instance(self, node_type, tags, auth):
+        name = (f"ray-{self.cluster_name}-{tags.get('node_kind', 'worker')}-"
+                f"{uuid.uuid4().hex[:6]}")
+        nc = dict(node_type.node_config)
+        accel = nc.get("accelerator_type", "")
+        labels = {"ray-cluster-name": self.cluster_name}
+        labels.update({f"ray-{k.replace('_', '-')}": v
+                       for k, v in tags.items()})
+        if accel.startswith("v"):  # a TPU VM, not a GCE VM
+            body = {
+                "acceleratorType": accel,
+                "runtimeVersion": nc.get("runtime_version",
+                                         "tpu-ubuntu2204-base"),
+                "labels": labels,
+                "networkConfig": {"enableExternalIps": True},
+            }
+            op = self.transport(
+                "POST",
+                f"{self.TPU}/projects/{self.project}/locations/{self.zone}"
+                f"/nodes?nodeId={name}", body)
+            self._wait_op(op)
+            node = self.transport(
+                "GET",
+                f"{self.TPU}/projects/{self.project}/locations/{self.zone}"
+                f"/nodes/{name}", None)
+            eps = node.get("networkEndpoints", [{}])
+            ip = (eps[0].get("accessConfig", {}).get("externalIp")
+                  or eps[0].get("ipAddress", ""))
+            return Instance(name, ip, dict(tags))
+        mt = nc.get("machine_type", "n2-standard-8")
+        body = {
+            "name": name,
+            "machineType": (f"zones/{self.zone}/machineTypes/{mt}"),
+            "labels": labels,
+            "disks": [{
+                "boot": True, "autoDelete": True,
+                "initializeParams": {
+                    "sourceImage": nc.get(
+                        "source_image",
+                        "projects/debian-cloud/global/images/family/"
+                        "debian-12"),
+                    "diskSizeGb": str(nc.get("disk_size_gb", 100)),
+                },
+            }],
+            "networkInterfaces": [{
+                "network": "global/networks/default",
+                "accessConfigs": [{"type": "ONE_TO_ONE_NAT"}],
+            }],
+        }
+        op = self.transport(
+            "POST",
+            f"{self.COMPUTE}/projects/{self.project}/zones/{self.zone}"
+            f"/instances", body)
+        self._wait_op(op)
+        inst = self.transport("GET", self._instance_url(name), None)
+        ip = ""
+        for iface in inst.get("networkInterfaces", []):
+            ip = iface.get("networkIP", ip)
+            for ac in iface.get("accessConfigs", []):
+                ip = ac.get("natIP", ip)
+        return Instance(name, ip, dict(tags))
+
+    def terminate_instance(self, instance_id):
+        try:
+            op = self.transport("DELETE", self._instance_url(instance_id),
+                                None)
+            self._wait_op(op)
+        except Exception:  # noqa: BLE001 — maybe a TPU VM, try that API
+            op = self.transport(
+                "DELETE",
+                f"{self.TPU}/projects/{self.project}/locations/{self.zone}"
+                f"/nodes/{instance_id}", None)
+            self._wait_op(op)
+
+    def command_runner(self, inst, auth):
+        return SSHCommandRunner(
+            inst.ip, ssh_user=auth.get("ssh_user", ""),
+            ssh_key=auth.get("ssh_private_key", ""),
+            ssh_port=int(auth.get("ssh_port", 22)))
+
+
+_PROVIDERS = {
+    "local": LocalProvider,
+    "ssh": SSHProvider,
+    "gce": GCEProvider,
+}
+
+
+def make_provider(config: ClusterConfig, **kw) -> InstanceProvider:
+    ptype = config.provider["type"]
+    try:
+        cls = _PROVIDERS[ptype]
+    except KeyError:
+        raise ValueError(
+            f"unknown provider type {ptype!r}; have {sorted(_PROVIDERS)}")
+    return cls(config.provider, config.cluster_name, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Commands (up / down / exec / rsync / submit)
+# ---------------------------------------------------------------------------
+
+def _subst(cmds: list[str], **vars_) -> list[str]:
+    return [c.format(**vars_) for c in cmds]
+
+
+def _sync_mounts(runner: CommandRunner, mounts: dict):
+    for remote, local in mounts.items():
+        runner.put(os.path.expanduser(local), remote)
+
+
+def _pick_free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _head_address(config: ClusterConfig, runner: CommandRunner) -> str:
+    """Read the address the head published (start --head wrote it under the
+    instance's RAY_TPU_STATE_DIR)."""
+    _, out = runner.run(
+        'cat "${RAY_TPU_STATE_DIR:-/tmp/ray_tpu}/ray_current_address"',
+        capture=True, timeout=30)
+    return out.strip()
+
+
+def _bootstrap_instance(config: ClusterConfig, provider: InstanceProvider,
+                        kind: str, node_type: NodeTypeSpec,
+                        head_address: str = "",
+                        verbose: bool = True) -> tuple[Instance,
+                                                       CommandRunner]:
+    inst = provider.create_instance(
+        node_type, {"node_kind": kind, "node_type": node_type.name},
+        config.auth)
+    runner = provider.command_runner(inst, config.auth)
+    runner.wait_ready()
+    log = print if verbose else (lambda *_: None)
+    log(f"[launcher] {kind} instance {inst.instance_id} @ {inst.ip}")
+    for cmd in config.initialization_commands:
+        runner.run(cmd)
+    _sync_mounts(runner, config.file_mounts)
+    setup = config.setup_commands + (
+        config.head_setup_commands if kind == "head"
+        else config.worker_setup_commands)
+    for cmd in setup:
+        runner.run(cmd)
+    start = (_subst(config.head_start_ray_commands,
+                    head_port=config.head_port)
+             if kind == "head" else
+             _subst(config.worker_start_ray_commands,
+                    head_address=head_address))
+    for cmd in start:
+        log(f"[launcher]   $ {cmd}")
+        runner.run(cmd, timeout=900)
+    return inst, runner
+
+
+def create_or_update_cluster(config: ClusterConfig,
+                             verbose: bool = True) -> str:
+    """`ray up`: ensure head + min_workers are running; returns the head
+    cluster address (host:port)."""
+    provider = make_provider(config)
+    heads = provider.non_terminated_instances({"node_kind": "head"})
+    if heads:
+        head = heads[0]
+        runner = provider.command_runner(head, config.auth)
+        if verbose:
+            print(f"[launcher] reusing head {head.instance_id} @ {head.ip}")
+    else:
+        head_type = config.available_node_types[config.head_node_type]
+        head, runner = _bootstrap_instance(config, provider, "head",
+                                           head_type, verbose=verbose)
+    address = _head_address(config, runner)
+    if not address:
+        raise RuntimeError("head did not publish a cluster address")
+    # The launcher's address is instance-relative ("127.0.0.1:port" or the
+    # head's private IP); rewrite the host to the instance IP we can reach.
+    port = address.rsplit(":", 1)[1]
+    address = f"{head.ip}:{port}"
+
+    for name, nt in config.available_node_types.items():
+        existing = provider.non_terminated_instances(
+            {"node_kind": "worker", "node_type": name})
+        for _ in range(nt.min_workers - len(existing)):
+            _bootstrap_instance(config, provider, "worker", nt,
+                                head_address=address, verbose=verbose)
+    if verbose:
+        print(f"[launcher] cluster {config.cluster_name!r} up at {address}")
+        print(f"[launcher] connect: ray_tpu.init(address={address!r})")
+    return address
+
+
+def teardown_cluster(config: ClusterConfig, verbose: bool = True):
+    """`ray down`: terminate every instance of this cluster."""
+    provider = make_provider(config)
+    for inst in provider.non_terminated_instances({}):
+        if verbose:
+            print(f"[launcher] terminating {inst.instance_id}")
+        provider.terminate_instance(inst.instance_id)
+
+
+def get_head_instance(config: ClusterConfig,
+                      provider: InstanceProvider | None = None) -> Instance:
+    provider = provider or make_provider(config)
+    heads = provider.non_terminated_instances({"node_kind": "head"})
+    if not heads:
+        raise RuntimeError(f"cluster {config.cluster_name!r} has no "
+                           f"running head (run `up` first)")
+    return heads[0]
+
+
+def _head_runner(config: ClusterConfig) -> CommandRunner:
+    provider = make_provider(config)
+    head = get_head_instance(config, provider)
+    return provider.command_runner(head, config.auth)
+
+
+def exec_cluster(config: ClusterConfig, cmd: str,
+                 capture: bool = False) -> tuple[int, str]:
+    """`ray exec`: run a shell command on the head instance."""
+    return _head_runner(config).run(cmd, check=False, capture=capture)
+
+
+def rsync(config: ClusterConfig, source: str, target: str, down: bool):
+    runner = _head_runner(config)
+    if down:
+        runner.get(source, target)
+    else:
+        runner.put(source, target)
+
+
+def submit(config: ClusterConfig, script: str, args: list[str] | None = None,
+           capture: bool = False) -> tuple[int, str]:
+    """`ray submit`: upload a script to the head and run it there."""
+    runner = _head_runner(config)
+    # Relative remote path: lands in $HOME over SSH and in the workspace
+    # on the local provider — either way the same path the run command sees.
+    remote = f"ray_tpu_submit/{os.path.basename(script)}"
+    runner.put(script, remote)
+    argstr = " ".join(shlex.quote(a) for a in (args or []))
+    # This machine's interpreter path only exists on local "instances";
+    # real machines run whatever `python3` resolves to there.
+    python = (shlex.quote(sys.executable)
+              if isinstance(runner, LocalCommandRunner) else "python3")
+    return runner.run(f"{python} {remote} {argstr}",
+                      check=False, capture=capture, timeout=3600)
+
+
+def attach(config: ClusterConfig):
+    """`ray attach`: replace this process with a shell on the head."""
+    argv = _head_runner(config).remote_shell_command()
+    os.execvp(argv[0], argv)

@@ -65,6 +65,17 @@ def _cmd_start(args):
         if args.block:
             os.execv(sys.executable, cmd)
         proc = subprocess.Popen(cmd, start_new_session=True)
+        # Record the agent pid so `ray_tpu stop` on this machine kills it
+        # (the reference's `ray stop` kills the local raylet the same way).
+        os.makedirs(_STATE_DIR, exist_ok=True)
+        pids = []
+        try:
+            with open(_PID_FILE) as f:
+                pids = json.loads(f.read())
+        except (FileNotFoundError, ValueError):
+            pass
+        with open(_PID_FILE, "w") as f:
+            f.write(json.dumps(pids + [proc.pid]))
         print(f"node agent started (pid {proc.pid}), joined {args.address}")
         return
     if args.block:
@@ -270,10 +281,80 @@ def _cmd_job(args):
         _print_rows([j.to_dict() for j in client.list_jobs()], "table")
 
 
+def _launcher_config(args):
+    from ray_tpu.autoscaler.launcher import ClusterConfig
+    return ClusterConfig.from_yaml(args.cluster_config)
+
+
+def _cmd_up(args):
+    from ray_tpu.autoscaler import launcher
+    launcher.create_or_update_cluster(_launcher_config(args))
+
+
+def _cmd_down(args):
+    from ray_tpu.autoscaler import launcher
+    launcher.teardown_cluster(_launcher_config(args))
+
+
+def _cmd_exec(args):
+    from ray_tpu.autoscaler import launcher
+    rc, _ = launcher.exec_cluster(_launcher_config(args),
+                                  " ".join(args.command))
+    sys.exit(rc)
+
+
+def _cmd_submit(args):
+    from ray_tpu.autoscaler import launcher
+    rc, _ = launcher.submit(_launcher_config(args), args.script,
+                            args.script_args)
+    sys.exit(rc)
+
+
+def _cmd_attach(args):
+    from ray_tpu.autoscaler import launcher
+    launcher.attach(_launcher_config(args))
+
+
+def _cmd_rsync(args):
+    from ray_tpu.autoscaler import launcher
+    launcher.rsync(_launcher_config(args), args.source, args.target,
+                   down=(args.cmd == "rsync-down"))
+
+
+def _cmd_get_head_ip(args):
+    from ray_tpu.autoscaler import launcher
+    print(launcher.get_head_instance(_launcher_config(args)).ip)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         prog="ray_tpu", description="ray_tpu cluster CLI")
     sub = p.add_subparsers(dest="cmd", required=True)
+
+    # Cluster launcher (parity: `ray up/down/exec/submit/attach/rsync`).
+    for name, fn, extra in (
+            ("up", _cmd_up, []),
+            ("down", _cmd_down, []),
+            ("attach", _cmd_attach, []),
+            ("get-head-ip", _cmd_get_head_ip, [])):
+        sp = sub.add_parser(name, help=f"cluster launcher: {name}")
+        sp.add_argument("cluster_config", help="cluster YAML")
+        sp.set_defaults(fn=fn)
+    sp = sub.add_parser("exec", help="run a shell command on the head")
+    sp.add_argument("cluster_config")
+    sp.add_argument("command", nargs=argparse.REMAINDER)
+    sp.set_defaults(fn=_cmd_exec)
+    sp = sub.add_parser("submit", help="upload + run a script on the head")
+    sp.add_argument("cluster_config")
+    sp.add_argument("script")
+    sp.add_argument("script_args", nargs=argparse.REMAINDER)
+    sp.set_defaults(fn=_cmd_submit)
+    for name in ("rsync-up", "rsync-down"):
+        sp = sub.add_parser(name)
+        sp.add_argument("cluster_config")
+        sp.add_argument("source")
+        sp.add_argument("target")
+        sp.set_defaults(fn=_cmd_rsync)
 
     sp = sub.add_parser("start", help="start a head node or join a cluster")
     sp.add_argument("--head", action="store_true")
