@@ -22,12 +22,14 @@ from ray_tpu.data.datasource import (  # noqa: F401
     decode_image,
     from_huggingface,
     from_torch,
+    read_avro,
     read_binary_files,
     read_csv,
     read_images,
     read_json,
     read_numpy,
     read_parquet,
+    read_sql,
     read_text,
     read_tfrecord,
     read_webdataset,
@@ -38,6 +40,6 @@ __all__ = [
     "range", "from_items", "from_pandas", "from_numpy", "from_arrow",
     "read_parquet", "read_csv", "read_json", "read_text",
     "read_binary_files", "read_numpy", "read_images",
-    "read_tfrecord", "read_webdataset",
+    "read_tfrecord", "read_webdataset", "read_avro", "read_sql",
     "from_huggingface", "from_torch", "decode_image",
 ]

@@ -421,6 +421,10 @@ class Dataset:
         (dependency-free codec, readable by any TF input pipeline)."""
         self._write(path, "tfrecord")
 
+    def write_avro(self, path: str) -> None:
+        """One avro object container file per block (built-in codec)."""
+        self._write(path, "avro")
+
     def _write(self, path: str, fmt: str) -> None:
         import os
         os.makedirs(path, exist_ok=True)

@@ -92,6 +92,60 @@ def read_numpy(paths, **_kw) -> Dataset:
     return _make_read(paths, one, "ReadNumpy")
 
 
+def read_avro(paths, **_kw) -> Dataset:
+    """Avro object container files via the built-in codec
+    (parity: avro_datasource.py, minus the fastavro dependency)."""
+    def one(f):
+        from ray_tpu.data import avro
+        _schema, records = avro.read_file(f)
+        if not records:
+            return pa.table({})
+        cols = {k: [r.get(k) for r in records] for k in records[0]}
+        return pa.table(cols)
+    return _make_read(paths, one, "ReadAvro")
+
+
+def read_sql(sql: str, connection_factory: Callable, *,
+             shard_keys: list | None = None, parallelism: int = 1,
+             **_kw) -> Dataset:
+    """Run a query through any DBAPI-2 connection factory.
+
+    Parity: reference `data.read_sql` (`read_api.py` — connection_factory
+    + optional sharding). With `shard_keys` and parallelism > 1 the query
+    is split into hash shards `WHERE MOD(ABS(<key expr>), P) = i`, one
+    read task each; otherwise one task runs the query whole.
+    """
+    def run_query(query: str) -> pa.Table:
+        conn = connection_factory()
+        try:
+            cur = conn.cursor()
+            cur.execute(query)
+            names = [d[0] for d in cur.description]
+            rows = cur.fetchall()
+        finally:
+            conn.close()
+        cols = {n: [r[i] for r in rows] for i, n in enumerate(names)}
+        return pa.table(cols) if rows else pa.table(
+            {n: pa.array([], type=pa.null()) for n in names})
+
+    if shard_keys and parallelism > 1:
+        key = " + ".join(f"CAST({k} AS INTEGER)" for k in shard_keys)
+        queries = [
+            f"SELECT * FROM ({sql}) AS _rtpu_shard WHERE MOD(ABS({key}), "
+            f"{parallelism}) = {i}"
+            for i in range(parallelism)]
+    else:
+        queries = [sql]
+
+    def mk(q):
+        def read(q=q):
+            return run_query(q)
+        return read
+
+    return Dataset(plan_mod.LogicalPlan(
+        [plan_mod.Read(name="ReadSQL", read_fns=[mk(q) for q in queries])]))
+
+
 @ray_tpu.remote
 def write_block_task(block, path: str, index: int, fmt: str) -> str:
     from ray_tpu.data.block import BlockAccessor
@@ -113,6 +167,9 @@ def write_block_task(block, path: str, index: int, fmt: str) -> str:
             out, (tfr.encode_example(
                 {k: v for k, v in row.items() if v is not None})
                 for row in rows))
+    elif fmt == "avro":
+        from ray_tpu.data import avro
+        avro.write_file(out, avro.schema_for_table(t), t.to_pylist())
     else:
         raise ValueError(f"unknown write format {fmt}")
     return out
