@@ -98,15 +98,32 @@ def build_worker_env(config, node_id_hex: str,
 
 
 def apply_pip_env(env: dict, zygote, pip: list | None):
-    """Prepare a worker spawn for a pip runtime env: build/reuse the env,
-    point the worker at it, and force a cold spawn (the zygote's env is
-    baked at fork-server start). Returns (env, zygote, env_key). Shared by
-    the head runtime and node agents."""
+    """Prepare a worker spawn for a package runtime env (pip/uv/conda/
+    container): build/reuse the env, point the worker at it, and force a
+    cold spawn (the zygote's env is baked at fork-server start). Returns
+    (env, zygote, env_key). Shared by the head runtime and node agents."""
     if not pip:
         return env, zygote, None
-    from ray_tpu.core.runtime_env import ensure_pip_env, pip_env_key
+    from ray_tpu.core.runtime_env import (
+        _norm_spec,
+        ensure_conda_env,
+        ensure_pip_env,
+        pip_env_key,
+    )
+    tool, pkgs = _norm_spec(pip)
     env = dict(env)
-    env["RAY_TPU_VENV_SITE"] = ensure_pip_env(pip)
+    if tool == "conda":
+        # A whole-interpreter env: the worker runs the env's own python
+        # (parity: runtime_env/conda.py activating the env for the worker).
+        prefix = ensure_conda_env(pkgs)
+        env["RAY_TPU_PYTHON"] = os.path.join(prefix, "bin", "python")
+        env["CONDA_PREFIX"] = prefix
+    elif tool == "container":
+        # spawn_worker_process wraps the worker in `podman run` (it owns
+        # the session dir needed for the mounts).
+        env["RAY_TPU_CONTAINER_IMAGE"] = pkgs[0]
+    else:
+        env["RAY_TPU_VENV_SITE"] = ensure_pip_env(pip)
     env_key = pip_env_key(pip)
     env["RAY_TPU_ENV_KEY"] = env_key
     return env, None, env_key
@@ -136,12 +153,33 @@ def spawn_worker_process(worker_id: WorkerID, store_path: str, env: dict,
     if proc is None:
         parent, child = socket_mod.socketpair(
             socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.worker",
-             store_path, worker_id.hex(), str(child.fileno())],
-            pass_fds=[child.fileno()], env=env,
-            close_fds=True, stdout=open(log_path, "ab"),
-            stderr=subprocess.STDOUT)
+        python = env.get("RAY_TPU_PYTHON") or sys.executable
+        image = env.get("RAY_TPU_CONTAINER_IMAGE", "")
+        if image:
+            # Container wrapper (podman --preserve-fds=1 maps fd 3): the
+            # worker's socketpair end must sit at exactly fd 3 inside.
+            # close_fds=False + preexec dup2: dup2's result fd has no
+            # CLOEXEC so it survives exec, while every other parent fd is
+            # CLOEXEC by Python default (pass_fds can't express "keep the
+            # fd I will only create in the child's preexec").
+            from ray_tpu.core.runtime_env import container_worker_argv
+            repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+            fd = child.fileno()
+            cmd = (container_worker_argv(image, session_dir, repo_root)
+                   + ["python", "-m", "ray_tpu.core.worker",
+                      store_path, worker_id.hex(), "3"])
+            proc = subprocess.Popen(
+                cmd, env=env, close_fds=False,
+                preexec_fn=lambda: os.dup2(fd, 3),
+                stdout=open(log_path, "ab"), stderr=subprocess.STDOUT)
+        else:
+            proc = subprocess.Popen(
+                [python, "-m", "ray_tpu.core.worker",
+                 store_path, worker_id.hex(), str(child.fileno())],
+                pass_fds=[child.fileno()], env=env,
+                close_fds=True, stdout=open(log_path, "ab"),
+                stderr=subprocess.STDOUT)
     child.close()
     return parent, proc
 
@@ -595,12 +633,15 @@ class TaskEventBuffer:
 
     def __init__(self, maxlen: int, export=None):
         self.events = collections.deque(maxlen=maxlen)
+        self.finished_total = 0  # monotonic, survives ring eviction
         self._export = export  # ExportEventWriter | None (off the hot path
         # unless the export_events config flag is set)
 
     def record(self, task_id: bytes, spec, state: str):
         name = spec if isinstance(spec, str) else (spec.name, spec.method_name)
         self.events.append((time.time(), task_id, name, state))
+        if state == "FINISHED":
+            self.finished_total += 1
         if self._export is not None:
             self._export.emit("TASK", task_id=task_id.hex(),
                               name=self._name(name), state=state)

@@ -580,3 +580,94 @@ def test_runtime_env_uv(ray_start_isolated, tmp_path):
         assert renv.build_count(("uv", pkgs)) == 1
     finally:
         os.environ.pop("RAY_TPU_ENV_CACHE", None)
+
+
+def test_runtime_env_conda(ray_start_isolated, tmp_path):
+    """runtime_env={"conda": {...}} builds a content-hashed whole-
+    interpreter env and runs the task under the env's own python (parity:
+    runtime_env/conda.py). A stub conda binary stands in for the real one
+    (not in this image): it materializes PREFIX/bin/python as a wrapper
+    around the host interpreter that brands the environment."""
+    import os
+    import stat
+    import textwrap
+
+    from ray_tpu.core import runtime_env as renv
+
+    fake_conda = tmp_path / "conda"
+    fake_conda.write_text(textwrap.dedent(f"""\
+        #!/bin/sh
+        if [ "$1" = "env" ] && [ "$2" = "create" ]; then
+            prefix="$4"
+            mkdir -p "$prefix/bin"
+            cat > "$prefix/bin/python" <<WRAP
+        #!/bin/sh
+        export RAY_TPU_FAKE_CONDA_PREFIX="$prefix"
+        exec {os.sys.executable} "\\$@"
+        WRAP
+            chmod +x "$prefix/bin/python"
+            exit 0
+        fi
+        if [ "$1" = "env" ] && [ "$2" = "list" ]; then
+            echo '{{"envs": []}}'
+            exit 0
+        fi
+        exit 1
+    """))
+    fake_conda.chmod(fake_conda.stat().st_mode | stat.S_IEXEC)
+
+    deps = ["python=3.11", "cowsay=5.0"]
+    os.environ["RAY_TPU_CONDA_EXE"] = str(fake_conda)
+    os.environ["RAY_TPU_ENV_CACHE"] = str(tmp_path / "envcache")
+    try:
+        @ray_tpu.remote(runtime_env={"conda": {"dependencies": deps}})
+        def probe():
+            return (os.environ.get("RAY_TPU_FAKE_CONDA_PREFIX"),
+                    os.environ.get("CONDA_PREFIX"),
+                    os.environ.get("RAY_TPU_ENV_KEY"))
+
+        fake_prefix, conda_prefix, key = ray_tpu.get(probe.remote(),
+                                                     timeout=120)
+        # The worker really ran through PREFIX/bin/python.
+        assert fake_prefix and fake_prefix == conda_prefix
+        assert os.path.basename(conda_prefix).startswith("conda-")
+        assert key == renv.pip_env_key(("conda", sorted(deps)))
+        assert renv.build_count(("conda", sorted(deps))) == 1
+
+        # Cache hit on reuse; default pool untouched.
+        ray_tpu.get(probe.remote(), timeout=120)
+        assert renv.build_count(("conda", sorted(deps))) == 1
+
+        @ray_tpu.remote
+        def host_probe():
+            return os.environ.get("RAY_TPU_FAKE_CONDA_PREFIX") is None
+
+        assert ray_tpu.get(host_probe.remote(), timeout=60)
+    finally:
+        os.environ.pop("RAY_TPU_CONDA_EXE", None)
+        os.environ.pop("RAY_TPU_ENV_CACHE", None)
+
+
+def test_runtime_env_container_argv():
+    """The container worker command matches the reference's podman launch
+    (image_uri.py): host ipc/net for the shm arena + transport, session
+    dir and source mounted, fd 3 preserved for the control socketpair."""
+    from ray_tpu.core.runtime_env import container_worker_argv, env_spec
+
+    argv = container_worker_argv("rayproject/ray:2.44.0", "/tmp/sess",
+                                 "/repo")
+    joined = " ".join(argv)
+    assert argv[1] == "run"
+    assert "--ipc=host" in argv and "--network=host" in argv
+    assert "--preserve-fds=1" in argv
+    assert "/tmp/sess:/tmp/sess" in joined and "/repo:/repo:ro" in joined
+    assert argv[-1] == "rayproject/ray:2.44.0"
+
+    # Both runtime_env spellings resolve to the same env spec.
+    assert env_spec({"image_uri": "img:1"}) == ("container", ["img:1"])
+    assert env_spec({"container": {"image": "img:1"}}) == (
+        "container", ["img:1"])
+    # And conda named-env vs dependency-list forms stay distinct.
+    assert env_spec({"conda": "base"}) == ("conda", ["env:base"])
+    assert env_spec({"conda": {"dependencies": ["numpy"]}}) == (
+        "conda", ["numpy"])
