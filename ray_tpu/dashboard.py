@@ -1,20 +1,67 @@
-"""Dashboard: HTTP introspection endpoints + Prometheus scrape target.
+"""Dashboard: HTTP introspection endpoints + Prometheus scrape target + SPA.
 
 Parity: reference `python/ray/dashboard/` (aiohttp head server, head.py:64,
-with node/job/metrics/state modules and a React frontend). Scope here: the
-machine-facing surface — JSON state endpoints the reference's frontend and
-`ray status` consume, plus /metrics for Prometheus (metrics module) and a
-minimal human landing page. Runs as a daemon thread in the head process.
+with node/job/metrics/state modules and the React frontend under
+`dashboard/client/`). Here: JSON state endpoints, /metrics for Prometheus
+(metrics module), a resource-history sampler feeding time-series charts
+(metrics module + embedded Grafana role), a log-file browser (log module),
+on-demand stack sampling (reporter module), and a no-build-step SPA served
+from `dashboard_assets/`. Runs as a daemon thread in the head process.
 
 Routes: /api/cluster_status /api/nodes /api/actors /api/tasks /api/objects
-        /api/workers /api/placement_groups /api/jobs /metrics /
+        /api/workers /api/placement_groups /api/jobs /api/history
+        /api/logs /api/profile /metrics /assets/* /
 """
 
 from __future__ import annotations
 
+import collections
 import json
+import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+_ASSET_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "dashboard_assets")
+_HISTORY: collections.deque = collections.deque(maxlen=900)  # ~45 min @ 3s
+_history_thread = None
+
+
+def _sample_loop(server):
+    """Background sampler: one compact utilization point every 3s
+    (the role of the reference's Prometheus + Grafana panels for the
+    frontend's charts, without requiring either to be deployed). Gated on
+    `server` staying current — a stop/start cycle must not leave two
+    samplers running."""
+    from ray_tpu.util import state
+    last_finished, last_ts = None, None
+    while _server is server:
+        try:
+            s = state.cluster_status()
+            used = {k: s["resources"]["total"].get(k, 0.0)
+                    - s["resources"]["available"].get(k, 0.0)
+                    for k in ("CPU", "TPU")}
+            finished = s.get("tasks_finished_total", 0)
+            now = time.time()
+            rate = 0.0
+            if last_finished is not None and now > last_ts:
+                rate = max(0.0, (finished - last_finished)
+                           / (now - last_ts))
+            last_finished, last_ts = finished, now
+            _HISTORY.append({
+                "ts": round(now, 1),
+                "cpu_used": round(used["CPU"], 2),
+                "tpu_used": round(used["TPU"], 2),
+                "pending": s.get("pending_tasks", 0),
+                "tasks_per_s": round(rate, 2),
+                "store_mib": round(
+                    s["store"].get("allocated", 0) / 2**20, 1),
+                "workers": s.get("num_workers", 0),
+            })
+        except Exception:  # noqa: BLE001 — sampler must outlive glitches
+            pass
+        time.sleep(3.0)
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -76,71 +123,60 @@ class _Handler(BaseHTTPRequestHandler):
                                "text/plain")
                 else:
                     self._json(report)
+            elif path == "/api/history":
+                self._json(list(_HISTORY))
+            elif path == "/api/logs":
+                self._logs()
             elif path == "/":
-                self._send(200, _INDEX_HTML, "text/html")
+                self._asset("index.html")
+            elif path.startswith("/assets/"):
+                self._asset(os.path.basename(path))
             else:
                 self._send(404, b"not found", "text/plain")
         except Exception as e:  # noqa: BLE001 — a broken route must not
             self._send(500, str(e).encode(), "text/plain")
 
+    _CTYPES = {".html": "text/html", ".js": "text/javascript",
+               ".css": "text/css", ".svg": "image/svg+xml"}
 
-# Single-file frontend (parity role: dashboard/client React app, at the
-# scale this dashboard needs): fetches the JSON routes and renders a live
-# overview + tables, refreshing every 2s.
-_INDEX_HTML = b"""<!doctype html>
-<html><head><title>ray_tpu dashboard</title><style>
- body{font-family:system-ui,sans-serif;margin:1.5rem;background:#fafafa}
- h1{font-size:1.2rem} h2{font-size:1rem;margin:1.2rem 0 .4rem}
- table{border-collapse:collapse;font-size:.85rem;background:#fff}
- td,th{border:1px solid #ddd;padding:.25rem .6rem;text-align:left}
- th{background:#f0f0f0} .cards{display:flex;gap:1rem;flex-wrap:wrap}
- .card{background:#fff;border:1px solid #ddd;border-radius:6px;
-       padding:.6rem 1rem;min-width:8rem}
- .card b{display:block;font-size:1.3rem} .muted{color:#888;font-size:.8rem}
-</style></head><body>
-<h1>ray_tpu dashboard</h1><div class=cards id=cards></div>
-<h2>Nodes</h2><table id=nodes></table>
-<h2>Actors</h2><table id=actors></table>
-<h2>Recent tasks</h2><table id=tasks></table>
-<h2>Jobs</h2><table id=jobs></table>
-<p class=muted>raw: <a href=/api/cluster_status>/api/cluster_status</a>
- <a href=/api/nodes>/api/nodes</a> <a href=/api/actors>/api/actors</a>
- <a href=/api/tasks>/api/tasks</a> <a href=/api/objects>/api/objects</a>
- <a href=/api/workers>/api/workers</a>
- <a href=/api/placement_groups>/api/placement_groups</a>
- <a href=/api/jobs>/api/jobs</a> <a href=/metrics>/metrics</a></p>
-<script>
-function esc(s){return String(s).replace(/&/g,'&amp;').replace(/</g,'&lt;')
-  .replace(/>/g,'&gt;').replace(/"/g,'&quot;')}
-function table(el, rows){
-  if(!rows.length){el.innerHTML='<tr><td class=muted>(empty)</td></tr>';return}
-  const cols=Object.keys(rows[0]);
-  el.innerHTML='<tr>'+cols.map(c=>'<th>'+esc(c)+'</th>').join('')+'</tr>'+
-    rows.map(r=>'<tr>'+cols.map(c=>'<td>'+esc(JSON.stringify(r[c]))+'</td>')
-    .join('')+'</tr>').join('');
-}
-async function j(p){return (await fetch(p)).json()}
-async function refresh(){
-  try{
-    const s=await j('/api/cluster_status');
-    const used=k=>((s.resources.total[k]||0)-(s.resources.available[k]||0));
-    document.getElementById('cards').innerHTML=
-      '<div class=card><b>'+s.nodes.alive+'</b>nodes alive</div>'+
-      '<div class=card><b>'+used('CPU')+'/'+(s.resources.total.CPU||0)+
-        '</b>CPUs used</div>'+
-      '<div class=card><b>'+used('TPU')+'/'+(s.resources.total.TPU||0)+
-        '</b>TPUs used</div>'+
-      '<div class=card><b>'+s.pending_tasks+'</b>pending tasks</div>'+
-      '<div class=card><b>'+(s.store.num_objects||0)+'</b>objects ('+
-        Math.round((s.store.allocated||0)/1048576)+' MiB)</div>';
-    table(document.getElementById('nodes'), await j('/api/nodes'));
-    table(document.getElementById('actors'), await j('/api/actors'));
-    table(document.getElementById('tasks'), (await j('/api/tasks')).slice(-20).reverse());
-    table(document.getElementById('jobs'), await j('/api/jobs'));
-  }catch(e){console.log(e)}
-}
-refresh(); setInterval(refresh, 2000);
-</script></body></html>"""
+    def _asset(self, name: str):
+        """Serve the SPA (parity role: dashboard/client build output)."""
+        path = os.path.join(_ASSET_DIR, os.path.basename(name))
+        if not os.path.isfile(path):
+            self._send(404, b"not found", "text/plain")
+            return
+        with open(path, "rb") as f:
+            body = f.read()
+        ctype = self._CTYPES.get(os.path.splitext(name)[1], "text/plain")
+        self._send(200, body, ctype)
+
+    def _logs(self):
+        """Log browser (parity: dashboard/modules/log): no `file` param
+        lists the session's log files; with one, tails it."""
+        import urllib.parse
+        from ray_tpu.core.runtime import get_runtime
+        q = urllib.parse.parse_qs(self.path.partition("?")[2])
+        log_dir = os.path.join(get_runtime().session_dir, "logs")
+        fname = q.get("file", [""])[0]
+        if not fname:
+            try:
+                files = sorted(os.listdir(log_dir))
+            except FileNotFoundError:
+                files = []
+            self._json(files)
+            return
+        path = os.path.join(log_dir, os.path.basename(fname))
+        if not os.path.isfile(path):
+            self._send(404, b"no such log file", "text/plain")
+            return
+        tail = int(q.get("tail", ["500"])[0])
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - tail * 200))
+            data = f.read()
+        lines = data.splitlines()[-tail:]
+        self._send(200, b"\n".join(lines), "text/plain; charset=utf-8")
 
 
 _server = None
@@ -152,8 +188,14 @@ def start_dashboard(host: str = "127.0.0.1", port: int = 0) -> str:
     if _server is not None:
         return "{}:{}".format(*_server.server_address)
     _server = ThreadingHTTPServer((host, port), _Handler)
+    _HISTORY.clear()  # samples from a previous runtime would be misleading
     threading.Thread(target=_server.serve_forever, daemon=True,
                      name="rtpu-dashboard").start()
+    global _history_thread
+    _history_thread = threading.Thread(target=_sample_loop, daemon=True,
+                                       args=(_server,),
+                                       name="rtpu-dash-sampler")
+    _history_thread.start()
     return "{}:{}".format(*_server.server_address)
 
 

@@ -1,0 +1,329 @@
+/* ray_tpu dashboard SPA (parity role: dashboard/client React app).
+   Hash-routed views over the JSON API; vanilla DOM, no build step.
+   Charts: single-series line + area small multiples fed by /api/history,
+   with a crosshair + tooltip hover layer. */
+
+"use strict";
+
+const VIEWS = [
+  ["overview", "Overview"],
+  ["nodes", "Nodes"],
+  ["workers", "Workers"],
+  ["actors", "Actors"],
+  ["tasks", "Tasks"],
+  ["objects", "Objects"],
+  ["placement_groups", "Placement groups"],
+  ["jobs", "Jobs"],
+  ["logs", "Logs"],
+];
+
+const $ = (sel) => document.querySelector(sel);
+const esc = (s) => String(s)
+  .replace(/&/g, "&amp;").replace(/</g, "&lt;")
+  .replace(/>/g, "&gt;").replace(/"/g, "&quot;");
+
+async function getJSON(path) {
+  const resp = await fetch(path);
+  if (!resp.ok) throw new Error(path + ": " + resp.status);
+  return resp.json();
+}
+
+function currentView() {
+  const h = location.hash.replace(/^#\/?/, "").split("?")[0];
+  return VIEWS.some(([v]) => v === h) ? h : "overview";
+}
+
+function renderNav() {
+  $("#nav").innerHTML = VIEWS.map(([v, label]) =>
+    `<a href="#/${v}" class="${v === currentView() ? "active" : ""}">` +
+    `${label}</a>`).join("");
+}
+
+/* ---------------- tables with filter + sort ---------------- */
+
+const tableState = {};  // view -> {filter, sortCol, asc}
+
+function badge(value) {
+  const v = String(value).toUpperCase();
+  let cls = "";
+  if (["ALIVE", "RUNNING", "FINISHED", "SUCCEEDED", "READY", "CREATED",
+       "IDLE", "BUSY", "TRUE"].includes(v)) cls = "good";
+  else if (["PENDING", "RESTARTING", "SCHEDULED", "SPILLED",
+            "STOPPED"].includes(v)) cls = "warning";
+  else if (["DEAD", "FAILED", "LOST", "REMOVED", "FALSE"].includes(v))
+    cls = "critical";
+  else return esc(value);
+  return `<span class="badge ${cls}">${esc(value)}</span>`;
+}
+
+const STATE_COLS = new Set(["state", "status", "alive", "job_status"]);
+
+function renderTable(view, rows) {
+  const st = tableState[view] ||= { filter: "", sortCol: null, asc: false };
+  let cols = rows.length ? Object.keys(rows[0]) : [];
+  let shown = rows;
+  if (st.filter) {
+    const f = st.filter.toLowerCase();
+    shown = rows.filter((r) =>
+      cols.some((c) => String(r[c]).toLowerCase().includes(f)));
+  }
+  if (st.sortCol) {
+    const c = st.sortCol;
+    shown = [...shown].sort((a, b) => {
+      const x = a[c], y = b[c];
+      const cmp = (typeof x === "number" && typeof y === "number")
+        ? x - y : String(x).localeCompare(String(y));
+      return st.asc ? cmp : -cmp;
+    });
+  }
+  const head = cols.map((c) =>
+    `<th data-col="${esc(c)}" class="${st.sortCol === c ?
+      "sorted" + (st.asc ? " asc" : "") : ""}">${esc(c)}</th>`).join("");
+  const body = shown.length ? shown.map((r) =>
+    `<tr>${cols.map((c) => `<td title="${esc(JSON.stringify(r[c]))}">` +
+      (STATE_COLS.has(c) ? badge(r[c]) : esc(JSON.stringify(r[c])))
+      + "</td>").join("")}</tr>`).join("")
+    : `<tr><td class="empty">(empty)</td></tr>`;
+  return `
+    <div class="toolbar">
+      <input id="filter" placeholder="filter…" value="${esc(st.filter)}">
+      <span class="count">${shown.length}/${rows.length} rows</span>
+    </div>
+    <table><thead><tr>${head}</tr></thead><tbody>${body}</tbody></table>`;
+}
+
+function wireTable(view, rerender) {
+  const inp = $("#filter");
+  if (inp) inp.addEventListener("input", () => {
+    tableState[view].filter = inp.value;
+    rerender();
+    const again = $("#filter");
+    again.focus();
+    again.setSelectionRange(again.value.length, again.value.length);
+  });
+  document.querySelectorAll("th[data-col]").forEach((th) =>
+    th.addEventListener("click", () => {
+      const st = tableState[view];
+      if (st.sortCol === th.dataset.col) st.asc = !st.asc;
+      else { st.sortCol = th.dataset.col; st.asc = false; }
+      rerender();
+    }));
+}
+
+/* ---------------- charts ---------------- */
+
+function lineChart(id, title, points, fmt) {
+  // Single series: titled tile, no legend needed; thin 2px line over a
+  // soft area, recessive grid, crosshair tooltip on hover.
+  const W = 300, H = 90, PADL = 34, PADB = 12, PADT = 6;
+  if (!points.length) {
+    return `<div class="chart"><h3>${esc(title)}</h3>` +
+      `<svg viewBox="0 0 ${W} ${H}"><text class="axis" x="8" y="45">` +
+      `no samples yet</text></svg></div>`;
+  }
+  const xs = points.map((p) => p[0]), ys = points.map((p) => p[1]);
+  const x0 = Math.min(...xs), x1 = Math.max(...xs) || 1;
+  const yMax = Math.max(...ys, 1e-9) * 1.1;
+  const X = (x) => PADL + (x - x0) / Math.max(x1 - x0, 1e-9)
+    * (W - PADL - 4);
+  const Y = (y) => PADT + (1 - y / yMax) * (H - PADT - PADB);
+  const path = points.map((p, i) =>
+    `${i ? "L" : "M"}${X(p[0]).toFixed(1)},${Y(p[1]).toFixed(1)}`).join("");
+  const area = path + `L${X(x1).toFixed(1)},${Y(0).toFixed(1)}` +
+    `L${X(x0).toFixed(1)},${Y(0).toFixed(1)}Z`;
+  const gridYs = [0.5, 1.0].map((f) => yMax * f / 1.1);
+  const grid = gridYs.map((g) =>
+    `<line class="gridline" x1="${PADL}" x2="${W - 4}" ` +
+    `y1="${Y(g).toFixed(1)}" y2="${Y(g).toFixed(1)}"/>` +
+    `<text class="axis" x="2" y="${(Y(g) + 3).toFixed(1)}">` +
+    `${fmt(g)}</text>`).join("");
+  return `<div class="chart" data-chart="${id}"><h3>${esc(title)}</h3>
+    <svg viewBox="0 0 ${W} ${H}" preserveAspectRatio="none">
+      ${grid}
+      <path class="area" d="${area}"/>
+      <path class="line" d="${path}"/>
+      <line class="cursor" y1="${PADT}" y2="${H - PADB}" x1="-10" x2="-10"/>
+      <circle class="dot" r="3" cx="-10" cy="-10"/>
+    </svg></div>`;
+}
+
+const chartData = {};  // id -> {points, fmt, title}
+
+function wireCharts() {
+  document.querySelectorAll("[data-chart]").forEach((el) => {
+    const svg = el.querySelector("svg");
+    const id = el.dataset.chart;
+    svg.addEventListener("mousemove", (ev) => {
+      const { points, fmt, title } = chartData[id] || {};
+      if (!points || !points.length) return;
+      const rect = svg.getBoundingClientRect();
+      const W = 300, PADL = 34;
+      const fx = (ev.clientX - rect.left) / rect.width * W;
+      const xs = points.map((p) => p[0]);
+      const x0 = Math.min(...xs), x1 = Math.max(...xs) || 1;
+      const t = x0 + (fx - PADL) / (W - PADL - 4) * (x1 - x0);
+      let best = 0;
+      points.forEach((p, i) => {
+        if (Math.abs(p[0] - t) < Math.abs(points[best][0] - t)) best = i;
+      });
+      const p = points[best];
+      const yMax = Math.max(...points.map((q) => q[1]), 1e-9) * 1.1;
+      const X = PADL + (p[0] - x0) / Math.max(x1 - x0, 1e-9)
+        * (W - PADL - 4);
+      const Y = 6 + (1 - p[1] / yMax) * (90 - 6 - 12);
+      svg.querySelector(".cursor").setAttribute("x1", X);
+      svg.querySelector(".cursor").setAttribute("x2", X);
+      const dot = svg.querySelector(".dot");
+      dot.setAttribute("cx", X);
+      dot.setAttribute("cy", Y);
+      const tip = $("#tooltip");
+      tip.style.display = "block";
+      tip.style.left = (ev.clientX + 12) + "px";
+      tip.style.top = (ev.clientY - 10) + "px";
+      tip.innerHTML = `<b>${fmt(p[1])}</b> <span>${esc(title)} · ` +
+        `${new Date(p[0] * 1000).toLocaleTimeString()}</span>`;
+    });
+    svg.addEventListener("mouseleave", () => {
+      $("#tooltip").style.display = "none";
+      svg.querySelector(".cursor").setAttribute("x1", -10);
+      svg.querySelector(".dot").setAttribute("cx", -10);
+    });
+  });
+}
+
+/* ---------------- views ---------------- */
+
+async function viewOverview() {
+  const [s, hist] = await Promise.all([
+    getJSON("/api/cluster_status"), getJSON("/api/history")]);
+  const used = (k) =>
+    (s.resources.total[k] || 0) - (s.resources.available[k] || 0);
+  const fmtInt = (v) => String(Math.round(v));
+  const fmtMiB = (v) => Math.round(v) + "M";
+  const cards = `
+    <div class="card"><b>${s.nodes.alive}</b><span>nodes alive</span></div>
+    <div class="card"><b>${used("CPU")}/${s.resources.total.CPU || 0}</b>
+      <span>CPUs used</span></div>
+    <div class="card"><b>${used("TPU")}/${s.resources.total.TPU || 0}</b>
+      <span>TPUs used</span></div>
+    <div class="card"><b>${s.pending_tasks}</b>
+      <span>pending tasks</span></div>
+    <div class="card"><b>${s.store.num_objects || 0}</b>
+      <span>objects · ${Math.round((s.store.allocated || 0) / 1048576)}
+      MiB</span></div>`;
+  const series = [
+    ["cpu", "CPU in use", hist.map((h) => [h.ts, h.cpu_used]), fmtInt],
+    ["tpu", "TPU in use", hist.map((h) => [h.ts, h.tpu_used]), fmtInt],
+    ["pending", "Pending tasks", hist.map((h) => [h.ts, h.pending]),
+     fmtInt],
+    ["tasks", "Tasks finished /s", hist.map((h) => [h.ts, h.tasks_per_s]),
+     fmtInt],
+    ["store", "Object store MiB", hist.map((h) => [h.ts, h.store_mib]),
+     fmtMiB],
+    ["workers", "Workers", hist.map((h) => [h.ts, h.workers]), fmtInt],
+  ];
+  series.forEach(([id, title, points, fmt]) =>
+    chartData[id] = { points, fmt, title });
+  $("#main").innerHTML =
+    `<div class="cards">${cards}</div><div class="charts">` +
+    series.map(([id, title, points, fmt]) =>
+      lineChart(id, title, points, fmt)).join("") +
+    `</div><p class="footer">raw: ` +
+    ["cluster_status", "nodes", "actors", "tasks", "objects", "workers",
+     "placement_groups", "jobs", "history"].map((r) =>
+      `<a href="/api/${r}">/api/${r}</a>`).join(" ") +
+    ` <a href="/metrics">/metrics</a></p>`;
+  wireCharts();
+}
+
+async function viewTable(view) {
+  const rows = await getJSON("/api/" + view);
+  const rerender = () => {
+    $("#main").innerHTML = renderTable(view, rows);
+    wireTable(view, rerender);
+    if (view === "workers") wireProfileButtons();
+  };
+  rerender();
+}
+
+function wireProfileButtons() {
+  // Augment the workers table with per-row stack sampling.
+  document.querySelectorAll("tbody tr").forEach((tr) => {
+    const idCell = tr.querySelector("td");
+    if (!idCell) return;
+    const wid = JSON.parse(idCell.title || '""');
+    const td = document.createElement("td");
+    td.innerHTML = `<button>profile 1s</button>`;
+    td.querySelector("button").addEventListener("click", async () => {
+      const text = await (await fetch(
+        `/api/profile?worker=${wid}&duration=1&format=text`)).text();
+      $("#main").insertAdjacentHTML("beforeend",
+        `<h3>stacks: ${esc(wid)}</h3><pre class="logview">` +
+        `${esc(text)}</pre>`);
+    });
+    tr.appendChild(td);
+  });
+  const headRow = document.querySelector("thead tr");
+  if (headRow) headRow.insertAdjacentHTML("beforeend", "<th></th>");
+}
+
+async function viewJobs() {
+  const rows = await getJSON("/api/jobs");
+  const rerender = () => {
+    $("#main").innerHTML = renderTable("jobs", rows);
+    wireTable("jobs", rerender);
+  };
+  rerender();
+}
+
+async function viewLogs() {
+  const files = await getJSON("/api/logs");
+  const sel = location.hash.split("?file=")[1] || "";
+  let html = `<div class="toolbar"><select id="logfile">` +
+    `<option value="">— pick a log file —</option>` +
+    files.map((f) => `<option ${f === decodeURIComponent(sel) ?
+      "selected" : ""}>${esc(f)}</option>`).join("") +
+    `</select></div>`;
+  if (sel) {
+    const text = await (await fetch(
+      "/api/logs?file=" + sel + "&tail=500")).text();
+    html += `<pre class="logview">${esc(text)}</pre>`;
+  }
+  $("#main").innerHTML = html;
+  $("#logfile").addEventListener("change", (ev) => {
+    location.hash = "#/logs?file=" + encodeURIComponent(ev.target.value);
+  });
+}
+
+/* ---------------- router + refresh loop ---------------- */
+
+let refreshTimer = null;
+
+async function render() {
+  renderNav();
+  $("#clock").textContent = new Date().toLocaleTimeString();
+  const view = currentView();
+  try {
+    if (view === "overview") await viewOverview();
+    else if (view === "logs") await viewLogs();
+    else if (view === "jobs") await viewJobs();
+    else await viewTable(view);
+  } catch (e) {
+    $("#main").innerHTML = `<p>${esc(e)}</p>`;
+  }
+}
+
+function scheduleRefresh() {
+  clearInterval(refreshTimer);
+  refreshTimer = setInterval(() => {
+    // Don't clobber an in-progress filter/profile interaction.
+    if (document.activeElement && document.activeElement.id === "filter")
+      return;
+    if (currentView() === "overview") render();
+    $("#clock").textContent = new Date().toLocaleTimeString();
+  }, 3000);
+}
+
+window.addEventListener("hashchange", () => { render(); });
+render();
+scheduleRefresh();

@@ -157,6 +157,8 @@ def _status(rt) -> dict:
         "pending_tasks": len(rt.task_queue),
         "actors": _summarize_actors(rt)["by_state"],
         "store": rt.store.stats(),
+        "num_workers": len(rt.workers),
+        "tasks_finished_total": rt.task_events.finished_total,
     }
 
 

@@ -236,14 +236,53 @@ def test_usage_stats_recording(tooling_cluster):
         os.environ.pop("RAY_TPU_USAGE_STATS_ENABLED")
 
 
-def test_dashboard_index_page(tooling_cluster):
-    from ray_tpu.dashboard import start_dashboard
+def test_dashboard_index_page():
+    """The SPA shell, its assets, the history sampler, and the log browser
+    all serve (parity roles: dashboard/client frontend, metrics panels,
+    modules/log). Own runtime: earlier tests in this module tear the
+    global runtime down, and the dashboard serves the CURRENT one."""
+    import json as json_mod
+    import time as time_mod
 
-    addr = start_dashboard()
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+    ray_tpu.init(num_cpus=1)
+    stop_dashboard()  # a server left over from an earlier test samples
+    addr = start_dashboard()  # the dead runtime; restart against this one
     with urllib.request.urlopen(f"http://{addr}/", timeout=10) as r:
         body = r.read().decode()
     assert "ray_tpu dashboard" in body
-    assert "/api/cluster_status" in body
+    assert "/assets/app.js" in body
+    for asset, marker in (("app.js", "viewOverview"),
+                          ("style.css", "--series-1")):
+        with urllib.request.urlopen(f"http://{addr}/assets/{asset}",
+                                    timeout=10) as r:
+            assert marker in r.read().decode()
+
+    # History sampler produces utilization points.
+    deadline = time_mod.monotonic() + 15
+    hist = []
+    while time_mod.monotonic() < deadline and not hist:
+        with urllib.request.urlopen(f"http://{addr}/api/history",
+                                    timeout=10) as r:
+            hist = json_mod.loads(r.read())
+        time_mod.sleep(0.5)
+    assert hist and {"ts", "cpu_used", "tpu_used", "pending",
+                     "tasks_per_s", "store_mib",
+                     "workers"} <= set(hist[0])
+
+    # Log browser: list + tail.
+    with urllib.request.urlopen(f"http://{addr}/api/logs",
+                                timeout=10) as r:
+        files = json_mod.loads(r.read())
+    assert isinstance(files, list)
+    if files:
+        with urllib.request.urlopen(
+                f"http://{addr}/api/logs?file={files[0]}&tail=5",
+                timeout=10) as r:
+            assert r.status == 200
+    stop_dashboard()
+    ray_tpu.shutdown()
 
 
 def test_tpu_slice_provider_ici_scaleup():
