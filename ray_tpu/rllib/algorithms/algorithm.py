@@ -28,6 +28,8 @@ class Algorithm:
         self.config = config
         if config.env is None:
             raise ValueError("config.environment(env=...) is required")
+        from ray_tpu.rllib.env.minatar import register_builtin_envs
+        register_builtin_envs()
         probe = gym.make(config.env, **config.env_config)
         self.module = module_for_env(
             probe, hidden=tuple(config.model.get("hidden", (64, 64))),

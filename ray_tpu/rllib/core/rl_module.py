@@ -247,6 +247,100 @@ class SquashedGaussianModule:
         return jnp.tanh(mean) * scale + shift
 
 
+@dataclass
+class ConvSpec:
+    """Conv torso for image observations (parity: rllib catalog CNN
+    stacks). Channel-last NHWC layout — the natural layout for TPU, where
+    XLA tiles channels onto MXU lanes. Input may arrive flat [B, H*W*C]
+    (the env-runner's layout); it is reshaped here."""
+
+    obs_shape: tuple  # (H, W, C)
+    filters: tuple    # ((out_channels, kernel, stride), ...)
+    dense: int = 128
+
+    def init(self, key):
+        params = []
+        c_in = self.obs_shape[-1]
+        h, w = self.obs_shape[0], self.obs_shape[1]
+        for out_c, k, s in self.filters:
+            key, kk = jax.random.split(key)
+            fan_in = k * k * c_in
+            params.append({"w": _dense_init(kk, (k, k, c_in, out_c),
+                                            1.0 / math.sqrt(fan_in)),
+                           "b": jnp.zeros((out_c,))})
+            h = (h - k) // s + 1
+            w = (w - k) // s + 1
+            c_in = out_c
+        key, kd = jax.random.split(key)
+        params.append({"w": _dense_init(kd, (h * w * c_in, self.dense)),
+                       "b": jnp.zeros((self.dense,))})
+        return params
+
+    def apply(self, params, x):
+        B = x.shape[0]
+        x = x.reshape((B,) + tuple(self.obs_shape))
+        for (out_c, k, s), layer in zip(self.filters, params[:-1]):
+            x = jax.lax.conv_general_dilated(
+                x, layer["w"], window_strides=(s, s), padding="VALID",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            x = jax.nn.relu(x + layer["b"])
+        x = x.reshape(B, -1)
+        head = params[-1]
+        return jax.nn.relu(x @ head["w"] + head["b"])
+
+
+# Standard conv stacks: the small net for 10x10 MinAtar-class grids, the
+# nature-CNN for 84x84 Atari frames (parity: rllib catalog defaults).
+MINATAR_FILTERS = ((16, 3, 1),)
+NATURE_FILTERS = ((32, 8, 4), (64, 4, 2), (64, 3, 1))
+
+
+@dataclass
+class CNNActorCriticModule:
+    """Policy + value heads over a shared conv torso, for image obs
+    (parity: rllib's default CNN PPO module; shared torso because conv
+    features transfer between heads and halve the FLOPs)."""
+
+    obs_shape: tuple
+    num_actions: int
+    filters: tuple = MINATAR_FILTERS
+    dense: int = 128
+
+    def _torso(self):
+        return ConvSpec(self.obs_shape, self.filters, self.dense)
+
+    def init(self, key) -> dict:
+        kt, k1, k2 = jax.random.split(key, 3)
+        torso = self._torso()
+        return {
+            "torso": torso.init(kt),
+            "pi_head": {"w": _dense_init(k1, (self.dense,
+                                              self.num_actions), 0.01),
+                        "b": jnp.zeros((self.num_actions,))},
+            "vf_head": {"w": _dense_init(k2, (self.dense, 1), 1.0),
+                        "b": jnp.zeros((1,))},
+        }
+
+    def forward(self, params, obs):
+        h = self._torso().apply(params["torso"], obs)
+        logits = h @ params["pi_head"]["w"] + params["pi_head"]["b"]
+        value = (h @ params["vf_head"]["w"] + params["vf_head"]["b"])[..., 0]
+        return logits, value
+
+    forward_train = forward
+
+    def forward_inference(self, params, obs):
+        logits, _ = self.forward(params, obs)
+        return jnp.argmax(logits, axis=-1)
+
+    def forward_exploration(self, params, obs, key):
+        logits, value = self.forward(params, obs)
+        action = jax.random.categorical(key, logits)
+        logp = jax.nn.log_softmax(logits)
+        logp_a = jnp.take_along_axis(logp, action[..., None], -1)[..., 0]
+        return action, logp_a, value
+
+
 def module_for_env(env_like, hidden=(64, 64), kind="actor_critic"):
     """Build the default module from (obs_space, action_space) shapes;
     Box action spaces get the continuous (squashed-Gaussian) module."""
@@ -268,6 +362,15 @@ def module_for_env(env_like, hidden=(64, 64), kind="actor_critic"):
             obs_dim, int(np.prod(space.shape)),
             tuple(low.tolist()), tuple(high.tolist()), hidden)
     num_actions = int(space.n)
+    obs_shape = tuple(env_like.observation_space.shape)
+    if kind == "actor_critic" and len(obs_shape) == 3 and obs_shape[0] >= 8:
+        # Image observations get the conv module (parity: rllib catalog
+        # picking a CNN stack for 2D obs): small net for MinAtar-class
+        # grids, nature-CNN for Atari-sized frames.
+        filters, dense = ((NATURE_FILTERS, 512) if obs_shape[0] >= 64
+                          else (MINATAR_FILTERS, 128))
+        return CNNActorCriticModule(obs_shape, num_actions,
+                                    filters=filters, dense=dense)
     if kind == "q":
         return QModule(obs_dim, num_actions, hidden)
     return ActorCriticModule(obs_dim, num_actions, hidden)

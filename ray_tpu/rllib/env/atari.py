@@ -1,0 +1,61 @@
+"""Real-ALE Atari support (when ale-py is installed).
+
+Parity: the reference's Atari benchmark path (rllib tuned examples wrap
+ALE envs with the deepmind preprocessing stack). ale-py is not in this
+image, so this module is a gated integration point: `register_atari`
+registers a preprocessed, frame-stacked variant of an ALE env under a
+stable id the env runners can `gym.make_vec`. The MinAtar-style suite
+(`minatar.py`) is the always-available stand-in at test scale.
+"""
+
+from __future__ import annotations
+
+
+def ale_available() -> bool:
+    try:
+        import ale_py  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+def register_atari(game: str = "Breakout", *, frame_stack: int = 4,
+                   screen_size: int = 84) -> str:
+    """Register `<game>NoFrameskip-v4` wrapped in the deepmind stack
+    (grayscale, resize, frame-skip 4, max-pool, stacked frames — via
+    gymnasium's AtariPreprocessing + FrameStackObservation) and return the
+    registered id. Raises with a clear message when ale-py is missing."""
+    if not ale_available():
+        raise RuntimeError(
+            "Atari environments need ale-py (pip install "
+            "'gymnasium[atari]'); at test scale use the built-in "
+            "MinAtarBreakout-v0 / MinAtarSpaceInvaders-v0 instead")
+    import ale_py
+    import gymnasium as gym
+    gym.register_envs(ale_py)
+    env_id = f"{game}Deepmind-v0"
+    if env_id in gym.registry:
+        return env_id
+
+    def make(render_mode=None, **kw):
+        import numpy as np
+        from gymnasium.wrappers import (
+            AtariPreprocessing,
+            FrameStackObservation,
+            TransformObservation,
+        )
+        env = gym.make(f"{game}NoFrameskip-v4", render_mode=render_mode,
+                       **kw)
+        env = AtariPreprocessing(env, screen_size=screen_size,
+                                 grayscale_obs=True, scale_obs=True)
+        env = FrameStackObservation(env, stack_size=frame_stack)
+        # [stack, H, W] -> [H, W, stack]: channel-last for the conv module.
+        space = gym.spaces.Box(0.0, 1.0,
+                               (screen_size, screen_size, frame_stack),
+                               np.float32)
+        return TransformObservation(
+            env, lambda obs: np.moveaxis(obs, 0, -1).astype(np.float32),
+            observation_space=space)
+
+    gym.register(id=env_id, entry_point=make)
+    return env_id

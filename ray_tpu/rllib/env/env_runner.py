@@ -28,6 +28,8 @@ class SingleAgentEnvRunner:
         import gymnasium as gym
         import jax
 
+        from ray_tpu.rllib.env.minatar import register_builtin_envs
+        register_builtin_envs()
         self.env = gym.make_vec(env_name, num_envs=num_envs,
                                 vectorization_mode="sync",
                                 **(env_config or {}))

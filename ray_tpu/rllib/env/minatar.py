@@ -1,0 +1,228 @@
+"""Native MinAtar-style arcade environments (Atari-class env path).
+
+Parity/role: the reference's RLlib benchmarks lean on ALE Atari
+(gymnasium[atari] + ale-py), which is not installable here. These are
+from-scratch 10x10 multi-channel reimplementations in the spirit of the
+MinAtar suite (Young & Tian 2019): binary-channel grids, the same action
+semantics, episodic reward — small enough to step fast on CPU env runners
+while exercising the conv-module path end to end
+(`rl_module.CNNActorCriticModule`). For real ALE frames see
+`ray_tpu/rllib/env/atari.py`.
+
+Registered gymnasium ids (via `register_builtin_envs()`):
+  MinAtarBreakout-v0, MinAtarSpaceInvaders-v0
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+try:
+    import gymnasium as gym
+    from gymnasium import spaces
+except ImportError:  # pragma: no cover - gymnasium is baked into the image
+    gym = None
+
+
+class MinAtarBreakout(gym.Env):
+    """10x10 Breakout: paddle row at the bottom, three brick rows at the
+    top, a diagonally bouncing ball. Channels: 0=paddle, 1=ball, 2=trail,
+    3=brick. Actions: 0=noop, 1=left, 2=right. Reward 1 per brick; the
+    wall regenerates when cleared; episode ends when the ball drops."""
+
+    metadata = {"render_modes": []}
+    SIZE = 10
+
+    def __init__(self, render_mode=None, max_steps: int = 1000):
+        n = self.SIZE
+        self.observation_space = spaces.Box(0.0, 1.0, (n, n, 4),
+                                            np.float32)
+        self.action_space = spaces.Discrete(3)
+        self.max_steps = max_steps
+        self._rng = np.random.default_rng(0)
+
+    def reset(self, *, seed=None, options=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        n = self.SIZE
+        self.paddle = n // 2
+        self.bricks = np.zeros((n, n), np.bool_)
+        self.bricks[1:4, :] = True
+        self.ball_y = 3
+        self.ball_x = int(self._rng.integers(0, n))
+        self.dy = 1
+        self.dx = 1 if self._rng.random() < 0.5 else -1
+        self.last_y, self.last_x = self.ball_y, self.ball_x
+        self.steps = 0
+        return self._obs(), {}
+
+    def _obs(self):
+        n = self.SIZE
+        o = np.zeros((n, n, 4), np.float32)
+        o[n - 1, self.paddle, 0] = 1.0
+        o[self.ball_y, self.ball_x, 1] = 1.0
+        o[self.last_y, self.last_x, 2] = 1.0
+        o[:, :, 3] = self.bricks
+        return o
+
+    def step(self, action):
+        n = self.SIZE
+        self.steps += 1
+        if action == 1:
+            self.paddle = max(0, self.paddle - 1)
+        elif action == 2:
+            self.paddle = min(n - 1, self.paddle + 1)
+        self.last_y, self.last_x = self.ball_y, self.ball_x
+        ny, nx = self.ball_y + self.dy, self.ball_x + self.dx
+        reward = 0.0
+        terminated = False
+        if nx < 0 or nx >= n:  # side wall
+            self.dx = -self.dx
+            nx = self.ball_x + self.dx
+        if ny < 0:  # ceiling
+            self.dy = 1
+            ny = self.ball_y + self.dy
+        if 0 <= ny < n and self.bricks[ny, nx]:
+            self.bricks[ny, nx] = False
+            reward = 1.0
+            self.dy = -self.dy
+            ny = self.ball_y + self.dy
+            if not self.bricks.any():  # wall cleared: regenerate
+                self.bricks[1:4, :] = True
+        if ny == n - 1:  # paddle row
+            if nx == self.paddle:
+                self.dy = -1
+                ny = self.ball_y + self.dy
+                # English: moving into the paddle edge mirrors dx.
+                if action == 1:
+                    self.dx = -1
+                elif action == 2:
+                    self.dx = 1
+            else:
+                terminated = True
+        self.ball_y = int(np.clip(ny, 0, n - 1))
+        self.ball_x = int(np.clip(nx, 0, n - 1))
+        truncated = self.steps >= self.max_steps
+        return self._obs(), reward, terminated, truncated, {}
+
+
+class MinAtarSpaceInvaders(gym.Env):
+    """10x10 Space Invaders: a 4x6 alien block marching side-to-side and
+    down, a cannon on the bottom row. Channels: 0=cannon, 1=alien,
+    2=alien bullet, 3=friendly bullet. Actions: 0=noop, 1=left, 2=right,
+    3=fire. Reward 1 per alien; new wave on clear; episode ends when a
+    bullet hits the cannon or aliens reach the bottom row."""
+
+    metadata = {"render_modes": []}
+    SIZE = 10
+
+    def __init__(self, render_mode=None, max_steps: int = 1000):
+        n = self.SIZE
+        self.observation_space = spaces.Box(0.0, 1.0, (n, n, 4),
+                                            np.float32)
+        self.action_space = spaces.Discrete(4)
+        self.max_steps = max_steps
+        self._rng = np.random.default_rng(0)
+
+    def _spawn_wave(self):
+        self.aliens = np.zeros((self.SIZE, self.SIZE), np.bool_)
+        self.aliens[1:5, 2:8] = True
+        self.adx = 1
+        self.move_timer = 0
+
+    def reset(self, *, seed=None, options=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self.cannon = self.SIZE // 2
+        self._spawn_wave()
+        self.enemy_shots: list[list[int]] = []  # [y, x]
+        self.my_shot = None  # [y, x] — one in flight at a time
+        self.steps = 0
+        return self._obs(), {}
+
+    def _obs(self):
+        n = self.SIZE
+        o = np.zeros((n, n, 4), np.float32)
+        o[n - 1, self.cannon, 0] = 1.0
+        o[:, :, 1] = self.aliens
+        for y, x in self.enemy_shots:
+            o[y, x, 2] = 1.0
+        if self.my_shot is not None:
+            o[self.my_shot[0], self.my_shot[1], 3] = 1.0
+        return o
+
+    def step(self, action):
+        n = self.SIZE
+        self.steps += 1
+        reward = 0.0
+        terminated = False
+        if action == 1:
+            self.cannon = max(0, self.cannon - 1)
+        elif action == 2:
+            self.cannon = min(n - 1, self.cannon + 1)
+        elif action == 3 and self.my_shot is None:
+            self.my_shot = [n - 2, self.cannon]
+
+        # Friendly bullet rises; hit removes an alien.
+        if self.my_shot is not None:
+            self.my_shot[0] -= 1
+            y, x = self.my_shot
+            if y < 0:
+                self.my_shot = None
+            elif self.aliens[y, x]:
+                self.aliens[y, x] = False
+                reward = 1.0
+                self.my_shot = None
+                if not self.aliens.any():
+                    self._spawn_wave()
+
+        # Alien block marches every other step; edge -> drop a row.
+        self.move_timer += 1
+        if self.move_timer % 2 == 0 and self.aliens.any():
+            cols = np.flatnonzero(self.aliens.any(axis=0))
+            if (self.adx > 0 and cols[-1] == n - 1) or \
+               (self.adx < 0 and cols[0] == 0):
+                self.aliens = np.roll(self.aliens, 1, axis=0)
+                self.adx = -self.adx
+                if self.aliens[n - 1].any():
+                    terminated = True  # invasion
+            else:
+                self.aliens = np.roll(self.aliens, self.adx, axis=1)
+
+        # Random alien fire from a bottom-most alien.
+        if self.aliens.any() and self._rng.random() < 0.2:
+            col = int(self._rng.choice(np.flatnonzero(
+                self.aliens.any(axis=0))))
+            row = int(np.flatnonzero(self.aliens[:, col])[-1])
+            self.enemy_shots.append([row + 1, col])
+
+        nxt = []
+        for y, x in self.enemy_shots:
+            y += 1
+            if y == n - 1 and x == self.cannon:
+                terminated = True
+            elif y < n:
+                nxt.append([y, x])
+        self.enemy_shots = nxt
+
+        truncated = self.steps >= self.max_steps
+        return self._obs(), reward, terminated, truncated, {}
+
+
+_REGISTERED = False
+
+
+def register_builtin_envs():
+    """Idempotently register the built-in envs with gymnasium (called by
+    the env runner in every actor process before gym.make_vec)."""
+    global _REGISTERED
+    if _REGISTERED or gym is None:
+        return
+    _REGISTERED = True
+    for name, ep in (
+            ("MinAtarBreakout-v0",
+             "ray_tpu.rllib.env.minatar:MinAtarBreakout"),
+            ("MinAtarSpaceInvaders-v0",
+             "ray_tpu.rllib.env.minatar:MinAtarSpaceInvaders")):
+        if name not in gym.registry:
+            gym.register(id=name, entry_point=ep)

@@ -604,3 +604,109 @@ def test_cql_bc_warmup_runs():
         assert np.isfinite(m1["actor_loss"]) and np.isfinite(m2["actor_loss"])
     finally:
         algo.stop()
+
+
+def test_minatar_breakout_mechanics():
+    """Native MinAtar-style Breakout: channels, bouncing, brick reward,
+    episode end when the ball drops (Atari-class env path, minatar.py)."""
+    import gymnasium as gym
+
+    from ray_tpu.rllib.env.minatar import register_builtin_envs
+    register_builtin_envs()
+    env = gym.make("MinAtarBreakout-v0")
+    obs, _ = env.reset(seed=3)
+    assert obs.shape == (10, 10, 4) and obs.dtype == np.float32
+    assert obs[:, :, 3].sum() == 30  # three brick rows
+    assert obs[9, :, 0].sum() == 1  # one paddle cell on the bottom row
+
+    total_reward, terminated = 0.0, False
+    # A scripted paddle aiming at the ball's NEXT column (current + dx
+    # from the trail channel) keeps the rally alive long enough to hit
+    # bricks.
+    for _ in range(300):
+        ball_x = int(np.argmax(obs[:, :, 1].sum(axis=0)))
+        last_x = int(np.argmax(obs[:, :, 2].sum(axis=0)))
+        target = min(9, max(0, ball_x + np.sign(ball_x - last_x)))
+        pad_x = int(np.argmax(obs[9, :, 0]))
+        act = 0 if target == pad_x else (1 if target < pad_x else 2)
+        obs, r, terminated, truncated, _ = env.step(act)
+        total_reward += r
+        if terminated or truncated:
+            break
+    assert total_reward >= 1.0, "tracking paddle never hit a brick"
+
+    # A frozen paddle loses quickly (termination path).
+    obs, _ = env.reset(seed=12345)
+    for _ in range(300):
+        obs, _, terminated, truncated, _ = env.step(0)
+        if terminated:
+            break
+    assert terminated, "ball never dropped past a frozen paddle"
+    env.close()
+
+
+def test_minatar_space_invaders_mechanics():
+    import gymnasium as gym
+
+    from ray_tpu.rllib.env.minatar import register_builtin_envs
+    register_builtin_envs()
+    env = gym.make("MinAtarSpaceInvaders-v0")
+    obs, _ = env.reset(seed=1)
+    assert obs.shape == (10, 10, 4)
+    assert obs[:, :, 1].sum() == 24  # 4x6 alien block
+
+    # Fire from under the block: a kill must land within a few volleys.
+    total = 0.0
+    for _ in range(60):
+        obs, r, terminated, truncated, _ = env.step(3)
+        total += r
+        if terminated or truncated:
+            break
+    assert total >= 1.0, "stationary cannon under the block never scored"
+    env.close()
+
+
+def test_cnn_module_forward_and_selection():
+    """Image obs spaces select the conv module; forward shapes line up
+    from both flat and [B,H,W,C] inputs."""
+    import gymnasium as gym
+    import jax
+
+    from ray_tpu.rllib.core.rl_module import (
+        CNNActorCriticModule,
+        module_for_env,
+    )
+    from ray_tpu.rllib.env.minatar import register_builtin_envs
+    register_builtin_envs()
+    env = gym.make("MinAtarBreakout-v0")
+    module = module_for_env(env)
+    assert isinstance(module, CNNActorCriticModule)
+    params = module.init(jax.random.PRNGKey(0))
+    obs = np.zeros((5, 10 * 10 * 4), np.float32)  # env-runner flat layout
+    logits, value = module.forward(params, obs)
+    assert logits.shape == (5, 3) and value.shape == (5,)
+    a, logp, v = module.forward_exploration(params, obs,
+                                            jax.random.PRNGKey(1))
+    assert a.shape == (5,) and logp.shape == (5,) and v.shape == (5,)
+    env.close()
+
+
+def test_ppo_minatar_trains():
+    """PPO + conv module on the MinAtar-style Breakout: a couple of
+    iterations run end to end and the scripted-tracking baseline is
+    beatable territory (full learning curves belong in bench, not tests)."""
+    config = (PPOConfig()
+              .environment(env="MinAtarBreakout-v0")
+              .env_runners(num_env_runners=0, num_envs_per_env_runner=4,
+                           rollout_fragment_length=32)
+              .training(train_batch_size=128, minibatch_size=64,
+                        num_epochs=2, lr=1e-3)
+              .debugging(seed=0))
+    algo = config.build_algo()
+    try:
+        for _ in range(2):
+            result = algo.train()
+        assert "policy_loss" in result
+        assert np.isfinite(result["policy_loss"])
+    finally:
+        algo.stop()
