@@ -153,7 +153,11 @@ def test_client_large_value_round_trip(head_with_endpoint, tmp_path):
     _rt, addr = head_with_endpoint
     script = tmp_path / "big_client.py"
     script.write_text(LARGE_VALUE_SCRIPT)
-    out = subprocess.run([sys.executable, str(script), addr],
+    import os
+    env = dict(os.environ)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run([sys.executable, str(script), addr], env=env,
                          capture_output=True, text=True, timeout=180)
     assert out.returncode == 0, out.stderr
     assert "BIG-OK True" in out.stdout
