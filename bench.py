@@ -52,10 +52,18 @@ BASELINE = {
 }
 
 
-def timeit(fn, number) -> float:
-    t0 = time.perf_counter()
-    fn(number)
-    return number / (time.perf_counter() - t0)
+def timeit(fn, number, trials=2) -> float:
+    """Warm run, then the mean of timed trials — the reference's
+    microbenchmark does the same (ray_microbenchmark_helpers.py:15: 1s
+    warmup, mean of four 2s windows), so cold-start transitions between
+    phases don't land on any one metric."""
+    fn(max(1, number // 10))  # warmup
+    rates = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        fn(number)
+        rates.append(number / (time.perf_counter() - t0))
+    return sum(rates) / len(rates)
 
 
 def main():

@@ -85,6 +85,17 @@ struct FreeBlock {
   uint64_t next;  // arena-relative offset of next free block, or 0 (arena off 0 is never free: we reserve first ALIGN bytes)
 };
 
+// Small freed blocks park in size-class fastbins (O(1) push/pop, one
+// singly-linked list per size class) instead of the address-ordered main
+// list, whose ordered insert is O(free blocks) — under small-object churn
+// (thousands of task results freed per second) that walk turned every
+// delete quadratic. Fastbins consolidate back into the main list (where
+// coalescing happens) past a byte threshold or on allocation pressure —
+// the dlmalloc fastbin design the reference's plasma store inherits.
+static const uint64_t FASTBIN_MAX = 2048;   // largest fastbinned block
+static const uint64_t NUM_FASTBINS = FASTBIN_MAX / ALIGN;  // 64..2048 step 64
+static const uint64_t FASTBIN_CONSOLIDATE_BYTES = 8u << 20;
+
 struct Header {
   uint64_t magic;
   uint64_t total_size;
@@ -97,6 +108,9 @@ struct Header {
   uint64_t bytes_allocated;
   uint64_t num_objects;
   uint64_t num_evictions;
+  uint64_t fastbin[NUM_FASTBINS];  // arena-relative heads, 0 = empty
+  uint64_t fastbin_bytes;
+  uint64_t num_tombstones;
 };
 
 static inline Slot* slots(Header* h) {
@@ -126,8 +140,35 @@ static void unlock(Header* h) { pthread_mutex_unlock(&h->mutex); }
 
 static uint64_t align_up(uint64_t v) { return (v + ALIGN - 1) & ~(ALIGN - 1); }
 
+static void consolidate_fastbins(Header* h);
+static int64_t alloc_block_main(Header* h, uint64_t need);
+static void insert_ordered(Header* h, uint64_t off, uint64_t size);
+
 static int64_t alloc_block(Header* h, uint64_t need) {
   need = align_up(need < MIN_BLOCK ? MIN_BLOCK : need);
+  if (need <= FASTBIN_MAX) {
+    uint64_t bin = need / ALIGN - 1;
+    uint64_t off = h->fastbin[bin];
+    if (off) {  // exact-size hit: O(1), no list walk
+      FreeBlock* fb = (FreeBlock*)(arena(h) + off);
+      h->fastbin[bin] = fb->next;
+      h->fastbin_bytes -= fb->size;
+      h->bytes_allocated += fb->size;
+      return (int64_t)off;
+    }
+  }
+  for (int pass = 0; pass < 2; pass++) {
+    if (pass) {  // main list exhausted: merge the fastbin cache back in
+      if (!h->fastbin_bytes) break;
+      consolidate_fastbins(h);
+    }
+    int64_t got = alloc_block_main(h, need);
+    if (got >= 0) return got;
+  }
+  return -1;
+}
+
+static int64_t alloc_block_main(Header* h, uint64_t need) {
   uint64_t prev = 0;
   uint64_t cur = h->free_head;
   while (cur) {
@@ -162,6 +203,35 @@ static int64_t alloc_block(Header* h, uint64_t need) {
 static void free_block(Header* h, uint64_t off, uint64_t size) {
   size = align_up(size < MIN_BLOCK ? MIN_BLOCK : size);
   h->bytes_allocated -= size;
+  if (size <= FASTBIN_MAX) {
+    uint64_t bin = size / ALIGN - 1;
+    FreeBlock* fb = (FreeBlock*)(arena(h) + off);
+    fb->size = size;
+    fb->next = h->fastbin[bin];
+    h->fastbin[bin] = off;
+    h->fastbin_bytes += size;
+    if (h->fastbin_bytes >= FASTBIN_CONSOLIDATE_BYTES)
+      consolidate_fastbins(h);
+    return;
+  }
+  insert_ordered(h, off, size);
+}
+
+static void consolidate_fastbins(Header* h) {
+  for (uint64_t b = 0; b < NUM_FASTBINS; b++) {
+    uint64_t cur = h->fastbin[b];
+    h->fastbin[b] = 0;
+    while (cur) {
+      FreeBlock* fb = (FreeBlock*)(arena(h) + cur);
+      uint64_t next = fb->next;
+      insert_ordered(h, cur, fb->size);
+      cur = next;
+    }
+  }
+  h->fastbin_bytes = 0;
+}
+
+static void insert_ordered(Header* h, uint64_t off, uint64_t size) {
   // insert address-ordered, coalesce with neighbors
   uint64_t prev = 0, cur = h->free_head;
   while (cur && cur < off) {
@@ -216,11 +286,34 @@ static Slot* insert_slot(Header* h, const uint8_t* id) {
   return reuse;  // table may be all tombstones
 }
 
+// Rebuild the table in place once tombstones dominate: with linear
+// probing, chains only terminate at SLOT_EMPTY, so a table that has seen
+// many delete cycles degrades every lookup MISS to O(num_slots) even when
+// nearly empty. Rehashing live entries restores short chains.
+static void rehash_table(Header* h) {
+  Slot* tab = slots(h);
+  uint64_t n = h->num_slots;
+  std::vector<Slot> live;
+  live.reserve(h->num_objects + 16);
+  for (uint64_t i = 0; i < n; i++)
+    if (tab[i].state == SLOT_CREATED || tab[i].state == SLOT_SEALED)
+      live.push_back(tab[i]);
+  memset(tab, 0, n * sizeof(Slot));
+  uint64_t mask = n - 1;
+  for (const Slot& s : live) {
+    uint64_t i = hash_id(s.id) & mask;
+    while (tab[i].state != SLOT_EMPTY) i = (i + 1) & mask;
+    tab[i] = s;
+  }
+  h->num_tombstones = 0;
+}
+
 static void evict_entry(Header* h, Slot* s) {
   free_block(h, s->offset, s->data_size + s->meta_size);
   s->state = SLOT_TOMBSTONE;
   s->refcnt = 0;
   h->num_objects--;
+  if (++h->num_tombstones > h->num_slots / 4) rehash_table(h);
 }
 
 // Evict sealed refcnt==0 objects (oldest lru first) until `need` is allocatable.
@@ -286,14 +379,22 @@ int store_create(void* base, const uint8_t* id, uint64_t data_size,
   Header* h = (Header*)base;
   lock(h);
   if (find_slot(h, id)) { unlock(h); return ERR_EXISTS; }
-  Slot* s = insert_slot(h, id);
-  if (!s) { unlock(h); return ERR_TABLE_FULL; }
+  // Allocate BEFORE claiming a slot: eviction inside the allocator can
+  // trip the tombstone rehash, which relocates the whole slot table and
+  // would invalidate a Slot* held across the call.
   int64_t off = alloc_with_eviction(h, data_size + meta_size);
   if (off < 0) { unlock(h); return ERR_FULL; }
+  Slot* s = insert_slot(h, id);
+  if (!s) {
+    free_block(h, off, data_size + meta_size);
+    unlock(h);
+    return ERR_TABLE_FULL;
+  }
   memcpy(s->id, id, 16);
   s->offset = (uint64_t)off;
   s->data_size = data_size;
   s->meta_size = meta_size;
+  if (s->state == SLOT_TOMBSTONE) h->num_tombstones--;
   s->state = SLOT_CREATED;
   s->refcnt = 1;  // creator holds a ref until seal+release
   s->lru_tick = ++h->lru_clock;

@@ -14,6 +14,38 @@ import threading
 _ID_SIZE = 16
 
 
+class _RandomPool:
+    """Buffered CSPRNG bytes: one os.urandom syscall amortizes ~1000 ids.
+    Forked children must not replay the parent's pool, so the buffer is
+    keyed by pid (workers fork from the zygote)."""
+
+    __slots__ = ("buf", "pos", "pid", "lock")
+
+    def __init__(self):
+        self.buf = b""
+        self.pos = 0
+        self.pid = -1
+        self.lock = threading.Lock()
+
+    def take(self, n: int) -> bytes:
+        with self.lock:
+            pid = os.getpid()
+            if pid != self.pid or self.pos + n > len(self.buf):
+                self.buf = os.urandom(max(1 << 14, n))
+                self.pos = 0
+                self.pid = pid
+            out = self.buf[self.pos:self.pos + n]
+            self.pos += n
+            return out
+
+
+_random_pool = _RandomPool()
+
+
+def random_bytes(n: int) -> bytes:
+    return _random_pool.take(n)
+
+
 class BaseID:
     """A fixed-size binary id with hex repr. Immutable and hashable."""
 
@@ -27,7 +59,7 @@ class BaseID:
 
     @classmethod
     def from_random(cls):
-        return cls(os.urandom(_ID_SIZE))
+        return cls(random_bytes(_ID_SIZE))
 
     @classmethod
     def from_hex(cls, hex_str: str):

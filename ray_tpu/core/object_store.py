@@ -172,6 +172,12 @@ class SharedMemoryStore:
             finally:
                 os.close(fd)
             self._base = ctypes.addressof(ctypes.c_char.from_buffer(self._mm))
+            try:
+                # Fewer TLB misses on GB-scale copies where the kernel
+                # allows THP on shmem (no-op where shmem_enabled=never).
+                self._mm.madvise(mmap.MADV_HUGEPAGE)
+            except (AttributeError, OSError, ValueError):
+                pass
             rc = self._lib.store_init(self._base, size, num_slots)
             if rc != OK:
                 raise RayTpuError(f"store_init failed: {rc}")

@@ -12,7 +12,7 @@ import os
 
 from ray_tpu.core import serialization
 from ray_tpu.core.config import get_config
-from ray_tpu.core.ids import ObjectID, TaskID
+from ray_tpu.core.ids import ObjectID, TaskID, random_bytes
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.task import TaskSpec
 
@@ -55,7 +55,7 @@ class RemoteFunction:
             f"use {self.__name__}.remote().")
 
     def _remote(self, args, kwargs, opts):
-        from ray_tpu.util import tracing as _tr
+        from ray_tpu.util import tracing as _tr  # lazy: tracing pulls otel
         if _tr._enabled:
             # The submit span parents the worker-side execute span via the
             # carrier injected below (parity: tracing_helper decorators).
@@ -83,7 +83,7 @@ class RemoteFunction:
             num_returns = 0
         from ray_tpu.util import tracing as _tracing
         trace_ctx = _tracing.inject_context() if _tracing._enabled else None
-        rnd = os.urandom(16 + 16 * num_returns)
+        rnd = random_bytes(16 + 16 * num_returns)
         task_id = TaskID(rnd[:16])
         return_ids = [rnd[16 + 16 * i : 32 + 16 * i]
                       for i in range(num_returns)]

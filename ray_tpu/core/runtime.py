@@ -773,6 +773,7 @@ class Runtime:
 
         self._selector = selectors.DefaultSelector()
         self._sel_lock = threading.Lock()
+        self._tl_out = threading.local()  # listener drain-pass send batch
         self._listener = threading.Thread(
             target=self._listen_loop, daemon=True, name="rtpu-listener")
         self._listener.start()
@@ -1235,24 +1236,73 @@ class Runtime:
                         self._on_node_conn_closed(handle)
                         continue
                     handle.buffer.feed(data)
-                    for msg in handle.buffer.frames():
-                        try:
-                            if handle.client_handle is not None:
-                                self._handle_msg(handle.client_handle, msg)
-                            else:
-                                self._handle_node_msg(handle, msg)
-                        except Exception:
-                            traceback.print_exc()
+                    msgs = handle.buffer.frames()
+                    self._begin_out_batch(msgs)
+                    try:
+                        for msg in msgs:
+                            try:
+                                if handle.client_handle is not None:
+                                    self._handle_msg(handle.client_handle,
+                                                     msg)
+                                else:
+                                    self._handle_node_msg(handle, msg)
+                            except Exception:
+                                traceback.print_exc()
+                    finally:
+                        self._flush_out_batch()
                     continue
                 if not data:
                     self._on_worker_death(handle)
                     continue
                 handle.buffer.feed(data)
-                for msg in handle.buffer.frames():
-                    try:
-                        self._handle_msg(handle, msg)
-                    except Exception:
-                        traceback.print_exc()
+                msgs = handle.buffer.frames()
+                self._begin_out_batch(msgs)
+                try:
+                    for msg in msgs:
+                        try:
+                            self._handle_msg(handle, msg)
+                        except Exception:
+                            traceback.print_exc()
+                finally:
+                    self._flush_out_batch()
+
+    # A drain pass that decoded several inbound frames usually produces
+    # several outbound actor dispatches too (fan-out submits arrive
+    # coalesced from the worker's sender thread). Batching them per target
+    # turns N sendalls into one (the worker side already unpacks "batch"
+    # frames). Listener-thread only — other threads send inline.
+
+    def _begin_out_batch(self, msgs):
+        if len(msgs) > 1:
+            self._tl_out.batch = {}
+            self._tl_out.order = []
+
+    def _buffered_send(self, w, frame) -> bool:
+        """Queue a frame on the current drain pass's batch; False when no
+        batch is active (caller sends inline)."""
+        batch = getattr(self._tl_out, "batch", None)
+        if batch is None:
+            return False
+        if w not in batch:
+            batch[w] = []
+            self._tl_out.order.append(w)
+        batch[w].append(frame)
+        return True
+
+    def _flush_out_batch(self):
+        batch = getattr(self._tl_out, "batch", None)
+        if batch is None:
+            return
+        self._tl_out.batch = None
+        for w in self._tl_out.order:
+            frames = batch[w]
+            try:
+                w.send(frames[0] if len(frames) == 1
+                       else ("batch", frames))
+            except OSError:
+                for frame in frames:
+                    if frame[0] == "exec":
+                        self._actor_exec_send_failed(frame[1])
 
     def _handle_msg(self, w: WorkerHandle, msg):
         op = msg[0]
@@ -2342,7 +2392,11 @@ class Runtime:
     def _free_object(self, oid: bytes):
         entry = self.directory.lookup(oid)
         self.directory.discard(oid)
-        self.store.delete(ObjectID(oid))
+        # Only shm-backed (or unknown — maybe mid-seal) entries touch the
+        # native store: a delete miss there linear-probes the slot table,
+        # which is pure waste for the inline-result common case.
+        if entry is None or entry[0] == "shm":
+            self.store.delete(ObjectID(oid))
         path = self._spilled.pop(oid, None)
         if path is not None:
             try:
@@ -2461,7 +2515,10 @@ class Runtime:
         return True
 
     def _on_object_ready(self, oid: bytes):
-        """Unblock tasks waiting on this dependency + remote subscribers."""
+        """Unblock tasks waiting on this dependency + remote subscribers.
+        Schedules only when something actually became ready — the no-waiter
+        common case (every task completion) otherwise forces a dispatch
+        pass per result, defeating the refill batching in _on_task_done."""
         ready_items = []
         with self.lock:
             for item in self.waiting_deps.pop(oid, []):
@@ -2470,9 +2527,10 @@ class Runtime:
                 item["pending"] -= 1
                 if item["pending"] == 0:
                     ready_items.append(item)
-        for item in ready_items:
-            self._enqueue_ready(item)
-        self._schedule()
+        if ready_items:
+            for item in ready_items:
+                self._enqueue_ready(item)
+            self._schedule()
 
     # ---------------- task submission / scheduling ----------------
 
@@ -3619,7 +3677,14 @@ class Runtime:
             if not spec.streaming:
                 self._lineage_register(spec)
             self._unpin_deps(spec)
-        self._schedule()
+        # Refill hysteresis: this completion freed no capacity (the
+        # reservation token passed to the worker's next pipelined spec), so
+        # while the worker's backlog sits above the half-depth mark a
+        # schedule pass cannot place anything it couldn't before. Waiting
+        # for the mark batches the refill — one dispatch frame then carries
+        # several specs, halving head send syscalls under storm load.
+        if len(w.assigned) <= self.config.max_tasks_in_flight_per_worker // 2:
+            self._schedule()
 
     def _fail_returns(self, spec: TaskSpec, exc: Exception):
         err = exc if isinstance(exc, TaskError) else TaskError(
@@ -3882,21 +3947,30 @@ class Runtime:
                 else ActorDiedError(msg="actor is dead"))
             return
         self.task_events.record(spec.task_id, spec, "RUNNING")
+        if self._buffered_send(w, ("exec", spec)):
+            return
         try:
             w.send(("exec", spec))
         except OSError:
-            # Raced with the worker dying (socket already closed). Park the
-            # call; the death handler replays/fails it with the actor's fate.
-            # If that handler already ran, fail the call here instead — nobody
-            # will drain the queue again.
-            with self.lock:
-                st.inflight.pop(spec.task_id, None)
-                if st.state != A_DEAD:
-                    st.queued.append(spec)
-                    return
-            cause = st.death_cause
-            self._fail_returns(spec, cause if isinstance(cause, Exception)
-                               else ActorDiedError(msg="actor is dead"))
+            self._actor_exec_send_failed(spec)
+
+    def _actor_exec_send_failed(self, spec):
+        # Raced with the worker dying (socket already closed). Park the
+        # call; the death handler replays/fails it with the actor's fate.
+        # If that handler already ran, fail the call here instead — nobody
+        # will drain the queue again.
+        st = self.actors.get(spec.actor_id)
+        if st is None:
+            self._fail_returns(spec, ActorDiedError(msg="actor is dead"))
+            return
+        with self.lock:
+            st.inflight.pop(spec.task_id, None)
+            if st.state != A_DEAD:
+                st.queued.append(spec)
+                return
+        cause = st.death_cause
+        self._fail_returns(spec, cause if isinstance(cause, Exception)
+                           else ActorDiedError(msg="actor is dead"))
 
     def kill_actor_by_id(self, actor_id: bytes, no_restart=True):
         st = self.actors.get(actor_id)

@@ -10,6 +10,7 @@ every message path is chaos-testable.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import pickle
 import random
@@ -206,6 +207,57 @@ def send_msg(sock: socket.socket, msg, lock: threading.Lock | None = None):
         sock.sendall(head)
         for b in bufs:
             sock.sendall(b)
+
+
+def send_many(sock: socket.socket, msgs: list,
+              lock: threading.Lock | None = None):
+    """Send several frames with as few syscalls as possible: consecutive
+    headers/payloads and small buffers join into one write; large raw
+    buffers are written as-is (joining would copy them). Frame order and
+    per-frame chaos hooks match N send_msg calls exactly."""
+    out: list = []
+    joined = 0
+
+    def flush():
+        nonlocal out, joined
+        if out:
+            sock.sendall(out[0] if len(out) == 1 else b"".join(out))
+            out = []
+            joined = 0
+
+    chaos = get_chaos()
+    ctx = lock if lock is not None else _NULL_CTX
+    with ctx:
+        for msg in msgs:
+            op = msg[0] if isinstance(msg, tuple) and msg else ""
+            chaos.maybe_delay(op)
+            if chaos.maybe_drop(op):
+                continue
+            if op and _is_proto_op(op):
+                from ray_tpu.core import proto_wire
+                payload = proto_wire.to_wire(msg)
+                if payload is not None:
+                    out.append(_HDR.pack(len(payload))
+                               + _NBUF.pack(_PROTO_FLAG) + payload)
+                    joined += len(payload)
+                    if joined >= _JOIN_CAP:
+                        flush()
+                    continue
+            for p in _encode(msg):
+                n = len(p) if isinstance(p, bytes) else p.nbytes
+                if isinstance(p, bytes) or n < (64 << 10):
+                    out.append(p)
+                    joined += n
+                    if joined >= _JOIN_CAP:
+                        flush()
+                else:
+                    flush()
+                    sock.sendall(p)
+        flush()
+
+
+_JOIN_CAP = 256 << 10
+_NULL_CTX = contextlib.nullcontext()
 
 
 def recv_msg(sock: socket.socket):
