@@ -17,6 +17,7 @@ from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig
 from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.algorithms.dreamerv3 import DreamerV3, DreamerV3Config
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
 from ray_tpu.rllib.algorithms.multi_agent_ppo import (
@@ -34,6 +35,7 @@ __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "APPO", "APPOConfig",
     "BC", "BCConfig", "DQN", "DQNConfig", "IMPALA", "IMPALAConfig",
     "MARWIL", "MARWILConfig", "SAC", "SACConfig", "CQL", "CQLConfig",
+    "DreamerV3", "DreamerV3Config",
     "MultiAgentPPO", "MultiAgentPPOConfig", "MultiAgentEnv",
     "MultiAgentEnvRunner",
 ]

@@ -30,9 +30,20 @@ class SingleAgentEnvRunner:
 
         from ray_tpu.rllib.env.minatar import register_builtin_envs
         register_builtin_envs()
-        self.env = gym.make_vec(env_name, num_envs=num_envs,
-                                vectorization_mode="sync",
-                                **(env_config or {}))
+        # SAME_STEP autoreset (gym<1.0 behavior): on done, step() returns
+        # the reset obs. gymnasium 1.x's NEXT_STEP default would record a
+        # phantom transition per episode boundary (terminal obs as the new
+        # episode's first obs, ignored action, reward 0) in every fragment.
+        try:
+            self.env = gym.make_vec(
+                env_name, num_envs=num_envs, vectorization_mode="sync",
+                vector_kwargs={
+                    "autoreset_mode": gym.vector.AutoresetMode.SAME_STEP},
+                **(env_config or {}))
+        except (AttributeError, TypeError):  # older gymnasium
+            self.env = gym.make_vec(env_name, num_envs=num_envs,
+                                    vectorization_mode="sync",
+                                    **(env_config or {}))
         self.num_envs = num_envs
         self.module = module
         self._key = jax.random.PRNGKey(seed)
