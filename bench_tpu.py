@@ -210,6 +210,41 @@ def bench_llm_decode(layout: str, slots: int = 32, prompt_len: int = 128,
     return out
 
 
+def bench_rl_ppo(iters: int = 3):
+    """RL throughput (BASELINE north star metric "RLlib PPO env-steps/
+    sec"): PPO + the conv module on the MinAtar-style Breakout, env
+    stepping on host CPU, policy forwards + GAE + learner updates
+    jit-compiled on the TPU — the reference's GPU-learner split
+    (rllib/core/learner/) with XLA in the torch role."""
+    from ray_tpu.rllib import PPOConfig
+
+    config = (PPOConfig()
+              .environment(env="MinAtarBreakout-v0")
+              .env_runners(num_env_runners=0, num_envs_per_env_runner=16,
+                           rollout_fragment_length=64)
+              .training(train_batch_size=1024, minibatch_size=256,
+                        num_epochs=2, lr=3e-4)
+              .debugging(seed=0))
+    algo = config.build_algo()
+    try:
+        algo.train()  # compile + warm
+        t0 = time.time()
+        steps0 = algo._timesteps
+        for _ in range(iters):
+            result = algo.train()
+        dt = time.time() - t0
+        steps = algo._timesteps - steps0
+        out = {
+            "config": "rl_ppo_minatar",
+            "env_steps_per_sec": round(steps / dt),
+            "policy_loss": round(float(result.get("policy_loss", 0.0)), 4),
+        }
+    finally:
+        algo.stop()
+    print(f"rl_ppo: {out}", file=sys.stderr)
+    return out
+
+
 def run() -> dict:
     """Returns {"device": ..., "configs": [...]} or {"skipped": reason}."""
     try:
@@ -248,6 +283,12 @@ def run() -> dict:
             results["configs"].append(
                 {"config": f"llm_decode_{layout}", "error": str(e)[:200]})
             print(f"llm_decode[{layout}]: FAILED {e}", file=sys.stderr)
+    try:
+        results["configs"].append(bench_rl_ppo())
+    except Exception as e:
+        results["configs"].append(
+            {"config": "rl_ppo_minatar", "error": str(e)[:200]})
+        print(f"rl_ppo: FAILED {e}", file=sys.stderr)
     return results
 
 

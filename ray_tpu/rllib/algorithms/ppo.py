@@ -110,8 +110,9 @@ class PPO(Algorithm):
                     jnp.asarray(f["rewards"]), jnp.asarray(f["values"]),
                     jnp.asarray(f["dones"]), jnp.asarray(f["last_values"]),
                     gamma=c.gamma, lam=c.lambda_)
-                f["advantages"] = np.asarray(adv)
-                f["returns"] = np.asarray(ret)
+                # One fetch for both outputs (two np.asarray calls = two
+                # blocking device round trips).
+                f["advantages"], f["returns"] = jax.device_get((adv, ret))
                 steps += f["rewards"].size
             batches.extend(frags)
         self._timesteps += steps
@@ -121,6 +122,13 @@ class PPO(Algorithm):
         batch = {k: batch[k] for k in
                  ("obs", "actions", "logp", "advantages", "returns")}
         n = batch["obs"].shape[0]
+        # Local learner: the whole epochs x minibatches sweep is one jit
+        # call (one dispatch + one metrics fetch per training step).
+        metrics = self.learner_group.update_epochs(
+            batch, num_epochs=c.num_epochs,
+            minibatch_size=c.minibatch_size, seed=self.iteration)
+        if metrics is not None:
+            return metrics
         metrics = {}
         rng = np.random.default_rng(self.iteration)
         for _ in range(c.num_epochs):

@@ -38,18 +38,25 @@ class Learner:
         self.opt_state = self.tx.init(self.params)
         self.mesh = mesh
         loss_cfg = dict(loss_cfg or {})
+        self._loss_fn = loss_fn
+        self._loss_cfg = loss_cfg
+        self._fused_epochs: dict = {}  # shape signature -> compiled sweep
         if not fused:
             # Subclasses that split grad/allreduce/apply skip the fused jit
             # (it would just hold a dead second copy of the pipeline).
             self._update = None
+            self._step_fn = None
             return
 
-        def _update(params, opt_state, batch):
+        def _step(params, opt_state, batch):
             (loss, aux), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params, batch, **loss_cfg)
             updates, opt_state = self.tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             return params, opt_state, loss, aux
+
+        self._step_fn = _step  # shared by the fused multi-epoch sweep
+        _update = _step
 
         if mesh is not None:
             # Batch rides the "dp" mesh axis; params replicated. XLA lowers
@@ -64,13 +71,76 @@ class Learner:
         else:
             self._update = jax.jit(_update)
 
+    @staticmethod
+    def _finalize_metrics(loss, aux) -> dict:
+        # ONE device fetch for every metric — per-scalar float() costs a
+        # blocking round trip each (painful on remote/tunneled devices).
+        loss, aux = jax.device_get((loss, aux))
+        out = {"total_loss": float(loss)}
+        out.update({k: float(v) for k, v in aux.items()})
+        return out
+
     def update(self, batch: dict) -> dict:
         batch = jax.tree_util.tree_map(jnp.asarray, batch)
         self.params, self.opt_state, loss, aux = self._update(
             self.params, self.opt_state, batch)
-        out = {"total_loss": float(loss)}
-        out.update({k: float(v) for k, v in aux.items()})
-        return out
+        return self._finalize_metrics(loss, aux)
+
+    def update_epochs(self, batch: dict, *, num_epochs: int,
+                      minibatch_size: int, seed: int = 0) -> dict | None:
+        """The whole epochs x shuffled-minibatches sweep as ONE jit call
+        (lax.scan over epochs, nested scan over minibatches). One
+        dispatch + one metrics fetch per training step instead of one per
+        minibatch — the difference between an accelerator-bound and a
+        dispatch-latency-bound PPO (SURVEY: no data-dependent Python
+        control flow inside the hot loop).
+
+        Returns None (caller falls back to the per-minibatch loop) when
+        the sweep can't express the config faithfully: a mesh-sharded
+        learner (the fused jit carries no shardings) or a batch that
+        doesn't tile into minibatches (scan needs uniform sizes; silently
+        dropping the remainder would diverge from the fallback)."""
+        batch = jax.tree_util.tree_map(jnp.asarray, batch)
+        n = next(iter(batch.values())).shape[0]
+        if self.mesh is not None or n % minibatch_size:
+            return None
+        nmb = n // minibatch_size
+        mb = minibatch_size
+        key_shape = (n, nmb, mb, num_epochs)
+        fused = self._fused_epochs.get(key_shape)
+        if fused is None:
+            fused = self._build_fused_epochs(n, nmb, mb, num_epochs)
+            self._fused_epochs[key_shape] = fused
+        self.params, self.opt_state, loss, aux = fused(
+            self.params, self.opt_state, batch,
+            jax.random.PRNGKey(seed))
+        return self._finalize_metrics(loss, aux)
+
+    def _build_fused_epochs(self, n, nmb, mb, num_epochs):
+        step_fn = self._step_fn
+
+        def one_minibatch(carry, idx):
+            params, opt_state, batch = carry
+            sl = jax.tree_util.tree_map(lambda x: jnp.take(x, idx, axis=0),
+                                        batch)
+            params, opt_state, loss, aux = step_fn(params, opt_state, sl)
+            return (params, opt_state, batch), (loss, aux)
+
+        def one_epoch(carry, key):
+            perm = jax.random.permutation(key, n)[:nmb * mb]
+            idxs = perm.reshape(nmb, mb)
+            carry, (losses, auxs) = jax.lax.scan(one_minibatch, carry,
+                                                 idxs)
+            return carry, (losses, auxs)
+
+        def fused(params, opt_state, batch, key):
+            keys = jax.random.split(key, num_epochs)
+            (params, opt_state, _b), (losses, auxs) = jax.lax.scan(
+                one_epoch, (params, opt_state, batch), keys)
+            last_aux = jax.tree_util.tree_map(lambda a: a[-1, -1], auxs)
+            return params, opt_state, losses[-1, -1], last_aux
+
+        return jax.jit(fused)
 
     def get_weights(self):
         return jax.device_get(self.params)
@@ -142,6 +212,17 @@ class LearnerGroup:
                 cls.remote(i, num_learners, group, module, loss_fn, **cfg)
                 for i in range(num_learners)]
             ray_tpu.get([r.ping.remote() for r in self.remotes], timeout=120)
+
+    def update_epochs(self, batch: dict, *, num_epochs: int,
+                      minibatch_size: int, seed: int = 0) -> dict | None:
+        """Fused multi-epoch sweep on the local learner (one accelerator
+        dispatch); None for actor groups — callers fall back to the
+        per-minibatch loop there."""
+        if self.local is not None:
+            return self.local.update_epochs(
+                batch, num_epochs=num_epochs,
+                minibatch_size=minibatch_size, seed=seed)
+        return None
 
     def update(self, batch: dict) -> dict:
         if self.local is not None:

@@ -46,9 +46,28 @@ class SingleAgentEnvRunner:
                                     **(env_config or {}))
         self.num_envs = num_envs
         self.module = module
-        self._key = jax.random.PRNGKey(seed)
+        # Acting runs on the CPU backend even in-process: env stepping is
+        # a per-step host round-trip, and paying an accelerator dispatch
+        # per step (hundreds of microseconds, ~ms over a tunneled chip)
+        # caps env-steps/s far below the CPU forward itself. The remote
+        # runner actors get this for free (CPU-backend workers); this
+        # makes local mode match. The learner keeps the accelerator.
+        try:
+            act_dev = jax.devices("cpu")[0]
+        except RuntimeError:
+            act_dev = None
+        self._act_device = act_dev
+        # Placement rides the committed inputs (params + key device_put to
+        # CPU below; obs is numpy): jit compiles for the CPU backend with
+        # no deprecated device= hint.
         self._explore = jax.jit(module.forward_exploration)
         self._infer = jax.jit(module.forward_inference)
+        # The RNG key must live on the acting device too: a key on the
+        # default accelerator makes every per-step split a device dispatch
+        # (a full network round trip on tunneled chips).
+        self._key = jax.random.PRNGKey(seed)
+        if act_dev is not None:
+            self._key = jax.device_put(self._key, act_dev)
         obs, _ = self.env.reset(seed=seed)
         self.obs = _flat(obs)
         # Per-env accumulators for completed-episode returns.
@@ -62,6 +81,10 @@ class SingleAgentEnvRunner:
         the object plane zero-copy)."""
         import jax
 
+        if self._act_device is not None:
+            # One transfer up front; otherwise every per-step jit call
+            # re-copies accelerator-resident params to the CPU backend.
+            params = jax.device_put(params, self._act_device)
         T, B = num_steps, self.num_envs
         obs_buf = np.empty((T, B, self.obs.shape[-1]), np.float32)
         if getattr(self.module, "action_kind", "discrete") == "continuous":
