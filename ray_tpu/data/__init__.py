@@ -25,6 +25,7 @@ from ray_tpu.data.datasource import (  # noqa: F401
     read_avro,
     read_binary_files,
     read_csv,
+    read_delta,
     read_images,
     read_json,
     read_numpy,
@@ -41,5 +42,6 @@ __all__ = [
     "read_parquet", "read_csv", "read_json", "read_text",
     "read_binary_files", "read_numpy", "read_images",
     "read_tfrecord", "read_webdataset", "read_avro", "read_sql",
+    "read_delta",
     "from_huggingface", "from_torch", "decode_image",
 ]
