@@ -117,21 +117,30 @@ def read_delta(table_path: str, *, version: int | None = None,
     tables written with default settings keep JSON logs for every commit).
     """
     import json as json_mod
+    import urllib.parse
 
     log_dir = os.path.join(table_path, "_delta_log")
     if not os.path.isdir(log_dir):
         raise FileNotFoundError(
             f"{table_path!r} is not a Delta table (no _delta_log/)")
+    if os.path.exists(os.path.join(log_dir, "_last_checkpoint")):
+        # Log cleanup may have deleted the JSON commits a checkpoint
+        # compacted; replaying the survivors would silently drop files.
+        raise NotImplementedError(
+            f"{table_path!r} has a checkpointed _delta_log; this reader "
+            f"replays JSON commits only — disable checkpointing or export "
+            f"the table")
     commits = sorted(
         f for f in os.listdir(log_dir)
         if f.endswith(".json") and f[:-5].isdigit())
     if version is not None:
+        if not commits or int(commits[-1][:-5]) < version:
+            raise FileNotFoundError(
+                f"{table_path!r} has no version {version} "
+                f"(latest: {int(commits[-1][:-5]) if commits else 'none'})")
         commits = [f for f in commits if int(f[:-5]) <= version]
     if not commits:
-        raise FileNotFoundError(
-            f"no delta commits in {log_dir!r}"
-            + (f" at or below version {version}" if version is not None
-               else ""))
+        raise FileNotFoundError(f"no delta commits in {log_dir!r}")
     active: dict[str, str] = {}
     for fname in commits:
         with open(os.path.join(log_dir, fname)) as f:
@@ -141,8 +150,9 @@ def read_delta(table_path: str, *, version: int | None = None,
                     continue
                 action = json_mod.loads(line)
                 if "add" in action:
-                    p = action["add"]["path"]
-                    active[p] = os.path.join(table_path, p)
+                    p = action["add"]["path"]  # protocol: percent-encoded
+                    active[p] = os.path.join(
+                        table_path, urllib.parse.unquote(p))
                 elif "remove" in action:
                     active.pop(action["remove"]["path"], None)
     if not active:

@@ -590,3 +590,16 @@ def test_read_delta_log_replay(ray_start_regular, tmp_path):
 
     with pytest.raises(FileNotFoundError, match="not a Delta table"):
         rd.read_delta(str(tmp_path / "nope"))
+    # Time travel past the latest version must raise, not silently serve
+    # the newest data.
+    with pytest.raises(FileNotFoundError, match="no version 99"):
+        rd.read_delta(str(table), version=99)
+    # Percent-encoded paths (the protocol encodes them) decode on read.
+    write_part("part 3.parquet", [7])
+    write_commit(2, [{"add": {"path": "part%203.parquet"}}])
+    latest = sorted(r["id"] for r in rd.read_delta(str(table)).take_all())
+    assert 7 in latest
+    # Checkpointed logs are out of scope and must refuse loudly.
+    (log / "_last_checkpoint").write_text('{"version": 2}')
+    with pytest.raises(NotImplementedError, match="checkpointed"):
+        rd.read_delta(str(table))
