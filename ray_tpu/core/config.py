@@ -33,7 +33,7 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "worker_startup_timeout_s": (float, 60.0, "time to wait for a worker to boot"),
     "worker_idle_timeout_s": (float, 300.0, "idle workers above pool size are reaped"),
     "max_pending_lease_requests": (int, 10, "in-flight lease requests per scheduling key"),
-    "max_tasks_in_flight_per_worker": (int, 4, "same-key tasks pipelined "
+    "max_tasks_in_flight_per_worker": (int, 8, "same-key tasks pipelined "
                                       "onto one busy worker (depth-K "
                                       "dispatch; 1 disables pipelining)"),
     "task_max_retries_default": (int, 3, "default retries for idempotent tasks"),
