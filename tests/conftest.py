@@ -30,6 +30,49 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# Smoke tier: one fast path per subsystem, selected here rather than by
+# editing every module. `pytest -m smoke` must stay green in <3 min on a
+# 1-CPU box (the full suite is ~20 min). Parity: the reference's CI tiers
+# (ci/ray_ci/core.tests.yml small/medium/large splits).
+_SMOKE = {
+    "test_core_api.py": {"test_simple_task", "test_put_get",
+                         "test_many_async_tasks", "test_error_propagation"},
+    "test_object_store.py": {"test_put_get_roundtrip", "test_zero_copy_numpy"},
+    "test_cluster.py": {"test_tasks_spread_across_nodes",
+                        "test_direct_actor_calls_bypass_head"},
+    "test_fault_tolerance.py": {"test_task_retry_on_worker_crash",
+                                "test_actor_restart"},
+    "test_placement_group.py": {"test_create_ready_remove"},
+    "test_collective.py": {"test_allreduce"},
+    "test_data.py": {"test_range_take_count", "test_map_and_fusion"},
+    "test_train.py": {"test_fit_reports_and_checkpoints",
+                      "test_torch_trainer_single_worker"},
+    "test_tune.py": {"test_tuner_grid", "test_generate_variants"},
+    "test_serve.py": {"test_basic_deploy_and_handle"},
+    "test_rllib.py": {"test_gae_matches_reference_impl",
+                      "test_actor_critic_module_shapes"},
+    "test_llm.py": {"test_engine_matches_naive_greedy"},
+    "test_dag.py": {"test_channel_roundtrip_and_versions",
+                    "test_compiled_pipeline_two_actors"},
+    "test_workflow.py": {"test_run_dag"},
+    "test_ops.py": {"test_rmsnorm", "test_flash_attention_multiblock"},
+    "test_parallel.py": {"test_ulysses_matches_reference"},
+    "test_protocol.py": {"test_agent_frame_round_trip",
+                         "test_value_codec_language_neutral"},
+    "test_aux.py": {"test_util_queue"},
+    "test_launcher.py": {"test_config_parsing_and_validation"},
+    "test_head_restart.py": {"test_head_restart_with_sqlite_store"},
+    "test_spilling.py": {"test_put_beyond_capacity_spills_and_restores"},
+    "test_tooling.py": {"test_state_api"},
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        names = _SMOKE.get(item.fspath.basename)
+        if names and item.originalname in names:
+            item.add_marker(pytest.mark.smoke)
+
 
 @pytest.fixture(scope="module")
 def ray_start_regular():
