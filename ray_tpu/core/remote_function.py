@@ -106,6 +106,7 @@ class RemoteFunction:
             trace_ctx=trace_ctx,
             streaming=streaming,
             runtime_env=opts.get("runtime_env"),
+            idempotent=bool(opts.get("idempotent", False)),
         )
         if isinstance(rt, Runtime):
             rt.submit_task(spec, fn_blob)

@@ -770,6 +770,11 @@ class Runtime:
         self.placement_groups: dict[bytes, PlacementGroupState] = {}
         self.pgs_waiting: collections.deque[bytes] = collections.deque()
         self._reservations: dict[bytes, tuple] = {}  # task_id -> token
+        # Two-phase steal: specs pulled off a busy worker's backlog await the
+        # origin's drop-ack before re-dispatch (exactly-once absent failures;
+        # the reference never duplicates execution without a failure).
+        # task_id -> (origin WorkerHandle, TaskSpec)
+        self._pending_steals: dict[bytes, tuple] = {}
 
         self._selector = selectors.DefaultSelector()
         self._sel_lock = threading.Lock()
@@ -1360,6 +1365,8 @@ class Runtime:
         elif op == "put_notify":
             self.directory.add_location(msg[1], w.node_id)
             self._on_object_ready(msg[1])
+        elif op == "drop_ack":
+            self._on_drop_ack(w, msg[1], msg[2])
         elif op == "profile_result":
             entry = self._profile_futs.pop(msg[1], None)
             if entry is not None:
@@ -3446,26 +3453,39 @@ class Runtime:
     def _steal_for_idle(self) -> bool:
         """Anti-straggler: with idle workers and empty queues, reclaim
         pipelined tasks that have not started (queued behind a long task on
-        a busy worker) back into the scheduling queues. The origin worker is
-        told to drop them silently; a lost race (task started between the
-        steal and the drop) means a benign duplicate execution of an
-        idempotent task, never a poisoned result."""
+        a busy worker) back into the scheduling queues.
+
+        Two-phase by default: the spec is parked in _pending_steals and only
+        re-enqueued once the origin worker acks that the task had not begun
+        (drop_ack True). If the origin already started it, the steal aborts
+        and the running execution stands — exactly-once absent failures, the
+        reference's invariant. Tasks explicitly marked idempotent=True (and
+        retriable) keep the cheaper one-phase path: enqueue immediately; a
+        lost drop race is a benign duplicate of a task the user declared
+        safe to replay."""
         stolen: list[tuple] = []
         with self.lock:
             if any(self.task_queues.values()):
                 return False
             idle = sum(len(n.idle) for n in self.nodes.values()
                        if n.state == "ALIVE")
-            if not idle:
+            # Each in-flight pending steal has already claimed an idle slot;
+            # without this, every _schedule pass re-steals the same backlog
+            # for the same idle workers while acks are in flight.
+            idle -= len(self._pending_steals)
+            if idle <= 0:
                 return False
             for w in self.workers.values():
                 if w.state != BUSY or len(w.assigned) <= 1:
                     continue
                 while len(w.assigned) > 1 and idle > 0:
                     spec = w.assigned[-1]
-                    if (spec.max_retries or 0) <= 0:
-                        # A lost drop race duplicates execution; tasks the
-                        # user marked non-retriable must never risk that.
+                    if (not getattr(spec, "idempotent", False)
+                            and (spec.retries_left or 0) <= 0):
+                        # Even two-phase stealing leaves a worker-death
+                        # window where "queued" vs "just begun" cannot be
+                        # distinguished — resolving it costs a retry, so a
+                        # task with no budget left must not be stolen.
                         break
                     # Steal only what can actually be placed RIGHT NOW on a
                     # node with a free worker — otherwise the spec would
@@ -3490,14 +3510,70 @@ class Runtime:
                     idle -= 1
                 if idle <= 0:
                     break
+            one_phase = []
             for w, spec in reversed(stolen):
-                self._enqueue_task_locked(spec, front=True)
+                if getattr(spec, "idempotent", False):
+                    self._enqueue_task_locked(spec, front=True)
+                    one_phase.append((w, spec))
+                else:
+                    self._pending_steals[spec.task_id] = (w, spec)
         for w, spec in stolen:
             try:
                 w.send(("drop_task", spec.task_id))
             except OSError:
+                # Ack will never come; the worker-death path requeues
+                # whatever is still parked in _pending_steals for w.
                 pass
-        return bool(stolen)
+        return bool(one_phase)
+
+    def _on_drop_ack(self, w: WorkerHandle, task_id: bytes, dropped: bool):
+        """Phase two of a steal. dropped=True: the origin never started the
+        task — re-dispatch it. dropped=False: the origin had already begun
+        (or finished) it — abort the steal and let that execution stand."""
+        with self.lock:
+            entry = self._pending_steals.pop(task_id, None)
+            if entry is None:
+                # Completion beat the ack (task finished at the origin while
+                # the steal was pending) — nothing left to do.
+                return
+            _w, spec = entry
+            fail_spec = None
+            if dropped:
+                self._enqueue_task_locked(spec, front=True)
+            elif w.state == DEAD:
+                # Origin began the task and died before finishing it (its
+                # death raced this ack): same retry-or-fail as the orphan
+                # block in the death handler — never silently drop the spec
+                # (its return futures would hang forever).
+                if (spec.retries_left or 0) > 0:
+                    spec.retries_left -= 1
+                    self.task_events.record(task_id, spec, "RETRY")
+                    self._enqueue_task_locked(spec, front=True)
+                    dropped = True  # trigger the _schedule below
+                else:
+                    fail_spec = spec
+            else:
+                # The origin is executing the spec right now: restore the
+                # in-flight bookkeeping so its eventual done/death handling
+                # finds it. The steal victim was the backlog tail, so every
+                # earlier done was processed before this ack (same-socket
+                # FIFO) and may have re-idled the worker — pull it back.
+                if w.state == IDLE:
+                    w.state = BUSY
+                    node = self.nodes.get(w.node_id)
+                    if node is not None:
+                        try:
+                            node.idle.remove(w)
+                        except ValueError:
+                            pass
+                w.assigned.append(spec)
+                self._sig_workers.setdefault(
+                    self._sched_key(spec), set()).add(w)
+        if fail_spec is not None:
+            self._fail_returns(fail_spec, WorkerCrashedError(
+                f"worker died executing stolen task {fail_spec.describe()}"))
+        if dropped:
+            self._schedule()
 
     @staticmethod
     def _take_idle_locked(node: NodeState, env_key: str | None):
@@ -3670,6 +3746,13 @@ class Runtime:
                     self._unpin_deps(spec)
             return
         spec = self._pop_assignment(w, task_id)
+        if spec is None:
+            # A steal was pending on this task and the origin finished it
+            # first: reap the steal, keep the result (exactly-once).
+            with self.lock:
+                entry = self._pending_steals.pop(task_id, None)
+            if entry is not None:
+                spec = entry[1]
         if spec is not None:
             self.task_events.record(task_id, spec, "FINISHED")
             if self._persist and spec.actor_id is None and not spec.streaming:
@@ -4080,6 +4163,29 @@ class Runtime:
                 else:
                     self._fail_returns(spec, WorkerCrashedError(
                         f"worker died executing {spec.describe()}"))
+        # Steals that never got their ack: the dying origin will not run
+        # them (or died mid-run). Stolen specs are retriable by construction;
+        # consume a retry — "queued tail" vs "just begun" cannot be told
+        # apart once the worker is gone, and a begun task must not replay
+        # for free.
+        with self.lock:
+            orphaned = [tid for tid, (ow, _s) in self._pending_steals.items()
+                        if ow is w]
+            requeue, fail = [], []
+            for tid in orphaned:
+                spec = self._pending_steals.pop(tid)[1]
+                if (spec.retries_left or 0) > 0:
+                    spec.retries_left -= 1
+                    self.task_events.record(tid, spec, "RETRY")
+                    self._enqueue_task_locked(spec, front=True)
+                    requeue.append(spec)
+                else:
+                    fail.append(spec)
+        for spec in fail:
+            self._fail_returns(spec, WorkerCrashedError(
+                f"worker died with stolen task {spec.describe()} unacked"))
+        if requeue:
+            self._schedule()
         for token, (fut, fwid) in list(self._profile_futs.items()):
             if fwid == w.worker_id.binary():
                 self._profile_futs.pop(token, None)

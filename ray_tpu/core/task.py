@@ -37,6 +37,8 @@ class TaskSpec:
                           # path with the head relay, enforced at the
                           # executing node's agent (parity: the sequence
                           # numbers of actor_task_submitter.h:78)
+        "idempotent",     # user-declared: safe to re-execute without a
+                          # failure; opts into the one-phase steal fast path
     )
 
     def __init__(self, **kw):

@@ -153,7 +153,17 @@ class WorkerRuntime:
         self._wait_lock = threading.Lock()
         self.task_queue: "queue.Queue" = None  # set in main
         self.cancelled_tasks: set = set()  # dropped before execution
-        self.dropped_tasks: set = set()    # stolen back; skip silently
+        # Stolen back; skip silently. A COUNTER, not a set: the same task
+        # can be stolen, re-dispatched, pipelined back onto this very
+        # worker, and stolen again — each acked drop corresponds to exactly
+        # one stale queued exec copy that must be skipped, and a set would
+        # absorb the second mark and let the stale copy run (duplicate).
+        self.dropped_tasks: dict = {}      # task_id -> pending skip count
+        # Two-phase steal: ids whose execution has begun. The receiver
+        # thread consults this under steal_lock to decide a drop_task's ack
+        # (begun -> drop_ack False, the head aborts the steal).
+        self.begun_tasks: set = set()
+        self.steal_lock = threading.Lock()
         self.actor_instance = None
         self.actor_id: bytes | None = None
         self.shutdown = threading.Event()
@@ -993,12 +1003,23 @@ def _worker_main(store_path: str, worker_id: WorkerID, fd: int):
                     rt.cancelled_tasks.pop()
                 rt.cancelled_tasks.add(msg[1])
             elif op == "drop_task":
-                # Stolen back by the scheduler (re-dispatched elsewhere):
-                # skip WITHOUT a cancelled reply — a reply would poison the
-                # re-dispatched task's return objects.
-                if len(rt.dropped_tasks) > 1024:
-                    rt.dropped_tasks.pop()
-                rt.dropped_tasks.add(msg[1])
+                # Steal phase one from the scheduler. Under steal_lock
+                # against the executor: if the task has begun, refuse the
+                # drop (ack False — the head aborts the steal and this
+                # execution stands); else mark it dropped so the executor
+                # skips it WITHOUT a cancelled reply — a reply would poison
+                # the re-dispatched task's return objects.
+                with rt.steal_lock:
+                    began = msg[1] in rt.begun_tasks
+                    if not began:
+                        if len(rt.dropped_tasks) > 1024:
+                            rt.dropped_tasks.popitem()
+                        rt.dropped_tasks[msg[1]] = (
+                            rt.dropped_tasks.get(msg[1], 0) + 1)
+                try:
+                    rt.send(("drop_ack", msg[1], not began))
+                except OSError:
+                    pass
             elif op == "profile":
                 # On-demand stack sampling (parity: dashboard reporter's
                 # py-spy endpoint); runs on a side thread so the executor
@@ -1066,8 +1087,23 @@ def _worker_main(store_path: str, worker_id: WorkerID, fd: int):
                 pool = concurrent.futures.ThreadPoolExecutor(cspec.max_concurrency)
             continue
         spec: TaskSpec = item
-        if spec.task_id in rt.dropped_tasks:
-            rt.dropped_tasks.discard(spec.task_id)
+        with rt.steal_lock:
+            n_drops = rt.dropped_tasks.get(spec.task_id, 0)
+            if n_drops:
+                if n_drops == 1:
+                    del rt.dropped_tasks[spec.task_id]
+                else:
+                    rt.dropped_tasks[spec.task_id] = n_drops - 1
+                dropped = True
+            else:
+                # Atomic with the drop check: once marked begun, a
+                # drop_task will be refused (ack False) instead of racing
+                # this execution.
+                dropped = False
+                if len(rt.begun_tasks) > 4096:
+                    rt.begun_tasks.pop()
+                rt.begun_tasks.add(spec.task_id)
+        if dropped:
             continue
         if spec.task_id in rt.cancelled_tasks:
             rt.cancelled_tasks.discard(spec.task_id)

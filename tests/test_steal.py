@@ -1,0 +1,107 @@
+"""Two-phase work stealing: exactly-once execution absent failures.
+
+Parity: the reference scheduler never duplicates execution without a
+failure (owner-side TaskManager retries only on worker death/OOM —
+`src/ray/core_worker/task_manager.h:216`). Steals here must therefore be
+ack-gated: a stolen spec is re-dispatched only after the origin worker
+confirms the task never began (drop_ack True).
+"""
+
+import os
+import time
+
+import pytest
+
+
+def _read_ids(path):
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [ln.strip() for ln in f if ln.strip()]
+
+
+@pytest.mark.smoke
+def test_steal_exactly_once_with_side_effects(tmp_path):
+    """Skewed same-key tasks pipeline behind a straggler; the idle worker
+    steals the backlog. Every task must run exactly once."""
+    import ray_tpu
+
+    log = str(tmp_path / "effects.txt")
+    rt = ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote
+        def f(i, path):
+            with open(path, "a") as fh:
+                fh.write(f"{i}\n")
+                fh.flush()
+            time.sleep(1.0 if i == 0 else 0.02)
+            return i
+
+        refs = [f.remote(i, log) for i in range(10)]
+        out = ray_tpu.get(refs, timeout=30)
+        assert sorted(out) == list(range(10))
+        ids = _read_ids(log)
+        assert sorted(ids) == sorted(set(ids)), f"duplicate execution: {ids}"
+        assert len(ids) == 10
+        assert not rt._pending_steals
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_steal_drop_race_keeps_origin_result(tmp_path):
+    """Force the lost-drop race: the drop_task frame is chaos-delayed past
+    the point where the origin begins (and even finishes) the stolen task.
+    The origin refuses the drop (or the completion reaps the pending
+    steal) — either way the task runs exactly once and its result is
+    kept."""
+    import ray_tpu
+    from ray_tpu.core import transport
+
+    log = str(tmp_path / "effects.txt")
+    old = transport._chaos
+    transport._chaos = transport.ChaosInjector("", "drop_task=400000:400000")
+    rt = ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote
+        def f(i, path):
+            with open(path, "a") as fh:
+                fh.write(f"{i}\n")
+                fh.flush()
+            time.sleep(0.15 if i == 0 else 0.25)
+            return i * 7
+
+        refs = [f.remote(i, log) for i in range(6)]
+        out = ray_tpu.get(refs, timeout=30)
+        assert out == [i * 7 for i in range(6)]
+        ids = _read_ids(log)
+        assert sorted(ids) == sorted(set(ids)), f"duplicate execution: {ids}"
+        # Give any straggling delayed drop_ack time to drain, then the
+        # pending-steal table must be empty (no leaked entries).
+        for _ in range(50):
+            if not rt._pending_steals:
+                break
+            time.sleep(0.1)
+        assert not rt._pending_steals
+    finally:
+        transport._chaos = old
+        ray_tpu.shutdown()
+
+
+def test_idempotent_tasks_use_one_phase_steal(tmp_path):
+    """idempotent=True opts into the immediate re-enqueue path; results
+    must still be correct (duplicates allowed in principle, results
+    poisoned never)."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote
+        def g(i):
+            time.sleep(0.5 if i == 0 else 0.01)
+            return i
+
+        refs = [g.options(idempotent=True).remote(i) for i in range(8)]
+        out = ray_tpu.get(refs, timeout=30)
+        assert sorted(out) == list(range(8))
+    finally:
+        ray_tpu.shutdown()
