@@ -30,6 +30,9 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
                             "tasks with num_tpus>0 re-latch onto the host "
                             "platform ('' = inherit the driver's)"),
     "num_workers": (int, 0, "worker pool size; 0 = num_cpus"),
+    "gc_gen0_threshold": (int, 20000, "python gc gen-0 threshold in head/"
+                          "workers; default 700 triggers a collection (and "
+                          "jax's gc callback) every ~70 control messages"),
     "worker_startup_timeout_s": (float, 60.0, "time to wait for a worker to boot"),
     "worker_idle_timeout_s": (float, 300.0, "idle workers above pool size are reaped"),
     "max_pending_lease_requests": (int, 10, "in-flight lease requests per scheduling key"),

@@ -769,6 +769,13 @@ class Runtime:
         self.kv: dict = _JournaledDict("kv", self._pstore)  # gcs_kv_manager.h
         self.placement_groups: dict[bytes, PlacementGroupState] = {}
         self.pgs_waiting: collections.deque[bytes] = collections.deque()
+        # The control loop allocates ~10 small objects per message; the
+        # default gen-0 threshold (700) runs a collection — and jax's
+        # _xla_gc_callback, registered by the environment's sitecustomize —
+        # every ~70 messages, visibly sampling in the hot relay path.
+        if cfg.gc_gen0_threshold > 0:
+            import gc
+            gc.set_threshold(cfg.gc_gen0_threshold)  # gens 1-2 untouched
         self._reservations: dict[bytes, tuple] = {}  # task_id -> token
         # Two-phase steal: specs pulled off a busy worker's backlog await the
         # origin's drop-ack before re-dispatch (exactly-once absent failures;
@@ -1595,16 +1602,26 @@ class Runtime:
         w = self.workers.get(wid)
         if w is None or w.state == DEAD:
             return
+        def send_or_buffer(frame):
+            # Ride the listener's per-drain-pass out-batch when one is
+            # active: a fan-out waiter gets thousands of these pushes, and
+            # one coalesced sendall beats one syscall (plus one receiver
+            # wakeup) per result. Client-mode drivers never get batch
+            # frames — their handle_push has no "batch" arm.
+            if getattr(w, "is_client", False) or not self._buffered_send(
+                    w, frame):
+                w.send(frame)
+
         kind = entry[0]
         if kind == "raw":
-            w.send(("obj", oid, "inline" if entry[3] else "err",
-                    entry[1], entry[2]))
+            send_or_buffer(("obj", oid, "inline" if entry[3] else "err",
+                            entry[1], entry[2]))
         elif kind == "inline":
             payload, bufs, _ = serialization.serialize_value(entry[1])
-            w.send(("obj", oid, "inline", payload, bufs))
+            send_or_buffer(("obj", oid, "inline", payload, bufs))
         elif kind == "err":
             payload, bufs, _ = serialization.serialize_value(entry[1])
-            w.send(("obj", oid, "err", payload, bufs))
+            send_or_buffer(("obj", oid, "err", payload, bufs))
         else:
             if getattr(w, "is_client", False):
                 # Clients have no store: materialize on the head and ship
@@ -2338,13 +2355,34 @@ class Runtime:
     def wait(self, refs, num_returns=1, timeout=None):
         if num_returns > len(refs):
             raise ValueError("num_returns > len(refs)")
-        # Fast path: enough refs already resolved — a plain dict probe per
-        # ref, no callback registration. Wait-in-a-loop patterns (pop one
-        # ready ref per call over N refs) would otherwise register O(N^2)
-        # ghost callbacks across the loop.
+        # Fastest path: wait()'s contract returns AT MOST num_returns ready
+        # refs — everything else goes to not_ready regardless of its actual
+        # state (same as the reference, `ray.wait`). So probe in order and
+        # STOP as soon as num_returns are found: the canonical
+        # pop-one-ref-per-call drain loop costs O(1) probes per call when
+        # completions keep pace, instead of O(N) probes of every pending
+        # ref on every call.
+        entries = self.directory.entries
+        with self.directory.lock:
+            found = []
+            for i, r in enumerate(refs):
+                if r.id.binary() in entries:
+                    found.append(i)
+                    if len(found) == num_returns:
+                        break
+        if len(found) == num_returns:
+            fset = set(found)
+            ready = [refs[i] for i in found]
+            not_ready = [r for i, r in enumerate(refs) if i not in fset]
+            return ready, not_ready
+        # Not enough ready. The scan above only breaks on success, so it
+        # covered every ref — reuse its partition instead of re-probing
+        # (split_ready here would double the lock-held probe cost exactly
+        # when the caller is about to block).
         oids = [r.id.binary() for r in refs]
-        ready, pending = self.directory.split_ready(oids)
-        ready_set: set[bytes] = set(ready)
+        fset = set(found)
+        ready_set: set[bytes] = {oids[i] for i in found}
+        pending = [o for i, o in enumerate(oids) if i not in fset]
         if len(ready_set) < num_returns:
             # Slow path: sleep on the directory's global ready pulse and
             # re-probe only the still-pending refs on each pulse (one lock

@@ -135,6 +135,7 @@ class WorkerRuntime:
         self.send_lock = threading.Lock()
         self._send_q: collections.deque = collections.deque()
         self._send_cv = threading.Condition()
+        self._last_send = 0.0
         self._send_exc: OSError | None = None
         self._sender_started = False
         # In-flight channel claims (inline senders + the sender thread
@@ -291,11 +292,24 @@ class WorkerRuntime:
         sender thread coalesces them into one write — a task fanning out
         actor calls or puts stops paying one syscall+wakeup per call.
         Order is exactly send-call order, so every head-side invariant
-        that held under inline sends still holds."""
+        that held under inline sends still holds.
+
+        Burst detection: a SEQUENTIAL fan-out loop (submit, submit, ...)
+        never finds the channel busy — each inline sendall completes, and
+        worse, wakes the head per frame (on a shared core that preemption
+        doubles the cost). When the previous send was <150us ago, hand the
+        frame to the sender thread instead: while its send_many syscall is
+        in flight the loop keeps queueing, so bursts collapse into a few
+        large writes."""
+        burst = False
+        now = time.monotonic()
+        if now - self._last_send < 150e-6:
+            burst = True
+        self._last_send = now
         with self._send_cv:
             if self._send_exc is not None:
                 raise self._send_exc
-            if self._send_q or self._sending:
+            if self._send_q or self._sending or burst:
                 if not self._sender_started:
                     self._sender_started = True
                     threading.Thread(target=self._sender_loop, daemon=True,
@@ -933,6 +947,11 @@ def _honor_platform_env(jax_mod):
 
 def _worker_main(store_path: str, worker_id: WorkerID, fd: int):
     set_config(Config.from_env())
+    if get_config().gc_gen0_threshold > 0:
+        # Same rationale as the head runtime: don't run a gc pass (plus
+        # jax's gc callback) every ~70 control messages.
+        import gc
+        gc.set_threshold(get_config().gc_gen0_threshold)  # gens 1-2 as-is
     venv_site = os.environ.get("RAY_TPU_VENV_SITE")
     if venv_site:
         # Env-pool worker: the pip env's packages shadow the host env for
