@@ -1224,34 +1224,38 @@ class Runtime:
                     events = self._selector.select(timeout=0.05)
                 except OSError:
                     continue
-            for key, _mask in events:
-                handle = key.data
-                if handle.kind == "accept":
+            # One out-batch per select round, spanning every ready
+            # connection: a single done_batch frame can fan out dozens of
+            # result pushes, and under load several conns are ready at
+            # once — coalescing across the whole round turns those into
+            # one sendall per destination.
+            self._begin_out_batch()
+            try:
+                for key, _mask in events:
+                    handle = key.data
+                    if handle.kind == "accept":
+                        try:
+                            conn_sock, _addr = key.fileobj.accept()
+                        except OSError:
+                            continue
+                        conn_sock.setblocking(True)
+                        nc = NodeConn(conn_sock)
+                        with self._sel_lock:
+                            self._selector.register(
+                                conn_sock, selectors.EVENT_READ, nc)
+                        continue
                     try:
-                        conn_sock, _addr = key.fileobj.accept()
+                        data = key.fileobj.recv(1 << 20)
+                    except (BlockingIOError, InterruptedError):
+                        continue
                     except OSError:
-                        continue
-                    conn_sock.setblocking(True)
-                    nc = NodeConn(conn_sock)
-                    with self._sel_lock:
-                        self._selector.register(
-                            conn_sock, selectors.EVENT_READ, nc)
-                    continue
-                try:
-                    data = key.fileobj.recv(1 << 20)
-                except (BlockingIOError, InterruptedError):
-                    continue
-                except OSError:
-                    data = b""
-                if handle.kind == "node":
-                    if not data:
-                        self._on_node_conn_closed(handle)
-                        continue
-                    handle.buffer.feed(data)
-                    msgs = handle.buffer.frames()
-                    self._begin_out_batch(msgs)
-                    try:
-                        for msg in msgs:
+                        data = b""
+                    if handle.kind == "node":
+                        if not data:
+                            self._on_node_conn_closed(handle)
+                            continue
+                        handle.buffer.feed(data)
+                        for msg in handle.buffer.frames():
                             try:
                                 if handle.client_handle is not None:
                                     self._handle_msg(handle.client_handle,
@@ -1260,34 +1264,27 @@ class Runtime:
                                     self._handle_node_msg(handle, msg)
                             except Exception:
                                 traceback.print_exc()
-                    finally:
-                        self._flush_out_batch()
-                    continue
-                if not data:
-                    self._on_worker_death(handle)
-                    continue
-                handle.buffer.feed(data)
-                msgs = handle.buffer.frames()
-                self._begin_out_batch(msgs)
-                try:
-                    for msg in msgs:
+                        continue
+                    if not data:
+                        self._on_worker_death(handle)
+                        continue
+                    handle.buffer.feed(data)
+                    for msg in handle.buffer.frames():
                         try:
                             self._handle_msg(handle, msg)
                         except Exception:
                             traceback.print_exc()
-                finally:
-                    self._flush_out_batch()
+            finally:
+                self._flush_out_batch()
 
-    # A drain pass that decoded several inbound frames usually produces
-    # several outbound actor dispatches too (fan-out submits arrive
-    # coalesced from the worker's sender thread). Batching them per target
-    # turns N sendalls into one (the worker side already unpacks "batch"
-    # frames). Listener-thread only — other threads send inline.
+    # The select-round out-batch: outbound frames produced while handling
+    # this round's inbound frames coalesce per destination into one
+    # sendall (the worker side unpacks "batch" frames). Listener-thread
+    # only — other threads send inline.
 
-    def _begin_out_batch(self, msgs):
-        if len(msgs) > 1:
-            self._tl_out.batch = {}
-            self._tl_out.order = []
+    def _begin_out_batch(self):
+        self._tl_out.batch = {}
+        self._tl_out.order = []
 
     def _buffered_send(self, w, frame) -> bool:
         """Queue a frame on the current drain pass's batch; False when no
