@@ -210,6 +210,54 @@ def bench_llm_decode(layout: str, slots: int = 32, prompt_len: int = 128,
     return out
 
 
+def bench_llm_prefix_shared(slots: int = 32, prompt_len: int = 256,
+                            gen: int = 64):
+    """Shared-prefix serving shape (VERDICT r3 #2 done-criterion:
+    prefix_hits > 0 UNDER MEASUREMENT): every prompt shares a 128-token
+    system-prompt prefix; admissions after the first borrow its cached
+    pages and prefill only the unique tail."""
+    import numpy as np
+
+    from ray_tpu.llm import EngineConfig, InferenceEngine
+    from ray_tpu.models import configs
+
+    cfg = configs.bench_125m()
+    eng = InferenceEngine(
+        cfg, EngineConfig(
+            max_slots=slots, max_len=1024,
+            prompt_buckets=(128, 256), eos_token=-1, kv_layout="paged"),
+        params=None, seed=0)
+    rng = np.random.default_rng(0)
+    shared = rng.integers(1, cfg.vocab, 128).tolist()
+    prompts = [shared + rng.integers(1, cfg.vocab, prompt_len - 129).tolist()
+               for _ in range(slots)]
+    # Warm SEQUENTIALLY: the first generate registers the shared prefix
+    # pages; the second burst (same size as the measured one, fresh
+    # suffixes) compiles the batched prefix-hit prefill and the full-size
+    # decode windows before the clock starts.
+    eng.generate(prompts[:1], max_new_tokens=gen, temperature=0.0)
+    warm = [shared + rng.integers(1, cfg.vocab, prompt_len - 129).tolist()
+            for _ in range(slots)]
+    eng.generate(warm, max_new_tokens=gen, temperature=0.0)
+    for p in prompts:
+        eng.add_request(p, max_new_tokens=gen, temperature=0.0)
+    t0 = time.time()
+    before = sum(len(r.generated) for r in eng.finished.values())
+    while eng.has_work():
+        eng.step_window()
+    toks = sum(len(r.generated) for r in eng.finished.values()) - before
+    dt = time.time() - t0
+    out = {
+        "config": "llm_decode_prefix_shared", "slots": slots,
+        "prompt_len": prompt_len, "shared_prefix": 128,
+        "max_new_tokens": gen,
+        "decode_tokens_per_sec": round(toks / dt),
+        "kv": eng.kv_stats(),
+    }
+    print(f"llm_prefix_shared: {out}", file=sys.stderr)
+    return out
+
+
 def bench_rl_ppo(iters: int = 3):
     """RL throughput (BASELINE north star metric "RLlib PPO env-steps/
     sec"): PPO + the conv module on the MinAtar-style Breakout, env
@@ -283,6 +331,12 @@ def run() -> dict:
             results["configs"].append(
                 {"config": f"llm_decode_{layout}", "error": str(e)[:200]})
             print(f"llm_decode[{layout}]: FAILED {e}", file=sys.stderr)
+    try:
+        results["configs"].append(bench_llm_prefix_shared())
+    except Exception as e:
+        results["configs"].append(
+            {"config": "llm_decode_prefix_shared", "error": str(e)[:200]})
+        print(f"llm_prefix_shared: FAILED {e}", file=sys.stderr)
     try:
         results["configs"].append(bench_rl_ppo())
     except Exception as e:

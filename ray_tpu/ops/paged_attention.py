@@ -1,0 +1,210 @@
+"""Paged-KV decode attention kernel (TPU Pallas).
+
+The decode hot loop attends one query token per slot against that slot's
+paged KV history. XLA lowers the naive formulation (gather pages into a
+contiguous [B, T] cache, then attend) at ~10% of HBM bandwidth — the page
+gather dominated the whole decode step. This kernel instead walks each
+slot's page table and DMAs exactly the pages it owns through a two-deep
+manual pipeline, flash-accumulating on the fly, so per-step traffic is
+the true KV working set.
+
+Parity: the role of vLLM's paged attention CUDA kernel inside the
+reference's LLM serving stack (`python/ray/llm/_internal/serve/deployments/
+llm/vllm/`); the TPU shape follows the public JetStream/MaxText paged
+decode pattern (scalar-prefetched page tables + manual double-buffered
+page DMA).
+
+Layouts:
+  q            [B, n_heads, head_dim]
+  k_pages, v_pages [n_kv_heads, num_pages, head_dim, page_size]
+      (head_dim BEFORE page: a page's DMA slice then has trailing dims
+      (head_dim, page) = (64|128, 128), which Mosaic can tile — with page
+      last-minor the 64-wide head_dim would land on the 128-lane axis and
+      the per-page slice fails to lower)
+  lengths      [B]  number of valid tokens (attend positions < lengths)
+  page_tables  [B, P]  page ids in position order (entry 0 = scratch page)
+
+Returns [B, n_heads, head_dim].
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = -0.7 * float(np.finfo(np.float32).max)
+
+
+def paged_decode_attention(q, k_pages, v_pages, lengths, page_tables, *,
+                           interpret: bool | None = None):
+    """Flash decode over paged KV; see module docstring for layouts.
+
+    interpret=None auto-selects: the Mosaic lowering needs a real TPU
+    backend; everywhere else (CPU tests, multichip dryrun) the kernel
+    runs in interpret mode."""
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    page, hd = k_pages.shape[3], k_pages.shape[2]
+    if not interpret and (page % 128 or hd % 8):
+        # Mosaic can only DMA page slices whose trailing dims tile to
+        # (8, 128); off-size pages (toy/test configs) fall back to the
+        # XLA gather-attend formulation — slower, always correct.
+        return _paged_decode_xla(q, k_pages, v_pages, lengths, page_tables)
+    return _paged_decode_dma(q, k_pages, v_pages, lengths,
+                             page_tables, interpret=interpret)
+
+
+@jax.jit
+def _paged_decode_xla(q, k_pages, v_pages, lengths, page_tables):
+    return paged_decode_attention_reference(q, k_pages, v_pages, lengths,
+                                            page_tables)
+
+
+def _dma_kernel(lengths_ref, tables_ref,  # scalar prefetch (SMEM)
+                q_ref, k_hbm, v_hbm, o_ref,
+                kbuf, vbuf, m_ref, l_ref, acc_ref, sem, *, page: int,
+                scale: float, pages_per_seq: int):
+    """One grid step per slot; the slot's pages stream HBM->VMEM through
+    a two-deep manual DMA pipeline (page i+1 in flight while page i is in
+    the flash update). One grid step per slot keeps grid overhead off the
+    hot path — a BlockSpec-per-page variant spends more time stepping the
+    grid than computing (measured ~0.8ms per layer call vs ~0.2ms for
+    this shape)."""
+    b = pl.program_id(0)
+    length = lengths_ref[b]
+    npg = jnp.minimum(
+        jax.lax.div(length + page - 1, page), pages_per_seq)
+
+    def start_copy(i, slot):
+        pid = tables_ref[b, i]
+        pltpu.make_async_copy(
+            k_hbm.at[:, pid], kbuf.at[slot], sem.at[slot, 0]).start()
+        pltpu.make_async_copy(
+            v_hbm.at[:, pid], vbuf.at[slot], sem.at[slot, 1]).start()
+
+    def wait_copy(slot):
+        pltpu.make_async_copy(
+            k_hbm.at[:, 0], kbuf.at[slot], sem.at[slot, 0]).wait()
+        pltpu.make_async_copy(
+            v_hbm.at[:, 0], vbuf.at[slot], sem.at[slot, 1]).wait()
+
+    m_ref[...] = jnp.full_like(m_ref, _NEG)
+    l_ref[...] = jnp.zeros_like(l_ref)
+    acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(npg > 0)
+    def _first():
+        start_copy(0, 0)
+
+    q = q_ref[0].astype(jnp.float32)                   # [hkv, g, hd]
+    hkv, g, hd = q.shape
+
+    def body(i, _):
+        slot = jax.lax.rem(i, 2)
+
+        @pl.when(i + 1 < npg)
+        def _prefetch():
+            start_copy(i + 1, 1 - slot)
+
+        wait_copy(slot)
+        k = kbuf[slot].astype(jnp.float32)             # [hkv, hd, page]
+        v = vbuf[slot].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale   # [hkv, g, page]
+        pos = i * page + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, dimension=2)
+        s = jnp.where(pos < length, s, _NEG)
+        m_old = m_ref[...]                             # [hkv*g, 128]
+        s2 = s.reshape(hkv * g, page)
+        m_cur = jnp.max(s2, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_old, jnp.broadcast_to(m_cur, m_old.shape))
+        alpha = jnp.exp(m_old[:, :1] - m_new[:, :1])
+        p_exp = jnp.exp(s2 - m_new[:, :1])
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(
+            p_exp, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p_exp.reshape(hkv, g, page), v,
+            (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)        # [hkv, g, hd]
+        acc_ref[...] = acc_ref[...] * alpha[:, None].reshape(
+            hkv, g, 1) + pv
+        m_ref[...] = m_new
+        return 0
+
+    jax.lax.fori_loop(0, npg, body, 0)
+    l = l_ref[...][:, :1]
+    l = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc_ref[...] / l.reshape(hkv, g, 1)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _paged_decode_dma(q, k_pages, v_pages, lengths, page_tables, *,
+                      interpret: bool = False):
+    B, h, hd = q.shape
+    hkv, N, _, page = k_pages.shape
+    assert h % hkv == 0, (h, hkv)
+    g = h // hkv
+    P = page_tables.shape[1]
+    q4 = q.reshape(B, hkv, g, hd)
+    scale = 1.0 / float(np.sqrt(hd))
+    kernel = functools.partial(_dma_kernel, page=page, scale=scale,
+                               pages_per_seq=P)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B,),
+            in_specs=[
+                pl.BlockSpec((1, hkv, g, hd),
+                             lambda b, lens, tbl: (b, 0, 0, 0)),
+                pl.BlockSpec(memory_space=pltpu.ANY),   # k_pages in HBM
+                pl.BlockSpec(memory_space=pltpu.ANY),   # v_pages in HBM
+            ],
+            out_specs=pl.BlockSpec((1, hkv, g, hd),
+                                   lambda b, lens, tbl: (b, 0, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((2, hkv, hd, page), k_pages.dtype),  # kbuf
+                pltpu.VMEM((2, hkv, hd, page), v_pages.dtype),  # vbuf
+                pltpu.VMEM((hkv * g, 128), jnp.float32),        # m
+                pltpu.VMEM((hkv * g, 128), jnp.float32),        # l
+                pltpu.VMEM((hkv, g, hd), jnp.float32),          # acc
+                pltpu.SemaphoreType.DMA((2, 2)),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, hkv, g, hd), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(lengths, page_tables, q4, k_pages, v_pages)
+    return out.reshape(B, h, hd)
+
+
+def paged_decode_attention_reference(q, k_pages, v_pages, lengths,
+                                     page_tables):
+    """Dense reference for tests: gather pages, mask, softmax."""
+    B, h, hd = q.shape
+    hkv, N, _, page = k_pages.shape
+    g = h // hkv
+    P = page_tables.shape[1]
+    T = P * page
+    ck = k_pages[:, page_tables]          # [hkv, B, P, hd, page]
+    cv = v_pages[:, page_tables]
+    # -> [B, hkv, T, hd]
+    ck = jnp.moveaxis(ck, 0, 1).transpose(0, 1, 2, 4, 3).reshape(
+        B, hkv, T, hd)
+    cv = jnp.moveaxis(cv, 0, 1).transpose(0, 1, 2, 4, 3).reshape(
+        B, hkv, T, hd)
+    q4 = q.reshape(B, hkv, g, hd).astype(jnp.float32)
+    s = jnp.einsum("bkgd,bktd->bkgt", q4, ck.astype(jnp.float32))
+    s = s / np.sqrt(hd)
+    mask = jnp.arange(T)[None, None, None] < lengths[:, None, None, None]
+    s = jnp.where(mask, s, -jnp.inf)
+    pr = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bkgt,bktd->bkgd", pr, cv.astype(jnp.float32))
+    return out.reshape(B, h, hd).astype(q.dtype)
