@@ -789,6 +789,13 @@ class Runtime:
         self._listener = threading.Thread(
             target=self._listen_loop, daemon=True, name="rtpu-listener")
         self._listener.start()
+        # Dedicated scheduler thread (see _schedule): submission bursts
+        # coalesce into few passes; dispatch sendalls leave the
+        # submitting/listener threads.
+        self._sched_cv = threading.Condition()
+        self._sched_gen = 0
+        threading.Thread(target=self._sched_loop, daemon=True,
+                         name="rtpu-scheduler").start()
 
         pool = cfg.num_workers or int(self.total_resources["CPU"])
         self.pool_size = max(1, pool)
@@ -2577,6 +2584,13 @@ class Runtime:
     # ---------------- task submission / scheduling ----------------
 
     def export_function(self, fn_id: bytes, blob: bytes):
+        # Every submission ships the blob; all but the first are repeats.
+        # The unlocked membership probe is safe (same fn_id -> same blob,
+        # and dict reads are atomic) and keeps the submit path off the
+        # scheduling lock — under a 64-agent storm this lock acquire
+        # sampled hotter than the actual export.
+        if fn_id in self.fn_table:
+            return
         with self.lock:
             self.fn_table[fn_id] = blob
 
@@ -3396,6 +3410,41 @@ class Runtime:
             return out
 
     def _schedule(self):
+        """Request a scheduling pass. Single-node clusters run it inline
+        (a pass sends to at most the local worker pool — the thread hop
+        would only add ~100us to every sync call). With agents attached,
+        the pass is debounced onto the dedicated scheduler thread: a
+        submission burst coalesces into a handful of passes whose
+        dispatch frames batch per agent, instead of every submit paying a
+        full pass plus one sendall per agent on the submitting thread (a
+        64-agent profile put ~37% of the head core in exactly that).
+        Concurrent passes are safe — queue pops and reservations are
+        under the lock — the debounce exists for throughput, not
+        correctness."""
+        if len(self.nodes) <= 1:
+            self._schedule_now()
+            return
+        with self._sched_cv:
+            self._sched_gen += 1
+            self._sched_cv.notify()
+
+    def _sched_loop(self):
+        gen_done = 0
+        while not self._shutdown:
+            with self._sched_cv:
+                while self._sched_gen == gen_done and not self._shutdown:
+                    # The timeout is a safety net only: every state change
+                    # that can unblock scheduling must call _schedule().
+                    self._sched_cv.wait(0.2)
+                gen_done = self._sched_gen
+            if self._shutdown:
+                return
+            try:
+                self._schedule_now()
+            except Exception:
+                traceback.print_exc()
+
+    def _schedule_now(self):
         """Dispatch every feasible queued task to an idle worker.
 
         Per-scheduling-key queues (parity: normal_task_submitter.h:58):
@@ -4343,6 +4392,8 @@ class Runtime:
             # its handle (we see it below) or will observe the flag and
             # self-clean.
             self._shutdown = True
+        with self._sched_cv:
+            self._sched_cv.notify_all()
         for node in list(self.nodes.values()):
             if node.conn is not None and node.state == "ALIVE":
                 try:
