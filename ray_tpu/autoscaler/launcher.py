@@ -653,10 +653,216 @@ class GCEProvider(InstanceProvider):
             ssh_port=int(auth.get("ssh_port", 22)))
 
 
+class KubernetesProvider(InstanceProvider):
+    """Pods on a Kubernetes cluster over the raw K8s REST API.
+
+    Parity: `python/ray/autoscaler/_private/kuberay/` — the reference's
+    dominant production deployment path. KubeRay-shaped rather than a
+    port: nodes ARE pods (no SSH, no VM bootstrap); the start command is
+    baked into the pod spec, so the provider is `self_bootstrapping` and
+    the launcher skips the CommandRunner phase. The HTTP layer is the
+    same single injectable `transport(method, url, body) -> dict` the GCE
+    provider uses, so every flow is unit-testable with zero egress
+    against a fake API server.
+
+    provider config keys: namespace (default "default"), api_server
+    (default in-cluster https://kubernetes.default.svc), service_account
+    token/CA picked up from the in-cluster mount when present.
+    node_config keys: image, command (list or str; overrides the
+    launcher-composed bootstrap), memory, labels, env (dict).
+    """
+
+    self_bootstrapping = True
+
+    def __init__(self, provider_config, cluster_name, transport=None):
+        super().__init__(provider_config, cluster_name)
+        self.namespace = provider_config.get("namespace", "default")
+        self.api = provider_config.get(
+            "api_server", "https://kubernetes.default.svc").rstrip("/")
+        self.transport = transport or self._default_transport
+        self._pending_commands: dict[str, list[str]] = {}
+        self._pending_env: dict[str, dict] = {}
+
+    # -- auth/transport --------------------------------------------------
+
+    _SA = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+    def _default_transport(self, method: str, url: str, body: dict | None):
+        import ssl
+        import urllib.request
+        headers = {"Content-Type": "application/json"}
+        try:
+            with open(f"{self._SA}/token") as f:
+                headers["Authorization"] = f"Bearer {f.read().strip()}"
+        except OSError:
+            pass
+        ctx = None
+        if url.startswith("https"):
+            ctx = ssl.create_default_context()
+            try:
+                ctx.load_verify_locations(f"{self._SA}/ca.crt")
+            except OSError:
+                pass
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method,
+                                     headers=headers)
+        with urllib.request.urlopen(req, timeout=60, context=ctx) as resp:
+            payload = resp.read()
+        return json.loads(payload) if payload else {}
+
+    # -- pod helpers -----------------------------------------------------
+
+    def _pods_url(self, name: str = "", query: str = "") -> str:
+        base = f"{self.api}/api/v1/namespaces/{self.namespace}/pods"
+        if name:
+            base += f"/{name}"
+        if query:
+            base += f"?{query}"
+        return base
+
+    @staticmethod
+    def _tags_of(labels: dict) -> dict:
+        return {k.replace("ray-", "", 1).replace("-", "_"): v
+                for k, v in labels.items() if k.startswith("ray-")
+                and k != "ray-cluster-name"}
+
+    def prepare_bootstrap(self, kind: str, commands: list[str],
+                          env: dict | None = None):
+        """Launcher hook: the composed setup+start commands for the next
+        `create_instance` of this node kind become the pod's container
+        command (KubeRay bakes the equivalent into the RayCluster CR)."""
+        self._pending_commands[kind] = list(commands)
+        self._pending_env[kind] = dict(env or {})
+
+    def non_terminated_instances(self, tag_filters):
+        sel = f"ray-cluster-name%3D{self.cluster_name}"
+        resp = self.transport("GET",
+                              self._pods_url(query=f"labelSelector={sel}"),
+                              None)
+        out = []
+        for pod in resp.get("items", []):
+            phase = pod.get("status", {}).get("phase", "")
+            if phase not in ("Running", "Pending"):
+                continue
+            if pod.get("metadata", {}).get("deletionTimestamp"):
+                continue
+            tags = self._tags_of(pod.get("metadata", {}).get("labels", {}))
+            if not all(tags.get(k) == v for k, v in tag_filters.items()):
+                continue
+            out.append(Instance(pod["metadata"]["name"],
+                                pod.get("status", {}).get("podIP", ""),
+                                tags, phase.lower()))
+        return out
+
+    def create_instance(self, node_type, tags, auth,
+                        wait_timeout: float = 300.0):
+        nc = dict(node_type.node_config)
+        kind = tags.get("node_kind", "worker")
+        name = (f"ray-{self.cluster_name}-{kind}-"
+                f"{uuid.uuid4().hex[:6]}")
+        labels = {"ray-cluster-name": self.cluster_name}
+        labels.update({f"ray-{k.replace('_', '-')}": str(v)
+                       for k, v in tags.items()})
+        labels.update(nc.get("labels", {}))
+        requests: dict = {}
+        cpus = node_type.resources.get("CPU")
+        if cpus:
+            requests["cpu"] = str(cpus)
+        if nc.get("memory"):
+            requests["memory"] = str(nc["memory"])
+        tpus = node_type.resources.get("TPU")
+        if tpus:
+            requests["google.com/tpu"] = str(int(tpus))
+        command = nc.get("command") or self._pending_commands.get(kind)
+        if isinstance(command, str):
+            command = ["/bin/sh", "-c", command]
+        elif command and not nc.get("command"):
+            command = ["/bin/sh", "-c", " && ".join(command)]
+        env_items = [{"name": k, "value": str(v)}
+                     for k, v in {**nc.get("env", {}),
+                                  **self._pending_env.get(kind, {})}.items()]
+        container = {
+            "name": "ray-node",
+            "image": nc.get("image", "ray-tpu:latest"),
+            "resources": {"requests": requests, "limits": dict(requests)},
+            "env": env_items,
+        }
+        if command:
+            container["command"] = command
+        body = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": name, "labels": labels},
+            "spec": {"restartPolicy": "Never",
+                     "containers": [container]},
+        }
+        self.transport("POST", self._pods_url(), body)
+        deadline = time.monotonic() + wait_timeout
+        ip = ""
+        while time.monotonic() < deadline:
+            pod = self.transport("GET", self._pods_url(name), None)
+            st = pod.get("status", {})
+            ip = st.get("podIP", "")
+            if st.get("phase") == "Failed":
+                raise RuntimeError(f"pod {name} failed: {st}")
+            if st.get("phase") == "Running" and ip:
+                break
+            time.sleep(1.0)
+        else:
+            raise TimeoutError(f"pod {name} not Running after "
+                               f"{wait_timeout}s")
+        return Instance(name, ip, dict(tags))
+
+    def terminate_instance(self, instance_id):
+        self.transport("DELETE", self._pods_url(instance_id), None)
+
+    def command_runner(self, inst, auth):
+        return KubectlCommandRunner(inst.instance_id, self.namespace)
+
+
+class KubectlCommandRunner(CommandRunner):
+    """exec/cp into a pod via the kubectl CLI (the K8s exec subresource
+    needs a SPDY/websocket upgrade that plain REST can't carry). Only
+    `ray exec`/`submit`/`rsync` convenience paths use this — cluster
+    bring-up never does (pods self-bootstrap)."""
+
+    def __init__(self, pod: str, namespace: str):
+        self.pod = pod
+        self.namespace = namespace
+
+    def _kubectl(self) -> list[str]:
+        return ["kubectl", "-n", self.namespace]
+
+    def run(self, cmd: str, *, check=True, capture=False, timeout=600.0):
+        import subprocess
+        proc = subprocess.run(
+            self._kubectl() + ["exec", self.pod, "--", "/bin/sh", "-lc",
+                               cmd],
+            capture_output=capture, text=True, timeout=timeout)
+        if check and proc.returncode != 0:
+            raise RuntimeError(
+                f"kubectl exec failed ({proc.returncode}): {cmd}")
+        return proc.returncode, (proc.stdout or "") if capture else ""
+
+    def put(self, local_path, remote_path):
+        import subprocess
+        subprocess.run(self._kubectl() + [
+            "cp", local_path, f"{self.pod}:{remote_path}"], check=True)
+
+    def get(self, remote_path, local_path):
+        import subprocess
+        subprocess.run(self._kubectl() + [
+            "cp", f"{self.pod}:{remote_path}", local_path], check=True)
+
+    def remote_shell_command(self) -> list[str]:
+        return self._kubectl() + ["exec", "-it", self.pod, "--", "/bin/sh"]
+
+
 _PROVIDERS = {
     "local": LocalProvider,
     "ssh": SSHProvider,
     "gce": GCEProvider,
+    "kubernetes": KubernetesProvider,
 }
 
 
@@ -705,6 +911,25 @@ def _bootstrap_instance(config: ClusterConfig, provider: InstanceProvider,
                         head_address: str = "",
                         verbose: bool = True) -> tuple[Instance,
                                                        CommandRunner]:
+    if getattr(provider, "self_bootstrapping", False):
+        # KubeRay-shaped: setup+start become the pod's container command;
+        # no runner phase (the image carries the environment, file mounts
+        # don't apply to pods).
+        setup = config.setup_commands + (
+            config.head_setup_commands if kind == "head"
+            else config.worker_setup_commands)
+        start = (_subst(config.head_start_ray_commands,
+                        head_port=config.head_port)
+                 if kind == "head" else
+                 _subst(config.worker_start_ray_commands,
+                        head_address=head_address))
+        provider.prepare_bootstrap(kind, setup + start)
+        inst = provider.create_instance(
+            node_type, {"node_kind": kind, "node_type": node_type.name},
+            config.auth)
+        if verbose:
+            print(f"[launcher] {kind} pod {inst.instance_id} @ {inst.ip}")
+        return inst, None
     inst = provider.create_instance(
         node_type, {"node_kind": kind, "node_type": node_type.name},
         config.auth)
@@ -739,20 +964,27 @@ def create_or_update_cluster(config: ClusterConfig,
     heads = provider.non_terminated_instances({"node_kind": "head"})
     if heads:
         head = heads[0]
-        runner = provider.command_runner(head, config.auth)
+        runner = (None if getattr(provider, "self_bootstrapping", False)
+                  else provider.command_runner(head, config.auth))
         if verbose:
             print(f"[launcher] reusing head {head.instance_id} @ {head.ip}")
     else:
         head_type = config.available_node_types[config.head_node_type]
         head, runner = _bootstrap_instance(config, provider, "head",
                                            head_type, verbose=verbose)
-    address = _head_address(config, runner)
-    if not address:
-        raise RuntimeError("head did not publish a cluster address")
-    # The launcher's address is instance-relative ("127.0.0.1:port" or the
-    # head's private IP); rewrite the host to the instance IP we can reach.
-    port = address.rsplit(":", 1)[1]
-    address = f"{head.ip}:{port}"
+    if runner is None:
+        # Self-bootstrapping (pod) head: the address is the pod IP at the
+        # configured port — there is no runner to ask.
+        address = f"{head.ip}:{config.head_port}"
+    else:
+        address = _head_address(config, runner)
+        if not address:
+            raise RuntimeError("head did not publish a cluster address")
+        # The launcher's address is instance-relative ("127.0.0.1:port" or
+        # the head's private IP); rewrite the host to the instance IP we
+        # can reach.
+        port = address.rsplit(":", 1)[1]
+        address = f"{head.ip}:{port}"
 
     for name, nt in config.available_node_types.items():
         existing = provider.non_terminated_instances(
