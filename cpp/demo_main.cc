@@ -53,6 +53,45 @@ int main(int argc, char** argv) {
   std::string got;
   if (!c.KvGet("cpp-key", &got) || got != "cpp-val") return 1;
   printf("KV ok\n");
+
+  // Actor lifecycle, no Python on this side: create a Python actor by
+  // importable class name, call it (ordered), wait, read results, kill.
+  std::string aid = c.CreateActor("tests.xlang_helpers.CppCounter",
+                                  {raytpu_client::Client::I64(10)});
+  if (aid.empty()) {
+    fprintf(stderr, "create_actor: %s\n", c.error().c_str());
+    return 1;
+  }
+  std::string r1 = c.CallActor(aid, "add", {raytpu_client::Client::I64(5)});
+  std::string r2 = c.CallActor(aid, "add", {raytpu_client::Client::I64(7)});
+  std::string r3 = c.CallActor(aid, "total", {});
+  if (r1.empty() || r2.empty() || r3.empty()) {
+    fprintf(stderr, "actor_call: %s\n", c.error().c_str());
+    return 1;
+  }
+  std::vector<std::string> ready;
+  if (!c.Wait({r1, r2, r3}, 3, 60, &ready) || ready.size() != 3) {
+    fprintf(stderr, "wait: %s\n", c.error().c_str());
+    return 1;
+  }
+  int64_t v1 = 0, v2 = 0, v3 = 0;
+  v = c.Get(r1, 60, &found);
+  if (!found || v.format() != "i64") return 1;
+  memcpy(&v1, v.data().data(), 8);
+  v = c.Get(r2, 60, &found);
+  memcpy(&v2, v.data().data(), 8);
+  v = c.Get(r3, 60, &found);
+  memcpy(&v3, v.data().data(), 8);
+  if (v1 != 15 || v2 != 22 || v3 != 22) {
+    fprintf(stderr, "actor results wrong: %lld %lld %lld\n",
+            (long long)v1, (long long)v2, (long long)v3);
+    return 1;
+  }
+  printf("ACTOR add=15,22 total=22\n");
+  if (!c.KillActor(aid, true)) return 1;
+  // Calls after kill fail cleanly on the client plane.
+  if (!c.CallActor(aid, "total", {}).empty()) return 1;
+  printf("ACTOR killed\n");
   printf("ALL OK\n");
   return 0;
 }

@@ -174,6 +174,61 @@ std::vector<std::string> Client::Submit(
           reply.submit().return_ids().end()};
 }
 
+std::string Client::CreateActor(const std::string& class_name,
+                                const std::vector<raytpu::Value>& args,
+                                double num_cpus, const std::string& name) {
+  raytpu::ClientRequest req;
+  auto* ca = req.mutable_create_actor();
+  ca->set_class_name(class_name);
+  ca->set_num_cpus(num_cpus);
+  if (!name.empty()) ca->set_name(name);
+  for (const auto& a : args) ca->add_args()->mutable_value()->CopyFrom(a);
+  raytpu::ClientReply reply;
+  if (!Rpc(&req, &reply)) return "";
+  return reply.create_actor().actor_id();
+}
+
+std::string Client::CallActor(const std::string& actor_id,
+                              const std::string& method,
+                              const std::vector<raytpu::Value>& args) {
+  raytpu::ClientRequest req;
+  auto* call = req.mutable_actor_call();
+  call->set_actor_id(actor_id);
+  call->set_method(method);
+  for (const auto& a : args) {
+    call->add_args()->mutable_value()->CopyFrom(a);
+  }
+  raytpu::ClientReply reply;
+  if (!Rpc(&req, &reply)) return "";
+  return reply.actor_call().return_id();
+}
+
+bool Client::KillActor(const std::string& actor_id, bool no_restart) {
+  raytpu::ClientRequest req;
+  req.mutable_kill_actor()->set_actor_id(actor_id);
+  req.mutable_kill_actor()->set_no_restart(no_restart);
+  raytpu::ClientReply reply;
+  if (!Rpc(&req, &reply)) return false;
+  return reply.kill_actor().ok();
+}
+
+bool Client::Wait(const std::vector<std::string>& object_ids,
+                  int num_returns, double timeout_s,
+                  std::vector<std::string>* ready) {
+  raytpu::ClientRequest req;
+  auto* w = req.mutable_wait();
+  for (const auto& oid : object_ids) w->add_object_ids(oid);
+  w->set_num_returns(num_returns);
+  w->set_timeout_s(timeout_s);
+  raytpu::ClientReply reply;
+  if (!Rpc(&req, &reply)) return false;
+  if (ready) {
+    ready->assign(reply.wait().ready().begin(),
+                  reply.wait().ready().end());
+  }
+  return static_cast<int>(reply.wait().ready_size()) >= num_returns;
+}
+
 bool Client::KvPut(const std::string& key, const std::string& value) {
   raytpu::ClientRequest req;
   req.mutable_kv_put()->set_key(key);

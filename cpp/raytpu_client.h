@@ -42,6 +42,22 @@ class Client {
                                   const std::vector<raytpu::Value>& args,
                                   int num_returns = 1);
 
+  // Cross-language actors (parity: ray::Actor, cpp/include/ray/api.h:130):
+  // create a Python actor by importable class name, call its methods with
+  // tagged args, wait on the returned object ids, kill it.
+  std::string CreateActor(const std::string& class_name,
+                          const std::vector<raytpu::Value>& args,
+                          double num_cpus = 1.0,
+                          const std::string& name = "");
+  std::string CallActor(const std::string& actor_id,
+                        const std::string& method,
+                        const std::vector<raytpu::Value>& args);
+  bool KillActor(const std::string& actor_id, bool no_restart = true);
+
+  // Block until num_returns of object_ids are ready; fills ready ids.
+  bool Wait(const std::vector<std::string>& object_ids, int num_returns,
+            double timeout_s, std::vector<std::string>* ready);
+
   // KV convenience (the head's internal KV).
   bool KvPut(const std::string& key, const std::string& value);
   bool KvGet(const std::string& key, std::string* value);

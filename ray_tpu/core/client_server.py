@@ -56,6 +56,10 @@ class ClientProtoServer:
         self.addr = (host, self.srv.getsockname()[1])
         self._stop = False
         self._xlang_fn_id = None
+        # actor_id -> ActorHandle created through this plane (keeps the
+        # handle alive; cross-language clients address actors by id)
+        self._actors: dict[bytes, object] = {}
+        self._actors_lock = threading.Lock()
         threading.Thread(target=self._accept_loop, daemon=True,
                          name="rtpu-proto-clients").start()
 
@@ -142,11 +146,19 @@ class ClientProtoServer:
         elif which == "submit":
             self._submit(req.submit, reply)
         elif which == "wait":
-            ready, not_ready = rt._wait_oids(
-                list(req.wait.object_ids), req.wait.num_returns or 1,
-                req.wait.timeout_s or None)
+            oids = list(req.wait.object_ids)
+            nret = req.wait.num_returns or 1
+            ready = rt._wait_oids(oids, nret,
+                                  req.wait.timeout_s or None)[:nret]
+            rset = set(ready)
             reply.wait.ready.extend(ready)
-            reply.wait.not_ready.extend(not_ready)
+            reply.wait.not_ready.extend(o for o in oids if o not in rset)
+        elif which == "create_actor":
+            self._create_actor(req.create_actor, reply)
+        elif which == "actor_call":
+            self._actor_call(req.actor_call, reply)
+        elif which == "kill_actor":
+            self._kill_actor(req.kill_actor, reply)
         elif which == "kv_put":
             with rt.lock:
                 rt.kv[req.kv_put.key] = req.kv_put.value
@@ -194,3 +206,55 @@ class ClientProtoServer:
         )
         rt.submit_task(spec)
         reply.submit.return_ids.extend(spec.return_ids)
+
+    # ---------------- cross-language actors ----------------
+    # Parity: the reference's cross-language actor creation/calls
+    # (core_worker.proto:457 CreateActor/PushTask with function
+    # descriptors; cpp/include/ray/api.h:130). The class is an importable
+    # Python name; the lifecycle (placement, restarts, ordering) is the
+    # normal actor machinery.
+
+    def _decode_args(self, proto_args):
+        args = []
+        for a in proto_args:
+            if a.WhichOneof("arg") == "object_id":
+                args.append(ObjectRef(ObjectID(a.object_id),
+                                      _add_ref=False))
+            else:
+                args.append(proto_wire.decode_value(a.value))
+        return args
+
+    def _create_actor(self, m: pb.CreateActorRequest, reply):
+        from ray_tpu.core.actor import ActorClass
+        module, _, attr = m.class_name.rpartition(".")
+        if not module:
+            raise ValueError(
+                f"cross-language actor class {m.class_name!r} must be "
+                f"'module.Class'")
+        cls = getattr(importlib.import_module(module), attr)
+        opts = {"num_cpus": m.num_cpus or 1,
+                "max_restarts": m.max_restarts,
+                "resources": dict(m.resources) or None}
+        if m.name:
+            opts["name"] = m.name
+        handle = ActorClass(cls, **opts).remote(*self._decode_args(m.args))
+        with self._actors_lock:
+            self._actors[handle._actor_id] = handle
+        reply.create_actor.actor_id = handle._actor_id
+
+    def _actor_call(self, m: pb.ActorCallRequest, reply):
+        with self._actors_lock:
+            handle = self._actors.get(m.actor_id)
+        if handle is None:
+            raise KeyError(f"unknown actor {m.actor_id.hex()} (created "
+                           f"through this client plane?)")
+        ref = getattr(handle, m.method).remote(*self._decode_args(m.args))
+        reply.actor_call.return_id = ref.id.binary()
+
+    def _kill_actor(self, m: pb.KillActorRequest, reply):
+        with self._actors_lock:
+            handle = self._actors.pop(m.actor_id, None)
+        if handle is not None:
+            self.rt.kill_actor_by_id(m.actor_id,
+                                     no_restart=bool(m.no_restart))
+        reply.kill_actor.ok = handle is not None

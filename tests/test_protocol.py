@@ -80,7 +80,10 @@ def test_value_codec_language_neutral():
     assert pw.encode_value(42).format == "i64"
     assert pw.encode_value("x").format == "utf8"
     assert pw.encode_value(b"x").format == "raw"
-    assert pw.encode_value({"a": 1}).format == "pickle"
+    assert pw.encode_value({"a": 1}).format == "json"
+    assert pw.encode_value([1, "x", None]).format == "json"
+    # genuinely Python-only payloads are the ONLY pickle fallback
+    assert pw.encode_value(object()).format == "pickle"
 
 
 @pytest.fixture(scope="module")
@@ -188,4 +191,6 @@ def test_cpp_frontend_end_to_end(proto_head):
     assert out.returncode == 0, out.stderr
     assert "TASK math.hypot(3,4)=5.0" in out.stdout
     assert "TASK len=5" in out.stdout
+    assert "ACTOR add=15,22 total=22" in out.stdout
+    assert "ACTOR killed" in out.stdout
     assert "ALL OK" in out.stdout

@@ -1,0 +1,14 @@
+"""Python classes/functions addressed BY NAME from non-Python frontends
+(the C++ e2e test creates this actor through the protobuf client plane)."""
+
+
+class CppCounter:
+    def __init__(self, start=0):
+        self.v = int(start)
+
+    def add(self, n):
+        self.v += int(n)
+        return self.v
+
+    def total(self):
+        return self.v
