@@ -26,6 +26,7 @@ from ray_tpu.data.datasource import (  # noqa: F401
     read_binary_files,
     read_csv,
     read_delta,
+    read_iceberg,
     read_images,
     read_json,
     read_numpy,
@@ -42,6 +43,6 @@ __all__ = [
     "read_parquet", "read_csv", "read_json", "read_text",
     "read_binary_files", "read_numpy", "read_images",
     "read_tfrecord", "read_webdataset", "read_avro", "read_sql",
-    "read_delta",
+    "read_delta", "read_iceberg",
     "from_huggingface", "from_torch", "decode_image",
 ]

@@ -425,6 +425,92 @@ class Dataset:
         """One avro object container file per block (built-in codec)."""
         self._write(path, "avro")
 
+    def write_iceberg(self, path: str) -> None:
+        """Write (or append a snapshot to) a file-system Apache Iceberg
+        table: parquet data files + an Avro manifest + manifest list +
+        `metadata/vN.metadata.json`. Appends preserve earlier snapshots'
+        manifests, so `read_iceberg(..., snapshot_id=...)` time-travels.
+        Parity: the write side of the reference's iceberg datasource
+        (`data/_internal/datasource/iceberg_datasource.py`), against the
+        open table format instead of pyiceberg."""
+        import json as json_mod
+        import os
+        import time as time_mod
+        import uuid as uuid_mod
+
+        from ray_tpu.data import avro
+        from ray_tpu.data.datasource import write_block_task
+
+        data_dir = os.path.join(path, "data")
+        meta_dir = os.path.join(path, "metadata")
+        os.makedirs(data_dir, exist_ok=True)
+        os.makedirs(meta_dir, exist_ok=True)
+        tag = uuid_mod.uuid4().hex[:8]
+        refs = []
+        for i, (bref, _m) in enumerate(self.iter_internal()):
+            refs.append(write_block_task.remote(
+                bref, data_dir, i, "parquet", f"snap-{tag}-"))
+        written = [p for p in ray_tpu.get(refs, timeout=600) if p]
+
+        versions = sorted(
+            (int(f[1:].split(".")[0]), f) for f in os.listdir(meta_dir)
+            if f.startswith("v") and f.endswith(".metadata.json"))
+        if versions:
+            with open(os.path.join(meta_dir, versions[-1][1])) as f:
+                meta = json_mod.load(f)
+        else:
+            meta = {"format-version": 2,
+                    "table-uuid": str(uuid_mod.uuid4()),
+                    "location": path, "snapshots": [],
+                    "current-snapshot-id": None}
+        snap_id = max((s["snapshot-id"] for s in meta["snapshots"]),
+                      default=0) + 1
+
+        entry_schema = {
+            "type": "record", "name": "manifest_entry", "fields": [
+                {"name": "status", "type": "int"},
+                {"name": "data_file", "type": {
+                    "type": "record", "name": "r2", "fields": [
+                        {"name": "content", "type": "int"},
+                        {"name": "file_path", "type": "string"},
+                        {"name": "file_format", "type": "string"},
+                        {"name": "record_count", "type": "long"},
+                    ]}},
+            ]}
+        manifest = os.path.join(meta_dir, f"m-{tag}.avro")
+        avro.write_file(manifest, entry_schema, [
+            {"status": 1, "data_file": {
+                "content": 0, "file_path": p, "file_format": "PARQUET",
+                "record_count": -1}}
+            for p in written])
+        # The new snapshot sees every earlier manifest plus this one.
+        prev_manifests: list[str] = []
+        cur = meta.get("current-snapshot-id")
+        if cur is not None:
+            snap = {s["snapshot-id"]: s for s in meta["snapshots"]}[cur]
+            ml_path = snap["manifest-list"]
+            _, prev = avro.read_file(ml_path)
+            prev_manifests = [m["manifest_path"] for m in prev]
+        ml_schema = {"type": "record", "name": "manifest_file", "fields": [
+            {"name": "manifest_path", "type": "string"}]}
+        ml = os.path.join(meta_dir, f"snap-{snap_id}-{tag}.avro")
+        avro.write_file(ml, ml_schema,
+                        [{"manifest_path": m}
+                         for m in prev_manifests + [manifest]])
+        meta["snapshots"].append({
+            "snapshot-id": snap_id,
+            "timestamp-ms": int(time_mod.time() * 1000),
+            "manifest-list": ml,
+            "summary": {"operation": "append"},
+        })
+        meta["current-snapshot-id"] = snap_id
+        vnum = (versions[-1][0] + 1) if versions else 1
+        tmp = os.path.join(meta_dir, f".v{vnum}.tmp")
+        with open(tmp, "w") as f:
+            json_mod.dump(meta, f)
+        os.replace(tmp, os.path.join(meta_dir,
+                                     f"v{vnum}.metadata.json"))
+
     def _write(self, path: str, fmt: str) -> None:
         import os
         os.makedirs(path, exist_ok=True)

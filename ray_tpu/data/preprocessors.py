@@ -1,0 +1,171 @@
+"""Dataset preprocessors: fit on a Dataset, transform Datasets/batches.
+
+Parity: `python/ray/data/preprocessors/` (scalers/encoders feeding
+Train). Fit statistics stream through `iter_batches` (numpy) so a fit
+never materializes the dataset; a fitted preprocessor is a small
+picklable object that travels to Train workers and transforms shards
+inside the ingest pipeline.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Preprocessor:
+    """fit(ds) computes stats; transform(ds) applies them lazily
+    (map_batches); transform_batch(dict) applies to one numpy batch."""
+
+    _fitted = False
+
+    def fit(self, ds) -> "Preprocessor":
+        self._fit(ds)
+        self._fitted = True
+        return self
+
+    def fit_transform(self, ds):
+        return self.fit(ds).transform(ds)
+
+    def transform(self, ds):
+        if not self._fitted and self._needs_fit():
+            raise RuntimeError(f"{type(self).__name__} must be fit before "
+                               f"transform")
+        fn = self.transform_batch
+        return ds.map_batches(fn, batch_format="numpy")
+
+    def transform_batch(self, batch: dict) -> dict:
+        raise NotImplementedError
+
+    def _fit(self, ds):
+        raise NotImplementedError
+
+    def _needs_fit(self) -> bool:
+        return True
+
+
+def _col_stats(ds, columns, want_minmax=False):
+    """One streaming pass: per-column n/sum/sumsq (+min/max)."""
+    acc = {c: [0, 0.0, 0.0, np.inf, -np.inf] for c in columns}
+    for batch in ds.iter_batches(batch_format="numpy"):
+        for c in columns:
+            v = np.asarray(batch[c], dtype=np.float64)
+            a = acc[c]
+            a[0] += v.size
+            a[1] += float(v.sum())
+            a[2] += float((v * v).sum())
+            if want_minmax and v.size:
+                a[3] = min(a[3], float(v.min()))
+                a[4] = max(a[4], float(v.max()))
+    return acc
+
+
+class StandardScaler(Preprocessor):
+    """(x - mean) / std per column (population std; std 0 -> 1)."""
+
+    def __init__(self, columns: list[str]):
+        self.columns = list(columns)
+        self.stats_: dict[str, tuple[float, float]] = {}
+
+    def _fit(self, ds):
+        for c, (n, s, ss, _mn, _mx) in _col_stats(ds, self.columns).items():
+            mean = s / max(n, 1)
+            var = max(ss / max(n, 1) - mean * mean, 0.0)
+            std = var ** 0.5
+            self.stats_[c] = (mean, std if std > 0 else 1.0)
+
+    def transform_batch(self, batch):
+        out = dict(batch)
+        for c in self.columns:
+            mean, std = self.stats_[c]
+            out[c] = (np.asarray(batch[c], np.float64) - mean) / std
+        return out
+
+
+class MinMaxScaler(Preprocessor):
+    """(x - min) / (max - min) per column (degenerate range -> 0)."""
+
+    def __init__(self, columns: list[str]):
+        self.columns = list(columns)
+        self.stats_: dict[str, tuple[float, float]] = {}
+
+    def _fit(self, ds):
+        st = _col_stats(ds, self.columns, want_minmax=True)
+        for c, (_n, _s, _ss, mn, mx) in st.items():
+            self.stats_[c] = (mn, mx)
+
+    def transform_batch(self, batch):
+        out = dict(batch)
+        for c in self.columns:
+            mn, mx = self.stats_[c]
+            span = (mx - mn) or 1.0
+            out[c] = (np.asarray(batch[c], np.float64) - mn) / span
+        return out
+
+
+class OneHotEncoder(Preprocessor):
+    """Each categorical column becomes `{col}_{value}` 0/1 columns
+    (categories discovered at fit, sorted for determinism; unseen values
+    encode as all-zeros)."""
+
+    def __init__(self, columns: list[str]):
+        self.columns = list(columns)
+        self.categories_: dict[str, list] = {}
+
+    def _fit(self, ds):
+        seen: dict[str, set] = {c: set() for c in self.columns}
+        for batch in ds.iter_batches(batch_format="numpy"):
+            for c in self.columns:
+                seen[c].update(np.asarray(batch[c]).tolist())
+        self.categories_ = {c: sorted(v, key=repr)
+                            for c, v in seen.items()}
+
+    def transform_batch(self, batch):
+        out = {k: v for k, v in batch.items() if k not in self.columns}
+        for c in self.columns:
+            v = np.asarray(batch[c])
+            for cat in self.categories_[c]:
+                out[f"{c}_{cat}"] = (v == cat).astype(np.int8)
+        return out
+
+
+class Concatenator(Preprocessor):
+    """Pack several numeric columns into one vector column (the shape
+    Train ingest wants: one features matrix per batch)."""
+
+    def __init__(self, columns: list[str], output_column_name: str =
+                 "concat_out", dtype=np.float32):
+        self.columns = list(columns)
+        self.output_column_name = output_column_name
+        self.dtype = dtype
+
+    def _needs_fit(self) -> bool:
+        return False
+
+    def _fit(self, ds):
+        pass
+
+    def transform_batch(self, batch):
+        out = {k: v for k, v in batch.items() if k not in self.columns}
+        mats = [np.asarray(batch[c], self.dtype).reshape(
+            len(np.asarray(batch[c])), -1) for c in self.columns]
+        out[self.output_column_name] = np.concatenate(mats, axis=1)
+        return out
+
+
+class Chain(Preprocessor):
+    """Apply preprocessors in sequence (fit streams each stage over the
+    previous stage's lazy transform)."""
+
+    def __init__(self, *stages: Preprocessor):
+        self.stages = list(stages)
+
+    def _fit(self, ds):
+        cur = ds
+        for st in self.stages:
+            st.fit(cur)
+            cur = st.transform(cur)
+
+    def transform_batch(self, batch):
+        for st in self.stages:
+            batch = st.transform_batch(batch)
+        return batch

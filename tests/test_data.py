@@ -603,3 +603,118 @@ def test_read_delta_log_replay(ray_start_regular, tmp_path):
     (log / "_last_checkpoint").write_text('{"version": 2}')
     with pytest.raises(NotImplementedError, match="checkpointed"):
         rd.read_delta(str(table))
+
+
+def test_iceberg_roundtrip_and_time_travel(ray_start_regular, tmp_path):
+    """write_iceberg -> read_iceberg round trip against the open table
+    format (no pyiceberg anywhere): metadata.json + Avro manifest list +
+    manifests + parquet, plus snapshot time travel after an append."""
+    import ray_tpu.data as rd
+
+    table = str(tmp_path / "ice")
+    rd.from_items([{"id": i, "v": i * 2} for i in range(10)]
+                  ).write_iceberg(table)
+    ds = rd.read_iceberg(table)
+    assert sorted(r["id"] for r in ds.take_all()) == list(range(10))
+
+    # Append a second snapshot; latest read sees both, snapshot 1 only
+    # the original rows (time travel).
+    rd.from_items([{"id": i, "v": 0} for i in range(10, 15)]
+                  ).write_iceberg(table)
+    assert rd.read_iceberg(table).count() == 15
+    assert sorted(r["id"] for r in
+                  rd.read_iceberg(table, snapshot_id=1).take_all()
+                  ) == list(range(10))
+    with pytest.raises(FileNotFoundError):
+        rd.read_iceberg(table, snapshot_id=99)
+    with pytest.raises(FileNotFoundError):
+        rd.read_iceberg(str(tmp_path / "not_a_table"))
+
+
+def test_preprocessors_scalers_and_encoders(ray_start_regular):
+    import numpy as np
+
+    import ray_tpu.data as rd
+    from ray_tpu.data.preprocessors import (Chain, Concatenator,
+                                            MinMaxScaler, OneHotEncoder,
+                                            StandardScaler)
+
+    rows = [{"a": float(i), "b": i % 3, "color": ["red", "green",
+                                                  "blue"][i % 3]}
+            for i in range(30)]
+    ds = rd.from_items(rows)
+
+    std = StandardScaler(["a"]).fit(ds)
+    out = np.concatenate([b["a"] for b in
+                          std.transform(ds).iter_batches()])
+    assert abs(out.mean()) < 1e-9 and abs(out.std() - 1.0) < 1e-9
+
+    mm = MinMaxScaler(["a"]).fit(ds)
+    out = np.concatenate([b["a"] for b in
+                          mm.transform(ds).iter_batches()])
+    assert out.min() == 0.0 and out.max() == 1.0
+
+    oh = OneHotEncoder(["color"]).fit(ds)
+    batch = oh.transform(ds).take_batch(30, batch_format="numpy")
+    assert set(oh.categories_["color"]) == {"red", "green", "blue"}
+    assert batch["color_red"].sum() == 10
+    assert "color" not in batch
+
+    # unfit preprocessors refuse to transform
+    with pytest.raises(RuntimeError):
+        StandardScaler(["a"]).transform(ds)
+
+    chain = Chain(StandardScaler(["a"]), OneHotEncoder(["color"]),
+                  Concatenator(["a", "color_red", "color_green",
+                                "color_blue"], "features"))
+    chain.fit(ds)
+    batch = chain.transform(ds).take_batch(30, batch_format="numpy")
+    assert batch["features"].shape == (30, 4)
+    assert batch["features"].dtype == np.float32
+
+
+def test_preprocessed_dataset_feeds_jax_trainer(ray_start_regular,
+                                                tmp_path):
+    """A fitted preprocessor travels to Train workers and its transformed
+    shard feeds a jitted step (VERDICT r3 #8 done-criterion)."""
+    import ray_tpu.data as rd
+    from ray_tpu.data.preprocessors import Concatenator, StandardScaler
+    from ray_tpu.train import (JaxTrainer, RunConfig, ScalingConfig)
+
+    rows = [{"x1": float(i), "x2": float(-i), "y": float(i % 2)}
+            for i in range(64)]
+    ds = rd.from_items(rows)
+    prep = StandardScaler(["x1", "x2"]).fit(ds)
+    train_ds = Concatenator(["x1", "x2"], "features").transform(
+        prep.transform(ds))
+
+    def loop(config):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ray_tpu.train import session
+        shard = session.get_dataset_shard("train")
+        w = jnp.zeros((2,))
+
+        @jax.jit
+        def step(w, feats, y):
+            pred = feats @ w
+            loss = jnp.mean((pred - y) ** 2)
+            return w - 0.1 * jax.grad(
+                lambda w: jnp.mean((feats @ w - y) ** 2))(w), loss
+        n = 0
+        for batch in shard.iter_batches(batch_size=16):
+            feats = jnp.asarray(np.asarray(batch["features"]))
+            y = jnp.asarray(np.asarray(batch["y"]))
+            w, loss = step(w, feats, y)
+            n += feats.shape[0]
+        session.report({"rows_seen": n, "loss": float(loss)})
+
+    trainer = JaxTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="prep", storage_path=str(tmp_path)),
+        datasets={"train": train_ds})
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["rows_seen"] > 0
