@@ -258,16 +258,19 @@ def bench_llm_prefix_shared(slots: int = 32, prompt_len: int = 256,
     return out
 
 
-def bench_rl_ppo(iters: int = 3):
+def bench_rl_ppo(iters: int = 3, env: str = "MinAtarBreakout-v0",
+                 tag: str = "rl_ppo_minatar"):
     """RL throughput (BASELINE north star metric "RLlib PPO env-steps/
-    sec"): PPO + the conv module on the MinAtar-style Breakout, env
-    stepping on host CPU, policy forwards + GAE + learner updates
-    jit-compiled on the TPU — the reference's GPU-learner split
-    (rllib/core/learner/) with XLA in the torch role."""
+    sec"): PPO + the conv module, env stepping on host CPU, policy
+    forwards + GAE + learner updates jit-compiled on the TPU — the
+    reference's GPU-learner split (rllib/core/learner/) with XLA in the
+    torch role. `env=AtariClass*-v0` runs the deepmind 84x84x4 frame
+    shape + nature-CNN (the reference's PPO-Atari benchmark shape,
+    ROM-free)."""
     from ray_tpu.rllib import PPOConfig
 
     config = (PPOConfig()
-              .environment(env="MinAtarBreakout-v0")
+              .environment(env=env)
               .env_runners(num_env_runners=0, num_envs_per_env_runner=16,
                            rollout_fragment_length=64)
               .training(train_batch_size=1024, minibatch_size=256,
@@ -278,18 +281,67 @@ def bench_rl_ppo(iters: int = 3):
         algo.train()  # compile + warm
         t0 = time.time()
         steps0 = algo._timesteps
+        learner_s = 0.0
         for _ in range(iters):
+            lt0 = time.time()
             result = algo.train()
+            learner_s += time.time() - lt0
         dt = time.time() - t0
         steps = algo._timesteps - steps0
         out = {
-            "config": "rl_ppo_minatar",
+            "config": tag,
+            "env": env,
             "env_steps_per_sec": round(steps / dt),
+            "train_iter_ms": round(learner_s / iters * 1e3, 1),
+            "sample_ms": result.get("sample_ms"),
+            "learner_update_ms": result.get("learner_update_ms"),
             "policy_loss": round(float(result.get("policy_loss", 0.0)), 4),
         }
     finally:
         algo.stop()
-    print(f"rl_ppo: {out}", file=sys.stderr)
+    print(f"rl_ppo[{env}]: {out}", file=sys.stderr)
+    return out
+
+
+def bench_rl_impala(iters: int = 4, env: str = "AtariClassBreakout-v0"):
+    """IMPALA async actor-learner at the Atari benchmark shape: remote
+    env runners feed fragments, the V-trace learner update runs
+    jit-compiled on the TPU (BASELINE north star: "RLlib IMPALA
+    multi-env async rollout -> TPU learner")."""
+    import ray_tpu
+    from ray_tpu.rllib import IMPALAConfig
+
+    ray_tpu.init(num_cpus=3)
+    try:
+        config = (IMPALAConfig()
+                  .environment(env=env)
+                  .env_runners(num_env_runners=2,
+                               num_envs_per_env_runner=8,
+                               rollout_fragment_length=32)
+                  .training(train_batch_size=512, lr=3e-4)
+                  .debugging(seed=0))
+        algo = config.build_algo()
+        try:
+            algo.train()  # compile + warm
+            t0 = time.time()
+            steps0 = algo._timesteps
+            for _ in range(iters):
+                result = algo.train()
+            dt = time.time() - t0
+            steps = algo._timesteps - steps0
+            out = {
+                "config": "rl_impala_atari_class",
+                "env": env,
+                "env_steps_per_sec": round(steps / dt),
+                "train_iter_ms": round(dt / iters * 1e3, 1),
+                "vtrace_policy_loss": round(
+                    float(result.get("policy_loss", 0.0)), 4),
+            }
+        finally:
+            algo.stop()
+    finally:
+        ray_tpu.shutdown()
+    print(f"rl_impala[{env}]: {out}", file=sys.stderr)
     return out
 
 
@@ -343,6 +395,19 @@ def run() -> dict:
         results["configs"].append(
             {"config": "rl_ppo_minatar", "error": str(e)[:200]})
         print(f"rl_ppo: FAILED {e}", file=sys.stderr)
+    try:
+        results["configs"].append(bench_rl_ppo(
+            env="AtariClassBreakout-v0", tag="rl_ppo_atari_class"))
+    except Exception as e:
+        results["configs"].append(
+            {"config": "rl_ppo_atari_class", "error": str(e)[:200]})
+        print(f"rl_ppo_atari: FAILED {e}", file=sys.stderr)
+    try:
+        results["configs"].append(bench_rl_impala())
+    except Exception as e:
+        results["configs"].append(
+            {"config": "rl_impala_atari_class", "error": str(e)[:200]})
+        print(f"rl_impala: FAILED {e}", file=sys.stderr)
     return results
 
 

@@ -98,7 +98,9 @@ class PPO(Algorithm):
                 "ent_coef": c.entropy_coeff}
 
     def training_step(self) -> dict:
+        import time as _time
         c = self.config
+        _t0 = _time.perf_counter()
         params = self.learner_group.get_weights()
         batches = []
         steps = 0
@@ -122,12 +124,19 @@ class PPO(Algorithm):
         batch = {k: batch[k] for k in
                  ("obs", "actions", "logp", "advantages", "returns")}
         n = batch["obs"].shape[0]
+        _sample_ms = (_time.perf_counter() - _t0) * 1e3
+        _t0 = _time.perf_counter()
         # Local learner: the whole epochs x minibatches sweep is one jit
         # call (one dispatch + one metrics fetch per training step).
         metrics = self.learner_group.update_epochs(
             batch, num_epochs=c.num_epochs,
             minibatch_size=c.minibatch_size, seed=self.iteration)
         if metrics is not None:
+            # sample vs learner split (the bench reports the learner step
+            # time on the accelerator separately from host env stepping)
+            metrics["sample_ms"] = round(_sample_ms, 1)
+            metrics["learner_update_ms"] = round(
+                (_time.perf_counter() - _t0) * 1e3, 1)
             return metrics
         metrics = {}
         rng = np.random.default_rng(self.iteration)

@@ -277,16 +277,23 @@ class ConvSpec:
         return params
 
     def apply(self, params, x):
-        B = x.shape[0]
-        x = x.reshape((B,) + tuple(self.obs_shape))
+        # Accept any leading batch dims ([B, ...] or IMPALA's [T, E, ...]),
+        # flat or image-shaped trailing dims.
+        shape = tuple(self.obs_shape)
+        if x.shape[-len(shape):] == shape:
+            lead = x.shape[:-len(shape)]
+        else:
+            lead = x.shape[:-1]  # flat [..., H*W*C]
+        x = x.reshape((-1,) + shape)
         for (out_c, k, s), layer in zip(self.filters, params[:-1]):
             x = jax.lax.conv_general_dilated(
                 x, layer["w"], window_strides=(s, s), padding="VALID",
                 dimension_numbers=("NHWC", "HWIO", "NHWC"))
             x = jax.nn.relu(x + layer["b"])
-        x = x.reshape(B, -1)
+        x = x.reshape(x.shape[0], -1)
         head = params[-1]
-        return jax.nn.relu(x @ head["w"] + head["b"])
+        out = jax.nn.relu(x @ head["w"] + head["b"])
+        return out.reshape(lead + (self.dense,))
 
 
 # Standard conv stacks: the small net for 10x10 MinAtar-class grids, the

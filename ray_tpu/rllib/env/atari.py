@@ -1,14 +1,94 @@
-"""Real-ALE Atari support (when ale-py is installed).
+"""Atari-class envs: real ALE when ale-py exists, plus a self-contained
+ALE-COMPATIBLE fallback that needs no ROMs.
 
 Parity: the reference's Atari benchmark path (rllib tuned examples wrap
 ALE envs with the deepmind preprocessing stack). ale-py is not in this
-image, so this module is a gated integration point: `register_atari`
-registers a preprocessed, frame-stacked variant of an ALE env under a
-stable id the env runners can `gym.make_vec`. The MinAtar-style suite
-(`minatar.py`) is the always-available stand-in at test scale.
+image, so two paths:
+
+- `register_atari`: the real thing when ale-py is importable.
+- `register_atari_class` (always available): `AtariClass<Game>-v0` wraps
+  each built-in MinAtar game and renders its state into the deepmind
+  observation contract — 84x84x4 float32 frame stacks — so policy
+  networks, learner compute, and rollout bandwidth match the ALE
+  benchmark shape exactly while the dynamics stay ROM-free. This is the
+  path the TPU RL benchmarks run (BASELINE north star: "RLlib PPO-Atari
+  matching torch-GPU throughput").
 """
 
 from __future__ import annotations
+
+import numpy as np
+
+try:
+    import gymnasium as gym
+    from gymnasium import spaces
+except ImportError:  # pragma: no cover - gymnasium is baked in
+    gym = None
+
+
+class AtariClassEnv(gym.Env):
+    """Deepmind-preprocessed view of a MinAtar core: the 10x10xC state
+    renders into an 84x84 grayscale frame (8x nearest-neighbour upscale,
+    channels weighted into intensities), stacked over the last 4 frames
+    -> obs [84, 84, 4] float32 in [0, 1]."""
+
+    metadata = {"render_modes": []}
+    SCREEN = 84
+
+    def __init__(self, core_cls, render_mode=None, **kw):
+        self.core = core_cls(**kw)
+        s = self.SCREEN
+        self.observation_space = spaces.Box(0.0, 1.0, (s, s, 4),
+                                            np.float32)
+        self.action_space = self.core.action_space
+        self._frames = np.zeros((s, s, 4), np.float32)
+
+    def _render(self, obs10) -> np.ndarray:
+        # channel weights spread entity types across gray levels
+        weights = np.linspace(1.0, 0.4, obs10.shape[-1],
+                              dtype=np.float32)
+        gray = np.max(obs10 * weights, axis=-1)   # [10, 10]
+        up = np.kron(gray, np.ones((8, 8), np.float32))  # [80, 80]
+        frame = np.zeros((self.SCREEN, self.SCREEN), np.float32)
+        frame[2:82, 2:82] = up
+        return frame
+
+    def reset(self, *, seed=None, options=None):
+        obs, info = self.core.reset(seed=seed, options=options)
+        frame = self._render(obs)
+        self._frames = np.repeat(frame[:, :, None], 4, axis=2)
+        return self._frames.copy(), info
+
+    def step(self, action):
+        obs, rew, term, trunc, info = self.core.step(action)
+        self._frames = np.concatenate(
+            [self._frames[:, :, 1:], self._render(obs)[:, :, None]],
+            axis=2)
+        return self._frames.copy(), rew, term, trunc, info
+
+
+_CLASS_REGISTERED = False
+
+
+def register_atari_class():
+    """Register AtariClass{Breakout,SpaceInvaders,Asterix,Freeway,
+    Seaquest}-v0 (idempotent)."""
+    global _CLASS_REGISTERED
+    if _CLASS_REGISTERED or gym is None:
+        return
+    _CLASS_REGISTERED = True
+    from ray_tpu.rllib.env import minatar as m
+    for game, cls in (("Breakout", m.MinAtarBreakout),
+                      ("SpaceInvaders", m.MinAtarSpaceInvaders),
+                      ("Asterix", m.MinAtarAsterix),
+                      ("Freeway", m.MinAtarFreeway),
+                      ("Seaquest", m.MinAtarSeaquest)):
+        env_id = f"AtariClass{game}-v0"
+        if env_id not in gym.registry:
+            gym.register(
+                id=env_id,
+                entry_point=("ray_tpu.rllib.env.atari:AtariClassEnv"),
+                kwargs={"core_cls": cls})
 
 
 def ale_available() -> bool:

@@ -741,3 +741,131 @@ def test_dreamerv3_world_model_learns():
         assert r["num_env_steps_sampled_lifetime"] > 0
     finally:
         algo.stop()
+
+
+def test_minatar_suite_and_atari_class_contract():
+    """The full built-in MinAtar suite + the ROM-free ALE-compatible
+    AtariClass variants satisfy the gymnasium contract; AtariClass obs
+    match the deepmind 84x84x4 float32 shape the Atari benchmarks use."""
+    import gymnasium as gym
+
+    from ray_tpu.rllib.env.minatar import (MINATAR_SUITE,
+                                           register_builtin_envs)
+    register_builtin_envs()
+    assert len(MINATAR_SUITE) == 5
+    for eid in MINATAR_SUITE:
+        env = gym.make(eid)
+        obs, _ = env.reset(seed=1)
+        assert env.observation_space.contains(obs)
+        stepped = 0
+        for _ in range(200):
+            obs, r, term, trunc, _ = env.step(env.action_space.sample())
+            assert env.observation_space.contains(obs)
+            stepped += 1
+            if term or trunc:
+                break
+        assert stepped > 3
+    env = gym.make("AtariClassSeaquest-v0")
+    obs, _ = env.reset(seed=0)
+    assert obs.shape == (84, 84, 4) and obs.dtype == np.float32
+    o2, r, *_ = env.step(0)
+    # frame stack rolls: the oldest frame leaves, the newest enters
+    assert (o2[:, :, :3] == obs[:, :, 1:]).all()
+
+
+def test_ppo_improves_on_minatar_freeway():
+    """PPO on the new Freeway game: crossing pays 1; a few iterations of
+    PPO must beat the random baseline clearly (score, not loss)."""
+    import gymnasium as gym
+
+    from ray_tpu.rllib import PPOConfig
+    from ray_tpu.rllib.env.minatar import register_builtin_envs
+    register_builtin_envs()
+
+    # random baseline
+    env = gym.make("MinAtarFreeway-v0", max_steps=150)
+    rng = np.random.default_rng(0)
+    rand_returns = []
+    for ep in range(12):
+        env.reset(seed=ep)
+        total = 0.0
+        for _ in range(150):
+            _o, r, term, trunc, _ = env.step(int(rng.integers(0, 3)))
+            total += r
+            if term or trunc:
+                break
+        rand_returns.append(total)
+    rand_mean = float(np.mean(rand_returns))
+
+    config = (PPOConfig()
+              .environment(env="MinAtarFreeway-v0",
+                           env_config={"max_steps": 150})
+              .env_runners(num_env_runners=0, num_envs_per_env_runner=8,
+                           rollout_fragment_length=64)
+              .training(train_batch_size=512, minibatch_size=128,
+                        num_epochs=4, lr=1e-3)
+              .debugging(seed=0))
+    algo = config.build_algo()
+    try:
+        best = -1.0
+        for _ in range(40):
+            result = algo.train()
+            best = max(best, result.get("episode_return_mean", -1.0))
+            if best > max(2.0 * rand_mean, rand_mean + 1.0):
+                break
+        assert best > max(2.0 * rand_mean, rand_mean + 1.0), (
+            best, rand_mean)
+    finally:
+        algo.stop()
+
+
+def test_dreamerv3_score_gate_minatar():
+    """DreamerV3 on MinAtarFreeway must REACH A SCORE (VERDICT r3 #6:
+    not just a loss decrease): late-training mean episode return beats
+    the measured random baseline."""
+    import gymnasium as gym
+
+    from ray_tpu.rllib import DreamerV3Config
+    from ray_tpu.rllib.env.minatar import register_builtin_envs
+    register_builtin_envs()
+
+    env = gym.make("MinAtarFreeway-v0", max_steps=150)
+    rng = np.random.default_rng(0)
+    rand_returns = []
+    for ep in range(12):
+        env.reset(seed=ep)
+        total = 0.0
+        for _ in range(150):
+            _o, r, term, trunc, _ = env.step(int(rng.integers(0, 3)))
+            total += r
+            if term or trunc:
+                break
+        rand_returns.append(total)
+    rand_mean = float(np.mean(rand_returns))
+
+    # High update-to-env-step ratio + small model: measured takeoff on
+    # this box around iter 45 (return 2+ by iter 50 vs ~0.17 random).
+    config = (DreamerV3Config()
+              .environment(env="MinAtarFreeway-v0",
+                           env_config={"max_steps": 150})
+              .training(batch_size_B=16, batch_length_T=16,
+                        num_updates_per_iter=16, horizon_H=15,
+                        entropy_scale=1e-3, actor_critic_lr=1e-3,
+                        model_size={"deter": 64, "hidden": 64,
+                                    "classes": 8, "groups": 8})
+              .debugging(seed=0))
+    config.num_envs = 8
+    algo = config.build_algo()
+    try:
+        scores = []
+        gate = max(1.25 * rand_mean, rand_mean + 0.3)
+        for _ in range(90):
+            r = algo.train()
+            if "episode_return_mean" in r:
+                scores.append(r["episode_return_mean"])
+            if len(scores) >= 3 and float(np.mean(scores[-3:])) > gate:
+                break
+        late = float(np.mean(scores[-3:]))
+        assert late > gate, (late, rand_mean, scores)
+    finally:
+        algo.stop()
