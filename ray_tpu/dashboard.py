@@ -49,6 +49,15 @@ def _sample_loop(server):
                 rate = max(0.0, (finished - last_finished)
                            / (now - last_ts))
             last_finished, last_ts = finished, now
+            # Task/actor state counts per tick: the frontend's
+            # state-over-time timelines (the role of the reference's
+            # task/actor state charts in dashboard/client).
+            from ray_tpu.core.runtime import get_runtime
+            from ray_tpu.util.state import (_summarize_actors,
+                                            _summarize_tasks)
+            rt = get_runtime()
+            tasks_by_state = _summarize_tasks(rt)["by_state"]
+            actors_by_state = _summarize_actors(rt)["by_state"]
             _HISTORY.append({
                 "ts": round(now, 1),
                 "cpu_used": round(used["CPU"], 2),
@@ -58,6 +67,8 @@ def _sample_loop(server):
                 "store_mib": round(
                     s["store"].get("allocated", 0) / 2**20, 1),
                 "workers": s.get("num_workers", 0),
+                "tasks_by_state": tasks_by_state,
+                "actors_by_state": actors_by_state,
             })
         except Exception:  # noqa: BLE001 — sampler must outlive glitches
             pass
@@ -125,6 +136,17 @@ class _Handler(BaseHTTPRequestHandler):
                     self._json(report)
             elif path == "/api/history":
                 self._json(list(_HISTORY))
+            elif path == "/api/serve":
+                # Live serve topology: apps -> deployments -> replica
+                # states (parity: dashboard/modules/serve).
+                try:
+                    from ray_tpu.serve import api as serve_api
+                    self._json(serve_api.status())
+                except Exception:  # noqa: BLE001 — serve not running
+                    self._json({})
+            elif path == "/api/train":
+                from ray_tpu.train import list_train_runs
+                self._json(list_train_runs())
             elif path == "/api/logs":
                 self._logs()
             elif path == "/":

@@ -7,6 +7,7 @@
 
 const VIEWS = [
   ["overview", "Overview"],
+  ["timeline", "Timeline"],
   ["nodes", "Nodes"],
   ["workers", "Workers"],
   ["actors", "Actors"],
@@ -14,6 +15,8 @@ const VIEWS = [
   ["objects", "Objects"],
   ["placement_groups", "Placement groups"],
   ["jobs", "Jobs"],
+  ["train", "Train"],
+  ["serve", "Serve"],
   ["logs", "Logs"],
 ];
 
@@ -191,6 +194,94 @@ function wireCharts() {
   });
 }
 
+/* Multi-series state-over-time chart: one line per state with a legend
+   (the task/actor state timelines of the reference's frontend). */
+const STATE_PALETTE = ["#4c9f70", "#d9a441", "#c75c5c", "#5b8dd9",
+                      "#9a6fb8", "#5bb8b0", "#8a8a8a"];
+
+function multiChart(title, hist, field) {
+  const W = 620, H = 130, PADL = 34, PADB = 14, PADT = 6;
+  const states = [...new Set(hist.flatMap(
+    (h) => Object.keys(h[field] || {})))].sort();
+  if (!hist.length || !states.length) {
+    return `<div class="chart wide"><h3>${esc(title)}</h3>` +
+      `<svg viewBox="0 0 ${W} ${H}"><text class="axis" x="8" y="60">` +
+      `no samples yet</text></svg></div>`;
+  }
+  const xs = hist.map((h) => h.ts);
+  const x0 = Math.min(...xs), x1 = Math.max(...xs) || 1;
+  const yMax = Math.max(1, ...hist.flatMap(
+    (h) => states.map((s) => (h[field] || {})[s] || 0))) * 1.1;
+  const X = (x) => PADL + (x - x0) / Math.max(x1 - x0, 1e-9)
+    * (W - PADL - 4);
+  const Y = (y) => PADT + (1 - y / yMax) * (H - PADT - PADB);
+  const paths = states.map((s, i) => {
+    const d = hist.map((h, j) =>
+      `${j ? "L" : "M"}${X(h.ts).toFixed(1)},` +
+      `${Y((h[field] || {})[s] || 0).toFixed(1)}`).join("");
+    return `<path class="line" style="stroke:` +
+      `${STATE_PALETTE[i % STATE_PALETTE.length]}" d="${d}"/>`;
+  }).join("");
+  const grid = [0.5, 1.0].map((f) => {
+    const g = yMax * f / 1.1;
+    return `<line class="gridline" x1="${PADL}" x2="${W - 4}" ` +
+      `y1="${Y(g).toFixed(1)}" y2="${Y(g).toFixed(1)}"/>` +
+      `<text class="axis" x="2" y="${(Y(g) + 3).toFixed(1)}">` +
+      `${Math.round(g)}</text>`;
+  }).join("");
+  const legend = states.map((s, i) =>
+    `<span class="legend-item"><i style="background:` +
+    `${STATE_PALETTE[i % STATE_PALETTE.length]}"></i>${esc(s)}</span>`)
+    .join("");
+  return `<div class="chart wide"><h3>${esc(title)}</h3>
+    <svg viewBox="0 0 ${W} ${H}" preserveAspectRatio="none">
+      ${grid}${paths}</svg>
+    <div class="legend">${legend}</div></div>`;
+}
+
+async function viewTimeline() {
+  const hist = await getJSON("/api/history");
+  $("#main").innerHTML = `<div class="charts">` +
+    multiChart("Tasks by state over time", hist, "tasks_by_state") +
+    multiChart("Actors by state over time", hist, "actors_by_state") +
+    `</div>`;
+}
+
+async function viewTrain() {
+  const runs = await getJSON("/api/train");
+  const rows = runs.map((r) => ({
+    name: r.name, state: r.state, workers: r.num_workers,
+    iterations: r.iterations,
+    started: new Date(r.started * 1000).toLocaleTimeString(),
+    latest_metrics: r.latest_metrics,
+  }));
+  const rerender = () => {
+    $("#main").innerHTML =
+      `<p class="footer">training runs driven from this head ` +
+      `process</p>` + renderTable("train", rows);
+    wireTable("train", rerender);
+  };
+  rerender();
+}
+
+async function viewServe() {
+  const apps = await getJSON("/api/serve");
+  const rows = [];
+  Object.entries(apps).forEach(([app, a]) =>
+    Object.entries(a.deployments || {}).forEach(([dep, d]) =>
+      rows.push({
+        app, status: a.status, route: a.route_prefix, deployment: dep,
+        dep_status: d.status, replicas:
+          `${d.running_replicas}/${d.target_num_replicas}`,
+        version: d.version,
+      })));
+  const rerender = () => {
+    $("#main").innerHTML = renderTable("serve", rows);
+    wireTable("serve", rerender);
+  };
+  rerender();
+}
+
 /* ---------------- views ---------------- */
 
 async function viewOverview() {
@@ -305,6 +396,9 @@ async function render() {
   const view = currentView();
   try {
     if (view === "overview") await viewOverview();
+    else if (view === "timeline") await viewTimeline();
+    else if (view === "train") await viewTrain();
+    else if (view === "serve") await viewServe();
     else if (view === "logs") await viewLogs();
     else if (view === "jobs") await viewJobs();
     else await viewTable(view);
@@ -319,7 +413,7 @@ function scheduleRefresh() {
     // Don't clobber an in-progress filter/profile interaction.
     if (document.activeElement && document.activeElement.id === "filter")
       return;
-    if (currentView() === "overview") render();
+    if (["overview", "timeline"].includes(currentView())) render();
     $("#clock").textContent = new Date().toLocaleTimeString();
   }, 3000);
 }

@@ -8,7 +8,8 @@ DP/FSDP/TP/SP/EP are sharding configs lowered by XLA, not collective calls.
 
 from ray_tpu.train.step import TrainState, make_train_step
 from ray_tpu.train.backend import Backend, JaxDistributedConfig
-from ray_tpu.train.trainer import JaxTrainer, ScalingConfig, RunConfig
+from ray_tpu.train.trainer import (JaxTrainer, ScalingConfig, RunConfig,
+                                   list_train_runs)
 from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
 from ray_tpu.train import session
 from ray_tpu.train.session import (

@@ -339,6 +339,7 @@ class JaxTrainer:
     def fit(self) -> Result:
         import cloudpickle
         storage_dir = self._storage_dir()
+        _register_run(self)
         loop_bytes = cloudpickle.dumps(self.train_loop)
         failures_left = self.run_config.failure_config.max_failures
         resume_path = (self.resume_from_checkpoint.path
@@ -463,12 +464,51 @@ class JaxTrainer:
                     if r["rank"] == 0:
                         latest = r["metrics"]
                         history.append(r["metrics"])
+                        _update_run(self, latest, len(history))
                         if "checkpoint" in r:
                             latest_ckpt_path = r["checkpoint"]
                 if err and not any("error" in r for r in reports):
                     raise _WorkerGroupError(f"worker {i} failed: {err}")
                 done[i] = finished
         return latest, history, latest_ckpt_path
+
+
+# ---- train-run registry (feeds the dashboard's Train page; parity:
+# dashboard/modules/train state aggregation) ----
+
+_TRAIN_RUNS: dict[str, dict] = {}
+
+
+def _register_run(trainer):
+    _TRAIN_RUNS[trainer.run_config.name] = {
+        "name": trainer.run_config.name,
+        "num_workers": trainer.scaling.num_workers,
+        "state": "RUNNING",
+        "started": time.time(),
+        "iterations": 0,
+        "latest_metrics": {},
+    }
+
+
+def _update_run(trainer, metrics: dict, iterations: int):
+    run = _TRAIN_RUNS.get(trainer.run_config.name)
+    if run is not None:
+        run["state"] = str(trainer.state)
+        run["iterations"] = iterations
+        run["latest_metrics"] = {
+            k: v for k, v in metrics.items()
+            if isinstance(v, (int, float, str, bool))}
+
+
+def list_train_runs() -> list[dict]:
+    """Dashboard/state surface: every run fit() in this driver process,
+    newest first, with live state + rank-0's latest reported metrics."""
+    out = []
+    for run in _TRAIN_RUNS.values():
+        t = dict(run)
+        out.append(t)
+    out.sort(key=lambda r: -r["started"])
+    return out
 
 
 class _WorkerGroupError(RayTpuError):
