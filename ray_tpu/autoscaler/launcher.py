@@ -797,21 +797,26 @@ class KubernetesProvider(InstanceProvider):
                      "containers": [container]},
         }
         self.transport("POST", self._pods_url(), body)
+        ip = self._wait_running(name, wait_timeout)
+        return Instance(name, ip, dict(tags))
+
+    def _wait_running(self, name: str, wait_timeout: float) -> str:
+        """Poll the pod until Running with an IP; on failure/timeout the
+        pod is DELETED before raising — a leaked Pending pod would count
+        against min_workers forever while never taking work."""
         deadline = time.monotonic() + wait_timeout
-        ip = ""
         while time.monotonic() < deadline:
             pod = self.transport("GET", self._pods_url(name), None)
             st = pod.get("status", {})
             ip = st.get("podIP", "")
             if st.get("phase") == "Failed":
+                self.terminate_instance(name)
                 raise RuntimeError(f"pod {name} failed: {st}")
             if st.get("phase") == "Running" and ip:
-                break
+                return ip
             time.sleep(1.0)
-        else:
-            raise TimeoutError(f"pod {name} not Running after "
-                               f"{wait_timeout}s")
-        return Instance(name, ip, dict(tags))
+        self.terminate_instance(name)
+        raise TimeoutError(f"pod {name} not Running after {wait_timeout}s")
 
     def terminate_instance(self, instance_id):
         self.transport("DELETE", self._pods_url(instance_id), None)
@@ -974,7 +979,13 @@ def create_or_update_cluster(config: ClusterConfig,
                                            head_type, verbose=verbose)
     if runner is None:
         # Self-bootstrapping (pod) head: the address is the pod IP at the
-        # configured port — there is no runner to ask.
+        # configured port — there is no runner to ask. A reused head may
+        # still be Pending (up rerun after an interrupt): wait for its IP
+        # the same way a fresh create does.
+        if not head.ip:
+            head = Instance(head.instance_id,
+                            provider._wait_running(head.instance_id, 300),
+                            head.tags)
         address = f"{head.ip}:{config.head_port}"
     else:
         address = _head_address(config, runner)

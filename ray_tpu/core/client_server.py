@@ -148,8 +148,17 @@ class ClientProtoServer:
         elif which == "wait":
             oids = list(req.wait.object_ids)
             nret = req.wait.num_returns or 1
-            ready = rt._wait_oids(oids, nret,
-                                  req.wait.timeout_s or None)[:nret]
+            if nret > len(oids):
+                # mirror the Python API's ValueError instead of blocking
+                # this connection's serial request loop forever
+                raise ValueError(
+                    f"num_returns {nret} > len(object_ids) {len(oids)}")
+            # timeout semantics: < 0 waits forever, 0 is a non-blocking
+            # probe, > 0 is a deadline (proto3 default 0 must not mean
+            # "block forever" — a poll would wedge the connection).
+            timeout = req.wait.timeout_s
+            timeout = None if timeout < 0 else timeout
+            ready = rt._wait_oids(oids, nret, timeout)[:nret]
             rset = set(ready)
             reply.wait.ready.extend(ready)
             reply.wait.not_ready.extend(o for o in oids if o not in rset)
@@ -224,8 +233,19 @@ class ClientProtoServer:
                 args.append(proto_wire.decode_value(a.value))
         return args
 
+    def _sweep_dead_actors(self):
+        """Evict handles whose actors died on their own (process exit,
+        restarts exhausted, killed Python-side) — without this a
+        long-lived head leaks one handle per short-lived actor."""
+        with self._actors_lock:
+            for aid in list(self._actors):
+                st = self.rt.actors.get(aid)
+                if st is None or getattr(st, "state", "") == "dead":
+                    del self._actors[aid]
+
     def _create_actor(self, m: pb.CreateActorRequest, reply):
         from ray_tpu.core.actor import ActorClass
+        self._sweep_dead_actors()
         module, _, attr = m.class_name.rpartition(".")
         if not module:
             raise ValueError(

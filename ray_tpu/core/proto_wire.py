@@ -67,15 +67,34 @@ def encode_value(obj) -> pb.Value:
         return pb.Value(data=obj.encode(), format="utf8")
     if isinstance(obj, (bytes, bytearray, memoryview)):
         return pb.Value(data=bytes(obj), format="raw")
-    if isinstance(obj, (list, tuple, dict)):
-        # Containers of JSON-able values stay language-neutral; only
-        # genuinely Python-only payloads fall through to pickle.
+    if isinstance(obj, (list, tuple, dict)) and _json_clean(obj):
+        # Containers of JSON-able values stay language-neutral (tuples
+        # decode as lists — JSON semantics, same as the reference's
+        # cross-language values); only genuinely Python-only payloads
+        # fall through to pickle. _json_clean pre-checks strictly —
+        # json.dumps would silently coerce non-string dict keys instead
+        # of raising, corrupting the round trip.
         import json as _json
-        try:
-            return pb.Value(data=_json.dumps(obj).encode(), format="json")
-        except (TypeError, ValueError):
-            pass
+        return pb.Value(data=_json.dumps(obj).encode(), format="json")
     return pb.Value(data=pickle.dumps(obj, protocol=5), format="pickle")
+
+
+def _json_clean(obj) -> bool:
+    """True when obj round-trips through JSON without silent coercion
+    (other than tuple->list): str keys only, JSON-able leaves."""
+    if obj is None or isinstance(obj, (bool, str)):
+        return True
+    if isinstance(obj, float):
+        import math as _math
+        return _math.isfinite(obj)
+    if isinstance(obj, int):
+        return True
+    if isinstance(obj, (list, tuple)):
+        return all(_json_clean(v) for v in obj)
+    if isinstance(obj, dict):
+        return all(isinstance(k, str) and _json_clean(v)
+                   for k, v in obj.items())
+    return False
 
 
 def decode_value(v: pb.Value):

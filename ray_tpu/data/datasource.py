@@ -201,15 +201,18 @@ def read_iceberg(table_path: str, *, snapshot_id: int | None = None,
             f"(have: {sorted(snaps)})")
 
     def _local(p: str) -> str:
-        # spec paths may be absolute URIs; map into the table dir
+        # spec paths may be absolute URIs, cwd-relative (a writer given a
+        # relative table path stores them verbatim), or table-relative
         if p.startswith("file://"):
             p = p[len("file://"):]
-        if os.path.isabs(p) and not os.path.exists(p):
+        if os.path.exists(p):
+            return p
+        if os.path.isabs(p):
             tail = p.split("/metadata/")[-1] if "/metadata/" in p \
                 else p.split("/data/")[-1]
             sub = "metadata" if "/metadata/" in p else "data"
             return os.path.join(table_path, sub, tail)
-        return p if os.path.isabs(p) else os.path.join(table_path, p)
+        return os.path.join(table_path, p)
 
     _, manifest_list = avro.read_file(_local(snaps[sid]["manifest-list"]))
     files: list[str] = []

@@ -24,9 +24,12 @@ try:
     from gymnasium import spaces
 except ImportError:  # pragma: no cover - gymnasium is baked in
     gym = None
+    spaces = None
+
+_EnvBase = gym.Env if gym is not None else object
 
 
-class AtariClassEnv(gym.Env):
+class AtariClassEnv(_EnvBase):
     """Deepmind-preprocessed view of a MinAtar core: the 10x10xC state
     renders into an 84x84 grayscale frame (8x nearest-neighbour upscale,
     channels weighted into intensities), stacked over the last 4 frames

@@ -22,9 +22,12 @@ try:
     from gymnasium import spaces
 except ImportError:  # pragma: no cover - gymnasium is baked into the image
     gym = None
+    spaces = None
+
+_EnvBase = gym.Env if gym is not None else object
 
 
-class MinAtarBreakout(gym.Env):
+class MinAtarBreakout(_EnvBase):
     """10x10 Breakout: paddle row at the bottom, three brick rows at the
     top, a diagonally bouncing ball. Channels: 0=paddle, 1=ball, 2=trail,
     3=brick. Actions: 0=noop, 1=left, 2=right. Reward 1 per brick; the
@@ -106,7 +109,7 @@ class MinAtarBreakout(gym.Env):
         return self._obs(), reward, terminated, truncated, {}
 
 
-class MinAtarSpaceInvaders(gym.Env):
+class MinAtarSpaceInvaders(_EnvBase):
     """10x10 Space Invaders: a 4x6 alien block marching side-to-side and
     down, a cannon on the bottom row. Channels: 0=cannon, 1=alien,
     2=alien bullet, 3=friendly bullet. Actions: 0=noop, 1=left, 2=right,
@@ -209,7 +212,7 @@ class MinAtarSpaceInvaders(gym.Env):
         return self._obs(), reward, terminated, truncated, {}
 
 
-class MinAtarAsterix(gym.Env):
+class MinAtarAsterix(_EnvBase):
     """10x10 Asterix: the hero moves in four directions; enemies and
     treasure slide horizontally across rows 1..8, spawning at a fixed
     cadence. Channels: 0=hero, 1=treasure, 2=enemy, 3=motion trail.
@@ -282,7 +285,7 @@ class MinAtarAsterix(gym.Env):
         return self._obs(), reward, terminated, truncated, {}
 
 
-class MinAtarFreeway(gym.Env):
+class MinAtarFreeway(_EnvBase):
     """10x10 Freeway: the chicken climbs from the bottom row to the top
     across 8 traffic lanes; cars wrap around at lane-specific speeds and
     directions. Channels: 0=chicken, 1=car, 2=fast-car marker,
@@ -350,7 +353,7 @@ class MinAtarFreeway(gym.Env):
         return self._obs(), reward, False, truncated, {}
 
 
-class MinAtarSeaquest(gym.Env):
+class MinAtarSeaquest(_EnvBase):
     """10x10 Seaquest: a submarine with an oxygen budget hunts fish with
     torpedoes and must surface (row 0) to refill. Channels: 0=sub,
     1=fish, 2=torpedo, 3=oxygen gauge (bottom row fill). Actions:

@@ -362,6 +362,7 @@ class JaxTrainer:
                 # the normal Result contract (error + last checkpoint +
                 # history) instead of leaking a raw exception.
                 self.state = ERRORED
+                _finalize_run(self)
                 from ray_tpu.train.checkpoint import Checkpoint
                 return Result(
                     metrics=latest_metrics,
@@ -412,8 +413,10 @@ class JaxTrainer:
             if failures_left > 0:
                 failures_left -= 1
                 self.state = RESTARTING
+                _finalize_run(self)
                 continue
             self.state = ERRORED
+            _finalize_run(self)
             from ray_tpu.train.checkpoint import Checkpoint
             return Result(metrics=latest_metrics,
                           checkpoint=Checkpoint(latest_ckpt_path)
@@ -421,6 +424,7 @@ class JaxTrainer:
                           path=storage_dir, error=error,
                           metrics_history=history)
 
+        _finalize_run(self)
         from ray_tpu.train.checkpoint import Checkpoint
         return Result(
             metrics=latest_metrics,
@@ -498,6 +502,12 @@ def _update_run(trainer, metrics: dict, iterations: int):
         run["latest_metrics"] = {
             k: v for k, v in metrics.items()
             if isinstance(v, (int, float, str, bool))}
+
+
+def _finalize_run(trainer):
+    run = _TRAIN_RUNS.get(trainer.run_config.name)
+    if run is not None:
+        run["state"] = str(trainer.state)
 
 
 def list_train_runs() -> list[dict]:
