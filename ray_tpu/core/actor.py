@@ -213,6 +213,18 @@ class ActorMethod:
                 # have executed, and only retry-permitted calls replay.
                 spec.retries_left = 1 if (len(loc) > 2 and loc[2]) else 0
                 rt.send(("direct_actor", loc[0], loc[1], spec))
+            elif (not streaming and not refs
+                  and not getattr(rt, "on_agent_node", False)
+                  and get_config().direct_actor_calls):
+                # Head-node worker: its socket terminates at the head, so
+                # there is no agent to route through — but the head can
+                # still take the THIN dispatch (straight to
+                # _send_actor_task, skipping journal/SUBMITTED-event/
+                # rid_to_spec/dep-pin bookkeeping a dep-free actor call
+                # doesn't need). Ordering needs no sequence numbers here:
+                # every call from this caller rides ONE socket and the
+                # head's listener handles frames in arrival order.
+                rt.send(("direct_actor_head", spec))
             else:
                 rt.send(("submit", spec))
         if streaming:

@@ -1391,6 +1391,18 @@ class Runtime:
         elif op == "submit":
             spec: TaskSpec = msg[1]
             self.submit_task(spec, fn_blob=None)
+        elif op == "direct_actor_head":
+            # Thin actor dispatch from a head-node worker (the agent-node
+            # direct path's counterpart; see actor.py). Dep-free by
+            # construction, so it goes straight to _send_actor_task —
+            # which parks on RESTARTING actors and fails on DEAD ones,
+            # exactly like the full path after gating.
+            spec = msg[1]
+            st = self.actors.get(spec.actor_id)
+            if st is None:
+                self.submit_task(spec)  # full path surfaces the failure
+            else:
+                self._send_actor_task(st, spec)
         elif op == "export_fn":
             _, fn_id, blob = msg
             with self.lock:
