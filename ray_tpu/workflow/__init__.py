@@ -50,6 +50,25 @@ class FunctionNode:
                    if isinstance(v, FunctionNode)])
 
 
+class Continuation:
+    """A step RESULT that continues the workflow with another DAG
+    (parity: `workflow.continuation` — dynamic workflows / sub-workflows).
+    The executor runs the nested DAG durably, its steps namespaced under
+    the returning step's id, and the nested output becomes the step's
+    result. Recovery never re-runs the step that returned it."""
+
+    def __init__(self, node: "FunctionNode"):
+        if not isinstance(node, FunctionNode):
+            raise TypeError(
+                f"continuation() takes a bound workflow step, got "
+                f"{type(node).__name__}")
+        self.node = node
+
+
+def continuation(node: "FunctionNode") -> Continuation:
+    return Continuation(node)
+
+
 class WorkflowStorage:
     """Filesystem layout: <root>/<workflow_id>/{status.json, steps/<id>.pkl}
     (parity: workflow_storage.py step-result persistence)."""
@@ -59,7 +78,8 @@ class WorkflowStorage:
         os.makedirs(os.path.join(self.root, "steps"), exist_ok=True)
 
     def _step_path(self, step_id: str) -> str:
-        return os.path.join(self.root, "steps", f"{step_id}.pkl")
+        safe = step_id.replace("/", "__")
+        return os.path.join(self.root, "steps", f"{safe}.pkl")
 
     def has_step(self, step_id: str) -> bool:
         return os.path.exists(self._step_path(step_id))
@@ -96,6 +116,41 @@ class WorkflowStorage:
         import cloudpickle
         with open(os.path.join(self.root, "dag.pkl"), "rb") as f:
             return cloudpickle.load(f)
+
+    # continuation markers: the parent step finished and returned a nested
+    # DAG — recovery resumes the nested DAG instead of re-running the
+    # parent (its side effects already happened).
+    def _cont_path(self, step_id: str) -> str:
+        return self._step_path(step_id) + ".cont"
+
+    def has_continuation(self, step_id: str) -> bool:
+        return os.path.exists(self._cont_path(step_id))
+
+    def save_continuation(self, step_id: str, node: FunctionNode):
+        import cloudpickle
+        tmp = self._cont_path(step_id) + ".tmp"
+        with open(tmp, "wb") as f:
+            cloudpickle.dump(node, f)
+        os.replace(tmp, self._cont_path(step_id))
+
+    def load_continuation(self, step_id: str) -> FunctionNode:
+        import cloudpickle
+        with open(self._cont_path(step_id), "rb") as f:
+            return cloudpickle.load(f)
+
+    def step_metadata(self) -> dict:
+        out = {}
+        steps_dir = os.path.join(self.root, "steps")
+        for fname in sorted(os.listdir(steps_dir)):
+            p = os.path.join(steps_dir, fname)
+            kind = "continuation" if fname.endswith(".cont") else "result"
+            sid = fname.replace("__", "/").rsplit(".pkl", 1)[0]
+            out[sid if kind == "result" else sid + " (continuation)"] = {
+                "kind": kind,
+                "size_bytes": os.path.getsize(p),
+                "finished_at": os.path.getmtime(p),
+            }
+        return out
 
 
 def _step_ids(dag: FunctionNode) -> dict[int, str]:
@@ -149,45 +204,69 @@ def _step_ids(dag: FunctionNode) -> dict[int, str]:
     return ids, order
 
 
-def _execute(workflow_id: str, dag: FunctionNode):
-    storage = WorkflowStorage(workflow_id)
-    storage.set_status("RUNNING")
+def _execute_dag(storage: WorkflowStorage, dag: FunctionNode,
+                 prefix: str = ""):
+    """Run one DAG level durably; nested Continuations recurse with their
+    steps namespaced under the returning step's id."""
     ids, order = _step_ids(dag)
+    ids = {nid: prefix + sid for nid, sid in ids.items()}
     results: dict[int, object] = {}
     pending = {id(n): n for n in order}
     inflight: dict[int, tuple] = {}  # node id -> (ref, step_id)
-    try:
-        while pending or inflight:
-            # Launch every ready step (parallelism across DAG branches).
-            for nid, n in list(pending.items()):
-                if any(id(d) not in results for d in n._deps()):
-                    continue
-                step_id = ids[nid]
-                if storage.has_step(step_id):
-                    results[nid] = storage.load_step(step_id)
-                    del pending[nid]
-                    continue
-                args = [results[id(a)] if isinstance(a, FunctionNode) else a
-                        for a in n.args]
-                kwargs = {k: results[id(v)] if isinstance(v, FunctionNode)
-                          else v for k, v in n.kwargs.items()}
-                inflight[nid] = (n.remote_fn.remote(*args, **kwargs),
-                                 step_id)
-                del pending[nid]
-            if not inflight:
+
+    def finish(nid, step_id, value):
+        if isinstance(value, Continuation):
+            # Durable hand-off BEFORE executing the nested DAG: a resume
+            # must continue it, never re-run the parent step.
+            if not storage.has_continuation(step_id):
+                storage.save_continuation(step_id, value.node)
+            value = _execute_dag(storage, value.node, prefix=step_id + "/")
+        storage.save_step(step_id, value)
+        results[nid] = value
+
+    while pending or inflight:
+        # Launch every ready step (parallelism across DAG branches).
+        for nid, n in list(pending.items()):
+            if any(id(d) not in results for d in n._deps()):
                 continue
-            refs = [ref for ref, _ in inflight.values()]
-            ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=300)
-            for nid, (ref, step_id) in list(inflight.items()):
-                if ref in ready:
-                    value = ray_tpu.get(ref, timeout=60)
-                    storage.save_step(step_id, value)
-                    results[nid] = value
-                    del inflight[nid]
+            step_id = ids[nid]
+            if storage.has_step(step_id):
+                results[nid] = storage.load_step(step_id)
+                del pending[nid]
+                continue
+            if storage.has_continuation(step_id):
+                # Parent ran before the crash; resume its continuation.
+                del pending[nid]
+                finish(nid, step_id, Continuation(
+                    storage.load_continuation(step_id)))
+                continue
+            args = [results[id(a)] if isinstance(a, FunctionNode) else a
+                    for a in n.args]
+            kwargs = {k: results[id(v)] if isinstance(v, FunctionNode)
+                      else v for k, v in n.kwargs.items()}
+            inflight[nid] = (n.remote_fn.remote(*args, **kwargs),
+                             step_id)
+            del pending[nid]
+        if not inflight:
+            continue
+        refs = [ref for ref, _ in inflight.values()]
+        ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=300)
+        for nid, (ref, step_id) in list(inflight.items()):
+            if ref in ready:
+                value = ray_tpu.get(ref, timeout=60)
+                del inflight[nid]
+                finish(nid, step_id, value)
+    return results[id(dag)]
+
+
+def _execute(workflow_id: str, dag: FunctionNode):
+    storage = WorkflowStorage(workflow_id)
+    storage.set_status("RUNNING")
+    try:
+        out = _execute_dag(storage, dag)
     except Exception as e:
         storage.set_status("FAILED", error=str(e))
         raise
-    out = results[id(dag)]
     storage.set_status("SUCCESSFUL")
     storage.save_step("__output__", out)
     return out
@@ -251,6 +330,22 @@ def list_all() -> list[tuple[str, str]]:
         st = WorkflowStorage(wid).get_status().get("status")
         out.append((wid, st))
     return out
+
+
+def get_metadata(workflow_id: str) -> dict:
+    """Workflow-level introspection (parity: workflow.get_metadata +
+    the reference's workflow inspection surface): status, timestamps,
+    and per-step durable-result metadata (nested continuation steps show
+    with their namespaced ids)."""
+    storage = WorkflowStorage(workflow_id)
+    status = storage.get_status()
+    return {
+        "workflow_id": workflow_id,
+        "status": status.get("status"),
+        "status_ts": status.get("ts"),
+        "error": status.get("error"),
+        "steps": storage.step_metadata(),
+    }
 
 
 def delete(workflow_id: str):

@@ -123,3 +123,81 @@ def test_custom_event_listener(ray_start_regular):
     workflow.init()
     dag = workflow.wait_for_event(Immediate, 21)
     assert workflow.run(dag) == 42
+
+
+def test_continuation_sub_workflow(ray_start_regular, tmp_path):
+    """A step returning workflow.continuation(...) hands off to a nested
+    DAG whose steps persist under the parent's namespace; the nested
+    output is the parent step's result (parity: dynamic workflows /
+    sub-workflows)."""
+    import ray_tpu
+    from ray_tpu import workflow
+
+    workflow.init(str(tmp_path / "wf"))
+
+    @ray_tpu.remote
+    def inner_add(a, b):
+        return a + b
+
+    @ray_tpu.remote
+    def outer(n):
+        from ray_tpu import workflow as wf
+        # dynamic: the continuation DAG depends on runtime data
+        return wf.continuation(inner_add.bind(n, n + 1))
+
+    @ray_tpu.remote
+    def plus_one(x):
+        return x + 1
+
+    dag = plus_one.bind(outer.bind(10))
+    out = workflow.run(dag, workflow_id="cont1")
+    assert out == 10 + 11 + 1
+    meta = workflow.get_metadata("cont1")
+    assert meta["status"] == "SUCCESSFUL"
+    # nested step persisted under the parent's namespace
+    assert any("/" in sid for sid in meta["steps"]), meta["steps"]
+    assert any(m["kind"] == "continuation"
+               for m in meta["steps"].values())
+
+
+def test_continuation_resume_skips_parent(ray_start_regular, tmp_path):
+    """Crash after the parent step returned its continuation: resume runs
+    the nested DAG without re-executing the parent (its side effects
+    already happened)."""
+    import os
+
+    import ray_tpu
+    from ray_tpu import workflow
+
+    workflow.init(str(tmp_path / "wf"))
+    marker = tmp_path / "parent_runs"
+
+    @ray_tpu.remote
+    def nested(v):
+        return v * 2
+
+    @ray_tpu.remote
+    def parent(path):
+        from ray_tpu import workflow as wf
+        with open(path, "a") as f:
+            f.write("x")
+        return wf.continuation(nested.bind(21))
+
+    dag = parent.bind(str(marker))
+    out = workflow.run(dag, workflow_id="cont2")
+    assert out == 42
+    assert marker.read_text() == "x"
+
+    # Simulate a crash AFTER the parent committed its continuation but
+    # before the nested result persisted: delete nested + final results,
+    # keep the continuation marker.
+    store = workflow.WorkflowStorage("cont2")
+    steps_dir = os.path.join(store.root, "steps")
+    for fname in os.listdir(steps_dir):
+        if not fname.endswith(".cont"):
+            os.remove(os.path.join(steps_dir, fname))
+    store.set_status("RUNNING")
+
+    out = workflow.resume("cont2")
+    assert out == 42
+    assert marker.read_text() == "x"  # parent did NOT re-run
