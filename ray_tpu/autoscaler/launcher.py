@@ -488,15 +488,23 @@ class GCEProvider(InstanceProvider):
             return json.loads(resp.read())["access_token"]
 
     def _default_transport(self, method: str, url: str, body: dict | None):
-        import urllib.request
-        data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(
-            url, data=data, method=method,
-            headers={"Authorization": f"Bearer {self._access_token()}",
-                     "Content-Type": "application/json"})
-        with urllib.request.urlopen(req, timeout=60) as resp:
-            payload = resp.read()
-        return json.loads(payload) if payload else {}
+        from ray_tpu.util.retry import (RetryPolicy, call_with_retries,
+                                        http_should_retry)
+
+        def once():
+            import urllib.request
+            data = json.dumps(body).encode() if body is not None else None
+            req = urllib.request.Request(
+                url, data=data, method=method,
+                headers={"Authorization":
+                         f"Bearer {self._access_token()}",
+                         "Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                payload = resp.read()
+            return json.loads(payload) if payload else {}
+
+        return call_with_retries(
+            once, policy=RetryPolicy(should_retry=http_should_retry))
 
     # -- REST helpers ----------------------------------------------------
 
@@ -688,27 +696,35 @@ class KubernetesProvider(InstanceProvider):
     _SA = "/var/run/secrets/kubernetes.io/serviceaccount"
 
     def _default_transport(self, method: str, url: str, body: dict | None):
-        import ssl
-        import urllib.request
-        headers = {"Content-Type": "application/json"}
-        try:
-            with open(f"{self._SA}/token") as f:
-                headers["Authorization"] = f"Bearer {f.read().strip()}"
-        except OSError:
-            pass
-        ctx = None
-        if url.startswith("https"):
-            ctx = ssl.create_default_context()
+        from ray_tpu.util.retry import (RetryPolicy, call_with_retries,
+                                        http_should_retry)
+
+        def once():
+            import ssl
+            import urllib.request
+            headers = {"Content-Type": "application/json"}
             try:
-                ctx.load_verify_locations(f"{self._SA}/ca.crt")
+                with open(f"{self._SA}/token") as f:
+                    headers["Authorization"] = f"Bearer {f.read().strip()}"
             except OSError:
                 pass
-        data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(url, data=data, method=method,
-                                     headers=headers)
-        with urllib.request.urlopen(req, timeout=60, context=ctx) as resp:
-            payload = resp.read()
-        return json.loads(payload) if payload else {}
+            ctx = None
+            if url.startswith("https"):
+                ctx = ssl.create_default_context()
+                try:
+                    ctx.load_verify_locations(f"{self._SA}/ca.crt")
+                except OSError:
+                    pass
+            data = json.dumps(body).encode() if body is not None else None
+            req = urllib.request.Request(url, data=data, method=method,
+                                         headers=headers)
+            with urllib.request.urlopen(req, timeout=60,
+                                        context=ctx) as resp:
+                payload = resp.read()
+            return json.loads(payload) if payload else {}
+
+        return call_with_retries(
+            once, policy=RetryPolicy(should_retry=http_should_retry))
 
     # -- pod helpers -----------------------------------------------------
 

@@ -777,6 +777,13 @@ class Runtime:
             import gc
             gc.set_threshold(cfg.gc_gen0_threshold)  # gens 1-2 untouched
         self._reservations: dict[bytes, tuple] = {}  # task_id -> token
+        # Generic pubsub hub (parity: src/ray/pubsub/publisher.h:300 —
+        # channelized publisher with per-key subscriptions). Workers
+        # subscribe over their head socket; driver-side subscribers are
+        # local callbacks. Delivery is at-most-once doorbell semantics;
+        # durable state (KV, directory) carries the payload of record.
+        self._pubsub_subs: dict[tuple, set] = {}    # (chan, key) -> wids
+        self._pubsub_local: dict[tuple, list] = {}  # (chan, key) -> cbs
         # Two-phase steal: specs pulled off a busy worker's backlog await the
         # origin's drop-ack before re-dispatch (exactly-once absent failures;
         # the reference never duplicates execution without a failure).
@@ -1378,6 +1385,19 @@ class Runtime:
             self._on_object_ready(msg[1])
         elif op == "drop_ack":
             self._on_drop_ack(w, msg[1], msg[2])
+        elif op == "subscribe":
+            with self.lock:
+                self._pubsub_subs.setdefault(
+                    (msg[1], msg[2]), set()).add(w.worker_id.binary())
+        elif op == "unsubscribe":
+            with self.lock:
+                subs = self._pubsub_subs.get((msg[1], msg[2]))
+                if subs is not None:
+                    subs.discard(w.worker_id.binary())
+                    if not subs:
+                        self._pubsub_subs.pop((msg[1], msg[2]), None)
+        elif op == "publish":
+            self.pubsub_publish(msg[1], msg[2], msg[3])
         elif op == "profile_result":
             entry = self._profile_futs.pop(msg[1], None)
             if entry is not None:
@@ -1613,6 +1633,51 @@ class Runtime:
         else:
             resp = RayTpuError(f"unknown request {what}")
         w.send(("resp", req_id, resp))
+
+    # ---------------- generic pubsub (publisher side) ----------------
+
+    def pubsub_publish(self, channel: str, key: str, message):
+        """Fan a message out to every subscriber of (channel, key):
+        worker subscribers get a pubsub_msg push; driver-side local
+        callbacks fire inline."""
+        with self.lock:
+            wids = list(self._pubsub_subs.get((channel, key), ()))
+            cbs = list(self._pubsub_local.get((channel, key), ()))
+        frame = ("pubsub_msg", channel, key, message)
+        for wid in wids:
+            w = self.workers.get(wid)
+            if w is None or w.state == DEAD:
+                with self.lock:
+                    subs = self._pubsub_subs.get((channel, key))
+                    if subs is not None:
+                        subs.discard(wid)
+                continue
+            try:
+                if not self._buffered_send(w, frame):
+                    w.send(frame)
+            except OSError:
+                pass  # death path prunes
+        for cb in cbs:
+            try:
+                cb(message)
+            except Exception:  # noqa: BLE001 — one bad cb can't stop fan-out
+                traceback.print_exc()
+
+    def pubsub_subscribe(self, channel: str, key: str, callback):
+        with self.lock:
+            self._pubsub_local.setdefault((channel, key),
+                                          []).append(callback)
+
+    def pubsub_unsubscribe(self, channel: str, key: str, callback):
+        with self.lock:
+            cbs = self._pubsub_local.get((channel, key))
+            if cbs is not None:
+                try:
+                    cbs.remove(callback)
+                except ValueError:
+                    pass
+                if not cbs:
+                    self._pubsub_local.pop((channel, key), None)
 
     def _push_obj_to_worker(self, wid: bytes, oid: bytes, entry):
         w = self.workers.get(wid)
@@ -4215,6 +4280,9 @@ class Runtime:
                 return
             w.state = DEAD
             self.workers.pop(w.worker_id.binary(), None)
+            wid_bin = w.worker_id.binary()
+            for subs in self._pubsub_subs.values():
+                subs.discard(wid_bin)
             node = self.nodes.get(w.node_id)
             if node is not None:
                 try:

@@ -165,6 +165,9 @@ class WorkerRuntime:
         # (begun -> drop_ack False, the head aborts the steal).
         self.begun_tasks: set = set()
         self.steal_lock = threading.Lock()
+        # pubsub subscriber registry (pubsub_msg pushes dispatch here)
+        self._pubsub_cbs: dict[tuple, list] = {}
+        self._pubsub_lock = threading.Lock()
         self.actor_instance = None
         self.actor_id: bytes | None = None
         self.shutdown = threading.Event()
@@ -186,6 +189,31 @@ class WorkerRuntime:
         self._req_lock = threading.Lock()
         self._req_seq = 0
         self._req_futures: dict[int, "concurrent.futures.Future"] = {}
+
+    # -- pubsub (subscriber side; parity: pubsub/subscriber.h:73) --
+
+    def pubsub_subscribe(self, channel: str, key: str, callback):
+        with self._pubsub_lock:
+            self._pubsub_cbs.setdefault((channel, key), []).append(callback)
+        self.send(("subscribe", channel, key))
+
+    def pubsub_unsubscribe(self, channel: str, key: str, callback):
+        last = False
+        with self._pubsub_lock:
+            cbs = self._pubsub_cbs.get((channel, key))
+            if cbs is not None:
+                try:
+                    cbs.remove(callback)
+                except ValueError:
+                    pass
+                if not cbs:
+                    self._pubsub_cbs.pop((channel, key), None)
+                    last = True
+        if last:
+            self.send(("unsubscribe", channel, key))
+
+    def pubsub_publish(self, channel: str, key: str, message):
+        self.send(("publish", channel, key, message))
 
     # -- object plane --
 
@@ -451,6 +479,16 @@ class WorkerRuntime:
                 fut.set_result(result)
         elif op == "actor_moved":
             self.actor_locations.pop(msg[1], None)
+        elif op == "pubsub_msg":
+            _, channel, key, message = msg
+            with self._pubsub_lock:
+                cbs = list(self._pubsub_cbs.get((channel, key), ()))
+            for cb in cbs:
+                try:
+                    cb(message)
+                except Exception:  # noqa: BLE001 — keep dispatching
+                    import traceback
+                    traceback.print_exc()
         else:
             raise RuntimeError(f"worker: unknown push {op}")
 

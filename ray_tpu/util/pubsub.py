@@ -1,0 +1,59 @@
+"""Generic channelized pubsub over the head hub.
+
+Parity: `src/ray/pubsub/publisher.h:300` / `subscriber.h:73` — the
+reusable publisher/subscriber channel the reference's subsystems share
+(GCS pubsub, object-location subs), instead of each subsystem re-solving
+delivery. Works from the driver (head process) and from any worker:
+
+    from ray_tpu.util import pubsub
+    pubsub.subscribe("jobs", "job-1", lambda m: print(m))
+    pubsub.publish("jobs", "job-1", {"state": "RUNNING"})
+
+Semantics: at-most-once doorbell delivery to every live subscriber of
+(channel, key). Payloads of record belong in durable state (KV, object
+store); the message is the wake-up. Subscriptions die with their worker.
+`wait_for(channel, key)` is the blocking convenience built on it.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+def _rt():
+    from ray_tpu.core.runtime import get_runtime
+    return get_runtime()
+
+
+def subscribe(channel: str, key: str, callback) -> None:
+    """Register `callback(message)` for every publish to (channel, key)."""
+    _rt().pubsub_subscribe(channel, key, callback)
+
+
+def unsubscribe(channel: str, key: str, callback) -> None:
+    _rt().pubsub_unsubscribe(channel, key, callback)
+
+
+def publish(channel: str, key: str, message=None) -> None:
+    """Deliver `message` to every current subscriber of (channel, key)."""
+    _rt().pubsub_publish(channel, key, message)
+
+
+def wait_for(channel: str, key: str, timeout: float | None = None):
+    """Block until one message arrives on (channel, key); returns it.
+    Raises TimeoutError on expiry."""
+    ev = threading.Event()
+    box: list = []
+
+    def cb(message):
+        box.append(message)
+        ev.set()
+
+    subscribe(channel, key, cb)
+    try:
+        if not ev.wait(timeout):
+            raise TimeoutError(
+                f"no message on ({channel!r}, {key!r}) in {timeout}s")
+        return box[0]
+    finally:
+        unsubscribe(channel, key, cb)

@@ -1,0 +1,68 @@
+"""Reusable retry policy for RPC-shaped calls.
+
+Parity: `src/ray/rpc/retryable_grpc_client.h` — the reference wraps its
+gRPC clients in one retry/backoff policy instead of each call site
+re-solving transient-failure handling. Here the callable IS the RPC
+(an HTTP transport, a socket send, a cloud API call).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    max_attempts: int = 3
+    base_backoff_s: float = 0.5
+    max_backoff_s: float = 8.0
+    # Exception types considered transient. Anything else propagates
+    # immediately (a 404 is an answer, not a flake).
+    retryable: tuple = (OSError, TimeoutError)
+    # Optional finer predicate: exc -> bool. When set it REPLACES the
+    # type check (e.g. "URLError yes, but HTTPError < 500 no").
+    should_retry: object = None
+
+
+def http_should_retry(exc) -> bool:
+    """Shared predicate for urllib-based transports: retry connection
+    failures and HTTP 5xx, never 4xx (an answer, not a flake)."""
+    import urllib.error
+    if isinstance(exc, urllib.error.HTTPError):
+        return exc.code >= 500
+    return isinstance(exc, (OSError, TimeoutError))
+
+
+def call_with_retries(fn, *args, policy: RetryPolicy | None = None,
+                      on_retry=None, **kwargs):
+    """Run `fn(*args, **kwargs)`, retrying transient failures with
+    exponential backoff. `on_retry(attempt, exc)` observes each retry
+    (logging/metrics hook). The final failure propagates unchanged."""
+    policy = policy or RetryPolicy()
+    attempt = 0
+    while True:
+        try:
+            return fn(*args, **kwargs)
+        except Exception as e:  # noqa: BLE001 — filtered right below
+            transient = (policy.should_retry(e) if policy.should_retry
+                         else isinstance(e, policy.retryable))
+            attempt += 1
+            if not transient or attempt >= policy.max_attempts:
+                raise
+            if on_retry is not None:
+                on_retry(attempt, e)
+            time.sleep(min(policy.base_backoff_s * (2 ** (attempt - 1)),
+                           policy.max_backoff_s))
+
+
+def retryable(policy: RetryPolicy | None = None, on_retry=None):
+    """Decorator form: wrap a client method in the shared policy."""
+    def deco(fn):
+        def wrapped(*args, **kwargs):
+            return call_with_retries(fn, *args, policy=policy,
+                                     on_retry=on_retry, **kwargs)
+        wrapped.__name__ = getattr(fn, "__name__", "retryable")
+        wrapped.__doc__ = fn.__doc__
+        return wrapped
+    return deco

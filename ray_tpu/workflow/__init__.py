@@ -367,26 +367,44 @@ class EventListener:
 
 
 class KVEventListener(EventListener):
-    """Default listener: waits for a key in the head KV (the in-cluster
-    analogue of the reference's HTTP event hook — publish with
-    `workflow.publish_event(key, value)` from anywhere)."""
+    """Default listener: the durable event value lives in the head KV;
+    the generic pubsub channel (`util/pubsub.py`, the publisher.h:300
+    role) is the DOORBELL — the waiter sleeps on a subscription instead
+    of burning a poll loop, with a slow re-check covering a doorbell
+    that fired before the subscription landed."""
 
-    def poll_for_event(self, key, poll_interval_s: float = 0.1):
+    def poll_for_event(self, key, poll_interval_s: float = 2.0):
+        import threading
+
         from ray_tpu.experimental.internal_kv import _internal_kv_take
-        while True:
-            # Atomic take: with several waiters on one key, exactly one
-            # consumes each published event (get-then-delete would let two
-            # waiters race — one double-consume, one hung).
-            v = _internal_kv_take(f"__wf_event__:{key}")
-            if v is not None:
-                return pickle.loads(v)
-            time.sleep(poll_interval_s)
+        from ray_tpu.util import pubsub
+
+        bell = threading.Event()
+        cb = lambda _msg: bell.set()  # noqa: E731
+        pubsub.subscribe("workflow_event", key, cb)
+        try:
+            while True:
+                # Atomic take: with several waiters on one key, exactly
+                # one consumes each published event (get-then-delete
+                # would let two waiters race — one double-consume, one
+                # hung).
+                v = _internal_kv_take(f"__wf_event__:{key}")
+                if v is not None:
+                    return pickle.loads(v)
+                bell.wait(poll_interval_s)
+                bell.clear()
+        finally:
+            pubsub.unsubscribe("workflow_event", key, cb)
 
 
 def publish_event(key: str, value=None):
-    """Fire an event that a wait_for_event step is (or will be) polling."""
+    """Fire an event that a wait_for_event step is (or will be) awaiting:
+    the value persists in the KV (late waiters find it), the pubsub
+    doorbell wakes current waiters immediately."""
     from ray_tpu.experimental.internal_kv import _internal_kv_put
+    from ray_tpu.util import pubsub
     _internal_kv_put(f"__wf_event__:{key}", pickle.dumps(value))
+    pubsub.publish("workflow_event", key)
 
 
 def wait_for_event(listener_cls=KVEventListener, *args, **kwargs):

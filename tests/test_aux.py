@@ -318,3 +318,91 @@ def test_log_monitor_streams_new_lines(tmp_path):
         assert "old line" not in text
     finally:
         mon.stop()
+
+
+def test_pubsub_channels(ray_start_regular):
+    """Generic channelized pubsub (publisher.h:300 role): driver and
+    worker subscribers on (channel, key); publishes from workers fan out;
+    other keys stay silent."""
+    import threading
+    import time
+
+    import ray_tpu
+    from ray_tpu.util import pubsub
+
+    got = []
+    ev = threading.Event()
+
+    def cb(msg):
+        got.append(msg)
+        ev.set()
+
+    pubsub.subscribe("jobs", "a", cb)
+    # silent: different key
+    pubsub.publish("jobs", "b", {"x": 1})
+
+    @ray_tpu.remote
+    def worker_pub():
+        from ray_tpu.util import pubsub as ps
+        ps.publish("jobs", "a", {"state": "DONE"})
+        return True
+
+    assert ray_tpu.get(worker_pub.remote(), timeout=30)
+    assert ev.wait(10)
+    assert got == [{"state": "DONE"}]
+    pubsub.unsubscribe("jobs", "a", cb)
+
+    # worker-side subscriber woken by a driver publish
+    @ray_tpu.remote
+    def worker_wait():
+        from ray_tpu.util import pubsub as ps
+        return ps.wait_for("jobs", "c", timeout=30)
+
+    ref = worker_wait.remote()
+    time.sleep(0.5)  # let the subscription land
+    pubsub.publish("jobs", "c", 42)
+    assert ray_tpu.get(ref, timeout=30) == 42
+
+
+def test_retry_policy():
+    """call_with_retries: transient failures back off and retry; 4xx-
+    style answers propagate immediately."""
+    import urllib.error
+
+    import pytest
+
+    from ray_tpu.util.retry import (RetryPolicy, call_with_retries,
+                                    http_should_retry)
+
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert call_with_retries(
+        flaky, policy=RetryPolicy(base_backoff_s=0.01)) == "ok"
+    assert calls["n"] == 3
+
+    def always_404():
+        calls["n"] += 1
+        raise urllib.error.HTTPError("u", 404, "nf", {}, None)
+
+    calls["n"] = 0
+    with pytest.raises(urllib.error.HTTPError):
+        call_with_retries(always_404, policy=RetryPolicy(
+            base_backoff_s=0.01, should_retry=http_should_retry))
+    assert calls["n"] == 1  # not retried
+
+    def always_503():
+        calls["n"] += 1
+        raise urllib.error.HTTPError("u", 503, "busy", {}, None)
+
+    calls["n"] = 0
+    with pytest.raises(urllib.error.HTTPError):
+        call_with_retries(always_503, policy=RetryPolicy(
+            max_attempts=3, base_backoff_s=0.01,
+            should_retry=http_should_retry))
+    assert calls["n"] == 3  # retried to exhaustion
