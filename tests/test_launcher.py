@@ -319,8 +319,12 @@ class _FakeK8s:
                 # inside the image
                 env["PATH"] = (os.path.dirname(sys.executable)
                                + os.pathsep + env.get("PATH", ""))
+                # Own session: pod deletion must kill the whole process
+                # TREE (a `ray_tpu start` daemonizes past its shell), the
+                # way a real kubelet tears down the pod cgroup.
                 self.procs[name] = subprocess.Popen(
                     ["/bin/sh", "-c", shell], env=env,
+                    start_new_session=True,
                     stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
             return pod
         if method == "GET" and "labelSelector" in url:
@@ -335,11 +339,19 @@ class _FakeK8s:
             self.pods.pop(name, None)
             proc = self.procs.pop(name, None)
             if proc is not None:
-                proc.terminate()
+                import os as os_mod
+                import signal as signal_mod
+                try:
+                    os_mod.killpg(proc.pid, signal_mod.SIGTERM)
+                except ProcessLookupError:
+                    pass
                 try:
                     proc.wait(timeout=5)
                 except subprocess.TimeoutExpired:
-                    proc.kill()
+                    try:
+                        os_mod.killpg(proc.pid, signal_mod.SIGKILL)
+                    except ProcessLookupError:
+                        pass
             return {}
         return {}
 
