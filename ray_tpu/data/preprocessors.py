@@ -152,6 +152,69 @@ class Concatenator(Preprocessor):
         return out
 
 
+class LabelEncoder(Preprocessor):
+    """Categorical column -> integer codes (categories discovered at
+    fit, sorted; unseen values encode as -1)."""
+
+    def __init__(self, label_column: str):
+        self.label_column = label_column
+        self.classes_: list = []
+
+    def _fit(self, ds):
+        seen: set = set()
+        for batch in ds.iter_batches(batch_format="numpy"):
+            seen.update(np.asarray(batch[self.label_column]).tolist())
+        self.classes_ = sorted(seen, key=repr)
+
+    def transform_batch(self, batch):
+        out = dict(batch)
+        index = {c: i for i, c in enumerate(self.classes_)}
+        v = np.asarray(batch[self.label_column])
+        out[self.label_column] = np.array(
+            [index.get(x, -1) for x in v.tolist()], np.int64)
+        return out
+
+
+class SimpleImputer(Preprocessor):
+    """Fill NaNs with the fit-time mean ("mean") or a constant
+    ("constant", fill_value)."""
+
+    def __init__(self, columns: list[str], strategy: str = "mean",
+                 fill_value: float = 0.0):
+        if strategy not in ("mean", "constant"):
+            raise ValueError(f"unknown imputer strategy {strategy!r}")
+        self.columns = list(columns)
+        self.strategy = strategy
+        self.fill_value = fill_value
+        self.stats_: dict[str, float] = {}
+
+    def _needs_fit(self) -> bool:
+        return self.strategy == "mean"
+
+    def _fit(self, ds):
+        if self.strategy != "mean":
+            return
+        acc = {c: [0, 0.0] for c in self.columns}
+        for batch in ds.iter_batches(batch_format="numpy"):
+            for c in self.columns:
+                v = np.asarray(batch[c], np.float64)
+                ok = np.isfinite(v)
+                acc[c][0] += int(ok.sum())
+                acc[c][1] += float(v[ok].sum())
+        self.stats_ = {c: (s / n if n else 0.0)
+                       for c, (n, s) in acc.items()}
+
+    def transform_batch(self, batch):
+        out = dict(batch)
+        for c in self.columns:
+            v = np.asarray(batch[c], np.float64).copy()
+            fill = (self.stats_.get(c, 0.0) if self.strategy == "mean"
+                    else self.fill_value)
+            v[~np.isfinite(v)] = fill
+            out[c] = v
+        return out
+
+
 class Chain(Preprocessor):
     """Apply preprocessors in sequence (fit streams each stage over the
     previous stage's lazy transform)."""

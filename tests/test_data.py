@@ -718,3 +718,33 @@ def test_preprocessed_dataset_feeds_jax_trainer(ray_start_regular,
     result = trainer.fit()
     assert result.error is None
     assert result.metrics["rows_seen"] > 0
+
+
+def test_label_encoder_and_imputer(ray_start_regular):
+    import math
+
+    import numpy as np
+
+    import ray_tpu.data as rd
+    from ray_tpu.data.preprocessors import LabelEncoder, SimpleImputer
+
+    ds = rd.from_items([
+        {"color": "red", "v": 1.0}, {"color": "blue", "v": float("nan")},
+        {"color": "green", "v": 3.0}, {"color": "red", "v": 4.0}])
+
+    le = LabelEncoder("color").fit(ds)
+    assert le.classes_ == ["blue", "green", "red"]
+    batch = le.transform(ds).take_batch(4, batch_format="numpy")
+    assert batch["color"].tolist() == [2, 0, 1, 2]
+    # unseen value -> -1
+    other = rd.from_items([{"color": "mauve", "v": 0.0}])
+    assert le.transform(other).take_all()[0]["color"] == -1
+
+    imp = SimpleImputer(["v"], strategy="mean").fit(ds)
+    vals = [r["v"] for r in imp.transform(ds).take_all()]
+    assert not any(math.isnan(x) for x in vals)
+    assert vals[1] == (1.0 + 3.0 + 4.0) / 3  # the fit-time mean
+
+    const = SimpleImputer(["v"], strategy="constant", fill_value=-9.0)
+    vals = [r["v"] for r in const.transform(ds).take_all()]
+    assert vals[1] == -9.0
