@@ -30,8 +30,14 @@ def run_many_agents(n_agents: int = 16, n_tasks: int = 400,
         def f(x):
             return (x + 1, ray_tpu.get_node_id())
 
-        # Warm every node's pool before the clock starts.
+        # Warm every node's pool before the clock starts...
         ray_tpu.get([f.remote(i) for i in range(2 * n_agents)],
+                    timeout=spawn_timeout)
+        # ...then let the boot storm drain: agent zygotes keep importing
+        # jax for several seconds after registration, and on a small box
+        # that import CPU would be billed to the measurement.
+        time.sleep(min(1.0 + 0.15 * n_agents, 12.0))
+        ray_tpu.get([f.remote(i) for i in range(n_agents)],
                     timeout=spawn_timeout)
         t0 = time.perf_counter()
         out = ray_tpu.get([f.remote(i) for i in range(n_tasks)],
