@@ -345,8 +345,21 @@ def bench_rl_impala(iters: int = 4, env: str = "AtariClassBreakout-v0"):
     return out
 
 
-def run() -> dict:
-    """Returns {"device": ..., "configs": [...]} or {"skipped": reason}."""
+def bench_llm_speculative():
+    """Speculative-decode bench (filled in with the engine's n-gram draft
+    path; see ray_tpu/llm/engine.py)."""
+    return {"config": "llm_decode_speculative",
+            "skipped": "engine speculative path lands with D6"}
+
+
+def run(deadline: float | None = None, emit=None) -> dict:
+    """Returns {"device": ..., "configs": [...]} or {"skipped": reason}.
+
+    deadline is an absolute time.monotonic() bound: entries whose cost
+    estimate doesn't fit are stamped "skipped" instead of run (r4's bench
+    never got to print because late sections blew the driver budget).
+    emit(tag, value) streams each headline number as it lands.
+    """
     try:
         import jax
         dev = jax.devices()[0]
@@ -356,58 +369,47 @@ def run() -> dict:
         return {"skipped": f"no TPU (platform={dev.platform})"}
 
     from ray_tpu.models import configs
-    results = {"device": str(getattr(dev, "device_kind", dev)), "configs": []}
+    results = {"device": str(getattr(dev, "device_kind", dev)),
+               "configs": []}
+    # (tag, est_seconds, thunk) — estimates include tunnel compile time.
     plan = [
-        ("125m", configs.bench_125m(attn_impl="pallas"), 16, 1024, 30),
-        ("llama3_1b",
-         configs.llama3_1b(attn_impl="pallas", remat=True), 16, 1024, 10),
+        ("125m", 90,
+         lambda: bench_config("125m", configs.bench_125m(attn_impl="pallas"),
+                              16, 1024, steps=30)),
+        ("llama3_1b", 120,
+         lambda: bench_config(
+             "llama3_1b", configs.llama3_1b(attn_impl="pallas", remat=True),
+             16, 1024, steps=10)),
+        ("sp_ring_32k", 90, bench_sp_ring),
+        ("llm_decode_dense", 80, lambda: bench_llm_decode("dense")),
+        ("llm_decode_paged", 80, lambda: bench_llm_decode("paged")),
+        ("llm_decode_prefix_shared", 80, bench_llm_prefix_shared),
+        ("llm_decode_speculative", 80, bench_llm_speculative),
+        ("rl_ppo_minatar", 60, bench_rl_ppo),
+        ("rl_ppo_atari_class", 90,
+         lambda: bench_rl_ppo(env="AtariClassBreakout-v0",
+                              tag="rl_ppo_atari_class")),
+        ("rl_impala_atari_class", 90, bench_rl_impala),
     ]
-    for tag, cfg, batch, seq, steps in plan:
+    for tag, est, thunk in plan:
+        if deadline is not None and time.monotonic() + est > deadline:
+            results["configs"].append({"config": tag, "skipped": "budget"})
+            print(f"{tag}: skipped (budget)", file=sys.stderr)
+            continue
         try:
-            results["configs"].append(
-                bench_config(tag, cfg, batch, seq, steps=steps))
+            r = thunk()
+            results["configs"].append(r)
+            if emit is not None:
+                for key in ("decode_tokens_per_sec", "tokens_per_sec",
+                            "tokens_per_sec_per_chip", "env_steps_per_sec",
+                            "mfu_pct"):
+                    if isinstance(r, dict) and key in r:
+                        emit(f"tpu_{tag}_{key}", float(r[key]))
+                        break
         except Exception as e:
-            results["configs"].append(
-                {"config": tag, "error": str(e)[:200]})
+            results["configs"].append({"config": tag,
+                                       "error": str(e)[:200]})
             print(f"{tag}: FAILED {e}", file=sys.stderr)
-    try:
-        results["configs"].append(bench_sp_ring())
-    except Exception as e:
-        results["configs"].append(
-            {"config": "sp_ring_32k", "error": str(e)[:200]})
-        print(f"sp_ring: FAILED {e}", file=sys.stderr)
-    for layout in ("dense", "paged"):
-        try:
-            results["configs"].append(bench_llm_decode(layout))
-        except Exception as e:
-            results["configs"].append(
-                {"config": f"llm_decode_{layout}", "error": str(e)[:200]})
-            print(f"llm_decode[{layout}]: FAILED {e}", file=sys.stderr)
-    try:
-        results["configs"].append(bench_llm_prefix_shared())
-    except Exception as e:
-        results["configs"].append(
-            {"config": "llm_decode_prefix_shared", "error": str(e)[:200]})
-        print(f"llm_prefix_shared: FAILED {e}", file=sys.stderr)
-    try:
-        results["configs"].append(bench_rl_ppo())
-    except Exception as e:
-        results["configs"].append(
-            {"config": "rl_ppo_minatar", "error": str(e)[:200]})
-        print(f"rl_ppo: FAILED {e}", file=sys.stderr)
-    try:
-        results["configs"].append(bench_rl_ppo(
-            env="AtariClassBreakout-v0", tag="rl_ppo_atari_class"))
-    except Exception as e:
-        results["configs"].append(
-            {"config": "rl_ppo_atari_class", "error": str(e)[:200]})
-        print(f"rl_ppo_atari: FAILED {e}", file=sys.stderr)
-    try:
-        results["configs"].append(bench_rl_impala())
-    except Exception as e:
-        results["configs"].append(
-            {"config": "rl_impala_atari_class", "error": str(e)[:200]})
-        print(f"rl_impala: FAILED {e}", file=sys.stderr)
     return results
 
 

@@ -922,7 +922,9 @@ def _head_address(config: ClusterConfig, runner: CommandRunner) -> str:
     """Read the address the head published (start --head wrote it under the
     instance's RAY_TPU_STATE_DIR)."""
     _, out = runner.run(
-        'cat "${RAY_TPU_STATE_DIR:-/tmp/ray_tpu}/ray_current_address"',
+        'cat "${RAY_TPU_STATE_DIR:-${TMPDIR:-/tmp}/ray_tpu_sessions}'
+        '/ray_current_address" 2>/dev/null'
+        ' || cat /tmp/ray_tpu/ray_current_address',
         capture=True, timeout=30)
     return out.strip()
 

@@ -21,9 +21,18 @@ import subprocess
 import sys
 import time
 
-_STATE_DIR = os.environ.get("RAY_TPU_STATE_DIR", "/tmp/ray_tpu")
+import tempfile as _tempfile
+
+# The cluster address/pid files live at the sessions root (NOT a dir
+# named after the package — /tmp/ray_tpu shadowed `import ray_tpu` for
+# scripts run from /tmp; see core/session.py and r4 verdict).
+_STATE_DIR = os.environ.get(
+    "RAY_TPU_STATE_DIR",
+    os.path.join(_tempfile.gettempdir(), "ray_tpu_sessions"))
 _ADDR_FILE = os.path.join(_STATE_DIR, "ray_current_address")
 _PID_FILE = os.path.join(_STATE_DIR, "ray_head_pids")
+# Migration shim: a head started by an old build published here.
+_LEGACY_ADDR_FILE = "/tmp/ray_tpu/ray_current_address"
 
 
 def _write_cluster_files(address: str, pids: list[int]):
@@ -39,12 +48,16 @@ def _resolve_address(args) -> str:
         "RAY_TPU_ADDRESS")
     if addr:
         return addr
-    try:
-        with open(_ADDR_FILE) as f:
-            return f.read().strip()
-    except FileNotFoundError:
-        sys.exit("no running cluster found: pass --address or run "
-                 "`ray_tpu start --head` first")
+    for path in (_ADDR_FILE, _LEGACY_ADDR_FILE):
+        try:
+            with open(path) as f:
+                addr = f.read().strip()
+            if addr:
+                return addr
+        except FileNotFoundError:
+            continue
+    sys.exit("no running cluster found: pass --address or run "
+             "`ray_tpu start --head` first")
 
 
 def _connect(args):
@@ -103,10 +116,11 @@ def _cmd_start(args):
     # Detach: re-exec ourselves with --block as a session leader. A stale
     # address file from a crashed head must not be mistaken for the new
     # head's publication.
-    try:
-        os.unlink(_ADDR_FILE)
-    except FileNotFoundError:
-        pass
+    for stale_addr in (_ADDR_FILE, _LEGACY_ADDR_FILE):
+        try:
+            os.unlink(stale_addr)
+        except FileNotFoundError:
+            pass
     cmd = [sys.executable, "-m", "ray_tpu", "start", "--head", "--block",
            "--port", str(args.port),
            "--num-tpus", str(args.num_tpus)]
@@ -167,7 +181,7 @@ def _cmd_stop(_args):
             except OSError:
                 pass
         print(f"stopped pid {pid}")
-    for p in (_PID_FILE, _ADDR_FILE):
+    for p in (_PID_FILE, _ADDR_FILE, _LEGACY_ADDR_FILE):
         try:
             os.unlink(p)
         except FileNotFoundError:

@@ -23,7 +23,6 @@ import os
 import selectors
 import signal
 import socket
-import tempfile
 import threading
 import time
 import traceback
@@ -103,10 +102,8 @@ class NodeAgent:
         set_config(cfg)
         self.config = cfg
         self.node_id = node_id or os.urandom(8)
-        self.session_dir = os.path.join(
-            tempfile.gettempdir(), "ray_tpu",
-            f"node_{uuid.uuid4().hex[:12]}")
-        os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
+        from ray_tpu.core.session import new_session_dir
+        self.session_dir = new_session_dir("node")
 
         shm_dir = "/dev/shm" if os.path.isdir("/dev/shm") else self.session_dir
         _reap_stale_stores(shm_dir)

@@ -26,7 +26,6 @@ import signal
 import socket
 import subprocess
 import sys
-import tempfile
 import threading
 import time
 import traceback
@@ -674,9 +673,8 @@ class Runtime:
         set_config(cfg)
         self.config = cfg
         self.session_id = uuid.uuid4().hex[:12]
-        self.session_dir = os.path.join(
-            tempfile.gettempdir(), "ray_tpu", f"session_{self.session_id}")
-        os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
+        from ray_tpu.core.session import new_session_dir
+        self.session_dir = new_session_dir("session")
 
         store_size = object_store_memory or default_store_size(cfg)
         shm_dir = "/dev/shm" if os.path.isdir("/dev/shm") else self.session_dir

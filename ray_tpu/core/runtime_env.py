@@ -101,7 +101,7 @@ def pip_env_key(spec) -> str:
 def env_cache_dir() -> str:
     return os.environ.get(
         "RAY_TPU_ENV_CACHE",
-        os.path.join(tempfile.gettempdir(), "ray_tpu", "pip_envs"))
+        os.path.join(tempfile.gettempdir(), "ray_tpu_sessions", "pip_envs"))
 
 
 def ensure_pip_env(pip: list[str], timeout: float = 600.0) -> str:
