@@ -39,8 +39,23 @@ def _write_cluster_files(address: str, pids: list[int]):
     os.makedirs(_STATE_DIR, exist_ok=True)
     with open(_ADDR_FILE, "w") as f:
         f.write(address)
+    # MERGE with still-alive recorded pids rather than clobbering: a
+    # concurrently-started (or killed-mid-boot) head/agent on this machine
+    # must stay visible to `ray_tpu stop`, or it becomes an orphan.
+    try:
+        with open(_PID_FILE) as f:
+            prev = json.loads(f.read())
+    except (FileNotFoundError, ValueError):
+        prev = []
+    alive = []
+    for pid in prev:
+        try:
+            os.kill(pid, 0)
+            alive.append(pid)
+        except OSError:
+            pass
     with open(_PID_FILE, "w") as f:
-        f.write(json.dumps(pids))
+        f.write(json.dumps(sorted(set(alive) | set(pids))))
 
 
 def _resolve_address(args) -> str:
@@ -95,6 +110,18 @@ def _cmd_start(args):
         if getattr(args, "persistence_path", ""):
             os.environ["RAY_TPU_HEAD_PERSISTENCE_PATH"] = \
                 args.persistence_path
+        # Record our pid BEFORE the (slow) runtime boot: a `stop` must be
+        # able to find this daemon even if the launching `start` process
+        # was killed mid-startup — the r4 bench starved behind exactly
+        # such an orphan (spawned, never published, never recorded).
+        os.makedirs(_STATE_DIR, exist_ok=True)
+        try:
+            with open(_PID_FILE) as f:
+                _pids = json.loads(f.read())
+        except (FileNotFoundError, ValueError):
+            _pids = []
+        with open(_PID_FILE, "w") as f:
+            f.write(json.dumps(_pids + [os.getpid()]))
         rt = ray_tpu.init(num_cpus=args.num_cpus, num_tpus=args.num_tpus,
                           object_store_memory=args.object_store_memory
                           or None)

@@ -185,9 +185,20 @@ class ActorMethod:
             # a worker on an agent node ships the call straight to the
             # actor's agent, skipping the head relay entirely. The agent
             # falls back to the head on stale locations / dead peers.
-            direct_capable = (getattr(rt, "on_agent_node", False)
-                              and get_config().direct_actor_calls)
-            if direct_capable:
+            cfg = get_config()
+            on_agent = getattr(rt, "on_agent_node", False)
+            direct_capable = on_agent and cfg.direct_actor_calls
+            # Head-node workers have their own direct transport: the
+            # worker<->worker UDS peer plane (worker.py _WorkerPeer) —
+            # same two-racing-transports shape as the agent plane, so the
+            # same seq stamping + executor-side order gate applies.
+            # hasattr guard: client-mode drivers (util/client.py) share
+            # this code path but have no peer plane — resolving locations
+            # there would aim agent-plane frames at the head.
+            worker_capable = (not on_agent and cfg.direct_actor_calls
+                              and cfg.worker_direct_calls
+                              and hasattr(rt, "send_direct_worker"))
+            if direct_capable or worker_capable:
                 # This caller may interleave direct and head-path calls to
                 # the same actor (ref-arg/streaming calls must ride the
                 # head). The two transports race, so every call carries a
@@ -202,28 +213,35 @@ class ActorMethod:
                 spec.caller_seq = rt.next_actor_call_seq(
                     self._handle._actor_id)
             loc = None
-            if not streaming and not refs and direct_capable:
+            if not streaming and not refs and (direct_capable
+                                               or worker_capable):
                 # Ref args need the head's dependency gating/pinning: a
                 # direct delivery would block the actor in arg resolution
                 # (head-of-line) and skip the owner's borrow pin.
                 loc = rt.resolve_actor_location(self._handle._actor_id)
-            if loc is not None:
+            if loc is not None and loc[0] == "uds":
+                # Worker peer plane: ship straight to the hosting
+                # worker's unix socket — 2 frame hops instead of 4, the
+                # head entirely out of the data path.
+                spec.retries_left = 1 if (len(loc) > 2 and loc[2]) else 0
+                if not rt.send_direct_worker(loc[1], spec):
+                    # Stale path / dead worker: drop the cached location
+                    # and take the thin head dispatch.
+                    rt.actor_locations.pop(self._handle._actor_id, None)
+                    rt.send(("direct_actor_head", spec))
+            elif loc is not None and on_agent:
                 # The resolution carries whether the actor permits task
                 # retries: a direct call whose channel dies mid-flight may
                 # have executed, and only retry-permitted calls replay.
                 spec.retries_left = 1 if (len(loc) > 2 and loc[2]) else 0
                 rt.send(("direct_actor", loc[0], loc[1], spec))
-            elif (not streaming and not refs
-                  and not getattr(rt, "on_agent_node", False)
-                  and get_config().direct_actor_calls):
-                # Head-node worker: its socket terminates at the head, so
-                # there is no agent to route through — but the head can
-                # still take the THIN dispatch (straight to
-                # _send_actor_task, skipping journal/SUBMITTED-event/
-                # rid_to_spec/dep-pin bookkeeping a dep-free actor call
-                # doesn't need). Ordering needs no sequence numbers here:
-                # every call from this caller rides ONE socket and the
-                # head's listener handles frames in arrival order.
+            elif (not streaming and not refs and not on_agent
+                  and cfg.direct_actor_calls):
+                # Head-node worker, no direct location (head-hosted /
+                # unstable actor or plane disabled): the head still takes
+                # the THIN dispatch (straight to _send_actor_task,
+                # skipping journal/SUBMITTED-event/rid_to_spec/dep-pin
+                # bookkeeping a dep-free actor call doesn't need).
                 rt.send(("direct_actor_head", spec))
             else:
                 rt.send(("submit", spec))

@@ -58,6 +58,9 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "direct_actor_calls": (bool, True, "worker->actor calls between agent "
                            "nodes ride direct agent<->agent channels, "
                            "bypassing the head relay"),
+    "worker_direct_calls": (bool, True, "head-node worker->worker actor "
+                            "calls ride a direct unix-socket peer plane "
+                            "(2 hops instead of 4), bypassing the head"),
     "health_check_failure_threshold": (int, 5, "missed checks before a node is dead"),
     "gcs_port": (int, 0, "GCS TCP port; 0 = pick free port"),
     # --- head fault tolerance (parity: redis_store_client.h:111 +

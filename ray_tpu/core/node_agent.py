@@ -32,6 +32,7 @@ from ray_tpu.core import objxfer
 from ray_tpu.core.config import Config, set_config
 from ray_tpu.core.ids import ObjectID, WorkerID
 from ray_tpu.core.object_store import SharedMemoryStore, default_store_size
+from ray_tpu.core.order_gate import OrderGate
 from ray_tpu.core.runtime import (
     _Zygote,
     _reap_stale_stores,
@@ -147,11 +148,8 @@ class NodeAgent:
         # Per-(caller worker, actor) in-order delivery gate: direct-path
         # frames (peer channel) and head-relayed frames race, so execs
         # carrying spec.caller_seq are buffered here until their turn
-        # (parity: actor_task_submitter.h:78 sequence enforcement).
-        # key -> [next_seq, buf, out deque, draining, last_used, seen_any]
-        self._order: dict[tuple, list] = {}
-        self._order_lock = threading.Lock()
-        self._order_buffered = 0  # frames parked waiting for a gap
+        # (shared with head-node workers — core/order_gate.py).
+        self._order_gate = OrderGate()
         self._agent_req_lock = threading.Lock()
         self._agent_req_seq = 0
         self._agent_req_futs: dict[int, "object"] = {}
@@ -226,7 +224,7 @@ class NodeAgent:
         wid = w.worker_id.binary()
         self.worker_actor.pop(wid, None)
         self.worker_env_key.pop(wid, None)
-        self._drop_ordered_for_worker(wid)
+        self._order_gate.drop_for_target(wid)
         # Direct calls delivered to the dead worker must fail back to their
         # origin — the head never saw them, so no one else can.
         for task_id, route in list(self._routed.items()):
@@ -343,8 +341,7 @@ class NodeAgent:
         while not self._shutdown:
             time.sleep(period)
             self._send_head(("heartbeat", self.node_id))
-            if self._order:
-                self._sweep_order_keys()
+            self._order_gate.sweep()
 
     def _to_worker(self, wid: bytes, inner):
         w = self.workers.get(wid)
@@ -483,176 +480,16 @@ class NodeAgent:
         self._peer_send(conn, origin_wid, target_wid, spec)
 
     # ------------- per-caller actor-call ordering (executor side) -------------
-
-    _ORDER_GAP_TIMEOUT = 5.0   # s to wait for a missing mid-stream seq
-    # A brand-new key can't tell "actor migrated here mid-stream" (lowest
-    # in-flight seq is the caller's live counter, adopt it) from "the
-    # caller's first-ever calls raced and the head relay is behind" (seq 0
-    # is coming, wait for it). 2s covers any realistic head-relay lag so
-    # first-call inversion needs a pathologically stalled head, while a
-    # post-migration resync costs at most one 2s hiccup.
-    _ORDER_FRESH_TIMEOUT = 2.0
-    _ORDER_KEY_TTL = 600.0      # s of inactivity before a key is swept
+    # The gate itself lives in core/order_gate.py (shared with head-node
+    # pooled workers, which face the same two-transport race on the
+    # worker<->worker peer plane).
 
     def _exec_in_order(self, spec, target_wid: bytes, deliver, on_drop=None):
-        """Deliver an actor exec in per-(caller, actor) submission order.
-
-        `deliver()` performs the actual send + route bookkeeping; `on_drop()`
-        fails the call back to its origin if the target worker dies while the
-        frame is buffered (None = the head replays it itself). A sequence gap
-        that never fills — a call failed before reaching this node — resyncs
-        after a timeout so one lost call can't wedge the actor; a brand-new
-        key (actor just placed/restarted here) adopts the lowest arriving
-        seq after a much shorter window, since the caller's counter survives
-        actor migrations.
-
-        Release order is protected by a per-key drain: the thread that frees
-        entries appends them to the key's out-queue and only one thread
-        drains it at a time, so a concurrent arrival can never overtake a
-        released-but-not-yet-sent earlier frame.
-        """
-        seq = getattr(spec, "caller_seq", None)
-        if seq is None or spec.owner is None or spec.actor_id is None:
-            deliver()
-            return
-        key = (spec.owner, spec.actor_id)
-        now = time.monotonic()
-        with self._order_lock:
-            st = self._order_key_locked(key, now)
-            if seq > st[0]:
-                timeout = (self._ORDER_GAP_TIMEOUT if st[5]
-                           else self._ORDER_FRESH_TIMEOUT)
-                if seq not in st[1]:  # dup = head-path retry of a buffered
-                    self._order_buffered += 1  # frame; keep one count
-                st[1][seq] = (deliver, on_drop, target_wid, now + timeout)
-                self._advance_order_locked(st)  # skips may gate the way
-            else:
-                st[2].append(deliver)
-                st[5] = True
-                if seq == st[0]:
-                    st[0] += 1
-                    self._advance_order_locked(st)
-                # seq < st[0]: a slot consumed earlier — a head-path retry
-                # after a fallback, or a dep-gated call the head skip-
-                # released (it orders at dep-resolution time) — deliver in
-                # queue order.
-        self._drain_order_key(st)
-
-    def _order_key_locked(self, key, now):
-        st = self._order.get(key)
-        if st is None:
-            # [next_seq, buf {seq: (deliver, on_drop, wid, deadline)},
-            #  out deque, draining flag, last_used, delivered_any,
-            #  skip-released slots]
-            st = self._order[key] = [0, {}, collections.deque(),
-                                    False, now, False, set()]
-        st[4] = now
-        return st
-
-    def _advance_order_locked(self, st):
-        """Release every consecutive buffered or skip-released slot from
-        st[0]; on progress, extend the remaining buffered deadlines — a
-        slow-but-advancing head relay is not a gap."""
-        progressed = False
-        while True:
-            if st[0] in st[1]:
-                d, _f, _w, _dl = st[1].pop(st[0])
-                self._order_buffered -= 1
-                st[2].append(d)
-                st[0] += 1
-                progressed = True
-            elif st[0] in st[6]:
-                st[6].discard(st[0])
-                st[0] += 1
-                progressed = True
-            else:
-                break
-        if progressed:
-            st[5] = True
-            if st[1]:
-                ddl = time.monotonic() + self._ORDER_GAP_TIMEOUT
-                for s, e in list(st[1].items()):
-                    st[1][s] = (e[0], e[1], e[2], ddl)
+        self._order_gate.submit(spec, deliver, on_drop=on_drop,
+                                target=target_wid)
 
     def _skip_order_slot(self, owner: bytes, actor_id: bytes, seq: int):
-        """Head notice: slot `seq` parked on pending deps at the head and
-        will arrive later (delivered at dep-resolution time, reference
-        semantics); release its successors now."""
-        with self._order_lock:
-            st = self._order_key_locked((owner, actor_id), time.monotonic())
-            if seq < st[0]:
-                return
-            st[6].add(seq)
-            if len(st[6]) > 4096:  # lost-call hygiene: skips are tiny ints
-                st[6] = {s for s in st[6] if s >= st[0]}
-            self._advance_order_locked(st)
-        self._drain_order_key(st)
-
-    def _drain_order_key(self, st):
-        """Single-drainer: deliver the key's released frames in order."""
-        with self._order_lock:
-            if st[3] or not st[2]:
-                return
-            st[3] = True
-        while True:
-            with self._order_lock:
-                if not st[2]:
-                    st[3] = False
-                    return
-                d = st[2].popleft()
-            try:
-                d()
-            except Exception:  # noqa: BLE001
-                traceback.print_exc()
-
-    def _flush_expired_order_gaps(self):
-        """A buffered seq waited past its deadline: the missing call died
-        en route (e.g. failed at the head) or predates this key (actor
-        migrated here mid-stream). Resync to the lowest buffered seq."""
-        now = time.monotonic()
-        drain = []
-        with self._order_lock:
-            for st in self._order.values():
-                buf = st[1]
-                if not buf or min(e[3] for e in buf.values()) > now:
-                    continue
-                st[0] = min(buf)
-                st[6] = {s for s in st[6] if s > st[0]}
-                self._advance_order_locked(st)
-                drain.append(st)
-        for st in drain:
-            self._drain_order_key(st)
-
-    def _drop_ordered_for_worker(self, wid: bytes):
-        """Target worker died: flush its buffered execs to their drop
-        handlers (direct calls fall back through the head; head-path calls
-        are simply dropped — the head replays them on worker_death). Keys
-        survive the death: a restart on this node continues the caller's
-        counter seamlessly; elsewhere, the new node's fresh key adopts the
-        live counter after _ORDER_FRESH_TIMEOUT."""
-        dropped = []
-        with self._order_lock:
-            for key, st in list(self._order.items()):
-                for seq, entry in list(st[1].items()):
-                    if entry[2] == wid:
-                        del st[1][seq]
-                        self._order_buffered -= 1
-                        dropped.append(entry[1])
-        for on_drop in dropped:
-            if on_drop is not None:
-                try:
-                    on_drop()
-                except Exception:  # noqa: BLE001
-                    traceback.print_exc()
-
-    def _sweep_order_keys(self):
-        """Heartbeat-paced TTL sweep of idle ordering keys (callers and
-        actors come and go; the gate must not grow without bound)."""
-        cutoff = time.monotonic() - self._ORDER_KEY_TTL
-        with self._order_lock:
-            for key, st in list(self._order.items()):
-                if st[4] < cutoff and not st[1] and not st[2]:
-                    del self._order[key]
+        self._order_gate.skip(owner, actor_id, seq)
 
     def _peer_send(self, conn: "_PeerConn", origin_wid, target_wid, spec):
         conn.inflight[spec.task_id] = (origin_wid, spec)
@@ -839,8 +676,8 @@ class NodeAgent:
                     events = self._selector.select(timeout=0.05)
                 except OSError:
                     continue
-            if self._order_buffered:
-                self._flush_expired_order_gaps()
+            if self._order_gate.buffered:
+                self._order_gate.flush_expired()
             for key, _mask in events:
                 kind, w = key.data
                 try:

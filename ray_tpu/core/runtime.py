@@ -203,6 +203,9 @@ class WorkerHandle:
         # Per-env worker pools (parity: worker_pool.h:228): None = default
         # pool; otherwise the pip env key the worker booted with.
         self.env_key: str | None = None
+        # Worker peer plane: UDS path where this (head-node) worker
+        # accepts direct actor calls from sibling workers.
+        self.peer_path: str | None = None
         self.buffer = FrameBuffer()
 
     @property
@@ -1349,6 +1352,8 @@ class Runtime:
             if len(msg) > 3 and msg[3]:
                 w.env_key = msg[3]  # env-pool worker (remote agents spawn
                 # them; the key rides the ready frame)
+            if len(msg) > 4 and msg[4]:
+                w.peer_path = msg[4]  # worker peer-plane UDS listener
             with self.lock:
                 if w.state == DEAD:
                     return
@@ -1409,6 +1414,24 @@ class Runtime:
         elif op == "submit":
             spec: TaskSpec = msg[1]
             self.submit_task(spec, fn_blob=None)
+        elif op == "direct_actor":
+            # Agent-plane routing frame that landed on the head (a client
+            # or misrouted caller): degrade to a normal submission rather
+            # than killing the connection's listener pass.
+            self.submit_task(msg[3])
+        elif op == "direct_fail":
+            # A worker-plane direct call's channel died after the exec
+            # frame was sent and the actor permits no retries: the only
+            # safe outcome is failing the returns (replaying could
+            # double-execute). Parity: the at-most-once arm of the
+            # reference's actor-death handling.
+            spec = msg[1]
+            st = self.actors.get(spec.actor_id)
+            cause = getattr(st, "death_cause", None) if st else None
+            self._fail_returns(
+                spec, cause if isinstance(cause, Exception)
+                else ActorDiedError(
+                    msg="actor's worker died with the call in flight"))
         elif op == "direct_actor_head":
             # Thin actor dispatch from a head-node worker (the agent-node
             # direct path's counterpart; see actor.py). Dep-free by
@@ -1602,11 +1625,30 @@ class Runtime:
             # actors and unstable states go through the head path).
             st = self.actors.get(arg)
             resp = None
+            requester_on_head = w.node_id == self.head_node_id
             if (st is not None and st.state == A_ALIVE
-                    and st.worker is not None and st.worker.state != DEAD
-                    and st.worker.node_id != self.head_node_id):
-                resp = (st.worker.node_id, st.worker.worker_id.binary(),
-                        bool(st.cspec.max_task_retries))
+                    and st.worker is not None and st.worker.state != DEAD):
+                if (st.worker.node_id != self.head_node_id
+                        and not requester_on_head):
+                    # Agent-plane location — only meaningful to a caller
+                    # that has an agent to route through; a head-node
+                    # worker must keep the thin head dispatch instead.
+                    resp = (st.worker.node_id,
+                            st.worker.worker_id.binary(),
+                            bool(st.cspec.max_task_retries))
+                elif (st.worker.node_id == self.head_node_id
+                      and getattr(st.worker, "peer_path", None)
+                      and w.kind == "worker"
+                      and not getattr(w, "is_client", False)
+                      and requester_on_head
+                      and self.config.worker_direct_calls):
+                    # Worker peer plane: the requester shares this
+                    # machine with the hosting worker — hand it the UDS
+                    # so calls skip the head relay entirely (the role of
+                    # the reference's direct worker-to-worker gRPC,
+                    # actor_task_submitter.h:78).
+                    resp = ("uds", st.worker.peer_path,
+                            bool(st.cspec.max_task_retries))
         elif what == "my_peer_addr":
             # The requester's node object-plane endpoint: p2p host
             # collectives rendezvous through this once per group, then
@@ -2704,13 +2746,38 @@ class Runtime:
             # resolution time (dependency_resolver.h), not submit time.
             self._send_seq_skip(spec)
 
+    def _broadcast_actor_moved(self, actor_id: bytes):
+        """Poison cached direct-call locations for a dying/moving actor
+        on every head-node pooled worker (agents do the same for their
+        own workers; the caller-side UDS EOF is the belt, this the
+        braces)."""
+        with self.lock:
+            targets = [w for w in self.workers.values()
+                       if w.node_id == self.head_node_id
+                       and not getattr(w, "is_client", False)]
+        for w in targets:
+            try:
+                w.send(("actor_moved", actor_id))
+            except OSError:
+                pass
+
     def _send_seq_skip(self, spec: TaskSpec):
         st = self.actors.get(spec.actor_id)
-        node = self.nodes.get(st.node_id) if st is not None else None
+        if st is None:
+            return
+        skip = ("seq_skip", spec.owner, spec.actor_id, spec.caller_seq)
+        if (st.node_id == self.head_node_id and st.worker is not None):
+            # Head-node actor: the gate lives in the hosting worker
+            # (worker peer plane).
+            try:
+                st.worker.send(skip)
+            except OSError:
+                pass  # gap timeout at the worker resyncs
+            return
+        node = self.nodes.get(st.node_id)
         if node is not None and node.conn is not None:
             try:
-                node.conn.send(("seq_skip", spec.owner, spec.actor_id,
-                                spec.caller_seq))
+                node.conn.send(skip)
             except OSError:
                 pass  # gap timeout at the agent resyncs
 
@@ -4278,6 +4345,11 @@ class Runtime:
                 return
             w.state = DEAD
             self.workers.pop(w.worker_id.binary(), None)
+            if getattr(w, "peer_path", None):
+                try:
+                    os.unlink(w.peer_path)
+                except OSError:
+                    pass
             wid_bin = w.worker_id.binary()
             for subs in self._pubsub_subs.values():
                 subs.discard(wid_bin)
@@ -4366,6 +4438,11 @@ class Runtime:
         st = self.actors.get(actor_id)
         if st is None or st.state == A_DEAD:
             return
+        # Only head-hosted actors can have worker-plane location caches
+        # (agents invalidate their own workers' caches themselves).
+        if (st.node_id == self.head_node_id
+                and self.config.worker_direct_calls):
+            self._broadcast_actor_moved(actor_id)
         cspec = st.cspec
         inflight = list(st.inflight.values())
         st.inflight.clear()

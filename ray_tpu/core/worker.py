@@ -19,6 +19,7 @@ import concurrent.futures
 import contextlib
 import inspect
 import os
+import socket
 import sys
 import threading
 import time
@@ -85,11 +86,12 @@ class _WorkerRefCounter:
     here; the overwhelmingly common temporary — put, use locally, drop —
     frees eagerly instead of leaking into the shared arena until eviction."""
 
-    def __init__(self, free_fn):
+    def __init__(self, free_fn, escape_fn=None):
         self._owned: dict[bytes, int] = {}
         self._escaped: set[bytes] = set()
         self._lock = threading.Lock()
         self._free_fn = free_fn
+        self._escape_fn = escape_fn  # first escape of an owned key
 
     def register_owned(self, object_id):
         """Call BEFORE constructing the first (strong) ObjectRef: the ref's
@@ -122,9 +124,70 @@ class _WorkerRefCounter:
 
     def mark_escaped(self, object_id):
         key = object_id.binary()
+        fire = False
         with self._lock:
-            if key in self._owned:
+            if key in self._owned and key not in self._escaped:
                 self._escaped.add(key)
+                fire = self._escape_fn is not None
+        if fire:
+            try:
+                self._escape_fn(key)
+            except Exception:  # noqa: BLE001 — escape hook is safety net
+                pass
+
+    def is_owned(self, key: bytes) -> bool:
+        with self._lock:
+            return key in self._owned
+
+
+class _WorkerPeer:
+    """One worker<->worker unix-socket channel of the head-node peer
+    plane (parity role: the reference's direct worker-to-worker gRPC
+    actor transport, actor_task_submitter.h:78 — here between pooled
+    workers of the head node, where there is no agent to route through).
+
+    The initiating side sends ("wexec", spec) frames and receives
+    ("wdone", ...) replies; the accepting side is the executor. Failures
+    signal as channel EOF (calls fall back through the head). Frames on
+    one channel are FIFO, which carries per-caller call order."""
+
+    def __init__(self, rt: "WorkerRuntime", sock, initiated: bool):
+        self.rt = rt
+        self.sock = sock
+        self.send_lock = threading.Lock()
+        self.alive = True
+        self.initiated = initiated
+        self.path: str | None = None       # dial target (initiator only)
+        self.inflight: dict[bytes, TaskSpec] = {}  # initiator bookkeeping
+
+    def send(self, msg):
+        send_msg(self.sock, msg, self.send_lock)
+
+    def start(self):
+        threading.Thread(target=self._read_loop, daemon=True,
+                         name="rtpu-wpeer").start()
+
+    def _read_loop(self):
+        fb = FrameBuffer()
+        while True:
+            try:
+                data = self.sock.recv(1 << 20)
+            except OSError:
+                data = b""
+            if not data:
+                self.alive = False
+                try:
+                    self.sock.close()
+                except OSError:
+                    pass
+                self.rt._on_wpeer_eof(self)
+                return
+            fb.feed(data)
+            for msg in fb.frames():
+                try:
+                    self.rt._on_wpeer_frame(self, msg)
+                except Exception:  # noqa: BLE001 — keep the channel alive
+                    traceback.print_exc()
 
 
 class WorkerRuntime:
@@ -173,7 +236,31 @@ class WorkerRuntime:
         self.shutdown = threading.Event()
         self.current_task = None
         self.refcount = _WorkerRefCounter(
-            lambda key: self.send(("free_put", key)))
+            self._on_owned_free, escape_fn=self._on_owned_escape)
+        # ---- worker<->worker peer plane (head-node pooled workers) ----
+        # Direct actor calls between workers of the head node ride unix
+        # sockets: 2 frame hops instead of 4 (caller->head->executor->
+        # head->caller), with the head entirely out of the data path.
+        # The agent plane's counterpart is node_agent._PeerConn.
+        self._peer_path: str | None = None   # our UDS listener (executor)
+        self._peer_srv: socket.socket | None = None
+        self._peer_conns: dict[str, "_WorkerPeer"] = {}  # path -> conn
+        self._peer_lock = threading.Lock()
+        # Executor side: task_id -> _WorkerPeer the exec arrived on.
+        self.direct_routes: dict[bytes, "_WorkerPeer"] = {}
+        # Caller side: inline results of direct calls, pinned while the
+        # ref lives (the 4096-LRU object_cache would silently evict them
+        # and a re-fetch from the head — which never saw the call — would
+        # hang). rid -> value.
+        self._direct_values: dict[bytes, object] = {}
+        # rid -> bool(escaped before arrival): set at submit, consumed at
+        # wdone/wfail.
+        self._direct_pending: dict[bytes, bool] = {}
+        self._direct_lock = threading.Lock()
+        # Executor-side per-(caller, actor) submission-order gate: peer
+        # frames race head-relayed frames exactly like the agent plane.
+        from ray_tpu.core.order_gate import OrderGate
+        self.order_gate = OrderGate()
         # Actor location cache for the direct agent<->agent call path
         # (parity: the resolved actor address inside
         # actor_task_submitter.h:78); poisoned by "actor_moved" pushes.
@@ -246,6 +333,9 @@ class WorkerRuntime:
         cached = self.object_cache.get(oid, _MISS)
         if cached is not _MISS:
             return self._raise_if_error(cached)
+        if oid in self._direct_values:  # pinned direct-call result that
+            return self._raise_if_error(  # fell out of the LRU cache
+                self._direct_values[oid])
         found, value = self.store.get_deserialized(ref.id, timeout=0)
         if found:
             return value
@@ -260,6 +350,8 @@ class WorkerRuntime:
         cached = self.object_cache.get(oid, _MISS)
         if cached is not _MISS:
             return self._raise_if_error(cached)
+        if oid in self._direct_values:
+            return self._raise_if_error(self._direct_values[oid])
         found, value = self.store.get_deserialized(ref.id, timeout=5.0)
         if found:
             return value
@@ -283,7 +375,8 @@ class WorkerRuntime:
 
         def is_ready(r) -> bool:
             oid = r.id.binary()
-            if oid in self.object_cache or self.store.contains(r.id):
+            if (oid in self.object_cache or oid in self._direct_values
+                    or self.store.contains(r.id)):
                 return True
             ev = subscribed.get(oid)
             if ev is not None and ev.is_set():
@@ -422,6 +515,188 @@ class WorkerRuntime:
         self.actor_locations[actor_id] = (tuple(loc) if loc is not None
                                           else self._HEAD_HOSTED)
         return tuple(loc) if loc is not None else None
+
+    # -- worker<->worker peer plane (head-node direct actor calls) --
+
+    def start_peer_listener(self) -> str | None:
+        """Bind this worker's UDS exec listener (executor half of the
+        peer plane). The path rides the "ready" frame so the head can
+        hand it to callers resolving this worker's actor."""
+        if not get_config().worker_direct_calls:
+            return None
+        path = f"{self.store_path}_w{self.worker_id.hex()[:12]}.sock"
+        try:
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+            srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            srv.bind(path)
+            srv.listen(64)
+        except OSError:
+            return None
+        self._peer_srv = srv
+        self._peer_path = path
+
+        def accept_loop():
+            while not self.shutdown.is_set():
+                try:
+                    s, _ = srv.accept()
+                except OSError:
+                    return
+                _WorkerPeer(self, s, initiated=False).start()
+
+        threading.Thread(target=accept_loop, daemon=True,
+                         name="rtpu-wpeer-accept").start()
+        return path
+
+    def send_direct_worker(self, path: str, spec) -> bool:
+        """Ship an actor call straight to the hosting worker's UDS.
+        False = couldn't (caller falls back to the head path)."""
+        try:
+            with self._peer_lock:
+                conn = self._peer_conns.get(path)
+            if conn is None or not conn.alive:
+                s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                s.connect(path)
+                fresh = _WorkerPeer(self, s, initiated=True)
+                fresh.path = path
+                with self._peer_lock:
+                    live = self._peer_conns.get(path)
+                    if live is not None and live.alive:
+                        try:
+                            s.close()
+                        except OSError:
+                            pass
+                        conn = live
+                    else:
+                        self._peer_conns[path] = fresh
+                        conn = fresh
+                if conn is fresh:
+                    conn.start()
+        except OSError:
+            return False
+        # The caller owns a direct call's results (the head never sees
+        # the call, so nobody else can): register BEFORE the ObjectRefs
+        # are constructed so their local refcounts take.
+        with self._direct_lock:
+            for rid in spec.return_ids:
+                self.refcount.register_owned(ObjectID(rid))
+                self._direct_pending[rid] = False
+        conn.inflight[spec.task_id] = spec
+        try:
+            conn.send(("wexec", spec))
+        except OSError:
+            conn.inflight.pop(spec.task_id, None)
+            with self._direct_lock:
+                for rid in spec.return_ids:
+                    self._direct_pending.pop(rid, None)
+            return False
+        return True
+
+    def _on_wpeer_frame(self, conn: "_WorkerPeer", msg):
+        op = msg[0]
+        if op == "wexec":
+            spec: TaskSpec = msg[1]
+            self.direct_routes[spec.task_id] = conn
+            self.order_gate.submit(
+                spec, lambda s=spec: self.task_queue.put(s))
+        elif op == "wdone":
+            for task_id, outs in msg[1]:
+                conn.inflight.pop(task_id, None)
+                self._apply_direct_done(outs)
+
+    def _on_wpeer_eof(self, conn: "_WorkerPeer"):
+        if conn.initiated:
+            with self._peer_lock:
+                if self._peer_conns.get(conn.path) is conn:
+                    self._peer_conns.pop(conn.path, None)
+            # Poison location-cache entries that point at the dead path.
+            for aid, loc in list(self.actor_locations.items()):
+                if (isinstance(loc, tuple) and len(loc) > 1
+                        and loc[0] == "uds" and loc[1] == conn.path):
+                    self.actor_locations.pop(aid, None)
+            # In-flight calls MAY have executed (the frame was sent):
+            # only retry-permitted calls replay, the rest fail cleanly.
+            for task_id, spec in list(conn.inflight.items()):
+                conn.inflight.pop(task_id, None)
+                self._direct_fallback(spec, maybe_executed=True)
+        else:
+            # The calling worker died: its results are moot — drop the
+            # routes so replies fall through to the discard path.
+            for task_id, c in list(self.direct_routes.items()):
+                if c is conn:
+                    self.direct_routes.pop(task_id, None)
+
+    def _apply_direct_done(self, outs):
+        """Caller side of a wdone: resolve futures like head obj pushes.
+        Inline values are pinned while their ref lives (see
+        _direct_values); escaped-while-pending refs materialize now."""
+        for rid, status, payload, bufs in outs:
+            if status in ("inline", "err"):
+                value = serialization.deserialize(payload, bufs)
+                self.object_cache[rid] = value
+                escaped = None
+                with self._direct_lock:
+                    escaped = self._direct_pending.pop(rid, None)
+                    if escaped is not None and (
+                            escaped or self.refcount.is_owned(rid)):
+                        self._direct_values[rid] = value
+                if escaped:
+                    self._materialize_direct(rid, value)
+            else:  # shm: already in the shared arena + head notified
+                with self._direct_lock:
+                    self._direct_pending.pop(rid, None)
+            with self._wait_lock:
+                for ev in self._pending_waits.pop(rid, []):
+                    ev.set()
+
+    def _direct_fallback(self, spec, maybe_executed: bool):
+        """A direct call's channel failed. Retry-permitted calls replay
+        through the head (which parks/fails them against the actor's
+        fate); a possibly-executed non-retryable call must only have its
+        returns failed — replaying could double-execute."""
+        with self._direct_lock:
+            for rid in spec.return_ids:
+                self._direct_pending.pop(rid, None)
+        retryable = getattr(spec, "retries_left", 0) > 0
+        try:
+            if maybe_executed and not retryable:
+                self.send(("direct_fail", spec))
+            else:
+                self.send(("direct_actor_head", spec))
+        except OSError:
+            pass
+
+    def _materialize_direct(self, rid: bytes, value):
+        """An owned direct-call result escaped this process: store it
+        under its exact id and tell the head, so borrowers anywhere can
+        resolve it (mirrors put() visibility)."""
+        nbytes = int(getattr(value, "nbytes", 0) or (1 << 20))
+        try:
+            _put_with_spill(self, ObjectID(rid), value, nbytes)
+            self.send(("put_notify", rid))
+        except Exception:  # noqa: BLE001 — borrower get() will surface it
+            traceback.print_exc()
+
+    def _on_owned_free(self, key: bytes):
+        with self._direct_lock:
+            self._direct_values.pop(key, None)
+            self._direct_pending.pop(key, None)
+        self.send(("free_put", key))
+
+    def _on_owned_escape(self, key: bytes):
+        with self._direct_lock:
+            if key in self._direct_values:
+                value = self._direct_values[key]
+            elif key in self._direct_pending:
+                # Escaped before the result arrived: flag so
+                # _apply_direct_done materializes on arrival.
+                self._direct_pending[key] = True
+                return
+            else:
+                return  # a plain put() escaping; head already knows it
+        self._materialize_direct(key, value)
 
     # -- streaming (ObjectRefGenerator consumed from a worker) --
 
@@ -710,6 +985,26 @@ def _reply_result(rt: WorkerRuntime, spec: TaskSpec, status, result,
             else:
                 _put_with_spill(rt, ObjectID(rid), value, nbytes)
                 outs.append((rid, "shm", None, None))
+    route = (rt.direct_routes.pop(spec.task_id, None)
+             if rt.direct_routes else None)
+    if route is not None:
+        # Direct-call reply: straight back on the caller's channel — the
+        # head never saw this task. Big results went into the SHARED
+        # head-node arena; notify the head of the location so borrowers
+        # beyond the caller can still resolve them.
+        for entry in outs:
+            if entry[1] == "shm":
+                rt.send(("put_notify", entry[0]))
+        if route.alive:
+            try:
+                route.send(("wdone", [(spec.task_id, outs)]))
+                return
+            except OSError:
+                pass
+        # Channel broke under the reply (the caller may well be alive —
+        # only its conn died): fall through to a plain head "done". The
+        # head banks the outs in its directory and the caller's wait_obj
+        # resolves them, so a reply is never silently lost.
     if batcher is not None:
         batcher.add(spec.task_id, spec.actor_id, outs)
         return
@@ -882,6 +1177,21 @@ def main():
     _worker_main(sys.argv[1], WorkerID.from_hex(sys.argv[2]), int(sys.argv[3]))
 
 
+def _die_with_parent():
+    """PR_SET_PDEATHSIG: the kernel SIGKILLs this process when its parent
+    dies. Belt-and-braces over the socket-EOF exit path — a SIGKILLed
+    head/agent/zygote must never leave orphaned workers stealing the box
+    (r4's bench starved behind exactly such a leak)."""
+    if sys.platform != "linux":
+        return
+    try:
+        import ctypes
+        libc = ctypes.CDLL("libc.so.6", use_errno=True)
+        libc.prctl(1, 9, 0, 0, 0)  # PR_SET_PDEATHSIG, SIGKILL
+    except Exception:  # noqa: BLE001 — hardening only
+        pass
+
+
 def zygote_main(store_path: str, ctrl_fd: int):
     """Forkserver: pays the interpreter+jax import cost once, then forks a
     ready-to-run worker in milliseconds per head request.
@@ -898,6 +1208,7 @@ def zygote_main(store_path: str, ctrl_fd: int):
     import socket as socket_mod
     import struct
 
+    _die_with_parent()
     try:  # usually already loaded via sitecustomize; make the warmup explicit
         import jax  # noqa: F401
         _honor_platform_env(jax)
@@ -984,6 +1295,7 @@ def _honor_platform_env(jax_mod):
 
 
 def _worker_main(store_path: str, worker_id: WorkerID, fd: int):
+    _die_with_parent()
     set_config(Config.from_env())
     if get_config().gc_gen0_threshold > 0:
         # Same rationale as the head runtime: don't run a gc pass (plus
@@ -1014,8 +1326,30 @@ def _worker_main(store_path: str, worker_id: WorkerID, fd: int):
     from ray_tpu.core import runtime as runtime_mod
     runtime_mod.set_worker_runtime(rt)
 
+    # Head-node pooled workers additionally listen for direct peer calls;
+    # the path rides the ready frame so the head can hand it to callers.
+    peer_path = (rt.start_peer_listener()
+                 if os.environ.get("RAY_TPU_IS_HEAD_NODE") == "1" else None)
     rt.send(("ready", worker_id.binary(), os.getpid(),
-             os.environ.get("RAY_TPU_ENV_KEY") or None))
+             os.environ.get("RAY_TPU_ENV_KEY") or None, peer_path))
+
+    def _gate_maintenance():
+        # The order gate needs a pump for gap timeouts (the agent's
+        # select loop plays this role on agent nodes).
+        n = 0
+        while not rt.shutdown.is_set():
+            time.sleep(1.0)
+            n += 1
+            if rt.order_gate.buffered:
+                rt.order_gate.flush_expired()
+            if n % 60 == 0:
+                rt.order_gate.sweep()
+
+    if not rt.on_agent_node:
+        # Agent-node workers never feed their gate (the agent's gate
+        # orders their frames) — no pump thread there.
+        threading.Thread(target=_gate_maintenance, daemon=True,
+                         name="rtpu-gate").start()
 
     actor_cfg = {}
     executor_threads: list[threading.Thread] = []
@@ -1047,7 +1381,23 @@ def _worker_main(store_path: str, worker_id: WorkerID, fd: int):
                 pending[0:0] = msg[1]
                 continue
             if op == "exec":
-                rt.task_queue.put(msg[1])
+                spec = msg[1]
+                if (spec.actor_id is not None
+                        and getattr(spec, "caller_seq", None) is not None
+                        and not rt.on_agent_node):
+                    # Head-relayed frames race the worker peer plane for
+                    # the same (caller, actor): restore submission order.
+                    # Head-node workers ONLY — an agent-node worker's
+                    # frames were already ordered by its agent's gate
+                    # (which is where the head sends seq_skips), and
+                    # gating twice would stall every skip-released slot
+                    # until the gap timeout.
+                    rt.order_gate.submit(
+                        spec, lambda s=spec: rt.task_queue.put(s))
+                else:
+                    rt.task_queue.put(spec)
+            elif op == "seq_skip":
+                rt.order_gate.skip(msg[1], msg[2], msg[3])
             elif op == "create_actor":
                 actor_cfg["spec"] = msg[1]
                 rt.task_queue.put(("__create_actor__", msg[1]))

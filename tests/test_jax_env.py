@@ -1,0 +1,119 @@
+"""On-device RL: jax-native envs + the fused PPO training iteration.
+
+Parity: the reference's PPO-Atari benchmark path
+(rllib/algorithms/ppo/ppo.py:388) — here the env itself is jax
+(env/jax_env.py), so rollout+GAE+update compile into one program.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from ray_tpu.rllib.env.jax_env import (JaxAtariClass, JaxBreakout,
+                                       JaxVecEnv, make_jax_env)
+
+def test_breakout_dynamics_match_numpy_statistics():
+    """Random play on the jax env must match the numpy MinAtar core's
+    episode statistics (same dynamics, different RNG streams)."""
+    import gymnasium as gym
+
+    from ray_tpu.rllib.env.minatar import register_builtin_envs
+    register_builtin_envs()
+
+    env = JaxVecEnv(JaxBreakout(), 16)
+    vs = env.reset(jax.random.PRNGKey(0))
+
+    @jax.jit
+    def roll(vs, key, n=200):
+        def f(c, _):
+            vs, key = c
+            key, ak, sk = jax.random.split(key, 3)
+            a = jax.random.randint(ak, (16,), 0, 3)
+            vs, rew, done = env.step(vs, a, sk)
+            return (vs, key), rew
+        (vs, _), rews = jax.lax.scan(f, (vs, key), None, length=n)
+        return vs, rews
+
+    vs, rews = roll(vs, jax.random.PRNGKey(1))
+    n_steps = 200 * 16
+    jax_ep_len = float(vs.done_len_sum / vs.done_count)
+    jax_rew_rate = float(rews.sum()) / n_steps
+
+    e = gym.make("MinAtarBreakout-v0")
+    rng = np.random.default_rng(0)
+    e.reset(seed=0)
+    tot, lens, cur = 0.0, [], 0
+    for _ in range(n_steps):
+        _, r, t, tr, _ = e.step(int(rng.integers(0, 3)))
+        tot += r
+        cur += 1
+        if t or tr:
+            lens.append(cur)
+            cur = 0
+            e.reset()
+    np_ep_len = float(np.mean(lens))
+    np_rew_rate = tot / n_steps
+    # Same dynamics => same order of statistics (loose bands: both are
+    # random-play estimates).
+    assert 0.5 * np_ep_len < jax_ep_len < 2.0 * np_ep_len, (
+        jax_ep_len, np_ep_len)
+    assert abs(jax_rew_rate - np_rew_rate) < 0.05, (
+        jax_rew_rate, np_rew_rate)
+
+
+@pytest.mark.smoke
+def test_atari_class_obs_contract():
+    """The on-device AtariClass twin keeps the deepmind obs contract:
+    [84, 84, 4] float32 in [0, 1], frame-stacked."""
+    env = JaxVecEnv(JaxAtariClass(JaxBreakout()), 3)
+    vs = env.reset(jax.random.PRNGKey(0))
+    obs = env.observe(vs)
+    assert obs.shape == (3, 84, 84, 4)
+    assert float(obs.min()) >= 0.0 and float(obs.max()) <= 1.0
+    vs2, rew, done = env.step(
+        vs, jax.numpy.zeros(3, jax.numpy.int32), jax.random.PRNGKey(1))
+    obs2 = env.observe(vs2)
+    # Frame stack shifted: new last channel, old channels moved left.
+    assert np.allclose(np.asarray(obs[..., 1]), np.asarray(obs2[..., 0]))
+
+
+def test_fused_ppo_learns_on_device():
+    """The single-dispatch train iteration improves the policy: after a
+    few dozen iterations on JaxMinAtarBreakout, mean episode return beats
+    the random-play baseline by a wide margin."""
+    import optax
+
+    from ray_tpu.rllib.core.ondevice import (OnDeviceSamplerGroup,
+                                             build_ppo_train_iter)
+    from ray_tpu.rllib.core.rl_module import (MINATAR_FILTERS,
+                                              CNNActorCriticModule)
+
+    env = make_jax_env("JaxMinAtarBreakout-v0", 16)
+    mod = CNNActorCriticModule((10, 10, 4), 3, filters=MINATAR_FILTERS,
+                               dense=128)
+    params = mod.init(jax.random.PRNGKey(0))
+    tx = optax.chain(optax.clip_by_global_norm(0.5), optax.adam(1e-3))
+    opt_state = tx.init(params)
+    ti = build_ppo_train_iter(env, mod, T=64, num_epochs=2,
+                              minibatch_size=256, gamma=0.99, lam=0.95,
+                              clip=0.2, vf_coef=0.5, ent_coef=0.01, tx=tx)
+    vs = env.reset(jax.random.PRNGKey(1))
+    key = jax.random.PRNGKey(2)
+    grp = OnDeviceSamplerGroup()
+    # Learning takes off around iteration 60-90 with these hparams
+    # (diagnostic run: ret/ep 0.12 -> 0.60 @ 90 -> 1.75 @ 120 -> 3.5 @
+    # 300). Record at 90 so the final window isolates the learned phase.
+    m = None
+    for i in range(120):
+        params, opt_state, vs, key, m = ti(params, opt_state, vs, key)
+        if i == 89:
+            grp.record(float(m["ep_ret_sum"]), float(m["ep_len_sum"]),
+                       float(m["ep_count"]))
+    ret_90 = float(m["ep_ret_sum"])
+    cnt_90 = float(m["ep_count"])
+    grp.record(ret_90, float(m["ep_len_sum"]), cnt_90)
+    final = grp.aggregate_metrics()
+    # Random play scores ~0.12/episode; the recent window of a learning
+    # policy clears several times that.
+    last_window = grp._window[-1][0]
+    assert last_window > 0.4, (final, grp._window)

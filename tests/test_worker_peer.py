@@ -1,0 +1,226 @@
+"""Worker<->worker direct actor calls on the head node (the UDS peer
+plane, worker.py _WorkerPeer).
+
+Parity: the reference's direct worker-to-worker actor transport
+(`src/ray/core_worker/transport/actor_task_submitter.h:78` ordered
+delivery + `dependency_resolver.h` post-resolution ordering) — here
+between pooled workers of the head node, where round 4's only path was a
+4-hop head relay. The agent plane's equivalents live in test_cluster.py;
+these mirror them for the worker plane.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+pytestmark = pytest.mark.usefixtures("fresh")
+
+
+@pytest.fixture
+def fresh():
+    rt = ray_tpu.init(num_cpus=4)
+    yield rt
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote(num_cpus=0)
+class Counter:
+    def __init__(self):
+        self.seen = []
+
+    def add(self, x):
+        self.seen.append(x)
+        return x * 2
+
+    def dump(self):
+        return self.seen
+
+    def big(self, n):
+        import numpy as np
+        return np.ones(n, dtype=np.uint8)
+
+
+@ray_tpu.remote
+def fan_out(handles, n):
+    refs = [h.add.remote(i) for i in range(n) for h in handles]
+    return ray_tpu.get(refs, timeout=60)
+
+
+@pytest.mark.smoke
+def test_worker_to_worker_values_correct():
+    sinks = [Counter.remote() for _ in range(2)]
+    ray_tpu.get([s.dump.remote() for s in sinks], timeout=30)
+    vals = ray_tpu.get(fan_out.remote(sinks, 50), timeout=60)
+    assert vals == [i * 2 for i in range(50) for _ in range(2)]
+
+
+def test_head_bypass_evidence():
+    """The head's task-event buffer must not see the worker's direct
+    calls (same evidence shape as the agent plane's bypass test)."""
+    a = Counter.remote()
+    ray_tpu.get(a.dump.remote(), timeout=30)
+
+    @ray_tpu.remote
+    def caller(h):
+        ray_tpu.get([h.add.remote(i) for i in range(20)], timeout=30)
+        return True
+
+    assert ray_tpu.get(caller.remote(a), timeout=60)
+    from ray_tpu.core.runtime import get_runtime
+    rt = get_runtime()
+    add_events = [tid for _ts, tid, name, _st in rt.task_events.snapshot()
+                  if name.endswith(".add")]
+    assert not add_events, f"head saw {len(add_events)} direct .add calls"
+
+
+def test_mixed_path_calls_stay_ordered():
+    """Interleaving ref-arg calls (head path) with plain calls (peer
+    plane) from one worker caller must preserve submission order —
+    enforced by the executing worker's order gate."""
+    a = Counter.remote()
+    ray_tpu.get(a.dump.remote(), timeout=30)
+
+    @ray_tpu.remote
+    def caller(h, n):
+        for i in range(n):
+            if i % 3 == 0:
+                h.add.remote(ray_tpu.put(i))  # ready ref: head path
+            else:
+                h.add.remote(i)               # peer plane
+        return ray_tpu.get(h.dump.remote(), timeout=60)
+
+    seen = ray_tpu.get(caller.remote(a, 30), timeout=120)
+    assert seen == list(range(30)), seen
+
+
+def test_dep_gated_call_does_not_stall_direct_calls():
+    """A call parked at the head on a pending dep must not stall the
+    caller's later direct calls (the head skip-releases its seq slot to
+    the hosting worker); the gated call lands at dep-resolution time."""
+    a = Counter.remote()
+    ray_tpu.get(a.dump.remote(), timeout=30)
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(4)
+        return "gated"
+
+    @ray_tpu.remote
+    def caller(h):
+        gate_ref = slow.remote()
+        t0 = time.monotonic()
+        h.add.remote(gate_ref)          # parks at head on slow()
+        fast = [h.add.remote(i) for i in range(5)]
+        ray_tpu.get(fast, timeout=30)
+        fast_done = time.monotonic() - t0
+        return fast_done
+
+    fast_done = ray_tpu.get(caller.remote(a), timeout=120)
+    assert fast_done < 3.0, (
+        f"direct calls stalled {fast_done:.1f}s behind a dep-parked call")
+    # The gated call still lands once its dep resolves.
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        seen = ray_tpu.get(a.dump.remote(), timeout=30)
+        if "gated" in seen:
+            break
+        time.sleep(0.2)
+    assert "gated" in seen and seen[-1] == "gated", seen
+
+
+def test_direct_result_ref_escapes_to_driver():
+    """A worker's direct-call result ref returned to the driver must
+    resolve (the caller materializes escaped results into the shared
+    store and notifies the head)."""
+    a = Counter.remote()
+    ray_tpu.get(a.dump.remote(), timeout=30)
+
+    @ray_tpu.remote
+    def caller(h):
+        refs = [h.add.remote(i) for i in range(4)]
+        ray_tpu.get(refs, timeout=30)   # results arrived (inline tier)
+        return refs                      # escape AFTER arrival
+
+    refs = ray_tpu.get(caller.remote(a), timeout=60)
+    assert ray_tpu.get(refs, timeout=30) == [0, 2, 4, 6]
+
+
+def test_direct_result_ref_escapes_while_pending():
+    """Escaping a direct-call ref BEFORE its result arrives (chained
+    into another task) must still resolve for the borrower."""
+    a = Counter.remote()
+    ray_tpu.get(a.dump.remote(), timeout=30)
+
+    @ray_tpu.remote
+    def double(x):
+        return x * 10
+
+    @ray_tpu.remote
+    def caller(h):
+        r = h.add.remote(3)        # direct call
+        chained = double.remote(r)  # escapes immediately (likely pending)
+        return ray_tpu.get(chained, timeout=30)
+
+    assert ray_tpu.get(caller.remote(a), timeout=60) == 60
+
+
+def test_large_results_ride_shared_store():
+    """Results above the inline cap go to the shared arena; the caller
+    and later borrowers both resolve them."""
+    a = Counter.remote()
+    ray_tpu.get(a.dump.remote(), timeout=30)
+
+    @ray_tpu.remote
+    def caller(h):
+        refs = [h.big.remote(2 << 20) for _ in range(3)]
+        arrs = ray_tpu.get(refs, timeout=60)
+        assert all(int(x.sum()) == 2 << 20 for x in arrs)
+        return refs[0]
+
+    ref = ray_tpu.get(caller.remote(a), timeout=120)
+    assert int(ray_tpu.get(ref, timeout=30).sum()) == 2 << 20
+
+
+def test_actor_death_fails_direct_calls():
+    a = Counter.remote()
+    ray_tpu.get(a.dump.remote(), timeout=30)
+
+    @ray_tpu.remote
+    class Killer:
+        def noop(self):
+            pass
+
+    @ray_tpu.remote
+    def caller(h):
+        ray_tpu.get(h.add.remote(1), timeout=30)  # peer channel is live
+        ray_tpu.kill(h)
+        refs = [h.add.remote(i) for i in range(10)]
+        errs = 0
+        for r in refs:
+            try:
+                ray_tpu.get(r, timeout=30)
+            except Exception:
+                errs += 1
+        return errs
+
+    # All post-kill calls must resolve to errors, never hang.
+    assert ray_tpu.get(caller.remote(a), timeout=120) == 10
+
+
+def test_plane_disabled_by_config(monkeypatch):
+    """worker_direct_calls=0 falls back to the head relay (chaos/compat
+    escape hatch)."""
+    ray_tpu.shutdown()
+    monkeypatch.setenv("RAY_TPU_WORKER_DIRECT_CALLS", "0")
+    ray_tpu.init(num_cpus=2)
+    a = Counter.remote()
+    ray_tpu.get(a.dump.remote(), timeout=30)
+
+    @ray_tpu.remote
+    def caller(h):
+        return ray_tpu.get([h.add.remote(i) for i in range(8)], timeout=30)
+
+    assert ray_tpu.get(caller.remote(a), timeout=60) == [i * 2
+                                                         for i in range(8)]
