@@ -35,27 +35,38 @@ _PID_FILE = os.path.join(_STATE_DIR, "ray_head_pids")
 _LEGACY_ADDR_FILE = "/tmp/ray_tpu/ray_current_address"
 
 
+def _record_pids(pids: list[int]):
+    """Merge pids into the shared PID file under an flock: a concurrently
+    started (or killed-mid-boot) head/agent on this machine must stay
+    visible to `ray_tpu stop`, or it becomes an orphan — and two
+    concurrent starts must not clobber each other's append. Dead recorded
+    pids are dropped while we're here."""
+    import fcntl
+    os.makedirs(_STATE_DIR, exist_ok=True)
+    with open(_PID_FILE, "a+") as f:
+        fcntl.flock(f, fcntl.LOCK_EX)
+        f.seek(0)
+        try:
+            prev = json.loads(f.read() or "[]")
+        except ValueError:
+            prev = []
+        alive = set(pids)
+        for pid in prev:
+            try:
+                os.kill(pid, 0)
+                alive.add(pid)
+            except OSError:
+                pass
+        f.seek(0)
+        f.truncate()
+        f.write(json.dumps(sorted(alive)))
+
+
 def _write_cluster_files(address: str, pids: list[int]):
     os.makedirs(_STATE_DIR, exist_ok=True)
     with open(_ADDR_FILE, "w") as f:
         f.write(address)
-    # MERGE with still-alive recorded pids rather than clobbering: a
-    # concurrently-started (or killed-mid-boot) head/agent on this machine
-    # must stay visible to `ray_tpu stop`, or it becomes an orphan.
-    try:
-        with open(_PID_FILE) as f:
-            prev = json.loads(f.read())
-    except (FileNotFoundError, ValueError):
-        prev = []
-    alive = []
-    for pid in prev:
-        try:
-            os.kill(pid, 0)
-            alive.append(pid)
-        except OSError:
-            pass
-    with open(_PID_FILE, "w") as f:
-        f.write(json.dumps(sorted(set(alive) | set(pids))))
+    _record_pids(pids)
 
 
 def _resolve_address(args) -> str:
@@ -95,15 +106,7 @@ def _cmd_start(args):
         proc = subprocess.Popen(cmd, start_new_session=True)
         # Record the agent pid so `ray_tpu stop` on this machine kills it
         # (the reference's `ray stop` kills the local raylet the same way).
-        os.makedirs(_STATE_DIR, exist_ok=True)
-        pids = []
-        try:
-            with open(_PID_FILE) as f:
-                pids = json.loads(f.read())
-        except (FileNotFoundError, ValueError):
-            pass
-        with open(_PID_FILE, "w") as f:
-            f.write(json.dumps(pids + [proc.pid]))
+        _record_pids([proc.pid])
         print(f"node agent started (pid {proc.pid}), joined {args.address}")
         return
     if args.block:
@@ -114,14 +117,7 @@ def _cmd_start(args):
         # able to find this daemon even if the launching `start` process
         # was killed mid-startup — the r4 bench starved behind exactly
         # such an orphan (spawned, never published, never recorded).
-        os.makedirs(_STATE_DIR, exist_ok=True)
-        try:
-            with open(_PID_FILE) as f:
-                _pids = json.loads(f.read())
-        except (FileNotFoundError, ValueError):
-            _pids = []
-        with open(_PID_FILE, "w") as f:
-            f.write(json.dumps(_pids + [os.getpid()]))
+        _record_pids([os.getpid()])
         rt = ray_tpu.init(num_cpus=args.num_cpus, num_tpus=args.num_tpus,
                           object_store_memory=args.object_store_memory
                           or None)

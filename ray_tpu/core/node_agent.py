@@ -17,7 +17,6 @@ multi-node cluster, mirroring the reference's `cluster_utils.Cluster:135`.
 from __future__ import annotations
 
 import argparse
-import collections
 import json
 import os
 import selectors

@@ -587,7 +587,12 @@ class WorkerRuntime:
         try:
             conn.send(("wexec", spec))
         except OSError:
-            conn.inflight.pop(spec.task_id, None)
+            # The inflight entry is the fallback TOKEN: exactly one of
+            # this path and _on_wpeer_eof's replay pops it (dict.pop is
+            # atomic under the GIL), so a send failing concurrently with
+            # channel EOF can never submit the call twice.
+            if conn.inflight.pop(spec.task_id, None) is None:
+                return True  # EOF handler owns the fallback already
             with self._direct_lock:
                 for rid in spec.return_ids:
                     self._direct_pending.pop(rid, None)
@@ -618,9 +623,11 @@ class WorkerRuntime:
                     self.actor_locations.pop(aid, None)
             # In-flight calls MAY have executed (the frame was sent):
             # only retry-permitted calls replay, the rest fail cleanly.
+            # The pop is the fallback token shared with the sender's
+            # OSError path — whoever pops the entry owns the fallback.
             for task_id, spec in list(conn.inflight.items()):
-                conn.inflight.pop(task_id, None)
-                self._direct_fallback(spec, maybe_executed=True)
+                if conn.inflight.pop(task_id, None) is not None:
+                    self._direct_fallback(spec, maybe_executed=True)
         else:
             # The calling worker died: its results are moot — drop the
             # routes so replies fall through to the discard path.

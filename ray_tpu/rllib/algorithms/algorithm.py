@@ -28,19 +28,45 @@ class Algorithm:
         self.config = config
         if config.env is None:
             raise ValueError("config.environment(env=...) is required")
-        from ray_tpu.rllib.env.minatar import register_builtin_envs
-        register_builtin_envs()
-        probe = gym.make(config.env, **config.env_config)
-        self.module = module_for_env(
-            probe, hidden=tuple(config.model.get("hidden", (64, 64))),
-            kind=self.module_kind)
-        probe.close()
-        self.env_runner_group = EnvRunnerGroup(
-            config.env, self.module,
-            num_env_runners=config.num_env_runners,
-            num_envs_per_env_runner=config.num_envs_per_env_runner,
-            seed=config.seed, env_config=config.env_config,
-            restart_failed=config.restart_failed_env_runners)
+        from ray_tpu.rllib.env.jax_env import is_jax_env, make_jax_env
+        self._jax_vec_env = None
+        self._ondev_iter = None  # built lazily by the on-device path
+        if is_jax_env(config.env):
+            # On-device env: dynamics are jax, the training iteration can
+            # compile end-to-end (env/jax_env.py + core/ondevice.py); no
+            # gym probe, no host env runners.
+            from ray_tpu.rllib.core.ondevice import OnDeviceSamplerGroup
+            from ray_tpu.rllib.core.rl_module import (
+                MINATAR_FILTERS, NATURE_FILTERS, CNNActorCriticModule)
+            venv = make_jax_env(config.env,
+                                config.num_envs_per_env_runner)
+            if not getattr(self, "supports_ondevice_env", False):
+                raise ValueError(
+                    "jax-native envs need an algorithm with an on-device "
+                    f"training path (PPO); {type(self).__name__} uses "
+                    "the gym env path")
+            filters, dense = ((NATURE_FILTERS, 512)
+                              if venv.obs_shape[0] >= 64
+                              else (MINATAR_FILTERS, 128))
+            self.module = CNNActorCriticModule(
+                venv.obs_shape, venv.num_actions, filters=filters,
+                dense=dense)
+            self._jax_vec_env = venv
+            self.env_runner_group = OnDeviceSamplerGroup()
+        else:
+            from ray_tpu.rllib.env.minatar import register_builtin_envs
+            register_builtin_envs()
+            probe = gym.make(config.env, **config.env_config)
+            self.module = module_for_env(
+                probe, hidden=tuple(config.model.get("hidden", (64, 64))),
+                kind=self.module_kind)
+            probe.close()
+            self.env_runner_group = EnvRunnerGroup(
+                config.env, self.module,
+                num_env_runners=config.num_env_runners,
+                num_envs_per_env_runner=config.num_envs_per_env_runner,
+                seed=config.seed, env_config=config.env_config,
+                restart_failed=config.restart_failed_env_runners)
         self.learner_group = LearnerGroup(
             self.module, self._loss_fn(),
             num_learners=config.num_learners,

@@ -77,6 +77,30 @@ def test_atari_class_obs_contract():
     assert np.allclose(np.asarray(obs[..., 1]), np.asarray(obs2[..., 0]))
 
 
+@pytest.mark.smoke
+def test_ppo_algorithm_surface_with_jax_env():
+    """config.environment(env="Jax...") drives the standard Algorithm
+    surface (train/save/metrics) through the on-device path."""
+    from ray_tpu.rllib import PPOConfig
+
+    config = (PPOConfig()
+              .environment(env="JaxMinAtarBreakout-v0")
+              .env_runners(num_env_runners=0, num_envs_per_env_runner=8)
+              .training(train_batch_size=256, minibatch_size=128,
+                        num_epochs=1, lr=1e-3)
+              .debugging(seed=0))
+    algo = config.build_algo()
+    try:
+        r = None
+        for _ in range(3):
+            r = algo.train()
+        assert r["num_env_steps_sampled_lifetime"] == 3 * 256
+        assert "learner_update_ms" in r and "policy_loss" in r
+        assert r["num_episodes"] > 0
+    finally:
+        algo.stop()
+
+
 def test_fused_ppo_learns_on_device():
     """The single-dispatch train iteration improves the policy: after a
     few dozen iterations on JaxMinAtarBreakout, mean episode return beats
