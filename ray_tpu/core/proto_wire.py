@@ -148,6 +148,12 @@ def to_wire(msg) -> bytes | None:
             r.object_inventory.append(oid)
     elif op == "heartbeat":
         f.heartbeat.node_id = msg[1]
+        if len(msg) > 2 and isinstance(msg[2], dict):
+            view = msg[2]
+            f.heartbeat.view_version = int(view.get("v", 0))
+            f.heartbeat.idle_workers = int(view.get("idle", 0))
+            f.heartbeat.lease_backlog = int(view.get("backlog", 0))
+            f.heartbeat.lease_inflight = int(view.get("inflight", 0))
     elif op == "node_ack":
         f.node_ack.head_node_id = msg[1]
     elif op == "worker_death":
@@ -204,6 +210,12 @@ def from_wire(data: bytes):
                 inventory, _addr_in(r, "ctrl_host", "ctrl_port"),
                 list(r.object_inventory))
     if which == "heartbeat":
+        h = f.heartbeat
+        if h.view_version:
+            return ("heartbeat", h.node_id,
+                    {"v": h.view_version, "idle": h.idle_workers,
+                     "backlog": h.lease_backlog,
+                     "inflight": h.lease_inflight})
         return ("heartbeat", f.heartbeat.node_id)
     if which == "node_ack":
         return ("node_ack", f.node_ack.head_node_id)

@@ -297,6 +297,18 @@ class NodeState:
         self.state = "ALIVE"
         self.last_heartbeat = time.monotonic()
         self.last_spawn_req = 0.0
+        # --- node-lease dispatch (the raylet-local scheduling split,
+        # parity: cluster_task_manager.h:45 / local_task_manager.h:65) ---
+        # Plain dep-free tasks are LEASED to the node as a whole: the
+        # agent owns per-worker dispatch, the head only debits node
+        # resources and banks completions per batch. task_id -> spec.
+        self.leases: dict[bytes, "TaskSpec"] = {}
+        # fn_ids whose blob this node's agent already caches.
+        self.lease_fns: set[bytes] = set()
+        # Agent-reported load view (versioned deltas riding heartbeats —
+        # the ray_syncer.h:20 role): {"v", "idle", "backlog"}.
+        self.load_view: dict = {}
+        self.last_reclaim = 0.0
 
 
 class _ForkedProc:
@@ -802,6 +814,9 @@ class Runtime:
         # submitting/listener threads.
         self._sched_cv = threading.Condition()
         self._sched_gen = 0
+        # Lease refills computed on the listener thread, sent by the
+        # scheduler thread (blocking sendalls must stay off the listener).
+        self._pending_lease_sends: collections.deque = collections.deque()
         threading.Thread(target=self._sched_loop, daemon=True,
                          name="rtpu-scheduler").start()
 
@@ -1946,6 +1961,15 @@ class Runtime:
             node = self.nodes.get(conn.node_id)
             if node is not None:
                 node.last_heartbeat = time.monotonic()
+                if len(msg) > 2 and isinstance(msg[2], dict):
+                    # Agent-local load view rides every heartbeat as a
+                    # versioned delta (the ray_syncer.h:20 role): applied
+                    # off the scheduling lock, read by the reclaimer and
+                    # the state API. TCP FIFO keeps versions monotonic.
+                    if msg[2].get("v", 0) >= node.load_view.get("v", -1):
+                        node.load_view = msg[2]
+                    if node.load_view.get("backlog"):
+                        self._maybe_reclaim_leases(node)
         elif op == "agent_req":
             # Small synchronous agent->head queries (peer discovery).
             _, req_id, what, arg = msg
@@ -1959,6 +1983,22 @@ class Runtime:
                 conn.send(("agent_resp", req_id, resp))
             except OSError:
                 pass
+        elif op == "node_done":
+            self._on_node_done(conn, msg[1])
+        elif op == "lease_fail":
+            self._on_lease_fail(conn.node_id, msg[1])
+        elif op == "lease_return":
+            # Reclaimed un-started leases: back into the queues verbatim
+            # (no retry consumed — they never ran).
+            node = self.nodes.get(conn.node_id)
+            with self.lock:
+                for spec in msg[1]:
+                    if node is not None:
+                        node.leases.pop(spec.task_id, None)
+                    self._release_token(
+                        self._reservations.pop(spec.task_id, None))
+                    self._enqueue_task_locked(spec, front=True)
+            self._schedule()
         elif op == "worker_death":
             w = self.workers.get(msg[1])
             if w is not None:
@@ -2313,6 +2353,12 @@ class Runtime:
                                     state="DEAD")
         for w in list(node.workers.values()):
             self._on_worker_death(w)
+        # Leased tasks died with the node: same policy as a dead worker's
+        # running task — each MAY have started, so replays consume a retry.
+        leased = list(node.leases.values())
+        node.leases.clear()
+        if leased:
+            self._on_lease_fail(node.node_id, leased)
         # Actors queued for assignment on this node never get a worker now:
         # release their dead-node reservation and re-place them.
         for aid in orphaned_assigns:
@@ -3582,6 +3628,15 @@ class Runtime:
             if self._shutdown:
                 return
             try:
+                if self._pending_lease_sends:
+                    # Merge everything queued since the last drain: one
+                    # sendall per NODE instead of one per completion
+                    # batch (at 64 agents the un-merged refill sends ate
+                    # ~30% of this thread in blocking sendalls).
+                    merged: list = []
+                    while self._pending_lease_sends:
+                        merged.extend(self._pending_lease_sends.popleft())
+                    self._send_leases(merged)
                 self._schedule_now()
             except Exception:
                 traceback.print_exc()
@@ -3595,6 +3650,7 @@ class Runtime:
         on every completion event."""
         dispatches = []
         failures = []
+        lease_dispatches: list = []  # (node, spec) — agent-local dispatch
         with self.lock:
             for sig in list(self.task_queues):
                 q = self.task_queues.get(sig)
@@ -3613,10 +3669,27 @@ class Runtime:
                         # Key blocked on resources: pipeline the backlog
                         # onto busy same-key workers (they ride those
                         # workers' existing reservations), then next key.
+                        # (Lease-eligible backlog refills node-locally in
+                        # _on_node_done instead — measurably faster than
+                        # topping nodes up from scheduler passes.)
                         self._pipeline_locked(sig, q, dispatches)
                         break
                     node, token = res
                     env_key = sig[2]
+                    if (node.conn is not None
+                            and self._lease_ok(spec, env_key)):
+                        # Node lease (raylet-local dispatch,
+                        # cluster_task_manager.h:45): the head debits node
+                        # resources and hands the task to the NODE; the
+                        # agent picks the worker, spawns on demand, and
+                        # reports completions in node_done batches — no
+                        # per-worker bookkeeping (and no per-completion
+                        # global-lock work) at the head.
+                        q.popleft()
+                        self._reservations[spec.task_id] = token
+                        node.leases[spec.task_id] = spec
+                        lease_dispatches.append((node, spec))
+                        continue
                     w = self._take_idle_locked(node, env_key)
                     if w is None:
                         # Resources fit but no free matching worker on that
@@ -3673,8 +3746,122 @@ class Runtime:
                     conn.send(("relay_batch", pairs))
             except OSError:
                 pass  # node death handling reroutes via heartbeat/EOF
+        if lease_dispatches:
+            self._send_leases(lease_dispatches)
         if self._steal_for_idle():
             self._schedule()
+
+    def _send_leases(self, lease_dispatches: list):
+        """One node_exec frame per node carries the batch; fn blobs ride
+        along the first time a node sees a function."""
+        per_node: dict = {}
+        node_order: list = []
+        for node, spec in lease_dispatches:
+            self.task_events.record(spec.task_id, spec, "RUNNING")
+            blob = None
+            if spec.fn_id and spec.fn_id not in node.lease_fns:
+                blob = self.fn_table.get(spec.fn_id)
+                node.lease_fns.add(spec.fn_id)
+            if node not in per_node:
+                per_node[node] = []
+                node_order.append(node)
+            per_node[node].append((spec.fn_id, blob, spec))
+        for node in node_order:
+            frame = ("node_exec", per_node[node])
+            # On the listener thread, ride the drain-pass out-batch: a
+            # synchronous sendall here would stall the whole control
+            # plane whenever one agent's socket back-pressures (with N
+            # busy agents on few cores that is the common case, and it
+            # serialized the lease plane at 16+ agents).
+            if self._buffered_send(node.conn, frame):
+                continue
+            try:
+                node.conn.send(frame)
+            except OSError:
+                pass  # node-death handling requeues node.leases
+
+    # Lease pipeline depth per node CPU: how many tasks may ride one node
+    # beyond its resource capacity (parity: max_tasks_in_flight_per_worker
+    # lease reuse — here per NODE; without it every lease costs a full
+    # head round-trip per task). 8 matches the worker pipeline depth —
+    # measured optimum on the emulated many-agent rig (deeper caps let
+    # early-finishing nodes hog the queue and collapse aggregate rate:
+    # 12 -> 4x slower at 64 agents; shallower starves worker pipelines).
+    _LEASE_DEPTH = 8
+
+    @staticmethod
+    def _lease_ok(spec: TaskSpec, env_key) -> bool:
+        return (env_key is None and spec.actor_id is None
+                and not spec.streaming and not spec.dependencies)
+
+    def _lease_refill_locked(self, node: NodeState,
+                             completed: int = 1) -> list:
+        """Pop lease-eligible backlog for `node` — called from
+        _on_node_done so a completion hands the node new work DIRECTLY
+        (one send, no scheduler pass), the lease-plane analogue of the
+        worker path's local token handoff. Self-clocking: at most
+        one-for-one with this batch's completions (plus the cap bound),
+        so a fast node cannot monopolize the queue. No reservation:
+        refills ride the node's running leases."""
+        if node.state != "ALIVE":
+            return []
+        cap = int(self._LEASE_DEPTH * max(1.0, node.total.get("CPU", 1.0)))
+        budget = min(cap - len(node.leases), completed)
+        if budget <= 0:
+            return []
+        out = []
+        for sig in list(self.task_queues):
+            strat, env_key = sig[1], sig[2]
+            if strat not in (None, "DEFAULT") or env_key is not None:
+                continue
+            # Capacity-type check (custom resources the node lacks).
+            if any(node.total.get(k, 0.0) < v for k, v in sig[0]):
+                continue
+            q = self.task_queues[sig]
+            while q and budget > 0:
+                spec = q[0]
+                if not self._lease_ok(spec, env_key):
+                    break
+                q.popleft()
+                budget -= 1
+                node.leases[spec.task_id] = spec
+                out.append((node, spec))
+            if not self.task_queues.get(sig):
+                self.task_queues.pop(sig, None)
+            if budget <= 0:
+                break
+        return out
+
+    def _maybe_reclaim_leases(self, node: NodeState):
+        """Anti-straggler for the lease plane: a node reporting backlog
+        while other nodes idle gets part of its UN-started lease queue
+        pulled back for re-scheduling (cheap single-phase — the agent only
+        returns tasks it never handed to a worker, so no execution race).
+        Cooldown-paced: one reclaim per node per second is plenty."""
+        now = time.monotonic()
+        if now - node.last_reclaim < 5.0:
+            return
+        # Only a STUCK node (backlog with nothing in flight) is a
+        # straggler; a node with execs in flight is making progress —
+        # reclaiming from it just thrashes tasks between loaded nodes
+        # (observed: 64 emulated agents on one core all report backlog
+        # while their workers boot, and reclaim ping-pong halved the
+        # aggregate rate).
+        if node.load_view.get("inflight", 0) > 0:
+            return
+        with self.lock:
+            if any(self.task_queues.values()):
+                return
+            idle = sum(len(n.idle) for n in self.nodes.values()
+                       if n.state == "ALIVE" and n is not node)
+        if idle <= 0:
+            return
+        node.last_reclaim = now
+        try:
+            node.conn.send(("lease_reclaim",
+                            min(idle, int(node.load_view["backlog"]))))
+        except OSError:
+            pass
 
     def _steal_for_idle(self) -> bool:
         """Anti-straggler: with idle workers and empty queues, reclaim
@@ -3940,6 +4127,90 @@ class Runtime:
                     if node is not None:
                         node.idle.append(w)
             return spec
+
+    def _on_node_done(self, conn: "NodeConn", entries: list):
+        """Batched completions of node-leased tasks (the raylet-local
+        dispatch path). ONE global-lock acquisition per BATCH — the
+        per-completion lock work the 64-agent profile named as the head's
+        ceiling (HEADPROF_r04) collapses into per-frame bookkeeping
+        (directory/object puts use their own locks)."""
+        nid = conn.node_id
+        node = self.nodes.get(nid)
+        # Object publication first (directory has its own locking);
+        # the locked waiter probe below then observes every entry —
+        # same ordering contract as _on_object_ready.
+        for task_id, outs in entries:
+            for rid, status, payload, bufs in outs:
+                if status == "inline":
+                    self.directory.put(rid, ("raw", payload, bufs, True))
+                elif status == "err":
+                    self.directory.put(rid, ("raw", payload, bufs, False))
+                else:
+                    self.directory.add_location(rid, nid)
+        ready_items = []
+        refill = []
+        with self.lock:
+            for task_id, outs in entries:
+                spec = node.leases.pop(task_id, None) if node else None
+                self._release_token(
+                    self._reservations.pop(task_id, None))
+                for rid, _s, _p, _b in outs:
+                    self._rid_to_spec.pop(rid, None)
+                    for item in self.waiting_deps.pop(rid, []):
+                        item["pending"] -= 1
+                        if item["pending"] == 0:
+                            ready_items.append(item)
+                self._cancelled.discard(task_id)
+                self._reconstructing.discard(task_id)
+                if spec is not None:
+                    self.task_events.record(task_id, spec, "FINISHED")
+                    if self._persist and not spec.streaming:
+                        self._pstore.delete("task", task_id)
+                    self._lineage_register(spec)
+                    self._unpin_deps(spec)
+            if node is not None:
+                refill = self._lease_refill_locked(node,
+                                                   completed=len(entries))
+        if refill:
+            # Hand the send to the scheduler thread: this runs on the
+            # LISTENER thread, and a blocking sendall to one
+            # back-pressured agent here stalls the entire control plane
+            # (profiled at 16 agents: the listener spent ~100% of its
+            # samples inside send_msg).
+            self._pending_lease_sends.append(refill)
+        for item in ready_items:
+            self._enqueue_ready(item)
+        self._schedule()
+
+    def _on_lease_fail(self, nid: bytes, specs: list):
+        """A leased task's worker died at the agent: mirror the
+        worker-death retry policy — the task MAY have started, so a
+        replay consumes a retry; exhausted ones fail their returns."""
+        node = self.nodes.get(nid)
+        requeued = False
+        for spec in specs:
+            if node is not None:
+                node.leases.pop(spec.task_id, None)
+            with self.lock:
+                self._release_token(
+                    self._reservations.pop(spec.task_id, None))
+            if spec.task_id in self._cancelled:
+                from ray_tpu.core.status import TaskCancelledError
+                self._fail_returns(spec, TaskCancelledError(
+                    f"task {spec.describe()} was cancelled"))
+                self._cancelled.discard(spec.task_id)
+            elif (spec.retries_left or 0) > 0:
+                spec.retries_left -= 1
+                self.task_events.record(spec.task_id, spec, "RETRY")
+                with self.lock:
+                    self._enqueue_task_locked(spec, front=True)
+                requeued = True
+            else:
+                self._fail_returns(spec, RayTpuError(
+                    f"worker died executing {spec.describe()} "
+                    "(leased; retries exhausted)"))
+        if requeued:
+            self._schedule()
 
     def _on_task_done(self, w: WorkerHandle, task_id: bytes,
                       actor_id: bytes | None, outs):

@@ -343,10 +343,26 @@ class WorkerRuntime:
         ev = threading.Event()
         with self._wait_lock:
             self._pending_waits.setdefault(oid, []).append(ev)
-        self.send(("wait_obj", oid))
-        if not ev.wait(timeout):
-            from ray_tpu.core.status import GetTimeoutError
-            raise GetTimeoutError(f"get() timed out on {ref}")
+        # Close the check-then-subscribe window: a peer-plane wdone that
+        # landed between the cache probes above and the registration just
+        # now signalled NOBODY — and unlike head-path objects, wait_obj
+        # cannot recover it (the head never saw a direct call). Re-probe
+        # now that any later arrival is guaranteed to set `ev`.
+        if oid in self.object_cache or oid in self._direct_values:
+            with self._wait_lock:
+                lst = self._pending_waits.get(oid)
+                if lst is not None:
+                    try:
+                        lst.remove(ev)
+                    except ValueError:
+                        pass
+                    if not lst:
+                        self._pending_waits.pop(oid, None)
+        else:
+            self.send(("wait_obj", oid))
+            if not ev.wait(timeout):
+                from ray_tpu.core.status import GetTimeoutError
+                raise GetTimeoutError(f"get() timed out on {ref}")
         cached = self.object_cache.get(oid, _MISS)
         if cached is not _MISS:
             return self._raise_if_error(cached)
