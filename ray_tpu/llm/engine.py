@@ -51,6 +51,11 @@ class EngineConfig:
     num_pages: int | None = None   # pool size; None = slots*ceil(max_len/
     #                                page)+1 (capacity parity with dense)
     prefix_cache: bool = True      # reuse full prompt pages across requests
+    # --- speculative decoding (parity: vLLM ngram speculation under the
+    # reference's llm stack; greedy windows only — sampled slots fall back
+    # to the plain window) ---
+    speculation: str | None = None  # None | "ngram"
+    spec_k: int = 4                 # drafts verified per model pass
 
 
 @dataclasses.dataclass
@@ -67,6 +72,11 @@ class Request:
     # sampled but never fed back. Re-admission resumes from it instead of
     # re-sampling the position.
     resume_token: int | None = None
+    # Guided decoding: a compiled TokenGuide (guided.py) and the host
+    # mirror of the slot's DFA state (advanced as tokens are read back;
+    # survives preemption/re-admission).
+    guide: object | None = None
+    guide_state: int = 0
 
 
 # ---------------- pure model steps ----------------
@@ -315,6 +325,9 @@ def decode_paged(params, pool_k, pool_v, tokens, lengths, active,
     sin, cos = rope(lengths[:, None], c.head_dim, c.rope_theta)
     w_idx = jnp.clip(lengths // page, 0, P - 1)
     w_page = jnp.take_along_axis(page_tables, w_idx[:, None], 1)[:, 0]
+    # overshooting slots past the table bucket write scratch, not their
+    # last real page (same guard as verify_paged)
+    w_page = jnp.where(lengths // page >= P, 0, w_page)
     w_page = jnp.where(active, w_page, 0)  # inactive -> scratch page
     w_off = lengths % page
     hkv_idx = jnp.arange(c.n_kv_heads)[:, None]
@@ -370,10 +383,162 @@ def decode_paged(params, pool_k, pool_v, tokens, lengths, active,
     return logits, pool_k, pool_v
 
 
+def verify_paged(params, pool_k, pool_v, tokens, lengths, active,
+                 page_tables, config: ModelConfig):
+    """Speculative-verify forward: S tokens per slot (the pending token +
+    S-1 drafts) at consecutive positions lengths..lengths+S-1, in ONE
+    model pass. Writes all S tokens' KV (rejected positions hold garbage
+    the position masks hide until real tokens overwrite them) and returns
+    logits [B, S, vocab] — logits[:, j] predicts the token AFTER input j.
+    Same unrolled-layer/donated-pool structure as decode_paged; attention
+    runs the multi-query Pallas kernel (one pass over the slot's pages for
+    all S queries)."""
+    from ray_tpu.ops.paged_attention import paged_verify_insert_attention
+    c = config
+    B, S = tokens.shape
+    x = jnp.take(params["embed"], tokens, axis=0)          # [B, S, d]
+    positions = lengths[:, None] + jnp.arange(S)[None]     # [B, S]
+    sin, cos = rope(positions, c.head_dim, c.rope_theta)
+
+    h_dim, kv_dim = c.n_heads * c.head_dim, c.n_kv_heads * c.head_dim
+    for li in range(c.n_layers):
+        lp = jax.tree_util.tree_map(lambda a: a[li], params["layers"])
+        normed = rmsnorm(x, lp["attn_norm"], c.norm_eps)
+        wqkv = jnp.concatenate([lp["wq"], lp["wk"], lp["wv"]], axis=1)
+        qkv = jnp.einsum("bsd,dq->bsq", normed, wqkv)
+        q = qkv[..., :h_dim].reshape(B, S, c.n_heads, c.head_dim)
+        k = qkv[..., h_dim:h_dim + kv_dim].reshape(
+            B, S, c.n_kv_heads, c.head_dim)
+        v = qkv[..., h_dim + kv_dim:].reshape(
+            B, S, c.n_kv_heads, c.head_dim)
+        q = apply_rope(q, sin, cos)
+        k = apply_rope(k, sin, cos)
+        # Insert is FUSED into the attention kernel: the new tokens'
+        # K/V merge into the page already streaming through VMEM and the
+        # merged page DMAs back to the aliased pool — token-granular XLA
+        # scatters serialized at ~2us/row and cost more than the whole
+        # forward (measured; see ops/paged_attention.py).
+        attn, pool_k, pool_v = paged_verify_insert_attention(
+            q, pool_k, pool_v, k, v, lengths + 1, page_tables, li)
+        attn = attn.reshape(B, S, c.n_heads * c.head_dim).astype(x.dtype)
+        h = x + jnp.einsum("bsq,qd->bsd", attn, lp["wo"])
+        if c.moe_experts:
+            x = _mlp_block(h, lp, c)
+        else:
+            normed2 = rmsnorm(h, lp["mlp_norm"], c.norm_eps)
+            wgu = jnp.concatenate([lp["wg"], lp["wu"]], axis=1)
+            gu = jnp.einsum("bsd,df->bsf", normed2, wgu)
+            f = gu.shape[-1] // 2
+            act = jax.nn.silu(gu[..., :f]) * gu[..., f:]
+            x = h + jnp.einsum("bsf,fd->bsd", act, lp["wd"])
+
+    x = rmsnorm(x, params["final_norm"], c.norm_eps)
+    head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
+    logits = jnp.einsum("bsd,dv->bsv", x.astype(jnp.float32),
+                        head.astype(jnp.float32))
+    neg = jnp.full_like(logits, -1e30)
+    neg = neg.at[:, :, 0].set(0.0)
+    logits = jnp.where(active[:, None, None], logits, neg)
+    return logits, pool_k, pool_v
+
+
+def ngram_draft(hist, lengths, last_tokens, k: int):
+    """Propose k draft tokens per slot by matching the trailing 2-gram
+    (hist[len-1], pending) against earlier history and copying what
+    followed the MOST RECENT match (the vLLM ngram-speculator policy;
+    device-side so drafting never fences the host). hist [B, H] holds all
+    known tokens: positions < len are fed, hist[len] is the pending
+    token. No match -> repeat the pending token (cheap, usually
+    rejected)."""
+    B, H = hist.shape
+    c0 = jnp.take_along_axis(
+        hist, jnp.clip(lengths - 1, 0)[:, None], 1)[:, 0]
+    c1 = last_tokens
+    idx = jnp.arange(H - 1)
+    m = ((hist[:, :-1] == c0[:, None]) & (hist[:, 1:] == c1[:, None])
+         & (idx[None] < (lengths - 1)[:, None]))
+    p = jnp.max(jnp.where(m, idx[None], -1), axis=1)       # [B]
+    found = p >= 0
+    start = jnp.where(found, p + 2, 0)
+    gat = jnp.clip(start[:, None] + jnp.arange(k)[None], 0, H - 1)
+    drafts = jnp.take_along_axis(hist, gat, 1)
+    return jnp.where(found[:, None], drafts, c1[:, None])
+
+
+def decode_window_spec(params, pool_k, pool_v, tokens, lengths, active,
+                       hist, page_tables, config: ModelConfig,
+                       eos_token: int, n_steps: int, spec_k: int):
+    """Speculative decode window (greedy-only): each of `n_steps` scan
+    iterations drafts spec_k tokens by device-side n-gram lookup,
+    verifies them in ONE multi-token forward (verify_paged), and emits
+    accepted-prefix + 1 bonus token — between 1 and spec_k+1 tokens per
+    model pass, with bitwise-identical output to plain greedy decoding
+    (the standard speculative-decoding guarantee at temperature 0).
+    Returns out blocks [n_steps, B, spec_k+1] (-1 = nothing emitted at
+    that position).
+
+    Parity: vLLM ngram speculative decoding
+    (`python/ray/llm/_internal/serve/deployments/llm/vllm/` inherits it);
+    redesigned for TPU — static [B, K+1] verify shapes, drafting and
+    acceptance fully on-device inside the window scan."""
+    K = spec_k
+    B = tokens.shape[0]
+    H = hist.shape[1]
+    jj = jnp.arange(K + 1)[None]                           # [1, K+1]
+
+    def one(carry, _):
+        pk, pv, toks, lens, act, hst = carry
+        drafts = ngram_draft(hst, lens, toks, K)           # [B, K]
+        tin = jnp.concatenate([toks[:, None], drafts], axis=1)
+        logits, pk, pv = verify_paged(params, pk, pv, tin, lens, act,
+                                      page_tables, config)
+        g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, K+1]
+        ok = g[:, :K] == drafts
+        acc = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1)
+        bonus = jnp.take_along_axis(g, acc[:, None], 1)[:, 0]
+        drafts_p = jnp.concatenate(
+            [drafts, jnp.zeros((B, 1), jnp.int32)], axis=1)
+        e = jnp.where(jj == acc[:, None], bonus[:, None],
+                      jnp.where(jj < acc[:, None], drafts_p, -1))
+        if eos_token >= 0:
+            is_eos = e == eos_token
+            # drop everything after the first emitted EOS
+            after = (jnp.cumsum(is_eos.astype(jnp.int32), axis=1)
+                     - is_eos.astype(jnp.int32)) > 0
+            e = jnp.where(after, -1, e)
+            stop = (e == eos_token).any(axis=1)
+        else:
+            stop = jnp.zeros((B,), bool)
+        e = jnp.where(act[:, None], e, -1)
+        stop = stop & act
+        # history update: emitted tokens live at positions lens+1+j
+        s0 = jnp.minimum(lens + 1, H - (K + 1))
+        offset = lens + 1 - s0                             # >= 0
+        src_j = jnp.clip(jj - offset[:, None], 0, K)
+        val = jnp.take_along_axis(e, src_j, 1)
+        gathered = jax.vmap(
+            lambda h, s: jax.lax.dynamic_slice(h, (s,), (K + 1,))
+        )(hst, s0)
+        write = (jj >= offset[:, None]) & (val >= 0) & act[:, None]
+        upd = jnp.where(write, val, gathered)
+        hst = jax.vmap(
+            lambda h, u, s: jax.lax.dynamic_update_slice(h, u, (s,))
+        )(hst, upd, s0)
+        toks = jnp.where(act, bonus, toks)
+        lens = jnp.where(act, lens + acc + 1, lens)
+        act = act & ~stop
+        return (pk, pv, toks, lens, act, hst), e
+
+    carry = (pool_k, pool_v, tokens, lengths, active, hist)
+    (pool_k, pool_v, tokens, lengths, active, hist), out_seq = (
+        jax.lax.scan(one, carry, None, length=n_steps))
+    return pool_k, pool_v, tokens, lengths, active, hist, out_seq
+
+
 def decode_window(params, pool_k, pool_v, tokens, lengths, active,
-                  page_tables, temps, top_ps, top_ks, key,
-                  config: ModelConfig, eos_token: int, n_steps: int,
-                  trunc: bool):
+                  page_tables, temps, top_ps, top_ks, gtables, gstates,
+                  key, config: ModelConfig, eos_token: int, n_steps: int,
+                  trunc: bool, guided: bool):
     """`n_steps` decode+sample steps in ONE compiled program (lax.scan),
     sampled tokens staying device-resident between steps. The host fences
     once per window instead of once per token — essential when the
@@ -382,38 +547,56 @@ def decode_window(params, pool_k, pool_v, tokens, lengths, active,
     EOS flips `active` on-device; the host discards any overshoot when it
     reads the [n_steps, B] token block back.
 
+    `guided` (static): constrained decoding. gtables [B, S, V] stacked
+    per-slot token-transition tables (unguided slots: an all-zeros row —
+    every token allowed), gstates [B] the per-slot DFA state, which rides
+    the scan carry so constraint enforcement never fences the host
+    (guided.py; the role of vLLM's outlines logits processors).
+
     Within a window page tables are frozen, so the caller bounds n_steps
     by every active slot's remaining page room.
     """
+    B = tokens.shape[0]
 
     def one(carry, _):
-        pk, pv, toks, lens, act, key = carry
+        pk, pv, toks, lens, act, gst, key = carry
         logits, pk, pv = decode_paged(params, pk, pv, toks, lens, act,
                                       page_tables, config)
         key, sub = jax.random.split(key)
+        mask = None
+        if guided:
+            row = gtables[jnp.arange(B), gst]          # [B, V]
+            mask = row >= 0
         if trunc:
-            nxt = sample(logits, temps, sub, top_p=top_ps, top_k=top_ks)
+            nxt = sample(logits, temps, sub, top_p=top_ps, top_k=top_ks,
+                         mask=mask)
         else:
-            nxt = sample(logits, temps, sub)
+            nxt = sample(logits, temps, sub, mask=mask)
         nxt = jnp.where(act, nxt.astype(jnp.int32), 0)
         out = jnp.where(act, nxt, -1)  # -1 = slot emitted nothing
         lens = jnp.where(act, lens + 1, lens)
+        if guided:
+            gst = jnp.where(act,
+                            jnp.maximum(row[jnp.arange(B), nxt], 0), gst)
         if eos_token >= 0:
             act = act & (nxt != eos_token)
-        return (pk, pv, nxt, lens, act, key), out
+        return (pk, pv, nxt, lens, act, gst, key), out
 
-    carry = (pool_k, pool_v, tokens, lengths, active, key)
-    (pool_k, pool_v, tokens, lengths, active, key), out_seq = jax.lax.scan(
-        one, carry, None, length=n_steps)
+    carry = (pool_k, pool_v, tokens, lengths, active, gstates, key)
+    (pool_k, pool_v, tokens, lengths, active, gstates, key), out_seq = (
+        jax.lax.scan(one, carry, None, length=n_steps))
     return pool_k, pool_v, tokens, lengths, active, key, out_seq
 
 
-def sample(logits, temperature, key, top_p=None, top_k=None):
+def sample(logits, temperature, key, top_p=None, top_k=None, mask=None):
     """Per-row temperature (0 = greedy) with optional nucleus (top_p) and
     top_k truncation — all branch-free under jit.
 
     top_p/top_k are per-row arrays; top_p=1.0 / top_k=0 disable the
-    respective filter for that row."""
+    respective filter for that row. mask [B, V] bool (True = allowed)
+    constrains both greedy and stochastic paths (guided decoding)."""
+    if mask is not None:
+        logits = jnp.where(mask, logits, -1e30)
     greedy = jnp.argmax(logits, axis=-1)
     temp = jnp.maximum(temperature, 1e-6)[:, None]
     scaled = logits / temp
@@ -528,6 +711,8 @@ class InferenceEngine:
             self._dev_key = jax.random.PRNGKey(seed + 2)
             self._dev_sampling = None  # (temps, top_ps, top_ks) device
             self._dev_sampling_fp = None
+            self._dev_gtables = None   # stacked guide tables [B, S, V]
+            self._guide_fp = None
             # Donate the pool/cache: without donation every step round-trips
             # the full KV through a fresh HBM allocation (~GBs/step).
             self._insert_batch = jax.jit(insert_pages_batch,
@@ -545,6 +730,22 @@ class InferenceEngine:
             self.cache_k = jax.device_put(self.cache_k, kv_sharding)
             self.cache_v = jax.device_put(self.cache_v, kv_sharding)
 
+        # Speculative decoding state (both layouts keep the host history
+        # mirror — step()/_admit write it unconditionally; the device twin
+        # and window machinery are paged-only).
+        self._spec = self.paged and e.speculation == "ngram"
+        if self._spec and e.spec_k + 1 > e.page_size:
+            # verify writes span at most 2 pages per slot
+            raise ValueError(
+                f"spec_k+1 ({e.spec_k + 1}) must not exceed "
+                f"page_size ({e.page_size})")
+        self.hist = np.zeros((e.max_slots, e.max_len), np.int32)
+        self._dev_hist = None
+        self._spec_window_fns: dict[tuple, object] = {}
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        self._spec_alpha = 0.0  # acceptance-rate EMA (window sizing)
+
         self._prefill = jax.jit(partial(prefill, config=c))
         # Two compiled samplers: the plain one (no sorts) serves the
         # default top_k=0/top_p=1 case on the hot decode loop; the
@@ -552,7 +753,8 @@ class InferenceEngine:
         # request asks for it.
         self._sample = jax.jit(sample)
         self._sample_trunc = jax.jit(
-            lambda lg, t, k, p, tk: sample(lg, t, k, top_p=p, top_k=tk))
+            lambda lg, t, k, p, tk, m=None: sample(lg, t, k, top_p=p,
+                                                   top_k=tk, mask=m))
         self._key = jax.random.PRNGKey(seed + 1)
 
         # host-side slot state
@@ -570,13 +772,21 @@ class InferenceEngine:
 
     def add_request(self, prompt_tokens, max_new_tokens=None,
                     temperature=None, top_p: float = 1.0,
-                    top_k: int = 0) -> int:
+                    top_k: int = 0, guide=None) -> int:
         # Validate at submission, in the CALLER's thread: an invalid prompt
         # must fail its own request, not blow up the shared engine pump.
         if self._chunk_size() and len(prompt_tokens) < self.e.max_len:
             pass  # chunked prefill admits any prompt under max_len
         else:
             self._bucket(len(prompt_tokens))
+        if guide is not None:
+            if not self.paged:
+                raise ValueError("guided decoding requires the paged "
+                                 "KV layout")
+            if guide.table.shape[1] != self.c.vocab:
+                raise ValueError(
+                    f"guide compiled for vocab {guide.table.shape[1]}, "
+                    f"model vocab is {self.c.vocab}")
         with self._lock:
             rid = self._next_id
             self._next_id += 1
@@ -584,7 +794,8 @@ class InferenceEngine:
             rid, list(map(int, prompt_tokens)),
             max_new_tokens or self.e.default_max_new_tokens,
             self.e.default_temperature if temperature is None
-            else temperature, top_p=float(top_p), top_k=int(top_k))
+            else temperature, top_p=float(top_p), top_k=int(top_k),
+            guide=guide)
         self.queue.append(req)
         return rid
 
@@ -887,10 +1098,12 @@ class InferenceEngine:
             self.slot_req[slot] = req
             self.lengths[slot] = n
             self.active[slot] = True
+            self.hist[slot, :n] = req.prompt
             if req.resume_token is not None:
                 first = req.resume_token  # already in req.generated
                 req.resume_token = None
                 self.last_tokens[slot] = first
+                self.hist[slot, n] = first
                 self._maybe_finish(slot, first)
             else:
                 # Defer the first-token sampling: one batched readback for
@@ -902,22 +1115,26 @@ class InferenceEngine:
             temps = jnp.asarray([r.temperature for _s, r, _l in pending],
                                 jnp.float32)
             self._key, sub = jax.random.split(self._key)
+            mask = self._host_guide_mask(
+                [(r, r.guide_state) for _s, r, _l in pending])
             if all(r.top_k == 0 and r.top_p >= 1.0
                    for _s, r, _l in pending):
-                toks = self._sample(stacked, temps, sub)
+                toks = self._sample(stacked, temps, sub, mask=mask)
             else:
                 toks = self._sample_trunc(
                     stacked, temps, sub,
                     jnp.asarray([r.top_p for _s, r, _l in pending],
                                 jnp.float32),
                     jnp.asarray([r.top_k for _s, r, _l in pending],
-                                jnp.int32))
+                                jnp.int32), mask)
             toks = np.asarray(toks)  # one fence for the burst
             for (slot, req, _l), tok in zip(pending, toks):
                 first = int(tok)
                 req.generated.append(first)
                 admitted[req.request_id] = first
                 self.last_tokens[slot] = first
+                self.hist[slot, self.lengths[slot]] = first
+                self._advance_guide(req, first)
                 self._maybe_finish(slot, first)
         return admitted
 
@@ -945,6 +1162,8 @@ class InferenceEngine:
             - len(self.cached_lru),
             "prefix_hits": self.prefix_hits,
             "preemptions": self.preemptions,
+            "spec_drafted": self.spec_drafted,
+            "spec_accepted": self.spec_accepted,
         }
 
     def _admit_dense(self) -> dict[int, int]:
@@ -967,6 +1186,8 @@ class InferenceEngine:
             self.lengths[slot] = n
             self.active[slot] = True
             self.last_tokens[slot] = first
+            self.hist[slot, :n] = req.prompt
+            self.hist[slot, n] = first
             self._maybe_finish(slot, first)
         return admitted
 
@@ -1009,13 +1230,21 @@ class InferenceEngine:
                 jnp.asarray(self.last_tokens), jnp.asarray(self.lengths),
                 jnp.asarray(self.active))
         self._key, sub = jax.random.split(self._key)
+        mask = None
+        if any(r is not None and r.guide is not None
+               for r in self.slot_req):
+            m = np.ones((self.e.max_slots, self.c.vocab), bool)
+            for i, r in enumerate(self.slot_req):
+                if r is not None and r.guide is not None:
+                    m[i] = r.guide.table[r.guide_state] >= 0
+            mask = jnp.asarray(m)
         if (top_ks == 0).all() and (top_ps >= 1.0).all():
             tokens = np.asarray(self._sample(logits, jnp.asarray(temps),
-                                             sub))
+                                             sub, mask=mask))
         else:
             tokens = np.asarray(self._sample_trunc(
                 logits, jnp.asarray(temps), sub,
-                jnp.asarray(top_ps), jnp.asarray(top_ks)))
+                jnp.asarray(top_ps), jnp.asarray(top_ks), mask))
         for i in range(self.e.max_slots):
             if not self.active[i]:
                 continue
@@ -1025,6 +1254,9 @@ class InferenceEngine:
             emitted[req.request_id] = tok
             self.lengths[i] += 1
             self.last_tokens[i] = tok
+            if self.lengths[i] < self.e.max_len:
+                self.hist[i, self.lengths[i]] = tok
+            self._advance_guide(req, tok)
             self._maybe_finish(i, tok)
         self._dev_dirty = True  # single-step path mutates host-side state
         return emitted
@@ -1107,7 +1339,54 @@ class InferenceEngine:
             self._dev = (jnp.asarray(self.last_tokens),
                          jnp.asarray(self.lengths),
                          jnp.asarray(self.active))
+            if self._spec:
+                self._dev_hist = jnp.asarray(self.hist)
             self._dev_dirty = False
+
+    def _sync_guides(self):
+        """(guided?, stacked tables [B, S, V], states [B]) for the window
+        jit. The stacked table re-uploads only when the slot->guide map
+        changes; the [B] state vector is tiny and re-uploads per window.
+        Unguided slots get an all-zeros table row: every token allowed,
+        state pinned to 0."""
+        e = self.e
+        reqs = [self.slot_req[i] for i in range(e.max_slots)]
+        fp = tuple((i, id(r.guide)) for i, r in enumerate(reqs)
+                   if r is not None and r.guide is not None)
+        if not fp:
+            return False, jnp.zeros((1, 1, 1), jnp.int32), \
+                jnp.zeros((e.max_slots,), jnp.int32)
+        if fp != self._guide_fp or self._dev_gtables is None:
+            S = max(r.guide.n_states for r in reqs
+                    if r is not None and r.guide is not None)
+            tab = np.zeros((e.max_slots, S, self.c.vocab), np.int32)
+            for i, r in enumerate(reqs):
+                if r is not None and r.guide is not None:
+                    g = r.guide.table
+                    tab[i, :g.shape[0]] = g
+            self._dev_gtables = jnp.asarray(tab)
+            self._guide_fp = fp
+        states = jnp.asarray(
+            [r.guide_state if (r is not None and r.guide is not None)
+             else 0 for r in reqs], jnp.int32)
+        return True, self._dev_gtables, states
+
+    def _host_guide_mask(self, rows) -> object | None:
+        """numpy mask [len(rows), vocab] for a host-side sample call, or
+        None when no row is guided. rows = list of (req, state)."""
+        if not any(r.guide is not None for r, _s in rows):
+            return None
+        m = np.ones((len(rows), self.c.vocab), bool)
+        for j, (r, s) in enumerate(rows):
+            if r.guide is not None:
+                m[j] = r.guide.table[s] >= 0
+        return jnp.asarray(m)
+
+    @staticmethod
+    def _advance_guide(req: Request, tok: int):
+        if req.guide is not None:
+            req.guide_state = max(int(req.guide.table[req.guide_state,
+                                                      tok]), 0)
 
     def _sync_sampling(self):
         e = self.e
@@ -1169,16 +1448,18 @@ class InferenceEngine:
             # are garbage it still needs) — round DOWN.
             k_bucket = max(b for b in self._win_buckets if b <= limit)
         trunc = self._sync_sampling()
+        guided, gtables_d, gstates_d = self._sync_guides()
         self._sync_device_state()
         tables = self._build_tables()
-        key = (tables.shape[1], k_bucket, trunc)
+        key = (tables.shape[1], k_bucket, trunc, guided,
+               gtables_d.shape if guided else None)
         fn = self._window_fns.get(key)
         if fn is None:
             fn = jax.jit(
                 partial(decode_window, config=self.c,
                         eos_token=int(self.e.eos_token),
-                        n_steps=k_bucket, trunc=trunc),
-                donate_argnums=(1, 2, 3, 4, 5, 10))
+                        n_steps=k_bucket, trunc=trunc, guided=guided),
+                donate_argnums=(1, 2, 3, 4, 5, 12))
             self._window_fns[key] = fn
         toks_d, lens_d, act_d = self._dev
         temps_d, tps_d, tks_d = self._dev_sampling
@@ -1186,7 +1467,7 @@ class InferenceEngine:
          self._dev_key, out_seq) = fn(
             self.params, self.cache_k, self.cache_v, toks_d, lens_d,
             act_d, jnp.asarray(tables), temps_d, tps_d, tks_d,
-            self._dev_key)
+            gtables_d, gstates_d, self._dev_key)
         self._dev = (toks_d, lens_d, act_d)
         out = np.asarray(out_seq)  # ONE fence per window
         emitted: dict[int, int] = {}
@@ -1200,11 +1481,123 @@ class InferenceEngine:
                 emitted[req.request_id] = tok
                 self.lengths[i] += 1
                 self.last_tokens[i] = tok
+                if self.lengths[i] < e.max_len:
+                    self.hist[i, self.lengths[i]] = tok
+                self._advance_guide(req, tok)
                 self._maybe_finish(i, tok)
                 if not self.active[i] and tok != e.eos_token:
                     # Finished by max_new/max_len: the device still thinks
                     # this slot is live — resync before the next window.
                     self._dev_dirty = True
+        if self._spec:
+            # device hist was not advanced by the plain window; force a
+            # re-upload before the next speculative window
+            self._dev_hist = None
+        return emitted
+
+    def _spec_applicable(self) -> bool:
+        """Speculation serves greedy, unguided slots; any active slot
+        outside that contract routes the whole window to the plain path
+        (mixed windows would need per-slot rejection sampling)."""
+        if not self._spec:
+            return False
+        for i in range(self.e.max_slots):
+            r = self.slot_req[i]
+            if not self.active[i] or r is None:
+                continue
+            if (r.temperature > 0 or r.top_k != 0 or r.top_p < 1.0
+                    or r.guide is not None):
+                return False
+        return True
+
+    def _run_window_spec(self) -> dict[int, int] | None:
+        """Speculative window: `iters` draft+verify scan steps, each
+        emitting 1..spec_k+1 tokens per slot. Returns None to fall back
+        to the plain window (pool-starved slot needs its token-granular
+        room binding)."""
+        e = self.e
+        page = e.page_size
+        K = e.spec_k
+        rems = [self.slot_req[i].max_new_tokens
+                - len(self.slot_req[i].generated)
+                for i in range(e.max_slots)
+                if self.active[i] and self.slot_req[i] is not None]
+        # Size the window by EXPECTED tokens per iteration (acceptance
+        # EMA), not the optimistic K+1: at low acceptance an
+        # optimistically-short window would finish only a third of the
+        # work and pay the host fence (~190ms over the tunnel) three
+        # times. Overshoot iterations cost ~0.5ms of compute each —
+        # always cheaper than another fence.
+        expected = 1.0 + self._spec_alpha * K
+        iters = max(1, -(-int(max(rems, default=1)) // max(int(expected),
+                                                           1)))
+        if self.queue:
+            iters = min(iters, 2)  # keep admission interleaving
+        iters = min(next((b for b in self._win_buckets if b >= iters),
+                         self._win_buckets[-1]), self._win_buckets[-1])
+        if not self._grow_pages(iters * (K + 1)):
+            return {}
+        for i in range(e.max_slots):
+            if not self.active[i]:
+                continue
+            room = len(self.slot_pages[i]) * page - int(self.lengths[i])
+            rem = (self.slot_req[i].max_new_tokens
+                   - len(self.slot_req[i].generated))
+            if room < min(K + 1, rem):
+                return None  # pool-starved: plain window binds per-token
+        self._sync_device_state()
+        if self._dev_hist is None:
+            self._dev_hist = jnp.asarray(self.hist)
+        tables = self._build_tables()
+        key = (tables.shape[1], iters)
+        fn = self._spec_window_fns.get(key)
+        if fn is None:
+            fn = jax.jit(partial(decode_window_spec, config=self.c,
+                                 eos_token=int(e.eos_token),
+                                 n_steps=iters, spec_k=K),
+                         donate_argnums=(1, 2, 3, 4, 5, 6))
+            self._spec_window_fns[key] = fn
+        toks_d, lens_d, act_d = self._dev
+        (self.cache_k, self.cache_v, toks_d, lens_d, act_d,
+         self._dev_hist, out_seq) = fn(
+            self.params, self.cache_k, self.cache_v, toks_d, lens_d,
+            act_d, self._dev_hist, jnp.asarray(tables))
+        self._dev = (toks_d, lens_d, act_d)
+        out = np.asarray(out_seq)  # [iters, B, K+1]; ONE fence
+        w_draft = w_acc = 0
+        emitted: dict[int, int] = {}
+        for it in range(out.shape[0]):
+            for i in range(e.max_slots):
+                if not self.active[i]:
+                    continue
+                row = out[it, i]
+                n_emit = int((row >= 0).sum())
+                if n_emit == 0:
+                    continue
+                self.spec_drafted += K
+                self.spec_accepted += n_emit - 1
+                w_draft += K
+                w_acc += n_emit - 1
+                for j in range(K + 1):
+                    tok = int(row[j])
+                    if tok < 0:
+                        continue
+                    if not self.active[i]:
+                        self._dev_dirty = True  # overshoot past host finish
+                        break
+                    req = self.slot_req[i]
+                    req.generated.append(tok)
+                    emitted[req.request_id] = tok
+                    self.lengths[i] += 1
+                    self.last_tokens[i] = tok
+                    if self.lengths[i] < e.max_len:
+                        self.hist[i, self.lengths[i]] = tok
+                    self._maybe_finish(i, tok)
+                    if not self.active[i] and tok != e.eos_token:
+                        self._dev_dirty = True
+        if w_draft:
+            self._spec_alpha = (0.5 * self._spec_alpha
+                                + 0.5 * (w_acc / w_draft))
         return emitted
 
     def step_window(self) -> dict[int, int]:
@@ -1214,7 +1607,11 @@ class InferenceEngine:
             return self.step()
         emitted = self._admit()
         if self.active.any():
-            emitted.update(self._run_window())
+            upd = (self._run_window_spec() if self._spec_applicable()
+                   else None)
+            if upd is None:
+                upd = self._run_window()
+            emitted.update(upd)
         return emitted
 
     # ---- conveniences ----

@@ -53,6 +53,7 @@ class _LLMServerImpl:
         self.model_cfg = model_cfg
         self._base_params = self.engine.params
         self._adapters: dict[str, object] = {}
+        self._guide_cache: dict[str, object] = {}
         self._waiters: dict[int, tuple] = {}  # rid -> (loop, future)
         self._token_subs: dict[int, "queue.Queue"] = {}  # rid -> token queue
         self._lock = threading.Lock()
@@ -96,15 +97,45 @@ class _LLMServerImpl:
                 loop.call_soon_threadsafe(fut.set_result, req)
 
     async def _submit(self, prompt_ids, max_new_tokens, temperature,
-                      top_p=1.0, top_k=0):
+                      top_p=1.0, top_k=0, guide=None):
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
         with self._lock:
             rid = self.engine.add_request(prompt_ids, max_new_tokens,
                                           temperature, top_p=top_p,
-                                          top_k=top_k)
+                                          top_k=top_k, guide=guide)
             self._waiters[rid] = (loop, fut)
         return await fut
+
+    def _resolve_guide(self, guided_regex=None, guided_json=None):
+        """Compile (and cache) a TokenGuide from the vLLM-style request
+        fields. Compilation is per-pattern, not per-request — repeated
+        schemas (the common case for structured extraction) hit the
+        cache."""
+        if guided_regex is None and guided_json is None:
+            return None
+        from ray_tpu.llm.guided import (compile_token_guide,
+                                        json_schema_to_regex)
+        if guided_json is not None:
+            pattern = json_schema_to_regex(guided_json)
+        else:
+            pattern = guided_regex
+        g = self._guide_cache.get(pattern)
+        if g is None:
+            g = compile_token_guide(pattern, self.tokenizer,
+                                    self.model_cfg.vocab,
+                                    self.engine.e.eos_token)
+            # Bounded LRU: patterns are user-supplied and each table is
+            # [n_states, vocab] int32 — an unbounded cache is a
+            # client-controllable memory leak in a long-lived replica.
+            while len(self._guide_cache) >= 64:
+                self._guide_cache.pop(next(iter(self._guide_cache)))
+            self._guide_cache[pattern] = g
+        else:
+            # refresh recency (dict preserves insertion order)
+            self._guide_cache.pop(pattern, None)
+        self._guide_cache[pattern] = g
+        return g
 
     # ---- model multiplexing (LoRA) ----
 
@@ -176,14 +207,16 @@ class _LLMServerImpl:
 
     async def completions(self, prompt: str, *, max_tokens=None,
                           temperature=None, top_p: float = 1.0,
-                          top_k: int = 0, model=None) -> dict:
+                          top_k: int = 0, model=None, guided_regex=None,
+                          guided_json=None) -> dict:
         # Adapter swap: engine params are per-step state, so point the
         # engine at the requested tree. Mixed-adapter batches decode with
         # the most recent selection (documented simplification).
         self.engine.params = self._params_for(model)
+        guide = self._resolve_guide(guided_regex, guided_json)
         ids = self.tokenizer.encode(prompt)
         req = await self._submit(ids, max_tokens, temperature,
-                                 top_p=top_p, top_k=top_k)
+                                 top_p=top_p, top_k=top_k, guide=guide)
         text = self.tokenizer.decode(req.generated)
         return {
             "id": f"cmpl-{uuid.uuid4().hex[:24]}",
@@ -198,13 +231,16 @@ class _LLMServerImpl:
 
     async def chat(self, messages: list, *, max_tokens=None,
                    temperature=None, top_p: float = 1.0, top_k: int = 0,
-                   model=None) -> dict:
+                   model=None, guided_regex=None,
+                   guided_json=None) -> dict:
         prompt = "".join(
             f"<|{m.get('role', 'user')}|>{m.get('content', '')}"
             for m in messages) + "<|assistant|>"
         out = await self.completions(prompt, max_tokens=max_tokens,
                                      temperature=temperature, top_p=top_p,
-                                     top_k=top_k, model=model)
+                                     top_k=top_k, model=model,
+                                     guided_regex=guided_regex,
+                                     guided_json=guided_json)
         return {
             "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
             "object": "chat.completion",
@@ -257,6 +293,22 @@ class _LLMServerImpl:
 
     def __del__(self):
         self._stop = True
+
+
+def _guided_fields(body: dict):
+    """vLLM-style guided_regex/guided_json fields, plus the OpenAI
+    response_format json_schema spelling."""
+    guided_regex = body.get("guided_regex")
+    guided_json = body.get("guided_json")
+    rf = body.get("response_format")
+    if guided_json is None and isinstance(rf, dict):
+        if rf.get("type") == "json_schema":
+            guided_json = rf.get("json_schema", {}).get("schema", {})
+        elif rf.get("type") == "json_object":
+            # a free-form JSON OBJECT (flat: scalar values — see
+            # json_schema_to_regex's depth-1 approximation)
+            guided_json = {"type": "object"}
+    return guided_regex, guided_json
 
 
 class _OpenAiRouterImpl:
@@ -318,6 +370,7 @@ class _OpenAiRouterImpl:
         except json.JSONDecodeError:
             return 400, {"error": "invalid JSON body"}
         try:
+            guided_regex, guided_json = _guided_fields(body)
             if path == "/v1/completions":
                 return await self.server.completions.remote(
                     body.get("prompt", ""),
@@ -325,7 +378,8 @@ class _OpenAiRouterImpl:
                     temperature=body.get("temperature"),
                     top_p=body.get("top_p", 1.0),
                     top_k=body.get("top_k", 0),
-                    model=body.get("model"))
+                    model=body.get("model"),
+                    guided_regex=guided_regex, guided_json=guided_json)
             if path == "/v1/chat/completions":
                 return await self.server.chat.remote(
                     body.get("messages", []),
@@ -333,7 +387,8 @@ class _OpenAiRouterImpl:
                     temperature=body.get("temperature"),
                     top_p=body.get("top_p", 1.0),
                     top_k=body.get("top_k", 0),
-                    model=body.get("model"))
+                    model=body.get("model"),
+                    guided_regex=guided_regex, guided_json=guided_json)
         except Exception as e:  # noqa: BLE001 — surface as API error
             return 400, {"error": str(e)}
         return 404, {"error": f"no route {path}"}

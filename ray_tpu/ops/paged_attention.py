@@ -68,17 +68,23 @@ def _paged_decode_xla(q, k_pages, v_pages, lengths, page_tables):
 def _dma_kernel(lengths_ref, tables_ref,  # scalar prefetch (SMEM)
                 q_ref, k_hbm, v_hbm, o_ref,
                 kbuf, vbuf, m_ref, l_ref, acc_ref, sem, *, page: int,
-                scale: float, pages_per_seq: int):
+                scale: float, pages_per_seq: int, n_q: int = 1):
     """One grid step per slot; the slot's pages stream HBM->VMEM through
     a two-deep manual DMA pipeline (page i+1 in flight while page i is in
     the flash update). One grid step per slot keeps grid overhead off the
     hot path — a BlockSpec-per-page variant spends more time stepping the
     grid than computing (measured ~0.8ms per layer call vs ~0.2ms for
-    this shape)."""
+    this shape).
+
+    n_q > 1 (speculative verify): the q block carries n_q query tokens per
+    slot folded into the head-group axis with the query index MINOR
+    ([hkv, g*n_q, hd], layout [g, n_q]); query j sits at absolute position
+    lengths-1+j, so its causal limit is lengths+j. The flash accumulators
+    simply widen by n_q rows."""
     b = pl.program_id(0)
     length = lengths_ref[b]
     npg = jnp.minimum(
-        jax.lax.div(length + page - 1, page), pages_per_seq)
+        jax.lax.div(length + (n_q - 1) + page - 1, page), pages_per_seq)
 
     def start_copy(i, slot):
         pid = tables_ref[b, i]
@@ -119,7 +125,14 @@ def _dma_kernel(lengths_ref, tables_ref,  # scalar prefetch (SMEM)
             preferred_element_type=jnp.float32) * scale   # [hkv, g, page]
         pos = i * page + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, dimension=2)
-        s = jnp.where(pos < length, s, _NEG)
+        if n_q == 1:
+            limit = length
+        else:
+            # row r of the folded axis is query j = r % n_q
+            limit = length + jax.lax.rem(
+                jax.lax.broadcasted_iota(jnp.int32, s.shape, dimension=1),
+                n_q)
+        s = jnp.where(pos < limit, s, _NEG)
         m_old = m_ref[...]                             # [hkv*g, 128]
         s2 = s.reshape(hkv * g, page)
         m_cur = jnp.max(s2, axis=1, keepdims=True)
@@ -163,8 +176,8 @@ def _paged_decode_dma(q, k_pages, v_pages, lengths, page_tables, *,
             in_specs=[
                 pl.BlockSpec((1, hkv, g, hd),
                              lambda b, lens, tbl: (b, 0, 0, 0)),
-                pl.BlockSpec(memory_space=pltpu.ANY),   # k_pages in HBM
-                pl.BlockSpec(memory_space=pltpu.ANY),   # v_pages in HBM
+                pl.BlockSpec(memory_space=pl.ANY),   # k_pages in HBM
+                pl.BlockSpec(memory_space=pl.ANY),   # v_pages in HBM
             ],
             out_specs=pl.BlockSpec((1, hkv, g, hd),
                                    lambda b, lens, tbl: (b, 0, 0, 0)),
@@ -183,6 +196,359 @@ def _paged_decode_dma(q, k_pages, v_pages, lengths, page_tables, *,
         interpret=interpret,
     )(lengths, page_tables, q4, k_pages, v_pages)
     return out.reshape(B, h, hd)
+
+
+def _fused_kernel(lengths_ref, tables_ref,  # scalar prefetch (SMEM)
+                  q_ref, knew_ref, vnew_ref, k_hbm, v_hbm,
+                  o_ref, ko_ref, vo_ref,
+                  kbuf, vbuf, m_ref, l_ref, acc_ref, sem, wsem, *,
+                  page: int, scale: float, pages_per_seq: int, n_q: int,
+                  layer: int):
+    """Verify attention with the KV INSERT fused in (JetStream-style):
+    the kernel already streams every page of the slot; when the page
+    holding the n_q new tokens passes through VMEM, their K/V columns are
+    merged in (one [hkv*hd, n_q] x [n_q, page] one-hot matmul) and the
+    merged page is DMAd back to the pool, which is input/output-aliased.
+    Token-granular XLA scatters serialized at ~2us/row and cost more than
+    the whole forward; here the write rides the DMA pipeline the attend
+    already pays for."""
+    b = pl.program_id(0)
+    length = lengths_ref[b]          # = base + 1 (limit of query 0)
+    base = length - 1                # position of the first new token
+    npg = jnp.minimum(
+        jax.lax.div(length + (n_q - 1) + page - 1, page), pages_per_seq)
+
+    def start_copy(i, slot):
+        pid = tables_ref[b, i]
+        pltpu.make_async_copy(
+            k_hbm.at[layer, :, pid], kbuf.at[slot], sem.at[slot, 0]).start()
+        pltpu.make_async_copy(
+            v_hbm.at[layer, :, pid], vbuf.at[slot], sem.at[slot, 1]).start()
+
+    def wait_copy(slot):
+        pltpu.make_async_copy(
+            k_hbm.at[layer, :, 0], kbuf.at[slot], sem.at[slot, 0]).wait()
+        pltpu.make_async_copy(
+            v_hbm.at[layer, :, 0], vbuf.at[slot], sem.at[slot, 1]).wait()
+
+    m_ref[...] = jnp.full_like(m_ref, _NEG)
+    l_ref[...] = jnp.zeros_like(l_ref)
+    acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(npg > 0)
+    def _first():
+        start_copy(0, 0)
+
+    q = q_ref[0].astype(jnp.float32)               # [hkv, g*n_q, hd]
+    hkv, gq, hd = q.shape
+    # page-padded new-token blocks in NATIVE dtype (bitwise-exact writes)
+    knew = knew_ref[0]                             # [hkv*hd, n_q]
+    vnew = vnew_ref[0]
+    zpad = jnp.zeros((knew.shape[0], page - n_q), knew.dtype)
+    knew_pad = jnp.concatenate([knew, zpad], axis=1)
+    vnew_pad = jnp.concatenate([vnew, zpad.astype(vnew.dtype)], axis=1)
+
+    def body(i, _):
+        slot = jax.lax.rem(i, 2)
+
+        @pl.when(i + 1 < npg)
+        def _prefetch():
+            start_copy(i + 1, 1 - slot)
+
+        wait_copy(slot)
+
+        # ---- fused insert: this page holds new-token positions? ----
+        lo, hi = i * page, (i + 1) * page
+        overlaps = (lo <= base + n_q - 1) & (hi > base)
+
+        @pl.when(overlaps)
+        def _merge():
+            pid = tables_ref[b, i]
+            # Token j lands at column base+j-lo. Shift the (page-padded)
+            # new-token block so column p holds token p-(base-lo), then
+            # select the covered columns. Roll+select keeps the written
+            # values BITWISE exact — a one-hot matmul merge would round
+            # through the MXU's bf16 multiply and break the speculative
+            # greedy-exactness contract.
+            cols = jax.lax.broadcasted_iota(jnp.int32, (1, page), 1)
+            idx = cols - (base - lo)
+            sel = (idx >= 0) & (idx < n_q)             # [1, page]
+            shift = jax.lax.rem(base - lo + page, page)
+            # roll only lowers for 32-bit lanes; bf16 -> f32 -> bf16 is
+            # exact (f32 is a superset), so the write stays bitwise
+            newk = pltpu.roll(knew_pad.astype(jnp.float32), shift,
+                              1).reshape(hkv, hd, page)
+            newv = pltpu.roll(vnew_pad.astype(jnp.float32), shift,
+                              1).reshape(hkv, hd, page)
+            sel = sel.reshape(1, 1, page)
+            kbuf[slot] = jnp.where(sel, newk.astype(kbuf.dtype),
+                                   kbuf[slot])
+            vbuf[slot] = jnp.where(sel, newv.astype(vbuf.dtype),
+                                   vbuf[slot])
+            # write the merged page back to the (aliased) pool
+            pltpu.make_async_copy(
+                kbuf.at[slot], k_hbm.at[layer, :, pid], wsem.at[0]).start()
+            pltpu.make_async_copy(
+                vbuf.at[slot], v_hbm.at[layer, :, pid], wsem.at[1]).start()
+            pltpu.make_async_copy(
+                kbuf.at[slot], k_hbm.at[layer, :, pid], wsem.at[0]).wait()
+            pltpu.make_async_copy(
+                vbuf.at[slot], v_hbm.at[layer, :, pid], wsem.at[1]).wait()
+
+        k = kbuf[slot].astype(jnp.float32)             # [hkv, hd, page]
+        v = vbuf[slot].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale  # [hkv, gq, page]
+        pos = i * page + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, dimension=2)
+        limit = length + jax.lax.rem(
+            jax.lax.broadcasted_iota(jnp.int32, s.shape, dimension=1),
+            n_q)
+        s = jnp.where(pos < limit, s, _NEG)
+        m_old = m_ref[...]
+        s2 = s.reshape(hkv * gq, page)
+        m_cur = jnp.max(s2, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_old, jnp.broadcast_to(m_cur, m_old.shape))
+        alpha = jnp.exp(m_old[:, :1] - m_new[:, :1])
+        p_exp = jnp.exp(s2 - m_new[:, :1])
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(
+            p_exp, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p_exp.reshape(hkv, gq, page), v,
+            (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        acc_ref[...] = acc_ref[...] * alpha[:, None].reshape(
+            hkv, gq, 1) + pv
+        m_ref[...] = m_new
+        return 0
+
+    jax.lax.fori_loop(0, npg, body, 0)
+    l = l_ref[...][:, :1]
+    l = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc_ref[...] / l.reshape(hkv, gq, 1)).astype(o_ref.dtype)
+
+
+def paged_verify_insert_attention(q, pool_k, pool_v, knew, vnew,
+                                  lengths, page_tables, layer: int, *,
+                                  interpret: bool | None = None):
+    """Fused insert+attend for the speculative verify step, against ONE
+    layer of the stacked pools.
+
+    q [B, S, h, hd]; knew/vnew [B, S, hkv, hd] are the S new tokens'
+    K/V, written into pool[layer] at positions lengths-1..lengths-1+S-1
+    as a side effect (the pools are input/output-aliased, so the caller
+    gets the same buffers back — no copies); query j attends
+    pos < lengths + j. Returns (attn [B, S, h, hd], pool_k, pool_v)."""
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    page, hd = pool_k.shape[4], pool_k.shape[3]
+    # Interpret mode does not propagate the kernel's in-place HBM
+    # writebacks through the input/output aliasing (verified empirically:
+    # the aliased outputs come back unmodified), so CPU paths — tests and
+    # the multichip dryrun — take the XLA insert+attend fallback. The
+    # Mosaic path also needs (8, 128)-tileable page slices.
+    if interpret or page % 128 or hd % 8:
+        return _verify_insert_xla(q, pool_k, pool_v, knew, vnew,
+                                  lengths, page_tables, layer)
+    return _verify_insert_dma(q, pool_k, pool_v, knew, vnew, lengths,
+                              page_tables, layer=layer,
+                              interpret=False)
+
+
+@functools.partial(jax.jit, static_argnames=("layer",))
+def _verify_insert_xla(q, pool_k, pool_v, knew, vnew, lengths,
+                       page_tables, layer):
+    pool_k, pool_v = _insert_tokens_xla(pool_k, pool_v, knew, vnew,
+                                        lengths, page_tables, layer)
+    out = paged_verify_attention_reference(q, pool_k[layer],
+                                           pool_v[layer], lengths,
+                                           page_tables)
+    return out, pool_k, pool_v
+
+
+def _insert_tokens_xla(pool_k, pool_v, knew, vnew, lengths,
+                       page_tables, layer):
+    """Token-scatter fallback insert (CPU tests / odd shapes)."""
+    B, S = knew.shape[:2]
+    hkv = pool_k.shape[1]
+    page = pool_k.shape[4]
+    P = page_tables.shape[1]
+    positions = (lengths - 1)[:, None] + jnp.arange(S)[None]
+    w_idx = jnp.clip(positions // page, 0, P - 1)
+    w_page = jnp.take_along_axis(page_tables, w_idx, 1)
+    w_page = jnp.where(positions // page >= P, 0, w_page)
+    w_off = positions % page
+    hkv_idx = jnp.arange(hkv)[:, None, None]
+    pool_k = pool_k.at[layer, hkv_idx, w_page[None], :, w_off[None]].set(
+        knew.transpose(2, 0, 1, 3).astype(pool_k.dtype))
+    pool_v = pool_v.at[layer, hkv_idx, w_page[None], :, w_off[None]].set(
+        vnew.transpose(2, 0, 1, 3).astype(pool_v.dtype))
+    return pool_k, pool_v
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "layer"),
+                   donate_argnums=(1, 2))
+def _verify_insert_dma(q, k_pages, v_pages, knew, vnew, lengths,
+                       page_tables, *, layer: int = 0,
+                       interpret: bool = False):
+    B, S, h, hd = q.shape
+    L, hkv, N, _, page = k_pages.shape
+    assert h % hkv == 0, (h, hkv)
+    g = h // hkv
+    P = page_tables.shape[1]
+    q4 = q.reshape(B, S, hkv, g, hd).transpose(0, 2, 3, 1, 4).reshape(
+        B, hkv, g * S, hd)
+    # [B, S, hkv, hd] -> [B, hkv*hd, S] for the in-kernel one-hot matmul
+    kn = knew.transpose(0, 2, 3, 1).reshape(B, hkv * hd, S)
+    vn = vnew.transpose(0, 2, 3, 1).reshape(B, hkv * hd, S)
+    scale = 1.0 / float(np.sqrt(hd))
+    kernel = functools.partial(_fused_kernel, page=page, scale=scale,
+                               pages_per_seq=P, n_q=S, layer=layer)
+    out, k_pages, v_pages = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B,),
+            in_specs=[
+                pl.BlockSpec((1, hkv, g * S, hd),
+                             lambda b, lens, tbl: (b, 0, 0, 0)),
+                pl.BlockSpec((1, hkv * hd, S),
+                             lambda b, lens, tbl: (b, 0, 0)),
+                pl.BlockSpec((1, hkv * hd, S),
+                             lambda b, lens, tbl: (b, 0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),      # k_pages in HBM
+                pl.BlockSpec(memory_space=pl.ANY),      # v_pages in HBM
+            ],
+            out_specs=[
+                pl.BlockSpec((1, hkv, g * S, hd),
+                             lambda b, lens, tbl: (b, 0, 0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),      # aliased k_pages
+                pl.BlockSpec(memory_space=pl.ANY),      # aliased v_pages
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((2, hkv, hd, page), k_pages.dtype),  # kbuf
+                pltpu.VMEM((2, hkv, hd, page), v_pages.dtype),  # vbuf
+                pltpu.VMEM((hkv * g * S, 128), jnp.float32),    # m
+                pltpu.VMEM((hkv * g * S, 128), jnp.float32),    # l
+                pltpu.VMEM((hkv, g * S, hd), jnp.float32),      # acc
+                pltpu.SemaphoreType.DMA((2, 2)),
+                pltpu.SemaphoreType.DMA((2,)),                  # writeback
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((B, hkv, g * S, hd), q.dtype),
+            jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+            jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
+        ],
+        # operand indices count the scalar-prefetch args first:
+        # 0=lengths 1=tables 2=q 3=knew 4=vnew 5=k_pages 6=v_pages
+        input_output_aliases={5: 1, 6: 2},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(lengths, page_tables, q4, kn, vn, k_pages, v_pages)
+    out = out.reshape(B, hkv, g, S, hd).transpose(0, 3, 1, 2, 4).reshape(
+        B, S, h, hd)
+    return out, k_pages, v_pages
+
+
+def paged_verify_attention(q, k_pages, v_pages, lengths, page_tables, *,
+                           interpret: bool | None = None):
+    """Multi-query paged attention for speculative verify: q [B, S, h, hd]
+    holds S query tokens per slot at consecutive positions, whose KV is
+    already written to the pool; query j attends pos < lengths + j
+    (`lengths` = the causal limit of query 0, i.e. its position + 1).
+    Returns [B, S, h, hd]. Same DMA pipeline as decode — the S queries
+    fold into the head-group axis, so verifying K drafts costs ONE pass
+    over the slot's pages instead of K+1."""
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    page, hd = k_pages.shape[3], k_pages.shape[2]
+    if not interpret and (page % 128 or hd % 8):
+        return _paged_verify_xla(q, k_pages, v_pages, lengths, page_tables)
+    return _paged_verify_dma(q, k_pages, v_pages, lengths, page_tables,
+                             interpret=interpret)
+
+
+@jax.jit
+def _paged_verify_xla(q, k_pages, v_pages, lengths, page_tables):
+    return paged_verify_attention_reference(q, k_pages, v_pages, lengths,
+                                            page_tables)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _paged_verify_dma(q, k_pages, v_pages, lengths, page_tables, *,
+                      interpret: bool = False):
+    B, S, h, hd = q.shape
+    hkv, N, _, page = k_pages.shape
+    assert h % hkv == 0, (h, hkv)
+    g = h // hkv
+    P = page_tables.shape[1]
+    # fold queries into the group axis, query index MINOR: [g, S]
+    q4 = q.reshape(B, S, hkv, g, hd).transpose(0, 2, 3, 1, 4).reshape(
+        B, hkv, g * S, hd)
+    scale = 1.0 / float(np.sqrt(hd))
+    kernel = functools.partial(_dma_kernel, page=page, scale=scale,
+                               pages_per_seq=P, n_q=S)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B,),
+            in_specs=[
+                pl.BlockSpec((1, hkv, g * S, hd),
+                             lambda b, lens, tbl: (b, 0, 0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),      # k_pages in HBM
+                pl.BlockSpec(memory_space=pl.ANY),      # v_pages in HBM
+            ],
+            out_specs=pl.BlockSpec((1, hkv, g * S, hd),
+                                   lambda b, lens, tbl: (b, 0, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((2, hkv, hd, page), k_pages.dtype),  # kbuf
+                pltpu.VMEM((2, hkv, hd, page), v_pages.dtype),  # vbuf
+                pltpu.VMEM((hkv * g * S, 128), jnp.float32),    # m
+                pltpu.VMEM((hkv * g * S, 128), jnp.float32),    # l
+                pltpu.VMEM((hkv, g * S, hd), jnp.float32),      # acc
+                pltpu.SemaphoreType.DMA((2, 2)),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, hkv, g * S, hd), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(lengths, page_tables, q4, k_pages, v_pages)
+    return out.reshape(B, hkv, g, S, hd).transpose(0, 3, 1, 2, 4).reshape(
+        B, S, h, hd)
+
+
+def paged_verify_attention_reference(q, k_pages, v_pages, lengths,
+                                     page_tables):
+    """Dense reference for the verify path: gather pages, per-query causal
+    mask (query j: pos < lengths + j), softmax."""
+    B, S, h, hd = q.shape
+    hkv, N, _, page = k_pages.shape
+    g = h // hkv
+    P = page_tables.shape[1]
+    T = P * page
+    ck = k_pages[:, page_tables]          # [hkv, B, P, hd, page]
+    cv = v_pages[:, page_tables]
+    ck = jnp.moveaxis(ck, 0, 1).transpose(0, 1, 2, 4, 3).reshape(
+        B, hkv, T, hd)
+    cv = jnp.moveaxis(cv, 0, 1).transpose(0, 1, 2, 4, 3).reshape(
+        B, hkv, T, hd)
+    q5 = q.reshape(B, S, hkv, g, hd).transpose(0, 2, 3, 1, 4).astype(
+        jnp.float32)                      # [B, hkv, g, S, hd]
+    s = jnp.einsum("bkgsd,bktd->bkgst", q5, ck.astype(jnp.float32))
+    s = s / np.sqrt(hd)
+    limit = lengths[:, None] + jnp.arange(S)[None]          # [B, S]
+    mask = (jnp.arange(T)[None, None, None, None]
+            < limit[:, None, None, :, None])
+    s = jnp.where(mask, s, -jnp.inf)
+    pr = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bkgst,bktd->bkgsd", pr, cv.astype(jnp.float32))
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, S, h, hd).astype(
+        q.dtype)
 
 
 def paged_decode_attention_reference(q, k_pages, v_pages, lengths,
