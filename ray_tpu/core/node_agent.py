@@ -50,6 +50,13 @@ class _AgentWorker:
         self.send_lock = threading.Lock()
         self.proc = proc
         self.buffer = FrameBuffer()
+        # Lease frames stage here (appended under the agent's lease lock,
+        # so reg_fn/exec ordering is the lock order) and drain under
+        # flush_lock: two _pump_leases threads sending directly could
+        # otherwise reorder a bare exec ahead of the reg_fn that its
+        # fn_id registration rode in on.
+        self.outbox: list = []
+        self.flush_lock = threading.Lock()
 
 
 class _PeerConn:
@@ -442,7 +449,7 @@ class NodeAgent:
                     if (wid in self.worker_actor
                             or self.worker_env_key.get(wid)):
                         continue
-                    frames = per_worker.setdefault(wid, (w, []))[1]
+                    frames = []
                     while (self._lease_q
                            and self._worker_load.get(wid, 0) < depth):
                         spec = self._lease_q.popleft()
@@ -457,18 +464,28 @@ class NodeAgent:
                                     ("reg_fn", spec.fn_id, blob))
                             fns.add(spec.fn_id)
                         frames.append(("exec", spec))
+                    if frames:
+                        # Stage under the lease lock: outbox order == the
+                        # order fn registrations were decided in, so a
+                        # concurrent pump's bare exec for the same fn_id
+                        # can never drain ahead of its reg_fn.
+                        w.outbox.extend(frames)
+                        per_worker[wid] = w
                 spawn = (bool(self._lease_q)
                          and (len(self.workers) + self._spawns_pending)
                          < self.max_workers)
                 if spawn:
                     self._spawns_pending += 1
-        for w, frames in per_worker.values():
-            if not frames:
-                continue
+        for w in per_worker.values():
             try:
-                send_msg(w.sock,
-                         frames[0] if len(frames) == 1
-                         else ("batch", frames), w.send_lock)
+                with w.flush_lock:
+                    with self._lease_lock:
+                        frames, w.outbox = w.outbox, []
+                    if not frames:
+                        continue
+                    send_msg(w.sock,
+                             frames[0] if len(frames) == 1
+                             else ("batch", frames), w.send_lock)
             except OSError:
                 pass  # _on_worker_eof lease-fails the inflight entries
         if spawn:
@@ -937,10 +954,16 @@ def main(argv=None):
                    help="extra resources as JSON")
     p.add_argument("--object-store-memory", type=int, default=0)
     p.add_argument("--node-ip", type=str, default="127.0.0.1")
+    p.add_argument("--watch-parent", type=int, default=0,
+                   help="self-terminate when this pid exits (the raylet "
+                        "parent-death watch)")
     p.add_argument("--node-id", type=str, default="",
                    help="hex node id (assigned by the launcher; random if "
                         "empty)")
     args = p.parse_args(argv)
+    if args.watch_parent:
+        from ray_tpu.cli import _watch_parent
+        _watch_parent(args.watch_parent)
     agent = NodeAgent(
         args.head, num_cpus=args.num_cpus, num_tpus=args.num_tpus,
         resources=json.loads(args.resources),

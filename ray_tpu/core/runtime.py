@@ -48,25 +48,62 @@ from ray_tpu.core.task import ActorCreationSpec, TaskSpec
 from ray_tpu.core.transport import FrameBuffer, send_msg
 
 def _reap_stale_stores(shm_dir: str):
-    """Unlink arenas whose head process died without shutdown()."""
+    """Unlink arenas whose head process died without shutdown(), and kill
+    worker processes orphaned by such a death — a SIGKILLed driver leaves
+    zygote workers holding the (unlinked) arena mapping forever otherwise
+    (observed: 3 zygotes + a 20GB arena surviving a killed test run)."""
     import glob as _glob
-    for path in _glob.glob(os.path.join(shm_dir, "ray_tpu_*")):
-        parts = os.path.basename(path).split("_")
+
+    def _driver_pid(name: str) -> int | None:
+        parts = name.split("_")
         if len(parts) < 3:
-            continue
+            return None
         try:
-            pid = int(parts[2])
+            return int(parts[2])
         except ValueError:
-            continue  # old unversioned name; leave it
+            return None  # old unversioned name; leave it
+
+    def _alive(pid: int) -> bool:
         try:
             os.kill(pid, 0)
+            return True
         except ProcessLookupError:
+            return False
+        except PermissionError:
+            return True  # alive, owned by someone else
+
+    for path in _glob.glob(os.path.join(shm_dir, "ray_tpu_*")):
+        pid = _driver_pid(os.path.basename(path))
+        if pid is not None and not _alive(pid):
             try:
                 os.unlink(path)
             except OSError:
                 pass
-        except PermissionError:
-            pass  # alive, owned by someone else
+    # Orphaned workers: cmdline `... -m ray_tpu.core.worker [--zygote]
+    # <arena path>`; reap when the arena's driver pid is dead.
+    try:
+        pids = [int(d) for d in os.listdir("/proc") if d.isdigit()]
+    except OSError:
+        return
+    for pid in pids:
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                argv = f.read().split(b"\0")
+        except OSError:
+            continue
+        if b"ray_tpu.core.worker" not in argv:
+            continue
+        for arg in argv:
+            name = os.path.basename(arg.decode("utf-8", "replace"))
+            if not name.startswith("ray_tpu_"):
+                continue
+            drv = _driver_pid(name)
+            if drv is not None and not _alive(drv):
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except OSError:
+                    pass
+                break
 
 
 IDLE, BUSY, ASSIGNED_ACTOR, DEAD = "idle", "busy", "actor", "dead"
@@ -4859,6 +4896,15 @@ class Runtime:
             self._log_monitor.stop()
         self.store.close()
         self.store.unlink()
+        # Worker peer sockets (`<arena>_w<id>.sock`) belong to worker
+        # processes we may have just killed mid-unlink; sweep them so a
+        # clean shutdown leaves /dev/shm empty.
+        import glob as _glob
+        for p in _glob.glob(self.store.path + "_w*.sock"):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
 
 
 # ---------------- global runtime plumbing ----------------

@@ -44,7 +44,8 @@ def _spawn_head(port, journal):
            "JAX_PLATFORMS": "cpu"}
     return subprocess.Popen(
         [sys.executable, "-m", "ray_tpu", "start", "--head", "--block",
-         "--port", str(port), "--num-cpus", "1"],
+         "--port", str(port), "--num-cpus", "1",
+         "--watch-parent", str(os.getpid())],
         env=env, start_new_session=True,
         stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
 
@@ -59,7 +60,8 @@ def test_head_restart_adopts_actors_and_finishes_queued_task(tmp_path):
         agent = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.core.node_agent",
              "--head", f"127.0.0.1:{port}", "--num-cpus", "2",
-             "--resources", '{"agent": 1}'],
+             "--resources", '{"agent": 1}',
+             "--watch-parent", str(os.getpid())],
             env={**os.environ, "JAX_PLATFORMS": "cpu"},
             start_new_session=True,
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
