@@ -841,6 +841,286 @@ class KubernetesProvider(InstanceProvider):
         return KubectlCommandRunner(inst.instance_id, self.namespace)
 
 
+# ---------------------------------------------------------------------------
+# AWS (EC2 Query API over SigV4, stdlib-only)
+# ---------------------------------------------------------------------------
+
+def _sigv4_kdf(secret: str, date: str, region: str, service: str) -> bytes:
+    """AWS SigV4 signing-key derivation chain."""
+    import hashlib
+    import hmac
+
+    def h(key: bytes, msg: str) -> bytes:
+        return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+    k = h(("AWS4" + secret).encode(), date)
+    k = h(k, region)
+    k = h(k, service)
+    return h(k, "aws4_request")
+
+
+def sigv4_headers(method: str, host: str, path: str, query: str,
+                  body: str, region: str, service: str, access_key: str,
+                  secret_key: str, session_token: str = "",
+                  amz_date: str | None = None) -> dict:
+    """Signed headers for one request (AWS Signature Version 4,
+    implemented from the spec with the stdlib — the reference gets this
+    via botocore). `amz_date` is injectable for the known-vector test."""
+    import datetime
+    import hashlib
+    import hmac
+
+    if amz_date is None:
+        amz_date = datetime.datetime.now(datetime.timezone.utc).strftime(
+            "%Y%m%dT%H%M%SZ")
+    date = amz_date[:8]
+    content_type = "application/x-www-form-urlencoded; charset=utf-8"
+    headers = {"content-type": content_type, "host": host,
+               "x-amz-date": amz_date}
+    if session_token:
+        headers["x-amz-security-token"] = session_token
+    signed = ";".join(sorted(headers))
+    canonical_headers = "".join(
+        f"{k}:{headers[k].strip()}\n" for k in sorted(headers))
+    payload_hash = hashlib.sha256(body.encode()).hexdigest()
+    canonical = "\n".join([method, path, query, canonical_headers,
+                           signed, payload_hash])
+    scope = f"{date}/{region}/{service}/aws4_request"
+    to_sign = "\n".join([
+        "AWS4-HMAC-SHA256", amz_date, scope,
+        hashlib.sha256(canonical.encode()).hexdigest()])
+    key = _sigv4_kdf(secret_key, date, region, service)
+    sig = hmac.new(key, to_sign.encode(), hashlib.sha256).hexdigest()
+    out = {"Content-Type": content_type, "X-Amz-Date": amz_date,
+           "Authorization":
+               (f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+                f"SignedHeaders={signed}, Signature={sig}")}
+    if session_token:
+        out["X-Amz-Security-Token"] = session_token
+    return out
+
+
+def ec2_xml_to_obj(text: str):
+    """EC2 Query API XML -> dicts/lists: `<item>` sequences become
+    lists, leaves become strings."""
+    import xml.etree.ElementTree as ET
+
+    def conv(elem):
+        children = list(elem)
+        if not children:
+            return (elem.text or "").strip()
+        if all(c.tag.split("}")[-1] == "item" for c in children):
+            return [conv(c) for c in children]
+        out = {}
+        for c in children:
+            tag = c.tag.split("}")[-1]
+            out[tag] = conv(c)
+        return out
+
+    return conv(ET.fromstring(text))
+
+
+class AWSProvider(InstanceProvider):
+    """EC2 instances over the raw EC2 Query API.
+
+    Parity: `python/ray/autoscaler/_private/aws/node_provider.py` (which
+    wraps boto3); here the HTTP layer is a single injectable
+    `transport(action, params) -> dict` speaking the EC2 Query API
+    (RunInstances / DescribeInstances / TerminateInstances with
+    TagSpecification params), and the default transport signs requests
+    with SigV4 using only the stdlib — no SDK, unit-testable with zero
+    egress.
+
+    Bootstrap rides cloud-init user data by default (`bootstrap:
+    user_data` — the launch-template pattern), which makes the provider
+    self-bootstrapping like the K8s one; `bootstrap: ssh` switches to
+    the reference's SSH command-runner flow.
+
+    node_config keys understood: image_id, instance_type, key_name,
+    subnet_id, security_group_ids, iam_instance_profile, user_data.
+    """
+
+    API_VERSION = "2016-11-15"
+    self_bootstrapping = True
+
+    def __init__(self, provider_config, cluster_name, transport=None):
+        super().__init__(provider_config, cluster_name)
+        self.region = provider_config.get("region", "us-west-2")
+        self.transport = transport or self._default_transport
+        self.self_bootstrapping = (
+            provider_config.get("bootstrap", "user_data") == "user_data")
+        self._boot_cmds: dict[str, list[str]] = {}
+
+    def prepare_bootstrap(self, kind: str, cmds: list[str]):
+        self._boot_cmds[kind] = list(cmds)
+
+    # -- transport --------------------------------------------------------
+
+    def _credentials(self) -> tuple[str, str, str]:
+        c = self.config
+        return (c.get("access_key_id")
+                or os.environ.get("AWS_ACCESS_KEY_ID", ""),
+                c.get("secret_access_key")
+                or os.environ.get("AWS_SECRET_ACCESS_KEY", ""),
+                c.get("session_token")
+                or os.environ.get("AWS_SESSION_TOKEN", ""))
+
+    def _default_transport(self, action: str, params: dict) -> dict:
+        import urllib.parse
+        import urllib.request
+
+        from ray_tpu.util.retry import (RetryPolicy, call_with_retries,
+                                        http_should_retry)
+        host = f"ec2.{self.region}.amazonaws.com"
+        form = {"Action": action, "Version": self.API_VERSION, **params}
+        body = urllib.parse.urlencode(sorted(form.items()))
+        ak, sk, tok = self._credentials()
+        if not ak:
+            raise RuntimeError(
+                "aws provider: no credentials (set AWS_ACCESS_KEY_ID / "
+                "AWS_SECRET_ACCESS_KEY or provider.access_key_id)")
+
+        def once():
+            headers = sigv4_headers("POST", host, "/", "", body,
+                                    self.region, "ec2", ak, sk, tok)
+            req = urllib.request.Request(
+                f"https://{host}/", data=body.encode(), method="POST",
+                headers=headers)
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                payload = resp.read().decode()
+            return ec2_xml_to_obj(payload) if payload else {}
+
+        return call_with_retries(
+            once, policy=RetryPolicy(should_retry=http_should_retry))
+
+    # -- provider interface ----------------------------------------------
+
+    def _describe(self, *, filters=(), instance_ids=()) -> list[dict]:
+        params: dict = {}
+        for i, (name, values) in enumerate(filters, 1):
+            params[f"Filter.{i}.Name"] = name
+            for j, v in enumerate(values, 1):
+                params[f"Filter.{i}.Value.{j}"] = v
+        for i, iid in enumerate(instance_ids, 1):
+            params[f"InstanceId.{i}"] = iid
+        resp = self.transport("DescribeInstances", params)
+        rs = resp.get("reservationSet") or []
+        out: list[dict] = []
+        for r in (rs if isinstance(rs, list) else [rs]):
+            iset = r.get("instancesSet") or []
+            out.extend(iset if isinstance(iset, list) else [iset])
+        return out
+
+    @staticmethod
+    def _tags_of(inst: dict) -> dict:
+        tags = {}
+        ts = inst.get("tagSet") or []
+        for t in (ts if isinstance(ts, list) else [ts]):
+            k = t.get("key", "")
+            if k.startswith("ray-"):
+                tags[k[4:].replace("-", "_")] = t.get("value", "")
+        return tags
+
+    @staticmethod
+    def _ip_of(inst: dict) -> str:
+        return (inst.get("ipAddress")
+                or inst.get("privateIpAddress", "") or "")
+
+    def non_terminated_instances(self, tag_filters):
+        insts = self._describe(filters=[
+            ("tag:ray-cluster-name", [self.cluster_name]),
+            ("instance-state-name", ["pending", "running"]),
+        ])
+        out = []
+        for it in insts:
+            tags = self._tags_of(it)
+            if tags.pop("cluster_name", None) not in (None,
+                                                      self.cluster_name):
+                continue
+            if not all(tags.get(k) == v for k, v in tag_filters.items()):
+                continue
+            state = (it.get("instanceState") or {}).get("name", "running")
+            out.append(Instance(it.get("instanceId", ""),
+                                self._ip_of(it), tags, state))
+        return out
+
+    def create_instance(self, node_type, tags, auth,
+                        wait_timeout: float = 300.0):
+        import base64
+        nc = dict(node_type.node_config)
+        params = {
+            "ImageId": nc.get("image_id", ""),
+            "InstanceType": nc.get("instance_type", "m5.large"),
+            "MinCount": "1",
+            "MaxCount": "1",
+        }
+        if not params["ImageId"]:
+            raise ValueError(
+                f"node type {node_type.name!r}: node_config.image_id "
+                f"(an AMI) is required for the aws provider")
+        key_name = nc.get("key_name") or auth.get("key_name", "")
+        if key_name:
+            params["KeyName"] = key_name
+        if nc.get("subnet_id"):
+            params["SubnetId"] = nc["subnet_id"]
+        if nc.get("iam_instance_profile"):
+            params["IamInstanceProfile.Name"] = nc["iam_instance_profile"]
+        for j, sg in enumerate(nc.get("security_group_ids", []), 1):
+            params[f"SecurityGroupId.{j}"] = sg
+        all_tags = {
+            "ray-cluster-name": self.cluster_name,
+            "Name": (f"ray-{self.cluster_name}-"
+                     f"{tags.get('node_kind', 'worker')}"),
+        }
+        all_tags.update({f"ray-{k.replace('_', '-')}": v
+                         for k, v in tags.items()})
+        params["TagSpecification.1.ResourceType"] = "instance"
+        for j, (k, v) in enumerate(sorted(all_tags.items()), 1):
+            params[f"TagSpecification.1.Tag.{j}.Key"] = k
+            params[f"TagSpecification.1.Tag.{j}.Value"] = v
+        if self.self_bootstrapping:
+            kind = tags.get("node_kind", "worker")
+            cmds = self._boot_cmds.get(kind, [])
+            script = nc.get("user_data", "")
+            if cmds:
+                script = "#!/bin/sh\n" + "\n".join(cmds) + "\n"
+            if script:
+                params["UserData"] = base64.b64encode(
+                    script.encode()).decode()
+        resp = self.transport("RunInstances", params)
+        iset = resp.get("instancesSet") or []
+        inst = (iset if isinstance(iset, list) else [iset])[0]
+        iid = inst.get("instanceId", "")
+        ip = self._wait_running(iid, wait_timeout)
+        return Instance(iid, ip, dict(tags))
+
+    def _wait_running(self, instance_id: str,
+                      wait_timeout: float = 300.0) -> str:
+        deadline = time.monotonic() + wait_timeout
+        while time.monotonic() < deadline:
+            for it in self._describe(instance_ids=[instance_id]):
+                state = (it.get("instanceState") or {}).get("name", "")
+                ip = self._ip_of(it)
+                if state == "running" and ip:
+                    return ip
+                if state in ("terminated", "shutting-down"):
+                    raise RuntimeError(
+                        f"instance {instance_id} died during launch "
+                        f"({state})")
+            time.sleep(1.0)
+        raise TimeoutError(
+            f"instance {instance_id} not running after {wait_timeout}s")
+
+    def terminate_instance(self, instance_id):
+        self.transport("TerminateInstances", {"InstanceId.1": instance_id})
+
+    def command_runner(self, inst, auth):
+        return SSHCommandRunner(
+            inst.ip, ssh_user=auth.get("ssh_user", "ec2-user"),
+            ssh_key=auth.get("ssh_private_key", ""),
+            ssh_port=int(auth.get("ssh_port", 22)))
+
+
 class KubectlCommandRunner(CommandRunner):
     """exec/cp into a pod via the kubectl CLI (the K8s exec subresource
     needs a SPDY/websocket upgrade that plain REST can't carry). Only
@@ -884,6 +1164,7 @@ _PROVIDERS = {
     "ssh": SSHProvider,
     "gce": GCEProvider,
     "kubernetes": KubernetesProvider,
+    "aws": AWSProvider,
 }
 
 

@@ -1,0 +1,326 @@
+"""AWS provider: SigV4 signing, EC2 Query API flow, `ray up` e2e and
+demand autoscaling against a fake EC2 endpoint.
+
+Parity: reference `python/ray/autoscaler/_private/aws/node_provider.py`
+(boto3-backed); here the EC2 Query API is spoken directly over an
+injectable transport and requests are signed with a stdlib SigV4."""
+
+import base64
+import os
+import subprocess
+import sys
+
+from ray_tpu.autoscaler.launcher import (
+    AWSProvider,
+    ClusterConfig,
+    NodeTypeSpec,
+    create_or_update_cluster,
+    ec2_xml_to_obj,
+    sigv4_headers,
+    teardown_cluster,
+)
+
+
+def test_sigv4_known_vector():
+    """The AWS-documented SigV4 example request must produce the
+    documented signature (GET iam ListUsers, 20150830, us-east-1)."""
+    headers = sigv4_headers(
+        "GET", "iam.amazonaws.com", "/",
+        "Action=ListUsers&Version=2010-05-08", "",
+        "us-east-1", "iam", "AKIDEXAMPLE",
+        "wJalrXUtnFEMI/K7MDENG+bPxRfiCYEXAMPLEKEY",
+        amz_date="20150830T123600Z")
+    assert headers["Authorization"].endswith(
+        "Signature=5d672d79c15b13162d9279b0855cfba6789a8edb4c82c400e"
+        "06b5924a6f2b5d7")
+    assert "content-type;host;x-amz-date" in headers["Authorization"]
+
+
+def test_ec2_xml_parsing():
+    xml = """<?xml version="1.0"?>
+    <DescribeInstancesResponse xmlns="http://ec2.amazonaws.com/doc/2016-11-15/">
+      <reservationSet>
+        <item>
+          <instancesSet>
+            <item>
+              <instanceId>i-abc</instanceId>
+              <instanceState><code>16</code><name>running</name></instanceState>
+              <ipAddress>54.1.2.3</ipAddress>
+              <tagSet>
+                <item><key>ray-cluster-name</key><value>demo</value></item>
+                <item><key>ray-node-kind</key><value>head</value></item>
+              </tagSet>
+            </item>
+          </instancesSet>
+        </item>
+      </reservationSet>
+    </DescribeInstancesResponse>"""
+    obj = ec2_xml_to_obj(xml)
+    inst = obj["reservationSet"][0]["instancesSet"][0]
+    assert inst["instanceId"] == "i-abc"
+    assert inst["instanceState"]["name"] == "running"
+    assert inst["tagSet"][0]["key"] == "ray-cluster-name"
+
+
+class _FakeEC2:
+    """Fake EC2 Query API endpoint: dict-backed instances, records every
+    (action, params) call. With run_instances=True it also plays
+    cloud-init — a created instance's UserData script runs as a local
+    subprocess (the fake-multinode trick applied to the EC2 surface), so
+    `ray up` and the autoscaler exercise the REAL cluster plane."""
+
+    def __init__(self, run_instances=False):
+        self.calls = []
+        self.instances = {}
+        self.procs = {}
+        self.run_instances = run_instances
+        self._n = 0
+
+    def __call__(self, action, params):
+        self.calls.append((action, dict(params)))
+        if action == "RunInstances":
+            self._n += 1
+            iid = f"i-{self._n:08x}"
+            tags = []
+            j = 1
+            while f"TagSpecification.1.Tag.{j}.Key" in params:
+                tags.append({
+                    "key": params[f"TagSpecification.1.Tag.{j}.Key"],
+                    "value": params[f"TagSpecification.1.Tag.{j}.Value"]})
+                j += 1
+            self.instances[iid] = {
+                "instanceId": iid,
+                "instanceState": {"code": "16", "name": "running"},
+                "ipAddress": "127.0.0.1",
+                "privateIpAddress": "127.0.0.1",
+                "imageId": params.get("ImageId", ""),
+                "instanceType": params.get("InstanceType", ""),
+                "tagSet": tags,
+            }
+            if self.run_instances and params.get("UserData"):
+                script = base64.b64decode(params["UserData"]).decode()
+                env = dict(os.environ)
+                pkg = os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__)))
+                env["PYTHONPATH"] = (pkg + os.pathsep
+                                     + env.get("PYTHONPATH", ""))
+                env["PATH"] = (os.path.dirname(sys.executable)
+                               + os.pathsep + env.get("PATH", ""))
+                # Own session: termination kills the whole process TREE
+                # (a `ray_tpu start` daemonizes past its shell), the way
+                # instance termination kills the VM.
+                self.procs[iid] = subprocess.Popen(
+                    ["/bin/sh", "-c", script], env=env,
+                    start_new_session=True,
+                    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            return {"instancesSet": [self.instances[iid]]}
+        if action == "DescribeInstances":
+            insts = list(self.instances.values())
+            ids = [v for k, v in params.items()
+                   if k.startswith("InstanceId.")]
+            if ids:
+                insts = [i for i in insts if i["instanceId"] in ids]
+            i = 1
+            while f"Filter.{i}.Name" in params:
+                name = params[f"Filter.{i}.Name"]
+                vals = [v for k, v in params.items()
+                        if k.startswith(f"Filter.{i}.Value.")]
+                if name == "instance-state-name":
+                    insts = [x for x in insts
+                             if x["instanceState"]["name"] in vals]
+                elif name.startswith("tag:"):
+                    tk = name[4:]
+                    insts = [x for x in insts
+                             if any(t["key"] == tk and t["value"] in vals
+                                    for t in x["tagSet"])]
+                i += 1
+            return {"reservationSet": [{"instancesSet": insts}]}
+        if action == "TerminateInstances":
+            iid = params.get("InstanceId.1", "")
+            inst = self.instances.get(iid)
+            if inst is not None:
+                inst["instanceState"] = {"code": "48", "name": "terminated"}
+            proc = self.procs.pop(iid, None)
+            if proc is not None:
+                import signal
+                try:
+                    os.killpg(proc.pid, signal.SIGTERM)
+                except ProcessLookupError:
+                    pass
+                try:
+                    proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    try:
+                        os.killpg(proc.pid, signal.SIGKILL)
+                    except ProcessLookupError:
+                        pass
+            return {"instancesSet": [{"instanceId": iid}]}
+        return {}
+
+    @property
+    def running(self):
+        return [i for i in self.instances.values()
+                if i["instanceState"]["name"] == "running"]
+
+    def shutdown(self):
+        for iid in list(self.procs):
+            self("TerminateInstances", {"InstanceId.1": iid})
+
+
+def test_aws_provider_query_flow():
+    """create/list/terminate through the Query API: RunInstances carries
+    AMI, type, tags and user data; DescribeInstances filters by cluster
+    tag + state; TerminateInstances ends the lease."""
+    fake = _FakeEC2()
+    prov = AWSProvider({"region": "us-west-2"}, "demo", transport=fake)
+    prov.prepare_bootstrap("head", ["echo setup", "ray start --head"])
+    nt = NodeTypeSpec(name="cpu", resources={"CPU": 8},
+                      node_config={"image_id": "ami-123",
+                                   "instance_type": "m6i.2xlarge",
+                                   "subnet_id": "subnet-9",
+                                   "security_group_ids": ["sg-1", "sg-2"]})
+    inst = prov.create_instance(nt, {"node_kind": "head",
+                                     "node_type": "cpu"}, {})
+    assert inst.ip == "127.0.0.1"
+    action, params = fake.calls[0]
+    assert action == "RunInstances"
+    assert params["ImageId"] == "ami-123"
+    assert params["InstanceType"] == "m6i.2xlarge"
+    assert params["SubnetId"] == "subnet-9"
+    assert params["SecurityGroupId.2"] == "sg-2"
+    tag_kv = {params[f"TagSpecification.1.Tag.{j}.Key"]:
+              params[f"TagSpecification.1.Tag.{j}.Value"]
+              for j in range(1, 5)}
+    assert tag_kv["ray-cluster-name"] == "demo"
+    assert tag_kv["ray-node-kind"] == "head"
+    script = base64.b64decode(params["UserData"]).decode()
+    assert "ray start --head" in script
+
+    live = prov.non_terminated_instances({"node_kind": "head"})
+    assert [i.instance_id for i in live] == [inst.instance_id]
+    assert not prov.non_terminated_instances({"node_kind": "worker"})
+
+    prov.terminate_instance(inst.instance_id)
+    assert not prov.non_terminated_instances({"node_kind": "head"})
+    assert fake.calls[-2][0] == "TerminateInstances"
+
+
+def test_aws_missing_ami_fails_loudly():
+    import pytest
+    prov = AWSProvider({"region": "us-west-2"}, "demo",
+                       transport=_FakeEC2())
+    nt = NodeTypeSpec(name="cpu", resources={"CPU": 1}, node_config={})
+    with pytest.raises(ValueError, match="image_id"):
+        prov.create_instance(nt, {"node_kind": "head"}, {})
+
+
+def test_aws_up_down_end_to_end(tmp_path):
+    """`ray up` with the aws provider against the fake EC2 (instances
+    run their user data as local processes): head + min worker come up,
+    a driver reaches the cluster, `down` terminates every instance."""
+    import socket
+    import time
+
+    import ray_tpu
+    from ray_tpu.autoscaler import launcher as L
+
+    fake = _FakeEC2(run_instances=True)
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    cfg = ClusterConfig.from_dict({
+        "cluster_name": "awsdemo",
+        "provider": {"type": "aws", "region": "us-east-1"},
+        "head_port": port,
+        "available_node_types": {
+            "head": {"resources": {"CPU": 1},
+                     "node_config": {"image_id": "ami-head"}},
+            "worker": {"resources": {"CPU": 1}, "min_workers": 1,
+                       "node_config": {"image_id": "ami-worker"}},
+        },
+        "head_node_type": "head",
+    })
+    orig = L._PROVIDERS["aws"]
+    L._PROVIDERS["aws"] = (
+        lambda pc, name, **kw: orig(pc, name, transport=fake))
+    try:
+        address = create_or_update_cluster(cfg, verbose=False)
+        assert address.endswith(f":{port}")
+        kinds = sorted(
+            t["value"] for i in fake.running for t in i["tagSet"]
+            if t["key"] == "ray-node-kind")
+        assert kinds == ["head", "worker"]
+        deadline = time.monotonic() + 60
+        last = None
+        while time.monotonic() < deadline:
+            try:
+                ray_tpu.init(address=address)
+                break
+            except Exception as e:  # noqa: BLE001 — head still booting
+                last = e
+                time.sleep(1.0)
+        else:
+            raise AssertionError(f"head never came up: {last}")
+
+        @ray_tpu.remote
+        def f(x):
+            return x + 1
+
+        assert ray_tpu.get(f.remote(41), timeout=120) == 42
+        ray_tpu.shutdown()
+        teardown_cluster(cfg, verbose=False)
+        assert not fake.running and not fake.procs
+    finally:
+        L._PROVIDERS["aws"] = orig
+        fake.shutdown()
+
+
+def test_aws_autoscaler_scale_up_down():
+    """Demand-driven EC2 scale-up + idle scale-down through the existing
+    reconciler, instances running as real local node agents (fake
+    cloud-init)."""
+    import time
+
+    import ray_tpu
+    from ray_tpu.autoscaler import (Autoscaler, AutoscalingConfig,
+                                    AWSNodeProvider, NodeTypeConfig)
+
+    fake = _FakeEC2(run_instances=True)
+    rt = ray_tpu.init(num_cpus=1)
+    try:
+        provider = AWSNodeProvider(
+            {"region": "us-east-1",
+             "node_config": {"image_id": "ami-worker"}},
+            "awsscale", runtime=rt, transport=fake)
+        config = AutoscalingConfig(
+            node_types={"cpu2": NodeTypeConfig(resources={"CPU": 2},
+                                               max_workers=1)},
+            idle_timeout_s=3.0, reconcile_interval_s=0.25)
+        scaler = Autoscaler(config, provider, rt)
+        scaler.start()
+        try:
+            @ray_tpu.remote(num_cpus=1)
+            def burn(t):
+                time.sleep(t)
+                return ray_tpu.get_node_id()
+
+            refs = [burn.remote(4.0) for _ in range(6)]
+            spots = set(ray_tpu.get(refs, timeout=180))
+            assert len(spots) >= 2  # work spilled onto an autoscaled VM
+            assert any(a == "RunInstances" for a, _p in fake.calls)
+
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline and scaler.managed:
+                time.sleep(0.5)
+            assert not scaler.managed
+            # scale-down terminated the instance on the API side too
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline and fake.running:
+                time.sleep(0.3)
+            assert not fake.running
+        finally:
+            scaler.stop()
+    finally:
+        ray_tpu.shutdown()
+        fake.shutdown()
