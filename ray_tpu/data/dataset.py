@@ -425,6 +425,29 @@ class Dataset:
         """One avro object container file per block (built-in codec)."""
         self._write(path, "avro")
 
+    def write_bigquery(self, project_id: str, dataset: str, table: str,
+                       *, api_base: str | None = None,
+                       access_token: str = "") -> None:
+        """Stream blocks into a BigQuery table via `tabledata.insertAll`
+        (one remote task per block). Parity: the write side of the
+        reference's bigquery datasource."""
+        from ray_tpu.data.datasource import bq_insert_block_task
+        refs = [bq_insert_block_task.remote(bref, project_id, dataset,
+                                            table, api_base, access_token)
+                for bref, _m in self.iter_internal()]
+        ray_tpu.get(refs, timeout=600)
+
+    def write_clickhouse(self, table: str, *,
+                         url: str = "http://localhost:8123",
+                         user: str = "", password: str = "") -> None:
+        """INSERT blocks into ClickHouse over its HTTP interface
+        (JSONEachRow; one remote task per block)."""
+        from ray_tpu.data.datasource import clickhouse_insert_block_task
+        refs = [clickhouse_insert_block_task.remote(bref, table, url,
+                                                    user, password)
+                for bref, _m in self.iter_internal()]
+        ray_tpu.get(refs, timeout=600)
+
     def write_iceberg(self, path: str) -> None:
         """Write (or append a snapshot to) a file-system Apache Iceberg
         table: parquet data files + an Avro manifest + manifest list +
