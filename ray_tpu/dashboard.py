@@ -147,6 +147,20 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/api/train":
                 from ray_tpu.train import list_train_runs
                 self._json(list_train_runs())
+            elif path.startswith("/api/grafana/"):
+                # Generated Grafana dashboard JSON (parity:
+                # dashboard/modules/metrics/grafana_dashboard_factory.py)
+                # — import straight into a Grafana instance or provision
+                # from disk.
+                from ray_tpu.util.grafana import dashboard_json
+                name = path.rsplit("/", 1)[-1]
+                if name.endswith(".json"):
+                    name = name[:-5]
+                try:
+                    self._send(200, dashboard_json(name).encode(),
+                               "application/json")
+                except KeyError as e:
+                    self._send(404, str(e).encode(), "text/plain")
             elif path == "/api/logs":
                 self._logs()
             elif path == "/":

@@ -57,6 +57,29 @@ class Router:
     REFRESH_PERIOD_S = 1.0
     METRICS_PERIOD_S = 1.0
 
+    # Process-wide serve metrics (parity: the serve_* metrics the
+    # reference's router/proxy export for the Grafana serve board;
+    # serve_deployment_metrics.py). Lazily created so importing handle
+    # doesn't register metrics in processes that never route.
+    _METRICS = None
+
+    @classmethod
+    def _metrics(cls):
+        if Router._METRICS is None:
+            from ray_tpu.util.metrics import Counter, Histogram
+            Router._METRICS = {
+                "requests": Counter(
+                    "serve_num_router_requests",
+                    "Requests routed, by deployment",
+                    tag_keys=("deployment", "application")),
+                "latency": Histogram(
+                    "serve_request_latency_ms",
+                    "End-to-end request latency (ms)",
+                    boundaries=(1, 5, 10, 50, 100, 500, 1000, 5000),
+                    tag_keys=("deployment", "application")),
+            }
+        return Router._METRICS
+
     def __init__(self, app_name: str, deployment_name: str):
         self.app = app_name
         self.deployment = deployment_name
@@ -158,6 +181,8 @@ class Router:
         serve/_private/proxy.py:420)."""
         info = self._pick()
         h = self._handle_for(info)
+        self._metrics()["requests"].inc(
+            tags={"deployment": self.deployment, "application": self.app})
         gen = h.handle_streaming_request.options(
             num_returns="streaming").remote(
                 method_name, list(args), dict(kwargs), multiplexed_model_id)
@@ -176,10 +201,12 @@ class Router:
                multiplexed_model_id: str = "") -> DeploymentResponse:
         info = self._pick()
         h = self._handle_for(info)
+        self._metrics()["requests"].inc(
+            tags={"deployment": self.deployment, "application": self.app})
         ref = h.handle_request.remote(method_name, list(args), dict(kwargs),
                                       multiplexed_model_id)
         with self._pending_cv:
-            self._pending.append((ref, info.replica_id))
+            self._pending.append((ref, info.replica_id, time.monotonic()))
             self._pending_cv.notify()
             if self._waiter is None:
                 self._waiter = threading.Thread(
@@ -200,18 +227,22 @@ class Router:
                         return
                     batch = self._pending
                     self._pending = []
-                refs = [r for r, _ in batch]
+                refs = [r for r, *_ in batch]
                 done, not_done = ray_tpu.wait(
                     refs, num_returns=len(refs), timeout=0.5)
                 done_set = {id(d) for d in done}
                 still = []
-                for ref, rid in batch:
+                for ref, rid, t0 in batch:
                     if id(ref) in done_set:
                         with self._lock:
                             if rid in self._inflight and self._inflight[rid] > 0:
                                 self._inflight[rid] -= 1
+                        self._metrics()["latency"].observe(
+                            (time.monotonic() - t0) * 1e3,
+                            tags={"deployment": self.deployment,
+                                  "application": self.app})
                     else:
-                        still.append((ref, rid))
+                        still.append((ref, rid, t0))
                 if still:
                     with self._pending_cv:
                         self._pending.extend(still)

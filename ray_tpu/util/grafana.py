@@ -1,0 +1,155 @@
+"""Grafana dashboard factory: metric definitions -> dashboard JSON.
+
+Parity: `dashboard/modules/metrics/grafana_dashboard_factory.py` in the
+reference, which generates the default / serve / data Grafana dashboards
+from panel templates so operators get working boards without hand-built
+JSON. Here panels derive from two sources: the fixed system gauges the
+/metrics route always exposes, and whatever Counters/Gauges/Histograms
+the application registered at generation time — counters render as
+rate() graphs, histograms as p50/p95/p99 `histogram_quantile` overlays.
+
+The artifact is a standard Grafana dashboard model (schemaVersion 36):
+import it via the Grafana UI/API or provision it from disk; the
+dashboard server also serves it at /api/grafana/<name>.json.
+"""
+
+from __future__ import annotations
+
+import json
+
+_PANEL_W = 12
+_PANEL_H = 8
+
+# The always-exposed cluster gauges (util/metrics.py _system_lines).
+_SYSTEM_PANELS = [
+    ("Object store fill", [
+        ("ray_tpu_object_store_allocated_bytes", "allocated"),
+        ("ray_tpu_object_store_capacity_bytes", "capacity")]),
+    ("Objects in store", [
+        ("ray_tpu_object_store_num_objects", "objects")]),
+    ("Store evictions", [
+        ("ray_tpu_object_store_num_evictions", "evictions")]),
+    ("Pending tasks", [("ray_tpu_pending_tasks", "pending")]),
+    ("Alive nodes", [("ray_tpu_alive_nodes", "nodes")]),
+    ("Workers", [("ray_tpu_workers", "workers")]),
+    ("Alive actors", [("ray_tpu_actors_alive", "actors")]),
+]
+
+
+def _target(expr: str, legend: str) -> dict:
+    return {"expr": expr, "legendFormat": legend, "refId": "A"}
+
+
+def _panel(pid: int, title: str, targets: list[dict], index: int) -> dict:
+    for i, t in enumerate(targets):
+        t["refId"] = chr(ord("A") + i)
+    return {
+        "id": pid,
+        "title": title,
+        "type": "timeseries",
+        "datasource": {"type": "prometheus", "uid": "${datasource}"},
+        "gridPos": {"h": _PANEL_H, "w": _PANEL_W,
+                    "x": (index % 2) * _PANEL_W,
+                    "y": (index // 2) * _PANEL_H},
+        "targets": targets,
+        "fieldConfig": {"defaults": {"custom": {"fillOpacity": 10}},
+                        "overrides": []},
+    }
+
+
+def _metric_targets(metric) -> tuple[str, list[dict]]:
+    """PromQL targets for one registered Metric, by kind."""
+    by = ("by ({}) ".format(", ".join(metric.tag_keys))
+          if metric.tag_keys else "")
+    legend = ("{{" + "}}-{{".join(metric.tag_keys) + "}}"
+              if metric.tag_keys else metric.name)
+    if metric.kind == "counter":
+        return (f"{metric.name} (rate/s)",
+                [_target(f"sum {by}(rate({metric.name}[5m]))", legend)])
+    if metric.kind == "histogram":
+        return (f"{metric.name} (latency quantiles)", [
+            _target(
+                f"histogram_quantile({q}, sum by (le) "
+                f"(rate({metric.name}_bucket[5m])))", f"p{int(q * 100)}")
+            for q in (0.5, 0.95, 0.99)])
+    return (metric.name, [_target(f"sum {by}({metric.name})", legend)])
+
+
+def generate_dashboard(name: str = "ray_tpu",
+                       title: str = "ray_tpu cluster",
+                       include_registry: bool = True) -> dict:
+    """Build the dashboard model. `include_registry=True` adds one panel
+    per application metric registered in util.metrics at call time (the
+    factory runs at serve time, so late-registered metrics appear on the
+    next fetch)."""
+    panels = []
+    pid = 1
+    for i, (ptitle, series) in enumerate(_SYSTEM_PANELS):
+        panels.append(_panel(
+            pid, ptitle, [_target(expr, leg) for expr, leg in series], i))
+        pid += 1
+    if include_registry:
+        from ray_tpu.util.metrics import _LOCK, _REGISTRY
+        with _LOCK:
+            metrics = sorted(_REGISTRY.values(), key=lambda m: m.name)
+        for m in metrics:
+            ptitle, targets = _metric_targets(m)
+            panels.append(_panel(pid, ptitle, targets, len(panels)))
+            pid += 1
+    return {
+        "uid": f"raytpu-{name}",
+        "title": title,
+        "tags": ["ray_tpu", "generated"],
+        "timezone": "browser",
+        "schemaVersion": 36,
+        "version": 1,
+        "refresh": "10s",
+        "time": {"from": "now-30m", "to": "now"},
+        "templating": {"list": [{
+            "name": "datasource",
+            "type": "datasource",
+            "query": "prometheus",
+            "current": {},
+        }]},
+        "panels": panels,
+    }
+
+
+def generate_serve_dashboard() -> dict:
+    """The Serve board (parity: the reference's serve_dashboard_panels):
+    per-deployment QPS, latency quantiles, error rate, replica counts —
+    expressed over the serve_* metrics the proxy/router registers."""
+    rows = [
+        ("Requests/s by deployment",
+         [_target('sum by (deployment) '
+                  '(rate(serve_num_router_requests[5m]))',
+                  "{{deployment}}")]),
+        ("Request latency quantiles",
+         [_target(f"histogram_quantile({q}, sum by (le) "
+                  f"(rate(serve_request_latency_ms_bucket[5m])))",
+                  f"p{int(q * 100)}") for q in (0.5, 0.95, 0.99)]),
+        ("Replicas by deployment",
+         [_target('sum by (deployment) (serve_num_replicas)',
+                  "{{deployment}}")]),
+    ]
+    panels = [_panel(i + 1, t, targets, i)
+              for i, (t, targets) in enumerate(rows)]
+    base = generate_dashboard("serve", "ray_tpu serve",
+                              include_registry=False)
+    base["panels"] = panels
+    base["uid"] = "raytpu-serve"
+    return base
+
+
+DASHBOARDS = {
+    "ray_tpu": generate_dashboard,
+    "serve": generate_serve_dashboard,
+}
+
+
+def dashboard_json(name: str) -> str:
+    try:
+        gen = DASHBOARDS[name]
+    except KeyError:
+        raise KeyError(f"no dashboard {name!r}; have {sorted(DASHBOARDS)}")
+    return json.dumps(gen(), indent=1)

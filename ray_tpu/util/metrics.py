@@ -136,6 +136,21 @@ def _system_lines() -> list[str]:
     ]
     for name, v in rows:
         lines += [f"# TYPE {name} gauge", f"{name} {v}"]
+    # Serve replica gauges, rendered from controller state at scrape time
+    # (the serve_* request/latency series come from router processes).
+    try:
+        from ray_tpu.serve import api as serve_api
+        st = serve_api.status()
+        if st:
+            lines.append("# TYPE serve_num_replicas gauge")
+            for app, info in st.items():
+                for dep, d in info.get("deployments", {}).items():
+                    lines.append(
+                        f'serve_num_replicas{{application="{app}",'
+                        f'deployment="{dep}"}} '
+                        f'{d.get("running_replicas", 0)}')
+    except Exception:  # noqa: BLE001 — serve absent or controller busy
+        pass
     return lines
 
 

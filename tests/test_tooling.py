@@ -425,3 +425,87 @@ def test_dashboard_timeline_train_serve_endpoints(tooling_cluster):
             assert isinstance(json.load(r), dict)
     finally:
         stop_dashboard()
+
+
+def test_grafana_dashboard_factory(tooling_cluster):
+    """Generated Grafana dashboard JSON is structurally loadable: uid,
+    schemaVersion, laid-out panels with PromQL targets; counters render
+    as rate() and histograms as histogram_quantile overlays; the
+    dashboard server serves it (VERDICT r4 #10 done-criterion)."""
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+    from ray_tpu.util.grafana import generate_dashboard
+    from ray_tpu.util.metrics import Counter, Histogram
+
+    Counter("graf_reqs_total", "reqs", tag_keys=("route",))
+    Histogram("graf_latency_ms", "lat", boundaries=(1, 10))
+
+    board = generate_dashboard()
+    assert board["uid"] and board["schemaVersion"] >= 30
+    assert board["templating"]["list"][0]["type"] == "datasource"
+    assert len(board["panels"]) >= 9  # 7 system + the 2 above
+    for p in board["panels"]:
+        assert set(p) >= {"id", "title", "type", "gridPos", "targets"}
+        assert all(t["expr"] for t in p["targets"])
+    by_title = {p["title"]: p for p in board["panels"]}
+    rate_panel = by_title["graf_reqs_total (rate/s)"]
+    assert "rate(graf_reqs_total[5m])" in rate_panel["targets"][0]["expr"]
+    hq = by_title["graf_latency_ms (latency quantiles)"]
+    assert len(hq["targets"]) == 3
+    assert "histogram_quantile(0.99" in hq["targets"][2]["expr"]
+    # panels tile without overlap
+    cells = {(p["gridPos"]["x"], p["gridPos"]["y"])
+             for p in board["panels"]}
+    assert len(cells) == len(board["panels"])
+    json.dumps(board)  # serializable as-is
+
+    addr = start_dashboard()
+    try:
+        with urllib.request.urlopen(
+                f"http://{addr}/api/grafana/ray_tpu.json", timeout=10) as r:
+            served = json.load(r)
+        assert served["uid"] == board["uid"]
+        with urllib.request.urlopen(
+                f"http://{addr}/api/grafana/serve.json", timeout=10) as r:
+            serve_board = json.load(r)
+        assert serve_board["uid"] == "raytpu-serve"
+        exprs = [t["expr"] for p in serve_board["panels"]
+                 for t in p["targets"]]
+        assert any("serve_num_router_requests" in e for e in exprs)
+        assert any("serve_request_latency_ms_bucket" in e for e in exprs)
+        import pytest as _pytest
+        with _pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://{addr}/api/grafana/nope.json", timeout=10)
+    finally:
+        stop_dashboard()
+
+
+def test_serve_router_metrics_emitted(ray_start_regular):
+    """Routing requests through a handle emits serve_* series the
+    generated serve board queries (requests counter, latency histogram,
+    replica gauge at scrape time)."""
+    from ray_tpu import serve as serve_api
+    from ray_tpu.util.metrics import prometheus_text
+
+    @serve_api.deployment
+    def echo(x):
+        return x
+
+    serve_api.run(echo.bind(), name="mx", route_prefix="/mx")
+    try:
+        h = serve_api.get_deployment_handle("echo", "mx")
+        for i in range(3):
+            assert h.remote(i).result(timeout_s=60) == i
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            text = prometheus_text()
+            if ('serve_num_router_requests{deployment="echo"' in text
+                    and "serve_request_latency_ms_bucket" in text):
+                break
+            time.sleep(0.5)
+        text = prometheus_text()
+        assert 'serve_num_router_requests{deployment="echo"' in text
+        assert "serve_request_latency_ms_bucket" in text
+        assert 'serve_num_replicas{application="mx"' in text
+    finally:
+        serve_api.delete("mx")
