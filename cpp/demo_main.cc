@@ -92,6 +92,38 @@ int main(int argc, char** argv) {
   // Calls after kill fail cleanly on the client plane.
   if (!c.CallActor(aid, "total", {}).empty()) return 1;
   printf("ACTOR killed\n");
+
+  // Placement group from C++ (no Python on this side): reserve a CPU
+  // bundle, place an actor inside the reservation, then tear it down.
+  bool pg_ready = false;
+  std::string pgid = c.CreatePlacementGroup(
+      {{{"CPU", 1.0}}}, "PACK", "cpp-pg", 30.0, &pg_ready);
+  if (pgid.empty() || !pg_ready) {
+    fprintf(stderr, "create_pg: %s\n", c.error().c_str());
+    return 1;
+  }
+  std::string paid = c.CreateActor("tests.xlang_helpers.CppCounter",
+                                   {raytpu_client::Client::I64(1)}, 1.0,
+                                   "", pgid, 0);
+  if (paid.empty()) {
+    fprintf(stderr, "pg actor: %s\n", c.error().c_str());
+    return 1;
+  }
+  std::string pr = c.CallActor(paid, "add",
+                               {raytpu_client::Client::I64(2)});
+  v = c.Get(pr, 60, &found);
+  int64_t pv = 0;
+  if (!found || v.format() != "i64") return 1;
+  memcpy(&pv, v.data().data(), 8);
+  if (pv != 3) {
+    fprintf(stderr, "pg actor result wrong: %lld\n", (long long)pv);
+    return 1;
+  }
+  printf("PG actor=3\n");
+  c.KillActor(paid, true);
+  if (!c.RemovePlacementGroup(pgid)) return 1;
+  if (c.RemovePlacementGroup(pgid)) return 1;  // idempotence: gone now
+  printf("PG removed\n");
   printf("ALL OK\n");
   return 0;
 }

@@ -176,16 +176,52 @@ std::vector<std::string> Client::Submit(
 
 std::string Client::CreateActor(const std::string& class_name,
                                 const std::vector<raytpu::Value>& args,
-                                double num_cpus, const std::string& name) {
+                                double num_cpus, const std::string& name,
+                                const std::string& placement_group_id,
+                                int bundle_index) {
   raytpu::ClientRequest req;
   auto* ca = req.mutable_create_actor();
   ca->set_class_name(class_name);
   ca->set_num_cpus(num_cpus);
   if (!name.empty()) ca->set_name(name);
+  if (!placement_group_id.empty()) {
+    ca->set_placement_group_id(placement_group_id);
+    ca->set_bundle_index(bundle_index);
+  }
   for (const auto& a : args) ca->add_args()->mutable_value()->CopyFrom(a);
   raytpu::ClientReply reply;
   if (!Rpc(&req, &reply)) return "";
   return reply.create_actor().actor_id();
+}
+
+std::string Client::CreatePlacementGroup(
+    const std::vector<std::map<std::string, double>>& bundles,
+    const std::string& strategy, const std::string& name,
+    double ready_timeout_s, bool* ready) {
+  raytpu::ClientRequest req;
+  auto* pg = req.mutable_create_placement_group();
+  for (const auto& b : bundles) {
+    auto* bundle = pg->add_bundles();
+    for (const auto& kv : b) {
+      (*bundle->mutable_resources())[kv.first] = kv.second;
+    }
+  }
+  pg->set_strategy(strategy);
+  if (!name.empty()) pg->set_name(name);
+  pg->set_ready_timeout_s(ready_timeout_s);
+  raytpu::ClientReply reply;
+  if (!Rpc(&req, &reply)) return "";
+  if (ready) *ready = reply.create_placement_group().ready();
+  return reply.create_placement_group().placement_group_id();
+}
+
+bool Client::RemovePlacementGroup(const std::string& placement_group_id) {
+  raytpu::ClientRequest req;
+  req.mutable_remove_placement_group()->set_placement_group_id(
+      placement_group_id);
+  raytpu::ClientReply reply;
+  if (!Rpc(&req, &reply)) return false;
+  return reply.remove_placement_group().ok();
 }
 
 std::string Client::CallActor(const std::string& actor_id,

@@ -48,7 +48,20 @@ class Client {
   std::string CreateActor(const std::string& class_name,
                           const std::vector<raytpu::Value>& args,
                           double num_cpus = 1.0,
-                          const std::string& name = "");
+                          const std::string& name = "",
+                          const std::string& placement_group_id = "",
+                          int bundle_index = -1);
+
+  // Placement groups (parity: ray::PlacementGroup from the C++ API):
+  // reserve bundles atomically; actors created with placement_group_id
+  // land inside the reservation. ready_timeout_s > 0 blocks until the
+  // reservation commits (ready=false on timeout).
+  std::string CreatePlacementGroup(
+      const std::vector<std::map<std::string, double>>& bundles,
+      const std::string& strategy = "PACK",
+      const std::string& name = "", double ready_timeout_s = 30.0,
+      bool* ready = nullptr);
+  bool RemovePlacementGroup(const std::string& placement_group_id);
   std::string CallActor(const std::string& actor_id,
                         const std::string& method,
                         const std::vector<raytpu::Value>& args);

@@ -224,12 +224,43 @@ def _cmd_start(args):
           f"stop with: python -m ray_tpu stop")
 
 
+def _scan_ray_processes() -> list[int]:
+    """Every ray_tpu daemon on this machine (head/agent/worker), by
+    /proc cmdline scan — `stop` kills them ALL, matching the reference's
+    `ray stop` semantics (scripts/scripts.py kill-all): a pid file can be
+    clobbered by a second cluster on the same machine, and orphans from
+    killed launchers must not outlive a stop."""
+    needles = (b"-m\0ray_tpu\0start", b"ray_tpu.core.node_agent",
+               b"ray_tpu.core.worker")
+    me = os.getpid()
+    out = []
+    try:
+        pids = [int(d) for d in os.listdir("/proc") if d.isdigit()]
+    except OSError:
+        return out
+    for pid in pids:
+        if pid == me:
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read()
+        except OSError:
+            continue
+        if any(n in cmd for n in needles):
+            out.append(pid)
+    return out
+
+
 def _cmd_stop(_args):
     try:
         with open(_PID_FILE) as f:
             pids = json.loads(f.read())
     except FileNotFoundError:
-        print("no recorded head process")
+        pids = []
+    scanned = _scan_ray_processes()
+    pids = list(dict.fromkeys([*pids, *scanned]))
+    if not pids:
+        print("no ray_tpu processes")
         return
     for pid in pids:
         # Only kill a whole process group the CLI itself created (the

@@ -48,8 +48,14 @@ def _addr_in(msg, host_field, port_field):
 # ---- client-plane tagged values (language-neutral) ----
 
 
-def encode_value(obj) -> pb.Value:
-    """Python value -> tagged Value a non-Python frontend can decode."""
+def encode_value(obj, *, allow_pickle: bool = True) -> pb.Value:
+    """Python value -> tagged Value a non-Python frontend can decode.
+
+    allow_pickle=False is the PLANE-LEVEL neutrality assertion (VERDICT
+    r4 #7): planes a non-Python participant reads set it so a value that
+    cannot be represented tagged fails loudly at the sender instead of
+    silently shipping an opaque pickle — one carelessly-added message
+    type must not re-open the hole the tagged encoding closed."""
     import struct as _struct
     if obj is None:
         return pb.Value(data=b"", format="none")
@@ -76,6 +82,10 @@ def encode_value(obj) -> pb.Value:
         # of raising, corrupting the round trip.
         import json as _json
         return pb.Value(data=_json.dumps(obj).encode(), format="json")
+    if not allow_pickle:
+        raise ValueError(
+            f"value of type {type(obj).__name__} has no language-neutral "
+            f"tagged encoding and this plane asserts no-pickle")
     return pb.Value(data=pickle.dumps(obj, protocol=5), format="pickle")
 
 
@@ -97,9 +107,13 @@ def _json_clean(obj) -> bool:
     return False
 
 
-def decode_value(v: pb.Value):
+def decode_value(v: pb.Value, *, allow_pickle: bool = True):
     import struct as _struct
     fmt = v.format
+    if fmt == "pickle" and not allow_pickle:
+        raise ValueError(
+            "received a pickle-format Value on a plane that asserts "
+            "no-pickle")
     if fmt in ("none", ""):
         return None
     if fmt == "bool":

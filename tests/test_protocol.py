@@ -193,4 +193,59 @@ def test_cpp_frontend_end_to_end(proto_head):
     assert "TASK len=5" in out.stdout
     assert "ACTOR add=15,22 total=22" in out.stdout
     assert "ACTOR killed" in out.stdout
+    assert "PG actor=3" in out.stdout      # placement group from C++
+    assert "PG removed" in out.stdout
     assert "ALL OK" in out.stdout
+
+
+def test_client_plane_asserts_no_pickle(proto_head):
+    """The client plane is an ASSERTED no-pickle plane (VERDICT r4 #7):
+    a pickle-format Value is rejected inbound, and a result that has no
+    tagged encoding errors at the sender instead of shipping an opaque
+    pickle to a non-Python reader."""
+    import pickle
+
+    from ray_tpu.protocol import raytpu_pb2 as pb
+
+    host, port = proto_head.client_proto_addr.split(":")
+    s = socket.create_connection((host, int(port)))
+    try:
+        # inbound: pickled put payload -> rejected loudly
+        r = _rpc(s, pb.ClientRequest(req_id=1, put=pb.PutRequest(
+            value=pb.Value(data=pickle.dumps({1: 2}), format="pickle"))))
+        assert "no-pickle" in r.error
+
+        # outbound: a task returning a Python-only value (non-str dict
+        # keys survive JSON only by coercion, so it has no neutral
+        # encoding) errors on get instead of silently pickling
+        sub = pb.SubmitRequest(fn_name="tests.xlang_helpers.py_only_value")
+        r = _rpc(s, pb.ClientRequest(req_id=2, submit=sub))
+        r = _rpc(s, pb.ClientRequest(req_id=3, get=pb.GetRequest(
+            object_id=r.submit.return_ids[0], timeout_s=60)))
+        assert "no-pickle" in r.error or "tagged" in r.error
+
+        # tagged values still flow
+        r = _rpc(s, pb.ClientRequest(req_id=4, put=pb.PutRequest(
+            value=pb.Value(data=b"ok", format="raw"))))
+        assert not r.error
+    finally:
+        s.close()
+
+
+def test_value_codec_no_pickle_assertion():
+    import pickle
+
+    import pytest
+
+    from ray_tpu.core import proto_wire as pw
+    from ray_tpu.protocol import raytpu_pb2 as pb
+
+    with pytest.raises(ValueError, match="no-pickle"):
+        pw.encode_value(object(), allow_pickle=False)
+    with pytest.raises(ValueError, match="no-pickle"):
+        pw.decode_value(pb.Value(data=pickle.dumps(1), format="pickle"),
+                        allow_pickle=False)
+    # everything representable still round-trips under the assertion
+    for v in (None, True, 7, 1.5, "s", b"b", [1, "x"], {"k": [1, 2]}):
+        enc = pw.encode_value(v, allow_pickle=False)
+        assert pw.decode_value(enc, allow_pickle=False) == v

@@ -12,3 +12,9 @@ class CppCounter:
 
     def total(self):
         return self.v
+
+
+def py_only_value():
+    """A value with no language-neutral tagged encoding (non-str dict
+    keys) — used to prove the client plane's no-pickle assertion."""
+    return {1: "x"}
