@@ -227,12 +227,15 @@ def preflight_kill_stale() -> list[int]:
     return killed
 
 
-def timeit(fn, number, trials=2) -> float:
+def timeit(fn, number, trials=2, warm=None) -> float:
     """Warm run, then the mean of timed trials — the reference's
     microbenchmark does the same (ray_microbenchmark_helpers.py:15: 1s
     warmup, mean of four 2s windows), so cold-start transitions between
-    phases don't land on any one metric."""
-    fn(max(1, number // 10))  # warmup
+    phases don't land on any one metric. `warm` overrides the default
+    10% warm pass: dispatch-storm metrics need ~1s of sustained load
+    before the allocator/branch caches settle (measured: trial rates
+    climb 6.3k -> 8.4k over the first ~20k nop tasks on the 1-CPU box)."""
+    fn(max(1, warm if warm is not None else number // 10))  # warmup
     rates = []
     for _ in range(trials):
         t0 = time.perf_counter()
@@ -335,7 +338,8 @@ def main():
         def tasks_async(n):
             ray_tpu.get([nop.remote() for _ in range(n)], timeout=120)
 
-        emit("single_client_tasks_async", timeit(tasks_async, 10000))
+        emit("single_client_tasks_async", timeit(tasks_async, 10000,
+                                             warm=8000))
 
         # multi client: m actors each submitting n nested tasks
         # (ray_perf.py "multi client tasks async").
@@ -362,7 +366,8 @@ def main():
         def actor_async(n):
             ray_tpu.get([a.ping.remote() for _ in range(n)], timeout=120)
 
-        emit("1_1_actor_calls_async", timeit(actor_async, 10000))
+        emit("1_1_actor_calls_async", timeit(actor_async, 10000,
+                                         warm=6000))
 
         ac = Sink.options(max_concurrency=16).remote()
         ray_tpu.get(ac.ping.remote(), timeout=60)
@@ -381,7 +386,7 @@ def main():
         def one_n(total):
             ray_tpu.get(fan.batch.remote(sinks, total // k), timeout=300)
 
-        emit("1_n_actor_calls_async", timeit(one_n, 2000 * k))
+        emit("1_n_actor_calls_async", timeit(one_n, 2000 * k, warm=2000))
 
         # n:n — m worker tasks each fanning to the k sinks.
         def n_n(total):

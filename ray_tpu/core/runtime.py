@@ -851,6 +851,7 @@ class Runtime:
         # submitting/listener threads.
         self._sched_cv = threading.Condition()
         self._sched_gen = 0
+        self._last_sched_req = 0.0
         # Lease refills computed on the listener thread, sent by the
         # scheduler thread (blocking sendalls must stay off the listener).
         self._pending_lease_sends: collections.deque = collections.deque()
@@ -3645,10 +3646,22 @@ class Runtime:
         64-agent profile put ~37% of the head core in exactly that).
         Concurrent passes are safe — queue pops and reservations are
         under the lock — the debounce exists for throughput, not
-        correctness."""
+        correctness.
+
+        Single-node burst debounce: a LONE request still runs inline
+        (sync-call latency unchanged), but when the previous request was
+        <150us ago — an async submit loop, or the listener draining a
+        completion storm — the pass defers to the scheduler thread, where
+        back-to-back requests coalesce into one pass and the dispatch
+        sendalls leave the submitting/listener threads (profiled at ~45%
+        of the listener's busy time on the 10k-nop bench)."""
         if len(self.nodes) <= 1:
-            self._schedule_now()
-            return
+            now = time.monotonic()
+            burst = now - self._last_sched_req < 150e-6
+            self._last_sched_req = now
+            if not burst:
+                self._schedule_now()
+                return
         with self._sched_cv:
             self._sched_gen += 1
             self._sched_cv.notify()
