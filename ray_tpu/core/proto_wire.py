@@ -134,6 +134,38 @@ def decode_value(v: pb.Value, *, allow_pickle: bool = True):
     raise ValueError(f"unknown Value format {fmt!r}")
 
 
+def encode_task_args(proto_args, kwargs: dict | None = None) -> bytes:
+    """Client-plane `repeated Arg` -> serialized TaskArgs payload, copied
+    verbatim (already tagged — the head never decodes to Python and
+    re-pickles). The exec plane's language-neutral payload form
+    (TaskSpec.payload_format == "proto"); parity direction:
+    core_worker.proto task args that a non-Python worker can read."""
+    ta = pb.TaskArgs()
+    for a in proto_args:
+        ta.args.add().CopyFrom(a)
+    for k, v in (kwargs or {}).items():
+        ta.kwargs[k].CopyFrom(v)
+    return ta.SerializeToString()
+
+
+def decode_task_args(data: bytes):
+    """Serialized TaskArgs -> (args, kwargs) with ObjectRef placeholders
+    for object_id entries (weak refs — the executing worker is a
+    borrower and resolves them through the store)."""
+    from ray_tpu.core.ids import ObjectID
+    from ray_tpu.core.object_ref import ObjectRef
+    ta = pb.TaskArgs()
+    ta.ParseFromString(data)
+
+    def one(a):
+        if a.WhichOneof("arg") == "object_id":
+            return ObjectRef(ObjectID(a.object_id), _add_ref=False)
+        return decode_value(a.value)
+
+    return ([one(a) for a in ta.args],
+            {k: one(v) for k, v in ta.kwargs.items()})
+
+
 def to_wire(msg) -> bytes | None:
     """Tuple message -> serialized AgentFrame, or None (keep pickle)."""
     op = msg[0]

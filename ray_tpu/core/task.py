@@ -39,6 +39,8 @@ class TaskSpec:
                           # numbers of actor_task_submitter.h:78)
         "idempotent",     # user-declared: safe to re-execute without a
                           # failure; opts into the one-phase steal fast path
+        "payload_format",  # None/"pickle" | "proto" (language-neutral
+                           # TaskArgs payload — proto_wire.decode_task_args)
     )
 
     def __init__(self, **kw):

@@ -888,7 +888,14 @@ def _execute(rt: WorkerRuntime, spec: TaskSpec, fn):
         rt.object_cache[oid] = serialization.deserialize(payload, bufs)
     renv_spec = getattr(spec, "runtime_env", None)
     try:
-        args, kwargs = serialization.deserialize(spec.payload, spec.buffers)
+        if getattr(spec, "payload_format", None) == "proto":
+            # Language-neutral TaskArgs payload (client-plane submissions
+            # keep their tagged args end to end — never re-pickled).
+            from ray_tpu.core import proto_wire
+            args, kwargs = proto_wire.decode_task_args(spec.payload)
+        else:
+            args, kwargs = serialization.deserialize(spec.payload,
+                                                     spec.buffers)
         args = [_resolve_arg(rt, a) for a in args]
         kwargs = {k: _resolve_arg(rt, v) for k, v in kwargs.items()}
         rt.current_task = spec  # describe() formatted lazily on demand

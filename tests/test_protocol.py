@@ -249,3 +249,39 @@ def test_value_codec_no_pickle_assertion():
     for v in (None, True, 7, 1.5, "s", b"b", [1, "x"], {"k": [1, 2]}):
         enc = pw.encode_value(v, allow_pickle=False)
         assert pw.decode_value(enc, allow_pickle=False) == v
+
+
+def test_exec_plane_neutral_task_args(proto_head):
+    """Client-submitted task args stay TAGGED end to end: the head copies
+    the client's Args verbatim into a TaskArgs exec payload
+    (payload_format="proto") and the worker decodes it without any
+    pickle — object_id args resolve through the store (VERDICT r4 #7
+    exec-plane neutrality where representable)."""
+    from ray_tpu.core import proto_wire as pw
+    from ray_tpu.protocol import raytpu_pb2 as pb
+
+    # codec round trip incl. refs
+    a1 = pb.Arg()
+    a1.value.CopyFrom(pw.encode_value("abc"))
+    a2 = pb.Arg(object_id=b"x" * 16)
+    data = pw.encode_task_args([a1, a2], {"k": a1})
+    args, kwargs = pw.decode_task_args(data)
+    assert args[0] == "abc"
+    assert args[1].id.binary() == b"x" * 16
+    assert kwargs["k"] == "abc"
+
+    host, port = proto_head.client_proto_addr.split(":")
+    s = socket.create_connection((host, int(port)))
+    try:
+        r = _rpc(s, pb.ClientRequest(req_id=1, put=pb.PutRequest(
+            value=pb.Value(data=b"12345678", format="raw"))))
+        oid = r.put.object_id
+        sub = pb.SubmitRequest(fn_name="builtins.len")
+        sub.args.add().object_id = oid
+        r = _rpc(s, pb.ClientRequest(req_id=2, submit=sub))
+        r = _rpc(s, pb.ClientRequest(req_id=3, get=pb.GetRequest(
+            object_id=r.submit.return_ids[0], timeout_s=60)))
+        assert not r.error
+        assert struct.unpack("<q", r.get.value.data)[0] == 8
+    finally:
+        s.close()
