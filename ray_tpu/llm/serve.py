@@ -205,10 +205,23 @@ class _LLMServerImpl:
 
     # ---- request API (called via handle) ----
 
+    @staticmethod
+    def _apply_stop(text: str, stop) -> tuple[str, bool]:
+        """Truncate at the earliest stop sequence (OpenAI `stop` param:
+        str or up to 4 strings; the stop text itself is not returned)."""
+        if not stop:
+            return text, False
+        seqs = [stop] if isinstance(stop, str) else list(stop)
+        cut = min((i for i in (text.find(s) for s in seqs if s)
+                   if i >= 0), default=-1)
+        if cut < 0:
+            return text, False
+        return text[:cut], True
+
     async def completions(self, prompt: str, *, max_tokens=None,
                           temperature=None, top_p: float = 1.0,
                           top_k: int = 0, model=None, guided_regex=None,
-                          guided_json=None) -> dict:
+                          guided_json=None, stop=None) -> dict:
         # Adapter swap: engine params are per-step state, so point the
         # engine at the requested tree. Mixed-adapter batches decode with
         # the most recent selection (documented simplification).
@@ -218,12 +231,17 @@ class _LLMServerImpl:
         req = await self._submit(ids, max_tokens, temperature,
                                  top_p=top_p, top_k=top_k, guide=guide)
         text = self.tokenizer.decode(req.generated)
+        text, stopped = self._apply_stop(text, stop)
         return {
             "id": f"cmpl-{uuid.uuid4().hex[:24]}",
             "object": "text_completion",
             "model": model or self.cfg.model_id,
             "choices": [{"index": 0, "text": text,
-                         "finish_reason": "stop"}],
+                         "finish_reason": "stop" if stopped else
+                         ("length" if len(req.generated)
+                          >= (max_tokens
+                              or self.engine.e.default_max_new_tokens)
+                          else "stop")}],
             "usage": {"prompt_tokens": len(ids),
                       "completion_tokens": len(req.generated),
                       "total_tokens": len(ids) + len(req.generated)},
@@ -231,8 +249,8 @@ class _LLMServerImpl:
 
     async def chat(self, messages: list, *, max_tokens=None,
                    temperature=None, top_p: float = 1.0, top_k: int = 0,
-                   model=None, guided_regex=None,
-                   guided_json=None) -> dict:
+                   model=None, guided_regex=None, guided_json=None,
+                   stop=None) -> dict:
         prompt = "".join(
             f"<|{m.get('role', 'user')}|>{m.get('content', '')}"
             for m in messages) + "<|assistant|>"
@@ -240,7 +258,7 @@ class _LLMServerImpl:
                                      temperature=temperature, top_p=top_p,
                                      top_k=top_k, model=model,
                                      guided_regex=guided_regex,
-                                     guided_json=guided_json)
+                                     guided_json=guided_json, stop=stop)
         return {
             "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
             "object": "chat.completion",
@@ -379,7 +397,8 @@ class _OpenAiRouterImpl:
                     top_p=body.get("top_p", 1.0),
                     top_k=body.get("top_k", 0),
                     model=body.get("model"),
-                    guided_regex=guided_regex, guided_json=guided_json)
+                    guided_regex=guided_regex, guided_json=guided_json,
+                    stop=body.get("stop"))
             if path == "/v1/chat/completions":
                 return await self.server.chat.remote(
                     body.get("messages", []),
@@ -388,7 +407,8 @@ class _OpenAiRouterImpl:
                     top_p=body.get("top_p", 1.0),
                     top_k=body.get("top_k", 0),
                     model=body.get("model"),
-                    guided_regex=guided_regex, guided_json=guided_json)
+                    guided_regex=guided_regex, guided_json=guided_json,
+                    stop=body.get("stop"))
         except Exception as e:  # noqa: BLE001 — surface as API error
             return 400, {"error": str(e)}
         return 404, {"error": f"no route {path}"}

@@ -136,3 +136,30 @@ def test_load_view_rides_heartbeats_and_reclaim_fires():
         assert any(m[0] == "lease_reclaim" for m in sent), sent
     finally:
         c.shutdown()
+
+
+def test_many_fresh_fns_never_race_registration():
+    """Regression: two _pump_leases threads could send a bare exec for an
+    fn_id ahead of the reg_fn that carried its registration (the exec
+    then failed permanently with 'function not registered'). Leasing many
+    DISTINCT fns in rapid bursts exercises the per-worker outbox ordering
+    under the agent's concurrent pumps."""
+    import cloudpickle
+
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    try:
+        c.add_node(num_cpus=2)
+        c.wait_for_nodes(2)
+
+        refs = []
+        for i in range(24):
+            # a fresh closure per task -> fresh fn_id -> reg_fn frame
+            fn = ray_tpu.remote(num_cpus=1)(
+                cloudpickle.loads(cloudpickle.dumps(
+                    lambda i=i: ("ok", i))))
+            refs.extend(fn.remote() for _ in range(3))
+        out = ray_tpu.get(refs, timeout=120)
+        assert sorted({o[1] for o in out}) == list(range(24))
+        assert all(o[0] == "ok" for o in out)
+    finally:
+        c.shutdown()

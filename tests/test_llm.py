@@ -422,3 +422,38 @@ def test_chunked_prefill_interleaves_with_decode(tiny_params):
     assert progressed_during_admission
     assert (eng.finished[r_long].generated
             == _naive_greedy(tiny_params, long_prompt, 4))
+
+
+def test_openai_stop_sequences(ray_start_regular):
+    """OpenAI `stop` truncates at the earliest stop string and reports
+    finish_reason=stop (parity: the reference's OpenAI surface)."""
+    import urllib.request
+
+    from ray_tpu import serve as serve_api
+    from ray_tpu.llm import build_openai_app
+    from ray_tpu.serve.config import DEFAULT_HTTP_PORT
+
+    app = build_openai_app(_llm_config())
+    serve_api.run(app, name="llm-stop", route_prefix="/llmstop")
+    base = f"http://127.0.0.1:{DEFAULT_HTTP_PORT}/llmstop"
+    try:
+        req = urllib.request.Request(
+            base + "/v1/completions",
+            data=json.dumps({"prompt": "hi", "max_tokens": 8}).encode(),
+            headers={"content-type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            full = json.load(r)["choices"][0]["text"]
+        assert len(full) >= 2
+        stop_at = full[1]  # use the 2nd generated char as the stop seq
+        req = urllib.request.Request(
+            base + "/v1/completions",
+            data=json.dumps({"prompt": "hi", "max_tokens": 8,
+                             "stop": [stop_at]}).encode(),
+            headers={"content-type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            out = json.load(r)
+        cut = out["choices"][0]["text"]
+        assert stop_at not in cut and full.startswith(cut)
+        assert out["choices"][0]["finish_reason"] == "stop"
+    finally:
+        serve_api.delete("llm-stop")
