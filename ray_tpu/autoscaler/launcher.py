@@ -244,6 +244,12 @@ class LocalCommandRunner(CommandRunner):
         self.env = dict(env or {})
         self.env.setdefault("RAY_TPU_STATE_DIR",
                             os.path.join(workspace, "state"))
+        # Several local "instances" share this machine: `ray_tpu stop`
+        # must stay scoped to this instance's pid file, not the
+        # machine-wide /proc sweep (which is correct on real machines —
+        # one instance each — but here would let a worker's bootstrap
+        # `stop` kill the head).
+        self.env.setdefault("RAY_TPU_STOP_SCOPED", "1")
         # A real machine has ray_tpu installed; the workspace "machine"
         # borrows this process's copy.
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(

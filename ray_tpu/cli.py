@@ -230,6 +230,11 @@ def _scan_ray_processes() -> list[int]:
     `ray stop` semantics (scripts/scripts.py kill-all): a pid file can be
     clobbered by a second cluster on the same machine, and orphans from
     killed launchers must not outlive a stop."""
+    if os.environ.get("RAY_TPU_STOP_SCOPED"):
+        # Emulated multi-instance setups (several "machines" sharing this
+        # host, each with its own RAY_TPU_STATE_DIR) must stop only what
+        # their own pid file records.
+        return []
     needles = (b"-m\0ray_tpu\0start", b"ray_tpu.core.node_agent",
                b"ray_tpu.core.worker")
     me = os.getpid()

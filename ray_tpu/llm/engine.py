@@ -77,6 +77,11 @@ class Request:
     # survives preemption/re-admission).
     guide: object | None = None
     guide_state: int = 0
+    # OpenAI logprobs: when True, token_logprobs collects log p(token)
+    # for each generated token (computed in-scan; spec windows fall back
+    # to the plain path for these requests).
+    logprobs: bool = False
+    token_logprobs: list = dataclasses.field(default_factory=list)
 
 
 # ---------------- pure model steps ----------------
@@ -538,7 +543,7 @@ def decode_window_spec(params, pool_k, pool_v, tokens, lengths, active,
 def decode_window(params, pool_k, pool_v, tokens, lengths, active,
                   page_tables, temps, top_ps, top_ks, gtables, gstates,
                   key, config: ModelConfig, eos_token: int, n_steps: int,
-                  trunc: bool, guided: bool):
+                  trunc: bool, guided: bool, want_logp: bool = False):
     """`n_steps` decode+sample steps in ONE compiled program (lax.scan),
     sampled tokens staying device-resident between steps. The host fences
     once per window instead of once per token — essential when the
@@ -552,6 +557,10 @@ def decode_window(params, pool_k, pool_v, tokens, lengths, active,
     every token allowed), gstates [B] the per-slot DFA state, which rides
     the scan carry so constraint enforcement never fences the host
     (guided.py; the role of vLLM's outlines logits processors).
+
+    `want_logp` (static): also emit log p(sampled token) per step
+    (log-softmax gather; OpenAI logprobs). The block becomes
+    (tokens [n_steps, B], logps [n_steps, B]).
 
     Within a window page tables are frozen, so the caller bounds n_steps
     by every active slot's remaining page room.
@@ -574,13 +583,19 @@ def decode_window(params, pool_k, pool_v, tokens, lengths, active,
             nxt = sample(logits, temps, sub, mask=mask)
         nxt = jnp.where(act, nxt.astype(jnp.int32), 0)
         out = jnp.where(act, nxt, -1)  # -1 = slot emitted nothing
+        if want_logp:
+            logp_all = jax.nn.log_softmax(logits, axis=-1)
+            logp = jnp.take_along_axis(logp_all, nxt[:, None], 1)[:, 0]
+            outs = (out, jnp.where(act, logp, 0.0))
+        else:
+            outs = out
         lens = jnp.where(act, lens + 1, lens)
         if guided:
             gst = jnp.where(act,
                             jnp.maximum(row[jnp.arange(B), nxt], 0), gst)
         if eos_token >= 0:
             act = act & (nxt != eos_token)
-        return (pk, pv, nxt, lens, act, gst, key), out
+        return (pk, pv, nxt, lens, act, gst, key), outs
 
     carry = (pool_k, pool_v, tokens, lengths, active, gstates, key)
     (pool_k, pool_v, tokens, lengths, active, gstates, key), out_seq = (
@@ -772,17 +787,18 @@ class InferenceEngine:
 
     def add_request(self, prompt_tokens, max_new_tokens=None,
                     temperature=None, top_p: float = 1.0,
-                    top_k: int = 0, guide=None) -> int:
+                    top_k: int = 0, guide=None,
+                    logprobs: bool = False) -> int:
         # Validate at submission, in the CALLER's thread: an invalid prompt
         # must fail its own request, not blow up the shared engine pump.
         if self._chunk_size() and len(prompt_tokens) < self.e.max_len:
             pass  # chunked prefill admits any prompt under max_len
         else:
             self._bucket(len(prompt_tokens))
+        if (guide is not None or logprobs) and not self.paged:
+            raise ValueError("guided decoding / logprobs require the "
+                             "paged KV layout")
         if guide is not None:
-            if not self.paged:
-                raise ValueError("guided decoding requires the paged "
-                                 "KV layout")
             if guide.table.shape[1] != self.c.vocab:
                 raise ValueError(
                     f"guide compiled for vocab {guide.table.shape[1]}, "
@@ -795,7 +811,7 @@ class InferenceEngine:
             max_new_tokens or self.e.default_max_new_tokens,
             self.e.default_temperature if temperature is None
             else temperature, top_p=float(top_p), top_k=int(top_k),
-            guide=guide)
+            guide=guide, logprobs=bool(logprobs))
         self.queue.append(req)
         return rid
 
@@ -1128,8 +1144,15 @@ class InferenceEngine:
                     jnp.asarray([r.top_k for _s, r, _l in pending],
                                 jnp.int32), mask)
             toks = np.asarray(toks)  # one fence for the burst
-            for (slot, req, _l), tok in zip(pending, toks):
+            p_logps = None
+            if any(r.logprobs for _s, r, _l in pending):
+                p_logps = np.asarray(jnp.take_along_axis(
+                    jax.nn.log_softmax(stacked, axis=-1),
+                    jnp.asarray(toks)[:, None], 1)[:, 0])
+            for j, ((slot, req, _l), tok) in enumerate(zip(pending, toks)):
                 first = int(tok)
+                if req.logprobs and p_logps is not None:
+                    req.token_logprobs.append(float(p_logps[j]))
                 req.generated.append(first)
                 admitted[req.request_id] = first
                 self.last_tokens[slot] = first
@@ -1245,11 +1268,18 @@ class InferenceEngine:
             tokens = np.asarray(self._sample_trunc(
                 logits, jnp.asarray(temps), sub,
                 jnp.asarray(top_ps), jnp.asarray(top_ks), mask))
+        logps = None
+        if any(r is not None and r.logprobs for r in self.slot_req):
+            logps = np.asarray(jnp.take_along_axis(
+                jax.nn.log_softmax(logits, axis=-1),
+                jnp.asarray(tokens)[:, None], 1)[:, 0])
         for i in range(self.e.max_slots):
             if not self.active[i]:
                 continue
             tok = int(tokens[i])
             req = self.slot_req[i]
+            if req.logprobs and logps is not None:
+                req.token_logprobs.append(float(logps[i]))
             req.generated.append(tok)
             emitted[req.request_id] = tok
             self.lengths[i] += 1
@@ -1449,16 +1479,20 @@ class InferenceEngine:
             k_bucket = max(b for b in self._win_buckets if b <= limit)
         trunc = self._sync_sampling()
         guided, gtables_d, gstates_d = self._sync_guides()
+        want_logp = any(
+            self.slot_req[i] is not None and self.slot_req[i].logprobs
+            for i in range(e.max_slots) if self.active[i])
         self._sync_device_state()
         tables = self._build_tables()
         key = (tables.shape[1], k_bucket, trunc, guided,
-               gtables_d.shape if guided else None)
+               gtables_d.shape if guided else None, want_logp)
         fn = self._window_fns.get(key)
         if fn is None:
             fn = jax.jit(
                 partial(decode_window, config=self.c,
                         eos_token=int(self.e.eos_token),
-                        n_steps=k_bucket, trunc=trunc, guided=guided),
+                        n_steps=k_bucket, trunc=trunc, guided=guided,
+                        want_logp=want_logp),
                 donate_argnums=(1, 2, 3, 4, 5, 12))
             self._window_fns[key] = fn
         toks_d, lens_d, act_d = self._dev
@@ -1469,7 +1503,12 @@ class InferenceEngine:
             act_d, jnp.asarray(tables), temps_d, tps_d, tks_d,
             gtables_d, gstates_d, self._dev_key)
         self._dev = (toks_d, lens_d, act_d)
-        out = np.asarray(out_seq)  # ONE fence per window
+        if want_logp:
+            out = np.asarray(out_seq[0])  # ONE fence per window
+            logps = np.asarray(out_seq[1])
+        else:
+            out = np.asarray(out_seq)
+            logps = None
         emitted: dict[int, int] = {}
         for k in range(out.shape[0]):
             for i in range(e.max_slots):
@@ -1477,6 +1516,8 @@ class InferenceEngine:
                 if tok < 0 or not self.active[i]:
                     continue
                 req = self.slot_req[i]
+                if req.logprobs and logps is not None:
+                    req.token_logprobs.append(float(logps[k, i]))
                 req.generated.append(tok)
                 emitted[req.request_id] = tok
                 self.lengths[i] += 1
@@ -1506,7 +1547,7 @@ class InferenceEngine:
             if not self.active[i] or r is None:
                 continue
             if (r.temperature > 0 or r.top_k != 0 or r.top_p < 1.0
-                    or r.guide is not None):
+                    or r.guide is not None or r.logprobs):
                 return False
         return True
 

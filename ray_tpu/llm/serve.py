@@ -97,13 +97,14 @@ class _LLMServerImpl:
                 loop.call_soon_threadsafe(fut.set_result, req)
 
     async def _submit(self, prompt_ids, max_new_tokens, temperature,
-                      top_p=1.0, top_k=0, guide=None):
+                      top_p=1.0, top_k=0, guide=None, logprobs=False):
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
         with self._lock:
             rid = self.engine.add_request(prompt_ids, max_new_tokens,
                                           temperature, top_p=top_p,
-                                          top_k=top_k, guide=guide)
+                                          top_k=top_k, guide=guide,
+                                          logprobs=logprobs)
             self._waiters[rid] = (loop, fut)
         return await fut
 
@@ -221,7 +222,8 @@ class _LLMServerImpl:
     async def completions(self, prompt: str, *, max_tokens=None,
                           temperature=None, top_p: float = 1.0,
                           top_k: int = 0, model=None, guided_regex=None,
-                          guided_json=None, stop=None) -> dict:
+                          guided_json=None, stop=None,
+                          logprobs=None) -> dict:
         # Adapter swap: engine params are per-step state, so point the
         # engine at the requested tree. Mixed-adapter batches decode with
         # the most recent selection (documented simplification).
@@ -229,14 +231,20 @@ class _LLMServerImpl:
         guide = self._resolve_guide(guided_regex, guided_json)
         ids = self.tokenizer.encode(prompt)
         req = await self._submit(ids, max_tokens, temperature,
-                                 top_p=top_p, top_k=top_k, guide=guide)
+                                 top_p=top_p, top_k=top_k, guide=guide,
+                                 logprobs=bool(logprobs))
         text = self.tokenizer.decode(req.generated)
         text, stopped = self._apply_stop(text, stop)
+        lp = None
+        if logprobs:
+            lp = {"tokens": [self.tokenizer.decode([t])
+                             for t in req.generated],
+                  "token_logprobs": list(req.token_logprobs)}
         return {
             "id": f"cmpl-{uuid.uuid4().hex[:24]}",
             "object": "text_completion",
             "model": model or self.cfg.model_id,
-            "choices": [{"index": 0, "text": text,
+            "choices": [{"index": 0, "text": text, "logprobs": lp,
                          "finish_reason": "stop" if stopped else
                          ("length" if len(req.generated)
                           >= (max_tokens
@@ -398,7 +406,8 @@ class _OpenAiRouterImpl:
                     top_k=body.get("top_k", 0),
                     model=body.get("model"),
                     guided_regex=guided_regex, guided_json=guided_json,
-                    stop=body.get("stop"))
+                    stop=body.get("stop"),
+                    logprobs=body.get("logprobs"))
             if path == "/v1/chat/completions":
                 return await self.server.chat.remote(
                     body.get("messages", []),

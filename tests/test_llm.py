@@ -457,3 +457,52 @@ def test_openai_stop_sequences(ray_start_regular):
         assert out["choices"][0]["finish_reason"] == "stop"
     finally:
         serve_api.delete("llm-stop")
+
+
+def test_engine_logprobs_match_forward(tiny_params):
+    """logprobs=True collects log p(token) per generated token; greedy
+    values must match a naive full-forward log_softmax (parity: the
+    OpenAI logprobs surface the reference serves through vLLM)."""
+    eng = InferenceEngine(
+        TINY, EngineConfig(max_slots=2, max_len=64, prompt_buckets=(16,),
+                           eos_token=-1), params=tiny_params)
+    prompt = [5, 6, 7]
+    rid = eng.add_request(prompt, max_new_tokens=5, temperature=0.0,
+                          logprobs=True)
+    while eng.has_work():
+        eng.step_window()
+    req = eng.finished.pop(rid)
+    assert len(req.token_logprobs) == len(req.generated) == 5
+    # naive reference
+    seq = list(prompt)
+    for tok, lp in zip(req.generated, req.token_logprobs):
+        logits = forward(tiny_params, jnp.asarray([seq]), TINY)[0, -1]
+        want = float(jax.nn.log_softmax(logits)[tok])
+        assert abs(lp - want) < 1e-3, (lp, want)
+        seq.append(tok)
+    assert all(lp <= 0.0 for lp in req.token_logprobs)
+
+
+def test_openai_logprobs_surface(ray_start_regular):
+    import urllib.request
+
+    from ray_tpu import serve as serve_api
+    from ray_tpu.llm import build_openai_app
+    from ray_tpu.serve.config import DEFAULT_HTTP_PORT
+
+    app = build_openai_app(_llm_config())
+    serve_api.run(app, name="llm-lp", route_prefix="/llmlp")
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{DEFAULT_HTTP_PORT}/llmlp/v1/completions",
+            data=json.dumps({"prompt": "hi", "max_tokens": 3,
+                             "logprobs": True}).encode(),
+            headers={"content-type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            out = json.load(r)
+        lp = out["choices"][0]["logprobs"]
+        assert len(lp["token_logprobs"]) == 3
+        assert len(lp["tokens"]) == 3
+        assert all(x <= 0.0 for x in lp["token_logprobs"])
+    finally:
+        serve_api.delete("llm-lp")
