@@ -45,10 +45,10 @@ class IMPALAConfig(AlgorithmConfig):
         return self
 
 
-@functools.partial(jax.jit, static_argnames=("gamma", "rho_bar", "c_bar"))
-def _vtrace(behavior_logp, target_logp, rewards, values, dones, last_values,
-            *, gamma, rho_bar=1.0, c_bar=1.0):
-    """V-trace targets/advantages over [T, B] (lax.scan, time-reversed)."""
+def _vtrace_core(behavior_logp, target_logp, rewards, values, dones,
+                 last_values, *, gamma, rho_bar=1.0, c_bar=1.0):
+    """V-trace targets/advantages over [T, B] (lax.scan, time-reversed).
+    Pure (traceable inside other jits — the on-device Anakin path)."""
     rho = jnp.exp(target_logp - behavior_logp)
     rho_c = jnp.minimum(rho_bar, rho)
     c = jnp.minimum(c_bar, rho)
@@ -68,6 +68,10 @@ def _vtrace(behavior_logp, target_logp, rewards, values, dones, last_values,
     return vs, pg_adv
 
 
+_vtrace = jax.jit(_vtrace_core,
+                  static_argnames=("gamma", "rho_bar", "c_bar"))
+
+
 def impala_loss(params, batch, *, module, vf_coef, ent_coef):
     logits, value = module.forward_train(params, batch["obs"])
     logp_all = jax.nn.log_softmax(logits)
@@ -82,9 +86,13 @@ def impala_loss(params, batch, *, module, vf_coef, ent_coef):
 
 
 class IMPALA(Algorithm):
+    supports_ondevice_env = True  # Anakin-style (core/ondevice.py)
+
     def __init__(self, config):
-        if config.num_env_runners < 1:
-            raise ValueError("IMPALA needs remote env runners (async)")
+        from ray_tpu.rllib.env.jax_env import is_jax_env
+        if config.num_env_runners < 1 and not is_jax_env(config.env):
+            raise ValueError("IMPALA needs remote env runners (async) "
+                             "or a jax-native env (on-device Anakin)")
         super().__init__(config)
         self._inflight: dict = {}  # ref -> runner index
         self._target_logp = jax.jit(
@@ -93,6 +101,7 @@ class IMPALA(Algorithm):
                 act[..., None].astype(jnp.int32), -1)[..., 0])
         self._updates_since_broadcast = 0
         self._params_ref = None
+        self._behavior_params = None  # on-device path: stale actor tree
 
     def _loss_fn(self):
         return functools.partial(impala_loss, module=self.module)
@@ -119,7 +128,57 @@ class IMPALA(Algorithm):
                                    self.config.rollout_fragment_length)
         self._inflight[ref] = idx
 
+    def _training_step_ondevice(self) -> dict:
+        """Anakin/Podracer IMPALA: on-device envs act with a behavior
+        tree the host refreshes every broadcast_interval iterations;
+        rollout + learner forward + V-trace + the minibatch pass compile
+        into one dispatch (core/ondevice.py build_impala_train_iter)."""
+        import time as _time
+
+        import jax as _jax
+
+        c = self.config
+        learner = self.learner_group.local
+        if learner is None:
+            raise ValueError("on-device IMPALA uses a local learner "
+                             "(num_learners=0)")
+        if self._ondev_iter is None:
+            from ray_tpu.rllib.core.ondevice import build_impala_train_iter
+            B = self._jax_vec_env.num_envs
+            T = max(1, c.train_batch_size // B)
+            self._ondev_iter = build_impala_train_iter(
+                self._jax_vec_env, self.module, T=T,
+                minibatch_size=min(c.minibatch_size, T * B),
+                gamma=c.gamma, rho_bar=c.clip_rho_threshold,
+                c_bar=c.clip_pg_rho_threshold, vf_coef=c.vf_loss_coeff,
+                ent_coef=c.entropy_coeff, tx=learner.tx)
+            self._ondev_T = T
+            self._ondev_vs = self._jax_vec_env.reset(
+                _jax.random.PRNGKey(c.seed or 0))
+            self._ondev_key = _jax.random.PRNGKey((c.seed or 0) + 1)
+            self._behavior_params = learner.params
+        _t0 = _time.perf_counter()
+        (learner.params, learner.opt_state, self._ondev_vs,
+         self._ondev_key, m) = self._ondev_iter(
+            learner.params, self._behavior_params, learner.opt_state,
+            self._ondev_vs, self._ondev_key)
+        self._updates_since_broadcast += 1
+        if self._updates_since_broadcast >= c.broadcast_interval:
+            self._behavior_params = learner.params
+            self._updates_since_broadcast = 0
+        m = {k: float(v) for k, v in _jax.device_get(m).items()}
+        dt_ms = (_time.perf_counter() - _t0) * 1e3
+        steps = self._ondev_T * self._jax_vec_env.num_envs
+        self._timesteps += steps
+        self.env_runner_group.record(
+            m.pop("ep_ret_sum"), m.pop("ep_len_sum"), m.pop("ep_count"))
+        m["learner_update_ms"] = round(dt_ms, 1)
+        m["sample_ms"] = 0.0
+        return m
+
     def training_step(self) -> dict:
+        if self._jax_vec_env is not None:
+            return self._training_step_ondevice()
         c = self.config
         if self._params_ref is None:
             self._broadcast()

@@ -103,6 +103,83 @@ def build_ppo_train_iter(vec_env: JaxVecEnv, module, *, T: int,
     return jax.jit(train_iter)
 
 
+def build_impala_train_iter(vec_env: JaxVecEnv, module, *, T: int,
+                            minibatch_size: int, gamma: float,
+                            rho_bar: float, c_bar: float, vf_coef: float,
+                            ent_coef: float, tx):
+    """On-device IMPALA (the Anakin/Podracer architecture: DeepMind's
+    published TPU formulation of IMPALA — sebulba/anakin, Hessel et al.
+    2021): envs live on the accelerator, acting uses a STALE behavior
+    policy, and V-trace corrects the off-policyness, all in ONE compiled
+    dispatch. The host refreshes behavior params every
+    broadcast_interval iterations (same knob as the async actor-learner
+    path), so the off-policy gap the reference creates with queue lag is
+    created here with deliberate staleness.
+
+    Returns jit(train_iter)(params, behavior_params, opt_state, vs, key)
+    -> (params, opt_state, vs, key, metrics)."""
+    from ray_tpu.rllib.algorithms.impala import _vtrace_core, impala_loss
+
+    rollout = build_rollout(vec_env, module, T)
+    B = vec_env.num_envs
+    n = T * B
+    if n % minibatch_size:
+        raise ValueError(f"T*B={n} must tile into minibatches "
+                         f"of {minibatch_size}")
+    nmb = n // minibatch_size
+    loss_fn = functools.partial(impala_loss, module=module,
+                                vf_coef=vf_coef, ent_coef=ent_coef)
+
+    def train_iter(params, behavior_params, opt_state, vs, key):
+        # Act with the stale behavior policy; traj["logp"]/["values"]
+        # are the BEHAVIOR policy's.
+        vs, key, traj = rollout(behavior_params, vs, key)
+        obs = traj["obs"]                       # [T, B, ...]
+        flat_obs = obs.reshape((n,) + obs.shape[2:])
+        # Learner-side forward: target logp + current value estimates.
+        logits, values_l = module.forward_train(params, flat_obs)
+        logp_all = jax.nn.log_softmax(logits)
+        acts = traj["actions"].reshape(n)
+        target_logp = jnp.take_along_axis(
+            logp_all, acts[:, None].astype(jnp.int32), -1)[:, 0]
+        last_vals = traj["last_values"]  # behavior bootstrap (host path
+        #                                  uses the same approximation)
+        vs_t, pg_adv = _vtrace_core(
+            traj["logp"], target_logp.reshape(T, B), traj["rewards"],
+            values_l.reshape(T, B), traj["dones"], last_vals,
+            gamma=gamma, rho_bar=rho_bar, c_bar=c_bar)
+        flat = {
+            "obs": flat_obs,
+            "actions": acts,
+            "vs": vs_t.reshape(n),
+            "pg_advantages": pg_adv.reshape(n),
+        }
+
+        def one_minibatch(carry, idx):
+            params, opt_state = carry
+            mb = jax.tree_util.tree_map(
+                lambda x: jnp.take(x, idx, axis=0), flat)
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, mb)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), opt_state), (
+                loss, aux)
+
+        key, pkey = jax.random.split(key)
+        perm = jax.random.permutation(pkey, n).reshape(nmb,
+                                                       minibatch_size)
+        (params, opt_state), (losses, auxs) = jax.lax.scan(
+            one_minibatch, (params, opt_state), perm)
+        metrics = {k: v[-1] for k, v in auxs.items()}
+        metrics["total_loss"] = losses[-1]
+        metrics["ep_ret_sum"] = vs.done_ret_sum
+        metrics["ep_len_sum"] = vs.done_len_sum
+        metrics["ep_count"] = vs.done_count
+        return params, opt_state, vs, key, metrics
+
+    return jax.jit(train_iter)
+
+
 class OnDeviceSamplerGroup:
     """Stands in for EnvRunnerGroup when the env is jax-native: episode
     statistics live on-device (banked by JaxVecEnv.step) and surface
