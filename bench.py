@@ -391,7 +391,8 @@ def main():
         def one_n(total):
             ray_tpu.get(fan.batch.remote(sinks, total // k), timeout=300)
 
-        emit("1_n_actor_calls_async", timeit(one_n, 2000 * k, warm=2000))
+        emit("1_n_actor_calls_async", timeit(one_n, 2000 * k,
+                                             warm=4000))
 
         # n:n — m worker tasks each fanning to the k sinks.
         def n_n(total):
