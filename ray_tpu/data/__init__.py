@@ -28,6 +28,7 @@ from ray_tpu.data.datasource import (  # noqa: F401
     read_clickhouse,
     read_csv,
     read_delta,
+    read_hudi,
     read_iceberg,
     read_images,
     read_json,
@@ -45,6 +46,7 @@ __all__ = [
     "read_parquet", "read_csv", "read_json", "read_text",
     "read_binary_files", "read_numpy", "read_images",
     "read_tfrecord", "read_webdataset", "read_avro", "read_sql",
-    "read_delta", "read_iceberg", "read_bigquery", "read_clickhouse",
+    "read_delta", "read_hudi", "read_iceberg", "read_bigquery",
+    "read_clickhouse",
     "from_huggingface", "from_torch", "decode_image",
 ]

@@ -448,6 +448,43 @@ class Dataset:
                 for bref, _m in self.iter_internal()]
         ray_tpu.get(refs, timeout=600)
 
+    def write_hudi(self, path: str) -> None:
+        """Write (or append an insert commit to) a copy-on-write Apache
+        Hudi table: one base parquet per block as a fresh file group +
+        a completed `.hoodie/<instant>.commit` timeline entry, so
+        `read_hudi(..., as_of=...)` time-travels across appends. Parity:
+        the write side of the reference's hudi datasource (hudi-rs
+        wrapped there; the open table layout here). Insert-only: upserts
+        would need record keys + index maintenance."""
+        import datetime as dt_mod
+        import json as json_mod
+        import os
+        import uuid as uuid_mod
+
+        from ray_tpu.data.block import BlockAccessor
+
+        hoodie = os.path.join(path, ".hoodie")
+        os.makedirs(hoodie, exist_ok=True)
+        props = os.path.join(hoodie, "hoodie.properties")
+        if not os.path.exists(props):
+            with open(props, "w") as f:
+                f.write("hoodie.table.name="
+                        f"{os.path.basename(path.rstrip('/'))}\n"
+                        "hoodie.table.type=COPY_ON_WRITE\n")
+        instant = dt_mod.datetime.utcnow().strftime("%Y%m%d%H%M%S%f")[:17]
+        import pyarrow.parquet as pq
+        stats = []
+        for i, (bref, _m) in enumerate(self.iter_internal()):
+            t = BlockAccessor.of(ray_tpu.get(bref, timeout=600)).table
+            file_id = uuid_mod.uuid4().hex[:16]
+            name = f"{file_id}_0-{i}_{instant}.parquet"
+            pq.write_table(t, os.path.join(path, name))
+            stats.append({"fileId": file_id, "path": name,
+                          "numWrites": t.num_rows})
+        with open(os.path.join(hoodie, f"{instant}.commit"), "w") as f:
+            json_mod.dump({"partitionToWriteStats": {"": stats},
+                           "operationType": "INSERT"}, f)
+
     def write_iceberg(self, path: str) -> None:
         """Write (or append a snapshot to) a file-system Apache Iceberg
         table: parquet data files + an Avro manifest + manifest list +

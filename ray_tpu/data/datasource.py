@@ -164,6 +164,60 @@ def read_delta(table_path: str, *, version: int | None = None,
                       lambda f: pq.read_table(f), "ReadDelta")
 
 
+def read_hudi(table_path: str, *, as_of: str | None = None,
+              **_kw) -> Dataset:
+    """Apache Hudi copy-on-write table reader (parity:
+    `data/_internal/datasource/hudi_datasource.py`, which wraps
+    hudi-rs; implemented against the open table layout instead).
+
+    Replays the `.hoodie/` timeline's completed `*.commit` instants up
+    to `as_of` (a timeline timestamp string; default latest), keeps the
+    LATEST committed file slice per file group (CoW base files named
+    `<fileId>_<writeToken>_<instant>.parquet`), and reads those parquet
+    files."""
+    import json as json_mod
+
+    hoodie = os.path.join(table_path, ".hoodie")
+    if not os.path.isdir(hoodie):
+        raise FileNotFoundError(
+            f"{table_path!r} is not a Hudi table (no .hoodie/)")
+    instants = sorted(
+        f[:-len(".commit")] for f in os.listdir(hoodie)
+        if f.endswith(".commit"))
+    if as_of is not None:
+        if as_of not in instants:
+            raise FileNotFoundError(
+                f"{table_path!r} has no completed instant {as_of!r} "
+                f"(have: {instants})")
+        instants = [t for t in instants if t <= as_of]
+    committed = set(instants)
+    # Latest committed base file per (partition, fileId).
+    latest: dict[tuple, tuple] = {}  # key -> (instant, path)
+    for root, _dirs, files in os.walk(table_path):
+        if ".hoodie" in root:
+            continue
+        for f in files:
+            if not f.endswith(".parquet"):
+                continue
+            stem = f[:-len(".parquet")]
+            parts = stem.split("_")
+            if len(parts) < 3:
+                continue
+            file_id, instant = parts[0], parts[-1]
+            if instant not in committed:
+                continue
+            key = (os.path.relpath(root, table_path), file_id)
+            if key not in latest or instant > latest[key][0]:
+                latest[key] = (instant, os.path.join(root, f))
+    paths = sorted(p for _t, p in latest.values())
+    if not paths:
+        return Dataset(plan_mod.LogicalPlan(
+            [plan_mod.Read(name="ReadHudi", read_fns=[
+                lambda: pa.table({})])]))
+    return _make_read(paths, lambda f: __import__(
+        "pyarrow.parquet", fromlist=["pq"]).read_table(f), "ReadHudi")
+
+
 def read_iceberg(table_path: str, *, snapshot_id: int | None = None,
                  **_kw) -> Dataset:
     """Apache Iceberg table reader (parity:
