@@ -280,14 +280,17 @@ class _LLMServerImpl:
 
     def completions_stream(self, prompt: str, max_tokens=None,
                            temperature=None, top_p: float = 1.0,
-                           top_k: int = 0, model=None):
+                           top_k: int = 0, model=None, stop=None):
         """Per-token stream: yields incremental text deltas as the engine
         decodes (sync generator — runs as a streaming actor method next to
-        the replica's asyncio loop)."""
+        the replica's asyncio loop). `stop` truncates the stream at the
+        earliest stop string (the stop text itself is never emitted)."""
         import queue as _queue
 
         self.engine.params = self._params_for(model)
         ids = self.tokenizer.encode(prompt)
+        stops = ([stop] if isinstance(stop, str) else list(stop or []))
+        hold = max((len(s) for s in stops), default=1) - 1
         sub: "_queue.Queue" = _queue.Queue()
         with self._lock:
             rid = self.engine.add_request(ids, max_tokens, temperature,
@@ -296,14 +299,28 @@ class _LLMServerImpl:
         try:
             generated: list[int] = []
             sent = ""
-            while True:
+            done = False
+            while not done:
                 tok = sub.get(timeout=300)
                 if tok is None:
-                    break
-                generated.append(tok)
-                # Incremental decode of the full sequence keeps multi-token
-                # merges correct; emit only the unseen suffix.
-                text = self.tokenizer.decode(generated)
+                    done = True
+                    text = self.tokenizer.decode(generated)
+                else:
+                    generated.append(tok)
+                    # Incremental decode of the full sequence keeps
+                    # multi-token merges correct; emit only the unseen
+                    # suffix.
+                    text = self.tokenizer.decode(generated)
+                if stops:
+                    cut = min((i for i in (text.find(s) for s in stops
+                                           if s) if i >= 0), default=-1)
+                    if cut >= 0:
+                        text, done = text[:cut], True
+                    elif not done:
+                        # hold back a stop-length tail: a stop string can
+                        # straddle the next token
+                        text = text[:max(len(text) - hold, len(sent))] \
+                            if hold else text
                 if len(text) > len(sent):
                     delta, sent = text[len(sent):], text
                     yield delta
@@ -369,7 +386,8 @@ class _OpenAiRouterImpl:
         model = body.get("model")
         deltas = self.server.completions_stream.remote_streaming(
             prompt, body.get("max_tokens"), body.get("temperature"),
-            body.get("top_p", 1.0), body.get("top_k", 0), model)
+            body.get("top_p", 1.0), body.get("top_k", 0), model,
+            body.get("stop"))
         obj = "chat.completion.chunk" if chat else "text_completion"
         for delta in deltas:
             if chat:

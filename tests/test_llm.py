@@ -506,3 +506,38 @@ def test_openai_logprobs_surface(ray_start_regular):
         assert all(x <= 0.0 for x in lp["token_logprobs"])
     finally:
         serve_api.delete("llm-lp")
+
+
+def test_openai_stream_stop_sequences(ray_start_regular):
+    """stream=true with stop: the SSE stream ends at the stop string and
+    never emits it (including stop strings straddling token
+    boundaries)."""
+    import http.client
+
+    from ray_tpu import serve as serve_api
+    from ray_tpu.llm import build_openai_app
+    from ray_tpu.serve.config import DEFAULT_HTTP_PORT
+
+    app = build_openai_app(_llm_config())
+    serve_api.run(app, name="llm-sstop", route_prefix="/llmsstop")
+    try:
+        def run(body_extra):
+            body = json.dumps({"prompt": "hi", "max_tokens": 8,
+                               "stream": True, **body_extra}).encode()
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", DEFAULT_HTTP_PORT, timeout=120)
+            conn.request("POST", "/llmsstop/v1/completions", body=body,
+                         headers={"content-type": "application/json"})
+            raw = conn.getresponse().read().decode()
+            conn.close()
+            chunks = [json.loads(e[6:]) for e in raw.splitlines()
+                      if e.startswith("data: ") and e != "data: [DONE]"]
+            return "".join(c["choices"][0]["text"] for c in chunks)
+
+        full = run({})
+        assert len(full) >= 2
+        stop_at = full[1]
+        cut = run({"stop": [stop_at]})
+        assert stop_at not in cut and full.startswith(cut)
+    finally:
+        serve_api.delete("llm-sstop")
