@@ -57,7 +57,9 @@ def _watch_parent(ppid: int):
             with open(f"/proc/{ppid}/stat") as f:
                 return f.read().rsplit(") ", 1)[1][0] == "Z"
         except OSError:
-            return True
+            # No procfs (or unreadable): fail SAFE — kill(0) said alive,
+            # and a false "dead" here would SIGKILL a healthy cluster.
+            return False
 
     def watch():
         while True:

@@ -324,6 +324,14 @@ class ClientProtoServer:
             self._actors[handle._actor_id] = handle
         reply.create_actor.actor_id = handle._actor_id
 
+    # Per-connection retained-result cap: a long-lived frontend looping
+    # CallActor without disconnecting must not pin unbounded results in
+    # the store. FIFO eviction — results are overwhelmingly fetched soon
+    # after their call; a client coming back for a result more than
+    # MAX_CONN_REFS calls later sees it as released (the reference's
+    # client server bounds its reference map with client-side releases).
+    MAX_CONN_REFS = 4096
+
     def _actor_call(self, m: pb.ActorCallRequest, reply, refs: dict):
         with self._actors_lock:
             handle = self._actors.get(m.actor_id)
@@ -331,6 +339,8 @@ class ClientProtoServer:
             raise KeyError(f"unknown actor {m.actor_id.hex()} (created "
                            f"through this client plane?)")
         ref = getattr(handle, m.method).remote(*self._decode_args(m.args))
+        while len(refs) >= self.MAX_CONN_REFS:
+            refs.pop(next(iter(refs)))
         refs[ref.id.binary()] = ref  # retained: see _serve
         reply.actor_call.return_id = ref.id.binary()
 

@@ -389,13 +389,27 @@ def read_bigquery(project_id: str, *, query: str | None = None,
         query = f"SELECT * FROM `{dataset}`"
 
     def read() -> pa.Table:
+        import time as time_mod
         url = f"{base}/projects/{project_id}/queries"
         resp = _http_json("POST", url,
                           {"query": query, "useLegacySql": False,
                            "maxResults": page_size}, access_token)
+        job = resp.get("jobReference", {}).get("jobId", "")
+        deadline = time_mod.monotonic() + 600
+        while not resp.get("jobComplete", True):
+            # jobs.query timed out before the query finished: poll
+            # getQueryResults until jobComplete — treating the partial
+            # response as final would silently return empty/truncated
+            # data.
+            if time_mod.monotonic() > deadline:
+                raise TimeoutError(
+                    f"bigquery job {job} not complete after 600s")
+            time_mod.sleep(1.0)
+            resp = _http_json(
+                "GET", f"{url}/{job}?maxResults={page_size}", None,
+                access_token)
         fields = resp.get("schema", {}).get("fields", [])
         rows = list(resp.get("rows", []))
-        job = resp.get("jobReference", {}).get("jobId", "")
         token = resp.get("pageToken")
         while token:
             resp = _http_json(

@@ -785,6 +785,7 @@ class InferenceEngine:
         self.finished: dict[int, Request] = {}
         self._next_id = 0
         self._lock = threading.Lock()
+        self._cancel_rids: set[int] = set()
 
     # ---- request API ----
 
@@ -817,6 +818,43 @@ class InferenceEngine:
             guide=guide, logprobs=bool(logprobs))
         self.queue.append(req)
         return rid
+
+    def cancel(self, request_id: int):
+        """Abort a request from ANY thread: flagged here, applied by the
+        pump thread at its next admission pass (a queued request drops;
+        an active slot finishes immediately with generated-so-far, its
+        pages released). An early-stopped stream must not keep burning
+        decode slots to max_new_tokens."""
+        self._cancel_rids.add(request_id)
+
+    def _apply_cancels(self):
+        if not self._cancel_rids:
+            return
+        rids: set[int] = set()
+        while True:
+            try:
+                rids.add(self._cancel_rids.pop())
+            except KeyError:
+                break
+        kept: collections.deque[Request] = collections.deque()
+        for req in self.queue:
+            if req.request_id in rids:
+                req.done = True
+                self.finished[req.request_id] = req
+            else:
+                kept.append(req)
+        self.queue = kept
+        for i in range(self.e.max_slots):
+            req = self.slot_req[i]
+            if req is None or req.request_id not in rids:
+                continue
+            req.done = True
+            self.finished[req.request_id] = req
+            self.active[i] = False
+            self.slot_req[i] = None
+            if self.paged:
+                self._release_slot(i)
+            self._dev_dirty = True
 
     def has_work(self) -> bool:
         return bool(self.queue) or bool(self.active.any())
@@ -946,6 +984,7 @@ class InferenceEngine:
         return True
 
     def _admit(self) -> dict[int, int]:
+        self._apply_cancels()
         return self._admit_paged() if self.paged else self._admit_dense()
 
     def _admit_paged(self) -> dict[int, int]:

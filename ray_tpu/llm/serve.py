@@ -56,6 +56,10 @@ class _LLMServerImpl:
         self._guide_cache: dict[str, object] = {}
         self._waiters: dict[int, tuple] = {}  # rid -> (loop, future)
         self._token_subs: dict[int, "queue.Queue"] = {}  # rid -> token queue
+        # rids whose consumer is gone (early-stopped/abandoned streams):
+        # the pump discards their finished records instead of stranding
+        # them in engine.finished forever.
+        self._discard: set[int] = set()
         self._lock = threading.Lock()
         self._stop = False
         self._pump = threading.Thread(target=self._loop, daemon=True,
@@ -93,6 +97,10 @@ class _LLMServerImpl:
                     if rid in self.engine.finished:
                         self.engine.finished.pop(rid)
                         self._token_subs[rid].put(None)  # end of stream
+                for rid in list(self._discard):
+                    if rid in self.engine.finished:
+                        self.engine.finished.pop(rid)
+                        self._discard.discard(rid)
             for loop, fut, req in done:
                 loop.call_soon_threadsafe(fut.set_result, req)
 
@@ -237,9 +245,17 @@ class _LLMServerImpl:
         text, stopped = self._apply_stop(text, stop)
         lp = None
         if logprobs:
-            lp = {"tokens": [self.tokenizer.decode([t])
-                             for t in req.generated],
-                  "token_logprobs": list(req.token_logprobs)}
+            kept = req.generated
+            if stopped:
+                # Align the logprob arrays with the TRUNCATED text:
+                # clients zip tokens/token_logprobs against text offsets.
+                kept = []
+                for t in req.generated:
+                    kept.append(t)
+                    if len(self.tokenizer.decode(kept)) >= len(text):
+                        break
+            lp = {"tokens": [self.tokenizer.decode([t]) for t in kept],
+                  "token_logprobs": list(req.token_logprobs[:len(kept)])}
         return {
             "id": f"cmpl-{uuid.uuid4().hex[:24]}",
             "object": "text_completion",
@@ -296,6 +312,7 @@ class _LLMServerImpl:
             rid = self.engine.add_request(ids, max_tokens, temperature,
                                           top_p=top_p, top_k=top_k)
             self._token_subs[rid] = sub
+        ended = False  # engine finished the request (pump popped it)
         try:
             generated: list[int] = []
             sent = ""
@@ -303,7 +320,7 @@ class _LLMServerImpl:
             while not done:
                 tok = sub.get(timeout=300)
                 if tok is None:
-                    done = True
+                    done = ended = True
                     text = self.tokenizer.decode(generated)
                 else:
                     generated.append(tok)
@@ -327,9 +344,17 @@ class _LLMServerImpl:
         finally:
             with self._lock:
                 self._token_subs.pop(rid, None)
-                # A timed-out/abandoned stream must not strand the finished
-                # record (nobody else will pop it for this rid).
-                self.engine.finished.pop(rid, None)
+                if ended:
+                    pass  # pump already popped the finished record
+                elif rid in self.engine.finished:
+                    self.engine.finished.pop(rid, None)
+                else:
+                    # Still decoding (early stop / abandoned stream):
+                    # cancel so the slot frees instead of burning to
+                    # max_new_tokens, and have the pump discard the
+                    # finished record when it lands.
+                    self.engine.cancel(rid)
+                    self._discard.add(rid)
 
     def model_ids(self) -> list:
         return [self.cfg.model_id, *self._adapters]

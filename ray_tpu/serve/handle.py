@@ -62,9 +62,15 @@ class Router:
     # serve_deployment_metrics.py). Lazily created so importing handle
     # doesn't register metrics in processes that never route.
     _METRICS = None
+    _METRICS_LOCK = threading.Lock()
 
     @classmethod
     def _metrics(cls):
+        with Router._METRICS_LOCK:
+            return cls._metrics_locked()
+
+    @classmethod
+    def _metrics_locked(cls):
         if Router._METRICS is None:
             from ray_tpu.util.metrics import Counter, Histogram
             Router._METRICS = {

@@ -541,3 +541,56 @@ def test_openai_stream_stop_sequences(ray_start_regular):
         assert stop_at not in cut and full.startswith(cut)
     finally:
         serve_api.delete("llm-sstop")
+
+
+def test_engine_cancel_frees_slot_and_finishes(tiny_params):
+    """cancel() drops a queued request and aborts an active slot with
+    its generated-so-far; pages release (no leak)."""
+    eng = InferenceEngine(
+        TINY, EngineConfig(max_slots=1, max_len=64, prompt_buckets=(16,),
+                           eos_token=-1), params=tiny_params)
+    r_active = eng.add_request([5, 6, 7], max_new_tokens=50,
+                               temperature=0.0)
+    r_queued = eng.add_request([8, 9], max_new_tokens=50, temperature=0.0)
+    for _ in range(3):
+        eng.step_window()
+    assert eng.active.any()
+    eng.cancel(r_active)
+    eng.cancel(r_queued)
+    eng.step_window()
+    assert r_active in eng.finished and r_queued in eng.finished
+    assert len(eng.finished[r_active].generated) >= 1
+    assert eng.finished[r_queued].generated == []
+    assert not eng.active.any()
+    assert eng.kv_stats()["pages_in_use"] == 0
+
+
+def test_stream_early_stop_no_leak():
+    """A stream cut by a stop sequence cancels the engine request: the
+    decode slot frees, no finished record strands on the replica, and
+    the pump discards the cancelled request's record."""
+    import time as time_mod
+
+    from ray_tpu.llm.serve import _LLMServerImpl
+
+    impl = _LLMServerImpl(_llm_config())
+    try:
+        # discover a stop character from an unconstrained stream
+        full = "".join(impl.completions_stream("hi", 6, 0.0))
+        assert len(full) >= 2
+        stop_at = full[1]
+        out = "".join(impl.completions_stream("hi", 6, 0.0,
+                                              stop=[stop_at]))
+        assert stop_at not in out and full.startswith(out)
+        deadline = time_mod.monotonic() + 30
+        while time_mod.monotonic() < deadline:
+            if (not impl.engine.finished and not impl._discard
+                    and not impl.engine.active.any()):
+                break
+            time_mod.sleep(0.2)
+        assert impl.engine.finished == {}
+        assert not impl._discard
+        assert not impl.engine.active.any()
+        assert impl.engine.kv_stats()["pages_in_use"] == 0
+    finally:
+        impl._stop = True
