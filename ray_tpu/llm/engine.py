@@ -473,17 +473,63 @@ def ngram_draft(hist, lengths, last_tokens, k: int):
     return jnp.where(found[:, None], drafts, c1[:, None])
 
 
+def spec_accept_sample(logits, tin, temps, key):
+    """Accept/resample step of delta-proposal speculative SAMPLING
+    (Leviathan et al.: with a deterministic draft d, accept w.p.
+    p(d); on reject, sample the residual — p with d's mass removed,
+    renormalized — which makes every emitted token an EXACT sample from
+    the target distribution). temps==0 rows reduce to the greedy
+    accept-iff-argmax rule with argmax picks, so one path serves mixed
+    batches bit-exactly for the greedy rows.
+
+    logits [B, K+1, V] (position j predicts the token AFTER input j),
+    tin [B, K+1] (pending token + K drafts), temps [B].
+    Returns (acc [B] accepted-draft count, final [B] the
+    resampled/bonus token at position acc, g_argmax [B, K+1])."""
+    B, K1, V = logits.shape
+    K = K1 - 1
+    greedy = (temps <= 0.0)[:, None]                       # [B, 1]
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None, None]
+    probs = jax.nn.softmax(scaled, axis=-1)                # [B, K+1, V]
+    g = jnp.argmax(logits, axis=-1).astype(jnp.int32)      # [B, K+1]
+    drafts = tin[:, 1:]                                    # [B, K]
+    p_d = jnp.take_along_axis(
+        probs[:, :K], drafts[..., None], -1)[..., 0]       # [B, K]
+    key, ku = jax.random.split(key)
+    u = jax.random.uniform(ku, (B, K))
+    ok = jnp.where(greedy, g[:, :K] == drafts, u < p_d)
+    acc = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1)
+    # Token at position acc: greedy -> argmax; sampled -> residual
+    # (reject, acc < K) or the plain target (bonus, acc == K).
+    probs_r = jnp.take_along_axis(
+        probs, acc[:, None, None], 1)[:, 0]                # [B, V]
+    d_r = jnp.take_along_axis(
+        tin, jnp.minimum(acc + 1, K)[:, None], 1)[:, 0]    # draft at acc
+    excl = jax.nn.one_hot(d_r, V, dtype=probs_r.dtype)
+    resid = jnp.where((acc < K)[:, None], probs_r * (1.0 - excl),
+                      probs_r)
+    resid = resid / jnp.maximum(resid.sum(-1, keepdims=True), 1e-30)
+    key, ks = jax.random.split(key)
+    sampled = jax.random.categorical(ks, jnp.log(resid + 1e-30), axis=-1)
+    bonus_g = jnp.take_along_axis(g, acc[:, None], 1)[:, 0]
+    final = jnp.where(greedy[:, 0], bonus_g,
+                      sampled.astype(jnp.int32))
+    return acc, final, g
+
+
 def decode_window_spec(params, pool_k, pool_v, tokens, lengths, active,
-                       hist, page_tables, config: ModelConfig,
-                       eos_token: int, n_steps: int, spec_k: int):
-    """Speculative decode window (greedy-only): each of `n_steps` scan
-    iterations drafts spec_k tokens by device-side n-gram lookup,
-    verifies them in ONE multi-token forward (verify_paged), and emits
-    accepted-prefix + 1 bonus token — between 1 and spec_k+1 tokens per
-    model pass, with bitwise-identical output to plain greedy decoding
-    (the standard speculative-decoding guarantee at temperature 0).
-    Returns out blocks [n_steps, B, spec_k+1] (-1 = nothing emitted at
-    that position).
+                       hist, page_tables, temps, key,
+                       config: ModelConfig, eos_token: int, n_steps: int,
+                       spec_k: int):
+    """Speculative decode window: each of `n_steps` scan iterations
+    drafts spec_k tokens by device-side n-gram lookup, verifies them in
+    ONE multi-token forward (verify_paged), and emits accepted-prefix +
+    1 final token — between 1 and spec_k+1 tokens per model pass.
+    Greedy (temp 0) rows are bitwise-identical to plain greedy decoding;
+    sampled rows use delta-proposal rejection sampling, so every emitted
+    token is an exact draw from the temperature-scaled target
+    distribution (Leviathan et al. 2023). Returns out blocks
+    [n_steps, B, spec_k+1] (-1 = nothing emitted at that position).
 
     Parity: vLLM ngram speculative decoding
     (`python/ray/llm/_internal/serve/deployments/llm/vllm/` inherits it);
@@ -495,15 +541,13 @@ def decode_window_spec(params, pool_k, pool_v, tokens, lengths, active,
     jj = jnp.arange(K + 1)[None]                           # [1, K+1]
 
     def one(carry, _):
-        pk, pv, toks, lens, act, hst = carry
+        pk, pv, toks, lens, act, hst, key = carry
         drafts = ngram_draft(hst, lens, toks, K)           # [B, K]
         tin = jnp.concatenate([toks[:, None], drafts], axis=1)
         logits, pk, pv = verify_paged(params, pk, pv, tin, lens, act,
                                       page_tables, config)
-        g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, K+1]
-        ok = g[:, :K] == drafts
-        acc = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1)
-        bonus = jnp.take_along_axis(g, acc[:, None], 1)[:, 0]
+        key, kacc = jax.random.split(key)
+        acc, bonus, g = spec_accept_sample(logits, tin, temps, kacc)
         drafts_p = jnp.concatenate(
             [drafts, jnp.zeros((B, 1), jnp.int32)], axis=1)
         e = jnp.where(jj == acc[:, None], bonus[:, None],
@@ -535,12 +579,12 @@ def decode_window_spec(params, pool_k, pool_v, tokens, lengths, active,
         toks = jnp.where(act, bonus, toks)
         lens = jnp.where(act, lens + acc + 1, lens)
         act = act & ~stop
-        return (pk, pv, toks, lens, act, hst), e
+        return (pk, pv, toks, lens, act, hst, key), e
 
-    carry = (pool_k, pool_v, tokens, lengths, active, hist)
-    (pool_k, pool_v, tokens, lengths, active, hist), out_seq = (
+    carry = (pool_k, pool_v, tokens, lengths, active, hist, key)
+    (pool_k, pool_v, tokens, lengths, active, hist, key), out_seq = (
         jax.lax.scan(one, carry, None, length=n_steps))
-    return pool_k, pool_v, tokens, lengths, active, hist, out_seq
+    return pool_k, pool_v, tokens, lengths, active, hist, key, out_seq
 
 
 def decode_window(params, pool_k, pool_v, tokens, lengths, active,
@@ -1579,16 +1623,17 @@ class InferenceEngine:
         return emitted
 
     def _spec_applicable(self) -> bool:
-        """Speculation serves greedy, unguided slots; any active slot
-        outside that contract routes the whole window to the plain path
-        (mixed windows would need per-slot rejection sampling)."""
+        """Speculation serves greedy AND plain-temperature slots (delta-
+        proposal rejection sampling keeps sampled outputs exact); top-k /
+        top-p truncation, guided decoding, and logprobs route the window
+        to the plain path."""
         if not self._spec:
             return False
         for i in range(self.e.max_slots):
             r = self.slot_req[i]
             if not self.active[i] or r is None:
                 continue
-            if (r.temperature > 0 or r.top_k != 0 or r.top_p < 1.0
+            if (r.top_k != 0 or r.top_p < 1.0
                     or r.guide is not None or r.logprobs):
                 return False
         return True
@@ -1638,13 +1683,16 @@ class InferenceEngine:
             fn = jax.jit(partial(decode_window_spec, config=self.c,
                                  eos_token=int(e.eos_token),
                                  n_steps=iters, spec_k=K),
-                         donate_argnums=(1, 2, 3, 4, 5, 6))
+                         donate_argnums=(1, 2, 3, 4, 5, 6, 9))
             self._spec_window_fns[key] = fn
+        self._sync_sampling()
+        temps_d = self._dev_sampling[0]
         toks_d, lens_d, act_d = self._dev
         (self.cache_k, self.cache_v, toks_d, lens_d, act_d,
-         self._dev_hist, out_seq) = fn(
+         self._dev_hist, self._dev_key, out_seq) = fn(
             self.params, self.cache_k, self.cache_v, toks_d, lens_d,
-            act_d, self._dev_hist, jnp.asarray(tables))
+            act_d, self._dev_hist, jnp.asarray(tables), temps_d,
+            self._dev_key)
         self._dev = (toks_d, lens_d, act_d)
         out = np.asarray(out_seq)  # [iters, B, K+1]; ONE fence
         w_draft = w_acc = 0
