@@ -215,6 +215,67 @@ class SimpleImputer(Preprocessor):
         return out
 
 
+class OrdinalEncoder(Preprocessor):
+    """Categorical columns -> integer codes in place (like LabelEncoder
+    but for feature columns, several at once; unseen values -> -1).
+    Parity: preprocessors/encoder.py OrdinalEncoder."""
+
+    def __init__(self, columns: list[str]):
+        self.columns = list(columns)
+        self.categories_: dict[str, list] = {}
+
+    def _fit(self, ds):
+        seen: dict[str, set] = {c: set() for c in self.columns}
+        for batch in ds.iter_batches(batch_format="numpy"):
+            for c in self.columns:
+                seen[c].update(np.asarray(batch[c]).tolist())
+        self.categories_ = {c: sorted(v, key=repr)
+                            for c, v in seen.items()}
+
+    def transform_batch(self, batch):
+        out = dict(batch)
+        for c in self.columns:
+            index = {v: i for i, v in enumerate(self.categories_[c])}
+            out[c] = np.array(
+                [index.get(x, -1)
+                 for x in np.asarray(batch[c]).tolist()], np.int64)
+        return out
+
+
+class MultiHotEncoder(Preprocessor):
+    """List-valued categorical columns -> fixed-width 0/1 vectors over
+    the vocabulary discovered at fit (unseen values ignored). Parity:
+    preprocessors/encoder.py MultiHotEncoder."""
+
+    def __init__(self, columns: list[str]):
+        self.columns = list(columns)
+        self.categories_: dict[str, list] = {}
+
+    def _fit(self, ds):
+        seen: dict[str, set] = {c: set() for c in self.columns}
+        for batch in ds.iter_batches(batch_format="numpy"):
+            for c in self.columns:
+                for row in np.asarray(batch[c], dtype=object).tolist():
+                    seen[c].update(row)
+        self.categories_ = {c: sorted(v, key=repr)
+                            for c, v in seen.items()}
+
+    def transform_batch(self, batch):
+        out = dict(batch)
+        for c in self.columns:
+            vocab = self.categories_[c]
+            index = {v: i for i, v in enumerate(vocab)}
+            rows = np.asarray(batch[c], dtype=object).tolist()
+            mat = np.zeros((len(rows), len(vocab)), np.int8)
+            for r, row in enumerate(rows):
+                for v in row:
+                    i = index.get(v)
+                    if i is not None:
+                        mat[r, i] = 1
+            out[c] = mat
+        return out
+
+
 class UniformKBinsDiscretizer(Preprocessor):
     """Bin numeric columns into `bins` equal-width intervals discovered
     from fit-time min/max; values become int bin indices 0..bins-1

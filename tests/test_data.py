@@ -907,3 +907,25 @@ def test_hudi_write_read_time_travel(ray_start_regular, tmp_path):
         rd.read_hudi(table, as_of="19700101000000000")
     with pytest.raises(FileNotFoundError):
         rd.read_hudi(str(tmp_path / "nope"))
+
+
+def test_ordinal_and_multihot_encoders(ray_start_regular):
+    import numpy as np
+
+    import ray_tpu.data as rd
+    from ray_tpu.data.preprocessors import MultiHotEncoder, OrdinalEncoder
+
+    ds = rd.from_items([{"c": "red", "tags": ["a", "b"]},
+                        {"c": "blue", "tags": ["b"]},
+                        {"c": "red", "tags": []}])
+    oe = OrdinalEncoder(["c"]).fit(ds)
+    assert oe.categories_["c"] == ["blue", "red"]
+    batch = oe.transform(ds).take_batch(3, batch_format="numpy")
+    assert batch["c"].tolist() == [1, 0, 1]
+    assert oe.transform_batch({"c": np.asarray(["mauve"])})["c"].tolist() \
+        == [-1]
+
+    mh = MultiHotEncoder(["tags"]).fit(ds)
+    assert mh.categories_["tags"] == ["a", "b"]
+    batch = mh.transform(ds).take_batch(3, batch_format="numpy")
+    assert batch["tags"].tolist() == [[1, 1], [0, 1], [0, 0]]
