@@ -102,8 +102,14 @@ def _gm(rs):
 
 
 def final_line(status: str = "complete"):
-    """The ONE stdout JSON line. Computed over whatever metrics landed —
-    skipped/failed ones are stamped, never silently averaged in."""
+    """The ONE stdout JSON line, guaranteed parseable from a tail window.
+
+    r5/r4 postmortem: the old final line carried the full 22-metric detail
+    + TPU config dump and overflowed the driver's stdout tail, so the
+    headline parsed as null two rounds running. Now the FULL results JSON
+    is persisted to the BENCH_OUT file and the final stdout line is a
+    short (<1 KB) headline: geomean, the split geomeans, the contended
+    top metrics, and a pointer to the detail file."""
     global _FINAL_PRINTED
     if _FINAL_PRINTED:
         return
@@ -120,7 +126,9 @@ def final_line(status: str = "complete"):
     geomean = _gm(ratios)
     mfu = max((c["mfu_pct"] for c in TPU.get("configs", [])
                if isinstance(c, dict) and "mfu_pct" in c), default=None)
-    out = {
+    detail_path = os.environ.get(
+        "BENCH_OUT", os.path.join(_REPO, "bench_out.json"))
+    full = {
         "metric": "core_microbenchmark_geomean_vs_ray",
         "value": round(geomean, 3),
         "unit": f"x (geomean of {len(ratios)}/{len(BASELINE)} metrics "
@@ -137,10 +145,42 @@ def final_line(status: str = "complete"):
         "detail": {k: round(v, 1) for k, v in RESULTS.items()},
     }
     if missing:
-        out["missing_metrics"] = missing
+        full["missing_metrics"] = missing
     if SKIPPED:
-        out["skipped_sections"] = SKIPPED
-    print(json.dumps(out), flush=True)
+        full["skipped_sections"] = SKIPPED
+    try:
+        with open(detail_path, "w") as f:
+            json.dump(full, f, indent=1)
+        wrote_detail = True
+    except OSError:
+        wrote_detail = False
+    headline = {
+        "metric": full["metric"],
+        "value": full["value"],
+        "unit": "x vs Ray 2.44 (64-CPU baseline numbers)",
+        "vs_baseline": full["vs_baseline"],
+        "single_client_geomean": full["single_client_geomean"],
+        "parallel_geomean": full["parallel_geomean"],
+        "status": status,
+        "wall_s": full["wall_s"],
+        "n_metrics": len(ratios),
+        "n_missing": len(missing),
+        "n_skipped": len(SKIPPED),
+        "tpu_mfu_pct": mfu,
+        "top": {k: round(RESULTS[k], 1) for k in (
+            "multi_client_put_gigabytes", "n_n_actor_calls_with_arg_async",
+            "multi_client_tasks_async", "single_client_put_gigabytes",
+            "single_client_tasks_async") if k in RESULTS},
+        "detail_file": detail_path if wrote_detail else None,
+    }
+    line = json.dumps(headline)
+    if len(line) > 1024:  # hard cap: the tail window must always parse it
+        for key in ("top", "detail_file", "unit"):
+            headline.pop(key, None)
+            line = json.dumps(headline)
+            if len(line) <= 1024:
+                break
+    print(line, flush=True)
 
 
 def _on_term(signum, _frame):

@@ -1,18 +1,30 @@
 // Shared-memory object store: a single mmap'd arena shared by every process on a
 // node, with an in-shm object index and allocator so create/seal/get/release are
-// direct memory operations under a robust process-shared mutex — no broker
-// round-trip.
+// direct memory operations — no broker round-trip.
 //
 // Parity: reference `src/ray/object_manager/plasma/` (PlasmaStore store.h:55,
 // dlmalloc arena, eviction_policy.h LRU, create_request_queue.h backpressure).
 // Design departure: plasma brokers create/get through a unix-socket server and
-// passes fds; here clients map the arena directly and synchronize through a
-// robust pthread mutex in shm, which removes the per-op socket round trip
+// passes fds; here clients map the arena directly and synchronize through
+// robust pthread mutexes in shm, which removes the per-op socket round trip
 // (the main cost in plasma's put/get calls/s) while keeping zero-copy reads.
 //
+// Concurrency: the index and allocator are SHARDED. Object ids hash to one of
+// N shards, each with its own robust mutex, slot-table segment, and small-block
+// cache (fastbins + a free list refilled in chunks), so concurrent create/get/
+// release from many clients only contend when their ids collide on a shard —
+// the plasma-era single store mutex serialized every client on one lock.
+// Large blocks (> small_max) come from a global extent allocator under its own
+// mutex; its critical sections are pointer splices (microseconds), so even
+// GB-scale puts from many clients overlap their copies fully.
+//
 // Layout:
-//   [Header | slot table (open addressing) | arena]
-// Free blocks form an address-ordered singly-linked list for O(1) coalescing.
+//   [Header | shard headers[N] | slot tables (per-shard segments) | arena]
+// Free blocks form address-ordered singly-linked lists (one global, one small-
+// block list per shard) for O(1) coalescing; freed small blocks park in
+// per-shard size-class fastbins, and shard caches consolidate back into the
+// global list past a byte threshold or on allocation pressure — the dlmalloc
+// fastbin design the reference's plasma store inherits, replicated per shard.
 //
 // All functions return 0 on success or a negative StoreStatus.
 
@@ -57,9 +69,11 @@ enum StoreStatus {
   ERR_CORRUPT = -7,
 };
 
-static const uint64_t MAGIC = 0x5241595F54505531ULL;  // "RAY_TPU1"
+static const uint64_t MAGIC = 0x5241595F54505532ULL;  // "RAY_TPU2" (sharded)
 static const uint64_t ALIGN = 64;
 static const uint64_t MIN_BLOCK = 128;
+static const uint32_t SHARD_CANARY = 0x53484152;      // "SHAR"
+static const uint64_t MAX_SHARDS = 256;
 
 enum SlotState : uint32_t {
   SLOT_EMPTY = 0,
@@ -85,36 +99,44 @@ struct FreeBlock {
   uint64_t next;  // arena-relative offset of next free block, or 0 (arena off 0 is never free: we reserve first ALIGN bytes)
 };
 
-// Small freed blocks park in size-class fastbins (O(1) push/pop, one
-// singly-linked list per size class) instead of the address-ordered main
-// list, whose ordered insert is O(free blocks) — under small-object churn
-// (thousands of task results freed per second) that walk turned every
-// delete quadratic. Fastbins consolidate back into the main list (where
-// coalescing happens) past a byte threshold or on allocation pressure —
-// the dlmalloc fastbin design the reference's plasma store inherits.
 static const uint64_t FASTBIN_MAX = 2048;   // largest fastbinned block
 static const uint64_t NUM_FASTBINS = FASTBIN_MAX / ALIGN;  // 64..2048 step 64
-static const uint64_t FASTBIN_CONSOLIDATE_BYTES = 8u << 20;
+static const uint64_t SMALL_MAX = 256u << 10;  // shard-cache ceiling
+
+struct Shard {
+  pthread_mutex_t mutex;
+  uint32_t canary;
+  uint32_t _pad0;
+  uint64_t free_head;              // small-block list, arena-relative, 0=none
+  uint64_t fastbin[NUM_FASTBINS];  // arena-relative heads, 0 = empty
+  uint64_t cache_bytes;            // bytes parked in fastbins + free list
+  uint64_t num_objects;
+  uint64_t num_evictions;
+  uint64_t num_tombstones;
+};
 
 struct Header {
   uint64_t magic;
   uint64_t total_size;
-  uint64_t num_slots;
-  uint64_t arena_offset;   // from base
+  uint64_t nshards;          // power of two, <= MAX_SHARDS
+  uint64_t slots_per_shard;  // power of two
+  uint64_t table_offset;     // from base
+  uint64_t arena_offset;     // from base
   uint64_t arena_size;
-  pthread_mutex_t mutex;
-  uint64_t free_head;      // arena-relative, 0 = none
-  uint64_t lru_clock;
-  uint64_t bytes_allocated;
-  uint64_t num_objects;
-  uint64_t num_evictions;
-  uint64_t fastbin[NUM_FASTBINS];  // arena-relative heads, 0 = empty
-  uint64_t fastbin_bytes;
-  uint64_t num_tombstones;
+  uint64_t refill_chunk;     // shard cache refill granularity
+  uint64_t small_max;        // allocations <= this ride the shard cache
+  uint64_t cache_limit;      // per-shard cache consolidation threshold
+  pthread_mutex_t mutex;     // global: extent list + bytes_from_global
+  uint64_t free_head;        // global extent list, arena-relative, 0 = none
+  uint64_t bytes_from_global;  // bytes carved out of the global list
+  uint64_t lru_clock;          // advanced with atomics, no lock
 };
 
-static inline Slot* slots(Header* h) {
-  return (Slot*)((char*)h + sizeof(Header));
+static inline Shard* shard_at(Header* h, uint64_t i) {
+  return (Shard*)((char*)h + sizeof(Header)) + i;
+}
+static inline Slot* shard_table(Header* h, uint64_t i) {
+  return (Slot*)((char*)h + h->table_offset) + i * h->slots_per_shard;
 }
 static inline char* arena(Header* h) { return (char*)h + h->arena_offset; }
 
@@ -125,115 +147,46 @@ static inline uint64_t hash_id(const uint8_t* id) {
   return x;
 }
 
-static void lock(Header* h) {
-  int rc = pthread_mutex_lock(&h->mutex);
+static inline uint64_t shard_of(Header* h, const uint8_t* id) {
+  return hash_id(id) & (h->nshards - 1);
+}
+// Probe start inside a shard's table segment: the low bits picked the
+// shard, so the in-shard position uses a disjoint bit range.
+static inline uint64_t slot_start(Header* h, const uint8_t* id) {
+  return (hash_id(id) >> 20) & (h->slots_per_shard - 1);
+}
+
+static inline uint64_t next_tick(Header* h) {
+  return __atomic_add_fetch(&h->lru_clock, 1, __ATOMIC_RELAXED);
+}
+
+static void lock_mu(pthread_mutex_t* mu) {
+  int rc = pthread_mutex_lock(mu);
   if (rc == EOWNERDEAD) {
-    // A process died holding the lock; shm metadata is still consistent because
-    // every mutation below completes all pointer updates before unlock and a
-    // half-written object is just an unsealed slot (evictable).
-    pthread_mutex_consistent(&h->mutex);
+    // A process died holding the lock; shm metadata is still consistent
+    // because every mutation completes all pointer updates before unlock and
+    // a half-written object is just an unsealed slot (evictable).
+    pthread_mutex_consistent(mu);
   }
 }
-static void unlock(Header* h) { pthread_mutex_unlock(&h->mutex); }
+static bool trylock_mu(pthread_mutex_t* mu) {
+  int rc = pthread_mutex_trylock(mu);
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(mu);
+    return true;
+  }
+  return rc == 0;
+}
+static void unlock_mu(pthread_mutex_t* mu) { pthread_mutex_unlock(mu); }
 
-// ---- allocator: address-ordered first-fit free list in the arena ----
+// ---- free-list primitives (shared by the global list and shard lists) ----
 
 static uint64_t align_up(uint64_t v) { return (v + ALIGN - 1) & ~(ALIGN - 1); }
 
-static void consolidate_fastbins(Header* h);
-static int64_t alloc_block_main(Header* h, uint64_t need);
-static void insert_ordered(Header* h, uint64_t off, uint64_t size);
-
-static int64_t alloc_block(Header* h, uint64_t need) {
-  need = align_up(need < MIN_BLOCK ? MIN_BLOCK : need);
-  if (need <= FASTBIN_MAX) {
-    uint64_t bin = need / ALIGN - 1;
-    uint64_t off = h->fastbin[bin];
-    if (off) {  // exact-size hit: O(1), no list walk
-      FreeBlock* fb = (FreeBlock*)(arena(h) + off);
-      h->fastbin[bin] = fb->next;
-      h->fastbin_bytes -= fb->size;
-      h->bytes_allocated += fb->size;
-      return (int64_t)off;
-    }
-  }
-  for (int pass = 0; pass < 2; pass++) {
-    if (pass) {  // main list exhausted: merge the fastbin cache back in
-      if (!h->fastbin_bytes) break;
-      consolidate_fastbins(h);
-    }
-    int64_t got = alloc_block_main(h, need);
-    if (got >= 0) return got;
-  }
-  return -1;
-}
-
-static int64_t alloc_block_main(Header* h, uint64_t need) {
-  uint64_t prev = 0;
-  uint64_t cur = h->free_head;
-  while (cur) {
-    FreeBlock* fb = (FreeBlock*)(arena(h) + cur);
-    if (fb->size >= need) {
-      uint64_t rem = fb->size - need;
-      // All sizes are ALIGN multiples, so rem is 0 or >= ALIGN: a nonzero
-      // remainder is always splittable and the absorb branch only fires at
-      // rem == 0 (so freeing align_up(data+meta) later returns exactly what
-      // was allocated — no leaked tail).
-      if (rem >= ALIGN) {
-        uint64_t newoff = cur + need;
-        FreeBlock* nb = (FreeBlock*)(arena(h) + newoff);
-        nb->size = rem;
-        nb->next = fb->next;
-        if (prev) ((FreeBlock*)(arena(h) + prev))->next = newoff;
-        else h->free_head = newoff;
-      } else {
-        need = fb->size;  // absorb remainder
-        if (prev) ((FreeBlock*)(arena(h) + prev))->next = fb->next;
-        else h->free_head = fb->next;
-      }
-      h->bytes_allocated += need;
-      return (int64_t)cur;
-    }
-    prev = cur;
-    cur = fb->next;
-  }
-  return -1;
-}
-
-static void free_block(Header* h, uint64_t off, uint64_t size) {
-  size = align_up(size < MIN_BLOCK ? MIN_BLOCK : size);
-  h->bytes_allocated -= size;
-  if (size <= FASTBIN_MAX) {
-    uint64_t bin = size / ALIGN - 1;
-    FreeBlock* fb = (FreeBlock*)(arena(h) + off);
-    fb->size = size;
-    fb->next = h->fastbin[bin];
-    h->fastbin[bin] = off;
-    h->fastbin_bytes += size;
-    if (h->fastbin_bytes >= FASTBIN_CONSOLIDATE_BYTES)
-      consolidate_fastbins(h);
-    return;
-  }
-  insert_ordered(h, off, size);
-}
-
-static void consolidate_fastbins(Header* h) {
-  for (uint64_t b = 0; b < NUM_FASTBINS; b++) {
-    uint64_t cur = h->fastbin[b];
-    h->fastbin[b] = 0;
-    while (cur) {
-      FreeBlock* fb = (FreeBlock*)(arena(h) + cur);
-      uint64_t next = fb->next;
-      insert_ordered(h, cur, fb->size);
-      cur = next;
-    }
-  }
-  h->fastbin_bytes = 0;
-}
-
-static void insert_ordered(Header* h, uint64_t off, uint64_t size) {
-  // insert address-ordered, coalesce with neighbors
-  uint64_t prev = 0, cur = h->free_head;
+static void list_insert_ordered(Header* h, uint64_t* headp, uint64_t off,
+                                uint64_t size) {
+  // insert address-ordered, coalesce with list neighbors
+  uint64_t prev = 0, cur = *headp;
   while (cur && cur < off) {
     prev = cur;
     cur = ((FreeBlock*)(arena(h) + cur))->next;
@@ -251,7 +204,7 @@ static void insert_ordered(Header* h, uint64_t off, uint64_t size) {
       off = prev;
     }
   } else {
-    h->free_head = off;
+    *headp = off;
   }
   if (nb->next && off + nb->size == nb->next) {  // coalesce new+next
     FreeBlock* nx = (FreeBlock*)(arena(h) + nb->next);
@@ -260,101 +213,306 @@ static void insert_ordered(Header* h, uint64_t off, uint64_t size) {
   }
 }
 
-// ---- slot table ----
+// First-fit with split. All block sizes are ALIGN multiples, so a nonzero
+// remainder is always splittable and the absorb branch only fires at
+// rem == 0 (freeing align_up(data+meta) later returns exactly what was
+// allocated — no leaked tail).
+static int64_t list_alloc_first_fit(Header* h, uint64_t* headp,
+                                    uint64_t need) {
+  uint64_t prev = 0;
+  uint64_t cur = *headp;
+  while (cur) {
+    FreeBlock* fb = (FreeBlock*)(arena(h) + cur);
+    if (fb->size >= need) {
+      uint64_t rem = fb->size - need;
+      if (rem >= ALIGN) {
+        uint64_t newoff = cur + need;
+        FreeBlock* nb = (FreeBlock*)(arena(h) + newoff);
+        nb->size = rem;
+        nb->next = fb->next;
+        if (prev) ((FreeBlock*)(arena(h) + prev))->next = newoff;
+        else *headp = newoff;
+      } else {
+        if (prev) ((FreeBlock*)(arena(h) + prev))->next = fb->next;
+        else *headp = fb->next;
+      }
+      return (int64_t)cur;
+    }
+    prev = cur;
+    cur = fb->next;
+  }
+  return -1;
+}
 
-static Slot* find_slot(Header* h, const uint8_t* id) {
-  uint64_t mask = h->num_slots - 1;
-  uint64_t i = hash_id(id) & mask;
-  for (uint64_t probes = 0; probes < h->num_slots; probes++, i = (i + 1) & mask) {
-    Slot* s = &slots(h)[i];
+// ---- shard allocator ----
+// Lock order: shard mutex -> (other shard via TRYLOCK only) -> global mutex.
+// The global mutex is always innermost, and a second shard is only ever
+// acquired with trylock, so no cycle can form.
+
+// caller holds sh->mutex; returns bytes actually taken from the GLOBAL list
+// (0 when none) via *taken so accounting stays exact.
+static int64_t shard_alloc(Header* h, Shard* sh, uint64_t need_raw) {
+  uint64_t need = align_up(need_raw < MIN_BLOCK ? MIN_BLOCK : need_raw);
+  if (need <= FASTBIN_MAX) {
+    uint64_t bin = need / ALIGN - 1;
+    uint64_t off = sh->fastbin[bin];
+    if (off) {  // exact-size hit: O(1), no list walk, no global lock
+      FreeBlock* fb = (FreeBlock*)(arena(h) + off);
+      sh->fastbin[bin] = fb->next;
+      sh->cache_bytes -= need;
+      return (int64_t)off;
+    }
+  }
+  if (need <= h->small_max) {
+    int64_t off = list_alloc_first_fit(h, &sh->free_head, need);
+    if (off >= 0) {
+      sh->cache_bytes -= need;
+      return off;
+    }
+    // Refill the shard cache from the global list: one global-lock trip
+    // buys refill_chunk/need future allocations lock-free.
+    uint64_t chunk = h->refill_chunk > need ? h->refill_chunk : need;
+    lock_mu(&h->mutex);
+    int64_t g = list_alloc_first_fit(h, &h->free_head, chunk);
+    if (g < 0 && chunk > need) {
+      chunk = need;  // global list fragmented: take just what we need
+      g = list_alloc_first_fit(h, &h->free_head, chunk);
+    }
+    if (g >= 0) h->bytes_from_global += chunk;
+    unlock_mu(&h->mutex);
+    if (g < 0) return -1;
+    if (chunk > need) {
+      list_insert_ordered(h, &sh->free_head, (uint64_t)g + need,
+                          chunk - need);
+      sh->cache_bytes += chunk - need;
+    }
+    return g;
+  }
+  // Large block: straight from the global extent list.
+  lock_mu(&h->mutex);
+  int64_t g = list_alloc_first_fit(h, &h->free_head, need);
+  if (g >= 0) h->bytes_from_global += need;
+  unlock_mu(&h->mutex);
+  return g;
+}
+
+// caller holds sh->mutex. Flush the shard's cached free blocks back into
+// the global list so neighbors from different shards can coalesce.
+static void consolidate_shard(Header* h, Shard* sh) {
+  lock_mu(&h->mutex);
+  for (uint64_t b = 0; b < NUM_FASTBINS; b++) {
+    uint64_t cur = sh->fastbin[b];
+    sh->fastbin[b] = 0;
+    while (cur) {
+      FreeBlock* fb = (FreeBlock*)(arena(h) + cur);
+      uint64_t next = fb->next;
+      h->bytes_from_global -= fb->size;
+      list_insert_ordered(h, &h->free_head, cur, fb->size);
+      cur = next;
+    }
+  }
+  uint64_t cur = sh->free_head;
+  sh->free_head = 0;
+  while (cur) {
+    FreeBlock* fb = (FreeBlock*)(arena(h) + cur);
+    uint64_t next = fb->next;
+    h->bytes_from_global -= fb->size;
+    list_insert_ordered(h, &h->free_head, cur, fb->size);
+    cur = next;
+  }
+  unlock_mu(&h->mutex);
+  sh->cache_bytes = 0;
+}
+
+// caller holds sh->mutex. to_global forces the block past the shard cache
+// (used by eviction under global pressure, where parking freed bytes in a
+// shard cache would strand them from the allocating shard).
+static void shard_free(Header* h, Shard* sh, uint64_t off, uint64_t size_raw,
+                       bool to_global) {
+  uint64_t size = align_up(size_raw < MIN_BLOCK ? MIN_BLOCK : size_raw);
+  if (to_global || size > h->small_max) {
+    lock_mu(&h->mutex);
+    h->bytes_from_global -= size;
+    list_insert_ordered(h, &h->free_head, off, size);
+    unlock_mu(&h->mutex);
+    return;
+  }
+  if (size <= FASTBIN_MAX) {
+    uint64_t bin = size / ALIGN - 1;
+    FreeBlock* fb = (FreeBlock*)(arena(h) + off);
+    fb->size = size;
+    fb->next = sh->fastbin[bin];
+    sh->fastbin[bin] = off;
+  } else {
+    list_insert_ordered(h, &sh->free_head, off, size);
+  }
+  sh->cache_bytes += size;
+  if (sh->cache_bytes >= h->cache_limit) consolidate_shard(h, sh);
+}
+
+// ---- slot table (per-shard segments) ----
+
+static Slot* find_slot(Header* h, uint64_t sidx, const uint8_t* id) {
+  Slot* tab = shard_table(h, sidx);
+  uint64_t mask = h->slots_per_shard - 1;
+  uint64_t i = slot_start(h, id);
+  for (uint64_t probes = 0; probes < h->slots_per_shard;
+       probes++, i = (i + 1) & mask) {
+    Slot* s = &tab[i];
     if (s->state == SLOT_EMPTY) return nullptr;
     if (s->state != SLOT_TOMBSTONE && memcmp(s->id, id, 16) == 0) return s;
   }
   return nullptr;
 }
 
-static Slot* insert_slot(Header* h, const uint8_t* id) {
-  uint64_t mask = h->num_slots - 1;
-  uint64_t i = hash_id(id) & mask;
+static Slot* insert_slot(Header* h, uint64_t sidx, const uint8_t* id) {
+  Slot* tab = shard_table(h, sidx);
+  uint64_t mask = h->slots_per_shard - 1;
+  uint64_t i = slot_start(h, id);
   Slot* reuse = nullptr;
-  for (uint64_t probes = 0; probes < h->num_slots; probes++, i = (i + 1) & mask) {
-    Slot* s = &slots(h)[i];
+  for (uint64_t probes = 0; probes < h->slots_per_shard;
+       probes++, i = (i + 1) & mask) {
+    Slot* s = &tab[i];
     if (s->state == SLOT_EMPTY) return reuse ? reuse : s;
     if (s->state == SLOT_TOMBSTONE) { if (!reuse) reuse = s; continue; }
     if (memcmp(s->id, id, 16) == 0) return nullptr;  // exists
   }
-  return reuse;  // table may be all tombstones
+  return reuse;  // segment may be all tombstones
 }
 
-// Rebuild the table in place once tombstones dominate: with linear
-// probing, chains only terminate at SLOT_EMPTY, so a table that has seen
-// many delete cycles degrades every lookup MISS to O(num_slots) even when
-// nearly empty. Rehashing live entries restores short chains.
-static void rehash_table(Header* h) {
-  Slot* tab = slots(h);
-  uint64_t n = h->num_slots;
+// Rebuild one shard's segment in place once tombstones dominate: with
+// linear probing, chains only terminate at SLOT_EMPTY, so a segment that
+// has seen many delete cycles degrades every lookup MISS to O(segment)
+// even when nearly empty. Rehashing live entries restores short chains.
+static void rehash_shard(Header* h, uint64_t sidx) {
+  Shard* sh = shard_at(h, sidx);
+  Slot* tab = shard_table(h, sidx);
+  uint64_t n = h->slots_per_shard;
   std::vector<Slot> live;
-  live.reserve(h->num_objects + 16);
+  live.reserve(sh->num_objects + 16);
   for (uint64_t i = 0; i < n; i++)
     if (tab[i].state == SLOT_CREATED || tab[i].state == SLOT_SEALED)
       live.push_back(tab[i]);
   memset(tab, 0, n * sizeof(Slot));
   uint64_t mask = n - 1;
   for (const Slot& s : live) {
-    uint64_t i = hash_id(s.id) & mask;
+    uint64_t i = slot_start(h, s.id);
     while (tab[i].state != SLOT_EMPTY) i = (i + 1) & mask;
     tab[i] = s;
   }
-  h->num_tombstones = 0;
+  sh->num_tombstones = 0;
 }
 
-static void evict_entry(Header* h, Slot* s) {
-  free_block(h, s->offset, s->data_size + s->meta_size);
+// caller holds the shard's mutex
+static void evict_entry(Header* h, uint64_t sidx, Slot* s, bool to_global) {
+  Shard* sh = shard_at(h, sidx);
+  shard_free(h, sh, s->offset, s->data_size + s->meta_size, to_global);
   s->state = SLOT_TOMBSTONE;
   s->refcnt = 0;
-  h->num_objects--;
-  if (++h->num_tombstones > h->num_slots / 4) rehash_table(h);
+  sh->num_objects--;
+  if (++sh->num_tombstones > h->slots_per_shard / 4) rehash_shard(h, sidx);
 }
 
-// Evict sealed refcnt==0 objects (oldest lru first) until `need` is allocatable.
-// Returns offset or -1.
-static int64_t alloc_with_eviction(Header* h, uint64_t need) {
-  int64_t off = alloc_block(h, need);
+// caller holds shard sidx's mutex; oldest sealed refcnt==0 slot or null
+static Slot* oldest_evictable(Header* h, uint64_t sidx) {
+  Slot* tab = shard_table(h, sidx);
+  Slot* victim = nullptr;
+  for (uint64_t i = 0; i < h->slots_per_shard; i++) {
+    Slot* s = &tab[i];
+    if (s->state == SLOT_SEALED && s->refcnt == 0 &&
+        (!victim || s->lru_tick < victim->lru_tick))
+      victim = s;
+  }
+  return victim;
+}
+
+// Evict sealed refcnt==0 objects until `need` is allocatable: own shard's
+// oldest first (exact LRU within the shard), then sweep sibling shards via
+// trylock, consolidating their caches so freed bytes reach the global
+// list. Approximate-global-LRU across shards — the per-victim full-table
+// scan the single-lock store did under one mutex is now a segment scan
+// under the victim shard's lock only. Returns offset or -1.
+static int64_t alloc_with_eviction(Header* h, uint64_t sidx, uint64_t need) {
+  Shard* sh = shard_at(h, sidx);
+  bool to_global = align_up(need) > h->small_max;
+  int64_t off = shard_alloc(h, sh, need);
   while (off < 0) {
-    Slot* victim = nullptr;
-    for (uint64_t i = 0; i < h->num_slots; i++) {
-      Slot* s = &slots(h)[i];
-      if (s->state == SLOT_SEALED && s->refcnt == 0 &&
-          (!victim || s->lru_tick < victim->lru_tick))
-        victim = s;
+    Slot* victim = oldest_evictable(h, sidx);
+    if (victim != nullptr) {
+      evict_entry(h, sidx, victim, to_global);
+      sh->num_evictions++;
+      off = shard_alloc(h, sh, need);
+      continue;
     }
-    if (!victim) return -1;
-    evict_entry(h, victim);
-    h->num_evictions++;
-    off = alloc_block(h, need);
+    // Own shard dry: flush our cache and sweep siblings for victims.
+    consolidate_shard(h, sh);
+    off = shard_alloc(h, sh, need);
+    if (off >= 0) return off;
+    bool progress = false;
+    for (uint64_t i = 0; i < h->nshards && off < 0; i++) {
+      if (i == sidx) continue;
+      Shard* o = shard_at(h, i);
+      if (!trylock_mu(&o->mutex)) continue;  // busy: it is making progress
+      Slot* v = oldest_evictable(h, i);
+      if (v != nullptr) {
+        evict_entry(h, i, v, true);
+        o->num_evictions++;
+        progress = true;
+      }
+      consolidate_shard(h, o);
+      unlock_mu(&o->mutex);
+      off = shard_alloc(h, sh, need);
+    }
+    if (off >= 0) return off;
+    if (!progress) return -1;
   }
   return off;
 }
 
 // ---- public API ----
 
-int store_init(void* base, uint64_t total_size, uint64_t num_slots) {
+int store_init(void* base, uint64_t total_size, uint64_t num_slots,
+               uint64_t nshards) {
   Header* h = (Header*)base;
   memset(h, 0, sizeof(Header));
   h->magic = MAGIC;
   h->total_size = total_size;
-  h->num_slots = num_slots;
-  uint64_t table_bytes = num_slots * sizeof(Slot);
-  h->arena_offset = align_up(sizeof(Header) + table_bytes);
+  if (nshards < 1) nshards = 1;
+  if (nshards > MAX_SHARDS) nshards = MAX_SHARDS;
+  while (nshards & (nshards - 1)) nshards &= nshards - 1;  // round down pow2
+  h->nshards = nshards;
+  uint64_t per = num_slots / nshards;
+  uint64_t p2 = 64;
+  while (p2 < per) p2 <<= 1;
+  h->slots_per_shard = p2;
+  uint64_t shards_bytes = nshards * sizeof(Shard);
+  uint64_t table_bytes = nshards * h->slots_per_shard * sizeof(Slot);
+  h->table_offset = align_up(sizeof(Header) + shards_bytes);
+  h->arena_offset = align_up(h->table_offset + table_bytes);
   if (h->arena_offset + MIN_BLOCK * 2 > total_size) return ERR_FULL;
   h->arena_size = total_size - h->arena_offset;
-  memset(slots(h), 0, table_bytes);
+
+  // Shard-cache tuning: refills large enough to amortize the global lock,
+  // small enough that N idle caches can't strand a meaningful arena slice.
+  uint64_t refill = h->arena_size / (nshards * 16);
+  if (refill < (64u << 10)) refill = 64u << 10;
+  if (refill > (4u << 20)) refill = 4u << 20;
+  h->refill_chunk = align_up(refill);
+  h->small_max = SMALL_MAX < h->refill_chunk ? SMALL_MAX : h->refill_chunk;
+  h->cache_limit = h->refill_chunk * 4;
 
   pthread_mutexattr_t attr;
   pthread_mutexattr_init(&attr);
   pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
   pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
   pthread_mutex_init(&h->mutex, &attr);
+  memset((char*)h + sizeof(Header), 0, shards_bytes);
+  memset(shard_table(h, 0), 0, table_bytes);
+  for (uint64_t i = 0; i < nshards; i++) {
+    Shard* sh = shard_at(h, i);
+    pthread_mutex_init(&sh->mutex, &attr);
+    sh->canary = SHARD_CANARY;
+  }
   pthread_mutexattr_destroy(&attr);
 
   // Reserve the first ALIGN bytes so offset 0 means "no block".
@@ -368,8 +526,18 @@ int store_init(void* base, uint64_t total_size, uint64_t num_slots) {
 }
 
 int store_validate(void* base) {
-  return ((Header*)base)->magic == MAGIC ? OK : ERR_CORRUPT;
+  Header* h = (Header*)base;
+  if (h->magic != MAGIC) return ERR_CORRUPT;
+  if (h->nshards < 1 || h->nshards > MAX_SHARDS ||
+      (h->nshards & (h->nshards - 1)))
+    return ERR_CORRUPT;
+  if (h->arena_offset + h->arena_size > h->total_size) return ERR_CORRUPT;
+  for (uint64_t i = 0; i < h->nshards; i++)
+    if (shard_at(h, i)->canary != SHARD_CANARY) return ERR_CORRUPT;
+  return OK;
 }
+
+uint64_t store_num_shards(void* base) { return ((Header*)base)->nshards; }
 
 // Creates an unsealed object and returns the absolute byte offset (from base)
 // where the caller should write data_size bytes of data then meta_size bytes
@@ -377,42 +545,46 @@ int store_validate(void* base) {
 int store_create(void* base, const uint8_t* id, uint64_t data_size,
                  uint64_t meta_size, uint64_t* out_offset) {
   Header* h = (Header*)base;
-  lock(h);
-  if (find_slot(h, id)) { unlock(h); return ERR_EXISTS; }
+  uint64_t sidx = shard_of(h, id);
+  Shard* sh = shard_at(h, sidx);
+  lock_mu(&sh->mutex);
+  if (find_slot(h, sidx, id)) { unlock_mu(&sh->mutex); return ERR_EXISTS; }
   // Allocate BEFORE claiming a slot: eviction inside the allocator can
-  // trip the tombstone rehash, which relocates the whole slot table and
-  // would invalidate a Slot* held across the call.
-  int64_t off = alloc_with_eviction(h, data_size + meta_size);
-  if (off < 0) { unlock(h); return ERR_FULL; }
-  Slot* s = insert_slot(h, id);
+  // trip the tombstone rehash, which relocates the shard's slot segment
+  // and would invalidate a Slot* held across the call.
+  int64_t off = alloc_with_eviction(h, sidx, data_size + meta_size);
+  if (off < 0) { unlock_mu(&sh->mutex); return ERR_FULL; }
+  Slot* s = insert_slot(h, sidx, id);
   if (!s) {
-    free_block(h, off, data_size + meta_size);
-    unlock(h);
+    shard_free(h, sh, (uint64_t)off, data_size + meta_size, false);
+    unlock_mu(&sh->mutex);
     return ERR_TABLE_FULL;
   }
   memcpy(s->id, id, 16);
   s->offset = (uint64_t)off;
   s->data_size = data_size;
   s->meta_size = meta_size;
-  if (s->state == SLOT_TOMBSTONE) h->num_tombstones--;
+  if (s->state == SLOT_TOMBSTONE) sh->num_tombstones--;
   s->state = SLOT_CREATED;
   s->refcnt = 1;  // creator holds a ref until seal+release
-  s->lru_tick = ++h->lru_clock;
+  s->lru_tick = next_tick(h);
   s->pending_delete = 0;
-  h->num_objects++;
+  sh->num_objects++;
   *out_offset = h->arena_offset + (uint64_t)off;
-  unlock(h);
+  unlock_mu(&sh->mutex);
   return OK;
 }
 
 int store_seal(void* base, const uint8_t* id) {
   Header* h = (Header*)base;
-  lock(h);
-  Slot* s = find_slot(h, id);
-  if (!s) { unlock(h); return ERR_NOTFOUND; }
+  uint64_t sidx = shard_of(h, id);
+  Shard* sh = shard_at(h, sidx);
+  lock_mu(&sh->mutex);
+  Slot* s = find_slot(h, sidx, id);
+  if (!s) { unlock_mu(&sh->mutex); return ERR_NOTFOUND; }
   s->state = SLOT_SEALED;
   s->refcnt--;  // drop creator ref
-  unlock(h);
+  unlock_mu(&sh->mutex);
   return OK;
 }
 
@@ -421,74 +593,101 @@ int store_seal(void* base, const uint8_t* id) {
 int store_get(void* base, const uint8_t* id, uint64_t* out_offset,
               uint64_t* out_data_size, uint64_t* out_meta_size) {
   Header* h = (Header*)base;
-  lock(h);
-  Slot* s = find_slot(h, id);
-  if (!s) { unlock(h); return ERR_NOTFOUND; }
-  if (s->state != SLOT_SEALED) { unlock(h); return ERR_AGAIN; }
+  uint64_t sidx = shard_of(h, id);
+  Shard* sh = shard_at(h, sidx);
+  lock_mu(&sh->mutex);
+  Slot* s = find_slot(h, sidx, id);
+  if (!s) { unlock_mu(&sh->mutex); return ERR_NOTFOUND; }
+  if (s->state != SLOT_SEALED) { unlock_mu(&sh->mutex); return ERR_AGAIN; }
   s->refcnt++;
-  s->lru_tick = ++h->lru_clock;
+  s->lru_tick = next_tick(h);
   *out_offset = h->arena_offset + s->offset;
   *out_data_size = s->data_size;
   *out_meta_size = s->meta_size;
-  unlock(h);
+  unlock_mu(&sh->mutex);
   return OK;
 }
 
 int store_release(void* base, const uint8_t* id) {
   Header* h = (Header*)base;
-  lock(h);
-  Slot* s = find_slot(h, id);
-  if (!s) { unlock(h); return ERR_NOTFOUND; }
+  uint64_t sidx = shard_of(h, id);
+  Shard* sh = shard_at(h, sidx);
+  lock_mu(&sh->mutex);
+  Slot* s = find_slot(h, sidx, id);
+  if (!s) { unlock_mu(&sh->mutex); return ERR_NOTFOUND; }
   if (s->refcnt > 0) s->refcnt--;
-  if (s->pending_delete && s->refcnt == 0) evict_entry(h, s);
-  unlock(h);
+  if (s->pending_delete && s->refcnt == 0)
+    evict_entry(h, sidx, s, false);
+  unlock_mu(&sh->mutex);
   return OK;
 }
 
 int store_contains(void* base, const uint8_t* id) {
   Header* h = (Header*)base;
-  lock(h);
-  Slot* s = find_slot(h, id);
+  uint64_t sidx = shard_of(h, id);
+  Shard* sh = shard_at(h, sidx);
+  lock_mu(&sh->mutex);
+  Slot* s = find_slot(h, sidx, id);
   int rc = (s && s->state == SLOT_SEALED) ? 1 : 0;
-  unlock(h);
+  unlock_mu(&sh->mutex);
   return rc;
 }
 
 // Abort an unsealed create (e.g. writer failed mid-copy).
 int store_abort(void* base, const uint8_t* id) {
   Header* h = (Header*)base;
-  lock(h);
-  Slot* s = find_slot(h, id);
-  if (!s) { unlock(h); return ERR_NOTFOUND; }
-  if (s->state == SLOT_CREATED) { evict_entry(h, s); unlock(h); return OK; }
-  unlock(h);
+  uint64_t sidx = shard_of(h, id);
+  Shard* sh = shard_at(h, sidx);
+  lock_mu(&sh->mutex);
+  Slot* s = find_slot(h, sidx, id);
+  if (!s) { unlock_mu(&sh->mutex); return ERR_NOTFOUND; }
+  if (s->state == SLOT_CREATED) {
+    evict_entry(h, sidx, s, false);
+    unlock_mu(&sh->mutex);
+    return OK;
+  }
+  unlock_mu(&sh->mutex);
   return ERR_BUSY;
 }
 
 int store_delete(void* base, const uint8_t* id) {
   Header* h = (Header*)base;
-  lock(h);
-  Slot* s = find_slot(h, id);
-  if (!s) { unlock(h); return ERR_NOTFOUND; }
+  uint64_t sidx = shard_of(h, id);
+  Shard* sh = shard_at(h, sidx);
+  lock_mu(&sh->mutex);
+  Slot* s = find_slot(h, sidx, id);
+  if (!s) { unlock_mu(&sh->mutex); return ERR_NOTFOUND; }
   if (s->refcnt > 0) {
     s->pending_delete = 1;  // freed on last release
-    unlock(h);
+    unlock_mu(&sh->mutex);
     return OK;
   }
-  evict_entry(h, s);
-  unlock(h);
+  evict_entry(h, sidx, s, false);
+  unlock_mu(&sh->mutex);
   return OK;
 }
 
+// LOCK-FREE: stats feed monitoring and the spill-threshold heuristic,
+// which tolerate a momentarily torn sum — taking the global plus every
+// shard mutex here would re-serialize the very put path the sharding
+// unlocked (the head-node spill check reads stats on EVERY worker put).
 void store_stats(void* base, uint64_t* out_allocated, uint64_t* out_capacity,
                  uint64_t* out_num_objects, uint64_t* out_num_evictions) {
   Header* h = (Header*)base;
-  lock(h);
-  *out_allocated = h->bytes_allocated;
+  uint64_t allocated =
+      __atomic_load_n(&h->bytes_from_global, __ATOMIC_RELAXED);
+  uint64_t nobj = 0, nevict = 0, cached = 0;
+  for (uint64_t i = 0; i < h->nshards; i++) {
+    Shard* sh = shard_at(h, i);
+    nobj += __atomic_load_n(&sh->num_objects, __ATOMIC_RELAXED);
+    nevict += __atomic_load_n(&sh->num_evictions, __ATOMIC_RELAXED);
+    cached += __atomic_load_n(&sh->cache_bytes, __ATOMIC_RELAXED);
+  }
+  // Bytes parked in shard caches are free capacity, not live objects.
+  *out_allocated = allocated > cached ? allocated - cached : 0;
   *out_capacity = h->arena_size;
-  *out_num_objects = h->num_objects;
-  *out_num_evictions = h->num_evictions;
-  unlock(h);
+  *out_num_objects = nobj;
+  *out_num_evictions = nevict;
 }
 
 uint64_t store_header_size() { return sizeof(Header); }
@@ -499,16 +698,20 @@ uint64_t store_header_size() { return sizeof(Header); }
 // raylets resyncing object locations with a restarted GCS).
 int64_t store_list_ids(void* base, uint8_t* out, uint64_t max_ids) {
   Header* h = (Header*)base;
-  lock(h);
-  Slot* tab = slots(h);
   uint64_t n = 0;
-  for (uint64_t i = 0; i < h->num_slots && n < max_ids; i++) {
-    if (tab[i].state == SLOT_SEALED) {
-      memcpy(out + n * 16, tab[i].id, 16);
-      n++;
+  for (uint64_t si = 0; si < h->nshards; si++) {
+    Shard* sh = shard_at(h, si);
+    Slot* tab = shard_table(h, si);
+    lock_mu(&sh->mutex);
+    for (uint64_t i = 0; i < h->slots_per_shard && n < max_ids; i++) {
+      if (tab[i].state == SLOT_SEALED) {
+        memcpy(out + n * 16, tab[i].id, 16);
+        n++;
+      }
     }
+    unlock_mu(&sh->mutex);
+    if (n >= max_ids) break;
   }
-  unlock(h);
   return (int64_t)n;
 }
 

@@ -154,6 +154,14 @@ class ActorMethod:
         args = [_promote_large(rt, a) for a in args]
         kwargs = {k: _promote_large(rt, v) for k, v in kwargs.items()}
         payload, buffers, refs = serialization.serialize_args(args, kwargs)
+        # Large pickle-5 buffers ship through the shm arena (one copy, read
+        # back zero-copy) instead of riding two socket hops via the head.
+        # Calls with returns only: the pack's caller-side ref release keys
+        # on the returns resolving (streaming calls have none).
+        args_ref = None
+        if not streaming and num_returns >= 1:
+            args_ref, payload, buffers = serialization.maybe_offload_args(
+                rt, payload, buffers)
         from ray_tpu.util import tracing as _tracing
         trace_ctx = _tracing.inject_context() if _tracing._enabled else None
         # One entropy read for every id this call needs.
@@ -174,9 +182,11 @@ class ActorMethod:
             method_name=self._name,
             max_retries=0,
             retries_left=0,
-            dependencies=[r.id.binary() for r in refs],
+            dependencies=([r.id.binary() for r in refs]
+                          + ([args_ref] if args_ref else [])),
             trace_ctx=trace_ctx,
             streaming=streaming,
+            args_ref=args_ref,
         )
         if isinstance(rt, Runtime):
             rt.submit_task(spec)
@@ -212,12 +222,29 @@ class ActorMethod:
                 spec.owner = rt.worker_id.binary()
                 spec.caller_seq = rt.next_actor_call_seq(
                     self._handle._actor_id)
+            # Ref args normally need the head's dependency gating/pinning:
+            # a direct delivery would block the actor in arg resolution
+            # (head-of-line) and skip the owner's borrow pin. BUT when
+            # every ref dep is owned by THIS worker and already sealed in
+            # the arena, both hazards vanish — the executor resolves them
+            # instantly from shm and pin_call_deps holds the owner's refs
+            # until the returns land. That keeps with-arg call bursts
+            # (actor fan-outs passing a put() handle) on the direct plane
+            # instead of paying a per-call head round trip.
+            local_deps = (bool(refs)
+                          and (direct_capable or worker_capable)
+                          and hasattr(rt, "deps_ready_local")
+                          and rt.deps_ready_local(refs))
+            direct_ok = not refs or local_deps
+            dep_oids = [r.id.binary() for r in refs] if local_deps else []
+            held = [args_ref] if args_ref else []
+            if (dep_oids or held) and hasattr(rt, "pin_call_deps"):
+                # Pin BEFORE any send so a racing completion can't release
+                # first; adds on non-owned keys are no-ops.
+                rt.pin_call_deps(spec, add_oids=dep_oids, held_oids=held)
             loc = None
-            if not streaming and not refs and (direct_capable
-                                               or worker_capable):
-                # Ref args need the head's dependency gating/pinning: a
-                # direct delivery would block the actor in arg resolution
-                # (head-of-line) and skip the owner's borrow pin.
+            if not streaming and direct_ok and (direct_capable
+                                                or worker_capable):
                 loc = rt.resolve_actor_location(self._handle._actor_id)
             if loc is not None and loc[0] == "uds":
                 # Worker peer plane: ship straight to the hosting
@@ -235,7 +262,7 @@ class ActorMethod:
                 # have executed, and only retry-permitted calls replay.
                 spec.retries_left = 1 if (len(loc) > 2 and loc[2]) else 0
                 rt.send(("direct_actor", loc[0], loc[1], spec))
-            elif (not streaming and not refs and not on_agent
+            elif (not streaming and direct_ok and not on_agent
                   and cfg.direct_actor_calls):
                 # Head-node worker, no direct location (head-hosted /
                 # unstable actor or plane disabled): the head still takes

@@ -22,7 +22,16 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "object_store_memory_bytes": (int, 0, "shm arena size; 0 = auto (30% RAM, capped)"),
     "object_store_auto_cap_bytes": (int, 20 * 2**30, "cap for auto-sized arena"),
     "object_store_hash_slots": (int, 1 << 16, "object index slots in shm"),
+    "object_store_shards": (int, 0, "lock shards in the shm store (index + "
+                            "allocator split per-shard); 0 = auto "
+                            "(power of two in [8, 16])"),
     "max_inline_object_bytes": (int, 100 * 1024, "results <= this are returned inline"),
+    "max_inline_arg_bytes": (int, 256 * 1024, "task/actor-call args whose "
+                             "pickle-5 buffers exceed this ship through the "
+                             "shm arena (create/seal + ref in the frame) "
+                             "instead of riding the socket frame; smaller "
+                             "args stay inline to keep the no-arg latency "
+                             "floor"),
     "object_spill_dir": (str, "", "directory for spilled objects; '' = <session>/spill"),
     "object_spill_threshold": (float, 0.8, "spill when arena usage exceeds this"),
     # --- workers / scheduling ---

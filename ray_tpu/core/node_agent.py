@@ -40,7 +40,8 @@ from ray_tpu.core.runtime import (
     build_worker_env,
     spawn_worker_process,
 )
-from ray_tpu.core.transport import FrameBuffer, send_msg
+from ray_tpu.core.transport import (FrameBuffer, enable_nodelay, send_many,
+                                    send_msg)
 
 
 class _AgentWorker:
@@ -119,7 +120,8 @@ class NodeAgent:
             shm_dir, f"ray_tpu_{os.getpid()}_{uuid.uuid4().hex[:12]}")
         self.store = SharedMemoryStore(
             self.store_path, size=object_store_memory or default_store_size(cfg),
-            num_slots=cfg.object_store_hash_slots, create=True)
+            num_slots=cfg.object_store_hash_slots, create=True,
+            num_shards=cfg.object_store_shards)
 
         self.resources = {
             "CPU": float(num_cpus if num_cpus is not None
@@ -178,6 +180,7 @@ class NodeAgent:
         host, port = head_addr.rsplit(":", 1)
         self.head_host, self.head_port = host, int(port)
         self.head_sock = socket.create_connection((host, int(port)))
+        enable_nodelay(self.head_sock)
         self.head_lock = threading.Lock()
         self.head_buffer = FrameBuffer()
         self._reconnecting = False
@@ -347,6 +350,7 @@ class NodeAgent:
                 except OSError:
                     time.sleep(0.5)
                     continue
+                enable_nodelay(sock)
                 self.head_sock = sock
                 self.head_buffer = FrameBuffer()
                 try:
@@ -502,11 +506,16 @@ class NodeAgent:
             with self._lease_lock:
                 self._spawns_pending = max(0, self._spawns_pending - 1)
 
-    def _sniff_lease_dones(self, w: _AgentWorker, msg) -> object | None:
+    def _sniff_lease_dones(self, w: _AgentWorker, msg,
+                           collector: list | None = None) -> object | None:
         """Consume completions of node-leased tasks locally (they flow to
         the head as batched node_done frames, NOT as per-worker relays).
         Returns the message to relay for mixed batches (head-path entries
-        untouched), or None when fully consumed."""
+        untouched), or None when fully consumed. With `collector`, leased
+        entries append there instead of sending — the select round flushes
+        completions from EVERY ready worker as one node_done frame and
+        pumps leases once (the same coalescing node_done already applied
+        per-worker, lifted across the round)."""
         wid = w.worker_id.binary()
         entries = ([msg[1:]] if msg[0] == "done" else list(msg[1]))
         leased, rest = [], []
@@ -520,8 +529,11 @@ class NodeAgent:
                 self._worker_load[wid] = max(0, load - 1)
         if not leased:
             return msg
-        self._send_head(("node_done", leased))
-        self._pump_leases()
+        if collector is not None:
+            collector.extend(leased)
+        else:
+            self._send_head(("node_done", leased))
+            self._pump_leases()
         if not rest:
             return None
         return (("done",) + tuple(rest[0]) if len(rest) == 1
@@ -602,6 +614,7 @@ class NodeAgent:
                 sock, _addr = self.ctrl_srv.accept()
             except OSError:
                 return
+            enable_nodelay(sock)
             _PeerConn(self, sock, nid=None).start()
 
     def _dial_peer(self, nid: bytes):
@@ -613,6 +626,7 @@ class NodeAgent:
             if not addr:
                 return None
             sock = socket.create_connection(tuple(addr), timeout=5.0)
+            enable_nodelay(sock)
         except Exception:  # noqa: BLE001 — fall back to head
             return None
         conn = _PeerConn(self, sock, nid=nid)
@@ -887,7 +901,18 @@ class NodeAgent:
                     if not data:
                         self._on_worker_eof(w)
                         continue
+                    # Frames that arrived together in this ONE recv are a
+                    # zero-latency batch: their head-bound relays coalesce
+                    # into one vectored sendmsg (framing preserved — the
+                    # head's FrameBuffer splits them back) and their leased
+                    # completions into one node_done + one lease pump.
+                    # Batching WIDER than a drain (a whole select round)
+                    # measurably stalls the done -> node_done -> refill
+                    # cycle the lease plane clocks on (16-agent run:
+                    # 4x fewer tasks/s round-batched vs per-drain).
                     w.buffer.feed(data)
+                    out_frames: list = []
+                    lease_dones: list = []
                     for msg in w.buffer.frames():
                         op0 = msg[0]
                         if op0 == "actor_ready":
@@ -909,15 +934,33 @@ class NodeAgent:
                                 except Exception:
                                     traceback.print_exc()
                             try:
-                                msg = self._sniff_lease_dones(w, msg)
+                                msg = self._sniff_lease_dones(
+                                    w, msg, collector=lease_dones)
                             except Exception:
                                 traceback.print_exc()
                             if msg is None:
-                                continue  # fully leased: rode node_done
+                                continue  # fully leased: rides node_done
                         elif op0 == "ready":
                             self._pump_leases()  # fresh worker: feed it
-                        self._send_head(
+                        out_frames.append(
                             ("wmsg", w.worker_id.binary(), msg))
+                    self._flush_head_batch(out_frames, lease_dones)
+
+    def _flush_head_batch(self, out_frames: list, lease_dones: list):
+        """One worker drain's head-bound traffic: a single frame (or one
+        coalesced sendmsg batch) plus at most one lease pump."""
+        if lease_dones:
+            out_frames.append(("node_done", lease_dones))
+        if out_frames:
+            try:
+                if len(out_frames) == 1:
+                    send_msg(self.head_sock, out_frames[0], self.head_lock)
+                else:
+                    send_many(self.head_sock, out_frames, self.head_lock)
+            except OSError:
+                self._reconnect_or_die()
+        if lease_dones:
+            self._pump_leases()
 
     def _die(self):
         if self._shutdown:

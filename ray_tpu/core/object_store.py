@@ -15,6 +15,7 @@ import mmap
 import os
 import pickle
 import struct
+import sys
 import time
 
 from ray_tpu._native.build import load_native
@@ -42,8 +43,10 @@ def _lib():
         u64 = ctypes.c_uint64
         p = ctypes.c_void_p
         b = ctypes.c_char_p
-        lib.store_init.argtypes = [p, u64, u64]
+        lib.store_init.argtypes = [p, u64, u64, u64]
         lib.store_validate.argtypes = [p]
+        lib.store_num_shards.argtypes = [p]
+        lib.store_num_shards.restype = u64
         lib.store_create.argtypes = [p, b, u64, u64, ctypes.POINTER(u64)]
         lib.store_seal.argtypes = [p, b]
         lib.store_get.argtypes = [p, b, ctypes.POINTER(u64), ctypes.POINTER(u64), ctypes.POINTER(u64)]
@@ -131,6 +134,14 @@ class _ReleaseHandle:
             self.store.release(self.object_id)
 
 
+# _TrackedBuffer implements the buffer protocol in pure Python via PEP 688
+# (__buffer__), which the interpreter only honors from 3.12. Earlier
+# Pythons cannot express "a buffer whose destruction releases the store
+# ref", so out-of-band reads fall back to a copy there — correct, just not
+# zero-copy.
+_ZERO_COPY_READS = sys.version_info >= (3, 12)
+
+
 class _TrackedBuffer:
     """PEP-688 buffer wrapper: consumers (numpy et al.) hold this object via
     the buffer protocol, so its destruction marks the buffer unused."""
@@ -157,11 +168,27 @@ class _TrackedBuffer:
             h.drop_one()
 
 
+def default_shard_count() -> int:
+    """Auto shard count: power of two, floored at 8 (even on few cores,
+    N processes timesharing one CPU stop blocking behind a preempted lock
+    holder when their ids hash to different shards) and capped at 16 —
+    beyond that the global extent lock, not shard locks, bounds scaling."""
+    n = max(os.cpu_count() or 1, 8)
+    p = 1
+    while p * 2 <= min(n, 16):
+        p *= 2
+    return p
+
+
 class SharedMemoryStore:
-    """One node's object store; head creates, workers attach."""
+    """One node's object store; head creates, workers attach.
+
+    `num_shards` splits the index/allocator lock (see object_store.cpp);
+    0 picks a power-of-two per-core default. Attaching processes read the
+    shard geometry from the arena header, so only the creator decides."""
 
     def __init__(self, path: str, size: int = 0, num_slots: int = 1 << 16,
-                 create: bool = False):
+                 create: bool = False, num_shards: int = 0):
         self.path = path
         self._lib = _lib()
         if create:
@@ -178,7 +205,8 @@ class SharedMemoryStore:
                 self._mm.madvise(mmap.MADV_HUGEPAGE)
             except (AttributeError, OSError, ValueError):
                 pass
-            rc = self._lib.store_init(self._base, size, num_slots)
+            rc = self._lib.store_init(self._base, size, num_slots,
+                                      num_shards or default_shard_count())
             if rc != OK:
                 raise RayTpuError(f"store_init failed: {rc}")
         else:
@@ -192,6 +220,7 @@ class SharedMemoryStore:
             if self._lib.store_validate(self._base) != OK:
                 raise RayTpuError(f"attached store at {path} is corrupt")
         self.size = size
+        self.num_shards = int(self._lib.store_num_shards(self._base))
 
     # -- raw object interface --
 
@@ -359,6 +388,21 @@ class SharedMemoryStore:
         if nbufs == 0:
             try:
                 value = pickle.loads(payload)
+            finally:
+                payload.release()
+                data.release()
+                self.release(object_id)
+            return True, value
+        if not _ZERO_COPY_READS:
+            # Pre-3.12 fallback: copy the buffers out and drop the store
+            # reference immediately (same lifetime story as the no-buffer
+            # path). Zero-copy needs PEP-688 _TrackedBuffer tracking.
+            bufs = []
+            for ln in lens:
+                bufs.append(bytes(data[off : off + ln]))
+                off += ln + ((-ln) % _ALIGN)
+            try:
+                value = pickle.loads(payload, buffers=bufs)
             finally:
                 payload.release()
                 data.release()

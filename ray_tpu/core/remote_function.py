@@ -74,6 +74,15 @@ class RemoteFunction:
 
         payload, buffers, refs = serialization.serialize_args(args, kwargs)
         num_returns = opts.get("num_returns", 1)
+        # Large pickle-5 buffers (nested arrays the per-arg promotion above
+        # can't see) ship through the shm arena instead of the socket frame.
+        # Only for calls with returns: the caller-side ref release keys on
+        # the returns resolving, and a streaming/0-return call would drop
+        # the pack before the submit frame even leaves the socket.
+        args_ref = None
+        if num_returns not in ("streaming", 0):
+            args_ref, payload, buffers = serialization.maybe_offload_args(
+                rt, payload, buffers)
         streaming = num_returns == "streaming"
         if streaming:
             # Generator task (parity: num_returns="streaming"): yields
@@ -102,11 +111,13 @@ class RemoteFunction:
             max_retries=max_retries,
             retries_left=max_retries,
             scheduling_strategy=opts.get("scheduling_strategy"),
-            dependencies=[r.id.binary() for r in refs],
+            dependencies=([r.id.binary() for r in refs]
+                          + ([args_ref] if args_ref else [])),
             trace_ctx=trace_ctx,
             streaming=streaming,
             runtime_env=opts.get("runtime_env"),
             idempotent=bool(opts.get("idempotent", False)),
+            args_ref=args_ref,
         )
         if isinstance(rt, Runtime):
             rt.submit_task(spec, fn_blob)
@@ -114,6 +125,9 @@ class RemoteFunction:
             if os.getpid() not in self._exported_in:
                 rt.send(("export_fn", fn_id, fn_blob))
                 self._exported_in.add(os.getpid())
+            if args_ref is not None:
+                # The put-time local ref releases when the returns resolve.
+                rt.pin_call_deps(spec, held_oids=[args_ref])
             rt.submit(spec)
         if streaming:
             from ray_tpu.core.object_ref import ObjectRefGenerator

@@ -165,6 +165,32 @@ def apply_pip_env(env: dict, zygote, pip: list | None):
     return env, None, env_key
 
 
+# All cold worker forks go through ONE long-lived spawner thread. The
+# workers arm PR_SET_PDEATHSIG, and on Linux the "parent" whose death
+# delivers the signal is the THREAD that forked the child — a worker
+# forked from a transient spawn thread is SIGKILLed the moment that
+# thread exits, IF it armed the prctl while the thread was still alive.
+# That race is why warm (fast-booting) env-pool workers died silently at
+# boot with empty logs while cold boots survived: a slow child armed
+# after the spawn thread was already gone (prctl then never fires).
+# Forking from a thread that lives as long as the process makes the
+# pdeathsig mean what it was always meant to mean.
+_spawn_exec = None
+_spawn_exec_lock = threading.Lock()
+
+
+def _on_spawner_thread(fn):
+    global _spawn_exec
+    if threading.current_thread() is threading.main_thread():
+        return fn()  # main thread outlives everything: fork directly
+    with _spawn_exec_lock:
+        if _spawn_exec is None:
+            import concurrent.futures
+            _spawn_exec = concurrent.futures.ThreadPoolExecutor(
+                1, thread_name_prefix="rtpu-spawn")
+    return _spawn_exec.submit(fn).result()
+
+
 def spawn_worker_process(worker_id: WorkerID, store_path: str, env: dict,
                          zygote: "_Zygote | None", session_dir: str):
     """Fork a worker from the warm zygote, or cold-exec as fallback.
@@ -205,17 +231,17 @@ def spawn_worker_process(worker_id: WorkerID, store_path: str, env: dict,
             cmd = (container_worker_argv(image, session_dir, repo_root)
                    + ["python", "-m", "ray_tpu.core.worker",
                       store_path, worker_id.hex(), "3"])
-            proc = subprocess.Popen(
+            proc = _on_spawner_thread(lambda: subprocess.Popen(
                 cmd, env=env, close_fds=False,
                 preexec_fn=lambda: os.dup2(fd, 3),
-                stdout=open(log_path, "ab"), stderr=subprocess.STDOUT)
+                stdout=open(log_path, "ab"), stderr=subprocess.STDOUT))
         else:
-            proc = subprocess.Popen(
+            proc = _on_spawner_thread(lambda: subprocess.Popen(
                 [python, "-m", "ray_tpu.core.worker",
                  store_path, worker_id.hex(), str(child.fileno())],
                 pass_fds=[child.fileno()], env=env,
                 close_fds=True, stdout=open(log_path, "ab"),
-                stderr=subprocess.STDOUT)
+                stderr=subprocess.STDOUT))
     child.close()
     return parent, proc
 
@@ -736,7 +762,8 @@ class Runtime:
             shm_dir, f"ray_tpu_{os.getpid()}_{self.session_id}")
         self.store = SharedMemoryStore(
             self.store_path, size=store_size,
-            num_slots=cfg.object_store_hash_slots, create=True)
+            num_slots=cfg.object_store_hash_slots, create=True,
+            num_shards=cfg.object_store_shards)
 
         # logical resources (parity: scheduling/resource_set.h)
         from ray_tpu.core.accelerators import detect_tpus
@@ -1307,6 +1334,8 @@ class Runtime:
                         except OSError:
                             continue
                         conn_sock.setblocking(True)
+                        from ray_tpu.core.transport import enable_nodelay
+                        enable_nodelay(conn_sock)
                         nc = NodeConn(conn_sock)
                         with self._sel_lock:
                             self._selector.register(
@@ -2476,6 +2505,15 @@ class Runtime:
         self.directory.put(oid.binary(), ("shm", {self.head_node_id}))
         return ObjectRef(oid)
 
+    def put_arg_object(self, value, nbytes) -> bytes:
+        """Store one offloaded-args pack (serialization.maybe_offload_args)
+        from the driver. Listed in the spec's dependencies, so submit_task
+        pins it; _unpin_deps frees it after the final completion."""
+        oid = ObjectID.from_random()
+        self.put_in_store(oid, value)
+        self.directory.put(oid.binary(), ("shm", {self.head_node_id}))
+        return oid.binary()
+
     def get(self, refs, timeout=None):
         from ray_tpu.core.object_ref import ObjectRef
         single = isinstance(refs, ObjectRef)
@@ -3110,6 +3148,17 @@ class Runtime:
     def _unpin_deps(self, spec: TaskSpec):
         for oid in spec.dependencies or []:
             self.refcount.unpin(oid)
+        aref = getattr(spec, "args_ref", None)
+        if aref is not None:
+            # The offloaded arg pack exists only for this task: free it
+            # cluster-wide now that no attempt can run again. (A later
+            # lineage reconstruction of this spec will fail its args fetch
+            # cleanly — same contract as a borrowed dep freed by its
+            # owner.)
+            try:
+                self._free_object(aref)
+            except Exception:  # noqa: BLE001 — cleanup is best effort
+                pass
 
     def _gate_on_deps(self, item, deps) -> bool:
         """Returns True when the item was enqueued immediately (no pending

@@ -71,6 +71,62 @@ def deserialize(payload: bytes, buffers=()):
     return pickle.loads(payload, buffers=buffers)
 
 
+class ArgPack:
+    """A task's pickled (args, kwargs) stream plus its out-of-band buffers,
+    stored as ONE shm object.
+
+    __reduce_ex__ re-wraps the buffers as PickleBuffers, so put_serialized
+    routes them out-of-band again: the arg bytes are copied exactly once
+    (into the arena) and the executor maps them back zero-copy — the same
+    treatment ray.put values get, now applied to call arguments (parity:
+    the reference inlining <100KB args and shipping the rest via plasma,
+    `python/ray/remote_function.py` + `core_worker` arg plumbing)."""
+
+    __slots__ = ("payload", "buffers")
+
+    def __init__(self, payload, *buffers):
+        self.payload = payload
+        self.buffers = list(buffers)
+
+    def __reduce_ex__(self, protocol):
+        return (ArgPack,
+                (self.payload, *[pickle.PickleBuffer(b)
+                                 for b in self.buffers]))
+
+    def load(self):
+        return deserialize(self.payload, self.buffers)
+
+
+def maybe_offload_args(rt, payload, buffers):
+    """Ship large pickle-5 arg buffers through the shm arena.
+
+    Returns (args_oid | None, payload, buffers): when the out-of-band
+    buffers exceed the configured threshold AND the runtime has a local
+    store (head driver or worker — client-mode drivers don't), the whole
+    (payload, buffers) pack is written to the arena once and the spec
+    carries only a 16-byte ref; the socket frame stays small, and the
+    head relay stops copying arg bytes twice. Below the threshold the
+    inputs pass through untouched, keeping the small-arg latency floor."""
+    if not buffers:
+        return None, payload, buffers
+    from ray_tpu.core.config import get_config
+    threshold = get_config().max_inline_arg_bytes
+    if threshold <= 0:
+        return None, payload, buffers
+    total = sum(b.nbytes if isinstance(b, memoryview) else len(b)
+                for b in buffers)
+    if total < threshold:
+        return None, payload, buffers
+    put = getattr(rt, "put_arg_object", None)
+    if put is None:
+        return None, payload, buffers
+    try:
+        oid = put(ArgPack(payload, *buffers), total + len(payload))
+    except Exception:  # noqa: BLE001 — arena pressure: fall back to inline
+        return None, payload, buffers
+    return oid, _EMPTY_ARGS_PAYLOAD, []
+
+
 def serialize_function(fn) -> tuple[bytes, bytes]:
     """Returns (function_id, pickled). Deterministic id so workers cache."""
     blob = cloudpickle.dumps(fn)

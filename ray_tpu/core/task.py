@@ -41,6 +41,13 @@ class TaskSpec:
                           # failure; opts into the one-phase steal fast path
         "payload_format",  # None/"pickle" | "proto" (language-neutral
                            # TaskArgs payload — proto_wire.decode_task_args)
+        "args_ref",        # oid bytes | None — large pickle-5 arg buffers
+                           # shipped through the shm arena as one ArgPack
+                           # object instead of riding the socket frame
+                           # (serialization.maybe_offload_args); always
+                           # also listed in `dependencies` so the head
+                           # gates dispatch on it and frees it after the
+                           # final completion
     )
 
     def __init__(self, **kw):

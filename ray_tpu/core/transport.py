@@ -96,6 +96,44 @@ def _load_buf(b):
     return b if isinstance(b, memoryview) else memoryview(b)
 
 
+def enable_nodelay(sock: socket.socket):
+    """Nagle-off for TCP control links: frames are already write-combined
+    at the sender (send_many/sendmsg below), so Nagle only adds delayed-ACK
+    stalls to small control frames. No-op for unix sockets."""
+    try:
+        if sock.family == socket.AF_INET:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    except (OSError, ValueError):
+        pass
+
+
+# Linux UIO_MAXIOV; sendmsg with more iovecs fails with EMSGSIZE.
+_IOV_MAX = 1024
+
+
+def sendmsg_all(sock: socket.socket, parts: list):
+    """Vectored sendall: ship a frame batch (headers, payloads, raw
+    buffers) in as few syscalls as the iovec limit allows, WITHOUT copying
+    large buffers into a joined blob. Advances across partial writes."""
+    bufs = []
+    for p in parts:
+        mv = p if isinstance(p, memoryview) else memoryview(p)
+        if mv.nbytes:
+            bufs.append(mv.cast("B") if mv.format != "B" or mv.ndim != 1
+                        else mv)
+    i = 0
+    while i < len(bufs):
+        try:
+            n = sock.sendmsg(bufs[i:i + _IOV_MAX])
+        except InterruptedError:
+            continue
+        while i < len(bufs) and n >= bufs[i].nbytes:
+            n -= bufs[i].nbytes
+            i += 1
+        if n:
+            bufs[i] = bufs[i][n:]
+
+
 class _MsgPickler(pickle.Pickler):
     """Routes bare memoryviews (task-arg/result buffers riding inside specs)
     out-of-band instead of failing — pickle refuses raw memoryviews."""
@@ -194,36 +232,50 @@ def send_msg(sock: socket.socket, msg, lock: threading.Lock | None = None):
                 sock.sendall(head)
             return
     parts = _encode(msg)
-    # Header/lengths coalesce into one small write; buffers are sent as-is —
-    # joining would copy every large tensor a second time.
+    # Header/lengths coalesce into one small blob; raw buffers ride the
+    # same vectored sendmsg as-is — one syscall for the whole frame, no
+    # second copy of large tensors.
     head = b"".join(p for p in parts if isinstance(p, bytes))
     bufs = [p for p in parts if not isinstance(p, bytes)]
     if lock:
         with lock:
-            sock.sendall(head)
-            for b in bufs:
-                sock.sendall(b)
+            if bufs:
+                sendmsg_all(sock, [head, *bufs])
+            else:
+                sock.sendall(head)
     else:
-        sock.sendall(head)
-        for b in bufs:
-            sock.sendall(b)
+        if bufs:
+            sendmsg_all(sock, [head, *bufs])
+        else:
+            sock.sendall(head)
 
 
 def send_many(sock: socket.socket, msgs: list,
               lock: threading.Lock | None = None):
-    """Send several frames with as few syscalls as possible: consecutive
-    headers/payloads and small buffers join into one write; large raw
-    buffers are written as-is (joining would copy them). Frame order and
+    """Send several frames in as few syscalls as possible: consecutive
+    headers/payloads and small buffers join into one blob, large raw
+    buffers ride the same vectored sendmsg uncopied, and the whole batch
+    flushes as one writev-style call per _BATCH_CAP bytes. Frame order and
     per-frame chaos hooks match N send_msg calls exactly."""
-    out: list = []
-    joined = 0
+    out: list = []     # pending iovec: joined small blobs + raw buffers
+    small: list = []   # run of small parts awaiting a join
+    pending = 0
+
+    def pack_small():
+        if small:
+            out.append(small[0] if len(small) == 1 else b"".join(small))
+            small.clear()
 
     def flush():
-        nonlocal out, joined
+        nonlocal pending
+        pack_small()
         if out:
-            sock.sendall(out[0] if len(out) == 1 else b"".join(out))
-            out = []
-            joined = 0
+            if len(out) == 1 and isinstance(out[0], bytes):
+                sock.sendall(out[0])
+            else:
+                sendmsg_all(sock, out)
+            out.clear()
+            pending = 0
 
     chaos = get_chaos()
     ctx = lock if lock is not None else _NULL_CTX
@@ -237,26 +289,31 @@ def send_many(sock: socket.socket, msgs: list,
                 from ray_tpu.core import proto_wire
                 payload = proto_wire.to_wire(msg)
                 if payload is not None:
-                    out.append(_HDR.pack(len(payload))
-                               + _NBUF.pack(_PROTO_FLAG) + payload)
-                    joined += len(payload)
-                    if joined >= _JOIN_CAP:
+                    small.append(_HDR.pack(len(payload))
+                                 + _NBUF.pack(_PROTO_FLAG) + payload)
+                    pending += len(payload)
+                    if pending >= _BATCH_CAP:
                         flush()
                     continue
             for p in _encode(msg):
                 n = len(p) if isinstance(p, bytes) else p.nbytes
                 if isinstance(p, bytes) or n < (64 << 10):
-                    out.append(p)
-                    joined += n
-                    if joined >= _JOIN_CAP:
-                        flush()
+                    small.append(p if isinstance(p, bytes) else bytes(p))
+                    pending += n
                 else:
+                    # Large buffer: its own iovec entry, never copied.
+                    pack_small()
+                    out.append(p)
+                    pending += n
+                if pending >= _BATCH_CAP:
                     flush()
-                    sock.sendall(p)
         flush()
 
 
-_JOIN_CAP = 256 << 10
+# Flush threshold for send_many batches: large enough to amortize syscalls
+# under fan-out bursts, small enough to keep peak pinned-buffer residency
+# bounded while frames stream out.
+_BATCH_CAP = 1 << 20
 _NULL_CTX = contextlib.nullcontext()
 
 

@@ -276,6 +276,13 @@ class WorkerRuntime:
         self._req_lock = threading.Lock()
         self._req_seq = 0
         self._req_futures: dict[int, "concurrent.futures.Future"] = {}
+        # Caller-side pins for direct actor calls that carry locally-owned
+        # object deps (and for offloaded arg packs): rid -> [remaining,
+        # [oid, ...]]. The head never sees a peer-plane call, so ITS
+        # submit-time dep pinning can't protect these — the caller holds a
+        # local ref on each dep until every return of the call resolves.
+        self._dep_pins: dict[bytes, list] = {}
+        self._dep_pin_lock = threading.Lock()
 
     # -- pubsub (subscriber side; parity: pubsub/subscriber.h:73) --
 
@@ -319,6 +326,18 @@ class WorkerRuntime:
         self.refcount.register_owned(oid)
         return ObjectRef(oid, owner=self.worker_id.binary())
 
+    def put_arg_object(self, value, nbytes) -> bytes:
+        """Store one offloaded-args pack (serialization.maybe_offload_args)
+        owned by this worker: the submitter releases the local ref when the
+        call's returns resolve (pin_call_deps), and the head additionally
+        frees it after the final completion of head-routed tasks."""
+        oid = ObjectID.from_random()
+        _put_with_spill(self, oid, value, nbytes)
+        self.refcount.register_owned(oid)
+        self.refcount.add_local_ref(oid)
+        self.send(("put_notify", oid.binary()))
+        return oid.binary()
+
     def get(self, refs, timeout=None):
         from ray_tpu.core.object_ref import ObjectRef
         single = isinstance(refs, ObjectRef)
@@ -338,6 +357,7 @@ class WorkerRuntime:
                 self._direct_values[oid])
         found, value = self.store.get_deserialized(ref.id, timeout=0)
         if found:
+            self._maybe_cache_scalar(oid, value)
             return value
         # Ask the owner; block until the push arrives.
         ev = threading.Event()
@@ -370,9 +390,22 @@ class WorkerRuntime:
             return self._raise_if_error(self._direct_values[oid])
         found, value = self.store.get_deserialized(ref.id, timeout=5.0)
         if found:
+            self._maybe_cache_scalar(oid, value)
             return value
         from ray_tpu.core.status import ObjectLostError
         raise ObjectLostError(ref.id)
+
+    _SCALAR_TYPES = (int, float, bool, bytes, str, type(None))
+
+    def _maybe_cache_scalar(self, oid: bytes, value):
+        """Cache tiny immutable scalars read from the arena: an actor
+        hammered with the same small ref arg (fan-out bursts passing one
+        put() handle) re-reads it per call otherwise — a shard-lock +
+        unpickle round trip for a value that can never change. Larger or
+        composite values stay uncached so the LRU can't pin arena-aliasing
+        buffers alive."""
+        if type(value) in self._SCALAR_TYPES and sys.getsizeof(value) < 4096:
+            self.object_cache[oid] = value
 
     @staticmethod
     def _raise_if_error(value):
@@ -513,6 +546,57 @@ class WorkerRuntime:
                 # immediate replay slot — order degrades gracefully.
                 self._actor_call_seq.popitem(last=False)
             return n
+
+    # -- caller-side dep pinning (direct calls + offloaded arg packs) --
+
+    def deps_ready_local(self, refs) -> bool:
+        """True when every ref dep is owned by THIS worker and already
+        sealed in the local arena — the precondition for taking the direct
+        actor-call path with args: the executor resolves them instantly
+        (no head-of-line blocking in its queue) and pin_call_deps below
+        replaces the head's submit-time borrow pin."""
+        for r in refs:
+            if not self.refcount.is_owned(r.id.binary()):
+                return False
+            if not self.store.contains(r.id):
+                return False
+        return True
+
+    def pin_call_deps(self, spec, add_oids=(), held_oids=()):
+        """Hold a local ref on each oid until every return of this call
+        resolves (wdone on the peer plane, or the head's obj push on a
+        fallback/get). `add_oids` take a fresh count here (direct-call
+        user deps); `held_oids` were already counted by the caller
+        (offloaded arg packs — put_arg_object's ref transfers in). A call
+        whose results are never observed keeps its pins for the worker's
+        lifetime — bounded by the caller's own working set, same as
+        holding the arg refs in a local."""
+        oids = list(add_oids) + list(held_oids)
+        if not oids:
+            return
+        from ray_tpu.core.ids import ObjectID as _OID
+        for oid in add_oids:
+            self.refcount.add_local_ref(_OID(oid))
+        if not spec.return_ids:
+            for oid in oids:  # fire-and-forget: nothing will resolve
+                self.refcount.remove_local_ref(_OID(oid))
+            return
+        pin = [len(spec.return_ids), oids]
+        with self._dep_pin_lock:
+            for rid in spec.return_ids:
+                self._dep_pins[rid] = pin
+
+    def _release_dep_pin(self, rid: bytes):
+        with self._dep_pin_lock:
+            pin = self._dep_pins.pop(rid, None)
+            if pin is None:
+                return
+            pin[0] -= 1
+            done = pin[0] <= 0
+        if done:
+            from ray_tpu.core.ids import ObjectID as _OID
+            for oid in pin[1]:
+                self.refcount.remove_local_ref(_OID(oid))
 
     _HEAD_HOSTED = ("head", b"")  # negative-cache sentinel
 
@@ -670,6 +754,8 @@ class WorkerRuntime:
             else:  # shm: already in the shared arena + head notified
                 with self._direct_lock:
                     self._direct_pending.pop(rid, None)
+            if self._dep_pins:
+                self._release_dep_pin(rid)
             with self._wait_lock:
                 for ev in self._pending_waits.pop(rid, []):
                     ev.set()
@@ -763,6 +849,8 @@ class WorkerRuntime:
             elif status == "err":
                 self.object_cache[oid] = serialization.deserialize(payload, bufs)
             # "shm": value readable from the store
+            if self._dep_pins:
+                self._release_dep_pin(oid)
             with self._wait_lock:
                 for ev in self._pending_waits.pop(oid, []):
                     ev.set()
@@ -823,6 +911,28 @@ def _resolve_arg(rt: WorkerRuntime, obj):
     if isinstance(obj, ObjectRef):
         return rt._get_one(obj, timeout=60.0)
     return obj
+
+
+def _spec_args(rt: WorkerRuntime, spec: TaskSpec):
+    """Decode a spec's (args, kwargs), wherever they live: an offloaded
+    shm ArgPack (args_ref), a language-neutral proto payload, or the
+    inline pickle frame."""
+    aref = getattr(spec, "args_ref", None)
+    if aref is not None:
+        found, pack = rt.store.get_deserialized(ObjectID(aref), timeout=0)
+        if not found:
+            # Cross-node call: the pack lives in the submitter's arena;
+            # resolve through the normal object plane (head directory ->
+            # peer pull), same as any ObjectRef argument.
+            from ray_tpu.core.object_ref import ObjectRef
+            pack = rt._get_one(ObjectRef(ObjectID(aref)), timeout=60.0)
+        return pack.load()
+    if getattr(spec, "payload_format", None) == "proto":
+        # Client-plane submissions keep their tagged args end to end —
+        # never re-pickled.
+        from ray_tpu.core import proto_wire
+        return proto_wire.decode_task_args(spec.payload)
+    return serialization.deserialize(spec.payload, spec.buffers)
 
 
 class _RuntimeEnv:
@@ -888,14 +998,7 @@ def _execute(rt: WorkerRuntime, spec: TaskSpec, fn):
         rt.object_cache[oid] = serialization.deserialize(payload, bufs)
     renv_spec = getattr(spec, "runtime_env", None)
     try:
-        if getattr(spec, "payload_format", None) == "proto":
-            # Language-neutral TaskArgs payload (client-plane submissions
-            # keep their tagged args end to end — never re-pickled).
-            from ray_tpu.core import proto_wire
-            args, kwargs = proto_wire.decode_task_args(spec.payload)
-        else:
-            args, kwargs = serialization.deserialize(spec.payload,
-                                                     spec.buffers)
+        args, kwargs = _spec_args(rt, spec)
         args = [_resolve_arg(rt, a) for a in args]
         kwargs = {k: _resolve_arg(rt, v) for k, v in kwargs.items()}
         rt.current_task = spec  # describe() formatted lazily on demand
@@ -946,7 +1049,7 @@ def _execute_streaming(rt: WorkerRuntime, spec: TaskSpec, fn):
     try:
         for oid, (payload, bufs) in spec.inline_deps.items():
             rt.object_cache[oid] = serialization.deserialize(payload, bufs)
-        args, kwargs = serialization.deserialize(spec.payload, spec.buffers)
+        args, kwargs = _spec_args(rt, spec)
         args = [_resolve_arg(rt, a) for a in args]
         kwargs = {k: _resolve_arg(rt, v) for k, v in kwargs.items()}
         rt.current_task = spec
@@ -1113,8 +1216,9 @@ async def _execute_async(rt, spec, fn):
     for oid, (payload, bufs) in spec.inline_deps.items():
         rt.object_cache[oid] = serialization.deserialize(payload, bufs)
     try:
-        args, kwargs = serialization.deserialize(spec.payload, spec.buffers)
         loop = asyncio.get_running_loop()
+        # Off-thread: an offloaded arg pack may need a cross-node fetch.
+        args, kwargs = await loop.run_in_executor(None, _spec_args, rt, spec)
         args = [await loop.run_in_executor(None, _resolve_arg, rt, a) for a in args]
         kwargs = {k: await loop.run_in_executor(None, _resolve_arg, rt, v)
                   for k, v in kwargs.items()}

@@ -194,7 +194,10 @@ def read_hudi(table_path: str, *, as_of: str | None = None,
     # Latest committed base file per (partition, fileId).
     latest: dict[tuple, tuple] = {}  # key -> (instant, path)
     for root, _dirs, files in os.walk(table_path):
-        if ".hoodie" in root:
+        # Skip only the timeline directory itself: match '.hoodie' as an
+        # exact os.sep-delimited path component — a data partition named
+        # e.g. 'x.hoodie' must not be silently excluded.
+        if ".hoodie" in root.split(os.sep):
             continue
         for f in files:
             if not f.endswith(".parquet"):
@@ -429,6 +432,17 @@ def read_bigquery(project_id: str, *, query: str | None = None,
         [plan_mod.Read(name="ReadBigQuery", read_fns=[read])]))
 
 
+def _clickhouse_auth_headers(user: str, password: str) -> dict:
+    """ClickHouse HTTP auth via X-ClickHouse-* headers (never the query
+    string, which leaks credentials into access logs and proxies)."""
+    headers = {}
+    if user:
+        headers["X-ClickHouse-User"] = user
+    if password:
+        headers["X-ClickHouse-Key"] = password
+    return headers
+
+
 def read_clickhouse(query: str, *, url: str = "http://localhost:8123",
                     user: str = "", password: str = "", **_kw) -> Dataset:
     """ClickHouse over its native HTTP interface (`FORMAT JSONEachRow`).
@@ -437,14 +451,13 @@ def read_clickhouse(query: str, *, url: str = "http://localhost:8123",
 
     def read() -> pa.Table:
         import json as json_mod
-        import urllib.parse
         import urllib.request
         q = query.rstrip("; \n") + " FORMAT JSONEachRow"
+        # Credentials ride headers, not the query string: URL params land
+        # verbatim in server access logs and any intermediate proxies.
         req = urllib.request.Request(
-            url + "/?" + urllib.parse.urlencode(
-                {k: v for k, v in (("user", user),
-                                   ("password", password)) if v}),
-            data=q.encode(), method="POST")
+            url + "/", data=q.encode(), method="POST",
+            headers=_clickhouse_auth_headers(user, password))
         with urllib.request.urlopen(req, timeout=120) as resp:
             text = resp.read().decode()
         rows = [json_mod.loads(ln) for ln in text.splitlines() if ln]
@@ -493,12 +506,10 @@ def clickhouse_insert_block_task(block, table: str, url: str,
         return 0
     body = "".join(json_mod.dumps(r, default=str) + "\n" for r in rows)
     params = {"query": f"INSERT INTO {table} FORMAT JSONEachRow"}
-    for k, v in (("user", user), ("password", password)):
-        if v:
-            params[k] = v
     req = urllib.request.Request(
         url + "/?" + urllib.parse.urlencode(params),
-        data=body.encode(), method="POST")
+        data=body.encode(), method="POST",
+        headers=_clickhouse_auth_headers(user, password))
     with urllib.request.urlopen(req, timeout=120) as resp:
         resp.read()
     return len(rows)

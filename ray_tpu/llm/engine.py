@@ -1467,7 +1467,11 @@ class InferenceEngine:
         state pinned to 0."""
         e = self.e
         reqs = [self.slot_req[i] for i in range(e.max_slots)]
-        fp = tuple((i, id(r.guide)) for i, r in enumerate(reqs)
+        # Keyed on the guide's monotonic serial, NOT id(): after the serve
+        # layer's LRU evicts a TokenGuide, a newly compiled guide can reuse
+        # the same id() on the same slot and the stale device table would
+        # silently keep enforcing the old constraint.
+        fp = tuple((i, r.guide.serial) for i, r in enumerate(reqs)
                    if r is not None and r.guide is not None)
         if not fp:
             return False, jnp.zeros((1, 1, 1), jnp.int32), \

@@ -19,6 +19,7 @@ token-level table by running each tokenizer piece through the byte DFA.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 
 import numpy as np
 
@@ -361,6 +362,9 @@ def compile_byte_dfa(pattern: str) -> ByteDFA:
 # ---------------- token-level table ----------------
 
 
+_guide_serial = itertools.count(1)
+
+
 @dataclasses.dataclass
 class TokenGuide:
     """table[s, tok] = next DFA state, or -1 when `tok` is disallowed in
@@ -369,6 +373,12 @@ class TokenGuide:
 
     table: np.ndarray          # [n_states, vocab] int32
     pattern: str
+    # Process-wide monotonic identity: device-table upload fingerprints
+    # key on this instead of id() — after an LRU eviction a newly compiled
+    # guide can land on a reused id() and silently keep enforcing the old
+    # constraint (engine._sync_guides).
+    serial: int = dataclasses.field(
+        default_factory=lambda: next(_guide_serial))
 
     @property
     def n_states(self) -> int:

@@ -247,12 +247,17 @@ class _LLMServerImpl:
         if logprobs:
             kept = req.generated
             if stopped:
-                # Align the logprob arrays with the TRUNCATED text:
-                # clients zip tokens/token_logprobs against text offsets.
+                # Align the logprob arrays with the TRUNCATED text by
+                # accumulating per-token text lengths — one decode per
+                # token (O(n)) instead of re-decoding the growing prefix
+                # per kept token (O(n²)), and consistent with the
+                # per-token `tokens` strings reported below.
                 kept = []
+                decoded_len = 0
                 for t in req.generated:
                     kept.append(t)
-                    if len(self.tokenizer.decode(kept)) >= len(text):
+                    decoded_len += len(self.tokenizer.decode([t]))
+                    if decoded_len >= len(text):
                         break
             lp = {"tokens": [self.tokenizer.decode([t]) for t in kept],
                   "token_logprobs": list(req.token_logprobs[:len(kept)])}

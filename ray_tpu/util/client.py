@@ -75,6 +75,13 @@ class ClientRuntime(WorkerRuntime):
     def store(self):
         raise RayTpuError("client mode has no local object store")
 
+    # No node-local arena: args always ride the frame inline, and the
+    # direct-call relaxation for locally-sealed deps never applies.
+    put_arg_object = None
+
+    def deps_ready_local(self, refs):
+        return False
+
     def request(self, what, arg=None, timeout=30.0):
         if not self._connected:
             raise RayTpuError("client connection to the head was lost")

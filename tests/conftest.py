@@ -36,8 +36,11 @@ import pytest  # noqa: E402
 # (ci/ray_ci/core.tests.yml small/medium/large splits).
 _SMOKE = {
     "test_core_api.py": {"test_simple_task", "test_put_get",
-                         "test_many_async_tasks", "test_error_propagation"},
-    "test_object_store.py": {"test_put_get_roundtrip", "test_zero_copy_numpy"},
+                         "test_many_async_tasks", "test_error_propagation",
+                         "test_large_args_offload_to_shm"},
+    "test_object_store.py": {"test_put_get_roundtrip", "test_zero_copy_numpy",
+                             "test_concurrent_puts_no_corruption",
+                             "test_cross_shard_eviction"},
     "test_cluster.py": {"test_tasks_spread_across_nodes",
                         "test_direct_actor_calls_bypass_head"},
     "test_fault_tolerance.py": {"test_task_retry_on_worker_crash",
