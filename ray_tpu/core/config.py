@@ -50,6 +50,28 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
                                       "dispatch; 1 disables pipelining)"),
     "task_max_retries_default": (int, 3, "default retries for idempotent tasks"),
     "actor_max_restarts_default": (int, 0, "default actor restarts"),
+    # --- cluster-view broadcast + lease spillback (parity:
+    #     ray_syncer.h:20 broadcast half + cluster_task_manager.cc:187
+    #     scheduler spillback — decentralized agent->agent rebalancing) ---
+    "cluster_view_broadcast_ms": (int, 100, "head broadcasts the versioned "
+                                  "cluster resource view to node agents at "
+                                  "this interval; per-agent version cursors "
+                                  "make every frame a delta (an agent only "
+                                  "receives entries that changed since its "
+                                  "cursor); 0 disables the broadcast plane"),
+    "lease_spillback": (bool, True, "a node agent whose un-started lease "
+                        "backlog exceeds its capacity forwards leases "
+                        "directly to an under-loaded peer agent (one "
+                        "agent->agent hop; the head is informed "
+                        "asynchronously via a lease_spilled delta)"),
+    "lease_spill_backlog_per_worker": (int, 2, "spillback backlog "
+                                       "threshold: spill only while the "
+                                       "agent's un-started lease queue "
+                                       "exceeds this many tasks per local "
+                                       "worker (the kept-local floor)"),
+    "lease_spill_max_hops": (int, 2, "max agent->agent hops a lease may "
+                             "take before it must execute where it is "
+                             "(ping-pong guard; each spill consumes one)"),
     # --- lineage reconstruction (parity: object_recovery_manager.h:43,
     #     task_manager.h:216 lineage resubmission) ---
     "max_object_reconstructions": (int, 3, "times a task is re-executed to "

@@ -176,6 +176,22 @@ class NodeAgent:
         self._fn_blobs: dict[bytes, bytes] = {}        # agent fn cache
         self._spawns_pending = 0   # in-flight spawns (cap accounting)
         self._hb_version = 0
+        # --- cluster-view cache + lease spillback (the syncer's downlink
+        # half, parity: ray_syncer.h:20 broadcast + the raylet's scheduler
+        # spillback, cluster_task_manager.cc:187) --- the head broadcasts
+        # the versioned cluster view as per-agent deltas (cluster_view
+        # frames); this agent uses it to forward surplus un-started leases
+        # DIRECTLY to an under-loaded peer agent — one agent->agent hop,
+        # zero per-task head involvement (the head learns via an async
+        # lease_spilled notice). Guarded by _lease_lock.
+        self._cluster_view: dict[bytes, dict] = {}  # nid -> view entry
+        self._cview_version = 0
+        self._peer_fns: dict[bytes, set] = {}  # fn blobs sent per peer
+        self._last_spill = 0.0
+        # Event-driven uplink deltas: last (idle, backlog) pair pushed to
+        # the head outside the heartbeat cadence, plus a rate limiter.
+        self._last_pushed_view: tuple = ()
+        self._last_view_push = 0.0
 
         host, port = head_addr.rsplit(":", 1)
         self.head_host, self.head_port = host, int(port)
@@ -381,6 +397,10 @@ class NodeAgent:
                 self._send_head(("heartbeat", self.node_id,
                                  self._load_view()))
                 self._order_gate.sweep()
+                # Periodic spill probe: backlog that formed while no view
+                # delta arrived (broadcasts only carry CHANGES) still
+                # drains toward idle peers within a heartbeat.
+                self._maybe_spill_leases()
             except Exception:  # noqa: BLE001 — a dead heartbeat thread
                 traceback.print_exc()  # would get this node declared dead
 
@@ -397,6 +417,26 @@ class NodeAgent:
             return {"v": self._hb_version, "idle": idle,
                     "backlog": len(self._lease_q),
                     "inflight": len(self._lease_inflight)}
+
+    def _maybe_push_load_delta(self):
+        """Event-driven uplink delta (the syncer push-on-change): when
+        this agent's (idle, backlog) pair materially changes, report it
+        immediately instead of waiting out the heartbeat period — peers
+        then see idle capacity within a broadcast tick and can spill
+        toward it while their backlog still exists. Rate-limited; the
+        periodic heartbeat remains the liveness floor."""
+        if not self.config.lease_spillback:
+            return
+        now = time.monotonic()
+        if now - self._last_view_push < 0.05:
+            return
+        view = self._load_view()
+        key = (view["idle"], view["backlog"])
+        if key == self._last_pushed_view:
+            return
+        self._last_view_push = now
+        self._last_pushed_view = key
+        self._send_head(("heartbeat", self.node_id, view))
 
     def _to_worker(self, wid: bytes, inner):
         w = self.workers.get(wid)
@@ -439,6 +479,18 @@ class NodeAgent:
         spawn = False
         depth = self.config.max_tasks_in_flight_per_worker
         with self._lease_lock:
+            if (self.config.lease_spillback and self._lease_q
+                    and len(self._lease_q) > self._spill_keep_locked()
+                    and self._view_room_locked()):
+                # Surplus beyond the local floor while a peer has idle
+                # capacity: don't bury it in depth-K worker pipelines
+                # (committed frames can't be clawed back) — dispatch
+                # shallow and leave the surplus in _lease_q where the
+                # spill pass below can forward it peer-to-peer. Under
+                # cluster-wide saturation (no idle peers) the full
+                # pipeline depth stands, which is where depth was
+                # measured to matter.
+                depth = min(depth, 2)
             if self._lease_q:
                 # Depth-K per worker (parity:
                 # max_tasks_in_flight_per_worker lease reuse): a worker
@@ -495,6 +547,7 @@ class NodeAgent:
         if spawn:
             threading.Thread(target=self._spawn_counted,
                              daemon=True).start()
+        self._maybe_spill_leases()
 
     def _spawn_counted(self):
         """_spawn_worker with the pending-spawn counter released — the
@@ -505,6 +558,167 @@ class NodeAgent:
         finally:
             with self._lease_lock:
                 self._spawns_pending = max(0, self._spawns_pending - 1)
+
+    # ---------------- lease spillback (agent->agent) ----------------
+    #
+    # Parity: the raylet's scheduler spillback (cluster_task_manager.cc:
+    # 187), decentralized: the head's cluster-view broadcast tells every
+    # agent where idle capacity is, and a saturated agent forwards its
+    # surplus un-started leases straight to an under-loaded peer over the
+    # existing agent<->agent ctrl channel — the head is informed
+    # asynchronously (lease_spilled) and never sits on the per-task path.
+
+    def _spill_keep_locked(self) -> int:
+        """Un-started backlog this agent keeps local (the spill floor).
+        Scaled by the INTENDED pool size, not live workers: burst-spawned
+        extras above the pool are transient, and during worker boot a
+        near-empty pool must read as 'capacity arriving', not as a floor
+        of zero that overspills the whole queue."""
+        return (self.config.lease_spill_backlog_per_worker
+                * max(1, self.pool_size))
+
+    def _view_room_locked(self) -> bool:
+        """Does the cached cluster view show a spillable peer?"""
+        for nid, e in self._cluster_view.items():
+            if (nid != self.node_id and e.get("state") == "ALIVE"
+                    and e.get("ctrl")
+                    and int(e.get("idle", 0)) > int(e.get("backlog", 0))):
+                return True
+        return False
+
+    def _maybe_spill_leases(self):
+        """Forward surplus un-started leases to under-loaded peers.
+        Selection runs under the lease lock; dialing/sending happens on a
+        side thread (the agent's main loop must never block on a peer's
+        socket). Hop-capped per spec (lease_spill_max_hops) so leases
+        cannot ping-pong between loaded agents."""
+        cfg = self.config
+        if not cfg.lease_spillback or self._shutdown:
+            return
+        now = time.monotonic()
+        plan = []  # (nid, [(fn_id, blob, spec), ...])
+        with self._lease_lock:
+            if now - self._last_spill < 0.05:
+                return  # pump storms: one selection per view tick is plenty
+            surplus = len(self._lease_q) - self._spill_keep_locked()
+            if surplus <= 0:
+                return
+            peers = []  # (spare capacity, nid, entry) — most room first
+            for nid, e in self._cluster_view.items():
+                if (nid == self.node_id or e.get("state") != "ALIVE"
+                        or not e.get("ctrl")):
+                    continue
+                room = int(e.get("idle", 0)) - int(e.get("backlog", 0))
+                if room > 0:
+                    peers.append((room, nid, e))
+            if not peers:
+                return
+            self._last_spill = now
+            peers.sort(key=lambda t: -t[0])
+            hop_capped = []
+            for room, nid, e in peers:
+                if surplus <= 0:
+                    break
+                take = min(surplus, room)
+                specs = []
+                while take > 0 and self._lease_q:
+                    # Newest first: the oldest entries keep their local
+                    # dispatch order (they are next to execute here).
+                    spec = self._lease_q.pop()
+                    hops = spec.spill_hops or 0
+                    if hops >= cfg.lease_spill_max_hops:
+                        hop_capped.append(spec)
+                        continue
+                    spec.spill_hops = hops + 1
+                    specs.append(spec)
+                    take -= 1
+                    surplus -= 1
+                if not specs:
+                    continue
+                # Optimistic view update: the peer's backlog just grew by
+                # what we are sending — without this every pump pass until
+                # the next broadcast would dump on the same peer.
+                e["backlog"] = int(e.get("backlog", 0)) + len(specs)
+                sent_fns = self._peer_fns.setdefault(nid, set())
+                triples = []
+                for spec in specs:
+                    blob = None
+                    if spec.fn_id and spec.fn_id not in sent_fns:
+                        blob = self._fn_blobs.get(spec.fn_id)
+                        sent_fns.add(spec.fn_id)
+                    triples.append((spec.fn_id, blob, spec))
+                plan.append((nid, triples))
+            for spec in hop_capped:  # must execute here: back of the queue
+                self._lease_q.append(spec)
+        for nid, triples in plan:
+            # Notice to the head FIRST (async bookkeeping — it re-points
+            # node.leases so peer-death replay stays correct), then the
+            # one agent->agent hop. The head's global lease pop tolerates
+            # either arrival order.
+            self._send_head(("lease_spilled",
+                             [(t[2].task_id, nid) for t in triples]))
+            threading.Thread(target=self._spill_to_peer,
+                             args=(nid, triples), daemon=True,
+                             name="rtpu-spill").start()
+
+    def _spill_to_peer(self, nid: bytes, triples: list):
+        """Side thread: deliver spilled leases over the peer ctrl channel;
+        an unreachable peer hands them back to the head (re-queued
+        verbatim — they never started anywhere, no retry consumed)."""
+        conn = self._peer_ctrl_conn(nid)
+        if conn is not None:
+            try:
+                conn.send(("lease_spill", self.node_id, triples))
+                return
+            except OSError:
+                pass
+        self._send_head(("lease_return", [t[2] for t in triples]))
+
+    def _peer_ctrl_conn(self, nid: bytes):
+        """Cached agent<->agent ctrl channel, dialed via the cluster
+        view's address (no head round trip). Blocking — side threads
+        only. The fresh channel is published for reuse UNLESS a direct-
+        call dial is mid-flight for the same peer (_dial_and_flush owns
+        publication then: its queued calls must drain first to keep
+        per-caller ordering)."""
+        with self._peer_lock:
+            conn = self._peer_conns.get(nid)
+            if conn is not None and conn.alive:
+                return conn
+        conn = self._dial_peer(nid)
+        if conn is None:
+            return None
+        with self._peer_lock:
+            cur = self._peer_conns.get(nid)
+            if cur is not None and cur.alive:
+                return cur  # raced another dial: use the published one
+            if nid not in self._dial_pending:
+                self._peer_conns[nid] = conn
+        return conn
+
+    def _on_lease_spill(self, origin_nid: bytes, triples: list):
+        """Executor side of a spill. Back-pressure: once our own
+        un-started backlog reaches the spill floor, refuse the overflow
+        by returning it to the head (re-queued, no retry consumed)
+        instead of accepting work we could only re-spill."""
+        reject = []
+        accepted = False
+        with self._lease_lock:
+            keep = self._spill_keep_locked()
+            for fn_id, blob, spec in triples:
+                if blob is not None:
+                    self._fn_blobs[fn_id] = blob
+                if (len(self._lease_q) >= keep
+                        or (spec.fn_id
+                            and spec.fn_id not in self._fn_blobs)):
+                    reject.append(spec)
+                else:
+                    self._lease_q.append(spec)
+                    accepted = True
+        if reject:
+            self._send_head(("lease_return", reject))
+        if accepted:
+            self._pump_leases()
 
     def _sniff_lease_dones(self, w: _AgentWorker, msg,
                            collector: list | None = None) -> object | None:
@@ -562,6 +776,18 @@ class NodeAgent:
                         self._fn_blobs[fn_id] = blob
                     self._lease_q.append(spec)
             self._pump_leases()
+            self._maybe_push_load_delta()
+        elif op == "cluster_view":
+            # Head broadcast of the versioned cluster resource view: a
+            # DELTA relative to this agent's head-side cursor (entries
+            # that changed since the last frame we were sent). Fresh
+            # information about idle peers may unblock a spill.
+            _, version, entries = msg
+            with self._lease_lock:
+                self._cview_version = version
+                for nid, e in entries:
+                    self._cluster_view[nid] = e
+            self._maybe_spill_leases()
         elif op == "lease_reclaim":
             # Head reclaims un-started backlog for idle nodes elsewhere.
             returned = []
@@ -620,15 +846,30 @@ class NodeAgent:
     def _dial_peer(self, nid: bytes):
         """Dial a peer agent's ctrl port WITHOUT publishing the channel —
         the dial thread publishes only after draining its pending queue,
-        keeping per-caller ordering across the dial window."""
-        try:
-            addr = self._head_request("node_ctrl_addr", nid)
-            if not addr:
+        keeping per-caller ordering across the dial window.
+
+        The address comes from the broadcast cluster view when it has the
+        peer (zero head round trips — the decentralization the broadcast
+        plane exists for); the synchronous head query is the fallback for
+        peers the view has not carried yet."""
+        from ray_tpu.core.transport import dial
+        sock = None
+        with self._lease_lock:
+            e = self._cluster_view.get(nid) or {}
+            addr = e.get("ctrl") if e.get("state") == "ALIVE" else None
+        if addr:
+            try:
+                sock = dial(addr)
+            except OSError:
+                sock = None  # stale view entry: ask the head
+        if sock is None:
+            try:
+                addr = self._head_request("node_ctrl_addr", nid)
+                if not addr:
+                    return None
+                sock = dial(addr)
+            except Exception:  # noqa: BLE001 — fall back to head
                 return None
-            sock = socket.create_connection(tuple(addr), timeout=5.0)
-            enable_nodelay(sock)
-        except Exception:  # noqa: BLE001 — fall back to head
-            return None
         conn = _PeerConn(self, sock, nid=nid)
         conn.send(("peer_hello", self.node_id))
         conn.start()
@@ -813,6 +1054,11 @@ class NodeAgent:
                     pass
 
             self._exec_in_order(spec, wid, deliver, on_drop=on_drop)
+        elif op == "lease_spill":
+            # Surplus leases forwarded by a saturated peer agent (the
+            # decentralized spillback hop — the head was only notified).
+            _, origin_nid, triples = msg
+            self._on_lease_spill(origin_nid, triples)
         elif op == "peer_done":
             _, origin_wid, done_msg = msg
             conn.inflight.pop(done_msg[1], None)
@@ -961,6 +1207,7 @@ class NodeAgent:
                 self._reconnect_or_die()
         if lease_dones:
             self._pump_leases()
+        self._maybe_push_load_delta()
 
     def _die(self):
         if self._shutdown:

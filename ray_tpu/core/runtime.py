@@ -372,6 +372,11 @@ class NodeState:
         # the ray_syncer.h:20 role): {"v", "idle", "backlog"}.
         self.load_view: dict = {}
         self.last_reclaim = 0.0
+        # Cluster-view broadcast cursor: the head-global view version this
+        # agent has been sent up to. Broadcasts carry only entries newer
+        # than the cursor (TCP FIFO makes advancing it at send time safe);
+        # a re-registration resets it to 0, which is the full-view resend.
+        self.cview_cursor = 0
 
 
 class _ForkedProc:
@@ -866,6 +871,18 @@ class Runtime:
         # the reference never duplicates execution without a failure).
         # task_id -> (origin WorkerHandle, TaskSpec)
         self._pending_steals: dict[bytes, tuple] = {}
+        # --- cluster-view broadcast (the missing half of the resource
+        # syncer, parity: ray_syncer.h:20 — agents report deltas up via
+        # heartbeats; the head broadcasts the merged, versioned cluster
+        # view back down so agents can spill leases peer-to-peer without
+        # a per-task head round trip, cluster_task_manager.cc:187). Each
+        # entry carries the global version it last changed at; per-agent
+        # cursors (NodeState.cview_cursor) turn every broadcast into a
+        # delta.
+        self._cview_lock = threading.Lock()
+        self._cview_version = 0
+        self._cview: dict[bytes, dict] = {}  # nid -> view entry (versioned)
+        self.lease_spills_total = 0  # agent->agent lease moves observed
 
         self._selector = selectors.DefaultSelector()
         self._sel_lock = threading.Lock()
@@ -884,6 +901,9 @@ class Runtime:
         self._pending_lease_sends: collections.deque = collections.deque()
         threading.Thread(target=self._sched_loop, daemon=True,
                          name="rtpu-scheduler").start()
+        if cfg.cluster_view_broadcast_ms > 0:
+            threading.Thread(target=self._cview_broadcast_loop, daemon=True,
+                             name="rtpu-cview").start()
 
         pool = cfg.num_workers or int(self.total_resources["CPU"])
         self.pool_size = max(1, pool)
@@ -1984,6 +2004,14 @@ class Runtime:
                             self.total_resources.get(k, 0.0) + v)
                 # New capacity may unblock queued PGs/actors.
                 self._kick_waiters()
+            # (Re-)registration resets the broadcast cursor: the agent's
+            # view cache died with its old process/link, so the next
+            # broadcast pass resends the full cluster view.
+            node.cview_cursor = 0
+            self._cview_update(
+                nid, state="ALIVE",
+                cpu=float((resources or {}).get("CPU", 0.0)),
+                ctrl=tuple(ctrl_addr) if ctrl_addr else None)
             # Worker inventory: rebuild handles for surviving workers and
             # adopt the actors they still host (head-restart resync,
             # parity: raylets resyncing with a restarted GCS).
@@ -2035,6 +2063,12 @@ class Runtime:
                     # the state API. TCP FIFO keeps versions monotonic.
                     if msg[2].get("v", 0) >= node.load_view.get("v", -1):
                         node.load_view = msg[2]
+                        view = node.load_view
+                        self._cview_update(
+                            conn.node_id,
+                            idle=int(view.get("idle", 0)),
+                            backlog=int(view.get("backlog", 0)),
+                            inflight=int(view.get("inflight", 0)))
                     if node.load_view.get("backlog"):
                         self._maybe_reclaim_leases(node)
         elif op == "agent_req":
@@ -2054,14 +2088,19 @@ class Runtime:
             self._on_node_done(conn, msg[1])
         elif op == "lease_fail":
             self._on_lease_fail(conn.node_id, msg[1])
+        elif op == "lease_spilled":
+            # Async spillback notice: leases moved agent->agent; the head
+            # only re-points its bookkeeping (no scheduling pass).
+            self._on_lease_spilled(conn.node_id, msg[1])
         elif op == "lease_return":
-            # Reclaimed un-started leases: back into the queues verbatim
-            # (no retry consumed — they never ran).
+            # Reclaimed (or back-pressure-refused spilled) un-started
+            # leases: back into the queues verbatim (no retry consumed —
+            # they never ran). Global pop: a spilled lease returned by the
+            # RECEIVING agent may still be booked on its origin node.
             node = self.nodes.get(conn.node_id)
             with self.lock:
                 for spec in msg[1]:
-                    if node is not None:
-                        node.leases.pop(spec.task_id, None)
+                    self._pop_lease_locked(spec.task_id, node)
                     self._release_token(
                         self._reservations.pop(spec.task_id, None))
                     self._enqueue_task_locked(spec, front=True)
@@ -2418,6 +2457,9 @@ class Runtime:
         if self.export_events is not None:
             self.export_events.emit("NODE", node_id=node.node_id.hex(),
                                     state="DEAD")
+        # Broadcast the death: agents must stop spilling leases (and
+        # dialing direct-call channels) toward this node.
+        self._cview_update(node.node_id, state="DEAD")
         for w in list(node.workers.values()):
             self._on_worker_death(w)
         # Leased tasks died with the node: same policy as a dead worker's
@@ -3962,6 +4004,112 @@ class Runtime:
         except OSError:
             pass
 
+    # ------------- cluster-view broadcast (syncer downlink) -------------
+    #
+    # The uplink half (agents reporting versioned load deltas on
+    # heartbeats) landed in round 5; this is the missing downlink
+    # (parity: ray_syncer.h:20 both directions). The head merges every
+    # node's delta into ONE versioned cluster view and periodically
+    # broadcasts it back to the agents; per-agent cursors make each frame
+    # a delta, so a quiet cluster costs zero broadcast bytes. Agents use
+    # the view to spill leases peer-to-peer (node_agent._maybe_spill_leases,
+    # parity: cluster_task_manager.cc:187) and to dial peer ctrl channels
+    # without a head round trip.
+
+    def _cview_update(self, nid: bytes, **fields):
+        """Merge fields into a node's view entry, bumping the global
+        version only when something actually changed — heartbeats with an
+        unchanged load view must not generate broadcast traffic."""
+        with self._cview_lock:
+            e = self._cview.setdefault(nid, {})
+            changed = False
+            for k, v in fields.items():
+                if e.get(k) != v:
+                    e[k] = v
+                    changed = True
+            if changed:
+                self._cview_version += 1
+                e["v"] = self._cview_version
+
+    def _cview_broadcast_loop(self):
+        period = self.config.cluster_view_broadcast_ms / 1000.0
+        while not self._shutdown:
+            time.sleep(period)
+            if self._shutdown:
+                return
+            try:
+                self._broadcast_cluster_view()
+            except Exception:  # noqa: BLE001 — the broadcaster must not die
+                traceback.print_exc()
+
+    def _broadcast_cluster_view(self):
+        """One delta frame per agent that is behind the current version:
+        exactly the entries newer than that agent's cursor (its own entry
+        elided — an agent is the authority on its own load). Cursors
+        advance at send time; TCP FIFO per link makes that safe, and a
+        link that dies mid-send re-registers, which resets the cursor to
+        0 (the full-view catch-up)."""
+        with self._cview_lock:
+            version = self._cview_version
+            entries = [(nid, dict(e)) for nid, e in self._cview.items()]
+        if version == 0:
+            return
+        for node in list(self.nodes.values()):
+            conn = node.conn
+            if conn is None or node.state != "ALIVE":
+                continue
+            cursor = node.cview_cursor
+            if cursor >= version:
+                continue
+            delta = [(nid, e) for nid, e in entries
+                     if e.get("v", 0) > cursor and nid != node.node_id]
+            node.cview_cursor = version
+            if not delta:
+                continue
+            try:
+                conn.send(("cluster_view", version, delta))
+            except OSError:
+                pass  # node-death handling owns the cleanup
+
+    def _pop_lease_locked(self, task_id: bytes, node):
+        """Pop a lease by task id under self.lock: the reporting node
+        first, then every node — a spilled lease can complete on its peer
+        before the origin's lease_spilled notice arrives (the two frames
+        ride different TCP links)."""
+        spec = node.leases.pop(task_id, None) if node is not None else None
+        if spec is None:
+            for n in self.nodes.values():
+                if n is node:
+                    continue
+                spec = n.leases.pop(task_id, None)
+                if spec is not None:
+                    break
+        return spec
+
+    def _on_lease_spilled(self, from_nid: bytes, moves: list):
+        """An agent forwarded leases to a peer agent (decentralized
+        spillback): move head-side lease ownership to the executing node
+        so node_done accounting and node-death replay stay truthful.
+        Advisory and async — the head is OFF the per-task path here; a
+        completion racing this frame simply wins (_pop_lease_locked)."""
+        requeue = []
+        with self.lock:
+            for task_id, to_nid in moves:
+                spec = self._pop_lease_locked(task_id,
+                                              self.nodes.get(from_nid))
+                if spec is None:
+                    continue  # already completed / failed / re-moved
+                dest = self.nodes.get(to_nid)
+                if dest is None or dest.state != "ALIVE":
+                    requeue.append(spec)
+                    continue
+                dest.leases[task_id] = spec
+                self.lease_spills_total += 1
+        if requeue:
+            # Destination died before the notice arrived: same policy as a
+            # node death mid-lease — the task MAY have started there.
+            self._on_lease_fail(None, requeue)
+
     def _steal_for_idle(self) -> bool:
         """Anti-straggler: with idle workers and empty queues, reclaim
         pipelined tasks that have not started (queued behind a long task on
@@ -4250,7 +4398,10 @@ class Runtime:
         refill = []
         with self.lock:
             for task_id, outs in entries:
-                spec = node.leases.pop(task_id, None) if node else None
+                # Global pop: a spilled lease completes on the EXECUTING
+                # node's link, which may not be the node it was leased to
+                # (and the lease_spilled notice may still be in flight).
+                spec = self._pop_lease_locked(task_id, node)
                 self._release_token(
                     self._reservations.pop(task_id, None))
                 for rid, _s, _p, _b in outs:
@@ -4288,9 +4439,8 @@ class Runtime:
         node = self.nodes.get(nid)
         requeued = False
         for spec in specs:
-            if node is not None:
-                node.leases.pop(spec.task_id, None)
             with self.lock:
+                self._pop_lease_locked(spec.task_id, node)
                 self._release_token(
                     self._reservations.pop(spec.task_id, None))
             if spec.task_id in self._cancelled:

@@ -48,6 +48,11 @@ class TaskSpec:
                            # also listed in `dependencies` so the head
                            # gates dispatch on it and frees it after the
                            # final completion
+        "spill_hops",      # int | None — agent->agent lease-spillback hops
+                           # taken so far; capped by lease_spill_max_hops
+                           # so a lease cannot ping-pong between loaded
+                           # agents (parity: the spillback hop guard of
+                           # cluster_task_manager.cc:187)
     )
 
     def __init__(self, **kw):

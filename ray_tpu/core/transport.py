@@ -6,6 +6,11 @@ floor for Python peers; the multi-node path (ray_tpu.core.cluster) layers the
 same frames over TCP. Fault-injection hooks (`testing_rpc_failure`,
 `testing_delay_us` config, parity `src/ray/rpc/rpc_chaos.h:23`) live here so
 every message path is chaos-testable.
+
+The agent<->agent ctrl plane (peer_exec/peer_done direct actor calls, and
+the lease-spillback frames `lease_spill` / the head-bound `lease_spilled`
+delta) rides these same frames over per-agent-pair TCP channels dialed
+with `dial()` below; chaos specs key on those op names like any other.
 """
 
 from __future__ import annotations
@@ -105,6 +110,16 @@ def enable_nodelay(sock: socket.socket):
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     except (OSError, ValueError):
         pass
+
+
+def dial(addr, timeout: float = 5.0) -> socket.socket:
+    """Connect a control channel to `addr` (host, port) with Nagle off —
+    the one way every ctrl-plane dial (agent<->agent peer channels, the
+    lease-spillback hop) should open a TCP link. Raises OSError on
+    failure; callers own their fallback policy."""
+    sock = socket.create_connection(tuple(addr), timeout=timeout)
+    enable_nodelay(sock)
+    return sock
 
 
 # Linux UIO_MAXIOV; sendmsg with more iovecs fails with EMSGSIZE.

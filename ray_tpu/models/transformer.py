@@ -188,7 +188,24 @@ def _mlp(x, lp):
 def hidden_states(params, tokens, config: ModelConfig, mesh=None):
     """tokens [batch, seq] -> final-norm hidden states [batch, seq, d]."""
     c = config
-    x = jnp.take(params["embed"], tokens, axis=0)
+    if mesh is not None and mesh.devices.size > 1:
+        # One-hot matmul lookup instead of gather (the iota-embed trick):
+        # the SPMD partitioner handles a [b,s,v] x [v,d] contraction over
+        # the tp-sharded vocab axis cleanly (masked matmul + psum), where
+        # the equivalent gather forced "Involuntary full rematerialization"
+        # (spmd_partitioner.cc:652) of the embedding activation in fwd AND
+        # bwd — the table's embed axis is fsdp-sharded on a transposed
+        # device order the partitioner cannot leave cheaply. The explicit
+        # constraint pins the result to the activation layout (batch over
+        # the data axes, embed replicated) so the bwd table grad
+        # partitions as a plain matmul too.
+        from ray_tpu.parallel.sharding import activation_batch_sharded
+        table = params["embed"]
+        onehot = jax.nn.one_hot(tokens, table.shape[0], dtype=table.dtype)
+        x = jnp.einsum("bsv,vd->bsd", onehot, table)
+        x = activation_batch_sharded(x, mesh)
+    else:
+        x = jnp.take(params["embed"], tokens, axis=0)
     positions = jnp.arange(tokens.shape[1])
     sin, cos = rope(positions, c.head_dim, c.rope_theta)
 

@@ -37,6 +37,10 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+if not hasattr(pltpu, "CompilerParams"):
+    # jax 0.4.x names it TPUCompilerParams; same fields.
+    pltpu.CompilerParams = pltpu.TPUCompilerParams
+
 _NEG = -0.7 * float(np.finfo(np.float32).max)
 
 

@@ -74,7 +74,7 @@ def gpipe(stage_fn, stacked_params, microbatches, mesh, axis_name: str = "pp"):
     stage; callers typically compute the loss inside stage_fn of the last
     stage and psum. For generic use we broadcast the last stage's buffer.
     """
-    from jax import shard_map
+    from ray_tpu.parallel.sharding import shard_map_compat
     s = mesh.shape[axis_name]
 
     def inner(params, mbs):
@@ -83,8 +83,7 @@ def gpipe(stage_fn, stacked_params, microbatches, mesh, axis_name: str = "pp"):
         # Broadcast final-stage outputs to all stages (psum of one-hot).
         return jax.lax.psum(out, axis_name)
 
-    return shard_map(
-        inner, mesh=mesh,
-        in_specs=(jax.tree.map(lambda _: P(axis_name), stacked_params),
-                  P()),
-        out_specs=P(), check_vma=False)(stacked_params, microbatches)
+    return shard_map_compat(
+        inner, mesh,
+        (jax.tree.map(lambda _: P(axis_name), stacked_params), P()),
+        P())(stacked_params, microbatches)

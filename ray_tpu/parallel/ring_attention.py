@@ -294,7 +294,7 @@ def ring_attention(q, k, v, mesh, axis_name: str = "sp", causal: bool = True,
     "interpret" (pallas interpreter — CPU tests take the kernel code path),
     "jnp" (pure-jnp oracle).
     """
-    from jax import shard_map
+    from ray_tpu.parallel.sharding import shard_map_compat
     n = mesh.shape[axis_name]
     explicit = impl in ("pallas", "interpret")
     if impl == "auto":
@@ -313,8 +313,7 @@ def ring_attention(q, k, v, mesh, axis_name: str = "sp", causal: bool = True,
     spec = q_spec if q_spec is not None else P(None, axis_name, None, None)
     fn = functools.partial(ring_attention_inner, axis_name=axis_name,
                            axis_size=n, causal=causal, impl=impl)
-    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_vma=False)(q, k, v)
+    return shard_map_compat(fn, mesh, (spec, spec, spec), spec)(q, k, v)
 
 
 def reference_attention(q, k, v, causal: bool = True,

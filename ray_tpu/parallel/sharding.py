@@ -77,3 +77,39 @@ def shard_params(params, logical_tree, rules: ShardingRules, mesh: Mesh):
 def with_sharding(x, mesh: Mesh, spec: P):
     """Sharding constraint inside jit (GSPMD hint)."""
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def shard_map_compat(fn, mesh: Mesh, in_specs, out_specs):
+    """shard_map across jax versions: `jax.shard_map(..., check_vma=)` on
+    current jax, `jax.experimental.shard_map.shard_map(..., check_rep=)`
+    on 0.4.x — same semantics (replication checking off; the wrappers
+    here all psum/permute explicitly). Every sp/pp entry point routes
+    through this so one jax upgrade path touches one function."""
+    try:
+        from jax import shard_map as _sm
+        return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_vma=False)
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+        return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=False)
+
+
+def data_axes(mesh: Mesh) -> tuple[str, ...]:
+    """Mesh axes that shard the batch dimension of activations (the
+    activation-layout half of the "batch" rule): the axes present on this
+    mesh, in rule order, so constraints built from it agree with
+    batch_spec = P(("dp", "fsdp")) on any mesh shape."""
+    return tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+
+
+def activation_batch_sharded(x, mesh: Mesh):
+    """Constrain a [batch, ...] activation to the canonical layout: batch
+    over the data axes, everything else replicated. Used at layout seams
+    where the partitioner would otherwise propagate a PARAM sharding into
+    the activation (the embedding lookup: its natural output inherits the
+    table's embed sharding on a transposed device order, which XLA can
+    only leave via involuntary full rematerialization)."""
+    axes = data_axes(mesh)
+    spec = P(axes if axes else None, *([None] * (x.ndim - 1)))
+    return with_sharding(x, mesh, spec)

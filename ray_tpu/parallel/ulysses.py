@@ -36,9 +36,8 @@ def ulysses_attention_inner(q, k, v, axis_name: str, causal: bool = True):
 
 def ulysses_attention(q, k, v, mesh, axis_name: str = "sp",
                       causal: bool = True):
-    from jax import shard_map
+    from ray_tpu.parallel.sharding import shard_map_compat
     spec = P(None, axis_name, None, None)
     fn = functools.partial(ulysses_attention_inner, axis_name=axis_name,
                            causal=causal)
-    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_vma=False)(q, k, v)
+    return shard_map_compat(fn, mesh, (spec, spec, spec), spec)(q, k, v)

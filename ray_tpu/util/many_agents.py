@@ -14,8 +14,16 @@ def run_many_agents(n_agents: int = 16, n_tasks: int = 400,
                     spawn_timeout: float = 240.0) -> dict:
     """Spin `n_agents` node agents on this machine, fan `n_tasks` trivial
     tasks across them, and return {'rate': tasks/s, 'nodes_alive': int,
-    'nodes_used': int, 'correct': bool}. Caller owns no cluster before or
-    after (shuts down on exit)."""
+    'nodes_used': int, 'correct': bool, 'head_cpu_s': float,
+    'tasks_per_head_cpu_s': float}. Caller owns no cluster before or
+    after (shuts down on exit).
+
+    head_cpu_s is the driver/head process's CPU time spent inside the
+    timed window (the head runtime lives in this process), so
+    tasks_per_head_cpu_s is the head-cost-per-task metric: the
+    decentralized lease plane (cluster-view broadcast + agent->agent
+    spillback) is working exactly when this number grows while wall-clock
+    rate holds — the head is off the per-task critical path."""
     import ray_tpu
     from ray_tpu.cluster_utils import Cluster
 
@@ -40,8 +48,10 @@ def run_many_agents(n_agents: int = 16, n_tasks: int = 400,
         ray_tpu.get([f.remote(i) for i in range(n_agents)],
                     timeout=spawn_timeout)
         t0 = time.perf_counter()
+        c0 = time.process_time()
         out = ray_tpu.get([f.remote(i) for i in range(n_tasks)],
                           timeout=300)
+        head_cpu_s = max(1e-9, time.process_time() - c0)
         rate = n_tasks / (time.perf_counter() - t0)
         from ray_tpu.core.runtime import get_runtime
         rt = get_runtime()
@@ -52,6 +62,9 @@ def run_many_agents(n_agents: int = 16, n_tasks: int = 400,
             "nodes_used": len({nid for _v, nid in out}),
             "correct": [v for v, _nid in out] == list(
                 range(1, n_tasks + 1)),
+            "head_cpu_s": round(head_cpu_s, 3),
+            "tasks_per_head_cpu_s": round(n_tasks / head_cpu_s, 1),
+            "lease_spills": rt.lease_spills_total,
         }
     finally:
         c.shutdown()

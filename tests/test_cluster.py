@@ -336,6 +336,7 @@ def test_p2p_collectives_bypass_head():
         c.shutdown()
 
 
+@pytest.mark.heavy
 def test_sixteen_agent_scheduling():
     """Many-agent scalability evidence (VERDICT r2 #9): 16 node agents on
     one box, tasks spread across all of them, head-loop dispatch batched

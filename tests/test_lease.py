@@ -138,6 +138,108 @@ def test_load_view_rides_heartbeats_and_reclaim_fires():
         c.shutdown()
 
 
+def test_spillback_drains_saturated_agent_via_peer():
+    """Decentralized spillback (the syncer's downlink in action): a node
+    whose un-started lease backlog exceeds its capacity forwards leases
+    DIRECTLY to an under-loaded peer agent — the head only receives the
+    async lease_spilled notice, never a per-task scheduling round trip.
+
+    Setup: node A advertises 24 CPUs but (num_workers=1) pools a single
+    worker (burst-spawn capped at 10), so the head's initial reservation
+    grant hands it 24 leases, most of which sit un-started in its
+    _lease_q for seconds; node B is a healthy 2-CPU peer that goes idle
+    after its own 2 leases and pushes an idle delta. The head's own
+    anti-straggler reclaim is disabled so only the agent->agent path can
+    move work."""
+    c = Cluster(initialize_head=True, head_node_args={
+        "num_cpus": 1,
+        "_system_config": {"num_workers": 1,
+                           "max_tasks_in_flight_per_worker": 1,
+                           "cluster_view_broadcast_ms": 50}})
+    a = c.add_node(num_cpus=24)
+    c.add_node(num_cpus=2)
+    c.wait_for_nodes(3)
+    try:
+        from ray_tpu.core.runtime import get_runtime
+        rt = get_runtime()
+        rt._maybe_reclaim_leases = lambda node: None  # isolate spillback
+
+        @ray_tpu.remote(num_cpus=1)
+        def slowish(i):
+            time.sleep(0.8)
+            return (i, ray_tpu.get_node_id())
+
+        out = ray_tpu.get([slowish.remote(i) for i in range(26)],
+                          timeout=120)
+        assert sorted(i for i, _ in out) == list(range(26))
+        # The peer executed spilled work: the head observed agent->agent
+        # lease moves, and node B (not just saturated A) ran tasks.
+        assert rt.lease_spills_total >= 1, rt.lease_spills_total
+        nodes_used = {n for _, n in out}
+        assert len(nodes_used) >= 2, nodes_used
+        a_nid = bytes.fromhex(a.node_id)
+        a_node = rt.nodes[a_nid]
+        # Every lease settled (none stranded by the move bookkeeping).
+        assert sum(len(n.leases) for n in rt.nodes.values()) == 0
+        assert not a_node.leases
+    finally:
+        c.shutdown()
+
+
+def test_cluster_view_broadcast_is_cursor_delta():
+    """The head's cluster-view broadcast carries only entries newer than
+    each agent's version cursor: an agent that missed broadcasts catches
+    up FROM ITS CURSOR (the stale suffix), not via a full resend — and an
+    up-to-date agent receives nothing at all."""
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    c.add_node(num_cpus=2)
+    c.add_node(num_cpus=2)
+    c.wait_for_nodes(3)
+    try:
+        from ray_tpu.core.runtime import get_runtime
+        rt = get_runtime()
+        agents = [n for n in rt.nodes.values() if n.conn is not None]
+        # Heartbeats populate both view entries (idle counts etc).
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not all(
+                "idle" in rt._cview.get(n.node_id, {}) for n in agents):
+            time.sleep(0.05)
+        target, other = agents[0], agents[1]
+        sent = []
+        real_send = target.conn.send
+        target.conn.send = lambda m: (sent.append(m), real_send(m))
+        try:
+            # Make ONE entry newer than everything else, then roll the
+            # target's cursor back to just before that change: the next
+            # broadcast must resend exactly the one stale entry.
+            rt._cview_update(other.node_id, idle=123)
+            v_before = rt._cview[other.node_id]["v"] - 1
+            target.cview_cursor = v_before
+            rt._broadcast_cluster_view()
+            frames = [m for m in sent if m[0] == "cluster_view"]
+            assert frames, sent
+            _, version, entries = frames[-1]
+            assert version == rt._cview_version
+            sent_nids = {nid for nid, _e in entries}
+            assert sent_nids == {other.node_id}, sent_nids
+            assert all(e["v"] > v_before for _nid, e in entries)
+            # Caught up: the next pass sends this agent nothing.
+            sent.clear()
+            rt._broadcast_cluster_view()
+            assert not [m for m in sent if m[0] == "cluster_view"], sent
+            # An agent's own entry never rides its broadcast (it is the
+            # authority on its own load).
+            target.cview_cursor = 0
+            sent.clear()
+            rt._broadcast_cluster_view()
+            _, _v, full = [m for m in sent if m[0] == "cluster_view"][-1]
+            assert target.node_id not in {nid for nid, _e in full}
+        finally:
+            target.conn.send = real_send
+    finally:
+        c.shutdown()
+
+
 def test_many_fresh_fns_never_race_registration():
     """Regression: two _pump_leases threads could send a bare exec for an
     fn_id ahead of the reg_fn that carried its registration (the exec

@@ -16,6 +16,11 @@ from ray_tpu.llm.engine import sample
 from ray_tpu.llm.tokenizer import ByteTokenizer
 from ray_tpu.models import ModelConfig, forward, init_params
 
+# Engine tests jit-compile prefill/decode graphs per config — the
+# compile-heavy tier. `-m "not heavy"` skips them to contain full-suite
+# wall time; nothing here is excluded from the full run.
+pytestmark = pytest.mark.heavy
+
 TINY = ModelConfig(vocab=300, d_model=64, n_layers=2, n_heads=4,
                    n_kv_heads=2, d_ff=128, dtype="float32")
 

@@ -30,6 +30,35 @@ def sp_mesh():
     return make_mesh(MeshConfig(fsdp=1, sp=8), axis_names=("dp", "fsdp", "pp", "sp", "tp", "ep"))
 
 
+def test_train_step_dp_fsdp_tp_no_involuntary_remat():
+    """Compiling the full sharded train step at dp=2,fsdp=2,tp=2 emits NO
+    XLA involuntary-full-rematerialization diagnostic (the replicate-then-
+    repartition fallback that shipped silently in rounds 3-5: the
+    embedding gather's output inherited the table's transposed fsdp
+    sharding). The one-hot lookup + activation constraint keep the
+    partitioner on cheap reshards; this pins it."""
+    import optax
+
+    from __graft_entry__ import _CaptureStderrFd
+    from ray_tpu.models import (configs, init_params, loss_fn,
+                                param_logical_axes)
+    from ray_tpu.train.step import make_train_step
+
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+    config = configs.tiny()
+    params = init_params(config, jax.random.PRNGKey(0))
+    init_fn, _step, compile_for, _ = make_train_step(
+        lambda p, b: loss_fn(p, b, config, mesh=mesh), optax.adamw(1e-3),
+        mesh, param_logical_axes(config))
+    state = init_fn(params)
+    batch = {"tokens": jnp.zeros((8, 33), jnp.int32)}
+    with _CaptureStderrFd() as cap:
+        state, loss = compile_for(state, batch)(state, batch)
+    assert b"Involuntary full rematerialization" not in cap.captured, (
+        cap.captured.decode("utf-8", "replace")[-2000:])
+    assert np.isfinite(float(loss))
+
+
 @pytest.mark.parametrize("causal", [True, False])
 def test_ring_attention_matches_reference(sp_mesh, causal):
     q, k, v = _qkv(jax.random.PRNGKey(0))

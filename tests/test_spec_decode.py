@@ -12,6 +12,10 @@ import pytest
 from ray_tpu.llm import EngineConfig, InferenceEngine
 from ray_tpu.models import ModelConfig, forward, init_params
 
+# Drafter+verifier engines compile multi-query verify graphs per case —
+# compile-heavy; see pytest.ini's `heavy` tier.
+pytestmark = pytest.mark.heavy
+
 TINY = ModelConfig(vocab=300, d_model=64, n_layers=2, n_heads=4,
                    n_kv_heads=2, d_ff=128, dtype="float32")
 
