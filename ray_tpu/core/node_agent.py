@@ -76,6 +76,16 @@ class _PeerConn:
     def send(self, msg):
         send_msg(self.sock, msg, self.send_lock)
 
+    def close(self):
+        """Retire the channel: shutdown (not close) so the blocked reader
+        thread wakes with EOF and owns the actual close + eof cleanup —
+        closing the fd out from under a live recv risks it landing on a
+        reused descriptor."""
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+
     def start(self):
         threading.Thread(target=self._read_loop, daemon=True,
                          name="rtpu-peer").start()
@@ -596,7 +606,7 @@ class NodeAgent:
         if not cfg.lease_spillback or self._shutdown:
             return
         now = time.monotonic()
-        plan = []  # (nid, [(fn_id, blob, spec), ...])
+        plan = []  # (nid, [(fn_id, blob, spec), ...], new fn_ids)
         with self._lease_lock:
             if now - self._last_spill < 0.05:
                 return  # pump storms: one selection per view tick is plenty
@@ -639,39 +649,68 @@ class NodeAgent:
                 # what we are sending — without this every pump pass until
                 # the next broadcast would dump on the same peer.
                 e["backlog"] = int(e.get("backlog", 0)) + len(specs)
-                sent_fns = self._peer_fns.setdefault(nid, set())
+                # Blob selection is optimistic only WITHIN this batch
+                # (one batch never carries the same blob twice);
+                # _peer_fns itself is credited by _spill_to_peer after
+                # the send SUCCEEDS — crediting here would let a failed
+                # delivery suppress the blob on every future spill to
+                # that peer, wedging the (peer, fn) pair into a
+                # permanent reject->requeue churn loop.
+                sent_fns = self._peer_fns.get(nid) or ()
+                new_fns = set()
                 triples = []
                 for spec in specs:
                     blob = None
-                    if spec.fn_id and spec.fn_id not in sent_fns:
+                    if (spec.fn_id and spec.fn_id not in sent_fns
+                            and spec.fn_id not in new_fns):
                         blob = self._fn_blobs.get(spec.fn_id)
-                        sent_fns.add(spec.fn_id)
+                        if blob is not None:
+                            new_fns.add(spec.fn_id)
                     triples.append((spec.fn_id, blob, spec))
-                plan.append((nid, triples))
+                plan.append((nid, triples, new_fns))
             for spec in hop_capped:  # must execute here: back of the queue
                 self._lease_q.append(spec)
-        for nid, triples in plan:
+        for nid, triples, new_fns in plan:
             # Notice to the head FIRST (async bookkeeping — it re-points
             # node.leases so peer-death replay stays correct), then the
-            # one agent->agent hop. The head's global lease pop tolerates
-            # either arrival order.
+            # one agent->agent hop. Each move carries the lease grant
+            # generation (lease_seq) and this hop's position in the spill
+            # chain (spill_hops) so the head can drop stale notices
+            # instead of re-pointing a lease that was re-granted, or
+            # applying a multi-hop chain's frames out of order.
             self._send_head(("lease_spilled",
-                             [(t[2].task_id, nid) for t in triples]))
+                             [(t[2].task_id, t[2].lease_seq,
+                               t[2].spill_hops, nid) for t in triples]))
             threading.Thread(target=self._spill_to_peer,
-                             args=(nid, triples), daemon=True,
+                             args=(nid, triples, new_fns), daemon=True,
                              name="rtpu-spill").start()
 
-    def _spill_to_peer(self, nid: bytes, triples: list):
+    def _spill_to_peer(self, nid: bytes, triples: list, new_fns: set):
         """Side thread: deliver spilled leases over the peer ctrl channel;
         an unreachable peer hands them back to the head (re-queued
-        verbatim — they never started anywhere, no retry consumed)."""
+        verbatim — they never started anywhere, no retry consumed).
+        _peer_fns is credited only once the send succeeds; a failed send
+        drops the peer's whole blob record (the channel died — assume
+        nothing about what it still holds). An unpublished channel (a
+        direct-call dial owned publication, or we lost a publish race)
+        is retired after this one-shot use instead of leaking its fd and
+        reader thread."""
         conn = self._peer_ctrl_conn(nid)
         if conn is not None:
             try:
                 conn.send(("lease_spill", self.node_id, triples))
+                if new_fns:
+                    with self._lease_lock:
+                        self._peer_fns.setdefault(nid, set()).update(new_fns)
                 return
             except OSError:
-                pass
+                with self._lease_lock:
+                    self._peer_fns.pop(nid, None)
+            finally:
+                with self._peer_lock:
+                    published = self._peer_conns.get(nid) is conn
+                if not published:
+                    conn.close()
         self._send_head(("lease_return", [t[2] for t in triples]))
 
     def _peer_ctrl_conn(self, nid: bytes):
@@ -680,7 +719,10 @@ class NodeAgent:
         only. The fresh channel is published for reuse UNLESS a direct-
         call dial is mid-flight for the same peer (_dial_and_flush owns
         publication then: its queued calls must drain first to keep
-        per-caller ordering)."""
+        per-caller ordering). Callers must close() a returned channel
+        that did not get published (they can tell by comparing against
+        _peer_conns) once done with it — an unpublished channel nobody
+        retires leaks its fd and reader thread."""
         with self._peer_lock:
             conn = self._peer_conns.get(nid)
             if conn is not None and conn.alive:
@@ -688,12 +730,15 @@ class NodeAgent:
         conn = self._dial_peer(nid)
         if conn is None:
             return None
+        redundant = None
         with self._peer_lock:
             cur = self._peer_conns.get(nid)
             if cur is not None and cur.alive:
-                return cur  # raced another dial: use the published one
-            if nid not in self._dial_pending:
+                redundant, conn = conn, cur  # raced another dial: reuse it
+            elif nid not in self._dial_pending:
                 self._peer_conns[nid] = conn
+        if redundant is not None:
+            redundant.close()
         return conn
 
     def _on_lease_spill(self, origin_nid: bytes, triples: list):
@@ -1071,10 +1116,20 @@ class NodeAgent:
                                   maybe_executed=maybe_executed)
 
     def _on_peer_eof(self, conn: "_PeerConn"):
+        published = False
         with self._peer_lock:
             if conn.nid is not None and self._peer_conns.get(
                     conn.nid) is conn:
                 self._peer_conns.pop(conn.nid, None)
+                published = True
+        if published:
+            # The peer LINK died for an unknown reason: forget which fn
+            # blobs that peer holds — the next spill resends them (cheap)
+            # rather than betting un-started work on stale bookkeeping.
+            # (One-shot channels skip this: their deliveries succeeded,
+            # and the blobs live in the peer's process-level cache.)
+            with self._lease_lock:
+                self._peer_fns.pop(conn.nid, None)
         # Calls in flight on the dead channel MAY have executed (the exec
         # frame was sent): only retry-permitted calls replay via the head.
         for task_id, (origin_wid, spec) in list(conn.inflight.items()):

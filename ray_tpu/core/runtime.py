@@ -2093,18 +2093,7 @@ class Runtime:
             # only re-points its bookkeeping (no scheduling pass).
             self._on_lease_spilled(conn.node_id, msg[1])
         elif op == "lease_return":
-            # Reclaimed (or back-pressure-refused spilled) un-started
-            # leases: back into the queues verbatim (no retry consumed —
-            # they never ran). Global pop: a spilled lease returned by the
-            # RECEIVING agent may still be booked on its origin node.
-            node = self.nodes.get(conn.node_id)
-            with self.lock:
-                for spec in msg[1]:
-                    self._pop_lease_locked(spec.task_id, node)
-                    self._release_token(
-                        self._reservations.pop(spec.task_id, None))
-                    self._enqueue_task_locked(spec, front=True)
-            self._schedule()
+            self._on_lease_return(conn.node_id, msg[1])
         elif op == "worker_death":
             w = self.workers.get(msg[1])
             if w is not None:
@@ -3828,6 +3817,7 @@ class Runtime:
                         # global-lock work) at the head.
                         q.popleft()
                         self._reservations[spec.task_id] = token
+                        spec.lease_seq = (spec.lease_seq or 0) + 1
                         node.leases[spec.task_id] = spec
                         lease_dispatches.append((node, spec))
                         continue
@@ -3965,6 +3955,7 @@ class Runtime:
                     break
                 q.popleft()
                 budget -= 1
+                spec.lease_seq = (spec.lease_seq or 0) + 1
                 node.leases[spec.task_id] = spec
                 out.append((node, spec))
             if not self.task_queues.get(sig):
@@ -4071,34 +4062,94 @@ class Runtime:
             except OSError:
                 pass  # node-death handling owns the cleanup
 
+    def _find_lease_locked(self, task_id: bytes, node):
+        """Locate a lease by task id under self.lock WITHOUT popping it:
+        the reporting node first, then every node — a spilled lease can
+        complete on its peer before the origin's lease_spilled notice
+        arrives (the two frames ride different TCP links). Returns
+        (holder_node, spec), both None when the lease is gone."""
+        if node is not None:
+            spec = node.leases.get(task_id)
+            if spec is not None:
+                return node, spec
+        for n in self.nodes.values():
+            if n is node:
+                continue
+            spec = n.leases.get(task_id)
+            if spec is not None:
+                return n, spec
+        return None, None
+
     def _pop_lease_locked(self, task_id: bytes, node):
-        """Pop a lease by task id under self.lock: the reporting node
-        first, then every node — a spilled lease can complete on its peer
-        before the origin's lease_spilled notice arrives (the two frames
-        ride different TCP links)."""
-        spec = node.leases.pop(task_id, None) if node is not None else None
-        if spec is None:
-            for n in self.nodes.values():
-                if n is node:
-                    continue
-                spec = n.leases.pop(task_id, None)
-                if spec is not None:
-                    break
+        """_find_lease_locked, destructively."""
+        holder, spec = self._find_lease_locked(task_id, node)
+        if holder is not None:
+            holder.leases.pop(task_id, None)
         return spec
+
+    def _on_lease_return(self, from_nid: bytes, specs: list):
+        """Reclaimed (or back-pressure-refused spilled) un-started
+        leases: back into the queues verbatim (no retry consumed — they
+        never ran). Global find: a spilled lease returned by the
+        RECEIVING agent may still be booked on its origin node.
+
+        A return only counts while the lease it names is CURRENT — still
+        booked somewhere AND the same grant generation (lease_seq). The
+        spill-to-a-dead-peer case races the head's own requeue
+        (_on_lease_spilled) against the origin agent's lease_return
+        fallback, and by the time the loser's frame lands the task may
+        already be re-queued, re-granted (seq bumped), or failed with
+        retries exhausted; acting on the stale frame anyway would enqueue
+        a second copy (duplicate execution) and double-release the
+        reservation token. The loser must be a no-op."""
+        node = self.nodes.get(from_nid)
+        requeued = False
+        with self.lock:
+            for spec in specs:
+                holder, cur = self._find_lease_locked(spec.task_id, node)
+                if (cur is None
+                        or (cur.lease_seq or 0) != (spec.lease_seq or 0)):
+                    continue  # already requeued / completed / re-granted
+                holder.leases.pop(spec.task_id, None)
+                self._release_token(
+                    self._reservations.pop(spec.task_id, None))
+                # Carry the hop count home: bouncing through the head
+                # does not reset the anti-ping-pong budget.
+                cur.spill_hops = spec.spill_hops
+                self._enqueue_task_locked(cur, front=True)
+                requeued = True
+        if requeued:
+            self._schedule()
 
     def _on_lease_spilled(self, from_nid: bytes, moves: list):
         """An agent forwarded leases to a peer agent (decentralized
         spillback): move head-side lease ownership to the executing node
         so node_done accounting and node-death replay stay truthful.
         Advisory and async — the head is OFF the per-task path here; a
-        completion racing this frame simply wins (_pop_lease_locked)."""
+        completion racing this frame simply wins (_find_lease_locked
+        comes up empty).
+
+        Two staleness guards, because these notices ride a different TCP
+        link than returns/completions: (1) a notice whose lease_seq does
+        not match the current lease names a PREVIOUS grant — the lease
+        was returned and re-granted before the notice landed, and
+        re-pointing the new grant would strand it (dest death replays
+        spuriously, real holder's death replays never); (2) within one
+        grant, the spill_hops position orders a multi-hop chain's notices
+        (A->B and B->C may arrive reversed) — only a move further along
+        the chain than what is already applied wins."""
         requeue = []
         with self.lock:
-            for task_id, to_nid in moves:
-                spec = self._pop_lease_locked(task_id,
-                                              self.nodes.get(from_nid))
-                if spec is None:
-                    continue  # already completed / failed / re-moved
+            for task_id, seq, hops, to_nid in moves:
+                holder, spec = self._find_lease_locked(
+                    task_id, self.nodes.get(from_nid))
+                if (spec is None
+                        or (spec.lease_seq or 0) != (seq or 0)):
+                    continue  # completed / failed / returned + re-granted
+                if (spec.spill_hops or 0) >= (hops or 0):
+                    continue  # a later hop's notice already applied
+                holder.leases.pop(task_id, None)
+                spec.spill_hops = hops
                 dest = self.nodes.get(to_nid)
                 if dest is None or dest.state != "ALIVE":
                     requeue.append(spec)
@@ -4107,7 +4158,9 @@ class Runtime:
                 self.lease_spills_total += 1
         if requeue:
             # Destination died before the notice arrived: same policy as a
-            # node death mid-lease — the task MAY have started there.
+            # node death mid-lease — the task MAY have started there. The
+            # origin agent's own lease_return fallback (its dial to the
+            # dead peer fails too) lands on a popped lease and no-ops.
             self._on_lease_fail(None, requeue)
 
     def _steal_for_idle(self) -> bool:

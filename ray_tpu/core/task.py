@@ -53,6 +53,12 @@ class TaskSpec:
                            # so a lease cannot ping-pong between loaded
                            # agents (parity: the spillback hop guard of
                            # cluster_task_manager.cc:187)
+        "lease_seq",       # int | None — head-side lease grant generation,
+                           # bumped on every (re)grant. Spill/return notices
+                           # echo it so the head can ignore stale frames
+                           # that name a PREVIOUS grant of the same task —
+                           # acting on one would re-point or re-enqueue a
+                           # live lease (duplicate execution / lost replay)
     )
 
     def __init__(self, **kw):

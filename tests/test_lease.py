@@ -240,6 +240,119 @@ def test_cluster_view_broadcast_is_cursor_delta():
         c.shutdown()
 
 
+def _synthetic_leased_spec(**kw):
+    """A parked-forever spec (custom resource no node offers) so the
+    scheduler can requeue it without ever dispatching it anywhere."""
+    import os
+
+    from ray_tpu.core.task import TaskSpec
+    d = dict(task_id=os.urandom(8), name="synthetic", retries_left=1,
+             resources={"SYNTH_LEASE_TEST": 1.0}, return_ids=[])
+    d.update(kw)
+    return TaskSpec(**d)
+
+
+def _queued_copies(rt, task_id):
+    with rt.lock:
+        return [s for q in rt.task_queues.values() for s in q
+                if s.task_id == task_id]
+
+
+def _drop_queued(rt, task_id):
+    with rt.lock:
+        for q in rt.task_queues.values():
+            for s in list(q):
+                if s.task_id == task_id:
+                    q.remove(s)
+
+
+def test_spill_to_dead_peer_requeues_exactly_once():
+    """The spill-to-a-dead-peer race: the head's lease_spilled handler
+    requeues when the destination is not ALIVE, and the origin agent's
+    failed dial independently sends a lease_return for the same specs.
+    Whichever frame lands second must be a no-op — acting on both put
+    TWO copies of the task in the queue (duplicate execution) and
+    double-released the reservation token."""
+    import pickle
+
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    c.add_node(num_cpus=2)
+    c.wait_for_nodes(2)
+    try:
+        from ray_tpu.core.runtime import get_runtime
+        rt = get_runtime()
+        node = next(n for n in rt.nodes.values() if n.conn is not None)
+        spec = _synthetic_leased_spec(lease_seq=1)
+        node.leases[spec.task_id] = spec
+        agent_copy = pickle.loads(pickle.dumps(spec))
+        agent_copy.spill_hops = 1
+        # Head processes the origin's notice first: dest is unknown/dead
+        # -> requeue (node-death policy: the task MAY have started).
+        rt._on_lease_spilled(node.node_id,
+                             [(spec.task_id, 1, 1, b"\xde\xad")])
+        assert len(_queued_copies(rt, spec.task_id)) == 1
+        # The origin's lease_return fallback lands second: no-op.
+        rt._on_lease_return(node.node_id, [agent_copy])
+        assert len(_queued_copies(rt, spec.task_id)) == 1
+        # Reversed arrival order on a fresh lease: return wins, the
+        # (now stale) dead-dest notice no-ops.
+        spec2 = _synthetic_leased_spec(lease_seq=1)
+        node.leases[spec2.task_id] = spec2
+        copy2 = pickle.loads(pickle.dumps(spec2))
+        copy2.spill_hops = 1
+        rt._on_lease_return(node.node_id, [copy2])
+        assert len(_queued_copies(rt, spec2.task_id)) == 1
+        rt._on_lease_spilled(node.node_id,
+                             [(spec2.task_id, 1, 1, b"\xde\xad")])
+        assert len(_queued_copies(rt, spec2.task_id)) == 1
+        _drop_queued(rt, spec.task_id)
+        _drop_queued(rt, spec2.task_id)
+    finally:
+        c.shutdown()
+
+
+def test_stale_spill_and_return_notices_are_ignored():
+    """Lease-generation guards: a lease_spilled notice or a lease_return
+    naming a PREVIOUS grant (the lease was returned and re-granted while
+    the frame was in flight) must neither re-point nor re-enqueue the
+    CURRENT grant, and within one grant an out-of-order multi-hop notice
+    (a lower hop arriving after a later one) must not re-point either."""
+    import pickle
+
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    c.add_node(num_cpus=2)
+    c.wait_for_nodes(2)
+    try:
+        from ray_tpu.core.runtime import get_runtime
+        rt = get_runtime()
+        node = next(n for n in rt.nodes.values() if n.conn is not None)
+        spec = _synthetic_leased_spec(lease_seq=2)  # current = grant #2
+        node.leases[spec.task_id] = spec
+        spills_before = rt.lease_spills_total
+        # Stale spill notice from grant #1 pointing at an ALIVE dest
+        # (the head node): the seq guard, not dest-death, must hold it.
+        rt._on_lease_spilled(node.node_id,
+                             [(spec.task_id, 1, 1, rt.head_node_id)])
+        assert node.leases.get(spec.task_id) is spec
+        assert rt.lease_spills_total == spills_before
+        # Stale return from grant #1: no duplicate enqueue.
+        stale = pickle.loads(pickle.dumps(spec))
+        stale.lease_seq = 1
+        stale.spill_hops = 1
+        rt._on_lease_return(node.node_id, [stale])
+        assert node.leases.get(spec.task_id) is spec
+        assert not _queued_copies(rt, spec.task_id)
+        # Same grant, reversed multi-hop arrival: hop 2 already applied,
+        # the late hop-1 notice cannot re-point the lease.
+        spec.spill_hops = 2
+        rt._on_lease_spilled(node.node_id,
+                             [(spec.task_id, 2, 1, rt.head_node_id)])
+        assert node.leases.get(spec.task_id) is spec
+        node.leases.pop(spec.task_id, None)
+    finally:
+        c.shutdown()
+
+
 def test_many_fresh_fns_never_race_registration():
     """Regression: two _pump_leases threads could send a bare exec for an
     fn_id ahead of the reg_fn that carried its registration (the exec
