@@ -140,6 +140,7 @@ def final_line(status: str = "complete"):
         "wall_s": round(time.monotonic() - _T0, 1),
         "host": EXTRAS.get("host", {}),
         "many_nodes_scaling": EXTRAS.get("many_nodes_scaling", {}),
+        "adag_pipeline": EXTRAS.get("adag_pipeline", {}),
         "tpu_mfu_pct": mfu,
         "tpu": TPU,
         "detail": {k: round(v, 1) for k, v in RESULTS.items()},
@@ -166,6 +167,7 @@ def final_line(status: str = "complete"):
         "n_metrics": len(ratios),
         "n_missing": len(missing),
         "n_skipped": len(SKIPPED),
+        "adag_x": EXTRAS.get("adag_pipeline", {}).get("tensor_speedup_x"),
         "tpu_mfu_pct": mfu,
         "host": {k: EXTRAS.get("host", {}).get(k)
                  for k in ("cpu_count", "memcpy_gbps")},
@@ -548,6 +550,49 @@ def main():
 
         emit("single_client_wait_1k_refs", timeit(wait_1k_refs, 10))
 
+    def sec_adag():
+        # Compiled-graph channel plane: a 3-stage pipeline moving a 64MB
+        # activation per execute (4 hops: driver->s1->s2->s3->driver),
+        # pickle channels vs the zero-copy tensor channels. Per-hop µs
+        # lands in the BENCH_OUT sidecar (acceptance: tensor plane >=5x
+        # cheaper per hop); the headline only carries the speedup.
+        from ray_tpu.dag import InputNode
+
+        @ray_tpu.remote(num_cpus=0)
+        class PipeStage:
+            def step(self, x):
+                return x
+
+        act = np.zeros(16 << 20, dtype=np.float32)  # 64 MB
+        hops = 4
+        per_hop_us = {}
+        for ctype in ("pickle", "tensor"):
+            stages = [PipeStage.remote() for _ in range(3)]
+            with InputNode() as inp:
+                dag = inp
+                for s in stages:
+                    dag = s.step.bind(dag)
+            compiled = dag.experimental_compile(
+                buffer_size_bytes=96 << 20, channel_type=ctype)
+            try:
+                compiled.execute(act).get(timeout=120)  # warm
+                n = 8
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    compiled.execute(act).get(timeout=120)
+                dt = time.perf_counter() - t0
+            finally:
+                compiled.teardown()
+            per_hop_us[ctype] = dt / (n * hops) * 1e6
+            emit(f"adag_pipeline_{ctype}_per_hop_us", per_hop_us[ctype])
+        EXTRAS["adag_pipeline"] = {
+            "activation_mb": act.nbytes >> 20, "stages": 3,
+            "hops_per_execute": hops,
+            "pickle_per_hop_us": round(per_hop_us["pickle"], 1),
+            "tensor_per_hop_us": round(per_hop_us["tensor"], 1),
+            "tensor_speedup_x": round(
+                per_hop_us["pickle"] / per_hop_us["tensor"], 2)}
+
     def sec_pg():
         # Comparability fix (r5 verdict: the single-node PG churn skipped
         # the whole reservation plane and inflated the vs-Ray geomean
@@ -650,6 +695,7 @@ def main():
         ("tasks", 120, sec_tasks),
         ("actors", 150, sec_actors),
         ("objects", 120, sec_objects),
+        ("adag", 90, sec_adag),
         ("pg", 90, sec_pg),
         ("client", 90, sec_client),
         ("many_agents", 180, sec_many_agents),

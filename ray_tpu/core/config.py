@@ -34,6 +34,22 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
                              "floor"),
     "object_spill_dir": (str, "", "directory for spilled objects; '' = <session>/spill"),
     "object_spill_threshold": (float, 0.8, "spill when arena usage exceeds this"),
+    "objxfer_conn_cache_size": (int, 4, "idle persistent pull connections "
+                                "cached per peer address (the objxfer "
+                                "client reuses one connection per pull "
+                                "instead of dialing); 0 = close after "
+                                "every pull"),
+    # --- compiled-graph channels (parity: the NCCL-channel data plane
+    #     under the reference's compiled graphs) ---
+    "dag_channel_type": (str, "tensor", "compiled-graph channel encoding: "
+                         "'tensor' stages array leaves straight into shm "
+                         "(no pickle on tensor bytes; zero-copy reads), "
+                         "'pickle' is the legacy whole-value frame"),
+    "tensor_channel_inline_bytes": (int, 4096, "array leaves smaller than "
+                                    "this ride the tensor frame's sidecar "
+                                    "pickle instead of the binary leaf "
+                                    "plane (descriptor overhead isn't "
+                                    "worth it below ~a page)"),
     # --- workers / scheduling ---
     "worker_jax_platform": (str, "cpu", "jax backend for pooled workers; "
                             "tasks with num_tpus>0 re-latch onto the host "

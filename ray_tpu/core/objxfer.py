@@ -9,9 +9,18 @@ answers pulls straight out of the shm arena in C++ threads (no GIL on the
 send path); `start_peer_server` falls back to a Python thread server
 speaking the identical binary protocol if the native build is unavailable.
 The pulling side receives straight into the destination arena buffer
-(`recv_into` on the created object) — no intermediate blob copy. Clients
-open one connection per pull (the server loop also supports reuse, should
-a cached-connection pull manager want it later).
+(`recv_into` on the created object) — no intermediate blob copy.
+
+Clients keep ONE cached persistent connection per (this process, peer
+addr) — the server loop always supported reuse; the pull path now uses it
+(parity: object_manager.h:119 persistent push/pull channels). A pull
+checks a connection out of the cache (exclusive while in use), returns it
+on clean completion, and closes-and-drops it on any error/EOF so a dead
+peer cannot poison later pulls. Cache size per addr is
+`objxfer_conn_cache_size` (0 restores connect-per-pull). Large bodies
+land via a chunked `recv_into` loop over buffers sized by
+_RECV_CHUNK with enlarged kernel socket buffers, so a 64MB activation
+streams at line rate instead of paying connect + slow-start per hop.
 
 Wire protocol (little endian):
   request:  16-byte object id
@@ -27,6 +36,11 @@ import threading
 from ray_tpu.core.ids import ObjectID
 
 _SIZES = struct.Struct("<QQ")
+
+# recv_into slice bound: large enough to amortize syscalls, small enough
+# that the kernel keeps draining the window while we copy (pipelining).
+_RECV_CHUNK = 1 << 20
+_SOCK_BUF = 4 << 20
 
 
 # ---------------- server ----------------
@@ -163,6 +177,80 @@ def _serve_conn(store, conn: socket.socket):
 # ---------------- client ----------------
 
 
+class _ConnCache:
+    """Idle persistent connections to peers, keyed by (host, port).
+
+    `checkout` pops an idle connection (or dials a fresh one); the caller
+    has exclusive use until it either `checkin`s it (clean completion) or
+    closes it (any error/EOF — never return a connection in an unknown
+    protocol state). At most `cap` idle connections are retained per
+    addr; extras are closed on checkin."""
+
+    def __init__(self):
+        self._idle: dict[tuple, list] = {}
+        self._lock = threading.Lock()
+
+    def _cap(self) -> int:
+        try:
+            from ray_tpu.core.config import get_config
+            return get_config().objxfer_conn_cache_size
+        except Exception:  # noqa: BLE001 — config not importable
+            return 4
+
+    def checkout(self, addr, timeout: float):
+        key = tuple(addr)
+        with self._lock:
+            pool = self._idle.get(key)
+            if pool:
+                s = pool.pop()
+                s.settimeout(timeout)
+                return s, True
+        s = socket.create_connection(key, timeout=timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        for opt in (socket.SO_RCVBUF, socket.SO_SNDBUF):
+            try:
+                s.setsockopt(socket.SOL_SOCKET, opt, _SOCK_BUF)
+            except OSError:
+                pass
+        return s, False
+
+    def checkin(self, addr, s):
+        cap = self._cap()
+        key = tuple(addr)
+        with self._lock:
+            pool = self._idle.setdefault(key, [])
+            if len(pool) < cap:
+                pool.append(s)
+                return
+        try:
+            s.close()
+        except OSError:
+            pass
+
+    def drop_addr(self, addr):
+        """Close every idle connection to a peer (node death)."""
+        with self._lock:
+            pool = self._idle.pop(tuple(addr), [])
+        for s in pool:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def clear(self):
+        with self._lock:
+            pools, self._idle = list(self._idle.values()), {}
+        for pool in pools:
+            for s in pool:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+
+_conn_cache = _ConnCache()
+
+
 def _recv_exact(sock: socket.socket, n: int):
     chunks = []
     while n:
@@ -178,10 +266,14 @@ def _recv_exact(sock: socket.socket, n: int):
 
 
 def _recv_into_exact(sock: socket.socket, view) -> bool:
+    """Chunked drain straight into the destination buffer: bounded
+    recv_into slices keep the kernel refilling the (enlarged) receive
+    window while the previous chunk copies out — the pipelined half of
+    the large-transfer path."""
     off, n = 0, len(view)
     while off < n:
         try:
-            r = sock.recv_into(view[off:], n - off)
+            r = sock.recv_into(view[off:], min(n - off, _RECV_CHUNK))
         except OSError:
             return False
         if r == 0:
@@ -214,10 +306,73 @@ def _create_for_write(store, oid: bytes, size: int, meta: bytes):
         raise
 
 
+def _pull_once(store, s, oid: bytes, unsealed_wait_s: float,
+               absent_wait_s: float):
+    """One pull on an already-connected socket. Returns (found, clean):
+    `clean` means the stream sits at a message boundary and the
+    connection may be cached for reuse."""
+    import time
+    start = time.monotonic()
+    unsealed_deadline = start + unsealed_wait_s
+    absent_deadline = start + absent_wait_s
+    delay = 0.001
+    while True:
+        s.sendall(oid)
+        ok = _recv_exact(s, 1)
+        now = time.monotonic()
+        if ok == b"\x02" and now < unsealed_deadline:
+            time.sleep(0.05)
+            continue
+        if ok == b"\x00" and now < absent_deadline:
+            time.sleep(delay)
+            delay = min(delay * 2, 0.025)
+            continue
+        break
+    if ok in (b"\x00", b"\x02"):
+        return False, True  # answered, just not available
+    if ok != b"\x01":
+        return False, False  # EOF / protocol error
+    sizes = _recv_exact(s, _SIZES.size)
+    if sizes is None:
+        return False, False
+    data_size, meta_size = _SIZES.unpack(sizes)
+    meta = b""
+    if meta_size:
+        meta = _recv_exact(s, meta_size)
+        if meta is None:
+            return False, False
+    buf = _create_for_write(store, oid, data_size, meta)
+    if buf is None:
+        # A concurrent pull won the race; still drain OUR copy off the
+        # stream so the connection stays at a message boundary.
+        left = data_size
+        while left:
+            got = _recv_exact(s, min(left, 1 << 20))
+            if got is None:
+                return True, False
+            left -= len(got)
+        return True, True
+    try:
+        if not _recv_into_exact(s, buf.data):
+            buf.abort()
+            return False, False
+        buf.seal()
+    except BaseException:
+        buf.abort()
+        raise
+    return True, True
+
+
 def fetch_from_peer(store, addr, oid: bytes, timeout: float = 60.0,
                     unsealed_wait_s: float = 5.0,
                     absent_wait_s: float = 0.0) -> bool:
     """Pull one object from a peer's port into `store`. Returns success.
+
+    Connections come from the per-addr cache (one dial per peer, not per
+    pull); a pull that ends off a message boundary closes its connection
+    instead of returning it. A CACHED connection that fails before any
+    byte of this pull arrived is retried once on a fresh dial — the peer
+    may simply have restarted since the connection was cached.
 
     A created-but-unsealed object at the source (reply 2) is retried on the
     same connection for up to `unsealed_wait_s` — a concurrent writer there
@@ -225,50 +380,33 @@ def fetch_from_peer(store, addr, oid: bytes, timeout: float = 60.0,
     (reply 0) on the SAME connection — the p2p collectives wait for a peer
     that has not published yet, and a reconnect per poll would churn
     thousands of throwaway TCP connections per op."""
-    import time
     if store.contains(ObjectID(oid)):
         return True
-    with socket.create_connection(tuple(addr), timeout=timeout) as s:
-        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        start = time.monotonic()
-        unsealed_deadline = start + unsealed_wait_s
-        absent_deadline = start + absent_wait_s
-        delay = 0.001
-        while True:
-            s.sendall(oid)
-            ok = _recv_exact(s, 1)
-            now = time.monotonic()
-            if ok == b"\x02" and now < unsealed_deadline:
-                time.sleep(0.05)
-                continue
-            if ok == b"\x00" and now < absent_deadline:
-                time.sleep(delay)
-                delay = min(delay * 2, 0.025)
-                continue
-            break
-        if ok != b"\x01":
-            return False
-        sizes = _recv_exact(s, _SIZES.size)
-        if sizes is None:
-            return False
-        data_size, meta_size = _SIZES.unpack(sizes)
-        meta = b""
-        if meta_size:
-            meta = _recv_exact(s, meta_size)
-            if meta is None:
-                return False
-        buf = _create_for_write(store, oid, data_size, meta)
-        if buf is None:
-            return True  # a concurrent pull won the race
+    for attempt in range(2):
         try:
-            if not _recv_into_exact(s, buf.data):
-                buf.abort()
-                return False
-            buf.seal()
-        except BaseException:
-            buf.abort()
-            raise
-    return True
+            s, reused = _conn_cache.checkout(addr, timeout)
+        except OSError:
+            return False
+        clean = False
+        try:
+            found, clean = _pull_once(store, s, oid, unsealed_wait_s,
+                                      absent_wait_s)
+        except OSError:
+            found = False
+        finally:
+            if clean:
+                _conn_cache.checkin(addr, s)
+            else:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        if found or clean:
+            return found
+        if not reused:
+            return False
+        # dirty failure on a cached conn: retry once on a fresh dial
+    return False
 
 
 # ---------------- blob helpers (spill restore, tests) ----------------

@@ -17,16 +17,42 @@ from __future__ import annotations
 
 import threading
 
-from ray_tpu.experimental.channel import Channel, ChannelClosedError
+from ray_tpu.experimental.channel import (
+    Channel,
+    ChannelClosedError,
+    TensorChannel,
+)
 
 __all__ = ["InputNode", "MultiOutputNode", "CompiledDAG",
            "ChannelClosedError"]
 
 
+def _chan_cls(channel_type: str):
+    if channel_type == "tensor":
+        return TensorChannel
+    if channel_type == "pickle":
+        return Channel
+    raise ValueError(f"unknown channel_type {channel_type!r} "
+                     "(expected 'tensor' or 'pickle')")
+
+
+def _default_channel_type() -> str:
+    try:
+        from ray_tpu.core.config import get_config
+        return get_config().dag_channel_type
+    except Exception:  # noqa: BLE001 — config not importable (bare tests)
+        return "tensor"
+
+
 class DAGNode:
-    def experimental_compile(self, buffer_size_bytes: int = 1 << 20
+    def experimental_compile(self, buffer_size_bytes: int = 1 << 20,
+                             channel_type: str | None = None
                              ) -> "CompiledDAG":
-        return CompiledDAG(self, buffer_size_bytes)
+        """channel_type: 'tensor' (default; array leaves cross each hop
+        as one memcpy, no pickle) or 'pickle' (the legacy whole-value
+        pickle frames)."""
+        return CompiledDAG(self, buffer_size_bytes,
+                           channel_type or _default_channel_type())
 
     def _deps(self):
         return [a for a in getattr(self, "args", ())
@@ -59,14 +85,23 @@ class MultiOutputNode(DAGNode):
         self.args = list(outputs)
 
 
-def _exec_loop(instance, schedule, in_specs, out_path):
+def _exec_loop(instance, schedule, in_specs, out_path,
+               channel_type: str = "pickle"):
     """Runs INSIDE the actor (via __run_with_instance__): read inputs,
     apply methods, write outputs, forever — until the input channels close.
     schedule: [(method_name, [arg_src...], out_idx)] in topo order; arg_src
     is ("chan", i) or ("const", value) or ("local", j) for a value produced
-    earlier in this actor's own schedule. in_specs: [(path, reader_idx)]."""
-    ins = [Channel(p, reader_idx=ri) for p, ri in in_specs]
-    out = Channel(out_path)
+    earlier in this actor's own schedule. in_specs: [(path, reader_idx)].
+
+    Tensor channels hand numpy leaves to the stage as READ-ONLY views
+    aliasing the input channel; the ack (which lets the upstream writer
+    overwrite) is released only AFTER the stage's output is written —
+    writing forces the computation, so the input bytes are consumed by
+    then. Stage methods must not retain input views across calls."""
+    cls = _chan_cls(channel_type)
+    ins = [cls(p, reader_idx=ri) for p, ri in in_specs]
+    out = cls(out_path)
+    tensor = channel_type == "tensor"
     try:
         while True:
             try:
@@ -86,6 +121,10 @@ def _exec_loop(instance, schedule, in_specs, out_path):
                         args.append(i)
                 local_vals[out_idx] = getattr(instance, method_name)(*args)
             out.write(local_vals[schedule[-1][2]])
+            if tensor:
+                del chan_vals, args, local_vals  # drop borrowed views
+                for ch in ins:
+                    ch.release()
     finally:
         for ch in ins:
             ch.close()
@@ -105,8 +144,11 @@ class CompiledDAGRef:
 
 
 class CompiledDAG:
-    def __init__(self, output_node: DAGNode, buffer_size_bytes: int):
+    def __init__(self, output_node: DAGNode, buffer_size_bytes: int,
+                 channel_type: str = "tensor"):
         self._buffer = buffer_size_bytes
+        self._channel_type = channel_type
+        self._cls = _chan_cls(channel_type)
         self._lock = threading.Lock()
         self._seq = 0
         self._read_seq = 0
@@ -159,11 +201,11 @@ class CompiledDAG:
                     input_actors.add(aid)
                 elif node_actor.get(id(d)) != aid:
                     consumers.setdefault(id(d), set()).add(aid)
-        self._input_chan = Channel(create=True, capacity=self._buffer,
-                                   n_readers=max(1, len(input_actors)))
+        self._input_chan = self._cls(create=True, capacity=self._buffer,
+                                     n_readers=max(1, len(input_actors)))
         chans: dict[int, Channel] = {
-            nid: Channel(create=True, capacity=self._buffer,
-                         n_readers=len(aids))
+            nid: self._cls(create=True, capacity=self._buffer,
+                           n_readers=len(aids))
             for nid, aids in consumers.items()}
         next_reader: dict[str, int] = {}  # channel path -> next reader idx
         # Reserve the driver's cursor (reader_idx 0) on the output channel.
@@ -226,7 +268,8 @@ class CompiledDAG:
         for aid, plan in actor_plans.items():
             m = ActorMethod(plan["handle"], "__run_with_instance__")
             ref = m._remote((_exec_loop, plan["schedule"],
-                             plan["in_specs"], plan["out_path"]), {})
+                             plan["in_specs"], plan["out_path"],
+                             self._channel_type), {})
             self._loops.append(ref)
         self._chans = list(chans.values())
         # The driver drains the output channel eagerly so backpressure
@@ -245,9 +288,13 @@ class CompiledDAG:
             return CompiledDAGRef(self, self._seq)
 
     def _drain_loop(self):
+        tensor = self._channel_type == "tensor"
         while True:
             try:
-                val = self._out_chan.read(timeout=None)
+                # copy=True: the user may hold the result indefinitely, so
+                # numpy leaves must not borrow the channel region.
+                val = (self._out_chan.read(timeout=None, copy=True)
+                       if tensor else self._out_chan.read(timeout=None))
             except (ChannelClosedError, OSError, ValueError):
                 return
             with self._cv:

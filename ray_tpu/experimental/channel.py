@@ -13,6 +13,27 @@ WriteAcquire waiting on ReadRelease). Same-node only (the region is a
 Layout: [u64 version][u64 payload_len][u64 n_readers][u64 ack x 8][payload]
 Each ack slot is written by exactly one reader (its last-read version), so
 there are no cross-process read-modify-write races.
+
+Two payload encodings share the seqlock:
+
+- `Channel` — pickle the whole value (control values, small objects).
+- `TensorChannel` — the zero-copy tensor plane (parity: the role NCCL
+  channels play under the reference's compiled graphs,
+  `torch_tensor_nccl_channel.py` / `nccl_group.py:22`, rebuilt for host
+  shm + TPU): array leaves of the value are staged STRAIGHT into the shm
+  region (one memcpy, multi-threaded native memcpy for large leaves)
+  under a fixed binary descriptor (dtype/shape/sharding spec) — tensor
+  bytes never pass through pickle; only the pytree skeleton rides a
+  sidecar pickle frame. Readers rebuild jax leaves with `jax.device_put`
+  (the one host->device copy) and hand numpy leaves out as read-only
+  views that alias the channel (ack deferred until `release()` — the
+  reference's ReadAcquire/ReadRelease). A same-process registry lets
+  co-located writer/reader pairs hand over the live `jax.Array`
+  reference with no host round-trip at all, guarded by a copy-on-write
+  epoch in the frame header. For cross-NODE hops the same frame seals
+  into the shm arena as a plain object (`put_tensor_object`) and the
+  remote side pulls it over `objxfer` then `device_put`s
+  (`get_tensor_object`).
 """
 
 from __future__ import annotations
@@ -21,6 +42,8 @@ import mmap
 import os
 import pickle
 import struct
+import sys
+import threading
 import time
 import uuid
 
@@ -71,13 +94,13 @@ class Channel:
 
     # -- writer side --
 
-    def write(self, value, timeout: float | None = 60.0):
-        self.write_bytes(pickle.dumps(value, protocol=5), timeout)
-
-    def write_bytes(self, payload: bytes, timeout: float | None = 60.0):
-        if len(payload) > self.capacity:
+    def _begin_write(self, length: int, timeout: float | None) -> int:
+        """Win backpressure and mark the seqlock odd (write in progress).
+        Returns the pre-write version; the caller stages the payload into
+        the region after the header and then calls `_commit_write`."""
+        if length > self.capacity:
             raise ValueError(
-                f"value of {len(payload)} bytes exceeds channel capacity "
+                f"value of {length} bytes exceeds channel capacity "
                 f"{self.capacity}")
         deadline = None if timeout is None else time.monotonic() + timeout
         delay = 5e-5
@@ -92,8 +115,18 @@ class Channel:
             time.sleep(delay)
             delay = min(delay * 2, 1e-3)
         struct.pack_into("<Q", self._mm, 0, version + 1)  # odd: writing
+        return version
+
+    def _commit_write(self, version: int, length: int):
+        struct.pack_into("<QQ", self._mm, 0, version + 2, length)
+
+    def write(self, value, timeout: float | None = 60.0):
+        self.write_bytes(pickle.dumps(value, protocol=5), timeout)
+
+    def write_bytes(self, payload: bytes, timeout: float | None = 60.0):
+        version = self._begin_write(len(payload), timeout)
         self._mm[_HDR.size:_HDR.size + len(payload)] = payload
-        struct.pack_into("<QQ", self._mm, 0, version + 2, len(payload))
+        self._commit_write(version, len(payload))
 
     def close_writer(self, timeout: float | None = 10.0):
         """Signal EOF to readers. If a slow reader never acks within the
@@ -117,27 +150,43 @@ class Channel:
 
     # -- reader side --
 
-    def read(self, timeout: float | None = 60.0):
-        """Block until a version newer than this cursor's last read; ack it
-        so the writer may proceed."""
+    def _poll_version(self, timeout: float | None):
+        """Block until a version newer than the cursor is committed;
+        returns (version, length) without acking."""
         deadline = None if timeout is None else time.monotonic() + timeout
         delay = 5e-5
         while True:
             version, length, _n, _acks = self._hdr()
             if version > self._last_version and version % 2 == 0:
-                payload = bytes(self._mm[_HDR.size:_HDR.size + length])
-                v2, = struct.unpack_from("<Q", self._mm, 0)
-                if v2 == version:  # seqlock: no concurrent write observed
-                    self._last_version = version
-                    struct.pack_into("<Q", self._mm,
-                                     24 + 8 * self.reader_idx, version)
-                    if payload == _CLOSE:
-                        raise ChannelClosedError(self.path)
-                    return pickle.loads(payload)
+                return version, length
             if deadline is not None and time.monotonic() > deadline:
                 raise TimeoutError(f"channel read timed out ({self.path})")
             time.sleep(delay)
             delay = min(delay * 2, 1e-3)
+
+    def _ack(self, version: int):
+        self._last_version = version
+        struct.pack_into("<Q", self._mm, 24 + 8 * self.reader_idx, version)
+
+    def _stable(self, version: int) -> bool:
+        v2, = struct.unpack_from("<Q", self._mm, 0)
+        return v2 == version
+
+    def read(self, timeout: float | None = 60.0):
+        """Block until a version newer than this cursor's last read; ack it
+        so the writer may proceed."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            remaining = (None if deadline is None
+                         else deadline - time.monotonic())
+            version, length = self._poll_version(remaining)
+            payload = bytes(self._mm[_HDR.size:_HDR.size + length])
+            if self._stable(version):  # seqlock: no concurrent write seen
+                self._ack(version)
+                if payload == _CLOSE:
+                    raise ChannelClosedError(self.path)
+                return pickle.loads(payload)
+            time.sleep(5e-5)
 
     # -- lifecycle --
 
@@ -156,3 +205,501 @@ class Channel:
     def __reduce__(self):
         return (Channel, (self.path, self.capacity, False, 1,
                           self.reader_idx))
+
+
+# ====================================================================
+# Tensor channel: zero-copy jax/numpy hops for compiled graphs
+# ====================================================================
+
+# Frame layout (inside the seqlock payload region):
+#   [_TC_HDR: magic, flags, epoch, writer_pid, n_leaves, meta_len]
+#   [_TC_LEAF x n_leaves: dtype16, kind, ndim, dims[6], offset, nbytes]
+#   [meta pickle bytes]                    (skeleton; NO tensor bytes)
+#   [leaf payloads, 64-aligned offsets relative to the payload start]
+#
+# flags bit 0 (INPROC): leaf payloads and table are ABSENT — the whole
+# value lives in the writer-process registry; only a reader in the
+# writer's process may consume the frame (it receives the live object
+# reference).
+
+_TC_MAGIC = 0x31435452  # "RTC1"
+_TC_HDR = struct.Struct("<IIQQII")
+_TC_LEAF = struct.Struct("<16sBB6qQQ")
+_TC_INPROC = 1
+_TC_ALIGN = 64
+_TC_MAX_DIMS = 6
+
+_KIND_NP = 0
+_KIND_JAX = 1
+
+# Copies above this go through the native multi-threaded memcpy when the
+# object-store native build is loadable (same thresholds as object_store).
+_FAST_COPY_MIN = 256 << 10
+_MT_COPY_MIN = 32 << 20
+
+
+class _TensorRef:
+    """Sidecar-pickle placeholder for an extracted tensor leaf. `spec` is
+    an optional sharding spec (e.g. a PartitionSpec) the reader may apply
+    when handed a mesh."""
+
+    __slots__ = ("index", "spec")
+
+    def __init__(self, index: int, spec=None):
+        self.index = index
+        self.spec = spec
+
+    def __reduce__(self):
+        return (_TensorRef, (self.index, self.spec))
+
+
+class _InprocRegistry:
+    """Process-local (path -> (version, epoch, value)) table backing the
+    same-process fast path. Only the LATEST committed value is retained
+    per channel, so the registry cannot grow beyond live channels."""
+
+    def __init__(self):
+        self._values: dict[str, tuple[int, int, object]] = {}
+        self._lock = threading.Lock()
+
+    def publish(self, path: str, version: int, epoch: int, value):
+        with self._lock:
+            self._values[path] = (version, epoch, value)
+
+    def lookup(self, path: str, version: int, epoch: int):
+        """Returns (hit, value). The copy-on-write epoch guard: a stale or
+        force-overwritten entry (epoch/version mismatch) is a MISS, never
+        the wrong value."""
+        with self._lock:
+            ent = self._values.get(path)
+        if ent is None or ent[0] != version or ent[1] != epoch:
+            return False, None
+        return True, ent[2]
+
+    def drop(self, path: str):
+        with self._lock:
+            self._values.pop(path, None)
+
+
+_INPROC = _InprocRegistry()
+
+
+def _leaf_kind(v):
+    """_KIND_NP / _KIND_JAX for array leaves the tensor plane carries
+    natively; None for everything else (rides the sidecar pickle)."""
+    import numpy as np
+    if isinstance(v, np.ndarray):
+        return None if v.dtype.hasobject else _KIND_NP
+    jax = sys.modules.get("jax")
+    if jax is not None and isinstance(v, jax.Array):
+        return _KIND_JAX
+    return None
+
+
+def _leaf_spec(v, kind):
+    """Best-effort sharding spec of a jax leaf (PartitionSpec or None) —
+    metadata only; the reader applies it iff it reconstructs onto a
+    mesh."""
+    if kind != _KIND_JAX:
+        return None
+    try:
+        return getattr(v.sharding, "spec", None)
+    except Exception:  # noqa: BLE001 — spec is advisory
+        return None
+
+
+def _host_view(v):
+    """C-contiguous host ndarray of a leaf. For a jax leaf this is THE
+    device->host transfer (exactly once per hop); on the CPU backend it
+    aliases the device buffer (no copy)."""
+    import numpy as np
+    arr = np.asarray(v)
+    if not arr.flags.c_contiguous:
+        arr = np.ascontiguousarray(arr)
+    return arr
+
+
+def _extract(value, leaves, descs, threshold):
+    """Recursively split container skeletons (dict/list/tuple) from array
+    leaves. Array leaves >= threshold bytes and <= 6-D move to the binary
+    plane; everything else stays in the sidecar pickle."""
+    kind = _leaf_kind(value)
+    if kind is not None:
+        host = _host_view(value)
+        if host.nbytes >= threshold and host.ndim <= _TC_MAX_DIMS:
+            leaves.append(host)
+            descs.append((kind, host.dtype.name, host.shape))
+            return _TensorRef(len(leaves) - 1, _leaf_spec(value, kind))
+        return value
+    if isinstance(value, dict):
+        return {k: _extract(v, leaves, descs, threshold)
+                for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        t = [_extract(v, leaves, descs, threshold) for v in value]
+        return t if isinstance(value, list) else tuple(t)
+    return value
+
+
+def _inline_threshold() -> int:
+    try:
+        from ray_tpu.core.config import get_config
+        return get_config().tensor_channel_inline_bytes
+    except Exception:  # noqa: BLE001 — config not importable (bare tests)
+        return 4096
+
+
+class _FramePlan:
+    """One encoded-frame layout: header + leaf table + meta + payloads.
+
+    `inproc=True` plans carry NO leaf table, meta, or payloads — the
+    value is handed over through the process registry, so the host
+    representation is never materialized at all."""
+
+    __slots__ = ("meta", "leaves", "descs", "offsets", "total", "flags")
+
+    def __init__(self, value, threshold: int, inproc: bool):
+        if inproc:
+            self.meta, self.leaves, self.descs, self.offsets = \
+                b"", [], [], []
+            self.flags = _TC_INPROC
+            self.total = _TC_HDR.size
+            return
+        leaves: list = []
+        descs: list = []
+        skeleton = _extract(value, leaves, descs, threshold)
+        self.meta = pickle.dumps(skeleton, protocol=5)
+        self.leaves = leaves
+        self.descs = descs
+        self.flags = 0
+        head = _TC_HDR.size + _TC_LEAF.size * len(leaves) + len(self.meta)
+        off = head + ((-head) % _TC_ALIGN)
+        self.offsets = []
+        for leaf in leaves:
+            self.offsets.append(off)
+            off += leaf.nbytes + ((-leaf.nbytes) % _TC_ALIGN)
+        self.total = off if leaves else head
+
+    def encode_into(self, buf, base: int, epoch: int, copy_fn):
+        """Write the frame into `buf` at byte offset `base`. `buf` must
+        support struct.pack_into (mmap or writable memoryview);
+        `copy_fn(off, arr)` stages one leaf payload at frame-relative
+        offset `off` (the fast-memcpy hook)."""
+        _TC_HDR.pack_into(buf, base, _TC_MAGIC, self.flags, epoch,
+                          os.getpid(), len(self.leaves), len(self.meta))
+        pos = base + _TC_HDR.size
+        for (kind, dtype_name, shape), off, leaf in zip(
+                self.descs, self.offsets, self.leaves):
+            dims = list(shape) + [0] * (_TC_MAX_DIMS - len(shape))
+            _TC_LEAF.pack_into(buf, pos, dtype_name.encode()[:16], kind,
+                               len(shape), *dims, off, leaf.nbytes)
+            pos += _TC_LEAF.size
+        if self.meta:
+            struct.pack_into(f"<{len(self.meta)}s", buf, pos, self.meta)
+        for off, leaf in zip(self.offsets, self.leaves):
+            if leaf.nbytes:
+                copy_fn(off, leaf)
+
+
+def frame_regions(buf, base: int = 0) -> dict:
+    """Parse a tensor frame's layout WITHOUT materializing values — test
+    leverage for the no-pickle plane assertion (the proto_wire
+    `allow_pickle=False` pattern: the tensor plane must be provably
+    pickle-free outside the declared meta region)."""
+    magic, flags, epoch, pid, n_leaves, meta_len = _TC_HDR.unpack_from(
+        buf, base)
+    if magic != _TC_MAGIC:
+        raise ValueError("not a tensor frame")
+    leaves = []
+    pos = base + _TC_HDR.size
+    for _ in range(n_leaves):
+        raw_dtype, kind, ndim, *rest = _TC_LEAF.unpack_from(buf, pos)
+        dims, off, nbytes = rest[:_TC_MAX_DIMS], rest[-2], rest[-1]
+        leaves.append({"dtype": raw_dtype.rstrip(b"\0").decode(),
+                       "kind": kind, "shape": tuple(dims[:ndim]),
+                       "offset": off, "nbytes": nbytes})
+        pos += _TC_LEAF.size
+    return {"flags": flags, "epoch": epoch, "writer_pid": pid,
+            "meta_offset": pos - base, "meta_len": meta_len,
+            "leaves": leaves}
+
+
+def _np_dtype(name: str):
+    import numpy as np
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # noqa: F401 — registers bfloat16 et al.
+        return np.dtype(name)
+
+
+def _decode_frame(buf, base: int, *, copy_np: bool, mesh=None):
+    """Rebuild the value from a tensor frame at `buf[base:]`.
+
+    numpy leaves alias `buf` as read-only views when copy_np=False (the
+    caller owns the release discipline); jax leaves are `jax.device_put`
+    — the single host->device copy of the hop — and BLOCKED until the
+    transfer lands, so the source region may be reused immediately after
+    this returns. Returns (value, borrowed)."""
+    import numpy as np
+    info = frame_regions(buf, base)
+    meta_off = base + info["meta_offset"]
+    skeleton = pickle.loads(bytes(memoryview(buf)[
+        meta_off:meta_off + info["meta_len"]]))
+    arrays = []
+    for leaf in info["leaves"]:
+        view = np.frombuffer(buf, dtype=np.uint8, count=leaf["nbytes"],
+                             offset=base + leaf["offset"])
+        arr = view.view(_np_dtype(leaf["dtype"])).reshape(leaf["shape"])
+        arr.flags.writeable = False
+        arrays.append((leaf["kind"], arr))
+
+    jax_outs: list = []
+
+    def resolve(node):
+        if isinstance(node, _TensorRef):
+            kind, arr = arrays[node.index]
+            if kind == _KIND_JAX:
+                import jax
+                if mesh is not None and node.spec is not None:
+                    from jax.sharding import NamedSharding
+                    out = jax.device_put(
+                        arr, NamedSharding(mesh, node.spec))
+                else:
+                    out = jax.device_put(arr)
+                jax_outs.append(out)
+                return out
+            return arr.copy() if copy_np else arr
+        if isinstance(node, dict):
+            return {k: resolve(v) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            t = [resolve(v) for v in node]
+            return t if isinstance(node, list) else tuple(t)
+        return node
+
+    value = resolve(skeleton)
+    if jax_outs:
+        import jax
+        # device_put is async: the writer may overwrite the source the
+        # moment we ack, so the transfers must have landed first.
+        jax.block_until_ready(jax_outs)
+    borrowed = (not copy_np) and any(k == _KIND_NP for k, _ in arrays)
+    return value, borrowed
+
+
+class TensorChannel(Channel):
+    """Seqlock channel whose payload is the tensor frame above.
+
+    Writer: `write(value)` stages array leaves straight into the shm
+    region (one memcpy; multi-threaded native memcpy for >=32MB leaves)
+    and publishes the live value in the process-local registry for
+    same-process readers.
+
+    Reader: `read()` returns the value. jax leaves arrive as fresh device
+    arrays (safe to hold); numpy leaves arrive as READ-ONLY views aliasing
+    the channel — the ack is deferred until `release()` (or the next
+    read/close), which is when the writer may overwrite. Pass copy=True to
+    materialize numpy leaves and ack immediately.
+
+    `inproc=True` (writer side) skips the host representation entirely:
+    the frame carries only the 32-byte header, and readers MUST be in the
+    writer's process (they receive the live object reference — zero
+    copies, zero host round-trips; do not mutate handed-over numpy leaves
+    in place). The copy-on-write epoch in the header guards the hand-off:
+    a reader never resolves a registry value from a different write than
+    the version its seqlock read committed."""
+
+    def __init__(self, path: str | None = None, capacity: int = 1 << 20,
+                 create: bool = False, n_readers: int = 1,
+                 reader_idx: int = 0, inproc: bool = False):
+        super().__init__(path, capacity, create=create,
+                         n_readers=n_readers, reader_idx=reader_idx)
+        self.inproc = inproc
+        self._epoch = 0
+        self._pending_ack: int | None = None
+        self._native = None  # lazily probed (lib, mm_base_addr) | (None, 0)
+
+    # -- native fast copy --
+
+    def _native_copy(self):
+        if self._native is None:
+            try:
+                import ctypes
+                from ray_tpu.core.object_store import _lib
+                lib = _lib()
+                base = ctypes.addressof(
+                    ctypes.c_char.from_buffer(self._mm))
+                self._native = (lib, base)
+            except Exception:  # noqa: BLE001 — no toolchain: plain copies
+                self._native = (None, 0)
+        return self._native
+
+    def _copy_leaf(self, off: int, leaf):
+        import numpy as np
+        abs_off = _HDR.size + off
+        n = leaf.nbytes
+        lib = None
+        if n >= _FAST_COPY_MIN:
+            lib, base = self._native_copy()
+        if lib is not None:
+            import ctypes
+            threads = (min(8, os.cpu_count() or 1)
+                       if n >= _MT_COPY_MIN else 1)
+            lib.store_memcpy(ctypes.c_void_p(base + abs_off),
+                             ctypes.c_void_p(leaf.ctypes.data), n, threads)
+        else:
+            memoryview(self._mm)[abs_off:abs_off + n] = \
+                leaf.reshape(-1).view(np.uint8)
+
+    # -- writer side --
+
+    def write(self, value, timeout: float | None = 60.0):
+        plan = _FramePlan(value, _inline_threshold(), self.inproc)
+        version = self._begin_write(plan.total, timeout)
+        self._epoch += 1
+        plan.encode_into(self._mm, _HDR.size, self._epoch, self._copy_leaf)
+        # Publish BEFORE commit: once a reader can observe the version,
+        # the registry entry for it already exists.
+        _INPROC.publish(self.path, version + 2, self._epoch, value)
+        self._commit_write(version, plan.total)
+
+    # -- reader side --
+
+    def release(self):
+        """Ack a borrowed read (numpy views handed out by the last
+        `read(copy=False)`); the writer may then overwrite the region.
+        Views obtained from that read MUST NOT be used afterwards."""
+        if self._pending_ack is not None:
+            v, self._pending_ack = self._pending_ack, None
+            self._ack(v)
+
+    def read(self, timeout: float | None = 60.0, *, copy: bool = False,
+             mesh=None):
+        self.release()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            remaining = (None if deadline is None
+                         else deadline - time.monotonic())
+            version, length = self._poll_version(remaining)
+            result = self._try_decode(version, length, copy, mesh)
+            if result is not None:
+                return result[0]
+            time.sleep(5e-5)
+
+    def _try_decode(self, version, length, copy, mesh):
+        """One seqlock-guarded decode attempt; None = torn read, retry."""
+        if length == len(_CLOSE) and \
+                self._mm[_HDR.size:_HDR.size + length] == _CLOSE:
+            if not self._stable(version):
+                return None
+            self._ack(version)
+            raise ChannelClosedError(self.path)
+        try:
+            info = frame_regions(self._mm, _HDR.size)
+        except (ValueError, struct.error):
+            if self._stable(version):
+                raise
+            return None  # torn header mid-overwrite
+        if info["writer_pid"] == os.getpid():
+            # Same-process fast path: hand over the live reference. The
+            # epoch guard rejects a registry slot replaced by a newer
+            # (or forced) write after this version was committed.
+            hit, value = _INPROC.lookup(self.path, version, info["epoch"])
+            if hit:
+                if not self._stable(version):
+                    return None
+                self._ack(version)
+                return (value,)
+        if info["flags"] & _TC_INPROC:
+            if not self._stable(version):
+                return None  # mid-overwrite: stale header, retry
+            raise RuntimeError(
+                f"in-proc tensor channel {self.path} read from pid "
+                f"{os.getpid()} (writer pid {info['writer_pid']}): "
+                "create the channel with inproc=False for cross-process "
+                "readers")
+        try:
+            value, borrowed = _decode_frame(self._mm, _HDR.size,
+                                            copy_np=copy, mesh=mesh)
+        except Exception:  # noqa: BLE001 — garbage from a torn frame
+            if self._stable(version):
+                raise
+            return None
+        if not self._stable(version):
+            return None
+        if borrowed:
+            # numpy views alias the channel: hold the ack until release()
+            # so the writer cannot overwrite under the reader.
+            self._last_version = version
+            self._pending_ack = version
+        else:
+            self._ack(version)
+        return (value,)
+
+    # -- lifecycle --
+
+    def close(self):
+        self.release()
+        if self._epoch:  # this cursor was the writer
+            _INPROC.drop(self.path)
+        super().close()
+
+    def __reduce__(self):
+        return (TensorChannel, (self.path, self.capacity, False, 1,
+                                self.reader_idx, self.inproc))
+
+
+# -------------------- object-plane (cross-node) hops --------------------
+
+
+def put_tensor_object(store, value, object_id=None):
+    """Seal `value` as ONE shm-arena object in tensor-frame encoding and
+    return its ObjectID. The cross-node half of the tensor plane: a remote
+    reader pulls the sealed object over `objxfer.fetch_from_peer` into its
+    own arena and rebuilds with `get_tensor_object` — the activation bytes
+    cross the wire once, with no pickle on either side's tensor leaves."""
+    from ray_tpu.core.ids import ObjectID
+    if object_id is None:
+        object_id = ObjectID.from_random()
+    plan = _FramePlan(value, _inline_threshold(), inproc=False)
+    buf = store.create(object_id, plan.total, meta=b"tensor_frame")
+    try:
+        import ctypes
+
+        def copy_fn(off, leaf):
+            n = leaf.nbytes
+            if n >= _FAST_COPY_MIN:
+                threads = (min(8, os.cpu_count() or 1)
+                           if n >= _MT_COPY_MIN else 1)
+                store._lib.store_memcpy(
+                    ctypes.c_void_p(store._base + buf.offset + off),
+                    ctypes.c_void_p(leaf.ctypes.data), n, threads)
+            else:
+                import numpy as np
+                buf.data[off:off + n] = leaf.reshape(-1).view(np.uint8)
+
+        plan.encode_into(buf.data, 0, 1, copy_fn)
+        buf.seal()
+    except BaseException:
+        buf.abort()
+        raise
+    return object_id
+
+
+def get_tensor_object(store, object_id, timeout: float | None = None,
+                      mesh=None):
+    """Rebuild a `put_tensor_object` value from the local arena. jax
+    leaves are device_put (the one host->device copy); numpy leaves are
+    copied out so the store reference can be released immediately."""
+    res = store.get_raw(object_id, timeout)
+    if res is None:
+        raise KeyError(f"tensor object {object_id} not found")
+    data, _meta = res
+    try:
+        value, _ = _decode_frame(data, 0, copy_np=True, mesh=mesh)
+    finally:
+        try:
+            data.release()
+        except BufferError:
+            pass  # a transient frombuffer view; dies with this frame
+        store.release(object_id)
+    return value

@@ -450,6 +450,101 @@ def _esc_literal(text: str) -> str:
     return "".join("\\" + c if c in _SPECIALS else c for c in text)
 
 
+# ---- exact bounded-integer interval automata ----
+#
+# The old digit-count approximation admitted every value sharing the
+# bound's digit count (maximum=500 admitted 999). These builders emit the
+# EXACT language: canonical decimal integers (no leading zeros, no -0)
+# inside the interval. Both-bounded intervals stay finite (greedy decoding
+# cannot loop on a digit forever); a single bound is inherently infinite
+# on its open side, matching the schema's semantics.
+
+
+def _digit_range(a: int, b: int) -> str:
+    return str(a) if a == b else f"[{a}-{b}]"
+
+
+def _fixed_width_range(lo: str, hi: str) -> str:
+    """Regex for decimal strings of width len(lo)==len(hi) in [lo, hi]
+    (numeric order == lexicographic order at fixed width)."""
+    if lo == hi:
+        return lo
+    a0, b0 = int(lo[0]), int(hi[0])
+    if len(lo) == 1:
+        return _digit_range(a0, b0)
+    rest = len(lo) - 1
+    if a0 == b0:
+        return lo[0] + _fixed_width_range(lo[1:], hi[1:])
+    parts = []
+    if lo[1:] == "0" * rest:
+        lo_first = a0  # lo's subtree is the full block
+    else:
+        parts.append(lo[0] + _fixed_width_range(lo[1:], "9" * rest))
+        lo_first = a0 + 1
+    if hi[1:] == "9" * rest:
+        hi_first = b0  # hi's subtree is the full block
+        hi_part = None
+    else:
+        hi_first = b0 - 1
+        hi_part = hi[0] + _fixed_width_range("0" * rest, hi[1:])
+    if lo_first <= hi_first:
+        parts.append(_digit_range(lo_first, hi_first)
+                     + f"[0-9]{{{rest}}}")
+    if hi_part is not None:
+        parts.append(hi_part)
+    return "(" + "|".join(parts) + ")"
+
+
+def _nonneg_range(lo: int, hi: int) -> str:
+    """Regex for canonical decimals of every value in [lo, hi], 0<=lo<=hi.
+    Split by digit count so leading-zero-free widths compose."""
+    if lo > hi:
+        raise ValueError(f"empty integer interval [{lo}, {hi}]")
+    parts = []
+    for width in range(len(str(lo)), len(str(hi)) + 1):
+        w_lo = max(lo, 0 if width == 1 else 10 ** (width - 1))
+        w_hi = min(hi, 10 ** width - 1)
+        if w_lo > w_hi:
+            continue
+        parts.append(_fixed_width_range(str(w_lo).zfill(width)[-width:],
+                                        str(w_hi)))
+    return parts[0] if len(parts) == 1 else "(" + "|".join(parts) + ")"
+
+
+def _nonneg_at_least(n: int) -> str:
+    """Canonical decimals of every value >= n >= 0 (unbounded above)."""
+    width = len(str(n))
+    longer = f"[1-9][0-9]{{{width},}}"
+    if n == 0:
+        return "(0|[1-9][0-9]*)"
+    same = _fixed_width_range(str(n), "9" * width)
+    return f"({same}|{longer})"
+
+
+def _int_interval_regex(lo: int | None, hi: int | None) -> str:
+    """Exact regex for canonical JSON integers in [lo, hi]; either side
+    may be open (None)."""
+    parts = []
+    # Negative half, emitted as '-' + magnitude (magnitude bounds flip):
+    # magnitudes m satisfy m >= max(1, -hi) and (lo set) m <= -lo.
+    if lo is None or lo <= -1:
+        mag_lo = 1 if (hi is None or hi >= -1) else -hi
+        if lo is None:
+            parts.append("-" + _nonneg_at_least(mag_lo))
+        elif mag_lo <= -lo:
+            parts.append("-" + _nonneg_range(mag_lo, -lo))
+    # Non-negative half.
+    if hi is None or hi >= 0:
+        nn_lo = 0 if lo is None else max(lo, 0)
+        if hi is None:
+            parts.append(_nonneg_at_least(nn_lo))
+        elif nn_lo <= hi:
+            parts.append(_nonneg_range(nn_lo, hi))
+    if not parts:
+        raise ValueError(f"empty integer interval [{lo}, {hi}]")
+    return parts[0] if len(parts) == 1 else "(" + "|".join(parts) + ")"
+
+
 def json_schema_to_regex(schema: dict) -> str:
     """Canonical (whitespace-free) JSON matching the schema subset:
     object/array/string/integer/number/boolean/null/enum/const. Object
@@ -472,19 +567,11 @@ def json_schema_to_regex(schema: dict) -> str:
         return _JSON_STRING
     if t == "integer":
         lo, hi = schema.get("minimum"), schema.get("maximum")
-        sign = "" if (lo is not None and lo >= 0) else "-?"
-        if hi is not None:
-            # Digit-count bound (approximation: values sharing the digit
-            # count of the bound are admitted; exact interval DFAs are
-            # overkill for a generation guide). Crucially this makes the
-            # pattern FINITE, so greedy decoding cannot loop on a digit
-            # forever. A minimum alone must NOT cap digits — the value is
-            # unbounded above.
-            d = max(len(str(abs(int(v)))) for v in (lo, hi)
-                    if v is not None)
-            rep = f"[0-9]{{0,{d - 1}}}" if d > 1 else ""
-            return f"{sign}(0|[1-9]{rep})"
-        return f"{sign}(0|[1-9][0-9]*)"
+        if lo is None and hi is None:
+            return "-?(0|[1-9][0-9]*)"
+        return _int_interval_regex(
+            None if lo is None else int(lo),
+            None if hi is None else int(hi))
     if t == "number":
         return _JSON_NUMBER
     if t == "boolean":

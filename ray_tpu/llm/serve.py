@@ -44,8 +44,16 @@ class _LLMServerImpl:
         mesh = None
         if llm_config.tensor_parallelism > 1:
             from ray_tpu.parallel import MeshConfig, make_mesh
-            mesh = make_mesh(MeshConfig(tp=llm_config.tensor_parallelism,
-                                        fsdp=1))
+            tp = llm_config.tensor_parallelism
+            devices = jax.devices()
+            if len(devices) < tp:
+                raise ValueError(
+                    f"tensor_parallelism={tp} needs {tp} devices, replica "
+                    f"sees {len(devices)}")
+            # The replica's first tp chips; a host with more chips keeps
+            # the rest for other replicas (mesh must not span them).
+            mesh = make_mesh(MeshConfig(tp=tp, fsdp=1),
+                             devices=devices[:tp])
         self.tokenizer = get_tokenizer(llm_config.tokenizer)
         engine_cfg = _wire_eos(llm_config.engine, self.tokenizer)
         self.engine = InferenceEngine(

@@ -50,7 +50,13 @@ def paged_decode_attention(q, k_pages, v_pages, lengths, page_tables, *,
 
     interpret=None auto-selects: the Mosaic lowering needs a real TPU
     backend; everywhere else (CPU tests, multichip dryrun) the kernel
-    runs in interpret mode."""
+    runs in interpret mode. RAY_TPU_PAGED_ATTN_IMPL=xla forces the plain
+    XLA gather-attend formulation — the fallback path the tp>1 virtual-
+    mesh dryrun uses (GSPMD shards it like any einsum; Pallas interpret
+    mode is also ~100x slower than XLA on CPU)."""
+    import os
+    if os.environ.get("RAY_TPU_PAGED_ATTN_IMPL") == "xla":
+        return _paged_decode_xla(q, k_pages, v_pages, lengths, page_tables)
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
     page, hd = k_pages.shape[3], k_pages.shape[2]

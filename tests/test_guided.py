@@ -223,3 +223,54 @@ def test_openai_guided_json_http(ray_start_regular):
         assert isinstance(obj["x"], int) and obj["t"] in ("a", "b")
     finally:
         serve_api.delete("llm-guided")
+
+
+def test_integer_interval_exact_boundaries():
+    """The bounded-integer automaton is EXACT: the old digit-count
+    approximation admitted any value sharing the bound's digit count
+    (maximum=500 accepted 999)."""
+    from ray_tpu.llm.guided import json_schema_to_regex
+
+    dfa = compile_byte_dfa(json_schema_to_regex(
+        {"type": "integer", "maximum": 500}))
+    assert dfa.matches(b"500")
+    assert not dfa.matches(b"501")
+    assert not dfa.matches(b"999")
+    assert dfa.matches(b"0") and dfa.matches(b"499")
+    assert dfa.matches(b"-999")  # no minimum: unbounded below
+
+    dfa = compile_byte_dfa(json_schema_to_regex(
+        {"type": "integer", "minimum": 0, "maximum": 500}))
+    assert not dfa.matches(b"-1") and not dfa.matches(b"501")
+    assert dfa.matches(b"0") and dfa.matches(b"500")
+    assert not dfa.matches(b"007")  # canonical decimals only
+
+    # negative-straddling interval, exhaustive over the decision range
+    dfa = compile_byte_dfa(json_schema_to_regex(
+        {"type": "integer", "minimum": -12, "maximum": 34}))
+    for v in range(-60, 61):
+        assert dfa.matches(str(v).encode()) == (-12 <= v <= 34), v
+
+    # minimum alone is exact too (and still unbounded above)
+    dfa = compile_byte_dfa(json_schema_to_regex(
+        {"type": "integer", "minimum": 7}))
+    assert not dfa.matches(b"6") and dfa.matches(b"7")
+    assert dfa.matches(b"70") and dfa.matches(b"123456789")
+    assert not dfa.matches(b"-7")
+
+
+def test_integer_interval_inside_object_schema():
+    """Bounded integers compose into object schemas (the serve-surface
+    path that hits json_schema_to_regex end to end)."""
+    from ray_tpu.llm.guided import json_schema_to_regex
+
+    rx = json_schema_to_regex({
+        "type": "object",
+        "properties": {"score": {"type": "integer", "minimum": 1,
+                                 "maximum": 10}}})
+    dfa = compile_byte_dfa(rx)
+    assert dfa.matches(b'{"score":10}')
+    assert dfa.matches(b'{"score":1}')
+    assert not dfa.matches(b'{"score":0}')
+    assert not dfa.matches(b'{"score":11}')
+    assert not dfa.matches(b'{"score":99}')

@@ -599,3 +599,32 @@ def test_stream_early_stop_no_leak():
         assert impl.engine.kv_stats()["pages_in_use"] == 0
     finally:
         impl._stop = True
+
+
+def test_serve_tp2_decode_identical_to_tp1(monkeypatch):
+    """The SERVING path's tensor-parallelism wiring (serve.py builds the
+    tp mesh from LLMConfig.tensor_parallelism): greedy decode through the
+    OpenAI surface under tp=2 must be bit-identical to tp=1. Runs the XLA
+    fallback attention formulation — the same path the multichip dryrun
+    gates on (`llm tp=2 ok`)."""
+    import asyncio
+
+    from ray_tpu.llm.serve import _LLMServerImpl
+
+    monkeypatch.setenv("RAY_TPU_PAGED_ATTN_IMPL", "xla")
+
+    def run(tp):
+        cfg = LLMConfig(
+            model_id="tiny", model=TINY,
+            engine=EngineConfig(max_slots=2, max_len=48,
+                                prompt_buckets=(16,), eos_token=-1),
+            tokenizer="byte", tensor_parallelism=tp, seed=0)
+        srv = _LLMServerImpl(cfg)
+        try:
+            out = asyncio.run(srv.completions("hello tp", max_tokens=5,
+                                              temperature=0.0))
+        finally:
+            srv._stop = True
+        return out["choices"][0]["text"]
+
+    assert run(2) == run(1)
