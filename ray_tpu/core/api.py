@@ -176,29 +176,21 @@ def get_node_id() -> str:
 
 
 def timeline(filename: str | None = None):
-    """Task state transitions; with `filename`, export a chrome://tracing
-    JSON (parity: ray.timeline(), _private/state.py:965)."""
+    """Chrome/Perfetto trace of the cluster's task-event pipeline
+    (parity: ray.timeline(), _private/state.py:965): one row per worker
+    (B/E-paired exec phases with deserialize-args / execute /
+    store-outputs sub-spans), per-node lease and spill rows, the head's
+    scheduler row, lease-spill hops as flow arrows, and TensorChannel /
+    objxfer transfer spans. With `filename`, the trace JSON is also
+    written there (load via chrome://tracing or ui.perfetto.dev)."""
     from ray_tpu.core.runtime import Runtime, get_runtime
     rt = get_runtime()
     if not isinstance(rt, Runtime):
         raise RayTpuError("timeline() is head-only")
-    events = rt.timeline()
-    if filename is None:
-        return events
-    import json
-    # Pair RUNNING->FINISHED per task into complete ("X") trace events.
-    running: dict = {}
-    trace = []
-    for ts, task_id, name, state in events:
-        if state == "RUNNING":
-            running[task_id] = ts
-        elif state in ("FINISHED", "RETRY") and task_id in running:
-            t0 = running.pop(task_id)
-            trace.append({
-                "name": name, "cat": "task", "ph": "X",
-                "ts": t0 * 1e6, "dur": (ts - t0) * 1e6,
-                "pid": "ray_tpu", "tid": task_id.hex()[:8],
-            })
-    with open(filename, "w") as f:
-        json.dump(trace, f)
+    rt.sync_task_store()
+    trace = rt.task_store.chrome_trace()
+    if filename is not None:
+        import json
+        with open(filename, "w") as f:
+            json.dump(trace, f)
     return trace

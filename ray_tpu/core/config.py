@@ -58,6 +58,15 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "gc_gen0_threshold": (int, 20000, "python gc gen-0 threshold in head/"
                           "workers; default 700 triggers a collection (and "
                           "jax's gc callback) every ~70 control messages"),
+    "gc_freeze_init": (bool, True, "gc.freeze() the boot-time object "
+                       "universe (jax + imports, ~1M objects) in head/"
+                       "zygote/agent processes: full collections stop "
+                       "re-scanning it (a gen-2 pass over the jax universe "
+                       "ran 100ms+ and showed up as bimodal task-storm "
+                       "rates), and zygote-forked workers keep those pages "
+                       "COW-shared. Cost: cyclic garbage created BEFORE "
+                       "init leaks (refcounted objects still free "
+                       "normally)"),
     "worker_startup_timeout_s": (float, 60.0, "time to wait for a worker to boot"),
     "worker_idle_timeout_s": (float, 300.0, "idle workers above pool size are reaped"),
     "max_pending_lease_requests": (int, 10, "in-flight lease requests per scheduling key"),
@@ -126,7 +135,29 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "event_stats": (bool, False, "record per-handler event-loop stats"),
     "export_events": (bool, False, "append task/actor/node state "
                       "transitions as JSONL under <session>/export_events"),
-    "task_events_buffer_size": (int, 10000, "ring buffer of task state transitions"),
+    "task_events": (bool, True, "task-event pipeline (parity: "
+                    "task_event_buffer.h:225 + gcs_task_manager.h:94): "
+                    "every process stamps timestamped task state "
+                    "transitions (submit, lease grant, spill hops, "
+                    "dispatch, exec sub-spans, output seal, channel/"
+                    "objxfer transfers) into a per-process drop-oldest "
+                    "ring, shipped to the head on frames the agents/"
+                    "workers already send; powers ray_tpu.timeline(), "
+                    "util.state.summary_tasks(), /api/timeline and the "
+                    "per-stage latency histograms at /metrics. Off = "
+                    "near-zero cost (one flag check per site)"),
+    "task_events_buffer_size": (int, 10000, "per-process task-event ring "
+                                "capacity (drop-oldest; drops counted "
+                                "and exported at /metrics)"),
+    "task_events_flush_ms": (int, 200, "emitters flush their ring at "
+                             "most this often, piggybacked on frames "
+                             "they already send (worker reply channel, "
+                             "agent select-round batch/heartbeat)"),
+    "task_events_max_tasks": (int, 10000, "head-side TaskEventStorage "
+                              "bound: merged task attempts retained; "
+                              "eviction prefers settled attempts of the "
+                              "largest job (gcs_task_manager.h:94 "
+                              "parity) and is drop-accounted"),
     "metrics_report_interval_ms": (int, 10000, "metrics flush interval"),
     # --- logging ---
     "log_dir": (str, "", "session log dir; '' = <session>/logs"),

@@ -28,7 +28,7 @@ import time
 import traceback
 import uuid
 
-from ray_tpu.core import objxfer
+from ray_tpu.core import objxfer, task_events
 from ray_tpu.core.config import Config, set_config
 from ray_tpu.core.ids import ObjectID, WorkerID
 from ray_tpu.core.object_store import SharedMemoryStore, default_store_size
@@ -47,6 +47,7 @@ from ray_tpu.core.transport import (FrameBuffer, enable_nodelay, send_many,
 class _AgentWorker:
     def __init__(self, worker_id: WorkerID, sock, proc):
         self.worker_id = worker_id
+        self.hex_id = worker_id.hex()  # stamped on node_done exec spans
         self.sock = sock
         self.send_lock = threading.Lock()
         self.proc = proc
@@ -121,6 +122,16 @@ class NodeAgent:
         set_config(cfg)
         self.config = cfg
         self.node_id = node_id or os.urandom(8)
+        # Task-event ring for THIS agent's emissions (spill hops, local
+        # worker choice); drained onto the select-round head batch and
+        # the heartbeat — frames this agent already sends.
+        task_events.configure(cfg)
+        self._tev = task_events.ring()
+        self._tev_last_flush = 0.0
+        if cfg.gc_freeze_init:
+            import gc
+            gc.freeze()  # same rationale as the head: full collections
+            # must not re-scan the boot-time import universe
         from ray_tpu.core.session import new_session_dir
         self.session_dir = new_session_dir("node")
 
@@ -406,6 +417,11 @@ class NodeAgent:
             try:
                 self._send_head(("heartbeat", self.node_id,
                                  self._load_view()))
+                fr = self._tev_frame(force=True)
+                if fr is not None:
+                    # Cadence floor: surplus ring contents that no worker
+                    # drain flushed this period still reach the head.
+                    self._send_head(fr)
                 self._order_gate.sweep()
                 # Periodic spill probe: backlog that formed while no view
                 # delta arrived (broadcasts only carry CHANGES) still
@@ -520,6 +536,10 @@ class NodeAgent:
                            and self._worker_load.get(wid, 0) < depth):
                         spec = self._lease_q.popleft()
                         self._lease_inflight[spec.task_id] = (wid, spec)
+                        if self._tev.enabled:
+                            task_events.emit_task(
+                                spec, "NODE_DISPATCHED",
+                                data={"worker": wid.hex()})
                         self._worker_load[wid] = (
                             self._worker_load.get(wid, 0) + 1)
                         fns = self._worker_fns.setdefault(wid, set())
@@ -640,6 +660,11 @@ class NodeAgent:
                         hop_capped.append(spec)
                         continue
                     spec.spill_hops = hops + 1
+                    if self._tev.enabled:
+                        task_events.emit_task(
+                            spec, "SPILL_SENT",
+                            data={"to": nid.hex(), "hop": spec.spill_hops,
+                                  "lease_seq": spec.lease_seq})
                     specs.append(spec)
                     take -= 1
                     surplus -= 1
@@ -756,8 +781,18 @@ class NodeAgent:
                 if (len(self._lease_q) >= keep
                         or (spec.fn_id
                             and spec.fn_id not in self._fn_blobs)):
+                    if self._tev.enabled:
+                        task_events.emit_task(
+                            spec, "SPILL_REJECTED",
+                            data={"from": origin_nid.hex(),
+                                  "hop": spec.spill_hops or 0})
                     reject.append(spec)
                 else:
+                    if self._tev.enabled:
+                        task_events.emit_task(
+                            spec, "SPILL_RECEIVED",
+                            data={"from": origin_nid.hex(),
+                                  "hop": spec.spill_hops or 0})
                     self._lease_q.append(spec)
                     accepted = True
         if reject:
@@ -781,7 +816,11 @@ class NodeAgent:
         with self._lease_lock:
             for e in entries:
                 if self._lease_inflight.pop(e[0], None) is not None:
-                    leased.append((e[0], e[2]))
+                    # (task_id, outs[, exec-span record, worker hex]) —
+                    # the piggybacked exec record keeps riding the
+                    # node_done batch toward the head.
+                    leased.append((e[0], e[2]) if len(e) < 4
+                                  else (e[0], e[2], e[3], w.hex_id))
                 else:
                     rest.append(e)
                 load = self._worker_load.get(wid, 0)
@@ -1142,12 +1181,13 @@ class NodeAgent:
         truthful)."""
         entries = ([msg[1:]] if msg[0] == "done"
                    else [e for e in msg[1]])
-        for task_id, _aid, _outs in entries:
+        for e in entries:
+            task_id = e[0]
             route = self._routed.pop(task_id, None)
             if route is None:
                 continue
             conn, origin_wid = route[0], route[1]
-            done_msg = ("done", task_id, _aid, _outs)
+            done_msg = ("done", task_id, e[1], e[2])
             if conn is None:
                 self._deliver_direct_done(origin_wid, done_msg)
             else:
@@ -1247,11 +1287,31 @@ class NodeAgent:
                             ("wmsg", w.worker_id.binary(), msg))
                     self._flush_head_batch(out_frames, lease_dones)
 
+    def _tev_frame(self, force: bool = False):
+        """A ("task_events", batch, dropped) frame when a flush is due,
+        else None. Riding the select-round batch / heartbeat means the
+        pipeline never adds a wakeup or connection of its own."""
+        tev = self._tev
+        if not (tev.enabled and (tev.events or tev.dropped)):
+            return None
+        now = time.monotonic()
+        if (not force and (now - self._tev_last_flush) * 1000.0
+                < self.config.task_events_flush_ms):
+            return None
+        self._tev_last_flush = now
+        batch, dropped = tev.drain()
+        if not batch and not dropped:
+            return None
+        return ("task_events", batch, dropped)
+
     def _flush_head_batch(self, out_frames: list, lease_dones: list):
         """One worker drain's head-bound traffic: a single frame (or one
         coalesced sendmsg batch) plus at most one lease pump."""
         if lease_dones:
             out_frames.append(("node_done", lease_dones))
+        fr = self._tev_frame()
+        if fr is not None:
+            out_frames.append(fr)
         if out_frames:
             try:
                 if len(out_frames) == 1:

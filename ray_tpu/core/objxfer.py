@@ -32,7 +32,9 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time as _time
 
+from ray_tpu.core import task_events as _task_events
 from ray_tpu.core.ids import ObjectID
 
 _SIZES = struct.Struct("<QQ")
@@ -382,10 +384,20 @@ def fetch_from_peer(store, addr, oid: bytes, timeout: float = 60.0,
     thousands of throwaway TCP connections per op."""
     if store.contains(ObjectID(oid)):
         return True
+    tev = _task_events.ring()
+    t0 = _time.time() if tev.enabled else 0.0
+
+    def _span(found: bool):
+        if tev.enabled:
+            tev.emit_span("obj_pull", oid.hex()[:12], t0,
+                          _time.time() - t0, ok=found,
+                          peer=f"{addr[0]}:{addr[1]}")
+
     for attempt in range(2):
         try:
             s, reused = _conn_cache.checkout(addr, timeout)
         except OSError:
+            _span(False)
             return False
         clean = False
         try:
@@ -402,10 +414,13 @@ def fetch_from_peer(store, addr, oid: bytes, timeout: float = 60.0,
                 except OSError:
                     pass
         if found or clean:
+            _span(found)
             return found
         if not reused:
+            _span(False)
             return False
         # dirty failure on a cached conn: retry once on a fresh dial
+    _span(False)
     return False
 
 

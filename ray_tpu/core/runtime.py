@@ -31,7 +31,7 @@ import time
 import traceback
 import uuid
 
-from ray_tpu.core import serialization
+from ray_tpu.core import serialization, task_events
 from ray_tpu.core.config import Config, get_config, set_config
 from ray_tpu.core.ids import ActorID, ObjectID, WorkerID
 from ray_tpu.core.object_store import SharedMemoryStore, default_store_size
@@ -270,6 +270,9 @@ class WorkerHandle:
         # accepts direct actor calls from sibling workers.
         self.peer_path: str | None = None
         self.buffer = FrameBuffer()
+        # Cached {"node","worker"} hex pair for DISPATCHED task events
+        # (built once; per-dispatch hex() measurably hit the storm path).
+        self.tev_data: dict | None = None
 
     @property
     def current_task(self) -> "TaskSpec | None":
@@ -706,12 +709,28 @@ def _kv_key_bytes(k) -> bytes:
     return k.encode() if isinstance(k, str) else k
 
 
+# Shared SUBMITTED data for driver-owned tasks (storage only reads event
+# data dicts, so one constant dict serves every driver submission).
+_DRIVER_JOB = {"job": "driver"}
+
+# Process-global emission ring, bound once (record() runs per task state
+# transition — a ring() call per record showed up in the task storm).
+_TEV_RING = task_events.ring()
+
+
 class TaskEventBuffer:
     """Bounded ring of task state transitions (parity: task_event_buffer.h:225).
 
     `record` sits on the per-call hot path, so it stores the spec's two name
     fields (not the spec itself — that would pin payload/buffer memory in
-    the ring) and defers string formatting to read time (`snapshot`)."""
+    the ring) and defers string formatting to read time (`snapshot`).
+
+    This legacy ring holds the HEAD's scheduling-path view only (it backs
+    `state.list_tasks` and the bypass-evidence tests); the cluster-wide
+    task-event pipeline (core/task_events.py) is fed by the forward in
+    `record` — `pipeline_state`/`data` let a call site give the pipeline a
+    richer transition (LEASE_GRANTED with node + lease_seq, DISPATCHED
+    with the worker) while the legacy ring keeps its coarse state."""
 
     def __init__(self, maxlen: int, export=None):
         self.events = collections.deque(maxlen=maxlen)
@@ -719,14 +738,32 @@ class TaskEventBuffer:
         self._export = export  # ExportEventWriter | None (off the hot path
         # unless the export_events config flag is set)
 
-    def record(self, task_id: bytes, spec, state: str):
+    def record(self, task_id: bytes, spec, state: str,
+               pipeline_state: str | None = None,
+               data: dict | None = None):
+        now = time.time()
         name = spec if isinstance(spec, str) else (spec.name, spec.method_name)
-        self.events.append((time.time(), task_id, name, state))
+        self.events.append((now, task_id, name, state))
         if state == "FINISHED":
             self.finished_total += 1
+        ring = _TEV_RING
+        if ring.enabled and not isinstance(spec, str):
+            # Inlined ring emit (this is a per-transition hot path; the
+            # extra call frames + second clock read measurably moved the
+            # task storm).
+            ev = ring.events
+            if len(ev) >= ring.capacity:
+                ring.dropped += 1
+            ev.append((task_id,
+                       max(0, (spec.max_retries or 0)
+                           - (spec.retries_left or 0)),
+                       pipeline_state or state, now, name, data))
         if self._export is not None:
+            lease_seq = (None if isinstance(spec, str)
+                         else getattr(spec, "lease_seq", None))
             self._export.emit("TASK", task_id=task_id.hex(),
-                              name=self._name(name), state=state)
+                              name=self._name(name), state=state,
+                              lease_seq=lease_seq)
 
     @staticmethod
     def _name(name) -> str:
@@ -790,6 +827,26 @@ class Runtime:
             self.export_events = ExportEventWriter(self.session_dir)
         self.task_events = TaskEventBuffer(cfg.task_events_buffer_size,
                                            export=self.export_events)
+        # Task-event pipeline (parity: task_event_buffer.h:225 emission +
+        # gcs_task_manager.h:94 head storage): the head's own emissions go
+        # through the process ring like every other process; agents and
+        # workers ship theirs on frames they already send, and everything
+        # merges per (task_id, attempt) in task_store.
+        task_events.configure(cfg)
+        self.task_store = task_events.TaskEventStorage(
+            max_tasks=cfg.task_events_max_tasks,
+            export=self.export_events)
+        # Arriving event batches park here and merge on a dedicated
+        # thread — the listener must never pay the ingest (a storm ships
+        # thousands of events/s, and merging them inline measurably
+        # slowed the dispatch loop). Bounded: overflow evicts the oldest
+        # parked batch, counted as source drops, never blocks.
+        self._tev_pending: collections.deque = collections.deque()
+        self._tev_overflow = 0
+        # Worker-process metric registries, merged at scrape time tagged
+        # WorkerId (parity: the per-node metrics agent aggregating worker
+        # metrics, _private/metrics_agent.py:492). wid -> {name: snapshot}.
+        self._worker_metrics: dict[bytes, dict] = {}
 
         self.lock = threading.RLock()
         # --- node table (parity: gcs_node_manager) ---
@@ -858,6 +915,14 @@ class Runtime:
         if cfg.gc_gen0_threshold > 0:
             import gc
             gc.set_threshold(cfg.gc_gen0_threshold)  # gens 1-2 untouched
+        if cfg.gc_freeze_init:
+            # Move the boot-time universe (jax + imports) to the
+            # permanent generation: full collections stop re-scanning
+            # ~1M immortal objects (a gen-2 pass over them ran 100ms+
+            # here and surfaced as bimodal task-storm rates once the
+            # task-event ring raised the allocation rate).
+            import gc
+            gc.freeze()
         self._reservations: dict[bytes, tuple] = {}  # task_id -> token
         # Generic pubsub hub (parity: src/ray/pubsub/publisher.h:300 —
         # channelized publisher with per-key subscriptions). Workers
@@ -890,6 +955,11 @@ class Runtime:
         self._listener = threading.Thread(
             target=self._listen_loop, daemon=True, name="rtpu-listener")
         self._listener.start()
+        if cfg.task_events:
+            # Started here (not at task_store creation): the loop reads
+            # _shutdown, which is only assigned a few blocks above.
+            threading.Thread(target=self._tev_ingest_loop, daemon=True,
+                             name="rtpu-tev-ingest").start()
         # Dedicated scheduler thread (see _schedule): submission bursts
         # coalesce into few passes; dispatch sendalls leave the
         # submitting/listener threads.
@@ -1433,12 +1503,16 @@ class Runtime:
     def _handle_msg(self, w: WorkerHandle, msg):
         op = msg[0]
         if op == "done":
-            self._on_task_done(w, msg[1], msg[2], msg[3])
+            self._on_task_done(w, msg[1], msg[2], msg[3],
+                               msg[4] if len(msg) > 4 else None)
         elif op == "done_batch":
             # Coalesced replies from a pipelined sync actor (worker-side
-            # _flush_replies): one frame, many task completions.
-            for task_id, actor_id, outs in msg[1]:
-                self._on_task_done(w, task_id, actor_id, outs)
+            # _flush_replies): one frame, many task completions. Entries
+            # optionally carry the packed exec-span record as a 4th
+            # element (task-event pipeline piggyback).
+            for entry in msg[1]:
+                self._on_task_done(w, entry[0], entry[1], entry[2],
+                                   entry[3] if len(entry) > 3 else None)
         elif op == "stream_item":
             # One yield from a streaming (generator) task.
             task_id, (rid, status, payload, bufs) = msg[1], msg[2]
@@ -1507,6 +1581,16 @@ class Runtime:
             entry = self._profile_futs.pop(msg[1], None)
             if entry is not None:
                 entry[0].set_result(msg[2])
+        elif op == "task_events":
+            # A worker's ring flush (piggybacked on its reply channel;
+            # agent-node workers' frames ride the agent's select-round
+            # relay batch). msg: (op, events, dropped_delta).
+            self._queue_task_events(msg[1], w.node_id,
+                                    w.worker_id.binary(), msg[2])
+        elif op == "metrics_update":
+            # Dirty-metric registry delta from a worker process: merged
+            # at scrape time into /metrics tagged WorkerId.
+            self._merge_worker_metrics(w.worker_id.binary(), msg[1])
         elif op == "free_put":
             # Owning worker dropped the last local handle of its own put()
             # and the ref never escaped — safe to free cluster-wide, unless
@@ -2094,6 +2178,10 @@ class Runtime:
             self._on_lease_spilled(conn.node_id, msg[1])
         elif op == "lease_return":
             self._on_lease_return(conn.node_id, msg[1])
+        elif op == "task_events":
+            # The agent's OWN ring (spill hops, node-local dispatch),
+            # flushed on its select-round head batch / heartbeats.
+            self._queue_task_events(msg[1], conn.node_id, None, msg[2])
         elif op == "worker_death":
             w = self.workers.get(msg[1])
             if w is not None:
@@ -2873,7 +2961,10 @@ class Runtime:
             # become plain bytes for the pickle journal.
             self._pstore.append("task", spec.task_id,
                                 _journal_safe_spec(spec))
-        self.task_events.record(spec.task_id, spec, "SUBMITTED")
+        self.task_events.record(
+            spec.task_id, spec, "SUBMITTED",
+            data=_DRIVER_JOB if spec.owner is None
+            else {"job": spec.owner.hex()})
         if spec.streaming:
             self._register_stream(spec.task_id)
             with self.lock:
@@ -3888,7 +3979,11 @@ class Runtime:
         per_node: dict = {}
         node_order: list = []
         for node, spec in lease_dispatches:
-            self.task_events.record(spec.task_id, spec, "RUNNING")
+            self.task_events.record(
+                spec.task_id, spec, "RUNNING",
+                pipeline_state="LEASE_GRANTED",
+                data={"node": node.node_id.hex(),
+                      "lease_seq": spec.lease_seq})
             blob = None
             if spec.fn_id and spec.fn_id not in node.lease_fns:
                 blob = self.fn_table.get(spec.fn_id)
@@ -4385,7 +4480,13 @@ class Runtime:
                     continue
                 frames.append(("reg_fn", spec.fn_id, blob))
                 w.registered_fns.add(spec.fn_id)
-            self.task_events.record(spec.task_id, spec, "RUNNING")
+            data = w.tev_data  # cached {"node","worker"} hex dict — a
+            if data is None:   # per-dispatch hex() showed in the storm
+                data = w.tev_data = {"node": (w.node_id or b"").hex(),
+                                     "worker": w.worker_id.hex()}
+            self.task_events.record(
+                spec.task_id, spec, "RUNNING", pipeline_state="DISPATCHED",
+                data=data)
             frames.append(("exec", spec))
         if not frames:
             return None
@@ -4436,10 +4537,16 @@ class Runtime:
         (directory/object puts use their own locks)."""
         nid = conn.node_id
         node = self.nodes.get(nid)
+        nid_hex = nid.hex() if nid else None
         # Object publication first (directory has its own locking);
         # the locked waiter probe below then observes every entry —
-        # same ordering contract as _on_object_ready.
-        for task_id, outs in entries:
+        # same ordering contract as _on_object_ready. Entries:
+        # (task_id, outs[, exec-span record, worker hex]).
+        for entry in entries:
+            task_id, outs = entry[0], entry[1]
+            if len(entry) > 2 and entry[2] is not None:
+                self._emit_exec_spans(task_id, entry[2], nid_hex,
+                                      entry[3] if len(entry) > 3 else None)
             for rid, status, payload, bufs in outs:
                 if status == "inline":
                     self.directory.put(rid, ("raw", payload, bufs, True))
@@ -4450,7 +4557,7 @@ class Runtime:
         ready_items = []
         refill = []
         with self.lock:
-            for task_id, outs in entries:
+            for task_id, outs, *_ in entries:
                 # Global pop: a spilled lease completes on the EXECUTING
                 # node's link, which may not be the node it was leased to
                 # (and the lease_spilled notice may still be in flight).
@@ -4514,8 +4621,28 @@ class Runtime:
         if requeued:
             self._schedule()
 
+    def _emit_exec_spans(self, task_id: bytes, tev, node_hex, worker_hex):
+        """One inlined ring append for a done frame's piggybacked exec
+        record ((attempt, exec_start, args_ready, exec_done, seal) from
+        the executing worker) — the whole worker-side exec story costs
+        the head one tuple here."""
+        ring = _TEV_RING
+        if not ring.enabled or tev is None:
+            return
+        ev = ring.events
+        if len(ev) >= ring.capacity:
+            ring.dropped += 1
+        ev.append((task_id, tev[0], "EXEC_SPANS", tev[4], None,
+                   (tev[1], tev[2], tev[3], worker_hex, node_hex)))
+
     def _on_task_done(self, w: WorkerHandle, task_id: bytes,
-                      actor_id: bytes | None, outs):
+                      actor_id: bytes | None, outs, tev=None):
+        if tev is not None:
+            d = w.tev_data
+            if d is None:
+                d = w.tev_data = {"node": (w.node_id or b"").hex(),
+                                  "worker": w.worker_id.hex()}
+            self._emit_exec_spans(task_id, tev, d["node"], d["worker"])
         for rid, status, payload, bufs in outs:
             # Inline payloads stay pickled until someone reads them — the
             # listener thread must not burn CPU deserializing results that may
@@ -4571,6 +4698,7 @@ class Runtime:
     def _fail_returns(self, spec: TaskSpec, exc: Exception):
         err = exc if isinstance(exc, TaskError) else TaskError(
             exc, str(exc), spec.describe())
+        self.task_events.record(spec.task_id, spec, "FAILED")
         self._unpin_deps(spec)
         if self._persist and spec.actor_id is None and not spec.streaming:
             self._pstore.delete("task", spec.task_id)
@@ -5109,6 +5237,68 @@ class Runtime:
 
     def timeline(self):
         return self.task_events.snapshot()
+
+    def _queue_task_events(self, events, node, worker, dropped):
+        """Park an arriving batch for the ingest thread (listener-thread
+        fast path: one deque append)."""
+        q = self._tev_pending
+        if len(q) >= 512:  # bounded: count the evicted batch as drops
+            try:
+                old = q.popleft()
+                self._tev_overflow += len(old[0]) + old[3]
+            except IndexError:
+                pass
+        q.append((events, node, worker, dropped))
+
+    def _tev_ingest_loop(self):
+        while not self._shutdown:
+            time.sleep(0.25)
+            try:
+                self._drain_tev_pending()
+            except Exception:  # noqa: BLE001 — ingest must outlive glitches
+                traceback.print_exc()
+
+    def _drain_tev_pending(self):
+        q = self._tev_pending
+        while q:
+            try:
+                events, node, worker, dropped = q.popleft()
+            except IndexError:
+                break
+            self.task_store.ingest(events, node=node, worker=worker,
+                                   dropped=dropped)
+        if self._tev_overflow:
+            n, self._tev_overflow = self._tev_overflow, 0
+            self.task_store.ingest([], dropped=n)
+
+    def sync_task_store(self):
+        """Merge everything pending — parked arrival batches plus the
+        head process's OWN emission ring (head emissions are
+        ring-buffered like every other process's, but there is no socket
+        to flush over — queries pull them in)."""
+        self._drain_tev_pending()
+        batch, dropped = task_events.ring().drain(max_events=1 << 20)
+        if batch or dropped:
+            self.task_store.ingest(batch, node=None, dropped=dropped)
+
+    def _merge_worker_metrics(self, wid: bytes, snapshots: list):
+        """Latest registry snapshot per (worker, metric name): deltas only
+        carry metrics that changed, so merge by name."""
+        per = self._worker_metrics.setdefault(wid, {})
+        for snap in snapshots:
+            per[snap["name"]] = snap
+
+    def worker_metric_snapshots(self) -> dict:
+        """wid -> {metric name -> snapshot}, live workers only (a dead
+        worker's counters would freeze into the scrape forever)."""
+        out = {}
+        for wid, per in list(self._worker_metrics.items()):
+            w = self.workers.get(wid)
+            if w is None or w.state == DEAD:
+                self._worker_metrics.pop(wid, None)
+                continue
+            out[wid] = per
+        return out
 
     # ---------------- shutdown ----------------
 

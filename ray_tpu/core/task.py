@@ -59,6 +59,14 @@ class TaskSpec:
                            # that name a PREVIOUS grant of the same task —
                            # acting on one would re-point or re-enqueue a
                            # live lease (duplicate execution / lost replay)
+        "exec_ts",         # worker-local scratch: [exec_start, args_ready,
+                           # exec_done] wall stamps collected during
+                           # execution, packed into ONE task event at
+                           # output seal (core/task_events.py EXEC_SPANS —
+                           # per-point emits churned enough allocations to
+                           # move the task storm). Never meaningful on the
+                           # wire: the executing worker is the last
+                           # process to hold the spec.
     )
 
     def __init__(self, **kw):

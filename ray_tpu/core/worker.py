@@ -27,13 +27,17 @@ import traceback
 
 import cloudpickle
 
-from ray_tpu.core import serialization
+from ray_tpu.core import serialization, task_events
 from ray_tpu.core.config import Config, set_config, get_config
 from ray_tpu.core.ids import ObjectID, WorkerID
 from ray_tpu.core.object_store import SharedMemoryStore
 from ray_tpu.core.status import TaskError
 from ray_tpu.core.task import TaskSpec
 from ray_tpu.core.transport import FrameBuffer, send_msg, socket_from_fd
+
+# Process-global task-event ring (core/task_events.py): emission sites
+# guard on `.enabled` (one attribute check when the pipeline is off).
+_TEV = task_events.ring()
 
 
 class _LRUCache:
@@ -283,6 +287,34 @@ class WorkerRuntime:
         # local ref on each dep until every return of the call resolves.
         self._dep_pins: dict[bytes, list] = {}
         self._dep_pin_lock = threading.Lock()
+        # Task-event / metric flush pacing (task_events_flush_ms): the
+        # ring drains onto the write-combined reply channel, so a flush
+        # rides the same coalesced sendmsg as the done frame it follows.
+        self._tev_last_flush = 0.0
+        self._tev_flush_s = get_config().task_events_flush_ms / 1000.0
+
+    def flush_task_events(self, force: bool = False):
+        """Ship the ring + dirty metric registry to the head (via the
+        agent relay on agent nodes). Rate-limited; piggybacks on the
+        sender-thread batching, so a flush right after a reply rides the
+        same coalesced write as the done frame before it."""
+        pending = _TEV.enabled and (_TEV.events or _TEV.dropped)
+        now = time.monotonic()
+        due = force or (now - self._tev_last_flush) >= self._tev_flush_s
+        if not due:
+            return
+        self._tev_last_flush = now
+        try:
+            if pending:
+                batch, dropped = _TEV.drain()
+                if batch or dropped:
+                    self.send(("task_events", batch, dropped))
+            from ray_tpu.util import metrics as _metrics
+            snap = _metrics.registry_delta()
+            if snap:
+                self.send(("metrics_update", snap))
+        except OSError:
+            pass  # head/agent gone; the worker is on its way out
 
     # -- pubsub (subscriber side; parity: pubsub/subscriber.h:73) --
 
@@ -997,10 +1029,18 @@ def _execute(rt: WorkerRuntime, spec: TaskSpec, fn):
     for oid, (payload, bufs) in spec.inline_deps.items():
         rt.object_cache[oid] = serialization.deserialize(payload, bufs)
     renv_spec = getattr(spec, "runtime_env", None)
+    tev = _TEV.enabled
+    if tev:
+        # Sub-span POINTS are stamped as bare floats and packed into ONE
+        # event at seal time (_reply_result) — per-point emits measurably
+        # moved the task storm via allocation/GC churn alone.
+        spec.exec_ts = [time.time(), 0.0, 0.0]
     try:
         args, kwargs = _spec_args(rt, spec)
         args = [_resolve_arg(rt, a) for a in args]
         kwargs = {k: _resolve_arg(rt, v) for k, v in kwargs.items()}
+        if tev:
+            spec.exec_ts[1] = time.time()  # args deserialized/resolved
         rt.current_task = spec  # describe() formatted lazily on demand
         # Read by util.placement_group.get_current_placement_group(); lives
         # on the runtime object because this module is __main__ in workers.
@@ -1023,6 +1063,8 @@ def _execute(rt: WorkerRuntime, spec: TaskSpec, fn):
     except BaseException as e:  # noqa: BLE001 — errors cross the wire
         return "err", TaskError.from_exception(e, spec.describe())
     finally:
+        if tev and spec.exec_ts is not None:
+            spec.exec_ts[2] = time.time()
         rt.current_scheduling_strategy = getattr(
             rt, "actor_scheduling_strategy", None)
 
@@ -1046,6 +1088,8 @@ def _execute_streaming(rt: WorkerRuntime, spec: TaskSpec, fn):
         return (rid, "shm", None, None)
 
     renv_spec = getattr(spec, "runtime_env", None)
+    if _TEV.enabled:
+        task_events.emit_task(spec, "EXEC_START")
     try:
         for oid, (payload, bufs) in spec.inline_deps.items():
             rt.object_cache[oid] = serialization.deserialize(payload, bufs)
@@ -1078,9 +1122,12 @@ def _execute_streaming(rt: WorkerRuntime, spec: TaskSpec, fn):
         except OSError:
             pass
     finally:
+        if _TEV.enabled:
+            task_events.emit_task(spec, "EXEC_DONE")
         rt.current_scheduling_strategy = getattr(
             rt, "actor_scheduling_strategy", None)
     rt.send(("done", spec.task_id, spec.actor_id, []))
+    rt.flush_task_events()
 
 
 def _reply_cancelled(rt: WorkerRuntime, spec: TaskSpec):
@@ -1118,13 +1165,32 @@ def _reply_result(rt: WorkerRuntime, spec: TaskSpec, status, result,
             else:
                 _put_with_spill(rt, ObjectID(rid), value, nbytes)
                 outs.append((rid, "shm", None, None))
+    tev = None
+    if _TEV.enabled and spec.exec_ts is not None:
+        # Packed exec record: (attempt, exec_start, args_ready,
+        # exec_done, seal). It PIGGYBACKS ON THE DONE FRAME itself (the
+        # ultimate already-sent frame) — the head unpacks it into an
+        # EXEC_SPANS pipeline event, so the reply hot path adds three
+        # clock reads and one tuple, with no extra frames, ring traffic
+        # or flush work (a separate event-ring hop here measurably moved
+        # the 1-CPU task storm).
+        es, ar, ed = spec.exec_ts
+        tev = (max(0, (spec.max_retries or 0)
+                   - (spec.retries_left or 0)), es, ar, ed, time.time())
     route = (rt.direct_routes.pop(spec.task_id, None)
              if rt.direct_routes else None)
     if route is not None:
         # Direct-call reply: straight back on the caller's channel — the
-        # head never saw this task. Big results went into the SHARED
-        # head-node arena; notify the head of the location so borrowers
-        # beyond the caller can still resolve them.
+        # head never saw this task, so its exec record ships through the
+        # event ring instead of a done frame (rare path; flushed on the
+        # piggybacked cadence).
+        if tev is not None:
+            _TEV.emit(spec.task_id, tev[0], "EXEC_SPANS", None,
+                      tev[1:4], ts=tev[4])
+            tev = None
+        # Big results went into the SHARED head-node arena; notify the
+        # head of the location so borrowers beyond the caller can still
+        # resolve them.
         for entry in outs:
             if entry[1] == "shm":
                 rt.send(("put_notify", entry[0]))
@@ -1139,9 +1205,13 @@ def _reply_result(rt: WorkerRuntime, spec: TaskSpec, status, result,
         # head banks the outs in its directory and the caller's wait_obj
         # resolves them, so a reply is never silently lost.
     if batcher is not None:
-        batcher.add(spec.task_id, spec.actor_id, outs)
+        batcher.add(spec.task_id, spec.actor_id, outs, tev)
         return
-    rt.send(("done", spec.task_id, spec.actor_id, outs))
+    rt.send(("done", spec.task_id, spec.actor_id, outs) if tev is None
+            else ("done", spec.task_id, spec.actor_id, outs, tev))
+    # Piggyback: a due task-event/metric flush rides the sender batching
+    # right behind the done frame (one coalesced write, no extra wakeup).
+    rt.flush_task_events()
 
 
 class _ReplyBatcher:
@@ -1164,9 +1234,10 @@ class _ReplyBatcher:
         threading.Thread(target=self._loop, daemon=True,
                          name="rtpu-reply-flush").start()
 
-    def add(self, task_id, actor_id, outs):
+    def add(self, task_id, actor_id, outs, tev=None):
         with self._cv:
-            self._batch.append((task_id, actor_id, outs))
+            self._batch.append((task_id, actor_id, outs) if tev is None
+                               else (task_id, actor_id, outs, tev))
             if (len(self._batch) >= self.max_batch
                     or self.rt.task_queue.empty()):
                 self._urgent = True
@@ -1188,8 +1259,7 @@ class _ReplyBatcher:
 
     def _send(self, batch: list):
         if len(batch) == 1:
-            task_id, actor_id, outs = batch[0]
-            self.rt.send(("done", task_id, actor_id, outs))
+            self.rt.send(("done",) + tuple(batch[0]))
         else:
             self.rt.send(("done_batch", batch))
 
@@ -1215,6 +1285,8 @@ class _ReplyBatcher:
 async def _execute_async(rt, spec, fn):
     for oid, (payload, bufs) in spec.inline_deps.items():
         rt.object_cache[oid] = serialization.deserialize(payload, bufs)
+    if _TEV.enabled:
+        spec.exec_ts = [time.time(), 0.0, 0.0]
     try:
         loop = asyncio.get_running_loop()
         # Off-thread: an offloaded arg pack may need a cross-node fetch.
@@ -1222,12 +1294,17 @@ async def _execute_async(rt, spec, fn):
         args = [await loop.run_in_executor(None, _resolve_arg, rt, a) for a in args]
         kwargs = {k: await loop.run_in_executor(None, _resolve_arg, rt, v)
                   for k, v in kwargs.items()}
+        if _TEV.enabled and spec.exec_ts is not None:
+            spec.exec_ts[1] = time.time()
         result = fn(*args, **kwargs)
         if inspect.iscoroutine(result):
             result = await result
         return "ok", result
     except BaseException as e:  # noqa: BLE001
         return "err", TaskError.from_exception(e, spec.describe())
+    finally:
+        if _TEV.enabled and spec.exec_ts is not None:
+            spec.exec_ts[2] = time.time()
 
 
 def _run_actor_async(rt: WorkerRuntime, max_concurrency: int):
@@ -1348,6 +1425,13 @@ def zygote_main(store_path: str, ctrl_fd: int):
         _honor_platform_env(jax)
     except ImportError:
         pass
+    if Config.from_env().gc_freeze_init:
+        # Freeze the warmed jax universe BEFORE forking: children skip
+        # re-scanning ~1M immortal objects on every full collection, and
+        # the frozen pages stay COW-shared across the whole pool (gc
+        # headers are never dirtied by gen-2 passes).
+        import gc
+        gc.freeze()
 
     # Live children (pid stays a zombie — unrecyclable — until we reap it
     # here, so a "kill" request can never hit a recycled pid).
@@ -1446,10 +1530,15 @@ def _worker_main(store_path: str, worker_id: WorkerID, fd: int):
         _honor_platform_env(_jax)
     except ImportError:
         pass
+    if get_config().gc_freeze_init:
+        import gc
+        gc.freeze()  # covers zygote-less spawns and anything the fork
+        # itself allocated; a second freeze after the zygote's is a no-op
     sock = socket_from_fd(fd)
 
     from ray_tpu.util import tracing as _tracing
     _tracing.maybe_setup_from_env()
+    task_events.configure(get_config())
 
     import queue
     rt = WorkerRuntime(sock, worker_id, store_path)
@@ -1484,6 +1573,23 @@ def _worker_main(store_path: str, worker_id: WorkerID, fd: int):
         # orders their frames) — no pump thread there.
         threading.Thread(target=_gate_maintenance, daemon=True,
                          name="rtpu-gate").start()
+
+    if _TEV.enabled:
+        # Cadence floor for the event/metric flush: the reply-path
+        # piggyback covers busy workers; this covers the tail batch an
+        # idle worker would otherwise hold forever.
+        def _tev_floor():
+            period = max(0.05,
+                         get_config().task_events_flush_ms / 1000.0)
+            while not rt.shutdown.is_set():
+                time.sleep(period)
+                try:
+                    rt.flush_task_events()
+                except Exception:  # noqa: BLE001 — flusher must survive
+                    pass
+
+        threading.Thread(target=_tev_floor, daemon=True,
+                         name="rtpu-tev-flush").start()
 
     actor_cfg = {}
     executor_threads: list[threading.Thread] = []
@@ -1679,6 +1785,7 @@ def _worker_main(store_path: str, worker_id: WorkerID, fd: int):
                           else None)
 
     batcher.flush_now()
+    rt.flush_task_events(force=True)  # last events/metrics out the door
     rt.flush_sends()  # the sender thread must drain before os._exit
     os._exit(0)
 

@@ -10,6 +10,7 @@ from `dashboard_assets/`. Runs as a daemon thread in the head process.
 
 Routes: /api/cluster_status /api/nodes /api/actors /api/tasks /api/objects
         /api/workers /api/placement_groups /api/jobs /api/history
+        /api/timeline /api/task_summary /api/tasks_over_time
         /api/logs /api/profile /metrics /assets/* /
 """
 
@@ -136,6 +137,26 @@ class _Handler(BaseHTTPRequestHandler):
                     self._json(report)
             elif path == "/api/history":
                 self._json(list(_HISTORY))
+            elif path == "/api/timeline":
+                # Chrome/Perfetto trace of the task-event pipeline (the
+                # dashboard face of ray_tpu.timeline()): load the JSON in
+                # chrome://tracing or ui.perfetto.dev.
+                from ray_tpu import timeline as _timeline
+                self._json(_timeline())
+            elif path == "/api/task_summary":
+                self._json(state.summary_tasks())
+            elif path == "/api/tasks_over_time":
+                # Tasks-over-time view: submitted/finished/failed counts
+                # per bucket over the trailing window, straight from the
+                # head's TaskEventStorage.
+                import urllib.parse
+                from ray_tpu.core.runtime import get_runtime
+                q = urllib.parse.parse_qs(self.path.partition("?")[2])
+                rt = get_runtime()
+                rt.sync_task_store()
+                self._json(rt.task_store.rate_buckets(
+                    window_s=float(q.get("window", ["300"])[0]),
+                    bucket_s=float(q.get("bucket", ["5"])[0])))
             elif path == "/api/serve":
                 # Live serve topology: apps -> deployments -> replica
                 # states (parity: dashboard/modules/serve).

@@ -47,6 +47,12 @@ import threading
 import time
 import uuid
 
+from ray_tpu.core import task_events as _task_events
+
+# Process-global emission ring: TensorChannel write / read-acquire spans
+# land in the task-event pipeline (one flag check when it is off).
+_TEV = _task_events.ring()
+
 MAX_READERS = 8
 _HDR = struct.Struct(f"<QQQ{MAX_READERS}Q")
 
@@ -553,6 +559,7 @@ class TensorChannel(Channel):
     # -- writer side --
 
     def write(self, value, timeout: float | None = 60.0):
+        t0 = time.time() if _TEV.enabled else 0.0
         plan = _FramePlan(value, _inline_threshold(), self.inproc)
         version = self._begin_write(plan.total, timeout)
         self._epoch += 1
@@ -561,6 +568,9 @@ class TensorChannel(Channel):
         # the registry entry for it already exists.
         _INPROC.publish(self.path, version + 2, self._epoch, value)
         self._commit_write(version, plan.total)
+        if _TEV.enabled:
+            _TEV.emit_span("chan_write", os.path.basename(self.path), t0,
+                           time.time() - t0, bytes=plan.total)
 
     # -- reader side --
 
@@ -580,8 +590,15 @@ class TensorChannel(Channel):
             remaining = (None if deadline is None
                          else deadline - time.monotonic())
             version, length = self._poll_version(remaining)
+            t0 = time.time() if _TEV.enabled else 0.0
             result = self._try_decode(version, length, copy, mesh)
             if result is not None:
+                if _TEV.enabled:
+                    # Read-ACQUIRE cost only (decode + device_put of the
+                    # committed frame), not the wait for the writer.
+                    _TEV.emit_span("chan_read",
+                                   os.path.basename(self.path), t0,
+                                   time.time() - t0, bytes=length)
                 return result[0]
             time.sleep(5e-5)
 

@@ -58,6 +58,22 @@ def summarize_tasks() -> dict:
     return _query("summarize_tasks")
 
 
+def summary_tasks() -> dict:
+    """Per-function rollup from the task-event pipeline (parity: `ray
+    summary tasks`): attempt counts, state breakdown, mean queue/exec/
+    total latencies, plus pipeline drop accounting. Works from remote
+    callers (workers, clients) through the head's state channel."""
+    return _query("summary_tasks")
+
+
+def list_task_events(limit: int = 1000) -> list[dict]:
+    """Merged per-attempt task events from the head's TaskEventStorage
+    (parity: `ray list tasks --detail` backed by gcs_task_manager.h:94):
+    each row carries the attempt's state-transition history with source
+    node/worker, lease_seq and spill hops."""
+    return _query("task_events", limit)
+
+
 def summarize_actors() -> dict:
     return _query("summarize_actors")
 
@@ -139,6 +155,16 @@ def _summarize_tasks(rt) -> dict:
     return {"by_state": by_state, "by_name": rt.task_events.summary()}
 
 
+def _summary_tasks(rt) -> dict:
+    rt.sync_task_store()
+    return rt.task_store.summary()
+
+
+def _task_events(rt, limit: int = 1000) -> list[dict]:
+    rt.sync_task_store()
+    return rt.task_store.list_events(limit)
+
+
 def _summarize_actors(rt) -> dict:
     by_state: dict[str, int] = {}
     for row in _actors(rt):
@@ -170,6 +196,8 @@ _HANDLERS = {
     "objects": _objects,
     "placement_groups": _placement_groups,
     "summarize_tasks": _summarize_tasks,
+    "summary_tasks": _summary_tasks,
+    "task_events": _task_events,
     "summarize_actors": _summarize_actors,
     "status": _status,
 }
