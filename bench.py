@@ -142,6 +142,7 @@ def final_line(status: str = "complete"):
         "many_nodes_scaling": EXTRAS.get("many_nodes_scaling", {}),
         "adag_pipeline": EXTRAS.get("adag_pipeline", {}),
         "task_events": EXTRAS.get("task_events", {}),
+        "cross_language": EXTRAS.get("cross_language", {}),
         "tpu_mfu_pct": mfu,
         "tpu": TPU,
         "detail": {k: round(v, 1) for k, v in RESULTS.items()},
@@ -170,6 +171,8 @@ def final_line(status: str = "complete"):
         "n_skipped": len(SKIPPED),
         "adag_x": EXTRAS.get("adag_pipeline", {}).get("tensor_speedup_x"),
         "tev_ovh_pct": EXTRAS.get("task_events", {}).get("overhead_pct"),
+        "xlang_s": EXTRAS.get("cross_language", {}).get(
+            "cpp_tasks_async_s"),
         "tpu_mfu_pct": mfu,
         "host": {k: EXTRAS.get("host", {}).get(k)
                  for k in ("cpu_count", "memcpy_gbps")},
@@ -701,6 +704,49 @@ def main():
                       "one cluster",
         }
 
+    def sec_cross_language():
+        # Cross-language worker plane: trivial-task round-trip latency +
+        # throughput on a C++ worker vs the Python pool in the SAME
+        # cluster (an emulated agent node advertises CPP and spawns
+        # cpp/raytpu_worker.cc on demand). Full numbers live in BENCH_OUT
+        # under "cross_language"; the headline stays under its byte cap.
+        from ray_tpu.cluster_utils import Cluster
+        cluster = Cluster(initialize_head=False)
+        node = cluster.add_node(num_cpus=2)
+        try:
+            cpp_nop = ray_tpu.cpp_function("rt.noop")
+            ray_tpu.get(cpp_nop.remote(), timeout=180)  # build+spawn warm
+
+            def cpp_sync(n):
+                for _ in range(n):
+                    ray_tpu.get(cpp_nop.remote(), timeout=60)
+
+            cpp_sync_rate = timeit(cpp_sync, 1000)
+            emit("cross_language_tasks_sync", cpp_sync_rate)
+
+            def cpp_async(n):
+                ray_tpu.get([cpp_nop.remote() for _ in range(n)],
+                            timeout=120)
+
+            cpp_async_rate = timeit(cpp_async, 4000, warm=2000)
+            emit("cross_language_tasks_async", cpp_async_rate)
+            # Python comparators measured earlier in sec_tasks on this
+            # same host (nop through the Python worker pool).
+            py_sync = RESULTS.get("single_client_tasks_sync", 0.0)
+            py_async = RESULTS.get("single_client_tasks_async", 0.0)
+            EXTRAS["cross_language"] = {
+                "cpp_tasks_sync_s": round(cpp_sync_rate, 1),
+                "cpp_tasks_async_s": round(cpp_async_rate, 1),
+                "cpp_rtt_ms": round(1e3 / cpp_sync_rate, 3)
+                if cpp_sync_rate else None,
+                "py_tasks_sync_s": round(py_sync, 1),
+                "py_tasks_async_s": round(py_async, 1),
+                "cpp_vs_py_async_x": round(cpp_async_rate / py_async, 3)
+                if py_async else None,
+            }
+        finally:
+            cluster.remove_node(node)
+
     def sec_client():
         # Client mode (remote driver over the cluster socket): a
         # subprocess connects via address and hammers get/put (parity:
@@ -763,6 +809,7 @@ def main():
         ("objects", 120, sec_objects),
         ("adag", 90, sec_adag),
         ("task_events", 180, sec_task_events),
+        ("cross_language", 90, sec_cross_language),
         ("pg", 90, sec_pg),
         ("client", 90, sec_client),
         ("many_agents", 180, sec_many_agents),

@@ -1,0 +1,960 @@
+// Hand-rolled protobuf bindings for ray_tpu/protocol/raytpu.proto.
+//
+// This build environment ships no protoc and no libprotobuf, so the C++
+// frontend (raytpu_client.cc) and the C++ worker runtime
+// (raytpu_worker.cc) encode/decode the schema with a small varint codec
+// implemented here — byte-compatible with the protobuf wire format the
+// Python side speaks through google.protobuf (the relationship mirrors
+// core/proto_wire.py: the .proto file is the contract, the codec is
+// hand-maintained). Only the fields the C++ sources use are materialized;
+// unknown fields are skipped on parse, so the header stays forward
+// compatible with schema growth. When a real protoc is available the
+// generated raytpu.pb.h is a drop-in replacement (the API below matches
+// the generated accessors the client code was written against).
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <string>
+#include <vector>
+
+namespace pbwire {
+
+// ---- wire primitives (proto wire types 0=varint, 1=fixed64, 2=len) ----
+
+inline void PutVarint(std::string* out, uint64_t v) {
+  while (v >= 0x80) {
+    out->push_back(static_cast<char>((v & 0x7f) | 0x80));
+    v >>= 7;
+  }
+  out->push_back(static_cast<char>(v));
+}
+
+inline void PutTag(std::string* out, int field, int wt) {
+  PutVarint(out, (static_cast<uint64_t>(field) << 3) | wt);
+}
+
+inline void PutLenField(std::string* out, int field, const std::string& s) {
+  if (s.empty()) return;
+  PutTag(out, field, 2);
+  PutVarint(out, s.size());
+  out->append(s);
+}
+
+// Length-delimited field emitted even when empty (oneof members and
+// required-presence submessages must hit the wire to select the arm).
+inline void PutLenAlways(std::string* out, int field, const std::string& s) {
+  PutTag(out, field, 2);
+  PutVarint(out, s.size());
+  out->append(s);
+}
+
+inline void PutInt(std::string* out, int field, int64_t v) {
+  if (v == 0) return;
+  PutTag(out, field, 0);
+  PutVarint(out, static_cast<uint64_t>(v));
+}
+
+inline void PutBool(std::string* out, int field, bool v) {
+  if (!v) return;
+  PutTag(out, field, 0);
+  PutVarint(out, 1);
+}
+
+inline void PutDouble(std::string* out, int field, double v) {
+  if (v == 0.0) return;
+  PutTag(out, field, 1);
+  char buf[8];
+  memcpy(buf, &v, 8);
+  out->append(buf, 8);
+}
+
+struct Reader {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+
+  Reader(const void* data, size_t n)
+      : p(static_cast<const uint8_t*>(data)),
+        end(static_cast<const uint8_t*>(data) + n) {}
+
+  uint64_t Varint() {
+    uint64_t v = 0;
+    int shift = 0;
+    while (p < end && shift < 64) {
+      uint8_t b = *p++;
+      v |= static_cast<uint64_t>(b & 0x7f) << shift;
+      if (!(b & 0x80)) return v;
+      shift += 7;
+    }
+    ok = false;
+    return 0;
+  }
+
+  bool Tag(int* field, int* wt) {
+    if (p >= end || !ok) return false;
+    uint64_t t = Varint();
+    if (!ok) return false;
+    *field = static_cast<int>(t >> 3);
+    *wt = static_cast<int>(t & 7);
+    return true;
+  }
+
+  std::string Bytes() {
+    uint64_t n = Varint();
+    if (!ok || p + n > end) {
+      ok = false;
+      return "";
+    }
+    std::string s(reinterpret_cast<const char*>(p), n);
+    p += n;
+    return s;
+  }
+
+  // Zero-copy view of a length-delimited field (valid while the parse
+  // buffer lives) — used for nested-message parses.
+  bool View(const uint8_t** data, size_t* n) {
+    uint64_t len = Varint();
+    if (!ok || p + len > end) {
+      ok = false;
+      return false;
+    }
+    *data = p;
+    *n = len;
+    p += len;
+    return true;
+  }
+
+  double Double() {
+    if (p + 8 > end) {
+      ok = false;
+      return 0.0;
+    }
+    double v;
+    memcpy(&v, p, 8);
+    p += 8;
+    return v;
+  }
+
+  void Skip(int wt) {
+    switch (wt) {
+      case 0:
+        Varint();
+        break;
+      case 1:
+        p += 8;
+        break;
+      case 2: {
+        uint64_t n = Varint();
+        if (p + n > end) { ok = false; return; }
+        p += n;
+        break;
+      }
+      case 5:
+        p += 4;
+        break;
+      default:
+        ok = false;
+    }
+    if (p > end) ok = false;
+  }
+};
+
+// map<string, double> encodes as repeated { 1: key, 2: value }.
+inline void PutMapSD(std::string* out, int field,
+                     const std::map<std::string, double>& m) {
+  for (const auto& kv : m) {
+    std::string entry;
+    PutLenField(&entry, 1, kv.first);
+    PutDouble(&entry, 2, kv.second);
+    PutLenAlways(out, field, entry);
+  }
+}
+
+inline void ParseMapSDEntry(const uint8_t* data, size_t n,
+                            std::map<std::string, double>* m) {
+  Reader r(data, n);
+  std::string key;
+  double val = 0.0;
+  int f, wt;
+  while (r.Tag(&f, &wt)) {
+    if (f == 1 && wt == 2) key = r.Bytes();
+    else if (f == 2 && wt == 1) val = r.Double();
+    else r.Skip(wt);
+  }
+  (*m)[key] = val;
+}
+
+}  // namespace pbwire
+
+namespace raytpu {
+
+// ---------- common ----------
+
+class Value {
+ public:
+  const std::string& data() const { return data_; }
+  const std::string& format() const { return format_; }
+  void set_data(const std::string& d) { data_ = d; }
+  void set_data(const void* d, size_t n) {
+    data_.assign(static_cast<const char*>(d), n);
+  }
+  void set_format(const std::string& f) { format_ = f; }
+  void CopyFrom(const Value& o) { *this = o; }
+
+  void AppendTo(std::string* out) const {
+    pbwire::PutLenField(out, 1, data_);
+    pbwire::PutLenField(out, 2, format_);
+  }
+  void Parse(const uint8_t* data, size_t n) {
+    pbwire::Reader r(data, n);
+    int f, wt;
+    while (r.Tag(&f, &wt)) {
+      if (f == 1 && wt == 2) data_ = r.Bytes();
+      else if (f == 2 && wt == 2) format_ = r.Bytes();
+      else r.Skip(wt);
+    }
+  }
+
+ private:
+  std::string data_;
+  std::string format_;
+};
+
+class Arg {
+ public:
+  Value* mutable_value() { has_value_ = true; return &value_; }
+  const Value& value() const { return value_; }
+  bool has_value() const { return has_value_; }
+  void set_object_id(const std::string& oid) { object_id_ = oid; }
+  const std::string& object_id() const { return object_id_; }
+  bool has_object_id() const { return !object_id_.empty(); }
+
+  void AppendTo(std::string* out) const {
+    if (has_value_) {
+      std::string v;
+      value_.AppendTo(&v);
+      pbwire::PutLenAlways(out, 1, v);
+    } else if (!object_id_.empty()) {
+      pbwire::PutLenField(out, 2, object_id_);
+    }
+  }
+  void Parse(const uint8_t* data, size_t n) {
+    pbwire::Reader r(data, n);
+    int f, wt;
+    const uint8_t* d;
+    size_t len;
+    while (r.Tag(&f, &wt)) {
+      if (f == 1 && wt == 2 && r.View(&d, &len)) {
+        has_value_ = true;
+        value_.Parse(d, len);
+      } else if (f == 2 && wt == 2) {
+        object_id_ = r.Bytes();
+      } else {
+        r.Skip(wt);
+      }
+    }
+  }
+
+ private:
+  Value value_;
+  bool has_value_ = false;
+  std::string object_id_;
+};
+
+class TaskArgs {
+ public:
+  std::vector<Arg> args;  // kwargs are a Python-side concept; skipped
+
+  void Parse(const uint8_t* data, size_t n) {
+    pbwire::Reader r(data, n);
+    int f, wt;
+    const uint8_t* d;
+    size_t len;
+    while (r.Tag(&f, &wt)) {
+      if (f == 1 && wt == 2 && r.View(&d, &len)) {
+        args.emplace_back();
+        args.back().Parse(d, len);
+      } else {
+        r.Skip(wt);
+      }
+    }
+  }
+  void AppendTo(std::string* out) const {
+    for (const auto& a : args) {
+      std::string buf;
+      a.AppendTo(&buf);
+      pbwire::PutLenAlways(out, 1, buf);
+    }
+  }
+};
+
+// The dispatch-relevant subset of raytpu.TaskSpec (unknown fields skip).
+class TaskSpec {
+ public:
+  std::string task_id;         // 1
+  std::string name;            // 3 — native symbol for cpp tasks
+  Value payload;               // 4 — format="task_args"
+  std::vector<std::string> return_ids;  // 5
+  int32_t max_retries = 0;     // 9
+  int32_t retries_left = 0;    // 10
+
+  void Parse(const uint8_t* data, size_t n) {
+    pbwire::Reader r(data, n);
+    int f, wt;
+    const uint8_t* d;
+    size_t len;
+    while (r.Tag(&f, &wt)) {
+      switch (f) {
+        case 1: task_id = r.Bytes(); break;
+        case 3: name = r.Bytes(); break;
+        case 4:
+          if (wt == 2 && r.View(&d, &len)) payload.Parse(d, len);
+          break;
+        case 5: return_ids.push_back(r.Bytes()); break;
+        case 9: max_retries = static_cast<int32_t>(r.Varint()); break;
+        case 10: retries_left = static_cast<int32_t>(r.Varint()); break;
+        default: r.Skip(wt);
+      }
+    }
+  }
+};
+
+// ---------- worker plane (agent <-> non-Python worker) ----------
+
+class WorkerHello {
+ public:
+  std::string worker_id;             // 1
+  int64_t pid = 0;                   // 2
+  std::string language;              // 3
+  std::vector<std::string> symbols;  // 4
+
+  void AppendTo(std::string* out) const {
+    pbwire::PutLenField(out, 1, worker_id);
+    pbwire::PutInt(out, 2, pid);
+    pbwire::PutLenField(out, 3, language);
+    for (const auto& s : symbols) pbwire::PutLenField(out, 4, s);
+  }
+};
+
+class WorkerOut {
+ public:
+  std::string object_id;  // 1
+  std::string status;     // 2 — "shm" | "err"
+  Value error;            // 3
+  bool has_error = false;
+
+  void AppendTo(std::string* out) const {
+    pbwire::PutLenField(out, 1, object_id);
+    pbwire::PutLenField(out, 2, status);
+    if (has_error) {
+      std::string e;
+      error.AppendTo(&e);
+      pbwire::PutLenAlways(out, 3, e);
+    }
+  }
+};
+
+class WorkerDone {
+ public:
+  std::string task_id;         // 1
+  std::vector<WorkerOut> outs; // 2
+  int64_t attempt = 0;         // 3
+  double exec_start = 0;       // 4
+  double args_ready = 0;       // 5
+  double exec_done = 0;        // 6
+  double seal = 0;             // 7
+
+  void AppendTo(std::string* out) const {
+    pbwire::PutLenField(out, 1, task_id);
+    for (const auto& o : outs) {
+      std::string buf;
+      o.AppendTo(&buf);
+      pbwire::PutLenAlways(out, 2, buf);
+    }
+    pbwire::PutInt(out, 3, attempt);
+    pbwire::PutDouble(out, 4, exec_start);
+    pbwire::PutDouble(out, 5, args_ready);
+    pbwire::PutDouble(out, 6, exec_done);
+    pbwire::PutDouble(out, 7, seal);
+  }
+};
+
+class WorkerFrame {
+ public:
+  enum Which { kNone, kHello, kExec, kDone, kShutdown };
+  Which which = kNone;
+  WorkerHello hello;
+  TaskSpec exec_spec;  // WorkerExec{ spec = 1 }
+
+  std::string SerializeHello() const {
+    std::string inner;
+    hello.AppendTo(&inner);
+    std::string out;
+    pbwire::PutLenAlways(&out, 1, inner);
+    return out;
+  }
+  static std::string SerializeDone(const WorkerDone& d) {
+    std::string inner;
+    d.AppendTo(&inner);
+    std::string out;
+    pbwire::PutLenAlways(&out, 3, inner);
+    return out;
+  }
+
+  bool Parse(const uint8_t* data, size_t n) {
+    pbwire::Reader r(data, n);
+    int f, wt;
+    const uint8_t* d;
+    size_t len;
+    while (r.Tag(&f, &wt)) {
+      if (f == 2 && wt == 2 && r.View(&d, &len)) {
+        which = kExec;
+        pbwire::Reader er(d, len);
+        int ef, ewt;
+        const uint8_t* sd;
+        size_t sn;
+        while (er.Tag(&ef, &ewt)) {
+          if (ef == 1 && ewt == 2 && er.View(&sd, &sn)) exec_spec.Parse(sd, sn);
+          else er.Skip(ewt);
+        }
+      } else if (f == 4 && wt == 2) {
+        which = kShutdown;
+        r.Skip(wt);
+      } else {
+        r.Skip(wt);
+      }
+    }
+    return r.ok;
+  }
+};
+
+// ---------- client plane ----------
+
+class InitRequest {
+ public:
+  void set_client_name(const std::string& v) { client_name_ = v; }
+  void set_client_language(const std::string& v) { client_language_ = v; }
+  void AppendTo(std::string* out) const {
+    pbwire::PutLenField(out, 1, client_name_);
+    pbwire::PutLenField(out, 2, client_language_);
+  }
+
+ private:
+  std::string client_name_, client_language_;
+};
+
+class InitReply {
+ public:
+  const std::map<std::string, double>& cluster_resources() const {
+    return resources_;
+  }
+  void Parse(const uint8_t* data, size_t n) {
+    pbwire::Reader r(data, n);
+    int f, wt;
+    const uint8_t* d;
+    size_t len;
+    while (r.Tag(&f, &wt)) {
+      if (f == 3 && wt == 2 && r.View(&d, &len))
+        pbwire::ParseMapSDEntry(d, len, &resources_);
+      else r.Skip(wt);
+    }
+  }
+
+ private:
+  std::map<std::string, double> resources_;
+};
+
+class PutRequest {
+ public:
+  Value* mutable_value() { return &value_; }
+  void AppendTo(std::string* out) const {
+    std::string v;
+    value_.AppendTo(&v);
+    pbwire::PutLenAlways(out, 1, v);
+  }
+
+ private:
+  Value value_;
+};
+
+class PutReply {
+ public:
+  const std::string& object_id() const { return object_id_; }
+  void Parse(const uint8_t* data, size_t n) {
+    pbwire::Reader r(data, n);
+    int f, wt;
+    while (r.Tag(&f, &wt)) {
+      if (f == 1 && wt == 2) object_id_ = r.Bytes();
+      else r.Skip(wt);
+    }
+  }
+
+ private:
+  std::string object_id_;
+};
+
+class GetRequest {
+ public:
+  void set_object_id(const std::string& v) { object_id_ = v; }
+  void set_timeout_s(double v) { timeout_s_ = v; }
+  void AppendTo(std::string* out) const {
+    pbwire::PutLenField(out, 1, object_id_);
+    pbwire::PutDouble(out, 2, timeout_s_);
+  }
+
+ private:
+  std::string object_id_;
+  double timeout_s_ = 0;
+};
+
+class GetReply {
+ public:
+  Value value_field;
+  bool found_ = false;
+  const Value& value() const { return value_field; }
+  bool found() const { return found_; }
+  void Parse(const uint8_t* data, size_t n) {
+    pbwire::Reader r(data, n);
+    int f, wt;
+    const uint8_t* d;
+    size_t len;
+    while (r.Tag(&f, &wt)) {
+      if (f == 1 && wt == 2 && r.View(&d, &len)) value_field.Parse(d, len);
+      else if (f == 2 && wt == 0) found_ = r.Varint() != 0;
+      else r.Skip(wt);
+    }
+  }
+};
+
+class SubmitRequest {
+ public:
+  void set_fn_name(const std::string& v) { fn_name_ = v; }
+  void set_num_returns(int v) { num_returns_ = v; }
+  Arg* add_args() {
+    args_.emplace_back();
+    return &args_.back();
+  }
+  void AppendTo(std::string* out) const {
+    pbwire::PutLenField(out, 1, fn_name_);
+    for (const auto& a : args_) {
+      std::string buf;
+      a.AppendTo(&buf);
+      pbwire::PutLenAlways(out, 2, buf);
+    }
+    pbwire::PutInt(out, 3, num_returns_);
+  }
+
+ private:
+  std::string fn_name_;
+  std::vector<Arg> args_;
+  int num_returns_ = 0;
+};
+
+class SubmitReply {
+ public:
+  const std::vector<std::string>& return_ids() const { return return_ids_; }
+  void Parse(const uint8_t* data, size_t n) {
+    pbwire::Reader r(data, n);
+    int f, wt;
+    while (r.Tag(&f, &wt)) {
+      if (f == 1 && wt == 2) return_ids_.push_back(r.Bytes());
+      else r.Skip(wt);
+    }
+  }
+
+ private:
+  std::vector<std::string> return_ids_;
+};
+
+class WaitRequest {
+ public:
+  void add_object_ids(const std::string& v) { object_ids_.push_back(v); }
+  void set_num_returns(int v) { num_returns_ = v; }
+  void set_timeout_s(double v) { timeout_s_ = v; }
+  void AppendTo(std::string* out) const {
+    for (const auto& o : object_ids_) pbwire::PutLenField(out, 1, o);
+    pbwire::PutInt(out, 2, num_returns_);
+    pbwire::PutDouble(out, 3, timeout_s_);
+  }
+
+ private:
+  std::vector<std::string> object_ids_;
+  int num_returns_ = 0;
+  double timeout_s_ = 0;
+};
+
+class WaitReply {
+ public:
+  const std::vector<std::string>& ready() const { return ready_; }
+  int ready_size() const { return static_cast<int>(ready_.size()); }
+  void Parse(const uint8_t* data, size_t n) {
+    pbwire::Reader r(data, n);
+    int f, wt;
+    while (r.Tag(&f, &wt)) {
+      if (f == 1 && wt == 2) ready_.push_back(r.Bytes());
+      else r.Skip(wt);
+    }
+  }
+
+ private:
+  std::vector<std::string> ready_;
+};
+
+class CreateActorRequest {
+ public:
+  void set_class_name(const std::string& v) { class_name_ = v; }
+  void set_num_cpus(double v) { num_cpus_ = v; }
+  void set_name(const std::string& v) { name_ = v; }
+  void set_placement_group_id(const std::string& v) { pg_id_ = v; }
+  void set_bundle_index(int v) { bundle_index_ = v; }
+  Arg* add_args() {
+    args_.emplace_back();
+    return &args_.back();
+  }
+  void AppendTo(std::string* out) const {
+    pbwire::PutLenField(out, 1, class_name_);
+    for (const auto& a : args_) {
+      std::string buf;
+      a.AppendTo(&buf);
+      pbwire::PutLenAlways(out, 2, buf);
+    }
+    pbwire::PutDouble(out, 3, num_cpus_);
+    pbwire::PutLenField(out, 6, name_);
+    pbwire::PutLenField(out, 7, pg_id_);
+    pbwire::PutInt(out, 8, bundle_index_);
+  }
+
+ private:
+  std::string class_name_, name_, pg_id_;
+  std::vector<Arg> args_;
+  double num_cpus_ = 0;
+  int bundle_index_ = 0;
+};
+
+class CreateActorReply {
+ public:
+  std::string actor_id_;
+  const std::string& actor_id() const { return actor_id_; }
+  void Parse(const uint8_t* data, size_t n) {
+    pbwire::Reader r(data, n);
+    int f, wt;
+    while (r.Tag(&f, &wt)) {
+      if (f == 1 && wt == 2) actor_id_ = r.Bytes();
+      else r.Skip(wt);
+    }
+  }
+};
+
+class Bundle {
+ public:
+  std::map<std::string, double>* mutable_resources() { return &resources_; }
+  void AppendTo(std::string* out) const {
+    pbwire::PutMapSD(out, 1, resources_);
+  }
+
+ private:
+  std::map<std::string, double> resources_;
+};
+
+class CreatePlacementGroupRequest {
+ public:
+  Bundle* add_bundles() {
+    bundles_.emplace_back();
+    return &bundles_.back();
+  }
+  void set_strategy(const std::string& v) { strategy_ = v; }
+  void set_name(const std::string& v) { name_ = v; }
+  void set_ready_timeout_s(double v) { ready_timeout_s_ = v; }
+  void AppendTo(std::string* out) const {
+    for (const auto& b : bundles_) {
+      std::string buf;
+      b.AppendTo(&buf);
+      pbwire::PutLenAlways(out, 1, buf);
+    }
+    pbwire::PutLenField(out, 2, strategy_);
+    pbwire::PutLenField(out, 3, name_);
+    pbwire::PutDouble(out, 4, ready_timeout_s_);
+  }
+
+ private:
+  std::vector<Bundle> bundles_;
+  std::string strategy_, name_;
+  double ready_timeout_s_ = 0;
+};
+
+class CreatePlacementGroupReply {
+ public:
+  std::string pg_id_;
+  bool ready_ = false;
+  const std::string& placement_group_id() const { return pg_id_; }
+  bool ready() const { return ready_; }
+  void Parse(const uint8_t* data, size_t n) {
+    pbwire::Reader r(data, n);
+    int f, wt;
+    while (r.Tag(&f, &wt)) {
+      if (f == 1 && wt == 2) pg_id_ = r.Bytes();
+      else if (f == 2 && wt == 0) ready_ = r.Varint() != 0;
+      else r.Skip(wt);
+    }
+  }
+};
+
+class RemovePlacementGroupRequest {
+ public:
+  void set_placement_group_id(const std::string& v) { pg_id_ = v; }
+  void AppendTo(std::string* out) const {
+    pbwire::PutLenField(out, 1, pg_id_);
+  }
+
+ private:
+  std::string pg_id_;
+};
+
+class SimpleOkReply {
+ public:
+  bool ok_ = false;
+  bool ok() const { return ok_; }
+  void Parse(const uint8_t* data, size_t n) {
+    pbwire::Reader r(data, n);
+    int f, wt;
+    while (r.Tag(&f, &wt)) {
+      if (f == 1 && wt == 0) ok_ = r.Varint() != 0;
+      else r.Skip(wt);
+    }
+  }
+};
+using RemovePlacementGroupReply = SimpleOkReply;
+using KillActorReply = SimpleOkReply;
+using KvPutReply = SimpleOkReply;
+
+class ActorCallRequest {
+ public:
+  void set_actor_id(const std::string& v) { actor_id_ = v; }
+  void set_method(const std::string& v) { method_ = v; }
+  Arg* add_args() {
+    args_.emplace_back();
+    return &args_.back();
+  }
+  void AppendTo(std::string* out) const {
+    pbwire::PutLenField(out, 1, actor_id_);
+    pbwire::PutLenField(out, 2, method_);
+    for (const auto& a : args_) {
+      std::string buf;
+      a.AppendTo(&buf);
+      pbwire::PutLenAlways(out, 3, buf);
+    }
+  }
+
+ private:
+  std::string actor_id_, method_;
+  std::vector<Arg> args_;
+};
+
+class ActorCallReply {
+ public:
+  std::string return_id_;
+  const std::string& return_id() const { return return_id_; }
+  void Parse(const uint8_t* data, size_t n) {
+    pbwire::Reader r(data, n);
+    int f, wt;
+    while (r.Tag(&f, &wt)) {
+      if (f == 1 && wt == 2) return_id_ = r.Bytes();
+      else r.Skip(wt);
+    }
+  }
+};
+
+class KillActorRequest {
+ public:
+  void set_actor_id(const std::string& v) { actor_id_ = v; }
+  void set_no_restart(bool v) { no_restart_ = v; }
+  void AppendTo(std::string* out) const {
+    pbwire::PutLenField(out, 1, actor_id_);
+    pbwire::PutBool(out, 2, no_restart_);
+  }
+
+ private:
+  std::string actor_id_;
+  bool no_restart_ = false;
+};
+
+class KvPutRequest {
+ public:
+  void set_key(const std::string& v) { key_ = v; }
+  void set_value(const std::string& v) { value_ = v; }
+  void AppendTo(std::string* out) const {
+    pbwire::PutLenField(out, 1, key_);
+    pbwire::PutLenField(out, 2, value_);
+  }
+
+ private:
+  std::string key_, value_;
+};
+
+class KvGetRequest {
+ public:
+  void set_key(const std::string& v) { key_ = v; }
+  void AppendTo(std::string* out) const {
+    pbwire::PutLenField(out, 1, key_);
+  }
+
+ private:
+  std::string key_;
+};
+
+class KvGetReply {
+ public:
+  std::string value_;
+  bool found_ = false;
+  const std::string& value() const { return value_; }
+  bool found() const { return found_; }
+  void Parse(const uint8_t* data, size_t n) {
+    pbwire::Reader r(data, n);
+    int f, wt;
+    while (r.Tag(&f, &wt)) {
+      if (f == 1 && wt == 2) value_ = r.Bytes();
+      else if (f == 2 && wt == 0) found_ = r.Varint() != 0;
+      else r.Skip(wt);
+    }
+  }
+};
+
+// One oneof arm per request type; exactly one is set per RPC.
+class ClientRequest {
+ public:
+  void set_req_id(uint64_t v) { req_id_ = v; }
+  InitRequest* mutable_init() { which_ = 2; return &init_; }
+  PutRequest* mutable_put() { which_ = 3; return &put_; }
+  GetRequest* mutable_get() { which_ = 4; return &get_; }
+  SubmitRequest* mutable_submit() { which_ = 5; return &submit_; }
+  WaitRequest* mutable_wait() { which_ = 6; return &wait_; }
+  KvPutRequest* mutable_kv_put() { which_ = 7; return &kv_put_; }
+  KvGetRequest* mutable_kv_get() { which_ = 8; return &kv_get_; }
+  CreateActorRequest* mutable_create_actor() {
+    which_ = 9;
+    return &create_actor_;
+  }
+  ActorCallRequest* mutable_actor_call() { which_ = 10; return &actor_call_; }
+  KillActorRequest* mutable_kill_actor() { which_ = 11; return &kill_actor_; }
+  CreatePlacementGroupRequest* mutable_create_placement_group() {
+    which_ = 12;
+    return &create_pg_;
+  }
+  RemovePlacementGroupRequest* mutable_remove_placement_group() {
+    which_ = 13;
+    return &remove_pg_;
+  }
+
+  bool SerializeToString(std::string* out) const {
+    out->clear();
+    pbwire::PutInt(out, 1, static_cast<int64_t>(req_id_));
+    std::string body;
+    switch (which_) {
+      case 2: init_.AppendTo(&body); break;
+      case 3: put_.AppendTo(&body); break;
+      case 4: get_.AppendTo(&body); break;
+      case 5: submit_.AppendTo(&body); break;
+      case 6: wait_.AppendTo(&body); break;
+      case 7: kv_put_.AppendTo(&body); break;
+      case 8: kv_get_.AppendTo(&body); break;
+      case 9: create_actor_.AppendTo(&body); break;
+      case 10: actor_call_.AppendTo(&body); break;
+      case 11: kill_actor_.AppendTo(&body); break;
+      case 12: create_pg_.AppendTo(&body); break;
+      case 13: remove_pg_.AppendTo(&body); break;
+      default: return false;
+    }
+    pbwire::PutLenAlways(out, which_, body);
+    return true;
+  }
+
+ private:
+  uint64_t req_id_ = 0;
+  int which_ = 0;
+  InitRequest init_;
+  PutRequest put_;
+  GetRequest get_;
+  SubmitRequest submit_;
+  WaitRequest wait_;
+  KvPutRequest kv_put_;
+  KvGetRequest kv_get_;
+  CreateActorRequest create_actor_;
+  ActorCallRequest actor_call_;
+  KillActorRequest kill_actor_;
+  CreatePlacementGroupRequest create_pg_;
+  RemovePlacementGroupRequest remove_pg_;
+};
+
+class ClientReply {
+ public:
+  const std::string& error() const { return error_; }
+  const InitReply& init() const { return init_; }
+  const PutReply& put() const { return put_; }
+  const GetReply& get() const { return get_; }
+  const SubmitReply& submit() const { return submit_; }
+  const WaitReply& wait() const { return wait_; }
+  const KvGetReply& kv_get() const { return kv_get_; }
+  const KvPutReply& kv_put() const { return kv_put_; }
+  const CreateActorReply& create_actor() const { return create_actor_; }
+  const ActorCallReply& actor_call() const { return actor_call_; }
+  const KillActorReply& kill_actor() const { return kill_actor_; }
+  const CreatePlacementGroupReply& create_placement_group() const {
+    return create_pg_;
+  }
+  const RemovePlacementGroupReply& remove_placement_group() const {
+    return remove_pg_;
+  }
+
+  bool ParseFromString(const std::string& s) {
+    pbwire::Reader r(s.data(), s.size());
+    int f, wt;
+    const uint8_t* d;
+    size_t n;
+    while (r.Tag(&f, &wt)) {
+      if (f == 1 && wt == 0) {
+        req_id_ = r.Varint();
+      } else if (f == 2 && wt == 2) {
+        error_ = r.Bytes();
+      } else if (wt == 2 && r.View(&d, &n)) {
+        switch (f) {
+          case 3: init_.Parse(d, n); break;
+          case 4: put_.Parse(d, n); break;
+          case 5: get_.Parse(d, n); break;
+          case 6: submit_.Parse(d, n); break;
+          case 7: wait_.Parse(d, n); break;
+          case 8: kv_get_.Parse(d, n); break;
+          case 9: kv_put_.Parse(d, n); break;
+          case 10: create_actor_.Parse(d, n); break;
+          case 11: actor_call_.Parse(d, n); break;
+          case 12: kill_actor_.Parse(d, n); break;
+          case 13: create_pg_.Parse(d, n); break;
+          case 14: remove_pg_.Parse(d, n); break;
+          default: break;  // unknown reply arm: ignore
+        }
+      } else {
+        r.Skip(wt);
+      }
+    }
+    return r.ok;
+  }
+
+ private:
+  uint64_t req_id_ = 0;
+  std::string error_;
+  InitReply init_;
+  PutReply put_;
+  GetReply get_;
+  SubmitReply submit_;
+  WaitReply wait_;
+  KvGetReply kv_get_;
+  KvPutReply kv_put_;
+  CreateActorReply create_actor_;
+  ActorCallReply actor_call_;
+  KillActorReply kill_actor_;
+  CreatePlacementGroupReply create_pg_;
+  RemovePlacementGroupReply remove_pg_;
+};
+
+}  // namespace raytpu

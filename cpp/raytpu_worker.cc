@@ -1,0 +1,445 @@
+// Cross-language worker runtime: a standalone C++ process that registers
+// with a node agent, receives task-dispatch frames, executes registered
+// native functions, and returns results — no Python and NO PICKLE anywhere
+// on its path (parity: the reference's C++ worker runtime,
+// cpp/src/ray/runtime/task/task_executor.cc + core_worker.proto:457).
+//
+// Plumbing:
+//   argv: <store_path> <worker_id_hex> <fd>
+//   - maps the node's shared-memory arena (the SAME file every Python
+//     process on the node maps) and calls the store's C API directly —
+//     object_store.cpp is compiled into this binary;
+//   - speaks length-prefixed protobuf WorkerFrame frames on the inherited
+//     socket fd (outer framing identical to transport.py, proto flag
+//     REQUIRED — a pickle frame is a loud protocol error, which is this
+//     worker's half of the no-pickle plane assertion);
+//   - task args arrive as a tagged raytpu.TaskArgs payload; object_id
+//     args are read zero-copy out of the arena (tagged-object layout,
+//     object_store.py TAGGED_META); returns are sealed back into the
+//     arena in the same layout and reported as arena ids.
+//
+// Functions are addressed by REGISTERED SYMBOL NAME (spec.name). The
+// built-in registry below covers the e2e tests and the bench; real
+// deployments extend it (or swap in a dlopen-based resolver) by editing
+// this table — the build is one cached g++ invocation away
+// (_native/build.py build_binary), so there is no build-system step.
+
+#include <time.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fcntl.h>
+#include <functional>
+#include <map>
+#include <string>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <vector>
+
+#include "pb/raytpu.pb.h"
+
+// ---- shared-memory store C API (object_store.cpp, linked in) ----
+extern "C" {
+int store_validate(void* base);
+int store_create(void* base, const uint8_t* id, uint64_t data_size,
+                 uint64_t meta_size, uint64_t* out_offset);
+int store_seal(void* base, const uint8_t* id);
+int store_get(void* base, const uint8_t* id, uint64_t* out_offset,
+              uint64_t* out_data_size, uint64_t* out_meta_size);
+int store_release(void* base, const uint8_t* id);
+}
+
+namespace {
+
+constexpr uint32_t kProtoFlag = 0x80000000u;
+constexpr char kTaggedMeta[] = "rtv1";  // object_store.py TAGGED_META
+
+double WallClock() {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return static_cast<double>(ts.tv_sec) + ts.tv_nsec * 1e-9;
+}
+
+bool SendAll(int fd, const char* data, size_t n) {
+  while (n) {
+    ssize_t w = ::write(fd, data, n);
+    if (w <= 0) return false;
+    data += w;
+    n -= w;
+  }
+  return true;
+}
+
+bool RecvAll(int fd, char* data, size_t n) {
+  while (n) {
+    ssize_t r = ::read(fd, data, n);
+    if (r <= 0) return false;
+    data += r;
+    n -= r;
+  }
+  return true;
+}
+
+bool SendFrame(int fd, const std::string& payload) {
+  char hdr[12];
+  uint64_t len = payload.size();
+  uint32_t nbufs = kProtoFlag;
+  memcpy(hdr, &len, 8);
+  memcpy(hdr + 8, &nbufs, 4);
+  return SendAll(fd, hdr, 12) && SendAll(fd, payload.data(), payload.size());
+}
+
+// A task argument resolved for execution: format + a borrowed byte span.
+// Arena args point STRAIGHT into the mmapped store (zero-copy; released
+// after the reply), inline args into the parsed frame.
+struct ArgView {
+  std::string format;
+  const char* data = nullptr;
+  size_t size = 0;
+
+  int64_t AsI64() const {
+    int64_t v = 0;
+    if (format == "i64" && size == 8) memcpy(&v, data, 8);
+    return v;
+  }
+  double AsF64() const {
+    double v = 0;
+    if (format == "f64" && size == 8) memcpy(&v, data, 8);
+    return v;
+  }
+  std::string Str() const { return std::string(data, size); }
+};
+
+raytpu::Value I64(int64_t v) {
+  raytpu::Value out;
+  out.set_format("i64");
+  out.set_data(&v, 8);
+  return out;
+}
+raytpu::Value F64(double v) {
+  raytpu::Value out;
+  out.set_format("f64");
+  out.set_data(&v, 8);
+  return out;
+}
+raytpu::Value Utf8(const std::string& s) {
+  raytpu::Value out;
+  out.set_format("utf8");
+  out.set_data(s);
+  return out;
+}
+
+using TaskFn = std::function<bool(const std::vector<ArgView>&,
+                                  std::vector<raytpu::Value>*,
+                                  std::string*)>;
+
+// ---- the native symbol registry (spec.name -> function) ----
+std::map<std::string, TaskFn> BuildRegistry() {
+  std::map<std::string, TaskFn> reg;
+  reg["rt.noop"] = [](const std::vector<ArgView>&,
+                      std::vector<raytpu::Value>* out, std::string*) {
+    out->push_back(I64(0));
+    return true;
+  };
+  reg["rt.pid"] = [](const std::vector<ArgView>&,
+                     std::vector<raytpu::Value>* out, std::string*) {
+    out->push_back(I64(static_cast<int64_t>(getpid())));
+    return true;
+  };
+  reg["rt.add_i64"] = [](const std::vector<ArgView>& args,
+                         std::vector<raytpu::Value>* out, std::string*) {
+    int64_t acc = 0;
+    for (const auto& a : args) acc += a.AsI64();
+    out->push_back(I64(acc));
+    return true;
+  };
+  reg["rt.mul_f64"] = [](const std::vector<ArgView>& args,
+                         std::vector<raytpu::Value>* out, std::string*) {
+    double acc = 1.0;
+    for (const auto& a : args) acc *= a.AsF64();
+    out->push_back(F64(acc));
+    return true;
+  };
+  reg["rt.concat_utf8"] = [](const std::vector<ArgView>& args,
+                             std::vector<raytpu::Value>* out, std::string*) {
+    std::string s;
+    for (const auto& a : args) s += a.Str();
+    out->push_back(Utf8(s));
+    return true;
+  };
+  // Byte length of any arg — works on arena args without copying them.
+  reg["rt.len"] = [](const std::vector<ArgView>& args,
+                     std::vector<raytpu::Value>* out, std::string* err) {
+    if (args.empty()) {
+      *err = "rt.len needs one argument";
+      return false;
+    }
+    out->push_back(I64(static_cast<int64_t>(args[0].size)));
+    return true;
+  };
+  // Sum of the raw bytes of arg 0 — touches every byte of a (possibly
+  // shm-arena) payload zero-copy; the e2e test checks the exact sum.
+  reg["rt.sum_bytes"] = [](const std::vector<ArgView>& args,
+                           std::vector<raytpu::Value>* out,
+                           std::string* err) {
+    if (args.empty()) {
+      *err = "rt.sum_bytes needs one argument";
+      return false;
+    }
+    int64_t acc = 0;
+    const uint8_t* p = reinterpret_cast<const uint8_t*>(args[0].data);
+    for (size_t i = 0; i < args[0].size; i++) acc += p[i];
+    out->push_back(I64(acc));
+    return true;
+  };
+  // Echo every arg back (exercises multi-return: num_returns == nargs).
+  reg["rt.echo"] = [](const std::vector<ArgView>& args,
+                      std::vector<raytpu::Value>* out, std::string*) {
+    for (const auto& a : args) {
+      raytpu::Value v;
+      v.set_format(a.format);
+      v.set_data(a.data, a.size);
+      out->push_back(v);
+    }
+    return true;
+  };
+  reg["rt.sleep_ms"] = [](const std::vector<ArgView>& args,
+                          std::vector<raytpu::Value>* out, std::string*) {
+    int64_t ms = args.empty() ? 0 : args[0].AsI64();
+    struct timespec ts = {ms / 1000, (ms % 1000) * 1000000L};
+    nanosleep(&ts, nullptr);
+    out->push_back(I64(ms));
+    return true;
+  };
+  reg["rt.fail"] = [](const std::vector<ArgView>&,
+                      std::vector<raytpu::Value>*, std::string* err) {
+    *err = "rt.fail raised (intentional cross-language task failure)";
+    return false;
+  };
+  return reg;
+}
+
+struct Worker {
+  int fd;
+  void* base = nullptr;
+  std::string worker_id;
+  std::map<std::string, TaskFn> registry = BuildRegistry();
+
+  bool SealTagged(const std::string& oid, const raytpu::Value& v) {
+    uint32_t fmt_len = static_cast<uint32_t>(v.format().size());
+    uint64_t total = 4 + fmt_len + v.data().size();
+    uint64_t off = 0;
+    int rc = store_create(base, reinterpret_cast<const uint8_t*>(oid.data()),
+                          total, 4, &off);
+    if (rc == -3 /* ERR_EXISTS */) return true;  // a prior attempt sealed it
+    if (rc != 0) return false;
+    char* dst = static_cast<char*>(base) + off;
+    memcpy(dst, &fmt_len, 4);
+    memcpy(dst + 4, v.format().data(), fmt_len);
+    memcpy(dst + 4 + fmt_len, v.data().data(), v.data().size());
+    memcpy(dst + total, kTaggedMeta, 4);  // meta region follows the data
+    return store_seal(base,
+                      reinterpret_cast<const uint8_t*>(oid.data())) == 0;
+  }
+
+  // Resolve one Arg; arena refs fill `held` for post-exec release.
+  bool ResolveArg(const raytpu::Arg& a, std::vector<ArgView>* out,
+                  std::vector<std::string>* held, std::string* err) {
+    if (a.has_object_id()) {
+      const auto& oid = a.object_id();
+      uint64_t off = 0, dsz = 0, msz = 0;
+      // Poll briefly: the agent stages deps before dispatch, so a miss
+      // here is a race with a concurrent seal, not a missing transfer.
+      int rc = -1;
+      for (int i = 0; i < 2000; i++) {
+        rc = store_get(base, reinterpret_cast<const uint8_t*>(oid.data()),
+                       &off, &dsz, &msz);
+        if (rc == 0) break;
+        struct timespec ts = {0, 5 * 1000000L};  // 5ms
+        nanosleep(&ts, nullptr);
+      }
+      if (rc != 0) {
+        *err = "arena object missing for arg (never staged?)";
+        return false;
+      }
+      const char* data = static_cast<const char*>(base) + off;
+      if (msz != 4 || memcmp(data + dsz, kTaggedMeta, 4) != 0) {
+        store_release(base, reinterpret_cast<const uint8_t*>(oid.data()));
+        *err = "arena arg is not a tagged object (pickle payload on the "
+               "no-pickle plane)";
+        return false;
+      }
+      uint32_t fmt_len = 0;
+      memcpy(&fmt_len, data, 4);
+      if (4 + static_cast<uint64_t>(fmt_len) > dsz) {
+        store_release(base, reinterpret_cast<const uint8_t*>(oid.data()));
+        *err = "corrupt tagged arena object";
+        return false;
+      }
+      held->push_back(oid);
+      ArgView v;
+      v.format.assign(data + 4, fmt_len);
+      v.data = data + 4 + fmt_len;
+      v.size = dsz - 4 - fmt_len;
+      if (v.format == "pickle") {
+        *err = "pickle-format arena arg on the no-pickle plane";
+        return false;
+      }
+      out->push_back(std::move(v));
+      return true;
+    }
+    const raytpu::Value& val = a.value();
+    if (val.format() == "pickle") {
+      *err = "pickle-format Value arg on the no-pickle plane";
+      return false;
+    }
+    ArgView v;
+    v.format = val.format();
+    v.data = val.data().data();
+    v.size = val.data().size();
+    out->push_back(std::move(v));
+    return true;
+  }
+
+  void Execute(const raytpu::TaskSpec& spec) {
+    raytpu::WorkerDone done;
+    done.task_id = spec.task_id;
+    done.attempt = spec.max_retries - spec.retries_left;
+    done.exec_start = WallClock();
+    std::string err;
+    std::vector<raytpu::Value> results;
+    std::vector<std::string> held;
+    raytpu::TaskArgs targs;
+    if (spec.payload.format() != "task_args") {
+      err = "dispatch payload is not a tagged TaskArgs (no-pickle plane "
+            "violation)";
+    } else {
+      targs.Parse(
+          reinterpret_cast<const uint8_t*>(spec.payload.data().data()),
+          spec.payload.data().size());
+      std::vector<ArgView> args;
+      bool ok = true;
+      for (const auto& a : targs.args) {
+        if (!ResolveArg(a, &args, &held, &err)) {
+          ok = false;
+          break;
+        }
+      }
+      done.args_ready = WallClock();
+      if (ok) {
+        auto it = registry.find(spec.name);
+        if (it == registry.end()) {
+          err = "no native symbol registered for '" + spec.name + "'";
+        } else if (it->second(args, &results, &err)) {
+          if (results.size() != spec.return_ids.size()) {
+            err = "task returned " + std::to_string(results.size()) +
+                  " values, expected " +
+                  std::to_string(spec.return_ids.size());
+            results.clear();
+          }
+        }
+      }
+    }
+    done.exec_done = WallClock();
+    for (size_t i = 0; i < spec.return_ids.size(); i++) {
+      raytpu::WorkerOut o;
+      o.object_id = spec.return_ids[i];
+      if (!err.empty()) {
+        o.status = "err";
+        o.has_error = true;
+        o.error = Utf8(err);
+      } else if (SealTagged(spec.return_ids[i], results[i])) {
+        o.status = "shm";
+      } else {
+        o.status = "err";
+        o.has_error = true;
+        o.error = Utf8("failed to seal return into the arena");
+      }
+      done.outs.push_back(std::move(o));
+    }
+    for (const auto& oid : held)
+      store_release(base, reinterpret_cast<const uint8_t*>(oid.data()));
+    done.seal = WallClock();
+    SendFrame(fd, raytpu::WorkerFrame::SerializeDone(done));
+  }
+
+  int Run() {
+    // Announce: worker id + pid + the registered symbol table.
+    raytpu::WorkerFrame hello;
+    hello.hello.worker_id = worker_id;
+    hello.hello.pid = getpid();
+    hello.hello.language = "cpp";
+    for (const auto& kv : registry) hello.hello.symbols.push_back(kv.first);
+    if (!SendFrame(fd, hello.SerializeHello())) return 1;
+
+    char hdr[12];
+    std::string payload;
+    while (RecvAll(fd, hdr, 12)) {
+      uint64_t len = 0;
+      uint32_t nbufs = 0;
+      memcpy(&len, hdr, 8);
+      memcpy(&nbufs, hdr + 8, 4);
+      if (!(nbufs & kProtoFlag)) {
+        // The no-pickle assertion, enforced at the reader: this worker
+        // cannot and will not decode a pickle frame.
+        fprintf(stderr,
+                "raytpu_worker: non-protobuf frame on the worker channel "
+                "(nbufs=0x%x) — no-pickle plane violation\n", nbufs);
+        return 3;
+      }
+      payload.resize(len);
+      if (len && !RecvAll(fd, payload.data(), len)) break;
+      raytpu::WorkerFrame frame;
+      if (!frame.Parse(reinterpret_cast<const uint8_t*>(payload.data()),
+                       payload.size())) {
+        fprintf(stderr, "raytpu_worker: unparseable WorkerFrame\n");
+        return 3;
+      }
+      if (frame.which == raytpu::WorkerFrame::kShutdown) return 0;
+      if (frame.which == raytpu::WorkerFrame::kExec) Execute(frame.exec_spec);
+    }
+    return 0;  // agent hung up: clean exit
+  }
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc != 4) {
+    fprintf(stderr, "usage: %s <store_path> <worker_id_hex> <fd>\n", argv[0]);
+    return 2;
+  }
+  Worker w;
+  // worker_id arrives hex-encoded; the wire carries raw bytes.
+  const char* hex = argv[2];
+  for (size_t i = 0; hex[i] && hex[i + 1]; i += 2) {
+    auto nyb = [](char c) -> int {
+      if (c >= '0' && c <= '9') return c - '0';
+      if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+      if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+      return 0;
+    };
+    w.worker_id.push_back(static_cast<char>((nyb(hex[i]) << 4)
+                                            | nyb(hex[i + 1])));
+  }
+  w.fd = atoi(argv[3]);
+
+  int sfd = open(argv[1], O_RDWR);
+  if (sfd < 0) {
+    fprintf(stderr, "raytpu_worker: cannot open store %s\n", argv[1]);
+    return 2;
+  }
+  struct stat st;
+  fstat(sfd, &st);
+  w.base = mmap(nullptr, st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED,
+                sfd, 0);
+  close(sfd);
+  if (w.base == MAP_FAILED || store_validate(w.base) != 0) {
+    fprintf(stderr, "raytpu_worker: store mmap/validate failed\n");
+    return 2;
+  }
+  return w.Run();
+}

@@ -11,6 +11,7 @@ from ray_tpu.core.api import (
     available_resources,
     cancel,
     cluster_resources,
+    cpp_function,
     get,
     get_actor,
     get_node_id,
@@ -41,6 +42,7 @@ from ray_tpu import util  # noqa: E402,F401  (parity: ray.util auto-import)
 __all__ = [
     "__version__", "init", "shutdown", "is_initialized", "remote", "method",
     "get", "put", "wait", "kill", "cancel", "get_actor", "cluster_resources",
+    "cpp_function",
     "available_resources", "nodes", "get_node_id", "timeline", "ObjectRef",
     "RayTpuError", "TaskError", "TaskCancelledError", "ActorDiedError", "WorkerCrashedError",
     "ObjectLostError", "GetTimeoutError", "util",

@@ -75,6 +75,32 @@ def _build(name: str, sources: tuple = (),
     return so_path
 
 
+def build_binary(name: str, sources: tuple, include_dirs: tuple = (),
+                 sanitizer: str | None = None) -> str:
+    """Compile (if needed) a standalone EXECUTABLE through the same
+    content-hash g++ cache and return its path.
+
+    Unlike _build, `sources` are absolute paths (the cpp worker's sources
+    live under the repo's cpp/ tree, not _native/). Used for the
+    cross-language worker binary (cpp/raytpu_worker.cc + object_store.cpp)
+    so no build-system step is ever required — the node agent compiles on
+    first spawn and every later spawn hits the cache."""
+    srcs = [s if os.path.isabs(s) else os.path.join(_DIR, s)
+            for s in sources]
+    extra, san_tag = _sanitizer_flags(sanitizer)
+    tag = _source_hash(srcs) + san_tag
+    out_path = os.path.join(_BUILD_DIR, f"{name}-{tag}")
+    if not os.path.exists(out_path):
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        tmp = out_path + f".tmp{os.getpid()}"
+        cmd = ["g++", "-O2", "-pthread", "-std=c++17", *extra]
+        cmd += [f"-I{d}" for d in include_dirs]
+        cmd += ["-o", tmp, *srcs]
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, out_path)  # atomic: concurrent builders race safely
+    return out_path
+
+
 def load_native(name: str, sources: tuple = ()) -> ctypes.CDLL:
     """Build (if needed) and dlopen a native lib from ray_tpu/_native/.
 

@@ -10,7 +10,7 @@ import inspect
 
 from ray_tpu.core.actor import ActorClass, ActorHandle, method  # noqa: F401
 from ray_tpu.core.object_ref import ObjectRef
-from ray_tpu.core.remote_function import RemoteFunction
+from ray_tpu.core.remote_function import CppFunction, RemoteFunction
 from ray_tpu.core.status import RayTpuError
 
 
@@ -57,8 +57,23 @@ def is_initialized() -> bool:
 
 
 def remote(*args, **options):
-    """@remote decorator for functions (tasks) and classes (actors)."""
+    """@remote decorator for functions (tasks) and classes (actors).
+
+    With `language="cpp"` the decorated function is a DECLARATION only:
+    its body never runs — the task executes the native symbol of the same
+    name registered in the C++ worker runtime (cpp/raytpu_worker.cc), and
+    every argument/return crosses as a tagged Value (no pickle)."""
     def decorate(obj):
+        if options.get("language") == "cpp":
+            if inspect.isclass(obj):
+                raise TypeError("language='cpp' applies to functions only "
+                                "(cross-language actors are future work)")
+            # `symbol=` overrides the Python name (native symbols may
+            # carry characters an identifier can't, e.g. "rt.noop").
+            opts = dict(options)
+            sym = opts.pop("symbol", None) or getattr(obj, "__name__",
+                                                      str(obj))
+            return CppFunction(sym, **opts)
         if inspect.isclass(obj):
             return ActorClass(obj, **options)
         return RemoteFunction(obj, **options)
@@ -69,6 +84,14 @@ def remote(*args, **options):
     if args:
         raise TypeError("@remote takes keyword options only")
     return decorate
+
+
+def cpp_function(symbol: str, **options) -> CppFunction:
+    """Handle for a native function registered in the C++ worker runtime:
+    `ray_tpu.cpp_function("rt.add_i64").remote(1, 2)` executes on a
+    `language=cpp` worker over the neutral exec plane and resolves through
+    the normal `ray_tpu.get`."""
+    return CppFunction(symbol, **options)
 
 
 def get(refs, *, timeout=None):

@@ -75,6 +75,20 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
                                       "dispatch; 1 disables pipelining)"),
     "task_max_retries_default": (int, 3, "default retries for idempotent tasks"),
     "actor_max_restarts_default": (int, 0, "default actor restarts"),
+    # --- cross-language workers (parity: the reference's C++ worker
+    #     runtime, cpp/src/ray/runtime/task/task_executor.cc +
+    #     core_worker.proto:457 — a non-Python process that registers,
+    #     leases, executes and returns tasks over the neutral exec plane) ---
+    "cpp_worker_enable": (bool, True, "node agents advertise the CPP "
+                          "capability resource and spawn the C++ worker "
+                          "binary on demand for language='cpp' tasks "
+                          "(compiled through the _native/build.py "
+                          "content-hash g++ cache on first use)"),
+    "cpp_worker_binary": (str, "", "path to a prebuilt raytpu_worker "
+                          "binary; '' = compile cpp/raytpu_worker.cc + "
+                          "_native/object_store.cpp on first spawn"),
+    "cpp_worker_pool": (int, 0, "max C++ workers per node agent; "
+                        "0 = the node's CPU count"),
     # --- cluster-view broadcast + lease spillback (parity:
     #     ray_syncer.h:20 broadcast half + cluster_task_manager.cc:187
     #     scheduler spillback — decentralized agent->agent rebalancing) ---

@@ -45,13 +45,21 @@ from ray_tpu.core.transport import (FrameBuffer, enable_nodelay, send_many,
 
 
 class _AgentWorker:
-    def __init__(self, worker_id: WorkerID, sock, proc):
+    def __init__(self, worker_id: WorkerID, sock, proc,
+                 language: str = "python"):
         self.worker_id = worker_id
         self.hex_id = worker_id.hex()  # stamped on node_done exec spans
         self.sock = sock
         self.send_lock = threading.Lock()
         self.proc = proc
-        self.buffer = FrameBuffer()
+        self.language = language
+        if language == "cpp":
+            # Non-Python workers speak protobuf WorkerFrames end to end
+            # (core/worker_wire.py) — their channel never carries pickle.
+            from ray_tpu.core.worker_wire import WorkerFrameBuffer
+            self.buffer = WorkerFrameBuffer()
+        else:
+            self.buffer = FrameBuffer()
         # Lease frames stage here (appended under the agent's lease lock,
         # so reg_fn/exec ordering is the lock order) and drain under
         # flush_lock: two _pump_leases threads sending directly could
@@ -151,6 +159,23 @@ class NodeAgent:
         }
         for k, v in (resources or {}).items():
             self.resources[k] = float(v)
+        # Cross-language worker capacity: nodes that can spawn the C++
+        # worker binary advertise the CPP capability resource; the head's
+        # normal resource matching then routes language="cpp" tasks here
+        # (each such task reserves CPP: 1).
+        self.cpp_enabled = bool(cfg.cpp_worker_enable)
+        self.cpp_pool = int(cfg.cpp_worker_pool
+                            or max(1, int(self.resources["CPU"])))
+        if self.cpp_enabled and "CPP" not in self.resources:
+            self.resources["CPP"] = float(self.cpp_pool)
+        # language="cpp" lease backlog (kept apart from _lease_q: cpp
+        # leases dispatch only onto cpp workers and never spill — the
+        # spill plane would need the peer to advertise CPP). Guarded by
+        # _lease_lock like the python queue.
+        self._cpp_q: collections.deque = collections.deque()
+        self._cpp_spawns_pending = 0
+        self._cpp_binary: str | None = None
+        self._cpp_build_lock = threading.Lock()
 
         # Peer port: serves whole-object pulls to sibling agents and the
         # head — native C++ threads reading the arena directly (Python
@@ -313,7 +338,14 @@ class NodeAgent:
                 except OSError:
                     pass
         self._send_head(("worker_death", wid))
-        if not self._shutdown and len(self.workers) < self.pool_size:
+        if w.language == "cpp":
+            # cpp workers are on-demand: a death only respawns if backlog
+            # still exists (the pump spawns against _cpp_q depth).
+            self._pump_cpp_leases()
+            return
+        n_python = sum(1 for aw in self.workers.values()
+                       if aw.language == "python")
+        if not self._shutdown and n_python < self.pool_size:
             threading.Thread(target=self._spawn_worker, daemon=True).start()
 
     # ---------------- head link ----------------
@@ -321,10 +353,12 @@ class NodeAgent:
     def _register(self):
         """(Re-)introduce this node to the head, with a worker inventory so
         a restarted head can adopt surviving workers/actors (parity:
-        raylets resyncing with a restarted GCS)."""
+        raylets resyncing with a restarted GCS). Each entry carries the
+        worker's language (WorkerInventory.language) — a restarted head
+        must not adopt a C++ worker into its Python pool."""
         inventory = [(wid, self.worker_actor.get(wid),
-                      self.worker_env_key.get(wid))
-                     for wid in list(self.workers)]
+                      self.worker_env_key.get(wid), w.language)
+                     for wid, w in list(self.workers.items())]
         # Object inventory: the arena outlives a head restart, so the new
         # head rebuilds its object directory from what each node still
         # holds — this is what lets journal-replayed tasks with object
@@ -436,8 +470,9 @@ class NodeAgent:
         without ever locking this node's dispatch state."""
         self._hb_version += 1
         with self._lease_lock:
-            idle = sum(1 for wid in list(self.workers)
-                       if not self._worker_load.get(wid)
+            idle = sum(1 for wid, w in list(self.workers.items())
+                       if w.language == "python"
+                       and not self._worker_load.get(wid)
                        and wid not in self.worker_actor
                        and not self.worker_env_key.get(wid))
             return {"v": self._hb_version, "idle": idle,
@@ -528,7 +563,8 @@ class NodeAgent:
                 for wid, w in list(self.workers.items()):
                     if not self._lease_q:
                         break
-                    if (wid in self.worker_actor
+                    if (w.language != "python"
+                            or wid in self.worker_actor
                             or self.worker_env_key.get(wid)):
                         continue
                     frames = []
@@ -588,6 +624,215 @@ class NodeAgent:
         finally:
             with self._lease_lock:
                 self._spawns_pending = max(0, self._spawns_pending - 1)
+
+    # ---------------- cross-language (cpp) workers ----------------
+    #
+    # Parity: the reference's non-Python worker runtimes (a C++ process
+    # driven by task_executor.cc over core_worker.proto). The agent spawns
+    # cpp/raytpu_worker.cc on demand (compiled through the
+    # _native/build.py content-hash g++ cache — no build-system step),
+    # hands it one socketpair end plus the node's shm arena path, and
+    # dispatches language="cpp" leases as protobuf WorkerFrames
+    # (core/worker_wire.py). No frame the cpp worker reads or writes
+    # carries pickle; args/returns that go through the arena use the
+    # tagged-object layout (object_store.TAGGED_META).
+
+    def _cpp_worker_binary(self) -> str:
+        override = self.config.cpp_worker_binary
+        if override:
+            return override
+        with self._cpp_build_lock:
+            if self._cpp_binary is None:
+                from ray_tpu._native import build as _nb
+                from ray_tpu._native.build import build_binary
+                native_dir = os.path.dirname(os.path.abspath(_nb.__file__))
+                repo = os.path.dirname(os.path.dirname(native_dir))
+                self._cpp_binary = build_binary(
+                    "raytpu_worker",
+                    sources=(os.path.join(repo, "cpp", "raytpu_worker.cc"),
+                             os.path.join(native_dir, "object_store.cpp")),
+                    include_dirs=(os.path.join(repo, "cpp"),))
+            return self._cpp_binary
+
+    def _spawn_cpp_worker(self):
+        """Compile (cached) + exec one C++ worker; registered in the same
+        selector/worker table as Python workers so death, kill_worker and
+        lease bookkeeping take the existing paths."""
+        try:
+            binary = self._cpp_worker_binary()
+            import socket as socket_mod
+            import subprocess
+            worker_id = WorkerID.from_random()
+            parent, child = socket_mod.socketpair(
+                socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+            log_path = os.path.join(self.session_dir, "logs",
+                                    f"cppworker-{worker_id.hex()[:8]}.out")
+            os.makedirs(os.path.dirname(log_path), exist_ok=True)
+            proc = subprocess.Popen(
+                [binary, self.store_path, worker_id.hex(),
+                 str(child.fileno())],
+                pass_fds=[child.fileno()], close_fds=True,
+                stdout=open(log_path, "ab"), stderr=subprocess.STDOUT)
+            child.close()
+            w = _AgentWorker(worker_id, parent, proc, language="cpp")
+            self.workers[worker_id.binary()] = w
+            with self._sel_lock:
+                self._selector.register(parent, selectors.EVENT_READ,
+                                        ("worker", w))
+        except Exception:  # noqa: BLE001 — a failed spawn must not wedge
+            traceback.print_exc()  # the agent; leases fail back via eof
+        finally:
+            with self._lease_lock:
+                self._cpp_spawns_pending = max(
+                    0, self._cpp_spawns_pending - 1)
+        self._pump_cpp_leases()
+
+    _CPP_DEPTH = 2  # pipelined execs per cpp worker (FIFO channel)
+
+    def _pump_cpp_leases(self):
+        """Dispatch queued cpp leases onto cpp workers; spawn more (up to
+        cpp_pool) while backlog outruns them. Dep staging: a lease whose
+        arena deps are not local yet is handed to a fetch thread and
+        re-queued when its objects land."""
+        if not self.cpp_enabled or self._shutdown:
+            return
+        dispatch = []   # (worker, spec)
+        stage = []      # (spec, missing oids)
+        spawn = False
+        with self._lease_lock:
+            cpp_workers = [w for w in self.workers.values()
+                           if w.language == "cpp"]
+            q = self._cpp_q
+            held = []
+            while q:
+                spec = q.popleft()
+                missing = [oid for oid in (spec.dependencies or [])
+                           if not self.store.contains(ObjectID(oid))]
+                if missing:
+                    stage.append((spec, missing))
+                    continue
+                target = None
+                for w in cpp_workers:
+                    if (self._worker_load.get(w.worker_id.binary(), 0)
+                            < self._CPP_DEPTH):
+                        target = w
+                        break
+                if target is None:
+                    held.append(spec)
+                    break
+                wid = target.worker_id.binary()
+                self._lease_inflight[spec.task_id] = (wid, spec)
+                self._worker_load[wid] = self._worker_load.get(wid, 0) + 1
+                if self._tev.enabled:
+                    task_events.emit_task(spec, "NODE_DISPATCHED",
+                                          data={"worker": wid.hex()})
+                dispatch.append((target, spec))
+            held.extend(q)
+            q.clear()
+            q.extend(held)
+            spawn = (bool(q)
+                     and (len(cpp_workers) + self._cpp_spawns_pending)
+                     < self.cpp_pool)
+            if spawn:
+                self._cpp_spawns_pending += 1
+        for w, spec in dispatch:
+            try:
+                from ray_tpu.core import worker_wire
+                frame = worker_wire.encode_exec(spec)
+                with w.send_lock:
+                    w.sock.sendall(frame)
+            except (OSError, ValueError):
+                # eof handling lease-fails the inflight entry; an
+                # encode refusal (non-neutral payload) fails it now.
+                with self._lease_lock:
+                    gone = self._lease_inflight.pop(spec.task_id, None)
+                    wid = w.worker_id.binary()
+                    self._worker_load[wid] = max(
+                        0, self._worker_load.get(wid, 0) - 1)
+                if gone is not None:
+                    self._send_head(("lease_fail", [spec]))
+        for spec, missing in stage:
+            threading.Thread(target=self._stage_cpp_deps,
+                             args=(spec, missing), daemon=True,
+                             name="rtpu-cpp-stage").start()
+        if spawn:
+            threading.Thread(target=self._spawn_cpp_worker,
+                             daemon=True, name="rtpu-cpp-spawn").start()
+
+    def _stage_cpp_deps(self, spec, missing: list):
+        """Pull a cpp lease's arena deps from their owning nodes before
+        dispatch — the cpp worker only reads the LOCAL arena (it has no
+        object-plane RPC surface; parity role: the raylet fetching task
+        args into plasma before assignment)."""
+        ok = True
+        for oid in missing:
+            if self.store.contains(ObjectID(oid)):
+                continue
+            try:
+                addr = self._head_request("object_src", oid)
+                if not addr or not objxfer.fetch_from_peer(
+                        self.store, tuple(addr), oid):
+                    ok = False
+            except Exception:  # noqa: BLE001 — report as a lease failure
+                traceback.print_exc()
+                ok = False
+            if not ok:
+                break
+        if not ok:
+            self._send_head(("lease_fail", [spec]))
+            return
+        with self._lease_lock:
+            self._cpp_q.appendleft(spec)
+        self._pump_cpp_leases()
+
+    def _on_cpp_frames(self, w: _AgentWorker, data: bytes):
+        """Inbound protobuf frames from one cpp worker (hello/done)."""
+        w.buffer.feed(data)
+        done_entries = []
+        for frame in w.buffer.frames():
+            which = frame.WhichOneof("msg")
+            if which == "hello":
+                self._pump_cpp_leases()  # fresh capacity: feed it
+            elif which == "done":
+                e = self._on_cpp_done(w, frame.done)
+                if e is not None:
+                    done_entries.append(e)
+        if done_entries:
+            self._send_head(("node_done", done_entries))
+            self._pump_cpp_leases()
+
+    def _on_cpp_done(self, w: _AgentWorker, done):
+        """One cpp task completion -> a node_done entry. Returns are
+        arena ids (tagged objects, status 'shm'); errors become TaskError
+        payloads HERE, at the language boundary — the worker<->agent
+        frame itself stays pickle-free."""
+        wid = w.worker_id.binary()
+        with self._lease_lock:
+            spec = None
+            popped = self._lease_inflight.pop(done.task_id, None)
+            if popped is not None:
+                spec = popped[1]
+            self._worker_load[wid] = max(
+                0, self._worker_load.get(wid, 0) - 1)
+        if spec is None:
+            return None  # stale done (lease already failed elsewhere)
+        from ray_tpu.core import serialization
+        from ray_tpu.core.status import RayTpuError, TaskError
+        outs = []
+        for o in done.outs:
+            if o.status == "shm":
+                outs.append((o.object_id, "shm", None, None))
+            else:
+                msg = (o.error.data.decode("utf-8", "replace")
+                       if o.error.data else "cpp task failed")
+                err = TaskError.from_exception(
+                    RayTpuError(f"cpp:{spec.name}: {msg}"),
+                    spec.describe())
+                payload, bufs, _ = serialization.serialize_value(err)
+                outs.append((o.object_id, "err", payload, bufs))
+        tev = (done.attempt, done.exec_start, done.args_ready,
+               done.exec_done, done.seal) if done.exec_start else None
+        return (done.task_id, outs, tev, w.hex_id)
 
     # ---------------- lease spillback (agent->agent) ----------------
     #
@@ -854,12 +1099,21 @@ class NodeAgent:
         elif op == "node_exec":
             # Node lease batch: WE pick the workers (raylet-local
             # dispatch); blobs ride along on first sight of a function.
+            # language="cpp" leases route to their own queue — they only
+            # ever dispatch onto cpp workers, over the protobuf plane.
+            any_cpp = False
             with self._lease_lock:
                 for fn_id, blob, spec in msg[1]:
                     if blob is not None:
                         self._fn_blobs[fn_id] = blob
-                    self._lease_q.append(spec)
+                    if getattr(spec, "language", None) == "cpp":
+                        self._cpp_q.append(spec)
+                        any_cpp = True
+                    else:
+                        self._lease_q.append(spec)
             self._pump_leases()
+            if any_cpp:
+                self._pump_cpp_leases()
             self._maybe_push_load_delta()
         elif op == "cluster_view":
             # Head broadcast of the versioned cluster resource view: a
@@ -1241,6 +1495,14 @@ class NodeAgent:
                 else:  # worker
                     if not data:
                         self._on_worker_eof(w)
+                        continue
+                    if w.language == "cpp":
+                        # Protobuf worker plane: decoded apart from the
+                        # pickle framing (and a non-proto frame raises).
+                        try:
+                            self._on_cpp_frames(w, data)
+                        except Exception:
+                            traceback.print_exc()
                         continue
                     # Frames that arrived together in this ONE recv are a
                     # zero-latency batch: their head-bound relays coalesce

@@ -317,6 +317,47 @@ class SharedMemoryStore:
         return {"allocated": a.value, "capacity": c.value,
                 "num_objects": n.value, "num_evictions": e.value}
 
+    # -- tagged-value interface (language-neutral arena objects) --
+    #
+    # Objects sealed with meta == TAGGED_META carry a tagged Value instead
+    # of a pickle: data = [u32 fmt_len][fmt utf8][payload]. This is the
+    # layout the C++ worker (cpp/raytpu_worker.cc) reads zero-copy for
+    # shm-arena task args and writes for its returns — no pickle anywhere
+    # on the cross-language path; Python readers decode it transparently
+    # in get_deserialized below.
+
+    TAGGED_META = b"rtv1"
+
+    def put_tagged(self, object_id: ObjectID, fmt: str, payload) -> int:
+        """Seal a language-neutral tagged value (see TAGGED_META layout)."""
+        fmt_b = fmt.encode()
+        payload = memoryview(payload) if not isinstance(
+            payload, (bytes, bytearray, memoryview)) else payload
+        n = len(payload)
+        total = 4 + len(fmt_b) + n
+        buf = self.create(object_id, total, meta=self.TAGGED_META)
+        try:
+            d = buf.data
+            struct.pack_into("<I", d, 0, len(fmt_b))
+            d[4:4 + len(fmt_b)] = fmt_b
+            d[4 + len(fmt_b):total] = payload
+            buf.seal()
+        except BaseException:
+            buf.abort()
+            raise
+        return total
+
+    def _decode_tagged(self, object_id: ObjectID, data):
+        from ray_tpu.core.proto_wire import decode_tagged
+        try:
+            (fmt_len,) = struct.unpack_from("<I", data, 0)
+            fmt = bytes(data[4:4 + fmt_len]).decode()
+            value = decode_tagged(fmt, data[4 + fmt_len:])
+        finally:
+            data.release()
+            self.release(object_id)
+        return value
+
     # -- serialized-value interface (pickle5 + out-of-band buffers) --
     #
     # Object layout: [u32 npickle][pickle bytes][pad to 64]
@@ -377,6 +418,10 @@ class SharedMemoryStore:
         if res is None:
             return False, None
         data, _meta = res
+        if _meta == self.TAGGED_META:
+            # Language-neutral tagged object (a C++ worker's return, a
+            # cross-language arg, a client-plane put): no pickle involved.
+            return True, self._decode_tagged(object_id, data)
         (npickle,) = struct.unpack_from("<I", data, 0)
         payload = data[4 : 4 + npickle]
         head = 4 + npickle

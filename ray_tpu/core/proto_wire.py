@@ -48,31 +48,27 @@ def _addr_in(msg, host_field, port_field):
 # ---- client-plane tagged values (language-neutral) ----
 
 
-def encode_value(obj, *, allow_pickle: bool = True) -> pb.Value:
-    """Python value -> tagged Value a non-Python frontend can decode.
-
-    allow_pickle=False is the PLANE-LEVEL neutrality assertion (VERDICT
-    r4 #7): planes a non-Python participant reads set it so a value that
-    cannot be represented tagged fails loudly at the sender instead of
-    silently shipping an opaque pickle — one carelessly-added message
-    type must not re-open the hole the tagged encoding closed."""
+def encode_tagged(obj, *, allow_pickle: bool = True) -> tuple[str, bytes]:
+    """Python value -> (format, data) of the tagged encoding — the pb-free
+    core of encode_value, shared with the shm arena's tagged-object layout
+    (object_store.put_tagged: what a C++ worker reads zero-copy)."""
     import struct as _struct
     if obj is None:
-        return pb.Value(data=b"", format="none")
+        return "none", b""
     if isinstance(obj, bool):
-        return pb.Value(data=b"\x01" if obj else b"\x00", format="bool")
+        return "bool", (b"\x01" if obj else b"\x00")
     if isinstance(obj, int):
         try:
-            return pb.Value(data=_struct.pack("<q", obj), format="i64")
+            return "i64", _struct.pack("<q", obj)
         except _struct.error:  # outside signed-64 range: decimal JSON
             import json as _json
-            return pb.Value(data=_json.dumps(obj).encode(), format="json")
+            return "json", _json.dumps(obj).encode()
     if isinstance(obj, float):
-        return pb.Value(data=_struct.pack("<d", obj), format="f64")
+        return "f64", _struct.pack("<d", obj)
     if isinstance(obj, str):
-        return pb.Value(data=obj.encode(), format="utf8")
+        return "utf8", obj.encode()
     if isinstance(obj, (bytes, bytearray, memoryview)):
-        return pb.Value(data=bytes(obj), format="raw")
+        return "raw", bytes(obj)
     if isinstance(obj, (list, tuple, dict)) and _json_clean(obj):
         # Containers of JSON-able values stay language-neutral (tuples
         # decode as lists — JSON semantics, same as the reference's
@@ -81,12 +77,52 @@ def encode_value(obj, *, allow_pickle: bool = True) -> pb.Value:
         # json.dumps would silently coerce non-string dict keys instead
         # of raising, corrupting the round trip.
         import json as _json
-        return pb.Value(data=_json.dumps(obj).encode(), format="json")
+        return "json", _json.dumps(obj).encode()
     if not allow_pickle:
         raise ValueError(
             f"value of type {type(obj).__name__} has no language-neutral "
             f"tagged encoding and this plane asserts no-pickle")
-    return pb.Value(data=pickle.dumps(obj, protocol=5), format="pickle")
+    return "pickle", pickle.dumps(obj, protocol=5)
+
+
+def encode_value(obj, *, allow_pickle: bool = True) -> pb.Value:
+    """Python value -> tagged Value a non-Python frontend can decode.
+
+    allow_pickle=False is the PLANE-LEVEL neutrality assertion (VERDICT
+    r4 #7): planes a non-Python participant reads set it so a value that
+    cannot be represented tagged fails loudly at the sender instead of
+    silently shipping an opaque pickle — one carelessly-added message
+    type must not re-open the hole the tagged encoding closed."""
+    fmt, data = encode_tagged(obj, allow_pickle=allow_pickle)
+    return pb.Value(data=data, format=fmt)
+
+
+def decode_tagged(fmt: str, data, *, allow_pickle: bool = True):
+    """(format, data) -> Python value — the pb-free core of decode_value,
+    shared with the arena's tagged-object layout."""
+    import struct as _struct
+    if fmt == "pickle" and not allow_pickle:
+        raise ValueError(
+            "received a pickle-format Value on a plane that asserts "
+            "no-pickle")
+    if fmt in ("none", ""):
+        return None
+    if fmt == "bool":
+        return bytes(data) != b"\x00"
+    if fmt == "i64":
+        return _struct.unpack("<q", data)[0]
+    if fmt == "f64":
+        return _struct.unpack("<d", data)[0]
+    if fmt == "utf8":
+        return bytes(data).decode()
+    if fmt == "raw":
+        return bytes(data)
+    if fmt == "pickle":
+        return pickle.loads(data)
+    if fmt == "json":
+        import json
+        return json.loads(bytes(data))
+    raise ValueError(f"unknown Value format {fmt!r}")
 
 
 def _json_clean(obj) -> bool:
@@ -108,30 +144,7 @@ def _json_clean(obj) -> bool:
 
 
 def decode_value(v: pb.Value, *, allow_pickle: bool = True):
-    import struct as _struct
-    fmt = v.format
-    if fmt == "pickle" and not allow_pickle:
-        raise ValueError(
-            "received a pickle-format Value on a plane that asserts "
-            "no-pickle")
-    if fmt in ("none", ""):
-        return None
-    if fmt == "bool":
-        return v.data != b"\x00"
-    if fmt == "i64":
-        return _struct.unpack("<q", v.data)[0]
-    if fmt == "f64":
-        return _struct.unpack("<d", v.data)[0]
-    if fmt == "utf8":
-        return v.data.decode()
-    if fmt == "raw":
-        return v.data
-    if fmt == "pickle":
-        return pickle.loads(v.data)
-    if fmt == "json":
-        import json
-        return json.loads(v.data)
-    raise ValueError(f"unknown Value format {fmt!r}")
+    return decode_tagged(v.format, v.data, allow_pickle=allow_pickle)
 
 
 def encode_task_args(proto_args, kwargs: dict | None = None) -> bytes:
@@ -186,6 +199,12 @@ def to_wire(msg) -> bytes | None:
         for item in inventory:
             wid, aid = item[0], item[1]
             env_key = item[2] if len(item) > 2 else None
+            if len(item) > 3 and item[3] not in (None, "python"):
+                # WorkerInventory.language (raytpu.proto field 4) is not in
+                # the checked-in bindings yet (no protoc in this build
+                # env): a non-Python worker entry rides the pickle framing
+                # until the next regen so the language survives the trip.
+                return None
             e = r.inventory.add()
             e.worker_id = wid
             e.actor_id = aid or b""

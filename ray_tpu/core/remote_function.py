@@ -136,6 +136,103 @@ class RemoteFunction:
         return out[0] if num_returns == 1 else out
 
 
+class CppFunction:
+    """Handle for a task executed by a C++ worker (parity: the reference's
+    cross-language calls by function descriptor — here the descriptor is a
+    native symbol name registered in cpp/raytpu_worker.cc).
+
+    Obtained via `ray_tpu.cpp_function("rt.add_i64")` or
+    `@ray_tpu.remote(language="cpp")` (the decorated body is never run —
+    its __name__ is the symbol). `.remote(*args)` encodes every argument
+    as a tagged Value (no pickle; non-neutral args fail loudly at the
+    caller), large bytes and ObjectRef args ride the shm arena in the
+    tagged-object layout, and the head leases the task onto a node
+    advertising the CPP capability resource."""
+
+    # Bytes args above this seal into the arena as tagged objects instead
+    # of riding inline in the TaskArgs payload (same motivation as
+    # max_inline_arg_bytes on the Python path).
+    ARENA_ARG_THRESHOLD = 256 * 1024
+
+    def __init__(self, symbol: str, **default_options):
+        self._symbol = symbol
+        self._options = dict(default_options)
+        self._options.pop("language", None)
+        self._options.pop("symbol", None)
+        self.__name__ = symbol
+
+    def options(self, **opts):
+        merged = {**self._options, **opts}
+        return CppFunction(self._symbol, **merged)
+
+    def __call__(self, *a, **kw):
+        raise TypeError(
+            f"cpp function {self._symbol} cannot be called directly; "
+            f"use .remote()")
+
+    def remote(self, *args, **kwargs):
+        from ray_tpu.core import proto_wire
+        from ray_tpu.core.runtime import Runtime, get_runtime
+        from ray_tpu.protocol import raytpu_pb2 as pb
+        if kwargs:
+            raise TypeError(
+                "cpp tasks take positional arguments only (native symbols "
+                "have no kwargs)")
+        rt = get_runtime()
+        opts = self._options
+        proto_args = []
+        deps: list[bytes] = []
+        pinned_refs = []  # keep promoted refs alive until submit pins them
+        for a in args:
+            if isinstance(a, ObjectRef):
+                deps.append(a.id.binary())
+                proto_args.append(pb.Arg(object_id=a.id.binary()))
+                continue
+            if (isinstance(a, (bytes, bytearray, memoryview))
+                    and len(a) > self.ARENA_ARG_THRESHOLD
+                    and isinstance(rt, Runtime)):
+                ref = rt.put_tagged(bytes(a))
+                pinned_refs.append(ref)
+                deps.append(ref.id.binary())
+                proto_args.append(pb.Arg(object_id=ref.id.binary()))
+                continue
+            arg = pb.Arg()
+            arg.value.CopyFrom(
+                proto_wire.encode_value(a, allow_pickle=False))
+            proto_args.append(arg)
+        payload = proto_wire.encode_task_args(proto_args)
+        num_returns = int(opts.get("num_returns", 1))
+        max_retries = int(opts.get("max_retries",
+                                   get_config().task_max_retries_default))
+        rnd = random_bytes(16 + 16 * num_returns)
+        spec = TaskSpec(
+            task_id=rnd[:16],
+            fn_id=None,
+            name=self._symbol,
+            payload=payload,
+            payload_format="proto",
+            language="cpp",
+            buffers=[],
+            return_ids=[rnd[16 + 16 * i: 32 + 16 * i]
+                        for i in range(num_returns)],
+            num_cpus=opts.get("num_cpus", 1),
+            num_tpus=0,
+            resources={"CPP": 1.0, **(opts.get("resources") or {})},
+            max_retries=max_retries,
+            retries_left=max_retries,
+            scheduling_strategy=opts.get("scheduling_strategy"),
+            dependencies=deps,
+            idempotent=bool(opts.get("idempotent", False)),
+        )
+        if isinstance(rt, Runtime):
+            rt.submit_task(spec)
+        else:
+            rt.submit(spec)
+        del pinned_refs  # submit pinned the deps; arg refs may die now
+        out = [ObjectRef(ObjectID(rid)) for rid in spec.return_ids]
+        return out[0] if num_returns == 1 else out
+
+
 def _promote_large(rt, value):
     """ray.put large array-like args implicitly (parity: remote_function.py
     inlines <100KB, ray.put's the rest)."""

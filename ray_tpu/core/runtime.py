@@ -2102,6 +2102,13 @@ class Runtime:
             for item in inventory:
                 wid, aid = item[0], item[1]
                 env_key = item[2] if len(item) > 2 else None
+                language = item[3] if len(item) > 3 else None
+                if language not in (None, "python"):
+                    # Non-Python workers are agent-local executors on the
+                    # lease plane; the head never dispatches to them
+                    # directly, so no handle is built (adopting one into
+                    # the Python pool would wedge the first pickle exec).
+                    continue
                 w = self.workers.get(wid)
                 if w is None:
                     w = RemoteWorkerHandle(WorkerID(wid), conn, nid)
@@ -2164,6 +2171,21 @@ class Runtime:
                 if (n is not None and n.state == "ALIVE"
                         and n.ctrl_addr):
                     resp = tuple(n.ctrl_addr)
+            elif what == "object_src":
+                # Peer address of a node holding `arg` in its arena — the
+                # agent-side dep staging for cpp leases pulls from here.
+                e = self.directory.lookup(arg)
+                if e is not None and e[0] == "shm":
+                    for nid2 in e[1]:
+                        n2 = self.nodes.get(nid2)
+                        if (n2 is not None and n2.state == "ALIVE"
+                                and n2.peer_addr):
+                            resp = tuple(n2.peer_addr)
+                            break
+                    else:
+                        head_pa = getattr(self, "head_peer_addr", None)
+                        if self.head_node_id in e[1] and head_pa:
+                            resp = tuple(head_pa)
             try:
                 conn.send(("agent_resp", req_id, resp))
             except OSError:
@@ -2623,6 +2645,32 @@ class Runtime:
         self.put_in_store(oid, value)
         self.directory.put(oid.binary(), ("shm", {self.head_node_id}))
         return ObjectRef(oid)
+
+    def put_tagged(self, value) -> "ObjectRef":
+        """put() in the language-neutral tagged arena layout (see
+        object_store.TAGGED_META): the sealed object is readable by
+        non-Python workers zero-copy — and by Python readers through the
+        normal get path. Raises if `value` has no tagged encoding (the
+        no-pickle assertion runs at the sender)."""
+        from ray_tpu.core import proto_wire
+        from ray_tpu.core.object_ref import ObjectRef
+        fmt, data = proto_wire.encode_tagged(value, allow_pickle=False)
+        oid = ObjectID.from_random()
+        self.put_tagged_store(oid, fmt, data)
+        self.directory.put(oid.binary(), ("shm", {self.head_node_id}))
+        return ObjectRef(oid)
+
+    def put_tagged_store(self, oid: "ObjectID", fmt: str, data) -> None:
+        """Seal (format, bytes) into the head arena with spill headroom —
+        the tagged-layout sibling of put_in_store."""
+        from ray_tpu.core.status import ObjectStoreFullError
+        self._ensure_headroom(len(data) + 64)
+        try:
+            self.store.put_tagged(oid, fmt, data)
+        except ObjectStoreFullError:
+            if not self._spill_bytes(int(len(data) * 1.5) + (1 << 20)):
+                raise
+            self.store.put_tagged(oid, fmt, data)
 
     def put_arg_object(self, value, nbytes) -> bytes:
         """Store one offloaded-args pack (serialization.maybe_offload_args)
@@ -4017,8 +4065,14 @@ class Runtime:
 
     @staticmethod
     def _lease_ok(spec: TaskSpec, env_key) -> bool:
+        # cpp tasks lease WITH dependencies: their deps are ready
+        # cluster-wide by queue time (the dep gate ran), and the agent
+        # stages them into its local arena before dispatch — the cpp
+        # worker has no object-plane RPC surface of its own.
         return (env_key is None and spec.actor_id is None
-                and not spec.streaming and not spec.dependencies)
+                and not spec.streaming
+                and (not spec.dependencies
+                     or getattr(spec, "language", None) == "cpp"))
 
     def _lease_refill_locked(self, node: NodeState,
                              completed: int = 1) -> list:

@@ -59,6 +59,13 @@ class TaskSpec:
                            # that name a PREVIOUS grant of the same task —
                            # acting on one would re-point or re-enqueue a
                            # live lease (duplicate execution / lost replay)
+        "language",        # None/"python" | "cpp" — which worker runtime
+                           # executes this task. cpp tasks address a native
+                           # symbol by `name`, carry a language-neutral
+                           # TaskArgs payload (payload_format="proto"), and
+                           # are dispatched agent-side onto a C++ worker
+                           # over the protobuf worker plane (no pickle on
+                           # any frame the executing worker reads/writes)
         "exec_ts",         # worker-local scratch: [exec_start, args_ready,
                            # exec_done] wall stamps collected during
                            # execution, packed into ONE task event at

@@ -1,0 +1,195 @@
+"""Protobuf wire for the agent <-> non-Python-worker exec plane.
+
+Parity: the reference's core worker RPC surface as seen by its C++/Java
+worker runtimes (`core_worker.proto:457` PushTask/returns +
+`cpp/src/ray/runtime/task/task_executor.cc`). A `language="cpp"` worker
+speaks length-prefixed protobuf frames on its agent socket — the SAME
+outer framing as every other channel (`<Q len><I nbufs>` with the nbufs
+MSB proto flag, transport.py) — but the payload is a `raytpu.WorkerFrame`
+instead of an AgentFrame, and NO pickle ever rides the channel: dispatch
+carries a `raytpu.TaskSpec` whose payload is a tagged `TaskArgs`, returns
+come back as arena object ids (sealed tagged — object_store.TAGGED_META).
+
+The checked-in protoc bindings predate these messages (this build env
+ships no protoc — see raytpu.proto), so the message classes are built at
+import time from hand-authored `FileDescriptorProto`s against the same
+descriptor pool the generated module uses. The C++ side hand-rolls the
+matching varint codec (cpp/pb/raytpu.pb.h); raytpu.proto documents the
+schema for the next regen.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+
+from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
+
+import ray_tpu.protocol.raytpu_pb2 as pb  # noqa: F401 — loads raytpu.proto
+
+_F = descriptor_pb2.FieldDescriptorProto
+
+
+def _msg(f, name, fields):
+    """Add one message: fields = [(name, number, type, type_name|None,
+    repeated)]."""
+    m = f.message_type.add()
+    m.name = name
+    for fname, num, ftype, tname, rep in fields:
+        fd = m.field.add()
+        fd.name = fname
+        fd.number = num
+        fd.type = ftype
+        fd.label = (_F.LABEL_REPEATED if rep else _F.LABEL_OPTIONAL)
+        if tname:
+            fd.type_name = tname
+    return m
+
+
+def _build():
+    pool = descriptor_pool.Default()
+    f = descriptor_pb2.FileDescriptorProto()
+    f.name = "ray_tpu/protocol/raytpu_worker.proto"
+    f.package = "raytpu"
+    f.syntax = "proto3"
+    f.dependency.append("ray_tpu/protocol/raytpu.proto")
+    _msg(f, "WorkerHello", [
+        ("worker_id", 1, _F.TYPE_BYTES, None, False),
+        ("pid", 2, _F.TYPE_INT64, None, False),
+        ("language", 3, _F.TYPE_STRING, None, False),
+        ("symbols", 4, _F.TYPE_STRING, None, True),
+    ])
+    _msg(f, "WorkerExec", [
+        ("spec", 1, _F.TYPE_MESSAGE, ".raytpu.TaskSpec", False),
+    ])
+    _msg(f, "WorkerOut", [
+        ("object_id", 1, _F.TYPE_BYTES, None, False),
+        ("status", 2, _F.TYPE_STRING, None, False),  # "shm" | "err"
+        ("error", 3, _F.TYPE_MESSAGE, ".raytpu.Value", False),
+    ])
+    _msg(f, "WorkerDone", [
+        ("task_id", 1, _F.TYPE_BYTES, None, False),
+        ("outs", 2, _F.TYPE_MESSAGE, ".raytpu.WorkerOut", True),
+        # Piggybacked exec record (the Python worker's done-frame tuple):
+        # (attempt, exec_start, args_ready, exec_done, seal).
+        ("attempt", 3, _F.TYPE_INT64, None, False),
+        ("exec_start", 4, _F.TYPE_DOUBLE, None, False),
+        ("args_ready", 5, _F.TYPE_DOUBLE, None, False),
+        ("exec_done", 6, _F.TYPE_DOUBLE, None, False),
+        ("seal", 7, _F.TYPE_DOUBLE, None, False),
+    ])
+    _msg(f, "WorkerShutdown", [])
+    wf = _msg(f, "WorkerFrame", [
+        ("hello", 1, _F.TYPE_MESSAGE, ".raytpu.WorkerHello", False),
+        ("exec", 2, _F.TYPE_MESSAGE, ".raytpu.WorkerExec", False),
+        ("done", 3, _F.TYPE_MESSAGE, ".raytpu.WorkerDone", False),
+        ("shutdown", 4, _F.TYPE_MESSAGE, ".raytpu.WorkerShutdown", False),
+    ])
+    oo = wf.oneof_decl.add()
+    oo.name = "msg"
+    for fd in wf.field:
+        fd.oneof_index = 0
+    try:
+        pool.Add(f)
+    except Exception:  # noqa: BLE001 — already added (module re-import)
+        pass
+
+    def cls(name):
+        return message_factory.GetMessageClass(
+            pool.FindMessageTypeByName(f"raytpu.{name}"))
+
+    return {n: cls(n) for n in ("WorkerHello", "WorkerExec", "WorkerOut",
+                                "WorkerDone", "WorkerShutdown",
+                                "WorkerFrame")}
+
+
+_CLASSES = _build()
+WorkerFrame = _CLASSES["WorkerFrame"]
+
+# Outer framing shared with transport.py: <Q payload_len><I nbufs> with
+# the nbufs MSB marking a protobuf payload. EVERY frame on a cpp-worker
+# channel carries the flag — the C++ worker rejects anything else (its
+# half of the no-pickle plane assertion).
+_HDR = struct.Struct("<Q")
+_NBUF = struct.Struct("<I")
+_PROTO_FLAG = 0x80000000
+
+
+def frame_bytes(payload: bytes) -> bytes:
+    return _HDR.pack(len(payload)) + _NBUF.pack(_PROTO_FLAG) + payload
+
+
+def send_frame(sock, msg, lock: threading.Lock | None = None):
+    data = frame_bytes(msg.SerializeToString())
+    if lock:
+        with lock:
+            sock.sendall(data)
+    else:
+        sock.sendall(data)
+
+
+def spec_to_pb(spec):
+    """Python TaskSpec -> raytpu.TaskSpec for the cpp worker plane.
+
+    Requires the language-neutral payload form (payload_format="proto"
+    with a serialized TaskArgs): anything else would smuggle pickle onto
+    the plane, so it fails loudly at the sender."""
+    if getattr(spec, "payload_format", None) != "proto":
+        raise ValueError(
+            f"task {spec.describe()} is language={spec.language!r} but its "
+            "payload is not a tagged TaskArgs (payload_format != 'proto'); "
+            "the cpp worker plane asserts no-pickle")
+    m = pb.TaskSpec()
+    m.task_id = spec.task_id
+    m.name = spec.name or ""
+    m.payload.data = spec.payload
+    m.payload.format = "task_args"
+    for rid in spec.return_ids or []:
+        m.return_ids.append(rid)
+    m.num_cpus = float(spec.num_cpus or 0)
+    m.max_retries = int(spec.max_retries or 0)
+    m.retries_left = int(spec.retries_left or 0)
+    return m
+
+
+def encode_exec(spec) -> bytes:
+    f = WorkerFrame()
+    f.exec.spec.CopyFrom(spec_to_pb(spec))
+    return frame_bytes(f.SerializeToString())
+
+
+def encode_shutdown() -> bytes:
+    f = WorkerFrame()
+    f.shutdown.SetInParent()
+    return frame_bytes(f.SerializeToString())
+
+
+class WorkerFrameBuffer:
+    """Incremental decoder for a cpp worker's channel: same outer framing
+    as transport.FrameBuffer, but payloads parse as WorkerFrame (and a
+    frame WITHOUT the proto flag is a protocol violation, not a pickle)."""
+
+    def __init__(self):
+        self._buf = bytearray()
+
+    def feed(self, data: bytes):
+        self._buf.extend(data)
+
+    def frames(self) -> list:
+        out = []
+        pre = _HDR.size + _NBUF.size
+        while len(self._buf) >= pre:
+            (n,) = _HDR.unpack_from(self._buf, 0)
+            (nbufs,) = _NBUF.unpack_from(self._buf, _HDR.size)
+            if not nbufs & _PROTO_FLAG:
+                raise ValueError(
+                    "cpp worker sent a non-protobuf frame (no-pickle plane "
+                    "violation)")
+            if len(self._buf) < pre + n:
+                break
+            payload = bytes(self._buf[pre:pre + n])
+            del self._buf[:pre + n]
+            f = WorkerFrame()
+            f.ParseFromString(payload)
+            out.append(f)
+        return out

@@ -61,6 +61,21 @@ function badge(value) {
 
 const STATE_COLS = new Set(["state", "status", "alive", "job_status"]);
 
+/* Drill-down linkification: id columns navigate per-node -> per-worker
+   -> per-task detail views (the reference frontend's entity pages). */
+const LINK_COLS = {
+  node_id: (v) => `#/node?id=${encodeURIComponent(v)}`,
+  worker_id: (v) => `#/worker?id=${encodeURIComponent(v)}`,
+  task_id: (v) => `#/task?id=${encodeURIComponent(v)}`,
+};
+
+function cellHTML(c, v) {
+  if (STATE_COLS.has(c)) return badge(v);
+  if (LINK_COLS[c] && typeof v === "string" && v)
+    return `<a class="drill" href="${LINK_COLS[c](v)}">${esc(v)}</a>`;
+  return esc(JSON.stringify(v));
+}
+
 function renderTable(view, rows) {
   const st = tableState[view] ||= { filter: "", sortCol: null, asc: false };
   let cols = rows.length ? Object.keys(rows[0]) : [];
@@ -84,8 +99,7 @@ function renderTable(view, rows) {
       "sorted" + (st.asc ? " asc" : "") : ""}">${esc(c)}</th>`).join("");
   const body = shown.length ? shown.map((r) =>
     `<tr>${cols.map((c) => `<td title="${esc(JSON.stringify(r[c]))}">` +
-      (STATE_COLS.has(c) ? badge(r[c]) : esc(JSON.stringify(r[c])))
-      + "</td>").join("")}</tr>`).join("")
+      cellHTML(c, r[c]) + "</td>").join("")}</tr>`).join("")
     : `<tr><td class="empty">(empty)</td></tr>`;
   return `
     <div class="toolbar">
@@ -386,6 +400,167 @@ async function viewLogs() {
   });
 }
 
+/* ---------------- drill-down detail views ----------------
+   #/node?id=…  -> the node's header + its workers + recent tasks
+   #/worker?id=… -> exec history of one worker + its log tail
+   #/task?id=…  -> one task's timeline phases + fn rollup + log tail
+   All derived from the existing /api/timeline, /api/task_summary,
+   /api/nodes, /api/workers and /api/logs endpoints. */
+
+function hashParam(name) {
+  const m = location.hash.match(new RegExp("[?&]" + name + "=([^&]*)"));
+  return m ? decodeURIComponent(m[1]) : "";
+}
+
+function backLink(view, label) {
+  return `<p class="footer"><a href="#/${view}">&larr; ${label}</a></p>`;
+}
+
+/* Pair B/E trace events per (pid, tid, name) stack; X events pass
+   through. Returns [{name, pid, tid, ts, dur, args}] (us). */
+function traceSlices(trace) {
+  const out = [], open = {};
+  trace.forEach((ev) => {
+    if (ev.ph === "X") out.push(ev);
+    else if (ev.ph === "B") {
+      (open[`${ev.pid}|${ev.tid}|${ev.name}`] ||= []).push(ev);
+    } else if (ev.ph === "E") {
+      const stack = open[`${ev.pid}|${ev.tid}|${ev.name}`];
+      const b = stack && stack.pop();
+      if (b) out.push({ ...b, ph: "X", dur: ev.ts - b.ts });
+    }
+  });
+  return out;
+}
+
+function phaseBars(slices) {
+  // Minimal horizontal phase chart: offset/duration bars over the task's
+  // whole span (the chrome-trace view, inlined for one task).
+  if (!slices.length) return "<p>(no timeline phases recorded)</p>";
+  const t0 = Math.min(...slices.map((s) => s.ts));
+  const t1 = Math.max(...slices.map((s) => s.ts + (s.dur || 0)), t0 + 1);
+  const rows = slices.map((s) => {
+    const left = ((s.ts - t0) / (t1 - t0) * 100).toFixed(2);
+    const width = Math.max(0.5, (s.dur || 0) / (t1 - t0) * 100).toFixed(2);
+    const ms = ((s.dur || 0) / 1000).toFixed(3);
+    return `<div class="phase-row" data-phase="${esc(s.name)}">
+      <span class="phase-label">${esc(s.name)}
+        <i class="muted">${esc(String(s.tid || ""))}</i></span>
+      <span class="phase-track"><span class="phase-bar"
+        style="left:${left}%;width:${width}%"></span></span>
+      <span class="phase-ms">${ms} ms</span></div>`;
+  }).join("");
+  return `<div class="phases">${rows}</div>`;
+}
+
+async function logTailHTML(fileName, lines) {
+  // Worker logs live on the head node; agent-node worker logs are not
+  // served from here — degrade to a note instead of an error page.
+  try {
+    const resp = await fetch(
+      `/api/logs?file=${encodeURIComponent(fileName)}&tail=${lines}`);
+    if (!resp.ok) throw new Error(String(resp.status));
+    const text = await resp.text();
+    return `<h3>log tail: ${esc(fileName)}</h3>` +
+      `<pre class="logview" id="tasklog">${esc(text)}</pre>`;
+  } catch (e) {
+    return `<p class="muted">no log file ${esc(fileName)} on the head ` +
+      `node (agent-node workers log locally)</p>`;
+  }
+}
+
+async function viewNodeDetail() {
+  const id = hashParam("id");
+  const [nodes, workers, trace] = await Promise.all([
+    getJSON("/api/nodes"), getJSON("/api/workers"),
+    getJSON("/api/timeline")]);
+  const node = nodes.find((n) => n.node_id === id);
+  const mine = workers.filter((w) => w.node_id === id);
+  // Tasks recently seen on this node's rows (lease/exec/spill slices).
+  const seen = new Map();
+  traceSlices(trace).forEach((ev) => {
+    const a = ev.args || {};
+    if (ev.pid === `node:${id}` && a.task_id)
+      seen.set(a.task_id, {
+        task_id: a.task_id, what: ev.name, state: a.state || "",
+        ms: ((ev.dur || 0) / 1000).toFixed(3),
+      });
+  });
+  $("#main").innerHTML =
+    `<h2 class="drill-title">node ${esc(id)}</h2>` +
+    (node ? `<div class="cards">
+      <div class="card"><b>${badge(node.alive)}</b><span>alive</span></div>
+      <div class="card"><b>${esc(node.hostname || "?")}</b>
+        <span>host</span></div>
+      <div class="card"><b>${esc(JSON.stringify(node.resources))}</b>
+        <span>resources</span></div></div>`
+      : `<p>(unknown node)</p>`) +
+    `<h3>workers (${mine.length})</h3>` + renderTable("node_workers", mine) +
+    `<h3>recent tasks on this node</h3>` +
+    renderTable("node_tasks", [...seen.values()]) +
+    backLink("nodes", "all nodes");
+}
+
+async function viewWorkerDetail() {
+  const id = hashParam("id");
+  const trace = await getJSON("/api/timeline");
+  const rows = traceSlices(trace)
+    .filter((ev) => ev.tid === `worker:${id}`
+            && (ev.args || {}).task_id)
+    .map((ev) => ({
+      task_id: ev.args.task_id, phase: ev.name,
+      state: ev.args.state || "", attempt: ev.args.attempt,
+      start: new Date(ev.ts / 1000).toLocaleTimeString(),
+      ms: +((ev.dur || 0) / 1000).toFixed(3),
+    }));
+  $("#main").innerHTML =
+    `<h2 class="drill-title">worker ${esc(id)}</h2>` +
+    `<h3>executed tasks</h3>` + renderTable("worker_tasks", rows) +
+    await logTailHTML(`worker-${id.slice(0, 8)}.out`, 100) +
+    backLink("workers", "all workers");
+}
+
+async function viewTaskDetail() {
+  const id = hashParam("id");
+  const [trace, summary] = await Promise.all([
+    getJSON("/api/timeline"), getJSON("/api/task_summary")]);
+  // Sub-spans (deserialize_args/execute/store_outputs) carry no task
+  // args; keep only ones nested inside this task's exec windows.
+  const mine = traceSlices(trace).filter(
+    (ev) => (ev.args || {}).task_id === id);
+  const windows = mine.map((ev) => [ev.ts, ev.ts + (ev.dur || 0), ev.tid]);
+  const subs = traceSlices(trace).filter((ev) => !(ev.args || {}).task_id
+    && windows.some(([a, b, tid]) => ev.tid === tid && ev.ts >= a
+      && ev.ts + (ev.dur || 0) <= b + 1));
+  const all = [...mine, ...subs].sort((a, b) => a.ts - b.ts);
+  const exec = mine.find((ev) => String(ev.name).startsWith("exec:"));
+  const fn = mine.length
+    ? String(mine[0].name).replace(/^[a-z_]+:/, "") : "";
+  const roll = ((summary || {}).tasks || {})[fn];
+  const wid = exec ? String(exec.tid).replace(/^worker:/, "") : "";
+  $("#main").innerHTML =
+    `<h2 class="drill-title">task ${esc(id)}</h2>` +
+    `<div class="cards">
+      <div class="card"><b>${esc(fn || "?")}</b><span>function</span></div>
+      <div class="card"><b>${mine.length ? badge(
+        (mine[0].args || {}).state || "?") : "?"}</b><span>state</span>
+      </div>
+      ${roll ? `<div class="card"><b>${roll.mean_exec_ms ?? "?"}</b>
+        <span>fn mean exec ms</span></div>
+      <div class="card"><b>${roll.mean_queue_ms ?? "?"}</b>
+        <span>fn mean queue ms</span></div>` : ""}
+    </div>` +
+    `<h3>timeline phases</h3>` + phaseBars(all) +
+    (wid ? `<p>executed on <a class="drill" href="#/worker?id=` +
+      `${encodeURIComponent(wid)}">worker ${esc(wid)}</a></p>` +
+      await logTailHTML(`worker-${wid.slice(0, 8)}.out`, 60) : "") +
+    backLink("tasks", "all tasks");
+}
+
+const DETAIL_VIEWS = {
+  node: viewNodeDetail, worker: viewWorkerDetail, task: viewTaskDetail,
+};
+
 /* ---------------- router + refresh loop ---------------- */
 
 let refreshTimer = null;
@@ -393,6 +568,15 @@ let refreshTimer = null;
 async function render() {
   renderNav();
   $("#clock").textContent = new Date().toLocaleTimeString();
+  const detail = location.hash.match(/^#\/(node|worker|task)\?/);
+  if (detail) {
+    try {
+      await DETAIL_VIEWS[detail[1]]();
+    } catch (e) {
+      $("#main").innerHTML = `<p>${esc(e)}</p>`;
+    }
+    return;
+  }
   const view = currentView();
   try {
     if (view === "overview") await viewOverview();

@@ -34,6 +34,27 @@ import numpy as np
 from ray_tpu.models import ModelConfig, init_params
 from ray_tpu.ops.layers import apply_rope, rmsnorm, rope
 
+# ---- shared compiled-step cache -------------------------------------
+# Engines used to create their own jax.jit wrappers, so two engines with
+# the SAME model config re-traced and re-compiled every step variant from
+# scratch (each wrapper owns a private executable cache). Keying the
+# wrappers process-globally on (step, model config, static lowering args)
+# lets every engine with equal statics share one wrapper — and therefore
+# one compile per input-shape bucket. This is what keeps a test suite (or
+# a serve process hosting several replicas of one model) from paying the
+# prefill/decode compile tax per engine instance. Shapes/shardings stay
+# OUT of the key: the wrapper's own aval-keyed cache handles those.
+_JIT_CACHE: dict[tuple, object] = {}
+_JIT_CACHE_LOCK = threading.Lock()
+
+
+def _shared_jit(key: tuple, factory):
+    with _JIT_CACHE_LOCK:
+        fn = _JIT_CACHE.get(key)
+        if fn is None:
+            fn = _JIT_CACHE[key] = factory()
+        return fn
+
 
 @dataclasses.dataclass(frozen=True)
 class EngineConfig:
@@ -777,17 +798,22 @@ class InferenceEngine:
             self._guide_fp = None
             # Donate the pool/cache: without donation every step round-trips
             # the full KV through a fresh HBM allocation (~GBs/step).
-            self._insert_batch = jax.jit(insert_pages_batch,
-                                         donate_argnums=(0, 1))
+            self._insert_batch = _shared_jit(
+                ("insert_pages_batch",),
+                lambda: jax.jit(insert_pages_batch, donate_argnums=(0, 1)))
             self._prefill_batches: dict[tuple, object] = {}
         else:
             kv_shape = (c.n_layers, e.max_slots, e.max_len, c.n_kv_heads,
                         c.head_dim)
             self.cache_k = jnp.zeros(kv_shape, c.jdtype)
             self.cache_v = jnp.zeros(kv_shape, c.jdtype)
-            self._insert = jax.jit(insert_kv, donate_argnums=(0, 1))
-            self._decode = jax.jit(partial(decode_step, config=c),
-                                   donate_argnums=(1, 2))
+            self._insert = _shared_jit(
+                ("insert_kv",),
+                lambda: jax.jit(insert_kv, donate_argnums=(0, 1)))
+            self._decode = _shared_jit(
+                ("decode_step", c),
+                lambda: jax.jit(partial(decode_step, config=c),
+                                donate_argnums=(1, 2)))
         if kv_sharding is not None:
             self.cache_k = jax.device_put(self.cache_k, kv_sharding)
             self.cache_v = jax.device_put(self.cache_v, kv_sharding)
@@ -808,15 +834,18 @@ class InferenceEngine:
         self.spec_accepted = 0
         self._spec_alpha = 0.0  # acceptance-rate EMA (window sizing)
 
-        self._prefill = jax.jit(partial(prefill, config=c))
+        self._prefill = _shared_jit(
+            ("prefill", c), lambda: jax.jit(partial(prefill, config=c)))
         # Two compiled samplers: the plain one (no sorts) serves the
         # default top_k=0/top_p=1 case on the hot decode loop; the
         # truncating one compiles the top-k/top-p masking only when some
         # request asks for it.
-        self._sample = jax.jit(sample)
-        self._sample_trunc = jax.jit(
-            lambda lg, t, k, p, tk, m=None: sample(lg, t, k, top_p=p,
-                                                   top_k=tk, mask=m))
+        self._sample = _shared_jit(("sample",), lambda: jax.jit(sample))
+        self._sample_trunc = _shared_jit(
+            ("sample_trunc",),
+            lambda: jax.jit(
+                lambda lg, t, k, p, tk, m=None: sample(lg, t, k, top_p=p,
+                                                       top_k=tk, mask=m)))
         self._key = jax.random.PRNGKey(seed + 1)
 
         # host-side slot state
@@ -1135,8 +1164,10 @@ class InferenceEngine:
             key = (n_pad, bucket, pre_bucket)
             fn = self._prefill_pre.get(key)
             if fn is None:
-                fn = jax.jit(partial(prefill_with_prefix_batch,
-                                     config=self.c))
+                fn = _shared_jit(
+                    ("prefill_with_prefix_batch", self.c),
+                    lambda: jax.jit(partial(prefill_with_prefix_batch,
+                                            config=self.c)))
                 self._prefill_pre[key] = fn
             logits, ks, vs = fn(
                 self.params, jnp.asarray(toks), self.cache_k,
@@ -1164,7 +1195,9 @@ class InferenceEngine:
             key = (n_pad, bucket)
             fn = self._prefill_batches.get(key)
             if fn is None:
-                fn = jax.jit(partial(prefill_batch, config=self.c))
+                fn = _shared_jit(
+                    ("prefill_batch", self.c),
+                    lambda: jax.jit(partial(prefill_batch, config=self.c)))
                 self._prefill_batches[key] = fn
             logits, ks, vs = fn(self.params, jnp.asarray(toks))
             self.cache_k, self.cache_v = self._insert_batch(
@@ -1428,8 +1461,10 @@ class InferenceEngine:
         p_bucket = tables.shape[1]
         fn = self._decode_paged.get(p_bucket)
         if fn is None:
-            fn = jax.jit(partial(decode_paged, config=self.c),
-                         donate_argnums=(1, 2))
+            fn = _shared_jit(
+                ("decode_paged", self.c),
+                lambda: jax.jit(partial(decode_paged, config=self.c),
+                                donate_argnums=(1, 2)))
             self._decode_paged[p_bucket] = fn
         logits, self.cache_k, self.cache_v = fn(
             self.params, self.cache_k, self.cache_v,
@@ -1578,12 +1613,17 @@ class InferenceEngine:
                gtables_d.shape if guided else None, want_logp)
         fn = self._window_fns.get(key)
         if fn is None:
-            fn = jax.jit(
-                partial(decode_window, config=self.c,
-                        eos_token=int(self.e.eos_token),
-                        n_steps=k_bucket, trunc=trunc, guided=guided,
-                        want_logp=want_logp),
-                donate_argnums=(1, 2, 3, 4, 5, 12))
+            # Static lowering args in the shared key; shapes stay out
+            # (the wrapper's aval cache covers them).
+            fn = _shared_jit(
+                ("decode_window", self.c, int(self.e.eos_token),
+                 k_bucket, trunc, guided, want_logp),
+                lambda: jax.jit(
+                    partial(decode_window, config=self.c,
+                            eos_token=int(self.e.eos_token),
+                            n_steps=k_bucket, trunc=trunc, guided=guided,
+                            want_logp=want_logp),
+                    donate_argnums=(1, 2, 3, 4, 5, 12)))
             self._window_fns[key] = fn
         toks_d, lens_d, act_d = self._dev
         temps_d, tps_d, tks_d = self._dev_sampling
@@ -1684,10 +1724,12 @@ class InferenceEngine:
         key = (tables.shape[1], iters)
         fn = self._spec_window_fns.get(key)
         if fn is None:
-            fn = jax.jit(partial(decode_window_spec, config=self.c,
-                                 eos_token=int(e.eos_token),
-                                 n_steps=iters, spec_k=K),
-                         donate_argnums=(1, 2, 3, 4, 5, 6, 9))
+            fn = _shared_jit(
+                ("decode_window_spec", self.c, int(e.eos_token), iters, K),
+                lambda: jax.jit(partial(decode_window_spec, config=self.c,
+                                        eos_token=int(e.eos_token),
+                                        n_steps=iters, spec_k=K),
+                                donate_argnums=(1, 2, 3, 4, 5, 6, 9)))
             self._spec_window_fns[key] = fn
         self._sync_sampling()
         temps_d = self._dev_sampling[0]

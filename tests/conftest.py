@@ -77,6 +77,21 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.smoke)
 
 
+@pytest.fixture(scope="session")
+def tiny_llm_params():
+    """ONE set of tiny-transformer params for every LLM-engine test file
+    (test_llm / test_spec_decode / test_guided build byte-identical TINY
+    configs; re-running init_params per module was pure wall-time). Paired
+    with the engine's process-global shared compiled-step cache
+    (llm/engine.py _shared_jit), which de-duplicates prefill/decode
+    compiles across engine INSTANCES — the two together keep the
+    compile-heavy LLM tier inside the tier-1 timeout."""
+    from ray_tpu.models import ModelConfig, init_params
+    cfg = ModelConfig(vocab=300, d_model=64, n_layers=2, n_heads=4,
+                      n_kv_heads=2, d_ff=128, dtype="float32")
+    return cfg, init_params(cfg, jax.random.PRNGKey(0))
+
+
 @pytest.fixture(scope="module")
 def ray_start_regular():
     """A real head runtime with a small worker pool, shared per module."""

@@ -21,8 +21,12 @@ TINY = ModelConfig(vocab=300, d_model=64, n_layers=2, n_heads=4,
 
 
 @pytest.fixture(scope="module")
-def tiny_params():
-    return init_params(TINY, jax.random.PRNGKey(0))
+def tiny_params(tiny_llm_params):
+    # Session-shared params (conftest.py): identical TINY config across
+    # the LLM test files, initialized once per test run.
+    cfg, params = tiny_llm_params
+    assert cfg == TINY
+    return params
 
 
 def _naive_greedy(params, prompt, n):

@@ -86,6 +86,74 @@ def test_metrics_and_dashboard(tooling_cluster):
         stop_dashboard()
 
 
+def test_dashboard_drilldown(tooling_cluster):
+    """DOM/API snapshot of the per-node -> per-worker -> per-task
+    drill-down (VERDICT directive #10): the served SPA carries the detail
+    routes + linkified id columns, and the API payloads the detail views
+    are built from hold their contract — timeline exec slices carry
+    task_id/worker ids, /api/task_summary rolls up the function, and the
+    executing worker's log tails through /api/logs."""
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+    @ray_tpu.remote
+    def drill(x):
+        return x * 2
+
+    assert ray_tpu.get([drill.remote(i) for i in range(3)],
+                       timeout=60) == [0, 2, 4]
+    rt = tooling_cluster
+    rt.sync_task_store()
+
+    addr = start_dashboard()
+    try:
+        def get(path):
+            with urllib.request.urlopen(f"http://{addr}{path}",
+                                        timeout=10) as r:
+                return r.read().decode()
+
+        # -- DOM snapshot: the SPA ships the drill-down machinery --
+        app_js = get("/assets/app.js")
+        for marker in ("#/node?id=", "#/worker?id=", "#/task?id=",
+                       "viewNodeDetail", "viewWorkerDetail",
+                       "viewTaskDetail", "phaseBars", "LINK_COLS",
+                       'class="drill"'):
+            assert marker in app_js, marker
+        css = get("/assets/style.css")
+        assert ".phase-bar" in css and "a.drill" in css
+        assert "app.js" in get("/")
+
+        # -- API contract the detail views consume --
+        trace = json.loads(get("/api/timeline"))
+        execs = [ev for ev in trace
+                 if ev.get("ph") == "B"
+                 and str(ev.get("name", "")).startswith("exec:drill")
+                 and ev.get("args", {}).get("task_id")]
+        assert execs, "timeline lost the exec slices drill-down links on"
+        ev = execs[0]
+        task_id = ev["args"]["task_id"]
+        worker_hex = str(ev["tid"]).replace("worker:", "")
+        assert len(task_id) == 32
+        # the per-task view needs the sub-span phases on the same row
+        subs = {e["name"] for e in trace
+                if e.get("tid") == ev["tid"] and e.get("ph") == "B"}
+        assert {"deserialize_args", "execute", "store_outputs"} <= subs
+        # function rollup backing the task-detail summary cards
+        summary = json.loads(get("/api/task_summary"))
+        assert "drill" in summary["tasks"]
+        assert summary["tasks"]["drill"]["mean_exec_ms"] is not None
+        # workers table rows link node->worker (both id columns present)
+        workers = json.loads(get("/api/workers"))
+        assert any(w["worker_id"] == worker_hex for w in workers)
+        assert all("node_id" in w for w in workers)
+        # the worker's log tail the task view embeds
+        logs = json.loads(get("/api/logs"))
+        fname = f"worker-{worker_hex[:8]}.out"
+        assert fname in logs
+        get(f"/api/logs?file={fname}&tail=5")  # 200 = tailable
+    finally:
+        stop_dashboard()
+
+
 def test_job_submission(tooling_cluster):
     from ray_tpu.job_submission import JobSubmissionClient
 
