@@ -1,0 +1,156 @@
+// Multi-threaded stress harness for the sharded shm object store —
+// compiled with -fsanitize=thread and RUN (not just built) by the
+// sanitizer tier (tests/test_sanitizers.py; parity: the reference's
+// bazel --config=tsan CI actually executing its store tests).
+//
+// The workload follows the store's usage contract exactly — write only
+// between a successful create and the seal, read only between a
+// successful get and the release — so every TSan report is a real
+// synchronization bug in object_store.cpp (shard mutexes, global extent
+// list, lock-free stats/lru-clock), not harness noise. The arena is
+// deliberately small: eviction, cross-shard victim sweeps, and the
+// global free list all run under contention.
+//
+//   argv: [n_threads] [iters_per_thread] [arena_mb]
+
+#include <pthread.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mman.h>
+
+#include <atomic>
+#include <cstdint>
+#include <vector>
+
+extern "C" {
+int store_init(void* base, uint64_t total_size, uint64_t num_slots,
+               uint64_t nshards);
+int store_validate(void* base);
+int store_create(void* base, const uint8_t* id, uint64_t data_size,
+                 uint64_t meta_size, uint64_t* out_offset);
+int store_seal(void* base, const uint8_t* id);
+int store_get(void* base, const uint8_t* id, uint64_t* out_offset,
+              uint64_t* out_data_size, uint64_t* out_meta_size);
+int store_release(void* base, const uint8_t* id);
+int store_contains(void* base, const uint8_t* id);
+int store_delete(void* base, const uint8_t* id);
+void store_stats(void* base, uint64_t* out_allocated, uint64_t* out_capacity,
+                 uint64_t* out_objects, uint64_t* out_evictions);
+}
+
+namespace {
+
+void* g_base = nullptr;
+std::atomic<uint64_t> g_errors{0};
+std::atomic<uint64_t> g_seals{0};
+std::atomic<uint64_t> g_hits{0};
+
+// Object ids are 16 bytes; (tid, slot) keys collide across threads by
+// construction: slot is shared modulo space, so create/create races,
+// get-while-create and delete-under-get all occur.
+void make_id(uint8_t id[16], uint64_t tid, uint64_t slot) {
+  memset(id, 0, 16);
+  memcpy(id, &slot, 8);
+  memcpy(id + 8, &tid, 8);
+}
+
+struct Args {
+  uint64_t tid;
+  uint64_t iters;
+  uint64_t nthreads;
+};
+
+void* worker(void* argp) {
+  Args* a = static_cast<Args*>(argp);
+  uint64_t x = a->tid * 2654435761u + 1;  // xorshift-ish per-thread rng
+  auto rnd = [&x]() {
+    x ^= x << 13;
+    x ^= x >> 7;
+    x ^= x << 17;
+    return x;
+  };
+  const uint64_t kSlots = 64;  // shared id space across ALL threads
+  for (uint64_t i = 0; i < a->iters; i++) {
+    uint8_t id[16];
+    uint64_t op = rnd() % 10;
+    if (op < 5) {  // create -> fill -> seal (own a shared slot)
+      make_id(id, rnd() % a->nthreads, rnd() % kSlots);
+      // Mix of fastbin-, shard-cache- and global-extent-sized blocks.
+      uint64_t sizes[] = {96, 1024, 8192, 70000, 500000};
+      uint64_t size = sizes[rnd() % 5];
+      uint64_t off = 0;
+      int rc = store_create(g_base, id, size, 4, &off);
+      if (rc == 0) {
+        char* dst = static_cast<char*>(g_base) + off;
+        memset(dst, static_cast<int>(i & 0xff), size);
+        memcpy(dst + size, "meta", 4);
+        if (store_seal(g_base, id) == 0) g_seals.fetch_add(1);
+      }
+    } else if (op < 8) {  // get -> read -> release
+      make_id(id, rnd() % a->nthreads, rnd() % kSlots);
+      uint64_t off = 0, dsz = 0, msz = 0;
+      if (store_get(g_base, id, &off, &dsz, &msz) == 0) {
+        const volatile char* p =
+            static_cast<const char*>(g_base) + off;
+        uint64_t acc = 0;
+        for (uint64_t j = 0; j < dsz; j += 512) acc += p[j];
+        (void)acc;
+        store_release(g_base, id);
+        g_hits.fetch_add(1);
+      }
+    } else if (op == 8) {
+      make_id(id, rnd() % a->nthreads, rnd() % kSlots);
+      store_contains(g_base, id);
+    } else {  // delete (refcounted objects survive; sealed idle ones go)
+      make_id(id, rnd() % a->nthreads, rnd() % kSlots);
+      store_delete(g_base, id);
+    }
+  }
+  return nullptr;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  uint64_t nthreads = argc > 1 ? strtoull(argv[1], nullptr, 10) : 8;
+  uint64_t iters = argc > 2 ? strtoull(argv[2], nullptr, 10) : 3000;
+  uint64_t arena_mb = argc > 3 ? strtoull(argv[3], nullptr, 10) : 48;
+
+  uint64_t total = arena_mb << 20;
+  g_base = mmap(nullptr, total, PROT_READ | PROT_WRITE,
+                MAP_SHARED | MAP_ANONYMOUS, -1, 0);
+  if (g_base == MAP_FAILED) {
+    perror("mmap");
+    return 2;
+  }
+  if (store_init(g_base, total, 2048, 4) != 0) {
+    fprintf(stderr, "store_init failed\n");
+    return 2;
+  }
+  std::vector<pthread_t> threads(nthreads);
+  std::vector<Args> args(nthreads);
+  for (uint64_t t = 0; t < nthreads; t++) {
+    args[t] = Args{t, iters, nthreads};
+    if (pthread_create(&threads[t], nullptr, worker, &args[t]) != 0) {
+      fprintf(stderr, "pthread_create failed\n");
+      return 2;
+    }
+  }
+  for (uint64_t t = 0; t < nthreads; t++) pthread_join(threads[t], nullptr);
+
+  if (store_validate(g_base) != 0) {
+    fprintf(stderr, "store corrupt after stress\n");
+    return 1;
+  }
+  uint64_t allocated = 0, capacity = 0, objects = 0, evictions = 0;
+  store_stats(g_base, &allocated, &capacity, &objects, &evictions);
+  printf("STRESS_OK threads=%llu iters=%llu seals=%llu hits=%llu "
+         "objects=%llu evictions=%llu allocated=%llu\n",
+         (unsigned long long)nthreads, (unsigned long long)iters,
+         (unsigned long long)g_seals.load(),
+         (unsigned long long)g_hits.load(),
+         (unsigned long long)objects, (unsigned long long)evictions,
+         (unsigned long long)allocated);
+  return 0;
+}

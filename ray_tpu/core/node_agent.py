@@ -647,6 +647,9 @@ class NodeAgent:
                 from ray_tpu._native.build import build_binary
                 native_dir = os.path.dirname(os.path.abspath(_nb.__file__))
                 repo = os.path.dirname(os.path.dirname(native_dir))
+                # staticcheck: ok blocking-under-lock — the build lock
+                # exists to hold concurrent spawns THROUGH one compile
+                # (cache stampede); only cpp spawn threads contend it.
                 self._cpp_binary = build_binary(
                     "raytpu_worker",
                     sources=(os.path.join(repo, "cpp", "raytpu_worker.cc"),
@@ -668,11 +671,18 @@ class NodeAgent:
             log_path = os.path.join(self.session_dir, "logs",
                                     f"cppworker-{worker_id.hex()[:8]}.out")
             os.makedirs(os.path.dirname(log_path), exist_ok=True)
-            proc = subprocess.Popen(
-                [binary, self.store_path, worker_id.hex(),
-                 str(child.fileno())],
-                pass_fds=[child.fileno()], close_fds=True,
-                stdout=open(log_path, "ab"), stderr=subprocess.STDOUT)
+            # Close the parent's log-fd copy after the spawn (Popen dups
+            # it into the child) — an inline open() leaked one fd per
+            # cpp-worker spawn for the agent's lifetime.
+            logf = open(log_path, "ab")
+            try:
+                proc = subprocess.Popen(
+                    [binary, self.store_path, worker_id.hex(),
+                     str(child.fileno())],
+                    pass_fds=[child.fileno()], close_fds=True,
+                    stdout=logf, stderr=subprocess.STDOUT)
+            finally:
+                logf.close()
             child.close()
             w = _AgentWorker(worker_id, parent, proc, language="cpp")
             self.workers[worker_id.binary()] = w
@@ -1209,7 +1219,18 @@ class NodeAgent:
             except Exception:  # noqa: BLE001 — fall back to head
                 return None
         conn = _PeerConn(self, sock, nid=nid)
-        conn.send(("peer_hello", self.node_id))
+        try:
+            conn.send(("peer_hello", self.node_id))
+        except OSError:
+            # Peer died between connect and hello: close the orphan fd
+            # (no reader thread owns it yet) and report "unreachable" —
+            # an escaping OSError would kill the dial thread and leave
+            # _dial_and_flush's _dial_pending entry wedged forever.
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return None
         conn.start()
         return conn
 

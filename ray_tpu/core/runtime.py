@@ -217,31 +217,38 @@ def spawn_worker_process(worker_id: WorkerID, store_path: str, env: dict,
             socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
         python = env.get("RAY_TPU_PYTHON") or sys.executable
         image = env.get("RAY_TPU_CONTAINER_IMAGE", "")
-        if image:
-            # Container wrapper (podman --preserve-fds=1 maps fd 3): the
-            # worker's socketpair end must sit at exactly fd 3 inside.
-            # close_fds=False + preexec dup2: dup2's result fd has no
-            # CLOEXEC so it survives exec, while every other parent fd is
-            # CLOEXEC by Python default (pass_fds can't express "keep the
-            # fd I will only create in the child's preexec").
-            from ray_tpu.core.runtime_env import container_worker_argv
-            repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
-                os.path.abspath(__file__))))
-            fd = child.fileno()
-            cmd = (container_worker_argv(image, session_dir, repo_root)
-                   + ["python", "-m", "ray_tpu.core.worker",
-                      store_path, worker_id.hex(), "3"])
-            proc = _on_spawner_thread(lambda: subprocess.Popen(
-                cmd, env=env, close_fds=False,
-                preexec_fn=lambda: os.dup2(fd, 3),
-                stdout=open(log_path, "ab"), stderr=subprocess.STDOUT))
-        else:
-            proc = _on_spawner_thread(lambda: subprocess.Popen(
-                [python, "-m", "ray_tpu.core.worker",
-                 store_path, worker_id.hex(), str(child.fileno())],
-                pass_fds=[child.fileno()], env=env,
-                close_fds=True, stdout=open(log_path, "ab"),
-                stderr=subprocess.STDOUT))
+        # Popen dups stdout into the child, so the parent's copy closes
+        # right after the spawn — one leaked log fd per spawn otherwise.
+        logf = open(log_path, "ab")
+        try:
+            if image:
+                # Container wrapper (podman --preserve-fds=1 maps fd 3):
+                # the worker's socketpair end must sit at exactly fd 3
+                # inside. close_fds=False + preexec dup2: dup2's result fd
+                # has no CLOEXEC so it survives exec, while every other
+                # parent fd is CLOEXEC by Python default (pass_fds can't
+                # express "keep the fd I will only create in the child's
+                # preexec").
+                from ray_tpu.core.runtime_env import container_worker_argv
+                repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))))
+                fd = child.fileno()
+                cmd = (container_worker_argv(image, session_dir, repo_root)
+                       + ["python", "-m", "ray_tpu.core.worker",
+                          store_path, worker_id.hex(), "3"])
+                proc = _on_spawner_thread(lambda: subprocess.Popen(
+                    cmd, env=env, close_fds=False,
+                    preexec_fn=lambda: os.dup2(fd, 3),
+                    stdout=logf, stderr=subprocess.STDOUT))
+            else:
+                proc = _on_spawner_thread(lambda: subprocess.Popen(
+                    [python, "-m", "ray_tpu.core.worker",
+                     store_path, worker_id.hex(), str(child.fileno())],
+                    pass_fds=[child.fileno()], env=env,
+                    close_fds=True, stdout=logf,
+                    stderr=subprocess.STDOUT))
+        finally:
+            logf.close()
     child.close()
     return parent, proc
 
@@ -425,12 +432,16 @@ class _Zygote:
         import socket as socket_mod
         parent, child = socket_mod.socketpair(
             socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
-        self.proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.worker", "--zygote",
-             store_path, str(child.fileno())],
-            pass_fds=[child.fileno()], env=env, close_fds=True,
-            stdout=open(os.path.join(session_dir, "logs", "zygote.out"), "ab"),
-            stderr=subprocess.STDOUT)
+        # Parent's log-fd copy closes after the spawn (Popen dup'd it).
+        logf = open(os.path.join(session_dir, "logs", "zygote.out"), "ab")
+        try:
+            self.proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu.core.worker", "--zygote",
+                 store_path, str(child.fileno())],
+                pass_fds=[child.fileno()], env=env, close_fds=True,
+                stdout=logf, stderr=subprocess.STDOUT)
+        finally:
+            logf.close()
         child.close()
         self.sock = parent
         self.lock = threading.Lock()
@@ -466,6 +477,9 @@ class _Zygote:
                 # Bounded: a wedged zygote must not freeze spawning/kills
                 # forever while we hold the lock — poison and fall back.
                 self.sock.settimeout(15.0)
+                # staticcheck: ok blocking-under-lock — self.lock IS this
+                # channel's serialization lock (one req/reply in flight),
+                # and the settimeout above bounds the stall.
                 self.sock.sendmsg([req], rights or [])
                 buf = self._recv_exact(4)
                 if buf is None:
@@ -3189,6 +3203,8 @@ class Runtime:
             if st is None:
                 return None  # fully consumed + closed earlier
             while len(st["items"]) <= idx and not st["done"]:
+                # staticcheck: ok cv-wait-foreign-lock — st["cv"] is
+                # Condition(self.lock), so wait() releases the held lock.
                 if not st["cv"].wait(timeout):
                     from ray_tpu.core.status import GetTimeoutError
                     raise GetTimeoutError(

@@ -690,7 +690,13 @@ class WorkerRuntime:
                 conn = self._peer_conns.get(path)
             if conn is None or not conn.alive:
                 s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-                s.connect(path)
+                try:
+                    s.connect(path)
+                except OSError:
+                    # The dial failed before any owner existed: close
+                    # here or the fd leaks on every stale-path retry.
+                    s.close()
+                    raise
                 fresh = _WorkerPeer(self, s, initiated=True)
                 fresh.path = path
                 with self._peer_lock:
@@ -1449,6 +1455,8 @@ def zygote_main(store_path: str, ctrl_fd: int):
 
     signal.signal(signal.SIGCHLD, _reap)
     ctrl = socket_from_fd(ctrl_fd)
+    # staticcheck: ok fd-use-unguarded — process-lifetime socket: the
+    # zygote exits with its ctrl channel; any failure here kills it.
     ctrl.sendall(b"RDY0")
     fdsize = array.array("i").itemsize
     while True:

@@ -298,8 +298,11 @@ def read_sql(sql: str, connection_factory: Callable, *,
 
     Parity: reference `data.read_sql` (`read_api.py` — connection_factory
     + optional sharding). With `shard_keys` and parallelism > 1 the query
-    is split into hash shards `WHERE MOD(ABS(<key expr>), P) = i`, one
-    read task each; otherwise one task runs the query whole.
+    is split into hash shards `WHERE (ABS(<key expr>) % P) = i`, one
+    read task each; otherwise one task runs the query whole. The `%`
+    operator (not `MOD()`) keeps the predicate portable: sqlite only
+    ships MOD() when compiled with math functions, and every DBAPI
+    backend we shard against (sqlite/MySQL/Postgres) accepts `%`.
     """
     def run_query(query: str) -> pa.Table:
         conn = connection_factory()
@@ -317,7 +320,7 @@ def read_sql(sql: str, connection_factory: Callable, *,
     if shard_keys and parallelism > 1:
         key = " + ".join(f"CAST({k} AS INTEGER)" for k in shard_keys)
         queries = [
-            f"SELECT * FROM ({sql}) AS _rtpu_shard WHERE MOD(ABS({key}), "
+            f"SELECT * FROM ({sql}) AS _rtpu_shard WHERE (ABS({key}) % "
             f"{parallelism}) = {i}"
             for i in range(parallelism)]
     else:

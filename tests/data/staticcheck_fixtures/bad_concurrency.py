@@ -1,0 +1,74 @@
+"""Seeded violations for the concurrency pass — one per rule.
+
+NOT imported anywhere; tools/staticcheck analyzes it as data. Every
+violation here must be detected (tests/test_staticcheck.py pins each),
+and clean_module.py holds the corrected twins.
+"""
+
+import pickle
+import socket
+import subprocess
+import threading
+import time
+
+
+class BadAgent:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state_lock = threading.Lock()
+        self._other_lock = threading.Lock()
+        self._cv = threading.Condition()
+        self.send_lock = threading.Lock()
+        self.sock = socket.socket()
+        self.items = []
+
+    def send_under_state_lock(self, frame):
+        # VIOLATION blocking-under-lock: a state lock held across a
+        # socket write stalls every reader of self.items on peer I/O.
+        with self._state_lock:
+            self.items.append(frame)
+            self.sock.sendall(frame)
+
+    def sleep_under_lock(self):
+        with self._lock:
+            time.sleep(0.5)  # VIOLATION blocking-under-lock
+
+    def pickle_under_lock(self, payload):
+        with self._lock:
+            return pickle.dumps(payload)  # VIOLATION blocking-under-lock
+
+    def subprocess_under_lock(self):
+        with self._lock:
+            subprocess.run(["true"])  # VIOLATION blocking-under-lock
+
+    def wait_foreign(self):
+        # VIOLATION cv-wait-foreign-lock: _cv.wait() only releases _cv's
+        # own lock; _state_lock stays held across the park.
+        with self._state_lock:
+            with self._cv:
+                self._cv.wait()
+
+    def relock_direct(self):
+        with self._lock:
+            with self._lock:  # VIOLATION relock (non-reentrant)
+                pass
+
+    def takes_lock(self):
+        with self._lock:
+            self.items.clear()
+
+    def relock_via_call(self):
+        with self._lock:
+            self.takes_lock()  # VIOLATION relock (callee retakes _lock)
+
+    # ---- lock-order inversion pair (VIOLATION lock-order-cycle) ----
+
+    def order_ab(self):
+        with self._state_lock:
+            with self._other_lock:
+                pass
+
+    def order_ba(self):
+        with self._other_lock:
+            with self._state_lock:
+                pass

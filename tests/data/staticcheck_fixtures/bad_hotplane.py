@@ -1,0 +1,29 @@
+"""Seeded violations for the hot-plane no-pickle pass (analyzed as data,
+never imported). `stage_leaf` poses as a tensor-payload-path function
+that smuggles pickle back in; `frame_codec` as a whole-module-banned
+proto-frame helper."""
+
+import pickle
+
+
+def stage_leaf(buf, leaf):
+    # VIOLATION pickle-on-hot-plane: payload path pickling tensor bytes.
+    raw = pickle.dumps(leaf)
+    buf[: len(raw)] = raw
+
+
+def sidecar_meta(skeleton):
+    # Not in the banned scope list: the skeleton sidecar MAY pickle.
+    return pickle.dumps(skeleton)
+
+
+class FakeChannel:
+    def copy_leaf(self, off, leaf):
+        # VIOLATION pickle-on-hot-plane (class-qualified scope).
+        import cloudpickle
+        return cloudpickle.dumps(leaf)
+
+    def write_meta(self, value):
+        # VIOLATION when the module is scoped as module-level no-pickle.
+        from ray_tpu.core import serialization
+        return serialization.serialize_value(value)

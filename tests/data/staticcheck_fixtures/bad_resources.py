@@ -1,0 +1,31 @@
+"""Seeded violations for the resource-hygiene pass (analyzed as data,
+never imported)."""
+
+import socket
+import subprocess
+import threading
+
+
+def spawn_with_inline_log(cmd, log_path):
+    # VIOLATION fd-inline-arg: the log fd has no name, so no closer.
+    return subprocess.Popen(cmd, stdout=open(log_path, "ab"))
+
+
+def leaky_probe(addr):
+    # VIOLATION fd-no-closer: never closed, never escapes.
+    s = socket.socket()
+    return 42
+
+
+def dial_unguarded(path):
+    s = socket.socket()
+    try:
+        s.connect(path)  # VIOLATION fd-use-unguarded: handler drops s
+    except OSError:
+        return None
+    return s
+
+
+def fire_and_forget(worker):
+    # VIOLATION unjoined-thread: non-daemon, nobody joins it.
+    threading.Thread(target=worker).start()

@@ -1,0 +1,72 @@
+"""The corrected twins of the bad_* fixtures: every shape the passes
+flag, done right — the non-detection half of each rule's test."""
+
+import socket
+import subprocess
+import threading
+import time
+
+
+class CleanAgent:
+    def __init__(self):
+        self._state_lock = threading.Lock()
+        self._cv = threading.Condition()
+        self.send_lock = threading.Lock()
+        self.sock = socket.socket()
+        self.items = []
+
+    def send_outside_lock(self, frame):
+        with self._state_lock:
+            self.items.append(frame)
+        # Send AFTER the state lock drops; send_lock only serializes
+        # this socket's writes (the sanctioned pattern).
+        with self.send_lock:
+            self.sock.sendall(frame)
+
+    def sleep_outside_lock(self):
+        with self._state_lock:
+            n = len(self.items)
+        time.sleep(0.01 * n)
+
+    def wait_own_cv(self):
+        with self._cv:
+            self._cv.wait(0.1)
+
+    def consistent_order(self):
+        with self._state_lock:
+            with self._cv:
+                pass  # same order as every other site: no cycle
+
+
+def spawn_with_owned_log(cmd, log_path):
+    logf = open(log_path, "ab")
+    try:
+        return subprocess.Popen(cmd, stdout=logf)
+    finally:
+        logf.close()
+
+
+def dial_guarded(path):
+    s = socket.socket()
+    try:
+        s.connect(path)
+    except OSError:
+        s.close()
+        return None
+    return s
+
+
+def probe_and_close(addr):
+    s = socket.socket()
+    s.close()
+    return 42
+
+
+def run_joined(worker):
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+
+
+def run_daemon(worker):
+    threading.Thread(target=worker, daemon=True).start()

@@ -1,0 +1,159 @@
+"""Sanitizers in RUN mode (promoted from the PR 5 build-only gates).
+
+Parity: the reference's bazel --config=tsan/asan CI tiers EXECUTE the
+sanitized binaries; compiling under a sanitizer proves nothing about
+races. Heavy-marked: sanitized builds are -O1 and TSan slows the stress
+~10x, so the default contained-wall tier (`-m "not heavy"`) skips them
+while tier-1 (which only excludes `slow`) still runs both.
+
+  TSan — a multi-threaded create/seal/get/release/delete storm over the
+  sharded shm store (cpp/object_store_stress.cc linked with
+  object_store.cpp), sized to force evictions and cross-shard victim
+  sweeps. halt_on_error turns any data race into a nonzero exit.
+
+  ASan — the C++ worker's full smoke path actually executes: register
+  (hello), inline-arg exec, zero-copy arena-arg exec, error surfacing,
+  shutdown — the same frames the agent speaks, driven straight over a
+  socketpair so no cluster boot is needed.
+"""
+
+import os
+import socket
+import struct
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_CPP = os.path.join(REPO, "cpp")
+_NATIVE = os.path.join(REPO, "ray_tpu", "_native")
+
+
+@pytest.mark.heavy
+def test_tsan_object_store_stress_runs_clean():
+    from ray_tpu._native.build import build_binary
+    binary = build_binary(
+        "object_store_stress",
+        sources=(os.path.join(_CPP, "object_store_stress.cc"),
+                 os.path.join(_NATIVE, "object_store.cpp")),
+        sanitizer="thread")
+    assert "-tsan" in binary
+    # 16MB arena + 500KB blocks force evictions + cross-shard sweeps.
+    r = subprocess.run(
+        [binary, "4", "2000", "16"], capture_output=True, text=True,
+        timeout=300,
+        env={**os.environ,
+             "TSAN_OPTIONS": "halt_on_error=1 exitcode=66"})
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, out[-4000:]
+    assert "ThreadSanitizer" not in out, out[-4000:]
+    assert "STRESS_OK" in r.stdout
+    # The workload actually contended: seals and cross-thread hits > 0.
+    stats = dict(kv.split("=") for kv in r.stdout.split()[1:])
+    assert int(stats["seals"]) > 0 and int(stats["hits"]) > 0, stats
+
+
+@pytest.mark.heavy
+def test_asan_worker_smoke_runs_clean(tmp_path):
+    from ray_tpu._native.build import build_binary
+    from ray_tpu.core import worker_wire
+    from ray_tpu.core.ids import ObjectID
+    from ray_tpu.core.object_store import SharedMemoryStore
+    from ray_tpu.protocol import raytpu_pb2 as pb
+
+    binary = build_binary(
+        "raytpu_worker",
+        sources=(os.path.join(_CPP, "raytpu_worker.cc"),
+                 os.path.join(_NATIVE, "object_store.cpp")),
+        include_dirs=(_CPP,), sanitizer="address")
+    assert "-asan" in binary
+
+    store_path = str(tmp_path / "store")
+    store = SharedMemoryStore(store_path, size=16 << 20, num_slots=1024,
+                              create=True, num_shards=2)
+    parent, child = socket.socketpair(socket.AF_UNIX, socket.SOCK_STREAM)
+    log = tmp_path / "cppworker.log"
+    logf = open(log, "ab")
+    try:
+        proc = subprocess.Popen(
+            [binary, store_path, os.urandom(8).hex(),
+             str(child.fileno())],
+            pass_fds=[child.fileno()], close_fds=True, stdout=logf,
+            stderr=subprocess.STDOUT,
+            # Leak checking off: the worker os-exits with its mmap and
+            # registry live by design; the smoke gates memory ERRORS.
+            env={**os.environ,
+                 "ASAN_OPTIONS": "detect_leaks=0 exitcode=66"})
+    finally:
+        logf.close()
+    child.close()
+
+    fb = worker_wire.WorkerFrameBuffer()
+
+    def read_frame(timeout=60):
+        parent.settimeout(timeout)
+        while True:
+            frames = fb.frames()
+            if frames:
+                return frames[0]
+            data = parent.recv(1 << 16)
+            assert data, "cpp worker hung up early"
+            fb.feed(data)
+
+    def exec_task(name, args, rids):
+        ta = pb.TaskArgs()
+        for fmt, data, oid in args:
+            a = ta.args.add()
+            if oid is not None:
+                a.object_id = oid
+            else:
+                a.value.format = fmt
+                a.value.data = data
+        f = worker_wire.WorkerFrame()
+        f.exec.spec.task_id = os.urandom(16)
+        f.exec.spec.name = name
+        f.exec.spec.payload.data = ta.SerializeToString()
+        f.exec.spec.payload.format = "task_args"
+        for r in rids:
+            f.exec.spec.return_ids.append(r)
+        parent.sendall(worker_wire.frame_bytes(f.SerializeToString()))
+        return read_frame()
+
+    try:
+        hello = read_frame()
+        assert hello.WhichOneof("msg") == "hello"
+        assert hello.hello.language == "cpp"
+        assert "rt.sum_bytes" in hello.hello.symbols
+
+        rid = os.urandom(16)
+        done = exec_task(
+            "rt.add_i64",
+            [("i64", struct.pack("<q", 2), None),
+             ("i64", struct.pack("<q", 3), None)], [rid])
+        assert done.done.outs[0].status == "shm", done
+        assert store.get_deserialized(ObjectID(rid))[1] == 5
+
+        arg_oid = os.urandom(16)
+        store.put_tagged(ObjectID(arg_oid), "raw", b"\x01\x02\x03\x04")
+        rid2 = os.urandom(16)
+        done2 = exec_task("rt.sum_bytes", [(None, None, arg_oid)], [rid2])
+        assert done2.done.outs[0].status == "shm", done2
+        assert store.get_deserialized(ObjectID(rid2))[1] == 10
+
+        rid3 = os.urandom(16)
+        done3 = exec_task("rt.fail", [], [rid3])
+        assert done3.done.outs[0].status == "err", done3
+        assert b"rt.fail raised" in done3.done.outs[0].error.data
+
+        parent.sendall(worker_wire.encode_shutdown())
+        rc = proc.wait(timeout=60)
+        assert rc == 0, f"asan worker exited {rc}"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        parent.close()
+        store.close()
+        store.unlink()
+    logtext = log.read_text(errors="replace")
+    assert "AddressSanitizer" not in logtext, logtext[-4000:]

@@ -1,0 +1,211 @@
+"""raytpu-check: the static-analysis suite is itself tier-1 tested.
+
+Three layers: (1) the CI gate — all four passes run clean against the
+checked-in baseline on the real repo; (2) per-rule detection — seeded
+violation fixtures must each fire, and their corrected twins must not;
+(3) wire-drift mutation — renumbering a field in a copied schema must be
+caught against all three hand-maintained sources (descriptor pool,
+worker_wire.py, cpp/pb/raytpu.pb.h).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.staticcheck import run_passes, repo_root  # noqa: E402
+from tools.staticcheck import baseline as baseline_mod  # noqa: E402
+from tools.staticcheck import (concurrency, hot_plane,  # noqa: E402
+                               resources, wire_drift)
+
+FIX = "tests/data/staticcheck_fixtures"
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------- (1) the CI gate ----------------
+
+
+def test_repo_is_clean_against_baseline():
+    """Tier-1: every pass over the real repo, diffed against the
+    checked-in baseline — a NEW violation anywhere fails this test."""
+    findings = run_passes(REPO)
+    base = baseline_mod.load(
+        os.path.join(REPO, baseline_mod.BASELINE_REL))
+    new, _stale = baseline_mod.diff(findings, base)
+    assert not new, "new staticcheck violations:\n" + "\n".join(
+        f.render() for f in new)
+
+
+def test_cli_exits_zero_on_repo_and_nonzero_on_fixture():
+    env = {**os.environ,
+           "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH",
+                                                            "")}
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.staticcheck"], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    for fixture in ("bad_concurrency", "bad_hotplane", "bad_resources"):
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.staticcheck", "--no-baseline",
+             "--files", f"{FIX}/{fixture}.py"],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+        assert r.returncode == 1, (fixture, r.stdout, r.stderr)
+        # file:line report shape
+        assert f"{FIX}/{fixture}.py:" in r.stdout
+
+
+# ---------------- (2) per-rule detection + clean twins ----------------
+
+
+def test_concurrency_detects_each_seeded_rule():
+    fs = concurrency.run(REPO, targets=(f"{FIX}/bad_concurrency.py",))
+    details = [f"{f.rule}:{f.detail}" for f in fs]
+    assert {"blocking-under-lock", "cv-wait-foreign-lock", "relock",
+            "lock-order-cycle"} <= _rules(fs), details
+    blocking = [d for d in details if d.startswith("blocking-under-lock")]
+    assert any("sendall" in d for d in blocking), details
+    assert any("sleep" in d for d in blocking), details
+    assert any("pickle.dumps" in d for d in blocking), details
+    assert any("subprocess" in d for d in blocking), details
+    assert sum(1 for f in fs if f.rule == "relock") == 2, details
+    cyc = [f for f in fs if f.rule == "lock-order-cycle"]
+    assert len(cyc) == 1 and "_state_lock" in cyc[0].detail \
+        and "_other_lock" in cyc[0].detail
+
+
+def test_hot_plane_scoped_and_module_level():
+    rel = f"{FIX}/bad_hotplane.py"
+    scoped = hot_plane.run(
+        REPO, scopes={rel: ("stage_leaf", "FakeChannel.copy_leaf")})
+    lines = {f.line for f in scoped}
+    assert any("pickle.dumps" in f.detail for f in scoped)
+    assert any("cloudpickle" in f.detail for f in scoped)
+    # sidecar_meta is OUTSIDE the scope: its pickle.dumps must not fire.
+    import ast
+    src = open(os.path.join(REPO, rel)).read()
+    sidecar_line = next(
+        n.lineno for n in ast.walk(ast.parse(src))
+        if isinstance(n, ast.FunctionDef) and n.name == "sidecar_meta")
+    assert all(ln < sidecar_line or ln > sidecar_line + 3 for ln in lines)
+    # Module-level ban catches everything including the wrapper call.
+    whole = hot_plane.run(REPO, scopes={rel: None})
+    assert any("serialize_value" in f.detail for f in whole)
+    assert len(whole) > len(scoped)
+    # A scope that no longer exists is itself drift.
+    gone = hot_plane.run(REPO, scopes={rel: ("no_such_fn",)})
+    assert any("no longer exists" in f.detail for f in gone)
+
+
+def test_resources_detects_each_seeded_rule():
+    fs = resources.run(REPO, targets=(f"{FIX}/bad_resources.py",))
+    assert _rules(fs) == {"fd-inline-arg", "fd-no-closer",
+                          "fd-use-unguarded", "unjoined-thread"}, [
+        f.render() for f in fs]
+
+
+def test_clean_twins_produce_no_findings():
+    rel = f"{FIX}/clean_module.py"
+    fs = (concurrency.run(REPO, targets=(rel,))
+          + resources.run(REPO, targets=(rel,)))
+    assert fs == [], [f.render() for f in fs]
+
+
+def test_inline_suppression_silences_a_rule(tmp_path):
+    mod = tmp_path / "supp.py"
+    mod.write_text(
+        "import threading, time\n"
+        "class A:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def f(self):\n"
+        "        with self._lock:\n"
+        "            # staticcheck: ok blocking-under-lock — fixture\n"
+        "            time.sleep(1)\n"
+        "    def g(self):\n"
+        "        with self._lock:\n"
+        "            time.sleep(1)\n")
+    fs = concurrency.run(str(tmp_path), targets=("supp.py",))
+    assert len(fs) == 1 and fs[0].detail.endswith("A.g")
+
+
+# ---------------- (3) wire drift ----------------
+
+
+def test_wire_drift_clean_on_repo():
+    assert wire_drift.run(REPO) == []
+
+
+def test_wire_drift_catches_field_renumber_in_all_three_sources(tmp_path):
+    """Mutate ONE field number in a copied schema; the pass must report
+    drift against the descriptor pool, worker_wire.py, AND the C++
+    codec — the three copies the suite exists to keep converged."""
+    src = open(os.path.join(REPO, wire_drift.PROTO_REL)).read()
+    assert "  int64 attempt = 3;" in src  # WorkerDone.attempt
+    mutated = src.replace("  int64 attempt = 3;", "  int64 attempt = 30;")
+    p = tmp_path / "raytpu.proto"
+    p.write_text(mutated)
+    fs = wire_drift.run(REPO, proto_path=str(p))
+    paths = {f.path for f in fs}
+    assert wire_drift.PROTO_REL in paths, [f.render() for f in fs]
+    assert wire_drift.WW_REL in paths, [f.render() for f in fs]
+    assert wire_drift.CPP_REL in paths, [f.render() for f in fs]
+    assert any("attempt" in f.detail for f in fs)
+
+
+def test_wire_drift_catches_wire_type_change(tmp_path):
+    src = open(os.path.join(REPO, wire_drift.PROTO_REL)).read()
+    assert "double exec_start = 4;" in src  # WorkerDone.exec_start
+    p = tmp_path / "raytpu.proto"
+    p.write_text(src.replace("double exec_start = 4;",
+                             "int64 exec_start = 4;"))
+    fs = wire_drift.run(REPO, proto_path=str(p))
+    assert any("wire type" in f.detail and "exec_start" in f.detail
+               for f in fs), [f.render() for f in fs]
+
+
+def test_wire_drift_catches_pickle_framed_pin_drift(tmp_path):
+    """Renumbering a message that has NO bindings (rides pickle framing)
+    is exactly the drift runtime can never catch — the pin must."""
+    src = open(os.path.join(REPO, wire_drift.PROTO_REL)).read()
+    assert "int64 lease_seq = 2;" in src  # LeaseSpilled.Move.lease_seq
+    p = tmp_path / "raytpu.proto"
+    p.write_text(src.replace("int64 lease_seq = 2;",
+                             "int64 lease_seq = 20;"))
+    fs = wire_drift.run(REPO, proto_path=str(p))
+    assert any("LeaseSpilled.Move" in f.detail and "pin" in f.detail
+               for f in fs), [f.render() for f in fs]
+
+
+# ---------------- baseline workflow ----------------
+
+
+def test_baseline_absorbs_and_flags(tmp_path):
+    from tools.staticcheck import Finding
+    f1 = Finding("r", "a.py", 3, "thing one")
+    f2 = Finding("r", "a.py", 9, "thing two")
+    bpath = tmp_path / "baseline.json"
+    baseline_mod.save(str(bpath), [f1])
+    base = baseline_mod.load(str(bpath))
+    new, stale = baseline_mod.diff([f1, f2], base)
+    assert [f.detail for f in new] == ["thing two"] and not stale
+    # Line drift does not churn the baseline (fingerprint has no line).
+    f1_moved = Finding("r", "a.py", 77, "thing one")
+    new, stale = baseline_mod.diff([f1_moved], base)
+    assert not new and not stale
+    # Paid-off debt surfaces as stale.
+    new, stale = baseline_mod.diff([], base)
+    assert not new and stale == [("r", "a.py", "thing one")]
+    # Multiset semantics: two identical findings need two entries.
+    baseline_mod.save(str(bpath), [f1, f1])
+    entries = json.load(open(bpath))
+    assert len(entries) == 2
+    base2 = baseline_mod.load(str(bpath))
+    new, _ = baseline_mod.diff([f1, f1, Finding("r", "a.py", 5,
+                                                "thing one")], base2)
+    assert len(new) == 1

@@ -1,0 +1,73 @@
+"""raytpu-check: repo-native static analysis for the hand-maintained planes.
+
+The reference keeps one generated artifact as the single source of truth
+for its wire layer; this protoc-less rebuild instead carries THREE
+hand-maintained copies of the schema (raytpu.proto, the hand-authored
+descriptors in core/worker_wire.py, the hand-rolled varint codec in
+cpp/pb/raytpu.pb.h) plus convention-enforced invariants (~70 lock sites,
+two no-pickle planes, closer/join ownership for fds and threads). Each
+pass turns one class of convention into a test failure:
+
+  wire_drift    the three schema copies can never silently diverge
+  concurrency   blocking calls inside lock-held regions; cross-module
+                lock-acquisition-order graph with inversion cycles
+  hot_plane     the PR 3/PR 5 invariant: tensor-channel and proto-frame
+                payload paths never touch pickle
+  resources     sockets/fds/threads created without a registered
+                closer/join owner
+
+Run as `python -m tools.staticcheck` (CI: exit nonzero on any finding not
+recorded in the checked-in baseline) or through the tier-1 pytest test
+(tests/test_staticcheck.py). Intentional sites are suppressed inline with
+`# staticcheck: ok <rule>` on the offending line or the line above;
+pre-existing debt lives in tools/staticcheck/baseline.json
+(`--update-baseline` rewrites it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One violation. `detail` is the line-number-free fingerprint the
+    baseline matches on (line numbers drift with every edit; the shape of
+    the violation does not)."""
+
+    rule: str        # e.g. "blocking-under-lock"
+    path: str        # repo-relative
+    line: int        # 1-based; 0 = whole-file finding
+    detail: str      # stable fingerprint, no line numbers
+    message: str = ""  # human text; defaults to detail
+
+    def render(self) -> str:
+        msg = self.message or self.detail
+        return f"{self.path}:{self.line}: [{self.rule}] {msg}"
+
+    def key(self) -> tuple:
+        return (self.rule, self.path, self.detail)
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+PASSES = ("wire_drift", "concurrency", "hot_plane", "resources")
+
+
+def run_passes(root: str | None = None,
+               passes: tuple = PASSES) -> list[Finding]:
+    """Run the requested passes over the repo; returns raw findings
+    (baseline not applied — see baseline.diff_against_baseline)."""
+    from tools.staticcheck import (concurrency, hot_plane, resources,
+                                   wire_drift)
+    root = root or repo_root()
+    mods = {"wire_drift": wire_drift, "concurrency": concurrency,
+            "hot_plane": hot_plane, "resources": resources}
+    findings: list[Finding] = []
+    for name in passes:
+        findings.extend(mods[name].run(root))
+    return findings

@@ -1,0 +1,88 @@
+"""CLI: `python -m tools.staticcheck [--passes a,b] [--update-baseline]`.
+
+Exit codes: 0 clean (all findings covered by the baseline), 1 new
+violations, 2 usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from tools.staticcheck import PASSES, repo_root, run_passes
+from tools.staticcheck import baseline as baseline_mod
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.staticcheck",
+        description="raytpu-check: wire-drift + concurrency + hot-plane "
+                    "+ resource-hygiene static analysis")
+    p.add_argument("--passes", default=",".join(PASSES),
+                   help=f"comma list of {', '.join(PASSES)}")
+    p.add_argument("--root", default=repo_root())
+    p.add_argument("--baseline", default=None,
+                   help=f"baseline file (default <root>/"
+                        f"{baseline_mod.BASELINE_REL})")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding, ignore the baseline")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="accept current findings as the new baseline")
+    p.add_argument("--files", default=None,
+                   help="comma list of python files: restrict the "
+                        "concurrency/resources passes to exactly these, "
+                        "and treat each as a module-level no-pickle "
+                        "scope for hot_plane (fixture/debug mode; "
+                        "wire_drift is skipped)")
+    args = p.parse_args(argv)
+
+    passes = tuple(s for s in args.passes.split(",") if s)
+    for s in passes:
+        if s not in PASSES:
+            print(f"unknown pass {s!r} (have: {', '.join(PASSES)})",
+                  file=sys.stderr)
+            return 2
+    if args.files:
+        findings = _run_on_files(args.root, passes,
+                                 tuple(args.files.split(",")))
+    else:
+        findings = run_passes(args.root, passes)
+
+    bpath = args.baseline or os.path.join(args.root,
+                                          baseline_mod.BASELINE_REL)
+    if args.update_baseline:
+        baseline_mod.save(bpath, findings)
+        print(f"baseline updated: {len(findings)} entries -> {bpath}")
+        return 0
+    import collections
+    base = (baseline_mod.load(bpath) if not args.no_baseline
+            else collections.Counter())
+    new, stale = baseline_mod.diff(findings, base)
+    for f in new:
+        print(f.render())
+    for key in stale:
+        print(f"stale baseline entry (no longer fires): {key}",
+              file=sys.stderr)
+    n_base = len(findings) - len(new)
+    print(f"{len(findings)} finding(s): {len(new)} new, "
+          f"{n_base} baselined, {len(stale)} stale baseline entr(ies)",
+          file=sys.stderr)
+    return 1 if new else 0
+
+
+def _run_on_files(root: str, passes: tuple, files: tuple) -> list:
+    from tools.staticcheck import concurrency, hot_plane, resources
+    rels = tuple(os.path.relpath(os.path.abspath(f), root) for f in files)
+    findings = []
+    if "concurrency" in passes:
+        findings += concurrency.run(root, targets=rels)
+    if "resources" in passes:
+        findings += resources.run(root, targets=rels)
+    if "hot_plane" in passes:
+        findings += hot_plane.run(root, scopes={r: None for r in rels})
+    return findings
+
+
+if __name__ == "__main__":
+    sys.exit(main())

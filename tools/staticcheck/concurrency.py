@@ -1,0 +1,512 @@
+"""Pass 2 — blocking calls under locks + lock-acquisition-order cycles.
+
+AST-based, over the hot-plane core modules. Two rule families:
+
+`blocking-under-lock`: a call that can block indefinitely (socket
+send/recv/connect, subprocess, time.sleep, future .result()/.join(),
+payload pickling, jax device ops) issued while a lock is held. Dedicated
+send-serialization locks (send_lock / flush_lock / head_lock — they exist
+precisely to serialize one socket's writes) permit SEND calls but nothing
+else. `cv-wait-foreign-lock`: waiting on a condition variable while
+holding a lock that is not the cv's own (wait() only releases its own
+lock; everything else held stalls every contender).
+
+`lock-order-cycle` / `relock`: a cross-module lock-acquisition graph.
+Direct nesting adds held->acquired edges; one level of call resolution
+(self.method, same-module function, corpus-unique method name) adds edges
+for locks a callee acquires. A cycle = two code paths that can take the
+same pair of locks in opposite orders; `relock` = syntactic re-entry of a
+non-reentrant lock.
+
+Lock identity is `Class.attr` (resolved via the corpus-wide registry of
+`self.x = threading.Lock()` assignments; attribute receivers other than
+`self` resolve when exactly one class defines that attr, else `?.attr`).
+Intentional sites carry `# staticcheck: ok <rule>` inline.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from tools.staticcheck import Finding
+
+# The lock-heavy core planes the paper's L0/L1 substrate lives in.
+TARGETS = (
+    "ray_tpu/core/node_agent.py",
+    "ray_tpu/core/worker.py",
+    "ray_tpu/core/runtime.py",
+    "ray_tpu/core/object_store.py",
+    "ray_tpu/core/objxfer.py",
+    "ray_tpu/core/task_events.py",
+)
+
+SEND_LOCKS = {"send_lock", "flush_lock", "head_lock"}
+
+SEND_METHODS = {"sendall", "sendmsg", "sendto", "send"}
+ALWAYS_BLOCKING_METHODS = {
+    "recv", "recv_into", "recvfrom", "accept", "connect", "communicate",
+    "result", "join", "sleep",
+}
+SEND_FUNCS = {"send_msg", "send_many", "sendmsg_all"}
+BLOCKING_FUNCS = {
+    "dial", "create_connection", "fetch_from_peer", "build_binary",
+    "build_native",
+}
+PICKLE_BASES = {"pickle", "cloudpickle", "_pickle"}
+PICKLE_METHODS = {"dumps", "loads", "dump", "load"}
+PAYLOAD_PICKLE_FUNCS = {"serialize_value"}
+JAX_METHODS = {"device_put", "block_until_ready", "device_get"}
+SUBPROCESS_FUNCS = {"run", "Popen", "check_call", "check_output", "call"}
+
+_LOCKY = re.compile(r"(lock|mutex|_cv$|^cv$|cond)")
+
+
+def _is_str_or_path_join(f, node) -> bool:
+    """os.path.join(...) and "sep".join(...) are not thread joins: a
+    string-literal receiver, a receiver chain mentioning path, or >=2
+    positional args (Thread.join takes at most a timeout)."""
+    if isinstance(f.value, ast.Constant):
+        return True
+    if "path" in _expr_src(f.value):
+        return True
+    return len(node.args) >= 2
+
+
+def _lock_like(name: str) -> bool:
+    return bool(_LOCKY.search(name.lower()))
+
+
+def suppressed(lines: list, lineno: int, rule: str) -> bool:
+    """`# staticcheck: ok <rule>` on the line, or anywhere in the block
+    of comment/blank lines immediately above it (so a marker can open a
+    multi-line justification comment)."""
+    def marked(ln: int) -> bool:
+        m = re.search(r"#\s*staticcheck:\s*ok\s+([\w,-]+)", lines[ln - 1])
+        return bool(m) and rule in m.group(1).split(",")
+
+    if not 1 <= lineno <= len(lines):
+        return False
+    if marked(lineno):
+        return True
+    ln = lineno - 1
+    while ln >= 1:
+        stripped = lines[ln - 1].strip()
+        if stripped and not stripped.startswith("#"):
+            return False
+        if stripped and marked(ln):
+            return True
+        ln -= 1
+    return False
+
+
+# ---------------- corpus model ----------------
+
+
+class _Module:
+    def __init__(self, root: str, rel: str):
+        self.rel = rel
+        self.name = os.path.basename(rel).removesuffix(".py")
+        with open(os.path.join(root, rel)) as f:
+            src = f.read()
+        self.lines = src.splitlines()
+        self.tree = ast.parse(src, filename=rel)
+        # {class: {method: FunctionDef}}, {func: FunctionDef}
+        self.classes: dict[str, dict] = {}
+        self.functions: dict[str, ast.AST] = {}
+        for node in self.tree.body:
+            if isinstance(node, ast.ClassDef):
+                self.classes[node.name] = {
+                    n.name: n for n in node.body
+                    if isinstance(n, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))}
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+
+
+class _Corpus:
+    def __init__(self, modules: list):
+        self.modules = modules
+        # lock attr -> {(class name, kind)} from `self.x = threading.X()`
+        self.attr_owners: dict[str, set] = {}
+        # method name -> [(module, class, FunctionDef)]
+        self.methods: dict[str, list] = {}
+        for m in modules:
+            for cname, meths in m.classes.items():
+                for mname, fn in meths.items():
+                    self.methods.setdefault(mname, []).append(
+                        (m, cname, fn))
+                for fn in meths.values():
+                    self._scan_lock_defs(fn, cname)
+
+    def _scan_lock_defs(self, fn, cname: str):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            kind = _lock_ctor_kind(node.value)
+            if kind is None:
+                continue
+            for t in node.targets:
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    self.attr_owners.setdefault(t.attr, set()).add(
+                        (cname, kind))
+
+    def owner_of(self, attr: str):
+        owners = self.attr_owners.get(attr, set())
+        if len(owners) == 1:
+            return next(iter(owners))
+        return None
+
+
+def _lock_ctor_kind(value) -> str | None:
+    if not isinstance(value, ast.Call):
+        return None
+    f = value.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else "")
+    return name if name in ("Lock", "RLock", "Condition") else None
+
+
+class _Lock:
+    def __init__(self, identity: str, attr: str, kind: str | None,
+                 expr_src: str):
+        self.identity = identity
+        self.attr = attr
+        self.kind = kind        # Lock | RLock | Condition | None (unknown)
+        self.expr_src = expr_src
+        self.is_send = attr in SEND_LOCKS
+
+
+def _expr_src(node) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # noqa: BLE001 — display only
+        return "<expr>"
+
+
+def _lock_of_expr(expr, corpus: _Corpus, cname: str | None):
+    """The _Lock a with-item / wait receiver denotes, or None."""
+    if isinstance(expr, ast.Attribute):
+        attr = expr.attr
+        if not _lock_like(attr):
+            return None
+        kind = None
+        if (isinstance(expr.value, ast.Name) and expr.value.id == "self"
+                and cname is not None
+                and any(o[0] == cname
+                        for o in corpus.attr_owners.get(attr, ()))):
+            owner = cname
+            kind = next(k for c, k in corpus.attr_owners[attr]
+                        if c == cname)
+        else:
+            resolved = corpus.owner_of(attr)
+            if resolved is not None:
+                owner, kind = resolved
+            else:
+                owner = "?"
+        return _Lock(f"{owner}.{attr}", attr, kind, _expr_src(expr))
+    if isinstance(expr, ast.Name):
+        if not _lock_like(expr.id):
+            return None
+        return _Lock(f"<local>.{expr.id}", expr.id, None, expr.id)
+    if isinstance(expr, ast.Subscript):
+        key = expr.slice
+        if isinstance(key, ast.Constant) and isinstance(key.value, str) \
+                and _lock_like(key.value):
+            return _Lock(f"?.{key.value}", key.value, None,
+                         _expr_src(expr))
+    return None
+
+
+# ---------------- the walker ----------------
+
+
+class _FuncWalker:
+    """Walks one function body tracking the held-lock stack; emits
+    blocking-call findings and acquisition edges."""
+
+    def __init__(self, corpus: _Corpus, module: _Module,
+                 cname: str | None, qualname: str,
+                 edges: list, findings: list):
+        self.corpus = corpus
+        self.module = module
+        self.cname = cname
+        self.qualname = qualname
+        self.edges = edges          # (from_id, to_id, site, via)
+        self.findings = findings
+        self.held: list[_Lock] = []
+
+    # -- entry --
+
+    def walk(self, fn):
+        for stmt in fn.body:
+            self._stmt(stmt)
+
+    def _stmt(self, node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # Nested def: runs later with its own (empty) lock context.
+            _FuncWalker(self.corpus, self.module, self.cname,
+                        f"{self.qualname}.{node.name}", self.edges,
+                        self.findings).walk(node)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            self._with(node)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child)
+            elif isinstance(child, ast.stmt):
+                self._stmt(child)
+            elif isinstance(child, (ast.excepthandler,)):
+                for s in child.body:
+                    self._stmt(s)
+
+    def _with(self, node):
+        pushed = 0
+        for item in node.items:
+            self._expr(item.context_expr, is_with_ctx=True)
+            lk = _lock_of_expr(item.context_expr, self.corpus, self.cname)
+            if lk is not None:
+                for held in self.held:
+                    self.edges.append(
+                        (held, lk, (self.module, node.lineno,
+                                    self.qualname), "nest"))
+                if any(h.identity == lk.identity for h in self.held) \
+                        and lk.kind == "Lock":
+                    self._finding(
+                        "relock", node.lineno,
+                        f"re-entering non-reentrant {lk.identity} "
+                        f"already held in {self.qualname}")
+                self.held.append(lk)
+                pushed += 1
+        for stmt in node.body:
+            self._stmt(stmt)
+        for _ in range(pushed):
+            self.held.pop()
+
+    # -- expressions / calls --
+
+    def _expr(self, node, is_with_ctx: bool = False):
+        for call in [n for n in ast.walk(node) if isinstance(n, ast.Call)]:
+            self._call(call)
+
+    def _call(self, node: ast.Call):
+        if not self.held:
+            self._call_edges(node)
+            return
+        f = node.func
+        attr = f.attr if isinstance(f, ast.Attribute) else None
+        base = None
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            base = f.value.id
+        name = f.id if isinstance(f, ast.Name) else None
+
+        label = None
+        is_send = False
+        if attr in ("wait", "wait_for"):
+            self._wait(node, f)
+        elif attr in SEND_METHODS or name in SEND_FUNCS \
+                or attr in SEND_FUNCS:
+            label, is_send = f"socket send ({attr or name})", True
+        elif attr == "join" and not _is_str_or_path_join(f, node):
+            label = "blocking call (.join())"
+        elif attr in ALWAYS_BLOCKING_METHODS and attr != "join":
+            label = f"blocking call (.{attr}())"
+        elif base in PICKLE_BASES and attr in PICKLE_METHODS:
+            label = f"payload pickling ({base}.{attr})"
+        elif (attr in PAYLOAD_PICKLE_FUNCS
+              or name in PAYLOAD_PICKLE_FUNCS):
+            label = f"payload pickling ({attr or name})"
+        elif attr in JAX_METHODS:
+            label = f"jax device op (.{attr})"
+        elif base == "subprocess" and attr in SUBPROCESS_FUNCS:
+            label = f"subprocess ({attr})"
+        elif name in BLOCKING_FUNCS or attr in BLOCKING_FUNCS:
+            label = f"blocking call ({attr or name})"
+
+        if label is not None:
+            # Send calls are the one thing a dedicated send lock is FOR.
+            blockers = [h for h in self.held
+                        if not (is_send and h.is_send)]
+            if blockers:
+                self._finding(
+                    "blocking-under-lock", node.lineno,
+                    f"{label} under {blockers[-1].identity} in "
+                    f"{self.qualname}")
+        self._call_edges(node)
+
+    def _wait(self, node, f):
+        recv = _lock_of_expr(f.value, self.corpus, self.cname)
+        if recv is None:
+            if self.held:  # Event/proc/future .wait under a lock
+                self._finding(
+                    "blocking-under-lock", node.lineno,
+                    f"blocking call (.{f.attr}()) under "
+                    f"{self.held[-1].identity} in {self.qualname}")
+            return
+        foreign = [h for h in self.held if h.identity != recv.identity]
+        if foreign:
+            self._finding(
+                "cv-wait-foreign-lock", node.lineno,
+                f"{recv.expr_src}.{f.attr}() waits while holding "
+                f"{foreign[-1].identity} in {self.qualname} (wait only "
+                "releases its own lock)")
+
+    # -- one-level call resolution for the order graph --
+
+    def _call_edges(self, node: ast.Call):
+        if not self.held:
+            return
+        target = self._resolve(node.func)
+        if target is None:
+            return
+        tmod, tcls, tfn, via = target
+        for lk, line in _acquired_locks(tfn, self.corpus, tcls):
+            for held in self.held:
+                if held.identity == lk.identity:
+                    if via == "self" and lk.kind == "Lock":
+                        # Same instance, non-reentrant: the callee will
+                        # block on the lock this caller already holds.
+                        self._finding(
+                            "relock", node.lineno,
+                            f"call to {tcls}.{tfn.name} (which takes "
+                            f"{lk.identity}) while {self.qualname} "
+                            "already holds it")
+                    continue
+                self.edges.append(
+                    (held, lk, (self.module, node.lineno, self.qualname),
+                     via))
+
+    def _resolve(self, f):
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            if f.value.id == "self" and self.cname is not None:
+                fn = self.module.classes.get(self.cname, {}).get(f.attr)
+                if fn is not None:
+                    return (self.module, self.cname, fn, "self")
+            cands = self.corpus.methods.get(f.attr, [])
+            if len(cands) == 1:
+                m, c, fn = cands[0]
+                return (m, c, fn, "unique")
+        elif isinstance(f, ast.Name):
+            fn = self.module.functions.get(f.id)
+            if fn is not None:
+                return (self.module, None, fn, "module")
+        return None
+
+    def _finding(self, rule: str, lineno: int, detail: str):
+        if suppressed(self.module.lines, lineno, rule):
+            return
+        self.findings.append(
+            Finding(rule, self.module.rel, lineno, detail))
+
+
+def _acquired_locks(fn, corpus: _Corpus, cname: str | None) -> list:
+    """Locks a function body acquires directly (nested defs excluded —
+    they run in their own context later)."""
+    out = []
+
+    def visit(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                for item in child.items:
+                    lk = _lock_of_expr(item.context_expr, corpus, cname)
+                    if lk is not None:
+                        out.append((lk, child.lineno))
+            visit(child)
+
+    visit(fn)
+    return out
+
+
+# ---------------- cycles ----------------
+
+
+def _find_cycles(edges: list) -> list:
+    """SCCs with a cycle in the acquisition graph -> findings. Send locks
+    are leaves by construction (send_msg only wraps sendall) and unknown
+    `?.x` identities collapse distinct objects, so both are excluded as
+    cycle STARTS but kept as edges for reporting context."""
+    graph: dict[str, set] = {}
+    sites: dict[tuple, tuple] = {}
+    for a, b, site, _via in edges:
+        if a.identity == b.identity:
+            continue
+        graph.setdefault(a.identity, set()).add(b.identity)
+        sites.setdefault((a.identity, b.identity), site)
+    # Tarjan SCC
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    stack: list[str] = []
+    on: set = set()
+    sccs: list[list] = []
+    counter = [0]
+
+    def strongconnect(v):
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on.add(v)
+        for w in graph.get(v, ()):
+            if w not in index:
+                strongconnect(w)
+                low[v] = min(low[v], low[w])
+            elif w in on:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            comp = []
+            while True:
+                w = stack.pop()
+                on.discard(w)
+                comp.append(w)
+                if w == v:
+                    break
+            if len(comp) > 1:
+                sccs.append(comp)
+
+    for v in list(graph):
+        if v not in index:
+            strongconnect(v)
+
+    findings = []
+    for comp in sccs:
+        comp = sorted(comp)
+        if all(c.startswith("?.") for c in comp):
+            continue
+        where = []
+        for a, b in sites:
+            if a in comp and b in comp:
+                mod, line, qual = sites[(a, b)]
+                where.append(f"{a}->{b} at {mod.rel} in {qual}")
+        findings.append(Finding(
+            "lock-order-cycle", where and sites[
+                next((a, b) for a, b in sites
+                     if a in comp and b in comp)][0].rel or "",
+            0,
+            "lock acquisition cycle: " + " | ".join(sorted(where))))
+    return findings
+
+
+# ---------------- entry ----------------
+
+
+def run(root: str, targets: tuple | None = None) -> list:
+    rels = [t for t in (targets or TARGETS)
+            if os.path.exists(os.path.join(root, t))]
+    modules = [_Module(root, rel) for rel in rels]
+    corpus = _Corpus(modules)
+    findings: list[Finding] = []
+    edges: list = []
+    for m in modules:
+        for cname, meths in m.classes.items():
+            for mname, fn in meths.items():
+                _FuncWalker(corpus, m, cname, f"{cname}.{mname}",
+                            edges, findings).walk(fn)
+        for fname, fn in m.functions.items():
+            _FuncWalker(corpus, m, None, fname, edges, findings).walk(fn)
+    findings.extend(_find_cycles(edges))
+    return findings

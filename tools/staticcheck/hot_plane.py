@@ -1,0 +1,143 @@
+"""Pass 3 — the no-pickle invariant on the hot planes.
+
+Two planes promise "no pickle on payload paths" by convention:
+
+  PR 5, proto-frame plane: core/worker_wire.py and the agent's cpp-worker
+  dispatch path never touch pickle at all — every frame a C++ worker
+  reads or writes is protobuf, every arena arg/return is tagged.
+  (The one sanctioned exception: converting a cpp error into a Python
+  TaskError AFTER the frame is decoded — the language boundary.)
+
+  PR 3, tensor-channel plane: TensorChannel stages array leaf BYTES
+  straight into shm; only the pytree skeleton rides the sidecar pickle.
+  The functions that handle leaf bytes must therefore never reference
+  pickle — a pickle call creeping into one silently reopens the copy
+  the zero-copy plane exists to close.
+
+Statically enforced as: banned scopes (whole module, or class.func /
+func within a module) may not reference pickle/cloudpickle or the
+pickle-wrapping serializers. Scope lists are pinned here; moving a
+function out of a scope is a reviewed edit, not a silent drift.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from tools.staticcheck import Finding
+from tools.staticcheck.concurrency import suppressed
+
+RULE = "pickle-on-hot-plane"
+
+# module -> None (whole module banned) or tuple of banned qualnames.
+SCOPES = {
+    # The proto-frame bindings: nothing in this module may pickle.
+    "ray_tpu/core/worker_wire.py": None,
+    # The agent's cpp dispatch/ingest path (frames + arena staging).
+    # _on_cpp_done is deliberately absent: it converts cpp errors to
+    # TaskError payloads at the language boundary, after the frame.
+    "ray_tpu/core/node_agent.py": (
+        "NodeAgent._pump_cpp_leases",
+        "NodeAgent._on_cpp_frames",
+        "NodeAgent._stage_cpp_deps",
+        "NodeAgent._spawn_cpp_worker",
+        "NodeAgent._cpp_worker_binary",
+    ),
+    # Tensor-leaf byte handling (the skeleton sidecar lives in
+    # _FramePlan.__init__ / _decode_frame, which ARE allowed to pickle).
+    "ray_tpu/experimental/channel.py": (
+        "_extract",
+        "_leaf_kind",
+        "_leaf_spec",
+        "_host_view",
+        "TensorChannel._copy_leaf",
+        "TensorChannel._native_copy",
+    ),
+    # The arena's tagged-object encoder (what a C++ worker reads raw).
+    "ray_tpu/core/object_store.py": (
+        "SharedMemoryStore.put_tagged",
+    ),
+}
+
+_PICKLE_NAMES = {"pickle", "cloudpickle", "_pickle", "_MsgPickler",
+                 "Pickler", "Unpickler", "PickleBuffer"}
+_WRAPPER_CALLS = {"serialize_value", "deserialize"}
+
+
+def _pickle_refs(fn_node) -> list:
+    """(lineno, description) for every pickle touch inside a scope."""
+    out = []
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Name) and node.id in _PICKLE_NAMES:
+            out.append((node.lineno, f"reference to {node.id}"))
+        elif isinstance(node, ast.Attribute):
+            if isinstance(node.value, ast.Name) \
+                    and node.value.id in _PICKLE_NAMES:
+                out.append((node.lineno,
+                            f"call of {node.value.id}.{node.attr}"))
+        elif isinstance(node, ast.Call):
+            f = node.func
+            name = (f.attr if isinstance(f, ast.Attribute)
+                    else f.id if isinstance(f, ast.Name) else None)
+            if name in _WRAPPER_CALLS:
+                out.append((node.lineno,
+                            f"pickle-wrapping serializer {name}()"))
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            mods = [a.name for a in node.names]
+            if isinstance(node, ast.ImportFrom) and node.module:
+                mods.append(node.module)
+            for m in mods:
+                if m.split(".")[0] in _PICKLE_NAMES:
+                    out.append((node.lineno, f"import of {m}"))
+    return out
+
+
+def _iter_scopes(tree, wanted):
+    """Yield (qualname, node) for module functions and class methods."""
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if wanted is None or node.name in wanted:
+                yield node.name, node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    q = f"{node.name}.{sub.name}"
+                    if wanted is None or q in wanted:
+                        yield q, sub
+
+
+def run(root: str, scopes: dict | None = None) -> list:
+    findings: list[Finding] = []
+    for rel, wanted in (scopes or SCOPES).items():
+        path = os.path.join(root, rel)
+        if not os.path.exists(path):
+            findings.append(Finding(
+                RULE, rel, 0, "scoped module missing — update SCOPES"))
+            continue
+        with open(path) as f:
+            src = f.read()
+        lines = src.splitlines()
+        tree = ast.parse(src, filename=path)
+        if wanted is None:
+            refs = _pickle_refs(tree)
+            for line, desc in refs:
+                if not suppressed(lines, line, RULE):
+                    findings.append(Finding(
+                        RULE, rel, line,
+                        f"{desc} in no-pickle module"))
+            continue
+        found = set()
+        for qual, node in _iter_scopes(tree, set(wanted)):
+            found.add(qual)
+            for line, desc in _pickle_refs(node):
+                if not suppressed(lines, line, RULE):
+                    findings.append(Finding(
+                        RULE, rel, line,
+                        f"{desc} on payload path {qual}"))
+        for qual in set(wanted) - found:
+            findings.append(Finding(
+                RULE, rel, 0,
+                f"payload-path scope {qual} no longer exists — the "
+                "no-pickle surface moved; update SCOPES"))
+    return findings

@@ -1,0 +1,183 @@
+"""Lightweight .proto parser: just enough proto3 for raytpu.proto.
+
+Handles messages (nested), scalar/message/repeated fields, oneofs, and
+map<k, v> fields (modeled as a field of the synthesized *Entry message,
+wire type 2 — the layout both google.protobuf and the hand-rolled C++
+codec put on the wire). No services/enums/extensions/reserved — the
+schema has none; the parser FAILS LOUDLY on syntax it does not know
+rather than silently skipping, so schema growth that outruns the checker
+surfaces as a checker error, not a missed drift.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+# proto scalar type -> wire type (proto3; no packed numeric repeated in
+# this schema, but packed(2) is accepted for them at the comparison layer)
+SCALAR_WIRE = {
+    "int32": 0, "int64": 0, "uint32": 0, "uint64": 0,
+    "sint32": 0, "sint64": 0, "bool": 0, "enum": 0,
+    "fixed64": 1, "sfixed64": 1, "double": 1,
+    "fixed32": 5, "sfixed32": 5, "float": 5,
+    "string": 2, "bytes": 2,
+}
+
+
+@dataclasses.dataclass
+class Field:
+    name: str
+    number: int
+    type: str          # scalar name, "map", or message type name
+    repeated: bool
+    oneof: str | None = None
+
+    @property
+    def wire_type(self) -> int:
+        if self.type in SCALAR_WIRE:
+            return SCALAR_WIRE[self.type]
+        return 2  # message / map / unknown-named type
+
+    @property
+    def is_message(self) -> bool:
+        return self.type not in SCALAR_WIRE and self.type != "map"
+
+
+@dataclasses.dataclass
+class Message:
+    full_name: str                      # e.g. "RegisterNode.WorkerInventory"
+    fields: dict = dataclasses.field(default_factory=dict)  # name -> Field
+
+    def by_number(self) -> dict:
+        return {f.number: f for f in self.fields.values()}
+
+
+_TOKEN = re.compile(r"""
+    (?P<comment>//[^\n]*|/\*.*?\*/)
+  | (?P<word>[A-Za-z_][\w.]*)
+  | (?P<number>\d+)
+  | (?P<punct>[{}<>=;,])
+  | (?P<string>"[^"]*")
+  | (?P<ws>\s+)
+""", re.VERBOSE | re.DOTALL)
+
+
+def _tokens(text: str):
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN.match(text, pos)
+        if m is None:
+            raise ValueError(f"protoparse: cannot tokenize at {text[pos:pos+40]!r}")
+        pos = m.end()
+        if m.lastgroup in ("comment", "ws"):
+            continue
+        yield m.group()
+
+
+class _Stream:
+    def __init__(self, toks):
+        self.toks = list(toks)
+        self.i = 0
+
+    def peek(self):
+        return self.toks[self.i] if self.i < len(self.toks) else None
+
+    def next(self):
+        t = self.peek()
+        if t is None:
+            raise ValueError("protoparse: unexpected end of file")
+        self.i += 1
+        return t
+
+    def expect(self, tok):
+        t = self.next()
+        if t != tok:
+            raise ValueError(f"protoparse: expected {tok!r}, got {t!r}")
+        return t
+
+
+def parse(path: str) -> dict:
+    """Parse a .proto file -> {full_message_name: Message}."""
+    with open(path) as f:
+        text = f.read()
+    s = _Stream(_tokens(text))
+    messages: dict[str, Message] = {}
+    while s.peek() is not None:
+        t = s.next()
+        if t in ("syntax", "package"):
+            while s.next() != ";":
+                pass
+        elif t == "option":
+            while s.next() != ";":
+                pass
+        elif t == "import":
+            while s.next() != ";":
+                pass
+        elif t == "message":
+            _parse_message(s, prefix="", messages=messages)
+        else:
+            raise ValueError(f"protoparse: unknown top-level token {t!r}")
+    return messages
+
+
+def _parse_message(s: _Stream, prefix: str, messages: dict):
+    name = s.next()
+    full = f"{prefix}{name}"
+    msg = Message(full)
+    messages[full] = msg
+    s.expect("{")
+    _parse_body(s, msg, full, messages, oneof=None)
+
+
+def _parse_body(s: _Stream, msg: Message, full: str, messages: dict,
+                oneof: str | None):
+    while True:
+        t = s.next()
+        if t == "}":
+            return
+        if t == ";":
+            continue
+        if t == "message":
+            if oneof is not None:
+                raise ValueError("protoparse: message inside oneof")
+            _parse_message(s, prefix=f"{full}.", messages=messages)
+            continue
+        if t == "oneof":
+            oname = s.next()
+            s.expect("{")
+            _parse_body(s, msg, full, messages, oneof=oname)
+            continue
+        if t == "reserved":
+            while s.next() != ";":
+                pass
+            continue
+        # field: [repeated] <type> <name> = <number> ;
+        repeated = False
+        if t == "repeated":
+            repeated = True
+            t = s.next()
+        if t == "map":
+            s.expect("<")
+            ktype = s.next()
+            s.expect(",")
+            vtype = s.next()
+            s.expect(">")
+            fname = s.next()
+            s.expect("=")
+            num = int(s.next())
+            s.expect(";")
+            msg.fields[fname] = Field(fname, num, "map", repeated=True,
+                                      oneof=oneof)
+            # Synthesize the map entry message (what rides the wire).
+            entry = Message(f"{full}.{fname}#entry")
+            entry.fields["key"] = Field("key", 1, ktype, False)
+            entry.fields["value"] = Field("value", 2, vtype, False)
+            messages[entry.full_name] = entry
+            continue
+        ftype = t
+        fname = s.next()
+        s.expect("=")
+        num = int(s.next())
+        s.expect(";")
+        msg.fields[fname] = Field(fname, num, ftype, repeated, oneof=oneof)

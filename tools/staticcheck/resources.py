@@ -1,0 +1,314 @@
+"""Pass 4 — resource hygiene: fds/sockets/threads need a registered owner.
+
+Three rules, tuned for the failure shapes this codebase has actually
+shipped (and reverted) — leaked log fds on worker spawn, sockets dropped
+on dial failure, reader threads nobody joins:
+
+  fd-inline-arg   an open()/socket()/dial() call used directly as an
+                  argument to another call: no name ever binds the fd,
+                  so no closer can exist (e.g. Popen(stdout=open(...))).
+  fd-no-closer    a socket/fd bound to a local that neither escapes
+                  (returned, stored, passed on, captured by a closure)
+                  nor is ever close()d/shutdown() in the function.
+  fd-use-unguarded a bound socket used for network I/O (connect/send/
+                  recv) before ownership transfers, where the use can
+                  raise out of the function without any enclosing
+                  try closing the fd — the classic dial-failure leak.
+  unjoined-thread a non-daemon Thread with no .join() owner in sight:
+                  process exit will hang on it, or nobody reaps it.
+
+Ownership transfer is deliberately generous (any call taking the name,
+any store) — the pass prefers missing a leak to crying wolf; the
+fixtures pin the shapes it must catch.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+
+from tools.staticcheck import Finding
+from tools.staticcheck.concurrency import suppressed
+
+TARGET_GLOBS = ("ray_tpu/core/*.py", "ray_tpu/experimental/channel.py")
+
+_FD_CTORS = {
+    ("socket", "socket"), ("socket", "create_connection"),
+    ("socket", "fromfd"), ("os", "open"), ("os", "fdopen"),
+}
+_FD_CTOR_NAMES = {"open", "dial", "make_socketpair", "socketpair",
+                  "socket_from_fd"}
+_RISKY_USES = {"connect", "sendall", "send", "recv", "recv_into",
+               "sendmsg", "makefile"}
+_CLOSERS = {"close", "shutdown", "detach"}
+
+
+def _is_fd_ctor(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id in _FD_CTOR_NAMES
+    if isinstance(f, ast.Attribute):
+        base = f.value.id if isinstance(f.value, ast.Name) else None
+        if (base, f.attr) in _FD_CTORS:
+            return True
+        # socket_mod.socketpair-style aliased imports
+        return f.attr in ("socketpair", "create_connection") \
+            and base is not None and "socket" in base
+    return False
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr == "Thread"
+    return isinstance(f, ast.Name) and f.id == "Thread"
+
+
+def _names_in(node) -> set:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+class _FnScan:
+    """Per-function facts about one tracked fd name."""
+
+    def __init__(self, name: str, line: int):
+        self.name = name
+        self.line = line
+        self.closed = False
+        self.escape_line: int | None = None   # earliest positional escape
+        self.closure_escape = False           # captured: position unknown
+        self.risky: list[tuple] = []          # (lineno, attr, try_stack)
+
+
+def run(root: str, targets: tuple | None = None) -> list:
+    findings: list[Finding] = []
+    rels = []
+    for pat in (targets or TARGET_GLOBS):
+        if os.path.isabs(pat) or os.path.exists(os.path.join(root, pat)):
+            rels.append(pat)
+        else:
+            rels.extend(sorted(
+                os.path.relpath(p, root)
+                for p in glob.glob(os.path.join(root, pat))))
+    for rel in rels:
+        path = rel if os.path.isabs(rel) else os.path.join(root, rel)
+        with open(path) as f:
+            src = f.read()
+        lines = src.splitlines()
+        tree = ast.parse(src, filename=path)
+        _scan_module(tree, rel if not os.path.isabs(rel)
+                     else os.path.basename(rel), lines, findings)
+    return findings
+
+
+def _scan_module(tree, rel: str, lines: list, findings: list):
+    def emit(rule, line, detail):
+        if not suppressed(lines, line, rule):
+            findings.append(Finding(rule, rel, line, detail))
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _scan_function(node, emit, tree)
+        # Inline fd args anywhere (module level included).
+        if isinstance(node, ast.Call):
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Call) and _is_fd_ctor(arg) \
+                        and not _in_with_context(tree, arg):
+                    ctor = ast.unparse(arg.func)
+                    emit("fd-inline-arg", arg.lineno,
+                         f"{ctor}(...) passed inline to "
+                         f"{ast.unparse(node.func)}(...): the fd has no "
+                         "name and no closer")
+
+
+def _in_with_context(tree, call) -> bool:
+    for w in ast.walk(tree):
+        if isinstance(w, (ast.With, ast.AsyncWith)):
+            for item in w.items:
+                if item.context_expr is call:
+                    return True
+    return False
+
+
+def _scan_function(fn, emit, module_tree):
+    _scan_fds(fn, emit)
+    _scan_threads(fn, emit, module_tree)
+
+
+# ---------------- fds ----------------
+
+
+def _walk_shallow(fn):
+    """ast.walk minus nested function bodies (those are scanned as their
+    own functions)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _scan_fds(fn, emit):
+    tracked: dict[str, _FnScan] = {}
+    with_names: set = set()
+    for node in _walk_shallow(fn):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    with_names |= _names_in(item.optional_vars)
+                if isinstance(item.context_expr, ast.Call) \
+                        and _is_fd_ctor(item.context_expr):
+                    with_names.add("!ctx")  # with open(...) — owned
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)\
+                and _is_fd_ctor(node.value):
+            names = []
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.append(t.id)
+                elif isinstance(t, ast.Tuple):
+                    names.extend(e.id for e in t.elts
+                                 if isinstance(e, ast.Name))
+            for n in names:
+                tracked.setdefault(n, _FnScan(n, node.lineno))
+    if not tracked:
+        return
+    tracked = {n: s for n, s in tracked.items() if n not in with_names}
+
+    # One pass with an explicit try-ancestor stack for guard resolution.
+    def visit(node, try_stack):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not fn:
+            for name in _names_in(node):
+                if name in tracked:
+                    tracked[name].closure_escape = True
+            return
+        if isinstance(node, ast.Try):
+            for s in node.body:
+                visit(s, try_stack + [node])
+            for h in node.handlers:
+                for s in h.body:
+                    visit(s, try_stack)
+            for s in node.orelse + node.finalbody:
+                visit(s, try_stack)
+            return
+        _classify(node, try_stack)
+        for child in ast.iter_child_nodes(node):
+            visit(child, try_stack)
+
+    def _mark_escape(name, line):
+        s = tracked.get(name)
+        if s is not None and (s.escape_line is None or line < s.escape_line):
+            s.escape_line = line
+
+    def _classify(node, try_stack):
+        if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)) \
+                and node.value is not None:
+            for name in _names_in(node.value):
+                _mark_escape(name, node.lineno)
+        elif isinstance(node, ast.Assign):
+            if any(not isinstance(t, ast.Name) for t in node.targets):
+                for name in _names_in(node.value):
+                    _mark_escape(name, node.lineno)
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)\
+                    and f.value.id in tracked:
+                s = tracked[f.value.id]
+                if f.attr in _CLOSERS:
+                    s.closed = True
+                elif f.attr in _RISKY_USES:
+                    s.risky.append((node.lineno, f.attr, list(try_stack)))
+                return
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                for name in _names_in(arg):
+                    _mark_escape(name, node.lineno)
+
+    for stmt in fn.body:
+        visit(stmt, [])
+
+    for name, s in tracked.items():
+        if s.closure_escape:
+            continue
+        if s.escape_line is None and not s.closed:
+            emit("fd-no-closer", s.line,
+                 f"fd/socket '{name}' created in {fn.name} is never "
+                 "closed and never escapes")
+            continue
+        for line, attr, try_stack in s.risky:
+            if s.escape_line is not None and s.escape_line <= line:
+                continue  # ownership already transferred
+            if any(_try_closes(t, name) for t in try_stack):
+                continue
+            emit("fd-use-unguarded", line,
+                 f"'{name}.{attr}()' can raise out of {fn.name} before "
+                 f"ownership of '{name}' transfers, with no enclosing "
+                 "try closing it (dial-failure fd leak)")
+
+
+def _try_closes(try_node: ast.Try, name: str) -> bool:
+    bodies = list(try_node.finalbody)
+    for h in try_node.handlers:
+        bodies.extend(h.body)
+    for stmt in bodies:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == name \
+                    and node.func.attr in _CLOSERS:
+                return True
+    return False
+
+
+# ---------------- threads ----------------
+
+
+def _scan_threads(fn, emit, module_tree):
+    for node in _walk_shallow(fn):
+        if not (isinstance(node, ast.Call) and _is_thread_ctor(node)):
+            continue
+        daemon = None
+        for kw in node.keywords:
+            if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+                daemon = bool(kw.value.value)
+        if daemon:
+            continue
+        # Bound somewhere with a join (or daemon flip) in module reach?
+        owner = _thread_owner(fn, node)
+        if owner is not None and _has_join(module_tree, owner):
+            continue
+        emit("unjoined-thread", node.lineno,
+             f"non-daemon Thread created in {fn.name} without a .join() "
+             "owner (or daemon=True)")
+
+
+def _thread_owner(fn, call) -> str | None:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and node.value is call:
+            t = node.targets[0]
+            if isinstance(t, ast.Name):
+                return t.id
+            if isinstance(t, ast.Attribute):
+                return ast.unparse(t)
+    return None
+
+
+def _has_join(module_tree, owner: str) -> bool:
+    # A join/daemon-set on the owner anywhere in the module counts as a
+    # registered owner (e.g. created in __init__, joined in close()).
+    for node in ast.walk(module_tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "join" \
+                and ast.unparse(node.func.value) == owner:
+            return True
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and t.attr == "daemon" \
+                        and ast.unparse(t.value) == owner:
+                    return True
+    return False

@@ -1,0 +1,417 @@
+"""Pass 1 — wire-schema drift across the three hand-maintained copies.
+
+`ray_tpu/protocol/raytpu.proto` is the contract; the implementations are
+(a) the Python bindings in the default descriptor pool (the checked-in
+raytpu_pb2 plus the hand-authored FileDescriptorProtos core/worker_wire.py
+adds at import), (b) the worker_wire.py `_msg(...)` source itself (checked
+by AST so a typo is caught even when the import-time pool add would mask
+it), and (c) the hand-rolled varint codec cpp/pb/raytpu.pb.h (tag
+constants + wire types recovered from the Put*/Parse sites).
+
+Two pinned fallback tables encode the protoc-less reality this repo
+documents in the schema comments:
+
+  PICKLE_FRAMED_MESSAGES — messages documented in the proto but absent
+    from the checked-in bindings (they ride the pickle framing until the
+    next regen). The pin is verified BOTH ways: the proto must still
+    declare them at the pinned numbers, and the pool must still lack them
+    (a regen that binds one is drift in the pin itself — delete the entry).
+  FALLBACK_FIELDS — fields of BOUND messages that are documented but not
+    generated (proto_wire.py falls back to pickle framing when they are
+    set). Same both-ways verification.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from tools.staticcheck import Finding
+from tools.staticcheck import protoparse
+
+PROTO_REL = "ray_tpu/protocol/raytpu.proto"
+WW_REL = "ray_tpu/core/worker_wire.py"
+CPP_REL = "cpp/pb/raytpu.pb.h"
+
+# Messages the checked-in bindings do not carry (pickle framing until the
+# next protoc regen): message -> {field name: number}.
+PICKLE_FRAMED_MESSAGES = {
+    "ClusterViewEntry": {"node_id": 1, "entry_version": 2, "state": 3,
+                         "idle_workers": 4, "lease_backlog": 5,
+                         "lease_inflight": 6, "cpu": 7, "ctrl_host": 8,
+                         "ctrl_port": 9},
+    "ClusterView": {"version": 1, "entries": 2},
+    "LeaseSpilled": {"moves": 1},
+    "LeaseSpilled.Move": {"task_id": 1, "lease_seq": 2, "spill_hops": 3,
+                          "to_node_id": 4},
+    "TaskEvent": {"task_id": 1, "attempt": 2, "state": 3, "ts": 4,
+                  "name": 5, "data": 6},
+    "TaskEvents": {"events": 1, "dropped": 2},
+    "MetricsUpdate": {"metrics": 1},
+    "MetricsUpdate.Metric": {"name": 1, "kind": 2, "description": 3,
+                             "tag_keys": 4, "values": 5},
+}
+
+# Fields of bound messages that ride the pickle-framing fallback when set
+# (documented in the proto, absent from the generated classes).
+FALLBACK_FIELDS = {
+    "TaskSpec": {"language": 21},
+    "RegisterNode.WorkerInventory": {"language": 4},
+    "AgentFrame": {"cluster_view": 11, "lease_spilled": 12,
+                   "task_events": 13, "metrics_update": 14},
+}
+
+# cpp class -> proto message(s) it implements (identity unless listed).
+CPP_ALIASES = {
+    "SimpleOkReply": ("RemovePlacementGroupReply", "KillActorReply",
+                      "KvPutReply"),
+}
+# Worker-plane messages the cpp codec must materialize COMPLETELY (the
+# client-plane classes are deliberate subsets; unknown fields skip).
+CPP_COMPLETE = ("WorkerHello", "WorkerOut", "WorkerDone")
+# Messages the C++ frontends depend on: a missing class is drift.
+CPP_REQUIRED = (
+    "Value", "Arg", "TaskArgs", "TaskSpec", "WorkerHello", "WorkerOut",
+    "WorkerDone", "WorkerFrame", "InitRequest", "InitReply", "PutRequest",
+    "PutReply", "GetRequest", "GetReply", "SubmitRequest", "SubmitReply",
+    "WaitRequest", "WaitReply", "CreateActorRequest", "CreateActorReply",
+    "Bundle", "CreatePlacementGroupRequest", "CreatePlacementGroupReply",
+    "RemovePlacementGroupRequest", "ActorCallRequest", "ActorCallReply",
+    "KillActorRequest", "KvPutRequest", "KvGetRequest", "KvGetReply",
+    "SimpleOkReply", "ClientRequest", "ClientReply",
+)
+
+RULE = "wire-drift"
+
+
+def run(root: str, proto_path: str | None = None,
+        ww_path: str | None = None, cpp_path: str | None = None,
+        use_pool: bool = True) -> list:
+    """All three cross-checks. Path overrides exist for the mutation
+    tests (run the real implementations against a doctored schema)."""
+    proto_path = proto_path or os.path.join(root, PROTO_REL)
+    ww_path = ww_path or os.path.join(root, WW_REL)
+    cpp_path = cpp_path or os.path.join(root, CPP_REL)
+    findings: list[Finding] = []
+    try:
+        schema = protoparse.parse(proto_path)
+    except ValueError as e:
+        return [Finding(RULE, PROTO_REL, 0, f"unparseable schema: {e}")]
+    if use_pool:
+        findings += check_pool(schema)
+    findings += check_worker_wire(schema, ww_path)
+    findings += check_cpp_header(schema, cpp_path)
+    return findings
+
+
+# ---------------- (a) descriptor-pool bindings ----------------
+
+def _pool_wire_type(fd) -> int | None:
+    from google.protobuf.descriptor import FieldDescriptor as F
+    wt = {F.TYPE_INT32: 0, F.TYPE_INT64: 0, F.TYPE_UINT32: 0,
+          F.TYPE_UINT64: 0, F.TYPE_SINT32: 0, F.TYPE_SINT64: 0,
+          F.TYPE_BOOL: 0, F.TYPE_ENUM: 0, F.TYPE_FIXED64: 1,
+          F.TYPE_SFIXED64: 1, F.TYPE_DOUBLE: 1, F.TYPE_FIXED32: 5,
+          F.TYPE_SFIXED32: 5, F.TYPE_FLOAT: 5, F.TYPE_STRING: 2,
+          F.TYPE_BYTES: 2, F.TYPE_MESSAGE: 2}
+    return wt.get(fd.type)
+
+
+def check_pool(schema: dict) -> list:
+    """Every proto message vs the live descriptor pool (raytpu_pb2 +
+    worker_wire's import-time additions)."""
+    import ray_tpu.core.worker_wire  # noqa: F401 — adds Worker* to pool
+    import ray_tpu.protocol.raytpu_pb2  # noqa: F401
+    from google.protobuf import descriptor_pool
+    pool = descriptor_pool.Default()
+    out: list[Finding] = []
+
+    for name, msg in schema.items():
+        if name.endswith("#entry"):
+            continue  # synthesized map entries: covered via the map field
+        pinned = PICKLE_FRAMED_MESSAGES.get(name)
+        try:
+            desc = pool.FindMessageTypeByName(f"raytpu.{name}")
+        except KeyError:
+            desc = None
+        if pinned is not None:
+            if desc is not None:
+                out.append(Finding(
+                    RULE, PROTO_REL, 0,
+                    f"{name}: pinned as pickle-framed but the pool now "
+                    "binds it — regen landed; delete its "
+                    "PICKLE_FRAMED_MESSAGES entry"))
+                continue
+            # Verify the pin still matches the schema (a schema edit that
+            # renumbers a pickle-framed message is exactly the silent
+            # drift the pickle path cannot catch at runtime).
+            declared = {f.name: f.number for f in msg.fields.values()}
+            if declared != pinned:
+                out.append(Finding(
+                    RULE, PROTO_REL, 0,
+                    f"{name}: proto declares {declared} but the "
+                    f"pickle-framing pin expects {pinned}"))
+            continue
+        if desc is None:
+            out.append(Finding(
+                RULE, PROTO_REL, 0,
+                f"{name}: declared in raytpu.proto but absent from the "
+                "python bindings (and not pinned as pickle-framed)"))
+            continue
+        fallback = FALLBACK_FIELDS.get(name, {})
+        bound = {f.name: f for f in desc.fields}
+        for f in msg.fields.values():
+            if f.name in fallback:
+                if fallback[f.name] != f.number:
+                    out.append(Finding(
+                        RULE, PROTO_REL, 0,
+                        f"{name}.{f.name}: proto number {f.number} != "
+                        f"pickle-fallback pin {fallback[f.name]}"))
+                if f.name in bound:
+                    out.append(Finding(
+                        RULE, PROTO_REL, 0,
+                        f"{name}.{f.name}: pinned as a pickle-fallback "
+                        "field but the bindings now carry it — delete "
+                        "its FALLBACK_FIELDS entry"))
+                continue
+            bf = bound.get(f.name)
+            if bf is None:
+                out.append(Finding(
+                    RULE, PROTO_REL, 0,
+                    f"{name}.{f.name}: in raytpu.proto but not in the "
+                    "python bindings"))
+                continue
+            if bf.number != f.number:
+                out.append(Finding(
+                    RULE, PROTO_REL, 0,
+                    f"{name}.{f.name}: field number {f.number} in proto "
+                    f"vs {bf.number} in the python bindings"))
+            pwt = _pool_wire_type(bf)
+            if pwt is not None and pwt != f.wire_type:
+                out.append(Finding(
+                    RULE, PROTO_REL, 0,
+                    f"{name}.{f.name}: wire type {f.wire_type} in proto "
+                    f"vs {pwt} in the python bindings"))
+        for bname, bf in bound.items():
+            if bname not in msg.fields:
+                out.append(Finding(
+                    RULE, PROTO_REL, 0,
+                    f"{name}.{bname}: in the python bindings (number "
+                    f"{bf.number}) but not in raytpu.proto"))
+    return out
+
+
+# ---------------- (b) worker_wire.py hand-authored descriptors ----------------
+
+_TYPE_ATTR_TO_PROTO = {
+    "TYPE_BYTES": "bytes", "TYPE_STRING": "string", "TYPE_INT32": "int32",
+    "TYPE_INT64": "int64", "TYPE_UINT64": "uint64", "TYPE_BOOL": "bool",
+    "TYPE_DOUBLE": "double", "TYPE_FLOAT": "float",
+}
+
+
+def check_worker_wire(schema: dict, path: str) -> list:
+    """AST cross-check of every `_msg(f, "Name", [...])` field tuple in
+    worker_wire.py against the schema — source-level, so a bad edit is
+    caught even if a stale pool already holds the old (correct) shape."""
+    rel = WW_REL
+    out: list[Finding] = []
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    seen: dict[str, dict] = {}  # msg name -> {fname: (num, type, rep, line)}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "_msg" and len(node.args) == 3):
+            continue
+        mname = node.args[1].value
+        fields = {}
+        for t in node.args[2].elts:
+            fname, num, ftype, tname, rep = t.elts
+            if isinstance(ftype, ast.Attribute):
+                type_attr = ftype.attr
+            else:
+                type_attr = "?"
+            tn = tname.value if isinstance(tname, ast.Constant) else None
+            if type_attr == "TYPE_MESSAGE":
+                ptype = (tn or "").removeprefix(".raytpu.")
+            else:
+                ptype = _TYPE_ATTR_TO_PROTO.get(type_attr, "?")
+            fields[fname.value] = (num.value, ptype, bool(rep.value),
+                                   t.lineno)
+        seen[mname] = fields
+
+    for mname, fields in seen.items():
+        msg = schema.get(mname)
+        if msg is None:
+            out.append(Finding(
+                RULE, rel, 0,
+                f"{mname}: descriptor built in worker_wire.py but the "
+                "message is not in raytpu.proto"))
+            continue
+        for fname, (num, ptype, rep, line) in fields.items():
+            pf = msg.fields.get(fname)
+            if pf is None:
+                out.append(Finding(
+                    RULE, rel, line,
+                    f"{mname}.{fname}: in worker_wire.py but not in "
+                    "raytpu.proto"))
+                continue
+            if pf.number != num:
+                out.append(Finding(
+                    RULE, rel, line,
+                    f"{mname}.{fname}: field number {num} in "
+                    f"worker_wire.py vs {pf.number} in raytpu.proto"))
+            if pf.type != ptype:
+                out.append(Finding(
+                    RULE, rel, line,
+                    f"{mname}.{fname}: type {ptype} in worker_wire.py "
+                    f"vs {pf.type} in raytpu.proto"))
+            if pf.repeated != rep:
+                out.append(Finding(
+                    RULE, rel, line,
+                    f"{mname}.{fname}: repeated={rep} in worker_wire.py "
+                    f"vs {pf.repeated} in raytpu.proto"))
+        for fname, pf in msg.fields.items():
+            if fname not in fields:
+                out.append(Finding(
+                    RULE, rel, 0,
+                    f"{mname}.{fname}: in raytpu.proto (number "
+                    f"{pf.number}) but missing from the worker_wire.py "
+                    "descriptor"))
+    # The worker plane must be fully mirrored here (these bindings are
+    # how Python speaks to the C++ worker at all).
+    for mname in ("WorkerHello", "WorkerExec", "WorkerOut", "WorkerDone",
+                  "WorkerShutdown", "WorkerFrame"):
+        if mname in schema and mname not in seen:
+            out.append(Finding(
+                RULE, rel, 0,
+                f"{mname}: worker-plane message has no worker_wire.py "
+                "descriptor"))
+    return out
+
+
+# ---------------- (c) cpp/pb/raytpu.pb.h tag constants ----------------
+
+_PUT = re.compile(
+    r"pbwire::Put(LenField|LenAlways|Int|Bool|Double|MapSD)"
+    r"\(\s*[^,()]*,\s*(\d+)\s*,")
+_FWT = re.compile(r"f == (\d+) && wt == (\d+)")
+_CASE = re.compile(r"case (\d+):")
+_WHICH = re.compile(r"which_ = (\d+)")
+_PUT_WT = {"LenField": 2, "LenAlways": 2, "MapSD": 2, "Int": 0,
+           "Bool": 0, "Double": 1}
+
+
+def _cpp_classes(text: str) -> dict:
+    """{class name: (body text, start line)} for namespace raytpu."""
+    ns = text.find("namespace raytpu")
+    if ns < 0:
+        return {}
+    out = {}
+    for m in re.finditer(r"^(?:class|struct) (\w+)", text[ns:], re.M):
+        name = m.group(1)
+        start = ns + m.start()
+        brace = text.find("{", start)
+        if brace < 0:
+            continue
+        depth, i = 1, brace + 1
+        while i < len(text) and depth:
+            if text[i] == "{":
+                depth += 1
+            elif text[i] == "}":
+                depth -= 1
+            i += 1
+        out[name] = (text[brace:i], text[:start].count("\n") + 1)
+    return out
+
+
+def _case_wire_type(body: str, pos: int) -> int | None:
+    """Wire type implied by a `case N:` arm: what reader call consumes it
+    (up to the next break/case)."""
+    stop = len(body)
+    for marker in ("break", "case ", "default:"):
+        j = body.find(marker, pos)
+        if 0 <= j < stop:
+            stop = j
+    seg = body[pos:stop]
+    if "r.Bytes()" in seg or "r.View(" in seg or ".Parse(" in seg:
+        return 2
+    if "r.Varint()" in seg:
+        return 0
+    if "r.Double()" in seg:
+        return 1
+    return None
+
+
+def _class_evidence(body: str, base_line: int) -> list:
+    """[(field number, wire type | None, line)] tag uses in one class."""
+    ev = []
+
+    def line_of(pos):
+        return base_line + body[:pos].count("\n")
+
+    for m in _PUT.finditer(body):
+        ev.append((int(m.group(2)), _PUT_WT[m.group(1)], line_of(m.start())))
+    for m in _FWT.finditer(body):
+        ev.append((int(m.group(1)), int(m.group(2)), line_of(m.start())))
+    for m in _CASE.finditer(body):
+        ev.append((int(m.group(1)), _case_wire_type(body, m.end()),
+                   line_of(m.start())))
+    for m in _WHICH.finditer(body):
+        # ClientRequest oneof arm selectors; every arm is a message (wt 2).
+        # 0 is the "nothing set" initializer, not a tag.
+        if int(m.group(1)) > 0:
+            ev.append((int(m.group(1)), 2, line_of(m.start())))
+    return ev
+
+
+def check_cpp_header(schema: dict, path: str) -> list:
+    rel = CPP_REL
+    out: list[Finding] = []
+    with open(path) as f:
+        text = f.read()
+    classes = _cpp_classes(text)
+    for req in CPP_REQUIRED:
+        if req not in classes and req not in CPP_ALIASES.values():
+            out.append(Finding(
+                RULE, rel, 0,
+                f"{req}: required by the C++ frontends but no class in "
+                "the hand-rolled codec"))
+    for cname, (body, base_line) in classes.items():
+        targets = CPP_ALIASES.get(cname, (cname,))
+        msgs = [schema[t] for t in targets if t in schema]
+        if not msgs:
+            out.append(Finding(
+                RULE, rel, base_line,
+                f"{cname}: class in the hand-rolled codec but no such "
+                "message in raytpu.proto"))
+            continue
+        evidence = _class_evidence(body, base_line)
+        seen_nums = set()
+        for num, wt, line in evidence:
+            seen_nums.add(num)
+            for msg in msgs:
+                pf = msg.by_number().get(num)
+                if pf is None:
+                    out.append(Finding(
+                        RULE, rel, line,
+                        f"{cname}: tag {num} used in the codec but "
+                        f"{msg.full_name} has no field {num} in "
+                        "raytpu.proto"))
+                elif wt is not None and wt != pf.wire_type:
+                    out.append(Finding(
+                        RULE, rel, line,
+                        f"{cname}: field {num} ({msg.full_name}."
+                        f"{pf.name}) encoded/parsed as wire type {wt} "
+                        f"but raytpu.proto says {pf.wire_type}"))
+        if cname in CPP_COMPLETE:
+            for pf in msgs[0].fields.values():
+                if pf.number not in seen_nums:
+                    out.append(Finding(
+                        RULE, rel, base_line,
+                        f"{cname}.{pf.name}: worker-plane field (number "
+                        f"{pf.number}) missing from the hand-rolled "
+                        "codec"))
+    return out
