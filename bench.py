@@ -169,6 +169,17 @@ def final_line(status: str = "complete"):
         "n_metrics": len(ratios),
         "n_missing": len(missing),
         "n_skipped": len(SKIPPED),
+        # The two data-plane gap rows (ROADMAP item 2): per-row ratio vs
+        # ref right in the headline so the trajectory reads without
+        # opening BENCH_OUT.
+        "mc_put_x": (round(RESULTS["multi_client_put_gigabytes"]
+                           / BASELINE["multi_client_put_gigabytes"], 3)
+                     if RESULTS.get("multi_client_put_gigabytes")
+                     else None),
+        "nn_async_x": (round(RESULTS["n_n_async_actor_calls_async"]
+                             / BASELINE["n_n_async_actor_calls_async"], 3)
+                       if RESULTS.get("n_n_async_actor_calls_async")
+                       else None),
         "adag_x": EXTRAS.get("adag_pipeline", {}).get("tensor_speedup_x"),
         "tev_ovh_pct": EXTRAS.get("task_events", {}).get("overhead_pct"),
         "xlang_s": EXTRAS.get("cross_language", {}).get(
@@ -196,7 +207,7 @@ def final_line(status: str = "complete"):
     if len(line) >= 2048:
         for key in ("host", "tpu_mfu_pct", "xlang_s", "tev_ovh_pct",
                     "adag_x", "n_skipped", "n_missing", "n_metrics",
-                    "wall_s", "status"):
+                    "wall_s", "status", "mc_put_x", "nn_async_x"):
             headline.pop(key, None)
             line = json.dumps(headline)
             if len(line) < 2048:

@@ -26,6 +26,13 @@
 extern "C" {
 int store_init(void* base, uint64_t total_size, uint64_t num_slots,
                uint64_t nshards);
+int store_reserve(void* base, uint64_t size, uint64_t* out_offset);
+int store_release_extent(void* base, uint64_t abs_offset, uint64_t size);
+int store_publish(void* base, const uint8_t* id, uint64_t abs_offset,
+                  uint64_t data_size, uint64_t meta_size);
+uint64_t store_num_reserves(void* base);
+void store_copy_adaptive(void* base, void* dst, const void* src, uint64_t n,
+                         int max_threads);
 int store_validate(void* base);
 int store_create(void* base, const uint8_t* id, uint64_t data_size,
                  uint64_t meta_size, uint64_t* out_offset);
@@ -45,6 +52,8 @@ void* g_base = nullptr;
 std::atomic<uint64_t> g_errors{0};
 std::atomic<uint64_t> g_seals{0};
 std::atomic<uint64_t> g_hits{0};
+std::atomic<uint64_t> g_reserves{0};
+std::atomic<uint64_t> g_publishes{0};
 
 // Object ids are 16 bytes; (tid, slot) keys collide across threads by
 // construction: slot is shared modulo space, so create/create races,
@@ -102,6 +111,50 @@ void* worker(void* argp) {
     } else if (op == 8) {
       make_id(id, rnd() % a->nthreads, rnd() % kSlots);
       store_contains(g_base, id);
+    } else if (op == 9 && (rnd() & 1)) {
+      // Reservation plane: reserve -> bump-carve 1..4 objects -> fill
+      // LOCK-FREE (adaptive copy for one of them) -> publish sealed ->
+      // release the tail. Interleaves with creates/evictions/deletes on
+      // the same shared id space, so every unlock-free fill racing an
+      // eviction or a duplicate publish is TSan-visible. Block geometry
+      // mirrors _round_block: align64(max(n, 128)).
+      const uint64_t kRsv = 192 * 1024;
+      uint64_t ext = 0;
+      if (store_reserve(g_base, kRsv, &ext) == 0) {
+        g_reserves.fetch_add(1);
+        uint64_t used = 0;
+        uint64_t nobjs = 1 + rnd() % 4;
+        char src[4096];
+        memset(src, 0x5a, sizeof(src));
+        for (uint64_t k = 0; k < nobjs; k++) {
+          uint64_t sizes[] = {96, 2048, 40000, 60000};
+          uint64_t dsz = sizes[rnd() % 4];
+          uint64_t block = dsz + 4 < 128 ? 128 : dsz + 4;
+          block = (block + 63) & ~63ULL;
+          if (used + block > kRsv) break;
+          uint64_t off = ext + used;
+          used += block;
+          char* dst = static_cast<char*>(g_base) + off;
+          // Fill with NO lock held: chunked copies + the adaptive path.
+          for (uint64_t w = 0; w < dsz; w += sizeof(src)) {
+            uint64_t len = dsz - w < sizeof(src) ? dsz - w : sizeof(src);
+            if (w == 0)
+              store_copy_adaptive(g_base, dst, src, len, 2);
+            else
+              memcpy(dst + w, src, len);
+          }
+          memcpy(dst + dsz, "meta", 4);
+          make_id(id, rnd() % a->nthreads, rnd() % kSlots);
+          if (store_publish(g_base, id, off, dsz, 4) == 0)
+            g_publishes.fetch_add(1);
+          else
+            // Duplicate id / full table: the chunk stays ours — return
+            // it so the accounting balances.
+            store_release_extent(g_base, off, block);
+        }
+        if (used < kRsv)
+          store_release_extent(g_base, ext + used, kRsv - used);
+      }
     } else {  // delete (refcounted objects survive; sealed idle ones go)
       make_id(id, rnd() % a->nthreads, rnd() % kSlots);
       store_delete(g_base, id);
@@ -146,11 +199,14 @@ int main(int argc, char** argv) {
   uint64_t allocated = 0, capacity = 0, objects = 0, evictions = 0;
   store_stats(g_base, &allocated, &capacity, &objects, &evictions);
   printf("STRESS_OK threads=%llu iters=%llu seals=%llu hits=%llu "
-         "objects=%llu evictions=%llu allocated=%llu\n",
+         "objects=%llu evictions=%llu allocated=%llu reserves=%llu "
+         "publishes=%llu\n",
          (unsigned long long)nthreads, (unsigned long long)iters,
          (unsigned long long)g_seals.load(),
          (unsigned long long)g_hits.load(),
          (unsigned long long)objects, (unsigned long long)evictions,
-         (unsigned long long)allocated);
+         (unsigned long long)allocated,
+         (unsigned long long)g_reserves.load(),
+         (unsigned long long)g_publishes.load());
   return 0;
 }

@@ -58,6 +58,16 @@ void store_memcpy(void* dst, const void* src, uint64_t n, int nthreads) {
   for (auto& t : ts) t.join();
 }
 
+// Adaptive-width arena copy: divides the thread budget by the number of
+// concurrent large copies into the SAME arena. One client copying 1GB
+// wants every core; ten clients each copying 80MB already parallelize
+// across processes, and giving each of them `max_threads` workers
+// oversubscribes the box 10x (measured as the multi-client put collapse:
+// more copy threads, less aggregate bandwidth). The counter lives in the
+// shm header so separate client processes see each other.
+void store_copy_adaptive(void* base, void* dst, const void* src, uint64_t n,
+                         int max_threads);
+
 enum StoreStatus {
   OK = 0,
   ERR_NOTFOUND = -1,
@@ -69,7 +79,7 @@ enum StoreStatus {
   ERR_CORRUPT = -7,
 };
 
-static const uint64_t MAGIC = 0x5241595F54505532ULL;  // "RAY_TPU2" (sharded)
+static const uint64_t MAGIC = 0x5241595F54505533ULL;  // "RAY_TPU3" (reservations)
 static const uint64_t ALIGN = 64;
 static const uint64_t MIN_BLOCK = 128;
 static const uint32_t SHARD_CANARY = 0x53484152;      // "SHAR"
@@ -130,6 +140,17 @@ struct Header {
   uint64_t free_head;        // global extent list, arena-relative, 0 = none
   uint64_t bytes_from_global;  // bytes carved out of the global list
   uint64_t lru_clock;          // advanced with atomics, no lock
+  // Write-reservation plane (multi-client put bandwidth): extents carved
+  // once and bump-filled client-side, published as sealed slots.
+  uint64_t num_reserves;       // atomic counter (diagnostics/tests)
+  uint64_t rsv_unused_bytes;   // atomic: reserved but not yet published —
+                               // subtracted from stats "allocated" so the
+                               // spill policy sees live bytes, not parked
+                               // headroom
+  uint64_t active_copiers;     // atomic: in-flight large arena copies;
+                               // store_copy_adaptive divides its thread
+                               // budget by this so N concurrent clients
+                               // don't oversubscribe N*threads workers
 };
 
 static inline Shard* shard_at(Header* h, uint64_t i) {
@@ -469,6 +490,121 @@ static int64_t alloc_with_eviction(Header* h, uint64_t sidx, uint64_t need) {
   return off;
 }
 
+// ---- write reservations (per-client lock-free put extents) ----
+//
+// The multi-client put path: a client carves one large extent under the
+// global mutex (store_reserve), bump-allocates object payloads inside it
+// with NO shared lock, memcpys each payload lock-free, and publishes each
+// finished object as an already-SEALED slot (store_publish — one short
+// shard-lock critical section; the state store is the visibility point).
+// Unused tail space returns via store_release_extent. Block geometry
+// contract: every published object occupies align_up(max(data+meta,
+// MIN_BLOCK)) bytes inside the extent — exactly what shard_free returns
+// on later eviction/delete, so reservation-born blocks coalesce like any
+// other.
+
+static void sweep_evict_all_shards(Header* h, bool* progress) {
+  *progress = false;
+  for (uint64_t i = 0; i < h->nshards; i++) {
+    Shard* sh = shard_at(h, i);
+    lock_mu(&sh->mutex);
+    Slot* v = oldest_evictable(h, i);
+    if (v != nullptr) {
+      evict_entry(h, i, v, true);
+      sh->num_evictions++;
+      *progress = true;
+    }
+    consolidate_shard(h, sh);
+    unlock_mu(&sh->mutex);
+  }
+}
+
+// Carve a raw extent of `size` bytes; *out_offset is ABSOLUTE (from
+// base), like store_create's. Evicts sealed refcnt==0 objects across all
+// shards under pressure. Returns OK or ERR_FULL.
+int store_reserve(void* base, uint64_t size, uint64_t* out_offset) {
+  Header* h = (Header*)base;
+  uint64_t need = align_up(size < MIN_BLOCK ? MIN_BLOCK : size);
+  for (;;) {
+    lock_mu(&h->mutex);
+    int64_t off = list_alloc_first_fit(h, &h->free_head, need);
+    if (off >= 0) h->bytes_from_global += need;
+    unlock_mu(&h->mutex);
+    if (off >= 0) {
+      __atomic_add_fetch(&h->num_reserves, 1, __ATOMIC_RELAXED);
+      __atomic_add_fetch(&h->rsv_unused_bytes, need, __ATOMIC_RELAXED);
+      *out_offset = h->arena_offset + (uint64_t)off;
+      return OK;
+    }
+    bool progress = false;
+    sweep_evict_all_shards(h, &progress);
+    if (!progress) return ERR_FULL;
+  }
+}
+
+// Return an unused reservation slice (tail, aborted chunk, or the whole
+// extent) to the global list. abs_offset/size must delimit bytes that
+// were reserved and never published.
+int store_release_extent(void* base, uint64_t abs_offset, uint64_t size) {
+  Header* h = (Header*)base;
+  if (size == 0) return OK;
+  uint64_t off = abs_offset - h->arena_offset;
+  lock_mu(&h->mutex);
+  h->bytes_from_global -= size;
+  list_insert_ordered(h, &h->free_head, off, size);
+  unlock_mu(&h->mutex);
+  __atomic_sub_fetch(&h->rsv_unused_bytes, size, __ATOMIC_RELAXED);
+  return OK;
+}
+
+// Publish a filled reservation chunk as a sealed object. The data +
+// metadata bytes are already in place at abs_offset; this inserts the
+// slot (SEALED, refcnt 0) under the shard lock — the single point where
+// the object becomes visible to store_get.
+int store_publish(void* base, const uint8_t* id, uint64_t abs_offset,
+                  uint64_t data_size, uint64_t meta_size) {
+  Header* h = (Header*)base;
+  uint64_t sidx = shard_of(h, id);
+  Shard* sh = shard_at(h, sidx);
+  uint64_t raw = data_size + meta_size;
+  uint64_t block = align_up(raw < MIN_BLOCK ? MIN_BLOCK : raw);
+  lock_mu(&sh->mutex);
+  Slot* s = insert_slot(h, sidx, id);
+  if (s == nullptr) {
+    int rc = find_slot(h, sidx, id) ? ERR_EXISTS : ERR_TABLE_FULL;
+    unlock_mu(&sh->mutex);
+    return rc;
+  }
+  memcpy(s->id, id, 16);
+  s->offset = abs_offset - h->arena_offset;
+  s->data_size = data_size;
+  s->meta_size = meta_size;
+  if (s->state == SLOT_TOMBSTONE) sh->num_tombstones--;
+  s->refcnt = 0;
+  s->lru_tick = next_tick(h);
+  s->pending_delete = 0;
+  __atomic_store_n(&s->state, (uint32_t)SLOT_SEALED, __ATOMIC_RELEASE);
+  sh->num_objects++;
+  unlock_mu(&sh->mutex);
+  __atomic_sub_fetch(&h->rsv_unused_bytes, block, __ATOMIC_RELAXED);
+  return OK;
+}
+
+uint64_t store_num_reserves(void* base) {
+  return __atomic_load_n(&((Header*)base)->num_reserves, __ATOMIC_RELAXED);
+}
+
+void store_copy_adaptive(void* base, void* dst, const void* src, uint64_t n,
+                         int max_threads) {
+  Header* h = (Header*)base;
+  uint64_t active =
+      __atomic_add_fetch(&h->active_copiers, 1, __ATOMIC_RELAXED);
+  int threads = max_threads / (int)(active ? active : 1);
+  if (threads < 1) threads = 1;
+  store_memcpy(dst, src, n, threads);
+  __atomic_sub_fetch(&h->active_copiers, 1, __ATOMIC_RELAXED);
+}
+
 // ---- public API ----
 
 int store_init(void* base, uint64_t total_size, uint64_t num_slots,
@@ -683,7 +819,10 @@ void store_stats(void* base, uint64_t* out_allocated, uint64_t* out_capacity,
     nevict += __atomic_load_n(&sh->num_evictions, __ATOMIC_RELAXED);
     cached += __atomic_load_n(&sh->cache_bytes, __ATOMIC_RELAXED);
   }
-  // Bytes parked in shard caches are free capacity, not live objects.
+  // Bytes parked in shard caches are free capacity, not live objects —
+  // and so are reserved-but-unpublished reservation slices (counting
+  // them would trip the spill policy on parked headroom).
+  cached += __atomic_load_n(&h->rsv_unused_bytes, __ATOMIC_RELAXED);
   *out_allocated = allocated > cached ? allocated - cached : 0;
   *out_capacity = h->arena_size;
   *out_num_objects = nobj;

@@ -12,6 +12,9 @@
 //   request:  16-byte object id
 //   response: u8 ok; if ok: u64 data_size, u64 meta_size, meta bytes,
 //             data bytes
+//   range request (multi-stream pulls): 16-byte RANGE_MAGIC, 16-byte
+//   object id, u64 offset, u64 length; response carries the TOTAL
+//   data_size/meta_size + meta, then only the requested byte slice.
 // Connections are persistent (many requests) and closed on peer EOF.
 //
 // Threading: one accept thread + one detached thread per connection —
@@ -85,8 +88,22 @@ static void* conn_main(void* arg) {
   delete ctx;
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  // Mirrors objxfer.RANGE_MAGIC: 0xff "RAYTPU_RANGE_1" 0xff.
+  static const uint8_t kRangeMagic[16] = {
+      0xff, 'R', 'A', 'Y', 'T', 'P', 'U', '_',
+      'R', 'A', 'N', 'G', 'E', '_', '1', 0xff};
   uint8_t oid[16];
   while (!st->stopping.load() && read_exact(fd, oid, 16) == 0) {
+    uint64_t want_off = 0, want_len = 0;
+    bool ranged = false;
+    if (memcmp(oid, kRangeMagic, 16) == 0) {
+      uint8_t req[16 + 8 + 8];
+      if (read_exact(fd, req, sizeof(req)) != 0) break;
+      memcpy(oid, req, 16);
+      memcpy(&want_off, req + 16, 8);
+      memcpy(&want_len, req + 24, 8);
+      ranged = true;
+    }
     uint64_t off = 0, dsize = 0, msize = 0;
     int rc = store_get(base, oid, &off, &dsize, &msize);
     if (rc != 0) {
@@ -96,6 +113,12 @@ static void* conn_main(void* arg) {
       if (write_all(fd, &ok, 1) != 0) break;
       continue;
     }
+    uint64_t s_off = 0, s_len = dsize;
+    if (ranged) {
+      s_off = want_off > dsize ? dsize : want_off;
+      s_len = dsize - s_off;
+      if (want_len < s_len) s_len = want_len;
+    }
     uint8_t hdr[1 + 8 + 8];
     hdr[0] = 1;
     memcpy(hdr + 1, &dsize, 8);
@@ -103,7 +126,7 @@ static void* conn_main(void* arg) {
     const char* data = (const char*)base + off;
     int err = write_all(fd, hdr, sizeof(hdr));
     if (!err && msize) err = write_all(fd, data + dsize, msize);
-    if (!err) err = write_all(fd, data, dsize);
+    if (!err && s_len) err = write_all(fd, data + s_off, s_len);
     store_release(base, oid);
     if (err) break;
   }

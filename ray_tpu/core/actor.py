@@ -83,7 +83,8 @@ class ActorClass:
             max_restarts=opts.get("max_restarts", cfg.actor_max_restarts_default),
             max_task_retries=opts.get("max_task_retries", 0),
             max_concurrency=opts.get(
-                "max_concurrency", 1000 if has_async else 1),
+                "max_concurrency",
+                cfg.async_actor_default_max_concurrency if has_async else 1),
             is_async=has_async,
             # Parity with the reference: an actor holds 0 CPUs for its
             # lifetime unless asked (actor.py default) — a 1-CPU default

@@ -34,11 +34,26 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
                              "floor"),
     "object_spill_dir": (str, "", "directory for spilled objects; '' = <session>/spill"),
     "object_spill_threshold": (float, 0.8, "spill when arena usage exceeds this"),
+    "put_reservation_min_bytes": (int, 4 << 20, "puts at least this large "
+                                  "take the per-client write-reservation "
+                                  "path (carve once under the shard/global "
+                                  "lock, fill lock-free, publish sealed); "
+                                  "0 disables the plane"),
+    "put_reservation_bytes": (int, 0, "write-reservation extent size per "
+                              "client; 0 = auto (min(256MB, arena/16)). "
+                              "Bigger extents amortize the global carve + "
+                              "spill check over more puts but strand more "
+                              "headroom per idle client"),
     "objxfer_conn_cache_size": (int, 4, "idle persistent pull connections "
                                 "cached per peer address (the objxfer "
                                 "client reuses one connection per pull "
                                 "instead of dialing); 0 = close after "
                                 "every pull"),
+    "objxfer_streams": (int, 4, "connections a single large cross-node "
+                        "object pull is striped over (range requests on "
+                        "cached connections); 1 = whole-object pulls"),
+    "objxfer_stream_min_bytes": (int, 32 << 20, "objects smaller than this "
+                                 "always pull on one connection"),
     # --- compiled-graph channels (parity: the NCCL-channel data plane
     #     under the reference's compiled graphs) ---
     "dag_channel_type": (str, "tensor", "compiled-graph channel encoding: "
@@ -125,12 +140,29 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "fetch_retry_timeout_s": (float, 10.0, "re-drive a cross-node object "
                               "fetch with no reply after this long "
                               "(<=0 disables; 3 retries then lost)"),
+    "async_actor_executor_shards": (int, 0, "event-loop shards per async "
+                                    "actor (each a thread running its own "
+                                    "asyncio loop; idle shards steal queued "
+                                    "calls from busy ones). 0 = auto "
+                                    "(min(4, cores/2), floor 1). >1 runs "
+                                    "coroutines of ONE actor on several "
+                                    "threads — method bodies that mutate "
+                                    "instance state between awaits should "
+                                    "pin shards to 1"),
+    "async_actor_default_max_concurrency": (int, 1000, "max_concurrency "
+                                            "for async actors that don't "
+                                            "set one (parity: the "
+                                            "reference's async-actor "
+                                            "default)"),
     "direct_actor_calls": (bool, True, "worker->actor calls between agent "
                            "nodes ride direct agent<->agent channels, "
                            "bypassing the head relay"),
-    "worker_direct_calls": (bool, True, "head-node worker->worker actor "
+    "worker_direct_calls": (bool, True, "same-node worker->worker actor "
                             "calls ride a direct unix-socket peer plane "
-                            "(2 hops instead of 4), bypassing the head"),
+                            "(2 hops instead of 4), bypassing the head on "
+                            "head nodes and the agent relay on agent "
+                            "nodes (call AND reply; the agent only sees "
+                            "async task-event/bookkeeping traffic)"),
     "health_check_failure_threshold": (int, 5, "missed checks before a node is dead"),
     "gcs_port": (int, 0, "GCS TCP port; 0 = pick free port"),
     # --- head fault tolerance (parity: redis_store_client.h:111 +

@@ -67,6 +67,10 @@ class _AgentWorker:
         # fn_id registration rode in on.
         self.outbox: list = []
         self.flush_lock = threading.Lock()
+        # UDS exec listener (worker peer plane) sniffed off the ready
+        # frame: set => the WORKER owns the order gate for its actor, so
+        # this agent delivers exec frames ungated and forwards seq_skips.
+        self.peer_path: str | None = None
 
 
 class _PeerConn:
@@ -151,6 +155,8 @@ class NodeAgent:
             self.store_path, size=object_store_memory or default_store_size(cfg),
             num_slots=cfg.object_store_hash_slots, create=True,
             num_shards=cfg.object_store_shards)
+        from ray_tpu.core.object_store import configure_store
+        configure_store(self.store, cfg)
 
         self.resources = {
             "CPU": float(num_cpus if num_cpus is not None
@@ -513,11 +519,15 @@ class NodeAgent:
                 self._worker_load[wid] = (
                     self._worker_load.get(wid, 0) + n_execs)
         if (inner[0] == "exec"
-                and getattr(inner[1], "caller_seq", None) is not None):
+                and getattr(inner[1], "caller_seq", None) is not None
+                and w.peer_path is None):
             # Head-relayed actor call from a caller that also uses
             # the direct path: hold for per-caller order. A drop
             # (worker death while buffered) needs no handler — the
             # head replays its inflight specs on worker_death.
+            # peer_path workers gate THEMSELVES (their UDS peer frames
+            # never pass through this agent, so the worker's gate is the
+            # only place both transports converge) — deliver ungated.
             def deliver(w=w, inner=inner):
                 try:
                     send_msg(w.sock, inner, w.send_lock)
@@ -1148,7 +1158,20 @@ class NodeAgent:
                 self._send_head(("lease_return", returned))
         elif op == "seq_skip":
             _, owner, aid, seq = msg
-            self._skip_order_slot(owner, aid, seq)
+            tw = None
+            for wid, hosted in self.worker_actor.items():
+                if hosted == aid:
+                    tw = self.workers.get(wid)
+                    break
+            if tw is not None and tw.peer_path:
+                # The hosting worker owns the order gate (peer plane):
+                # the skip must land there, not on this agent's gate.
+                try:
+                    send_msg(tw.sock, msg, tw.send_lock)
+                except OSError:
+                    pass  # worker gone; its gate died with it
+            else:
+                self._skip_order_slot(owner, aid, seq)
         elif op == "spawn_worker":
             pip = msg[1] if len(msg) > 1 else None
             if len(self.workers) < self.max_workers:
@@ -1260,9 +1283,12 @@ class NodeAgent:
                     self._routed.pop(spec.task_id, None)
                     self._direct_fallback(origin_wid, spec)
 
-            self._exec_in_order(
-                spec, target_wid, deliver,
-                on_drop=lambda: self._direct_fallback(origin_wid, spec))
+            if tw.peer_path:
+                deliver()  # the worker's own gate orders this frame
+            else:
+                self._exec_in_order(
+                    spec, target_wid, deliver,
+                    on_drop=lambda: self._direct_fallback(origin_wid, spec))
             return
         with self._peer_lock:
             conn = self._peer_conns.get(target_nid)
@@ -1412,7 +1438,10 @@ class NodeAgent:
                 except OSError:
                     pass
 
-            self._exec_in_order(spec, wid, deliver, on_drop=on_drop)
+            if tw.peer_path:
+                deliver()  # the worker's own gate orders this frame
+            else:
+                self._exec_in_order(spec, wid, deliver, on_drop=on_drop)
         elif op == "lease_spill":
             # Surplus leases forwarded by a saturated peer agent (the
             # decentralized spillback hop — the head was only notified).
@@ -1565,6 +1594,8 @@ class NodeAgent:
                             if msg is None:
                                 continue  # fully leased: rides node_done
                         elif op0 == "ready":
+                            if len(msg) > 4 and msg[4]:
+                                w.peer_path = msg[4]
                             self._pump_leases()  # fresh worker: feed it
                         out_frames.append(
                             ("wmsg", w.worker_id.binary(), msg))

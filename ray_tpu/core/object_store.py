@@ -57,8 +57,14 @@ def _lib():
         lib.store_stats.argtypes = [p] + [ctypes.POINTER(u64)] * 4
         lib.store_header_size.restype = u64
         lib.store_memcpy.argtypes = [p, p, u64, ctypes.c_int]
+        lib.store_copy_adaptive.argtypes = [p, p, p, u64, ctypes.c_int]
         lib.store_list_ids.argtypes = [p, p, u64]
         lib.store_list_ids.restype = ctypes.c_int64
+        lib.store_reserve.argtypes = [p, u64, ctypes.POINTER(u64)]
+        lib.store_release_extent.argtypes = [p, u64, u64]
+        lib.store_publish.argtypes = [p, b, u64, u64, u64]
+        lib.store_num_reserves.argtypes = [p]
+        lib.store_num_reserves.restype = u64
         lib._sigs_set = True
     return lib
 
@@ -106,6 +112,57 @@ class ObjectBuffer:
             self.data.release()
             self.meta_view.release()
             self.store._abort(self.object_id)
+
+
+def _round_block(total: int) -> int:
+    """Block footprint of an object inside a reservation extent — MUST
+    mirror the allocator's align_up(max(n, MIN_BLOCK)) (object_store.cpp)
+    so a published block frees back exactly what was carved."""
+    return (max(total, 128) + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+class _Reservation:
+    """One client's private write extent: carved once under the global
+    lock, bump-allocated with no shared lock at all."""
+
+    __slots__ = ("off", "size", "used")
+
+    def __init__(self, off: int, size: int):
+        self.off = off
+        self.size = size
+        self.used = 0
+
+
+class _ReservedBuffer(ObjectBuffer):
+    """ObjectBuffer carved from a write reservation: the fill runs with
+    no lock held anywhere; seal() publishes the slot (already SEALED —
+    one short shard-lock critical section, the visibility point)."""
+
+    __slots__ = ("data_size", "meta_size", "block")
+
+    def seal(self):
+        self.data.release()
+        self.meta_view.release()
+        rc = self.store._lib.store_publish(
+            self.store._base, self.object_id.binary(), self.offset,
+            self.data_size, self.meta_size)
+        # Either way the chunk is no longer this buffer's: a successful
+        # publish transferred it to the slot, a failed one is released
+        # right here — a later abort() must not release it again.
+        self._sealed = True
+        if rc == ERR_EXISTS:
+            self.store._release_chunk(self.offset, self.block)
+            raise RayTpuError(f"object {self.object_id} already exists")
+        if rc != OK:
+            self.store._release_chunk(self.offset, self.block)
+            raise ObjectStoreFullError(
+                f"publish of {self.object_id} failed (rc={rc})")
+
+    def abort(self):
+        if not self._sealed:
+            self.data.release()
+            self.meta_view.release()
+            self.store._release_chunk(self.offset, self.block)
 
 
 class _ReleaseHandle:
@@ -221,6 +278,21 @@ class SharedMemoryStore:
                 raise RayTpuError(f"attached store at {path} is corrupt")
         self.size = size
         self.num_shards = int(self._lib.store_num_shards(self._base))
+        # -- write-reservation plane (multi-client put bandwidth) --
+        # Payloads >= reservation_min_bytes bump-allocate inside a private
+        # extent of reservation_chunk_bytes (clamped to arena/16) carved
+        # once under the global lock; the fill and publish take no
+        # allocator lock on the per-put path. 0 chunk disables.
+        import threading
+        self.reservation_min_bytes = 4 << 20
+        self.reservation_chunk_bytes = min(256 << 20, max(0, size // 16))
+        self._rsv: _Reservation | None = None
+        self._rsv_lock = threading.Lock()
+        # Optional policy hook called (OUTSIDE any store lock) with the
+        # byte count about to be carved from the global list — head-node
+        # runtimes point it at their spill machinery so room is made per
+        # REFILL, not per put.
+        self.spill_hook = None
 
     # -- raw object interface --
 
@@ -240,6 +312,100 @@ class SharedMemoryStore:
             meta_view[:] = meta
         mv.release()
         return ObjectBuffer(self, object_id, data, meta_view, off.value)
+
+    # -- write reservations --
+
+    def _release_chunk(self, abs_off: int, size: int):
+        self._lib.store_release_extent(self._base, abs_off, size)
+
+    def release_reservation(self):
+        """Return the unused tail of this client's reservation (shutdown,
+        or before a refill)."""
+        with self._rsv_lock:
+            r, self._rsv = self._rsv, None
+        if r is not None and r.size > r.used:
+            self._release_chunk(r.off + r.used, r.size - r.used)
+
+    def reservation_fits(self, nbytes: int) -> bool:
+        """True when a put of ~nbytes will carve from the current
+        reservation without touching the global allocator (callers use
+        this to skip per-put spill checks)."""
+        r = self._rsv
+        return r is not None and r.used + _round_block(nbytes + 512) <= r.size
+
+    def num_reserves(self) -> int:
+        return int(self._lib.store_num_reserves(self._base))
+
+    def _carve(self, block: int) -> int | None:
+        with self._rsv_lock:
+            r = self._rsv
+            if r is not None and r.used + block <= r.size:
+                off = r.off + r.used
+                r.used += block
+                return off
+        return None
+
+    def _reserved_create(self, object_id: ObjectID, data_size: int,
+                         meta: bytes) -> "_ReservedBuffer | None":
+        """Bump-carve a block for one object; refills the reservation from
+        the global extent list when the current one is exhausted. Returns
+        None when the arena cannot host a fresh extent (caller falls back
+        to the eviction-capable create path)."""
+        total = data_size + len(meta)
+        block = _round_block(total)
+        off = self._carve(block)
+        if off is None:
+            chunk = max(self.reservation_chunk_bytes, block)
+            hook = self.spill_hook
+            if hook is not None:
+                try:
+                    hook(chunk)
+                except Exception:  # noqa: BLE001 — policy hook, best effort
+                    pass
+            with self._rsv_lock:
+                r = self._rsv
+                if r is not None and r.used + block <= r.size:
+                    off = r.off + r.used  # another thread refilled
+                    r.used += block
+                else:
+                    if r is not None and r.size > r.used:
+                        self._release_chunk(r.off + r.used, r.size - r.used)
+                    self._rsv = None
+                    out = ctypes.c_uint64()
+                    rc = self._lib.store_reserve(self._base, chunk,
+                                                 ctypes.byref(out))
+                    if rc != OK and chunk > block:
+                        chunk = block  # arena tight: take just this object
+                        rc = self._lib.store_reserve(self._base, chunk,
+                                                     ctypes.byref(out))
+                    if rc != OK:
+                        return None
+                    r = _Reservation(out.value, chunk)
+                    r.used = block
+                    self._rsv = r
+                    off = r.off
+        mv = memoryview(self._mm)
+        data = mv[off : off + data_size]
+        meta_view = mv[off + data_size : off + total]
+        if meta:
+            meta_view[:] = meta
+        mv.release()
+        buf = _ReservedBuffer(self, object_id, data, meta_view, off)
+        buf.data_size = data_size
+        buf.meta_size = len(meta)
+        buf.block = block
+        return buf
+
+    def _acquire_buffer(self, object_id: ObjectID, data_size: int,
+                        meta: bytes = b"") -> ObjectBuffer:
+        """Reservation fast path when large enough and enabled, else the
+        classic create (shard lock + eviction)."""
+        if (self.reservation_chunk_bytes
+                and data_size + len(meta) >= self.reservation_min_bytes):
+            buf = self._reserved_create(object_id, data_size, meta)
+            if buf is not None:
+                return buf
+        return self.create(object_id, data_size, meta=meta)
 
     def _seal(self, object_id: ObjectID):
         self._lib.store_seal(self._base, object_id.binary())
@@ -335,7 +501,7 @@ class SharedMemoryStore:
             payload, (bytes, bytearray, memoryview)) else payload
         n = len(payload)
         total = 4 + len(fmt_b) + n
-        buf = self.create(object_id, total, meta=self.TAGGED_META)
+        buf = self._acquire_buffer(object_id, total, meta=self.TAGGED_META)
         try:
             d = buf.data
             struct.pack_into("<I", d, 0, len(fmt_b))
@@ -378,7 +544,7 @@ class SharedMemoryStore:
         for ln in lens:
             offsets.append(total)
             total += ln + ((-ln) % _ALIGN)
-        buf = self.create(object_id, total)
+        buf = self._acquire_buffer(object_id, total)
         try:
             d = buf.data
             struct.pack_into("<I", d, 0, len(payload))
@@ -392,10 +558,17 @@ class SharedMemoryStore:
                 ln = len(r)
                 src = _buf_address(r) if ln >= _FAST_COPY_MIN else None
                 if src is not None:
-                    threads = (_COPY_THREADS if ln >= _MT_COPY_MIN else 1)
-                    self._lib.store_memcpy(
-                        ctypes.c_void_p(dst_base + off),
-                        ctypes.c_void_p(src), ln, threads)
+                    if ln >= _MT_COPY_MIN:
+                        # Thread budget split across CONCURRENT arena
+                        # copiers (shm counter): ten clients each copying
+                        # 80MB already parallelize across processes.
+                        self._lib.store_copy_adaptive(
+                            self._base, ctypes.c_void_p(dst_base + off),
+                            ctypes.c_void_p(src), ln, _COPY_THREADS)
+                    else:
+                        self._lib.store_memcpy(
+                            ctypes.c_void_p(dst_base + off),
+                            ctypes.c_void_p(src), ln, 1)
                 else:
                     d[off : off + ln] = r
             buf.seal()
@@ -463,6 +636,12 @@ class SharedMemoryStore:
         return True, value
 
     def close(self):
+        # Return the reservation tail first — leaked tails survive the
+        # process and strand arena space until the file is unlinked.
+        try:
+            self.release_reservation()
+        except Exception:  # noqa: BLE001 — teardown best effort
+            pass
         # Views into self._mm may still be alive (zero-copy values); the mmap
         # stays mapped until the process exits in that case.
         try:
@@ -475,6 +654,19 @@ class SharedMemoryStore:
             os.unlink(self.path)
         except FileNotFoundError:
             pass
+
+
+def configure_store(store: SharedMemoryStore, cfg) -> None:
+    """Apply the config's write-reservation knobs to a store handle.
+    Called wherever a process creates/attaches its arena handle (head,
+    node agent, worker) — the store module itself stays config-free."""
+    mn = cfg.put_reservation_min_bytes
+    if mn <= 0:
+        store.reservation_chunk_bytes = 0
+        return
+    store.reservation_min_bytes = mn
+    chunk = cfg.put_reservation_bytes or min(256 << 20, store.size // 16)
+    store.reservation_chunk_bytes = max(0, chunk)
 
 
 def default_store_size(config) -> int:

@@ -25,6 +25,17 @@ streams at line rate instead of paying connect + slow-start per hop.
 Wire protocol (little endian):
   request:  16-byte object id
   response: u8 ok; if ok: u64 data_size, u64 meta_size, meta bytes, data
+
+  range request (multi-stream pulls): 16-byte RANGE_MAGIC, 16-byte
+  object id, u64 offset, u64 length
+  response: u8 ok; if ok: u64 data_size (TOTAL), u64 meta_size,
+  meta bytes, data[offset : offset+min(length, data_size-offset)]
+
+Large objects stripe over `objxfer_streams` connections (each from the
+per-addr cache): the first range request doubles as the size probe, the
+remainder splits into per-connection ranges received concurrently into
+disjoint slices of the created buffer. The magic rides the same 16-byte
+slot as an object id (2^-128 collision: ids are random bytes).
 """
 
 from __future__ import annotations
@@ -38,6 +49,10 @@ from ray_tpu.core import task_events as _task_events
 from ray_tpu.core.ids import ObjectID
 
 _SIZES = struct.Struct("<QQ")
+
+# 16-byte request discriminator for range pulls (same slot as an id).
+RANGE_MAGIC = b"\xffRAYTPU_RANGE_1\xff"
+_RANGE_REQ = struct.Struct("<QQ")
 
 # recv_into slice bound: large enough to amortize syscalls, small enough
 # that the kernel keeps draining the window while we copy (pipelining).
@@ -147,6 +162,14 @@ def _serve_conn(store, conn: socket.socket):
             oid = _recv_exact(conn, 16)
             if oid is None:
                 return
+            want_off = 0
+            want_len = None
+            if oid == RANGE_MAGIC:
+                req = _recv_exact(conn, 16 + _RANGE_REQ.size)
+                if req is None:
+                    return
+                oid = req[:16]
+                want_off, want_len = _RANGE_REQ.unpack(req[16:])
             res = None
             try:
                 res = store.get_raw(ObjectID(oid), timeout=0)
@@ -160,10 +183,15 @@ def _serve_conn(store, conn: socket.socket):
                 continue
             data, meta = res
             try:
+                s_off = min(want_off, len(data))
+                s_len = len(data) - s_off
+                if want_len is not None and want_len < s_len:
+                    s_len = want_len
                 conn.sendall(b"\x01" + _SIZES.pack(len(data), len(meta)))
                 if meta:
                     conn.sendall(meta)
-                conn.sendall(data)
+                if s_len:
+                    conn.sendall(data[s_off : s_off + s_len])
             finally:
                 data.release()
                 store.release(ObjectID(oid))
@@ -365,6 +393,169 @@ def _pull_once(store, s, oid: bytes, unsealed_wait_s: float,
     return True, True
 
 
+def _recv_range_header(s, oid: bytes, unsealed_wait_s: float,
+                       absent_wait_s: float, length: int):
+    """Issue range request(s) for [0, length) with the same retry
+    semantics as _pull_once's availability loop. Returns
+    (ok_byte, data_size, meta_size, meta) — meta is None on protocol
+    error (connection must be dropped)."""
+    import time
+    start = time.monotonic()
+    unsealed_deadline = start + unsealed_wait_s
+    absent_deadline = start + absent_wait_s
+    delay = 0.001
+    while True:
+        s.sendall(RANGE_MAGIC + oid + _RANGE_REQ.pack(0, length))
+        ok = _recv_exact(s, 1)
+        now = time.monotonic()
+        if ok == b"\x02" and now < unsealed_deadline:
+            time.sleep(0.05)
+            continue
+        if ok == b"\x00" and now < absent_deadline:
+            time.sleep(delay)
+            delay = min(delay * 2, 0.025)
+            continue
+        break
+    if ok in (b"\x00", b"\x02"):
+        return ok, 0, 0, b""
+    if ok != b"\x01":
+        return ok, 0, 0, None
+    sizes = _recv_exact(s, _SIZES.size)
+    if sizes is None:
+        return b"", 0, 0, None
+    data_size, meta_size = _SIZES.unpack(sizes)
+    meta = b""
+    if meta_size:
+        meta = _recv_exact(s, meta_size)
+        if meta is None:
+            return b"", 0, 0, None
+    return b"\x01", data_size, meta_size, meta
+
+
+def _pull_range_worker(store, addr, oid: bytes, view, offset: int,
+                       timeout: float, result: list, idx: int):
+    """One extra stream of a striped pull: checkout a connection, pull
+    [offset, offset+len(view)) straight into the buffer slice."""
+    ok = False
+    s = None
+    clean = False
+    try:
+        s, _reused = _conn_cache.checkout(addr, timeout)
+        s.sendall(RANGE_MAGIC + oid + _RANGE_REQ.pack(offset, len(view)))
+        rok = _recv_exact(s, 1)
+        if rok == b"\x01":
+            sizes = _recv_exact(s, _SIZES.size)
+            if sizes is not None:
+                _dsz, msz = _SIZES.unpack(sizes)
+                skip = _recv_exact(s, msz) if msz else b""
+                if skip is not None and _recv_into_exact(s, view):
+                    ok = True
+                    clean = True
+    except OSError:
+        pass
+    finally:
+        try:
+            view.release()
+        except BufferError:
+            pass
+        if s is not None:
+            if clean:
+                _conn_cache.checkin(addr, s)
+            else:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+    result[idx] = ok
+
+
+def _pull_striped(store, addr, s, oid: bytes, unsealed_wait_s: float,
+                  absent_wait_s: float, streams: int, first_len: int,
+                  timeout: float):
+    """Range-protocol pull: the first request doubles as the size probe
+    and carries the first `first_len` bytes; anything beyond stripes
+    over `streams` connections received concurrently into disjoint
+    slices of the created buffer. Same (found, clean) contract as
+    _pull_once."""
+    ok, data_size, _msz, meta = _recv_range_header(
+        s, oid, unsealed_wait_s, absent_wait_s, first_len)
+    if meta is None:
+        return False, False
+    if ok in (b"\x00", b"\x02"):
+        return False, True  # answered, just not available
+    got = min(first_len, data_size)
+    buf = _create_for_write(store, oid, data_size, meta)
+    if buf is None:
+        # A concurrent pull won the race; drain OUR bytes off the stream
+        # so the connection stays at a message boundary.
+        left = got
+        while left:
+            c = _recv_exact(s, min(left, 1 << 20))
+            if c is None:
+                return True, False
+            left -= len(c)
+        return True, True
+    try:
+        head_view = buf.data[:got]
+        try:
+            if not _recv_into_exact(s, head_view):
+                buf.abort()
+                return False, False
+        finally:
+            head_view.release()
+        if data_size > got:
+            rest = data_size - got
+            n = max(1, min(streams, (rest + first_len - 1) // first_len))
+            per = (rest + n - 1) // n
+            threads = []
+            results = [False] * n
+            try:
+                pos = got
+                for i in range(n):
+                    ln = min(per, data_size - pos)
+                    view = buf.data[pos : pos + ln]
+                    if i < n - 1:
+                        t = threading.Thread(
+                            target=_pull_range_worker,
+                            args=(store, addr, oid, view, pos, timeout,
+                                  results, i), daemon=True)
+                        t.start()
+                        threads.append(t)
+                    else:
+                        # Last stripe rides THIS connection (open, warm).
+                        try:
+                            s.sendall(RANGE_MAGIC + oid
+                                      + _RANGE_REQ.pack(pos, ln))
+                            rok = _recv_exact(s, 1)
+                            good = False
+                            if rok == b"\x01":
+                                sizes = _recv_exact(s, _SIZES.size)
+                                if sizes is not None:
+                                    _d, msz = _SIZES.unpack(sizes)
+                                    skip = (_recv_exact(s, msz) if msz
+                                            else b"")
+                                    good = (skip is not None
+                                            and _recv_into_exact(s, view))
+                            results[i] = good
+                        finally:
+                            view.release()
+                    pos += ln
+            finally:
+                # Writers must be off the buffer before any abort can
+                # recycle its arena space.
+                for t in threads:
+                    t.join()
+            if not all(results):
+                buf.abort()
+                # primary conn is at a boundary only if ITS stripe worked
+                return False, results[-1]
+        buf.seal()
+    except BaseException:
+        buf.abort()
+        raise
+    return True, True
+
+
 def fetch_from_peer(store, addr, oid: bytes, timeout: float = 60.0,
                     unsealed_wait_s: float = 5.0,
                     absent_wait_s: float = 0.0) -> bool:
@@ -393,6 +584,13 @@ def fetch_from_peer(store, addr, oid: bytes, timeout: float = 60.0,
                           _time.time() - t0, ok=found,
                           peer=f"{addr[0]}:{addr[1]}")
 
+    try:
+        from ray_tpu.core.config import get_config
+        cfg = get_config()
+        streams = cfg.objxfer_streams
+        stream_min = cfg.objxfer_stream_min_bytes
+    except Exception:  # noqa: BLE001 — config not importable (bare tests)
+        streams, stream_min = 1, 32 << 20
     for attempt in range(2):
         try:
             s, reused = _conn_cache.checkout(addr, timeout)
@@ -401,8 +599,13 @@ def fetch_from_peer(store, addr, oid: bytes, timeout: float = 60.0,
             return False
         clean = False
         try:
-            found, clean = _pull_once(store, s, oid, unsealed_wait_s,
-                                      absent_wait_s)
+            if streams > 1:
+                found, clean = _pull_striped(
+                    store, addr, s, oid, unsealed_wait_s, absent_wait_s,
+                    streams, max(1 << 20, stream_min), timeout)
+            else:
+                found, clean = _pull_once(store, s, oid, unsealed_wait_s,
+                                          absent_wait_s)
         except OSError:
             found = False
         finally:

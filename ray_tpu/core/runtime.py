@@ -820,6 +820,11 @@ class Runtime:
             self.store_path, size=store_size,
             num_slots=cfg.object_store_hash_slots, create=True,
             num_shards=cfg.object_store_shards)
+        from ray_tpu.core.object_store import configure_store
+        configure_store(self.store, cfg)
+        # Reservation refills make room through the spill machinery once
+        # per EXTENT instead of a stats probe + spill pass per put.
+        self.store.spill_hook = self._ensure_headroom
 
         # logical resources (parity: scheduling/resource_set.h)
         from ray_tpu.core.accelerators import detect_tpus
@@ -833,6 +838,9 @@ class Runtime:
 
         self.directory = ObjectDirectory()
         self.refcount = ReferenceCounter(free_callback=self._free_object)
+        # Actor execs relayed by the head (diagnostics; the direct
+        # worker<->worker plane keeps this flat under actor storms).
+        self.actor_head_dispatches = 0
         # Export API (parity: export_api/ durable event stream): opt-in
         # JSONL writer fed by task/actor/node state transitions.
         self.export_events = None
@@ -1314,7 +1322,11 @@ class Runtime:
     def put_in_store(self, oid: "ObjectID", value) -> None:
         from ray_tpu.core.status import ObjectStoreFullError
         approx = int(getattr(value, "nbytes", 0) or (1 << 20))
-        self._ensure_headroom(approx)
+        # Reservation-backed puts carve no global memory: the refill path
+        # already ran the headroom check (store.spill_hook), so the
+        # per-put stats probe + spill pass is skipped.
+        if not self.store.reservation_fits(approx):
+            self._ensure_headroom(approx)
         try:
             self.store.put_serialized(oid, value)
         except ObjectStoreFullError:
@@ -1828,7 +1840,19 @@ class Runtime:
             requester_on_head = w.node_id == self.head_node_id
             if (st is not None and st.state == A_ALIVE
                     and st.worker is not None and st.worker.state != DEAD):
-                if (st.worker.node_id != self.head_node_id
+                if (st.worker.node_id == w.node_id
+                        and not requester_on_head
+                        and getattr(st.worker, "peer_path", None)
+                        and w.kind == "worker"
+                        and not getattr(w, "is_client", False)
+                        and self.config.worker_direct_calls):
+                    # Same AGENT node: hand out the hosting worker's UDS
+                    # so actor->actor calls skip the agent relay both
+                    # ways (call and reply) — the agent only sees the
+                    # async put_notify/task-event bookkeeping.
+                    resp = ("uds", st.worker.peer_path,
+                            bool(st.cspec.max_task_retries))
+                elif (st.worker.node_id != self.head_node_id
                         and not requester_on_head):
                     # Agent-plane location — only meaningful to a caller
                     # that has an agent to route through; a head-node
@@ -5009,6 +5033,10 @@ class Runtime:
         self._send_actor_task(st, spec)
 
     def _send_actor_task(self, st: ActorState, spec: TaskSpec):
+        # Diagnostic: every actor exec the HEAD relays (the direct worker
+        # peer plane never passes through here — tests assert this stays
+        # flat during a direct-call storm).
+        self.actor_head_dispatches += 1
         with self.lock:
             w = st.worker
             if st.state == A_DEAD:

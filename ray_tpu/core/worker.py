@@ -261,6 +261,10 @@ class WorkerRuntime:
         # wdone/wfail.
         self._direct_pending: dict[bytes, bool] = {}
         self._direct_lock = threading.Lock()
+        # Diagnostics: direct (peer-plane) calls this worker shipped —
+        # tests pair this against the head's actor_head_dispatches to
+        # assert storms stay off the head/agent relay.
+        self.direct_calls_sent = 0
         # Executor-side per-(caller, actor) submission-order gate: peer
         # frames race head-relayed frames exactly like the agent plane.
         from ray_tpu.core.order_gate import OrderGate
@@ -346,7 +350,25 @@ class WorkerRuntime:
     @property
     def store(self) -> SharedMemoryStore:
         if self._store is None:
-            self._store = SharedMemoryStore(self.store_path)
+            from ray_tpu.core.object_store import configure_store
+            st = SharedMemoryStore(self.store_path)
+            configure_store(st, get_config())
+            if os.environ.get("RAY_TPU_IS_HEAD_NODE") == "1":
+                # Reservation refills ask the head for room once per
+                # extent (the old path probed stats + requested spill on
+                # every large put). Agent arenas rely on LRU eviction.
+                def _spill_refill_hook(need: int, _st=st):
+                    stats = _st.stats()
+                    cap = stats["capacity"] or 1
+                    limit = get_config().object_spill_threshold * cap
+                    if stats["allocated"] + need > limit:
+                        self.request(
+                            "spill",
+                            int(stats["allocated"] + need - limit)
+                            + (4 << 20))
+
+                st.spill_hook = _spill_refill_hook
+            self._store = st
         return self._store
 
     def put(self, value):
@@ -653,7 +675,10 @@ class WorkerRuntime:
     def start_peer_listener(self) -> str | None:
         """Bind this worker's UDS exec listener (executor half of the
         peer plane). The path rides the "ready" frame so the head can
-        hand it to callers resolving this worker's actor."""
+        hand it to callers resolving this worker's actor — on head nodes
+        AND agent nodes (same-node actor->actor calls skip the agent
+        relay both ways; the agent learns of results asynchronously via
+        put_notify/task-event frames only)."""
         if not get_config().worker_direct_calls:
             return None
         path = f"{self.store_path}_w{self.worker_id.hex()[:12]}.sock"
@@ -735,6 +760,7 @@ class WorkerRuntime:
                 for rid in spec.return_ids:
                     self._direct_pending.pop(rid, None)
             return False
+        self.direct_calls_sent += 1
         return True
 
     def _on_wpeer_frame(self, conn: "_WorkerPeer", msg):
@@ -925,7 +951,7 @@ def _put_with_spill(rt: "WorkerRuntime", oid: ObjectID, value, nbytes: int):
     skipped and the agent arena's eviction is the pressure valve."""
     from ray_tpu.core.status import ObjectStoreFullError
     on_head = os.environ.get("RAY_TPU_IS_HEAD_NODE") == "1"
-    if on_head:
+    if on_head and not rt.store.reservation_fits(nbytes):
         stats = rt.store.stats()
         cap = stats["capacity"] or 1
         limit = get_config().object_spill_threshold * cap
@@ -1030,6 +1056,22 @@ class _RuntimeEnv:
         return False
 
 
+_SYNC_EXEC_LOOP = threading.local()
+
+
+def _run_coroutine_sync(coro):
+    """Drive a coroutine returned by a SYNC-executed function to
+    completion. Keeps one loop per executor thread (matching the old
+    implicit-get_event_loop() behavior, where loop-bound state survived
+    across calls) without the deprecated implicit-loop API that warns on
+    3.12+."""
+    loop = getattr(_SYNC_EXEC_LOOP, "loop", None)
+    if loop is None or loop.is_closed():
+        loop = asyncio.new_event_loop()
+        _SYNC_EXEC_LOOP.loop = loop
+    return loop.run_until_complete(coro)
+
+
 def _execute(rt: WorkerRuntime, spec: TaskSpec, fn):
     """Runs one task; returns ('ok'|'err', value_or_TaskError)."""
     for oid, (payload, bufs) in spec.inline_deps.items():
@@ -1064,7 +1106,7 @@ def _execute(rt: WorkerRuntime, spec: TaskSpec, fn):
         with ctx, span:
             result = fn(*args, **kwargs)
             if inspect.iscoroutine(result):
-                result = asyncio.get_event_loop().run_until_complete(result)
+                result = _run_coroutine_sync(result)
         return "ok", result
     except BaseException as e:  # noqa: BLE001 — errors cross the wire
         return "err", TaskError.from_exception(e, spec.describe())
@@ -1187,19 +1229,24 @@ def _reply_result(rt: WorkerRuntime, spec: TaskSpec, status, result,
              if rt.direct_routes else None)
     if route is not None:
         # Direct-call reply: straight back on the caller's channel — the
-        # head never saw this task, so its exec record ships through the
-        # event ring instead of a done frame (rare path; flushed on the
+        # head/agent never saw this task, so its exec record ships
+        # through the event ring instead of a done frame (flushed on the
         # piggybacked cadence).
         if tev is not None:
             _TEV.emit(spec.task_id, tev[0], "EXEC_SPANS", None,
                       tev[1:4], ts=tev[4])
             tev = None
-        # Big results went into the SHARED head-node arena; notify the
-        # head of the location so borrowers beyond the caller can still
-        # resolve them.
+        # Big results went into the node's SHARED arena; notify the head
+        # of the location so borrowers beyond the caller can still
+        # resolve them (async on agent nodes: the frame rides the relay).
         for entry in outs:
             if entry[1] == "shm":
                 rt.send(("put_notify", entry[0]))
+        if batcher is not None:
+            # A burst of pipelined direct calls coalesces into ONE wdone
+            # frame per caller channel (the flusher groups by route).
+            batcher.add(spec.task_id, spec.actor_id, outs, route=route)
+            return
         if route.alive:
             try:
                 route.send(("wdone", [(spec.task_id, outs)]))
@@ -1221,13 +1268,14 @@ def _reply_result(rt: WorkerRuntime, spec: TaskSpec, status, result,
 
 
 class _ReplyBatcher:
-    """Coalesces sync-actor completion frames with a BOUNDED delay.
+    """Coalesces actor completion frames with a BOUNDED delay.
 
-    A burst of pipelined fast calls flushes as one "done_batch"; a result
-    never waits on the NEXT call's execution (the flusher thread sends it
-    within `max_delay` regardless) and flushes immediately when the task
-    queue is drained — so get(timeout)/wait progress semantics hold even
-    when a slow call sits behind a fast one."""
+    A burst of pipelined fast calls flushes as one "done_batch" (head
+    path) or one "wdone" per caller channel (direct worker-peer path); a
+    result never waits on the NEXT call's execution (the flusher thread
+    sends it within `max_delay` regardless) and flushes immediately when
+    the task queue is drained — so get(timeout)/wait progress semantics
+    hold even when a slow call sits behind a fast one."""
 
     def __init__(self, rt: WorkerRuntime, max_delay: float = 0.001,
                  max_batch: int = 64):
@@ -1235,16 +1283,20 @@ class _ReplyBatcher:
         self.max_delay = max_delay
         self.max_batch = max_batch
         self._cv = threading.Condition()
-        self._batch: list = []
+        self._batch: list = []          # head-path entries
+        self._routed: list = []         # (route, task_id, actor_id, outs)
         self._urgent = False
         threading.Thread(target=self._loop, daemon=True,
                          name="rtpu-reply-flush").start()
 
-    def add(self, task_id, actor_id, outs, tev=None):
+    def add(self, task_id, actor_id, outs, tev=None, route=None):
         with self._cv:
-            self._batch.append((task_id, actor_id, outs) if tev is None
-                               else (task_id, actor_id, outs, tev))
-            if (len(self._batch) >= self.max_batch
+            if route is not None:
+                self._routed.append((route, task_id, actor_id, outs))
+            else:
+                self._batch.append((task_id, actor_id, outs) if tev is None
+                                   else (task_id, actor_id, outs, tev))
+            if (len(self._batch) + len(self._routed) >= self.max_batch
                     or self.rt.task_queue.empty()):
                 self._urgent = True
             self._cv.notify()
@@ -1255,51 +1307,103 @@ class _ReplyBatcher:
         a concurrent flusher pass and this call each send disjoint sets."""
         with self._cv:
             batch = self._batch
+            routed = self._routed
             self._batch = []
+            self._routed = []
             self._urgent = False
-        if batch:
-            try:
-                self._send(batch)
-            except OSError:
-                pass
+        try:
+            self._send(batch, routed)
+        except OSError:
+            pass
 
-    def _send(self, batch: list):
+    def _send(self, batch: list, routed: list):
+        for route, pairs, entries in self._group_routes(routed):
+            sent = False
+            if route.alive:
+                try:
+                    route.send(("wdone", pairs))
+                    sent = True
+                except OSError:
+                    pass
+            if not sent:
+                # Caller channel died under the reply: bank each result
+                # at the head instead (its directory resolves the
+                # caller's wait_obj) — a reply is never silently lost.
+                batch = batch + [(tid, aid, outs)
+                                 for (tid, aid, outs) in entries]
         if len(batch) == 1:
             self.rt.send(("done",) + tuple(batch[0]))
-        else:
+        elif batch:
             self.rt.send(("done_batch", batch))
+
+    @staticmethod
+    def _group_routes(routed: list):
+        if not routed:
+            return ()
+        groups: dict = {}
+        for route, task_id, actor_id, outs in routed:
+            g = groups.get(id(route))
+            if g is None:
+                g = groups[id(route)] = (route, [], [])
+            g[1].append((task_id, outs))
+            g[2].append((task_id, actor_id, outs))
+        return groups.values()
 
     def _loop(self):
         while True:
             with self._cv:
-                while not self._batch:
+                while not (self._batch or self._routed):
                     self._urgent = False
+                    self._cv.notify_all()
                     self._cv.wait()
                 if not self._urgent:
                     # Let a burst accumulate, but never longer than
                     # max_delay past the first pending reply.
                     self._cv.wait(self.max_delay)
                 batch = self._batch
+                routed = self._routed
                 self._batch = []
+                self._routed = []
                 self._urgent = False
             try:
-                self._send(batch)
+                self._send(batch, routed)
             except OSError:
                 return  # head gone; the worker is about to exit anyway
 
 
 async def _execute_async(rt, spec, fn):
+    from ray_tpu.core.object_ref import ObjectRef
     for oid, (payload, bufs) in spec.inline_deps.items():
         rt.object_cache[oid] = serialization.deserialize(payload, bufs)
     if _TEV.enabled:
         spec.exec_ts = [time.time(), 0.0, 0.0]
     try:
         loop = asyncio.get_running_loop()
-        # Off-thread: an offloaded arg pack may need a cross-node fetch.
-        args, kwargs = await loop.run_in_executor(None, _spec_args, rt, spec)
-        args = [await loop.run_in_executor(None, _resolve_arg, rt, a) for a in args]
-        kwargs = {k: await loop.run_in_executor(None, _resolve_arg, rt, v)
-                  for k, v in kwargs.items()}
+        aref = getattr(spec, "args_ref", None)
+        payload = spec.payload
+        if (aref is None and not spec.buffers
+                and getattr(spec, "payload_format", None) != "proto"
+                and (payload is None or len(payload) <= 65536)):
+            # Fast path (the async ping storm): tiny inline args decode
+            # right on the loop — an executor round trip per call costs
+            # far more than the unpickle (this hop, plus one per arg and
+            # one for the reply, was the bulk of the old per-actor
+            # asyncio funnel's 8x gap vs sync actors).
+            args, kwargs = serialization.deserialize(payload, spec.buffers)
+        else:
+            # Off-thread: an offloaded arg pack may need a cross-node
+            # fetch.
+            args, kwargs = await loop.run_in_executor(
+                None, _spec_args, rt, spec)
+        if any(type(a) is ObjectRef for a in args):
+            # Only ref args can block (store probe / head round trip).
+            args = [await loop.run_in_executor(None, _resolve_arg, rt, a)
+                    if type(a) is ObjectRef else a for a in args]
+        if kwargs:
+            kwargs = {k: (await loop.run_in_executor(
+                              None, _resolve_arg, rt, v)
+                          if type(v) is ObjectRef else v)
+                      for k, v in kwargs.items()}
         if _TEV.enabled and spec.exec_ts is not None:
             spec.exec_ts[1] = time.time()
         result = fn(*args, **kwargs)
@@ -1313,41 +1417,191 @@ async def _execute_async(rt, spec, fn):
             spec.exec_ts[2] = time.time()
 
 
-def _run_actor_async(rt: WorkerRuntime, max_concurrency: int):
-    """Asyncio executor for async actors (parity: fiber.h async actors)."""
-    import queue as q
+class _AsyncShard:
+    """One event-loop thread of the sharded async-actor executor."""
 
-    async def main():
-        sem = asyncio.Semaphore(max_concurrency or 1000)
-        loop = asyncio.get_running_loop()
+    __slots__ = ("idx", "dq", "loop", "wake", "sem", "inflight", "thread")
 
-        async def run_one(spec, fn):
-            async with sem:
-                status, result = await _execute_async(rt, spec, fn)
-                await loop.run_in_executor(None, _reply_result, rt, spec, status, result)
+    def __init__(self, idx: int):
+        self.idx = idx
+        self.dq: collections.deque = collections.deque()
+        self.loop = None
+        self.wake = None
+        self.sem = None
+        self.inflight = 0
+        self.thread = None
 
-        while not rt.shutdown.is_set():
+
+class _AsyncActorExecutor:
+    """Sharded, work-stealing asyncio executor for async actors.
+
+    Replaces the single per-actor asyncio funnel: N threads each run
+    their own event loop; the worker's main thread dispatches specs to
+    the least-loaded shard's deque, and a shard that drains its own
+    queue steals from the busiest sibling (deque ops are atomic under
+    the GIL, so steals need no locks). Replies coalesce through the
+    shared _ReplyBatcher — direct-path results flush as ONE wdone frame
+    per caller channel per burst.
+
+    Concurrency semantics: max_concurrency splits across shards (each
+    shard bounds its slice with an asyncio.Semaphore). With >1 shard,
+    coroutines of one actor run on several OS threads — the GIL keeps
+    attribute access atomic, but methods that mutate instance state
+    across awaits and assumed loop-serialized interleaving should set
+    async_actor_executor_shards=1."""
+
+    def __init__(self, rt: WorkerRuntime, n_shards: int,
+                 max_concurrency: int, batcher: "_ReplyBatcher"):
+        self.rt = rt
+        self.batcher = batcher
+        self.stopping = False
+        per = max(1, max_concurrency // n_shards)
+        # Append as they boot: a shard's loop may probe `shards` (steal)
+        # before its siblings exist.
+        self.shards: list[_AsyncShard] = []
+        for i in range(n_shards):
+            self.shards.append(self._start_shard(i, per))
+
+    def _start_shard(self, idx: int, per: int) -> _AsyncShard:
+        sh = _AsyncShard(idx)
+        ready = threading.Event()
+
+        def run():
+            asyncio.run(self._shard_main(sh, per, ready))
+
+        sh.thread = threading.Thread(target=run, daemon=True,
+                                     name=f"rtpu-async-{idx}")
+        sh.thread.start()
+        ready.wait()
+        return sh
+
+    def _steal(self, me: _AsyncShard):
+        busiest, depth = None, 0
+        for sh in self.shards:
+            if sh is not me and len(sh.dq) > depth:
+                busiest, depth = sh, len(sh.dq)
+        if busiest is None:
+            return None
+        try:
+            return busiest.dq.pop()  # newest end: cheapest cache handoff
+        except IndexError:
+            return None
+
+    async def _shard_main(self, sh: _AsyncShard, per: int,
+                          ready: threading.Event):
+        sh.loop = asyncio.get_running_loop()
+        sh.wake = asyncio.Event()
+        sh.sem = asyncio.Semaphore(per)
+        ready.set()
+        rt = self.rt
+        while True:
             try:
-                spec = await loop.run_in_executor(None, rt.task_queue.get, True, 0.1)
-            except q.Empty:
+                item = sh.dq.popleft()
+            except IndexError:
+                item = self._steal(sh)
+            if item is None:
+                if self.stopping:
+                    break
+                sh.wake.clear()
+                # Re-check after clear: a dispatcher append + set that
+                # landed between the steal miss and the clear is caught
+                # by this probe instead of sleeping until the next wake.
+                if not sh.dq:
+                    await sh.wake.wait()
                 continue
+            spec, fn, streaming = item
+            if streaming:
+                # Sync-generator streaming works on async actors too: the
+                # generator runs on an executor thread (async generators
+                # are rejected inside _execute_streaming).
+                sh.loop.run_in_executor(None, _execute_streaming,
+                                        rt, spec, fn)
+                continue
+            sh.inflight += 1
+            sh.loop.create_task(self._run_one(sh, spec, fn))
+        while sh.inflight:  # graceful drain before the loop closes
+            await asyncio.sleep(0.005)
+
+    async def _run_one(self, sh: _AsyncShard, spec, fn):
+        rt = self.rt
+        try:
+            async with sh.sem:
+                status, result = await _execute_async(rt, spec, fn)
+            if status == "ok" and (
+                    result is None or type(result) in (bool, int, float)
+                    or (type(result) in (str, bytes) and len(result) < 8192)):
+                # Small scalar reply: serialize + batch right on the loop
+                # (one more executor hop would dominate a ping()).
+                _reply_result(rt, spec, status, result,
+                              batcher=self.batcher)
+            else:
+                await sh.loop.run_in_executor(
+                    None, _reply_result, rt, spec, status, result,
+                    self.batcher)
+        except Exception:  # noqa: BLE001 — a reply failure must not
+            traceback.print_exc()  # kill the shard loop
+        finally:
+            sh.inflight -= 1
+
+    def run(self):
+        """Dispatcher — runs on the worker's main thread (the old per-
+        task queue-get executor hop is gone: the blocking get happens
+        here, off every event loop)."""
+        rt = self.rt
+        shards = self.shards
+        while not rt.shutdown.is_set():
+            spec = rt.task_queue.get()
             if spec is None:
                 break
             if spec.task_id in rt.cancelled_tasks:
                 rt.cancelled_tasks.discard(spec.task_id)
-                await loop.run_in_executor(None, _reply_cancelled, rt, spec)
+                _reply_cancelled(rt, spec)
                 continue
             fn = _actor_method(rt, spec)
-            if getattr(spec, "streaming", False):
-                # Sync-generator streaming works on async actors too: the
-                # generator runs on an executor thread (async generators
-                # are rejected inside _execute_streaming).
-                asyncio.ensure_future(loop.run_in_executor(
-                    None, _execute_streaming, rt, spec, fn))
-                continue
-            asyncio.ensure_future(run_one(spec, fn))
+            target = shards[0]
+            if len(shards) > 1:
+                load = len(target.dq) + target.inflight
+                for sh in shards[1:]:
+                    ln = len(sh.dq) + sh.inflight
+                    if ln < load:
+                        target, load = sh, ln
+            target.dq.append(
+                (spec, fn, bool(getattr(spec, "streaming", False))))
+            try:
+                target.loop.call_soon_threadsafe(target.wake.set)
+            except RuntimeError:
+                # Target loop died (crash on its thread): any live
+                # sibling can steal the queued item once woken.
+                for sh in shards:
+                    try:
+                        sh.loop.call_soon_threadsafe(sh.wake.set)
+                        break
+                    except RuntimeError:
+                        continue
+        self.stopping = True
+        for sh in shards:
+            try:
+                sh.loop.call_soon_threadsafe(sh.wake.set)
+            except RuntimeError:
+                pass  # loop already closed
+        for sh in shards:
+            sh.thread.join(timeout=5.0)
 
-    asyncio.run(main())
+
+def _run_actor_async(rt: WorkerRuntime, max_concurrency: int,
+                     batcher: "_ReplyBatcher | None" = None):
+    """Sharded asyncio executor for async actors (parity: fiber.h async
+    actors, distributed over async_actor_executor_shards event loops)."""
+    cfg = get_config()
+    conc = max_concurrency or cfg.async_actor_default_max_concurrency
+    n = cfg.async_actor_executor_shards
+    if n <= 0:
+        n = max(1, min(4, (os.cpu_count() or 1) // 2))
+    n = max(1, min(n, conc))
+    if batcher is None:
+        batcher = _ReplyBatcher(rt)
+    _AsyncActorExecutor(rt, n, conc, batcher).run()
+    batcher.flush_now()
 
 
 def _ensure_accelerator_platform(num_tpus):
@@ -1557,10 +1811,10 @@ def _worker_main(store_path: str, worker_id: WorkerID, fd: int):
     from ray_tpu.core import runtime as runtime_mod
     runtime_mod.set_worker_runtime(rt)
 
-    # Head-node pooled workers additionally listen for direct peer calls;
-    # the path rides the ready frame so the head can hand it to callers.
-    peer_path = (rt.start_peer_listener()
-                 if os.environ.get("RAY_TPU_IS_HEAD_NODE") == "1" else None)
+    # Pooled workers listen for direct peer calls (head-node AND agent-
+    # node); the path rides the ready frame so the head can hand it to
+    # same-node callers resolving this worker's actor.
+    peer_path = rt.start_peer_listener()
     rt.send(("ready", worker_id.binary(), os.getpid(),
              os.environ.get("RAY_TPU_ENV_KEY") or None, peer_path))
 
@@ -1576,9 +1830,11 @@ def _worker_main(store_path: str, worker_id: WorkerID, fd: int):
             if n % 60 == 0:
                 rt.order_gate.sweep()
 
-    if not rt.on_agent_node:
-        # Agent-node workers never feed their gate (the agent's gate
-        # orders their frames) — no pump thread there.
+    if not rt.on_agent_node or peer_path is not None:
+        # A worker with a peer listener owns the order gate for its
+        # actor (peer frames race agent/head-relayed ones); a gate needs
+        # a pump for gap timeouts. Agent-node workers WITHOUT a listener
+        # never feed their gate (the agent's gate orders their frames).
         threading.Thread(target=_gate_maintenance, daemon=True,
                          name="rtpu-gate").start()
 
@@ -1632,14 +1888,18 @@ def _worker_main(store_path: str, worker_id: WorkerID, fd: int):
                 spec = msg[1]
                 if (spec.actor_id is not None
                         and getattr(spec, "caller_seq", None) is not None
-                        and not rt.on_agent_node):
-                    # Head-relayed frames race the worker peer plane for
-                    # the same (caller, actor): restore submission order.
-                    # Head-node workers ONLY — an agent-node worker's
-                    # frames were already ordered by its agent's gate
-                    # (which is where the head sends seq_skips), and
-                    # gating twice would stall every skip-released slot
-                    # until the gap timeout.
+                        and (not rt.on_agent_node
+                             or rt._peer_path is not None)):
+                    # Head/agent-relayed frames race the worker peer
+                    # plane for the same (caller, actor): restore
+                    # submission order. Only workers that OWN a peer
+                    # listener gate — the gate must be the single
+                    # ordering point, so the agent delivers their frames
+                    # ungated (and forwards seq_skips here). A listener-
+                    # less agent-node worker's frames were already
+                    # ordered by its agent's gate, and gating twice
+                    # would stall every skip-released slot until the
+                    # gap timeout.
                     rt.order_gate.submit(
                         spec, lambda s=spec: rt.task_queue.put(s))
                 else:
@@ -1736,7 +1996,7 @@ def _worker_main(store_path: str, worker_id: WorkerID, fd: int):
             if cspec is None:
                 continue
             if cspec.is_async:
-                _run_actor_async(rt, cspec.max_concurrency)
+                _run_actor_async(rt, cspec.max_concurrency, batcher)
                 break
             if cspec.max_concurrency and cspec.max_concurrency > 1:
                 pool = concurrent.futures.ThreadPoolExecutor(cspec.max_concurrency)
@@ -1795,6 +2055,10 @@ def _worker_main(store_path: str, worker_id: WorkerID, fd: int):
     batcher.flush_now()
     rt.flush_task_events(force=True)  # last events/metrics out the door
     rt.flush_sends()  # the sender thread must drain before os._exit
+    if rt._store is not None:
+        # Graceful exits return the write-reservation tail; a SIGKILLed
+        # worker strands at most one extent until the arena is unlinked.
+        rt._store.close()
     os._exit(0)
 
 

@@ -678,18 +678,25 @@ def put_tensor_object(store, value, object_id=None):
     if object_id is None:
         object_id = ObjectID.from_random()
     plan = _FramePlan(value, _inline_threshold(), inproc=False)
-    buf = store.create(object_id, plan.total, meta=b"tensor_frame")
+    buf = store._acquire_buffer(object_id, plan.total, meta=b"tensor_frame")
     try:
         import ctypes
 
         def copy_fn(off, leaf):
             n = leaf.nbytes
             if n >= _FAST_COPY_MIN:
-                threads = (min(8, os.cpu_count() or 1)
-                           if n >= _MT_COPY_MIN else 1)
+                if n >= _MT_COPY_MIN:
+                    # Thread budget shared with every concurrent arena
+                    # copier (shm counter) — see store_copy_adaptive.
+                    store._lib.store_copy_adaptive(
+                        store._base,
+                        ctypes.c_void_p(store._base + buf.offset + off),
+                        ctypes.c_void_p(leaf.ctypes.data), n,
+                        min(8, os.cpu_count() or 1))
+                    return
                 store._lib.store_memcpy(
                     ctypes.c_void_p(store._base + buf.offset + off),
-                    ctypes.c_void_p(leaf.ctypes.data), n, threads)
+                    ctypes.c_void_p(leaf.ctypes.data), n, 1)
             else:
                 import numpy as np
                 buf.data[off:off + n] = leaf.reshape(-1).view(np.uint8)

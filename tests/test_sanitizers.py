@@ -48,9 +48,12 @@ def test_tsan_object_store_stress_runs_clean():
     assert r.returncode == 0, out[-4000:]
     assert "ThreadSanitizer" not in out, out[-4000:]
     assert "STRESS_OK" in r.stdout
-    # The workload actually contended: seals and cross-thread hits > 0.
+    # The workload actually contended: seals and cross-thread hits > 0,
+    # and the write-reservation plane (reserve -> lock-free fill ->
+    # publish) actually ran against the eviction churn.
     stats = dict(kv.split("=") for kv in r.stdout.split()[1:])
     assert int(stats["seals"]) > 0 and int(stats["hits"]) > 0, stats
+    assert int(stats["reserves"]) > 0 and int(stats["publishes"]) > 0, stats
 
 
 @pytest.mark.heavy

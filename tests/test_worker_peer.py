@@ -224,3 +224,135 @@ def test_plane_disabled_by_config(monkeypatch):
 
     assert ray_tpu.get(caller.remote(a), timeout=60) == [i * 2
                                                          for i in range(8)]
+
+
+# ---- async-actor storms on the direct plane (sharded executors) ----
+
+
+@ray_tpu.remote(num_cpus=0)
+class AsyncPing:
+    async def ping(self):
+        return "pong"
+
+    async def pid(self):
+        import os
+        return os.getpid()
+
+
+@ray_tpu.remote
+def async_fan_storm(handles, n):
+    """Worker-side N:N storm against async actors; returns (values_ok,
+    direct_calls_sent delta) so the driver can assert the transport."""
+    from ray_tpu.core.runtime import get_runtime
+    rt = get_runtime()
+    before = rt.direct_calls_sent
+    refs = [h.ping.remote() for _ in range(n) for h in handles]
+    vals = ray_tpu.get(refs, timeout=120)
+    return (sum(v == "pong" for v in vals), rt.direct_calls_sent - before)
+
+
+def test_async_actor_storm_rides_direct_plane(fresh):
+    """N:N async-actor storm: every reply lands, the calls ride the
+    worker<->worker UDS plane, and the HEAD's actor dispatch counter
+    stays flat — the agent/head hop is out of the data path."""
+    asinks = [AsyncPing.remote() for _ in range(2)]
+    ray_tpu.get([a.ping.remote() for a in asinks], timeout=30)  # place
+    before = fresh.actor_head_dispatches
+    per = 150
+    ok, direct = ray_tpu.get(async_fan_storm.remote(asinks, per),
+                             timeout=120)
+    delta = fresh.actor_head_dispatches - before
+    assert ok == per * 2
+    assert direct >= per * 2 * 0.95, (
+        f"storm fell off the direct plane: {direct} direct sends")
+    assert delta <= 10, f"head saw {delta} dispatches during the storm"
+
+
+@ray_tpu.remote(num_cpus=0)
+class AsyncVictim:
+    async def pid(self):
+        import os
+        return os.getpid()
+
+    async def work(self, key):
+        # Execution-side effect: the head's kv counts every EXECUTION of
+        # this logical call — exactly-once means no counter exceeds 1
+        # (max_task_retries=0: a maybe-executed call must never replay).
+        import asyncio as _asyncio
+
+        from ray_tpu.core.runtime import get_runtime
+        get_runtime().request("kv_incr", f"exo:{key}")
+        await _asyncio.sleep(0.02)  # paced: the mid-storm kill must land
+        return key                  # while calls are still in flight
+
+
+@ray_tpu.remote
+def victim_storm(victim, n):
+    refs = [victim.work.remote(i) for i in range(n)]
+    ok, err = 0, 0
+    for r in refs:
+        try:
+            ray_tpu.get(r, timeout=60)
+            ok += 1
+        except Exception:  # noqa: BLE001 — ActorDiedError et al.
+            err += 1
+    return ok, err
+
+
+def test_async_storm_mid_kill_results_exactly_once(fresh):
+    """SIGKILL the async actor's worker mid-storm: every ref resolves
+    (value or death error, no hangs) and no logical call executed more
+    than once."""
+    import os
+    import signal
+
+    # max_concurrency=4 + 20ms per call paces 400 calls over ~2s, so
+    # the 0.4s kill always lands with most of the storm in flight.
+    victim = AsyncVictim.options(max_restarts=0,
+                                 max_concurrency=4).remote()
+    pid = ray_tpu.get(victim.pid.remote(), timeout=30)
+    n = 400
+    storm_ref = victim_storm.remote(victim, n)
+    time.sleep(0.4)  # let the storm get airborne
+    os.kill(pid, signal.SIGKILL)
+    ok, err = ray_tpu.get(storm_ref, timeout=180)
+    assert ok + err == n  # every ref resolved exactly once
+    assert err > 0, "kill landed after the storm finished; retune sleep"
+    # no double execution anywhere
+    for key in fresh.kv_keys(b"exo:"):
+        k = key.decode() if isinstance(key, bytes) else key
+        assert int(fresh.kv[k if k in fresh.kv else key]) == 1, k
+
+
+def test_agent_node_actor_calls_ride_worker_uds(fresh):
+    """Same-node actor->actor calls on an AGENT node skip the agent
+    relay: the caller ships frames to the hosting worker's UDS and the
+    head's dispatch counter stays flat."""
+    from ray_tpu.cluster_utils import Cluster
+    cluster = Cluster(initialize_head=False)
+    node = cluster.add_node(num_cpus=4, resources={"peer": 10},
+                            object_store_memory=64 << 20)
+    try:
+        target = Counter.options(resources={"peer": 1}).remote()
+        ray_tpu.get(target.dump.remote(), timeout=60)
+
+        @ray_tpu.remote(num_cpus=0, resources={"peer": 1})
+        class AgentCaller:
+            def storm(self, t, n):
+                from ray_tpu.core.runtime import get_runtime
+                rt = get_runtime()
+                before = rt.direct_calls_sent
+                vals = ray_tpu.get([t.add.remote(i) for i in range(n)],
+                                   timeout=120)
+                return vals, rt.direct_calls_sent - before
+
+        caller = AgentCaller.remote()
+        before = fresh.actor_head_dispatches
+        vals, direct = ray_tpu.get(caller.storm.remote(target, 120),
+                                   timeout=120)
+        delta = fresh.actor_head_dispatches - before
+        assert vals == [i * 2 for i in range(120)]
+        assert direct >= 110, f"only {direct} calls rode the UDS plane"
+        assert delta <= 10, f"head saw {delta} dispatches"
+    finally:
+        cluster.remove_node(node)

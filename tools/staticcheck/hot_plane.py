@@ -54,9 +54,23 @@ SCOPES = {
         "TensorChannel._copy_leaf",
         "TensorChannel._native_copy",
     ),
-    # The arena's tagged-object encoder (what a C++ worker reads raw).
+    # The arena's tagged-object encoder (what a C++ worker reads raw)
+    # and the write-reservation fill plane (lock-free carve/publish —
+    # raw byte moves only; serialization happens in the callers).
     "ray_tpu/core/object_store.py": (
         "SharedMemoryStore.put_tagged",
+        "SharedMemoryStore._reserved_create",
+        "SharedMemoryStore._carve",
+        "_ReservedBuffer.seal",
+    ),
+    # The direct actor-call frame plane (worker<->worker UDS): routing
+    # and shipping only — payload (de)serialization belongs to
+    # _apply_direct_done/_reply_result, never to the frame movers.
+    "ray_tpu/core/worker.py": (
+        "WorkerRuntime.send_direct_worker",
+        "WorkerRuntime._on_wpeer_frame",
+        "_ReplyBatcher._send",
+        "_ReplyBatcher._group_routes",
     ),
 }
 

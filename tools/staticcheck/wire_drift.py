@@ -51,6 +51,11 @@ PICKLE_FRAMED_MESSAGES = {
     "MetricsUpdate": {"metrics": 1},
     "MetricsUpdate.Metric": {"name": 1, "kind": 2, "description": 3,
                              "tag_keys": 4, "values": 5},
+    # Direct worker<->worker actor-call frames (UDS peer plane): pickle
+    # framing today, schema documented for the next regen.
+    "DirectActorCall": {"spec": 1},
+    "DirectActorReply": {"dones": 1},
+    "DirectActorReply.Done": {"task_id": 1, "outs": 2},
 }
 
 # Fields of bound messages that ride the pickle-framing fallback when set
