@@ -143,6 +143,7 @@ def final_line(status: str = "complete"):
         "adag_pipeline": EXTRAS.get("adag_pipeline", {}),
         "task_events": EXTRAS.get("task_events", {}),
         "cross_language": EXTRAS.get("cross_language", {}),
+        "chaos_storm": EXTRAS.get("chaos_storm", {}),
         "tpu_mfu_pct": mfu,
         "tpu": TPU,
         "detail": {k: round(v, 1) for k, v in RESULTS.items()},
@@ -181,6 +182,9 @@ def final_line(status: str = "complete"):
                        if RESULTS.get("n_n_async_actor_calls_async")
                        else None),
         "adag_x": EXTRAS.get("adag_pipeline", {}).get("tensor_speedup_x"),
+        # Robustness headline: storm throughput as a fraction of the
+        # clean run under the fixed-seed 1% fault schedule.
+        "chaos_x": EXTRAS.get("chaos_storm", {}).get("chaos_x"),
         "tev_ovh_pct": EXTRAS.get("task_events", {}).get("overhead_pct"),
         "xlang_s": EXTRAS.get("cross_language", {}).get(
             "cpp_tasks_async_s"),
@@ -206,8 +210,9 @@ def final_line(status: str = "complete"):
     # oversize path — trim to the irreducible core instead of dying.
     if len(line) >= 2048:
         for key in ("host", "tpu_mfu_pct", "xlang_s", "tev_ovh_pct",
-                    "adag_x", "n_skipped", "n_missing", "n_metrics",
-                    "wall_s", "status", "mc_put_x", "nn_async_x"):
+                    "adag_x", "chaos_x", "n_skipped", "n_missing",
+                    "n_metrics", "wall_s", "status", "mc_put_x",
+                    "nn_async_x"):
             headline.pop(key, None)
             line = json.dumps(headline)
             if len(line) < 2048:
@@ -873,6 +878,70 @@ def _main_inner():
         }
         emit("many_nodes_tasks_s", float(rate))
 
+    def sec_chaos():
+        # Chaos storm (core/chaos.py): the same retryable task storm run
+        # clean and under a seeded 1% fault schedule + a mid-storm worker
+        # SIGKILL. chaos_x = chaotic/clean throughput (1.0 = faults are
+        # free; the recovery machinery's tax is the gap), recovery_s =
+        # wall time for a fresh batch to complete after a pooled worker
+        # is SIGKILLed cold. Fixed seed => the same fault sequence every
+        # round, so the trajectory of chaos_x is comparable.
+        schedule = ("transport.send.delay:0.01,transport.send.drop:0.002,"
+                    "worker.exec.kill:150")
+        code_tmpl = r"""
+import json, os, time
+import ray_tpu
+sched = {sched!r}
+cfg = {{"chaos_schedule": sched, "chaos_seed": 42}} if sched else {{}}
+rt = ray_tpu.init(num_cpus=2, _system_config=cfg)
+
+@ray_tpu.remote(num_cpus=1, max_retries=3)
+def work(i):
+    return i * 2
+
+ray_tpu.get([work.remote(i) for i in range(50)], timeout=60)  # warm
+t0 = time.perf_counter()
+refs = [work.remote(i) for i in range(400)]
+out = ray_tpu.get(refs, timeout=240)
+el = time.perf_counter() - t0
+assert out == [i * 2 for i in range(400)], "storm refs must resolve"
+rec = None
+if sched:
+    ws = [w for w in rt.head_node.workers.values()
+          if getattr(w, "proc", None) is not None]
+    if ws:
+        try:
+            os.kill(ws[0].proc.pid, 9)
+        except (ProcessLookupError, AttributeError):
+            pass
+        t1 = time.perf_counter()
+        got = ray_tpu.get([work.remote(i) for i in range(20)],
+                          timeout=120)
+        assert got == [i * 2 for i in range(20)]
+        rec = time.perf_counter() - t1
+    rt.store.reclaim_orphans()
+    assert rt.store.stats()["rsv_unused"] == 0, "leaked reservations"
+print("CHAOS_RES", json.dumps({{"tasks_s": 400 / el, "recovery_s": rec}}))
+ray_tpu.shutdown()
+"""
+        out_clean = run_sub(code_tmpl.format(sched=""), timeout=150,
+                            tag="chaos_clean")
+        clean = json.loads([ln for ln in out_clean.splitlines()
+                            if ln.startswith("CHAOS_RES")][0][10:])
+        out_chaos = run_sub(code_tmpl.format(sched=schedule), timeout=200,
+                            tag="chaos_storm")
+        chaotic = json.loads([ln for ln in out_chaos.splitlines()
+                              if ln.startswith("CHAOS_RES")][0][10:])
+        EXTRAS["chaos_storm"] = {
+            "clean_tasks_s": round(clean["tasks_s"], 1),
+            "chaos_tasks_s": round(chaotic["tasks_s"], 1),
+            "chaos_x": round(chaotic["tasks_s"]
+                             / max(clean["tasks_s"], 1e-9), 3),
+            "recovery_s": (round(chaotic["recovery_s"], 2)
+                           if chaotic.get("recovery_s") else None),
+            "schedule": schedule, "seed": 42,
+        }
+
     sections = [
         ("tasks", 120, sec_tasks),
         ("actors", 150, sec_actors),
@@ -882,6 +951,7 @@ def _main_inner():
         ("cross_language", 90, sec_cross_language),
         ("pg", 90, sec_pg),
         ("client", 90, sec_client),
+        ("chaos", 150, sec_chaos),
         ("many_agents", 180, sec_many_agents),
     ]
     # Resilience-test hooks: a section that hangs forever and one that

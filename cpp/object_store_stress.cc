@@ -14,10 +14,13 @@
 //   argv: [n_threads] [iters_per_thread] [arena_mb]
 
 #include <pthread.h>
+#include <signal.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
 #include <sys/mman.h>
+#include <sys/wait.h>
+#include <unistd.h>
 
 #include <atomic>
 #include <cstdint>
@@ -31,6 +34,8 @@ int store_release_extent(void* base, uint64_t abs_offset, uint64_t size);
 int store_publish(void* base, const uint8_t* id, uint64_t abs_offset,
                   uint64_t data_size, uint64_t meta_size);
 uint64_t store_num_reserves(void* base);
+uint64_t store_rsv_unused(void* base);
+int64_t store_reclaim_orphans(void* base);
 void store_copy_adaptive(void* base, void* dst, const void* src, uint64_t n,
                          int max_threads);
 int store_validate(void* base);
@@ -196,17 +201,76 @@ int main(int argc, char** argv) {
     fprintf(stderr, "store corrupt after stress\n");
     return 1;
   }
+
+  // Kill-and-reclaim: fork a child that reserves an extent, publishes one
+  // object into it, bump-carves a second, then SIGKILLs itself — the
+  // crash window between store_reserve and the final store_publish. The
+  // parent's pid-liveness sweep must return every unpublished byte and
+  // zero rsv_unused, with the published object surviving. The arena is
+  // MAP_SHARED, so the child's mutations are visible here (the same
+  // crash-consistency contract a SIGKILLed client process exercises).
+  uint64_t rsv_before = store_rsv_unused(g_base);
+  pid_t child = fork();
+  if (child == 0) {
+    uint64_t ext = 0;
+    const uint64_t kRsv = 256 * 1024;
+    if (store_reserve(g_base, kRsv, &ext) == 0) {
+      uint8_t id[16];
+      make_id(id, 9999, 9999);  // outside the shared (tid, slot) space
+      uint64_t dsz = 40000;
+      char* dst = static_cast<char*>(g_base) + ext;
+      memset(dst, 0x77, dsz + 4);
+      store_publish(g_base, id, ext, dsz, 4);
+      // Second object carved (cursor advanced client-side) but NEVER
+      // published: dies right here with the extent's tail parked.
+    }
+    kill(getpid(), SIGKILL);
+    _exit(3);  // unreachable
+  }
+  int wst = 0;
+  waitpid(child, &wst, 0);
+  if (!WIFSIGNALED(wst) || WTERMSIG(wst) != SIGKILL) {
+    fprintf(stderr, "kill-and-reclaim child did not die by SIGKILL\n");
+    return 1;
+  }
+  uint64_t rsv_leaked = store_rsv_unused(g_base);
+  int64_t reclaimed = store_reclaim_orphans(g_base);
+  uint64_t rsv_after = store_rsv_unused(g_base);
+  if (reclaimed <= 0 || rsv_after > rsv_before || rsv_leaked <= rsv_before) {
+    fprintf(stderr,
+            "kill-and-reclaim accounting wrong: before=%llu leaked=%llu "
+            "reclaimed=%lld after=%llu\n",
+            (unsigned long long)rsv_before, (unsigned long long)rsv_leaked,
+            (long long)reclaimed, (unsigned long long)rsv_after);
+    return 1;
+  }
+  {
+    uint8_t id[16];
+    make_id(id, 9999, 9999);
+    uint64_t off = 0, dsz = 0, msz = 0;
+    if (store_get(g_base, id, &off, &dsz, &msz) != 0 || dsz != 40000) {
+      fprintf(stderr, "published object lost by the reclaim sweep\n");
+      return 1;
+    }
+    store_release(g_base, id);
+  }
+  if (store_validate(g_base) != 0) {
+    fprintf(stderr, "store corrupt after reclaim\n");
+    return 1;
+  }
+
   uint64_t allocated = 0, capacity = 0, objects = 0, evictions = 0;
   store_stats(g_base, &allocated, &capacity, &objects, &evictions);
   printf("STRESS_OK threads=%llu iters=%llu seals=%llu hits=%llu "
          "objects=%llu evictions=%llu allocated=%llu reserves=%llu "
-         "publishes=%llu\n",
+         "publishes=%llu reclaimed=%lld\n",
          (unsigned long long)nthreads, (unsigned long long)iters,
          (unsigned long long)g_seals.load(),
          (unsigned long long)g_hits.load(),
          (unsigned long long)objects, (unsigned long long)evictions,
          (unsigned long long)allocated,
          (unsigned long long)g_reserves.load(),
-         (unsigned long long)g_publishes.load());
+         (unsigned long long)g_publishes.load(),
+         (long long)reclaimed);
   return 0;
 }

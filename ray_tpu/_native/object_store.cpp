@@ -30,10 +30,14 @@
 
 #include <errno.h>
 #include <pthread.h>
+#include <signal.h>
 #include <stdint.h>
 #include <string.h>
+#include <unistd.h>
 
+#include <algorithm>
 #include <thread>
+#include <utility>
 #include <vector>
 
 extern "C" {
@@ -79,7 +83,7 @@ enum StoreStatus {
   ERR_CORRUPT = -7,
 };
 
-static const uint64_t MAGIC = 0x5241595F54505533ULL;  // "RAY_TPU3" (reservations)
+static const uint64_t MAGIC = 0x5241595F54505534ULL;  // "RAY_TPU4" (rsv records)
 static const uint64_t ALIGN = 64;
 static const uint64_t MIN_BLOCK = 128;
 static const uint32_t SHARD_CANARY = 0x53484152;      // "SHAR"
@@ -107,6 +111,25 @@ struct Slot {
 struct FreeBlock {
   uint64_t size;
   uint64_t next;  // arena-relative offset of next free block, or 0 (arena off 0 is never free: we reserve first ALIGN bytes)
+};
+
+// Crash-consistency record for one live write-reservation extent: who
+// carved it (pid) and how many of its bytes are still neither published
+// nor released. Registered under the global mutex at store_reserve;
+// store_publish / store_release_extent decrement `unpublished` and the
+// record self-retires at zero. A client that dies mid-reservation leaves
+// an active record whose pid no longer exists — store_reclaim_orphans
+// finds those, returns the unaccounted gaps inside [off, off+size) to the
+// global free list, and repairs rsv_unused_bytes, so a SIGKILLed client
+// can no longer strand an extent (or wedge spill accounting) until the
+// arena is unlinked.
+static const uint64_t MAX_RSV_RECS = 256;
+struct RsvRec {
+  uint64_t pid;
+  uint64_t off;          // arena-relative extent start
+  uint64_t size;         // extent bytes
+  uint64_t unpublished;  // bytes not yet published/released (atomic)
+  uint64_t active;       // atomic 0/1; set last (release) at register
 };
 
 static const uint64_t FASTBIN_MAX = 2048;   // largest fastbinned block
@@ -151,6 +174,7 @@ struct Header {
                                // store_copy_adaptive divides its thread
                                // budget by this so N concurrent clients
                                // don't oversubscribe N*threads workers
+  RsvRec rsv_recs[MAX_RSV_RECS];  // live-extent ownership (crash sweep)
 };
 
 static inline Shard* shard_at(Header* h, uint64_t i) {
@@ -519,20 +543,72 @@ static void sweep_evict_all_shards(Header* h, bool* progress) {
   }
 }
 
+// Find the active record whose extent contains arena-relative `off`, or
+// null. Records are few and mutate rarely; the scan is lock-free (active
+// flips 0->1 with release ordering after the fields are written, and only
+// the owner — or the sweeper, for a DEAD owner — flips it back).
+static RsvRec* rsv_find(Header* h, uint64_t off) {
+  for (uint64_t i = 0; i < MAX_RSV_RECS; i++) {
+    RsvRec* r = &h->rsv_recs[i];
+    if (!__atomic_load_n(&r->active, __ATOMIC_ACQUIRE)) continue;
+    // Atomic field reads: a sibling thread may be re-initializing a
+    // RETIRED record slot concurrently; active's acquire/release pairing
+    // guarantees the fields are consistent whenever active reads 1, and
+    // the atomics keep the (ignored) racing reads untorn.
+    uint64_t ro = __atomic_load_n(&r->off, __ATOMIC_RELAXED);
+    uint64_t rs = __atomic_load_n(&r->size, __ATOMIC_RELAXED);
+    if (off >= ro && off < ro + rs) return r;
+  }
+  return nullptr;
+}
+
+// Owner-side accounting for bytes leaving the "reserved, unaccounted"
+// state (a publish or an explicit release): the record self-retires when
+// nothing unpublished remains, so a cleanly drained extent needs no
+// explicit close call and the record slot recycles.
+static void rsv_account(Header* h, uint64_t off, uint64_t bytes) {
+  RsvRec* r = rsv_find(h, off);
+  if (r == nullptr) return;  // unrecorded extent (table was full)
+  uint64_t left =
+      __atomic_sub_fetch(&r->unpublished, bytes, __ATOMIC_RELAXED);
+  if (left == 0
+      || left > __atomic_load_n(&r->size, __ATOMIC_RELAXED))
+    // drained (or accounting drift): retire the record slot
+    __atomic_store_n(&r->active, 0, __ATOMIC_RELEASE);
+}
+
 // Carve a raw extent of `size` bytes; *out_offset is ABSOLUTE (from
 // base), like store_create's. Evicts sealed refcnt==0 objects across all
-// shards under pressure. Returns OK or ERR_FULL.
+// shards under pressure. Returns OK or ERR_FULL. The extent is recorded
+// with this process's pid so store_reclaim_orphans can return it if the
+// owner dies before publishing/releasing every byte.
 int store_reserve(void* base, uint64_t size, uint64_t* out_offset) {
   Header* h = (Header*)base;
   uint64_t need = align_up(size < MIN_BLOCK ? MIN_BLOCK : size);
   for (;;) {
     lock_mu(&h->mutex);
     int64_t off = list_alloc_first_fit(h, &h->free_head, need);
-    if (off >= 0) h->bytes_from_global += need;
-    unlock_mu(&h->mutex);
     if (off >= 0) {
+      h->bytes_from_global += need;
+      // Register ownership INSIDE the critical section: a death after
+      // unlock leaves a consistent (counted + recorded) extent for the
+      // sweeper. Table full => proceed unrecorded (no crash protection
+      // for this extent; 256 concurrent extents per node is the bound).
+      for (uint64_t i = 0; i < MAX_RSV_RECS; i++) {
+        RsvRec* r = &h->rsv_recs[i];
+        if (__atomic_load_n(&r->active, __ATOMIC_RELAXED)) continue;
+        __atomic_store_n(&r->pid, (uint64_t)getpid(), __ATOMIC_RELAXED);
+        __atomic_store_n(&r->off, (uint64_t)off, __ATOMIC_RELAXED);
+        __atomic_store_n(&r->size, need, __ATOMIC_RELAXED);
+        __atomic_store_n(&r->unpublished, need, __ATOMIC_RELAXED);
+        __atomic_store_n(&r->active, 1, __ATOMIC_RELEASE);
+        break;
+      }
       __atomic_add_fetch(&h->num_reserves, 1, __ATOMIC_RELAXED);
       __atomic_add_fetch(&h->rsv_unused_bytes, need, __ATOMIC_RELAXED);
+    }
+    unlock_mu(&h->mutex);
+    if (off >= 0) {
       *out_offset = h->arena_offset + (uint64_t)off;
       return OK;
     }
@@ -554,6 +630,7 @@ int store_release_extent(void* base, uint64_t abs_offset, uint64_t size) {
   list_insert_ordered(h, &h->free_head, off, size);
   unlock_mu(&h->mutex);
   __atomic_sub_fetch(&h->rsv_unused_bytes, size, __ATOMIC_RELAXED);
+  rsv_account(h, off, size);
   return OK;
 }
 
@@ -587,11 +664,126 @@ int store_publish(void* base, const uint8_t* id, uint64_t abs_offset,
   sh->num_objects++;
   unlock_mu(&sh->mutex);
   __atomic_sub_fetch(&h->rsv_unused_bytes, block, __ATOMIC_RELAXED);
+  rsv_account(h, abs_offset - h->arena_offset, block);
   return OK;
 }
 
 uint64_t store_num_reserves(void* base) {
   return __atomic_load_n(&((Header*)base)->num_reserves, __ATOMIC_RELAXED);
+}
+
+uint64_t store_rsv_unused(void* base) {
+  return __atomic_load_n(&((Header*)base)->rsv_unused_bytes,
+                         __ATOMIC_RELAXED);
+}
+
+// ---- orphaned-reservation reclamation (pid-liveness sweep) ----
+//
+// A client SIGKILLed between store_reserve and its final store_publish /
+// store_release_extent leaves (a) the extent's unaccounted bytes carved
+// out of the global list forever and (b) rsv_unused_bytes inflated by the
+// same amount — stats under-report "allocated" and the spill policy can
+// wedge. The sweep: for every active record whose pid no longer exists,
+// compute which bytes of [off, off+size) are ACCOUNTED FOR elsewhere
+// (live slots the client published before dying; free-list blocks from
+// slices it released or published-then-evicted) and return every
+// remaining gap to the global free list, repairing both counters.
+
+static bool pid_alive(uint64_t pid) {
+  if (pid == 0) return true;  // unknown owner: never reclaim
+  if (kill((pid_t)pid, 0) == 0) return true;
+  return errno != ESRCH;  // EPERM = alive under another uid
+}
+
+// Collect [lo,hi)-clamped intervals of one free list into `iv`.
+static void collect_list(Header* h, uint64_t head, uint64_t lo, uint64_t hi,
+                         std::vector<std::pair<uint64_t, uint64_t>>* iv) {
+  for (uint64_t cur = head; cur;) {
+    FreeBlock* fb = (FreeBlock*)(arena(h) + cur);
+    uint64_t b = cur, e = cur + fb->size;
+    if (b < hi && e > lo)
+      iv->push_back({b < lo ? lo : b, e > hi ? hi : e});
+    cur = fb->next;
+  }
+}
+
+// Reclaim one dead record. Caller holds NO locks; takes every shard
+// mutex then the global mutex (the store's shard->global lock order).
+static int64_t reclaim_record(Header* h, uint64_t ri) {
+  for (uint64_t i = 0; i < h->nshards; i++) lock_mu(&shard_at(h, i)->mutex);
+  lock_mu(&h->mutex);
+  RsvRec* rec = &h->rsv_recs[ri];
+  int64_t freed = 0;
+  if (__atomic_load_n(&rec->active, __ATOMIC_ACQUIRE)
+      && !pid_alive(rec->pid)) {
+    uint64_t lo = rec->off, hi = rec->off + rec->size;
+    std::vector<std::pair<uint64_t, uint64_t>> iv;
+    // Live slots published into the extent (block footprint, align_up —
+    // the geometry contract shared with eviction).
+    for (uint64_t si = 0; si < h->nshards; si++) {
+      Slot* tab = shard_table(h, si);
+      for (uint64_t i = 0; i < h->slots_per_shard; i++) {
+        Slot* s = &tab[i];
+        if (s->state != SLOT_CREATED && s->state != SLOT_SEALED) continue;
+        uint64_t raw = s->data_size + s->meta_size;
+        uint64_t blk = align_up(raw < MIN_BLOCK ? MIN_BLOCK : raw);
+        uint64_t b = s->offset, e = s->offset + blk;
+        if (b < hi && e > lo)
+          iv.push_back({b < lo ? lo : b, e > hi ? hi : e});
+      }
+    }
+    // Free bytes already returned (released slices, evicted publishes —
+    // possibly coalesced across the extent boundary, hence the clamp).
+    collect_list(h, h->free_head, lo, hi, &iv);
+    for (uint64_t si = 0; si < h->nshards; si++) {
+      Shard* sh = shard_at(h, si);
+      collect_list(h, sh->free_head, lo, hi, &iv);
+      for (uint64_t b = 0; b < NUM_FASTBINS; b++)
+        collect_list(h, sh->fastbin[b], lo, hi, &iv);
+    }
+    std::sort(iv.begin(), iv.end());
+    // Walk the gaps: bytes of the dead extent no structure accounts for.
+    uint64_t cursor = lo;
+    auto free_gap = [&](uint64_t b, uint64_t e) {
+      if (e <= b) return;
+      list_insert_ordered(h, &h->free_head, b, e - b);
+      h->bytes_from_global -= e - b;
+      freed += (int64_t)(e - b);
+    };
+    for (auto& p : iv) {
+      if (p.first > cursor) free_gap(cursor, p.first);
+      if (p.second > cursor) cursor = p.second;
+    }
+    free_gap(cursor, hi);
+    if (freed > 0) {
+      uint64_t cur =
+          __atomic_load_n(&h->rsv_unused_bytes, __ATOMIC_RELAXED);
+      uint64_t sub = (uint64_t)freed < cur ? (uint64_t)freed : cur;
+      __atomic_sub_fetch(&h->rsv_unused_bytes, sub, __ATOMIC_RELAXED);
+    }
+    __atomic_store_n(&rec->active, 0, __ATOMIC_RELEASE);
+  }
+  unlock_mu(&h->mutex);
+  for (uint64_t i = h->nshards; i-- > 0;)
+    unlock_mu(&shard_at(h, i)->mutex);
+  return freed;
+}
+
+// Sweep every active record for dead owners; returns bytes reclaimed.
+// Cheap when nothing died: one lock-free record scan + one kill(pid, 0)
+// per live extent — safe to call from heartbeat/pressure paths.
+int64_t store_reclaim_orphans(void* base) {
+  Header* h = (Header*)base;
+  uint64_t self = (uint64_t)getpid();
+  int64_t total = 0;
+  for (uint64_t i = 0; i < MAX_RSV_RECS; i++) {
+    RsvRec* rec = &h->rsv_recs[i];
+    if (!__atomic_load_n(&rec->active, __ATOMIC_ACQUIRE)) continue;
+    uint64_t pid = __atomic_load_n(&rec->pid, __ATOMIC_RELAXED);
+    if (pid == self || pid_alive(pid)) continue;
+    total += reclaim_record(h, i);
+  }
+  return total;
 }
 
 void store_copy_adaptive(void* base, void* dst, const void* src, uint64_t n,

@@ -177,6 +177,50 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     # --- fault injection (test leverage, parity: rpc_chaos.h) ---
     "testing_rpc_failure": (str, "", "'method=max_failures' comma list; drops messages"),
     "testing_delay_us": (str, "", "'method=min:max' comma list; injects delays"),
+    # --- chaos plane (core/chaos.py: deterministic seeded fault
+    #     injection at named hot-path seams) ---
+    "chaos_schedule": (str, "", "comma list of 'site:spec' arming named "
+                       "injection sites (chaos.REGISTERED_SITES): spec is "
+                       "a 1-based hit count (fire exactly once on that "
+                       "hit) or a probability in (0,1) applied per hit; "
+                       "site may be an fnmatch glob ('transport.*:0.01'). "
+                       "Same chaos_seed => identical per-site fire "
+                       "sequence. '' disables (zero overhead)"),
+    "chaos_seed": (int, 0, "seed for the chaos plane's per-site RNGs; a "
+                   "fixed seed makes a chaos storm replayable"),
+    # --- unified retry/backoff policy (core/retry.py Backoff: capped
+    #     exponential + jitter against a deadline — the one cadence every
+    #     core retry loop sleeps through) ---
+    "retry_backoff_base_s": (float, 0.05, "first retry interval"),
+    "retry_backoff_cap_s": (float, 2.0, "retry interval ceiling"),
+    "retry_backoff_jitter": (float, 0.2, "fractional jitter (+/-) applied "
+                             "to every interval — desynchronizes N "
+                             "processes re-dialing one restarted peer"),
+    "peer_dial_timeout_s": (float, 5.0, "connect timeout for ctrl-plane "
+                            "dials (agent<->agent channels, spill hops)"),
+    "lease_redrive_timeout_s": (float, 10.0, "head re-sends a granted "
+                                "lease whose node reports ITSELF idle "
+                                "(no backlog, nothing in flight) this "
+                                "long after the grant — recovers a "
+                                "node_exec frame lost on the wire; "
+                                "agents dedup re-sent (task, lease_seq) "
+                                "pairs so a re-drive can never "
+                                "double-queue. <=0 disables"),
+    "objxfer_stream_fail_limit": (int, 3, "after this many striped-pull "
+                                  "range failures against one peer "
+                                  "address, pulls from it degrade to "
+                                  "single-stream until a striped pull "
+                                  "completes clean"),
+    "orphan_reclaim_interval_s": (float, 5.0, "store owners (head, node "
+                                  "agents) sweep the arena's write-"
+                                  "reservation records for dead-pid "
+                                  "owners at this cadence, returning "
+                                  "leaked extents and repairing "
+                                  "rsv_unused (a client SIGKILLed "
+                                  "between reserve and publish strands "
+                                  "its extent otherwise). <=0 disables "
+                                  "the periodic sweep (pressure-path "
+                                  "sweeps still run)"),
     # --- observability ---
     "event_stats": (bool, False, "record per-handler event-loop stats"),
     "export_events": (bool, False, "append task/actor/node state "
@@ -273,5 +317,13 @@ def set_config(cfg: Config):
     try:
         from ray_tpu.core import transport
         transport._chaos = None
+    except ImportError:
+        pass
+    # Arm (or disarm) the named-site chaos plane from the resolved config
+    # — every process that adopts a config re-derives its site table, so
+    # the schedule propagates to workers/agents through the environment.
+    try:
+        from ray_tpu.core import chaos
+        chaos.configure_from(cfg)
     except ImportError:
         pass

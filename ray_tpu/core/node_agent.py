@@ -28,8 +28,9 @@ import time
 import traceback
 import uuid
 
-from ray_tpu.core import objxfer, task_events
+from ray_tpu.core import chaos, objxfer, task_events
 from ray_tpu.core.config import Config, set_config
+from ray_tpu.core.retry import Backoff
 from ray_tpu.core.ids import ObjectID, WorkerID
 from ray_tpu.core.object_store import SharedMemoryStore, default_store_size
 from ray_tpu.core.order_gate import OrderGate
@@ -157,6 +158,9 @@ class NodeAgent:
             num_shards=cfg.object_store_shards)
         from ray_tpu.core.object_store import configure_store
         configure_store(self.store, cfg)
+        # Serializes the heartbeat loop's orphan-reservation sweep against
+        # _die()'s arena unmap (a sweep over freed shm segfaults).
+        self._store_close_lock = threading.Lock()
 
         self.resources = {
             "CPU": float(num_cpus if num_cpus is not None
@@ -223,6 +227,14 @@ class NodeAgent:
         self._lease_lock = threading.Lock()
         self._lease_q: collections.deque = collections.deque()
         self._lease_inflight: dict[bytes, tuple] = {}  # tid -> (wid, spec)
+        # (task_id, lease_seq) pairs this agent has accepted (bounded,
+        # guarded by _lease_lock): the head's lease re-drive (a node_exec
+        # resent because the grant frame was lost on the wire) dedups
+        # here, so a re-drive racing the original delivery can never
+        # double-queue an execution. A legitimate re-grant after
+        # lease_return carries a bumped lease_seq and passes.
+        self._lease_seen: "collections.OrderedDict[tuple, bool]" = (
+            collections.OrderedDict())
         self._worker_load: dict[bytes, int] = {}       # outstanding execs
         self._worker_fns: dict[bytes, set] = {}        # wid -> fn_ids sent
         self._fn_blobs: dict[bytes, bytes] = {}        # agent fn cache
@@ -419,13 +431,17 @@ class NodeAgent:
                 self.head_sock.close()
             except OSError:
                 pass
-            deadline = time.monotonic() + self.config.agent_reconnect_grace_s
-            while not self._shutdown and time.monotonic() < deadline:
+            # Jittered capped-exponential retry against the grace deadline
+            # (core/retry.py): N agents re-dialing one restarted head no
+            # longer fire in lockstep every 500ms.
+            bo = Backoff(deadline_s=self.config.agent_reconnect_grace_s)
+            while not self._shutdown and not bo.expired():
                 try:
                     sock = socket.create_connection(
                         (self.head_host, self.head_port), timeout=2.0)
                 except OSError:
-                    time.sleep(0.5)
+                    if not bo.sleep():
+                        break
                     continue
                 enable_nodelay(sock)
                 self.head_sock = sock
@@ -452,8 +468,12 @@ class NodeAgent:
 
     def _heartbeat_loop(self):
         period = self.config.health_check_period_ms / 1000.0
+        reclaim_every = self.config.orphan_reclaim_interval_s
+        last_reclaim = time.monotonic()
         while not self._shutdown:
             time.sleep(period)
+            chaos.kill("agent.sigkill")  # deterministic agent death on
+            # the Nth heartbeat tick (role-targeted SIGKILL)
             try:
                 self._send_head(("heartbeat", self.node_id,
                                  self._load_view()))
@@ -467,6 +487,16 @@ class NodeAgent:
                 # delta arrived (broadcasts only carry CHANGES) still
                 # drains toward idle peers within a heartbeat.
                 self._maybe_spill_leases()
+                if (reclaim_every > 0
+                        and time.monotonic() - last_reclaim >= reclaim_every):
+                    # Dead-client reservation sweep: a worker SIGKILLed
+                    # between reserve and publish strands its extent (and
+                    # inflates rsv_unused) until this repairs it. Under
+                    # the close gate — _die() unmaps the arena.
+                    last_reclaim = time.monotonic()
+                    with self._store_close_lock:
+                        if not self._shutdown:
+                            self.store.reclaim_orphans()
             except Exception:  # noqa: BLE001 — a dead heartbeat thread
                 traceback.print_exc()  # would get this node declared dead
 
@@ -968,9 +998,14 @@ class NodeAgent:
             # chain (spill_hops) so the head can drop stale notices
             # instead of re-pointing a lease that was re-granted, or
             # applying a multi-hop chain's frames out of order.
-            self._send_head(("lease_spilled",
-                             [(t[2].task_id, t[2].lease_seq,
-                               t[2].spill_hops, nid) for t in triples]))
+            if chaos.site("agent.spill_notice.lose"):
+                pass  # injected notice loss: the head's lease-pop
+                # fallbacks + the peer's lease_return path must keep
+                # completions/death replay correct without it
+            else:
+                self._send_head(("lease_spilled",
+                                 [(t[2].task_id, t[2].lease_seq,
+                                   t[2].spill_hops, nid) for t in triples]))
             threading.Thread(target=self._spill_to_peer,
                              args=(nid, triples, new_fns), daemon=True,
                              name="rtpu-spill").start()
@@ -1058,12 +1093,27 @@ class NodeAgent:
                             spec, "SPILL_RECEIVED",
                             data={"from": origin_nid.hex(),
                                   "hop": spec.spill_hops or 0})
+                    if self._lease_dup_locked(spec):
+                        continue  # already queued here (re-driven grant
+                        # that chased the spill to this node)
                     self._lease_q.append(spec)
                     accepted = True
         if reject:
             self._send_head(("lease_return", reject))
         if accepted:
             self._pump_leases()
+
+    def _lease_dup_locked(self, spec) -> bool:
+        """Seen-set check+record for one accepted lease (caller holds
+        _lease_lock). True => this exact grant generation was already
+        accepted on this node and the copy must be dropped."""
+        key = (spec.task_id, spec.lease_seq or 0)
+        if key in self._lease_seen:
+            return True
+        self._lease_seen[key] = True
+        while len(self._lease_seen) > 8192:
+            self._lease_seen.popitem(last=False)
+        return False
 
     def _sniff_lease_dones(self, w: _AgentWorker, msg,
                            collector: list | None = None) -> object | None:
@@ -1126,6 +1176,8 @@ class NodeAgent:
                 for fn_id, blob, spec in msg[1]:
                     if blob is not None:
                         self._fn_blobs[fn_id] = blob
+                    if self._lease_dup_locked(spec):
+                        continue  # head re-drive of a grant we DID get
                     if getattr(spec, "language", None) == "cpp":
                         self._cpp_q.append(spec)
                         any_cpp = True
@@ -1224,6 +1276,9 @@ class NodeAgent:
         plane exists for); the synchronous head query is the fallback for
         peers the view has not carried yet."""
         from ray_tpu.core.transport import dial
+        if chaos.site("agent.peer_dial.fail"):
+            return None  # injected unreachable peer: callers fall back
+            # through the head (or lease_return the spill batch)
         sock = None
         with self._lease_lock:
             e = self._cluster_view.get(nid) or {}
@@ -1655,10 +1710,12 @@ class NodeAgent:
         except OSError:
             pass
         try:
-            # Peer server first: native threads read the arena mmap raw.
+            # Peer server first: native threads read the arena mmap raw;
+            # close gate second: the heartbeat orphan sweep walks it too.
             self.peer_server.stop()
-            self.store.close()
-            self.store.unlink()
+            with self._store_close_lock:
+                self.store.close()
+                self.store.unlink()
         except Exception:  # noqa: BLE001
             pass
         os._exit(0)

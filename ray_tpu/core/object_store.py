@@ -65,6 +65,10 @@ def _lib():
         lib.store_publish.argtypes = [p, b, u64, u64, u64]
         lib.store_num_reserves.argtypes = [p]
         lib.store_num_reserves.restype = u64
+        lib.store_rsv_unused.argtypes = [p]
+        lib.store_rsv_unused.restype = u64
+        lib.store_reclaim_orphans.argtypes = [p]
+        lib.store_reclaim_orphans.restype = ctypes.c_int64
         lib._sigs_set = True
     return lib
 
@@ -141,6 +145,9 @@ class _ReservedBuffer(ObjectBuffer):
     __slots__ = ("data_size", "meta_size", "block")
 
     def seal(self):
+        from ray_tpu.core import chaos
+        chaos.kill("store.publish.kill")  # SIGKILL in the crash window the
+        # orphan sweep exists for: bytes filled, slot never published
         self.data.release()
         self.meta_view.release()
         rc = self.store._lib.store_publish(
@@ -321,10 +328,28 @@ class SharedMemoryStore:
     def release_reservation(self):
         """Return the unused tail of this client's reservation (shutdown,
         or before a refill)."""
+        from ray_tpu.core import chaos
         with self._rsv_lock:
             r, self._rsv = self._rsv, None
         if r is not None and r.size > r.used:
+            if chaos.site("store.reserve.abandon"):
+                return  # simulate the crash window: the tail leaks until
+                # the owner pid dies and the liveness sweep repairs it
             self._release_chunk(r.off + r.used, r.size - r.used)
+
+    def reclaim_orphans(self) -> int:
+        """Pid-liveness sweep over the arena's reservation records:
+        extents whose owner died mid-reservation are returned to the
+        global free list and `rsv_unused` is repaired. Returns bytes
+        reclaimed. Cheap when nothing died — store owners (head runtime,
+        node agents) call this on pressure and on a heartbeat cadence."""
+        return int(self._lib.store_reclaim_orphans(self._base))
+
+    def rsv_unused(self) -> int:
+        """Reserved-but-unpublished bytes currently parked across ALL
+        clients' write reservations (the counter the orphan sweep
+        repairs; tests assert it returns to baseline after storms)."""
+        return int(self._lib.store_rsv_unused(self._base))
 
     def reservation_fits(self, nbytes: int) -> bool:
         """True when a put of ~nbytes will carve from the current
@@ -351,8 +376,12 @@ class SharedMemoryStore:
         the global extent list when the current one is exhausted. Returns
         None when the arena cannot host a fresh extent (caller falls back
         to the eviction-capable create path)."""
+        from ray_tpu.core import chaos
         total = data_size + len(meta)
         block = _round_block(total)
+        if chaos.site("store.reserve.exhaust"):
+            return None  # injected arena exhaustion: caller falls back to
+            # the eviction-capable create path
         off = self._carve(block)
         if off is None:
             chunk = max(self.reservation_chunk_bytes, block)
@@ -369,7 +398,12 @@ class SharedMemoryStore:
                     r.used += block
                 else:
                     if r is not None and r.size > r.used:
-                        self._release_chunk(r.off + r.used, r.size - r.used)
+                        if chaos.site("store.reserve.abandon"):
+                            pass  # crash window: old tail leaks until the
+                            # liveness sweep reclaims it
+                        else:
+                            self._release_chunk(r.off + r.used,
+                                                r.size - r.used)
                     self._rsv = None
                     out = ctypes.c_uint64()
                     rc = self._lib.store_reserve(self._base, chunk,
@@ -481,7 +515,8 @@ class SharedMemoryStore:
         a, c, n, e = (ctypes.c_uint64() for _ in range(4))
         self._lib.store_stats(self._base, *(ctypes.byref(x) for x in (a, c, n, e)))
         return {"allocated": a.value, "capacity": c.value,
-                "num_objects": n.value, "num_evictions": e.value}
+                "num_objects": n.value, "num_evictions": e.value,
+                "rsv_unused": int(self._lib.store_rsv_unused(self._base))}
 
     # -- tagged-value interface (language-neutral arena objects) --
     #

@@ -45,8 +45,10 @@ import struct
 import threading
 import time as _time
 
+from ray_tpu.core import chaos
 from ray_tpu.core import task_events as _task_events
 from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.retry import Backoff
 
 _SIZES = struct.Struct("<QQ")
 
@@ -345,17 +347,18 @@ def _pull_once(store, s, oid: bytes, unsealed_wait_s: float,
     start = time.monotonic()
     unsealed_deadline = start + unsealed_wait_s
     absent_deadline = start + absent_wait_s
-    delay = 0.001
+    # Status-2/absent polling rides the shared jittered policy
+    # (core/retry.py) instead of the old hand-rolled constants.
+    bo = Backoff(base_s=0.001, cap_s=0.05)
     while True:
         s.sendall(oid)
         ok = _recv_exact(s, 1)
         now = time.monotonic()
-        if ok == b"\x02" and now < unsealed_deadline:
-            time.sleep(0.05)
-            continue
-        if ok == b"\x00" and now < absent_deadline:
-            time.sleep(delay)
-            delay = min(delay * 2, 0.025)
+        if ((ok == b"\x02" and now < unsealed_deadline)
+                or (ok == b"\x00" and now < absent_deadline)):
+            time.sleep(min(bo.next_interval(),
+                           max(0.0, (unsealed_deadline if ok == b"\x02"
+                                     else absent_deadline) - now)))
             continue
         break
     if ok in (b"\x00", b"\x02"):
@@ -403,17 +406,16 @@ def _recv_range_header(s, oid: bytes, unsealed_wait_s: float,
     start = time.monotonic()
     unsealed_deadline = start + unsealed_wait_s
     absent_deadline = start + absent_wait_s
-    delay = 0.001
+    bo = Backoff(base_s=0.001, cap_s=0.05)
     while True:
         s.sendall(RANGE_MAGIC + oid + _RANGE_REQ.pack(0, length))
         ok = _recv_exact(s, 1)
         now = time.monotonic()
-        if ok == b"\x02" and now < unsealed_deadline:
-            time.sleep(0.05)
-            continue
-        if ok == b"\x00" and now < absent_deadline:
-            time.sleep(delay)
-            delay = min(delay * 2, 0.025)
+        if ((ok == b"\x02" and now < unsealed_deadline)
+                or (ok == b"\x00" and now < absent_deadline)):
+            time.sleep(min(bo.next_interval(),
+                           max(0.0, (unsealed_deadline if ok == b"\x02"
+                                     else absent_deadline) - now)))
             continue
         break
     if ok in (b"\x00", b"\x02"):
@@ -432,25 +434,38 @@ def _recv_range_header(s, oid: bytes, unsealed_wait_s: float,
     return b"\x01", data_size, meta_size, meta
 
 
+def _range_into(s, oid: bytes, offset: int, view) -> bool:
+    """Issue one range request on a connected socket and drain the slice
+    straight into `view`. True only when the full range landed and the
+    connection sits at a message boundary."""
+    if chaos.site("objxfer.range.reset"):
+        try:
+            s.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        return False  # injected mid-stripe stream death
+    s.sendall(RANGE_MAGIC + oid + _RANGE_REQ.pack(offset, len(view)))
+    rok = _recv_exact(s, 1)
+    if rok != b"\x01":
+        return False
+    sizes = _recv_exact(s, _SIZES.size)
+    if sizes is None:
+        return False
+    _dsz, msz = _SIZES.unpack(sizes)
+    if msz and _recv_exact(s, msz) is None:
+        return False
+    return _recv_into_exact(s, view)
+
+
 def _pull_range_worker(store, addr, oid: bytes, view, offset: int,
                        timeout: float, result: list, idx: int):
     """One extra stream of a striped pull: checkout a connection, pull
     [offset, offset+len(view)) straight into the buffer slice."""
     ok = False
     s = None
-    clean = False
     try:
         s, _reused = _conn_cache.checkout(addr, timeout)
-        s.sendall(RANGE_MAGIC + oid + _RANGE_REQ.pack(offset, len(view)))
-        rok = _recv_exact(s, 1)
-        if rok == b"\x01":
-            sizes = _recv_exact(s, _SIZES.size)
-            if sizes is not None:
-                _dsz, msz = _SIZES.unpack(sizes)
-                skip = _recv_exact(s, msz) if msz else b""
-                if skip is not None and _recv_into_exact(s, view):
-                    ok = True
-                    clean = True
+        ok = _range_into(s, oid, offset, view)
     except OSError:
         pass
     finally:
@@ -459,7 +474,7 @@ def _pull_range_worker(store, addr, oid: bytes, view, offset: int,
         except BufferError:
             pass
         if s is not None:
-            if clean:
+            if ok:
                 _conn_cache.checkin(addr, s)
             else:
                 try:
@@ -467,6 +482,85 @@ def _pull_range_worker(store, addr, oid: bytes, view, offset: int,
                 except OSError:
                     pass
     result[idx] = ok
+
+
+def _pull_range_fresh(store, addr, oid: bytes, buf, pos: int, ln: int,
+                      timeout: float) -> bool:
+    """Recovery path: re-pull ONE failed range on a brand-new dial (the
+    per-addr cache may be poisoned by whatever killed the stream). The
+    fresh connection is cached on success — it is the healthiest link we
+    have to this peer."""
+    view = buf.data[pos : pos + ln]
+    s = None
+    ok = False
+    try:
+        try:
+            s = socket.create_connection(tuple(addr), timeout=timeout)
+        except OSError:
+            return False
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        ok = _range_into(s, oid, pos, view)
+    except OSError:
+        ok = False
+    finally:
+        try:
+            view.release()
+        except BufferError:
+            pass
+        if s is not None:
+            if ok:
+                _conn_cache.checkin(addr, s)
+            else:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+    return ok
+
+
+# Striped-pull health per peer address: consecutive range-stream failures.
+# At `objxfer_stream_fail_limit` the client degrades that peer to
+# single-stream pulls until a striped pull completes clean — a peer whose
+# extra connections keep dying (conntrack limits, flaky NIC, an LB in the
+# path) stops paying the stripe setup tax just to fail it.
+_stripe_fails: dict = {}
+_stripe_lock = threading.Lock()
+
+
+def _stripe_fail_limit() -> int:
+    try:
+        from ray_tpu.core.config import get_config
+        return get_config().objxfer_stream_fail_limit
+    except Exception:  # noqa: BLE001 — config not importable
+        return 3
+
+
+def _note_stripe_result(addr, failures: int):
+    key = tuple(addr)
+    with _stripe_lock:
+        if failures:
+            _stripe_fails[key] = _stripe_fails.get(key, 0) + failures
+        else:
+            _stripe_fails.pop(key, None)
+
+
+def _stripes_degraded(addr) -> bool:
+    with _stripe_lock:
+        return _stripe_fails.get(tuple(addr), 0) >= _stripe_fail_limit()
+
+
+def _note_degraded_success(addr):
+    """A single-stream pull in degraded mode completed clean: decay the
+    failure count so striping is re-probed after `limit` clean pulls
+    (degrade must not be a one-way door — the flaky middlebox may have
+    been replaced)."""
+    key = tuple(addr)
+    with _stripe_lock:
+        n = _stripe_fails.get(key, 0)
+        if n > 1:
+            _stripe_fails[key] = n - 1
+        else:
+            _stripe_fails.pop(key, None)
 
 
 def _pull_striped(store, addr, s, oid: bytes, unsealed_wait_s: float,
@@ -484,6 +578,7 @@ def _pull_striped(store, addr, s, oid: bytes, unsealed_wait_s: float,
     if ok in (b"\x00", b"\x02"):
         return False, True  # answered, just not available
     got = min(first_len, data_size)
+    primary_clean = True  # False once the primary conn's own stripe fails
     buf = _create_for_write(store, oid, data_size, meta)
     if buf is None:
         # A concurrent pull won the race; drain OUR bytes off the stream
@@ -509,10 +604,12 @@ def _pull_striped(store, addr, s, oid: bytes, unsealed_wait_s: float,
             per = (rest + n - 1) // n
             threads = []
             results = [False] * n
+            ranges = []
             try:
                 pos = got
                 for i in range(n):
                     ln = min(per, data_size - pos)
+                    ranges.append((pos, ln))
                     view = buf.data[pos : pos + ln]
                     if i < n - 1:
                         t = threading.Thread(
@@ -524,19 +621,10 @@ def _pull_striped(store, addr, s, oid: bytes, unsealed_wait_s: float,
                     else:
                         # Last stripe rides THIS connection (open, warm).
                         try:
-                            s.sendall(RANGE_MAGIC + oid
-                                      + _RANGE_REQ.pack(pos, ln))
-                            rok = _recv_exact(s, 1)
-                            good = False
-                            if rok == b"\x01":
-                                sizes = _recv_exact(s, _SIZES.size)
-                                if sizes is not None:
-                                    _d, msz = _SIZES.unpack(sizes)
-                                    skip = (_recv_exact(s, msz) if msz
-                                            else b"")
-                                    good = (skip is not None
-                                            and _recv_into_exact(s, view))
-                            results[i] = good
+                            try:
+                                results[i] = _range_into(s, oid, pos, view)
+                            except OSError:
+                                results[i] = False
                         finally:
                             view.release()
                     pos += ln
@@ -545,15 +633,32 @@ def _pull_striped(store, addr, s, oid: bytes, unsealed_wait_s: float,
                 # recycle its arena space.
                 for t in threads:
                     t.join()
-            if not all(results):
-                buf.abort()
-                # primary conn is at a boundary only if ITS stripe worked
-                return False, results[-1]
+            primary_clean = results[-1]
+            n_failed = results.count(False)
+            if n_failed:
+                # Partial failure: a single dead stream no longer aborts
+                # the whole get. Re-pull ONLY the failed ranges, each on
+                # a fresh dial (sequential — this is the recovery path,
+                # not the fast path); give up only when a retry fails
+                # too. The per-addr health counter degrades chronically
+                # flaky peers to single-stream pulls.
+                for i, ok_i in enumerate(results):
+                    if ok_i:
+                        continue
+                    pos_i, ln_i = ranges[i]
+                    if not _pull_range_fresh(store, addr, oid, buf,
+                                             pos_i, ln_i, timeout):
+                        _note_stripe_result(addr, n_failed)
+                        buf.abort()
+                        # primary conn is at a boundary only if ITS
+                        # stripe worked
+                        return False, results[-1]
+            _note_stripe_result(addr, n_failed)
         buf.seal()
     except BaseException:
         buf.abort()
         raise
-    return True, True
+    return True, primary_clean
 
 
 def fetch_from_peer(store, addr, oid: bytes, timeout: float = 60.0,
@@ -575,6 +680,7 @@ def fetch_from_peer(store, addr, oid: bytes, timeout: float = 60.0,
     thousands of throwaway TCP connections per op."""
     if store.contains(ObjectID(oid)):
         return True
+    chaos.delay("objxfer.fetch.delay")
     tev = _task_events.ring()
     t0 = _time.time() if tev.enabled else 0.0
 
@@ -591,12 +697,20 @@ def fetch_from_peer(store, addr, oid: bytes, timeout: float = 60.0,
         stream_min = cfg.objxfer_stream_min_bytes
     except Exception:  # noqa: BLE001 — config not importable (bare tests)
         streams, stream_min = 1, 32 << 20
+    degraded = streams > 1 and _stripes_degraded(addr)
+    if degraded:
+        streams = 1  # chronic range-stream failures: single-stream mode
     for attempt in range(2):
         try:
             s, reused = _conn_cache.checkout(addr, timeout)
         except OSError:
             _span(False)
             return False
+        if chaos.site("objxfer.pull.reset"):
+            try:  # injected dead connection: the dirty-failure retry path
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
         clean = False
         try:
             if streams > 1:
@@ -617,6 +731,8 @@ def fetch_from_peer(store, addr, oid: bytes, timeout: float = 60.0,
                 except OSError:
                     pass
         if found or clean:
+            if found and degraded:
+                _note_degraded_success(addr)  # decay toward re-striping
             _span(found)
             return found
         if not reused:

@@ -31,7 +31,7 @@ import time
 import traceback
 import uuid
 
-from ray_tpu.core import serialization, task_events
+from ray_tpu.core import chaos, serialization, task_events
 from ray_tpu.core.config import Config, get_config, set_config
 from ray_tpu.core.ids import ActorID, ObjectID, WorkerID
 from ray_tpu.core.object_store import SharedMemoryStore, default_store_size
@@ -376,6 +376,11 @@ class NodeState:
         # agent owns per-worker dispatch, the head only debits node
         # resources and banks completions per batch. task_id -> spec.
         self.leases: dict[bytes, "TaskSpec"] = {}
+        # Grant timestamps + re-drive counts for the lease watchdog:
+        # task_id -> [sent_monotonic, redrives]. A node_exec frame lost on
+        # the wire (or dropped by chaos) would otherwise park its lease in
+        # `leases` forever while the agent sits idle.
+        self.lease_sent: dict[bytes, list] = {}
         # fn_ids whose blob this node's agent already caches.
         self.lease_fns: set[bytes] = set()
         # Agent-reported load view (versioned deltas riding heartbeats —
@@ -825,6 +830,9 @@ class Runtime:
         # Reservation refills make room through the spill machinery once
         # per EXTENT instead of a stats probe + spill pass per put.
         self.store.spill_hook = self._ensure_headroom
+        # Serializes the health loop's orphan-reservation sweep against
+        # shutdown()'s arena unmap (a sweep over freed shm segfaults).
+        self._store_close_lock = threading.Lock()
 
         # logical resources (parity: scheduling/resource_set.h)
         from ray_tpu.core.accelerators import detect_tpus
@@ -1311,11 +1319,17 @@ class Runtime:
     def _ensure_headroom(self, nbytes: int):
         """Spill-BEFORE-pressure: the arena's last-resort LRU eviction
         silently destroys owned objects, so every head-store write makes
-        room under the spill threshold first."""
+        room under the spill threshold first. Under pressure, dead
+        clients' stranded reservations are reclaimed BEFORE spilling live
+        objects to disk — leaked extents are free headroom."""
         stats = self.store.stats()
         cap = stats["capacity"] or 1
         limit = self.config.object_spill_threshold * cap
         if stats["allocated"] + nbytes > limit:
+            if self.store.reclaim_orphans() > 0:
+                stats = self.store.stats()
+                if stats["allocated"] + nbytes <= limit:
+                    return
             self._spill_bytes(int(stats["allocated"] + nbytes - limit)
                               + (4 << 20))
 
@@ -2070,6 +2084,8 @@ class Runtime:
     def _health_loop(self):
         period = self.config.health_check_period_ms / 1000.0
         deadline = period * self.config.health_check_failure_threshold
+        reclaim_every = self.config.orphan_reclaim_interval_s
+        last_reclaim = time.monotonic()
         while not self._shutdown:
             time.sleep(period)
             now = time.monotonic()
@@ -2077,6 +2093,64 @@ class Runtime:
                 if (node.conn is not None and node.state == "ALIVE"
                         and now - node.last_heartbeat > deadline):
                     self._on_node_death(node)
+                elif node.conn is not None and node.state == "ALIVE":
+                    self._redrive_lost_leases(node, now)
+            if (reclaim_every > 0
+                    and now - last_reclaim >= reclaim_every):
+                # Head-arena liveness sweep: reservations stranded by
+                # SIGKILLed head-node workers return to the free list.
+                # Under the close gate: shutdown() unmaps the arena, and
+                # a sweep dereferencing freed shm is a segfault, not an
+                # exception.
+                last_reclaim = now
+                with self._store_close_lock:
+                    if not self._shutdown:
+                        try:
+                            self.store.reclaim_orphans()
+                        except Exception:  # noqa: BLE001 — sweep must
+                            traceback.print_exc()  # not kill the loop
+
+    def _redrive_lost_leases(self, node: NodeState, now: float):
+        """Lease watchdog: a granted lease whose node_exec frame was lost
+        on the wire parks in node.leases forever while the agent idles.
+        When the agent reports ITSELF fully idle (no backlog, nothing in
+        flight) and a lease is older than lease_redrive_timeout_s, resend
+        the grant — the agent dedups (task_id, lease_seq), so a re-drive
+        racing a slow original delivery cannot double-queue."""
+        timeout = self.config.lease_redrive_timeout_s
+        if timeout <= 0 or not node.leases:
+            if not node.leases:
+                node.lease_sent.clear()
+            return
+        view = node.load_view
+        if view.get("backlog", 0) or view.get("inflight", 0):
+            return  # the agent is busy: its leases are simply running
+        resend = []
+        with self.lock:
+            for tid in list(node.lease_sent):
+                if tid not in node.leases:
+                    node.lease_sent.pop(tid, None)  # completed/moved
+                    continue
+                ent = node.lease_sent[tid]
+                if now - ent[0] < timeout or ent[1] >= 5:
+                    continue
+                ent[0] = now
+                ent[1] += 1
+                spec = node.leases[tid]
+                # Re-attach the blob unconditionally: the lost frame may
+                # have been the one carrying it (lease_fns was already
+                # credited at the original grant).
+                resend.append((spec.fn_id, self.fn_table.get(spec.fn_id),
+                               spec))
+        if not resend:
+            return
+        self.task_events.record(
+            resend[0][2].task_id, resend[0][2], "RETRY",
+            data={"redrive": "lease"})
+        try:
+            node.conn.send(("node_exec", resend))
+        except OSError:
+            pass  # node death handling owns the requeue
 
     def _handle_node_msg(self, conn: NodeConn, msg):
         op = msg[0]
@@ -3077,14 +3151,16 @@ class Runtime:
             self._send_seq_skip(spec)
 
     def _broadcast_actor_moved(self, actor_id: bytes):
-        """Poison cached direct-call locations for a dying/moving actor
-        on every head-node pooled worker (agents do the same for their
-        own workers; the caller-side UDS EOF is the belt, this the
-        braces)."""
+        """Poison cached direct-call locations for a dying/moving/
+        restarted actor on every pooled worker — head-node workers
+        directly, agent-node workers through their node relay (their
+        cached UDS paths and negative "head-hosted" entries both go
+        stale the moment the actor moves). The caller-side UDS EOF is
+        the belt, this the braces."""
         with self.lock:
             targets = [w for w in self.workers.values()
-                       if w.node_id == self.head_node_id
-                       and not getattr(w, "is_client", False)]
+                       if not getattr(w, "is_client", False)
+                       and getattr(w, "kind", "worker") == "worker"]
         for w in targets:
             try:
                 w.send(("actor_moved", actor_id))
@@ -4081,7 +4157,13 @@ class Runtime:
                 node_order.append(node)
             per_node[node].append((spec.fn_id, blob, spec))
         for node in node_order:
+            now = time.monotonic()
+            for _fid, _blob, spec in per_node[node]:
+                node.lease_sent[spec.task_id] = [now, 0]
             frame = ("node_exec", per_node[node])
+            if chaos.site("head.lease_grant.lose"):
+                continue  # injected grant loss: the lease watchdog in
+                # _health_loop re-drives it against an idle agent
             # On the listener thread, ride the drain-pass out-batch: a
             # synchronous sendall here would stall the whole control
             # plane whenever one agent's socket back-pressures (with N
@@ -4587,7 +4669,19 @@ class Runtime:
         msg = frames[0] if len(frames) == 1 else ("batch", frames)
         if defer_remote and isinstance(w, RemoteWorkerHandle):
             return msg
-        w.send(msg)
+        try:
+            w.send(msg)
+        except OSError:
+            # The worker died under this dispatch (chaos storms hit this
+            # window constantly: SIGKILL between idle-pop and send). The
+            # specs are already in w.assigned, so the death path replays
+            # them — force the socket to EOF so the listener notices NOW
+            # and owns recovery; raising here would kill whichever thread
+            # happened to be scheduling (observed: the listener itself).
+            try:
+                w.sock.shutdown(socket.SHUT_RDWR)
+            except (OSError, AttributeError):
+                pass
         return None
 
     def _pop_assignment(self, w: WorkerHandle, task_id: bytes):
@@ -4972,6 +5066,7 @@ class Runtime:
             return
         dead_worker = None
         with self.lock:
+            was_restart = st.state == A_RESTARTING
             if st.state == A_DEAD:
                 # Killed while starting up: do not resurrect; stop the worker
                 # (outside the lock — zygote kills round-trip).
@@ -4983,6 +5078,13 @@ class Runtime:
                 st.queued.clear()
         if st.state == A_ALIVE:
             self._export_actor(st, "ALIVE")
+            if was_restart:
+                # Restart landed (possibly on a new worker/node): poison
+                # every caller's cached direct-call location — including
+                # the NEGATIVE "head-hosted" entries callers latched while
+                # the actor was restarting, which would otherwise pin them
+                # to the slow head path (and any stale UDS path) forever.
+                self._broadcast_actor_moved(actor_id)
         if dead_worker is not None:
             dead_worker.kill()
         for spec in queued:
@@ -5447,8 +5549,12 @@ class Runtime:
             self.export_events.close()
         if self._log_monitor is not None:
             self._log_monitor.stop()
-        self.store.close()
-        self.store.unlink()
+        # Close gate: the health loop's orphan sweep walks the raw arena;
+        # unmapping under it is a segfault. _shutdown is already set, so
+        # once we hold the lock no further sweep can start.
+        with self._store_close_lock:
+            self.store.close()
+            self.store.unlink()
         # Worker peer sockets (`<arena>_w<id>.sock`) belong to worker
         # processes we may have just killed mid-unlink; sweep them so a
         # clean shutdown leaves /dev/shm empty.

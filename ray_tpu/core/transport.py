@@ -24,6 +24,8 @@ import struct
 import threading
 import time
 
+from ray_tpu.core import chaos
+
 _HDR = struct.Struct("<Q")
 
 
@@ -112,11 +114,20 @@ def enable_nodelay(sock: socket.socket):
         pass
 
 
-def dial(addr, timeout: float = 5.0) -> socket.socket:
+def dial(addr, timeout: float | None = None) -> socket.socket:
     """Connect a control channel to `addr` (host, port) with Nagle off —
     the one way every ctrl-plane dial (agent<->agent peer channels, the
     lease-spillback hop) should open a TCP link. Raises OSError on
-    failure; callers own their fallback policy."""
+    failure; callers own their fallback policy. The default timeout is
+    the `peer_dial_timeout_s` config knob."""
+    if timeout is None:
+        try:
+            from ray_tpu.core.config import get_config
+            timeout = get_config().peer_dial_timeout_s
+        except Exception:  # noqa: BLE001 — config not importable
+            timeout = 5.0
+    if chaos.site("transport.dial.fail"):
+        raise OSError("chaos: transport.dial.fail")
     sock = socket.create_connection(tuple(addr), timeout=timeout)
     enable_nodelay(sock)
     return sock
@@ -228,18 +239,41 @@ def _encode(msg) -> list:
     return parts
 
 
+def _chaos_trunc_send(sock: socket.socket, blob,
+                      lock: threading.Lock | None):
+    """transport.send.trunc fired: ship HALF the frame, then tear the
+    connection — the receiver sees a torn frame followed by EOF, exactly
+    the wire state a sender SIGKILLed mid-sendall leaves behind."""
+    ctx = lock if lock is not None else _NULL_CTX
+    with ctx:
+        try:
+            sock.sendall(bytes(blob[: max(1, len(blob) // 2)]))
+            sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+    raise ConnectionResetError("chaos: transport.send.trunc")
+
+
 def send_msg(sock: socket.socket, msg, lock: threading.Lock | None = None):
     op = msg[0] if isinstance(msg, tuple) and msg else ""
-    chaos = get_chaos()
-    chaos.maybe_delay(op)
-    if chaos.maybe_drop(op):
+    injector = get_chaos()
+    injector.maybe_delay(op)
+    if injector.maybe_drop(op):
         return
+    trunc = False
+    if chaos._armed is not None:
+        chaos.delay("transport.send.delay")
+        if chaos.site("transport.send.drop"):
+            return
+        trunc = chaos.site("transport.send.trunc")
     if op and _is_proto_op(op):
         from ray_tpu.core import proto_wire
         payload = proto_wire.to_wire(msg)
         if payload is not None:
             head = (_HDR.pack(len(payload))
                     + _NBUF.pack(_PROTO_FLAG) + payload)
+            if trunc:
+                _chaos_trunc_send(sock, head, lock)
             if lock:
                 with lock:
                     sock.sendall(head)
@@ -252,6 +286,8 @@ def send_msg(sock: socket.socket, msg, lock: threading.Lock | None = None):
     # second copy of large tensors.
     head = b"".join(p for p in parts if isinstance(p, bytes))
     bufs = [p for p in parts if not isinstance(p, bytes)]
+    if trunc:
+        _chaos_trunc_send(sock, head, lock)
     if lock:
         with lock:
             if bufs:
@@ -292,14 +328,18 @@ def send_many(sock: socket.socket, msgs: list,
             out.clear()
             pending = 0
 
-    chaos = get_chaos()
+    injector = get_chaos()
     ctx = lock if lock is not None else _NULL_CTX
     with ctx:
         for msg in msgs:
             op = msg[0] if isinstance(msg, tuple) and msg else ""
-            chaos.maybe_delay(op)
-            if chaos.maybe_drop(op):
+            injector.maybe_delay(op)
+            if injector.maybe_drop(op):
                 continue
+            if chaos._armed is not None:
+                chaos.delay("transport.send.delay")
+                if chaos.site("transport.send.drop"):
+                    continue
             if op and _is_proto_op(op):
                 from ray_tpu.core import proto_wire
                 payload = proto_wire.to_wire(msg)
@@ -334,6 +374,14 @@ _NULL_CTX = contextlib.nullcontext()
 
 def recv_msg(sock: socket.socket):
     """Blocking receive of one frame; returns None on clean EOF."""
+    if chaos._armed is not None:
+        chaos.delay("transport.recv.delay")
+        if chaos.site("transport.recv.reset"):
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            return None  # the EOF contract: caller runs its death path
     hdr = _recv_exact(sock, _HDR.size + _NBUF.size)
     if hdr is None:
         return None

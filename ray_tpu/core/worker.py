@@ -27,7 +27,7 @@ import traceback
 
 import cloudpickle
 
-from ray_tpu.core import serialization, task_events
+from ray_tpu.core import chaos, serialization, task_events
 from ray_tpu.core.config import Config, set_config, get_config
 from ray_tpu.core.ids import ObjectID, WorkerID
 from ray_tpu.core.object_store import SharedMemoryStore
@@ -747,6 +747,13 @@ class WorkerRuntime:
                 self.refcount.register_owned(ObjectID(rid))
                 self._direct_pending[rid] = False
         conn.inflight[spec.task_id] = spec
+        if chaos.site("worker.direct_call.reset"):
+            try:  # injected channel death under an outgoing call: the
+                # send below fails and EOF replay races it — exactly one
+                # of the two owns the fallback token
+                conn.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
         try:
             conn.send(("wexec", spec))
         except OSError:
@@ -832,11 +839,16 @@ class WorkerRuntime:
         with self._direct_lock:
             for rid in spec.return_ids:
                 self._direct_pending.pop(rid, None)
-        retryable = getattr(spec, "retries_left", 0) > 0
+        retryable = (spec.retries_left or 0) > 0
         try:
             if maybe_executed and not retryable:
                 self.send(("direct_fail", spec))
             else:
+                if maybe_executed:
+                    # The replay consumes retry budget (same contract as
+                    # the agent plane's _direct_fallback): a maybe-
+                    # executed call must not replay for free forever.
+                    spec.retries_left -= 1
                 self.send(("direct_actor_head", spec))
         except OSError:
             pass
@@ -2024,6 +2036,8 @@ def _worker_main(store_path: str, worker_id: WorkerID, fd: int):
             rt.cancelled_tasks.discard(spec.task_id)
             _reply_cancelled(rt, spec)
             continue
+        chaos.kill("worker.exec.kill")  # SIGKILL with the task accepted
+        # but un-replied: the head's worker-death replay owns recovery
         if getattr(spec, "num_tpus", 0):
             _ensure_accelerator_platform(spec.num_tpus)
         if spec.actor_id is not None:

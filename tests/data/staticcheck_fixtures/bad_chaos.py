@@ -1,0 +1,29 @@
+"""Seeded chaos_sites violations (one per rule). Never imported — parsed
+by tools/staticcheck/chaos_sites.py in fixture (--files) mode."""
+
+from ray_tpu.core import chaos  # noqa: F401 — fixture, never imported
+
+
+def hot_seam():
+    # chaos-site-unregistered: not in chaos.REGISTERED_SITES.
+    if chaos.site("not.a.registered.site"):
+        return
+    # chaos-site-dynamic: the registry cross-check cannot audit this.
+    name = "tran" + "sport.send.drop"
+    chaos.kill(name)
+
+
+def _direct_fallback(spec):
+    # recovery-swallow: broad + silent inside a pinned recovery scope.
+    try:
+        spec.replay()
+    except Exception:
+        pass
+
+
+def _on_peer_eof(conn):
+    # Clean twin inside a recovery scope: narrow catch, real action.
+    try:
+        conn.close()
+    except OSError:
+        conn.dead = True

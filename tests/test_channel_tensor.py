@@ -537,3 +537,91 @@ def test_objxfer_single_stream_path_unchanged(two_stores):
         cfgv["objxfer_streams"] = saved
         srv.stop()
         objxfer._conn_cache.clear()
+
+
+def test_objxfer_striped_pull_survives_range_stream_death(two_stores):
+    """Chaos kills one range stream mid-striped-pull: the failed range
+    re-pulls on a fresh dial and the object still lands bit-exact (a
+    single dead stream no longer aborts the whole get)."""
+    from ray_tpu.core import chaos, objxfer
+    from ray_tpu.core.config import get_config
+    from ray_tpu.core.ids import ObjectID
+    src, dst = two_stores
+    objxfer._conn_cache.clear()
+    objxfer._stripe_fails.clear()
+    cfgv = get_config()._values
+    saved = (cfgv["objxfer_streams"], cfgv["objxfer_stream_min_bytes"])
+    cfgv["objxfer_streams"], cfgv["objxfer_stream_min_bytes"] = 3, 1 << 20
+    data = np.random.default_rng(23).integers(
+        0, 255, 9 << 20, dtype=np.uint8)
+    oid = ObjectID.from_random()
+    src.put_serialized(oid, data)
+    srv = objxfer._start_python_peer_server(src, "127.0.0.1")
+    chaos.configure("objxfer.range.reset:1", seed=5)
+    try:
+        addr = ("127.0.0.1", srv.port)
+        assert objxfer.fetch_from_peer(dst, addr, oid.binary(),
+                                       timeout=30.0)
+        # the injected fault actually fired
+        assert chaos.snapshot()["objxfer.range.reset"][1] == 1
+        found, out = dst.get_deserialized(oid, timeout=0)
+        assert found and np.array_equal(out, data)
+        del out
+    finally:
+        chaos.configure("")
+        (cfgv["objxfer_streams"],
+         cfgv["objxfer_stream_min_bytes"]) = saved
+        srv.stop()
+        objxfer._conn_cache.clear()
+        objxfer._stripe_fails.clear()
+
+
+def test_objxfer_degrades_to_single_stream_after_repeated_failures(
+        two_stores, monkeypatch):
+    """After objxfer_stream_fail_limit range failures against one peer,
+    pulls degrade to single-stream (no striped path at all); clean
+    degraded pulls decay the counter back toward striping."""
+    from ray_tpu.core import objxfer
+    from ray_tpu.core.config import get_config
+    from ray_tpu.core.ids import ObjectID
+    src, dst = two_stores
+    objxfer._conn_cache.clear()
+    objxfer._stripe_fails.clear()
+    cfgv = get_config()._values
+    saved = (cfgv["objxfer_streams"], cfgv["objxfer_stream_min_bytes"])
+    cfgv["objxfer_streams"], cfgv["objxfer_stream_min_bytes"] = 3, 1 << 20
+    srv = objxfer._start_python_peer_server(src, "127.0.0.1")
+    try:
+        addr = ("127.0.0.1", srv.port)
+        limit = get_config().objxfer_stream_fail_limit
+        objxfer._note_stripe_result(addr, limit)
+        assert objxfer._stripes_degraded(addr)
+
+        def no_stripes(*a, **kw):
+            raise AssertionError("striped path used while degraded")
+
+        monkeypatch.setattr(objxfer, "_pull_striped", no_stripes)
+        oid = ObjectID.from_random()
+        src.put_serialized(oid, np.full(2 << 20, 7, np.uint8))
+        # degraded: the pull must take the single-stream path only
+        assert objxfer.fetch_from_peer(dst, addr, oid.binary(),
+                                       timeout=30.0)
+        # ...and its clean completion decays the counter below the limit,
+        # re-probing striping on the next large pull.
+        assert not objxfer._stripes_degraded(addr)
+        monkeypatch.undo()
+        oid2 = ObjectID.from_random()
+        data2 = np.random.default_rng(3).integers(0, 255, 4 << 20,
+                                                  dtype=np.uint8)
+        src.put_serialized(oid2, data2)
+        assert objxfer.fetch_from_peer(dst, addr, oid2.binary(),
+                                       timeout=30.0)
+        found, out = dst.get_deserialized(oid2, timeout=0)
+        assert found and np.array_equal(out, data2)
+        del out
+    finally:
+        (cfgv["objxfer_streams"],
+         cfgv["objxfer_stream_min_bytes"]) = saved
+        srv.stop()
+        objxfer._conn_cache.clear()
+        objxfer._stripe_fails.clear()

@@ -1,0 +1,327 @@
+"""Chaos plane: deterministic fault injection + crash-consistent recovery.
+
+Three layers:
+  (1) the injector itself — schedule grammar, per-site seeded
+      determinism (same seed => identical fire sequence), glob arming,
+      zero-overhead disarm, loud failure on a typo'd site;
+  (2) the crash windows the chaos sites exist for, driven directly —
+      SIGKILL between reserve and publish (the liveness sweep reclaims),
+      reservation abandonment, injected arena exhaustion mid-refill;
+  (3) chaos storms on a live runtime — seeded schedules over the real
+      task/data planes; every submitted ref must resolve (value or clean
+      TaskError) and store accounting must return to baseline.
+
+Own module so its clusters never share a fixture with test_cluster.py.
+"""
+
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core import chaos
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_store import SharedMemoryStore
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    chaos.configure("")
+
+
+# ---------------- (1) the injector ----------------
+
+
+def test_schedule_nth_hit_fires_exactly_once():
+    chaos.configure("transport.send.drop:3", seed=1)
+    fired = [chaos.site("transport.send.drop") for _ in range(10)]
+    assert fired == [False, False, True] + [False] * 7
+    assert chaos.snapshot()["transport.send.drop"] == (10, 1)
+
+
+def test_schedule_probability_is_seed_deterministic():
+    logs = []
+    for _ in range(2):
+        chaos.configure("transport.send.drop:0.3", seed=42)
+        for _i in range(200):
+            chaos.site("transport.send.drop")
+        logs.append(chaos.fire_log())
+    assert logs[0] == logs[1] and 20 < len(logs[0]) < 120
+    chaos.configure("transport.send.drop:0.3", seed=43)
+    for _i in range(200):
+        chaos.site("transport.send.drop")
+    assert chaos.fire_log() != logs[0]  # different seed, different storm
+
+
+def test_glob_arms_every_matching_site():
+    chaos.configure("transport.*:0.5", seed=0)
+    snap = chaos.snapshot()
+    assert {"transport.send.drop", "transport.send.trunc",
+            "transport.recv.reset", "transport.dial.fail"} <= set(snap)
+    assert "worker.exec.kill" not in snap
+
+
+def test_unknown_site_and_bad_spec_fail_loudly():
+    with pytest.raises(ValueError):
+        chaos.configure("no.such.site:1")
+    with pytest.raises(ValueError):
+        chaos.configure("transport.send.drop:1.5")
+    with pytest.raises(ValueError):
+        chaos.configure("transport.send.drop:0")
+    chaos.configure("transport.send.drop:1")
+    with pytest.raises(ValueError):
+        chaos.site("typo.site.name")  # armed mode audits names
+
+
+def test_disarmed_is_inert():
+    chaos.configure("")
+    assert not chaos.armed()
+    assert chaos.site("transport.send.drop") is False
+    assert chaos.snapshot() == {} and chaos.fire_log() == []
+
+
+def test_delay_site_sleeps_deterministically():
+    chaos.configure("transport.send.delay:1", seed=9)
+    t0 = time.monotonic()
+    chaos.delay("transport.send.delay", max_s=0.2)
+    first = time.monotonic() - t0
+    assert first <= 0.25
+    chaos.configure("transport.send.delay:1", seed=9)
+    t0 = time.monotonic()
+    chaos.delay("transport.send.delay", max_s=0.2)
+    assert abs((time.monotonic() - t0) - first) < 0.05  # same seeded draw
+
+
+# ---------------- the shared retry policy (core/retry.py) ----------------
+
+
+def test_backoff_caps_jitters_and_respects_deadline():
+    from ray_tpu.core.retry import Backoff
+    bo = Backoff(base_s=0.1, cap_s=0.4, jitter=0.25, deadline_s=60)
+    seq = [bo.next_interval() for _ in range(6)]
+    # capped exponential: nominal 0.1 0.2 0.4 0.4 ..., each +/-25%
+    for got, nominal in zip(seq, [0.1, 0.2, 0.4, 0.4, 0.4, 0.4]):
+        assert nominal * 0.74 <= got <= nominal * 1.26, (got, nominal)
+    bo.reset()
+    assert bo.next_interval() <= 0.1 * 1.26
+    # deadline: sleep() returns False once exhausted and never oversleeps
+    bo2 = Backoff(base_s=0.05, cap_s=0.05, jitter=0.0, deadline_s=0.12)
+    t0 = time.monotonic()
+    waits = []
+    while bo2.sleep():
+        waits.append(time.monotonic() - t0)
+    assert time.monotonic() - t0 < 0.5
+    assert not bo2.sleep()
+
+
+def test_call_with_backoff_retries_then_raises():
+    from ray_tpu.core.retry import call_with_backoff
+    attempts = []
+
+    def flaky():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert call_with_backoff(flaky, deadline_s=5.0, base_s=0.01,
+                             cap_s=0.02) == "ok"
+    assert len(attempts) == 3
+    with pytest.raises(ValueError):  # non-retryable propagates at once
+        call_with_backoff(lambda: (_ for _ in ()).throw(ValueError()),
+                          deadline_s=1.0, base_s=0.01)
+
+
+# ---------------- (2) crash windows, driven directly ----------------
+
+
+@pytest.fixture()
+def arena(tmp_path):
+    st = SharedMemoryStore(str(tmp_path / "arena"), size=64 << 20,
+                           num_slots=2048, create=True, num_shards=4)
+    st.reservation_min_bytes = 1 << 20
+    st.reservation_chunk_bytes = 8 << 20
+    yield st
+    st.close()
+    st.unlink()
+
+
+def _attach(path):
+    st = SharedMemoryStore(path)
+    st.reservation_min_bytes = 1 << 20
+    st.reservation_chunk_bytes = 8 << 20
+    return st
+
+
+def test_publish_kill_window_reclaimed_by_liveness_sweep(arena):
+    """Child dies by the store.publish.kill chaos site — between carving
+    a block and publishing it. The parent sweep returns every
+    unpublished byte, rsv_unused returns to baseline, and the space is
+    reusable."""
+    base = arena.stats()
+    pid = os.fork()
+    if pid == 0:  # child
+        try:
+            st = _attach(arena.path)
+            chaos.configure("store.publish.kill:1", seed=0)
+            st.put_serialized(ObjectID(b"K" * 16),
+                              np.zeros(2 << 20, np.uint8))
+        finally:
+            os._exit(7)  # only reached if the kill site failed to fire
+    _pid, status = os.waitpid(pid, 0)
+    assert os.WIFSIGNALED(status) and os.WTERMSIG(status) == signal.SIGKILL
+    assert arena.stats()["rsv_unused"] > 0  # the stranded extent
+    assert arena.reclaim_orphans() > 0
+    after = arena.stats()
+    assert after["rsv_unused"] == 0
+    assert after["allocated"] == base["allocated"]
+    big = arena.create(ObjectID(b"C" * 16), 48 << 20)  # space is back
+    big.seal()
+    arena.delete(ObjectID(b"C" * 16))
+
+
+def test_reservation_abandonment_reclaimed_after_owner_exit(arena):
+    """The store.reserve.abandon site makes release_reservation leak its
+    tail (the SIGKILL-shaped bookkeeping loss). Once the owner process
+    exits, the sweep repairs the arena."""
+    pid = os.fork()
+    if pid == 0:
+        rc = 1
+        try:
+            st = _attach(arena.path)
+            chaos.configure("store.reserve.abandon:1", seed=0)
+            st.put_serialized(ObjectID(b"V" * 16),
+                              np.zeros(2 << 20, np.uint8))
+            st.release_reservation()  # abandoned: tail leaks
+            rc = 0
+        finally:
+            os._exit(rc)
+    _pid, status = os.waitpid(pid, 0)
+    assert os.WEXITSTATUS(status) == 0
+    assert arena.stats()["rsv_unused"] > 0
+    assert arena.reclaim_orphans() > 0
+    assert arena.stats()["rsv_unused"] == 0
+    # the published object survived the sweep
+    assert arena.contains(ObjectID(b"V" * 16))
+
+
+def test_sweep_never_touches_live_owners(arena):
+    """A LIVE client mid-reservation is not an orphan: the sweep must
+    leave its extent alone (pid-liveness is the gate)."""
+    buf = arena._reserved_create(ObjectID(b"L" * 16), 2 << 20, b"")
+    assert buf is not None
+    parked = arena.stats()["rsv_unused"]
+    assert parked > 0
+    assert arena.reclaim_orphans() == 0  # own pid: skipped
+    assert arena.stats()["rsv_unused"] == parked
+    buf.seal()
+    arena.release_reservation()
+    assert arena.stats()["rsv_unused"] == 0
+
+
+def test_injected_arena_exhaustion_falls_back_to_create(arena):
+    """store.reserve.exhaust makes the reservation plane report a full
+    arena: puts must degrade to the eviction-capable create path and
+    still succeed, bit-exact."""
+    chaos.configure("store.reserve.exhaust:0.5", seed=3)
+    vals = [np.full(2 << 20, i, np.uint8) for i in range(6)]
+    oids = [ObjectID.from_random() for _ in vals]
+    for oid, v in zip(oids, vals):
+        arena.put_serialized(oid, v)
+    hits, fires = chaos.snapshot()["store.reserve.exhaust"]
+    assert fires > 0, "exhaustion never injected — test proves nothing"
+    for oid, v in zip(oids, vals):
+        found, got = arena.get_deserialized(oid, timeout=0)
+        assert found and np.array_equal(got, v)
+        del got
+
+
+# ---------------- (3) chaos storms on a live runtime ----------------
+
+
+def test_storm_send_delays_and_worker_kills_all_refs_resolve():
+    """Seeded storm over a live head: jittered frame delays on every
+    send plus workers SIGKILLed mid-storm (the Nth execution in each
+    worker process — every respawned worker dies again). The survival
+    contract is the ISSUE's acceptance wording: every submitted ref
+    RESOLVES, to its value or to a clean typed error once its retry
+    budget is honestly exhausted (a task can be the Nth exec on four
+    successive workers) — never a hang, never an untyped blowup — and
+    the arena's reservation accounting returns to baseline."""
+    from ray_tpu.core.status import RayTpuError
+    rt = ray_tpu.init(num_cpus=2, _system_config={
+        "chaos_schedule": "transport.send.delay:0.02,worker.exec.kill:4",
+        "chaos_seed": 1234,
+    })
+    try:
+        @ray_tpu.remote(num_cpus=1, max_retries=3)
+        def bump(i):
+            return i * 3
+
+        refs = [bump.remote(i) for i in range(24)]
+        values, errors = 0, 0
+        for i, ref in enumerate(refs):
+            try:
+                assert ray_tpu.get(ref, timeout=180) == i * 3
+                values += 1
+            except RayTpuError:  # retries exhausted: clean, typed
+                errors += 1
+        assert values + errors == 24
+        assert values >= 16, (values, errors)  # the storm must not win
+        rt.store.reclaim_orphans()
+        stats = rt.store.stats()
+        assert stats["rsv_unused"] == 0, stats
+    finally:
+        ray_tpu.shutdown()
+        chaos.configure("")
+
+
+def test_storm_fixed_seed_reproduces_infection_sequence():
+    """Same seed + same (single-threaded) site sequence => identical
+    fire log — the acceptance criterion that makes storms replayable."""
+    seq = (["transport.send.drop"] * 50 + ["transport.recv.reset"] * 30
+           + ["transport.send.drop"] * 50)
+    logs = []
+    for _ in range(2):
+        chaos.configure("transport.send.drop:0.2,transport.recv.reset:0.2",
+                        seed=77)
+        for name in seq:
+            chaos.site(name)
+        logs.append(chaos.fire_log())
+    assert logs[0] == logs[1] and logs[0]
+
+
+def test_head_lease_grant_loss_is_redriven():
+    """Drop the head's first node_exec lease batch on the wire: the
+    lease watchdog re-drives it once the agent reports itself idle, and
+    every task still resolves (no wedged leases, no duplicates)."""
+    from ray_tpu.cluster_utils import Cluster
+    c = Cluster(initialize_head=True, head_node_args={
+        "num_cpus": 0,
+        "_system_config": {
+            "chaos_schedule": "head.lease_grant.lose:1",
+            "chaos_seed": 7,
+            "lease_redrive_timeout_s": 1.0,
+        }})
+    c.add_node(num_cpus=2)
+    c.wait_for_nodes(2)
+    try:
+        @ray_tpu.remote(num_cpus=1)
+        def double(i):
+            return i * 2
+
+        t0 = time.monotonic()
+        refs = [double.remote(i) for i in range(6)]
+        out = ray_tpu.get(refs, timeout=120)
+        assert sorted(out) == [i * 2 for i in range(6)]
+        fired = chaos.snapshot().get("head.lease_grant.lose", (0, 0))[1]
+        if fired:  # the drop happened in THIS (head) process
+            # recovery cost at least one redrive period
+            assert time.monotonic() - t0 >= 0.8
+    finally:
+        c.shutdown()
+        chaos.configure("")

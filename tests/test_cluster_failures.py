@@ -306,3 +306,118 @@ def test_direct_actor_call_survives_peer_death():
         assert out == [i * 10 for i in range(25)]
     finally:
         c.shutdown()
+
+
+def test_chaos_agent_sigkill_mid_lease_storm():
+    """Seeded chaos SIGKILLs the agents (Nth heartbeat tick) while a
+    retryable task storm runs with lease spillback armed and spill
+    notices randomly dropped: node-death detection requeues the leases
+    and every ref still resolves on the surviving head workers."""
+    from ray_tpu.core import chaos
+    c = Cluster(initialize_head=True, head_node_args={
+        "num_cpus": 2,
+        "_system_config": {
+            "chaos_schedule": ("agent.sigkill:2,"
+                               "agent.spill_notice.lose:0.5"),
+            "chaos_seed": 99,
+            # fast node-death detection keeps the storm's wall short
+            "health_check_period_ms": 300,
+        }})
+    c.add_node(num_cpus=2)
+    c.add_node(num_cpus=2)
+    c.wait_for_nodes(3)
+    try:
+        @ray_tpu.remote(num_cpus=1, max_retries=3)
+        def work(i):
+            time.sleep(0.05)
+            return i + 100
+
+        refs = [work.remote(i) for i in range(20)]
+        out = ray_tpu.get(refs, timeout=240)
+        assert out == [i + 100 for i in range(20)]
+    finally:
+        c.shutdown()
+        chaos.configure("")
+
+
+def test_chaos_direct_call_reset_exactly_once_nonretryable():
+    """The direct worker<->worker UDS channel resets under an outgoing
+    call to a NON-retryable actor: every call must resolve to its value
+    or a clean error, and no key may ever execute twice (the
+    maybe-executed ambiguity must never replay at-most-once calls)."""
+    from ray_tpu.core import chaos
+    rt = ray_tpu.init(num_cpus=3, _system_config={
+        "chaos_schedule": "worker.direct_call.reset:3",
+        "chaos_seed": 5,
+    })
+    try:
+        @ray_tpu.remote(num_cpus=1)
+        class Counter:
+            def __init__(self):
+                self.counts = {}
+
+            def incr(self, key):
+                self.counts[key] = self.counts.get(key, 0) + 1
+                return key
+
+            def snapshot(self):
+                return dict(self.counts)
+
+        @ray_tpu.remote(num_cpus=1)
+        def caller(h, n):
+            results = []
+            for i in range(n):
+                try:
+                    results.append(("ok", ray_tpu.get(h.incr.remote(i),
+                                                      timeout=60)))
+                except Exception as e:  # noqa: BLE001 — clean error ok
+                    results.append(("err", type(e).__name__))
+            return results
+
+        a = Counter.remote()
+        ray_tpu.get(a.snapshot.remote(), timeout=60)
+        results = ray_tpu.get(caller.remote(a, 10), timeout=180)
+        assert len(results) == 10
+        counts = ray_tpu.get(a.snapshot.remote(), timeout=60)
+        # exactly-once: nothing double-executed, with or without the
+        # channel reset in the middle
+        assert all(v == 1 for v in counts.values()), counts
+        for i, (status, payload) in enumerate(results):
+            if status == "ok":
+                assert payload == i
+            else:  # the chaos'd call: failed CLEANLY, and never ran twice
+                assert counts.get(i, 0) <= 1
+    finally:
+        ray_tpu.shutdown()
+        chaos.configure("")
+
+
+def test_chaos_arena_exhaustion_mid_refill_storm():
+    """store.reserve.exhaust randomly fails reservation refills under a
+    large-result storm: every put falls back to the evicting create
+    path, every ref resolves bit-exact, and reservation accounting
+    returns to baseline."""
+    from ray_tpu.core import chaos
+    rt = ray_tpu.init(num_cpus=2, object_store_memory=256 << 20,
+                      _system_config={
+                          "chaos_schedule": "store.reserve.exhaust:0.3",
+                          "chaos_seed": 21,
+                      })
+    try:
+        @ray_tpu.remote(num_cpus=1, max_retries=2)
+        def big(i):
+            return np.full(5 << 20, i % 251, dtype=np.uint8)
+
+        refs = [big.remote(i) for i in range(8)]
+        for i, ref in enumerate(refs):
+            val = ray_tpu.get(ref, timeout=120)
+            assert val.shape == (5 << 20,) and int(val[0]) == i % 251
+            del val
+        # No ORPHANED bytes: whatever rsv_unused still reports belongs to
+        # live pooled workers' parked reservation tails (legitimate
+        # headroom, returned at worker exit), not to dead clients.
+        assert rt.store.reclaim_orphans() == 0
+        assert rt.store.stats()["rsv_unused"] < rt.store.size
+    finally:
+        ray_tpu.shutdown()
+        chaos.configure("")

@@ -50,7 +50,8 @@ def test_cli_exits_zero_on_repo_and_nonzero_on_fixture():
         [sys.executable, "-m", "tools.staticcheck"], cwd=REPO, env=env,
         capture_output=True, text=True, timeout=300)
     assert r.returncode == 0, r.stdout + r.stderr
-    for fixture in ("bad_concurrency", "bad_hotplane", "bad_resources"):
+    for fixture in ("bad_concurrency", "bad_hotplane", "bad_resources",
+                    "bad_chaos"):
         r = subprocess.run(
             [sys.executable, "-m", "tools.staticcheck", "--no-baseline",
              "--files", f"{FIX}/{fixture}.py"],
@@ -107,6 +108,34 @@ def test_resources_detects_each_seeded_rule():
     assert _rules(fs) == {"fd-inline-arg", "fd-no-closer",
                           "fd-use-unguarded", "unjoined-thread"}, [
         f.render() for f in fs]
+
+
+def test_chaos_sites_detects_each_seeded_rule():
+    from tools.staticcheck import chaos_sites
+    fs = chaos_sites.run(REPO, targets=(f"{FIX}/bad_chaos.py",))
+    assert _rules(fs) == {"chaos-site-unregistered", "chaos-site-dynamic",
+                          "recovery-swallow"}, [f.render() for f in fs]
+    # Exactly one recovery-swallow: the narrow-catch twin in _on_peer_eof
+    # must not fire.
+    assert sum(1 for f in fs if f.rule == "recovery-swallow") == 1
+
+
+def test_chaos_sites_registry_both_ways():
+    """Repo mode: every source seam registered AND every registered site
+    present in the source — the both-ways drift contract. An UNUSED
+    registered site must fire when the registry gains a phantom entry."""
+    from ray_tpu.core import chaos as chaos_mod
+    from tools.staticcheck import chaos_sites
+    assert chaos_sites.run(REPO) == []
+    phantom = "phantom.site.never.used"
+    chaos_mod.REGISTERED_SITES[phantom] = "fixture phantom"
+    try:
+        fs = chaos_sites.run(REPO)
+        assert any(f.rule == "chaos-site-unused"
+                   and phantom in f.detail for f in fs), [
+            f.render() for f in fs]
+    finally:
+        del chaos_mod.REGISTERED_SITES[phantom]
 
 
 def test_clean_twins_produce_no_findings():

@@ -55,18 +55,20 @@ def repo_root() -> str:
         os.path.dirname(os.path.abspath(__file__))))
 
 
-PASSES = ("wire_drift", "concurrency", "hot_plane", "resources")
+PASSES = ("wire_drift", "concurrency", "hot_plane", "resources",
+          "chaos_sites")
 
 
 def run_passes(root: str | None = None,
                passes: tuple = PASSES) -> list[Finding]:
     """Run the requested passes over the repo; returns raw findings
     (baseline not applied — see baseline.diff_against_baseline)."""
-    from tools.staticcheck import (concurrency, hot_plane, resources,
-                                   wire_drift)
+    from tools.staticcheck import (chaos_sites, concurrency, hot_plane,
+                                   resources, wire_drift)
     root = root or repo_root()
     mods = {"wire_drift": wire_drift, "concurrency": concurrency,
-            "hot_plane": hot_plane, "resources": resources}
+            "hot_plane": hot_plane, "resources": resources,
+            "chaos_sites": chaos_sites}
     findings: list[Finding] = []
     for name in passes:
         findings.extend(mods[name].run(root))

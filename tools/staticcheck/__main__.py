@@ -72,7 +72,8 @@ def main(argv=None) -> int:
 
 
 def _run_on_files(root: str, passes: tuple, files: tuple) -> list:
-    from tools.staticcheck import concurrency, hot_plane, resources
+    from tools.staticcheck import (chaos_sites, concurrency, hot_plane,
+                                   resources)
     rels = tuple(os.path.relpath(os.path.abspath(f), root) for f in files)
     findings = []
     if "concurrency" in passes:
@@ -81,6 +82,8 @@ def _run_on_files(root: str, passes: tuple, files: tuple) -> list:
         findings += resources.run(root, targets=rels)
     if "hot_plane" in passes:
         findings += hot_plane.run(root, scopes={r: None for r in rels})
+    if "chaos_sites" in passes:
+        findings += chaos_sites.run(root, targets=rels)
     return findings
 
 

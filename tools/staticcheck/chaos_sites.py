@@ -1,0 +1,181 @@
+"""Pass 5 — chaos-site registry drift + recovery-path exception hygiene.
+
+The chaos plane (ray_tpu/core/chaos.py) is convention-coupled in two
+directions: every `chaos.site("name")` / `chaos.kill(...)` /
+`chaos.delay(...)` literal in the source must name a registered site (a
+typo'd site silently never fires — the storm "passes" by testing
+nothing), and every REGISTERED_SITES entry must still have a seam in the
+source (a site whose seam was refactored away keeps appearing in
+schedules and docs while injecting nothing). This pass checks both
+directions, the same shape as wire_drift's both-ways pinned tables.
+
+Second family: recovery paths. The functions that HANDLE injected faults
+(fallbacks, reconnects, reclaim sweeps — the RECOVERY_SCOPES table) must
+not swallow errors blind: a bare `except:` or a broad
+`except (Base)Exception:` whose body is only pass/continue turns a
+recovery bug into silence exactly where the chaos suite is trying to
+look. Narrow catches (`except OSError: pass` on an already-dead channel)
+are fine; broad-and-silent is the anti-pattern. `# staticcheck: ok
+<rule>` suppresses intentional sites, as everywhere else.
+
+  chaos-site-unregistered  source literal not in REGISTERED_SITES
+  chaos-site-unused        REGISTERED_SITES entry with no source seam
+  chaos-site-dynamic       non-literal site name (unauditable)
+  recovery-swallow         bare/broad silent except inside a recovery fn
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+
+from tools.staticcheck import Finding
+from tools.staticcheck.concurrency import suppressed
+
+TARGET_GLOBS = ("ray_tpu/core/*.py", "ray_tpu/experimental/channel.py")
+
+_CHAOS_FNS = {"site", "kill", "delay"}
+
+# (repo-relative path, function name) pairs whose bodies are recovery
+# paths — the code that must turn an injected fault into a clean outcome.
+# Scanned for the recovery-swallow rule; a scope that no longer exists is
+# itself a finding (the recovery path was refactored away unreviewed).
+RECOVERY_SCOPES: tuple = (
+    ("ray_tpu/core/worker.py", "_direct_fallback"),
+    ("ray_tpu/core/worker.py", "_on_wpeer_eof"),
+    ("ray_tpu/core/node_agent.py", "_direct_fallback"),
+    ("ray_tpu/core/node_agent.py", "_on_peer_eof"),
+    ("ray_tpu/core/node_agent.py", "_reconnect_or_die"),
+    ("ray_tpu/core/node_agent.py", "_spill_to_peer"),
+    ("ray_tpu/core/node_agent.py", "_on_lease_spill"),
+    ("ray_tpu/core/objxfer.py", "_pull_striped"),
+    ("ray_tpu/core/objxfer.py", "_pull_range_fresh"),
+    ("ray_tpu/core/objxfer.py", "fetch_from_peer"),
+    ("ray_tpu/core/runtime.py", "_redrive_lost_leases"),
+    ("ray_tpu/core/runtime.py", "_on_actor_worker_death"),
+    ("ray_tpu/core/object_store.py", "release_reservation"),
+    ("ray_tpu/core/object_store.py", "reclaim_orphans"),
+)
+_RECOVERY_FN_NAMES = {name for _p, name in RECOVERY_SCOPES}
+
+
+def _registered_sites() -> dict:
+    from ray_tpu.core.chaos import REGISTERED_SITES
+    return REGISTERED_SITES
+
+
+def _iter_files(root: str, targets: tuple | None):
+    if targets:
+        for rel in targets:
+            yield rel, (rel if os.path.isabs(rel)
+                        else os.path.join(root, rel))
+        return
+    for pat in TARGET_GLOBS:
+        for p in sorted(glob.glob(os.path.join(root, pat))):
+            yield os.path.relpath(p, root), p
+
+
+def _is_chaos_call(node: ast.Call) -> str | None:
+    """'site'/'kill'/'delay' when the call is chaos.<fn>(...), else None."""
+    f = node.func
+    if (isinstance(f, ast.Attribute) and f.attr in _CHAOS_FNS
+            and isinstance(f.value, ast.Name)
+            and f.value.id in ("chaos", "_chaos_mod")):
+        return f.attr
+    return None
+
+
+def _handler_is_silent(handler: ast.ExceptHandler) -> bool:
+    """Body is nothing but pass/continue (no re-raise, no logging, no
+    fallback action)."""
+    for stmt in handler.body:
+        if not isinstance(stmt, (ast.Pass, ast.Continue)):
+            return False
+    return True
+
+
+def _handler_is_broad(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True  # bare except
+    names = []
+    t = handler.type
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    for e in elts:
+        if isinstance(e, ast.Name):
+            names.append(e.id)
+        elif isinstance(e, ast.Attribute):
+            names.append(e.attr)
+    return any(n in ("Exception", "BaseException") for n in names)
+
+
+def run(root: str, targets: tuple | None = None) -> list:
+    findings: list[Finding] = []
+    sites = _registered_sites()
+    used: dict[str, tuple] = {}  # site -> (rel, line) first use
+    scopes_seen: set = set()
+
+    for rel, path in _iter_files(root, targets):
+        rel_key = rel if not os.path.isabs(rel) else os.path.basename(rel)
+        try:
+            with open(path) as f:
+                src = f.read()
+        except OSError:
+            continue
+        lines = src.splitlines()
+        tree = ast.parse(src, filename=path)
+
+        def emit(rule, line, detail):
+            if not suppressed(lines, line, rule):
+                findings.append(Finding(rule, rel_key, line, detail))
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                kind = _is_chaos_call(node)
+                if kind is None or not node.args:
+                    continue
+                arg = node.args[0]
+                if not (isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)):
+                    emit("chaos-site-dynamic", node.lineno,
+                         f"chaos.{kind}(...) with a non-literal site name "
+                         "— the registry cross-check cannot audit it")
+                    continue
+                name = arg.value
+                used.setdefault(name, (rel_key, node.lineno))
+                if name not in sites:
+                    emit("chaos-site-unregistered", node.lineno,
+                         f"chaos.{kind}({name!r}) is not in "
+                         "chaos.REGISTERED_SITES — it can never be armed")
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                in_repo_scope = (rel_key, node.name) in {
+                    (p, n) for p, n in RECOVERY_SCOPES}
+                in_fixture_scope = (targets is not None
+                                    and node.name in _RECOVERY_FN_NAMES)
+                if not (in_repo_scope or in_fixture_scope):
+                    continue
+                scopes_seen.add((rel_key, node.name))
+                for sub in ast.walk(node):
+                    if not isinstance(sub, ast.ExceptHandler):
+                        continue
+                    if _handler_is_broad(sub) and _handler_is_silent(sub):
+                        emit("recovery-swallow", sub.lineno,
+                             f"broad silent except in recovery path "
+                             f"{node.name}: an injected fault's recovery "
+                             "bug disappears here")
+
+    if targets is None:
+        for name in sites:
+            if name not in used:
+                findings.append(Finding(
+                    "chaos-site-unused", "ray_tpu/core/chaos.py", 0,
+                    f"registered chaos site {name!r} has no "
+                    "chaos.site/kill/delay seam in the source"))
+        for pair in RECOVERY_SCOPES:
+            if pair not in scopes_seen:
+                findings.append(Finding(
+                    "recovery-swallow", pair[0], 0,
+                    f"pinned recovery scope {pair[1]!r} no longer exists "
+                    "in {0}; update RECOVERY_SCOPES after reviewing the "
+                    "refactor".format(pair[0])))
+    return findings
