@@ -144,6 +144,7 @@ def final_line(status: str = "complete"):
         "task_events": EXTRAS.get("task_events", {}),
         "cross_language": EXTRAS.get("cross_language", {}),
         "chaos_storm": EXTRAS.get("chaos_storm", {}),
+        "elastic_train": EXTRAS.get("elastic_train", {}),
         "tpu_mfu_pct": mfu,
         "tpu": TPU,
         "detail": {k: round(v, 1) for k, v in RESULTS.items()},
@@ -185,6 +186,12 @@ def final_line(status: str = "complete"):
         # Robustness headline: storm throughput as a fraction of the
         # clean run under the fixed-seed 1% fault schedule.
         "chaos_x": EXTRAS.get("chaos_storm", {}).get("chaos_x"),
+        # Elastic train plane: seconds from mid-run worker SIGKILL to the
+        # first post-restart report, and the bit-stability verdict of the
+        # resumed loss trajectory (True = committed-manifest resume
+        # restored exactly the pre-death state).
+        "train_rec_s": EXTRAS.get("elastic_train", {}).get("recovery_s"),
+        "train_bit": EXTRAS.get("elastic_train", {}).get("bit_stable"),
         "tev_ovh_pct": EXTRAS.get("task_events", {}).get("overhead_pct"),
         "xlang_s": EXTRAS.get("cross_language", {}).get(
             "cpp_tasks_async_s"),
@@ -210,7 +217,8 @@ def final_line(status: str = "complete"):
     # oversize path — trim to the irreducible core instead of dying.
     if len(line) >= 2048:
         for key in ("host", "tpu_mfu_pct", "xlang_s", "tev_ovh_pct",
-                    "adag_x", "chaos_x", "n_skipped", "n_missing",
+                    "adag_x", "chaos_x", "train_bit", "train_rec_s",
+                    "n_skipped", "n_missing",
                     "n_metrics", "wall_s", "status", "mc_put_x",
                     "nn_async_x"):
             headline.pop(key, None)
@@ -942,6 +950,84 @@ ray_tpu.shutdown()
             "schedule": schedule, "seed": 42,
         }
 
+    def sec_elastic_train():
+        # Elastic training plane (ROADMAP item 3): the same deterministic
+        # 2-worker training run executed clean and with a seeded mid-run
+        # worker SIGKILL (chaos train.worker_kill). train_rec_s = wall
+        # time from the last pre-death report to the first post-restart
+        # report (death detection + gang respawn + committed-manifest
+        # resume); train_bit = the resumed loss trajectory is BIT-equal
+        # to the clean run's at every step (state is a pure function of
+        # step, so any divergence means the resume restored wrong state).
+        code = r"""
+import json, os, tempfile, time
+import ray_tpu
+from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+from ray_tpu.train.trainer import FailureConfig
+
+def loop(config):
+    import os as _os, time as _time
+    from ray_tpu.core import chaos as _chaos
+    from ray_tpu.train import session
+    rank = session.get_world_rank()
+    marker = _os.path.join(config["marker_dir"], "armed_%d" % rank)
+    if config["kill"] and rank == 1 and not _os.path.exists(marker):
+        open(marker, "w").close()
+        _chaos.configure("train.worker_kill:%d" % config["kill_at"],
+                         seed=7)
+    ckpt = session.get_checkpoint()
+    state, start = 1.0, 0
+    if ckpt:
+        d = ckpt.load_shard(rank)
+        state, start = d["state"], d["step"] + 1
+    for step in range(start, config["steps"]):
+        state = (state * 1.000003 + 0.000007) % 1.7
+        session.report({"step": step, "loss": abs(state - 0.5),
+                        "t": time.time()},
+                       checkpoint={"step": step, "state": state})
+        _time.sleep(0.03)  # a "step": lets commits land between reports
+
+rt = ray_tpu.init(num_cpus=4)
+tmp = tempfile.mkdtemp()
+mk = os.path.join(tmp, "markers")
+os.makedirs(mk, exist_ok=True)
+STEPS = 40
+
+def fit(kill, name):
+    t = JaxTrainer(
+        loop,
+        train_loop_config={"steps": STEPS, "marker_dir": mk,
+                           "kill": kill, "kill_at": 12},
+        scaling_config=ScalingConfig(num_workers=2, min_workers=1),
+        run_config=RunConfig(name=name, storage_path=tmp,
+                             failure_config=FailureConfig(max_failures=2)))
+    return t.fit()
+
+ref = fit(False, "ref")
+assert ref.error is None, ref.error
+chaotic = fit(True, "chaos")
+assert chaotic.error is None, chaotic.error
+assert chaotic.metrics_history[-1]["step"] == STEPS - 1
+ts = [m["t"] for m in chaotic.metrics_history]
+rec = max(b - a for a, b in zip(ts, ts[1:]))
+ref_by_step = {m["step"]: m["loss"] for m in ref.metrics_history}
+ch_by_step = {}
+for m in chaotic.metrics_history:
+    ch_by_step[m["step"]] = m["loss"]  # re-run steps: resumed wins
+bit = all(ch_by_step[s] == ref_by_step[s] for s in ch_by_step)
+print("ELASTIC_RES", json.dumps(
+    {"recovery_s": round(rec, 2), "bit_stable": bool(bit)}))
+ray_tpu.shutdown()
+"""
+        out = run_sub(code, timeout=120, tag="elastic_train")
+        res = json.loads([ln for ln in out.splitlines()
+                          if ln.startswith("ELASTIC_RES")][0][12:])
+        EXTRAS["elastic_train"] = {
+            "recovery_s": res["recovery_s"],
+            "bit_stable": res["bit_stable"],
+            "kill": "train.worker_kill:12 (rank 1, seeded)",
+        }
+
     sections = [
         ("tasks", 120, sec_tasks),
         ("actors", 150, sec_actors),
@@ -952,6 +1038,7 @@ ray_tpu.shutdown()
         ("pg", 90, sec_pg),
         ("client", 90, sec_client),
         ("chaos", 150, sec_chaos),
+        ("elastic_train", 60, sec_elastic_train),
         ("many_agents", 180, sec_many_agents),
     ]
     # Resilience-test hooks: a section that hangs forever and one that

@@ -70,6 +70,17 @@ REGISTERED_SITES: dict[str, str] = {
                         "task",
     "worker.direct_call.reset": "the direct worker<->worker UDS channel "
                                 "resets under an outgoing call",
+    "train.worker_kill": "a train worker self-SIGKILLs mid-step (on a "
+                         "session.report — no ack, no shard durability)",
+    "train.ckpt_shard_abandon": "a rank writes its checkpoint shard but "
+                                "dies before acking durability, so the "
+                                "step's manifest can never commit",
+    "train.manifest_loss": "the controller's manifest commit for a "
+                           "fully-acked step is dropped (resume must come "
+                           "from the previous committed step)",
+    "train.poll_hang": "a train worker's poll() wedges without dying "
+                       "(the hung-not-dead worker the watchdog converts "
+                       "into a FailurePolicy restart)",
 }
 
 

@@ -221,6 +221,33 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
                                   "its extent otherwise). <=0 disables "
                                   "the periodic sweep (pressure-path "
                                   "sweeps still run)"),
+    # --- elastic training plane (train/trainer.py + train/checkpoint.py:
+    #     crash-consistent sharded checkpoints, gang re-mesh on worker
+    #     death; parity: Train FailureConfig/worker-group restart) ---
+    "train_poll_timeout_s": (float, 600.0, "controller-side deadline for "
+                             "one worker-group poll() round trip; a "
+                             "worker that is wedged-not-dead (poll never "
+                             "returns) is declared hung after this long "
+                             "and handled by the FailurePolicy instead "
+                             "of stalling the run"),
+    "train_progress_timeout_s": (float, 0.0, "hung-GANG watchdog: if NO "
+                                 "rank reports progress (a report or a "
+                                 "finish) for this long while polls still "
+                                 "answer, the group is declared hung and "
+                                 "restarted by the FailurePolicy. 0 "
+                                 "disables (polls answering + steps "
+                                 "legitimately slow is the common case)"),
+    "train_restart_wait_s": (float, 5.0, "elastic restart capacity-settle "
+                             "deadline: a gang restart waits up to this "
+                             "long (sleeping through the retry_backoff_* "
+                             "cadence) for the dead gang's resources to "
+                             "release before sizing the new world"),
+    "train_ckpt_arena": (bool, True, "checkpoint shards are additionally "
+                         "sealed as tagged arena objects (put_tagged) so "
+                         "a restarted gang can restore over striped "
+                         "objxfer pulls from surviving peers; the "
+                         "committed on-disk manifest stays the source of "
+                         "truth (arena restore is best-effort)"),
     # --- observability ---
     "event_stats": (bool, False, "record per-handler event-loop stats"),
     "export_events": (bool, False, "append task/actor/node state "

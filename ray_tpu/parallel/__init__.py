@@ -7,10 +7,12 @@ inside jit over a `jax.sharding.Mesh`, so XLA lowers communication to ICI
 transfers and overlaps it with compute.
 """
 
-from ray_tpu.parallel.mesh import MeshConfig, get_abstract_mesh, make_mesh
+from ray_tpu.parallel.mesh import (MeshConfig, elastic_config,
+                                   get_abstract_mesh, make_mesh)
 from ray_tpu.parallel.sharding import (
     ShardingRules,
     logical_to_physical,
+    reshard,
     shard_params,
     with_sharding,
 )
@@ -18,7 +20,7 @@ from ray_tpu.parallel.ring_attention import ring_attention
 from ray_tpu.parallel.ulysses import ulysses_attention
 
 __all__ = [
-    "MeshConfig", "make_mesh", "get_abstract_mesh", "ShardingRules",
-    "logical_to_physical", "shard_params", "with_sharding",
-    "ring_attention", "ulysses_attention",
+    "MeshConfig", "make_mesh", "elastic_config", "get_abstract_mesh",
+    "ShardingRules", "logical_to_physical", "shard_params", "reshard",
+    "with_sharding", "ring_attention", "ulysses_attention",
 ]

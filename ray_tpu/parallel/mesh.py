@@ -107,6 +107,27 @@ def make_hybrid_mesh(config: MeshConfig, dcn_axes=("dp", "pp")) -> Mesh:
     return Mesh(arr, AXES)
 
 
+def elastic_config(config: MeshConfig, n_devices: int) -> MeshConfig:
+    """Refit a mesh config to a new device count (the gang re-mesh after a
+    worker/host death). Model-parallel axes (tp/sp/ep/pp) are baked into
+    the program's shardings and kept fixed; the DATA axes (dp, fsdp)
+    absorb the change — dp keeps the largest divisor of its old degree
+    that fits, fsdp takes the rest. Raises if the model axes alone no
+    longer fit (a tp=4 program cannot re-mesh onto 2 chips)."""
+    model = 1
+    for a in ("pp", "sp", "tp", "ep"):
+        model *= max(getattr(config, a), 1)
+    if n_devices % model:
+        raise ValueError(
+            f"cannot re-mesh onto {n_devices} devices: model axes need "
+            f"multiples of {model} "
+            f"(pp={config.pp} sp={config.sp} tp={config.tp} ep={config.ep})")
+    data = n_devices // model
+    old_dp = max(config.dp, 1)
+    dp = math.gcd(old_dp, data)
+    return dataclasses.replace(config, dp=dp, fsdp=data // dp)
+
+
 _current_mesh: Mesh | None = None
 
 

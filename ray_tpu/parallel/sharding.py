@@ -74,6 +74,15 @@ def shard_params(params, logical_tree, rules: ShardingRules, mesh: Mesh):
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs)
 
 
+def reshard(tree, shardings):
+    """Device-put every leaf onto its (new-mesh) sharding — the elastic
+    restore step: state saved on an N-device mesh lands on an M-device
+    mesh (jax moves shards through host memory where layouts differ).
+    `shardings` is a matching pytree of NamedShardings, e.g. the
+    state_shardings make_train_step derives for the NEW mesh."""
+    return jax.tree.map(lambda x, s: jax.device_put(x, s), tree, shardings)
+
+
 def with_sharding(x, mesh: Mesh, spec: P):
     """Sharding constraint inside jit (GSPMD hint)."""
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

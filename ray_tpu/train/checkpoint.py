@@ -1,10 +1,28 @@
-"""Checkpoints: directory handles + orbax-backed pytree state.
+"""Checkpoints: crash-consistent sharded directories + orbax pytree state.
 
 Parity: reference `python/ray/train/_checkpoint.py:56` (Checkpoint = dir +
 fs URI), `train/_internal/checkpoint_manager.py` (keep-top-K),
-`train/_internal/storage.py:358` (StorageContext). TPU-first addition:
-`save_state/restore_state` use orbax (async-capable, sharding-aware), so a
-GSPMD-sharded TrainState checkpoints without gathering to one host.
+`train/_internal/storage.py:358` (StorageContext). TPU-first additions:
+
+- `save_state/restore_state` use orbax (async-capable, sharding-aware), so
+  a GSPMD-sharded TrainState checkpoints without gathering to one host,
+  and `restore_state` with a resharded abstract target restores an N-way
+  save onto an M-way mesh (the elastic re-mesh path).
+
+- **Two-phase commit.** A distributed checkpoint directory is only valid
+  once it carries a `MANIFEST.json`: every rank writes its shard
+  (tmp+fsync+rename, so a shard file either exists complete or not at
+  all), acks durability to the controller, and the controller commits the
+  manifest — shard list + step + world size + dataset offsets — with the
+  same tmp+fsync+rename dance, only after ALL ranks acked. A SIGKILL
+  anywhere in the window leaves either a previous committed checkpoint
+  (manifest present) or an uncommitted directory `gc_uncommitted` removes
+  on restart; it can never leave a torn checkpoint that LOOKS resumable.
+
+Shard naming is deterministic (`checkpoint_<step>` / `shard_R-of-W.pkl`),
+so every rank of a gang converges on the same directory without
+coordination, and a crashed attempt's re-run of the same step overwrites
+its own debris.
 """
 
 from __future__ import annotations
@@ -13,27 +31,240 @@ import json
 import os
 import pickle
 import shutil
+import tempfile
 import time
 from typing import Any
 
+MANIFEST_NAME = "MANIFEST.json"
+_CKPT_PREFIX = "checkpoint_"
+
+
+def _fsync_dir(path: str) -> None:
+    # Directory fsync publishes the rename itself; ignore filesystems that
+    # refuse to fsync a directory fd (the rename is still atomic there).
+    try:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:
+        pass
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """tmp (same dir) + fsync + rename + dir fsync: `path` either holds
+    the complete bytes or does not exist — never a torn prefix."""
+    d = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".tmp_" + os.path.basename(path))
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    _fsync_dir(d)
+
+
+def atomic_write_json(path: str, obj) -> None:
+    atomic_write_bytes(path, json.dumps(obj).encode())
+
+
+def shard_name(rank: int, world: int) -> str:
+    return f"shard_{rank:05d}-of-{world:05d}.pkl"
+
+
+def step_dir(storage_dir: str, step: int) -> str:
+    """Deterministic per-step directory: all ranks converge on it with no
+    coordination (the old time-ms suffix made every rank mint its own)."""
+    return os.path.join(storage_dir, f"{_CKPT_PREFIX}{int(step):06d}")
+
+
+def write_shard(data: dict, ckpt_dir: str, rank: int, world: int) -> str:
+    """Durably write one rank's state shard; returns the shard file name.
+    The shard is complete-or-absent (atomic_write_bytes)."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    name = shard_name(rank, world)
+    atomic_write_bytes(os.path.join(ckpt_dir, name),
+                       pickle.dumps(data, protocol=5))
+    return name
+
+
+def commit_manifest(ckpt_dir: str, *, step: int, world_size: int,
+                    shards: list[str], dataset_offsets: dict | None = None,
+                    mesh_shape: dict | None = None,
+                    arena: dict | None = None,
+                    extra: dict | None = None) -> str:
+    """Phase 2: publish the checkpoint. Called by the controller only
+    after every rank acked a durable shard; the manifest rename is the
+    commit point — `latest_ckpt_path` may only ever advance to a
+    directory whose manifest exists."""
+    manifest = {
+        "step": int(step),
+        "world_size": int(world_size),
+        "shards": list(shards),
+        "dataset_offsets": dict(dataset_offsets or {}),
+        "mesh_shape": dict(mesh_shape or {}),
+        # rank -> arena object id hex: surviving peers restore shards over
+        # striped objxfer pulls instead of shared disk (best-effort; disk
+        # stays the source of truth).
+        "arena": dict(arena or {}),
+        "committed_at": time.time(),
+    }
+    if extra:
+        manifest.update(extra)
+    atomic_write_json(os.path.join(ckpt_dir, MANIFEST_NAME), manifest)
+    return ckpt_dir
+
+
+def load_manifest(path: str) -> dict | None:
+    try:
+        with open(os.path.join(path, MANIFEST_NAME)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def is_committed(path: str) -> bool:
+    """Committed = manifest present, or the legacy single-file layout
+    (data.pkl — its atomic rename IS that layout's commit point)."""
+    return (os.path.exists(os.path.join(path, MANIFEST_NAME))
+            or os.path.exists(os.path.join(path, "data.pkl")))
+
+
+def latest_committed(storage_dir: str) -> str | None:
+    """Highest-step committed checkpoint dir under storage_dir, or None."""
+    best: tuple[int, str] | None = None
+    try:
+        names = os.listdir(storage_dir)
+    except OSError:
+        return None
+    for name in names:
+        if not name.startswith(_CKPT_PREFIX):
+            continue
+        path = os.path.join(storage_dir, name)
+        if not os.path.isdir(path) or not is_committed(path):
+            continue
+        m = load_manifest(path)
+        step = (m or {}).get("step")
+        if step is None:
+            # Legacy dir: fall back to the name's step field.
+            try:
+                step = int(name[len(_CKPT_PREFIX):].split("_")[0])
+            except ValueError:
+                step = -1
+        if best is None or step > best[0]:
+            best = (step, path)
+    return best[1] if best else None
+
+
+def gc_uncommitted(storage_dir: str) -> list[str]:
+    """Remove checkpoint dirs that never committed (no manifest, no legacy
+    data.pkl) — the debris a crash leaves between shard writes and the
+    manifest rename. Run at (re)start, when no writer can be mid-flight.
+    Returns the removed paths."""
+    removed = []
+    try:
+        names = os.listdir(storage_dir)
+    except OSError:
+        return removed
+    for name in names:
+        path = os.path.join(storage_dir, name)
+        if not (name.startswith(_CKPT_PREFIX) and os.path.isdir(path)):
+            continue
+        if not is_committed(path):
+            shutil.rmtree(path, ignore_errors=True)
+            removed.append(path)
+    return removed
+
 
 class Checkpoint:
-    """A handle to a checkpoint directory."""
+    """A handle to a checkpoint directory (legacy single-file or sharded
+    manifest layout)."""
 
     def __init__(self, path: str):
         self.path = path
 
     @classmethod
     def from_dict(cls, data: dict, storage_dir: str, step: int = 0) -> "Checkpoint":
-        path = os.path.join(storage_dir, f"checkpoint_{step:06d}_{int(time.time()*1e3)}")
-        os.makedirs(path, exist_ok=True)
-        with open(os.path.join(path, "data.pkl"), "wb") as f:
-            pickle.dump(data, f, protocol=5)
+        """Single-writer convenience: a world-size-1 sharded checkpoint,
+        committed on the spot (write shard, then manifest)."""
+        path = step_dir(storage_dir, step)
+        name = write_shard(data, path, 0, 1)
+        commit_manifest(path, step=step, world_size=1, shards=[name])
         return cls(path)
 
+    def manifest(self) -> dict | None:
+        return load_manifest(self.path)
+
+    def is_committed(self) -> bool:
+        return is_committed(self.path)
+
     def to_dict(self) -> dict:
-        with open(os.path.join(self.path, "data.pkl"), "rb") as f:
+        """The rank-0 shard (legacy surface: with one writer — or
+        DP-replicated state — this IS the state)."""
+        legacy = os.path.join(self.path, "data.pkl")
+        if os.path.exists(legacy):
+            with open(legacy, "rb") as f:
+                return pickle.load(f)
+        return self.load_shard(0)
+
+    def load_shard(self, rank: int, world: int | None = None) -> dict:
+        """Shard for `rank` under a (possibly different) restore world
+        size. An N-way save restored at world M maps rank r to saved
+        shard r % N — exact for DP-replicated dict state; genuinely
+        sharded pytrees reshard through the orbax plane
+        (`restore_state` with a resharded abstract target) instead.
+        Tries the manifest's arena object first (objxfer pull from a
+        surviving peer), then shared disk."""
+        m = self.manifest()
+        if m is None:
+            raise FileNotFoundError(
+                f"{self.path} has no committed manifest — uncommitted "
+                "checkpoints are not restorable (gc_uncommitted removes "
+                "them at restart)")
+        n = m["world_size"]
+        if not m["shards"]:
+            raise FileNotFoundError(
+                f"{self.path} committed without dict shards (externally "
+                "written state, e.g. an orbax save_state dir) — restore "
+                "it with checkpoint.restore_state, not load_shard")
+        src = rank % n if n else 0
+        data = self._load_shard_arena(m, src)
+        if data is not None:
+            return data
+        with open(os.path.join(self.path, m["shards"][src]), "rb") as f:
             return pickle.load(f)
+
+    def _load_shard_arena(self, manifest: dict, src_rank: int):
+        """Best-effort arena restore: the manifest's sealed shard object,
+        pulled over the object plane (PR 7 striped pulls cross-node). Any
+        failure — no runtime, object evicted, owner gone — falls back to
+        the disk shard."""
+        hex_id = (manifest.get("arena") or {}).get(str(src_rank))
+        if not hex_id:
+            return None
+        try:
+            import ray_tpu
+            from ray_tpu.core.ids import ObjectID
+            from ray_tpu.core.object_ref import ObjectRef
+            from ray_tpu.core.runtime import current_runtime
+            if current_runtime() is None:
+                return None
+            ref = ObjectRef(ObjectID.from_hex(hex_id), _add_ref=False)
+            # Short deadline: the common miss is an object freed with its
+            # dead owner — waiting a long resolution timeout on EVERY
+            # rank's restore would slow the restart the arena path exists
+            # to speed up.
+            return ray_tpu.get(ref, timeout=1)
+        except Exception:  # noqa: BLE001 — disk is the source of truth
+            return None
 
     @classmethod
     def from_directory(cls, path: str) -> "Checkpoint":
@@ -57,6 +288,21 @@ def restore_state(path: str, target=None):
     return ckptr.restore(os.path.abspath(path), target)
 
 
+def abstract_state(template, shardings):
+    """Abstract restore target: the template's shapes/dtypes with NEW
+    shardings attached — hand it to `restore_state` to reshard an N-way
+    orbax save onto an M-way mesh (orbax assembles each array straight
+    into the target sharding; no N-way gather materializes)."""
+    import jax
+
+    def leaf(x, s):
+        shape = getattr(x, "shape", None)
+        dtype = getattr(x, "dtype", None)
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=s)
+
+    return jax.tree.map(leaf, template, shardings)
+
+
 class CheckpointManager:
     """Keep-top-K checkpoint retention with a metrics index."""
 
@@ -67,6 +313,7 @@ class CheckpointManager:
         self.metric = metric
         self.mode = mode
         self.entries: list[tuple[float, str]] = []
+        self.latest_committed_path: str | None = None
         os.makedirs(storage_dir, exist_ok=True)
 
     def register(self, checkpoint: Checkpoint, metrics: dict | None = None):
@@ -77,17 +324,31 @@ class CheckpointManager:
                 score = -score
         else:
             score = -time.time()  # newest wins
+        if checkpoint.is_committed():
+            self.latest_committed_path = checkpoint.path
+        # Re-registration (a restart re-commits the step it resumed at)
+        # replaces the old entry: duplicate entries would let keep-K
+        # evict a path that is still tracked live.
+        self.entries = [e for e in self.entries if e[1] != checkpoint.path]
         self.entries.append((score, checkpoint.path))
         self.entries.sort()
         while len(self.entries) > self.keep:
-            _, path = self.entries.pop()
+            victim_i = len(self.entries) - 1
+            # Never evict the latest COMMITTED checkpoint, even when the
+            # keep-K metric scoring ranks it worst: it is the only state a
+            # crash right now is provably able to resume from.
+            if self.entries[victim_i][1] == self.latest_committed_path:
+                victim_i -= 1
+            if victim_i < 0:
+                break
+            _, path = self.entries.pop(victim_i)
             shutil.rmtree(path, ignore_errors=True)
         self._write_index(metrics)
 
     def _write_index(self, metrics):
-        with open(os.path.join(self.storage_dir, "index.json"), "w") as f:
-            json.dump({"checkpoints": [p for _, p in self.entries],
-                       "latest_metrics": metrics}, f)
+        atomic_write_json(os.path.join(self.storage_dir, "index.json"),
+                          {"checkpoints": [p for _, p in self.entries],
+                           "latest_metrics": metrics})
 
     def best(self) -> Checkpoint | None:
         return Checkpoint(self.entries[0][1]) if self.entries else None

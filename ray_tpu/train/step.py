@@ -90,6 +90,16 @@ def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
         new_params = optax.apply_updates(state.params, updates)
         return TrainState(new_params, new_opt, state.step + 1), loss
 
+    def state_shardings(state: TrainState) -> TrainState:
+        """The full TrainState sharding tree for THIS mesh — the abstract
+        restore target of the elastic re-mesh path: feed it through
+        `checkpoint.abstract_state` and orbax assembles an N-way save
+        directly into this mesh's layout (no gather, no host blowup)."""
+        return TrainState(
+            params=param_shardings,
+            opt_state=opt_shardings(state.opt_state, state.params),
+            step=repl)
+
     def compile_for(state: TrainState, sample_batch):
         if mesh.devices.size == 1:
             # Single-chip: every NamedSharding is the trivial one, so skip the
@@ -98,15 +108,14 @@ def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
             # dispatch path (the axon-tunneled chip round-trips buffers per
             # call when in/out shardings are present: ~25x step-time blowup).
             return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
-        state_shardings = TrainState(
-            params=param_shardings,
-            opt_state=opt_shardings(state.opt_state, state.params),
-            step=repl)
+        shardings = state_shardings(state)
         batch_shardings = jax.tree.map(lambda _: batch_sharding, sample_batch)
         return jax.jit(
             step_fn,
-            in_shardings=(state_shardings, batch_shardings),
-            out_shardings=(state_shardings, repl),
+            in_shardings=(shardings, batch_shardings),
+            out_shardings=(shardings, repl),
             donate_argnums=(0,) if donate else ())
 
+    # Attached rather than returned: the 4-tuple is a public surface.
+    compile_for.state_shardings = state_shardings
     return init_fn, step_fn, compile_for, param_shardings

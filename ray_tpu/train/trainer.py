@@ -13,6 +13,14 @@ jax.distributed (coordinator = worker 0). DP/FSDP/TP/SP/EP happen INSIDE the
 program via shardings, so there is no NCCL-style process group to babysit:
 the "backend setup" the reference does in `train/torch/config.py` reduces to
 jax.distributed.initialize + mesh construction.
+
+Elastic resume (ROADMAP item 3): checkpoints are two-phase-committed
+(train/checkpoint.py — every rank's durable shard ack, THEN the controller's
+manifest rename), `latest_ckpt_path` advances only on committed manifests,
+and a worker death restarts the gang at whatever world size the cluster
+still fits (>= min_workers), resharding state and re-splitting datasets from
+the manifest's recorded offsets. A wedged-not-dead worker is converted into
+the same restart by the poll/progress watchdogs instead of stalling the run.
 """
 
 from __future__ import annotations
@@ -26,7 +34,16 @@ import traceback
 from typing import Any, Callable
 
 import ray_tpu
-from ray_tpu.core.status import RayTpuError
+from ray_tpu.core import chaos
+from ray_tpu.core.status import GetTimeoutError, RayTpuError
+
+
+def _train_knob(name: str, override=None) -> float:
+    """RunConfig override first, then the cluster config knob."""
+    if override is not None:
+        return override
+    from ray_tpu.core.config import get_config
+    return getattr(get_config(), name)
 
 
 @dataclasses.dataclass
@@ -57,6 +74,12 @@ class RunConfig:
     failure_config: FailureConfig = dataclasses.field(
         default_factory=FailureConfig)
     checkpoint_keep: int = 2
+    # Per-run overrides for the train_* config knobs (None = knob value):
+    # one poll round-trip deadline, the no-progress gang watchdog, and the
+    # restart capacity-settle wait.
+    poll_timeout_s: float | None = None
+    progress_timeout_s: float | None = None
+    restart_wait_s: float | None = None
 
 
 @dataclasses.dataclass
@@ -136,7 +159,8 @@ class TrainWorker:
         return self.rank
 
     def run(self, loop_fn_bytes: bytes, loop_config: dict,
-            checkpoint_path: str | None, dataset_shards: dict | None = None):
+            checkpoint_path: str | None, dataset_shards: dict | None = None,
+            dataset_offsets: dict | None = None):
         import cloudpickle
         from ray_tpu.train import session as session_mod
         from ray_tpu.train.checkpoint import Checkpoint
@@ -145,7 +169,8 @@ class TrainWorker:
         self._session = session_mod.TrainSession(
             self.rank, self.world_size, self.storage_dir, checkpoint=ckpt,
             dataset_shards=dataset_shards, local_rank=self.local_rank,
-            local_world_size=self.local_world_size)
+            local_world_size=self.local_world_size,
+            dataset_offsets=dataset_offsets)
         session_mod._set_session(self._session)
 
         def target():
@@ -164,6 +189,11 @@ class TrainWorker:
 
     def poll(self):
         """Controller heartbeat: (reports, finished, error_str)."""
+        if chaos.site("train.poll_hang"):
+            # Wedged-not-dead: the actor thread hangs without the process
+            # dying — the shape a stuck collective / NFS stall takes. The
+            # controller's poll deadline must convert this into a restart.
+            time.sleep(3600)
         s = self._session
         if s is None:
             return [], False, None
@@ -194,6 +224,21 @@ class TrainWorker:
 # controller states (parity: TrainControllerState in v2 controller.py)
 INIT, RUNNING, RESTARTING, FINISHED, ERRORED = (
     "INITIALIZING", "RUNNING", "RESTARTING", "FINISHED", "ERRORED")
+
+
+class _PendingCommit:
+    """Phase-2 state for one (dir, step): which ranks acked a durable
+    shard, plus the manifest payload accumulated from the acks."""
+
+    __slots__ = ("step", "world", "acks", "shards", "arena", "offsets")
+
+    def __init__(self, step: int, world: int):
+        self.step = step
+        self.world = world
+        self.acks: set[int] = set()
+        self.shards: dict[int, str] = {}
+        self.arena: dict[str, str] = {}
+        self.offsets: dict = {}
 
 
 class JaxTrainer:
@@ -261,17 +306,19 @@ class JaxTrainer:
         """Workers for this (re)start: fixed, or fitted to what the cluster
         offers (elastic ScalingPolicy). On restarts the previous gang's
         kills release resources asynchronously — wait for capacity to
-        settle instead of snapshotting mid-teardown and shrinking to the
-        floor for no reason."""
+        settle (through the shared backoff policy, not a hot 100ms poll)
+        instead of snapshotting mid-teardown and shrinking to the floor
+        for no reason."""
+        from ray_tpu.core.retry import Backoff
         n = self.scaling.num_workers
         lo = self.scaling.min_workers
         if lo is None:
             return n
-        deadline = time.monotonic() + wait_s
         best = self._fit_now()
-        while best < n and time.monotonic() < deadline:
-            time.sleep(0.1)
-            best = max(best, self._fit_now())
+        if wait_s > 0:
+            bo = Backoff(deadline_s=wait_s)
+            while best < n and bo.sleep():
+                best = max(best, self._fit_now())
         if best < lo:
             from ray_tpu.core.status import ResourceError
             raise ResourceError(
@@ -336,8 +383,24 @@ class JaxTrainer:
             raise
         return workers
 
+    def _resume_path(self, latest_ckpt_path: str | None) -> str | None:
+        """The path the NEXT gang resumes from: committed manifests only.
+        An uncommitted or torn directory (possible only for caller-supplied
+        resume_from_checkpoint — in-run paths advance on commit) is refused
+        loudly: resuming from state that merely LOOKS complete is the bug
+        this plane exists to kill."""
+        if latest_ckpt_path is None:
+            return None
+        from ray_tpu.train import checkpoint as ckpt_mod
+        if not ckpt_mod.is_committed(latest_ckpt_path):
+            raise RayTpuError(
+                f"checkpoint {latest_ckpt_path} has no committed manifest "
+                "(torn or abandoned write); refusing to resume from it")
+        return latest_ckpt_path
+
     def fit(self) -> Result:
         import cloudpickle
+        from ray_tpu.train import checkpoint as ckpt_mod
         storage_dir = self._storage_dir()
         _register_run(self)
         loop_bytes = cloudpickle.dumps(self.train_loop)
@@ -346,15 +409,28 @@ class JaxTrainer:
                        if self.resume_from_checkpoint else None)
         history: list[dict] = []
         latest_metrics: dict = {}
-        latest_ckpt_path = resume_path
+        # Held on self, not a local: _poll_until_done commits checkpoints
+        # as acks arrive and may then RAISE on a worker death — a local
+        # would forget every commit of the crashed attempt and restart
+        # the run from scratch instead of the last committed step.
+        self._latest_committed = self._resume_path(resume_path)
+        self._ckpt_mgr = ckpt_mod.CheckpointManager(
+            storage_dir, keep=self.run_config.checkpoint_keep)
 
         first_start = True
         while True:
             self.state = RUNNING
+            # A crashed attempt's debris (shards written, manifest never
+            # committed) must not survive into this attempt: no writer can
+            # be mid-flight here, so uncommitted dirs are garbage.
+            ckpt_mod.gc_uncommitted(storage_dir)
             try:
                 # Restarts wait for the previous gang's resources to
                 # release first.
-                n = self._elastic_size(wait_s=0.0 if first_start else 5.0)
+                n = self._elastic_size(
+                    wait_s=0.0 if first_start else _train_knob(
+                        "train_restart_wait_s",
+                        self.run_config.restart_wait_s))
             except RayTpuError as e:
                 if first_start:
                     raise  # misconfigured from the start: surface raw
@@ -366,8 +442,8 @@ class JaxTrainer:
                 from ray_tpu.train.checkpoint import Checkpoint
                 return Result(
                     metrics=latest_metrics,
-                    checkpoint=Checkpoint(latest_ckpt_path)
-                    if latest_ckpt_path else None,
+                    checkpoint=Checkpoint(self._latest_committed)
+                    if self._latest_committed else None,
                     path=storage_dir, error=e, metrics_history=history)
             first_start = False
             error = None
@@ -377,10 +453,11 @@ class JaxTrainer:
                 # in the first steps races the start RPC; a shrunk cluster
                 # can kill placement) — all of it is FailurePolicy territory.
                 workers = self._make_group(storage_dir, n)
-                shards = self._split_datasets(n)
+                shards, offsets = self._split_datasets(
+                    n, self._latest_committed)
                 ray_tpu.get([
                     w.run.remote(loop_bytes, self.loop_config,
-                                 latest_ckpt_path, shards[i])
+                                 self._latest_committed, shards[i], offsets)
                     for i, w in enumerate(workers)], timeout=300)
             except _WorkerGroupError as e:
                 error = e
@@ -389,17 +466,21 @@ class JaxTrainer:
             try:
                 if error is not None:
                     raise error
-                latest_metrics, history_part, latest_ckpt_path = (
-                    self._poll_until_done(workers, latest_ckpt_path))
-                history.extend(history_part)
+                # _poll_until_done appends into `history` in place, so
+                # reports from an attempt that later crashes still reach
+                # the Result (and the dashboard).
+                latest_metrics = self._poll_until_done(workers, history)
                 self.state = FINISHED
             except _WorkerGroupError as e:
                 error = e
             # Backend teardown hook (best effort, bounded), then hard kill.
-            if workers:
+            # A HUNG group gets no grace (its poll already ate the poll
+            # deadline once), and an already-broken group gets one second,
+            # not five: restart latency is the recovery metric.
+            if workers and not isinstance(error, _WorkerGroupHung):
                 try:
                     ray_tpu.get([w.shutdown.remote() for w in workers],
-                                timeout=5)
+                                timeout=5 if error is None else 1)
                 except Exception:  # noqa: BLE001 — wedged workers
                     pass
             for w in workers:
@@ -409,7 +490,9 @@ class JaxTrainer:
                     pass
             if error is None:
                 break
-            # FailurePolicy: restart the whole gang from the last checkpoint.
+            # FailurePolicy: restart the whole gang from the last
+            # COMMITTED checkpoint (latest_ckpt_path only ever advances on
+            # manifest commits).
             if failures_left > 0:
                 failures_left -= 1
                 self.state = RESTARTING
@@ -419,8 +502,8 @@ class JaxTrainer:
             _finalize_run(self)
             from ray_tpu.train.checkpoint import Checkpoint
             return Result(metrics=latest_metrics,
-                          checkpoint=Checkpoint(latest_ckpt_path)
-                          if latest_ckpt_path else None,
+                          checkpoint=Checkpoint(self._latest_committed)
+                          if self._latest_committed else None,
                           path=storage_dir, error=error,
                           metrics_history=history)
 
@@ -428,40 +511,117 @@ class JaxTrainer:
         from ray_tpu.train.checkpoint import Checkpoint
         return Result(
             metrics=latest_metrics,
-            checkpoint=Checkpoint(latest_ckpt_path) if latest_ckpt_path else None,
+            checkpoint=Checkpoint(self._latest_committed)
+            if self._latest_committed else None,
             path=storage_dir, metrics_history=history)
 
-    def _split_datasets(self, n: int):
+    def _split_datasets(self, n: int, latest_ckpt_path: str | None = None):
         """Per-worker dataset shards (parity: get_dataset_shard/
         streaming_split). Equal-row shards: lockstep SPMD loops need
         identical iteration counts per rank (streaming_split(equal=True)
-        semantics — a ragged shard would hang a collective at epoch end)."""
+        semantics — a ragged shard would hang a collective at epoch end).
+
+        Elastic resume: the committed manifest records per-dataset row
+        offsets (reported by rank 0 alongside its checkpoint); rows before
+        the offset were consumed pre-crash, so the new gang — possibly a
+        different world size — re-splits only the remainder."""
+        offsets: dict = {}
+        if latest_ckpt_path:
+            from ray_tpu.train import checkpoint as ckpt_mod
+            m = ckpt_mod.load_manifest(latest_ckpt_path)
+            offsets = dict((m or {}).get("dataset_offsets") or {})
         shards = [dict() for _ in range(n)]
         for name, ds in self.datasets.items():
+            off = int(offsets.get(name, 0))
+            if off > 0 and hasattr(ds, "split_at_indices"):
+                ds = ds.split_at_indices([off])[1]
             if hasattr(ds, "split"):
                 parts = ds.split(n, equal=True)
             else:
                 parts = [ds] * n
             for i in range(n):
                 shards[i][name] = parts[i]
-        return shards
+        return shards, offsets
 
-    def _poll_until_done(self, workers, latest_ckpt_path):
-        history = []
+    def _commit_if_ready(self, pending: "_PendingCommit", ckpt_dir: str,
+                         latest_metrics: dict) -> bool:
+        """Phase 2: all ranks acked durable shards -> rename the manifest
+        in. Returns True when the checkpoint committed (the ONLY event
+        that advances latest_ckpt_path)."""
+        from ray_tpu.train import checkpoint as ckpt_mod
+        if len(pending.acks) < pending.world:
+            return False
+        if chaos.site("train.manifest_loss"):
+            # Controller crash window: every shard is durable but the
+            # manifest rename never happens — the step must be invisible
+            # to restarts (gc'd), and resume comes from the previous one.
+            return False
+        # The manifest's shard list is indexed BY RANK — it is either
+        # complete (every rank wrote a dict shard) or empty (externally
+        # written state, e.g. an orbax dir); a partial list would silently
+        # remap ranks onto wrong shards.
+        shards = [pending.shards.get(r) for r in range(pending.world)]
+        if any(s is None for s in shards):
+            shards = []
+        try:
+            ckpt_mod.commit_manifest(
+                ckpt_dir, step=pending.step, world_size=pending.world,
+                shards=shards,
+                dataset_offsets=pending.offsets, arena=pending.arena)
+        except FileNotFoundError:
+            # The dir vanished between the acks and the commit (a restart
+            # re-running an old step can race keep-K eviction of its own
+            # dir). The checkpoint is gone: it must NOT become latest —
+            # same outcome as a lost manifest, and just as survivable.
+            return False
+        self._ckpt_mgr.register(ckpt_mod.Checkpoint(ckpt_dir),
+                                latest_metrics or None)
+        return True
+
+    def _poll_until_done(self, workers, history: list):
+        poll_timeout = _train_knob("train_poll_timeout_s",
+                                   self.run_config.poll_timeout_s)
+        progress_timeout = _train_knob("train_progress_timeout_s",
+                                       self.run_config.progress_timeout_s)
         latest = {}
         done = [False] * len(workers)
+        pending: dict[str, _PendingCommit] = {}
+        last_progress = time.monotonic()
         while not all(done):
             time.sleep(0.05)
-            try:
-                polls = ray_tpu.get(
-                    [w.poll.remote() for w in workers], timeout=600)
-            except ray_tpu.RayTpuError as e:
-                # A hard-crashed worker (OOM kill, preempted host, os._exit)
-                # dies as an actor, not as an error report — that is still
-                # a worker-group failure the FailurePolicy must see.
-                raise _WorkerGroupError(f"worker actor died: {e}") from e
+            refs = [w.poll.remote() for w in workers]
+            polls = []
+            group_error = None
+            for ref in refs:
+                # Per-ref resolution: one dead rank must not discard the
+                # SURVIVORS' drained reports for this round — their shard
+                # acks may complete a commit the restart then resumes
+                # from, instead of re-running work that was already done.
+                try:
+                    polls.append(ray_tpu.get(
+                        ref, timeout=max(poll_timeout, 0.001)))
+                except GetTimeoutError as e:
+                    # Wedged-not-dead: the worker process answers liveness
+                    # but its poll never returns (hung collective, stuck
+                    # I/O). Without this deadline the run stalls for the
+                    # full get timeout on EVERY poll round; with it, the
+                    # FailurePolicy restarts from the committed manifest.
+                    raise _WorkerGroupHung(
+                        f"worker group hung: poll() exceeded "
+                        f"train_poll_timeout_s={poll_timeout}s: {e}") from e
+                except ray_tpu.RayTpuError as e:
+                    # A hard-crashed worker (OOM kill, preempted host,
+                    # os._exit) dies as an actor, not as an error report —
+                    # still a worker-group failure the FailurePolicy must
+                    # see, AFTER the survivors' rounds are processed.
+                    polls.append(([], False, None))
+                    if group_error is None:
+                        group_error = _WorkerGroupError(
+                            f"worker actor died: {e}")
+            progressed = False
             for i, (reports, finished, err) in enumerate(polls):
                 for r in reports:
+                    progressed = True
                     if "error" in r:
                         raise _WorkerGroupError(
                             f"worker {i} failed:\n{r['error']}")
@@ -469,12 +629,42 @@ class JaxTrainer:
                         latest = r["metrics"]
                         history.append(r["metrics"])
                         _update_run(self, latest, len(history))
-                        if "checkpoint" in r:
-                            latest_ckpt_path = r["checkpoint"]
+                    ack = r.get("ckpt_shard")
+                    if ack:
+                        ckpt_dir = ack["dir"]
+                        pc = pending.get(ckpt_dir)
+                        if pc is None:
+                            pc = pending[ckpt_dir] = _PendingCommit(
+                                ack["step"], ack["world"])
+                        pc.acks.add(ack["rank"])
+                        if ack.get("shard"):
+                            pc.shards[ack["rank"]] = ack["shard"]
+                        if ack.get("arena"):
+                            pc.arena[str(ack["rank"])] = ack["arena"]
+                        if ack.get("dataset_offsets"):
+                            pc.offsets = ack["dataset_offsets"]
+                        if self._commit_if_ready(pc, ckpt_dir, latest):
+                            self._latest_committed = ckpt_dir
+                            pending.pop(ckpt_dir, None)
                 if err and not any("error" in r for r in reports):
                     raise _WorkerGroupError(f"worker {i} failed: {err}")
+                if finished and not done[i]:
+                    progressed = True
                 done[i] = finished
-        return latest, history, latest_ckpt_path
+            if group_error is not None:
+                raise group_error
+            now = time.monotonic()
+            if progressed:
+                last_progress = now
+            elif (progress_timeout and progress_timeout > 0
+                    and now - last_progress > progress_timeout):
+                # Polls answer but NOTHING moves: no reports, no finishes.
+                # The per-step progress deadline turns the wedge into a
+                # FailurePolicy restart instead of an unbounded stall.
+                raise _WorkerGroupHung(
+                    "worker group hung: no rank reported progress for "
+                    f"train_progress_timeout_s={progress_timeout}s")
+        return latest
 
 
 # ---- train-run registry (feeds the dashboard's Train page; parity:
@@ -523,3 +713,8 @@ def list_train_runs() -> list[dict]:
 
 class _WorkerGroupError(RayTpuError):
     pass
+
+
+class _WorkerGroupHung(_WorkerGroupError):
+    """A group declared hung by the poll/progress watchdogs — restartable
+    like any group failure, but skipped for graceful-shutdown grace."""

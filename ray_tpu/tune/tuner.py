@@ -325,7 +325,20 @@ class TuneController:
             metrics = dict(rep.get("metrics", {}))
             trial.iteration += 1
             metrics.setdefault("training_iteration", trial.iteration)
-            if rep.get("checkpoint"):
+            ack = rep.get("ckpt_shard")
+            if ack:
+                # Two-phase checkpoint ack (train/session.py): tune trials
+                # are world-1 gangs, so the single rank's durable-shard
+                # ack IS "all ranks acked" — commit the manifest here and
+                # only then adopt the path (torn dirs stay invisible).
+                from ray_tpu.train import checkpoint as ckpt_mod
+                if ack.get("shard") and not ckpt_mod.is_committed(
+                        ack["dir"]):
+                    ckpt_mod.commit_manifest(
+                        ack["dir"], step=ack["step"],
+                        world_size=ack["world"], shards=[ack["shard"]])
+                trial.latest_checkpoint = ack["dir"]
+            elif rep.get("checkpoint"):
                 trial.latest_checkpoint = rep["checkpoint"]
             trial.last_metrics = metrics
             trial.history.append(metrics)

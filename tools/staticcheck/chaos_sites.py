@@ -33,7 +33,8 @@ import os
 from tools.staticcheck import Finding
 from tools.staticcheck.concurrency import suppressed
 
-TARGET_GLOBS = ("ray_tpu/core/*.py", "ray_tpu/experimental/channel.py")
+TARGET_GLOBS = ("ray_tpu/core/*.py", "ray_tpu/experimental/channel.py",
+                "ray_tpu/train/*.py")
 
 _CHAOS_FNS = {"site", "kill", "delay"}
 
@@ -56,6 +57,13 @@ RECOVERY_SCOPES: tuple = (
     ("ray_tpu/core/runtime.py", "_on_actor_worker_death"),
     ("ray_tpu/core/object_store.py", "release_reservation"),
     ("ray_tpu/core/object_store.py", "reclaim_orphans"),
+    # Elastic train plane: the code that turns a killed/hung worker or a
+    # torn checkpoint into a committed-manifest resume must stay loud.
+    ("ray_tpu/train/trainer.py", "_poll_until_done"),
+    ("ray_tpu/train/trainer.py", "_commit_if_ready"),
+    ("ray_tpu/train/trainer.py", "_resume_path"),
+    ("ray_tpu/train/checkpoint.py", "gc_uncommitted"),
+    ("ray_tpu/train/checkpoint.py", "load_shard"),
 )
 _RECOVERY_FN_NAMES = {name for _p, name in RECOVERY_SCOPES}
 
