@@ -39,6 +39,16 @@ from ray_tpu.core.status import (
 
 from ray_tpu import util  # noqa: E402,F401  (parity: ray.util auto-import)
 
+
+def __getattr__(name):
+    # `ray_tpu.diagnostics` lazily: it registers a jax.monitoring listener
+    # at import, and eagerly importing jax here would bloat every control-
+    # plane process (head/agent) that never touches a device.
+    if name == "diagnostics":
+        import importlib
+        return importlib.import_module("ray_tpu.diagnostics")
+    raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")
+
 __all__ = [
     "__version__", "init", "shutdown", "is_initialized", "remote", "method",
     "get", "put", "wait", "kill", "cancel", "get_actor", "cluster_resources",

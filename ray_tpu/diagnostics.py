@@ -1,0 +1,70 @@
+"""Process-global jit compile-cache diagnostics.
+
+The dynamic half of graphcheck's recompile gate (finding class 3): the
+static pass can prove a *hazard* (weak types, per-call jit wrappers,
+unstable static args), but whether a hot loop actually recompiles in
+steady state is a runtime fact. `jit_misses()` is a monotonic counter of
+backend compiles in this process — tests snapshot it, run N steady-state
+steps, and assert the delta is zero:
+
+    base = diagnostics.jit_misses()
+    for _ in range(8):
+        engine.step()
+    assert diagnostics.jit_misses() == base
+
+Implementation: jax.monitoring duration events. Every executable build
+records '/jax/core/compile/backend_compile_duration' exactly once (the
+jaxpr trace and jaxpr->MLIR stages record their own keys, counted
+separately as `jit_traces()` — a tracing-cache miss that HITS the
+persistent compilation cache still costs the trace). The listener is
+registered at import, appends nothing per event but two int increments,
+and is process-global — counters cover every engine/trainer/actor in
+the process, which is exactly what a steady-state assertion wants.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_lock = threading.Lock()
+_counts = {"compiles": 0, "traces": 0}
+_installed = False
+
+_COMPILE_KEY = "/jax/core/compile/backend_compile_duration"
+_TRACE_KEY = "/jax/core/compile/jaxpr_trace_duration"
+
+
+def _listener(name: str, duration_secs: float = 0.0, **_kw) -> None:
+    if name == _COMPILE_KEY:
+        with _lock:
+            _counts["compiles"] += 1
+    elif name == _TRACE_KEY:
+        with _lock:
+            _counts["traces"] += 1
+
+
+def _install() -> None:
+    global _installed
+    if _installed:
+        return
+    import jax
+    jax.monitoring.register_event_duration_secs_listener(_listener)
+    _installed = True
+
+
+_install()
+
+
+def jit_misses() -> int:
+    """Monotonic count of backend compiles in this process. A steady-state
+    hot loop must hold this flat; every increment is a fresh executable
+    (new shape bucket, weak-type fork, unstable static, dropped cache)."""
+    with _lock:
+        return _counts["compiles"]
+
+
+def jit_traces() -> int:
+    """Monotonic count of jaxpr traces (>= jit_misses: retraces that hit
+    the executable cache still pay python tracing)."""
+    with _lock:
+        return _counts["traces"]

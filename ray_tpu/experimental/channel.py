@@ -727,3 +727,33 @@ def get_tensor_object(store, object_id, timeout: float | None = None,
             pass  # a transient frombuffer view; dies with this frame
         store.release(object_id)
     return value
+
+
+def __graphcheck__(gc):
+    """graphcheck hook (tools/graphcheck): the TensorChannel read-side
+    restage — the `jax.device_put` that rebuilds device leaves from the
+    shm frame. Pins that the path stays a pure host->device copy: zero
+    collectives, zero host callbacks (a stray debug hook here would
+    serialize every channel read)."""
+
+    def build(mesh):
+        import jax
+        import jax.numpy as jnp
+
+        leaves = {"acts": jax.ShapeDtypeStruct((64, 256), jnp.float32),
+                  "tokens": jax.ShapeDtypeStruct((64,), jnp.int32)}
+
+        def restage(frame):
+            return jax.tree_util.tree_map(jax.device_put, frame)
+
+        return gc.GraphSpec(
+            name="channel.device_put", fn=restage, args=(leaves,),
+            min_donate_bytes=16384, arg_names=("frame",))
+
+    # The rebuilt device arrays are copies BY DESIGN: the inputs alias
+    # the mmap'd channel region (or a borrowed reader view), which the
+    # writer will overwrite after the ack — donating them would hand XLA
+    # a buffer the seqlock protocol still owns.
+    # graphcheck: ok donation-missing — reader must not overwrite the
+    # borrowed channel region; restage output is a deliberate copy.
+    gc.register("channel.device_put", build)

@@ -1810,3 +1810,71 @@ class InferenceEngine:
                 gen = gen[:-1]
             out.append(gen)
         return out
+
+
+def __graphcheck__(gc):
+    """graphcheck hook (tools/graphcheck): the four steady-state serving
+    graphs, lowered at a tiny config. Pins per graph: the KV pool/cache
+    donation pattern (dropping one silently doubles the pool's HBM), zero
+    host callbacks on the decode hot loop, and the collective/flops
+    fingerprint. Shapes mirror the engine's paged layout
+    [L, hkv, pages, hd, page]."""
+    c = ModelConfig(vocab=128, d_model=32, n_layers=2, n_heads=2,
+                    n_kv_heads=1, d_ff=64, dtype="float32")
+    page, npages, slots, ptab = 16, 17, 4, 4
+
+    def _params():
+        return jax.eval_shape(lambda k: init_params(c, k),
+                              jax.random.PRNGKey(0))
+
+    def _sds(shape, dtype):
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+    def _pool():
+        return _sds((c.n_layers, c.n_kv_heads, npages, c.head_dim, page),
+                    jnp.float32)
+
+    def build_prefill(mesh):
+        return gc.GraphSpec(
+            name="llm.prefill", fn=partial(prefill_batch, config=c),
+            args=(_params(), _sds((2, 32), jnp.int32)),
+            arg_names=("params", "tokens"))
+
+    def build_decode(mesh):
+        return gc.GraphSpec(
+            name="llm.decode_paged", fn=partial(decode_paged, config=c),
+            args=(_params(), _pool(), _pool(), _sds((slots,), jnp.int32),
+                  _sds((slots,), jnp.int32), _sds((slots,), jnp.bool_),
+                  _sds((slots, ptab), jnp.int32)),
+            donate_argnums=(1, 2), min_donate_bytes=16384,
+            arg_names=("params", "pool_k", "pool_v", "tokens", "lengths",
+                       "active", "page_tables"))
+
+    def build_insert(mesh):
+        return gc.GraphSpec(
+            name="llm.insert_kv", fn=insert_pages_batch,
+            args=(_pool(), _pool(),
+                  _sds((c.n_layers, 2, 32, c.n_kv_heads, c.head_dim),
+                       jnp.float32),
+                  _sds((c.n_layers, 2, 32, c.n_kv_heads, c.head_dim),
+                       jnp.float32),
+                  _sds((2, 2), jnp.int32), _sds((2,), jnp.int32)),
+            donate_argnums=(0, 1), min_donate_bytes=16384,
+            arg_names=("pool_k", "pool_v", "ks", "vs", "page_ids",
+                       "lengths"))
+
+    def build_spec_verify(mesh):
+        return gc.GraphSpec(
+            name="llm.spec_verify", fn=partial(verify_paged, config=c),
+            args=(_params(), _pool(), _pool(),
+                  _sds((slots, 3), jnp.int32), _sds((slots,), jnp.int32),
+                  _sds((slots,), jnp.bool_), _sds((slots, ptab),
+                                                  jnp.int32)),
+            donate_argnums=(1, 2), min_donate_bytes=16384,
+            arg_names=("params", "pool_k", "pool_v", "tokens", "lengths",
+                       "active", "page_tables"))
+
+    gc.register("llm.prefill", build_prefill)
+    gc.register("llm.decode_paged", build_decode)
+    gc.register("llm.insert_kv", build_insert)
+    gc.register("llm.spec_verify", build_spec_verify)

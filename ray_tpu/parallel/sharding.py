@@ -12,6 +12,7 @@ from __future__ import annotations
 import dataclasses
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
@@ -67,6 +68,17 @@ def logical_to_physical(rules: ShardingRules, logical_tree):
     )
 
 
+def declared_param_specs(param_axes, rules: ShardingRules | None = None):
+    """THE declared param shardings: the single table both the jit sites
+    (train/step.py in_shardings) and the graphcheck cross-check read.
+    graphcheck compares the shardings a hot graph actually LOWERED with
+    against this declaration, so an edit that drops in_shardings from a
+    jit site — or a rules edit that silently de-shards a param — fails
+    the static gate instead of surfacing as an MFU cliff on hardware."""
+    return logical_to_physical(rules or ShardingRules.default(),
+                               param_axes)
+
+
 def shard_params(params, logical_tree, rules: ShardingRules, mesh: Mesh):
     """Device-put a param pytree with its sharding (for init / restore)."""
     specs = logical_to_physical(rules, logical_tree)
@@ -110,6 +122,32 @@ def data_axes(mesh: Mesh) -> tuple[str, ...]:
     mesh, in rule order, so constraints built from it agree with
     batch_spec = P(("dp", "fsdp")) on any mesh shape."""
     return tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+
+
+def __graphcheck__(gc):
+    """graphcheck hook (tools/graphcheck): the canonical activation
+    batch-constraint graph. Pins that `activation_batch_sharded` lowers
+    to a pure layout constraint on a dp x fsdp mesh — zero collectives,
+    zero callbacks — i.e. the embedding-seam constraint stays a hint,
+    never a resharding round trip."""
+
+    def build(mesh):
+        from jax.sharding import NamedSharding
+
+        x = jax.ShapeDtypeStruct((64, 128), jnp.float32)
+        batch_spec = P(("dp", "fsdp"))
+
+        def fn(a):
+            return activation_batch_sharded(a, mesh) * 2.0
+
+        return gc.GraphSpec(
+            name="parallel.batch_constraint", fn=fn, args=(x,),
+            in_shardings=(NamedSharding(mesh, batch_spec),),
+            declared_in_specs=(("acts", batch_spec),),
+            expect_sharded=("acts",), arg_names=("acts",))
+
+    gc.register("parallel.batch_constraint", build,
+                meshes=({"dp": 2, "fsdp": 2},))
 
 
 def activation_batch_sharded(x, mesh: Mesh):

@@ -15,7 +15,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ray_tpu.parallel.sharding import ShardingRules, logical_to_physical
+from ray_tpu.parallel.sharding import ShardingRules, declared_param_specs
 
 
 @dataclasses.dataclass
@@ -46,7 +46,10 @@ def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
     same code runs 1-chip or N-chip.
     """
     rules = rules or ShardingRules.default()
-    param_specs = logical_to_physical(rules, param_axes)
+    # The declared table (parallel/sharding.py): graphcheck cross-checks
+    # the lowered step against the same source, so in_shardings here can
+    # never silently diverge from the declaration.
+    param_specs = declared_param_specs(param_axes, rules)
     param_shardings = jax.tree.map(
         lambda s: NamedSharding(mesh, s), param_specs)
     batch_spec = batch_spec if batch_spec is not None else P(("dp", "fsdp"))
@@ -119,3 +122,43 @@ def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
     # Attached rather than returned: the 4-tuple is a public surface.
     compile_for.state_shardings = state_shardings
     return init_fn, step_fn, compile_for, param_shardings
+
+
+def __graphcheck__(gc):
+    """graphcheck hook (tools/graphcheck): the sharded train step, lowered
+    through the REAL compile_for wrapper on a simulated dp2 x fsdp2 mesh.
+    Pins: state donated (params + opt moments aliased into the outputs),
+    FSDP params never lower replicated, lowered in-shardings match the
+    declared parallel/sharding.py table, and the collective counts of the
+    FSDP gather/psum pattern."""
+
+    def build(mesh):
+        d, f, b = 256, 512, 32
+        param_axes = {"w_in": ("embed", "mlp"), "w_out": ("mlp", "embed")}
+
+        def loss_fn(params, batch):
+            h = jnp.tanh(batch["x"] @ params["w_in"])
+            y = h @ params["w_out"]
+            return jnp.mean((y - batch["y"]) ** 2)
+
+        init_fn, step_fn, compile_for, _ = make_train_step(
+            loss_fn, optax.adam(1e-3), mesh, param_axes)
+        params = {
+            "w_in": jax.ShapeDtypeStruct((d, f), jnp.float32),
+            "w_out": jax.ShapeDtypeStruct((f, d), jnp.float32)}
+        state = jax.eval_shape(init_fn, params)
+        batch = {"x": jax.ShapeDtypeStruct((b, d), jnp.float32),
+                 "y": jax.ShapeDtypeStruct((b, d), jnp.float32)}
+        specs = declared_param_specs(param_axes)
+        return gc.GraphSpec(
+            name="train.step", fn=step_fn, args=(state, batch),
+            jit_fn=compile_for(state, batch), donate_argnums=(0,),
+            declared_in_specs=tuple(
+                (f"'{k}'", s) for k, s in sorted(specs.items())),
+            expect_sharded=("w_in", "w_out"),
+            min_donate_bytes=1 << 16, arg_names=("state", "batch"))
+
+    # tp rides along at size 1: the declared rules map "mlp" -> "tp", so
+    # the mesh must carry the axis name even when it is not being tested.
+    gc.register("train.step", build,
+                meshes=({"dp": 2, "fsdp": 2, "tp": 1},))

@@ -123,9 +123,11 @@ class TrainWorker:
             # resolves the interface IP other nodes can reach — hostname
             # lookup often lands on 127.0.1.1 (Debian /etc/hosts).
             probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-            probe.connect(("8.8.8.8", 80))
-            ip = probe.getsockname()[0]
-            probe.close()
+            try:
+                probe.connect(("8.8.8.8", 80))
+                ip = probe.getsockname()[0]
+            finally:
+                probe.close()
         except OSError:
             pass
         if ip is None or ip.startswith("127."):

@@ -1,0 +1,46 @@
+"""Seeded-violation fixture for graphcheck's AST companion passes.
+
+Every marked line must fire its rule; the suppressed twin below it must
+not. NOT imported — parsed only.
+"""
+
+import jax
+from functools import partial
+
+
+def hot_fn(x, n):
+    if x:                    # host-sync-coercion (branch on traced)
+        y = float(x) + 1.0   # host-sync-coercion (scalar coercion)
+    else:
+        y = x.item()         # host-sync-coercion (.item on traced)
+    return y * n
+
+
+hot = jax.jit(partial(hot_fn, n=2))
+
+
+def hot_suppressed(x):
+    # graphcheck: ok host-sync-coercion — fixture: intentional twin
+    if x:
+        return x + 1
+    return x
+
+
+hot2 = jax.jit(hot_suppressed)
+
+stepper = jax.jit(hot_fn, static_argnames=("n",))
+
+
+def caller(xs):
+    out = []
+    for x in xs:
+        out.append(jax.jit(hot_fn)(x, 2))  # jit-per-call + jit-in-loop
+    return out
+
+
+def caller2(x):
+    return stepper(x, n=dict(k=1))  # unstable-static-arg
+
+
+def caller3(x):
+    return stepper(x, n=2)  # constant static: clean

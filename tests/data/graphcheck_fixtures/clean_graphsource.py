@@ -1,0 +1,34 @@
+"""Clean twin of bad_graphsource.py: the same shapes done right — every
+coercion on statics, jit wrappers hoisted, static args hashable. Must
+produce ZERO findings from graphcheck's AST passes. NOT imported —
+parsed only.
+"""
+
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+
+def hot_fn(x, scale, n):
+    # `scale`/`n` ride partial/static_argnames: python coercions on them
+    # are trace-time constants, not device syncs.
+    s = float(scale)
+    if n > 1:
+        x = x * s
+    return jnp.where(x > 0, x, 0.0) * n
+
+
+hot = jax.jit(partial(hot_fn, scale=2.0, n=2))
+
+stepper = jax.jit(hot_fn, static_argnames=("scale", "n"))
+
+
+def caller(xs):
+    out = []
+    for x in xs:
+        out.append(hot(x))  # wrapper hoisted: no per-call jit
+    return out
+
+
+def caller2(x):
+    return stepper(x, scale=1.5, n=3)  # hashable constants as statics

@@ -325,7 +325,19 @@ def test_chaos_agent_sigkill_mid_lease_storm():
         }})
     c.add_node(num_cpus=2)
     c.add_node(num_cpus=2)
-    c.wait_for_nodes(3)
+    # Wait for 3 REGISTERED nodes, not 3 simultaneously-alive: the chaos
+    # kill fires on the 2nd heartbeat tick (0.6s here), so on a slow
+    # in-suite boot an agent can legitimately die before the last one
+    # registers — the scenario (agent death -> lease requeue -> refs
+    # resolve on survivors) holds either way, but an alive==3 gate races
+    # the kill it armed.
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if len(c.rt.nodes_table()) >= 3:
+            break
+        time.sleep(0.02)
+    else:
+        raise TimeoutError("cluster never registered 3 nodes")
     try:
         @ray_tpu.remote(num_cpus=1, max_retries=3)
         def work(i):

@@ -1,0 +1,314 @@
+"""graphcheck: the lowered-XLA-graph gate is itself tier-1 tested.
+
+Layers: (1) the CI gate — every registered hot graph lowers clean
+against the committed (EMPTY) baseline and the fingerprint contract;
+(2) per-finding-class detection — four seeded drift fixtures (donation
+drop, injected host callback, replicated-param sharding edit,
+collective-count change) must each flip the gate red, and their clean
+twins stay green; (3) the AST companion passes on seeded source
+fixtures; (4) suppression + --update-baseline round trips.
+
+Wall budget: ONE session-scoped lowered corpus (lower once, analyze
+many); seeded fixtures are sub-100ms single-op graphs.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# Lowers + compiles the whole registered corpus once per session — the
+# compile-heavy tier (`-m "not heavy"` skips; tier-1 runs everything).
+pytestmark = pytest.mark.heavy
+
+from tools import checklib  # noqa: E402
+from tools import graphcheck  # noqa: E402
+from tools.graphcheck import (collectives, donation, fingerprint,  # noqa: E402
+                              hostsync, lowering, memory, recompile)
+from tools.graphcheck import GraphSpec  # noqa: E402
+
+FIX = "tests/data/graphcheck_fixtures"
+SRC = ("tests/test_graphcheck.py", 1)  # seeded specs point here
+
+
+@pytest.fixture(scope="session")
+def graph_corpus():
+    """The real registered corpus, lowered ONCE for every test below."""
+    registry = graphcheck.load_corpus()
+    return lowering.lower_all(registry)
+
+
+def _lower(name, fn, args, mesh_axes=None, **kw):
+    mesh = lowering.make_mesh(mesh_axes)
+    spec = GraphSpec(name=name, fn=fn, args=args, **kw)
+    spec.mesh = mesh
+    spec.mesh_axes = mesh_axes
+    spec.source = SRC
+    return lowering.lower_graph(spec)
+
+
+def _sds(shape, dtype=jnp.float32):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+# ---------------- (1) the CI gate ----------------
+
+
+def test_repo_graphs_clean_and_covered(graph_corpus):
+    """Tier-1: >= 6 hot graphs analyzed, zero unsuppressed findings, and
+    the committed baseline ships EMPTY for ray_tpu/ (debt is fixed or
+    inline-suppressed at the registration site, never baselined)."""
+    assert len(graph_corpus) >= 6, [r.graph_id for r in graph_corpus]
+    assert all(r.error is None for r in graph_corpus), [
+        (r.graph_id, r.error) for r in graph_corpus]
+    findings = graphcheck.run(REPO, corpus=graph_corpus)
+    base = checklib.load_baseline(
+        os.path.join(REPO, graphcheck.BASELINE_REL))
+    new, _stale = checklib.diff_baseline(findings, base)
+    assert not new, "new graphcheck violations:\n" + "\n".join(
+        f.render() for f in new)
+    with open(os.path.join(REPO, graphcheck.BASELINE_REL)) as f:
+        assert json.load(f) == []
+
+
+def test_fingerprints_cover_corpus_exactly(graph_corpus):
+    committed = fingerprint.load(
+        os.path.join(REPO, graphcheck.FINGERPRINTS_REL))
+    assert set(committed) == {r.graph_id for r in graph_corpus}
+    # The flagship invariants the contract exists to hold:
+    assert committed["train.step@dp2_fsdp2"]["donated"] == ["state"]
+    assert committed["train.step@dp2_fsdp2"]["collectives"]
+    assert committed["llm.decode_paged@1dev"]["donated"] == [
+        "pool_k", "pool_v"]
+    assert all(fp["callbacks"] == 0 for fp in committed.values())
+
+
+# ---------------- (2) seeded drift fixtures ----------------
+
+
+def test_seeded_donation_drop_flips_gate():
+    def step(state, batch):
+        return state + batch.sum(0), batch.mean()
+
+    big = _sds((256, 256))  # 256 KB, threaded through the step
+    bad = _lower("fix.donate", step, (big, _sds((4, 256), jnp.float32)),
+                 arg_names=("state", "batch"), min_donate_bytes=1 << 16)
+    fs = donation.analyze(bad)
+    assert any(f.rule == "donation-missing" and "state" in f.detail
+               for f in fs), [f.render() for f in fs]
+    good = _lower("fix.donate_ok", step,
+                  (big, _sds((4, 256), jnp.float32)),
+                  donate_argnums=(0,), arg_names=("state", "batch"),
+                  min_donate_bytes=1 << 16)
+    assert donation.analyze(good) == []
+
+
+def test_seeded_rejected_donation_detected():
+    def cast(x):
+        return (x.astype(jnp.bfloat16),)
+
+    rec = _lower("fix.reject", cast, (_sds((1024,)),),
+                 donate_argnums=(0,))
+    fs = donation.analyze(rec)
+    assert any(f.rule == "donation-rejected" for f in fs), [
+        f.render() for f in fs]
+
+
+def test_seeded_host_callback_flips_gate():
+    def leaky(x):
+        y = jax.pure_callback(
+            lambda a: np.asarray(a),
+            jax.ShapeDtypeStruct((8,), np.float32), x)
+        return y * 2
+
+    bad = _lower("fix.callback", leaky, (_sds((8,)),), hot=True)
+    count, fs = hostsync.analyze(bad)
+    assert count == 1
+    assert any(f.rule == "host-sync" for f in fs), [f.render() for f in fs]
+    # Warm-path twin: counted in the fingerprint, no finding.
+    warm = _lower("fix.callback_warm", leaky, (_sds((8,)),), hot=False)
+    count, fs = hostsync.analyze(warm)
+    assert count == 1 and fs == []
+    clean = _lower("fix.noop", lambda x: x * 2, (_sds((8,)),), hot=True)
+    assert hostsync.analyze(clean) == (0, [])
+
+
+def test_seeded_replicated_param_and_sharding_edit_flip_gate():
+    mesh_axes = {"dp": 2, "fsdp": 2}
+    mesh = lowering.make_mesh(mesh_axes)
+
+    def fwd(w):
+        return (w * 2,)
+
+    def spec_for(sharding_spec):
+        s = GraphSpec(
+            name="fix.shard", fn=fwd, args=(_sds((64, 64)),),
+            in_shardings=(NamedSharding(mesh, sharding_spec),),
+            declared_in_specs=(("w", P("fsdp")),),
+            expect_sharded=("w",), arg_names=("w",))
+        s.mesh = mesh
+        s.mesh_axes = mesh_axes
+        s.source = SRC
+        return s
+
+    # The "sharding edit": the FSDP param lowered fully replicated.
+    bad = lowering.lower_graph(spec_for(P()))
+    _, fs = collectives.analyze(bad)
+    rules = {f.rule for f in fs}
+    assert "replicated-param" in rules, [f.render() for f in fs]
+    assert "sharding-mismatch" in rules, [f.render() for f in fs]
+    good = lowering.lower_graph(spec_for(P("fsdp")))
+    _, fs = collectives.analyze(good)
+    assert fs == [], [f.render() for f in fs]
+
+
+def test_seeded_collective_count_drift_flips_gate(graph_corpus, tmp_path):
+    """Perturb ONE committed collective count for train.step; the
+    fingerprint diff over the session corpus must go red — the exact
+    drift a silent FSDP->replicated edit produces, with no benchmark."""
+    committed = fingerprint.load(
+        os.path.join(REPO, graphcheck.FINGERPRINTS_REL))
+    drifted = json.loads(json.dumps(committed))
+    coll = drifted["train.step@dp2_fsdp2"]["collectives"]
+    coll["all-gather"] = coll.get("all-gather", 0) + 3
+    fpath = tmp_path / "fingerprints.json"
+    fpath.write_text(json.dumps(drifted))
+    fps = graphcheck.current_fingerprints(graph_corpus)
+    fs = fingerprint.diff(fps, str(fpath), graph_corpus)
+    assert any(f.rule == "fingerprint-drift" and "all-gather" in f.detail
+               and "train.step" in f.detail for f in fs), [
+        f.render() for f in fs]
+    # Unperturbed file: clean.
+    fpath.write_text(json.dumps(committed))
+    assert fingerprint.diff(fps, str(fpath), graph_corpus) == []
+
+
+def test_seeded_weak_type_input_detected():
+    rec = _lower("fix.weak", lambda x: x + 1, (3.0,))
+    fs = recompile.analyze(rec)
+    assert any(f.rule == "weak-type-input" for f in fs), [
+        f.render() for f in fs]
+    strong = _lower("fix.strong", lambda x: x + 1, (_sds(()),))
+    assert recompile.analyze(strong) == []
+
+
+def test_memory_budget_gate():
+    def blowup(x):
+        return (x[:, None] * x[None, :]).sum()
+
+    rec = _lower("fix.mem", blowup, (_sds((512,)),),
+                 budget_bytes=1024)
+    peak, fs = memory.analyze(rec)
+    assert peak is not None and peak > 1024
+    assert any(f.rule == "hbm-over-budget" for f in fs)
+    rec2 = _lower("fix.mem_ok", blowup, (_sds((512,)),),
+                  budget_bytes=1 << 30)
+    _, fs2 = memory.analyze(rec2)
+    assert fs2 == []
+
+
+# ---------------- (3) AST companion passes ----------------
+
+
+def test_ast_passes_detect_each_seeded_rule():
+    fs = hostsync.scan_sources(REPO, (f"{FIX}/bad_graphsource.py",))
+    details = [f"{f.rule}:{f.detail}" for f in fs]
+    coercions = [d for d in details if d.startswith("host-sync-coercion")]
+    assert any("float(x)" in d for d in coercions), details
+    assert any("branching on traced value 'x'" in d
+               for d in coercions), details
+    assert any(".item()" in d for d in coercions), details
+    # The suppressed twin must NOT fire (hot_suppressed).
+    assert not any("hot_suppressed" in d for d in details), details
+
+    fs = recompile.scan_sources(REPO, (f"{FIX}/bad_graphsource.py",))
+    rules = {f.rule for f in fs}
+    assert {"jit-per-call", "jit-in-loop",
+            "unstable-static-arg"} <= rules, [f.render() for f in fs]
+    # caller3's constant static is clean.
+    assert not any(f.rule == "unstable-static-arg" and "n=2" in f.detail
+                   for f in fs)
+
+
+def test_ast_clean_twin_produces_no_findings():
+    rel = f"{FIX}/clean_graphsource.py"
+    fs = (hostsync.scan_sources(REPO, (rel,))
+          + recompile.scan_sources(REPO, (rel,)))
+    assert fs == [], [f.render() for f in fs]
+
+
+# ---------------- (4) suppression + baseline round trip ----------------
+
+
+def test_spec_suppression_at_registration_site(tmp_path):
+    """A `# graphcheck: ok <rule>` comment above the register() call
+    silences that rule for the graph — the channel.device_put pattern."""
+    hook = tmp_path / "hook_mod.py"
+    hook.write_text(
+        "# fixture registration site\n"
+        "# graphcheck: ok host-sync\n"
+        "REGISTER_LINE = 3\n")
+
+    def leaky(x):
+        return jax.pure_callback(
+            lambda a: np.asarray(a),
+            jax.ShapeDtypeStruct((4,), np.float32), x) * 1.0
+
+    spec = GraphSpec(name="fix.supp", fn=leaky, args=(_sds((4,)),),
+                     hot=True)
+    spec.mesh = None
+    spec.mesh_axes = None
+    spec.source = (str(hook), 3)
+    rec = lowering.lower_graph(spec)
+    _, fs = hostsync.analyze(rec)
+    assert fs and fs[0].rule == "host-sync"
+    assert graphcheck._spec_suppressed(str(tmp_path), spec, "host-sync")
+    assert not graphcheck._spec_suppressed(str(tmp_path), spec,
+                                           "donation-missing")
+
+
+def test_update_baseline_round_trip(tmp_path):
+    def step(state):
+        return (state * 2,)
+
+    rec = _lower("fix.roundtrip", step, (_sds((256, 256)),),
+                 min_donate_bytes=1 << 10)
+    fs = donation.analyze(rec)
+    assert fs  # donation-missing seeded
+    bpath = tmp_path / "baseline.json"
+    checklib.save_baseline(str(bpath), fs)
+    new, stale = checklib.diff_baseline(
+        fs, checklib.load_baseline(str(bpath)))
+    assert not new and not stale  # accepted debt absorbs the finding
+    new, stale = checklib.diff_baseline(
+        [], checklib.load_baseline(str(bpath)))
+    assert not new and stale  # paid-off debt surfaces as stale
+
+
+# ---------------- CLI ----------------
+
+
+def test_cli_filtered_gate_exits_zero():
+    """CLI plumbing end to end on the CHEAPEST graph only (the full
+    corpus is already gated in-process by the session fixture)."""
+    env = {**os.environ,
+           "PYTHONPATH": REPO + os.pathsep + os.environ.get(
+               "PYTHONPATH", "")}
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.graphcheck", "--graphs",
+         "parallel.*"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=180)
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.graphcheck", "--list"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=180)
+    assert r.returncode == 0 and "train.step" in r.stdout

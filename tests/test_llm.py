@@ -34,12 +34,36 @@ def tiny_params(tiny_llm_params):
     return params
 
 
-def _naive_greedy(params, prompt, n):
+# One JITTED reference forward per model config: the bare `forward` runs
+# EAGERLY (hundreds of per-op dispatches, ~0.45s/call on this box), which
+# made the naive-greedy verifications the single biggest cost in this
+# file (~80 calls = ~36s in the pool-exhaustion test alone).
+_FWD_JIT: dict = {}
+
+
+def _jit_forward(config):
+    fn = _FWD_JIT.get(id(config))
+    if fn is None:
+        fn = _FWD_JIT[id(config)] = jax.jit(
+            lambda p, t: forward(p, t, config))
+    return fn
+
+
+def _naive_greedy(params, prompt, n, config=TINY):
+    """Reference greedy decode via the full forward. Fixed-length right
+    padding (attention is causal, so the pad tail is inert) + the jitted
+    forward above: every step and every caller shares ONE compiled
+    executable instead of paying eager dispatch per token."""
+    fwd = _jit_forward(config)
     seq = list(prompt)
     out = []
+    pad_to = 64
+    while len(prompt) + n > pad_to:
+        pad_to += 32
     for _ in range(n):
-        logits = forward(params, jnp.asarray([seq]), TINY)
-        nxt = int(jnp.argmax(logits[0, -1]))
+        padded = seq + [0] * (pad_to - len(seq))
+        logits = fwd(params, jnp.asarray([padded]))
+        nxt = int(jnp.argmax(logits[0, len(seq) - 1]))
         out.append(nxt)
         seq.append(nxt)
     return out
@@ -96,14 +120,7 @@ def test_engine_moe_model_matches_naive_greedy():
     prompts = [[4, 5, 6], [11, 12]]
     outs = eng.generate(prompts, max_new_tokens=5, temperature=0.0)
     for p, got in zip(prompts, outs):
-        seq = list(p)
-        ref = []
-        for _ in range(5):
-            logits = forward(params, jnp.asarray([seq]), moe)
-            nxt = int(jnp.argmax(logits[0, -1]))
-            ref.append(nxt)
-            seq.append(nxt)
-        assert got == ref
+        assert got == _naive_greedy(params, p, 5, config=moe)
 
 
 def test_sampling_temperature_zero_is_greedy():
@@ -482,10 +499,12 @@ def test_engine_logprobs_match_forward(tiny_params):
         eng.step_window()
     req = eng.finished.pop(rid)
     assert len(req.token_logprobs) == len(req.generated) == 5
-    # naive reference
+    # naive reference (jitted fixed-length forward — see _naive_greedy)
+    fwd = _jit_forward(TINY)
     seq = list(prompt)
     for tok, lp in zip(req.generated, req.token_logprobs):
-        logits = forward(tiny_params, jnp.asarray([seq]), TINY)[0, -1]
+        padded = seq + [0] * (64 - len(seq))
+        logits = fwd(tiny_params, jnp.asarray([padded]))[0, len(seq) - 1]
         want = float(jax.nn.log_softmax(logits)[tok])
         assert abs(lp - want) < 1e-3, (lp, want)
         seq.append(tok)
@@ -632,3 +651,24 @@ def test_serve_tp2_decode_identical_to_tp1(monkeypatch):
         return out["choices"][0]["text"]
 
     assert run(2) == run(1)
+
+
+def test_decode_steady_state_no_recompiles(tiny_params):
+    """The dynamic half of graphcheck finding class 3: after warmup, 8
+    decode steps in one page bucket must not touch the compiler — any
+    increment of the process-global jit-miss counter is a recompile
+    hazard (weak-type fork, unstable static, shape wobble) that static
+    analysis can only flag as a maybe."""
+    from ray_tpu import diagnostics
+    eng = InferenceEngine(
+        TINY, EngineConfig(max_slots=2, max_len=64, prompt_buckets=(16,),
+                           eos_token=-1), params=tiny_params)
+    eng.add_request([5, 6, 7], max_new_tokens=16)
+    eng.add_request([9, 10, 11, 12], max_new_tokens=16)
+    for _ in range(3):   # admission + prefill + first decode variants
+        eng.step()
+    base = diagnostics.jit_misses()
+    for _ in range(8):
+        eng.step()
+    assert diagnostics.jit_misses() == base, \
+        "steady-state decode recompiled"

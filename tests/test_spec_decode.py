@@ -29,12 +29,26 @@ def tiny_params(tiny_llm_params):
     return params
 
 
+_FWD64 = None  # jitted fixed-length reference forward (see test_llm.py)
+
+
 def _naive_greedy(params, prompt, n):
+    """Fixed-length padded JITTED forward (causal attention makes the
+    pad tail inert): one compiled executable for every step and caller
+    instead of eager per-op dispatch per token — same shave as
+    test_llm._naive_greedy."""
+    global _FWD64
+    if _FWD64 is None:
+        _FWD64 = jax.jit(lambda p, t: forward(p, t, TINY))
     seq = list(prompt)
     out = []
+    pad_to = 64
+    while len(prompt) + n > pad_to:
+        pad_to += 32
     for _ in range(n):
-        logits = forward(params, jnp.asarray([seq]), TINY)
-        nxt = int(jnp.argmax(logits[0, -1]))
+        padded = seq + [0] * (pad_to - len(seq))
+        logits = _FWD64(params, jnp.asarray([padded]))
+        nxt = int(jnp.argmax(logits[0, len(seq) - 1]))
         out.append(nxt)
         seq.append(nxt)
     return out

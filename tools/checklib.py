@@ -1,0 +1,140 @@
+"""Shared plumbing for the repo's static-analysis gates.
+
+Both analysis planes — `tools.staticcheck` (source conventions) and
+`tools.graphcheck` (lowered XLA graphs) — share one findings/debt model:
+
+  Finding       a violation with a line-number-free fingerprint
+  suppressed()  inline `# <tool>: ok <rule>` markers (on the line or in
+                the comment block above it)
+  baseline      a checked-in JSON multiset of accepted findings; new
+                findings fail, paid-off debt surfaces as stale
+
+The baseline file is a JSON list of {rule, path, detail} entries —
+line-number-free fingerprints, so routine edits above a recorded site do
+not churn it. Matching is multiset-aware: two identical recorded entries
+absorb two identical findings; a third is NEW and fails the run.
+
+`--update-baseline` rewrites the file from the current findings (the
+reviewed way to accept debt); stale entries (recorded but no longer
+firing) are reported as warnings and dropped on the next update, so the
+debt ledger only ever shrinks by paying it.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import os
+import re
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One violation. `detail` is the line-number-free fingerprint the
+    baseline matches on (line numbers drift with every edit; the shape of
+    the violation does not)."""
+
+    rule: str        # e.g. "blocking-under-lock"
+    path: str        # repo-relative
+    line: int        # 1-based; 0 = whole-file finding
+    detail: str      # stable fingerprint, no line numbers
+    message: str = ""  # human text; defaults to detail
+
+    def render(self) -> str:
+        msg = self.message or self.detail
+        return f"{self.path}:{self.line}: [{self.rule}] {msg}"
+
+    def key(self) -> tuple:
+        return (self.rule, self.path, self.detail)
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def suppressed(lines: list, lineno: int, rule: str,
+               tool: str = "staticcheck") -> bool:
+    """`# <tool>: ok <rule>` on the line, or anywhere in the block of
+    comment/blank lines immediately above it (so a marker can open a
+    multi-line justification comment)."""
+    pat = re.compile(rf"#\s*{tool}:\s*ok\s+([\w,-]+)")
+
+    def marked(ln: int) -> bool:
+        m = pat.search(lines[ln - 1])
+        return bool(m) and rule in m.group(1).split(",")
+
+    if not 1 <= lineno <= len(lines):
+        return False
+    if marked(lineno):
+        return True
+    ln = lineno - 1
+    while ln >= 1:
+        stripped = lines[ln - 1].strip()
+        if stripped and not stripped.startswith("#"):
+            return False
+        if stripped and marked(ln):
+            return True
+        ln -= 1
+    return False
+
+
+# ---------------- baseline workflow ----------------
+
+
+def load_baseline(path: str) -> collections.Counter:
+    if not os.path.exists(path):
+        return collections.Counter()
+    with open(path) as f:
+        entries = json.load(f)
+    return collections.Counter(
+        (e["rule"], e["path"], e["detail"]) for e in entries)
+
+
+def save_baseline(path: str, findings: list) -> None:
+    entries = sorted(
+        ({"rule": f.rule, "path": f.path, "detail": f.detail}
+         for f in findings),
+        key=lambda e: (e["rule"], e["path"], e["detail"]))
+    with open(path, "w") as f:
+        json.dump(entries, f, indent=1)
+        f.write("\n")
+
+
+def diff_baseline(findings: list, baseline: collections.Counter):
+    """-> (new findings, stale baseline keys)."""
+    remaining = collections.Counter(baseline)
+    new: list[Finding] = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line)):
+        if remaining[f.key()] > 0:
+            remaining[f.key()] -= 1
+        else:
+            new.append(f)
+    stale = sorted(k for k, n in remaining.items() if n > 0)
+    return new, stale
+
+
+def report(findings: list, bpath: str, *, update: bool = False,
+           use_baseline: bool = True, out=None) -> int:
+    """The shared CLI tail: diff against the baseline (or rewrite it) and
+    print the summary. Returns the exit code (0 clean, 1 new findings)."""
+    import sys
+    out = out or sys.stdout
+    if update:
+        save_baseline(bpath, findings)
+        print(f"baseline updated: {len(findings)} entries -> {bpath}",
+              file=out)
+        return 0
+    base = (load_baseline(bpath) if use_baseline
+            else collections.Counter())
+    new, stale = diff_baseline(findings, base)
+    for f in new:
+        print(f.render(), file=out)
+    for key in stale:
+        print(f"stale baseline entry (no longer fires): {key}",
+              file=sys.stderr)
+    n_base = len(findings) - len(new)
+    print(f"{len(findings)} finding(s): {len(new)} new, "
+          f"{n_base} baselined, {len(stale)} stale baseline entr(ies)",
+          file=sys.stderr)
+    return 1 if new else 0

@@ -1,0 +1,236 @@
+"""graphcheck: static analysis over the LOWERED XLA graphs of every
+registered TPU hot path.
+
+`tools.staticcheck` gates the *source* of the distributed plane; the TPU
+hot path's worst regressions live one layer down, in the lowered graph —
+a dropped `donate_argnums` silently doubles HBM, a stray `pure_callback`
+inserts a device→host sync into a jitted region, a sharding edit turns an
+FSDP param into an implicit full all-gather. None of that is visible to
+source lints or CPU-only benchmarks. graphcheck AOT-lowers every
+registered hot graph on CPU under simulated meshes (`jax.jit(...).lower()`
+— no execution, no TPU) and analyzes the jaxpr + StableHLO + compiled
+HLO for five finding classes:
+
+  donation      large state-threading buffers accepted by value but not
+                donated; donations XLA silently rejected
+  host-sync     pure_callback / io_callback / debug_print inside graphs
+                registered as steady-state hot, plus an AST companion
+                flagging python-scalar coercions on traced values
+  recompile     weak-typed inputs that fork the executable cache; jit
+                wrappers constructed per call / per loop iteration;
+                unstable static args at jit call sites
+  collectives   all-gather/all-reduce/reduce-scatter/all-to-all/
+                collective-permute counts per graph; lowered in-shardings
+                cross-checked against the declared parallel/sharding.py
+                specs; FSDP params that lower fully replicated
+  memory        peak-HBM estimate from compiled.memory_analysis() gated
+                against per-graph budgets
+
+Each graph registers through a `__graphcheck__(gc)` hook in its OWN
+module (train/step.py, llm/engine.py, rllib learner, channel.py) —
+product code never imports tools/. Per-graph fingerprints (collective
+counts by type, donated-arg set, callback count, flops/bytes) are
+committed in tools/graphcheck/fingerprints.json: ANY drift fails tier-1
+without running a benchmark. Findings diff against
+tools/graphcheck/baseline.json with the same multiset /
+`--update-baseline` / inline-`# graphcheck: ok <rule>` semantics as
+staticcheck (shared impl: tools.checklib).
+
+Run as `python -m tools.graphcheck`, through the tier-1 test
+(tests/test_graphcheck.py), or as part of the unified gate
+`python -m tools.staticcheck --all`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+from typing import Any, Callable
+
+from tools.checklib import Finding, repo_root, suppressed  # noqa: F401
+
+BASELINE_REL = "tools/graphcheck/baseline.json"
+FINGERPRINTS_REL = "tools/graphcheck/fingerprints.json"
+
+# Product modules that define a `__graphcheck__(gc)` registration hook.
+# Their sources are also the corpus for the AST companion passes
+# (host-sync coercions, recompile hazards at jit call sites).
+HOOK_MODULES = (
+    "ray_tpu.train.step",
+    "ray_tpu.llm.engine",
+    "ray_tpu.rllib.core.learner",
+    "ray_tpu.experimental.channel",
+    "ray_tpu.parallel.sharding",
+)
+
+
+@dataclasses.dataclass
+class GraphSpec:
+    """One registered hot graph under one mesh.
+
+    `fn` is the UNJITTED python callable with every static already bound
+    (functools.partial); `args` are ShapeDtypeStructs (or arrays) for the
+    dynamic arguments only — lowering never executes the graph.
+    """
+
+    name: str
+    fn: Callable
+    args: tuple
+    donate_argnums: tuple = ()
+    in_shardings: Any = None       # pytree over args (NamedShardings)
+    out_shardings: Any = None
+    # The PRODUCTION jit wrapper, when the product module builds its own
+    # (e.g. train/step.py compile_for): lowering uses it verbatim, so an
+    # edit that drops donation/shardings from the product jit site is
+    # analyzed as shipped, not as re-declared here. `donate_argnums`
+    # stays the DECLARED intent — donation.py cross-checks it against
+    # the aliasing the wrapper actually lowered.
+    jit_fn: Any = None
+    # Declared partition specs: tuple of (label-substring, PartitionSpec)
+    # pairs cross-checked against the shardings the graph actually
+    # lowered with (every flattened input arg whose label contains the
+    # substring must match). The declaration should come from
+    # parallel/sharding.py (declared_param_specs) so an edit that drops
+    # in_shardings from the jit site diverges from the declared table
+    # and fails the gate.
+    declared_in_specs: tuple = ()
+    hot: bool = True               # steady-state hot: host callbacks banned
+    min_donate_bytes: int = 1 << 16
+    # Substrings of flattened-arg labels that must NOT lower fully
+    # replicated on a multi-device mesh (the FSDP-param drift gate).
+    expect_sharded: tuple = ()
+    budget_bytes: int | None = None
+    arg_names: tuple | None = None  # labels for args; default arg0..N
+    # Filled by the registry:
+    mesh: Any = None
+    mesh_axes: dict | None = None
+    source: tuple = ("", 0)        # (repo-relative path, line) of register()
+
+
+@dataclasses.dataclass
+class _Registration:
+    name: str
+    build: Callable                # build(mesh) -> GraphSpec
+    meshes: tuple                  # tuple of {axis: size} dicts (or None)
+    source: tuple
+
+
+_REGISTRY: dict[str, _Registration] = {}
+
+
+def register(name: str, build: Callable, meshes: tuple = (None,),
+             _source: tuple | None = None) -> None:
+    """Called from a product module's `__graphcheck__(gc)` hook.
+
+    `build(mesh)` returns the GraphSpec for one mesh (mesh is None for
+    single-device). `meshes` is a tuple of {axis: size} dicts; None means
+    the default single-device lowering. Suppressions are inline comments
+    (`# graphcheck: ok <rule> — reason`) at the register() call site.
+    """
+    if _source is None:
+        f = sys._getframe(1)
+        path = os.path.abspath(f.f_code.co_filename)
+        try:
+            path = os.path.relpath(path, repo_root())
+        except ValueError:  # other drive (windows); keep absolute
+            pass
+        _source = (path, f.f_lineno)
+    _REGISTRY[name] = _Registration(name, build, tuple(meshes), _source)
+
+
+def clear_registry() -> None:
+    _REGISTRY.clear()
+
+
+def load_corpus(modules: tuple = HOOK_MODULES) -> dict:
+    """Import every hook module and run its `__graphcheck__(gc)` hook
+    against this module. Returns the registry (name -> _Registration).
+    A hook module without the hook is drift — registered in PR 10's
+    contract — and raises."""
+    import importlib
+    gc_mod = sys.modules[__name__]
+    clear_registry()
+    for modname in modules:
+        mod = importlib.import_module(modname)
+        hook = getattr(mod, "__graphcheck__", None)
+        if hook is None:
+            raise RuntimeError(
+                f"{modname} lost its __graphcheck__ hook (graphcheck "
+                "registration drift)")
+        hook(gc_mod)
+    return dict(_REGISTRY)
+
+
+def mesh_key(axes: dict | None) -> str:
+    """Size-1 axes exist only to satisfy PartitionSpecs (the repo's
+    standard mesh carries all six names); the key names the real shape."""
+    if not axes:
+        return "1dev"
+    parts = [f"{k}{v}" for k, v in axes.items() if v > 1]
+    return "_".join(parts) or "1dev"
+
+
+def _spec_suppressed(root: str, spec: GraphSpec, rule: str) -> bool:
+    path, line = spec.source
+    full = path if os.path.isabs(path) else os.path.join(root, path)
+    try:
+        with open(full) as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return False
+    return suppressed(lines, line, rule, tool="graphcheck")
+
+
+def run(root: str | None = None, *, registry: dict | None = None,
+        source_rels: tuple | None = None,
+        fingerprints_path: str | None = None,
+        corpus: list | None = None) -> list:
+    """Lower + analyze every registered graph and scan the hook-module
+    sources; returns raw findings (baseline not applied). `corpus` lets
+    tests inject pre-lowered records (lower once, analyze many)."""
+    from tools.graphcheck import (collectives, donation, fingerprint,
+                                  hostsync, lowering, memory, recompile)
+    root = root or repo_root()
+    if corpus is None:
+        if registry is None:
+            registry = load_corpus()
+        corpus = lowering.lower_all(registry)
+    findings: list[Finding] = []
+    fps: dict[str, dict] = {}
+    for rec in corpus:
+        per_graph: list[Finding] = []
+        per_graph += donation.analyze(rec)
+        cb_count, hs = hostsync.analyze(rec)
+        per_graph += hs
+        per_graph += recompile.analyze(rec)
+        coll_counts, cf = collectives.analyze(rec)
+        per_graph += cf
+        peak, mf = memory.analyze(rec)
+        per_graph += mf
+        fps[rec.graph_id] = fingerprint.build(rec, cb_count, coll_counts,
+                                              peak)
+        findings += [f for f in per_graph
+                     if not _spec_suppressed(root, rec.spec, f.rule)]
+    fpath = fingerprints_path or os.path.join(root, FINGERPRINTS_REL)
+    findings += fingerprint.diff(fps, fpath, corpus)
+    if source_rels is None:
+        source_rels = tuple(
+            m.replace(".", "/") + ".py" for m in HOOK_MODULES)
+    findings += hostsync.scan_sources(root, source_rels)
+    findings += recompile.scan_sources(root, source_rels)
+    return findings
+
+
+def current_fingerprints(corpus: list) -> dict:
+    """Fingerprints for an already-lowered corpus (used by
+    --update-baseline to rewrite fingerprints.json)."""
+    from tools.graphcheck import (collectives, fingerprint, hostsync,
+                                  memory)
+    fps = {}
+    for rec in corpus:
+        cb, _ = hostsync.analyze(rec)
+        coll, _ = collectives.analyze(rec)
+        peak, _ = memory.analyze(rec)
+        fps[rec.graph_id] = fingerprint.build(rec, cb, coll, peak)
+    return fps

@@ -1,0 +1,97 @@
+"""Finding class 4 — collective & sharding drift.
+
+Collectives are counted per type in the COMPILED module (GSPMD inserts
+them at partitioning time, after lowering — the StableHLO only carries
+sharding annotations). The counts themselves are fingerprint material
+(fingerprint.py): an edit that turns an FSDP param gather into a full
+all-gather-per-layer changes the count and fails the gate without any
+benchmark. Two findings fire directly here:
+
+`replicated-param` — a flattened input leaf whose label matches the
+spec's `expect_sharded` patterns lowered FULLY REPLICATED on a
+multi-device mesh: the FSDP/TP sharding silently fell off (the memory
+win is gone, and first use inserts an implicit broadcast).
+
+`sharding-mismatch` — the sharding the graph actually lowered with
+diverges from the spec DECLARED in parallel/sharding.py
+(GraphSpec.declared_in_specs): someone edited the jit site without
+updating the declared table, or vice versa.
+"""
+
+from __future__ import annotations
+
+import re
+
+from tools.checklib import Finding
+from tools.graphcheck.lowering import LoweredGraph
+
+COLLECTIVE_TYPES = ("all-gather", "all-reduce", "reduce-scatter",
+                    "all-to-all", "collective-permute")
+
+# Op definitions in HLO text: `%all-gather.3 = ...` or fused/async
+# `all-gather-start`. `-done` halves of async pairs are not counted.
+_OP_RE = re.compile(
+    r"=\s*\S+\s+(all-gather|all-reduce|reduce-scatter|all-to-all|"
+    r"collective-permute)(?:-start)?\(")
+
+
+def count(hlo: str) -> dict:
+    counts: dict[str, int] = {}
+    for m in _OP_RE.finditer(hlo):
+        counts[m.group(1)] = counts.get(m.group(1), 0) + 1
+    return counts
+
+
+def _norm(spec) -> tuple:
+    """PartitionSpec -> canonical tuple (trailing Nones trimmed)."""
+    parts = [tuple(p) if isinstance(p, (tuple, list)) else p
+             for p in tuple(spec)]
+    while parts and parts[-1] is None:
+        parts.pop()
+    return tuple(parts)
+
+
+def analyze(rec: LoweredGraph) -> tuple:
+    """-> (collective counts for the fingerprint, findings)."""
+    counts = count(rec.hlo) if rec.hlo else {}
+    findings: list[Finding] = []
+    spec = rec.spec
+    path, line = spec.source
+
+    multi = spec.mesh is not None and spec.mesh.devices.size > 1
+    if multi and spec.expect_sharded and rec.input_shardings:
+        for fa, sh in zip(rec.flat_in, rec.input_shardings):
+            if not any(pat in fa.label for pat in spec.expect_sharded):
+                continue
+            if int(fa.aval.size) * fa.aval.dtype.itemsize < 128:
+                continue
+            if getattr(sh, "is_fully_replicated", False):
+                findings.append(Finding(
+                    "replicated-param", path, line,
+                    f"{rec.graph_id}: {fa.label} is expected sharded "
+                    f"({'/'.join(spec.expect_sharded)}) but lowered "
+                    "fully replicated — the FSDP/TP sharding fell off"))
+
+    if spec.declared_in_specs and rec.input_shardings:
+        for pat, want in spec.declared_in_specs:
+            matched = False
+            for fa, got in zip(rec.flat_in, rec.input_shardings):
+                if pat not in fa.label:
+                    continue
+                matched = True
+                got_spec = getattr(got, "spec", None)
+                if got_spec is None:
+                    continue
+                if _norm(want) != _norm(got_spec):
+                    findings.append(Finding(
+                        "sharding-mismatch", path, line,
+                        f"{rec.graph_id}: {fa.label} lowered with "
+                        f"{tuple(got_spec)} but the declared spec is "
+                        f"{tuple(want)}"))
+            if not matched:
+                findings.append(Finding(
+                    "sharding-mismatch", path, line,
+                    f"{rec.graph_id}: declared spec pattern {pat!r} "
+                    "matches no input — declaration drifted from the "
+                    "graph"))
+    return counts, findings

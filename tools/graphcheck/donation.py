@@ -1,0 +1,87 @@
+"""Finding class 1 — donation.
+
+Two failure shapes, both invisible at runtime on CPU:
+
+`donation-missing` — a large buffer the graph THREADS (an input whose
+shape/dtype reappears in the outputs: TrainState, KV pools, optimizer
+moments) accepted by value but not donated. XLA then keeps the input
+alive across the step, doubling that buffer's HBM footprint.
+
+`donation-rejected` — `donate_argnums` was passed but XLA could not use
+the donation (dtype/shape/sharding mismatch between the donated input and
+every output). jax only WARNS — the jit runs fine, the donation is a
+silent no-op — so the warning is promoted to a gate failure here.
+
+`lowering-failed` also lives here: a registered graph that no longer
+lowers/compiles at all is the loudest drift of the lot.
+"""
+
+from __future__ import annotations
+
+import collections
+
+from tools.checklib import Finding
+from tools.graphcheck.lowering import LoweredGraph
+
+
+def _key(aval):
+    return (tuple(aval.shape), str(aval.dtype))
+
+
+def analyze(rec: LoweredGraph) -> list:
+    spec = rec.spec
+    path, line = spec.source
+    findings: list[Finding] = []
+    if rec.error is not None:
+        findings.append(Finding(
+            "lowering-failed", path, line,
+            f"{rec.graph_id}: graph no longer compiles: {rec.error}"))
+
+    out_counts = collections.Counter(_key(a) for a in rec.flat_out_avals)
+    # Donated inputs absorb their congruent outputs first, so a donated
+    # state leaf does not leave its output free to "absolve" an identical
+    # un-donated leaf.
+    for fa in rec.flat_in:
+        if fa.donated and out_counts[_key(fa.aval)] > 0:
+            out_counts[_key(fa.aval)] -= 1
+    for fa in rec.flat_in:
+        if fa.donated:
+            continue
+        size = int(fa.aval.size) * fa.aval.dtype.itemsize
+        if size < spec.min_donate_bytes:
+            continue
+        if out_counts[_key(fa.aval)] > 0:
+            out_counts[_key(fa.aval)] -= 1
+            findings.append(Finding(
+                "donation-missing", path, line,
+                f"{rec.graph_id}: {fa.label} "
+                f"({size} bytes {fa.aval.dtype}{list(fa.aval.shape)}) is "
+                "threaded through the step (congruent output) but not in "
+                "donate_argnums — its HBM is held twice"))
+    for msg in rec.donation_warnings:
+        findings.append(Finding(
+            "donation-rejected", path, line,
+            f"{rec.graph_id}: XLA rejected a declared donation "
+            f"(silent no-op): {msg}"))
+    # Registered intent vs what the production wrapper actually lowered:
+    # donate_argnums declared here but ZERO aliased outputs in the
+    # StableHLO (and no rejection warning) means the jit site itself
+    # dropped the donation.
+    if spec.donate_argnums and not rec.donation_warnings \
+            and "tf.aliasing_output" not in rec.stablehlo:
+        findings.append(Finding(
+            "donation-missing", path, line,
+            f"{rec.graph_id}: args {tuple(spec.donate_argnums)} are "
+            "registered as donated but the lowered module aliases no "
+            "output — the jit site dropped donate_argnums"))
+    return findings
+
+
+def donated_labels(rec: LoweredGraph) -> list:
+    """Top-level donated arg labels for the fingerprint (collapsed to the
+    argument, not every leaf)."""
+    names = {}
+    for fa in rec.flat_in:
+        if fa.donated:
+            names[fa.arg_idx] = fa.label.split("[")[0].split(".")[0]
+    return [names[i] for i in sorted(names)]

@@ -1,0 +1,116 @@
+"""The committed per-graph contract.
+
+tools/graphcheck/fingerprints.json maps `<graph>@<mesh>` to the
+fingerprint of its lowered+partitioned module:
+
+  collectives   {type: count} from the compiled HLO
+  donated       top-level donated argument labels
+  callbacks     host callbacks in the jaxpr
+  flops         cost_analysis() flops (4 significant digits)
+  bytes         peak-memory estimate (4 significant digits)
+
+ANY drift — a new collective, a dropped donation, an injected callback,
+a flops/bytes step change — fails tier-1 until the change is reviewed
+and `python -m tools.graphcheck --update-baseline` rewrites the file.
+A registered graph missing from the file, or a committed entry whose
+graph no longer registers, is drift too (the contract must cover the
+corpus exactly).
+
+flops/bytes are rounded to 4 significant digits: coarse enough to
+absorb backend noise, fine enough that any real graph edit (a layer, a
+gather, a dtype) moves them.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from tools.checklib import Finding
+from tools.graphcheck.lowering import LoweredGraph
+
+
+def _sig4(x):
+    if x is None:
+        return None
+    if x == 0:
+        return 0
+    from math import floor, log10
+    ndig = 3 - floor(log10(abs(x)))
+    return round(x, ndig) if ndig > 0 else int(round(x, ndig))
+
+
+def build(rec: LoweredGraph, callbacks: int, coll_counts: dict,
+          peak_bytes) -> dict:
+    from tools.graphcheck import donation
+    flops = None
+    if rec.compiled is not None:
+        try:
+            ca = rec.compiled.cost_analysis()
+            ca0 = ca[0] if isinstance(ca, (list, tuple)) else ca
+            flops = ca0.get("flops")
+        except Exception:  # noqa: BLE001 — backend-optional surface
+            flops = None
+    return {
+        "collectives": dict(sorted(coll_counts.items())),
+        "donated": donation.donated_labels(rec),
+        # Aliased-output count from the lowered module itself: a jit site
+        # that silently drops donate_argnums changes this even when the
+        # registered intent above stays the same.
+        "aliased": rec.stablehlo.count("tf.aliasing_output"),
+        "callbacks": callbacks,
+        "flops": _sig4(flops),
+        "bytes": _sig4(peak_bytes),
+    }
+
+
+def load(path: str) -> dict:
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        return json.load(f)
+
+
+def save(path: str, fps: dict) -> None:
+    with open(path, "w") as f:
+        json.dump(dict(sorted(fps.items())), f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def diff(fps: dict, path: str, corpus: list) -> list:
+    """Current fingerprints vs the committed file -> findings. Points at
+    each graph's registration site so suppressions live there."""
+    committed = load(path)
+    from tools.checklib import repo_root
+    try:
+        rel = os.path.relpath(path, repo_root())
+        if rel.startswith(".."):
+            rel = path
+    except ValueError:
+        rel = path
+    sources = {rec.graph_id: rec.spec.source for rec in corpus}
+    findings: list[Finding] = []
+    for gid, fp in sorted(fps.items()):
+        src_path, line = sources.get(gid, (rel, 0))
+        if gid not in committed:
+            findings.append(Finding(
+                "fingerprint-missing", src_path, line,
+                f"{gid}: no committed fingerprint — review and run "
+                "`python -m tools.graphcheck --update-baseline`"))
+            continue
+        want = committed[gid]
+        deltas = []
+        for k in ("collectives", "donated", "aliased", "callbacks",
+                  "flops", "bytes"):
+            if fp.get(k) != want.get(k):
+                deltas.append(f"{k} {want.get(k)!r} -> {fp.get(k)!r}")
+        if deltas:
+            findings.append(Finding(
+                "fingerprint-drift", src_path, line,
+                f"{gid}: " + "; ".join(deltas)))
+    for gid in sorted(set(committed) - set(fps)):
+        findings.append(Finding(
+            "fingerprint-stale", rel, 0,
+            f"{gid}: committed fingerprint but the graph no longer "
+            "registers — `--update-baseline` after review"))
+    return findings

@@ -1,0 +1,199 @@
+"""Finding class 2 — host-sync ops inside steady-state hot graphs.
+
+Graph side: `pure_callback` / `io_callback` / `debug_callback`
+(jax.debug.print lowers to it) primitives in the jaxpr, cross-checked
+against callback custom_calls in the StableHLO — each one is a device→
+host round trip serialized into the jitted region. Graphs registered
+with hot=True fail on any; warm-path graphs (hot=False) just carry the
+count in their fingerprint so an increase is still drift.
+
+AST companion (`host-sync-coercion`): python-scalar coercions on traced
+values at jit sites — `float(x)` / `int(x)` / `bool(x)` / `x.item()` on
+a traced parameter, or branching on one (`if x:`) — each forces a
+blocking device_get (or a TracerBoolConversionError at trace time the
+moment someone jits the caller). Only BARE parameter names of functions
+that are demonstrably jit targets in the same module are flagged, so
+config/static params named like configs stay quiet.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from tools.checklib import Finding, suppressed
+from tools.graphcheck.lowering import LoweredGraph
+
+CALLBACK_PRIMS = {"pure_callback", "io_callback", "debug_callback"}
+
+# Parameters that carry statics/configs by repo convention — never traced.
+_STATIC_NAMES = {"config", "cfg", "c", "self", "mesh", "module", "tx",
+                 "optimizer", "rules", "key_shape"}
+
+
+def _count_jaxpr_callbacks(jaxpr) -> int:
+    seen = 0
+    stack = [jaxpr.jaxpr]
+    while stack:
+        jx = stack.pop()
+        for eqn in jx.eqns:
+            if eqn.primitive.name in CALLBACK_PRIMS:
+                seen += 1
+            for v in eqn.params.values():
+                for w in (v if isinstance(v, (list, tuple)) else (v,)):
+                    inner = getattr(w, "jaxpr", None)
+                    if inner is not None:
+                        stack.append(inner)
+    return seen
+
+
+def analyze(rec: LoweredGraph) -> tuple:
+    """-> (callback count for the fingerprint, findings)."""
+    n = _count_jaxpr_callbacks(rec.jaxpr)
+    # StableHLO cross-check catches callbacks smuggled in below the jaxpr
+    # (custom lowering rules).
+    n_hlo = rec.stablehlo.count("callback")
+    count = max(n, 1 if (n == 0 and n_hlo) else n)
+    findings: list[Finding] = []
+    if rec.spec.hot and count:
+        path, line = rec.spec.source
+        findings.append(Finding(
+            "host-sync", path, line,
+            f"{rec.graph_id}: {count} host callback(s) "
+            "(pure_callback/io_callback/debug_print) inside a graph "
+            "registered as steady-state hot — each is a device->host "
+            "sync serialized into the step"))
+    return count, findings
+
+
+# ---------------- AST companion ----------------
+
+
+def _jit_target_names(tree: ast.Module) -> tuple:
+    """-> (jit-target function names, {name: kwargs bound statically}).
+
+    A name counts as a jit target when it is passed to jax.jit somewhere
+    in the module (directly, via functools.partial(fn, ...), or as a jit
+    decorator). Kwargs bound by ANY `partial(fn, kw=...)` in the module,
+    and names in literal `static_argnames`, are python statics at trace
+    time — never traced — so the coercion rules must skip them."""
+    targets: set[str] = set()
+    static_kwargs: dict[str, set] = {}
+
+    def is_jit(func) -> bool:
+        return (isinstance(func, ast.Attribute) and func.attr == "jit") \
+            or (isinstance(func, ast.Name) and func.id == "jit")
+
+    def is_partial(func) -> bool:
+        return (isinstance(func, ast.Name) and func.id == "partial") or \
+            (isinstance(func, ast.Attribute) and func.attr == "partial")
+
+    def first_fn_name(node):
+        # jax.jit(X) / jax.jit(partial(X, ...)) -> X's name
+        if isinstance(node, ast.Name):
+            return node.id
+        if isinstance(node, ast.Call) and is_partial(node.func):
+            return first_fn_name(node.args[0]) if node.args else None
+        return None
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if is_partial(node.func) and node.args:
+            name = first_fn_name(node.args[0])
+            if name:
+                static_kwargs.setdefault(name, set()).update(
+                    kw.arg for kw in node.keywords if kw.arg)
+        if is_jit(node.func) and node.args:
+            name = first_fn_name(node.args[0])
+            if name:
+                targets.add(name)
+                for kw in node.keywords:
+                    if kw.arg == "static_argnames":
+                        v = kw.value
+                        elts = v.elts if isinstance(
+                            v, (ast.Tuple, ast.List)) else [v]
+                        static_kwargs.setdefault(name, set()).update(
+                            e.value for e in elts
+                            if isinstance(e, ast.Constant))
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                d = dec.func if isinstance(dec, ast.Call) else dec
+                if is_jit(d):
+                    targets.add(node.name)
+                elif isinstance(dec, ast.Call) and any(
+                        is_jit(a) for a in dec.args):
+                    # @functools.partial(jax.jit, static_argnames=...)
+                    targets.add(node.name)
+                    for kw in dec.keywords:
+                        if kw.arg == "static_argnames":
+                            v = kw.value
+                            elts = v.elts if isinstance(
+                                v, (ast.Tuple, ast.List)) else [v]
+                            static_kwargs.setdefault(
+                                node.name, set()).update(
+                                e.value for e in elts
+                                if isinstance(e, ast.Constant))
+    return targets, static_kwargs
+
+
+def scan_sources(root: str, rels: tuple) -> list:
+    findings: list[Finding] = []
+    for rel in rels:
+        path = os.path.join(root, rel)
+        if not os.path.exists(path):
+            continue
+        with open(path) as f:
+            src = f.read()
+        lines = src.splitlines()
+        tree = ast.parse(src, filename=rel)
+        targets, static_kwargs = _jit_target_names(tree)
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if node.name not in targets:
+                continue
+            traced = {a.arg for a in node.args.args
+                      + node.args.posonlyargs}
+            traced -= _STATIC_NAMES
+            traced -= {a.arg for a in node.args.kwonlyargs}
+            traced -= static_kwargs.get(node.name, set())
+            for f in _scan_fn(node, traced, rel):
+                if not suppressed(lines, f.line, f.rule,
+                                  tool="graphcheck"):
+                    findings.append(f)
+    return findings
+
+
+def _scan_fn(fn, traced: set, rel: str) -> list:
+    out: list[Finding] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name) and f.id in ("float", "int",
+                                                    "bool") \
+                    and len(node.args) == 1 \
+                    and isinstance(node.args[0], ast.Name) \
+                    and node.args[0].id in traced:
+                out.append(Finding(
+                    "host-sync-coercion", rel, node.lineno,
+                    f"{f.id}({node.args[0].id}) coerces traced value "
+                    f"'{node.args[0].id}' to a python scalar inside jit "
+                    f"target {fn.name} (device sync / trace error)"))
+            elif isinstance(f, ast.Attribute) and f.attr == "item" \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id in traced:
+                out.append(Finding(
+                    "host-sync-coercion", rel, node.lineno,
+                    f"{f.value.id}.item() on traced value inside jit "
+                    f"target {fn.name}"))
+        elif isinstance(node, (ast.If, ast.While)) \
+                and isinstance(node.test, ast.Name) \
+                and node.test.id in traced:
+            out.append(Finding(
+                "host-sync-coercion", rel, node.lineno,
+                f"branching on traced value '{node.test.id}' inside jit "
+                f"target {fn.name} (implicit bool() -> device sync)"))
+    return out

@@ -1,0 +1,42 @@
+"""Finding class 5 — peak-memory estimate gated per graph.
+
+`compiled.memory_analysis()` (CompiledMemoryStats, where the backend
+provides it) gives argument + output + temp sizes for the compiled
+module; their sum is the static peak-HBM estimate for one execution —
+donation shows up here directly (a donated input's buffer is aliased
+into an output instead of counted twice via temp). Graphs registered
+with a `budget_bytes` fail when the estimate exceeds it; every graph
+carries the estimate in its fingerprint so an unbudgeted regression is
+still drift.
+
+The estimate is CPU-lowered, so absolute numbers differ from real TPU
+HBM (no rematerialization tuning, different layout padding) — budgets
+gate the ORDER of the footprint, not the exact byte.
+"""
+
+from __future__ import annotations
+
+from tools.checklib import Finding
+from tools.graphcheck.lowering import LoweredGraph
+
+
+def analyze(rec: LoweredGraph) -> tuple:
+    """-> (peak-bytes estimate or None, findings)."""
+    if rec.compiled is None:
+        return None, []
+    try:
+        ma = rec.compiled.memory_analysis()
+        peak = int(ma.argument_size_in_bytes + ma.output_size_in_bytes
+                   + ma.temp_size_in_bytes - ma.alias_size_in_bytes)
+    except Exception:  # noqa: BLE001 — backend-optional surface
+        return None, []
+    findings: list[Finding] = []
+    spec = rec.spec
+    if spec.budget_bytes is not None and peak > spec.budget_bytes:
+        path, line = spec.source
+        findings.append(Finding(
+            "hbm-over-budget", path, line,
+            f"{rec.graph_id}: peak-memory estimate {peak} bytes exceeds "
+            f"the registered budget {spec.budget_bytes} (args+outputs+"
+            "temps-aliased)"))
+    return peak, findings

@@ -1,0 +1,175 @@
+"""Finding class 3 — recompile hazards.
+
+Graph side (`weak-type-input`): a registered graph whose example inputs
+carry weak types. A weak-typed aval means the call site fed a bare
+python scalar; jax keys the executable cache on weak_type, so the same
+graph called once with `0.1` and once with `jnp.float32(0.1)` compiles
+TWICE — the classic "why is decode recompiling every other step".
+
+Source side (AST over the hook modules):
+
+  jit-per-call          `jax.jit(f)(x)` — the wrapper (and its whole
+                        executable cache) is rebuilt on every call.
+  jit-in-loop           `jax.jit(...)` constructed inside a for/while
+                        body — same hazard, loop-shaped. The repo idiom
+                        is the process-global `_shared_jit` cache.
+  unstable-static-arg   a call site of a known static-arg jit wrapper
+                        passing a freshly-constructed object (Call/dict/
+                        list literal) in a static position: every call
+                        builds a new key, and unless the type defines
+                        stable __hash__/__eq__ the compile cache forks
+                        per call.
+
+The runtime half of this finding class is ray_tpu.diagnostics.jit_misses
+(a process-global compile counter) asserted flat over steady-state steps
+in the engine/train tests.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from tools.checklib import Finding, suppressed
+from tools.graphcheck.lowering import LoweredGraph
+
+
+def analyze(rec: LoweredGraph) -> list:
+    findings: list[Finding] = []
+    path, line = rec.spec.source
+    weak = [v for v in rec.jaxpr.jaxpr.invars
+            if getattr(v.aval, "weak_type", False)]
+    if weak:
+        labels = [fa.label for fa, v in zip(rec.flat_in,
+                                            rec.jaxpr.jaxpr.invars)
+                  if getattr(v.aval, "weak_type", False)]
+        findings.append(Finding(
+            "weak-type-input", path, line,
+            f"{rec.graph_id}: {len(weak)} weak-typed input(s) "
+            f"({', '.join(labels[:4])}) — a python scalar fed as a "
+            "traced arg forks the compile cache (weak vs strong dtype)"))
+    return findings
+
+
+# ---------------- AST pass ----------------
+
+
+def _is_jit(func) -> bool:
+    return (isinstance(func, ast.Attribute) and func.attr == "jit") \
+        or (isinstance(func, ast.Name) and func.id == "jit")
+
+
+def _static_names(call: ast.Call) -> tuple:
+    """static_argnames of a jax.jit(...) call, when literal."""
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            v = kw.value
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return tuple(e.value for e in v.elts
+                             if isinstance(e, ast.Constant))
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                return (v.value,)
+    return ()
+
+
+def _fresh_object(node) -> str | None:
+    """An expression that constructs a new object per call: a Call, or a
+    dict/list/set literal."""
+    if isinstance(node, ast.Call):
+        try:
+            return ast.unparse(node.func)
+        except Exception:  # noqa: BLE001 — display only
+            return "<call>"
+    if isinstance(node, (ast.Dict, ast.List, ast.Set)):
+        return type(node).__name__.lower() + " literal"
+    return None
+
+
+def scan_sources(root: str, rels: tuple) -> list:
+    findings: list[Finding] = []
+    for rel in rels:
+        path = os.path.join(root, rel)
+        if not os.path.exists(path):
+            continue
+        with open(path) as f:
+            src = f.read()
+        lines = src.splitlines()
+        tree = ast.parse(src, filename=rel)
+        for f_ in _scan_tree(tree, rel):
+            if not suppressed(lines, f_.line, f_.rule, tool="graphcheck"):
+                findings.append(f_)
+    return findings
+
+
+def _scan_tree(tree: ast.Module, rel: str) -> list:
+    out: list[Finding] = []
+    # name -> static argnames, for wrappers assigned at module/class level
+    # (x = jax.jit(f, static_argnames=...)) and decorated defs.
+    static_jits: dict[str, tuple] = {}
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                       ast.Call) \
+                and _is_jit(node.value.func):
+            names = _static_names(node.value)
+            if names:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        static_jits[t.id] = names
+                    elif isinstance(t, ast.Attribute):
+                        static_jits[t.attr] = names
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    # @partial(jax.jit, static_argnames=...) or
+                    # @jax.jit(static_argnames=...)
+                    if _is_jit(dec.func) or any(_is_jit(a)
+                                                for a in dec.args):
+                        names = _static_names(dec)
+                        if names:
+                            static_jits[node.name] = names
+
+    loop_stack: list = []
+
+    def visit(node, in_loop: bool):
+        if isinstance(node, ast.Call):
+            if _is_jit(node.func):
+                if in_loop:
+                    out.append(Finding(
+                        "jit-in-loop", rel, node.lineno,
+                        "jax.jit(...) constructed inside a loop body — "
+                        "rebuilds the wrapper (and its executable cache) "
+                        "per iteration; hoist or use _shared_jit"))
+            # jax.jit(f)(x): the jit call is itself the callee.
+            if isinstance(node.func, ast.Call) and _is_jit(node.func.func):
+                out.append(Finding(
+                    "jit-per-call", rel, node.lineno,
+                    "jax.jit(f)(...) builds a fresh wrapper per call — "
+                    "every invocation retraces and recompiles"))
+            callee = node.func
+            cname = callee.id if isinstance(callee, ast.Name) else (
+                callee.attr if isinstance(callee, ast.Attribute) else None)
+            statics = static_jits.get(cname or "", ())
+            for kw in node.keywords:
+                if kw.arg in statics:
+                    fresh = _fresh_object(kw.value)
+                    if fresh:
+                        out.append(Finding(
+                            "unstable-static-arg", rel, node.lineno,
+                            f"call to {cname} passes freshly-constructed "
+                            f"{fresh} as static arg '{kw.arg}' — a new "
+                            "cache key (likely a recompile) per call"))
+        loop = in_loop or isinstance(node, (ast.For, ast.While,
+                                            ast.AsyncFor))
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                # Nested defs run later, outside the loop's per-iteration
+                # path... unless they are immediately called; keep simple
+                # and scan them as non-loop bodies.
+                visit(child, False)
+            else:
+                visit(child, loop)
+
+    visit(tree, False)
+    return out

@@ -26,33 +26,10 @@ pre-existing debt lives in tools/staticcheck/baseline.json
 
 from __future__ import annotations
 
-import dataclasses
-import os
-
-
-@dataclasses.dataclass(frozen=True)
-class Finding:
-    """One violation. `detail` is the line-number-free fingerprint the
-    baseline matches on (line numbers drift with every edit; the shape of
-    the violation does not)."""
-
-    rule: str        # e.g. "blocking-under-lock"
-    path: str        # repo-relative
-    line: int        # 1-based; 0 = whole-file finding
-    detail: str      # stable fingerprint, no line numbers
-    message: str = ""  # human text; defaults to detail
-
-    def render(self) -> str:
-        msg = self.message or self.detail
-        return f"{self.path}:{self.line}: [{self.rule}] {msg}"
-
-    def key(self) -> tuple:
-        return (self.rule, self.path, self.detail)
-
-
-def repo_root() -> str:
-    return os.path.dirname(os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__))))
+# Findings/suppression/baseline plumbing is shared with tools.graphcheck
+# (the lowered-XLA-graph plane); re-exported here so every existing
+# `from tools.staticcheck import Finding` caller keeps working.
+from tools.checklib import Finding, repo_root  # noqa: F401
 
 
 PASSES = ("wire_drift", "concurrency", "hot_plane", "resources",

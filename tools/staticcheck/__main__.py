@@ -1,7 +1,11 @@
 """CLI: `python -m tools.staticcheck [--passes a,b] [--update-baseline]`.
 
 Exit codes: 0 clean (all findings covered by the baseline), 1 new
-violations, 2 usage/internal error.
+violations, 2 usage/internal error. `--all` additionally runs the
+lowered-XLA-graph plane (`tools.graphcheck`) and merges exit codes, so
+ONE command gates the whole static plane:
+
+    python -m tools.staticcheck --all
 """
 
 from __future__ import annotations
@@ -35,6 +39,10 @@ def main(argv=None) -> int:
                         "and treat each as a module-level no-pickle "
                         "scope for hot_plane (fixture/debug mode; "
                         "wire_drift is skipped)")
+    p.add_argument("--all", action="store_true",
+                   help="also run tools.graphcheck (lowered-XLA-graph "
+                        "gates); exit nonzero if EITHER plane reports "
+                        "new findings")
     args = p.parse_args(argv)
 
     passes = tuple(s for s in args.passes.split(",") if s)
@@ -68,7 +76,15 @@ def main(argv=None) -> int:
     print(f"{len(findings)} finding(s): {len(new)} new, "
           f"{n_base} baselined, {len(stale)} stale baseline entr(ies)",
           file=sys.stderr)
-    return 1 if new else 0
+    rc = 1 if new else 0
+    if args.all:
+        print("--- graphcheck (lowered-XLA-graph plane) ---",
+              file=sys.stderr)
+        from tools.graphcheck.__main__ import main as graph_main
+        grc = graph_main(["--root", args.root]
+                         + (["--no-baseline"] if args.no_baseline else []))
+        rc = max(rc, grc)
+    return rc
 
 
 def _run_on_files(root: str, passes: tuple, files: tuple) -> list:

@@ -1,54 +1,15 @@
 """Baseline workflow: existing debt is recorded, new violations fail.
 
-The baseline file is a JSON list of {rule, path, detail} entries —
-line-number-free fingerprints, so routine edits above a recorded site do
-not churn it. Matching is multiset-aware: two identical recorded entries
-absorb two identical findings; a third is NEW and fails the run.
-
-`python -m tools.staticcheck --update-baseline` rewrites the file from
-the current findings (the reviewed way to accept debt); stale entries
-(recorded but no longer firing) are reported as warnings and dropped on
-the next update, so the debt ledger only ever shrinks by paying it.
+The mechanics (line-number-free fingerprints, multiset matching,
+`--update-baseline`, stale-entry warnings) live in tools.checklib and are
+shared with tools.graphcheck; this module pins staticcheck's baseline
+location and keeps the long-standing load/save/diff API.
 """
 
 from __future__ import annotations
 
-import collections
-import json
-import os
-
-from tools.staticcheck import Finding
+from tools.checklib import (diff_baseline as diff,  # noqa: F401
+                            load_baseline as load,
+                            save_baseline as save)
 
 BASELINE_REL = "tools/staticcheck/baseline.json"
-
-
-def load(path: str) -> collections.Counter:
-    if not os.path.exists(path):
-        return collections.Counter()
-    with open(path) as f:
-        entries = json.load(f)
-    return collections.Counter(
-        (e["rule"], e["path"], e["detail"]) for e in entries)
-
-
-def save(path: str, findings: list) -> None:
-    entries = sorted(
-        ({"rule": f.rule, "path": f.path, "detail": f.detail}
-         for f in findings),
-        key=lambda e: (e["rule"], e["path"], e["detail"]))
-    with open(path, "w") as f:
-        json.dump(entries, f, indent=1)
-        f.write("\n")
-
-
-def diff(findings: list, baseline: collections.Counter):
-    """-> (new findings, stale baseline keys)."""
-    remaining = collections.Counter(baseline)
-    new: list[Finding] = []
-    for f in sorted(findings, key=lambda f: (f.path, f.line)):
-        if remaining[f.key()] > 0:
-            remaining[f.key()] -= 1
-        else:
-            new.append(f)
-    stale = sorted(k for k, n in remaining.items() if n > 0)
-    return new, stale

@@ -32,7 +32,9 @@ import re
 
 from tools.staticcheck import Finding
 
-# The lock-heavy core planes the paper's L0/L1 substrate lives in.
+# The lock-heavy core planes the paper's L0/L1 substrate lives in, plus
+# the train/tune/serve planes (PR 9 put real lock/thread/fd traffic into
+# train's elastic checkpoint + watchdog paths).
 TARGETS = (
     "ray_tpu/core/node_agent.py",
     "ray_tpu/core/worker.py",
@@ -40,6 +42,15 @@ TARGETS = (
     "ray_tpu/core/object_store.py",
     "ray_tpu/core/objxfer.py",
     "ray_tpu/core/task_events.py",
+    "ray_tpu/train/backend.py",
+    "ray_tpu/train/checkpoint.py",
+    "ray_tpu/train/session.py",
+    "ray_tpu/train/step.py",
+    "ray_tpu/train/trainer.py",
+    "ray_tpu/tune/schedulers.py",
+    "ray_tpu/tune/search.py",
+    "ray_tpu/tune/tuner.py",
+    "ray_tpu/llm/serve.py",
 )
 
 SEND_LOCKS = {"send_lock", "flush_lock", "head_lock"}
@@ -79,26 +90,9 @@ def _lock_like(name: str) -> bool:
 
 
 def suppressed(lines: list, lineno: int, rule: str) -> bool:
-    """`# staticcheck: ok <rule>` on the line, or anywhere in the block
-    of comment/blank lines immediately above it (so a marker can open a
-    multi-line justification comment)."""
-    def marked(ln: int) -> bool:
-        m = re.search(r"#\s*staticcheck:\s*ok\s+([\w,-]+)", lines[ln - 1])
-        return bool(m) and rule in m.group(1).split(",")
-
-    if not 1 <= lineno <= len(lines):
-        return False
-    if marked(lineno):
-        return True
-    ln = lineno - 1
-    while ln >= 1:
-        stripped = lines[ln - 1].strip()
-        if stripped and not stripped.startswith("#"):
-            return False
-        if stripped and marked(ln):
-            return True
-        ln -= 1
-    return False
+    """`# staticcheck: ok <rule>` markers (shared impl in checklib)."""
+    from tools.checklib import suppressed as _supp
+    return _supp(lines, lineno, rule, tool="staticcheck")
 
 
 # ---------------- corpus model ----------------

@@ -31,7 +31,9 @@ import os
 from tools.staticcheck import Finding
 from tools.staticcheck.concurrency import suppressed
 
-TARGET_GLOBS = ("ray_tpu/core/*.py", "ray_tpu/experimental/channel.py")
+TARGET_GLOBS = ("ray_tpu/core/*.py", "ray_tpu/experimental/channel.py",
+                "ray_tpu/train/*.py", "ray_tpu/tune/*.py",
+                "ray_tpu/llm/serve.py")
 
 _FD_CTORS = {
     ("socket", "socket"), ("socket", "create_connection"),
