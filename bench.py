@@ -145,6 +145,7 @@ def final_line(status: str = "complete"):
         "cross_language": EXTRAS.get("cross_language", {}),
         "chaos_storm": EXTRAS.get("chaos_storm", {}),
         "elastic_train": EXTRAS.get("elastic_train", {}),
+        "serve_storm": EXTRAS.get("serve_storm", {}),
         "tpu_mfu_pct": mfu,
         "tpu": TPU,
         "detail": {k: round(v, 1) for k, v in RESULTS.items()},
@@ -192,6 +193,19 @@ def final_line(status: str = "complete"):
         # restored exactly the pre-death state).
         "train_rec_s": EXTRAS.get("elastic_train", {}).get("recovery_s"),
         "train_bit": EXTRAS.get("elastic_train", {}).get("bit_stable"),
+        # Disaggregated serving plane: the open-loop storm's latency
+        # headline, the dense-vs-disagg p99 ratio, the mid-storm-kill
+        # p99, and the zero-admitted-drops verdict (must be 0).
+        "serve_p50_ms": EXTRAS.get("serve_storm", {}).get(
+            "disagg", {}).get("p50_ms"),
+        "serve_p99_ms": EXTRAS.get("serve_storm", {}).get(
+            "disagg", {}).get("p99_ms"),
+        "serve_dvd_x": EXTRAS.get("serve_storm", {}).get(
+            "dense_vs_disagg_p99_x"),
+        "serve_kill_p99_ms": EXTRAS.get("serve_storm", {}).get(
+            "disagg_kill", {}).get("p99_ms"),
+        "serve_drop": EXTRAS.get("serve_storm", {}).get(
+            "disagg_kill", {}).get("dropped"),
         "tev_ovh_pct": EXTRAS.get("task_events", {}).get("overhead_pct"),
         "xlang_s": EXTRAS.get("cross_language", {}).get(
             "cpp_tasks_async_s"),
@@ -218,6 +232,8 @@ def final_line(status: str = "complete"):
     if len(line) >= 2048:
         for key in ("host", "tpu_mfu_pct", "xlang_s", "tev_ovh_pct",
                     "adag_x", "chaos_x", "train_bit", "train_rec_s",
+                    "serve_p50_ms", "serve_dvd_x", "serve_kill_p99_ms",
+                    "serve_p99_ms", "serve_drop",
                     "n_skipped", "n_missing",
                     "n_metrics", "wall_s", "status", "mc_put_x",
                     "nn_async_x"):
@@ -1028,6 +1044,138 @@ ray_tpu.shutdown()
             "kill": "train.worker_kill:12 (rank 1, seeded)",
         }
 
+    def sec_serve_storm():
+        # Disaggregated LLM serving plane (llm/serve.py, ROADMAP item 1):
+        # the same open-loop arrival curve (requests fire on a fixed QPS
+        # schedule regardless of completions — the million-user shape)
+        # driven at (a) the disaggregated prefill/decode app, (b) a dense
+        # 2-replica LLMServer comparator, and (c) the disaggregated app
+        # with every decode replica armed to SIGKILL itself mid-storm
+        # (serve.decode.kill, fixed seed; respawns come back clean).
+        # Contract: admitted requests NEVER drop — overflow sheds loudly
+        # (OverloadedError) at admission, and mid-storm replica death
+        # degrades p99 while every in-flight stream re-resolves
+        # exactly-once. p50/p99 land in the headline.
+        code = r"""
+import json, threading, time
+import ray_tpu
+from ray_tpu import serve as serve_api
+from ray_tpu.core.status import OverloadedError, RayTpuError
+from ray_tpu.llm import (DisaggConfig, EngineConfig, LLMConfig,
+                         build_disagg_deployment, build_llm_deployment)
+from ray_tpu.models import ModelConfig
+
+MODEL = ModelConfig(vocab=300, d_model=64, n_layers=2, n_heads=4,
+                    n_kv_heads=2, d_ff=128, dtype="float32")
+ENG = EngineConfig(max_slots=4, max_len=96, prompt_buckets=(32,),
+                   eos_token=-1, default_max_new_tokens=16, page_size=16)
+QPS, N_REQ, MAX_NEW = 4.0, 32, 16
+PROMPTS = ["storm tenant %d asks question %d" % (i % 4, i)
+           for i in range(N_REQ)]
+
+rt = ray_tpu.init(num_cpus=6)
+
+def storm(handle, tag):
+    lat, shed, dropped = [], [], []
+    lock = threading.Lock()
+    t0 = time.monotonic()
+    def fire(i, p):
+        t_sched = t0 + i / QPS
+        time.sleep(max(0.0, t_sched - time.monotonic()))
+        ts = time.monotonic()
+        try:
+            out = handle.completions.remote(
+                p, max_tokens=MAX_NEW, temperature=0.0).result(timeout_s=120)
+            ok = out["usage"]["completion_tokens"] > 0
+            with lock:
+                (lat if ok else dropped).append(
+                    (time.monotonic() - ts) * 1e3 if ok else p)
+        except OverloadedError:
+            with lock:
+                shed.append(p)
+        except Exception as e:
+            if "OverloadedError" in str(e) or "overloaded" in str(e):
+                with lock:
+                    shed.append(p)
+            else:
+                with lock:
+                    dropped.append("%s: %r" % (p, e))
+    ths = [threading.Thread(target=fire, args=(i, p))
+           for i, p in enumerate(PROMPTS)]
+    for t in ths: t.start()
+    for t in ths: t.join(timeout=240)
+    lat.sort()
+    def pct(q):
+        return round(lat[min(int(q * len(lat)), len(lat) - 1)], 1) if lat else None
+    return {"tag": tag, "admitted": len(lat), "shed": len(shed),
+            "dropped": len(dropped), "drop_detail": dropped[:3],
+            "p50_ms": pct(0.50), "p99_ms": pct(0.99),
+            "wall_s": round(time.monotonic() - t0, 1)}
+
+# (a) disaggregated: 1 prefill + 2 decode + coordinator, token budgets
+# sized so the 4 QPS open-loop curve overflows into sheds at the burst.
+cfg = LLMConfig(model_id="storm", model=MODEL, engine=ENG, tokenizer="byte")
+dapp = build_disagg_deployment(cfg, DisaggConfig(
+    decode_replicas=2, max_decode_inflight_tokens=320,
+    max_prefill_queue_tokens=512))
+serve_api.run(dapp, name="disagg", route_prefix=None, http_port=18311,
+              blocking_timeout_s=300)
+h = serve_api.get_deployment_handle("DisaggLLMServer:storm", "disagg")
+h.completions.remote(PROMPTS[0], max_tokens=4, temperature=0.0).result(
+    timeout_s=240)  # warm the compile caches before the clock starts
+r_disagg = storm(h, "disagg")
+
+# (c) the same curve with every decode replica armed to die mid-storm
+dec = serve_api.get_deployment_handle("DecodePool:storm", "disagg")
+pids = set()
+for _ in range(30):
+    pids.add(dec.configure_chaos.remote("serve.decode.kill:24", 42
+                                        ).result(timeout_s=60))
+    if len(pids) >= 2: break
+r_kill = storm(h, "disagg_kill")
+stats = h.stats.remote().result(timeout_s=30)
+serve_api.delete("disagg")
+
+# (b) dense comparator: 2 monolithic engine replicas, no admission plane
+cfg2 = LLMConfig(model_id="storm", model=MODEL, engine=ENG,
+                 tokenizer="byte", num_replicas=2)
+serve_api.run(build_llm_deployment(cfg2), name="dense", route_prefix=None,
+              http_port=18312, blocking_timeout_s=300)
+hd = serve_api.get_deployment_handle("LLMServer:storm", "dense")
+hd.completions.remote(PROMPTS[0], max_tokens=4, temperature=0.0).result(
+    timeout_s=240)
+r_dense = storm(hd, "dense")
+serve_api.delete("dense")
+
+assert r_kill["dropped"] == 0, r_kill   # zero admitted requests dropped
+print("STORM_RES", json.dumps({
+    "qps": QPS, "n_req": N_REQ, "max_new": MAX_NEW,
+    "disagg": r_disagg, "disagg_kill": r_kill, "dense": r_dense,
+    "armed_replicas": len(pids),
+    "streams_resumed": stats.get("streams_resumed", 0),
+    "decode_failures": stats.get("decode_failures", 0)}))
+ray_tpu.shutdown()
+"""
+        out = run_sub(code, timeout=min(420, max(180, _remaining() - 20)),
+                      tag="serve_storm")
+        res = json.loads([ln for ln in out.splitlines()
+                          if ln.startswith("STORM_RES")][0][10:])
+        d, k, dn = res["disagg"], res["disagg_kill"], res["dense"]
+        emit("serve_storm_p99_ms", d["p99_ms"] or 0.0)
+        EXTRAS["serve_storm"] = {
+            "open_loop_qps": res["qps"], "n_req": res["n_req"],
+            "max_new_tokens": res["max_new"],
+            "disagg": d, "disagg_kill": k, "dense": dn,
+            "dense_vs_disagg_p99_x": (round(dn["p99_ms"] / d["p99_ms"], 2)
+                                      if d["p99_ms"] and dn["p99_ms"]
+                                      else None),
+            "kill": {"schedule": "serve.decode.kill:24 (both replicas, "
+                                 "seed 42)",
+                     "streams_resumed": res["streams_resumed"],
+                     "decode_failures": res["decode_failures"],
+                     "admitted_dropped": k["dropped"]},
+        }
+
     sections = [
         ("tasks", 120, sec_tasks),
         ("actors", 150, sec_actors),
@@ -1040,6 +1188,7 @@ ray_tpu.shutdown()
         ("chaos", 150, sec_chaos),
         ("elastic_train", 60, sec_elastic_train),
         ("many_agents", 180, sec_many_agents),
+        ("serve_storm", 180, sec_serve_storm),
     ]
     # Resilience-test hooks: a section that hangs forever and one that
     # throws, injectable so the watchdog/headline contract stays pinned

@@ -81,6 +81,18 @@ REGISTERED_SITES: dict[str, str] = {
     "train.poll_hang": "a train worker's poll() wedges without dying "
                        "(the hung-not-dead worker the watchdog converts "
                        "into a FailurePolicy restart)",
+    "serve.router.drop": "the serving coordinator's routed decode "
+                         "dispatch is dropped before it reaches the "
+                         "pool (redriven through the shared backoff)",
+    "serve.kv_handoff.lose": "the sealed prefill->decode KV handoff "
+                             "object is lost in flight — the decode "
+                             "replica must fall back to re-prefilling",
+    "serve.decode.kill": "a decode replica self-SIGKILLs mid-stream "
+                         "(one hit per emitted stream chunk; in-flight "
+                         "streams must re-resolve exactly-once on a "
+                         "surviving replica)",
+    "serve.prefill.stall": "the prefill worker stalls by a seeded "
+                           "jitter before returning its KV handoff",
 }
 
 

@@ -87,3 +87,8 @@ class ResourceError(RayTpuError):
 
 class OutOfMemoryError(RayTpuError):
     pass
+
+
+class OverloadedError(RayTpuError):
+    """A serving-plane admission controller shed this request (fast, loud
+    backpressure instead of queue collapse). Retry later or elsewhere."""

@@ -11,10 +11,14 @@ Parity map to the reference's `python/ray/llm/`:
 
 from ray_tpu.llm.batch import build_llm_processor
 from ray_tpu.llm.config import EngineConfig, LLMConfig, LoraConfig
-from ray_tpu.llm.engine import InferenceEngine
-from ray_tpu.llm.serve import build_llm_deployment, build_openai_app
+from ray_tpu.llm.engine import InferenceEngine, PrefillEngine
+from ray_tpu.llm.serve import (DisaggConfig, build_disagg_deployment,
+                               build_disagg_openai_app,
+                               build_llm_deployment, build_openai_app)
 
 __all__ = [
-    "InferenceEngine", "EngineConfig", "LLMConfig", "LoraConfig",
-    "build_llm_processor", "build_llm_deployment", "build_openai_app",
+    "InferenceEngine", "PrefillEngine", "EngineConfig", "LLMConfig",
+    "LoraConfig", "DisaggConfig", "build_llm_processor",
+    "build_llm_deployment", "build_openai_app",
+    "build_disagg_deployment", "build_disagg_openai_app",
 ]

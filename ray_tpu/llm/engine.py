@@ -106,6 +106,11 @@ class Request:
     # to the plain path for these requests).
     logprobs: bool = False
     token_logprobs: list = dataclasses.field(default_factory=list)
+    # Disaggregated serving: a (ks, vs) prompt-KV handoff exported by a
+    # prefill worker (PrefillEngine.prefill_export). The pump imports it
+    # into the prefix cache right before this request's admission, so the
+    # suffix prefill only covers what the handoff does not.
+    kv_handoff: tuple | None = None
 
 
 # ---------------- pure model steps ----------------
@@ -711,6 +716,37 @@ def sample(logits, temperature, key, top_p=None, top_k=None, mask=None):
 # ---------------- the engine ----------------
 
 
+def _resolve_params(model_config: ModelConfig, params, mesh, rules,
+                    seed: int):
+    """Init (or accept) params and shard them over the replica mesh —
+    shared by the decode engine and the prefill-pool engine."""
+    if params is None:
+        params = init_params(model_config, jax.random.PRNGKey(seed))
+    if mesh is not None:
+        from ray_tpu.models import param_logical_axes
+        from ray_tpu.parallel.sharding import ShardingRules, shard_params
+        rules = rules or ShardingRules.default()
+        params = shard_params(params, param_logical_axes(model_config),
+                              rules, mesh)
+    return params
+
+
+def _prompt_bucket(e: EngineConfig, n: int) -> int:
+    """The prefill compile bucket for an n-token prompt. Buckets above
+    max_len are unusable: their prefill KV could not be spliced into the
+    [.., max_len, ..] cache."""
+    usable = [b for b in e.prompt_buckets if b <= e.max_len]
+    limit = min(max(usable, default=0), e.max_len - 1)
+    if n > limit:
+        raise ValueError(
+            f"prompt of {n} tokens exceeds the engine limit {limit} "
+            f"(buckets={e.prompt_buckets}, max_len={e.max_len})")
+    for b in usable:
+        if n <= b:
+            return b
+    raise ValueError(f"no prompt bucket fits {n} tokens")
+
+
 class InferenceEngine:
     """Slot-based continuous batching over the jitted steps above.
 
@@ -724,16 +760,8 @@ class InferenceEngine:
         self.c = model_config
         self.e = engine_config or EngineConfig()
         self.mesh = mesh
-        if params is None:
-            params = init_params(model_config, jax.random.PRNGKey(seed))
-        if mesh is not None:
-            from ray_tpu.models import param_logical_axes
-            from ray_tpu.parallel.sharding import (ShardingRules,
-                                                   shard_params)
-            rules = rules or ShardingRules.default()
-            params = shard_params(params, param_logical_axes(model_config),
-                                  rules, mesh)
-        self.params = params
+        self.params = _resolve_params(model_config, params, mesh, rules,
+                                      seed)
         c, e = self.c, self.e
         self.paged = e.kv_layout == "paged"
         kv_sharding = None
@@ -865,7 +893,15 @@ class InferenceEngine:
     def add_request(self, prompt_tokens, max_new_tokens=None,
                     temperature=None, top_p: float = 1.0,
                     top_k: int = 0, guide=None,
-                    logprobs: bool = False) -> int:
+                    logprobs: bool = False, resume_token: int | None = None,
+                    kv_handoff: tuple | None = None) -> int:
+        """`resume_token`/`kv_handoff` serve the disaggregated decode pool:
+        resume_token is a token already SAMPLED for this sequence (by a
+        prefill worker, or by a decode replica that died mid-stream) —
+        decoding resumes from it without re-sampling its position;
+        kv_handoff is the exported prompt KV the pump imports into the
+        prefix cache at admission (import_kv) so only the un-handed-off
+        suffix re-prefills."""
         # Validate at submission, in the CALLER's thread: an invalid prompt
         # must fail its own request, not blow up the shared engine pump.
         if self._chunk_size() and len(prompt_tokens) < self.e.max_len:
@@ -875,6 +911,10 @@ class InferenceEngine:
         if (guide is not None or logprobs) and not self.paged:
             raise ValueError("guided decoding / logprobs require the "
                              "paged KV layout")
+        if ((resume_token is not None or kv_handoff is not None)
+                and not self.paged):
+            raise ValueError("decode-state resume / KV handoff require "
+                             "the paged KV layout")
         if guide is not None:
             if guide.table.shape[1] != self.c.vocab:
                 raise ValueError(
@@ -888,7 +928,13 @@ class InferenceEngine:
             max_new_tokens or self.e.default_max_new_tokens,
             self.e.default_temperature if temperature is None
             else temperature, top_p=float(top_p), top_k=int(top_k),
-            guide=guide, logprobs=bool(logprobs))
+            guide=guide, logprobs=bool(logprobs), kv_handoff=kv_handoff)
+        if resume_token is not None:
+            # Same contract as preemption resume: the token is already part
+            # of the sequence (it counts against max_new_tokens) and seeds
+            # decoding without re-sampling its position.
+            req.generated.append(int(resume_token))
+            req.resume_token = int(resume_token)
         self.queue.append(req)
         return rid
 
@@ -950,19 +996,7 @@ class InferenceEngine:
         return (max(usable) // page) * page
 
     def _bucket(self, n: int) -> int:
-        # Buckets above max_len are unusable: their prefill KV could not be
-        # spliced into the [.., max_len, ..] cache.
-        usable = [b for b in self.e.prompt_buckets if b <= self.e.max_len]
-        limit = min(max(usable, default=0), self.e.max_len - 1)
-        if n > limit:
-            raise ValueError(
-                f"prompt of {n} tokens exceeds the engine limit {limit} "
-                f"(buckets={self.e.prompt_buckets}, "
-                f"max_len={self.e.max_len})")
-        for b in usable:
-            if n <= b:
-                return b
-        raise ValueError(f"no prompt bucket fits {n} tokens")
+        return _prompt_bucket(self.e, n)
 
     # ---- page pool (paged layout only) ----
 
@@ -1025,6 +1059,55 @@ class InferenceEngine:
             pages.append(pid)
         return pages
 
+    def import_kv(self, prompt_tokens, ks, vs) -> int:
+        """Splice a handed-off prompt KV (PrefillEngine.prefill_export
+        output: [L, S, hkv, hd] host arrays, K post-RoPE at absolute
+        positions) into the paged pool as prefix-cache pages. The pages
+        land ref-0 in the eviction LRU — exactly like pages released by a
+        finished request — so the next admission of this prompt (or any
+        prompt sharing the prefix) pins them via the normal prefix-hit
+        path and only prefills the tail. Returns pages imported.
+
+        NOT thread-safe against step(): call from the pump thread (the
+        engine queue's kv_handoff field routes a handoff there)."""
+        if not (self.paged and self.e.prefix_cache):
+            return 0
+        page = self.e.page_size
+        prompt = list(map(int, prompt_tokens))
+        full = len(prompt) // page
+        if full * page == len(prompt):
+            full -= 1  # >=1 token always re-prefills (its logits seed)
+        full = min(full, int(ks.shape[1]) // page)
+        if full <= 0:
+            return 0
+        hit = len(self._find_prefix(prompt))
+        if hit >= full:
+            return 0  # everything the handoff covers is already cached
+        new_pages: list[int] = []
+        for _ in range(full - hit):
+            pid = self._alloc_page()
+            if pid is None:
+                break  # pool full of pinned pages: partial import is fine
+            new_pages.append(pid)
+        if not new_pages:
+            return 0
+        n_tab = len(new_pages)
+        seg = slice(hit * page, (hit + n_tab) * page)
+        self.cache_k, self.cache_v = self._insert_batch(
+            self.cache_k, self.cache_v,
+            jnp.asarray(ks[:, seg])[:, None], jnp.asarray(vs[:, seg])[:, None],
+            jnp.asarray(np.asarray(new_pages, np.int32)[None]),
+            jnp.asarray([n_tab * page], jnp.int32))
+        for i, pid in enumerate(new_pages):
+            self.page_refs[pid] = 1
+            h = self._prefix_hash(prompt[:(hit + i + 1) * page])
+            if h not in self.page_hash:
+                self.page_hash[h] = pid
+                self.hash_of_page[pid] = h
+            # ref 0 -> cached_lru (evictable) via the standard release path
+            self._decref_page(pid)
+        return len(new_pages)
+
     def _preempt_victim(self, needer: int) -> bool:
         """Pool exhausted mid-decode: requeue the youngest re-prefillable
         active slot (vLLM recompute-preemption semantics); its generated
@@ -1075,6 +1158,14 @@ class InferenceEngine:
             req = self.queue.popleft()
             slot = free[0]
             n = len(req.prompt)
+            if req.kv_handoff is not None:
+                # Disaggregated handoff: splice the prefill worker's KV
+                # into the prefix cache NOW (pump thread — page
+                # bookkeeping is single-threaded here), so _find_prefix
+                # below hits it and only the tail re-prefills.
+                ks_h, vs_h = req.kv_handoff
+                req.kv_handoff = None
+                self.import_kv(req.prompt, ks_h, vs_h)
             pre_pages = self._find_prefix(req.prompt)
             hit = len(pre_pages)
             suffix = req.prompt[hit * page:]
@@ -1812,6 +1903,68 @@ class InferenceEngine:
         return out
 
 
+class PrefillEngine:
+    """Prefill-only engine for the disaggregated serving plane's prefill
+    pool (llm/serve.py): runs the bucketed prefill jit, samples the first
+    continuation token, and EXPORTS the prompt KV for the decode-pool
+    handoff — a prefill worker owns no decode pool, no slots, no pages.
+    The exported K is post-RoPE at absolute positions, so a decode
+    replica's `import_kv` splices it verbatim into its prefix cache."""
+
+    def __init__(self, model_config: ModelConfig,
+                 engine_config: EngineConfig | None = None, *,
+                 params=None, mesh=None, rules=None, seed: int = 0):
+        self.c = model_config
+        self.e = engine_config or EngineConfig()
+        self.mesh = mesh
+        self.params = _resolve_params(model_config, params, mesh, rules,
+                                      seed)
+        self._prefill = _shared_jit(
+            ("prefill", self.c),
+            lambda: jax.jit(partial(prefill, config=self.c)))
+        self._sample = _shared_jit(("sample",), lambda: jax.jit(sample))
+        self._sample_trunc = _shared_jit(
+            ("sample_trunc",),
+            lambda: jax.jit(
+                lambda lg, t, k, p, tk, m=None: sample(lg, t, k, top_p=p,
+                                                       top_k=tk, mask=m)))
+        self._key = jax.random.PRNGKey(seed + 1)
+
+    def prefill_export(self, prompt_tokens, temperature=None,
+                       top_p: float = 1.0, top_k: int = 0):
+        """-> (first_token, ks, vs): the sampled continuation token plus
+        the prompt's full-page KV as host arrays [L, S, hkv, hd] with
+        S = page-aligned prefix length (0 when the prompt spans less than
+        one full page — nothing worth handing off). Greedy (temp 0) picks
+        match the decode engine's bit-exactly."""
+        ids = list(map(int, prompt_tokens))
+        n = len(ids)
+        bucket = _prompt_bucket(self.e, n)
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :n] = ids
+        logits, ks, vs = self._prefill(self.params, jnp.asarray(toks))
+        temp = (self.e.default_temperature if temperature is None
+                else temperature)
+        self._key, sub = jax.random.split(self._key)
+        row = logits[n - 1][None]
+        if top_k == 0 and top_p >= 1.0:
+            first = int(self._sample(
+                row, jnp.asarray([temp], jnp.float32), sub)[0])
+        else:
+            first = int(self._sample_trunc(
+                row, jnp.asarray([temp], jnp.float32), sub,
+                jnp.asarray([top_p], jnp.float32),
+                jnp.asarray([top_k], jnp.int32))[0])
+        page = self.e.page_size
+        full = n // page
+        if full * page == n:
+            full -= 1  # the decode side always re-prefills >=1 token
+        cut = max(full, 0) * page
+        ks_np = np.asarray(ks[:, :cut])
+        vs_np = np.asarray(vs[:, :cut])
+        return first, ks_np, vs_np
+
+
 def __graphcheck__(gc):
     """graphcheck hook (tools/graphcheck): the four steady-state serving
     graphs, lowered at a tiny config. Pins per graph: the KV pool/cache
@@ -1874,7 +2027,52 @@ def __graphcheck__(gc):
             arg_names=("params", "pool_k", "pool_v", "tokens", "lengths",
                        "active", "page_tables"))
 
+    # ---- disaggregated serving plane (llm/serve.py) ----
+    # The prefill-pool export graph, the decode-pool steady-state window,
+    # and the decode-side KV-handoff import (the splice fed by the host
+    # device_put of the sealed arena object). Pinning these keeps router
+    # churn from silently swapping decode graphs or dropping the pool
+    # donations (a dropped donation doubles every decode replica's HBM).
+
+    def build_prefill_pool(mesh):
+        return gc.GraphSpec(
+            name="llm.prefill_pool", fn=partial(prefill, config=c),
+            args=(_params(), _sds((1, 32), jnp.int32)),
+            arg_names=("params", "tokens"))
+
+    def build_decode_window(mesh):
+        return gc.GraphSpec(
+            name="llm.decode_pool_window",
+            fn=partial(decode_window, config=c, eos_token=2, n_steps=2,
+                       trunc=False, guided=False, want_logp=False),
+            args=(_params(), _pool(), _pool(), _sds((slots,), jnp.int32),
+                  _sds((slots,), jnp.int32), _sds((slots,), jnp.bool_),
+                  _sds((slots, ptab), jnp.int32),
+                  _sds((slots,), jnp.float32), _sds((slots,), jnp.float32),
+                  _sds((slots,), jnp.int32), _sds((1, 1, 1), jnp.int32),
+                  _sds((slots,), jnp.int32), _sds((2,), jnp.uint32)),
+            donate_argnums=(1, 2), min_donate_bytes=16384,
+            arg_names=("params", "pool_k", "pool_v", "tokens", "lengths",
+                       "active", "page_tables", "temps", "top_ps",
+                       "top_ks", "gtables", "gstates", "key"))
+
+    def build_kv_handoff(mesh):
+        # import_kv's splice: ONE request, a multi-page contiguous handoff
+        # segment (vs llm.insert_kv's admission-burst shape).
+        kv = _sds((c.n_layers, 1, 2 * page, c.n_kv_heads, c.head_dim),
+                  jnp.float32)
+        return gc.GraphSpec(
+            name="llm.kv_handoff", fn=insert_pages_batch,
+            args=(_pool(), _pool(), kv, kv, _sds((1, 2), jnp.int32),
+                  _sds((1,), jnp.int32)),
+            donate_argnums=(0, 1), min_donate_bytes=16384,
+            arg_names=("pool_k", "pool_v", "ks", "vs", "page_ids",
+                       "lengths"))
+
     gc.register("llm.prefill", build_prefill)
     gc.register("llm.decode_paged", build_decode)
     gc.register("llm.insert_kv", build_insert)
     gc.register("llm.spec_verify", build_spec_verify)
+    gc.register("llm.prefill_pool", build_prefill_pool)
+    gc.register("llm.decode_pool_window", build_decode_window)
+    gc.register("llm.kv_handoff", build_kv_handoff)

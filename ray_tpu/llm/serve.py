@@ -1,4 +1,5 @@
-"""LLM serving: engine-backed deployment + OpenAI-compatible router.
+"""LLM serving: engine-backed deployment + OpenAI-compatible router,
+plus the disaggregated prefill/decode production plane.
 
 Parity: reference `python/ray/llm/_internal/serve/` — `LLMServer`
 deployment wrapping the engine (`deployments/llm/`), OpenAI-compatible
@@ -6,29 +7,70 @@ ingress (`deployments/routers/router.py`, /v1/chat/completions etc.), LoRA
 multiplexing (`deployments/llm/multiplex/`). The engine here is the
 in-process jit-compiled continuous-batching engine (engine.py), not an
 external vLLM process; TP is a mesh inside the replica.
+
+The disaggregated plane (`build_disagg_openai_app`) runs prefill and
+decode as SEPARATE replica pools: prefill workers export the prompt KV
+(PrefillEngine), seal it as an arena object (`ray_tpu.put` — pulled over
+objxfer when the pools land on different nodes), and the coordinator
+routes each request to the decode replica whose prefix cache holds the
+longest shared prompt prefix, where the handoff splices into the paged
+pool (engine.import_kv) and decoding continues under continuous
+batching. Robustness is the load-bearing structure, not an afterthought:
+SLO-aware token-budget admission control sheds overflow fast and loud
+(OverloadedError) instead of collapsing the queue, all retries ride
+core/retry.Backoff, and a decode replica SIGKILLed mid-stream has its
+in-flight streams re-resolved exactly-once on a surviving replica
+(positions already delivered are never re-emitted; the KV rebuilds from
+the sealed handoff object or by re-prefilling). Four chaos sites pin the
+failure modes: serve.router.drop, serve.kv_handoff.lose,
+serve.decode.kill, serve.prefill.stall (core/chaos.py).
 """
 
 from __future__ import annotations
 
 import asyncio
+import collections
+import dataclasses
+import sys
 import threading
 import time
 import uuid
 
 from ray_tpu import serve
+from ray_tpu.core import chaos
+from ray_tpu.core.retry import Backoff
+from ray_tpu.core.status import (ActorDiedError, GetTimeoutError,
+                                 OverloadedError, RayTpuError)
 from ray_tpu.llm.config import LLMConfig
-from ray_tpu.llm.engine import EngineConfig, InferenceEngine
+from ray_tpu.llm.engine import (EngineConfig, InferenceEngine,
+                                PrefillEngine)
 from ray_tpu.llm.lora import init_lora, merge_lora
 from ray_tpu.llm.tokenizer import get_tokenizer
 
 
 def _wire_eos(engine_cfg: EngineConfig, tokenizer) -> EngineConfig:
     """Stop on the TOKENIZER's eos unless the user overrode the default."""
-    import dataclasses
     eos = getattr(tokenizer, "eos_id", None)
     if eos is not None and engine_cfg.eos_token == EngineConfig().eos_token:
         return dataclasses.replace(engine_cfg, eos_token=eos)
     return engine_cfg
+
+
+def _replica_mesh(llm_config: LLMConfig):
+    """The replica's tp mesh (None for tp=1): the replica's first tp
+    chips; a host with more chips keeps the rest for other replicas."""
+    if llm_config.tensor_parallelism <= 1:
+        return None
+    import jax
+
+    from ray_tpu.parallel import MeshConfig, make_mesh
+    tp = llm_config.tensor_parallelism
+    devices = jax.devices()
+    if len(devices) < tp:
+        raise ValueError(
+            f"tensor_parallelism={tp} needs {tp} devices, replica "
+            f"sees {len(devices)}")
+    return make_mesh(MeshConfig(tp=tp, fsdp=1), devices=devices[:tp])
 
 
 class _LLMServerImpl:
@@ -37,23 +79,9 @@ class _LLMServerImpl:
     HTTP callers)."""
 
     def __init__(self, llm_config: LLMConfig):
-        import jax
-
         self.cfg = llm_config
         model_cfg = llm_config.resolve_model()
-        mesh = None
-        if llm_config.tensor_parallelism > 1:
-            from ray_tpu.parallel import MeshConfig, make_mesh
-            tp = llm_config.tensor_parallelism
-            devices = jax.devices()
-            if len(devices) < tp:
-                raise ValueError(
-                    f"tensor_parallelism={tp} needs {tp} devices, replica "
-                    f"sees {len(devices)}")
-            # The replica's first tp chips; a host with more chips keeps
-            # the rest for other replicas (mesh must not span them).
-            mesh = make_mesh(MeshConfig(tp=tp, fsdp=1),
-                             devices=devices[:tp])
+        mesh = _replica_mesh(llm_config)
         self.tokenizer = get_tokenizer(llm_config.tokenizer)
         engine_cfg = _wire_eos(llm_config.engine, self.tokenizer)
         self.engine = InferenceEngine(
@@ -376,6 +404,16 @@ class _LLMServerImpl:
         self._stop = True
 
 
+def _is_overload(e: Exception) -> bool:
+    """OverloadedError, possibly wrapped in the remote TaskError chain."""
+    if isinstance(e, OverloadedError):
+        return True
+    cause = getattr(e, "cause", None)
+    if isinstance(cause, OverloadedError):
+        return True
+    return "OverloadedError" in str(e) or "overloaded" in str(e)
+
+
 def _guided_fields(body: dict):
     """vLLM-style guided_regex/guided_json fields, plus the OpenAI
     response_format json_schema spelling."""
@@ -475,6 +513,11 @@ class _OpenAiRouterImpl:
                     guided_regex=guided_regex, guided_json=guided_json,
                     stop=body.get("stop"))
         except Exception as e:  # noqa: BLE001 — surface as API error
+            if _is_overload(e):
+                # Admission shed (disaggregated plane): the OpenAI rate
+                # limit status, so clients back off instead of retrying
+                # hot.
+                return 429, {"error": str(e)}
             return 400, {"error": str(e)}
         return 404, {"error": f"no route {path}"}
 
@@ -492,5 +535,625 @@ def build_openai_app(llm_config: LLMConfig):
     """Parity: reference `build_openai_app` — OpenAI router in front of an
     engine deployment; `serve.run(app)` serves it over HTTP."""
     server = build_llm_deployment(llm_config)
+    router = serve.deployment(_OpenAiRouterImpl, name="OpenAiRouter")
+    return router.bind(server)
+
+
+# ================= disaggregated prefill/decode plane =================
+
+
+@dataclasses.dataclass
+class DisaggConfig:
+    """Knobs for the disaggregated serving plane (module docstring).
+
+    Admission control is per-pool token-budget backpressure: a request
+    costs `prompt_tokens` against the prefill queue until its KV is
+    exported, and `prompt_tokens + max_new_tokens` against the decode
+    pool until its stream completes. Overflow — either budget, the
+    request cap, or the estimated queue wait against `admission_slo_ms` —
+    sheds immediately with OverloadedError instead of queueing."""
+
+    prefill_replicas: int = 1
+    decode_replicas: int = 2
+    # --- admission control (the overload contract) ---
+    max_prefill_queue_tokens: int = 8192
+    max_decode_inflight_tokens: int = 16384
+    max_ongoing_requests: int = 256
+    admission_slo_ms: float | None = None  # est decode wait SLO; None=off
+    # --- routing / handoff ---
+    handoff: bool = True          # False: decode pool always re-prefills
+    route_cache_prefixes: int = 4096  # prefix keys remembered per replica
+    stream_chunk_tokens: int = 8  # decode stream: max tokens per chunk
+    # --- recovery pacing (core/retry.Backoff deadlines) ---
+    dispatch_deadline_s: float = 15.0  # route+prefill redrive budget
+    resume_deadline_s: float = 60.0    # mid-stream death re-resolve budget
+
+
+class _PrefillWorkerImpl:
+    """One prefill-pool worker: prompt -> (first token, sealed KV handoff).
+
+    The KV export (full prompt pages, post-RoPE) is sealed as ONE arena
+    object via `ray_tpu.put` — zero-copy into the node's shm store, pulled
+    over objxfer when the decode pool lives on another node — and only the
+    small ObjectRef travels through the coordinator. Outside a cluster
+    (serve local testing mode) the arrays ride inline instead."""
+
+    def __init__(self, llm_config: LLMConfig):
+        self.cfg = llm_config
+        model_cfg = llm_config.resolve_model()
+        self.tokenizer = get_tokenizer(llm_config.tokenizer)
+        engine_cfg = _wire_eos(llm_config.engine, self.tokenizer)
+        self.engine = PrefillEngine(model_cfg, engine_cfg,
+                                    mesh=_replica_mesh(llm_config),
+                                    seed=llm_config.seed)
+
+    def prefill(self, prompt_ids, temperature=None, top_p: float = 1.0,
+                top_k: int = 0) -> dict:
+        chaos.delay("serve.prefill.stall", max_s=0.25)
+        first, ks, vs = self.engine.prefill_export(
+            prompt_ids, temperature=temperature, top_p=top_p, top_k=top_k)
+        kv = None
+        if ks.shape[1]:
+            import ray_tpu
+            if ray_tpu.is_initialized():
+                kv = ray_tpu.put((ks, vs))  # sealed arena object
+            else:
+                kv = (ks, vs)  # local testing mode: no store to seal into
+        return {"first": int(first), "kv": kv,
+                "kv_tokens": int(ks.shape[1])}
+
+
+class _DecodeReplicaImpl(_LLMServerImpl):
+    """Decode-pool replica: imports KV handoffs into the engine's prefix
+    cache and serves resumable token streams under continuous batching."""
+
+    def _fetch_handoff(self, kv, prompt_ids):
+        """Resolve the handoff to (ks, vs) host arrays, or None — the
+        caller re-prefills. Loss (injected via serve.kv_handoff.lose or
+        real: the owning prefill worker died and took the object with it)
+        degrades to a re-prefill, never a failed stream."""
+        if kv is None:
+            return None
+        if chaos.site("serve.kv_handoff.lose"):
+            return None  # injected in-flight loss
+        if isinstance(kv, tuple):
+            return kv
+        import ray_tpu
+        try:
+            return ray_tpu.get(kv, timeout=30)
+        except RayTpuError as e:
+            print(f"serve: KV handoff lost ({e}); re-prefilling "
+                  f"{len(prompt_ids)}-token prompt", file=sys.stderr)
+            return None
+
+    def configure_chaos(self, schedule: str, seed: int = 0) -> int:
+        """Arm chaos in THIS replica process only and return its pid
+        (test/bench hook: a cluster-wide serve.decode.kill schedule would
+        re-arm every controller-respawned replica and crash-loop the pool
+        at low Nth counts)."""
+        import os
+        chaos.configure(schedule, seed)
+        return os.getpid()
+
+    def decode_stream(self, prompt_ids, generated, kv=None,
+                      max_tokens=None, temperature=None,
+                      top_p: float = 1.0, top_k: int = 0,
+                      chunk_tokens: int = 8):
+        """Continue a request whose prompt was prefilled elsewhere.
+
+        `generated` = tokens the client already holds (>=1: the prefill's
+        first token; more when resuming a stream whose previous replica
+        died). Yields lists of NEW token ids — exactly the positions
+        after `generated`, each exactly once. The prompt KV comes from
+        the handoff (import_kv prefix splice) or, when the handoff is
+        lost, a full re-prefill; tokens in `generated` beyond the prompt
+        re-prefill as suffix either way."""
+        import queue as _queue
+        e = self.engine.e
+        max_new = max_tokens or e.default_max_new_tokens
+        generated = [int(t) for t in generated]
+        if not generated:
+            raise ValueError("decode_stream needs >=1 seed token (the "
+                             "prefill's first sample)")
+        rem = max_new - len(generated)
+        if rem <= 0 or generated[-1] == e.eos_token:
+            return
+        handoff = self._fetch_handoff(kv, prompt_ids)
+        sub: "_queue.Queue" = _queue.Queue()
+        with self._lock:
+            rid = self.engine.add_request(
+                list(prompt_ids) + generated[:-1], rem + 1, temperature,
+                top_p=top_p, top_k=top_k, resume_token=generated[-1],
+                kv_handoff=handoff)
+            self._token_subs[rid] = sub
+        del handoff
+        ended = False
+        try:
+            while True:
+                tok = sub.get(timeout=300)
+                if tok is None:
+                    ended = True
+                    return
+                chunk = [tok]
+                while len(chunk) < max(chunk_tokens, 1):
+                    try:
+                        nxt = sub.get_nowait()
+                    except _queue.Empty:
+                        break
+                    if nxt is None:
+                        ended = True
+                        break
+                    chunk.append(nxt)
+                # The mid-stream crash probe: one hit per emitted chunk,
+                # fired BEFORE the yield so the dying replica takes the
+                # chunk with it — the consumer must re-resolve from its
+                # last DELIVERED position, not ours.
+                chaos.kill("serve.decode.kill")
+                yield chunk
+                if ended:
+                    return
+        finally:
+            with self._lock:
+                self._token_subs.pop(rid, None)
+                if ended:
+                    pass  # pump already popped the finished record
+                elif rid in self.engine.finished:
+                    self.engine.finished.pop(rid, None)
+                else:
+                    # Abandoned mid-decode (consumer gone): free the slot.
+                    self.engine.cancel(rid)
+                    self._discard.add(rid)
+
+    def kv_stats(self) -> dict:
+        return self.engine.kv_stats()
+
+
+class _DisaggServerImpl:
+    """The disaggregated serving coordinator: SLO-aware admission,
+    prefix-aware decode routing, prefill->decode KV handoff, and
+    exactly-once stream recovery across decode replica death. Exposes the
+    same request surface as _LLMServerImpl (completions / chat /
+    completions_stream / model_ids) so the OpenAI ingress composes with
+    either backend unchanged."""
+
+    def __init__(self, llm_config: LLMConfig, disagg: DisaggConfig | None,
+                 prefill_handle, decode_handle):
+        import concurrent.futures
+        self.cfg = llm_config
+        self.d = disagg or DisaggConfig()
+        self.tokenizer = get_tokenizer(llm_config.tokenizer)
+        engine_cfg = _wire_eos(llm_config.engine, self.tokenizer)
+        self._page = engine_cfg.page_size
+        self._eos = engine_cfg.eos_token
+        self._max_new_default = engine_cfg.default_max_new_tokens
+        self.prefill = prefill_handle
+        self.decode = decode_handle
+        # Local-testing mode: the "pools" are single in-process instances.
+        self._local_decode = getattr(decode_handle, "_target", None)
+        self._lock = threading.Lock()
+        # ---- admission accounting (token budgets per pool) ----
+        self._prefill_queue_tokens = 0
+        self._decode_inflight_tokens = 0
+        self._ongoing = 0
+        self._tok_rate_ema = 0.0  # decode tokens/s across the pool
+        # ---- routing state ----
+        self._route_cache: dict = {}    # replica_id -> OrderedDict(keys)
+        self._replica_load: dict = {}   # replica_id -> inflight tokens
+        self.counters = collections.Counter()
+        # Blocking prefill/stream work runs here, NOT on the replica's
+        # asyncio loop (and not on its tiny default executor): admitted
+        # concurrency is bounded by admission control, not thread count.
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(self.d.max_ongoing_requests, 8),
+            thread_name_prefix="disagg")
+
+    # ---- admission control ----
+
+    def _admit(self, n_prompt: int, max_new: int) -> int:
+        """Admit or shed, synchronously and fast (called on the request
+        path BEFORE any pool work is scheduled). Returns the decode-pool
+        token cost the caller must release."""
+        d = self.d
+        cost = n_prompt + max_new
+        with self._lock:
+            est_ms = None
+            if d.admission_slo_ms is not None and self._tok_rate_ema > 1.0:
+                est_ms = 1e3 * (self._decode_inflight_tokens
+                                / self._tok_rate_ema)
+            if (self._ongoing >= d.max_ongoing_requests
+                    or (self._prefill_queue_tokens + n_prompt
+                        > d.max_prefill_queue_tokens)
+                    or (self._decode_inflight_tokens + cost
+                        > d.max_decode_inflight_tokens)
+                    or (est_ms is not None
+                        and est_ms > d.admission_slo_ms)):
+                self.counters["shed"] += 1
+                raise OverloadedError(
+                    "serving plane overloaded: request shed "
+                    f"(ongoing={self._ongoing}, "
+                    f"prefill_q={self._prefill_queue_tokens}tok, "
+                    f"decode_inflight={self._decode_inflight_tokens}tok"
+                    + (f", est_wait={est_ms:.0f}ms" if est_ms is not None
+                       else "") + ")")
+            self._ongoing += 1
+            self._prefill_queue_tokens += n_prompt
+            self._decode_inflight_tokens += cost
+            self.counters["admitted"] += 1
+        return cost
+
+    def _release(self, cost: int, tokens_emitted: int, dt_s: float):
+        with self._lock:
+            self._ongoing -= 1
+            self._decode_inflight_tokens -= cost
+            if tokens_emitted > 0 and dt_s > 0:
+                rate = tokens_emitted / dt_s
+                self._tok_rate_ema = (rate if self._tok_rate_ema == 0.0
+                                      else 0.7 * self._tok_rate_ema
+                                      + 0.3 * rate)
+
+    def _release_prefill(self, n_prompt: int):
+        with self._lock:
+            self._prefill_queue_tokens -= n_prompt
+
+    # ---- prefix-aware routing over the decode pool ----
+
+    def _prefix_keys(self, ids) -> list:
+        page = self._page
+        return [InferenceEngine._prefix_hash(ids[:(i + 1) * page])
+                for i in range(len(ids) // page)]
+
+    def _decode_router(self):
+        return self.decode._get_router()
+
+    def _live_decode_replicas(self) -> list:
+        if self._local_decode is not None:
+            return ["local"]
+        return self._decode_router().live_replicas()
+
+    @staticmethod
+    def _rep_id(rep) -> str:
+        return rep if isinstance(rep, str) else rep.replica_id
+
+    def _pick_by_prefix(self, reps: list, keys: list):
+        """The replica whose recorded prefix keys cover the longest
+        leading run of this prompt's page keys; ties break to the least
+        loaded (the continuous-batching analogue of pow-2)."""
+        best, best_hit, best_load = None, -1, 0
+        for rep in reps:
+            rid = self._rep_id(rep)
+            cache = self._route_cache.get(rid)
+            hit = 0
+            if cache:
+                for k in keys:
+                    if k not in cache:
+                        break
+                    hit += 1
+            load = self._replica_load.get(rid, 0)
+            if hit > best_hit or (hit == best_hit and load < best_load):
+                best, best_hit, best_load = rep, hit, load
+        if best_hit > 0:
+            self.counters["route_prefix_hits"] += 1
+        return best
+
+    def _record_route(self, rep, keys: list):
+        rid = self._rep_id(rep)
+        cache = self._route_cache.setdefault(
+            rid, collections.OrderedDict())
+        for k in keys:
+            cache.pop(k, None)
+            cache[k] = None
+        while len(cache) > self.d.route_cache_prefixes:
+            cache.popitem(last=False)
+
+    def _dispatch_decode(self, ids: list, cost: int):
+        """Pick a decode replica (prefix-aware), surviving injected
+        dispatch drops and empty replica sets; every redrive is paced by
+        the shared Backoff policy."""
+        keys = self._prefix_keys(ids)
+        bo = Backoff(deadline_s=self.d.dispatch_deadline_s)
+        while True:
+            reps = self._live_decode_replicas()
+            if reps:
+                rep = self._pick_by_prefix(reps, keys)
+                if chaos.site("serve.router.drop"):
+                    # Injected: the routed dispatch vanished before the
+                    # pool saw it. Redrive, paced — a tight retry loop
+                    # here is exactly the storm the jitter exists for.
+                    self.counters["router_drops"] += 1
+                    if not bo.sleep():
+                        raise RayTpuError(
+                            "serve router: dispatch dropped and redrive "
+                            "deadline exhausted")
+                    continue
+                with self._lock:
+                    rid = self._rep_id(rep)
+                    self._replica_load[rid] = (
+                        self._replica_load.get(rid, 0) + cost)
+                self._record_route(rep, keys)
+                return rep
+            if not bo.sleep():
+                raise RayTpuError(
+                    f"no live decode replicas within "
+                    f"{self.d.dispatch_deadline_s}s")
+
+    def _unload(self, rep, cost: int):
+        with self._lock:
+            rid = self._rep_id(rep)
+            left = self._replica_load.get(rid, 0) - cost
+            if left > 0:
+                self._replica_load[rid] = left
+            else:
+                self._replica_load.pop(rid, None)
+
+    def _note_decode_failure(self, rep, exc):
+        """A decode replica failed mid-stream: forget its prefix cache,
+        report it dead so the controller respawns it, and route around."""
+        self.counters["decode_failures"] += 1
+        rid = self._rep_id(rep)
+        self._route_cache.pop(rid, None)
+        with self._lock:
+            self._replica_load.pop(rid, None)
+        if self._local_decode is None:
+            self._decode_router().mark_replica_dead(rid)
+        print(f"serve: decode replica {rid} failed mid-stream ({exc}); "
+              "re-resolving its streams", file=sys.stderr)
+
+    # ---- prefill + decode streams, with recovery ----
+
+    def _prefill_with_retry(self, ids, temperature, top_p, top_k) -> dict:
+        """Prefill through the pool handle; worker death / timeout
+        redrives through the shared backoff (the sealed handoff object,
+        once exported, survives its worker's death)."""
+        bo = Backoff(deadline_s=self.d.dispatch_deadline_s)
+        while True:
+            try:
+                return self.prefill.prefill.remote(
+                    list(ids), temperature, top_p, top_k).result(
+                        timeout_s=60)
+            except (ActorDiedError, GetTimeoutError) as e:
+                if not bo.sleep():
+                    raise RayTpuError(
+                        f"prefill pool unavailable: {e}") from e
+
+    def _open_decode_stream(self, rep, ids, generated, kv, max_new,
+                            temperature, top_p, top_k):
+        """One decode stream attempt on one replica: yields token chunks;
+        raises RayTpuError when the replica dies mid-stream."""
+        args = [list(ids), list(generated), kv, max_new, temperature,
+                top_p, top_k, self.d.stream_chunk_tokens]
+        if self._local_decode is not None:
+            yield from self._local_decode.decode_stream(*args)
+            return
+        import ray_tpu
+        router = self._decode_router()
+        gen = router.assign_streaming_to(rep, "decode_stream", args, {})
+        try:
+            for ref in gen:
+                yield ray_tpu.get(ref, timeout=120)
+        finally:
+            gen.close()
+            router.release_streaming(rep.replica_id)
+
+    def _stream_tokens(self, ids, generated, kv, max_new, temperature,
+                       top_p, top_k, cost: int):
+        """Yield the tokens after `generated` EXACTLY ONCE, re-resolving
+        the stream on a surviving replica when a decode replica dies
+        mid-flight. `generated` is mutated in place (the recovery cursor:
+        a resumed stream continues from the last delivered position)."""
+        bo = Backoff(deadline_s=self.d.resume_deadline_s)
+        while len(generated) < max_new and generated[-1] != self._eos:
+            rep = self._dispatch_decode(ids, cost)
+            try:
+                for chunk in self._open_decode_stream(
+                        rep, ids, generated, kv, max_new, temperature,
+                        top_p, top_k):
+                    for tok in chunk:
+                        generated.append(int(tok))
+                        yield int(tok)
+                    bo.reset()  # progress restarts the recovery budget
+                return  # clean close: the engine finished the request
+            except RayTpuError as e:
+                # Mid-stream death (or torn stream): re-resolve from the
+                # last DELIVERED token. Tokens already yielded are never
+                # re-emitted; the next attempt re-prefills (or re-imports
+                # the sealed handoff) and decodes positions
+                # len(generated).. only.
+                self._note_decode_failure(rep, e)
+                self.counters["streams_resumed"] += 1
+                if not bo.sleep():
+                    raise
+            finally:
+                self._unload(rep, cost)
+
+    def _run_admitted(self, ids, max_new, temperature, top_p, top_k,
+                      cost: int) -> list:
+        """Prefill -> route -> stream to completion; returns all tokens
+        (admission already charged; released here)."""
+        t0 = time.monotonic()
+        toks: list = []
+        try:
+            try:
+                pre = self._prefill_with_retry(ids, temperature, top_p,
+                                               top_k)
+            finally:
+                self._release_prefill(len(ids))
+            kv = pre["kv"] if self.d.handoff else None
+            self.counters["handoff_tokens"] += (pre["kv_tokens"]
+                                                if kv is not None else 0)
+            toks = [pre["first"]]
+            if toks[0] != self._eos:
+                for tok in self._stream_tokens(
+                        ids, toks, kv, max_new, temperature, top_p,
+                        top_k, cost):
+                    pass  # _stream_tokens appends into toks
+            self.counters["completed"] += 1
+            return toks
+        finally:
+            self._release(cost, len(toks), time.monotonic() - t0)
+
+    # ---- request surface (mirrors _LLMServerImpl) ----
+
+    def _check_plain(self, model, guided_regex=None, guided_json=None,
+                     logprobs=None):
+        if model is not None and model != self.cfg.model_id:
+            raise ValueError(
+                f"model {model!r}: the disaggregated plane serves only "
+                f"the base model {self.cfg.model_id!r}")
+        if guided_regex or guided_json or logprobs:
+            raise ValueError("guided decoding / logprobs are not "
+                             "supported on the disaggregated plane")
+
+    async def completions(self, prompt: str, *, max_tokens=None,
+                          temperature=None, top_p: float = 1.0,
+                          top_k: int = 0, model=None, guided_regex=None,
+                          guided_json=None, stop=None,
+                          logprobs=None) -> dict:
+        self._check_plain(model, guided_regex, guided_json, logprobs)
+        ids = self.tokenizer.encode(prompt)
+        max_new = max_tokens or self._max_new_default
+        # Admission runs HERE, on the replica's event loop, before any
+        # executor hop: a shed must stay fast and loud even when every
+        # worker thread is busy decoding admitted traffic.
+        cost = self._admit(len(ids), max_new)
+        loop = asyncio.get_running_loop()
+        toks = await loop.run_in_executor(
+            self._pool, self._run_admitted, ids, max_new, temperature,
+            top_p, top_k, cost)
+        text = self.tokenizer.decode(toks)
+        text, stopped = _LLMServerImpl._apply_stop(text, stop)
+        return {
+            "id": f"cmpl-{uuid.uuid4().hex[:24]}",
+            "object": "text_completion",
+            "model": self.cfg.model_id,
+            "choices": [{"index": 0, "text": text, "logprobs": None,
+                         "finish_reason": "stop" if stopped else
+                         ("length" if len(toks) >= max_new else "stop")}],
+            "usage": {"prompt_tokens": len(ids),
+                      "completion_tokens": len(toks),
+                      "total_tokens": len(ids) + len(toks)},
+        }
+
+    async def chat(self, messages: list, *, max_tokens=None,
+                   temperature=None, top_p: float = 1.0, top_k: int = 0,
+                   model=None, guided_regex=None, guided_json=None,
+                   stop=None) -> dict:
+        prompt = "".join(
+            f"<|{m.get('role', 'user')}|>{m.get('content', '')}"
+            for m in messages) + "<|assistant|>"
+        out = await self.completions(prompt, max_tokens=max_tokens,
+                                     temperature=temperature, top_p=top_p,
+                                     top_k=top_k, model=model,
+                                     guided_regex=guided_regex,
+                                     guided_json=guided_json, stop=stop)
+        return {
+            "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
+            "object": "chat.completion",
+            "model": out["model"],
+            "choices": [{"index": 0,
+                         "message": {"role": "assistant",
+                                     "content": out["choices"][0]["text"]},
+                         "finish_reason": "stop"}],
+            "usage": out["usage"],
+        }
+
+    def completions_stream(self, prompt: str, max_tokens=None,
+                           temperature=None, top_p: float = 1.0,
+                           top_k: int = 0, model=None, stop=None):
+        """Streaming text deltas through the disaggregated plane (same
+        stop-sequence holdback semantics as the dense replica's stream)."""
+        self._check_plain(model)
+        ids = self.tokenizer.encode(prompt)
+        max_new = max_tokens or self._max_new_default
+        cost = self._admit(len(ids), max_new)
+        t0 = time.monotonic()
+        stops = ([stop] if isinstance(stop, str) else list(stop or []))
+        hold = max((len(s) for s in stops), default=1) - 1
+        toks: list = []
+        try:
+            try:
+                pre = self._prefill_with_retry(ids, temperature, top_p,
+                                               top_k)
+            finally:
+                self._release_prefill(len(ids))
+            kv = pre["kv"] if self.d.handoff else None
+            toks = [pre["first"]]
+
+            def token_iter():
+                yield toks[0]
+                if toks[0] != self._eos:
+                    yield from self._stream_tokens(
+                        ids, toks, kv, max_new, temperature, top_p,
+                        top_k, cost)
+
+            sent = ""
+            done = False
+            seen: list = []
+            it = token_iter()
+            while not done:
+                try:
+                    seen.append(next(it))
+                    text = self.tokenizer.decode(seen)
+                except StopIteration:
+                    done = True
+                    text = self.tokenizer.decode(seen)
+                if stops:
+                    cut = min((i for i in (text.find(s) for s in stops
+                                           if s) if i >= 0), default=-1)
+                    if cut >= 0:
+                        text, done = text[:cut], True
+                    elif not done and hold:
+                        text = text[:max(len(text) - hold, len(sent))]
+                if len(text) > len(sent):
+                    delta, sent = text[len(sent):], text
+                    yield delta
+            self.counters["completed"] += 1
+        finally:
+            self._release(cost, len(toks), time.monotonic() - t0)
+
+    def model_ids(self) -> list:
+        return [self.cfg.model_id]
+
+    def stats(self) -> dict:
+        """Admission/routing/recovery counters + live gauges (tests, the
+        serve_storm bench, and dashboards)."""
+        with self._lock:
+            out = dict(self.counters)
+            out.update(
+                ongoing=self._ongoing,
+                prefill_queue_tokens=self._prefill_queue_tokens,
+                decode_inflight_tokens=self._decode_inflight_tokens,
+                decode_tok_rate_ema=round(self._tok_rate_ema, 1))
+        return out
+
+
+def build_disagg_deployment(llm_config: LLMConfig,
+                            disagg: DisaggConfig | None = None):
+    """The disaggregated serving plane as an Application rooted at the
+    coordinator: a prefill pool + a decode pool + the coordinator wiring
+    them (admission, prefix routing, handoff, recovery)."""
+    d = disagg or DisaggConfig()
+    mid = llm_config.model_id
+    prefill = serve.deployment(
+        _PrefillWorkerImpl, name=f"PrefillPool:{mid}").options(
+        num_replicas=d.prefill_replicas,
+        ray_actor_options={"num_tpus": llm_config.num_tpus_per_replica},
+    ).bind(llm_config)
+    decode = serve.deployment(
+        _DecodeReplicaImpl, name=f"DecodePool:{mid}").options(
+        num_replicas=d.decode_replicas,
+        health_check_period_s=0.5,
+        ray_actor_options={"num_tpus": llm_config.num_tpus_per_replica},
+    ).bind(llm_config)
+    coord = serve.deployment(
+        _DisaggServerImpl, name=f"DisaggLLMServer:{mid}")
+    return coord.bind(llm_config, d, prefill, decode)
+
+
+def build_disagg_openai_app(llm_config: LLMConfig,
+                            disagg: DisaggConfig | None = None):
+    """OpenAI-surface ingress over the disaggregated plane — the drop-in
+    production sibling of `build_openai_app` (same routes; overload sheds
+    surface as HTTP 429)."""
+    server = build_disagg_deployment(llm_config, disagg)
     router = serve.deployment(_OpenAiRouterImpl, name="OpenAiRouter")
     return router.bind(server)

@@ -97,13 +97,18 @@ def run(app: Application, *, name: str = "default",
 
 
 def _wait_running(controller, app_name, timeout_s):
-    deadline = time.monotonic() + timeout_s
-    while time.monotonic() < deadline:
+    # Paced by the shared backoff policy (core/retry.py), not a fixed
+    # 100ms poll — many clients waiting out one controller deploy should
+    # not arrive in lockstep.
+    from ray_tpu.core.retry import Backoff
+    bo = Backoff(base_s=0.05, cap_s=0.5, deadline_s=timeout_s)
+    while True:
         st = ray_tpu.get(controller.get_status.remote(), timeout=10)
         app = st.get(app_name)
         if app is not None and app["status"] == "RUNNING":
             return
-        time.sleep(0.1)
+        if not bo.sleep():
+            break
     raise TimeoutError(
         f"application {app_name!r} did not reach RUNNING in {timeout_s}s: "
         f"{ray_tpu.get(controller.get_status.remote(), timeout=10)}")
@@ -119,14 +124,15 @@ def status() -> dict:
 
 
 def delete(name: str, *, blocking_timeout_s: float = 30.0):
+    from ray_tpu.core.retry import Backoff
     controller = _get_controller()
     ray_tpu.get(controller.delete_application.remote(name), timeout=10)
-    deadline = time.monotonic() + blocking_timeout_s
-    while time.monotonic() < deadline:
+    bo = Backoff(base_s=0.05, cap_s=0.5, deadline_s=blocking_timeout_s)
+    while True:
         if name not in ray_tpu.get(controller.get_status.remote(), timeout=10):
             return
-        time.sleep(0.1)
-    raise TimeoutError(f"application {name!r} did not delete")
+        if not bo.sleep():
+            raise TimeoutError(f"application {name!r} did not delete")
 
 
 def get_deployment_handle(deployment_name: str, app_name: str = "default"

@@ -156,17 +156,20 @@ class Router:
 
     # -- pow-2 choice ------------------------------------------------------
     def _pick(self):
-        deadline = time.monotonic() + 30.0
+        # Replica-wait pacing rides the shared backoff policy
+        # (core/retry.py) instead of a fixed 50ms poll: N routers hammering
+        # a restarting controller is the retry storm jitter exists for.
+        from ray_tpu.core.retry import Backoff
+        bo = Backoff(deadline_s=30.0)
         while True:
             self._refresh()
             with self._lock:
                 reps = list(self._replicas)
             if reps:
                 break
-            if time.monotonic() > deadline:
+            if not bo.sleep():
                 raise RayTpuError(
                     f"no replicas for {self.app}/{self.deployment} after 30s")
-            time.sleep(0.05)
             self._last_refresh = 0.0
         with self._lock:
             if len(reps) == 1:
@@ -202,6 +205,38 @@ class Router:
             if replica_id in self._inflight and self._inflight[replica_id] > 0:
                 self._inflight[replica_id] -= 1
         self._maybe_push_metrics()
+
+    # -- replica-addressed routing (the serve-llm prefix router) -----------
+    def live_replicas(self) -> list:
+        """The current replica set (refreshing the cached controller
+        snapshot). Callers that route by replica IDENTITY — e.g. the
+        disaggregated LLM plane's longest-prefix decode routing — pick
+        from this list and dispatch via assign_streaming_to; pow-2 stays
+        the default anonymous path."""
+        self._refresh()
+        with self._lock:
+            return list(self._replicas)
+
+    def assign_streaming_to(self, info, method_name, args, kwargs,
+                            multiplexed_model_id: str = ""):
+        """Streaming request pinned to a SPECIFIC replica (from
+        live_replicas). The caller owns the stream: call
+        release_streaming(info.replica_id) when it closes."""
+        h = self._handle_for(info)
+        self._metrics()["requests"].inc(
+            tags={"deployment": self.deployment, "application": self.app})
+        with self._lock:
+            self._inflight[info.replica_id] = (
+                self._inflight.get(info.replica_id, 0) + 1)
+        return h.handle_streaming_request.options(
+            num_returns="streaming").remote(
+                method_name, list(args), dict(kwargs), multiplexed_model_id)
+
+    def mark_replica_dead(self, replica_id: str):
+        """Public seam for identity-routing callers that observed a
+        replica die mid-request (reports to the controller + forces a
+        snapshot refresh)."""
+        self._mark_dead(replica_id)
 
     def assign(self, method_name, args, kwargs,
                multiplexed_model_id: str = "") -> DeploymentResponse:

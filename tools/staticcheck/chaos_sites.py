@@ -34,7 +34,8 @@ from tools.staticcheck import Finding
 from tools.staticcheck.concurrency import suppressed
 
 TARGET_GLOBS = ("ray_tpu/core/*.py", "ray_tpu/experimental/channel.py",
-                "ray_tpu/train/*.py")
+                "ray_tpu/train/*.py", "ray_tpu/llm/*.py",
+                "ray_tpu/serve/*.py")
 
 _CHAOS_FNS = {"site", "kill", "delay"}
 
@@ -64,6 +65,13 @@ RECOVERY_SCOPES: tuple = (
     ("ray_tpu/train/trainer.py", "_resume_path"),
     ("ray_tpu/train/checkpoint.py", "gc_uncommitted"),
     ("ray_tpu/train/checkpoint.py", "load_shard"),
+    # Disaggregated LLM serving plane: the code that turns a dropped
+    # dispatch, a lost KV handoff, or a decode replica SIGKILLed
+    # mid-stream into a completed (exactly-once) request must stay loud.
+    ("ray_tpu/llm/serve.py", "_fetch_handoff"),
+    ("ray_tpu/llm/serve.py", "_dispatch_decode"),
+    ("ray_tpu/llm/serve.py", "_prefill_with_retry"),
+    ("ray_tpu/llm/serve.py", "_stream_tokens"),
 )
 _RECOVERY_FN_NAMES = {name for _p, name in RECOVERY_SCOPES}
 
