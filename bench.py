@@ -140,6 +140,7 @@ def final_line(status: str = "complete"):
         "wall_s": round(time.monotonic() - _T0, 1),
         "host": EXTRAS.get("host", {}),
         "many_nodes_scaling": EXTRAS.get("many_nodes_scaling", {}),
+        "native_sched_ab": EXTRAS.get("native_sched_ab", {}),
         "adag_pipeline": EXTRAS.get("adag_pipeline", {}),
         "task_events": EXTRAS.get("task_events", {}),
         "cross_language": EXTRAS.get("cross_language", {}),
@@ -902,6 +903,47 @@ def _main_inner():
         }
         emit("many_nodes_tasks_s", float(rate))
 
+        # Native A/B (sidecar only): the SAME workload with the C++
+        # select-round core on vs off. COUNTERBALANCED on-off-off-on (the
+        # PR 4 lesson: naive A-then-B cluster pairs read machine drift as
+        # signal — this box swings several-fold run to run under 33
+        # processes), best-of per mode reported alongside every sample.
+        try:
+            samples = {"on": [{"tasks_s": round(float(rate), 1),
+                               "head_cpu_s": float(head_cpu),
+                               "tasks_per_head_cpu_s": float(per_cpu)}],
+                       "off": []}
+            for mode in ("off", "off", "on"):
+                ab_budget = min(180, max(90, _remaining() - 60))
+                if ab_budget < 90:
+                    break
+                if mode == "off":
+                    os.environ["RAY_TPU_NATIVE_SCHED"] = "0"
+                try:
+                    out_ab = run_sub(code, timeout=ab_budget,
+                                     tag=f"many_agents_native_{mode}")
+                finally:
+                    os.environ.pop("RAY_TPU_NATIVE_SCHED", None)
+                line = [ln for ln in out_ab.splitlines()
+                        if ln.startswith("RATE")][0]
+                _, r_s, _u, hc, pc, _sp = line.split()
+                samples[mode].append(
+                    {"tasks_s": round(float(r_s), 1),
+                     "head_cpu_s": float(hc),
+                     "tasks_per_head_cpu_s": float(pc)})
+            best = {m: max(s, key=lambda r: r["tasks_s"])
+                    for m, s in samples.items() if s}
+            EXTRAS["native_sched_ab"] = {
+                "workload": f"run_many_agents(n_agents={n_agents}, "
+                            "n_tasks=1500)",
+                "order": "on off off on (counterbalanced)",
+                "best": best,
+                "samples": samples,
+            }
+        except Exception as e:  # noqa: BLE001 — A/B is informational
+            EXTRAS["native_sched_ab"] = {"error": str(e)[:300],
+                                         "samples": samples}
+
     def sec_chaos():
         # Chaos storm (core/chaos.py): the same retryable task storm run
         # clean and under a seeded 1% fault schedule + a mid-storm worker
@@ -1187,7 +1229,7 @@ ray_tpu.shutdown()
         ("client", 90, sec_client),
         ("chaos", 150, sec_chaos),
         ("elastic_train", 60, sec_elastic_train),
-        ("many_agents", 180, sec_many_agents),
+        ("many_agents", 280, sec_many_agents),  # main run + native-off A/B
         ("serve_storm", 180, sec_serve_storm),
     ]
     # Resilience-test hooks: a section that hangs forever and one that

@@ -1,0 +1,202 @@
+// TSan run-mode storm over the native select-round core's lease ledger +
+// dispatch tables (cpp/agent_core.cc). Contract-correct multi-threaded use:
+//
+//   * producers push leases (agc_seen dedup + agc_push) the way the head's
+//     node_exec_raw ingest and the spill-accept path do;
+//   * a dispatcher thread plans (agc_dispatch), drains outboxes
+//     (agc_take_outbox) and drecs — the agent main loop's role;
+//   * a completer pops inflight entries (agc_inflight_pop) like the done
+//     path, racing the dispatcher;
+//   * a stealer runs agc_steal_tail / agc_fail_worker — the spill/reclaim
+//     and worker-death cold paths;
+//   * worker churn adds/removes workers and flips eligibility mid-storm.
+//
+// Every operation here is legal concurrent API use, so any TSan report is
+// an agent_core bug, not a harness artifact. Run with
+// TSAN_OPTIONS=halt_on_error=1 (tests/test_sanitizers.py).
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* agc_new();
+void agc_free(void*);
+int agc_worker_add(void*, uint64_t, int, const uint8_t*, int, const char*,
+                   int);
+void agc_worker_remove(void*, int);
+void agc_worker_eligible(void*, int, int);
+void agc_load_add(void*, int, int);
+int agc_seen(void*, const uint8_t*, int, uint64_t);
+int agc_push(void*, const uint8_t*, int, const uint8_t*, int, uint64_t,
+             const uint8_t*, uint64_t, int64_t, const uint8_t*, int, int);
+void agc_fn_blob(void*, const uint8_t*, int, const uint8_t*, uint64_t);
+uint64_t agc_backlog(void*);
+uint64_t agc_inflight(void*);
+int agc_idle(void*);
+int agc_dispatch(void*, int, int);
+int agc_outbox_widx(void*, int);
+int agc_take_outbox(void*, int, const uint8_t**, uint64_t*);
+int agc_drec_count(void*);
+int agc_drec(void*, int, const uint8_t**, uint64_t*, int*, int64_t*,
+             const uint8_t**, uint64_t*);
+int agc_inflight_pop(void*, const uint8_t*, int);
+int agc_steal_tail(void*, int);
+int agc_fail_worker(void*, int);
+int agc_stolen(void*, int, const uint8_t**, uint64_t*, const uint8_t**,
+               uint64_t*, uint64_t*, const uint8_t**, uint64_t*);
+void agc_stats(void*, uint64_t*, uint64_t*, uint64_t*);
+}
+
+namespace {
+
+constexpr int kWorkers = 6;
+constexpr int kProducers = 3;
+constexpr int kTasksPerProducer = 4000;
+
+std::atomic<bool> g_stop{false};
+std::atomic<uint64_t> g_pushed{0}, g_dispatched{0}, g_completed{0},
+    g_stolen{0}, g_failed{0};
+
+void make_tid(uint8_t* out, int producer, int i) {
+  memset(out, 0, 16);
+  out[0] = (uint8_t)producer;
+  memcpy(out + 1, &i, sizeof(i));
+}
+
+void producer(void* c, int id) {
+  uint8_t tid[16], fn[16];
+  memset(fn, 0x41 + id, 16);
+  uint8_t blob[64];
+  memset(blob, 0x55, sizeof(blob));
+  agc_fn_blob(c, fn, 16, blob, sizeof(blob));
+  std::string spec(180 + id * 7, (char)('a' + id));
+  for (int i = 0; i < kTasksPerProducer; i++) {
+    make_tid(tid, id, i);
+    uint64_t seq = 1 + (i % 3);
+    if (agc_seen(c, tid, 16, seq)) continue;
+    agc_push(c, tid, 16, fn, 16, seq, (const uint8_t*)spec.data(),
+             spec.size(), i % 4, (const uint8_t*)"stress", 6, i % 17 == 0);
+    g_pushed.fetch_add(1, std::memory_order_relaxed);
+    if (i % 64 == 0) agc_seen(c, tid, 16, seq);  // re-drive dedup path
+  }
+}
+
+void dispatcher(void* c) {
+  const uint8_t* p;
+  uint64_t n;
+  while (!g_stop.load(std::memory_order_acquire)) {
+    int k = agc_dispatch(c, 8, 1);
+    for (int i = 0; i < k; i++) {
+      int widx = agc_outbox_widx(c, i);
+      if (widx >= 0 && agc_take_outbox(c, widx, &p, &n) == 0 && n > 0)
+        g_dispatched.fetch_add(1, std::memory_order_relaxed);
+    }
+    const uint8_t *tp, *np;
+    uint64_t tl, nl;
+    int widx;
+    int64_t att;
+    int dr = agc_drec_count(c);
+    for (int i = 0; i < dr; i++)
+      agc_drec(c, i, &tp, &tl, &widx, &att, &np, &nl);
+    agc_backlog(c);
+    agc_idle(c);
+  }
+}
+
+// Completions: replay every possible tid through inflight_pop, racing the
+// dispatcher that inserts them.
+void completer(void* c) {
+  uint8_t tid[16];
+  while (!g_stop.load(std::memory_order_acquire)) {
+    for (int pr = 0; pr < kProducers; pr++) {
+      for (int i = 0; i < kTasksPerProducer; i += 7) {
+        make_tid(tid, pr, i);
+        if (agc_inflight_pop(c, tid, 16) >= 0)
+          g_completed.fetch_add(1, std::memory_order_relaxed);
+      }
+    }
+  }
+}
+
+void stealer(void* c) {
+  while (!g_stop.load(std::memory_order_acquire)) {
+    int n = agc_steal_tail(c, 16);
+    const uint8_t *tp, *fp, *sp;
+    uint64_t tl, fl, sl, seq;
+    for (int i = 0; i < n; i++) {
+      if (agc_stolen(c, i, &tp, &tl, &fp, &fl, &seq, &sp, &sl) == 0) {
+        // push the stolen lease back (the hop-capped / reclaim path)
+        agc_push(c, tp, (int)tl, fp, (int)fl, seq, sp, sl, 0, nullptr, 0,
+                 0);
+        g_stolen.fetch_add(1, std::memory_order_relaxed);
+      }
+    }
+    std::this_thread::yield();
+  }
+}
+
+void churner(void* c, int base_widx) {
+  uint8_t wid[8];
+  int flip = 0;
+  while (!g_stop.load(std::memory_order_acquire)) {
+    memset(wid, 0x77, 8);
+    int w = agc_worker_add(c, 1000 + flip, -1, wid, 8, "deadbeefdead", 1);
+    agc_load_add(c, w, 1);
+    agc_load_add(c, w, -1);
+    int n = agc_fail_worker(c, w);
+    if (n) g_failed.fetch_add(n, std::memory_order_relaxed);
+    agc_worker_remove(c, w);
+    agc_worker_eligible(c, base_widx + (flip % kWorkers), flip & 1);
+    agc_worker_eligible(c, base_widx + (flip % kWorkers), 1);
+    flip++;
+    std::this_thread::yield();
+  }
+}
+
+}  // namespace
+
+int main() {
+  void* c = agc_new();
+  uint8_t wid[8];
+  for (int i = 0; i < kWorkers; i++) {
+    memset(wid, i, 8);
+    agc_worker_add(c, 100 + i, -1, wid, 8, "aabbccddeeff0011", 1);
+  }
+  std::vector<std::thread> ts;
+  ts.emplace_back(dispatcher, c);
+  ts.emplace_back(completer, c);
+  ts.emplace_back(stealer, c);
+  ts.emplace_back(churner, c, 0);
+  for (int i = 0; i < kProducers; i++) ts.emplace_back(producer, c, i);
+  for (size_t i = ts.size() - kProducers; i < ts.size(); i++) ts[i].join();
+  ts.resize(ts.size() - kProducers);
+  // drain: let the dispatcher/completer race over the tail for a moment
+  for (int spin = 0; spin < 200 && agc_backlog(c) > 0; spin++)
+    agc_dispatch(c, 8, 0);
+  g_stop.store(true, std::memory_order_release);
+  for (auto& t : ts) t.join();
+  uint64_t grants, dones, dispatched;
+  agc_stats(c, &grants, &dones, &dispatched);
+  printf("pushed=%llu planner_dispatched=%llu completed=%llu stolen=%llu "
+         "failed=%llu backlog=%llu inflight=%llu\n",
+         (unsigned long long)g_pushed.load(),
+         (unsigned long long)dispatched,
+         (unsigned long long)g_completed.load(),
+         (unsigned long long)g_stolen.load(),
+         (unsigned long long)g_failed.load(),
+         (unsigned long long)agc_backlog(c),
+         (unsigned long long)agc_inflight(c));
+  bool ok = g_pushed.load() > 0 && dispatched > 0 && g_completed.load() > 0;
+  agc_free(c);
+  if (!ok) {
+    fprintf(stderr, "stress exercised too little of the ledger\n");
+    return 2;
+  }
+  printf("AGENT_CORE_STRESS_OK\n");
+  return 0;
+}

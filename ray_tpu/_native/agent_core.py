@@ -1,0 +1,322 @@
+"""ctypes bindings for the native select-round core (cpp/agent_core.cc).
+
+One `AgentCore` instance per agent process: the C++ side owns the frame
+pump (epoll + outer-frame split + pickle-prefix sniff), the lease ledger
+(queue of raw spec bytes, dedup, inflight, per-worker load/fn tables) and
+the native frame builders; Python keeps policy and performs every socket
+write under the same locks as the pure-Python path. Built on demand
+through the content-hash g++ cache (ray_tpu/_native/build.py) — a failed
+build degrades to the pure-Python scheduler, never to an error.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+_u64 = ctypes.c_uint64
+_i32 = ctypes.c_int
+_u8p = ctypes.POINTER(ctypes.c_uint8)
+
+# Frame kinds surfaced by the pump.
+KIND_PICKLE = 0
+KIND_PROTO = 1
+KIND_RAW = 2
+KIND_EOF = 3
+
+_lib = None
+_lib_err = None
+
+
+def _load():
+    global _lib, _lib_err
+    if _lib is not None or _lib_err is not None:
+        return _lib
+    try:
+        from ray_tpu._native import build as _b
+        from ray_tpu._native.build import load_native
+        native_dir = os.path.dirname(os.path.abspath(_b.__file__))
+        repo = os.path.dirname(os.path.dirname(native_dir))
+        src = os.path.join(repo, "cpp", "agent_core.cc")
+        lib = load_native("agent_core", sources=(src,))
+    except Exception as e:  # noqa: BLE001 — degrade to pure Python
+        _lib_err = e
+        return None
+    p = ctypes.c_void_p
+    lib.agc_new.restype = p
+    lib.agc_free.argtypes = [p]
+    lib.agc_add_fd.argtypes = [p, _i32, _u64, _i32]
+    lib.agc_del_fd.argtypes = [p, _i32]
+    lib.agc_poll.argtypes = [p, _i32]
+    lib.agc_split.argtypes = [p]
+    lib.agc_consume_hot.argtypes = [p, _u64]
+    lib.agc_dispatch.argtypes = [p, _i32, _i32]
+    lib.agc_outbox_widx.argtypes = [p, _i32]
+    lib.agc_take_outbox.argtypes = [p, _i32, ctypes.POINTER(_u8p),
+                                    ctypes.POINTER(_u64)]
+    lib.agc_drec_count.argtypes = [p]
+    lib.agc_drec.argtypes = [p, _i32, ctypes.POINTER(_u8p),
+                             ctypes.POINTER(_u64), ctypes.POINTER(_i32),
+                             ctypes.POINTER(ctypes.c_int64),
+                             ctypes.POINTER(_u8p), ctypes.POINTER(_u64)]
+    lib.agc_nd_take.argtypes = [p, ctypes.POINTER(_u8p),
+                                ctypes.POINTER(_u64)]
+    lib.agc_frame_count.argtypes = [p]
+    lib.agc_frame_info.argtypes = [
+        p, _i32, ctypes.POINTER(_u64), ctypes.POINTER(_i32),
+        ctypes.POINTER(_i32), ctypes.POINTER(_u8p), ctypes.POINTER(_u64),
+        ctypes.POINTER(_u8p), ctypes.POINTER(_u64), ctypes.POINTER(_i32),
+        ctypes.POINTER(_i32)]
+    lib.agc_frame_buf.argtypes = [p, _i32, _i32, ctypes.POINTER(_u8p),
+                                  ctypes.POINTER(_u64)]
+    lib.agc_round_end.argtypes = [p]
+    lib.agc_worker_add.argtypes = [p, _u64, _i32, ctypes.c_char_p, _i32,
+                                   ctypes.c_char_p, _i32]
+    lib.agc_worker_remove.argtypes = [p, _i32]
+    lib.agc_worker_eligible.argtypes = [p, _i32, _i32]
+    lib.agc_load_add.argtypes = [p, _i32, _i32]
+    lib.agc_worker_load.argtypes = [p, _i32]
+    lib.agc_seen.argtypes = [p, ctypes.c_char_p, _i32, _u64]
+    lib.agc_push.argtypes = [p, ctypes.c_char_p, _i32, ctypes.c_char_p,
+                             _i32, _u64, ctypes.c_char_p, _u64,
+                             ctypes.c_int64, ctypes.c_char_p, _i32, _i32]
+    lib.agc_fn_blob.argtypes = [p, ctypes.c_char_p, _i32, ctypes.c_char_p,
+                                _u64]
+    lib.agc_get_fn_blob.argtypes = [p, ctypes.c_char_p, _i32,
+                                    ctypes.POINTER(_u8p),
+                                    ctypes.POINTER(_u64)]
+    lib.agc_has_fn_blob.argtypes = [p, ctypes.c_char_p, _i32]
+    lib.agc_backlog.argtypes = [p]
+    lib.agc_backlog.restype = _u64
+    lib.agc_inflight.argtypes = [p]
+    lib.agc_inflight.restype = _u64
+    lib.agc_idle.argtypes = [p]
+    lib.agc_inflight_pop.argtypes = [p, ctypes.c_char_p, _i32]
+    lib.agc_steal_tail.argtypes = [p, _i32]
+    lib.agc_fail_worker.argtypes = [p, _i32]
+    lib.agc_stolen.argtypes = [
+        p, _i32, ctypes.POINTER(_u8p), ctypes.POINTER(_u64),
+        ctypes.POINTER(_u8p), ctypes.POINTER(_u64), ctypes.POINTER(_u64),
+        ctypes.POINTER(_u8p), ctypes.POINTER(_u64)]
+    lib.agc_stats.argtypes = [p, ctypes.POINTER(_u64), ctypes.POINTER(_u64),
+                              ctypes.POINTER(_u64)]
+    lib.agc_proto_tag_count.argtypes = []
+    lib.agc_proto_tag_entry.argtypes = [_i32, ctypes.POINTER(_i32),
+                                        ctypes.POINTER(ctypes.c_char_p)]
+    _lib = lib
+    return lib
+
+
+def _view(ptr, n):
+    if not n:
+        return b""
+    return memoryview((ctypes.c_uint8 * n).from_address(
+        ctypes.cast(ptr, ctypes.c_void_p).value))
+
+
+HEAD_TAG = 1  # the agent's head link; worker tags are assigned per worker
+
+
+class AgentCore:
+    """Python face of one native select-round context."""
+
+    def __init__(self):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"agent_core build failed: {_lib_err!r}")
+        self._lib = lib
+        self._ctx = lib.agc_new()
+        self._next_tag = 16
+
+    def close(self):
+        if self._ctx:
+            self._lib.agc_free(self._ctx)
+            self._ctx = None
+
+    # -- pump --
+
+    def add_fd(self, fd: int, tag: int, raw: bool = False):
+        self._lib.agc_add_fd(self._ctx, fd, tag, 1 if raw else 0)
+
+    def del_fd(self, fd: int):
+        self._lib.agc_del_fd(self._ctx, fd)
+
+    def alloc_tag(self) -> int:
+        self._next_tag += 1
+        return self._next_tag
+
+    def poll(self, timeout_ms: int) -> int:
+        return self._lib.agc_poll(self._ctx, timeout_ms)
+
+    def split(self) -> int:
+        return self._lib.agc_split(self._ctx)
+
+    def consume_hot(self, head_tag: int = HEAD_TAG) -> int:
+        return self._lib.agc_consume_hot(self._ctx, head_tag)
+
+    def frames(self):
+        """Yield (tag, kind, proto_tag, payload_view, bufs, whole_view) for
+        every frame Python must handle. Views die at round_end()."""
+        lib, ctx = self._lib, self._ctx
+        n = lib.agc_frame_count(ctx)
+        tag, kind, ptag = _u64(), _i32(), _i32()
+        pp, pl = _u8p(), _u64()
+        wp, wl = _u8p(), _u64()
+        nb, cons = _i32(), _i32()
+        for i in range(n):
+            if lib.agc_frame_info(ctx, i, tag, kind, ptag, pp, pl, wp, wl,
+                                  nb, cons) != 0:
+                continue
+            if cons.value:
+                continue
+            bufs = []
+            for j in range(nb.value):
+                bp, bl = _u8p(), _u64()
+                if lib.agc_frame_buf(ctx, i, j, bp, bl) == 0:
+                    # bytes COPY, not a view: out-of-band buffers can
+                    # outlive the round inside decoded messages (a spec
+                    # parked on a dial thread, a relayed obj push) while
+                    # the native conn buffer is recycled at round_end —
+                    # matching FrameBuffer, which also yields bytes.
+                    bufs.append(bytes(_view(bp, bl.value)))
+            yield (tag.value, kind.value, ptag.value,
+                   _view(pp, pl.value), bufs, _view(wp, wl.value))
+
+    def round_end(self):
+        self._lib.agc_round_end(self._ctx)
+
+    # -- dispatch --
+
+    def dispatch(self, depth: int, record: bool) -> list:
+        """Plan + natively build per-worker batches; returns the widx list
+        whose outboxes gained frames."""
+        lib, ctx = self._lib, self._ctx
+        k = lib.agc_dispatch(ctx, depth, 1 if record else 0)
+        return [lib.agc_outbox_widx(ctx, i) for i in range(k)]
+
+    def take_outbox(self, widx: int):
+        pp, pl = _u8p(), _u64()
+        if self._lib.agc_take_outbox(self._ctx, widx, pp, pl) != 0:
+            return b""
+        return _view(pp, pl.value)
+
+    def dispatch_records(self):
+        """[(tid, widx, attempt, name|None)] for this round's dispatches."""
+        lib, ctx = self._lib, self._ctx
+        out = []
+        tp, tl, widx = _u8p(), _u64(), _i32()
+        att = ctypes.c_int64()
+        np_, nl = _u8p(), _u64()
+        for i in range(lib.agc_drec_count(ctx)):
+            if lib.agc_drec(ctx, i, tp, tl, widx, att, np_, nl) == 0:
+                name = bytes(_view(np_, nl.value)).decode(
+                    "utf-8", "replace") if nl.value else None
+                out.append((bytes(_view(tp, tl.value)), widx.value,
+                            att.value, name))
+        return out
+
+    def take_node_done(self):
+        pp, pl = _u8p(), _u64()
+        self._lib.agc_nd_take(self._ctx, pp, pl)
+        return _view(pp, pl.value) if pl.value else b""
+
+    # -- ledger --
+
+    def worker_add(self, tag, fd, wid: bytes, whex: str,
+                   eligible: bool = True) -> int:
+        return self._lib.agc_worker_add(self._ctx, tag, fd, wid, len(wid),
+                                        whex.encode(), 1 if eligible else 0)
+
+    def worker_remove(self, widx: int):
+        self._lib.agc_worker_remove(self._ctx, widx)
+
+    def worker_eligible(self, widx: int, ok: bool):
+        self._lib.agc_worker_eligible(self._ctx, widx, 1 if ok else 0)
+
+    def load_add(self, widx: int, n: int):
+        self._lib.agc_load_add(self._ctx, widx, n)
+
+    def worker_load(self, widx: int) -> int:
+        return self._lib.agc_worker_load(self._ctx, widx)
+
+    def seen(self, tid: bytes, seq: int) -> bool:
+        return bool(self._lib.agc_seen(self._ctx, tid, len(tid), seq or 0))
+
+    def push(self, tid: bytes, fn: bytes | None, seq: int,
+             spec_bytes: bytes, attempt: int = 0, name: str | None = None,
+             front: bool = False):
+        fn = fn or b""
+        nm = (name or "").encode("utf-8", "replace")
+        self._lib.agc_push(self._ctx, tid, len(tid), fn, len(fn), seq or 0,
+                           spec_bytes, len(spec_bytes), attempt or 0,
+                           nm, len(nm), 1 if front else 0)
+
+    def fn_blob(self, fn: bytes, blob: bytes):
+        self._lib.agc_fn_blob(self._ctx, fn, len(fn), blob, len(blob))
+
+    def get_fn_blob(self, fn: bytes):
+        pp, pl = _u8p(), _u64()
+        if self._lib.agc_get_fn_blob(self._ctx, fn, len(fn), pp, pl) != 0:
+            return None
+        return bytes(_view(pp, pl.value))
+
+    def has_fn_blob(self, fn: bytes) -> bool:
+        return bool(self._lib.agc_has_fn_blob(self._ctx, fn, len(fn)))
+
+    def backlog(self) -> int:
+        return int(self._lib.agc_backlog(self._ctx))
+
+    def inflight(self) -> int:
+        return int(self._lib.agc_inflight(self._ctx))
+
+    def idle(self) -> int:
+        return int(self._lib.agc_idle(self._ctx))
+
+    def inflight_pop(self, tid: bytes) -> int:
+        return self._lib.agc_inflight_pop(self._ctx, tid, len(tid))
+
+    def _stolen(self, n: int) -> list:
+        lib, ctx = self._lib, self._ctx
+        out = []
+        tp, tl = _u8p(), _u64()
+        fp, fl = _u8p(), _u64()
+        seq = _u64()
+        sp, sl = _u8p(), _u64()
+        for i in range(n):
+            if lib.agc_stolen(ctx, i, tp, tl, fp, fl, seq, sp, sl) == 0:
+                out.append((bytes(_view(tp, tl.value)),
+                            bytes(_view(fp, fl.value)) or None,
+                            seq.value, bytes(_view(sp, sl.value))))
+        return out
+
+    def steal_tail(self, n: int) -> list:
+        """Pop up to n newest un-started leases: [(tid, fn, seq, spec)]."""
+        return self._stolen(self._lib.agc_steal_tail(self._ctx, n))
+
+    def fail_worker(self, widx: int) -> list:
+        """Drain a dead worker's inflight leases: [(tid, fn, seq, spec)]."""
+        return self._stolen(self._lib.agc_fail_worker(self._ctx, widx))
+
+    def stats(self) -> dict:
+        g, d, x = _u64(), _u64(), _u64()
+        self._lib.agc_stats(self._ctx, g, d, x)
+        return {"native_grants": g.value, "native_dones": d.value,
+                "native_dispatched": x.value}
+
+
+def proto_tag_table() -> dict:
+    """The AgentFrame oneof tags compiled into the native sniffer
+    (staticcheck cross-checks these against raytpu.proto)."""
+    lib = _load()
+    if lib is None:
+        return {}
+    out = {}
+    f, name = _i32(), ctypes.c_char_p()
+    for i in range(lib.agc_proto_tag_count()):
+        if lib.agc_proto_tag_entry(i, f, name) == 0:
+            out[name.value.decode()] = f.value
+    return out
+
+
+def available() -> bool:
+    return _load() is not None

@@ -58,8 +58,18 @@ def build_native(name: str, sources: tuple = (),
 
 def _build(name: str, sources: tuple = (),
            sanitizer: str | None = None) -> str:
-    srcs = [os.path.join(_DIR, f"{name}.cpp")]
-    srcs += [os.path.join(_DIR, s) for s in sources]
+    # Default source is _native/<name>.cpp; absolute `sources` entries
+    # (e.g. cpp/agent_core.cc, which lives beside the other cross-language
+    # C++ in the repo's cpp/ tree) are taken as-is, so one cache serves
+    # both layouts.
+    srcs = []
+    primary = os.path.join(_DIR, f"{name}.cpp")
+    if os.path.exists(primary):
+        srcs.append(primary)
+    srcs += [s if os.path.isabs(s) else os.path.join(_DIR, s)
+             for s in sources]
+    if not srcs:
+        raise FileNotFoundError(f"no sources for native module {name!r}")
     extra, san_tag = _sanitizer_flags(sanitizer)
     tag = _source_hash(srcs) + san_tag
     so_path = os.path.join(_BUILD_DIR, f"{name}-{tag}.so")

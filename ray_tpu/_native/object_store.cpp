@@ -33,6 +33,7 @@
 #include <signal.h>
 #include <stdint.h>
 #include <string.h>
+#include <sys/mman.h>
 #include <unistd.h>
 
 #include <algorithm>
@@ -83,7 +84,7 @@ enum StoreStatus {
   ERR_CORRUPT = -7,
 };
 
-static const uint64_t MAGIC = 0x5241595F54505534ULL;  // "RAY_TPU4" (rsv records)
+static const uint64_t MAGIC = 0x5241595F54505535ULL;  // "RAY_TPU5" (affinity)
 static const uint64_t ALIGN = 64;
 static const uint64_t MIN_BLOCK = 128;
 static const uint32_t SHARD_CANARY = 0x53484152;      // "SHAR"
@@ -132,6 +133,13 @@ struct RsvRec {
   uint64_t active;       // atomic 0/1; set last (release) at register
 };
 
+static const uint64_t MAX_AFF_RECS = 64;
+struct AffRec {
+  uint64_t pid;   // 0 = empty
+  uint64_t off;   // arena-relative range start the pid last owned
+  uint64_t size;
+};
+
 static const uint64_t FASTBIN_MAX = 2048;   // largest fastbinned block
 static const uint64_t NUM_FASTBINS = FASTBIN_MAX / ALIGN;  // 64..2048 step 64
 static const uint64_t SMALL_MAX = 256u << 10;  // shard-cache ceiling
@@ -175,6 +183,16 @@ struct Header {
                                // budget by this so N concurrent clients
                                // don't oversubscribe N*threads workers
   RsvRec rsv_recs[MAX_RSV_RECS];  // live-extent ownership (crash sweep)
+  // Owner-affinity hints: the last extent range each pid drained (recorded
+  // when a reservation record retires or a tail is released). store_reserve
+  // prefers carving its next extent from free bytes inside the caller's
+  // hinted range, so a refill lands on pages already in that process's page
+  // table — BENCH_r06 isolated the cold-refill page faults as the 8.4->2.1
+  // GB/s multi-writer collapse. Hints are advisory: torn reads just cost a
+  // failed range probe, never a wrong allocation (the free list is the
+  // truth). All fields accessed with relaxed atomics (TSan-clean).
+  uint64_t num_aff_hits;           // atomic: affinity-satisfied reserves
+  AffRec aff_recs[MAX_AFF_RECS];
 };
 
 static inline Shard* shard_at(Header* h, uint64_t i) {
@@ -543,6 +561,71 @@ static void sweep_evict_all_shards(Header* h, bool* progress) {
   }
 }
 
+// ---- owner-affinity hints (process-local gates + in-shm hint table) ----
+
+// Per-process knobs (store_reserve_config): compiled-in defaults ON; the
+// Python side lowers them from the put_extent_affinity / put_extent_pretouch
+// config knobs at configure time.
+static int g_rsv_affinity = 1;
+static int g_rsv_pretouch = 1;
+
+void store_reserve_config(int affinity, int pretouch) {
+  g_rsv_affinity = affinity;
+  g_rsv_pretouch = pretouch;
+}
+
+static void aff_note(Header* h, uint64_t pid, uint64_t off, uint64_t size) {
+  if (!pid) return;
+  AffRec* r = &h->aff_recs[pid % MAX_AFF_RECS];
+  __atomic_store_n(&r->off, off, __ATOMIC_RELAXED);
+  __atomic_store_n(&r->size, size, __ATOMIC_RELAXED);
+  __atomic_store_n(&r->pid, pid, __ATOMIC_RELAXED);
+}
+
+// Carve `need` bytes from a free block whose usable span intersects
+// [lo, hi) — allocation starts at max(block_start, lo) so a hinted range
+// coalesced into a larger block still yields the warm bytes (3-way split:
+// head remainder, carve, tail remainder). Caller holds the global mutex.
+static int64_t list_alloc_in_range(Header* h, uint64_t* headp, uint64_t lo,
+                                   uint64_t hi, uint64_t need) {
+  if (hi <= lo || hi - lo < need) return -1;
+  uint64_t prev = 0, cur = *headp;
+  while (cur) {
+    FreeBlock* fb = (FreeBlock*)(arena(h) + cur);
+    uint64_t b = cur, e = cur + fb->size, nxt = fb->next;
+    uint64_t start = b > lo ? b : lo;
+    if (start < hi && start + need <= e) {
+      // unlink the block, re-insert the remainders
+      if (prev) ((FreeBlock*)(arena(h) + prev))->next = nxt;
+      else *headp = nxt;
+      if (start > b) list_insert_ordered(h, headp, b, start - b);
+      if (e > start + need)
+        list_insert_ordered(h, headp, start + need, e - (start + need));
+      return (int64_t)start;
+    }
+    prev = cur;
+    cur = nxt;
+  }
+  return -1;
+}
+
+// Pre-fault a carved extent so the client's bump-fill memcpys never
+// minor-fault mid-copy: MADV_POPULATE_WRITE where the kernel has it,
+// else one write per page (the bytes are ours and uninitialized).
+#ifndef MADV_POPULATE_WRITE
+#define MADV_POPULATE_WRITE 23
+#endif
+static void pretouch(char* p, uint64_t n) {
+  uint64_t page = 4096;
+  uint64_t lo = (uint64_t)p & ~(page - 1);
+  uint64_t hi = ((uint64_t)p + n + page - 1) & ~(page - 1);
+  if (madvise((void*)lo, hi - lo, MADV_POPULATE_WRITE) == 0) return;
+  for (volatile char* q = (volatile char*)p; q < (volatile char*)(p + n);
+       q += page)
+    *q = *q;
+  if (n) { volatile char* q = (volatile char*)(p + n - 1); *q = *q; }
+}
+
 // Find the active record whose extent contains arena-relative `off`, or
 // null. Records are few and mutate rarely; the scan is lock-free (active
 // flips 0->1 with release ordering after the fields are written, and only
@@ -572,9 +655,15 @@ static void rsv_account(Header* h, uint64_t off, uint64_t bytes) {
   uint64_t left =
       __atomic_sub_fetch(&r->unpublished, bytes, __ATOMIC_RELAXED);
   if (left == 0
-      || left > __atomic_load_n(&r->size, __ATOMIC_RELAXED))
-    // drained (or accounting drift): retire the record slot
+      || left > __atomic_load_n(&r->size, __ATOMIC_RELAXED)) {
+    // drained (or accounting drift): retire the record slot, leaving an
+    // owner-affinity hint behind — the drained extent's pages are warm in
+    // this pid's page table, so its NEXT reserve should carve from here.
+    aff_note(h, __atomic_load_n(&r->pid, __ATOMIC_RELAXED),
+             __atomic_load_n(&r->off, __ATOMIC_RELAXED),
+             __atomic_load_n(&r->size, __ATOMIC_RELAXED));
     __atomic_store_n(&r->active, 0, __ATOMIC_RELEASE);
+  }
 }
 
 // Carve a raw extent of `size` bytes; *out_offset is ABSOLUTE (from
@@ -585,9 +674,27 @@ static void rsv_account(Header* h, uint64_t off, uint64_t bytes) {
 int store_reserve(void* base, uint64_t size, uint64_t* out_offset) {
   Header* h = (Header*)base;
   uint64_t need = align_up(size < MIN_BLOCK ? MIN_BLOCK : size);
+  uint64_t self = (uint64_t)getpid();
+  // Owner-affinity probe (advisory hint; relaxed reads — the free list
+  // walk below is the truth): prefer bytes this pid drained before.
+  uint64_t aff_lo = 0, aff_hi = 0;
+  if (g_rsv_affinity) {
+    AffRec* ar = &h->aff_recs[self % MAX_AFF_RECS];
+    if (__atomic_load_n(&ar->pid, __ATOMIC_RELAXED) == self) {
+      aff_lo = __atomic_load_n(&ar->off, __ATOMIC_RELAXED);
+      aff_hi = aff_lo + __atomic_load_n(&ar->size, __ATOMIC_RELAXED);
+      if (aff_hi <= aff_lo || aff_hi > h->arena_size) aff_lo = aff_hi = 0;
+    }
+  }
   for (;;) {
     lock_mu(&h->mutex);
-    int64_t off = list_alloc_first_fit(h, &h->free_head, need);
+    int64_t off = -1;
+    if (aff_hi > aff_lo) {
+      off = list_alloc_in_range(h, &h->free_head, aff_lo, aff_hi, need);
+      if (off >= 0)
+        __atomic_add_fetch(&h->num_aff_hits, 1, __ATOMIC_RELAXED);
+    }
+    if (off < 0) off = list_alloc_first_fit(h, &h->free_head, need);
     if (off >= 0) {
       h->bytes_from_global += need;
       // Register ownership INSIDE the critical section: a death after
@@ -610,12 +717,18 @@ int store_reserve(void* base, uint64_t size, uint64_t* out_offset) {
     unlock_mu(&h->mutex);
     if (off >= 0) {
       *out_offset = h->arena_offset + (uint64_t)off;
+      if (g_rsv_pretouch)
+        pretouch(arena(h) + (uint64_t)off, need);
       return OK;
     }
     bool progress = false;
     sweep_evict_all_shards(h, &progress);
     if (!progress) return ERR_FULL;
   }
+}
+
+uint64_t store_aff_hits(void* base) {
+  return __atomic_load_n(&((Header*)base)->num_aff_hits, __ATOMIC_RELAXED);
 }
 
 // Return an unused reservation slice (tail, aborted chunk, or the whole
@@ -630,6 +743,9 @@ int store_release_extent(void* base, uint64_t abs_offset, uint64_t size) {
   list_insert_ordered(h, &h->free_head, off, size);
   unlock_mu(&h->mutex);
   __atomic_sub_fetch(&h->rsv_unused_bytes, size, __ATOMIC_RELAXED);
+  // The released slice is warm in this pid's page table — hint the next
+  // reserve at it even when the record has publishes still outstanding.
+  aff_note(h, (uint64_t)getpid(), off, size);
   rsv_account(h, off, size);
   return OK;
 }

@@ -20,6 +20,7 @@ import argparse
 import collections
 import json
 import os
+import pickle
 import selectors
 import signal
 import socket
@@ -41,8 +42,8 @@ from ray_tpu.core.runtime import (
     build_worker_env,
     spawn_worker_process,
 )
-from ray_tpu.core.transport import (FrameBuffer, enable_nodelay, send_many,
-                                    send_msg)
+from ray_tpu.core.transport import (FrameBuffer, enable_nodelay,
+                                    encode_payload, send_many, send_msg)
 
 
 class _AgentWorker:
@@ -72,6 +73,12 @@ class _AgentWorker:
         # frame: set => the WORKER owns the order gate for its actor, so
         # this agent delivers exec frames ungated and forwards seq_skips.
         self.peer_path: str | None = None
+        # Native select-round bookkeeping (cpp/agent_core.cc): the pump
+        # tag this worker's fd carries and its ledger index. None when
+        # the agent runs the pure-Python loop.
+        self.tag: int | None = None
+        self.widx: int | None = None
+        self.nat_fd: int | None = None
 
 
 class _PeerConn:
@@ -279,6 +286,27 @@ class NodeAgent:
         self.zygote = _Zygote(self.session_dir, self.store_path,
                               self._worker_env())
 
+        # --- native select-round core (cpp/agent_core.cc) --- the frame
+        # pump, lease queue/dedup/inflight ledger and hot-frame builds run
+        # in C++ when `native_sched` is on and the module builds; any
+        # failure degrades to the pure-Python loop below, never to an
+        # error. Chaos-armed processes keep the native LEDGER but route
+        # every send through send_msg so the seeded transport sites fire
+        # exactly as scheduled (storm equivalence, not just speed).
+        self._nat = None
+        self._tag_worker: dict[int, _AgentWorker] = {}
+        self._widx_worker: dict[int, _AgentWorker] = {}
+        self._dispatch_plan_lock = threading.Lock()
+        if cfg.native_sched:
+            try:
+                from ray_tpu._native.agent_core import HEAD_TAG, AgentCore
+                nat = AgentCore()
+                nat.add_fd(self.head_sock.fileno(), HEAD_TAG)
+                self._nat = nat
+            except Exception:  # noqa: BLE001 — pure-Python fallback
+                traceback.print_exc()
+                self._nat = None
+
         threading.Thread(target=self._prestart, daemon=True).start()
         threading.Thread(target=self._heartbeat_loop, daemon=True).start()
         threading.Thread(target=self._ctrl_accept_loop, daemon=True,
@@ -312,6 +340,24 @@ class NodeAgent:
         with self._sel_lock:
             self._selector.register(parent, selectors.EVENT_READ,
                                     ("worker", w))
+        self._nat_track_worker(w, eligible=not env_key)
+
+    def _nat_track_worker(self, w: _AgentWorker, eligible: bool):
+        """Register a fresh worker with the native pump + ledger (no-op in
+        pure-Python mode). cpp workers ride the pump in raw mode (their
+        protobuf WorkerFrame stream keeps its own framing)."""
+        nat = self._nat
+        if nat is None:
+            return
+        tag = nat.alloc_tag()
+        w.tag = tag
+        w.nat_fd = w.sock.fileno()
+        w.widx = nat.worker_add(tag, w.nat_fd, w.worker_id.binary(),
+                                w.hex_id,
+                                eligible and w.language == "python")
+        self._tag_worker[tag] = w
+        self._widx_worker[w.widx] = w
+        nat.add_fd(w.nat_fd, tag, raw=(w.language == "cpp"))
 
     def _on_worker_eof(self, w: _AgentWorker):
         with self._sel_lock:
@@ -319,6 +365,14 @@ class NodeAgent:
                 self._selector.unregister(w.sock)
             except (KeyError, ValueError):
                 pass
+        nat_failed = []
+        if self._nat is not None and w.widx is not None:
+            if w.nat_fd is not None:
+                self._nat.del_fd(w.nat_fd)
+            nat_failed = self._nat.fail_worker(w.widx)
+            self._nat.worker_remove(w.widx)
+            self._tag_worker.pop(w.tag, None)
+            self._widx_worker.pop(w.widx, None)
         try:
             w.sock.close()
         except OSError:
@@ -330,8 +384,10 @@ class NodeAgent:
         self.worker_env_key.pop(wid, None)
         self._order_gate.drop_for_target(wid)
         # Leased tasks in flight on the dead worker: the HEAD runs the
-        # retry policy (it owns retries_left); report and forget.
-        lease_failed = []
+        # retry policy (it owns retries_left); report and forget. Native
+        # mode drains the C++ inflight table (raw spec bytes, unpickled
+        # only here on the death path).
+        lease_failed = [pickle.loads(spec) for _t, _f, _s, spec in nat_failed]
         with self._lease_lock:
             self._worker_load.pop(wid, None)
             self._worker_fns.pop(wid, None)
@@ -427,6 +483,11 @@ class NodeAgent:
                     self._selector.unregister(self.head_sock)
                 except (KeyError, ValueError):
                     pass
+            if self._nat is not None:
+                try:
+                    self._nat.del_fd(self.head_sock.fileno())
+                except OSError:
+                    pass
             try:
                 self.head_sock.close()
             except OSError:
@@ -460,6 +521,9 @@ class NodeAgent:
                 with self._sel_lock:
                     self._selector.register(sock, selectors.EVENT_READ,
                                             ("head", None))
+                if self._nat is not None:
+                    from ray_tpu._native.agent_core import HEAD_TAG
+                    self._nat.add_fd(sock.fileno(), HEAD_TAG)
                 return
             self._die()
         finally:
@@ -505,6 +569,15 @@ class NodeAgent:
         ray_syncer.h:20 resource-view role): the head reads idle/backlog
         without ever locking this node's dispatch state."""
         self._hb_version += 1
+        nat = self._nat
+        if nat is not None:
+            # The ledger is native: idle/backlog/inflight read straight
+            # from the C++ tables (cpp leases stay on the Python dicts).
+            with self._lease_lock:
+                return {"v": self._hb_version, "idle": nat.idle(),
+                        "backlog": int(nat.backlog()),
+                        "inflight": (int(nat.inflight())
+                                     + len(self._lease_inflight))}
         with self._lease_lock:
             idle = sum(1 for wid, w in list(self.workers.items())
                        if w.language == "python"
@@ -545,9 +618,12 @@ class NodeAgent:
                    else sum(1 for f in inner[1] if f[0] == "exec")
                    if inner[0] == "batch" else 0)
         if n_execs:
-            with self._lease_lock:
-                self._worker_load[wid] = (
-                    self._worker_load.get(wid, 0) + n_execs)
+            if self._nat is not None and w.widx is not None:
+                self._nat.load_add(w.widx, n_execs)
+            else:
+                with self._lease_lock:
+                    self._worker_load[wid] = (
+                        self._worker_load.get(wid, 0) + n_execs)
         if (inner[0] == "exec"
                 and getattr(inner[1], "caller_seq", None) is not None
                 and w.peer_path is None):
@@ -571,11 +647,85 @@ class NodeAgent:
         except OSError:
             pass
 
+    def _dispatch_depth_locked(self, backlog: int) -> int:
+        """Per-worker pipeline depth for this pump pass (caller holds
+        _lease_lock): shallow while a spillable peer has room, full
+        otherwise — the same heuristic as the Python pump."""
+        depth = self.config.max_tasks_in_flight_per_worker
+        if (self.config.lease_spillback and backlog
+                and backlog > self._spill_keep_locked()
+                and self._view_room_locked()):
+            depth = min(depth, 2)
+        return depth
+
+    def _pump_leases_native(self):
+        """Native dispatch: the C++ planner pops leases onto idle workers
+        and BUILDS the reg_fn/exec_raw frames; Python performs the sends
+        under the existing per-worker locks (and, when chaos is armed,
+        re-expands the batch into per-frame send_msg calls so every
+        seeded transport site fires exactly as in the Python loop)."""
+        nat = self._nat
+        with self._lease_lock:
+            depth = self._dispatch_depth_locked(int(nat.backlog()))
+        armed = chaos._armed is not None
+        record = self._tev.enabled
+        # Planning and the drec drain stay together under a small lock
+        # (dispatch records are per-call scratch); the SENDS happen
+        # outside it — ordering across concurrent pumps is already
+        # guaranteed by the native per-worker outbox (appends under the
+        # ledger mutex, atomic take under the worker's flush lock), the
+        # same staged-outbox contract as the Python pump.
+        with self._dispatch_plan_lock:
+            widxs = nat.dispatch(depth, record)
+            recs = nat.dispatch_records() if record else ()
+        if record:
+            ring = self._tev
+            for tid, widx, attempt, name in recs:
+                w = self._widx_worker.get(widx)
+                ring.emit(tid, attempt, "NODE_DISPATCHED",
+                          (name, None),
+                          {"worker": w.hex_id if w else ""})
+        for widx in widxs:
+            w = self._widx_worker.get(widx)
+            if w is None:
+                continue
+            try:
+                with w.flush_lock:
+                    buf = nat.take_outbox(widx)
+                    if not len(buf):
+                        continue
+                    if not armed:
+                        with w.send_lock:
+                            w.sock.sendall(buf)
+                    else:
+                        # Chaos-armed: replay the prebuilt batch one
+                        # frame at a time through send_msg — drop/
+                        # trunc/delay sites hit individual frames,
+                        # matching the Python loop's storm behavior.
+                        fb = FrameBuffer()
+                        fb.feed(bytes(buf))
+                        for m in fb.frames():
+                            send_msg(w.sock, m, w.send_lock)
+            except OSError:
+                pass  # _on_worker_eof lease-fails the inflight entries
+        with self._lease_lock:
+            spawn = (nat.backlog() > 0
+                     and (len(self.workers) + self._spawns_pending)
+                     < self.max_workers)
+            if spawn:
+                self._spawns_pending += 1
+        if spawn:
+            threading.Thread(target=self._spawn_counted,
+                             daemon=True).start()
+        self._maybe_spill_leases()
+
     def _pump_leases(self):
         """Dispatch queued leases onto locally-idle workers; spawn more
         workers (up to the cap) when backlog outruns the pool — worker
         choice and pool growth are NODE decisions here, the
         local_task_manager.h:65 split."""
+        if self._nat is not None:
+            return self._pump_leases_native()
         per_worker: dict = {}
         spawn = False
         depth = self.config.max_tasks_in_flight_per_worker
@@ -729,6 +879,7 @@ class NodeAgent:
             with self._sel_lock:
                 self._selector.register(parent, selectors.EVENT_READ,
                                         ("worker", w))
+            self._nat_track_worker(w, eligible=False)
         except Exception:  # noqa: BLE001 — a failed spawn must not wedge
             traceback.print_exc()  # the agent; leases fail back via eof
         finally:
@@ -911,6 +1062,121 @@ class NodeAgent:
                 return True
         return False
 
+    def _on_node_exec_raw(self, entries):
+        """Ingest a raw-spec lease batch outside the native fast loop
+        (chaos-armed rounds, walker bails, or native off entirely)."""
+        nat = self._nat
+        if nat is not None:
+            for ent in entries:
+                tid, fn, seq, blob, sb = ent[:5]
+                attempt = ent[5] if len(ent) > 5 else 0
+                name = ent[6] if len(ent) > 6 else None
+                if blob is not None and fn is not None:
+                    nat.fn_blob(fn, blob)
+                if nat.seen(tid, seq or 0):
+                    continue
+                nat.push(tid, fn, seq or 0, sb, attempt, name)
+            self._pump_leases()
+            return
+        # Pure-Python fallback: decode the specs (off the lease lock),
+        # then take the object path.
+        decoded = [(ent[1], ent[3], pickle.loads(ent[4]))
+                   for ent in entries]
+        with self._lease_lock:
+            for fn, blob, spec in decoded:
+                if blob is not None and fn is not None:
+                    self._fn_blobs[fn] = blob
+                if self._lease_dup_locked(spec):
+                    continue
+                self._lease_q.append(spec)
+        self._pump_leases()
+
+    def _maybe_spill_leases_native(self):
+        """Native-ledger spill pass: selection logic mirrors the Python
+        path, but surplus leases are STOLEN from the C++ queue tail and
+        their specs unpickled here (the one cold path that needs the
+        object form — hops/seq live inside the spec)."""
+        cfg = self.config
+        nat = self._nat
+        now = time.monotonic()
+        plan = []
+        with self._lease_lock:
+            if now - self._last_spill < 0.05:
+                return
+            surplus = int(nat.backlog()) - self._spill_keep_locked()
+            if surplus <= 0:
+                return
+            peers = []
+            for nid, e in self._cluster_view.items():
+                if (nid == self.node_id or e.get("state") != "ALIVE"
+                        or not e.get("ctrl")):
+                    continue
+                room = int(e.get("idle", 0)) - int(e.get("backlog", 0))
+                if room > 0:
+                    peers.append((room, nid, e))
+            if not peers:
+                return
+            self._last_spill = now
+            peers.sort(key=lambda t: -t[0])
+            total = min(surplus, sum(room for room, _n, _e in peers))
+            stolen = nat.steal_tail(total)
+        # Spec decode off the lease lock (steal_tail already removed the
+        # entries atomically under the native mutex, so nothing else can
+        # dispatch them meanwhile).
+        cand = [pickle.loads(spec) for _t, _f, _s, spec in stolen]
+        with self._lease_lock:
+            hop_capped = []
+            ci = 0
+            for room, nid, e in peers:
+                take = min(room, len(cand) - ci)
+                specs = []
+                while take > 0 and ci < len(cand):
+                    spec = cand[ci]
+                    ci += 1
+                    hops = spec.spill_hops or 0
+                    if hops >= cfg.lease_spill_max_hops:
+                        hop_capped.append(spec)
+                        continue
+                    spec.spill_hops = hops + 1
+                    if self._tev.enabled:
+                        task_events.emit_task(
+                            spec, "SPILL_SENT",
+                            data={"to": nid.hex(), "hop": spec.spill_hops,
+                                  "lease_seq": spec.lease_seq})
+                    specs.append(spec)
+                    take -= 1
+                if not specs:
+                    continue
+                e["backlog"] = int(e.get("backlog", 0)) + len(specs)
+                sent_fns = self._peer_fns.get(nid) or ()
+                new_fns = set()
+                triples = []
+                for spec in specs:
+                    blob = None
+                    if (spec.fn_id and spec.fn_id not in sent_fns
+                            and spec.fn_id not in new_fns):
+                        blob = nat.get_fn_blob(spec.fn_id)
+                        if blob is not None:
+                            new_fns.add(spec.fn_id)
+                    triples.append((spec.fn_id, blob, spec))
+                plan.append((nid, triples, new_fns))
+            # Hop-capped (must run here) and unplaced surplus go back to
+            # the queue tail, exactly where the Python path leaves them.
+            for spec in hop_capped + cand[ci:]:
+                nat.push(spec.task_id, spec.fn_id, spec.lease_seq or 0,
+                         encode_payload(spec),
+                         task_events.attempt_of(spec), spec.name)
+        for nid, triples, new_fns in plan:
+            if chaos.site("agent.spill_notice.lose"):
+                pass  # injected notice loss (see the Python path)
+            else:
+                self._send_head(("lease_spilled",
+                                 [(t[2].task_id, t[2].lease_seq,
+                                   t[2].spill_hops, nid) for t in triples]))
+            threading.Thread(target=self._spill_to_peer,
+                             args=(nid, triples, new_fns), daemon=True,
+                             name="rtpu-spill").start()
+
     def _maybe_spill_leases(self):
         """Forward surplus un-started leases to under-loaded peers.
         Selection runs under the lease lock; dialing/sending happens on a
@@ -920,6 +1186,8 @@ class NodeAgent:
         cfg = self.config
         if not cfg.lease_spillback or self._shutdown:
             return
+        if self._nat is not None:
+            return self._maybe_spill_leases_native()
         now = time.monotonic()
         plan = []  # (nid, [(fn_id, blob, spec), ...], new fn_ids)
         with self._lease_lock:
@@ -1073,14 +1341,22 @@ class NodeAgent:
         instead of accepting work we could only re-spill."""
         reject = []
         accepted = False
+        nat = self._nat
         with self._lease_lock:
             keep = self._spill_keep_locked()
             for fn_id, blob, spec in triples:
                 if blob is not None:
-                    self._fn_blobs[fn_id] = blob
-                if (len(self._lease_q) >= keep
-                        or (spec.fn_id
-                            and spec.fn_id not in self._fn_blobs)):
+                    if nat is not None:
+                        nat.fn_blob(fn_id, blob)
+                    else:
+                        self._fn_blobs[fn_id] = blob
+                backlog = (int(nat.backlog()) if nat is not None
+                           else len(self._lease_q))
+                have_fn = (not spec.fn_id
+                           or (nat.has_fn_blob(spec.fn_id)
+                               if nat is not None
+                               else spec.fn_id in self._fn_blobs))
+                if backlog >= keep or not have_fn:
                     if self._tev.enabled:
                         task_events.emit_task(
                             spec, "SPILL_REJECTED",
@@ -1093,10 +1369,17 @@ class NodeAgent:
                             spec, "SPILL_RECEIVED",
                             data={"from": origin_nid.hex(),
                                   "hop": spec.spill_hops or 0})
-                    if self._lease_dup_locked(spec):
-                        continue  # already queued here (re-driven grant
-                        # that chased the spill to this node)
-                    self._lease_q.append(spec)
+                    if nat is not None:
+                        if nat.seen(spec.task_id, spec.lease_seq or 0):
+                            continue  # re-driven grant chased the spill
+                        nat.push(spec.task_id, spec.fn_id,
+                                 spec.lease_seq or 0, encode_payload(spec),
+                                 task_events.attempt_of(spec), spec.name)
+                    else:
+                        if self._lease_dup_locked(spec):
+                            continue  # already queued here (re-driven
+                            # grant that chased the spill to this node)
+                        self._lease_q.append(spec)
                     accepted = True
         if reject:
             self._send_head(("lease_return", reject))
@@ -1128,18 +1411,31 @@ class NodeAgent:
         wid = w.worker_id.binary()
         entries = ([msg[1:]] if msg[0] == "done" else list(msg[1]))
         leased, rest = [], []
-        with self._lease_lock:
+        nat = self._nat
+        if nat is not None:
+            # The inflight table is native; a miss is a head-path done
+            # whose load was credited via _to_worker's load_add.
             for e in entries:
-                if self._lease_inflight.pop(e[0], None) is not None:
-                    # (task_id, outs[, exec-span record, worker hex]) —
-                    # the piggybacked exec record keeps riding the
-                    # node_done batch toward the head.
+                if nat.inflight_pop(e[0]) >= 0:
                     leased.append((e[0], e[2]) if len(e) < 4
                                   else (e[0], e[2], e[3], w.hex_id))
                 else:
                     rest.append(e)
-                load = self._worker_load.get(wid, 0)
-                self._worker_load[wid] = max(0, load - 1)
+                    if w.widx is not None:
+                        nat.load_add(w.widx, -1)
+        else:
+            with self._lease_lock:
+                for e in entries:
+                    if self._lease_inflight.pop(e[0], None) is not None:
+                        # (task_id, outs[, exec-span record, worker hex])
+                        # — the piggybacked exec record keeps riding the
+                        # node_done batch toward the head.
+                        leased.append((e[0], e[2]) if len(e) < 4
+                                      else (e[0], e[2], e[3], w.hex_id))
+                    else:
+                        rest.append(e)
+                    load = self._worker_load.get(wid, 0)
+                    self._worker_load[wid] = max(0, load - 1)
         if not leased:
             return msg
         if collector is not None:
@@ -1172,21 +1468,47 @@ class NodeAgent:
             # language="cpp" leases route to their own queue — they only
             # ever dispatch onto cpp workers, over the protobuf plane.
             any_cpp = False
-            with self._lease_lock:
+            nat = self._nat
+            if nat is not None:
+                # Object-form grants (head fallback frames, lease
+                # watchdog re-drives) feed the NATIVE ledger: dedup
+                # against the same seen table the raw path uses, then
+                # re-pickle the spec into the native queue.
                 for fn_id, blob, spec in msg[1]:
-                    if blob is not None:
-                        self._fn_blobs[fn_id] = blob
-                    if self._lease_dup_locked(spec):
-                        continue  # head re-drive of a grant we DID get
+                    if blob is not None and fn_id is not None:
+                        nat.fn_blob(fn_id, blob)
+                    if nat.seen(spec.task_id, spec.lease_seq or 0):
+                        continue  # re-drive of a grant we DID get
                     if getattr(spec, "language", None) == "cpp":
-                        self._cpp_q.append(spec)
+                        with self._lease_lock:
+                            self._cpp_q.append(spec)
                         any_cpp = True
                     else:
-                        self._lease_q.append(spec)
+                        nat.push(spec.task_id, spec.fn_id,
+                                 spec.lease_seq or 0, encode_payload(spec),
+                                 task_events.attempt_of(spec), spec.name)
+            else:
+                with self._lease_lock:
+                    for fn_id, blob, spec in msg[1]:
+                        if blob is not None:
+                            self._fn_blobs[fn_id] = blob
+                        if self._lease_dup_locked(spec):
+                            continue  # head re-drive of a grant we DID get
+                        if getattr(spec, "language", None) == "cpp":
+                            self._cpp_q.append(spec)
+                            any_cpp = True
+                        else:
+                            self._lease_q.append(spec)
             self._pump_leases()
             if any_cpp:
                 self._pump_cpp_leases()
             self._maybe_push_load_delta()
+        elif op == "node_exec_raw":
+            # Native-plane lease batch: specs ride as raw pickle bytes
+            # with (tid, fn, lease_seq, blob, spec, attempt, name)
+            # sideband — consumed in C++ on the native loop; this
+            # handler is the chaos-armed / fallback ingest.
+            self._on_node_exec_raw(msg[1])
         elif op == "cluster_view":
             # Head broadcast of the versioned cluster resource view: a
             # DELTA relative to this agent's head-side cursor (entries
@@ -1201,11 +1523,15 @@ class NodeAgent:
         elif op == "lease_reclaim":
             # Head reclaims un-started backlog for idle nodes elsewhere.
             returned = []
-            with self._lease_lock:
-                for _ in range(int(msg[1])):
-                    if not self._lease_q:
-                        break
-                    returned.append(self._lease_q.pop())
+            if self._nat is not None:
+                returned = [pickle.loads(spec) for _t, _f, _s, spec
+                            in self._nat.steal_tail(int(msg[1]))]
+            else:
+                with self._lease_lock:
+                    for _ in range(int(msg[1])):
+                        if not self._lease_q:
+                            break
+                        returned.append(self._lease_q.pop())
             if returned:
                 self._send_head(("lease_return", returned))
         elif op == "seq_skip":
@@ -1568,7 +1894,47 @@ class NodeAgent:
 
     # ---------------- main loop ----------------
 
+    def _handle_worker_msg(self, w: _AgentWorker, msg, out_frames: list,
+                           lease_dones: list):
+        """One decoded Python-worker frame (shared by the Python select
+        loop and the native pump's slow path)."""
+        op0 = msg[0]
+        if op0 == "actor_ready":
+            # Track which worker hosts which actor — the
+            # re-registration inventory needs it for head-restart
+            # adoption (and the native ledger stops leasing to it).
+            self.worker_actor[w.worker_id.binary()] = msg[1]
+            if self._nat is not None and w.widx is not None:
+                self._nat.worker_eligible(w.widx, False)
+        elif op0 == "direct_actor":
+            # Direct-call fast path: never touches the head.
+            try:
+                self._route_direct(w, msg)
+            except Exception:
+                traceback.print_exc()
+            return
+        elif op0 in ("done", "done_batch"):
+            if self._routed:
+                try:
+                    self._maybe_route_done(w, msg)
+                except Exception:
+                    traceback.print_exc()
+            try:
+                msg = self._sniff_lease_dones(w, msg,
+                                              collector=lease_dones)
+            except Exception:
+                traceback.print_exc()
+            if msg is None:
+                return  # fully leased: rides node_done
+        elif op0 == "ready":
+            if len(msg) > 4 and msg[4]:
+                w.peer_path = msg[4]
+            self._pump_leases()  # fresh worker: feed it
+        out_frames.append(("wmsg", w.worker_id.binary(), msg))
+
     def run(self):
+        if self._nat is not None:
+            return self._run_native()
         while not self._shutdown:
             with self._sel_lock:
                 try:
@@ -1622,39 +1988,87 @@ class NodeAgent:
                     out_frames: list = []
                     lease_dones: list = []
                     for msg in w.buffer.frames():
-                        op0 = msg[0]
-                        if op0 == "actor_ready":
-                            # Track which worker hosts which actor — the
-                            # re-registration inventory needs it for
-                            # head-restart adoption.
-                            self.worker_actor[w.worker_id.binary()] = msg[1]
-                        elif op0 == "direct_actor":
-                            # Direct-call fast path: never touches the head.
-                            try:
-                                self._route_direct(w, msg)
-                            except Exception:
-                                traceback.print_exc()
-                            continue
-                        elif op0 in ("done", "done_batch"):
-                            if self._routed:
-                                try:
-                                    self._maybe_route_done(w, msg)
-                                except Exception:
-                                    traceback.print_exc()
-                            try:
-                                msg = self._sniff_lease_dones(
-                                    w, msg, collector=lease_dones)
-                            except Exception:
-                                traceback.print_exc()
-                            if msg is None:
-                                continue  # fully leased: rides node_done
-                        elif op0 == "ready":
-                            if len(msg) > 4 and msg[4]:
-                                w.peer_path = msg[4]
-                            self._pump_leases()  # fresh worker: feed it
-                        out_frames.append(
-                            ("wmsg", w.worker_id.binary(), msg))
+                        self._handle_worker_msg(w, msg, out_frames,
+                                                lease_dones)
                     self._flush_head_batch(out_frames, lease_dones)
+
+    def _run_native(self):
+        """The select round on the native pump: C++ owns readiness, frame
+        split, hot-frame consumption (lease grants in, leased dones out)
+        and dispatch planning; Python handles the cold frames and performs
+        the sends. Chaos-armed rounds skip native consumption so every
+        frame takes the Python path and its seeded sites."""
+        from ray_tpu.core.transport import _decode_proto
+        from ray_tpu._native.agent_core import (HEAD_TAG, KIND_EOF,
+                                                KIND_PROTO, KIND_RAW)
+        nat = self._nat
+        while not self._shutdown:
+            try:
+                n = nat.poll(50)
+            except OSError:
+                continue
+            if self._order_gate.buffered:
+                self._order_gate.flush_expired()
+            if n <= 0:
+                continue
+            nat.split()
+            consumed = 0
+            if chaos._armed is None and not self._routed:
+                consumed = nat.consume_hot(HEAD_TAG)
+            out_frames: list = []
+            lease_dones: list = []
+            head_eof = False
+            dead_workers: list = []
+            for tag, kind, _ptag, payload, bufs, _whole in nat.frames():
+                try:
+                    if kind == KIND_EOF:
+                        if tag == HEAD_TAG:
+                            head_eof = True
+                        else:
+                            w = self._tag_worker.get(tag)
+                            if w is not None:
+                                dead_workers.append(w)
+                        continue
+                    if tag == HEAD_TAG:
+                        msg = (_decode_proto(bytes(payload))
+                               if kind == KIND_PROTO
+                               else pickle.loads(payload, buffers=bufs))
+                        self._handle_head_msg(msg)
+                        continue
+                    w = self._tag_worker.get(tag)
+                    if w is None:
+                        continue
+                    if kind == KIND_RAW:
+                        self._on_cpp_frames(w, bytes(payload))
+                        continue
+                    msg = (_decode_proto(bytes(payload))
+                           if kind == KIND_PROTO
+                           else pickle.loads(payload, buffers=bufs))
+                    self._handle_worker_msg(w, msg, out_frames,
+                                            lease_dones)
+                except Exception:
+                    traceback.print_exc()
+            self._flush_head_batch(out_frames, lease_dones)
+            if consumed:
+                # The round's node_done_raw batch (raw done frames, one
+                # frame per completing worker) — built natively, sent
+                # under the same head lock as every other head write.
+                nd = nat.take_node_done()
+                if len(nd):
+                    try:
+                        with self.head_lock:
+                            self.head_sock.sendall(nd)
+                    except OSError:
+                        head_eof = True
+                self._pump_leases()
+                self._maybe_push_load_delta()
+            nat.round_end()  # frame views die here
+            for w in dead_workers:
+                self._on_worker_eof(w)
+            if head_eof:
+                self._reconnect_or_die()
+                if self._shutdown:
+                    return
 
     def _tev_frame(self, force: bool = False):
         """A ("task_events", batch, dropped) frame when a flush is due,

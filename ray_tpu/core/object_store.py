@@ -69,6 +69,9 @@ def _lib():
         lib.store_rsv_unused.restype = u64
         lib.store_reclaim_orphans.argtypes = [p]
         lib.store_reclaim_orphans.restype = ctypes.c_int64
+        lib.store_reserve_config.argtypes = [ctypes.c_int, ctypes.c_int]
+        lib.store_aff_hits.argtypes = [p]
+        lib.store_aff_hits.restype = u64
         lib._sigs_set = True
     return lib
 
@@ -360,6 +363,10 @@ class SharedMemoryStore:
 
     def num_reserves(self) -> int:
         return int(self._lib.store_num_reserves(self._base))
+
+    def num_affinity_hits(self) -> int:
+        """Reserves satisfied from this-pid-warm bytes (owner affinity)."""
+        return int(self._lib.store_aff_hits(self._base))
 
     def _carve(self, block: int) -> int | None:
         with self._rsv_lock:
@@ -695,6 +702,12 @@ def configure_store(store: SharedMemoryStore, cfg) -> None:
     """Apply the config's write-reservation knobs to a store handle.
     Called wherever a process creates/attaches its arena handle (head,
     node agent, worker) — the store module itself stays config-free."""
+    try:
+        store._lib.store_reserve_config(
+            1 if cfg.put_extent_affinity else 0,
+            1 if cfg.put_extent_pretouch else 0)
+    except AttributeError:
+        pass  # stale .so without the affinity plane
     mn = cfg.put_reservation_min_bytes
     if mn <= 0:
         store.reservation_chunk_bytes = 0

@@ -18,6 +18,28 @@ from ray_tpu.core.task import TaskSpec
 
 _LARGE_ARG_THRESHOLD = 1024 * 1024  # promote args above this to the shm store
 
+# Per-call `from x import y` resolves through importlib's fromlist handler
+# every time — measurable on the submit hot loop. Cache the modules once
+# (still lazy: runtime/tracing must not import at module load).
+_rt_mod = None
+_tr_mod = None
+
+
+def _runtime_mod():
+    global _rt_mod
+    if _rt_mod is None:
+        from ray_tpu.core import runtime as _rt_mod_  # noqa: N813
+        _rt_mod = _rt_mod_
+    return _rt_mod
+
+
+def _tracing_mod():
+    global _tr_mod
+    if _tr_mod is None:
+        from ray_tpu.util import tracing as _tr_mod_
+        _tr_mod = _tr_mod_
+    return _tr_mod
+
 
 class RemoteFunction:
     def __init__(self, fn, **default_options):
@@ -55,7 +77,7 @@ class RemoteFunction:
             f"use {self.__name__}.remote().")
 
     def _remote(self, args, kwargs, opts):
-        from ray_tpu.util import tracing as _tr  # lazy: tracing pulls otel
+        _tr = _tracing_mod()  # lazy: tracing pulls otel
         if _tr._enabled:
             # The submit span parents the worker-side execute span via the
             # carrier injected below (parity: tracing_helper decorators).
@@ -64,8 +86,8 @@ class RemoteFunction:
         return self._remote_inner(args, kwargs, opts)
 
     def _remote_inner(self, args, kwargs, opts):
-        from ray_tpu.core.runtime import Runtime, get_runtime
-        rt = get_runtime()
+        rt_mod = _runtime_mod()
+        Runtime, rt = rt_mod.Runtime, rt_mod.get_runtime()
         fn_id, fn_blob = self._ensure_serialized()
 
         # Large plain args go to the shm store so the payload frame stays small.
@@ -90,7 +112,7 @@ class RemoteFunction:
             # off — a half-streamed task must not silently replay. Workers
             # consume the stream through head-side stream_next RPCs.
             num_returns = 0
-        from ray_tpu.util import tracing as _tracing
+        _tracing = _tracing_mod()
         trace_ctx = _tracing.inject_context() if _tracing._enabled else None
         rnd = random_bytes(16 + 16 * num_returns)
         task_id = TaskID(rnd[:16])

@@ -45,7 +45,7 @@ from ray_tpu.core.status import (
     WorkerCrashedError,
 )
 from ray_tpu.core.task import ActorCreationSpec, TaskSpec
-from ray_tpu.core.transport import FrameBuffer, send_msg
+from ray_tpu.core.transport import FrameBuffer, encode_payload, send_msg
 
 def _reap_stale_stores(shm_dir: str):
     """Unlink arenas whose head process died without shutdown(), and kill
@@ -2304,6 +2304,12 @@ class Runtime:
                 pass
         elif op == "node_done":
             self._on_node_done(conn, msg[1])
+        elif op == "node_done_raw":
+            # Native-agent completion batch: the agent forwarded the
+            # workers' done frames RAW (no agent-side unpickle/repickle);
+            # the head decodes them here, where the payloads are consumed
+            # anyway. msg = (op, worker_hex, [raw outer frames]).
+            self._on_node_done_raw(conn, msg[1], msg[2])
         elif op == "lease_fail":
             self._on_lease_fail(conn.node_id, msg[1])
         elif op == "lease_spilled":
@@ -4156,14 +4162,49 @@ class Runtime:
                 per_node[node] = []
                 node_order.append(node)
             per_node[node].append((spec.fn_id, blob, spec))
+        native = self.config.native_sched
         for node in node_order:
             now = time.monotonic()
             for _fid, _blob, spec in per_node[node]:
                 node.lease_sent[spec.task_id] = [now, 0]
-            frame = ("node_exec", per_node[node])
+            if native:
+                # Native grant plane: each spec ships as raw pickle bytes
+                # with (tid, fn, lease_seq, blob, spec, attempt, name)
+                # sideband — the agent's C++ core ingests, dedups, queues
+                # and dispatches them without a Python unpickle. cpp-
+                # language leases keep the object form (their queue and
+                # protobuf dispatch stay Python-side).
+                raw_entries, obj_triples = [], []
+                for fid, blob, spec in per_node[node]:
+                    if getattr(spec, "language", None) == "cpp":
+                        obj_triples.append((fid, blob, spec))
+                    else:
+                        raw_entries.append(
+                            (spec.task_id, fid, spec.lease_seq, blob,
+                             encode_payload(spec),
+                             task_events.attempt_of(spec), spec.name))
+                frames = []
+                if raw_entries:
+                    frames.append(("node_exec_raw", raw_entries))
+                if obj_triples:
+                    frames.append(("node_exec", obj_triples))
+            else:
+                frames = [("node_exec", per_node[node])]
             if chaos.site("head.lease_grant.lose"):
                 continue  # injected grant loss: the lease watchdog in
                 # _health_loop re-drives it against an idle agent
+            sent_ok = True
+            for frame in frames[:-1]:
+                if self._buffered_send(node.conn, frame):
+                    continue
+                try:
+                    node.conn.send(frame)
+                except OSError:
+                    sent_ok = False
+                    break
+            if not sent_ok:
+                continue
+            frame = frames[-1]
             # On the listener thread, ride the drain-pass out-batch: a
             # synchronous sendall here would stall the whole control
             # plane whenever one agent's socket back-pressures (with N
@@ -4716,6 +4757,39 @@ class Runtime:
                     if node is not None:
                         node.idle.append(w)
             return spec
+
+    def _on_node_done_raw(self, conn: "NodeConn", whex: str, raws: list):
+        """Unpack raw worker done frames into node_done entries. Each raw
+        item is one COMPLETE outer frame (header + payload + oob buffers)
+        exactly as the worker sent it — the C++ agent loop only sniffed
+        the task ids, so the single unpickle happens here, where the
+        payloads are consumed anyway. Parsed in place (no FrameBuffer
+        bytearray round trip: one header unpack + one loads per frame)."""
+        import pickle as _pickle
+        import struct as _struct
+        entries = []
+        for raw in raws:
+            (n,) = _struct.unpack_from("<Q", raw, 0)
+            (nbufs,) = _struct.unpack_from("<I", raw, 8)
+            off = 12 + 8 * nbufs
+            blens = _struct.unpack_from(f"<{nbufs}Q", raw, 12) if nbufs \
+                else ()
+            payload = memoryview(raw)[off:off + n]
+            bufs = []
+            boff = off + n
+            for bl in blens:
+                bufs.append(memoryview(raw)[boff:boff + bl])
+                boff += bl
+            m = _pickle.loads(payload, buffers=bufs)
+            if m[0] == "done":
+                entries.append((m[1], m[3],
+                                m[4] if len(m) > 4 else None, whex))
+            elif m[0] == "done_batch":
+                for e in m[1]:
+                    entries.append((e[0], e[2],
+                                    e[3] if len(e) > 3 else None, whex))
+        if entries:
+            self._on_node_done(conn, entries)
 
     def _on_node_done(self, conn: "NodeConn", entries: list):
         """Batched completions of node-leased tasks (the raylet-local

@@ -239,6 +239,20 @@ def _encode(msg) -> list:
     return parts
 
 
+def encode_payload(obj) -> bytes:
+    """Pickle one object to a SELF-CONTAINED byte string (out-of-band
+    buffers serialized in-band): the raw-spec payload of the native
+    scheduling plane's node_exec_raw / exec_raw frames, where the spec
+    bytes must survive opaque relays through the C++ ledger."""
+    try:
+        return pickle.dumps(obj, protocol=5)
+    except (TypeError, AttributeError, pickle.PicklingError):
+        import io
+        f = io.BytesIO()
+        _MsgPickler(f, protocol=5).dump(obj)
+        return f.getvalue()
+
+
 def _chaos_trunc_send(sock: socket.socket, blob,
                       lock: threading.Lock | None):
     """transport.send.trunc fired: ship HALF the frame, then tear the

@@ -19,6 +19,7 @@ import concurrent.futures
 import contextlib
 import inspect
 import os
+import pickle
 import socket
 import sys
 import threading
@@ -1895,6 +1896,13 @@ def _worker_main(store_path: str, worker_id: WorkerID, fd: int):
                 # One head-side sendall carrying several dispatch frames
                 # (pipelined same-key tasks); unpack in order.
                 pending[0:0] = msg[1]
+                continue
+            if op == "exec_raw":
+                # Native lease plane (cpp/agent_core.cc dispatch): the
+                # spec rides as raw pickle bytes, decoded HERE — the one
+                # process that executes it. Only dep-free plain tasks
+                # lease, so there is no actor ordering to gate.
+                rt.task_queue.put(pickle.loads(msg[1]))
                 continue
             if op == "exec":
                 spec = msg[1]

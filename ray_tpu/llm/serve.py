@@ -368,7 +368,8 @@ class _LLMServerImpl:
                     # Incremental decode of the full sequence keeps
                     # multi-token merges correct; emit only the unseen
                     # suffix.
-                    text = self.tokenizer.decode(generated)
+                    text = _hold_incomplete_utf8(
+                        self.tokenizer.decode(generated))
                 if stops:
                     cut = min((i for i in (text.find(s) for s in stops
                                            if s) if i >= 0), default=-1)
@@ -402,6 +403,19 @@ class _LLMServerImpl:
 
     def __del__(self):
         self._stop = True
+
+
+def _hold_incomplete_utf8(text: str) -> str:
+    """UTF-8 boundary holdback for streaming text deltas: a multi-byte
+    character whose bytes straddle a token/chunk edge decodes to U+FFFD
+    until its continuation bytes arrive — emitting it would bake the
+    replacement char into the client's stream (the token plane is exact;
+    the text plane wasn't). Hold the trailing replacement run back until
+    the next delta completes it; the FINAL decode (stream end) bypasses
+    this, so genuinely invalid bytes still surface as U+FFFD."""
+    if text.endswith("�"):
+        return text.rstrip("�")
+    return text
 
 
 def _is_overload(e: Exception) -> bool:
@@ -1092,7 +1106,8 @@ class _DisaggServerImpl:
             while not done:
                 try:
                     seen.append(next(it))
-                    text = self.tokenizer.decode(seen)
+                    text = _hold_incomplete_utf8(
+                        self.tokenizer.decode(seen))
                 except StopIteration:
                     done = True
                     text = self.tokenizer.decode(seen)

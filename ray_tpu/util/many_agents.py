@@ -45,7 +45,13 @@ def run_many_agents(n_agents: int = 16, n_tasks: int = 400,
         # jax for several seconds after registration, and on a small box
         # that import CPU would be billed to the measurement.
         time.sleep(min(1.0 + 0.15 * n_agents, 12.0))
-        ray_tpu.get([f.remote(i) for i in range(n_agents)],
+        # Throwaway measurement wave: the FIRST full fan-out after boot
+        # consistently runs several-fold slower than steady state (late
+        # zygote imports + first-touch page faults across ~2N processes
+        # competing for this box's cores); clocking it measured machine
+        # settling, not the scheduler.
+        ray_tpu.get([f.remote(i) for i in range(max(n_agents,
+                                                    n_tasks // 3))],
                     timeout=spawn_timeout)
         t0 = time.perf_counter()
         c0 = time.process_time()

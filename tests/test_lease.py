@@ -117,11 +117,22 @@ def test_load_view_rides_heartbeats_and_reclaim_fires():
         from ray_tpu.core.runtime import get_runtime
         rt = get_runtime()
         node = next(n for n in rt.nodes.values() if n.conn is not None)
+        def others_idle():
+            return sum(len(n.idle) for n in rt.nodes.values()
+                       if n.state == "ALIVE" and n is not node)
+
         deadline = time.monotonic() + 30
-        while time.monotonic() < deadline and not node.load_view:
+        # Wait for the preconditions reclaim gates on (worker pools idle
+        # on BOTH sides), not just the first heartbeat — the first view
+        # can land while workers are still booting (idle 0 everywhere),
+        # and reclaim correctly refuses to fire then.
+        while time.monotonic() < deadline and (
+                not node.load_view or others_idle() <= 0
+                or node.load_view.get("idle", 0) <= 0):
             time.sleep(0.2)
         assert node.load_view.get("v", 0) > 0
         assert "idle" in node.load_view and "backlog" in node.load_view
+        assert others_idle() > 0
         # Reclaim plumbing: a (synthetic) backlog report triggers one
         # lease_reclaim frame toward the agent; the agent answers with a
         # lease_return the head accepts (empty queue -> no returns, and

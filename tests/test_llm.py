@@ -593,6 +593,55 @@ def test_engine_cancel_frees_slot_and_finishes(tiny_params):
     assert eng.kv_stats()["pages_in_use"] == 0
 
 
+def test_stream_utf8_boundary_holdback():
+    """A multi-byte char whose bytes straddle stream chunks must NOT emit
+    replacement chars mid-stream: the incomplete tail is held back until
+    its continuation bytes arrive (ROADMAP leftover — the token plane was
+    exact, the text plane emitted U+FFFD). Driven through the real
+    completions_stream generator with a controlled token feed."""
+    import threading
+    import time as time_mod
+
+    from ray_tpu.llm.serve import _LLMServerImpl
+
+    impl = _LLMServerImpl.__new__(_LLMServerImpl)
+    impl.tokenizer = ByteTokenizer()
+    impl._lock = threading.Lock()
+    impl._token_subs = {}
+    impl._discard = set()
+
+    class _Eng:
+        params = None
+        finished = {}
+
+        def add_request(self, ids, *a, **k):
+            return 1
+
+        def cancel(self, rid):
+            raise AssertionError("clean end must not cancel")
+
+    impl.engine = _Eng()
+    impl._params_for = lambda model: None
+
+    payload = "a😀é!"  # 4-byte and 2-byte chars straddling byte-tokens
+
+    def feed():
+        deadline = time_mod.monotonic() + 10
+        while 1 not in impl._token_subs:
+            if time_mod.monotonic() > deadline:
+                return
+            time_mod.sleep(0.005)
+        q = impl._token_subs[1]
+        for b in payload.encode("utf-8"):
+            q.put(b)
+        q.put(None)
+
+    threading.Thread(target=feed, daemon=True).start()
+    deltas = list(impl.completions_stream("hi", max_tokens=16))
+    assert "".join(deltas) == payload
+    assert all("�" not in d for d in deltas), deltas
+
+
 def test_stream_early_stop_no_leak():
     """A stream cut by a stop sequence cancels the engine request: the
     decode slot frees, no finished record strands on the replica, and

@@ -1,0 +1,273 @@
+"""Native select-round core (cpp/agent_core.cc) — unit + cluster gates.
+
+Unit tier: the pump/ledger/planner driven directly over socketpairs with
+real CPython pickles (the walker's contract is "parse the C pickler's
+output or bail to Python", so every shape here is produced by
+pickle.dumps). Cluster tier: the native plane on the wire end to end,
+behavioral equivalence with `native_sched=off`, and a seeded chaos storm
+through the SAME fault sites as the pure-Python loop (PR 8 schedule
+grammar) with the C++ ledger engaged.
+"""
+
+import pickle
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+pytestmark = []
+
+_HDR = struct.Struct("<Q")
+_NBUF = struct.Struct("<I")
+
+
+def _frame(msg, bufs=()):
+    payload = pickle.dumps(msg, protocol=5)
+    parts = [_HDR.pack(len(payload)), _NBUF.pack(len(bufs))]
+    parts += [struct.pack("<Q", len(b)) for b in bufs]
+    parts.append(payload)
+    parts += list(bufs)
+    return b"".join(parts)
+
+
+@pytest.fixture()
+def core():
+    from ray_tpu._native import agent_core as AC
+    assert AC.available(), f"agent_core build failed: {AC._lib_err!r}"
+    c = AC.AgentCore()
+    yield c
+    c.close()
+
+
+def test_pump_grant_dispatch_done_roundtrip(core):
+    """The whole native hot loop over socketpairs: node_exec_raw ingest
+    (dedup included), planned dispatch with reg_fn-before-exec ordering,
+    and done/done_batch consumption into a node_done_raw batch that
+    preserves the workers' raw frames byte-for-byte."""
+    from ray_tpu._native import agent_core as AC
+    from ray_tpu.core.transport import FrameBuffer
+
+    ha, hb = socket.socketpair()
+    wa, wb = socket.socketpair()
+    core.add_fd(hb.fileno(), AC.HEAD_TAG)
+    wtag = core.alloc_tag()
+    core.add_fd(wb.fileno(), wtag)
+    widx = core.worker_add(wtag, wb.fileno(), b"W" * 8, "aa" * 8)
+
+    fn = b"F" * 16
+    s1, s2, s3 = b"SPEC-ONE", b"SPEC-TWO" * 40, b"SPEC-THREE"
+    entries = [(b"T" * 16, fn, 1, b"BLOB" * 10, s1, 0, "f"),
+               (b"U" * 16, fn, 1, None, s2, 2, "f"),
+               (b"V" * 16, None, 2, None, s3, 0, None)]
+    ha.sendall(_frame(("node_exec_raw", entries)))
+    assert core.poll(2000) == 1
+    core.split()
+    assert core.consume_hot() == 1
+    assert core.backlog() == 3
+    assert not list(core.frames())  # fully consumed natively
+    core.round_end()
+
+    # A re-driven grant (same task, same lease_seq) dedups in C++.
+    ha.sendall(_frame(("node_exec_raw", entries)))
+    core.poll(2000); core.split(); core.consume_hot()
+    assert core.backlog() == 3
+    core.round_end()
+
+    widxs = core.dispatch(2, True)
+    assert widxs == [widx]
+    recs = core.dispatch_records()
+    assert [(r[0], r[2], r[3]) for r in recs] == [
+        (b"T" * 16, 0, "f"), (b"U" * 16, 2, "f")]
+    out = bytes(core.take_outbox(widx))
+    wb.sendall(out)
+    fb = FrameBuffer()
+    fb.feed(wa.recv(1 << 20))
+    msgs = fb.frames()
+    assert msgs[0] == ("reg_fn", fn, b"BLOB" * 10)  # BEFORE its exec
+    assert msgs[1] == ("exec_raw", s1)
+    assert msgs[2] == ("exec_raw", s2)
+    assert (core.worker_load(widx), core.inflight(), core.backlog()) \
+        == (2, 2, 1)
+
+    d1 = _frame(("done", b"T" * 16, None,
+                 [(b"R" * 16, "inline", b"payload", [])],
+                 (1, 0.1, 0.2, 0.3, 0.4)))
+    d2 = _frame(("done_batch",
+                 [(b"U" * 16, None, [(b"S" * 16, "shm", None, None)])]))
+    wa.sendall(d1 + d2)
+    core.poll(2000); core.split()
+    assert core.consume_hot() == 2
+    nd = bytes(core.take_node_done())
+    fb2 = FrameBuffer()
+    fb2.feed(nd)
+    (op, whex, raws), = fb2.frames()
+    assert op == "node_done_raw" and whex == "aa" * 8
+    assert raws == [d1, d2]  # byte-identical raw forwarding
+    assert core.inflight() == 0 and core.worker_load(widx) == 0
+    core.round_end()
+
+    for s in (ha, hb, wa, wb):
+        s.close()
+
+
+def test_unleased_and_buffered_dones_fall_through_to_python(core):
+    """A done whose task id is NOT in the inflight table (head-path actor
+    completion) and a done carrying out-of-band buffers both take the
+    Python path — the native consumer only claims frames it fully owns."""
+    from ray_tpu._native import agent_core as AC
+    wa, wb = socket.socketpair()
+    wtag = core.alloc_tag()
+    core.add_fd(wb.fileno(), wtag)
+    core.worker_add(wtag, wb.fileno(), b"W" * 8, "bb" * 8)
+    wa.sendall(_frame(("done", b"X" * 16, None, [], None)))
+    core.push(b"Y" * 16, None, 1, b"SPEC")
+    core.dispatch(8, False)
+    wa.sendall(_frame(("done", b"Y" * 16, None, [], None),
+                      bufs=(b"oob-bytes",)))
+    core.poll(2000); core.split()
+    assert core.consume_hot() == 0
+    left = list(core.frames())
+    assert len(left) == 2
+    assert pickle.loads(left[0][3])[1] == b"X" * 16
+    msg = pickle.loads(left[1][3], buffers=left[1][4])
+    assert msg[1] == b"Y" * 16
+    core.round_end()
+    wa.close(); wb.close()
+
+
+def test_walker_bails_on_foreign_shapes(core):
+    """Payloads outside the restricted unpickler's contract (dicts, sets,
+    reduce objects) are never consumed natively — they surface to Python
+    intact. A wrong parse would be corruption; a bail is just a slow
+    frame."""
+    from ray_tpu._native import agent_core as AC
+    ha, hb = socket.socketpair()
+    core.add_fd(hb.fileno(), AC.HEAD_TAG)
+    weird = ("node_exec_raw", [{"not": "a tuple"}])
+    ha.sendall(_frame(weird))
+    core.poll(2000); core.split()
+    assert core.consume_hot() == 0
+    (fr,) = list(core.frames())
+    assert pickle.loads(fr[3]) == weird
+    core.round_end()
+    ha.close(); hb.close()
+
+
+def test_worker_death_drains_native_inflight(core):
+    from ray_tpu._native import agent_core as AC
+    wa, wb = socket.socketpair()
+    wtag = core.alloc_tag()
+    core.add_fd(wb.fileno(), wtag)
+    widx = core.worker_add(wtag, wb.fileno(), b"W" * 8, "cc" * 8)
+    spec = pickle.dumps({"marker": 1})
+    core.push(b"Z" * 16, b"F" * 16, 3, spec)
+    core.dispatch(8, False)
+    core.take_outbox(widx)
+    assert core.inflight() == 1
+    failed = core.fail_worker(widx)
+    assert [(t, s, sp) for t, _f, s, sp in failed] == [
+        (b"Z" * 16, 3, spec)]
+    assert core.inflight() == 0
+    # EOF surfaces as a pump event for the death path.
+    wa.close()
+    core.poll(2000); core.split()
+    assert any(f[1] == AC.KIND_EOF and f[0] == wtag
+               for f in core.frames())
+    core.round_end()
+    wb.close()
+
+
+# ---------------- cluster tier ----------------
+
+
+def test_native_plane_on_the_wire_and_correct():
+    """Default config (native_sched on): the head grants via
+    node_exec_raw, agents complete via node_done_raw, and a fan-out of
+    tasks over 2 agents returns correct results."""
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    c.add_node(num_cpus=1)
+    c.add_node(num_cpus=1)
+    c.wait_for_nodes(3)
+    try:
+        from ray_tpu.core.runtime import get_runtime
+        rt = get_runtime()
+        assert rt.config.native_sched
+        sent_ops = []
+        for node in rt.nodes.values():
+            if node.conn is None:
+                continue
+            real = node.conn.send
+            node.conn.send = (lambda m, _r=real: (sent_ops.append(m[0]),
+                                                  _r(m))[1])
+
+        @ray_tpu.remote(num_cpus=1)
+        def f(x):
+            return x * 3
+
+        out = ray_tpu.get([f.remote(i) for i in range(60)], timeout=120)
+        assert out == [i * 3 for i in range(60)]
+        flat = set(sent_ops)
+        for node in rt.nodes.values():
+            if node.conn is not None:
+                del node.conn.send  # restore the class method
+        assert "node_exec_raw" in flat, flat  # the native grant plane ran
+    finally:
+        c.shutdown()
+
+
+def test_native_off_equivalence():
+    """`native_sched=off` (the pure-Python fallback) computes the same
+    results over the same cluster shape."""
+    c = Cluster(initialize_head=True,
+                head_node_args={"num_cpus": 1,
+                                "_system_config": {"native_sched": False}})
+    c.add_node(num_cpus=1)
+    c.wait_for_nodes(2)
+    try:
+        from ray_tpu.core.runtime import get_runtime
+        assert not get_runtime().config.native_sched
+
+        @ray_tpu.remote(num_cpus=1)
+        def f(x):
+            return x * 3
+
+        out = ray_tpu.get([f.remote(i) for i in range(40)], timeout=120)
+        assert out == [i * 3 for i in range(40)]
+    finally:
+        c.shutdown()
+
+
+def test_native_chaos_storm_same_seeded_sites():
+    """The PR 8 chaos schedule drives the native loop through the same
+    seeded fault sites: a lost lease grant (head.lease_grant.lose → the
+    lease watchdog re-drives it and the C++ dedup table absorbs the
+    duplicate) and a mid-storm worker SIGKILL (worker.exec.kill → the
+    native inflight table drains into lease_fail replay — the
+    dispatch-vs-worker-death race). Every task resolves exactly once.
+    Chaos-armed rounds route sends through send_msg, so the sites fire
+    per frame while the C++ ledger keeps the bookkeeping."""
+    c = Cluster(initialize_head=True, head_node_args={
+        "num_cpus": 1,
+        "_system_config": {
+            "chaos_schedule": ("head.lease_grant.lose:3,"
+                               "worker.exec.kill:30"),
+            "chaos_seed": 1234,
+            "lease_redrive_timeout_s": 1.0,
+        }})
+    c.add_node(num_cpus=2)
+    c.wait_for_nodes(2)
+    try:
+        @ray_tpu.remote(num_cpus=1, max_retries=4)
+        def f(x):
+            return x + 1000
+
+        refs = [f.remote(i) for i in range(80)]
+        out = ray_tpu.get(refs, timeout=150)
+        assert out == [i + 1000 for i in range(80)]
+    finally:
+        c.shutdown()

@@ -62,6 +62,40 @@ def test_tsan_object_store_stress_runs_clean():
 
 
 @pytest.mark.heavy
+def test_tsan_agent_core_stress_runs_clean():
+    """The native select-round core's lease ledger + dispatch tables
+    under threads (cpp/agent_core_stress.cc): producers pushing grants,
+    a dispatcher planning/draining outboxes, a completer racing
+    inflight_pop against it, a stealer running the spill/reclaim pops,
+    and worker add/remove/eligibility churn — every call is legal
+    concurrent API use, so any TSan report is an agent_core bug."""
+    from ray_tpu._native.build import build_binary
+    binary = build_binary(
+        "agent_core_stress",
+        sources=(os.path.join(_CPP, "agent_core_stress.cc"),
+                 os.path.join(_CPP, "agent_core.cc")),
+        sanitizer="thread")
+    assert "-tsan" in binary
+    r = subprocess.run(
+        [binary], capture_output=True, text=True, timeout=300,
+        env={**os.environ,
+             "TSAN_OPTIONS": "halt_on_error=1 exitcode=66"})
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, out[-4000:]
+    assert "ThreadSanitizer" not in out, out[-4000:]
+    assert "AGENT_CORE_STRESS_OK" in r.stdout
+    stats = dict(kv.split("=") for kv in r.stdout.split()
+                 if "=" in kv)
+    # The storm actually contended: grants queued, the planner dispatched
+    # against racing completions, and the cold paths (steal, worker
+    # death) both fired.
+    assert int(stats["pushed"]) > 0, stats
+    assert int(stats["planner_dispatched"]) > 0, stats
+    assert int(stats["completed"]) > 0, stats
+    assert int(stats["stolen"]) > 0, stats
+
+
+@pytest.mark.heavy
 def test_asan_worker_smoke_runs_clean(tmp_path):
     from ray_tpu._native.build import build_binary
     from ray_tpu.core import worker_wire

@@ -198,6 +198,25 @@ def test_wire_drift_catches_wire_type_change(tmp_path):
                for f in fs), [f.render() for f in fs]
 
 
+def test_wire_drift_catches_agent_core_sniffer_renumber(tmp_path):
+    """The native select-round core's AgentFrame sniffer table
+    (cpp/agent_core.cc kAgentFrameTags) is pinned both ways: a seeded
+    renumber in the C++ table flags (bad tag AND the orphaned proto
+    field), and dropping an entry flags the blind spot."""
+    src = open(os.path.join(REPO, wire_drift.AGENT_CORE_REL)).read()
+    assert '{2, "heartbeat"}' in src
+    p = tmp_path / "agent_core.cc"
+    p.write_text(src.replace('{2, "heartbeat"}', '{19, "heartbeat"}'))
+    fs = wire_drift.run(REPO, agent_core_path=str(p))
+    assert any("tag 19" in f.detail for f in fs), [f.render() for f in fs]
+    assert any("AgentFrame.heartbeat" in f.detail and "missing" in f.detail
+               for f in fs), [f.render() for f in fs]
+    # rename-only drift: number right, name wrong
+    p.write_text(src.replace('{2, "heartbeat"}', '{2, "heartbeet"}'))
+    fs = wire_drift.run(REPO, agent_core_path=str(p))
+    assert any("heartbeet" in f.detail for f in fs), [f.render() for f in fs]
+
+
 def test_wire_drift_catches_pickle_framed_pin_drift(tmp_path):
     """Renumbering a message that has NO bindings (rides pickle framing)
     is exactly the drift runtime can never catch — the pin must."""

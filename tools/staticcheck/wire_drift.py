@@ -89,15 +89,20 @@ CPP_REQUIRED = (
 
 RULE = "wire-drift"
 
+# The native select-round core's AgentFrame oneof sniffer table
+# (cpp/agent_core.cc kAgentFrameTags): cross-checked BOTH WAYS below.
+AGENT_CORE_REL = "cpp/agent_core.cc"
+
 
 def run(root: str, proto_path: str | None = None,
         ww_path: str | None = None, cpp_path: str | None = None,
-        use_pool: bool = True) -> list:
-    """All three cross-checks. Path overrides exist for the mutation
+        agent_core_path: str | None = None, use_pool: bool = True) -> list:
+    """All four cross-checks. Path overrides exist for the mutation
     tests (run the real implementations against a doctored schema)."""
     proto_path = proto_path or os.path.join(root, PROTO_REL)
     ww_path = ww_path or os.path.join(root, WW_REL)
     cpp_path = cpp_path or os.path.join(root, CPP_REL)
+    agent_core_path = agent_core_path or os.path.join(root, AGENT_CORE_REL)
     findings: list[Finding] = []
     try:
         schema = protoparse.parse(proto_path)
@@ -107,6 +112,7 @@ def run(root: str, proto_path: str | None = None,
         findings += check_pool(schema)
     findings += check_worker_wire(schema, ww_path)
     findings += check_cpp_header(schema, cpp_path)
+    findings += check_agent_core(schema, agent_core_path)
     return findings
 
 
@@ -370,6 +376,67 @@ def _class_evidence(body: str, base_line: int) -> list:
         if int(m.group(1)) > 0:
             ev.append((int(m.group(1)), 2, line_of(m.start())))
     return ev
+
+
+# ------------- (d) cpp/agent_core.cc AgentFrame sniffer tags -------------
+#
+# The native frame pump labels proto-framed control messages by their
+# outermost AgentFrame oneof tag (kAgentFrameTags). Drift directions:
+# a renumber/rename in EITHER place desynchronizes the label from the
+# message, and an AgentFrame field the table does not carry leaves the
+# native pump blind to a control message (it would surface unlabeled and
+# cost Python a trial decode — or worse, be labeled wrong after a
+# renumber). Both directions are findings.
+
+_AGC_TABLE_RE = re.compile(
+    r"kAgentFrameTags\[\]\s*=\s*\{(.*?)\};", re.S)
+_AGC_ENTRY_RE = re.compile(r'\{\s*(\d+)\s*,\s*"(\w+)"\s*\}')
+
+
+def check_agent_core(schema: dict, path: str) -> list:
+    rel = AGENT_CORE_REL
+    if not os.path.exists(path):
+        return [Finding(RULE, rel, 0,
+                        "native select-round core source missing (the "
+                        "sniffer tag table is pinned here)")]
+    with open(path) as f:
+        text = f.read()
+    m = _AGC_TABLE_RE.search(text)
+    if m is None:
+        return [Finding(RULE, rel, 0,
+                        "kAgentFrameTags table not found (the native "
+                        "proto sniffer lost its pin)")]
+    base_line = text[:m.start()].count("\n") + 1
+    table: dict[int, tuple[str, int]] = {}
+    for em in _AGC_ENTRY_RE.finditer(m.group(1)):
+        line = base_line + m.group(1)[:em.start()].count("\n")
+        table[int(em.group(1))] = (em.group(2), line)
+    af = schema.get("AgentFrame")
+    if af is None:
+        return [Finding(RULE, PROTO_REL, 0,
+                        "AgentFrame missing from raytpu.proto but pinned "
+                        "by the native sniffer")]
+    out: list[Finding] = []
+    by_num = af.by_number()
+    for num, (name, line) in table.items():
+        pf = by_num.get(num)
+        if pf is None:
+            out.append(Finding(
+                RULE, rel, line,
+                f"kAgentFrameTags: tag {num} ({name!r}) but AgentFrame "
+                f"has no field {num} in raytpu.proto"))
+        elif pf.name != name:
+            out.append(Finding(
+                RULE, rel, line,
+                f"kAgentFrameTags: tag {num} named {name!r} but "
+                f"raytpu.proto calls AgentFrame.{num} {pf.name!r}"))
+    for num, pf in by_num.items():
+        if num not in table:
+            out.append(Finding(
+                RULE, rel, base_line,
+                f"AgentFrame.{pf.name} (field {num}) missing from "
+                "kAgentFrameTags — the native pump cannot label it"))
+    return out
 
 
 def check_cpp_header(schema: dict, path: str) -> list:
