@@ -112,6 +112,20 @@ _fire_log: list = []
 _FIRE_LOG_CAP = 8192
 _lock = threading.Lock()
 
+# racecheck seam: the interleaving explorer (tools/racecheck) registers a
+# schedule hook so every chaos site doubles as a yield point — the same
+# zero-overhead contract as a disarmed schedule (one global read).
+_sched_hook = None
+
+
+def set_schedule_hook(hook):
+    """Install (or clear, with None) the explorer's schedule hook;
+    returns the previous hook so nested explorers can restore it."""
+    global _sched_hook
+    old = _sched_hook
+    _sched_hook = hook
+    return old
+
 
 def _site_rng(name: str, seed: int):
     import random
@@ -183,6 +197,9 @@ def site(name: str) -> bool:
     """One hit of the named seam; returns True when the fault should
     fire. The caller implements the fault — the site's semantics live at
     the seam, the schedule only picks the hits."""
+    h = _sched_hook
+    if h is not None:
+        h(name)
     a = _armed
     if a is None:
         return False
@@ -210,6 +227,8 @@ def delay(name: str, max_s: float = 0.05) -> None:
     duration draw rides the same per-site RNG, so it replays too)."""
     a = _armed
     if a is None:
+        if _sched_hook is not None:
+            site(name)  # schedule point only: disarmed sites never fire
         return
     if site(name):
         st = a[name]
@@ -221,7 +240,9 @@ def delay(name: str, max_s: float = 0.05) -> None:
 def kill(name: str) -> None:
     """SIGKILL this process when the site fires — the crash-consistency
     probe: no atexit, no flush, no release runs."""
-    if _armed is not None and site(name):
+    if _armed is None and _sched_hook is None:
+        return
+    if site(name):
         os.kill(os.getpid(), signal.SIGKILL)
 
 

@@ -505,7 +505,12 @@ class NodeAgent:
                         break
                     continue
                 enable_nodelay(sock)
+                # racecheck: ok thread-escape single-reconnector by the
+                # _reconnecting latch; concurrent senders reading the
+                # stale binding get OSError and re-enter this path, the
+                # select loop re-registers on the next round
                 self.head_sock = sock
+                # racecheck: ok thread-escape same latch as head_sock
                 self.head_buffer = FrameBuffer()
                 try:
                     self._register()
@@ -567,18 +572,23 @@ class NodeAgent:
     def _load_view(self) -> dict:
         """Versioned local-load delta riding heartbeats (the
         ray_syncer.h:20 resource-view role): the head reads idle/backlog
-        without ever locking this node's dispatch state."""
-        self._hb_version += 1
+        without ever locking this node's dispatch state. The version
+        bump rides _lease_lock WITH the snapshot it stamps: the
+        heartbeat loop and the select round's push-delta both call here,
+        and an unlocked `+= 1` could mint duplicate versions — the
+        head's cursor logic would then discard the NEWER view as stale."""
         nat = self._nat
         if nat is not None:
             # The ledger is native: idle/backlog/inflight read straight
             # from the C++ tables (cpp leases stay on the Python dicts).
             with self._lease_lock:
+                self._hb_version += 1
                 return {"v": self._hb_version, "idle": nat.idle(),
                         "backlog": int(nat.backlog()),
                         "inflight": (int(nat.inflight())
                                      + len(self._lease_inflight))}
         with self._lease_lock:
+            self._hb_version += 1
             idle = sum(1 for wid, w in list(self.workers.items())
                        if w.language == "python"
                        and not self._worker_load.get(wid)
@@ -2081,6 +2091,9 @@ class NodeAgent:
         if (not force and (now - self._tev_last_flush) * 1000.0
                 < self.config.task_events_flush_ms):
             return None
+        # racecheck: ok thread-escape pacing heuristic only: select round
+        # and heartbeat both stamp it; a torn check costs one extra flush
+        # of an already-thread-safe ring, never a lost event
         self._tev_last_flush = now
         batch, dropped = tev.drain()
         if not batch and not dropped:

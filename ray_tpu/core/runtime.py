@@ -873,6 +873,12 @@ class Runtime:
         # parked batch, counted as source drops, never blocks.
         self._tev_pending: collections.deque = collections.deque()
         self._tev_overflow = 0
+        # Guards _tev_overflow's read-modify-writes: the listener's
+        # overflow bump races the ingest thread's swap-and-reset, and an
+        # unguarded swap LOSES drop counts — the one thing drop
+        # accounting must never do. Both touches are cold (overflow only
+        # fires with 512 batches parked; the drain ticks at 4 Hz).
+        self._tev_overflow_lock = threading.Lock()
         # Worker-process metric registries, merged at scrape time tagged
         # WorkerId (parity: the per-node metrics agent aggregating worker
         # metrics, _private/metrics_agent.py:492). wid -> {name: snapshot}.
@@ -2053,12 +2059,17 @@ class Runtime:
             srv.listen(128)
             srv.setblocking(False)
             self._cluster_srv = srv
+            # racecheck: ok thread-escape written exactly once while
+            # cluster mode boots — no agent exists to race the readers
+            # until enable_cluster returns the address they dial
             self.cluster_addr = f"{host}:{srv.getsockname()[1]}"
             # The head serves its own objects to nodes over a dedicated
             # peer port (native C++ server; big blobs must never ride the
             # control link).
             from ray_tpu.core import objxfer
             self._peer_server = objxfer.start_peer_server(self.store, host)
+            # racecheck: ok thread-escape same boot-once publication as
+            # cluster_addr above
             self.head_peer_addr = (host, self._peer_server.port)
             # Visible through the node table too (p2p collective ranks on
             # the head resolve their endpoint the same way workers do).
@@ -3999,6 +4010,10 @@ class Runtime:
         if len(self.nodes) <= 1:
             now = time.monotonic()
             burst = now - self._last_sched_req < 150e-6
+            # racecheck: ok thread-escape burst-coalescing heuristic: a
+            # torn stamp misclassifies one request, whose worst case is
+            # one extra (idempotent) inline pass or one deferred hop to
+            # the scheduler thread it was built to take anyway
             self._last_sched_req = now
             if not burst:
                 self._schedule_now()
@@ -5209,11 +5224,14 @@ class Runtime:
         self._send_actor_task(st, spec)
 
     def _send_actor_task(self, st: ActorState, spec: TaskSpec):
-        # Diagnostic: every actor exec the HEAD relays (the direct worker
-        # peer plane never passes through here — tests assert this stays
-        # flat during a direct-call storm).
-        self.actor_head_dispatches += 1
         with self.lock:
+            # Diagnostic: every actor exec the HEAD relays (the direct
+            # worker peer plane never passes through here — tests assert
+            # this stays flat during a direct-call storm). Counted under
+            # the lock: listener + submitter threads both land here, and
+            # an unlocked += loses increments exactly when the count is
+            # being compared against a storm's dispatch total.
+            self.actor_head_dispatches += 1
             w = st.worker
             if st.state == A_DEAD:
                 dead_cause = st.death_cause
@@ -5519,7 +5537,8 @@ class Runtime:
         if len(q) >= 512:  # bounded: count the evicted batch as drops
             try:
                 old = q.popleft()
-                self._tev_overflow += len(old[0]) + old[3]
+                with self._tev_overflow_lock:
+                    self._tev_overflow += len(old[0]) + old[3]
             except IndexError:
                 pass
         q.append((events, node, worker, dropped))
@@ -5541,8 +5560,9 @@ class Runtime:
                 break
             self.task_store.ingest(events, node=node, worker=worker,
                                    dropped=dropped)
-        if self._tev_overflow:
+        with self._tev_overflow_lock:
             n, self._tev_overflow = self._tev_overflow, 0
+        if n:
             self.task_store.ingest([], dropped=n)
 
     def sync_task_store(self):

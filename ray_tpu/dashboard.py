@@ -29,12 +29,17 @@ _HISTORY: collections.deque = collections.deque(maxlen=900)  # ~45 min @ 3s
 _history_thread = None
 
 
+# Sampler cadence; module-level so tests (and fast local dashboards) can
+# tighten it instead of waiting out multiples of the production 3s tick.
+_SAMPLE_INTERVAL_S = 3.0
+
+
 def _sample_loop(server):
-    """Background sampler: one compact utilization point every 3s
-    (the role of the reference's Prometheus + Grafana panels for the
-    frontend's charts, without requiring either to be deployed). Gated on
-    `server` staying current — a stop/start cycle must not leave two
-    samplers running."""
+    """Background sampler: one compact utilization point every
+    `_SAMPLE_INTERVAL_S` (the role of the reference's Prometheus +
+    Grafana panels for the frontend's charts, without requiring either
+    to be deployed). Gated on `server` staying current — a stop/start
+    cycle must not leave two samplers running."""
     from ray_tpu.util import state
     last_finished, last_ts = None, None
     while _server is server:
@@ -73,7 +78,7 @@ def _sample_loop(server):
             })
         except Exception:  # noqa: BLE001 — sampler must outlive glitches
             pass
-        time.sleep(3.0)
+        time.sleep(_SAMPLE_INTERVAL_S)
 
 
 class _Handler(BaseHTTPRequestHandler):

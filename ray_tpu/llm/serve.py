@@ -556,6 +556,26 @@ def build_openai_app(llm_config: LLMConfig):
 # ================= disaggregated prefill/decode plane =================
 
 
+_shed_metric = None
+
+
+def _record_shed(pool: str) -> None:
+    """Bump `ray_tpu_serve_shed_total{pool=...}` — rendered at /metrics
+    by the local registry (driver-side serving) or shipped to the head
+    on the worker metric-delta frames (replica processes). Lazy: the
+    metric registers on the first shed so importing this module never
+    touches the registry."""
+    global _shed_metric
+    if _shed_metric is None:
+        from ray_tpu.util.metrics import Counter as _MetricCounter
+        _shed_metric = _MetricCounter(
+            "ray_tpu_serve_shed_total",
+            "requests shed by serving-plane admission control, by the "
+            "pool whose budget tripped (requests|prefill|decode|slo)",
+            tag_keys=("pool",))
+    _shed_metric.inc(tags={"pool": pool})
+
+
 @dataclasses.dataclass
 class DisaggConfig:
     """Knobs for the disaggregated serving plane (module docstring).
@@ -766,7 +786,10 @@ class _DisaggServerImpl:
     def _admit(self, n_prompt: int, max_new: int) -> int:
         """Admit or shed, synchronously and fast (called on the request
         path BEFORE any pool work is scheduled). Returns the decode-pool
-        token cost the caller must release."""
+        token cost the caller must release. Every shed is attributed to
+        the POOL whose budget tripped and exported as
+        `ray_tpu_serve_shed_total{pool=...}` — the per-pool signal the
+        serve autoscaler scales decode replicas on."""
         d = self.d
         cost = n_prompt + max_new
         with self._lock:
@@ -774,17 +797,24 @@ class _DisaggServerImpl:
             if d.admission_slo_ms is not None and self._tok_rate_ema > 1.0:
                 est_ms = 1e3 * (self._decode_inflight_tokens
                                 / self._tok_rate_ema)
-            if (self._ongoing >= d.max_ongoing_requests
-                    or (self._prefill_queue_tokens + n_prompt
-                        > d.max_prefill_queue_tokens)
-                    or (self._decode_inflight_tokens + cost
-                        > d.max_decode_inflight_tokens)
-                    or (est_ms is not None
-                        and est_ms > d.admission_slo_ms)):
+            shed_pool = None
+            if self._ongoing >= d.max_ongoing_requests:
+                shed_pool = "requests"
+            elif (self._prefill_queue_tokens + n_prompt
+                    > d.max_prefill_queue_tokens):
+                shed_pool = "prefill"
+            elif (self._decode_inflight_tokens + cost
+                    > d.max_decode_inflight_tokens):
+                shed_pool = "decode"
+            elif est_ms is not None and est_ms > d.admission_slo_ms:
+                shed_pool = "slo"
+            if shed_pool is not None:
                 self.counters["shed"] += 1
+                self.counters[f"shed_{shed_pool}"] += 1
+                _record_shed(shed_pool)
                 raise OverloadedError(
                     "serving plane overloaded: request shed "
-                    f"(ongoing={self._ongoing}, "
+                    f"(pool={shed_pool}, ongoing={self._ongoing}, "
                     f"prefill_q={self._prefill_queue_tokens}tok, "
                     f"decode_inflight={self._decode_inflight_tokens}tok"
                     + (f", est_wait={est_ms:.0f}ms" if est_ms is not None

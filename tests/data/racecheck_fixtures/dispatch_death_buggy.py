@@ -1,0 +1,46 @@
+"""Seeded historical race #2 (PR 8): dispatch-vs-worker-death listener
+kill. The pre-fix `_dispatch_many` shape: the scheduling thread's send to
+a local worker is UNGUARDED — a worker SIGKILLed between assignment and
+send raises BrokenPipeError out of the LISTENER, which dies, and nothing
+ever re-drives the inflight ledger (the 180s wedge the first chaos storm
+caught). The dying control thread IS the violation."""
+
+
+class _Worker:
+    def __init__(self):
+        self.alive = True
+        self.assigned = []   # tasks booked on this worker
+        self.inbox = []      # tasks the worker actually received
+
+
+def build(api):
+    w = _Worker()
+    lock = api.lock(name="sched_lock")
+    executed = []
+
+    def listener():
+        # dispatch: book the task, then send it to the worker
+        with lock:
+            w.assigned.append("T1")
+        api.point("dispatch.send")
+        if not w.alive:
+            # seeded bug: unguarded send — BrokenPipe kills the listener
+            raise BrokenPipeError("send to dead worker")
+        w.inbox.append("T1")
+        executed.append("T1")
+
+    def death():
+        api.point("death.detect")
+        with lock:
+            w.alive = False
+            # the death path replays everything booked but undelivered
+            replay = [t for t in w.assigned if t not in w.inbox]
+        for t in replay:
+            executed.append(t)
+
+    def check():
+        assert executed.count("T1") == 1, (
+            f"T1 executed {executed.count('T1')}x (want exactly once)")
+
+    return {"threads": [("listener", listener), ("death", death)],
+            "check": check}

@@ -1,0 +1,57 @@
+"""Fixed twin of dispatch_death_buggy: the shipped shape — the dispatch
+send is guarded; a send racing the worker's death hands recovery to the
+death path (which replays everything booked-but-undelivered), the
+listener survives, and the task still executes exactly once."""
+
+
+class _Worker:
+    def __init__(self):
+        self.alive = True
+        self.assigned = []
+        self.inbox = []
+
+
+def build(api):
+    w = _Worker()
+    lock = api.lock(name="sched_lock")
+    executed = []
+    replayed = set()
+
+    def recover_locked():
+        """The death handler's replay of booked-but-undelivered tasks,
+        deduped — it runs from the death DETECTION and again from any
+        dispatcher's forced EOF, and must hand out each task once."""
+        replay = [t for t in w.assigned
+                  if t not in w.inbox and t not in replayed]
+        replayed.update(replay)
+        return replay
+
+    def listener():
+        with lock:
+            w.assigned.append("T1")
+        api.point("dispatch.send")
+        # The fix: the send is guarded; a dead worker forces EOF and
+        # hands recovery to the (idempotent) death replay instead of
+        # killing the listener.
+        with lock:
+            if w.alive:
+                w.inbox.append("T1")
+                executed.append("T1")
+            else:
+                for t in recover_locked():
+                    executed.append(t)
+
+    def death():
+        api.point("death.detect")
+        with lock:
+            w.alive = False
+            replay = recover_locked()
+        for t in replay:
+            executed.append(t)
+
+    def check():
+        assert executed.count("T1") == 1, (
+            f"T1 executed {executed.count('T1')}x (want exactly once)")
+
+    return {"threads": [("listener", listener), ("death", death)],
+            "check": check}

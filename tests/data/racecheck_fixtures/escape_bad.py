@@ -1,0 +1,44 @@
+"""Seeded thread-escape violations: every rule shape the escape pass
+must catch on this file when targeted directly (--files mode)."""
+
+import threading
+
+
+class LeakyLoop:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.counter = 0          # RMW'd by the loop, read by the api
+        self.latest = None        # rebound by the loop with no lock
+        self.mode = "a"           # written under DIFFERENT locks
+        self.other_lock = threading.Lock()
+        self._shutdown = False    # monotonic latch: must NOT fire
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while not self._shutdown:
+            self.counter += 1           # unlocked RMW in the loop role
+            self.latest = object()      # unlocked rebinding
+            with self.other_lock:
+                # wrong lock vs the reader's (and not a latch: the
+                # written value varies)
+                self.mode = "b" if self.counter % 2 else "c"
+
+    def snapshot(self):
+        with self.lock:
+            return (self.counter, self.latest, self.mode)
+
+    def stop(self):
+        self._shutdown = True  # single-constant publication: excluded
+
+
+class SuppressedLoop:
+    def __init__(self):
+        self.stat = 0
+        threading.Thread(target=self._tick, daemon=True).start()
+
+    def _tick(self):
+        # racecheck: ok thread-escape stats-only counter, torn reads fine
+        self.stat = self.stat + 1
+
+    def read(self):
+        return self.stat

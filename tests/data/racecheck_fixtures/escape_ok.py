@@ -1,0 +1,28 @@
+"""Clean twin of escape_bad: the same shapes, correctly locked (or
+ordered by the fork happens-before edge) — the escape pass must report
+nothing here."""
+
+import threading
+
+
+class TidyLoop:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.counter = 0
+        self.latest = None
+        self.mode = "a"           # configured BEFORE the spawn: ordered
+        self._shutdown = False
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while not self._shutdown:
+            with self.lock:
+                self.counter += 1
+                self.latest = object()
+
+    def snapshot(self):
+        with self.lock:
+            return (self.counter, self.latest, self.mode)
+
+    def stop(self):
+        self._shutdown = True

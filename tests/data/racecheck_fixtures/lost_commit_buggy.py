@@ -1,0 +1,65 @@
+"""Seeded historical race #3 (PR 9): lost-commit-on-raise. The pre-fix
+controller kept the committed-checkpoint advance in a LOCAL of the poll
+loop; a worker death raising out of the loop lost every commit of that
+attempt, and the restart silently re-ran from scratch. Real checkpoint
+machinery (write_shard / commit_manifest / latest_committed) on tmpfs;
+the seeded bug is only WHERE the advance lands."""
+
+import os
+import tempfile
+
+
+def build(api):
+    from ray_tpu.train import checkpoint as ckpt_mod
+
+    root = tempfile.mkdtemp(
+        prefix="racecheck_fix_",
+        dir="/dev/shm" if os.path.isdir("/dev/shm") else None)
+    step, world = 3, 2
+    ckpt_dir = ckpt_mod.step_dir(root, step)
+    lock = api.lock(name="acks_lock")
+    acks = {}
+    ctl = {"latest_committed": None, "raised": False}
+
+    def rank(r):
+        def fn():
+            api.point(f"rank{r}.step")
+            name = ckpt_mod.write_shard({"rank": r}, ckpt_dir, r, world)
+            api.point(f"rank{r}.durable")
+            with lock:
+                acks[r] = name
+        return fn
+
+    def controller():
+        committed_local = None  # the seeded bug: a LOCAL, not ctl state
+        for _ in range(10):
+            api.point("ctl.poll")
+            with lock:
+                ready = dict(acks)
+            if committed_local is None and len(ready) == world:
+                ckpt_mod.commit_manifest(
+                    ckpt_dir, step=step, world_size=world,
+                    shards=[ready[r] for r in range(world)])
+            # keep polling for 'finished' ranks; a worker death raises
+            # out of the loop HERE — after a possible commit
+            if api.fired("ctl.worker_death_raises"):
+                ctl["raised"] = True
+                return  # advance lost: never copied to ctl state
+            if committed_local is None and len(ready) == world:
+                committed_local = ckpt_dir
+        ctl["latest_committed"] = committed_local
+
+    def check():
+        disk = ckpt_mod.latest_committed(root)
+        if disk is not None:
+            assert ctl["latest_committed"] == disk, (
+                "lost commit: disk has a committed manifest but the "
+                "controller forgot it — the restart re-runs from scratch")
+
+    def cleanup():
+        import shutil
+        shutil.rmtree(root, ignore_errors=True)
+
+    return {"threads": [("rank0", rank(0)), ("rank1", rank(1)),
+                        ("controller", controller)],
+            "check": check, "cleanup": cleanup}

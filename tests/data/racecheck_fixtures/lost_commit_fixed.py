@@ -1,0 +1,60 @@
+"""Fixed twin of lost_commit_buggy: the shipped shape — the committed
+advance lands on the controller's durable state THE MOMENT the manifest
+renames in (`self._latest_committed = ckpt_dir` inside the poll loop),
+so a worker death raising afterwards cannot lose it."""
+
+import os
+import tempfile
+
+
+def build(api):
+    from ray_tpu.train import checkpoint as ckpt_mod
+
+    root = tempfile.mkdtemp(
+        prefix="racecheck_fix_",
+        dir="/dev/shm" if os.path.isdir("/dev/shm") else None)
+    step, world = 3, 2
+    ckpt_dir = ckpt_mod.step_dir(root, step)
+    lock = api.lock(name="acks_lock")
+    acks = {}
+    ctl = {"latest_committed": None, "raised": False}
+
+    def rank(r):
+        def fn():
+            api.point(f"rank{r}.step")
+            name = ckpt_mod.write_shard({"rank": r}, ckpt_dir, r, world)
+            api.point(f"rank{r}.durable")
+            with lock:
+                acks[r] = name
+        return fn
+
+    def controller():
+        committed = False
+        for _ in range(10):
+            api.point("ctl.poll")
+            with lock:
+                ready = dict(acks)
+            if not committed and len(ready) == world:
+                ckpt_mod.commit_manifest(
+                    ckpt_dir, step=step, world_size=world,
+                    shards=[ready[r] for r in range(world)])
+                # the fix: record the advance IMMEDIATELY
+                ctl["latest_committed"] = ckpt_dir
+                committed = True
+            if api.fired("ctl.worker_death_raises"):
+                ctl["raised"] = True
+                return  # the advance already landed
+
+    def check():
+        disk = ckpt_mod.latest_committed(root)
+        if disk is not None:
+            assert ctl["latest_committed"] == disk, (
+                "lost commit: disk committed but controller forgot")
+
+    def cleanup():
+        import shutil
+        shutil.rmtree(root, ignore_errors=True)
+
+    return {"threads": [("rank0", rank(0)), ("rank1", rank(1)),
+                        ("controller", controller)],
+            "check": check, "cleanup": cleanup}

@@ -1,0 +1,47 @@
+"""Seeded historical race #1 (PR 2): spill-to-dead-peer duplicate
+execution. The PRE-FIX `_on_lease_return` shape: re-enqueue whatever the
+return frame names, with NO current-booking / lease_seq guard — so the
+head's own dead-dest requeue and the origin agent's dial-failure
+fallback can both enqueue the same task and double-release its
+reservation token. The explorer must find an interleaving where the
+spilled-notice path wins the race and the stale return still requeues.
+"""
+
+
+def build(api):
+    from tools.racecheck.protocols import _mk_head, _mk_spec
+
+    head = _mk_head(api)
+    node_a = head.add_node(b"A")
+    tid = b"T1"
+    node_a.leases[tid] = _mk_spec(tid, lease_seq=1)
+    head._reservations[tid] = ("node", b"A", {"CPU": 1.0})
+
+    def buggy_on_lease_return(from_nid, specs):
+        # The seeded bug: no `cur is None` / lease_seq staleness guard.
+        with head.lock:
+            for spec in specs:
+                holder, cur = head._find_lease_locked(
+                    spec.task_id, head.nodes.get(from_nid))
+                if holder is not None:
+                    holder.leases.pop(spec.task_id, None)
+                head._release_token(
+                    head._reservations.pop(spec.task_id, None))
+                head._enqueue_task_locked(cur or spec, front=True)
+
+    def spilled_notice():
+        api.point("head.lease_spilled.arrive")
+        head._on_lease_spilled(b"A", [(tid, 1, 1, b"B")])  # B is dead
+
+    def return_fallback():
+        api.point("head.lease_return.arrive")
+        buggy_on_lease_return(b"A", [_mk_spec(tid, lease_seq=1,
+                                              spill_hops=1)])
+
+    def check():
+        assert len(head.enqueued) == 1, (
+            f"duplicate execution: requeued {len(head.enqueued)}x")
+
+    return {"threads": [("spill_notice", spilled_notice),
+                        ("lease_return", return_fallback)],
+            "check": check}

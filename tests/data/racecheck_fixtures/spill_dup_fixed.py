@@ -1,0 +1,32 @@
+"""Fixed twin of spill_dup_buggy: the SHIPPED `Runtime._on_lease_return`
+(current-booking + lease_seq guard) on the identical scenario — the
+explorer must find no interleaving that double-enqueues."""
+
+
+def build(api):
+    from tools.racecheck.protocols import _mk_head, _mk_spec
+
+    head = _mk_head(api)
+    node_a = head.add_node(b"A")
+    tid = b"T1"
+    node_a.leases[tid] = _mk_spec(tid, lease_seq=1)
+    head._reservations[tid] = ("node", b"A", {"CPU": 1.0})
+
+    def spilled_notice():
+        api.point("head.lease_spilled.arrive")
+        head._on_lease_spilled(b"A", [(tid, 1, 1, b"B")])  # B is dead
+
+    def return_fallback():
+        api.point("head.lease_return.arrive")
+        head._on_lease_return(b"A", [_mk_spec(tid, lease_seq=1,
+                                              spill_hops=1)])
+
+    def check():
+        assert len(head.enqueued) == 1, (
+            f"duplicate execution: requeued {len(head.enqueued)}x")
+        assert len(head.released) == 1, (
+            f"token released {len(head.released)}x")
+
+    return {"threads": [("spill_notice", spilled_notice),
+                        ("lease_return", return_fallback)],
+            "check": check}

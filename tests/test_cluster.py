@@ -269,7 +269,7 @@ def test_dep_gated_actor_call_does_not_stall_direct_calls():
             first = ray_tpu.get(h.dump.remote(), timeout=60)
             dt = _time.monotonic() - t0
             ray_tpu.get(sref, timeout=60)
-            _time.sleep(1.5)            # let the released call deliver
+            _time.sleep(1.0)            # let the released call deliver
             final = ray_tpu.get(h.dump.remote(), timeout=60)
             return first, dt, final
 
@@ -345,7 +345,7 @@ def test_sixteen_agent_scheduling():
     flake on loaded hosts — every process shares this machine's CPUs)."""
     from ray_tpu.util.many_agents import run_many_agents
 
-    res = run_many_agents(n_agents=16, n_tasks=400)
+    res = run_many_agents(n_agents=16, n_tasks=400, settle=False)
     print(f"16-agent scheduling: {res['rate']:.0f} tasks/s "
           f"(reference many_nodes baseline: 215)")
     assert res["correct"]

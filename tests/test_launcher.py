@@ -481,7 +481,7 @@ def test_k8s_autoscaler_scale_up_down():
         config = AutoscalingConfig(
             node_types={"cpu2": NodeTypeConfig(resources={"CPU": 2},
                                                max_workers=1)},
-            idle_timeout_s=3.0, reconcile_interval_s=0.25)
+            idle_timeout_s=1.5, reconcile_interval_s=0.25)
         scaler = Autoscaler(config, provider, rt)
         scaler.start()
         try:
@@ -490,7 +490,10 @@ def test_k8s_autoscaler_scale_up_down():
                 time.sleep(t)
                 return ray_tpu.get_node_id()
 
-            refs = [burn.remote(4.0) for _ in range(6)]
+            # 2.5s x 6 keeps ~15s of queued demand on the 1-CPU head
+            # -- ample for the scaled node to boot and steal work --
+            # while cutting the floor (was 4.0s burns + 3s idle-out).
+            refs = [burn.remote(2.5) for _ in range(6)]
             spots = set(ray_tpu.get(refs, timeout=180))
             assert len(spots) >= 2  # work spilled onto an autoscaled POD
             assert any(m == "POST" and b and b.get("kind") == "Pod"

@@ -169,42 +169,50 @@ def _llm_config():
         tokenizer="byte")
 
 
-def test_openai_serve_app(ray_start_regular):
+@pytest.fixture(scope="module")
+def openai_llm_app(ray_start_regular):
+    """ONE OpenAI app over the shared tiny config for every read-only
+    HTTP surface test in this module — each private serve.run/delete
+    cycle paid a ~4s replica boot for an identical app. Yields the
+    route prefix."""
+    from ray_tpu import serve as serve_api
+    from ray_tpu.llm import build_openai_app
+
+    serve_api.run(build_openai_app(_llm_config()), name="llm-shared",
+                  route_prefix="/llmshared")
+    yield "/llmshared"
+    serve_api.delete("llm-shared")
+
+
+def test_openai_serve_app(openai_llm_app):
     """serve.run(build_openai_app(...)) then speak OpenAI over HTTP."""
     import urllib.request
 
-    from ray_tpu import serve as serve_api
-    from ray_tpu.llm import build_openai_app
     from ray_tpu.serve.config import DEFAULT_HTTP_PORT
 
-    app = build_openai_app(_llm_config())
-    serve_api.run(app, name="llm", route_prefix="/llm")
-    base = f"http://127.0.0.1:{DEFAULT_HTTP_PORT}/llm"
-    try:
-        with urllib.request.urlopen(base + "/v1/models", timeout=30) as r:
-            models = json.load(r)
-        assert models["data"][0]["id"] == "tiny"
+    base = f"http://127.0.0.1:{DEFAULT_HTTP_PORT}{openai_llm_app}"
+    with urllib.request.urlopen(base + "/v1/models", timeout=30) as r:
+        models = json.load(r)
+    assert models["data"][0]["id"] == "tiny"
 
-        req = urllib.request.Request(
-            base + "/v1/completions",
-            data=json.dumps({"prompt": "hi", "max_tokens": 3}).encode(),
-            headers={"content-type": "application/json"})
-        with urllib.request.urlopen(req, timeout=60) as r:
-            out = json.load(r)
-        assert out["object"] == "text_completion"
-        assert out["usage"]["completion_tokens"] == 3
+    req = urllib.request.Request(
+        base + "/v1/completions",
+        data=json.dumps({"prompt": "hi", "max_tokens": 3}).encode(),
+        headers={"content-type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        out = json.load(r)
+    assert out["object"] == "text_completion"
+    assert out["usage"]["completion_tokens"] == 3
 
-        req = urllib.request.Request(
-            base + "/v1/chat/completions",
-            data=json.dumps({
-                "messages": [{"role": "user", "content": "hello"}],
-                "max_tokens": 2}).encode(),
-            headers={"content-type": "application/json"})
-        with urllib.request.urlopen(req, timeout=60) as r:
-            out = json.load(r)
-        assert out["choices"][0]["message"]["role"] == "assistant"
-    finally:
-        serve_api.delete("llm")
+    req = urllib.request.Request(
+        base + "/v1/chat/completions",
+        data=json.dumps({
+            "messages": [{"role": "user", "content": "hello"}],
+            "max_tokens": 2}).encode(),
+        headers={"content-type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        out = json.load(r)
+    assert out["choices"][0]["message"]["role"] == "assistant"
 
 
 def test_serve_lora_adapters(ray_start_regular):
@@ -300,48 +308,42 @@ def test_engine_top_k_request(tiny_params):
     assert len(req.generated) >= 1
 
 
-def test_openai_stream_sse(ray_start_regular):
+def test_openai_stream_sse(openai_llm_app):
     """stream=true serves SSE chunks; first delta arrives before [DONE]
     (end-to-end token streaming: engine pump -> streaming actor method ->
     router __stream__ -> proxy chunked response)."""
     import http.client
 
-    from ray_tpu import serve as serve_api
-    from ray_tpu.llm import build_openai_app
     from ray_tpu.serve.config import DEFAULT_HTTP_PORT
 
-    app = build_openai_app(_llm_config())
-    serve_api.run(app, name="llm-sse", route_prefix="/llmsse")
-    try:
-        body = json.dumps({"prompt": "hi", "max_tokens": 4,
-                           "stream": True}).encode()
-        conn = http.client.HTTPConnection("127.0.0.1", DEFAULT_HTTP_PORT,
-                                          timeout=120)
-        conn.request("POST", "/llmsse/v1/completions", body=body,
-                     headers={"content-type": "application/json"})
-        resp = conn.getresponse()
-        assert resp.status == 200
-        assert resp.headers.get("content-type", "").startswith(
-            "text/event-stream")
-        raw = resp.read().decode()
-        conn.close()
-        events = [ln for ln in raw.splitlines() if ln.startswith("data: ")]
-        assert events[-1] == "data: [DONE]"
-        chunks = [json.loads(e[6:]) for e in events[:-1]]
-        assert chunks, raw
-        assert chunks[0]["object"] == "text_completion"
-        assert all(c["choices"][0]["finish_reason"] is None for c in chunks)
-        # Non-stream requests on the same app still return plain JSON.
-        import urllib.request
-        req = urllib.request.Request(
-            f"http://127.0.0.1:{DEFAULT_HTTP_PORT}/llmsse/v1/completions",
-            data=json.dumps({"prompt": "hi", "max_tokens": 2}).encode(),
-            headers={"content-type": "application/json"})
-        with urllib.request.urlopen(req, timeout=60) as r:
-            out = json.load(r)
-        assert out["object"] == "text_completion"
-    finally:
-        serve_api.delete("llm-sse")
+    body = json.dumps({"prompt": "hi", "max_tokens": 4,
+                       "stream": True}).encode()
+    conn = http.client.HTTPConnection("127.0.0.1", DEFAULT_HTTP_PORT,
+                                      timeout=120)
+    conn.request("POST", f"{openai_llm_app}/v1/completions", body=body,
+                 headers={"content-type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    assert resp.headers.get("content-type", "").startswith(
+        "text/event-stream")
+    raw = resp.read().decode()
+    conn.close()
+    events = [ln for ln in raw.splitlines() if ln.startswith("data: ")]
+    assert events[-1] == "data: [DONE]"
+    chunks = [json.loads(e[6:]) for e in events[:-1]]
+    assert chunks, raw
+    assert chunks[0]["object"] == "text_completion"
+    assert all(c["choices"][0]["finish_reason"] is None for c in chunks)
+    # Non-stream requests on the same app still return plain JSON.
+    import urllib.request
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{DEFAULT_HTTP_PORT}{openai_llm_app}"
+        "/v1/completions",
+        data=json.dumps({"prompt": "hi", "max_tokens": 2}).encode(),
+        headers={"content-type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        out = json.load(r)
+    assert out["object"] == "text_completion"
 
 
 def test_paged_kv_growth_beyond_initial_pages(tiny_params):
@@ -450,39 +452,32 @@ def test_chunked_prefill_interleaves_with_decode(tiny_params):
             == _naive_greedy(tiny_params, long_prompt, 4))
 
 
-def test_openai_stop_sequences(ray_start_regular):
+def test_openai_stop_sequences(openai_llm_app):
     """OpenAI `stop` truncates at the earliest stop string and reports
     finish_reason=stop (parity: the reference's OpenAI surface)."""
     import urllib.request
 
-    from ray_tpu import serve as serve_api
-    from ray_tpu.llm import build_openai_app
     from ray_tpu.serve.config import DEFAULT_HTTP_PORT
 
-    app = build_openai_app(_llm_config())
-    serve_api.run(app, name="llm-stop", route_prefix="/llmstop")
-    base = f"http://127.0.0.1:{DEFAULT_HTTP_PORT}/llmstop"
-    try:
-        req = urllib.request.Request(
-            base + "/v1/completions",
-            data=json.dumps({"prompt": "hi", "max_tokens": 8}).encode(),
-            headers={"content-type": "application/json"})
-        with urllib.request.urlopen(req, timeout=60) as r:
-            full = json.load(r)["choices"][0]["text"]
-        assert len(full) >= 2
-        stop_at = full[1]  # use the 2nd generated char as the stop seq
-        req = urllib.request.Request(
-            base + "/v1/completions",
-            data=json.dumps({"prompt": "hi", "max_tokens": 8,
-                             "stop": [stop_at]}).encode(),
-            headers={"content-type": "application/json"})
-        with urllib.request.urlopen(req, timeout=60) as r:
-            out = json.load(r)
-        cut = out["choices"][0]["text"]
-        assert stop_at not in cut and full.startswith(cut)
-        assert out["choices"][0]["finish_reason"] == "stop"
-    finally:
-        serve_api.delete("llm-stop")
+    base = f"http://127.0.0.1:{DEFAULT_HTTP_PORT}{openai_llm_app}"
+    req = urllib.request.Request(
+        base + "/v1/completions",
+        data=json.dumps({"prompt": "hi", "max_tokens": 8}).encode(),
+        headers={"content-type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        full = json.load(r)["choices"][0]["text"]
+    assert len(full) >= 2
+    stop_at = full[1]  # use the 2nd generated char as the stop seq
+    req = urllib.request.Request(
+        base + "/v1/completions",
+        data=json.dumps({"prompt": "hi", "max_tokens": 8,
+                         "stop": [stop_at]}).encode(),
+        headers={"content-type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        out = json.load(r)
+    cut = out["choices"][0]["text"]
+    assert stop_at not in cut and full.startswith(cut)
+    assert out["choices"][0]["finish_reason"] == "stop"
 
 
 def test_engine_logprobs_match_forward(tiny_params):
@@ -511,64 +506,52 @@ def test_engine_logprobs_match_forward(tiny_params):
     assert all(lp <= 0.0 for lp in req.token_logprobs)
 
 
-def test_openai_logprobs_surface(ray_start_regular):
+def test_openai_logprobs_surface(openai_llm_app):
     import urllib.request
 
-    from ray_tpu import serve as serve_api
-    from ray_tpu.llm import build_openai_app
     from ray_tpu.serve.config import DEFAULT_HTTP_PORT
 
-    app = build_openai_app(_llm_config())
-    serve_api.run(app, name="llm-lp", route_prefix="/llmlp")
-    try:
-        req = urllib.request.Request(
-            f"http://127.0.0.1:{DEFAULT_HTTP_PORT}/llmlp/v1/completions",
-            data=json.dumps({"prompt": "hi", "max_tokens": 3,
-                             "logprobs": True}).encode(),
-            headers={"content-type": "application/json"})
-        with urllib.request.urlopen(req, timeout=60) as r:
-            out = json.load(r)
-        lp = out["choices"][0]["logprobs"]
-        assert len(lp["token_logprobs"]) == 3
-        assert len(lp["tokens"]) == 3
-        assert all(x <= 0.0 for x in lp["token_logprobs"])
-    finally:
-        serve_api.delete("llm-lp")
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{DEFAULT_HTTP_PORT}{openai_llm_app}"
+        "/v1/completions",
+        data=json.dumps({"prompt": "hi", "max_tokens": 3,
+                         "logprobs": True}).encode(),
+        headers={"content-type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        out = json.load(r)
+    lp = out["choices"][0]["logprobs"]
+    assert len(lp["token_logprobs"]) == 3
+    assert len(lp["tokens"]) == 3
+    assert all(x <= 0.0 for x in lp["token_logprobs"])
 
 
-def test_openai_stream_stop_sequences(ray_start_regular):
+def test_openai_stream_stop_sequences(openai_llm_app):
     """stream=true with stop: the SSE stream ends at the stop string and
     never emits it (including stop strings straddling token
     boundaries)."""
     import http.client
 
-    from ray_tpu import serve as serve_api
-    from ray_tpu.llm import build_openai_app
     from ray_tpu.serve.config import DEFAULT_HTTP_PORT
 
-    app = build_openai_app(_llm_config())
-    serve_api.run(app, name="llm-sstop", route_prefix="/llmsstop")
-    try:
-        def run(body_extra):
-            body = json.dumps({"prompt": "hi", "max_tokens": 8,
-                               "stream": True, **body_extra}).encode()
-            conn = http.client.HTTPConnection(
-                "127.0.0.1", DEFAULT_HTTP_PORT, timeout=120)
-            conn.request("POST", "/llmsstop/v1/completions", body=body,
-                         headers={"content-type": "application/json"})
-            raw = conn.getresponse().read().decode()
-            conn.close()
-            chunks = [json.loads(e[6:]) for e in raw.splitlines()
-                      if e.startswith("data: ") and e != "data: [DONE]"]
-            return "".join(c["choices"][0]["text"] for c in chunks)
+    def run(body_extra):
+        body = json.dumps({"prompt": "hi", "max_tokens": 8,
+                           "stream": True, **body_extra}).encode()
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", DEFAULT_HTTP_PORT, timeout=120)
+        conn.request("POST", f"{openai_llm_app}/v1/completions",
+                     body=body,
+                     headers={"content-type": "application/json"})
+        raw = conn.getresponse().read().decode()
+        conn.close()
+        chunks = [json.loads(e[6:]) for e in raw.splitlines()
+                  if e.startswith("data: ") and e != "data: [DONE]"]
+        return "".join(c["choices"][0]["text"] for c in chunks)
 
-        full = run({})
-        assert len(full) >= 2
-        stop_at = full[1]
-        cut = run({"stop": [stop_at]})
-        assert stop_at not in cut and full.startswith(cut)
-    finally:
-        serve_api.delete("llm-sstop")
+    full = run({})
+    assert len(full) >= 2
+    stop_at = full[1]
+    cut = run({"stop": [stop_at]})
+    assert stop_at not in cut and full.startswith(cut)
 
 
 def test_engine_cancel_frees_slot_and_finishes(tiny_params):

@@ -1,0 +1,193 @@
+"""racecheck — the third analysis plane is itself tier-1 tested.
+
+Four layers: (1) the CI gates — the thread-escape pass runs clean
+against the EMPTY core baseline, and every registered protocol model
+holds its invariants under a deterministic exploration budget; (2)
+per-rule detection — seeded fixtures fire, clean twins don't; (3) the
+acceptance criterion: the explorer REDISCOVERS all three historical
+races (PR 2 spill duplicate-execution, PR 8 dispatch-vs-death listener
+kill, PR 9 lost-commit-on-raise) from their seeded fixtures, with the
+fixed twins green; (4) harness semantics — deadlock detection,
+determinism of the first violating schedule, fork happens-before.
+"""
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools import checklib  # noqa: E402
+from tools.racecheck import BASELINE_REL, escape, explore_models  # noqa: E402
+from tools.racecheck.interleave import explore  # noqa: E402
+
+FIX = "tests/data/racecheck_fixtures"
+
+
+def _load_fixture(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, FIX, f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _explore(name, **kw):
+    kw.setdefault("max_schedules", 2000)
+    kw.setdefault("pct_schedules", 16)
+    return explore(_load_fixture(name).build, **kw)
+
+
+# ---------------- (1) the CI gates ----------------
+
+
+def test_repo_escape_clean_against_baseline():
+    findings = escape.run(REPO)
+    base = checklib.load_baseline(os.path.join(REPO, BASELINE_REL))
+    new, _stale = checklib.diff_baseline(findings, base)
+    assert not new, "new thread-escape violations:\n" + "\n".join(
+        f.render() for f in new)
+
+
+def test_real_protocol_cores_hold_invariants():
+    """Every registered model — the REAL lease/store/checkpoint/stream
+    cores — explored under a small deterministic budget: 0 violations."""
+    violations = explore_models(budget=8.0, seed=0)
+    assert not violations, "\n".join(f.message for f in violations)
+
+
+# ---------------- (2) escape-pass detection ----------------
+
+
+def test_escape_detects_each_seeded_shape():
+    fs = escape.run(REPO, targets=(f"{FIX}/escape_bad.py",))
+    details = [f.detail for f in fs]
+    for field in ("counter", "latest", "mode"):
+        assert any(f"LeakyLoop.{field}" in d for d in details), (
+            field, details)
+    # the monotonic latch and the suppressed counter must NOT fire
+    assert not any("_shutdown" in d for d in details), details
+    assert not any("SuppressedLoop" in d for d in details), details
+
+
+def test_escape_clean_twin_is_clean():
+    assert escape.run(REPO, targets=(f"{FIX}/escape_ok.py",)) == []
+
+
+# ---------------- (3) the three historical races ----------------
+
+
+@pytest.mark.parametrize("buggy,fixed", [
+    ("spill_dup_buggy", "spill_dup_fixed"),
+    ("dispatch_death_buggy", "dispatch_death_fixed"),
+    ("lost_commit_buggy", "lost_commit_fixed"),
+])
+def test_explorer_rediscovers_historical_race(buggy, fixed):
+    red = _explore(buggy)
+    assert red.violation is not None, (
+        f"{buggy}: explorer missed the seeded race in "
+        f"{red.schedules} schedules")
+    green = _explore(fixed, max_schedules=500)
+    assert green.violation is None, (
+        f"{fixed}: fixed twin flagged red: {green.violation}\n"
+        f"{green.trace}")
+
+
+# ---------------- (4) harness semantics ----------------
+
+
+def test_deadlock_detected():
+    def build(api):
+        a = api.lock(name="a")
+        b = api.lock(name="b")
+
+        def t1():
+            with a:
+                api.point("t1.mid")
+                with b:
+                    pass
+
+        def t2():
+            with b:
+                api.point("t2.mid")
+                with a:
+                    pass
+
+        return {"threads": [("t1", t1), ("t2", t2)], "check": None}
+
+    res = explore(build, max_schedules=500, pct_schedules=4)
+    assert res.violation is not None and "deadlock" in res.violation
+
+
+def test_relock_of_nonreentrant_lock_detected():
+    def build(api):
+        lk = api.lock(name="lk")
+
+        def t1():
+            with lk:
+                with lk:
+                    pass
+
+        return {"threads": [("t1", t1)], "check": None}
+
+    res = explore(build, max_schedules=50)
+    assert res.violation is not None and "relock" in res.violation
+
+
+def test_first_violation_is_deterministic():
+    r1 = _explore("spill_dup_buggy")
+    r2 = _explore("spill_dup_buggy")
+    assert r1.violation == r2.violation
+    assert r1.schedule == r2.schedule
+    assert r1.schedules == r2.schedules
+
+
+def test_chaos_sites_double_as_yield_points():
+    """A chaos.site call inside model code is a schedule point: the
+    explorer can interleave another thread exactly there, with chaos
+    itself disarmed (the site never fires)."""
+    from ray_tpu.core import chaos
+
+    def build(api):
+        seen = []
+
+        def t1():
+            seen.append("t1.pre")
+            assert not chaos.site("transport.send.drop")  # disarmed
+            seen.append("t1.post")
+
+        def t2():
+            seen.append("t2")
+
+        def check():
+            assert len(seen) == 3
+
+        return {"threads": [("t1", t1), ("t2", t2)], "check": check}
+
+    res = explore(build, max_schedules=200)
+    assert res.violation is None
+    assert chaos._sched_hook is None  # hook restored after every run
+
+
+def test_cli_exit_codes():
+    env = {**os.environ,
+           "PYTHONPATH": REPO + os.pathsep + os.environ.get(
+               "PYTHONPATH", "")}
+    # clean: escape over the repo + the two cheap lease models
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.racecheck", "--budget", "4",
+         "--models", "lease_return,lease_dedup"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    # seeded escape fixture: nonzero, file:line report shape
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.racecheck", "--no-baseline",
+         "--passes", "escape", "--files", f"{FIX}/escape_bad.py"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1, (r.stdout, r.stderr)
+    assert f"{FIX}/escape_bad.py:" in r.stdout
+    assert "thread-escape" in r.stdout

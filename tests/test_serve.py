@@ -202,10 +202,13 @@ def test_user_config_reconfigure(serve_instance):
 def test_autoscaling_scale_up(serve_instance):
     @serve.deployment(autoscaling_config=serve.AutoscalingConfig(
         min_replicas=1, max_replicas=3, target_ongoing_requests=1,
-        upscale_delay_s=0.5, downscale_delay_s=60))
+        upscale_delay_s=0.3, downscale_delay_s=60))
     class Slow:
         def __call__(self):
-            time.sleep(0.4)
+            # Slow enough that 6-wide waves outrun one replica (queue
+            # pressure > target_ongoing_requests), short enough that the
+            # backlog the detection loop builds drains cheaply at delete.
+            time.sleep(0.25)
             return "ok"
 
     h = serve.run(Slow.bind(), name="slow", route_prefix=None,

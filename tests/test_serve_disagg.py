@@ -247,3 +247,63 @@ def test_decode_sigkill_mid_storm_resumes_exactly_once(ray_start_regular,
         assert stats["completed"] == len(prompts)
     finally:
         serve_api.delete("disagg-kill")
+
+
+def test_shed_metric_per_pool_and_prometheus_escaping():
+    """ROADMAP item 1's autoscaler signal: every admission shed exports
+    `ray_tpu_serve_shed_total{pool=...}` tagged with the budget that
+    tripped, and the exposition lines escape label values per the
+    Prometheus format (a hostile value cannot corrupt the scrape)."""
+    import collections
+    import types
+
+    from ray_tpu.llm import serve as serve_mod
+    from ray_tpu.util import metrics as umetrics
+
+    def mk_coord(**cfg):
+        coord = types.SimpleNamespace(
+            d=DisaggConfig(**cfg), _lock=threading.Lock(),
+            _prefill_queue_tokens=0, _decode_inflight_tokens=0,
+            _ongoing=0, _tok_rate_ema=0.0,
+            counters=collections.Counter())
+        coord._admit = types.MethodType(
+            serve_mod._DisaggServerImpl._admit, coord)
+        return coord
+
+    def shed_counts():
+        m = serve_mod._shed_metric
+        return dict(m._values) if m is not None else {}
+
+    before = shed_counts()
+    c = mk_coord(max_prefill_queue_tokens=4, max_decode_inflight_tokens=6,
+                 max_ongoing_requests=1)
+    with pytest.raises(OverloadedError, match="pool=decode"):
+        c._admit(2, 8)       # 2+8 > decode budget 6
+    with pytest.raises(OverloadedError, match="pool=prefill"):
+        c._admit(5, 1)       # prompt 5 > prefill budget 4
+    c._admit(1, 1)
+    with pytest.raises(OverloadedError, match="pool=requests"):
+        c._admit(1, 1)       # ongoing cap 1
+    slo = mk_coord(max_prefill_queue_tokens=1 << 20,
+                   max_decode_inflight_tokens=1 << 20,
+                   max_ongoing_requests=64, admission_slo_ms=1.0)
+    slo._tok_rate_ema = 10.0
+    slo._decode_inflight_tokens = 1000  # est wait 100s >> 1ms SLO
+    with pytest.raises(OverloadedError, match="pool=slo"):
+        slo._admit(1, 1)
+    after = shed_counts()
+    for pool in ("decode", "prefill", "requests", "slo"):
+        assert after.get((pool,), 0) == before.get((pool,), 0) + 1, pool
+    assert c.counters["shed"] == 3 and c.counters["shed_decode"] == 1
+
+    text = umetrics.prometheus_text()
+    assert "# TYPE ray_tpu_serve_shed_total counter" in text
+    for pool in ("decode", "prefill", "requests", "slo"):
+        assert f'ray_tpu_serve_shed_total{{pool="{pool}"}}' in text, pool
+
+    # Escaping: a hostile label value through the same family renders
+    # backslash -> \\, quote -> \", newline -> \n (exposition spec).
+    serve_mod._record_shed('bad"pool\nwith\\slash')
+    text = umetrics.prometheus_text()
+    assert ('ray_tpu_serve_shed_total{pool="bad\\"pool\\nwith\\\\slash"}'
+            in text)

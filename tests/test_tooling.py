@@ -205,7 +205,7 @@ def test_autoscaler_scales_up_and_down():
     config = AutoscalingConfig(
         node_types={"cpu2": NodeTypeConfig(resources={"CPU": 2},
                                            max_workers=1)},
-        idle_timeout_s=3.0, reconcile_interval_s=0.25)
+        idle_timeout_s=1.5, reconcile_interval_s=0.25)
     scaler = Autoscaler(config, FakeNodeProvider(rt), rt)
     scaler.start()
     try:
@@ -214,7 +214,10 @@ def test_autoscaler_scales_up_and_down():
             time.sleep(t)
             return ray_tpu.get_node_id()
 
-        refs = [burn.remote(4.0) for _ in range(6)]
+        # 2.5s x 6 keeps ~15s of queued demand on the 1-CPU head --
+        # ample for the scaled node to boot and steal work -- while
+        # cutting the test's floor (was 4.0s burns + 3s idle-out).
+        refs = [burn.remote(2.5) for _ in range(6)]
         spots = set(ray_tpu.get(refs, timeout=180))
         # Spilled onto an autoscaled node (which also proves a managed node
         # was launched; it may have idled out again already).
@@ -461,8 +464,13 @@ def test_dashboard_timeline_train_serve_endpoints(tooling_cluster):
     import time
 
     import ray_tpu
+    from ray_tpu import dashboard as dash_mod
     from ray_tpu.dashboard import start_dashboard, stop_dashboard
 
+    # Tighten the sampler tick (production default 3s): the assertions
+    # need a handful of sampled points, not 12s of wall.
+    old_tick = dash_mod._SAMPLE_INTERVAL_S
+    dash_mod._SAMPLE_INTERVAL_S = 0.75
     addr = start_dashboard()
     try:
         @ray_tpu.remote
@@ -470,8 +478,8 @@ def test_dashboard_timeline_train_serve_endpoints(tooling_cluster):
             time.sleep(0.05)
             return i
 
-        # a live "job": tasks churn while the 3s sampler ticks
-        deadline = time.monotonic() + 12
+        # a live "job": tasks churn while the sampler ticks ~5 times
+        deadline = time.monotonic() + 4.5
         while time.monotonic() < deadline:
             ray_tpu.get([work.remote(i) for i in range(8)], timeout=60)
 
@@ -493,6 +501,7 @@ def test_dashboard_timeline_train_serve_endpoints(tooling_cluster):
             assert isinstance(json.load(r), dict)
     finally:
         stop_dashboard()
+        dash_mod._SAMPLE_INTERVAL_S = old_tick
 
 
 def test_grafana_dashboard_factory(tooling_cluster):

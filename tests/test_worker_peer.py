@@ -104,7 +104,9 @@ def test_dep_gated_call_does_not_stall_direct_calls():
 
     @ray_tpu.remote
     def slow():
-        time.sleep(4)
+        # Must outlast the 2.0s stall threshold below (a stalled run
+        # reads ~this gate's length); 2.5s keeps margin over it.
+        time.sleep(2.5)
         return "gated"
 
     @ray_tpu.remote
@@ -118,7 +120,7 @@ def test_dep_gated_call_does_not_stall_direct_calls():
         return fast_done
 
     fast_done = ray_tpu.get(caller.remote(a), timeout=120)
-    assert fast_done < 3.0, (
+    assert fast_done < 2.0, (
         f"direct calls stalled {fast_done:.1f}s behind a dep-parked call")
     # The gated call still lands once its dep resolves.
     deadline = time.monotonic() + 30

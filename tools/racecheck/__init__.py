@@ -1,0 +1,74 @@
+"""racecheck: the third static-analysis plane — concurrency semantics.
+
+The repo's static gates now cover three planes:
+
+  tools.staticcheck   source conventions (wire drift, lock discipline,
+                      no-pickle scopes, fd/thread hygiene, chaos sites)
+  tools.graphcheck    lowered XLA graphs (donation, host sync, recompile,
+                      collective drift, memory)
+  tools.racecheck     concurrency SEMANTICS: who may touch what from
+                      which thread (static thread-escape analysis), and
+                      whether the distributed protocol cores hold their
+                      invariants under EVERY bounded interleaving
+                      (deterministic schedule exploration)
+
+Two cooperating passes:
+
+  escape       staticcheck pass 5: corpus-wide thread-role registry from
+               spawn sites; flags fields written by one role and touched
+               by another with no common held lock (`thread-escape`).
+               Findings diff against tools/racecheck/baseline.json
+               (ships EMPTY on core); suppress inline with
+               `# racecheck: ok thread-escape <reason>`.
+  interleave   CHESS/PCT-style deterministic interleaving explorer run
+               over the REAL protocol cores single-process (lease
+               return/spill/dedup, store reserve/publish/reclaim, the
+               two-phase checkpoint commit, the stream-resume cursor),
+               asserting machine-checked invariants: exactly-once
+               execution per (task_id, lease_seq), no double-release of
+               reservation extents, latest-committed manifest never
+               regresses, delivered token positions never re-emit or
+               skip. Yield points ride the chaos plane's sites
+               (`chaos.set_schedule_hook`) plus cooperative locks.
+
+Run `python -m tools.racecheck` (exit 1 on any new static finding OR any
+interleaving violation), or as the third stage of
+`python -m tools.staticcheck --all`. The exploration budget is bounded
+and deterministic: `RAYTPU_RACECHECK_BUDGET_S` (default 20s) splits
+across the registered protocol models, exhaustive-first then PCT seeds.
+"""
+
+from __future__ import annotations
+
+import os
+
+from tools.checklib import Finding, repo_root  # noqa: F401
+
+BASELINE_REL = "tools/racecheck/baseline.json"
+DEFAULT_BUDGET_S = 20.0
+
+
+def run(root: str | None = None,
+        targets: tuple | None = None) -> list[Finding]:
+    """The static (thread-escape) pass; explorer violations are produced
+    by explore_models() — they are hard failures, never baselined."""
+    from tools.racecheck import escape
+    return escape.run(root or repo_root(), targets=targets)
+
+
+def budget_s() -> float:
+    try:
+        return float(os.environ.get("RAYTPU_RACECHECK_BUDGET_S",
+                                    DEFAULT_BUDGET_S))
+    except ValueError:
+        return DEFAULT_BUDGET_S
+
+
+def explore_models(budget: float | None = None, seed: int = 0,
+                   names: tuple | None = None) -> list[Finding]:
+    """Run every registered protocol model under schedule enumeration;
+    each violation renders as one Finding with rule
+    `interleaving-violation` (path = the module owning the core)."""
+    from tools.racecheck import protocols
+    return protocols.run_all(budget if budget is not None else budget_s(),
+                             seed=seed, names=names)

@@ -1,0 +1,82 @@
+"""CLI: `python -m tools.racecheck [--passes escape,interleave]`.
+
+Exit codes: 0 clean, 1 new static findings OR any interleaving
+violation, 2 usage error. The static (escape) findings diff against
+tools/racecheck/baseline.json; interleaving violations are hard
+failures with no baseline. `RAYTPU_RACECHECK_BUDGET_S` (default 20)
+bounds the exploration wall clock; `--budget` overrides.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from tools import checklib
+from tools.racecheck import (BASELINE_REL, budget_s, explore_models,
+                             repo_root, run)
+
+PASSES = ("escape", "interleave")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.racecheck",
+        description="racecheck: thread-escape static analysis + "
+                    "deterministic interleaving model checking")
+    p.add_argument("--passes", default=",".join(PASSES),
+                   help=f"comma list of {', '.join(PASSES)}")
+    p.add_argument("--root", default=repo_root())
+    p.add_argument("--baseline", default=None)
+    p.add_argument("--no-baseline", action="store_true")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="accept current ESCAPE findings as the baseline "
+                        "(interleaving violations are never baselined)")
+    p.add_argument("--files", default=None,
+                   help="comma list of python files: restrict the escape "
+                        "pass to exactly these (fixture/debug mode)")
+    p.add_argument("--budget", type=float, default=None,
+                   help="exploration wall budget in seconds (default "
+                        "RAYTPU_RACECHECK_BUDGET_S or 20)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--models", default=None,
+                   help="comma list restricting the interleave pass to "
+                        "these protocol models")
+    args = p.parse_args(argv)
+
+    passes = tuple(s for s in args.passes.split(",") if s)
+    for s in passes:
+        if s not in PASSES:
+            print(f"unknown pass {s!r} (have: {', '.join(PASSES)})",
+                  file=sys.stderr)
+            return 2
+
+    rc = 0
+    if "escape" in passes:
+        targets = None
+        if args.files:
+            targets = tuple(
+                os.path.relpath(os.path.abspath(f), args.root)
+                for f in args.files.split(","))
+        findings = run(args.root, targets=targets)
+        bpath = args.baseline or os.path.join(args.root, BASELINE_REL)
+        rc = checklib.report(findings, bpath,
+                            update=args.update_baseline,
+                            use_baseline=not args.no_baseline)
+        if args.update_baseline:
+            return rc
+    if "interleave" in passes:
+        budget = args.budget if args.budget is not None else budget_s()
+        names = (tuple(args.models.split(",")) if args.models else None)
+        violations = explore_models(budget, seed=args.seed, names=names)
+        for f in violations:
+            print(f.render())
+        print(f"interleave: {len(violations)} violation(s) within "
+              f"{budget:.0f}s budget", file=sys.stderr)
+        rc = max(rc, 1 if violations else 0)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

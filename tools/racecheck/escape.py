@@ -1,0 +1,516 @@
+"""Thread-escape static analysis — staticcheck pass 5, the static half
+of racecheck.
+
+Builds a corpus-wide THREAD-ROLE REGISTRY from spawn sites in the
+lock-heavy planes (`threading.Thread(target=self._loop)`, executor-shard
+`.submit(self._fn)`, nested-def spawns `Thread(target=run)`), computes
+each role's reachable method set by transitive `self.method()` closure
+inside the class, and records every `self.field` READ and WRITE together
+with the held-lock stack at the access (the same lock-identity machinery
+as staticcheck's concurrency pass). A field is a THREAD ESCAPE when one
+role WRITES it and a different role touches it with NO COMMON HELD LOCK —
+the static shape of every cross-thread lost-update / torn-check bug the
+chaos storms have caught dynamically.
+
+Noise model (what deliberately does NOT fire):
+
+  - writes inside `__init__` (and methods reachable only from it):
+    construction happens-before every spawn, so boot-time publication is
+    ordered;
+  - fields whose only post-boot writes are ONE constant value (monotonic
+    latches: `self._shutdown = True` read by loops — the CPython
+    GIL-published flag idiom this codebase uses deliberately);
+  - lock-like attributes themselves (`self.lock`, `self._cv`, ...);
+  - container METHOD mutation (`self.q.append(x)`, `self.d[k] = v`):
+    single bytecode container ops are GIL-atomic; this pass targets
+    attribute REBINDING and read-modify-write (`self.x = ...`,
+    `self.x += 1`), where interleaving loses updates even under the GIL.
+
+Roles: every spawn target (plus everything it reaches) is one role; all
+methods not reachable from any spawn site form the single `api` role
+(external callers — client threads, the listener's dispatch, etc.).
+A method reachable from several spawn sites belongs to each of them.
+
+Findings carry rule `thread-escape`, diff against an EMPTY baseline on
+core, and suppress inline with `# racecheck: ok thread-escape <reason>`
+(checked at BOTH access sites of a pair, so the justification can live at
+whichever side states the design — e.g. a seqlock field or an atomics-
+style counter read torn by design).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from tools.checklib import Finding, suppressed
+from tools.staticcheck import concurrency as conc
+
+# Same lock-heavy corpus as the concurrency pass: the planes whose spawn
+# sites are the listener / ingest / health / dial / copier / reply-batcher
+# / executor-shard threads the module docstring names.
+TARGETS = conc.TARGETS
+
+_SAFE_CTORS = {
+    # assignments of these never make the FIELD unsafe to touch (the
+    # object's own thread-safety is its contract); rebinding still counts.
+    "Lock", "RLock", "Condition", "Event", "Semaphore", "BoundedSemaphore",
+    "Queue", "deque", "ThreadPoolExecutor",
+}
+
+
+def _target_qualname(call: ast.Call) -> ast.AST | None:
+    """The spawn target expression of a Thread(...) / .submit(...) call,
+    or None when this call spawns nothing."""
+    f = call.func
+    fname = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else "")
+    if fname == "Thread":
+        for kw in call.keywords:
+            if kw.arg == "target":
+                return kw.value
+        return None
+    if fname == "submit" and call.args:
+        return call.args[0]
+    return None
+
+
+def _spawn_target_name(expr) -> tuple[str, str] | None:
+    """-> ("self", method) | ("local", name) for resolvable targets."""
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) \
+            and expr.value.id == "self":
+        return ("self", expr.attr)
+    if isinstance(expr, ast.Name):
+        return ("local", expr.id)
+    if isinstance(expr, ast.Call):  # functools.partial(self._fn, ...)
+        fn = expr.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else "")
+        if name == "partial" and expr.args:
+            return _spawn_target_name(expr.args[0])
+    return None
+
+
+class _Access:
+    __slots__ = ("kind", "locks", "line", "qual", "roles", "variants")
+
+    def __init__(self, kind, locks, line, qual):
+        self.kind = kind          # "read" | "write"
+        self.locks = locks        # frozenset of lock identities (local)
+        self.line = line
+        self.qual = qual          # "Class.method"
+        self.roles = set()        # filled by role attribution
+        self.variants = [locks]   # lock sets incl. caller contexts
+
+
+class _AccessWalker:
+    """Held-lock-tracking walk of one function body collecting self.field
+    accesses and self-call edges (for role reachability)."""
+
+    def __init__(self, corpus, module, cname):
+        self.corpus = corpus
+        self.module = module
+        self.cname = cname
+        self.held: list = []
+        self.accesses: dict[str, list[_Access]] = {}
+        # self.method name -> set of frozenset(lock ids) held at callsite
+        self.calls: dict[str, set] = {}
+        self.local_calls: set[str] = set()  # nested-def names called
+        self.const_writes: dict[str, set] = {}  # attr -> literal reprs
+        self.nonconst_write: set[str] = set()
+
+    def walk(self, fn, qual):
+        self.qual = qual
+        for stmt in fn.body:
+            self._stmt(stmt)
+
+    def _stmt(self, node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs walk as their own role roots
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            pushed = 0
+            for item in node.items:
+                self._expr(item.context_expr)
+                lk = conc._lock_of_expr(item.context_expr, self.corpus,
+                                        self.cname)
+                if lk is not None:
+                    self.held.append(lk)
+                    pushed += 1
+            for s in node.body:
+                self._stmt(s)
+            for _ in range(pushed):
+                self.held.pop()
+            return
+        if isinstance(node, ast.Assign):
+            self._expr(node.value)
+            for t in node.targets:
+                self._target(t, node.value)
+            return
+        if isinstance(node, ast.AugAssign):
+            self._expr(node.value)
+            self._target(node.target, None, aug=True)
+            return
+        if isinstance(node, (ast.AnnAssign,)) and node.value is not None:
+            self._expr(node.value)
+            self._target(node.target, node.value)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child)
+            elif isinstance(child, ast.stmt):
+                self._stmt(child)
+            elif isinstance(child, ast.excepthandler):
+                for s in child.body:
+                    self._stmt(s)
+
+    def _is_self_attr(self, node) -> bool:
+        return (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self")
+
+    def _target(self, t, value, aug: bool = False):
+        if self._is_self_attr(t):
+            self._record(t.attr, "write", t.lineno)
+            if aug:
+                # read-modify-write: the load half races too
+                self._record(t.attr, "read", t.lineno)
+                self.nonconst_write.add(t.attr)
+            else:
+                self._note_write_value(t.attr, value)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                self._target(el, None)
+            if value is not None:
+                pass  # value already visited
+        else:
+            # Subscript/attribute-chain stores: container mutation — the
+            # documented GIL-atomic carve-out. Still visit the receiver
+            # as a READ of the outer field.
+            self._expr(t)
+
+    def _note_write_value(self, attr, value):
+        if isinstance(value, ast.Constant) \
+                and isinstance(value.value, (bool, int, float, str,
+                                             type(None))):
+            self.const_writes.setdefault(attr, set()).add(
+                repr(value.value))
+        elif isinstance(value, ast.Call) and (
+                (value.func.attr if isinstance(value.func, ast.Attribute)
+                 else getattr(value.func, "id", "")) in _SAFE_CTORS):
+            self.const_writes.setdefault(attr, set()).add("<safe-ctor>")
+        else:
+            self.nonconst_write.add(attr)
+
+    def _expr(self, node):
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                f = n.func
+                if isinstance(f, ast.Attribute) \
+                        and isinstance(f.value, ast.Name) \
+                        and f.value.id == "self":
+                    self.calls.setdefault(f.attr, set()).add(
+                        frozenset(h.identity for h in self.held))
+                elif isinstance(f, ast.Name):
+                    self.local_calls.add(f.id)
+                tgt = _target_qualname(n)
+                if tgt is not None:
+                    continue
+            if self._is_self_attr(n) and isinstance(n.ctx, ast.Load):
+                # `self.x.append(...)` / `self.x[k]` read the binding;
+                # `self.x` as a call receiver likewise.
+                self._record(n.attr, "read", n.lineno)
+
+    def _record(self, attr, kind, line):
+        if conc._lock_like(attr) or attr.startswith("__"):
+            return
+        locks = frozenset(h.identity for h in self.held)
+        self.accesses.setdefault(attr, []).append(
+            _Access(kind, locks, line, self.qual))
+
+
+class _ClassModel:
+    def __init__(self, module, cname, methods):
+        self.module = module
+        self.cname = cname
+        self.methods = methods          # name -> FunctionDef
+        self.nested: dict[str, dict] = {}  # method -> {name: FunctionDef}
+        for mname, fn in methods.items():
+            self.nested[mname] = {
+                n.name: n for n in ast.walk(fn)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and n is not fn}
+
+    def spawn_roles(self) -> dict[str, dict]:
+        """role name -> {"fns": [root FunctionDef], "sites":
+        [(spawning method, line)]} — the sites carry the fork
+        happens-before edge (writes above a spawn in the spawning method
+        are ordered before everything the spawned role does)."""
+        roles: dict[str, dict] = {}
+        for mname, fn in self.methods.items():
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                tgt = _target_qualname(node)
+                if tgt is None:
+                    continue
+                resolved = _spawn_target_name(tgt)
+                if resolved is None:
+                    continue
+                kind, name = resolved
+                if kind == "self" and name in self.methods:
+                    ent = roles.setdefault(name,
+                                           {"fns": [], "sites": []})
+                    ent["fns"].append(self.methods[name])
+                elif kind == "local" and name in self.nested.get(mname, {}):
+                    ent = roles.setdefault(f"{mname}.<{name}>",
+                                           {"fns": [], "sites": []})
+                    ent["fns"].append(self.nested[mname][name])
+                else:
+                    continue
+                ent["sites"].append((mname, node.lineno))
+        return roles
+
+
+def _walk_fn(corpus, module, cname, fn, qual) -> _AccessWalker:
+    w = _AccessWalker(corpus, module, cname)
+    w.walk(fn, qual)
+    return w
+
+
+def _closure(model: _ClassModel, walks: dict, roots: list) -> set:
+    """Method names reachable from `roots` via self-calls (transitive)."""
+    seen: set[str] = set()
+    frontier = list(roots)
+    while frontier:
+        name = frontier.pop()
+        if name in seen or name not in model.methods:
+            continue
+        seen.add(name)
+        frontier.extend(walks[name].calls)
+    return seen
+
+
+def _externally_called(modules) -> set:
+    """Method names invoked on a NON-self receiver anywhere in the corpus
+    (`self.runtime._on_x()`, `rt.submit()`, `w.drain()`): these are entry
+    points some OTHER module's thread can drive, so they root the
+    external role even when private."""
+    out: set = set()
+    for m in modules:
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute):
+                recv = node.func.value
+                if not (isinstance(recv, ast.Name) and recv.id == "self"):
+                    out.add(node.func.attr)
+    return out
+
+
+def run(root: str, targets: tuple | None = None) -> list[Finding]:
+    rels = [t for t in (targets or TARGETS)
+            if os.path.exists(os.path.join(root, t))]
+    modules = [conc._Module(root, rel) for rel in rels]
+    corpus = conc._Corpus(modules)
+    external = _externally_called(modules)
+    findings: list[Finding] = []
+    for m in modules:
+        for cname, methods in m.classes.items():
+            findings.extend(_check_class(corpus, m, cname, methods,
+                                         external))
+    return findings
+
+
+_MAX_CONTEXTS = 6
+
+
+def _check_class(corpus, module, cname, methods,
+                 external: set) -> list[Finding]:
+    model = _ClassModel(module, cname, methods)
+    roles = model.spawn_roles()
+    if not roles:
+        return []
+
+    # Walk every method once; nested role roots walk separately.
+    walks: dict[str, _AccessWalker] = {}
+    for mname, fn in methods.items():
+        walks[mname] = _walk_fn(corpus, module, cname, fn,
+                                f"{cname}.{mname}")
+    role_reach: dict[str, set] = {}
+    role_extra_walks: dict[str, _AccessWalker] = {}
+    fork_hb: dict[str, dict] = {}   # role -> {spawning method: min line}
+    for rname, ent in roles.items():
+        if "." in rname:  # nested-def role: walk the nested body itself
+            w = _walk_fn(corpus, module, cname, ent["fns"][0],
+                         f"{cname}.{rname}")
+            role_extra_walks[rname] = w
+            role_reach[rname] = _closure(model, walks, list(w.calls))
+        else:
+            role_reach[rname] = _closure(model, walks, [rname])
+        hb: dict[str, int] = {}
+        for mname, line in ent["sites"]:
+            hb[mname] = min(line, hb.get(mname, line))
+        fork_hb[rname] = hb
+
+    # Boot-only methods: reachable from __init__ and from nowhere else —
+    # they run before any spawn, so their writes are ordered (fixpoint:
+    # a method stays boot-only while every caller is __init__/boot-only).
+    boot_reach = _closure(model, walks, ["__init__"]) \
+        if "__init__" in methods else set()
+    callers: dict[str, set] = {}
+    for mn, w in walks.items():
+        for callee in w.calls:
+            callers.setdefault(callee, set()).add(mn)
+    boot_only = set(boot_reach)
+    changed = True
+    while changed:
+        changed = False
+        for mn in list(boot_only):
+            outside = {c for c in callers.get(mn, ())
+                       if c != "__init__" and c not in boot_only}
+            if outside:
+                boot_only.discard(mn)
+                changed = True
+    # The external role roots: methods some OTHER thread can enter
+    # directly — public surface, corpus-wide non-self callees, or in-class
+    # orphans (no in-class caller). A private helper only ever reached
+    # from a thread loop stays in that loop's role alone.
+    api_roots = [mn for mn in methods
+                 if mn != "__init__" and mn not in boot_only
+                 and mn not in roles
+                 and (not mn.startswith("_") or mn in external
+                      or mn not in callers)]
+    role_reach["api"] = _closure(model, walks, api_roots)
+
+    # ---- caller-held-lock context propagation ----
+    # CONTEXTS(m): the lock sets m can be ENTERED under. Role/api roots
+    # enter lock-free; each in-class callsite contributes (caller ctx |
+    # site locks). Fixpoint; above the cap a method's contexts collapse
+    # to their intersection (the locks guaranteed on every path).
+    contexts: dict[str, set] = {mn: set() for mn in methods}
+    entry = set(api_roots) | {rn for rn in roles if "." not in rn}
+    for mn in entry:
+        contexts[mn].add(frozenset())
+    work = list(entry)
+    nested_ctx = frozenset()
+    for rname, w in role_extra_walks.items():
+        # nested-def role bodies enter lock-free; seed their callees
+        for callee, sites in w.calls.items():
+            if callee in contexts:
+                for site_locks in sites:
+                    if (nested_ctx | site_locks) not in contexts[callee]:
+                        contexts[callee].add(nested_ctx | site_locks)
+                        work.append(callee)
+    while work:
+        mn = work.pop()
+        w = walks.get(mn)
+        if w is None:
+            continue
+        for callee, sites in w.calls.items():
+            if callee not in contexts:
+                continue
+            tgt = contexts[callee]
+            before = len(tgt)
+            for ctx in list(contexts[mn]) or [frozenset()]:
+                for site_locks in sites:
+                    tgt.add(ctx | site_locks)
+            if len(tgt) > _MAX_CONTEXTS:
+                common = frozenset.intersection(*tgt)
+                tgt.clear()
+                tgt.add(common)
+            if len(tgt) != before:
+                work.append(callee)
+
+    def variants(mname: str, local: frozenset) -> list:
+        ctxs = contexts.get(mname) or {frozenset()}
+        return [c | local for c in ctxs]
+
+    # ---- aggregate accesses per field per role ----
+    per_field: dict[str, list[_Access]] = {}
+    const_vals: dict[str, set] = {}
+    nonconst: set = set()
+
+    def absorb(w: _AccessWalker, rnames: list, mname: str | None):
+        for attr, accs in w.accesses.items():
+            for a in accs:
+                a2 = _Access(a.kind, a.locks, a.line, a.qual)
+                a2.roles = set(rnames)
+                a2.variants = (variants(mname, a.locks) if mname
+                               else [a.locks])
+                per_field.setdefault(attr, []).append(a2)
+        for attr, vals in w.const_writes.items():
+            const_vals.setdefault(attr, set()).update(vals)
+        nonconst.update(w.nonconst_write)
+
+    for mname, w in walks.items():
+        rnames = [rn for rn, reach in role_reach.items() if mname in reach]
+        if not rnames:
+            continue  # boot-only method
+        absorb(w, rnames, mname)
+    for rname, w in role_extra_walks.items():
+        absorb(w, [rname], None)
+
+    # ---- the escape rule ----
+    findings: list[Finding] = []
+    lines = module.lines
+    for attr, accs in sorted(per_field.items()):
+        writes = [a for a in accs if a.kind == "write"]
+        if not writes:
+            continue
+        # Monotonic-latch / safe-ctor carve-out: every post-boot write is
+        # one constant (or a thread-safe ctor) => publication-only field.
+        if attr not in nonconst and len(const_vals.get(attr, ())) <= 1:
+            continue
+        hit = _first_unlocked_pair(writes, accs, cname, fork_hb)
+        if hit is None:
+            continue
+        w, other = hit
+        if suppressed(lines, w.line, "thread-escape", tool="racecheck") \
+                or suppressed(lines, other.line, "thread-escape",
+                              tool="racecheck"):
+            continue
+        wl = ",".join(sorted(w.locks)) or "no lock"
+        ol = ",".join(sorted(other.locks)) or "no lock"
+        findings.append(Finding(
+            "thread-escape", module.rel, w.line,
+            f"{cname}.{attr}: written in {w.qual} "
+            f"[{_rolestr(w)}] under {wl}; {other.kind} in {other.qual} "
+            f"[{_rolestr(other)}] under {ol} — no common lock",
+        ))
+    return findings
+
+
+def _rolestr(a: _Access) -> str:
+    return "+".join(sorted(a.roles))
+
+
+def _fork_ordered(x: _Access, y: _Access, cname: str,
+                  fork_hb: dict) -> bool:
+    """True when `x` is in the spawning method ABOVE the spawn site of
+    the sole role `y` runs in — the fork happens-before edge (configure
+    state, then start the thread)."""
+    if len(y.roles) != 1:
+        return False
+    hb = fork_hb.get(next(iter(y.roles)))
+    if not hb:
+        return False
+    meth = x.qual.removeprefix(cname + ".")
+    return meth in hb and x.line < hb[meth]
+
+
+def _first_unlocked_pair(writes, accs, cname, fork_hb):
+    """First (write, access) pair that can run on different threads with
+    provably disjoint lock sets on SOME pair of entry contexts."""
+    for w in writes:
+        for a in accs:
+            if a is w:
+                continue
+            if a.roles == w.roles and len(w.roles) == 1:
+                continue  # one role on both sides: same thread
+            if _fork_ordered(w, a, cname, fork_hb) \
+                    or _fork_ordered(a, w, cname, fork_hb):
+                continue  # ordered by Thread.start()
+            if not any(not (vw & va)
+                       for vw in w.variants for va in a.variants):
+                continue  # every context pair shares a lock
+            return (w, a)
+    return None

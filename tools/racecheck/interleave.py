@@ -1,0 +1,542 @@
+"""Deterministic interleaving explorer — the dynamic half of racecheck.
+
+CHESS/PCT-style systematic schedule exploration for the repo's
+distributed-control-plane protocol cores, run SINGLE-PROCESS under a
+cooperative scheduler: every logical thread is a real thread, but exactly
+one holds the run token at any instant, and the token only changes hands
+at YIELD POINTS. Yield points come from three places:
+
+  - `CooperativeLock.acquire()/release()` — the harness-supplied lock the
+    model swaps into the object under test (`self.lock`, `self._lease_lock`
+    ...), so every critical-section boundary is a schedule point;
+  - `chaos.site(...)` markers — the 26+ seeded fault sites (PR 8) already
+    threaded through transport/store/agent/train/serve double as schedule
+    points for free via `chaos.set_schedule_hook` (zero overhead when no
+    explorer is attached, exactly like a disarmed chaos plane);
+  - explicit `api.point()` calls in model/fixture code (queue/deque ops,
+    protocol step boundaries).
+
+Two enumeration strategies share one decision-trace core, so a fault
+branch (`api.choice(n)` — e.g. "does the peer die here?") is explored the
+same way a context switch is:
+
+  exhaustive    DFS over the decision tree with a PREEMPTION BOUND
+                (CHESS): switching away from a runnable thread costs one
+                preemption; at the bound the scheduler must run the
+                current thread on. Bound 2-3 covers the overwhelming
+                majority of real concurrency bugs at polynomial cost.
+  PCT           probabilistic concurrency testing: random per-thread
+                priorities plus d-1 priority-change points, seeded, so
+                each run is a deterministic schedule and a found bug
+                replays from (seed, run index).
+
+A VIOLATION is any of: an invariant check failing after the run, an
+uncaught exception inside a logical thread (the PR 8 listener-kill shape:
+a dying control thread IS the bug), a deadlock (every live thread blocked
+on a cooperative lock), or a livelock (step budget exhausted). The first
+violating schedule is returned with its full decision trace and yield-
+point log, and replays deterministically: same model + same strategy
+state => same interleaving.
+
+Models build fresh state per schedule via `build(api)` and return a dict:
+
+    {"threads": [(name, fn), ...],   # logical threads, run to completion
+     "check": fn | None}             # post-run invariant assertions
+
+This module is dependency-free (stdlib only) so fixtures under
+tests/data/ can drive it without the product tree on the path.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+
+class _Abort(BaseException):
+    """Internal: unwind a logical thread after the run is cancelled."""
+
+
+class Violation(Exception):
+    """An invariant the model checks raised (or the harness detected a
+    deadlock/livelock/thread death)."""
+
+
+class CooperativeLock:
+    """Drop-in for threading.Lock/RLock under the cooperative scheduler.
+
+    acquire() yields BEFORE taking the lock (the classic race window:
+    check-then-act straddling the boundary), blocks cooperatively while
+    another logical thread owns it, and release() yields after freeing it
+    so a waiter can be scheduled immediately."""
+
+    def __init__(self, sched: "Scheduler", reentrant: bool = False,
+                 name: str = "lock"):
+        self._s = sched
+        self._reentrant = reentrant
+        self.name = name
+        self._owner = None      # logical thread or None
+        self._depth = 0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        s = self._s
+        me = s._current()
+        if me is None:  # foreign (non-logical) thread: degrade to no-op
+            return True
+        s._yield_point(f"{self.name}.acquire")
+        if self._owner is me:
+            if self._reentrant:
+                self._depth += 1
+                return True
+            raise Violation(
+                f"relock of non-reentrant {self.name} by {me.name}")
+        while self._owner is not None:
+            if not blocking:
+                return False
+            s._block(me, self)
+        self._owner = me
+        self._depth = 1
+        return True
+
+    def release(self) -> None:
+        me = self._s._current()
+        if me is None:
+            return
+        if self._owner is not me:
+            raise Violation(
+                f"{me.name} released {self.name} it does not hold")
+        self._depth -= 1
+        if self._depth == 0:
+            self._owner = None
+            self._s._unblock_waiters(self)
+        self._s._yield_point(f"{self.name}.release")
+
+    def locked(self) -> bool:
+        return self._owner is not None
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class _LThread:
+    __slots__ = ("name", "fn", "ev", "state", "real", "exc", "waiting_on",
+                 "prio")
+
+    def __init__(self, name, fn):
+        self.name = name
+        self.fn = fn
+        self.ev = threading.Event()
+        self.state = "ready"   # ready | running | blocked | done
+        self.real = None
+        self.exc = None
+        self.waiting_on = None
+        self.prio = 0.0
+
+
+class Api:
+    """What a model/fixture's `build(api)` sees."""
+
+    def __init__(self, sched: "Scheduler"):
+        self._s = sched
+
+    def lock(self, reentrant: bool = False,
+             name: str = "lock") -> CooperativeLock:
+        return CooperativeLock(self._s, reentrant, name)
+
+    def point(self, site: str = "point") -> None:
+        """Explicit yield point (queue/deque op, protocol step edge)."""
+        self._s._yield_point(site)
+
+    def choice(self, n: int, site: str = "choice") -> int:
+        """A fault/branch decision the strategies enumerate exactly like
+        a context switch (exhaustive walks every arm)."""
+        return self._s._choice(n, site)
+
+    def fired(self, site: str) -> bool:
+        """Sugar: a binary fault branch ('does the peer die here?')."""
+        return self._s._choice(2, site) == 1
+
+    def trace(self) -> list:
+        return list(self._s.log)
+
+
+# ---------------- strategies ----------------
+
+
+class ExhaustiveStrategy:
+    """DFS over the decision tree with a preemption bound. Decision 0 is
+    always "continue the current thread" when it is runnable, so the
+    first schedule is the non-preemptive one and the bound prunes only
+    voluntary switches."""
+
+    name = "exhaustive"
+
+    def __init__(self, max_preemptions: int = 2):
+        self.max_preemptions = max_preemptions
+        self.prefix: list[list[int]] = []  # [chosen, n_choices]
+        self.pos = 0
+        self.preemptions = 0
+        self.complete = False
+
+    def begin_run(self, threads):
+        self.pos = 0
+        self.preemptions = 0
+
+    def _decide(self, n: int) -> int:
+        if n <= 1:
+            return 0
+        if self.pos < len(self.prefix):
+            ent = self.prefix[self.pos]
+            ent[1] = n
+            idx = min(ent[0], n - 1)
+        else:
+            self.prefix.append([0, n])
+            idx = 0
+        self.pos += 1
+        return idx
+
+    def pick(self, current, runnable):
+        if current is not None and current.state != "done" \
+                and current.waiting_on is None:
+            # current could keep running: switching away is a preemption
+            if self.preemptions >= self.max_preemptions:
+                return current
+            others = [t for t in runnable if t is not current]
+            idx = self._decide(1 + len(others))
+            if idx == 0:
+                return current
+            self.preemptions += 1
+            return others[idx - 1]
+        # current blocked/done: a switch is forced, not a preemption
+        idx = self._decide(len(runnable))
+        return runnable[idx]
+
+    def choice(self, n: int) -> int:
+        return self._decide(n)
+
+    def next_run(self) -> bool:
+        # Drop stale tail from a longer previous run, then increment the
+        # deepest decision that still has unexplored arms.
+        del self.prefix[self.pos:]
+        while self.prefix and self.prefix[-1][0] + 1 >= self.prefix[-1][1]:
+            self.prefix.pop()
+        if not self.prefix:
+            self.complete = True
+            return False
+        self.prefix[-1][0] += 1
+        return True
+
+    def state_repr(self) -> str:
+        return "exhaustive:" + ",".join(str(c) for c, _ in
+                                        self.prefix[:self.pos])
+
+
+class PCTStrategy:
+    """Probabilistic concurrency testing (Burckhardt et al.): random
+    priorities + d-1 priority-change points give a 1/(n * k^(d-1))
+    detection guarantee for depth-d bugs; each seed is one deterministic
+    schedule."""
+
+    name = "pct"
+
+    def __init__(self, seed: int, depth: int = 3, length_hint: int = 256):
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.depth = depth
+        self.length_hint = length_hint
+        self.step = 0
+        self.change_points: set[int] = set()
+        self.complete = False
+
+    def begin_run(self, threads):
+        self.rng = random.Random(self.seed)
+        self.step = 0
+        for t in threads:
+            t.prio = self.rng.random()
+        self.change_points = {
+            self.rng.randrange(1, max(2, self.length_hint))
+            for _ in range(max(0, self.depth - 1))}
+
+    def pick(self, current, runnable):
+        self.step += 1
+        if self.step in self.change_points and current is not None:
+            current.prio = min(t.prio for t in runnable) - 1.0
+        return max(runnable, key=lambda t: t.prio)
+
+    def choice(self, n: int) -> int:
+        return self.rng.randrange(n) if n > 1 else 0
+
+    def next_run(self) -> bool:
+        return False  # one seed, one schedule; the driver rotates seeds
+
+    def state_repr(self) -> str:
+        return f"pct:seed={self.seed},d={self.depth}"
+
+
+# ---------------- the scheduler ----------------
+
+
+class Scheduler:
+    """One schedule execution: real threads, one token."""
+
+    # Step budget: a model that exceeds this under SOME schedule is
+    # livelocked (e.g. an unpaced retry loop that never cedes progress).
+    MAX_STEPS = 50_000
+
+    def __init__(self, strategy):
+        self.strategy = strategy
+        self.threads: list[_LThread] = []
+        self.by_ident: dict[int, _LThread] = {}
+        self.log: list[tuple] = []
+        self.failure: str | None = None
+        self.abort = False
+        self.steps = 0
+        self._main_ev = threading.Event()
+
+    # -- thread identity --
+
+    def _current(self) -> _LThread | None:
+        return self.by_ident.get(threading.get_ident())
+
+    def _runnable(self) -> list[_LThread]:
+        return [t for t in self.threads if t.state == "ready"]
+
+    # -- decision points --
+
+    def _choice(self, n: int, site: str) -> int:
+        lt = self._current()
+        if self.abort:
+            raise _Abort
+        idx = self.strategy.choice(n)
+        self.log.append((lt.name if lt else "?", f"{site}[{idx}/{n}]"))
+        return idx
+
+    def _yield_point(self, site: str) -> None:
+        lt = self._current()
+        if lt is None:
+            return  # a non-logical thread wandered in: never gate it
+        if self.abort:
+            raise _Abort
+        self.steps += 1
+        if self.steps > self.MAX_STEPS:
+            self._fail(f"livelock: schedule exceeded {self.MAX_STEPS} "
+                       "yield points")
+            self._abort_all()
+            raise _Abort
+        self.log.append((lt.name, site))
+        runnable = self._runnable() + [lt]
+        nxt = self.strategy.pick(lt, runnable)
+        if nxt is lt:
+            return
+        lt.state = "ready"
+        self._hand_token(nxt)
+        self._wait_token(lt)
+
+    def _block(self, lt: _LThread, lock) -> None:
+        """Current thread cannot proceed until `lock` frees."""
+        lt.state = "blocked"
+        lt.waiting_on = lock
+        runnable = self._runnable()
+        if not runnable:
+            self._fail(
+                "deadlock: all live threads blocked on cooperative locks "
+                f"({', '.join(t.name for t in self.threads if t.state == 'blocked')})")
+            self._abort_all()
+            raise _Abort
+        nxt = self.strategy.pick(None, runnable)
+        self._hand_token(nxt)
+        self._wait_token(lt)
+
+    def _unblock_waiters(self, lock) -> None:
+        for t in self.threads:
+            if t.state == "blocked" and t.waiting_on is lock:
+                t.state = "ready"
+                t.waiting_on = None
+
+    def _hand_token(self, nxt: _LThread) -> None:
+        nxt.state = "running"
+        nxt.waiting_on = None
+        nxt.ev.set()
+
+    def _wait_token(self, lt: _LThread) -> None:
+        lt.ev.wait()
+        lt.ev.clear()
+        if self.abort:
+            raise _Abort
+        lt.state = "running"
+
+    def _fail(self, msg: str) -> None:
+        if self.failure is None:
+            self.failure = msg
+
+    def _abort_all(self) -> None:
+        self.abort = True
+        for t in self.threads:
+            if t.state != "done":
+                t.ev.set()
+        self._main_ev.set()
+
+    # -- thread lifecycle --
+
+    def _thread_body(self, lt: _LThread) -> None:
+        lt.ev.wait()
+        lt.ev.clear()
+        if not self.abort:
+            lt.state = "running"
+            try:
+                lt.fn()
+            except _Abort:
+                pass
+            except BaseException as e:  # noqa: BLE001 — the violation class
+                lt.exc = e
+                self._fail(f"thread {lt.name!r} died: {type(e).__name__}: "
+                           f"{e}")
+                self._abort_all()
+        lt.state = "done"
+        self._on_thread_done(lt)
+
+    def _on_thread_done(self, lt: _LThread) -> None:
+        if self.abort:
+            if all(t.state == "done" for t in self.threads):
+                self._main_ev.set()
+            return
+        runnable = self._runnable()
+        if runnable:
+            nxt = self.strategy.pick(None, runnable)
+            self._hand_token(nxt)
+            return
+        blocked = [t for t in self.threads if t.state == "blocked"]
+        if blocked:
+            self._fail("deadlock: "
+                       + ", ".join(t.name for t in blocked)
+                       + " blocked with no runnable thread left")
+            self._abort_all()
+            return
+        self._main_ev.set()  # everything done
+
+    # -- one schedule --
+
+    def run(self, build) -> str | None:
+        """Execute one schedule of `build(api)`; returns the violation
+        message or None."""
+        try:
+            from ray_tpu.core import chaos
+        except ImportError:  # product tree absent: explicit points only
+            chaos = None
+        api = Api(self)
+        prog = build(api)
+        check = prog.get("check")
+        cleanup = prog.get("cleanup")
+        for name, fn in prog["threads"]:
+            lt = _LThread(name, fn)
+            self.threads.append(lt)
+        self.strategy.begin_run(self.threads)
+        old_hook = (chaos.set_schedule_hook(self._yield_point)
+                    if chaos is not None else None)
+        try:
+            for lt in self.threads:
+                lt.real = threading.Thread(
+                    target=self._thread_body, args=(lt,), daemon=True,
+                    name=f"racecheck-{lt.name}")
+                lt.real.start()
+                self.by_ident[lt.real.ident] = lt
+            first = self.strategy.pick(None, self._runnable())
+            self._hand_token(first)
+            if not self._main_ev.wait(timeout=60):
+                self._fail("hung schedule: a logical thread blocked in a "
+                           "real (non-cooperative) call")
+                self._abort_all()
+            for lt in self.threads:
+                lt.real.join(timeout=5)
+        finally:
+            if chaos is not None:
+                chaos.set_schedule_hook(old_hook)
+        if self.failure is None and check is not None:
+            try:
+                check()
+            except AssertionError as e:
+                self._fail(f"invariant violated: {e}")
+            except Violation as e:
+                self._fail(str(e))
+        if cleanup is not None:
+            try:
+                cleanup()
+            except Exception:  # noqa: BLE001 — cleanup is best-effort
+                pass
+        return self.failure
+
+    def render_trace(self, limit: int = 80) -> str:
+        tail = self.log[-limit:]
+        lines = [f"  {name} @ {site}" for name, site in tail]
+        if len(self.log) > limit:
+            lines.insert(0, f"  ... ({len(self.log) - limit} earlier "
+                            "points elided)")
+        return "\n".join(lines)
+
+
+# ---------------- the exploration driver ----------------
+
+
+class ExploreResult:
+    def __init__(self):
+        self.violation: str | None = None
+        self.schedule: str | None = None   # strategy state that found it
+        self.trace: str = ""
+        self.schedules = 0
+        self.exhaustive_complete = False
+
+    def __repr__(self):
+        s = "clean" if self.violation is None else "VIOLATION"
+        return (f"<ExploreResult {s} schedules={self.schedules} "
+                f"complete={self.exhaustive_complete}>")
+
+
+def explore(build, *, seed: int = 0, max_preemptions: int = 2,
+            max_schedules: int = 20_000, budget_s: float | None = None,
+            pct_schedules: int = 128, pct_depth: int = 3) -> ExploreResult:
+    """Bounded exhaustive pass first (complete for small models), then
+    PCT seeds with whatever budget remains. Deterministic for a given
+    (model, seed, bounds): wall-budget exhaustion can only truncate the
+    tail of the search, never reorder it, so the first violation found is
+    stable across runs that get at least that far."""
+    deadline = None if budget_s is None else time.monotonic() + budget_s
+    res = ExploreResult()
+
+    def out_of_budget() -> bool:
+        return deadline is not None and time.monotonic() >= deadline
+
+    ex = ExhaustiveStrategy(max_preemptions=max_preemptions)
+    while res.schedules < max_schedules and not out_of_budget():
+        sched = Scheduler(ex)
+        failure = sched.run(build)
+        res.schedules += 1
+        if failure is not None:
+            res.violation = failure
+            res.schedule = ex.state_repr()
+            res.trace = sched.render_trace()
+            return res
+        if not ex.next_run():
+            res.exhaustive_complete = True
+            break
+    if res.exhaustive_complete:
+        return res
+    # Exhaustive truncated (bound/budget/cap): sweep PCT seeds on top.
+    for i in range(pct_schedules):
+        if out_of_budget():
+            break
+        pct = PCTStrategy(seed=seed * 10_007 + i, depth=pct_depth)
+        sched = Scheduler(pct)
+        failure = sched.run(build)
+        res.schedules += 1
+        if failure is not None:
+            res.violation = failure
+            res.schedule = pct.state_repr()
+            res.trace = sched.render_trace()
+            return res
+    return res

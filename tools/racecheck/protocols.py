@@ -1,0 +1,540 @@
+"""The REAL protocol cores under the interleaving explorer.
+
+Each model binds the SHIPPED methods of a distributed protocol core onto
+a harness object (`types.MethodType` — the decision logic that runs in
+production is byte-for-byte what the explorer schedules), swaps its locks
+for CooperativeLocks, stubs only the transport/effect edges (socket
+sends, remote calls), and asserts the protocol's machine-checked
+invariant over every explored interleaving:
+
+  lease_return      Runtime._on_lease_return + _on_lease_spilled +
+                    _find/_pop_lease_locked: the spill-to-dead-peer race
+                    (head requeue vs origin agent's lease_return
+                    fallback) enqueues EXACTLY ONCE per (task_id,
+                    lease_seq) and releases the reservation token
+                    exactly once — the PR 2 duplicate-execution bug's
+                    fixed shape.
+  lease_dedup       NodeAgent._lease_dup_locked: a head re-drive racing
+                    the original grant delivery queues the lease once.
+  store_reserve     the real shm store's write-reservation plane
+                    (SharedMemoryStore._reserved_create / seal /
+                    release_reservation / reclaim_orphans on a private
+                    arena): no double-release of reservation extents,
+                    rsv_unused returns to zero, every sealed object
+                    readable — under concurrent writers, mid-flight
+                    releases and liveness sweeps.
+  ckpt_two_phase    train/checkpoint.py's atomic layout + the REAL
+                    TorchTrainer._commit_if_ready: the latest committed
+                    manifest never regresses and a torn directory is
+                    never resumable, across rank deaths before ack,
+                    manifest loss, and controller raise — the PR 9
+                    lost-commit bug's fixed shape.
+  stream_resume     llm/serve.py's _DisaggServerImpl admission +
+                    _stream_tokens recovery cursor (real _admit /
+                    _release / _run_admitted / _stream_tokens): token
+                    positions are delivered exactly once across decode
+                    replica death at every chunk boundary, and the
+                    admission ledger drains to zero.
+
+`run_all` splits the exploration budget across models; every violation
+renders as one `interleaving-violation` Finding anchored at the module
+that owns the core. These are hard failures — there is no baseline for a
+protocol that loses a commit under some schedule.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import types
+
+from tools.checklib import Finding
+from tools.racecheck.interleave import explore
+
+MODELS = {}
+
+
+def model(name, path):
+    def deco(fn):
+        MODELS[name] = (fn, path)
+        return fn
+    return deco
+
+
+# ---------------- lease protocol (runtime head side) ----------------
+
+
+def _mk_spec(task_id: bytes, lease_seq: int, spill_hops: int = 0):
+    from ray_tpu.core.task import TaskSpec
+    spec = TaskSpec.__new__(TaskSpec)
+    for s in TaskSpec.__slots__:
+        try:
+            setattr(spec, s, None)
+        except AttributeError:
+            pass
+    spec.task_id = task_id
+    spec.name = "racecheck"
+    spec.lease_seq = lease_seq
+    spec.spill_hops = spill_hops
+    spec.max_retries = 3
+    spec.retries_left = 3
+    return spec
+
+
+def _mk_head(api):
+    """A harness head running the REAL lease bookkeeping methods."""
+    from ray_tpu.core.runtime import NodeState, Runtime
+    head = types.SimpleNamespace()
+    head.lock = api.lock(name="head.lock")
+    head.nodes = {}
+    head._reservations = {}
+    head.lease_spills_total = 0
+    head.enqueued = []          # (task_id, lease_seq) of every requeue
+    head.released = []          # tokens released
+    head.task_events = types.SimpleNamespace(record=lambda *a, **k: None)
+    # REAL protocol methods — the code under test.
+    for name in ("_on_lease_return", "_on_lease_spilled",
+                 "_find_lease_locked", "_pop_lease_locked"):
+        setattr(head, name, types.MethodType(getattr(Runtime, name), head))
+    # Effect edges, stubbed to count.
+    head._release_token = lambda tok: (
+        head.released.append(tok) if tok else None)
+
+    def _enqueue_task_locked(spec, front=False):
+        head.enqueued.append((spec.task_id, spec.lease_seq or 0))
+        return True
+    head._enqueue_task_locked = _enqueue_task_locked
+    head._schedule = lambda: None
+
+    def _on_lease_fail(nid, specs):
+        # The dead-dest requeue path of _on_lease_spilled: same effect
+        # shape as the real one — pop the reservation, requeue. (The
+        # real method's retry accounting is out of scope here.)
+        with head.lock:
+            for spec in specs:
+                head._release_token(
+                    head._reservations.pop(spec.task_id, None))
+                head._enqueue_task_locked(spec, front=True)
+    head._on_lease_fail = _on_lease_fail
+
+    def add_node(nid: bytes):
+        n = NodeState(nid, {"CPU": 4.0}, None)
+        head.nodes[nid] = n
+        return n
+    head.add_node = add_node
+    return head
+
+
+@model("lease_return", "ray_tpu/core/runtime.py")
+def build_lease_return(api):
+    """PR 2's fixed race, on the real methods: lease spilled A->B, B dies;
+    the head's dead-dest requeue races the origin agent's lease_return
+    fallback. Exactly one requeue, one token release — in EVERY order."""
+    head = _mk_head(api)
+    node_a = head.add_node(b"A")
+    tid = b"T1"
+    spec = _mk_spec(tid, lease_seq=1)
+    node_a.leases[tid] = spec
+    head._reservations[tid] = ("node", b"A", {"CPU": 1.0})
+
+    def spilled_notice():
+        api.point("head.lease_spilled.arrive")
+        # B is not in head.nodes => dest dead => requeue path
+        head._on_lease_spilled(b"A", [(tid, 1, 1, b"B")])
+
+    def return_fallback():
+        api.point("head.lease_return.arrive")
+        head._on_lease_return(b"A", [_mk_spec(tid, lease_seq=1,
+                                              spill_hops=1)])
+
+    def check():
+        assert len(head.enqueued) == 1, (
+            f"duplicate execution: task requeued {len(head.enqueued)}x "
+            f"({head.enqueued})")
+        assert len(head.released) == 1, (
+            f"reservation token released {len(head.released)}x")
+
+    return {"threads": [("spill_notice", spilled_notice),
+                        ("lease_return", return_fallback)],
+            "check": check}
+
+
+@model("lease_dedup", "ray_tpu/core/node_agent.py")
+def build_lease_dedup(api):
+    """Head re-drive racing the original grant delivery: the agent's
+    (task_id, lease_seq) seen-set accepts exactly one copy; a RE-GRANT
+    (bumped lease_seq) must still pass."""
+    import collections
+    from ray_tpu.core.node_agent import NodeAgent
+    agent = types.SimpleNamespace()
+    agent._lease_lock = api.lock(name="agent._lease_lock")
+    agent._lease_seen = collections.OrderedDict()
+    agent._lease_q = []
+    agent._lease_dup_locked = types.MethodType(
+        NodeAgent._lease_dup_locked, agent)
+
+    def deliver(tag, seq):
+        def fn():
+            api.point(f"agent.grant.{tag}")
+            spec = _mk_spec(b"T1", lease_seq=seq)
+            with agent._lease_lock:
+                if not agent._lease_dup_locked(spec):
+                    agent._lease_q.append(spec)
+        return fn
+
+    def check():
+        seqs = [s.lease_seq for s in agent._lease_q]
+        assert sorted(seqs) == [1, 2], (
+            f"dedup broke: queued lease_seqs {seqs} (want one seq-1 copy "
+            "dropped, the seq-2 re-grant kept)")
+
+    return {"threads": [("grant", deliver("orig", 1)),
+                        ("redrive", deliver("redrive", 1)),
+                        ("regrant", deliver("regrant", 2))],
+            "check": check}
+
+
+# ---------------- store write-reservation plane ----------------
+
+
+@model("store_reserve", "ray_tpu/core/object_store.py")
+def build_store_reserve(api):
+    """The real native store's reservation protocol, Python seams under
+    the scheduler (carve / bump-fill / publish / tail release / liveness
+    sweep). Native calls are atomic steps; the interleavings explored are
+    exactly the ones the _rsv_lock plane can produce."""
+    from ray_tpu.core.ids import ObjectID
+    from ray_tpu.core.object_store import SharedMemoryStore
+
+    path = os.path.join(
+        tempfile.gettempdir(),
+        f"rtpu_racecheck_{os.getpid()}_{next(_STORE_SEQ)}")
+    store = SharedMemoryStore(path, size=4 << 20, num_slots=64,
+                              create=True, num_shards=2)
+    store.reservation_min_bytes = 1 << 10
+    store.reservation_chunk_bytes = 64 << 10
+    store._rsv_lock = api.lock(name="store._rsv_lock")
+    sealed = []
+
+    def writer(tag, n_objs):
+        def fn():
+            for i in range(n_objs):
+                oid = ObjectID((tag + bytes([i])).ljust(16, b"\0"))
+                api.point(f"store.put.{tag!r}.{i}")
+                buf = store._acquire_buffer(oid, 4 << 10)
+                buf.data[:4] = b"\xaa\xbb\xcc\xdd"
+                if api.fired(f"store.abort.{tag!r}.{i}"):
+                    buf.abort()   # abandoned put: chunk must free ONCE
+                    continue
+                buf.seal()
+                sealed.append(oid)
+        return fn
+
+    def releaser():
+        api.point("store.release_reservation")
+        store.release_reservation()
+
+    def sweeper():
+        api.point("store.reclaim")
+        # Live-owner safety: this process is alive, so the sweep may
+        # reclaim NOTHING of the in-flight reservations.
+        store.reclaim_orphans()
+
+    def check():
+        store.release_reservation()
+        assert store.rsv_unused() == 0, (
+            f"rsv_unused={store.rsv_unused()} after all tails "
+            "released — a tail leaked or double-released")
+        for oid in sealed:
+            data, _meta = store.get_raw(oid, timeout=0)
+            assert bytes(data[:4]) == b"\xaa\xbb\xcc\xdd", (
+                f"sealed object {oid} unreadable after storm")
+            store.release(oid)
+        st = store.stats()
+        assert st["num_objects"] == len(sealed), (
+            f"{st['num_objects']} objects vs {len(sealed)} seals")
+
+    def cleanup():
+        store.close()
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+    return {"threads": [("writer_a", writer(b"A", 2)),
+                        ("writer_b", writer(b"B", 2)),
+                        ("releaser", releaser),
+                        ("sweeper", sweeper)],
+            "check": check, "cleanup": cleanup}
+
+
+def _counter():
+    i = 0
+    while True:
+        yield i
+        i += 1
+
+
+_STORE_SEQ = _counter()
+
+
+# ---------------- two-phase checkpoint commit ----------------
+
+
+@model("ckpt_two_phase", "ray_tpu/train/checkpoint.py")
+def build_ckpt_two_phase(api):
+    """Real shard writes + real manifest commit (trainer._commit_if_ready)
+    under rank death, manifest loss and a controller raise: the latest
+    committed manifest never regresses, a torn dir is never resumable,
+    and a commit that HAPPENED survives the controller's raise (PR 9)."""
+    from ray_tpu.train import checkpoint as ckpt_mod
+    from ray_tpu.train.trainer import _PendingCommit, JaxTrainer
+
+    root = tempfile.mkdtemp(prefix="racecheck_ckpt_",
+                            dir="/dev/shm" if os.path.isdir("/dev/shm")
+                            else None)
+    step = 7
+    world = 2
+    ckpt_dir = ckpt_mod.step_dir(root, step)
+    acks_lock = api.lock(name="acks_lock")
+    acks: dict[int, str] = {}
+
+    ctl = types.SimpleNamespace()
+    ctl._latest_committed = None
+    ctl._ckpt_mgr = ckpt_mod.CheckpointManager(root, keep=2)
+    ctl._commit_if_ready = types.MethodType(
+        JaxTrainer._commit_if_ready, ctl)
+    ctl.raised = False
+    ctl.committed_before_raise = None
+
+    def rank(r):
+        def fn():
+            api.point(f"rank{r}.step")
+            name = ckpt_mod.write_shard({"rank": r, "step": step},
+                                        ckpt_dir, r, world)
+            api.point(f"rank{r}.durable")
+            if api.fired(f"rank{r}.die_before_ack"):
+                return  # the train.ckpt_shard_abandon window
+            with acks_lock:
+                acks[r] = name
+        return fn
+
+    def controller():
+        pc = _PendingCommit(step, world)
+        for _ in range(12):
+            api.point("ctl.poll")
+            with acks_lock:
+                for r, name in acks.items():
+                    pc.acks.add(r)
+                    pc.shards[r] = name
+            if ctl._commit_if_ready(pc, ckpt_dir, {}):
+                # The PR 9 contract: the advance lands on the controller
+                # IMMEDIATELY, so a raise below cannot lose it.
+                ctl._latest_committed = ckpt_dir
+                ctl.committed_before_raise = ckpt_dir
+                break
+            if api.fired("ctl.worker_death_raises"):
+                # A dead rank raises out of the poll loop — fit()'s
+                # FailurePolicy catches and restarts from
+                # self._latest_committed.
+                ctl.raised = True
+                return
+        return
+
+    def check():
+        # Restart-time recovery: exactly what fit() does.
+        ckpt_mod.gc_uncommitted(root)
+        latest = ckpt_mod.latest_committed(root)
+        if ctl.committed_before_raise is not None:
+            assert ctl._latest_committed == ckpt_dir, (
+                "commit advance lost on the controller (the PR 9 "
+                "lost-commit shape)")
+            assert latest == ckpt_dir, (
+                f"committed step invisible after restart: {latest}")
+            m = ckpt_mod.load_manifest(latest)
+            assert m["world_size"] == world and len(m["shards"]) == world
+            for r in range(world):
+                d = ckpt_mod.Checkpoint(latest).load_shard(r)
+                assert d == {"rank": r, "step": step}
+        else:
+            assert latest is None, (
+                f"uncommitted dir resumable after gc: {latest}")
+            assert not os.path.exists(ckpt_dir), (
+                "torn checkpoint dir survived gc_uncommitted")
+
+    def cleanup():
+        import shutil
+        shutil.rmtree(root, ignore_errors=True)
+
+    return {"threads": [("rank0", rank(0)), ("rank1", rank(1)),
+                        ("controller", controller)],
+            "check": check, "cleanup": cleanup}
+
+
+# ---------------- serve stream-resume cursor ----------------
+
+
+class _NoSleepBackoff:
+    """Deterministic Backoff stand-in for the model: pacing is not the
+    protocol under test, and real jittered sleeps would make schedules
+    wall-time-dependent."""
+
+    def __init__(self, *a, **k):
+        self.left = 8
+
+    def sleep(self):
+        self.left -= 1
+        return self.left > 0
+
+    def reset(self):
+        self.left = 8
+
+    def expired(self):
+        return self.left <= 0
+
+
+@model("stream_resume", "ray_tpu/llm/serve.py")
+def build_stream_resume(api):
+    """Two concurrent streams through the REAL coordinator admission +
+    recovery cursor, with a fake decode replica that honors
+    decode_stream's contract (yields positions after `generated`) and can
+    die at any chunk boundary: every position delivered exactly once,
+    and the admission ledger drains to zero."""
+    import collections
+
+    from ray_tpu.core.status import RayTpuError
+    from ray_tpu.llm import serve as serve_mod
+
+    scripts = {"s1": [11, 12, 13, 14], "s2": [21, 22, 23]}
+
+    coord = types.SimpleNamespace()
+    coord.d = serve_mod.DisaggConfig(
+        max_prefill_queue_tokens=1 << 20,
+        max_decode_inflight_tokens=1 << 20,
+        max_ongoing_requests=16, stream_chunk_tokens=2,
+        handoff=False, dispatch_deadline_s=5.0, resume_deadline_s=5.0)
+    coord._lock = api.lock(name="coord._lock")
+    coord._prefill_queue_tokens = 0
+    coord._decode_inflight_tokens = 0
+    coord._ongoing = 0
+    coord._tok_rate_ema = 0.0
+    coord._replica_load = {}
+    coord._route_cache = {}
+    coord._eos = -1
+    coord.counters = collections.Counter()
+    # REAL coordinator methods — the code under test.
+    for name in ("_admit", "_release", "_release_prefill",
+                 "_stream_tokens", "_run_admitted", "_unload"):
+        setattr(coord, name,
+                types.MethodType(
+                    getattr(serve_mod._DisaggServerImpl, name), coord))
+    coord._rep_id = serve_mod._DisaggServerImpl._rep_id  # staticmethod
+    # Transport/effect stubs.
+    coord._note_decode_failure = lambda rep, exc: None
+
+    def _dispatch_decode(ids, cost):
+        with coord._lock:
+            coord._replica_load["rep"] = (
+                coord._replica_load.get("rep", 0) + cost)
+        return "rep"
+    coord._dispatch_decode = _dispatch_decode
+
+    def _prefill_with_retry(ids, temperature, top_p, top_k):
+        script = scripts[bytes(ids).decode()]
+        api.point("serve.prefill")
+        return {"first": script[0], "kv": None, "kv_tokens": 0}
+    coord._prefill_with_retry = _prefill_with_retry
+
+    # Bounded faults (standard for schedule exploration): at most two
+    # replica deaths per stream. Unbounded deaths exhaust the resume
+    # deadline and the stream RIGHTFULLY errors out — by-design behavior,
+    # not the exactly-once property under test.
+    kills = {k: 0 for k in scripts}
+
+    def _open_decode_stream(rep, ids, generated, kv, max_new,
+                            temperature, top_p, top_k):
+        key = bytes(ids).decode()
+        script = scripts[key]
+        pos = len(generated)
+        assert pos >= 1, "resume cursor lost the prefill token"
+        while pos < len(script):
+            chunk = script[pos:pos + coord.d.stream_chunk_tokens]
+            # Mirror the shipped chaos.kill placement: the replica dies
+            # BEFORE the chunk reaches the consumer, taking it along.
+            if kills[key] < 2 and api.fired("serve.decode.kill"):
+                kills[key] += 1
+                raise RayTpuError("decode replica died mid-stream")
+            yield chunk
+            pos += len(chunk)
+    coord._open_decode_stream = _open_decode_stream
+
+    results = {}
+
+    def stream(key):
+        def fn():
+            script = scripts[key]
+            ids = list(key.encode())
+            cost = coord._admit(len(ids), len(script))
+            toks = coord._run_admitted(ids, len(script), None, 1.0, 0,
+                                       cost)
+            results[key] = toks
+        return fn
+
+    real_backoff = serve_mod.Backoff
+    serve_mod.Backoff = _NoSleepBackoff
+
+    def cleanup():
+        serve_mod.Backoff = real_backoff
+
+    def check():
+        for key, script in scripts.items():
+            assert results.get(key) == script, (
+                f"stream {key}: delivered {results.get(key)} != {script} "
+                "(re-emitted or skipped positions across replica death)")
+        assert coord._ongoing == 0, f"_ongoing={coord._ongoing} leaked"
+        assert coord._decode_inflight_tokens == 0, (
+            f"decode budget leaked: {coord._decode_inflight_tokens}")
+        assert coord._prefill_queue_tokens == 0, (
+            f"prefill budget leaked: {coord._prefill_queue_tokens}")
+
+    return {"threads": [("stream_s1", stream("s1")),
+                        ("stream_s2", stream("s2"))],
+            "check": check, "cleanup": cleanup}
+
+
+# ---------------- driver ----------------
+
+
+# Per-model exploration caps: the store/ckpt models do real (tmpfs) I/O
+# per schedule, so their schedule counts stay low; the in-memory lease
+# and cursor models can afford full bounded-exhaustive sweeps.
+_CAPS = {
+    "lease_return": dict(max_schedules=4000, pct_schedules=32),
+    "lease_dedup": dict(max_schedules=4000, pct_schedules=32),
+    "store_reserve": dict(max_schedules=250, pct_schedules=12,
+                          max_preemptions=1),
+    "ckpt_two_phase": dict(max_schedules=400, pct_schedules=16,
+                           max_preemptions=1),
+    "stream_resume": dict(max_schedules=2500, pct_schedules=24),
+}
+
+
+def run_all(budget_s: float, seed: int = 0,
+            names: tuple | None = None) -> list[Finding]:
+    """Split the budget across models; one Finding per violation."""
+    todo = [(n, MODELS[n]) for n in (names or MODELS) if n in MODELS]
+    if not todo:
+        return []
+    per = max(budget_s / len(todo), 0.5)
+    findings: list[Finding] = []
+    for name, (build, path) in todo:
+        caps = _CAPS.get(name, {})
+        res = explore(build, seed=seed, budget_s=per, **caps)
+        if res.violation is not None:
+            findings.append(Finding(
+                "interleaving-violation", path, 0,
+                f"{name}: {res.violation} [schedule {res.schedule}, "
+                f"after {res.schedules} schedules]",
+                message=f"{name}: {res.violation}\n  schedule: "
+                        f"{res.schedule}\n  trace:\n{res.trace}"))
+    return findings

@@ -41,8 +41,9 @@ def main(argv=None) -> int:
                         "wire_drift is skipped)")
     p.add_argument("--all", action="store_true",
                    help="also run tools.graphcheck (lowered-XLA-graph "
-                        "gates); exit nonzero if EITHER plane reports "
-                        "new findings")
+                        "gates) and tools.racecheck (thread-escape + "
+                        "interleaving model checking); exit nonzero if "
+                        "ANY plane reports new findings")
     args = p.parse_args(argv)
 
     passes = tuple(s for s in args.passes.split(",") if s)
@@ -84,6 +85,12 @@ def main(argv=None) -> int:
         grc = graph_main(["--root", args.root]
                          + (["--no-baseline"] if args.no_baseline else []))
         rc = max(rc, grc)
+        print("--- racecheck (concurrency-semantics plane) ---",
+              file=sys.stderr)
+        from tools.racecheck.__main__ import main as race_main
+        rrc = race_main(["--root", args.root]
+                        + (["--no-baseline"] if args.no_baseline else []))
+        rc = max(rc, rrc)
     return rc
 
 
