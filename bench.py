@@ -140,7 +140,7 @@ def final_line(status: str = "complete"):
         "wall_s": round(time.monotonic() - _T0, 1),
         "host": EXTRAS.get("host", {}),
         "many_nodes_scaling": EXTRAS.get("many_nodes_scaling", {}),
-        "native_sched_ab": EXTRAS.get("native_sched_ab", {}),
+        "native_head_ab": EXTRAS.get("native_head_ab", {}),
         "adag_pipeline": EXTRAS.get("adag_pipeline", {}),
         "task_events": EXTRAS.get("task_events", {}),
         "cross_language": EXTRAS.get("cross_language", {}),
@@ -207,6 +207,11 @@ def final_line(status: str = "complete"):
             "disagg_kill", {}).get("p99_ms"),
         "serve_drop": EXTRAS.get("serve_storm", {}).get(
             "disagg_kill", {}).get("dropped"),
+        # Native head core (PR 14): best-of tasks-per-head-CPU-second
+        # with the head core ON from the counterbalanced A/B — the
+        # acceptance metric's headline copy (full samples in BENCH_OUT).
+        "tphc_s": EXTRAS.get("native_head_ab", {}).get(
+            "best", {}).get("on", {}).get("tasks_per_head_cpu_s"),
         "tev_ovh_pct": EXTRAS.get("task_events", {}).get("overhead_pct"),
         "xlang_s": EXTRAS.get("cross_language", {}).get(
             "cpp_tasks_async_s"),
@@ -903,11 +908,14 @@ def _main_inner():
         }
         emit("many_nodes_tasks_s", float(rate))
 
-        # Native A/B (sidecar only): the SAME workload with the C++
-        # select-round core on vs off. COUNTERBALANCED on-off-off-on (the
-        # PR 4 lesson: naive A-then-B cluster pairs read machine drift as
-        # signal — this box swings several-fold run to run under 33
-        # processes), best-of per mode reported alongside every sample.
+        # Native-HEAD A/B (sidecar only): the SAME workload with the C++
+        # head core (PR 14) on vs off — native_sched (the agent half)
+        # stays ON in both modes, so the delta isolates the head's
+        # listener/ledger/grant port (the r07 A/B already isolated the
+        # agent half). COUNTERBALANCED on-off-off-on (the PR 4 lesson:
+        # naive A-then-B cluster pairs read machine drift as signal —
+        # this box swings several-fold run to run under 33 processes),
+        # best-of per mode reported alongside every sample.
         try:
             samples = {"on": [{"tasks_s": round(float(rate), 1),
                                "head_cpu_s": float(head_cpu),
@@ -918,12 +926,12 @@ def _main_inner():
                 if ab_budget < 90:
                     break
                 if mode == "off":
-                    os.environ["RAY_TPU_NATIVE_SCHED"] = "0"
+                    os.environ["RAY_TPU_NATIVE_HEAD"] = "0"
                 try:
                     out_ab = run_sub(code, timeout=ab_budget,
-                                     tag=f"many_agents_native_{mode}")
+                                     tag=f"many_agents_nhead_{mode}")
                 finally:
-                    os.environ.pop("RAY_TPU_NATIVE_SCHED", None)
+                    os.environ.pop("RAY_TPU_NATIVE_HEAD", None)
                 line = [ln for ln in out_ab.splitlines()
                         if ln.startswith("RATE")][0]
                 _, r_s, _u, hc, pc, _sp = line.split()
@@ -933,16 +941,18 @@ def _main_inner():
                      "tasks_per_head_cpu_s": float(pc)})
             best = {m: max(s, key=lambda r: r["tasks_s"])
                     for m, s in samples.items() if s}
-            EXTRAS["native_sched_ab"] = {
+            EXTRAS["native_head_ab"] = {
                 "workload": f"run_many_agents(n_agents={n_agents}, "
                             "n_tasks=1500)",
                 "order": "on off off on (counterbalanced)",
+                "note": "native_sched ON in both modes; off = "
+                        "RAY_TPU_NATIVE_HEAD=0 (pure-Python listener)",
                 "best": best,
                 "samples": samples,
             }
         except Exception as e:  # noqa: BLE001 — A/B is informational
-            EXTRAS["native_sched_ab"] = {"error": str(e)[:300],
-                                         "samples": samples}
+            EXTRAS["native_head_ab"] = {"error": str(e)[:300],
+                                        "samples": samples}
 
     def sec_chaos():
         # Chaos storm (core/chaos.py): the same retryable task storm run

@@ -1,352 +1,36 @@
-// Native select-round core for the scheduling hot loop (the raylet-split's
-// C++ half). Owns, per agent process:
+// Native select-round core for the AGENT's scheduling hot loop (the
+// raylet-split's C++ half; the head's sibling lives in head_core.cc and
+// the shared machinery — frame pump, restricted unpickler, native
+// pickle writers, AgentFrame tag sniffer — in frame_core.h). Owns, per
+// agent process:
 //
-//   * the FRAME PUMP — epoll readiness, MSG_DONTWAIT reads into per-connection
-//     buffers, outer-frame splitting (the <Q len><I nbufs>[<Q blen>...] framing
-//     of core/transport.py, proto-flag frames included), and a pickle-prefix
-//     sniffer that classifies each frame's op without a Python unpickle;
-//   * the LEASE LEDGER — the un-started lease queue (raw pickled spec bytes,
-//     carried opaque end to end), the (task_id, lease_seq) dedup table that
-//     makes head lease re-drives idempotent, per-worker load / sent-fn /
-//     eligibility bookkeeping, and the inflight map that worker-death replay
-//     drains;
-//   * the DISPATCH PLANNER — pops leases onto idle workers depth-K deep and
-//     builds the wire frames natively (hand-rolled pickle of the fixed
-//     ("reg_fn", fn, blob) / ("exec_raw", spec_bytes) shapes into per-worker
-//     outboxes, and the round's ("node_done_raw", whex, [raw frames]) batch
-//     toward the head) so the hot loop never pickles or unpickles in Python;
-//   * a RESTRICTED UNPICKLER — walks the C-pickler output of the few hot
-//     frame shapes (node_exec_raw ingest; done/done_batch task-id extraction)
-//     and BAILS to the Python path on any opcode outside its contract, so an
-//     unexpected payload is a slow frame, never a wrong one.
+//   * the FRAME PUMP — framecore::FramePump over the head link and
+//     every worker socket (raw mode for cpp workers);
+//   * the LEASE LEDGER — the un-started lease queue (raw pickled spec
+//     bytes, carried opaque end to end), the (task_id, lease_seq) dedup
+//     table that makes head lease re-drives idempotent, per-worker
+//     load / sent-fn / eligibility bookkeeping, and the inflight map
+//     that worker-death replay drains;
+//   * the DISPATCH PLANNER — pops leases onto idle workers depth-K deep
+//     and builds the wire frames natively (reg_fn / exec_raw into
+//     per-worker outboxes, the round's node_done_raw batch toward the
+//     head) so the hot loop never pickles or unpickles in Python.
 //
 // Python keeps policy and the actual socket writes: chaos sites, spill
-// decisions, worker spawn, and every send happen under the same Python locks
-// as the pure-Python path (ray_tpu/core/node_agent.py gates on `native_sched`).
-//
-// Wire-contract note (tools/staticcheck wire-drift): the outer framing and
-// AgentFrame oneof tags used by the proto sniffer below are cross-checked
-// against ray_tpu/protocol/raytpu.proto — see kAgentFrameTags.
+// decisions, worker spawn, and every send happen under the same Python
+// locks as the pure-Python path (ray_tpu/core/node_agent.py gates on
+// `native_sched`).
 
-#include <errno.h>
-#include <pthread.h>
-#include <stdint.h>
-#include <string.h>
-#include <sys/epoll.h>
-#include <sys/socket.h>
-#include <unistd.h>
+#include "frame_core.h"
 
 #include <deque>
 #include <string>
 #include <unordered_map>
 #include <vector>
 
+using namespace framecore;
+
 namespace {
-
-// ---- outer framing (must match core/transport.py) ----
-static const uint32_t PROTO_FLAG = 0x80000000u;
-
-// AgentFrame oneof field tags (ray_tpu/protocol/raytpu.proto). The pump
-// labels proto-framed control messages by their outermost tag so Python can
-// route without a trial decode; staticcheck pins these both ways against the
-// .proto. Wire type is always 2 (length-delimited submessage).
-struct AgentFrameTag { int field; const char* name; };
-static const AgentFrameTag kAgentFrameTags[] = {
-    {1, "register_node"}, {2, "heartbeat"}, {3, "node_ack"},
-    {4, "worker_death"}, {5, "spawn_worker"}, {6, "kill_worker"},
-    {7, "fetch"}, {8, "fetched"}, {9, "free_object"}, {10, "seq_skip"},
-    {11, "cluster_view"}, {12, "lease_spilled"}, {13, "task_events"},
-    {14, "metrics_update"},
-};
-
-// ---- pickle opcodes (protocol 5, CPython C pickler output) ----
-enum : uint8_t {
-  OP_PROTO = 0x80, OP_FRAME = 0x95, OP_STOP = '.',
-  OP_NONE = 'N', OP_NEWTRUE = 0x88, OP_NEWFALSE = 0x89,
-  OP_BININT = 'J', OP_BININT1 = 'K', OP_BININT2 = 'M', OP_LONG1 = 0x8a,
-  OP_BINFLOAT = 'G',
-  OP_SHORT_BINBYTES = 'C', OP_BINBYTES = 'B', OP_BINBYTES8 = 0x8e,
-  OP_SHORT_BINUNICODE = 0x8c, OP_BINUNICODE = 'X', OP_BINUNICODE8 = 0x8d,
-  OP_EMPTY_LIST = ']', OP_EMPTY_TUPLE = ')', OP_MARK = '(',
-  OP_TUPLE1 = 0x85, OP_TUPLE2 = 0x86, OP_TUPLE3 = 0x87, OP_TUPLE = 't',
-  OP_APPEND = 'a', OP_APPENDS = 'e',
-  OP_MEMOIZE = 0x94, OP_BINGET = 'h', OP_LONG_BINGET = 'j',
-  OP_NEXT_BUFFER = 0x97, OP_READONLY_BUFFER = 0x98,
-};
-
-struct PVal {
-  enum Kind { NONE, BOOL, INT, BYTES, STR, LIST, TUPLE, OPAQUE } kind;
-  int64_t i = 0;
-  const uint8_t* p = nullptr;  // BYTES/STR view into the frame buffer
-  uint64_t len = 0;
-  std::vector<int> items;      // LIST/TUPLE arena ids
-};
-
-// Restricted pickle walker: builds an arena of PVals (stack holds arena ids
-// so memo aliasing — a BINGET of a list later APPENDS-mutated — stays
-// correct). Returns the arena id of the root value, or -1 to bail.
-struct PickleWalk {
-  std::deque<PVal> arena;
-  std::vector<int> stack;
-  std::vector<int> marks;
-  std::vector<int> memo;
-
-  int push(PVal&& v) {
-    arena.emplace_back(std::move(v));
-    stack.push_back((int)arena.size() - 1);
-    return stack.back();
-  }
-
-  int parse(const uint8_t* d, uint64_t n) {
-    uint64_t i = 0;
-    while (i < n) {
-      uint8_t op = d[i++];
-      switch (op) {
-        case OP_PROTO: if (i + 1 > n) return -1; i += 1; break;
-        case OP_FRAME: if (i + 8 > n) return -1; i += 8; break;
-        case OP_NONE: push({PVal::NONE}); break;
-        case OP_NEWTRUE: { PVal v{PVal::BOOL}; v.i = 1; push(std::move(v)); break; }
-        case OP_NEWFALSE: { PVal v{PVal::BOOL}; v.i = 0; push(std::move(v)); break; }
-        case OP_BININT: {
-          if (i + 4 > n) return -1;
-          int32_t x; memcpy(&x, d + i, 4); i += 4;
-          PVal v{PVal::INT}; v.i = x; push(std::move(v)); break;
-        }
-        case OP_BININT1: {
-          if (i + 1 > n) return -1;
-          PVal v{PVal::INT}; v.i = d[i]; i += 1; push(std::move(v)); break;
-        }
-        case OP_BININT2: {
-          if (i + 2 > n) return -1;
-          uint16_t x; memcpy(&x, d + i, 2); i += 2;
-          PVal v{PVal::INT}; v.i = x; push(std::move(v)); break;
-        }
-        case OP_LONG1: {
-          if (i + 1 > n) return -1;
-          uint8_t k = d[i]; i += 1;
-          if (i + k > n || k > 8) return -1;
-          int64_t x = 0;
-          for (int b = 0; b < k; b++) x |= (int64_t)d[i + b] << (8 * b);
-          if (k && (d[i + k - 1] & 0x80))  // sign-extend
-            for (int b = k; b < 8; b++) x |= (int64_t)0xff << (8 * b);
-          i += k;
-          PVal v{PVal::INT}; v.i = x; push(std::move(v)); break;
-        }
-        case OP_BINFLOAT: {
-          if (i + 8 > n) return -1; i += 8;
-          push({PVal::OPAQUE}); break;
-        }
-        case OP_SHORT_BINBYTES: case OP_SHORT_BINUNICODE: {
-          if (i + 1 > n) return -1;
-          uint64_t k = d[i]; i += 1;
-          if (i + k > n) return -1;
-          PVal v{op == OP_SHORT_BINBYTES ? PVal::BYTES : PVal::STR};
-          v.p = d + i; v.len = k; i += k; push(std::move(v)); break;
-        }
-        case OP_BINBYTES: case OP_BINUNICODE: {
-          if (i + 4 > n) return -1;
-          uint32_t k; memcpy(&k, d + i, 4); i += 4;
-          if (i + k > n) return -1;
-          PVal v{op == OP_BINBYTES ? PVal::BYTES : PVal::STR};
-          v.p = d + i; v.len = k; i += k; push(std::move(v)); break;
-        }
-        case OP_BINBYTES8: case OP_BINUNICODE8: {
-          if (i + 8 > n) return -1;
-          uint64_t k; memcpy(&k, d + i, 8); i += 8;
-          if (k > n || i + k > n) return -1;
-          PVal v{op == OP_BINBYTES8 ? PVal::BYTES : PVal::STR};
-          v.p = d + i; v.len = k; i += k; push(std::move(v)); break;
-        }
-        case OP_EMPTY_LIST: push({PVal::LIST}); break;
-        case OP_EMPTY_TUPLE: push({PVal::TUPLE}); break;
-        case OP_MARK: marks.push_back((int)stack.size()); break;
-        case OP_APPEND: {
-          if (stack.size() < 2) return -1;
-          int it = stack.back(); stack.pop_back();
-          PVal& l = arena[stack.back()];
-          if (l.kind != PVal::LIST) return -1;
-          l.items.push_back(it); break;
-        }
-        case OP_APPENDS: {
-          if (marks.empty()) return -1;
-          int m = marks.back(); marks.pop_back();
-          if ((int)stack.size() < m || m < 1) return -1;
-          PVal& l = arena[stack[m - 1]];
-          if (l.kind != PVal::LIST) return -1;
-          for (int j = m; j < (int)stack.size(); j++) l.items.push_back(stack[j]);
-          stack.resize(m); break;
-        }
-        case OP_TUPLE1: case OP_TUPLE2: case OP_TUPLE3: {
-          int k = op - OP_TUPLE1 + 1;
-          if ((int)stack.size() < k) return -1;
-          PVal v{PVal::TUPLE};
-          v.items.assign(stack.end() - k, stack.end());
-          stack.resize(stack.size() - k);
-          push(std::move(v)); break;
-        }
-        case OP_TUPLE: {
-          if (marks.empty()) return -1;
-          int m = marks.back(); marks.pop_back();
-          if ((int)stack.size() < m) return -1;
-          PVal v{PVal::TUPLE};
-          v.items.assign(stack.begin() + m, stack.end());
-          stack.resize(m);
-          push(std::move(v)); break;
-        }
-        case OP_MEMOIZE:
-          if (stack.empty()) return -1;
-          memo.push_back(stack.back()); break;
-        case OP_BINGET: {
-          if (i + 1 > n) return -1;
-          uint8_t k = d[i]; i += 1;
-          if (k >= memo.size()) return -1;
-          stack.push_back(memo[k]); break;
-        }
-        case OP_LONG_BINGET: {
-          if (i + 4 > n) return -1;
-          uint32_t k; memcpy(&k, d + i, 4); i += 4;
-          if (k >= memo.size()) return -1;
-          stack.push_back(memo[k]); break;
-        }
-        case OP_NEXT_BUFFER: push({PVal::OPAQUE}); break;
-        case OP_READONLY_BUFFER: break;  // wraps top in place
-        case OP_STOP:
-          if (stack.size() != 1) return -1;
-          return stack.back();
-        default:
-          return -1;  // outside the contract: Python owns this frame
-      }
-    }
-    return -1;
-  }
-};
-
-// Cheap op sniff: the first string literal pushed in a C-pickled tuple
-// ("op", ...) is the op. Returns length of op copied into out (0 = unknown).
-static int sniff_op(const uint8_t* d, uint64_t n, char* out, int cap) {
-  uint64_t i = 0;
-  if (i + 2 <= n && d[i] == OP_PROTO) i += 2;
-  if (i + 9 <= n && d[i] == OP_FRAME) i += 9;
-  while (i < n && d[i] == OP_MARK) i += 1;  // 4+-tuples open with MARK
-  if (i >= n) return 0;
-  uint64_t k = 0;
-  if (d[i] == OP_SHORT_BINUNICODE) {
-    if (i + 2 > n) return 0;
-    k = d[i + 1]; i += 2;
-  } else if (d[i] == OP_BINUNICODE) {
-    if (i + 5 > n) return 0;
-    uint32_t kk; memcpy(&kk, d + i + 1, 4); k = kk; i += 5;
-  } else {
-    return 0;
-  }
-  if (k == 0 || k >= (uint64_t)cap || i + k > n) return 0;
-  memcpy(out, d + i, k);
-  out[k] = 0;
-  return (int)k;
-}
-
-// ---- native pickle writers for the fixed hot-frame shapes ----
-
-static void put_u64(std::string& o, uint64_t v) { o.append((const char*)&v, 8); }
-static void put_u32(std::string& o, uint32_t v) { o.append((const char*)&v, 4); }
-
-static void pk_bytes(std::string& o, const uint8_t* p, uint64_t n) {
-  if (n < 256) {
-    o.push_back((char)OP_SHORT_BINBYTES);
-    o.push_back((char)n);
-  } else if (n <= 0xffffffffu) {
-    o.push_back((char)OP_BINBYTES);
-    put_u32(o, (uint32_t)n);
-  } else {
-    o.push_back((char)OP_BINBYTES8);
-    put_u64(o, n);
-  }
-  o.append((const char*)p, n);
-}
-
-static void pk_str(std::string& o, const char* s) {
-  size_t n = strlen(s);
-  o.push_back((char)OP_SHORT_BINUNICODE);
-  o.push_back((char)n);
-  o.append(s, n);
-}
-
-static void pk_proto(std::string& o) {
-  o.push_back((char)OP_PROTO);
-  o.push_back((char)5);
-}
-
-// One complete outer frame carrying pickled `payload` (no oob buffers).
-static void frame_wrap(std::string& out, const std::string& payload) {
-  put_u64(out, payload.size());
-  put_u32(out, 0);
-  out += payload;
-}
-
-// ("exec_raw", <spec bytes>) as a complete outer frame.
-static void build_exec_raw(std::string& out, const std::string& spec) {
-  std::string p;
-  pk_proto(p);
-  pk_str(p, "exec_raw");
-  pk_bytes(p, (const uint8_t*)spec.data(), spec.size());
-  p.push_back((char)OP_TUPLE2);
-  p.push_back((char)OP_STOP);
-  frame_wrap(out, p);
-}
-
-// ("reg_fn", <fn bytes>, <blob bytes>) as a complete outer frame.
-static void build_reg_fn(std::string& out, const std::string& fn,
-                         const std::string& blob) {
-  std::string p;
-  pk_proto(p);
-  pk_str(p, "reg_fn");
-  pk_bytes(p, (const uint8_t*)fn.data(), fn.size());
-  pk_bytes(p, (const uint8_t*)blob.data(), blob.size());
-  p.push_back((char)OP_TUPLE3);
-  p.push_back((char)OP_STOP);
-  frame_wrap(out, p);
-}
-
-// ("node_done_raw", <worker hex str>, [<raw frame bytes>, ...]).
-static void build_node_done_raw(std::string& out, const std::string& whex,
-                                const std::vector<std::string>& raws) {
-  std::string p;
-  pk_proto(p);
-  pk_str(p, "node_done_raw");
-  pk_str(p, whex.c_str());
-  p.push_back((char)OP_EMPTY_LIST);
-  p.push_back((char)OP_MARK);
-  for (const auto& r : raws)
-    pk_bytes(p, (const uint8_t*)r.data(), r.size());
-  p.push_back((char)OP_APPENDS);
-  p.push_back((char)OP_TUPLE3);
-  p.push_back((char)OP_STOP);
-  frame_wrap(out, p);
-}
-
-// ---- context ----
-
-struct Conn {
-  int fd = -1;
-  uint64_t tag = 0;
-  bool raw = false;       // cpp-worker plane: hand chunks to Python unsplit
-  bool eof = false;
-  std::string buf;        // unconsumed inbound bytes
-  size_t scan = 0;        // split cursor into buf
-};
-
-struct Frame {
-  uint64_t tag;
-  int kind;               // 0 pickle, 1 proto, 2 raw chunk, 3 eof
-  int proto_tag = 0;      // kind 1: AgentFrame oneof field tag (0 unknown)
-  const uint8_t* whole = nullptr;  // full frame incl. outer header
-  uint64_t whole_len = 0;
-  const uint8_t* payload = nullptr;
-  uint64_t payload_len = 0;
-  std::vector<std::pair<const uint8_t*, uint64_t>> bufs;
-  char op[24] = {0};      // sniffed op ("" = not sniffable)
-  bool consumed = false;
-};
 
 struct LeaseEntry {
   std::string tid, fn, spec;
@@ -369,10 +53,7 @@ struct WorkerRec {
 
 struct Ctx {
   pthread_mutex_t mu = PTHREAD_MUTEX_INITIALIZER;
-  int ep = -1;
-  std::unordered_map<int, Conn> conns;          // fd -> conn
-  std::vector<epoll_event> events;
-  std::vector<Frame> frames;
+  FramePump pump;
   std::deque<LeaseEntry> q;
   std::unordered_map<std::string, std::pair<int, LeaseEntry>> inflight;
   std::unordered_map<std::string, uint64_t> seen;   // tid+seq -> gen
@@ -385,15 +66,9 @@ struct Ctx {
   std::vector<DRec> drecs;                          // dispatched this round
   std::vector<int> out_widx;                        // workers w/ staged outbox
   std::vector<LeaseEntry> stolen;                   // steal/fail results
-  std::string nd_out, nd_scratch;
+  std::string nd_scratch;
   uint64_t stat_native_dones = 0, stat_native_grants = 0,
            stat_native_dispatch = 0;
-};
-
-struct Lock {
-  pthread_mutex_t* m;
-  explicit Lock(pthread_mutex_t* mm) : m(mm) { pthread_mutex_lock(m); }
-  ~Lock() { pthread_mutex_unlock(m); }
 };
 
 static std::string seen_key(const uint8_t* tid, int tlen, uint64_t seq) {
@@ -469,80 +144,36 @@ extern "C" {
 
 void* agc_new() {
   Ctx* c = new Ctx();
-  c->ep = epoll_create1(EPOLL_CLOEXEC);
+  c->pump.init();
   return c;
 }
 
 void agc_free(void* h) {
   Ctx* c = (Ctx*)h;
-  if (c->ep >= 0) close(c->ep);
+  c->pump.close_ep();
   delete c;
 }
 
 int agc_add_fd(void* h, int fd, uint64_t tag, int raw_mode) {
   Ctx* c = (Ctx*)h;
   Lock l(&c->mu);
-  epoll_event ev{};
-  ev.events = EPOLLIN;
-  ev.data.fd = fd;
-  if (epoll_ctl(c->ep, EPOLL_CTL_ADD, fd, &ev) != 0) return -1;
-  Conn& cn = c->conns[fd];
-  cn.fd = fd;
-  cn.tag = tag;
-  cn.raw = raw_mode != 0;
-  cn.eof = false;
-  cn.buf.clear();
-  cn.scan = 0;
-  return 0;
+  return c->pump.add_fd(fd, tag, raw_mode ? CONN_RAW : CONN_PICKLE);
 }
 
 int agc_del_fd(void* h, int fd) {
   Ctx* c = (Ctx*)h;
   Lock l(&c->mu);
-  epoll_ctl(c->ep, EPOLL_CTL_DEL, fd, nullptr);
-  c->conns.erase(fd);
-  return 0;
+  return c->pump.del_fd(fd);
 }
 
 // Wait for readiness and drain readable bytes into per-conn buffers.
 // Returns the number of conns with new data or EOF (0 on timeout).
 int agc_poll(void* h, int timeout_ms) {
   Ctx* c = (Ctx*)h;
-  c->events.resize(64);
-  int n = epoll_wait(c->ep, c->events.data(), (int)c->events.size(),
-                     timeout_ms);
+  int n = c->pump.wait(timeout_ms);
   if (n <= 0) return n;
   Lock l(&c->mu);
-  int active = 0;
-  char tmp[1 << 18];
-  for (int i = 0; i < n; i++) {
-    int fd = c->events[i].data.fd;
-    auto it = c->conns.find(fd);
-    if (it == c->conns.end()) continue;
-    Conn& cn = it->second;
-    bool got = false;
-    for (;;) {
-      ssize_t r = recv(fd, tmp, sizeof(tmp), MSG_DONTWAIT);
-      if (r > 0) {
-        cn.buf.append(tmp, (size_t)r);
-        got = true;
-        if ((size_t)r < sizeof(tmp)) break;
-        continue;
-      }
-      if (r == 0) {
-        cn.eof = true;
-        got = true;
-        break;
-      }
-      if (errno == EINTR) continue;
-      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
-      cn.eof = true;  // hard error: surface as EOF, Python runs death path
-      got = true;
-      break;
-    }
-    if (got) active++;
-  }
-  return active;
+  return c->pump.drain(n);
 }
 
 // Split buffered bytes into frames (per conn, in order). Raw-mode conns
@@ -551,78 +182,7 @@ int agc_poll(void* h, int timeout_ms) {
 int agc_split(void* h) {
   Ctx* c = (Ctx*)h;
   Lock l(&c->mu);
-  c->frames.clear();
-  for (auto& kv : c->conns) {
-    Conn& cn = kv.second;
-    if (cn.raw) {
-      if (cn.scan < cn.buf.size()) {
-        Frame f;
-        f.tag = cn.tag;
-        f.kind = 2;
-        f.payload = (const uint8_t*)cn.buf.data() + cn.scan;
-        f.payload_len = cn.buf.size() - cn.scan;
-        cn.scan = cn.buf.size();
-        c->frames.push_back(std::move(f));
-      }
-    } else {
-      const uint8_t* d = (const uint8_t*)cn.buf.data();
-      size_t n = cn.buf.size();
-      while (cn.scan + 12 <= n) {
-        uint64_t plen;
-        uint32_t nbufs;
-        memcpy(&plen, d + cn.scan, 8);
-        memcpy(&nbufs, d + cn.scan + 8, 4);
-        Frame f;
-        f.tag = cn.tag;
-        if (nbufs & PROTO_FLAG) {
-          uint64_t total = 12 + plen;
-          if (cn.scan + total > n) break;
-          f.kind = 1;
-          f.whole = d + cn.scan;
-          f.whole_len = total;
-          f.payload = d + cn.scan + 12;
-          f.payload_len = plen;
-          // outermost submessage tag of the AgentFrame (varint key)
-          if (plen >= 1) {
-            uint8_t key = f.payload[0];
-            if ((key & 7) == 2) f.proto_tag = key >> 3;
-          }
-          cn.scan += total;
-        } else {
-          if (nbufs > 4096) { cn.eof = true; break; }  // corrupt header
-          uint64_t lens_end = 12 + 8ull * nbufs;
-          if (cn.scan + lens_end > n) break;
-          uint64_t total = lens_end + plen;
-          std::vector<uint64_t> blens(nbufs);
-          for (uint32_t b = 0; b < nbufs; b++) {
-            memcpy(&blens[b], d + cn.scan + 12 + 8ull * b, 8);
-            total += blens[b];
-          }
-          if (cn.scan + total > n) break;
-          f.kind = 0;
-          f.whole = d + cn.scan;
-          f.whole_len = total;
-          f.payload = d + cn.scan + lens_end;
-          f.payload_len = plen;
-          uint64_t off = cn.scan + lens_end + plen;
-          for (uint32_t b = 0; b < nbufs; b++) {
-            f.bufs.emplace_back(d + off, blens[b]);
-            off += blens[b];
-          }
-          sniff_op(f.payload, f.payload_len, f.op, sizeof(f.op));
-          cn.scan += total;
-        }
-        c->frames.push_back(std::move(f));
-      }
-    }
-    if (cn.eof && cn.scan >= cn.buf.size()) {
-      Frame f;
-      f.tag = cn.tag;
-      f.kind = 3;
-      c->frames.push_back(std::move(f));
-    }
-  }
-  return (int)c->frames.size();
+  return c->pump.split();
 }
 
 // Natively consume the hot frames in the split set:
@@ -638,8 +198,8 @@ int agc_consume_hot(void* h, uint64_t head_tag) {
   Ctx* c = (Ctx*)h;
   Lock l(&c->mu);
   int consumed = 0;
-  for (auto& f : c->frames) {
-    if (f.kind != 0 || f.consumed) continue;
+  for (auto& f : c->pump.frames) {
+    if (f.kind != KIND_PICKLE || f.consumed) continue;
     if (f.tag == head_tag && strcmp(f.op, "node_exec_raw") == 0) {
       PickleWalk w;
       int root = w.parse(f.payload, f.payload_len);
@@ -822,7 +382,7 @@ int agc_nd_take(void* h, const uint8_t** p, uint64_t* n) {
 
 int agc_frame_count(void* h) {
   Ctx* c = (Ctx*)h;
-  return (int)c->frames.size();
+  return (int)c->pump.frames.size();
 }
 
 // out layout: tag, kind, proto_tag, payload ptr/len, whole ptr/len, nbufs,
@@ -832,28 +392,13 @@ int agc_frame_info(void* h, int i, uint64_t* tag, int* kind, int* proto_tag,
                    const uint8_t** whole, uint64_t* wlen, int* nbufs,
                    int* consumed) {
   Ctx* c = (Ctx*)h;
-  if (i < 0 || i >= (int)c->frames.size()) return -1;
-  Frame& f = c->frames[i];
-  *tag = f.tag;
-  *kind = f.kind;
-  *proto_tag = f.proto_tag;
-  *payload = f.payload;
-  *plen = f.payload_len;
-  *whole = f.whole;
-  *wlen = f.whole_len;
-  *nbufs = (int)f.bufs.size();
-  *consumed = f.consumed ? 1 : 0;
-  return 0;
+  return c->pump.frame_info(i, tag, kind, proto_tag, payload, plen, whole,
+                            wlen, nbufs, consumed);
 }
 
 int agc_frame_buf(void* h, int i, int j, const uint8_t** p, uint64_t* n) {
   Ctx* c = (Ctx*)h;
-  if (i < 0 || i >= (int)c->frames.size()) return -1;
-  Frame& f = c->frames[i];
-  if (j < 0 || j >= (int)f.bufs.size()) return -1;
-  *p = f.bufs[j].first;
-  *n = f.bufs[j].second;
-  return 0;
+  return c->pump.frame_buf(i, j, p, n);
 }
 
 // End of round: drop consumed bytes from conn buffers and clear the frame
@@ -861,15 +406,8 @@ int agc_frame_buf(void* h, int i, int j, const uint8_t** p, uint64_t* n) {
 void agc_round_end(void* h) {
   Ctx* c = (Ctx*)h;
   Lock l(&c->mu);
-  c->frames.clear();
   c->drecs.clear();
-  for (auto& kv : c->conns) {
-    Conn& cn = kv.second;
-    if (cn.scan > 0) {
-      cn.buf.erase(0, cn.scan);
-      cn.scan = 0;
-    }
-  }
+  c->pump.round_end();
 }
 
 // ---- ledger API ----
@@ -1073,14 +611,11 @@ void agc_stats(void* h, uint64_t* grants, uint64_t* dones,
 
 // Number of AgentFrame oneof tags the proto sniffer knows (drift gate).
 int agc_proto_tag_count() {
-  return (int)(sizeof(kAgentFrameTags) / sizeof(kAgentFrameTags[0]));
+  return agent_frame_tag_count();
 }
 
 int agc_proto_tag_entry(int i, int* field, const char** name) {
-  if (i < 0 || i >= agc_proto_tag_count()) return -1;
-  *field = kAgentFrameTags[i].field;
-  *name = kAgentFrameTags[i].name;
-  return 0;
+  return agent_frame_tag_entry(i, field, name);
 }
 
 }  // extern "C"

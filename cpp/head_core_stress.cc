@@ -1,0 +1,238 @@
+// TSan run-mode storm over the native head core's ledger tables
+// (cpp/head_core.cc). Contract-correct multi-threaded use, mirroring the
+// head process's real thread roles:
+//
+//   * a PUMP thread runs the listener's round: hdc_poll / hdc_split /
+//     hdc_consume_hot, drains the completion records, hdc_round_end;
+//   * GRANTER threads stage grants and take per-node outboxes
+//     (hdc_grant_add / hdc_grant_take) the way the scheduler and driver
+//     threads do — each granter owns a disjoint node set, the same
+//     exclusion the per-conn send lock provides in the runtime;
+//   * a FEEDER thread writes hand-built node_done_raw frames into the
+//     node socketpairs, racing the pump's in-place parse;
+//   * a COLD thread replays hdc_inflight_pop (the lease_fail / reclaim
+//     path) and churns extra nodes (hdc_node_add / hdc_node_remove /
+//     hdc_grant_drop) mid-storm.
+//
+// Every operation here is legal concurrent API use, so any TSan report
+// is a head_core bug, not a harness artifact. Run with
+// TSAN_OPTIONS=halt_on_error=1 (tests/test_sanitizers.py).
+
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "frame_core.h"
+
+extern "C" {
+void* hdc_new();
+void hdc_free(void*);
+int hdc_add_fd(void*, int, uint64_t, int);
+int hdc_del_fd(void*, int);
+int hdc_poll(void*, int);
+int hdc_split(void*);
+int hdc_consume_hot(void*);
+int hdc_rec_count(void*);
+int hdc_rec_info(void*, int, int*, int*, const uint8_t**, uint64_t*,
+                 const uint8_t**, uint64_t*, int*, int64_t*, double*, int*,
+                 int*);
+int hdc_rec_out(void*, int, const uint8_t**, uint64_t*, int*,
+                const uint8_t**, uint64_t*, int*);
+int hdc_recs_take(void*, const uint8_t**, uint64_t*);
+void hdc_round_end(void*);
+int hdc_node_add(void*, uint64_t);
+void hdc_node_remove(void*, int);
+void hdc_grant_add(void*, int, const uint8_t*, int, const uint8_t*, int,
+                   uint64_t, const uint8_t*, uint64_t, int, const uint8_t*,
+                   uint64_t, int64_t, const uint8_t*, int);
+int hdc_grant_take(void*, int, const uint8_t**, uint64_t*);
+void hdc_grant_drop(void*, int);
+int hdc_inflight_pop(void*, const uint8_t*, int);
+uint64_t hdc_inflight(void*);
+void hdc_stats(void*, uint64_t*, uint64_t*, uint64_t*);
+}
+
+namespace {
+
+constexpr int kNodes = 4;
+constexpr int kGranters = 2;
+constexpr int kTasksPerGranter = 5000;
+
+std::atomic<bool> g_stop{false};
+std::atomic<uint64_t> g_granted{0}, g_taken{0}, g_fed{0}, g_drained{0},
+    g_cold_pops{0};
+
+void make_tid(uint8_t* out, int granter, int i) {
+  memset(out, 0, 16);
+  out[0] = (uint8_t)(0x10 + granter);
+  memcpy(out + 1, &i, sizeof(i));
+}
+
+// ("done", tid, None, [(rid, "shm", None, None)], None) as a complete
+// outer frame — the buf-less shape a native agent forwards raw.
+void build_done(std::string& out, const uint8_t* tid) {
+  using namespace framecore;
+  std::string p;
+  pk_proto(p);
+  p.push_back((char)OP_MARK);
+  pk_str(p, "done");
+  pk_bytes(p, tid, 16);
+  pk_none(p);
+  p.push_back((char)OP_EMPTY_LIST);
+  p.push_back((char)OP_MARK);
+  p.push_back((char)OP_MARK);
+  pk_bytes(p, tid, 16);  // rid: reuse the tid bytes
+  pk_str(p, "shm");
+  pk_none(p);
+  pk_none(p);
+  p.push_back((char)OP_TUPLE);
+  p.push_back((char)OP_APPENDS);
+  pk_none(p);
+  p.push_back((char)OP_TUPLE);
+  p.push_back((char)OP_STOP);
+  framecore::frame_wrap(out, p);
+}
+
+void granter(void* c, int id, const int* nidx, const int* wfd) {
+  uint8_t tid[16], fn[16];
+  memset(fn, 0x61 + id, 16);
+  std::string spec(200 + id * 11, (char)('A' + id));
+  const uint8_t* p;
+  uint64_t n;
+  int per = kNodes / kGranters;
+  for (int i = 0; i < kTasksPerGranter; i++) {
+    make_tid(tid, id, i);
+    int node = nidx[id * per + (i % per)];
+    hdc_grant_add(c, node, tid, 16, fn, 16, 1 + (i % 3),
+                  (const uint8_t*)"BLOB", 4, i % 5 == 0,
+                  (const uint8_t*)spec.data(), spec.size(), i % 4,
+                  (const uint8_t*)"stress", 6);
+    g_granted.fetch_add(1, std::memory_order_relaxed);
+    if (i % 8 == 0) {
+      if (hdc_grant_take(c, node, &p, &n) == 0 && n > 0) {
+        g_taken.fetch_add(1, std::memory_order_relaxed);
+        // feed the grant frame's tids back as completions
+        for (int j = i - (i % 8); j <= i; j++) {
+          uint8_t t2[16];
+          make_tid(t2, id, j);
+          std::string done;
+          build_done(done, t2);
+          std::string nd;
+          std::vector<std::string> raws{done};
+          framecore::build_node_done_raw(nd, "aabbccdd", raws);
+          ssize_t w = write(wfd[id * per + (i % per)], nd.data(),
+                            nd.size());
+          if (w > 0) g_fed.fetch_add(1, std::memory_order_relaxed);
+        }
+      }
+    }
+  }
+}
+
+void pump(void* c) {
+  while (!g_stop.load(std::memory_order_acquire)) {
+    int n = hdc_poll(c, 10);
+    if (n < 0) continue;
+    hdc_split(c);
+    hdc_consume_hot(c);
+    // the listener's bulk drain first (what the runtime actually uses),
+    // then the per-record accessors over the same round
+    const uint8_t* bp;
+    uint64_t bn;
+    hdc_recs_take(c, &bp, &bn);
+    int recs = hdc_rec_count(c);
+    int nidx, known, tevp, ooff, nouts;
+    int64_t teva;
+    double tev4[4];
+    const uint8_t *tp, *wp, *rp, *pp;
+    uint64_t tl, wl, rl, pl;
+    int st, pnone;
+    for (int i = 0; i < recs; i++) {
+      if (hdc_rec_info(c, i, &nidx, &known, &tp, &tl, &wp, &wl, &tevp,
+                       &teva, tev4, &ooff, &nouts) != 0)
+        continue;
+      for (int j = ooff; j < ooff + nouts; j++)
+        hdc_rec_out(c, j, &rp, &rl, &st, &pp, &pl, &pnone);
+      g_drained.fetch_add(1, std::memory_order_relaxed);
+    }
+    hdc_round_end(c);
+  }
+}
+
+void cold(void* c) {
+  uint8_t tid[16];
+  uint64_t churn_tag = 9000;
+  while (!g_stop.load(std::memory_order_acquire)) {
+    for (int g = 0; g < kGranters; g++) {
+      for (int i = 0; i < kTasksPerGranter; i += 13) {
+        make_tid(tid, g, i);
+        if (hdc_inflight_pop(c, tid, 16) >= 0)
+          g_cold_pops.fetch_add(1, std::memory_order_relaxed);
+      }
+    }
+    // node churn: a short-lived node gets grants staged, dropped, and
+    // retired (the node-death path)
+    int n = hdc_node_add(c, churn_tag++);
+    hdc_grant_add(c, n, tid, 16, nullptr, 0, 1, nullptr, 0, 0,
+                  (const uint8_t*)"spec", 4, 0, nullptr, 0);
+    hdc_grant_drop(c, n);
+    hdc_node_remove(c, n);
+    std::this_thread::yield();
+  }
+}
+
+}  // namespace
+
+int main() {
+  void* c = hdc_new();
+  int nidx[kNodes], wfd[kNodes];
+  for (int i = 0; i < kNodes; i++) {
+    int sp[2];
+    if (socketpair(AF_UNIX, SOCK_STREAM, 0, sp) != 0) return 3;
+    uint64_t tag = 100 + i;
+    hdc_add_fd(c, sp[0], tag, 0);
+    nidx[i] = hdc_node_add(c, tag);
+    wfd[i] = sp[1];
+  }
+  std::vector<std::thread> ts;
+  ts.emplace_back(pump, c);
+  ts.emplace_back(cold, c);
+  std::vector<std::thread> gs;
+  for (int i = 0; i < kGranters; i++)
+    gs.emplace_back(granter, c, i, nidx, wfd);
+  for (auto& t : gs) t.join();
+  // let the pump drain the tail
+  for (int spin = 0; spin < 100 && g_drained.load() < g_fed.load() / 2;
+       spin++)
+    std::this_thread::sleep_for(std::chrono::milliseconds(10));
+  g_stop.store(true, std::memory_order_release);
+  for (auto& t : ts) t.join();
+  uint64_t grants, dones, frames;
+  hdc_stats(c, &grants, &dones, &frames);
+  printf("granted=%llu taken=%llu fed=%llu drained=%llu cold_pops=%llu "
+         "ledger_grants=%llu ledger_dones=%llu frames=%llu inflight=%llu\n",
+         (unsigned long long)g_granted.load(),
+         (unsigned long long)g_taken.load(),
+         (unsigned long long)g_fed.load(),
+         (unsigned long long)g_drained.load(),
+         (unsigned long long)g_cold_pops.load(),
+         (unsigned long long)grants, (unsigned long long)dones,
+         (unsigned long long)frames, (unsigned long long)hdc_inflight(c));
+  bool ok = g_granted.load() > 0 && g_taken.load() > 0 && dones > 0
+            && g_drained.load() > 0 && g_cold_pops.load() > 0;
+  hdc_free(c);
+  if (!ok) {
+    fprintf(stderr, "stress exercised too little of the head ledger\n");
+    return 2;
+  }
+  printf("HEAD_CORE_STRESS_OK\n");
+  return 0;
+}

@@ -38,7 +38,8 @@ def _load():
         native_dir = os.path.dirname(os.path.abspath(_b.__file__))
         repo = os.path.dirname(os.path.dirname(native_dir))
         src = os.path.join(repo, "cpp", "agent_core.cc")
-        lib = load_native("agent_core", sources=(src,))
+        hdr = os.path.join(repo, "cpp", "frame_core.h")
+        lib = load_native("agent_core", sources=(src,), headers=(hdr,))
     except Exception as e:  # noqa: BLE001 — degrade to pure Python
         _lib_err = e
         return None

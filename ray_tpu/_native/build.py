@@ -47,21 +47,24 @@ def _sanitizer_flags(sanitizer: str | None) -> tuple[list, str]:
 
 
 def build_native(name: str, sources: tuple = (),
-                 sanitizer: str | None = None) -> str:
+                 sanitizer: str | None = None,
+                 headers: tuple = ()) -> str:
     """Compile (if needed) and return the .so path WITHOUT loading it.
 
     `sanitizer` overrides the env var ("thread"/"address"/""/None) — passed
     through as a parameter, never by mutating process-global env (a
     concurrent load_native in another thread must not pick it up)."""
-    return _build(name, sources, sanitizer=sanitizer)
+    return _build(name, sources, sanitizer=sanitizer, headers=headers)
 
 
 def _build(name: str, sources: tuple = (),
-           sanitizer: str | None = None) -> str:
+           sanitizer: str | None = None, headers: tuple = ()) -> str:
     # Default source is _native/<name>.cpp; absolute `sources` entries
     # (e.g. cpp/agent_core.cc, which lives beside the other cross-language
     # C++ in the repo's cpp/ tree) are taken as-is, so one cache serves
-    # both layouts.
+    # both layouts. `headers` are hashed (so an edit to a shared .h like
+    # cpp/frame_core.h invalidates every .so that includes it) and their
+    # directories ride -I; they are never handed to g++ as inputs.
     srcs = []
     primary = os.path.join(_DIR, f"{name}.cpp")
     if os.path.exists(primary):
@@ -70,15 +73,19 @@ def _build(name: str, sources: tuple = (),
              for s in sources]
     if not srcs:
         raise FileNotFoundError(f"no sources for native module {name!r}")
+    hdrs = [p if os.path.isabs(p) else os.path.join(_DIR, p)
+            for p in headers]
     extra, san_tag = _sanitizer_flags(sanitizer)
-    tag = _source_hash(srcs) + san_tag
+    tag = _source_hash(srcs + hdrs) + san_tag
     so_path = os.path.join(_BUILD_DIR, f"{name}-{tag}.so")
     if not os.path.exists(so_path):
         os.makedirs(_BUILD_DIR, exist_ok=True)
         tmp = so_path + f".tmp{os.getpid()}"
         cmd = [
             "g++", "-O2", "-fPIC", "-shared", "-pthread",
-            "-std=c++17", *extra, "-o", tmp, *srcs,
+            "-std=c++17", *extra,
+            *sorted({f"-I{os.path.dirname(p)}" for p in hdrs}),
+            "-o", tmp, *srcs,
         ]
         subprocess.run(cmd, check=True, capture_output=True, text=True)
         os.replace(tmp, so_path)  # atomic: concurrent builders race safely
@@ -86,7 +93,7 @@ def _build(name: str, sources: tuple = (),
 
 
 def build_binary(name: str, sources: tuple, include_dirs: tuple = (),
-                 sanitizer: str | None = None) -> str:
+                 sanitizer: str | None = None, headers: tuple = ()) -> str:
     """Compile (if needed) a standalone EXECUTABLE through the same
     content-hash g++ cache and return its path.
 
@@ -94,11 +101,14 @@ def build_binary(name: str, sources: tuple, include_dirs: tuple = (),
     live under the repo's cpp/ tree, not _native/). Used for the
     cross-language worker binary (cpp/raytpu_worker.cc + object_store.cpp)
     so no build-system step is ever required — the node agent compiles on
-    first spawn and every later spawn hits the cache."""
+    first spawn and every later spawn hits the cache. `headers` ride the
+    content hash only (an edit to a shared .h rebuilds the binary)."""
     srcs = [s if os.path.isabs(s) else os.path.join(_DIR, s)
             for s in sources]
+    hdrs = [p if os.path.isabs(p) else os.path.join(_DIR, p)
+            for p in headers]
     extra, san_tag = _sanitizer_flags(sanitizer)
-    tag = _source_hash(srcs) + san_tag
+    tag = _source_hash(srcs + hdrs) + san_tag
     out_path = os.path.join(_BUILD_DIR, f"{name}-{tag}")
     if not os.path.exists(out_path):
         os.makedirs(_BUILD_DIR, exist_ok=True)
@@ -111,15 +121,17 @@ def build_binary(name: str, sources: tuple, include_dirs: tuple = (),
     return out_path
 
 
-def load_native(name: str, sources: tuple = ()) -> ctypes.CDLL:
+def load_native(name: str, sources: tuple = (),
+                headers: tuple = ()) -> ctypes.CDLL:
     """Build (if needed) and dlopen a native lib from ray_tpu/_native/.
 
     Default source is <name>.cpp; `sources` names additional .cpp files
-    compiled into the same .so (the hash covers all of them, so editing
-    any source invalidates the cache)."""
+    compiled into the same .so and `headers` shared includes (the hash
+    covers all of them, so editing any source OR header invalidates the
+    cache)."""
     with _lock:
         if name in _loaded:
             return _loaded[name]
-        lib = ctypes.CDLL(_build(name, sources))
+        lib = ctypes.CDLL(_build(name, sources, headers=headers))
         _loaded[name] = lib
         return lib

@@ -215,6 +215,20 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
                      "(off, or a failed native build) is behaviorally "
                      "identical; chaos-armed processes route every send "
                      "through the Python chaos sites either way"),
+    "native_head": (bool, True, "run the HEAD's listener select round in "
+                    "C++ too (cpp/head_core.cc), finishing the scheduling "
+                    "plane's native split: the node-listener frame pump, "
+                    "in-place node_done_raw parse + (task_id, lease_seq) "
+                    "completion ledger, and native node_exec_raw grant "
+                    "builds into per-node outboxes go native, while "
+                    "Python keeps all policy (placement, spill, placement "
+                    "groups, dep gating, retries) and every cold path "
+                    "(lease_return/lease_spilled/reclaim/redrive/cpp "
+                    "leases) keeps its object-form frames. Pure-Python "
+                    "fallback (off, or a failed native build) is "
+                    "behaviorally identical; chaos-armed processes skip "
+                    "native consumption and route every send through the "
+                    "Python chaos sites either way"),
     "put_extent_affinity": (bool, True, "store_reserve prefers free-list "
                             "ranges this pid owned before (per-pid extent "
                             "hints recorded when reservations retire): "

@@ -21,6 +21,7 @@ from __future__ import annotations
 import collections
 import concurrent.futures
 import os
+import pickle
 import selectors
 import signal
 import socket
@@ -45,7 +46,8 @@ from ray_tpu.core.status import (
     WorkerCrashedError,
 )
 from ray_tpu.core.task import ActorCreationSpec, TaskSpec
-from ray_tpu.core.transport import FrameBuffer, encode_payload, send_msg
+from ray_tpu.core.transport import (FrameBuffer, encode_frame,
+                                    encode_payload, send_msg)
 
 def _reap_stale_stores(shm_dir: str):
     """Unlink arenas whose head process died without shutdown(), and kill
@@ -333,6 +335,11 @@ class NodeConn:
         self.buffer = FrameBuffer()
         self.node_id: bytes | None = None  # set on register_node
         self.client_handle = None  # set on client_hello (client mode)
+        # Native head core bookkeeping (cpp/head_core.cc): the pump tag
+        # this conn's fd rides, and — once register_node lands — its
+        # native node index (grant outbox + completion-ledger key).
+        self._htag: int | None = None
+        self._nidx: int | None = None
 
     def send(self, msg):
         send_msg(self.sock, msg, self.send_lock)
@@ -342,6 +349,8 @@ class _Acceptor:
     """Selector sentinel for the cluster's listening socket."""
 
     kind = "accept"
+    sock = None       # set in enable_cluster (the native pump accepts
+    _htag = None      # through Python, so the handle carries the socket)
 
 
 class NodeState:
@@ -988,8 +997,28 @@ class Runtime:
         self._selector = selectors.DefaultSelector()
         self._sel_lock = threading.Lock()
         self._tl_out = threading.local()  # listener drain-pass send batch
+        # --- native head core (cpp/head_core.cc) --- the listener's
+        # frame pump, the node_done_raw completion parse + (task_id,
+        # lease_seq) ledger and the node_exec_raw grant builds run in C++
+        # when `native_head` is on and the module builds; any failure
+        # degrades to the pure-Python listener below, never to an error.
+        # Chaos-armed processes keep the native ledger but skip native
+        # consumption and route every send through per-frame send_msg so
+        # the seeded transport sites fire exactly as scheduled.
+        self._hnat = None
+        self._htag: dict[int, object] = {}   # pump tag -> handle
+        self._nidx_conn: dict[int, NodeConn] = {}
+        if cfg.native_head:
+            try:
+                from ray_tpu._native.head_core import HeadCore
+                self._hnat = HeadCore()
+            except Exception:  # noqa: BLE001 — pure-Python fallback
+                traceback.print_exc()
+                self._hnat = None
         self._listener = threading.Thread(
-            target=self._listen_loop, daemon=True, name="rtpu-listener")
+            target=(self._listen_loop_native if self._hnat is not None
+                    else self._listen_loop),
+            daemon=True, name="rtpu-listener")
         self._listener.start()
         if cfg.task_events:
             # Started here (not at task_store creation): the loop reads
@@ -1429,8 +1458,7 @@ class Runtime:
                 return None
             self.workers[worker_id.binary()] = handle
             self.head_node.workers[worker_id.binary()] = handle
-        with self._sel_lock:
-            self._selector.register(parent, selectors.EVENT_READ, handle)
+        self._pump_register(parent, handle)
         return handle
 
     def _replenish_pool_async(self):
@@ -1447,6 +1475,147 @@ class Runtime:
         threading.Thread(target=run, daemon=True).start()
 
     # ---------------- listener / message handling ----------------
+
+    def _pump_register(self, sock, handle, accept: bool = False):
+        """Register a readable fd with the listener: the native head
+        pump when it owns the select round, the Python selector
+        otherwise. `handle` is the routing object (WorkerHandle /
+        NodeConn / _Acceptor)."""
+        nat = self._hnat
+        if nat is None:
+            with self._sel_lock:
+                self._selector.register(sock, selectors.EVENT_READ, handle)
+            return
+        tag = nat.alloc_tag()
+        handle._htag = tag
+        handle._hfd = sock.fileno()
+        self._htag[tag] = handle
+        nat.add_fd(handle._hfd, tag, accept=accept)
+
+    def _pump_unregister(self, sock, handle=None):
+        nat = self._hnat
+        if nat is None:
+            with self._sel_lock:
+                try:
+                    self._selector.unregister(sock)
+                except (KeyError, ValueError):
+                    pass
+            return
+        tag = getattr(handle, "_htag", None)
+        fd = getattr(handle, "_hfd", None)
+        if fd is None:
+            try:
+                fd = sock.fileno()
+            except (OSError, AttributeError):
+                fd = -1
+        if fd is not None and fd >= 0:
+            try:
+                nat.del_fd(fd)
+            except OSError:
+                pass
+        if tag is not None:
+            self._htag.pop(tag, None)
+            handle._htag = None
+
+    def _accept_pending(self, acc):
+        """Drain the listening socket (native pump surfaced readiness)."""
+        from ray_tpu.core.transport import enable_nodelay
+        srv = acc.sock
+        while True:
+            try:
+                conn_sock, _addr = srv.accept()
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                return
+            conn_sock.setblocking(True)
+            enable_nodelay(conn_sock)
+            nc = NodeConn(conn_sock)
+            self._pump_register(conn_sock, nc)
+
+    def _drain_native_completions(self, nat):
+        """Feed the round's natively parsed node_done_raw records into
+        the SAME per-batch completion pass as the Python path — grouped
+        per node conn, entry shape (task_id, outs, tev, whex). The
+        C++ side already popped the (task_id, lease_seq) ledger;
+        _on_node_done's _pop_lease_locked stays the authoritative pop."""
+        groups: dict = {}
+        order: list = []
+        for nidx, _known, tid, whex, outs, tev in nat.completions():
+            if nidx not in groups:
+                groups[nidx] = []
+                order.append(nidx)
+            groups[nidx].append((tid, outs, tev, whex))
+        for nidx in order:
+            conn = self._nidx_conn.get(nidx)
+            if conn is None:
+                continue
+            try:
+                self._on_node_done(conn, groups[nidx], native_popped=True)
+            except Exception:
+                traceback.print_exc()
+
+    def _listen_loop_native(self):
+        """The head's select round on the native pump (cpp/head_core.cc):
+        C++ owns readiness, frame split and node_done_raw consumption;
+        Python handles the cold frames, runs accepts, and performs every
+        send (the out-batch coalescing is unchanged). Chaos-armed rounds
+        skip native consumption so every frame takes the Python path and
+        its seeded sites."""
+        from ray_tpu._native.head_core import (KIND_ACCEPT, KIND_EOF,
+                                               KIND_PROTO)
+        from ray_tpu.core.transport import _decode_proto
+        nat = self._hnat
+        while not self._shutdown:
+            try:
+                n = nat.poll(50)
+            except OSError:
+                continue
+            if n <= 0:
+                continue
+            nat.split()
+            consumed = 0
+            if chaos._armed is None:
+                consumed = nat.consume_hot()
+            dead: list = []
+            self._begin_out_batch()
+            try:
+                if consumed:
+                    self._drain_native_completions(nat)
+                for tag, kind, _pt, payload, bufs, _whole in nat.frames():
+                    handle = self._htag.get(tag)
+                    if handle is None:
+                        continue
+                    try:
+                        if kind == KIND_ACCEPT:
+                            self._accept_pending(handle)
+                            continue
+                        if kind == KIND_EOF:
+                            dead.append(handle)
+                            continue
+                        msg = (_decode_proto(bytes(payload))
+                               if kind == KIND_PROTO
+                               else pickle.loads(payload, buffers=bufs))
+                        if handle.kind == "node":
+                            if handle.client_handle is not None:
+                                self._handle_msg(handle.client_handle, msg)
+                            else:
+                                self._handle_node_msg(handle, msg)
+                        else:
+                            self._handle_msg(handle, msg)
+                    except Exception:
+                        traceback.print_exc()
+            finally:
+                self._flush_out_batch()
+            nat.round_end()  # frame views die here
+            for handle in dead:
+                try:
+                    if handle.kind == "node":
+                        self._on_node_conn_closed(handle)
+                    else:
+                        self._on_worker_death(handle)
+                except Exception:
+                    traceback.print_exc()
 
     def _listen_loop(self):
         while not self._shutdown:
@@ -2086,8 +2255,9 @@ class Runtime:
                 print(f"ray_tpu: proto client plane unavailable ({e!r})",
                       file=sys.stderr)
                 self.client_proto_addr = None
-        with self._sel_lock:
-            self._selector.register(srv, selectors.EVENT_READ, _Acceptor())
+        acc = _Acceptor()
+        acc.sock = srv
+        self._pump_register(srv, acc, accept=True)
         threading.Thread(target=self._health_loop, daemon=True,
                          name="rtpu-node-health").start()
         return self.cluster_addr
@@ -2211,6 +2381,14 @@ class Runtime:
                             self.total_resources.get(k, 0.0) + v)
                 # New capacity may unblock queued PGs/actors.
                 self._kick_waiters()
+            if (self._hnat is not None and conn._htag is not None
+                    and conn._nidx is None):
+                # Native node slot: keys the grant outbox and the
+                # completion ledger for this conn. A reconnected agent
+                # arrives on a FRESH conn (fresh tag, fresh slot); the
+                # old conn's slot retires on its EOF.
+                conn._nidx = self._hnat.node_add(conn._htag)
+                self._nidx_conn[conn._nidx] = conn
             # (Re-)registration resets the broadcast cursor: the agent's
             # view cache died with its old process/link, so the next
             # broadcast pass resends the full cluster view.
@@ -2644,11 +2822,14 @@ class Runtime:
                 ObjectID(oid))
 
     def _on_node_conn_closed(self, conn: NodeConn):
-        with self._sel_lock:
-            try:
-                self._selector.unregister(conn.sock)
-            except (KeyError, ValueError):
-                pass
+        self._pump_unregister(conn.sock, conn)
+        if self._hnat is not None and conn._nidx is not None:
+            # Retire the native node slot: drops its staged grants and
+            # (task_id, lease_seq) mirror entries — Python requeues the
+            # leases themselves from node.leases below.
+            self._hnat.node_remove(conn._nidx)
+            self._nidx_conn.pop(conn._nidx, None)
+            conn._nidx = None
         try:
             conn.sock.close()
         except OSError:
@@ -2682,6 +2863,15 @@ class Runtime:
                     0.0, self.total_resources.get(k, 0.0) - v)
             orphaned_assigns = list(node.pending_actor_assign)
             node.pending_actor_assign.clear()
+        conn = node.conn
+        if (self._hnat is not None and conn is not None
+                and conn._nidx is not None):
+            # Health-timeout death (no conn EOF yet): retire the native
+            # node slot NOW so its staged grants and inflight mirror
+            # entries can't outlive the lease requeue below.
+            self._hnat.node_remove(conn._nidx)
+            self._nidx_conn.pop(conn._nidx, None)
+            conn._nidx = None
         if self.export_events is not None:
             self.export_events.emit("NODE", node_id=node.node_id.hex(),
                                     state="DEAD")
@@ -4178,10 +4368,53 @@ class Runtime:
                 node_order.append(node)
             per_node[node].append((spec.fn_id, blob, spec))
         native = self.config.native_sched
+        # Native-head grant builder: armed processes fall back to the
+        # Python frame path so head.lease_grant.lose and the transport
+        # sites fire per frame, exactly as in the pure-Python loop.
+        hnat = self._hnat if chaos._armed is None else None
         for node in node_order:
             now = time.monotonic()
             for _fid, _blob, spec in per_node[node]:
                 node.lease_sent[spec.task_id] = [now, 0]
+            nidx = node.conn._nidx if node.conn is not None else None
+            if native and hnat is not None and nidx is not None:
+                # Native grant plane, head half: stage each raw entry
+                # into the C++ per-node outbox (the spec bytes were
+                # pickled exactly once by encode_payload; the batch
+                # frame itself is built natively — no second pickle of
+                # the entry list) and ship it as ONE sendall under the
+                # conn's write lock. cpp-language leases keep the
+                # object form (their queue and protobuf dispatch stay
+                # Python-side at the agent).
+                obj_triples = []
+                staged = 0
+                for fid, blob, spec in per_node[node]:
+                    if getattr(spec, "language", None) == "cpp":
+                        obj_triples.append((fid, blob, spec))
+                        continue
+                    hnat.grant_add(nidx, spec.task_id, fid,
+                                   spec.lease_seq or 0, blob,
+                                   encode_payload(spec),
+                                   task_events.attempt_of(spec),
+                                   spec.name)
+                    staged += 1
+                if obj_triples:
+                    if not self._buffered_send(node.conn,
+                                               ("node_exec", obj_triples)):
+                        try:
+                            node.conn.send(("node_exec", obj_triples))
+                        except OSError:
+                            hnat.grant_drop(nidx)
+                            continue  # node-death requeues node.leases
+                if staged:
+                    try:
+                        with node.conn.send_lock:
+                            buf = hnat.grant_take(nidx)
+                            if len(buf):
+                                node.conn.sock.sendall(buf)
+                    except OSError:
+                        pass  # node-death handling requeues node.leases
+                continue
             if native:
                 # Native grant plane: each spec ships as raw pickle bytes
                 # with (tid, fn, lease_seq, blob, spec, attempt, name)
@@ -4362,16 +4595,27 @@ class Runtime:
 
     def _broadcast_cluster_view(self):
         """One delta frame per agent that is behind the current version:
-        exactly the entries newer than that agent's cursor (its own entry
-        elided — an agent is the authority on its own load). Cursors
+        exactly the entries newer than that agent's cursor. Cursors
         advance at send time; TCP FIFO per link makes that safe, and a
         link that dies mid-send re-registers, which resets the cursor to
-        0 (the full-view catch-up)."""
+        0 (the full-view catch-up).
+
+        Encoded ONCE per distinct cursor (under a 16-agent storm every
+        agent sits at the same cursor, so the tick costs one pickle +
+        N raw sendalls instead of N pickles — the broadcaster was ~30%
+        of head CPU in the HEADPROF_r06 storm before this). An agent's
+        own entry rides along un-elided: every agent-side consumer
+        already skips nid == self (the agent is the authority on its own
+        load), so the shared bytes are semantically identical to the old
+        per-agent frames. Chaos-armed processes keep per-agent send_msg
+        so the seeded transport sites fire per frame."""
         with self._cview_lock:
             version = self._cview_version
             entries = [(nid, dict(e)) for nid, e in self._cview.items()]
         if version == 0:
             return
+        armed = chaos._armed is not None
+        by_cursor: dict = {}
         for node in list(self.nodes.values()):
             conn = node.conn
             if conn is None or node.state != "ALIVE":
@@ -4379,15 +4623,27 @@ class Runtime:
             cursor = node.cview_cursor
             if cursor >= version:
                 continue
-            delta = [(nid, e) for nid, e in entries
-                     if e.get("v", 0) > cursor and nid != node.node_id]
             node.cview_cursor = version
+            by_cursor.setdefault(cursor, []).append(node)
+        for cursor, nodes in by_cursor.items():
+            delta = [(nid, e) for nid, e in entries
+                     if e.get("v", 0) > cursor]
             if not delta:
                 continue
-            try:
-                conn.send(("cluster_view", version, delta))
-            except OSError:
-                pass  # node-death handling owns the cleanup
+            if armed:
+                for node in nodes:
+                    try:
+                        node.conn.send(("cluster_view", version, delta))
+                    except OSError:
+                        pass  # node-death handling owns the cleanup
+                continue
+            blob = encode_frame(("cluster_view", version, delta))
+            for node in nodes:
+                try:
+                    with node.conn.send_lock:
+                        node.conn.sock.sendall(blob)
+                except OSError:
+                    pass  # node-death handling owns the cleanup
 
     def _find_lease_locked(self, task_id: bytes, node):
         """Locate a lease by task id under self.lock WITHOUT popping it:
@@ -4407,11 +4663,18 @@ class Runtime:
                 return n, spec
         return None, None
 
-    def _pop_lease_locked(self, task_id: bytes, node):
-        """_find_lease_locked, destructively."""
+    def _pop_lease_locked(self, task_id: bytes, node,
+                          native_popped: bool = False):
+        """_find_lease_locked, destructively. Also retires the native
+        head core's (task_id, lease_seq) mirror entry so the cold paths
+        (lease_fail / reclaim / node death) can never leak it —
+        `native_popped=True` skips that call on the hot completion path,
+        where consume_hot already popped (or never held) the entry."""
         holder, spec = self._find_lease_locked(task_id, node)
         if holder is not None:
             holder.leases.pop(task_id, None)
+            if self._hnat is not None and not native_popped:
+                self._hnat.inflight_pop(task_id)
         return spec
 
     def _on_lease_return(self, from_nid: bytes, specs: list):
@@ -4438,6 +4701,8 @@ class Runtime:
                         or (cur.lease_seq or 0) != (spec.lease_seq or 0)):
                     continue  # already requeued / completed / re-granted
                 holder.leases.pop(spec.task_id, None)
+                if self._hnat is not None:
+                    self._hnat.inflight_pop(spec.task_id)
                 self._release_token(
                     self._reservations.pop(spec.task_id, None))
                 # Carry the hop count home: bouncing through the head
@@ -4806,7 +5071,8 @@ class Runtime:
         if entries:
             self._on_node_done(conn, entries)
 
-    def _on_node_done(self, conn: "NodeConn", entries: list):
+    def _on_node_done(self, conn: "NodeConn", entries: list,
+                      native_popped: bool = False):
         """Batched completions of node-leased tasks (the raylet-local
         dispatch path). ONE global-lock acquisition per BATCH — the
         per-completion lock work the 64-agent profile named as the head's
@@ -4838,7 +5104,8 @@ class Runtime:
                 # Global pop: a spilled lease completes on the EXECUTING
                 # node's link, which may not be the node it was leased to
                 # (and the lease_spilled notice may still be in flight).
-                spec = self._pop_lease_locked(task_id, node)
+                spec = self._pop_lease_locked(task_id, node,
+                                              native_popped)
                 self._release_token(
                     self._reservations.pop(task_id, None))
                 for rid, _s, _p, _b in outs:
@@ -5323,11 +5590,7 @@ class Runtime:
         if w.state == DEAD:
             return
         if w.sock is not None:
-            with self._sel_lock:
-                try:
-                    self._selector.unregister(w.sock)
-                except (KeyError, ValueError):
-                    pass
+            self._pump_unregister(w.sock, w)
             try:
                 w.sock.close()
             except OSError:

@@ -239,6 +239,16 @@ def _encode(msg) -> list:
     return parts
 
 
+def encode_frame(msg) -> bytes:
+    """One complete outer frame as a self-contained byte string — for
+    fan-out control messages (the head's cluster-view broadcast) that
+    are pickled ONCE and sendall'd to N destinations raw. Out-of-band
+    buffers are joined in-band (control messages carry none worth
+    zero-copying)."""
+    return b"".join(bytes(p) if not isinstance(p, bytes) else p
+                    for p in _encode(msg))
+
+
 def encode_payload(obj) -> bytes:
     """Pickle one object to a SELF-CONTAINED byte string (out-of-band
     buffers serialized in-band): the raw-spec payload of the native

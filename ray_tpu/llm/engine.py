@@ -25,6 +25,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import threading
+import weakref
 from functools import partial
 
 import jax
@@ -884,6 +885,10 @@ class InferenceEngine:
         self.slot_req: list[Request | None] = [None] * B
         self.queue: collections.deque[Request] = collections.deque()
         self.finished: dict[int, Request] = {}
+        # rid -> live Request, weakly: streaming consumers (the decode
+        # pool's logprob plane) read incremental per-token state without
+        # any pop bookkeeping — entries vanish with their request.
+        self._req_by_id = weakref.WeakValueDictionary()
         self._next_id = 0
         self._lock = threading.Lock()
         self._cancel_rids: set[int] = set()
@@ -936,7 +941,15 @@ class InferenceEngine:
             req.generated.append(int(resume_token))
             req.resume_token = int(resume_token)
         self.queue.append(req)
+        self._req_by_id[rid] = req
         return rid
+
+    def request(self, request_id: int) -> "Request | None":
+        """The live (or finished-but-referenced) Request for `request_id`.
+        Incremental readers (streaming logprobs) may read append-only
+        fields like token_logprobs; the entry disappears with the
+        request object itself."""
+        return self._req_by_id.get(request_id)
 
     def cancel(self, request_id: int):
         """Abort a request from ANY thread: flagged here, applied by the
@@ -1931,12 +1944,17 @@ class PrefillEngine:
         self._key = jax.random.PRNGKey(seed + 1)
 
     def prefill_export(self, prompt_tokens, temperature=None,
-                       top_p: float = 1.0, top_k: int = 0):
-        """-> (first_token, ks, vs): the sampled continuation token plus
-        the prompt's full-page KV as host arrays [L, S, hkv, hd] with
-        S = page-aligned prefix length (0 when the prompt spans less than
-        one full page — nothing worth handing off). Greedy (temp 0) picks
-        match the decode engine's bit-exactly."""
+                       top_p: float = 1.0, top_k: int = 0,
+                       want_logp: bool = False):
+        """-> (first_token, ks, vs[, first_logp]): the sampled
+        continuation token plus the prompt's full-page KV as host arrays
+        [L, S, hkv, hd] with S = page-aligned prefix length (0 when the
+        prompt spans less than one full page — nothing worth handing
+        off). Greedy (temp 0) picks match the decode engine's
+        bit-exactly. `want_logp` additionally returns log p(first_token)
+        under the unmasked distribution — the OpenAI-logprobs value for
+        the position the prefill pool samples (the decode pool covers
+        the rest of the stream)."""
         ids = list(map(int, prompt_tokens))
         n = len(ids)
         bucket = _prompt_bucket(self.e, n)
@@ -1962,7 +1980,10 @@ class PrefillEngine:
         cut = max(full, 0) * page
         ks_np = np.asarray(ks[:, :cut])
         vs_np = np.asarray(vs[:, :cut])
-        return first, ks_np, vs_np
+        if not want_logp:
+            return first, ks_np, vs_np
+        first_logp = float(jax.nn.log_softmax(row[0])[first])
+        return first, ks_np, vs_np, first_logp
 
 
 def __graphcheck__(gc):

@@ -281,22 +281,8 @@ class _LLMServerImpl:
         text, stopped = self._apply_stop(text, stop)
         lp = None
         if logprobs:
-            kept = req.generated
-            if stopped:
-                # Align the logprob arrays with the TRUNCATED text by
-                # accumulating per-token text lengths — one decode per
-                # token (O(n)) instead of re-decoding the growing prefix
-                # per kept token (O(n²)), and consistent with the
-                # per-token `tokens` strings reported below.
-                kept = []
-                decoded_len = 0
-                for t in req.generated:
-                    kept.append(t)
-                    decoded_len += len(self.tokenizer.decode([t]))
-                    if decoded_len >= len(text):
-                        break
-            lp = {"tokens": [self.tokenizer.decode([t]) for t in kept],
-                  "token_logprobs": list(req.token_logprobs[:len(kept)])}
+            lp = _logprob_fields(self.tokenizer, text, stopped,
+                                 req.generated, req.token_logprobs)
         return {
             "id": f"cmpl-{uuid.uuid4().hex[:24]}",
             "object": "text_completion",
@@ -403,6 +389,29 @@ class _LLMServerImpl:
 
     def __del__(self):
         self._stop = True
+
+
+def _logprob_fields(tokenizer, text: str, stopped: bool, generated,
+                    token_logprobs) -> dict:
+    """The OpenAI `logprobs` response block, aligned with the (possibly
+    stop-truncated) text — shared by the dense replica and the
+    disaggregated coordinator so the two paths can never drift."""
+    kept = list(generated)
+    if stopped:
+        # Align the logprob arrays with the TRUNCATED text by
+        # accumulating per-token text lengths — one decode per token
+        # (O(n)) instead of re-decoding the growing prefix per kept
+        # token (O(n²)), and consistent with the per-token `tokens`
+        # strings reported below.
+        kept = []
+        decoded_len = 0
+        for t in generated:
+            kept.append(t)
+            decoded_len += len(tokenizer.decode([t]))
+            if decoded_len >= len(text):
+                break
+    return {"tokens": [tokenizer.decode([t]) for t in kept],
+            "token_logprobs": list(token_logprobs[:len(kept)])}
 
 
 def _hold_incomplete_utf8(text: str) -> str:
@@ -591,9 +600,20 @@ class DisaggConfig:
     decode_replicas: int = 2
     # --- admission control (the overload contract) ---
     max_prefill_queue_tokens: int = 8192
+    # PER LIVE DECODE REPLICA: the coordinator multiplies this budget by
+    # the decode pool's live replica count (refreshed on dispatch and on
+    # shed reports), so an autoscaled pool admits proportionally more.
     max_decode_inflight_tokens: int = 16384
     max_ongoing_requests: int = 256
     admission_slo_ms: float | None = None  # est decode wait SLO; None=off
+    # --- autoscaling (ROADMAP item 1: scale decode on shed rate) ---
+    # AutoscalingConfig kwargs for the DecodePool deployment (e.g.
+    # dict(min_replicas=1, max_replicas=4, upscale_shed_rate=1.0)):
+    # the coordinator attributes decode/slo admission sheds to the pool
+    # (record_shed_metrics), and the controller adds a replica when the
+    # sustained shed rate crosses upscale_shed_rate. None = fixed
+    # decode_replicas.
+    decode_autoscale: dict | None = None
     # --- routing / handoff ---
     handoff: bool = True          # False: decode pool always re-prefills
     route_cache_prefixes: int = 4096  # prefix keys remembered per replica
@@ -622,10 +642,12 @@ class _PrefillWorkerImpl:
                                     seed=llm_config.seed)
 
     def prefill(self, prompt_ids, temperature=None, top_p: float = 1.0,
-                top_k: int = 0) -> dict:
+                top_k: int = 0, want_logp: bool = False) -> dict:
         chaos.delay("serve.prefill.stall", max_s=0.25)
-        first, ks, vs = self.engine.prefill_export(
-            prompt_ids, temperature=temperature, top_p=top_p, top_k=top_k)
+        out = self.engine.prefill_export(
+            prompt_ids, temperature=temperature, top_p=top_p, top_k=top_k,
+            want_logp=want_logp)
+        first, ks, vs = out[:3]
         kv = None
         if ks.shape[1]:
             import ray_tpu
@@ -634,7 +656,8 @@ class _PrefillWorkerImpl:
             else:
                 kv = (ks, vs)  # local testing mode: no store to seal into
         return {"first": int(first), "kv": kv,
-                "kv_tokens": int(ks.shape[1])}
+                "kv_tokens": int(ks.shape[1]),
+                "first_logp": out[3] if want_logp else None}
 
 
 class _DecodeReplicaImpl(_LLMServerImpl):
@@ -672,16 +695,21 @@ class _DecodeReplicaImpl(_LLMServerImpl):
     def decode_stream(self, prompt_ids, generated, kv=None,
                       max_tokens=None, temperature=None,
                       top_p: float = 1.0, top_k: int = 0,
-                      chunk_tokens: int = 8):
+                      chunk_tokens: int = 8, want_logp: bool = False):
         """Continue a request whose prompt was prefilled elsewhere.
 
         `generated` = tokens the client already holds (>=1: the prefill's
         first token; more when resuming a stream whose previous replica
         died). Yields lists of NEW token ids — exactly the positions
-        after `generated`, each exactly once. The prompt KV comes from
-        the handoff (import_kv prefix splice) or, when the handoff is
-        lost, a full re-prefill; tokens in `generated` beyond the prompt
-        re-prefill as suffix either way."""
+        after `generated`, each exactly once — or, with `want_logp`,
+        lists of (token, logprob) pairs: a resumed request appends one
+        token_logprobs entry per NEWLY decoded position (the resume
+        token itself is never re-sampled), so the k-th streamed token
+        pairs with token_logprobs[k] and positions already delivered
+        keep the logprobs their original replica streamed. The prompt
+        KV comes from the handoff (import_kv prefix splice) or, when
+        the handoff is lost, a full re-prefill; tokens in `generated`
+        beyond the prompt re-prefill as suffix either way."""
         import queue as _queue
         e = self.engine.e
         max_new = max_tokens or e.default_max_new_tokens
@@ -698,17 +726,30 @@ class _DecodeReplicaImpl(_LLMServerImpl):
             rid = self.engine.add_request(
                 list(prompt_ids) + generated[:-1], rem + 1, temperature,
                 top_p=top_p, top_k=top_k, resume_token=generated[-1],
-                kv_handoff=handoff)
+                kv_handoff=handoff, logprobs=want_logp)
             self._token_subs[rid] = sub
+        req_obj = self.engine.request(rid) if want_logp else None
         del handoff
         ended = False
+        lp_i = 0  # cursor into req_obj.token_logprobs (append-only; the
+        # pump appends the k-th entry before it puts the k-th token)
+
+        def _pair(tok):
+            nonlocal lp_i
+            if req_obj is None:
+                return tok
+            lp = (float(req_obj.token_logprobs[lp_i])
+                  if lp_i < len(req_obj.token_logprobs) else None)
+            lp_i += 1
+            return (tok, lp)
+
         try:
             while True:
                 tok = sub.get(timeout=300)
                 if tok is None:
                     ended = True
                     return
-                chunk = [tok]
+                chunk = [_pair(tok)]
                 while len(chunk) < max(chunk_tokens, 1):
                     try:
                         nxt = sub.get_nowait()
@@ -717,7 +758,7 @@ class _DecodeReplicaImpl(_LLMServerImpl):
                     if nxt is None:
                         ended = True
                         break
-                    chunk.append(nxt)
+                    chunk.append(_pair(nxt))
                 # The mid-stream crash probe: one hit per emitted chunk,
                 # fired BEFORE the yield so the dying replica takes the
                 # chunk with it — the consumer must re-resolve from its
@@ -770,6 +811,12 @@ class _DisaggServerImpl:
         self._decode_inflight_tokens = 0
         self._ongoing = 0
         self._tok_rate_ema = 0.0  # decode tokens/s across the pool
+        # Live decode replica count (scales the decode token budget):
+        # refreshed on dispatch and on shed reports — starts at 1, the
+        # local-testing pool size, and never blocks the admission path.
+        self._n_decode_live = 1
+        self._shed_pending = 0      # sheds not yet reported upstream
+        self._shed_reporting = False
         # ---- routing state ----
         self._route_cache: dict = {}    # replica_id -> OrderedDict(keys)
         self._replica_load: dict = {}   # replica_id -> inflight tokens
@@ -793,6 +840,8 @@ class _DisaggServerImpl:
         d = self.d
         cost = n_prompt + max_new
         with self._lock:
+            decode_budget = (d.max_decode_inflight_tokens
+                             * max(1, self._n_decode_live))
             est_ms = None
             if d.admission_slo_ms is not None and self._tok_rate_ema > 1.0:
                 est_ms = 1e3 * (self._decode_inflight_tokens
@@ -804,7 +853,7 @@ class _DisaggServerImpl:
                     > d.max_prefill_queue_tokens):
                 shed_pool = "prefill"
             elif (self._decode_inflight_tokens + cost
-                    > d.max_decode_inflight_tokens):
+                    > decode_budget):
                 shed_pool = "decode"
             elif est_ms is not None and est_ms > d.admission_slo_ms:
                 shed_pool = "slo"
@@ -812,18 +861,70 @@ class _DisaggServerImpl:
                 self.counters["shed"] += 1
                 self.counters[f"shed_{shed_pool}"] += 1
                 _record_shed(shed_pool)
-                raise OverloadedError(
-                    "serving plane overloaded: request shed "
-                    f"(pool={shed_pool}, ongoing={self._ongoing}, "
-                    f"prefill_q={self._prefill_queue_tokens}tok, "
-                    f"decode_inflight={self._decode_inflight_tokens}tok"
-                    + (f", est_wait={est_ms:.0f}ms" if est_ms is not None
-                       else "") + ")")
-            self._ongoing += 1
-            self._prefill_queue_tokens += n_prompt
-            self._decode_inflight_tokens += cost
-            self.counters["admitted"] += 1
+                if shed_pool in ("decode", "slo"):
+                    # Decode-capacity signal: feed the serve autoscaler
+                    # (reported off-path; the shed itself stays fast).
+                    self._shed_pending += 1
+                msg = ("serving plane overloaded: request shed "
+                       f"(pool={shed_pool}, ongoing={self._ongoing}, "
+                       f"prefill_q={self._prefill_queue_tokens}tok, "
+                       f"decode_inflight={self._decode_inflight_tokens}"
+                       "tok"
+                       + (f", est_wait={est_ms:.0f}ms"
+                          if est_ms is not None else "") + ")")
+            else:
+                self._ongoing += 1
+                self._prefill_queue_tokens += n_prompt
+                self._decode_inflight_tokens += cost
+                self.counters["admitted"] += 1
+        if shed_pool is not None:
+            self._maybe_report_sheds()
+            raise OverloadedError(msg)
         return cost
+
+    def _maybe_report_sheds(self):
+        """Forward pending decode-capacity sheds to the serve controller
+        (record_shed_metrics on the DecodePool deployment) — the signal
+        the shed-rate autoscaler scales decode replicas on. The shed
+        path only flips a flag and (at most once per burst) spawns a
+        short-lived drainer thread, so a shed stays fast even when the
+        controller is busy; the drainer also refreshes the live-replica
+        count so the decode budget tracks scale-ups."""
+        if self._local_decode is not None:
+            return  # local-testing mode: no controller, fixed pool
+        with self._lock:
+            if self._shed_pending == 0 or self._shed_reporting:
+                return
+            self._shed_reporting = True
+        threading.Thread(target=self._shed_report_loop, daemon=True,
+                         name="disagg-shed-report").start()
+
+    def _shed_report_loop(self):
+        """Drain pending shed counts to the controller at ~2Hz until the
+        burst subsides (a storm's sheds land faster than one report per
+        shed could ship them; a trailing remainder must still reach the
+        autoscaler or the observed rate under-counts)."""
+        try:
+            while True:
+                with self._lock:
+                    delta = self._shed_pending
+                    self._shed_pending = 0
+                if delta == 0:
+                    return
+                try:
+                    router = self._decode_router()
+                    reps = router.live_replicas()
+                    if reps:
+                        with self._lock:
+                            self._n_decode_live = len(reps)
+                    router._controller().record_shed_metrics.remote(
+                        router.app, router.deployment, delta)
+                except Exception:  # noqa: BLE001 — best-effort reporting
+                    pass
+                time.sleep(0.5)
+        finally:
+            with self._lock:
+                self._shed_reporting = False
 
     def _release(self, cost: int, tokens_emitted: int, dt_s: float):
         with self._lock:
@@ -898,6 +999,9 @@ class _DisaggServerImpl:
         while True:
             reps = self._live_decode_replicas()
             if reps:
+                if self._local_decode is None:
+                    with self._lock:
+                        self._n_decode_live = len(reps)
                 rep = self._pick_by_prefix(reps, keys)
                 if chaos.site("serve.router.drop"):
                     # Injected: the routed dispatch vanished before the
@@ -944,7 +1048,8 @@ class _DisaggServerImpl:
 
     # ---- prefill + decode streams, with recovery ----
 
-    def _prefill_with_retry(self, ids, temperature, top_p, top_k) -> dict:
+    def _prefill_with_retry(self, ids, temperature, top_p, top_k,
+                            want_logp: bool = False) -> dict:
         """Prefill through the pool handle; worker death / timeout
         redrives through the shared backoff (the sealed handoff object,
         once exported, survives its worker's death)."""
@@ -952,19 +1057,21 @@ class _DisaggServerImpl:
         while True:
             try:
                 return self.prefill.prefill.remote(
-                    list(ids), temperature, top_p, top_k).result(
-                        timeout_s=60)
+                    list(ids), temperature, top_p, top_k,
+                    want_logp).result(timeout_s=60)
             except (ActorDiedError, GetTimeoutError) as e:
                 if not bo.sleep():
                     raise RayTpuError(
                         f"prefill pool unavailable: {e}") from e
 
     def _open_decode_stream(self, rep, ids, generated, kv, max_new,
-                            temperature, top_p, top_k):
-        """One decode stream attempt on one replica: yields token chunks;
-        raises RayTpuError when the replica dies mid-stream."""
+                            temperature, top_p, top_k,
+                            want_logp: bool = False):
+        """One decode stream attempt on one replica: yields token chunks
+        ((token, logprob) pair chunks with want_logp); raises RayTpuError
+        when the replica dies mid-stream."""
         args = [list(ids), list(generated), kv, max_new, temperature,
-                top_p, top_k, self.d.stream_chunk_tokens]
+                top_p, top_k, self.d.stream_chunk_tokens, want_logp]
         if self._local_decode is not None:
             yield from self._local_decode.decode_stream(*args)
             return
@@ -979,19 +1086,29 @@ class _DisaggServerImpl:
             router.release_streaming(rep.replica_id)
 
     def _stream_tokens(self, ids, generated, kv, max_new, temperature,
-                       top_p, top_k, cost: int):
+                       top_p, top_k, cost: int, logps: list | None = None):
         """Yield the tokens after `generated` EXACTLY ONCE, re-resolving
         the stream on a surviving replica when a decode replica dies
         mid-flight. `generated` is mutated in place (the recovery cursor:
-        a resumed stream continues from the last delivered position)."""
+        a resumed stream continues from the last delivered position).
+        When `logps` is a list, the decode pool streams (token, logprob)
+        pairs and logps grows in lockstep with generated — a resumed
+        stream keeps the logprobs of already-delivered positions (they
+        were never re-decoded) and appends only the new ones."""
         bo = Backoff(deadline_s=self.d.resume_deadline_s)
+        want_logp = logps is not None
         while len(generated) < max_new and generated[-1] != self._eos:
             rep = self._dispatch_decode(ids, cost)
             try:
                 for chunk in self._open_decode_stream(
                         rep, ids, generated, kv, max_new, temperature,
-                        top_p, top_k):
-                    for tok in chunk:
+                        top_p, top_k, want_logp):
+                    for item in chunk:
+                        if want_logp:
+                            tok, lp = item
+                            logps.append(lp)
+                        else:
+                            tok = item
                         generated.append(int(tok))
                         yield int(tok)
                     bo.reset()  # progress restarts the recovery budget
@@ -1010,49 +1127,53 @@ class _DisaggServerImpl:
                 self._unload(rep, cost)
 
     def _run_admitted(self, ids, max_new, temperature, top_p, top_k,
-                      cost: int) -> list:
-        """Prefill -> route -> stream to completion; returns all tokens
-        (admission already charged; released here)."""
+                      cost: int, want_logp: bool = False) -> tuple:
+        """Prefill -> route -> stream to completion; returns
+        (tokens, logprobs-or-None) (admission already charged; released
+        here)."""
         t0 = time.monotonic()
         toks: list = []
+        logps: list | None = [] if want_logp else None
         try:
             try:
                 pre = self._prefill_with_retry(ids, temperature, top_p,
-                                               top_k)
+                                               top_k, want_logp)
             finally:
                 self._release_prefill(len(ids))
             kv = pre["kv"] if self.d.handoff else None
             self.counters["handoff_tokens"] += (pre["kv_tokens"]
                                                 if kv is not None else 0)
             toks = [pre["first"]]
+            if want_logp:
+                logps.append(pre.get("first_logp"))
             if toks[0] != self._eos:
                 for tok in self._stream_tokens(
                         ids, toks, kv, max_new, temperature, top_p,
-                        top_k, cost):
-                    pass  # _stream_tokens appends into toks
+                        top_k, cost, logps):
+                    pass  # _stream_tokens appends into toks/logps
             self.counters["completed"] += 1
-            return toks
+            return toks, logps
         finally:
             self._release(cost, len(toks), time.monotonic() - t0)
 
     # ---- request surface (mirrors _LLMServerImpl) ----
 
-    def _check_plain(self, model, guided_regex=None, guided_json=None,
-                     logprobs=None):
+    def _check_plain(self, model, guided_regex=None, guided_json=None):
         if model is not None and model != self.cfg.model_id:
             raise ValueError(
                 f"model {model!r}: the disaggregated plane serves only "
                 f"the base model {self.cfg.model_id!r}")
-        if guided_regex or guided_json or logprobs:
-            raise ValueError("guided decoding / logprobs are not "
-                             "supported on the disaggregated plane")
+        if guided_regex or guided_json:
+            raise ValueError("guided decoding is not supported on the "
+                             "disaggregated plane")
 
     async def completions(self, prompt: str, *, max_tokens=None,
                           temperature=None, top_p: float = 1.0,
                           top_k: int = 0, model=None, guided_regex=None,
                           guided_json=None, stop=None,
                           logprobs=None) -> dict:
-        self._check_plain(model, guided_regex, guided_json, logprobs)
+        self._check_plain(model, guided_regex, guided_json)
+        want_logp = bool(logprobs)
         ids = self.tokenizer.encode(prompt)
         max_new = max_tokens or self._max_new_default
         # Admission runs HERE, on the replica's event loop, before any
@@ -1060,16 +1181,23 @@ class _DisaggServerImpl:
         # worker thread is busy decoding admitted traffic.
         cost = self._admit(len(ids), max_new)
         loop = asyncio.get_running_loop()
-        toks = await loop.run_in_executor(
+        toks, logps = await loop.run_in_executor(
             self._pool, self._run_admitted, ids, max_new, temperature,
-            top_p, top_k, cost)
+            top_p, top_k, cost, want_logp)
         text = self.tokenizer.decode(toks)
         text, stopped = _LLMServerImpl._apply_stop(text, stop)
+        lp = None
+        if want_logp:
+            # Same alignment helper as the dense replica: logprobs
+            # gathered across prefill-export, the decode stream, and any
+            # mid-stream resumes read as ONE per-token array.
+            lp = _logprob_fields(self.tokenizer, text, stopped, toks,
+                                 logps or [])
         return {
             "id": f"cmpl-{uuid.uuid4().hex[:24]}",
             "object": "text_completion",
             "model": self.cfg.model_id,
-            "choices": [{"index": 0, "text": text, "logprobs": None,
+            "choices": [{"index": 0, "text": text, "logprobs": lp,
                          "finish_reason": "stop" if stopped else
                          ("length" if len(toks) >= max_new else "stop")}],
             "usage": {"prompt_tokens": len(ids),
@@ -1167,7 +1295,8 @@ class _DisaggServerImpl:
                 ongoing=self._ongoing,
                 prefill_queue_tokens=self._prefill_queue_tokens,
                 decode_inflight_tokens=self._decode_inflight_tokens,
-                decode_tok_rate_ema=round(self._tok_rate_ema, 1))
+                decode_tok_rate_ema=round(self._tok_rate_ema, 1),
+                n_decode_live=self._n_decode_live)
         return out
 
 
@@ -1187,6 +1316,10 @@ def build_disagg_deployment(llm_config: LLMConfig,
         _DecodeReplicaImpl, name=f"DecodePool:{mid}").options(
         num_replicas=d.decode_replicas,
         health_check_period_s=0.5,
+        # Shed-rate autoscaling (DisaggConfig.decode_autoscale): the
+        # coordinator attributes decode-capacity sheds to this pool and
+        # the controller grows it when the rate sustains.
+        autoscaling_config=d.decode_autoscale,
         ray_actor_options={"num_tpus": llm_config.num_tpus_per_replica},
     ).bind(llm_config)
     coord = serve.deployment(

@@ -28,6 +28,14 @@ class AutoscalingConfig:
     upscale_smoothing_factor: float = 1.0
     downscale_smoothing_factor: float = 1.0
     initial_replicas: Optional[int] = None
+    # Admission-shed-driven scale-UP (the serving plane's overload
+    # signal, `ray_tpu_serve_shed_total{pool=...}`): when reporters
+    # attribute >= this many sheds/second (sustained over
+    # shed_window_s) to this deployment, the controller targets one
+    # more replica — bounded by max_replicas and paced by
+    # upscale_delay_s like any other upscale decision. None = off.
+    upscale_shed_rate: Optional[float] = None
+    shed_window_s: float = 5.0
 
     def __post_init__(self):
         if self.min_replicas < 0 or self.max_replicas < max(1, self.min_replicas):
@@ -35,6 +43,10 @@ class AutoscalingConfig:
                 "need 0 <= min_replicas <= max_replicas and max_replicas >= 1")
         if self.target_ongoing_requests <= 0:
             raise ValueError("target_ongoing_requests must be > 0")
+        if self.upscale_shed_rate is not None and self.upscale_shed_rate <= 0:
+            raise ValueError("upscale_shed_rate must be > 0 (or None)")
+        if self.shed_window_s <= 0:
+            raise ValueError("shed_window_s must be > 0")
 
 
 @dataclasses.dataclass

@@ -55,6 +55,7 @@ class _DeploymentState:
         self.snapshot_version = 0
         # autoscaling bookkeeping
         self.handle_metrics: dict = {}         # reporter -> (count, ts)
+        self.shed_events: list = []            # (count_delta, ts) reports
         self.last_scale_up = 0.0
         self.last_scale_down = 0.0
         self.scale_decision_since = None
@@ -185,6 +186,23 @@ class ServeController:
             return
         ds.handle_metrics[reporter_id or "default"] = (ongoing, time.monotonic())
 
+    async def record_shed_metrics(self, app_name, deployment_name,
+                                  shed_delta: int):
+        """Admission-shed report attributed to `deployment_name` (the
+        `ray_tpu_serve_shed_total{pool=...}` signal, forwarded by the
+        coordinator that runs admission control): feeds the shed-rate
+        upscale rule in _autoscale."""
+        ds = self._get_ds(app_name, deployment_name)
+        if ds is None or shed_delta <= 0:
+            return
+        now = time.monotonic()
+        ds.shed_events.append((int(shed_delta), now))
+        # Bound the ledger: only the configured window ever matters.
+        ac = ds.config.autoscaling_config
+        horizon = (ac.shed_window_s if ac is not None else 60.0) + 60.0
+        ds.shed_events = [(c, t) for c, t in ds.shed_events
+                          if now - t < horizon]
+
     # ---------------- introspection ----------------
     async def get_status(self):
         out = {}
@@ -265,6 +283,15 @@ class ServeController:
         desired = math.ceil(
             total_ongoing / ac.target_ongoing_requests) if fresh else (
                 ds.target_num_replicas)
+        if ac.upscale_shed_rate is not None:
+            # Overload signal: sustained admission-shed rate attributed
+            # to this pool asks for one more replica regardless of the
+            # queue-depth estimate (a shedding pool's ongoing count is
+            # capped BY the shedding — queue depth alone never sees it).
+            window = [c for c, ts in ds.shed_events
+                      if now - ts < ac.shed_window_s]
+            if sum(window) / ac.shed_window_s >= ac.upscale_shed_rate:
+                desired = max(desired, ds.target_num_replicas + 1)
         desired = max(ac.min_replicas, min(desired, ac.max_replicas))
         cur = ds.target_num_replicas
         if desired == cur:

@@ -216,9 +216,35 @@ def test_cluster_view_broadcast_is_cursor_delta():
                 "idle" in rt._cview.get(n.node_id, {}) for n in agents):
             time.sleep(0.05)
         target, other = agents[0], agents[1]
-        sent = []
-        real_send = target.conn.send
-        target.conn.send = lambda m: (sent.append(m), real_send(m))
+
+        # The broadcast is encoded once and sendall'd raw (PR 14's
+        # encode-once fan-out), so the spy sits at the SOCKET and
+        # decodes frames back; conn.send-level spying would miss it.
+        from ray_tpu.core.transport import FrameBuffer
+
+        class SockSpy:
+            def __init__(self, sock):
+                self._s = sock
+                self.sent = []
+
+            def sendall(self, b):
+                self.sent.append(bytes(b))
+                return self._s.sendall(b)
+
+            def __getattr__(self, a):
+                return getattr(self._s, a)
+
+        def cview_frames(spy):
+            fb = FrameBuffer()
+            for b in spy.sent:
+                fb.feed(b)
+            return [m for m in fb.frames()
+                    if isinstance(m, tuple) and m
+                    and m[0] == "cluster_view"]
+
+        spy = SockSpy(target.conn.sock)
+        real_sock = target.conn.sock
+        target.conn.sock = spy
         try:
             # Make ONE entry newer than everything else, then roll the
             # target's cursor back to just before that change: the next
@@ -227,26 +253,30 @@ def test_cluster_view_broadcast_is_cursor_delta():
             v_before = rt._cview[other.node_id]["v"] - 1
             target.cview_cursor = v_before
             rt._broadcast_cluster_view()
-            frames = [m for m in sent if m[0] == "cluster_view"]
-            assert frames, sent
+            frames = cview_frames(spy)
+            assert frames, spy.sent
             _, version, entries = frames[-1]
             assert version == rt._cview_version
             sent_nids = {nid for nid, _e in entries}
-            assert sent_nids == {other.node_id}, sent_nids
+            # The shared encode-once frame may carry the target's own
+            # entry too (every agent-side consumer skips nid == self);
+            # the DELTA contract is about versions, not the elide.
+            assert other.node_id in sent_nids, sent_nids
             assert all(e["v"] > v_before for _nid, e in entries)
+            assert all(e["v"] > v_before for nid, e in entries
+                       if nid == other.node_id)
             # Caught up: the next pass sends this agent nothing.
-            sent.clear()
+            spy.sent.clear()
             rt._broadcast_cluster_view()
-            assert not [m for m in sent if m[0] == "cluster_view"], sent
-            # An agent's own entry never rides its broadcast (it is the
-            # authority on its own load).
+            assert not cview_frames(spy), spy.sent
+            # Cursor rollback to zero = the full-view catch-up.
             target.cview_cursor = 0
-            sent.clear()
+            spy.sent.clear()
             rt._broadcast_cluster_view()
-            _, _v, full = [m for m in sent if m[0] == "cluster_view"][-1]
-            assert target.node_id not in {nid for nid, _e in full}
+            _, _v, full = cview_frames(spy)[-1]
+            assert other.node_id in {nid for nid, _e in full}
         finally:
-            target.conn.send = real_send
+            target.conn.sock = real_sock
     finally:
         c.shutdown()
 

@@ -44,6 +44,15 @@ def core():
     c.close()
 
 
+@pytest.fixture()
+def hcore():
+    from ray_tpu._native import head_core as HC
+    assert HC.available(), f"head_core build failed: {HC._lib_err!r}"
+    c = HC.HeadCore()
+    yield c
+    c.close()
+
+
 def test_pump_grant_dispatch_done_roundtrip(core):
     """The whole native hot loop over socketpairs: node_exec_raw ingest
     (dedup included), planned dispatch with reg_fn-before-exec ordering,
@@ -182,14 +191,163 @@ def test_worker_death_drains_native_inflight(core):
     wb.close()
 
 
+# ---------------- head core (cpp/head_core.cc) unit tier ----------------
+
+
+def test_head_core_grant_build_matches_python_frames(hcore):
+    """The native grant builder's node_exec_raw frame is byte-compatible
+    with the Python path: FrameBuffer decodes it to the identical entry
+    tuples, and an agent core ingests it through the same restricted
+    walker that consumes Python-built grants."""
+    from ray_tpu._native import agent_core as AC
+    from ray_tpu.core.transport import FrameBuffer
+
+    na, nb = socket.socketpair()
+    tag = hcore.alloc_tag()
+    hcore.add_fd(nb.fileno(), tag)
+    nidx = hcore.node_add(tag)
+
+    spec = b"SPECBYTES" * 40
+    hcore.grant_add(nidx, b"T" * 16, b"F" * 16, 3, b"BLOB", spec, 1, "fx")
+    hcore.grant_add(nidx, b"U" * 16, None, 1, None, b"S2", 0, None)
+    assert hcore.inflight() == 2
+    buf = bytes(hcore.grant_take(nidx))
+    fb = FrameBuffer()
+    fb.feed(buf)
+    (msg,) = fb.frames()
+    assert msg == ("node_exec_raw",
+                   [(b"T" * 16, b"F" * 16, 3, b"BLOB", spec, 1, "fx"),
+                    (b"U" * 16, None, 1, None, b"S2", 0, None)])
+    assert not len(hcore.grant_take(nidx))  # double-buffer drained
+
+    ac = AC.AgentCore()
+    ha, hb = socket.socketpair()
+    ac.add_fd(hb.fileno(), AC.HEAD_TAG)
+    ha.sendall(buf)
+    assert ac.poll(2000) == 1
+    ac.split()
+    assert ac.consume_hot() == 1 and ac.backlog() == 2
+    ac.close()
+    for s in (na, nb, ha, hb):
+        s.close()
+
+
+def test_head_core_completion_ledger_roundtrip(hcore):
+    """node_done_raw consumption in place: done + done_batch + the
+    piggybacked exec record parse into flat completion records, the
+    (task_id, lease_seq) ledger pops exactly once (a replayed completion
+    surfaces known=False), and the outs rebuild to the exact tuples
+    _on_node_done consumes."""
+    na, nb = socket.socketpair()
+    tag = hcore.alloc_tag()
+    hcore.add_fd(nb.fileno(), tag)
+    nidx = hcore.node_add(tag)
+    hcore.grant_add(nidx, b"T" * 16, None, 1, None, b"S", 0, None)
+    hcore.grant_add(nidx, b"U" * 16, None, 1, None, b"S", 0, None)
+
+    d1 = _frame(("done", b"T" * 16, None,
+                 [(b"R" * 16, "inline", b"payload", [])],
+                 (1, 0.125, 0.25, 0.5, 123.75)))
+    d2 = _frame(("done_batch",
+                 [(b"U" * 16, None, [(b"S" * 16, "shm", None, None)])]))
+    na.sendall(_frame(("node_done_raw", "aa" * 8, [d1, d2])))
+    assert hcore.poll(2000) == 1
+    hcore.split()
+    assert hcore.consume_hot() == 1
+    recs = list(hcore.completions())
+    assert [(r[0], r[1], r[2], r[3]) for r in recs] == [
+        (nidx, True, b"T" * 16, "aa" * 8),
+        (nidx, True, b"U" * 16, "aa" * 8)]
+    assert recs[0][4] == [(b"R" * 16, "inline", b"payload", [])]
+    assert recs[0][5] == (1, 0.125, 0.25, 0.5, 123.75)
+    assert recs[1][4] == [(b"S" * 16, "shm", None, None)]
+    assert recs[1][5] is None
+    assert not list(hcore.frames())  # fully consumed natively
+    assert hcore.inflight() == 0
+    hcore.round_end()
+
+    # Replay (a redrive raced the original): parsed again, but the
+    # ledger entry is gone — known=False, Python's pop stays decider.
+    na.sendall(_frame(("node_done_raw", "aa" * 8, [d1])))
+    hcore.poll(2000)
+    hcore.split()
+    assert hcore.consume_hot() == 1
+    ((_n, known, tid, _w, _o, _t),) = list(hcore.completions())
+    assert known is False and tid == b"T" * 16
+    hcore.round_end()
+    na.close()
+    nb.close()
+
+
+def test_head_core_bails_to_python_on_foreign_shapes(hcore):
+    """Actor completions, oob-buffer frames and unknown shapes are never
+    consumed natively — the whole node_done_raw frame surfaces to Python
+    intact (two-phase commit: no half-consumed frame)."""
+    na, nb = socket.socketpair()
+    tag = hcore.alloc_tag()
+    hcore.add_fd(nb.fileno(), tag)
+    hcore.node_add(tag)
+    # an actor done (actor_id not None) inside an otherwise-fine batch
+    d_ok = _frame(("done", b"T" * 16, None, [], None))
+    d_actor = _frame(("done", b"V" * 16, b"A" * 16, [], None))
+    weird = ("node_done_raw", "bb" * 8, [d_ok, d_actor])
+    na.sendall(_frame(weird))
+    # a node_done_raw whose inner frame carries oob buffers
+    d_bufs = _frame(("done", b"W" * 16, None, [], None),
+                    bufs=(b"oob",))
+    na.sendall(_frame(("node_done_raw", "bb" * 8, [d_bufs])))
+    hcore.poll(2000)
+    hcore.split()
+    assert hcore.consume_hot() == 0
+    assert not list(hcore.completions())
+    left = [pickle.loads(f[3]) for f in hcore.frames()]
+    assert left[0] == weird
+    assert left[1][0] == "node_done_raw"
+    hcore.round_end()
+    na.close()
+    nb.close()
+
+
+def test_head_core_accept_readiness_and_unregistered_conns(hcore):
+    """Accept sockets surface KIND_ACCEPT records (never recv'd in C++),
+    and node_done_raw arriving on a conn with no registered node slot
+    falls through to Python."""
+    from ray_tpu._native import head_core as HC
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    srv.setblocking(False)
+    atag = hcore.alloc_tag()
+    hcore.add_fd(srv.fileno(), atag, accept=True)
+    cli = socket.create_connection(srv.getsockname())
+
+    na, nb = socket.socketpair()
+    tag = hcore.alloc_tag()
+    hcore.add_fd(nb.fileno(), tag)  # registered fd, NO node_add
+    d = _frame(("done", b"T" * 16, None, [], None))
+    na.sendall(_frame(("node_done_raw", "cc" * 8, [d])))
+    hcore.poll(2000)
+    hcore.split()
+    assert hcore.consume_hot() == 0
+    kinds = {(f[0], f[1]) for f in hcore.frames()}
+    assert (atag, HC.KIND_ACCEPT) in kinds
+    assert (tag, HC.KIND_PICKLE) in kinds
+    hcore.round_end()
+    for s in (cli, srv, na, nb):
+        s.close()
+
+
 # ---------------- cluster tier ----------------
 
 
 def test_native_plane_on_the_wire_and_correct():
-    """Default config (native_sched on): the head grants via
-    node_exec_raw, agents complete via node_done_raw, and a fan-out of
-    tasks over 2 agents returns correct results."""
-    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    """Default config (native_sched + native_head on): the head grants
+    via natively-built node_exec_raw frames, agents complete via
+    node_done_raw batches the head core consumes in place, and a fan-out
+    of tasks over 2 agents returns correct results. The head runs no
+    tasks itself (num_cpus=0) so every completion crosses the native
+    lease plane."""
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 0})
     c.add_node(num_cpus=1)
     c.add_node(num_cpus=1)
     c.wait_for_nodes(3)
@@ -197,13 +355,7 @@ def test_native_plane_on_the_wire_and_correct():
         from ray_tpu.core.runtime import get_runtime
         rt = get_runtime()
         assert rt.config.native_sched
-        sent_ops = []
-        for node in rt.nodes.values():
-            if node.conn is None:
-                continue
-            real = node.conn.send
-            node.conn.send = (lambda m, _r=real: (sent_ops.append(m[0]),
-                                                  _r(m))[1])
+        assert rt.config.native_head and rt._hnat is not None
 
         @ray_tpu.remote(num_cpus=1)
         def f(x):
@@ -211,11 +363,13 @@ def test_native_plane_on_the_wire_and_correct():
 
         out = ray_tpu.get([f.remote(i) for i in range(60)], timeout=120)
         assert out == [i * 3 for i in range(60)]
-        flat = set(sent_ops)
-        for node in rt.nodes.values():
-            if node.conn is not None:
-                del node.conn.send  # restore the class method
-        assert "node_exec_raw" in flat, flat  # the native grant plane ran
+        stats = rt._hnat.stats()
+        # The native grant plane ran end to end: grants were built in
+        # C++, completions parsed + ledger-popped in C++, and nothing
+        # leaked in the (task_id, lease_seq) mirror.
+        assert stats["native_grants"] >= 60, stats
+        assert stats["native_dones"] >= 1, stats
+        assert rt._hnat.inflight() == 0
     finally:
         c.shutdown()
 
@@ -242,15 +396,20 @@ def test_native_off_equivalence():
         c.shutdown()
 
 
-def test_native_chaos_storm_same_seeded_sites():
+@pytest.mark.parametrize("native_head", [True, False],
+                         ids=["head_on", "head_off"])
+def test_native_chaos_storm_same_seeded_sites(native_head):
     """The PR 8 chaos schedule drives the native loop through the same
     seeded fault sites: a lost lease grant (head.lease_grant.lose → the
     lease watchdog re-drives it and the C++ dedup table absorbs the
     duplicate) and a mid-storm worker SIGKILL (worker.exec.kill → the
     native inflight table drains into lease_fail replay — the
     dispatch-vs-worker-death race). Every task resolves exactly once.
-    Chaos-armed rounds route sends through send_msg, so the sites fire
-    per frame while the C++ ledger keeps the bookkeeping."""
+    Chaos-armed rounds route sends through send_msg (and the head skips
+    native consumption), so the sites fire per frame while the C++
+    ledgers keep the bookkeeping. Parametrized over `native_head` — the
+    PR 14 chaos-equivalence contract: the storm's outcome is identical
+    with the head core on and off."""
     c = Cluster(initialize_head=True, head_node_args={
         "num_cpus": 1,
         "_system_config": {
@@ -258,10 +417,14 @@ def test_native_chaos_storm_same_seeded_sites():
                                "worker.exec.kill:30"),
             "chaos_seed": 1234,
             "lease_redrive_timeout_s": 1.0,
+            "native_head": native_head,
         }})
     c.add_node(num_cpus=2)
     c.wait_for_nodes(2)
     try:
+        from ray_tpu.core.runtime import get_runtime
+        assert (get_runtime()._hnat is not None) == native_head
+
         @ray_tpu.remote(num_cpus=1, max_retries=4)
         def f(x):
             return x + 1000

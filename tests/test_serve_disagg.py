@@ -17,7 +17,8 @@ from ray_tpu.core import chaos
 from ray_tpu.core.status import OverloadedError
 from ray_tpu.llm import (DisaggConfig, EngineConfig, InferenceEngine,
                          LLMConfig, PrefillEngine, build_disagg_deployment,
-                         build_disagg_openai_app, build_openai_app)
+                         build_disagg_openai_app, build_llm_deployment,
+                         build_openai_app)
 from ray_tpu.llm.tokenizer import get_tokenizer
 from ray_tpu.models import ModelConfig
 
@@ -45,6 +46,21 @@ def _reference_texts(params, prompts, max_new):
     eng = InferenceEngine(TINY, ENG, params=params)
     return {p: tok.decode(eng.generate([tok.encode(p)], max_new, 0.0)[0])
             for p in prompts}
+
+
+def _reference_logprobs(params, prompts, max_new):
+    """Greedy per-token logprobs through a plain single engine (the
+    monolithic twin of prefill-export + decode-import)."""
+    tok = get_tokenizer("byte")
+    eng = InferenceEngine(TINY, ENG, params=params)
+    out = {}
+    for p in prompts:
+        rid = eng.add_request(tok.encode(p), max_new, 0.0, logprobs=True)
+        while eng.has_work():
+            eng.step()
+        req = eng.finished.pop(rid)
+        out[p] = (req.generated, list(req.token_logprobs))
+    return out
 
 
 def test_prefill_export_import_matches_engine(tiny_llm_params):
@@ -103,6 +119,40 @@ def test_disagg_local_mode_matches_dense(tiny_llm_params):
     ref = h_ref.remote(Req()).result(timeout_s=120)
     assert out["choices"][0]["text"] == ref["choices"][0]["text"]
     assert out["usage"] == ref["usage"]
+
+
+def test_disagg_logprobs_match_dense_path(tiny_llm_params):
+    """ROADMAP item 1 (today they 400'd): logprobs thread through
+    prefill-export (first token's logp rides the handoff dict) →
+    decode-import ((token, logprob) pair chunks) and come out identical
+    to the dense replica's — same tokens, same values, same
+    stop-truncation alignment via the shared _logprob_fields helper."""
+    from ray_tpu import serve as serve_api
+    _cfg_obj, params = tiny_llm_params
+    refs = _reference_logprobs(params, ["logprob parity probe!"], 6)
+
+    h_d = serve_api.run(build_disagg_deployment(_cfg(6)),
+                        local_testing_mode=True)
+    h_ref = serve_api.run(build_llm_deployment(_cfg(6)),
+                          local_testing_mode=True)
+    out = h_d.completions.remote("logprob parity probe!", max_tokens=6,
+                                 temperature=0.0,
+                                 logprobs=1).result(timeout_s=240)
+    ref = h_ref.completions.remote("logprob parity probe!", max_tokens=6,
+                                   temperature=0.0,
+                                   logprobs=1).result(timeout_s=240)
+    assert out["choices"][0]["text"] == ref["choices"][0]["text"]
+    lp_d = out["choices"][0]["logprobs"]
+    lp_r = ref["choices"][0]["logprobs"]
+    assert lp_d["tokens"] == lp_r["tokens"]
+    assert lp_d["token_logprobs"] == pytest.approx(
+        lp_r["token_logprobs"], abs=1e-4)
+    # ...and against the from-scratch single-engine reference.
+    _toks, ref_lps = refs["logprob parity probe!"]
+    assert lp_d["token_logprobs"] == pytest.approx(ref_lps, abs=1e-4)
+    # Guided decoding stays rejected (the 400 that REMAINS by design).
+    with pytest.raises(Exception, match="guided"):
+        h_d.completions.remote("x", guided_regex="a+").result(timeout_s=60)
 
 
 def test_overload_sheds_fast_while_admitted_complete(tiny_llm_params):
@@ -205,6 +255,7 @@ def test_decode_sigkill_mid_storm_resumes_exactly_once(ray_start_regular,
     prompts = [f"shared prefix req {i}" for i in range(6)]
     _tiny_cfg, params = tiny_llm_params  # == the replicas' seed-0 init
     refs = _reference_texts(params, prompts, 10)
+    ref_lps = _reference_logprobs(params, prompts[:2], 10)
 
     app = build_disagg_deployment(cfg, DisaggConfig(decode_replicas=2))
     serve_api.run(app, name="disagg-kill", route_prefix=None,
@@ -226,8 +277,14 @@ def test_decode_sigkill_mid_storm_resumes_exactly_once(ray_start_regular,
 
         def one(p):
             try:
+                # The first two prompts also carry logprobs through the
+                # storm: a mid-stream kill must resume the logprob
+                # stream exactly-once too (delivered positions keep
+                # their original values; only new positions append).
                 results[p] = h.completions.remote(
-                    p, max_tokens=10, temperature=0.0).result(timeout_s=240)
+                    p, max_tokens=10, temperature=0.0,
+                    logprobs=1 if p in ref_lps else None).result(
+                    timeout_s=240)
             except Exception as e:  # noqa: BLE001 — recorded + asserted
                 errs[p] = repr(e)
 
@@ -244,9 +301,88 @@ def test_decode_sigkill_mid_storm_resumes_exactly_once(ray_start_regular,
         for p in prompts:
             assert results[p]["choices"][0]["text"] == refs[p], p
             assert results[p]["usage"]["completion_tokens"] == 10
+        for p, (_toks, lps) in ref_lps.items():
+            got = results[p]["choices"][0]["logprobs"]
+            assert got is not None, p
+            assert got["token_logprobs"] == pytest.approx(lps,
+                                                          abs=1e-4), p
         assert stats["completed"] == len(prompts)
     finally:
         serve_api.delete("disagg-kill")
+
+
+def test_shed_rate_autoscales_decode_pool(ray_start_regular,
+                                          tiny_llm_params):
+    """ROADMAP item 1's missing wire: a sustained admission-shed rate
+    (the `ray_tpu_serve_shed_total{pool=...}` signal, forwarded by the
+    coordinator as record_shed_metrics) makes the serve controller grow
+    the DecodePool, and — because the coordinator's decode token budget
+    is per LIVE replica — the shed rate then drops: a wave that shed
+    before the scale-up admits fully after it."""
+    from ray_tpu import serve as serve_api
+
+    max_new = 8
+    prompts = [f"autoscale probe {i}" for i in range(6)]
+    # Budget fits ~2 requests per replica: cost = prompt(~17) + 8.
+    disagg = DisaggConfig(
+        decode_replicas=1,
+        max_decode_inflight_tokens=60,
+        decode_autoscale=dict(min_replicas=1, max_replicas=2,
+                              upscale_shed_rate=0.2, shed_window_s=8.0,
+                              upscale_delay_s=0.2))
+    app = build_disagg_deployment(_cfg(max_new), disagg)
+    serve_api.run(app, name="disagg-auto", route_prefix=None,
+                  http_port=8129, blocking_timeout_s=240)
+    try:
+        h = serve_api.get_deployment_handle("DisaggLLMServer:tiny",
+                                            "disagg-auto")
+
+        def wave(ps):
+            done, shed = [], []
+
+            def one(p):
+                try:
+                    done.append(h.completions.remote(
+                        p, max_tokens=max_new,
+                        temperature=0.0).result(timeout_s=240))
+                except OverloadedError:
+                    shed.append(p)
+
+            ts = [threading.Thread(target=one, args=(p,)) for p in ps]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=240)
+            return done, shed
+
+        done1, shed1 = wave(prompts)
+        assert shed1, "the storm must overflow the 1-replica budget"
+        assert done1, "backpressure must not starve everything"
+
+        # The controller acts on the reported rate: DecodePool -> 2.
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            st = serve_api.status()["disagg-auto"]["deployments"]
+            dp = st["DecodePool:tiny"]
+            if dp["running_replicas"] >= 2:
+                break
+            time.sleep(0.5)
+        else:
+            raise AssertionError(f"decode pool never scaled up: {st}")
+
+        # One probe dispatch refreshes the coordinator's live count...
+        h.completions.remote(prompts[0], max_tokens=max_new,
+                             temperature=0.0).result(timeout_s=240)
+        stats = h.stats.remote().result(timeout_s=30)
+        assert stats["n_decode_live"] >= 2, stats
+        # ...and the doubled budget admits the 4-wide wave that WOULD
+        # have shed at one replica (2x60 >= 4 x ~25 tokens): the shed
+        # rate dropped to zero with the extra replica.
+        done2, shed2 = wave(prompts[:4])
+        assert not shed2, f"post-scale-up wave still shed: {shed2}"
+        assert len(done2) == 4
+    finally:
+        serve_api.delete("disagg-auto")
 
 
 def test_shed_metric_per_pool_and_prometheus_escaping():
@@ -265,9 +401,13 @@ def test_shed_metric_per_pool_and_prometheus_escaping():
             d=DisaggConfig(**cfg), _lock=threading.Lock(),
             _prefill_queue_tokens=0, _decode_inflight_tokens=0,
             _ongoing=0, _tok_rate_ema=0.0,
+            _n_decode_live=1, _shed_pending=0, _shed_reporting=False,
+            _local_decode=object(),  # short-circuits the shed reporter
             counters=collections.Counter())
         coord._admit = types.MethodType(
             serve_mod._DisaggServerImpl._admit, coord)
+        coord._maybe_report_sheds = types.MethodType(
+            serve_mod._DisaggServerImpl._maybe_report_sheds, coord)
         return coord
 
     def shed_counts():

@@ -198,23 +198,51 @@ def test_wire_drift_catches_wire_type_change(tmp_path):
                for f in fs), [f.render() for f in fs]
 
 
-def test_wire_drift_catches_agent_core_sniffer_renumber(tmp_path):
-    """The native select-round core's AgentFrame sniffer table
-    (cpp/agent_core.cc kAgentFrameTags) is pinned both ways: a seeded
-    renumber in the C++ table flags (bad tag AND the orphaned proto
-    field), and dropping an entry flags the blind spot."""
-    src = open(os.path.join(REPO, wire_drift.AGENT_CORE_REL)).read()
+def test_wire_drift_catches_frame_tag_sniffer_renumber(tmp_path):
+    """The native cores' SHARED AgentFrame sniffer table
+    (cpp/frame_core.h kAgentFrameTags, compiled into both agent_core.cc
+    and head_core.cc) is pinned both ways: a seeded renumber in the C++
+    table flags (bad tag AND the orphaned proto field), and dropping an
+    entry flags the blind spot."""
+    src = open(os.path.join(REPO, wire_drift.FRAME_CORE_REL)).read()
     assert '{2, "heartbeat"}' in src
-    p = tmp_path / "agent_core.cc"
+    p = tmp_path / "frame_core.h"
     p.write_text(src.replace('{2, "heartbeat"}', '{19, "heartbeat"}'))
-    fs = wire_drift.run(REPO, agent_core_path=str(p))
+    fs = wire_drift.run(REPO, frame_core_path=str(p))
     assert any("tag 19" in f.detail for f in fs), [f.render() for f in fs]
     assert any("AgentFrame.heartbeat" in f.detail and "missing" in f.detail
                for f in fs), [f.render() for f in fs]
     # rename-only drift: number right, name wrong
     p.write_text(src.replace('{2, "heartbeat"}', '{2, "heartbeet"}'))
-    fs = wire_drift.run(REPO, agent_core_path=str(p))
+    fs = wire_drift.run(REPO, frame_core_path=str(p))
     assert any("heartbeet" in f.detail for f in fs), [f.render() for f in fs]
+
+
+def test_wire_drift_catches_native_core_escaping_shared_table(tmp_path):
+    """PR 14's head-half pin: a native core that stops including
+    frame_core.h (or re-declares kAgentFrameTags locally) escapes the
+    shared pin — both directions are findings against the .cc itself."""
+    head_src = open(os.path.join(REPO, "cpp", "head_core.cc")).read()
+    agent_src = open(os.path.join(REPO, "cpp", "agent_core.cc")).read()
+    # clean twins: the real cores pass
+    assert wire_drift.check_native_cores_share_table(REPO) == []
+    # (a) dropped include
+    p1 = tmp_path / "head_core.cc"
+    p1.write_text(head_src.replace('#include "frame_core.h"',
+                                   '// include removed'))
+    p2 = tmp_path / "agent_core.cc"
+    p2.write_text(agent_src)
+    fs = wire_drift.check_native_cores_share_table(
+        REPO, core_paths=(str(p1), str(p2)))
+    assert any("no longer includes frame_core.h" in f.detail
+               for f in fs), [f.render() for f in fs]
+    # (b) forked local table
+    p1.write_text(head_src + '\nstatic const framecore::AgentFrameTag '
+                  'kAgentFrameTags[] = {{1, "register_node"}};\n')
+    fs = wire_drift.check_native_cores_share_table(
+        REPO, core_paths=(str(p1), str(p2)))
+    assert any("forks the shared table" in f.detail for f in fs), [
+        f.render() for f in fs]
 
 
 def test_wire_drift_catches_pickle_framed_pin_drift(tmp_path):

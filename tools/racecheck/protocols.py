@@ -89,6 +89,9 @@ def _mk_head(api):
     head.nodes = {}
     head._reservations = {}
     head.lease_spills_total = 0
+    head._hnat = None           # native head core absent in the model:
+    # the (task_id, lease_seq) mirror pops are C-side bookkeeping with
+    # no interleaving semantics of their own (idempotent erase)
     head.enqueued = []          # (task_id, lease_seq) of every requeue
     head.released = []          # tokens released
     head.task_events = types.SimpleNamespace(record=lambda *a, **k: None)
@@ -418,6 +421,10 @@ def build_stream_resume(api):
     coord._decode_inflight_tokens = 0
     coord._ongoing = 0
     coord._tok_rate_ema = 0.0
+    coord._n_decode_live = 1     # PR 14: decode budget is per live replica
+    coord._shed_pending = 0
+    coord._shed_reporting = False
+    coord._local_decode = object()  # short-circuits the shed reporter
     coord._replica_load = {}
     coord._route_cache = {}
     coord._eos = -1
@@ -439,7 +446,8 @@ def build_stream_resume(api):
         return "rep"
     coord._dispatch_decode = _dispatch_decode
 
-    def _prefill_with_retry(ids, temperature, top_p, top_k):
+    def _prefill_with_retry(ids, temperature, top_p, top_k,
+                            want_logp=False):
         script = scripts[bytes(ids).decode()]
         api.point("serve.prefill")
         return {"first": script[0], "kv": None, "kv_tokens": 0}
@@ -452,7 +460,8 @@ def build_stream_resume(api):
     kills = {k: 0 for k in scripts}
 
     def _open_decode_stream(rep, ids, generated, kv, max_new,
-                            temperature, top_p, top_k):
+                            temperature, top_p, top_k,
+                            want_logp=False):
         key = bytes(ids).decode()
         script = scripts[key]
         pos = len(generated)
@@ -475,8 +484,8 @@ def build_stream_resume(api):
             script = scripts[key]
             ids = list(key.encode())
             cost = coord._admit(len(ids), len(script))
-            toks = coord._run_admitted(ids, len(script), None, 1.0, 0,
-                                       cost)
+            toks, _lps = coord._run_admitted(ids, len(script), None, 1.0,
+                                             0, cost)
             results[key] = toks
         return fn
 

@@ -72,6 +72,18 @@ SCOPES = {
         "_ReplyBatcher._send",
         "_ReplyBatcher._group_routes",
     ),
+    # PR 14, native head ingest seams: the natively-parsed completion
+    # drain rebuilds _on_node_done entries from C++ records — a pickle
+    # call creeping in reopens exactly the per-frame unpickle the head
+    # core exists to close. (Cold frames still unpickle in
+    # _listen_loop_native, which is deliberately NOT scoped.)
+    "ray_tpu/core/runtime.py": (
+        "Runtime._drain_native_completions",
+        "Runtime._accept_pending",
+    ),
+    # The head core's ctypes binding moves raw bytes only; payload
+    # (de)serialization belongs to the runtime's policy layer.
+    "ray_tpu/_native/head_core.py": None,
 }
 
 _PICKLE_NAMES = {"pickle", "cloudpickle", "_pickle", "_MsgPickler",

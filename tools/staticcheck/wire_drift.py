@@ -89,20 +89,25 @@ CPP_REQUIRED = (
 
 RULE = "wire-drift"
 
-# The native select-round core's AgentFrame oneof sniffer table
-# (cpp/agent_core.cc kAgentFrameTags): cross-checked BOTH WAYS below.
-AGENT_CORE_REL = "cpp/agent_core.cc"
+# The native scheduling cores' shared AgentFrame oneof sniffer table
+# (cpp/frame_core.h kAgentFrameTags, compiled into BOTH agent_core.cc
+# and head_core.cc): cross-checked BOTH WAYS below, and each core is
+# verified to actually include the shared header (a fork of the table
+# would silently escape the pin).
+FRAME_CORE_REL = "cpp/frame_core.h"
+NATIVE_CORES = ("cpp/agent_core.cc", "cpp/head_core.cc")
 
 
 def run(root: str, proto_path: str | None = None,
         ww_path: str | None = None, cpp_path: str | None = None,
-        agent_core_path: str | None = None, use_pool: bool = True) -> list:
-    """All four cross-checks. Path overrides exist for the mutation
+        frame_core_path: str | None = None, use_pool: bool = True,
+        native_core_paths: tuple | None = None) -> list:
+    """All five cross-checks. Path overrides exist for the mutation
     tests (run the real implementations against a doctored schema)."""
     proto_path = proto_path or os.path.join(root, PROTO_REL)
     ww_path = ww_path or os.path.join(root, WW_REL)
     cpp_path = cpp_path or os.path.join(root, CPP_REL)
-    agent_core_path = agent_core_path or os.path.join(root, AGENT_CORE_REL)
+    frame_core_path = frame_core_path or os.path.join(root, FRAME_CORE_REL)
     findings: list[Finding] = []
     try:
         schema = protoparse.parse(proto_path)
@@ -112,7 +117,8 @@ def run(root: str, proto_path: str | None = None,
         findings += check_pool(schema)
     findings += check_worker_wire(schema, ww_path)
     findings += check_cpp_header(schema, cpp_path)
-    findings += check_agent_core(schema, agent_core_path)
+    findings += check_frame_tags(schema, frame_core_path)
+    findings += check_native_cores_share_table(root, native_core_paths)
     return findings
 
 
@@ -378,14 +384,15 @@ def _class_evidence(body: str, base_line: int) -> list:
     return ev
 
 
-# ------------- (d) cpp/agent_core.cc AgentFrame sniffer tags -------------
+# ------------- (d) cpp/frame_core.h AgentFrame sniffer tags -------------
 #
-# The native frame pump labels proto-framed control messages by their
-# outermost AgentFrame oneof tag (kAgentFrameTags). Drift directions:
-# a renumber/rename in EITHER place desynchronizes the label from the
+# The shared native frame pump labels proto-framed control messages by
+# their outermost AgentFrame oneof tag (kAgentFrameTags in frame_core.h,
+# compiled into both the agent and head cores). Drift directions: a
+# renumber/rename in EITHER place desynchronizes the label from the
 # message, and an AgentFrame field the table does not carry leaves the
-# native pump blind to a control message (it would surface unlabeled and
-# cost Python a trial decode — or worse, be labeled wrong after a
+# native pumps blind to a control message (it would surface unlabeled
+# and cost Python a trial decode — or worse, be labeled wrong after a
 # renumber). Both directions are findings.
 
 _AGC_TABLE_RE = re.compile(
@@ -393,12 +400,12 @@ _AGC_TABLE_RE = re.compile(
 _AGC_ENTRY_RE = re.compile(r'\{\s*(\d+)\s*,\s*"(\w+)"\s*\}')
 
 
-def check_agent_core(schema: dict, path: str) -> list:
-    rel = AGENT_CORE_REL
+def check_frame_tags(schema: dict, path: str) -> list:
+    rel = FRAME_CORE_REL
     if not os.path.exists(path):
         return [Finding(RULE, rel, 0,
-                        "native select-round core source missing (the "
-                        "sniffer tag table is pinned here)")]
+                        "shared native-core header missing (the sniffer "
+                        "tag table is pinned here)")]
     with open(path) as f:
         text = f.read()
     m = _AGC_TABLE_RE.search(text)
@@ -436,6 +443,38 @@ def check_agent_core(schema: dict, path: str) -> list:
                 RULE, rel, base_line,
                 f"AgentFrame.{pf.name} (field {num}) missing from "
                 "kAgentFrameTags — the native pump cannot label it"))
+    return out
+
+
+def check_native_cores_share_table(root: str,
+                                   core_paths: tuple | None = None) -> list:
+    """Both native cores must compile the SHARED tag table: each .cc has
+    to include frame_core.h, and neither may re-declare kAgentFrameTags
+    locally — a forked copy would drift outside the pin above."""
+    out: list[Finding] = []
+    rels = NATIVE_CORES if core_paths is None else None
+    paths = ([(r, os.path.join(root, r)) for r in rels] if rels is not None
+             else [(p, p) for p in core_paths])
+    for rel, path in paths:
+        if not os.path.exists(path):
+            out.append(Finding(
+                RULE, rel, 0,
+                "native core source missing (the scheduling plane's "
+                "native split pins both halves here)"))
+            continue
+        with open(path) as f:
+            text = f.read()
+        if '#include "frame_core.h"' not in text:
+            out.append(Finding(
+                RULE, rel, 1,
+                "native core no longer includes frame_core.h — its "
+                "sniffer escaped the shared kAgentFrameTags pin"))
+        m = _AGC_TABLE_RE.search(text)
+        if m is not None:
+            out.append(Finding(
+                RULE, rel, text[:m.start()].count("\n") + 1,
+                "local kAgentFrameTags declaration forks the shared "
+                "table in frame_core.h — delete it"))
     return out
 
 
