@@ -54,6 +54,21 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
                         "cached connections); 1 = whole-object pulls"),
     "objxfer_stream_min_bytes": (int, 32 << 20, "objects smaller than this "
                                  "always pull on one connection"),
+    # --- data plane (Arrow blocks in the arena) ---
+    "data_block_arrow": (bool, True, "pyarrow.Table values seal into the "
+                         "arena as tagged Arrow IPC objects (format "
+                         "'arrow': the writer streams the IPC encoding "
+                         "straight into a write reservation, readers "
+                         "re-hydrate zero-copy over the mapped arena); "
+                         "off = blocks ride the pickle path like any "
+                         "other value"),
+    "vectored_arg_fetch_min": (int, 2, "a task whose args carry at least "
+                               "this many locally-missing ObjectRefs "
+                               "subscribes to all of them in ONE wait_objs "
+                               "frame, and the head groups same-source "
+                               "pulls into one batched objxfer round "
+                               "(fetch_many) instead of N serial gets; "
+                               "0 disables vectored fetch"),
     # --- compiled-graph channels (parity: the NCCL-channel data plane
     #     under the reference's compiled graphs) ---
     "dag_channel_type": (str, "tensor", "compiled-graph channel encoding: "

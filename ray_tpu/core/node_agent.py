@@ -1577,6 +1577,13 @@ class NodeAgent:
             threading.Thread(target=self._fetch_object,
                              args=(oid, tuple(src_addr), attempt),
                              daemon=True).start()
+        elif op == "fetch_many":
+            # Vectored pull: one batched objxfer round for a same-source
+            # group (the exchange reduce half's many small pieces).
+            _, entries, src_addr = msg
+            threading.Thread(target=self._fetch_objects_many,
+                             args=(entries, tuple(src_addr)),
+                             daemon=True, name="rtpu-fetch-many").start()
         elif op == "free_obj":
             try:
                 self.store.delete(ObjectID(msg[1]))
@@ -1901,6 +1908,19 @@ class NodeAgent:
         except Exception:  # noqa: BLE001
             traceback.print_exc()
         self._send_head(("fetched", oid, ok, attempt))
+
+    def _fetch_objects_many(self, entries: list, src_addr):
+        """Pull a same-source batch [(oid, attempt), ...] over ONE objxfer
+        connection round and reply with a single fetched_many frame."""
+        results: dict = {}
+        try:
+            results = objxfer.fetch_many_from_peer(
+                self.store, src_addr, [oid for oid, _att in entries])
+        except Exception:  # noqa: BLE001
+            traceback.print_exc()
+        self._send_head(("fetched_many",
+                         [(oid, bool(results.get(oid)), att)
+                          for oid, att in entries]))
 
     # ---------------- main loop ----------------
 

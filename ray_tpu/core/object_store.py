@@ -209,6 +209,31 @@ class _ReleaseHandle:
 _ZERO_COPY_READS = sys.version_info >= (3, 12)
 
 
+class _ArrowKeepalive:
+    """Pins one arrow-block read: handed to pyarrow as the foreign
+    buffer's `base`, so the store reference (and the arena view) outlive
+    every Table / column / numpy view derived from the zero-copy read.
+    Same lifetime story as _TrackedBuffer, without needing PEP 688 —
+    arrow reads are zero-copy on every Python version."""
+
+    __slots__ = ("_store", "_oid", "_view")
+
+    def __init__(self, store, object_id, view):
+        self._store = store
+        self._oid = object_id
+        self._view = view
+
+    def __del__(self):
+        v, self._view = self._view, None
+        if v is None:
+            return
+        try:
+            v.release()
+        except BufferError:
+            pass
+        self._store.release(self._oid)
+
+
 class _TrackedBuffer:
     """PEP-688 buffer wrapper: consumers (numpy et al.) hold this object via
     the buffer protocol, so its destruction marks the buffer unused."""
@@ -536,6 +561,71 @@ class SharedMemoryStore:
 
     TAGGED_META = b"rtv1"
 
+    # Arrow blocks ride the tagged layout under this format tag:
+    # payload = [u32 pad][u64 ipc_len][pad zero bytes][Arrow IPC stream],
+    # pad chosen at write time so the stream starts 64-aligned in the
+    # arena. The writer streams the IPC encoding DIRECTLY into the
+    # acquired buffer (write reservation when large enough) — no
+    # intermediate bytes object, no pickle; readers re-hydrate via
+    # pa.ipc.open_stream over a zero-copy view whose lifetime pins the
+    # store reference (_ArrowKeepalive).
+    ARROW_FMT = "arrow"
+
+    def put_arrow(self, object_id: ObjectID, table) -> int:
+        """Seal a pyarrow.Table as a tagged arena object (ARROW_FMT).
+
+        Two-pass IPC encode: a MockOutputStream pass sizes the stream
+        without materializing it, then the real pass writes into the
+        acquired arena buffer through a FixedSizeBufferWriter."""
+        import pyarrow as pa
+        sink = pa.MockOutputStream()
+        with pa.ipc.new_stream(sink, table.schema) as w:
+            w.write_table(table)
+        ipc_len = sink.size()
+        fmt_b = self.ARROW_FMT.encode()
+        hdr = 4 + len(fmt_b) + 12
+        total = hdr + 63 + ipc_len  # worst-case alignment pad
+        buf = self._acquire_buffer(object_id, total, meta=self.TAGGED_META)
+        try:
+            pad = (-(buf.offset + hdr)) % 64
+            d = buf.data
+            struct.pack_into("<I", d, 0, len(fmt_b))
+            d[4:4 + len(fmt_b)] = fmt_b
+            struct.pack_into("<IQ", d, 4 + len(fmt_b), pad, ipc_len)
+            body = d[hdr + pad: hdr + pad + ipc_len]
+            try:
+                writer = pa.FixedSizeBufferWriter(pa.py_buffer(body))
+                with pa.ipc.new_stream(writer, table.schema) as w:
+                    w.write_table(table)
+                del writer
+            finally:
+                try:
+                    body.release()
+                except BufferError:
+                    pass  # pyarrow still holds the export; dies with it
+            buf.seal()
+        except BaseException:
+            buf.abort()
+            raise
+        return total
+
+    def _decode_arrow(self, object_id: ObjectID, data, off: int):
+        """Re-hydrate a put_arrow object zero-copy: the returned Table's
+        buffers alias the mapped arena; the store reference is dropped
+        when the table (and every view derived from it) is collected."""
+        import pyarrow as pa
+        pad, ipc_len = struct.unpack_from("<IQ", data, off)
+        start = off + 12 + pad
+        addr = _buf_address(data)
+        if addr is None:  # no numpy: copy out (correct, just not zero-copy)
+            blob = bytes(data[start:start + ipc_len])
+            data.release()
+            self.release(object_id)
+            return pa.ipc.open_stream(pa.BufferReader(blob)).read_all()
+        keep = _ArrowKeepalive(self, object_id, data)
+        fb = pa.foreign_buffer(addr + start, ipc_len, base=keep)
+        return pa.ipc.open_stream(pa.BufferReader(fb)).read_all()
+
     def put_tagged(self, object_id: ObjectID, fmt: str, payload) -> int:
         """Seal a language-neutral tagged value (see TAGGED_META layout)."""
         fmt_b = fmt.encode()
@@ -556,10 +646,14 @@ class SharedMemoryStore:
         return total
 
     def _decode_tagged(self, object_id: ObjectID, data):
+        (fmt_len,) = struct.unpack_from("<I", data, 0)
+        fmt = bytes(data[4:4 + fmt_len]).decode()
+        if fmt == self.ARROW_FMT:
+            # Arrow block: keeps its store reference pinned until the
+            # zero-copy table dies (_decode_arrow owns the release).
+            return self._decode_arrow(object_id, data, 4 + fmt_len)
         from ray_tpu.core.proto_wire import decode_tagged
         try:
-            (fmt_len,) = struct.unpack_from("<I", data, 0)
-            fmt = bytes(data[4:4 + fmt_len]).decode()
             value = decode_tagged(fmt, data[4 + fmt_len:])
         finally:
             data.release()
@@ -696,6 +790,23 @@ class SharedMemoryStore:
             os.unlink(self.path)
         except FileNotFoundError:
             pass
+
+
+def arrow_block_of(value):
+    """`value` as the pyarrow.Table the arena-native arrow plane should
+    carry, or None (not a Table, pyarrow never imported in this process,
+    or the `data_block_arrow` knob is off). sys.modules probing keeps
+    processes that never touch the data plane from importing pyarrow."""
+    pa = sys.modules.get("pyarrow")
+    if pa is None or not isinstance(value, pa.Table):
+        return None
+    try:
+        from ray_tpu.core.config import get_config
+        if not get_config().data_block_arrow:
+            return None
+    except Exception:  # noqa: BLE001 — config not importable (bare tests)
+        pass
+    return value
 
 
 def configure_store(store: SharedMemoryStore, cfg) -> None:

@@ -743,12 +743,78 @@ def fetch_from_peer(store, addr, oid: bytes, timeout: float = 60.0,
     return False
 
 
+def fetch_many_from_peer(store, addr, oids: list, timeout: float = 60.0,
+                         unsealed_wait_s: float = 5.0) -> dict:
+    """Pull many objects from ONE peer over one checked-out connection —
+    request/response per object with no per-object dial, checkout, or
+    head round trip (the vectored half of the exchange reduce fetch;
+    pieces are small, so single-stream pulls are the right shape).
+    Returns {oid: found}. A dirty failure mid-batch falls back to
+    per-object fetch_from_peer (fresh dial, stripe-capable) for the
+    remainder, so one dead connection degrades, never loses objects."""
+    out: dict = {}
+    todo: list = []
+    for oid in oids:
+        if store.contains(ObjectID(oid)):
+            out[oid] = True
+        else:
+            todo.append(oid)
+    if not todo:
+        return out
+    chaos.delay("objxfer.fetch.delay")
+    tev = _task_events.ring()
+    t0 = _time.time() if tev.enabled else 0.0
+    s = None
+    clean = True
+    try:
+        s, _reused = _conn_cache.checkout(addr, timeout)
+    except OSError:
+        s = None
+    if s is not None:
+        if chaos.site("objxfer.pull.reset"):
+            try:  # injected dead connection: the per-object fallback path
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        try:
+            for oid in todo:
+                try:
+                    found, clean = _pull_once(store, s, oid,
+                                              unsealed_wait_s, 0.0)
+                except OSError:
+                    found, clean = False, False
+                out[oid] = found
+                if not clean:
+                    break
+        finally:
+            if clean:
+                _conn_cache.checkin(addr, s)
+            else:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+    for oid in todo:
+        if not out.get(oid):
+            out[oid] = fetch_from_peer(store, addr, oid, timeout,
+                                       unsealed_wait_s)
+    if tev.enabled:
+        tev.emit_span("obj_pull_many", f"{len(todo)} objs", t0,
+                      _time.time() - t0,
+                      ok=all(out.get(o) for o in todo),
+                      peer=f"{addr[0]}:{addr[1]}")
+    return out
+
+
 # ---------------- blob helpers (spill restore, tests) ----------------
 
 
-def write_blob(store, oid: bytes, blob) -> None:
-    """Store one raw serialized object blob (idempotent)."""
-    buf = _create_for_write(store, oid, len(blob), b"")
+def write_blob(store, oid: bytes, blob, meta: bytes = b"") -> None:
+    """Store one raw serialized object blob (idempotent). `meta` carries
+    the tagged-object meta for arrow/tensor/cross-language layouts — a
+    spill restore that dropped it would re-seal the bytes as the default
+    pickle layout."""
+    buf = _create_for_write(store, oid, len(blob), meta)
     if buf is None:
         return
     try:

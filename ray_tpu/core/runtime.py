@@ -25,6 +25,7 @@ import pickle
 import selectors
 import signal
 import socket
+import struct
 import subprocess
 import sys
 import threading
@@ -911,6 +912,13 @@ class Runtime:
         # can't kill a retried fetch.
         self._fetches: dict[tuple, dict] = {}
         self._fetch_attempts = 0
+        # Diagnostics (under self.lock): cross-node object movements the
+        # head orchestrated (one per registered (dest, oid) fetch) + the
+        # agent-initiated object_src pulls. The data-plane locality tests
+        # assert a co-located pipeline keeps these flat.
+        self.cross_node_fetches = 0
+        # fetch_many frames sent (vectored same-source pull batches).
+        self.fetch_batches_sent = 0
         # On-demand worker profiling (dashboard /api/profile): token ->
         # future resolved when the worker's sampler report arrives.
         self._profile_futs: dict[bytes, "object"] = {}
@@ -1304,10 +1312,18 @@ class Runtime:
                 res = self.store.get_raw(ObjectID(oid), timeout=0)
                 if res is None:
                     continue
-                data, _meta = res
+                data, meta = res
                 path = os.path.join(self.spill_dir, oid.hex())
                 try:
                     with open(path, "wb") as f:
+                        # Spill file = [u32 meta_len][meta][data]: the
+                        # tagged-object meta (arrow blocks, tensor
+                        # frames, cross-language values) must survive the
+                        # disk round trip or the restored copy decodes as
+                        # the wrong layout.
+                        f.write(struct.pack("<I", len(meta)))
+                        if meta:
+                            f.write(meta)
                         f.write(data)
                 finally:
                     data.release()
@@ -1333,20 +1349,23 @@ class Runtime:
             return False
         try:
             with open(path, "rb") as f:
-                blob = f.read()
+                raw = f.read()
         except FileNotFoundError:
             return False
+        (meta_len,) = struct.unpack_from("<I", raw, 0)
+        meta = bytes(raw[4:4 + meta_len])
+        blob = memoryview(raw)[4 + meta_len:]
         # Under _spill_lock: a concurrent spill pass must not 'cheap-drop'
         # the arena copy between our write and add_location (it would leave
         # the directory claiming a head copy that is gone).
         with self._spill_lock:
             self._ensure_headroom(len(blob))
             try:
-                objxfer.write_blob(self.store, oid, blob)
+                objxfer.write_blob(self.store, oid, blob, meta=meta)
             except Exception:  # noqa: BLE001 — arena full: make room, retry
                 if not self._spill_bytes(int(len(blob) * 1.2)):
                     return False
-                objxfer.write_blob(self.store, oid, blob)
+                objxfer.write_blob(self.store, oid, blob, meta=meta)
             self._restored_at[oid] = time.monotonic()
             self.directory.add_location(oid, self.head_node_id)
         return True
@@ -1369,7 +1388,9 @@ class Runtime:
                               + (4 << 20))
 
     def put_in_store(self, oid: "ObjectID", value) -> None:
+        from ray_tpu.core.object_store import arrow_block_of
         from ray_tpu.core.status import ObjectStoreFullError
+        table = arrow_block_of(value)
         approx = int(getattr(value, "nbytes", 0) or (1 << 20))
         # Reservation-backed puts carve no global memory: the refill path
         # already ran the headroom check (store.spill_hook), so the
@@ -1377,11 +1398,17 @@ class Runtime:
         if not self.store.reservation_fits(approx):
             self._ensure_headroom(approx)
         try:
-            self.store.put_serialized(oid, value)
+            if table is not None:
+                self.store.put_arrow(oid, table)
+            else:
+                self.store.put_serialized(oid, value)
         except ObjectStoreFullError:
             if not self._spill_bytes(int(approx * 1.5) + (1 << 20)):
                 raise
-            self.store.put_serialized(oid, value)
+            if table is not None:
+                self.store.put_arrow(oid, table)
+            else:
+                self.store.put_serialized(oid, value)
 
     # ---------------- OOM monitor ----------------
 
@@ -1774,6 +1801,10 @@ class Runtime:
                 self._push_obj_to_worker(wid, oid, entry)
 
             self.directory.on_ready(oid, push)
+        elif op == "wait_objs":
+            # Vectored dependency subscribe: one frame, many oids; ready
+            # same-source remote objects pull as ONE fetch_many batch.
+            self._on_wait_objs(w, msg[1])
         elif op == "put_notify":
             self.directory.add_location(msg[1], w.node_id)
             self._on_object_ready(msg[1])
@@ -2184,6 +2215,69 @@ class Runtime:
 
             self._fetch_to_node(node, oid, done)
 
+    def _on_wait_objs(self, w: WorkerHandle, oids: list):
+        """Batched wait_obj (the vectored dependency fetch): ready shm
+        objects that need a pull to w's agent node are routed through the
+        fetch collector and grouped per SOURCE into one fetch_many frame
+        — a reduce partition's many small exchange pieces cross the wire
+        in one batched objxfer round instead of N serial gets. Pending /
+        inline / err / local oids take the per-oid wait_obj path."""
+        wid = w.worker_id.binary()
+        node = self.nodes.get(w.node_id)
+        batch: list = []
+        for oid in oids:
+            entry = self.directory.lookup(oid)
+            if (node is not None and node.conn is not None
+                    and not getattr(w, "is_client", False)
+                    and entry is not None and entry[0] == "shm"
+                    and w.node_id not in (entry[1] if len(entry) > 1
+                                          else {self.head_node_id})):
+                batch.append(oid)
+                continue
+
+            def push(entry, oid=oid, wid=wid):
+                self._push_obj_to_worker(wid, oid, entry)
+
+            self.directory.on_ready(oid, push)
+        if not batch:
+            return
+        collector: list = []
+        for oid in batch:
+
+            def done(ok, err, wid=wid, oid=oid, nid=w.node_id):
+                if ok:
+                    self._push_obj_to_worker(wid, oid, ("shm", {nid}))
+                else:
+                    w2 = self.workers.get(wid)
+                    if w2 is not None and w2.state != DEAD:
+                        from ray_tpu.core.status import ObjectLostError
+                        payload, bufs, _ = serialization.serialize_value(
+                            err or ObjectLostError(ObjectID(oid)))
+                        w2.send(("obj", oid, "err", payload, bufs))
+
+            self._fetch_to_node(node, oid, done, collector=collector)
+        self._send_fetch_batches(node, collector)
+
+    def _send_fetch_batches(self, node: NodeState, collector: list):
+        """Ship collected (oid, attempt, src_addr) fetch routes: same-source
+        groups of >=2 ride ONE fetch_many frame, singletons the classic
+        fetch frame. A send failure is recoverable — each entry's armed
+        watchdog re-drives it as an individual fetch."""
+        groups: dict = {}
+        for oid, attempt, src_addr in collector:
+            groups.setdefault(tuple(src_addr), []).append((oid, attempt))
+        for src_addr, entries in groups.items():
+            try:
+                if len(entries) == 1:
+                    oid, attempt = entries[0]
+                    node.conn.send(("fetch", oid, src_addr, attempt))
+                else:
+                    node.conn.send(("fetch_many", entries, src_addr))
+                    with self.lock:
+                        self.fetch_batches_sent += 1
+            except OSError:
+                pass  # watchdog re-drives per-oid
+
     def _push_inline_to_client(self, w: WorkerHandle, oid: bytes):
         try:
             entry = self.directory.lookup(oid)
@@ -2475,6 +2569,8 @@ class Runtime:
             elif what == "object_src":
                 # Peer address of a node holding `arg` in its arena — the
                 # agent-side dep staging for cpp leases pulls from here.
+                with self.lock:
+                    self.cross_node_fetches += 1
                 e = self.directory.lookup(arg)
                 if e is not None and e[0] == "shm":
                     for nid2 in e[1]:
@@ -2525,6 +2621,17 @@ class Runtime:
                 from ray_tpu.core.status import ObjectLostError
                 err = ObjectLostError(ObjectID(oid))
             self._finish_fetch((nid, oid), ok, err, attempt=attempt)
+        elif op == "fetched_many":
+            # One reply frame for a vectored fetch_many batch.
+            nid = conn.node_id
+            for oid, ok, attempt in msg[1]:
+                err = None
+                if ok:
+                    self.directory.add_location(oid, nid)
+                else:
+                    from ray_tpu.core.status import ObjectLostError
+                    err = ObjectLostError(ObjectID(oid))
+                self._finish_fetch((nid, oid), ok, err, attempt=attempt)
         elif op == "client_hello":
             # A client-mode driver (parity: Ray Client `ray://` sessions):
             # acts like a worker whose every object value travels inline.
@@ -2617,9 +2724,16 @@ class Runtime:
         self.directory.on_ready(oid, on_entry)
         return True
 
-    def _fetch_to_node(self, dest: NodeState, oid: bytes, done_cb):
+    def _fetch_to_node(self, dest: NodeState, oid: bytes, done_cb,
+                       collector: list | None = None):
         """Materialize `oid` in `dest`'s store; done_cb(ok, err) when done.
-        Non-blocking; safe to call from the listener thread."""
+        Non-blocking; safe to call from the listener thread.
+
+        With `collector`, an agent-bound fetch frame is appended as
+        (oid, attempt, src_addr) instead of being sent — _on_wait_objs
+        groups same-source entries into ONE fetch_many frame (the
+        vectored pull plane); the per-oid watchdog still arms, so a
+        dropped batch frame degrades to individual re-driven fetches."""
         with self.lock:
             key = (dest.node_id, oid)
             info = self._fetches.get(key)
@@ -2627,6 +2741,7 @@ class Runtime:
                 info["cbs"].append(done_cb)
                 return
             self._fetch_attempts += 1
+            self.cross_node_fetches += 1
             info = {"cbs": [done_cb], "src": None,
                     "attempt": self._fetch_attempts}
             self._fetches[key] = info
@@ -2693,7 +2808,11 @@ class Runtime:
                     src_addr = tuple(src.peer_addr)
                 else:
                     src_addr = self.head_peer_addr
-                dest.conn.send(("fetch", oid, src_addr, info["attempt"]))
+                if collector is not None:
+                    collector.append((oid, info["attempt"], src_addr))
+                else:
+                    dest.conn.send(("fetch", oid, src_addr,
+                                    info["attempt"]))
         except OSError as e:
             self._finish_fetch(key, False, e)
             return
@@ -2957,6 +3076,23 @@ class Runtime:
         return out
 
     # ---------------- object plane ----------------
+
+    def node_of_object(self, oid: bytes) -> str | None:
+        """Hex node id of a live node holding `oid` in its arena, or None
+        for inline/err/unknown entries. The data executor's locality
+        hints resolve block owners through this (soft NodeAffinity: the
+        head's placement still falls back when the owner is saturated or
+        dead)."""
+        e = self.directory.lookup(oid)
+        if e is None or e[0] != "shm":
+            return None
+        locs = e[1] if len(e) > 1 else {self.head_node_id}
+        with self.lock:
+            for nid in locs:
+                n = self.nodes.get(nid)
+                if n is not None and n.state == "ALIVE":
+                    return nid.hex()
+        return None
 
     def put(self, value) -> "ObjectRef":
         from ray_tpu.core.object_ref import ObjectRef

@@ -31,7 +31,7 @@ import cloudpickle
 from ray_tpu.core import chaos, serialization, task_events
 from ray_tpu.core.config import Config, set_config, get_config
 from ray_tpu.core.ids import ObjectID, WorkerID
-from ray_tpu.core.object_store import SharedMemoryStore
+from ray_tpu.core.object_store import SharedMemoryStore, arrow_block_of
 from ray_tpu.core.status import TaskError
 from ray_tpu.core.task import TaskSpec
 from ray_tpu.core.transport import FrameBuffer, send_msg, socket_from_fd
@@ -469,6 +469,55 @@ class WorkerRuntime:
         if isinstance(value, Exception):
             raise value
         return value
+
+    def prefetch_refs(self, refs):
+        """Vectored dependency fetch: subscribe to every locally-missing
+        ref in ONE wait_objs frame so the head materializes them
+        concurrently (and groups same-source pulls into one batched
+        objxfer round). Best-effort warm-up — anything still missing
+        afterward falls back to _get_one's own per-ref wait/timeout."""
+        if len(refs) < 2:
+            return
+        min_refs = get_config().vectored_arg_fetch_min
+        if min_refs <= 0 or len(refs) < min_refs:
+            return
+        missing: list = []
+        events: list = []
+        seen: set = set()
+        for r in refs:
+            oid = r.id.binary()
+            if (oid in seen or oid in self.object_cache
+                    or oid in self._direct_values
+                    or self.store.contains(r.id)):
+                continue
+            seen.add(oid)
+            ev = threading.Event()
+            with self._wait_lock:
+                self._pending_waits.setdefault(oid, []).append(ev)
+            missing.append(oid)
+            events.append(ev)
+        if len(missing) < min_refs:
+            # Below the vectored floor: drop the subscriptions — the
+            # per-ref path will re-subscribe with its own timeout story.
+            with self._wait_lock:
+                for oid, ev in zip(missing, events):
+                    lst = self._pending_waits.get(oid)
+                    if lst is not None:
+                        try:
+                            lst.remove(ev)
+                        except ValueError:
+                            pass
+                        if not lst:
+                            self._pending_waits.pop(oid, None)
+            return
+        try:
+            self.send(("wait_objs", missing))
+        except OSError:
+            return
+        deadline = time.monotonic() + 60.0
+        for ev in events:
+            if not ev.wait(max(0.0, deadline - time.monotonic())):
+                break  # per-arg resolve owns the error/timeout story
 
     def wait(self, refs, num_returns=1, timeout=None):
         import time as _t
@@ -971,13 +1020,20 @@ def _put_with_spill(rt: "WorkerRuntime", oid: ObjectID, value, nbytes: int):
         if stats["allocated"] + nbytes > limit:
             rt.request("spill",
                        int(stats["allocated"] + nbytes - limit) + (4 << 20))
+    table = arrow_block_of(value)
     try:
-        rt.store.put_serialized(oid, value)
+        if table is not None:
+            rt.store.put_arrow(oid, table)
+        else:
+            rt.store.put_serialized(oid, value)
     except ObjectStoreFullError:
         if not on_head:
             raise
         rt.request("spill", int(nbytes * 1.5) + (1 << 20))
-        rt.store.put_serialized(oid, value)
+        if table is not None:
+            rt.store.put_arrow(oid, table)
+        else:
+            rt.store.put_serialized(oid, value)
 
 
 GLOBAL: WorkerRuntime | None = None
@@ -988,6 +1044,20 @@ def _resolve_arg(rt: WorkerRuntime, obj):
     if isinstance(obj, ObjectRef):
         return rt._get_one(obj, timeout=60.0)
     return obj
+
+
+def _resolve_args(rt: WorkerRuntime, args, kwargs):
+    """Resolve a task's (args, kwargs), prefetching ref args as ONE
+    vectored batch first — a reduce task's N exchange pieces pull
+    concurrently (same-source groups over one objxfer round) instead of
+    N serial get rounds."""
+    from ray_tpu.core.object_ref import ObjectRef
+    refs = [a for a in args if isinstance(a, ObjectRef)]
+    refs += [v for v in kwargs.values() if isinstance(v, ObjectRef)]
+    if len(refs) >= 2:
+        rt.prefetch_refs(refs)
+    return ([_resolve_arg(rt, a) for a in args],
+            {k: _resolve_arg(rt, v) for k, v in kwargs.items()})
 
 
 def _spec_args(rt: WorkerRuntime, spec: TaskSpec):
@@ -1098,8 +1168,7 @@ def _execute(rt: WorkerRuntime, spec: TaskSpec, fn):
         spec.exec_ts = [time.time(), 0.0, 0.0]
     try:
         args, kwargs = _spec_args(rt, spec)
-        args = [_resolve_arg(rt, a) for a in args]
-        kwargs = {k: _resolve_arg(rt, v) for k, v in kwargs.items()}
+        args, kwargs = _resolve_args(rt, args, kwargs)
         if tev:
             spec.exec_ts[1] = time.time()  # args deserialized/resolved
         rt.current_task = spec  # describe() formatted lazily on demand
@@ -1139,6 +1208,12 @@ def _execute_streaming(rt: WorkerRuntime, spec: TaskSpec, fn):
 
     def entry_for(value, status="inline-or-shm"):
         rid = os.urandom(16)
+        if status != "err":
+            table = arrow_block_of(value)
+            if (table is not None
+                    and table.nbytes > cfg.max_inline_object_bytes):
+                _put_with_spill(rt, ObjectID(rid), table, table.nbytes)
+                return (rid, "shm", None, None)
         payload, bufs, _ = serialization.serialize_value(value)
         if status == "err":
             return (rid, "err", payload, bufs)
@@ -1155,8 +1230,7 @@ def _execute_streaming(rt: WorkerRuntime, spec: TaskSpec, fn):
         for oid, (payload, bufs) in spec.inline_deps.items():
             rt.object_cache[oid] = serialization.deserialize(payload, bufs)
         args, kwargs = _spec_args(rt, spec)
-        args = [_resolve_arg(rt, a) for a in args]
-        kwargs = {k: _resolve_arg(rt, v) for k, v in kwargs.items()}
+        args, kwargs = _resolve_args(rt, args, kwargs)
         rt.current_task = spec
         rt.current_scheduling_strategy = (
             spec.scheduling_strategy
@@ -1219,6 +1293,14 @@ def _reply_result(rt: WorkerRuntime, spec: TaskSpec, status, result,
         values = results if n_returns > 1 else [result]
         outs = []
         for rid, value in zip(spec.return_ids, values):
+            table = arrow_block_of(value)
+            if (table is not None
+                    and table.nbytes > cfg.max_inline_object_bytes):
+                # Arrow block return: streamed straight into the arena in
+                # the tagged IPC layout — no pickle of the block bytes.
+                _put_with_spill(rt, ObjectID(rid), table, table.nbytes)
+                outs.append((rid, "shm", None, None))
+                continue
             payload, bufs, _ = serialization.serialize_value(value)
             nbytes = serialization.total_nbytes(payload, bufs)
             if nbytes <= cfg.max_inline_object_bytes:
@@ -1984,8 +2066,7 @@ def _worker_main(store_path: str, worker_id: WorkerID, fd: int):
             _ensure_accelerator_platform(getattr(cspec, "num_tpus", 0))
             cls = rt.functions[cspec.cls_id]
             args, kwargs = serialization.deserialize(cspec.payload, cspec.buffers)
-            args = [_resolve_arg(rt, a) for a in args]
-            kwargs = {k: _resolve_arg(rt, v) for k, v in kwargs.items()}
+            args, kwargs = _resolve_args(rt, args, kwargs)
             # Set before __init__ so get_current_placement_group() works
             # inside the constructor too.
             rt.actor_scheduling_strategy = cspec.scheduling_strategy

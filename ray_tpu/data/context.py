@@ -19,6 +19,12 @@ class DataContext:
     memory_budget_bytes: int = 0
     eager_free: bool = True
     verbose_progress: bool = False
+    # Locality-aware submission: map/split tasks carry a soft
+    # NodeAffinity hint for the node owning their input block (resolved
+    # through the head's object directory), so map-heavy pipelines stay
+    # node-local instead of objxfer-pulling every block. Placement falls
+    # back to the hybrid policy when the owner is saturated or dead.
+    locality_hints: bool = True
 
     _local = threading.local()
 

@@ -210,6 +210,43 @@ def _finish(pair):
     return bref, ray_tpu.get(mref, timeout=600)
 
 
+# ---------------- locality hints ----------------
+
+
+def _owner_node(bref) -> str | None:
+    """Hex id of the node holding bref's block, or None (client-mode
+    driver, worker-nested execution, inline entry, dead owner)."""
+    try:
+        from ray_tpu.core.runtime import Runtime, get_runtime
+        rt = get_runtime()
+    except Exception:  # noqa: BLE001 — no runtime yet
+        return None
+    if not isinstance(rt, Runtime):
+        return None  # only the head driver sees the object directory
+    try:
+        return rt.node_of_object(bref.id.binary())
+    except Exception:  # noqa: BLE001 — directory churn: hint is optional
+        return None
+
+
+def _locality_strategy(ctx, cache: dict, bref):
+    """Soft NodeAffinity for the node owning `bref`, or None. Cached per
+    node PER STAGE: the head's scheduling queues key non-string
+    strategies by identity, so reusing one object per node keeps a
+    stage's same-node submissions on one queue."""
+    if not getattr(ctx, "locality_hints", True):
+        return None
+    nid = _owner_node(bref)
+    if nid is None:
+        return None
+    strat = cache.get(nid)
+    if strat is None:
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy)
+        strat = cache[nid] = NodeAffinitySchedulingStrategy(nid, soft=True)
+    return strat
+
+
 def _windowed(submits, window: int, budget=None, est_bytes=None):
     """Submit lazily, keep <= window tasks in flight, yield in order.
 
@@ -252,8 +289,12 @@ def _read_stage(op: plan_mod.Read, ctx, budget=None):
 def _task_map_stage(op: plan_mod.MapBlocks, upstream, ctx, budget=None):
     # Estimate each output at its input block's size (metadata is exact for
     # the upstream block; maps are usually size-preserving or shrinking).
+    # Each submit carries a soft locality hint for the block's owner node,
+    # so a map chain follows its blocks instead of pulling them.
+    affinity: dict = {}
     return _windowed(
-        (((lambda bref=bref: _map_task.remote(op.fn, bref)),
+        (((lambda bref=bref, s=_locality_strategy(ctx, affinity, bref):
+           _map_task.options(scheduling_strategy=s).remote(op.fn, bref)),
           int(meta.size_bytes or ctx.target_min_block_size))
          for bref, meta in upstream),
         ctx.max_tasks_in_flight, budget=budget)
@@ -321,10 +362,15 @@ def _all_to_all_stage(op: plan_mod.AllToAll, upstream, ctx):
             idx = [len(flat) * i // n_out for i in range(1, n_out)]
             boundaries = [flat[i] for i in idx]
 
+    affinity: dict = {}
+
     def submit_split(bref, idx):
-        return _split_task.options(num_returns=n_out).remote(
-            pre_fn, bref, n_out, split_kind, key, boundaries,
-            args.get("seed"), descending, idx, block_starts[idx])
+        # The exchange's map half reads one block: keep it block-local.
+        return _split_task.options(
+            num_returns=n_out,
+            scheduling_strategy=_locality_strategy(ctx, affinity, bref),
+        ).remote(pre_fn, bref, n_out, split_kind, key, boundaries,
+                 args.get("seed"), descending, idx, block_starts[idx])
 
     piece_refs = []  # [n_inputs][n_out]
     for idx, (bref, _meta) in enumerate(inputs):

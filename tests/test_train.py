@@ -170,12 +170,19 @@ def test_torch_trainer_single_worker(ray_start_regular, tmp_path):
 def test_torch_trainer_ddp_gradients_sync(ray_start_regular, tmp_path):
     from ray_tpu.train.torch import TorchConfig, TorchTrainer
 
+    from ray_tpu.train.trainer import FailureConfig
     trainer = TorchTrainer(
         torch_loop_ddp,
         train_loop_config={"epochs": 10},
         torch_config=TorchConfig(init_timeout_s=60),
         scaling_config=ScalingConfig(num_workers=2),
-        run_config=RunConfig(name="torchddp", storage_path=str(tmp_path)))
+        run_config=RunConfig(
+            name="torchddp", storage_path=str(tmp_path),
+            # The rendezvous port is minted bind(0)-then-close: under a
+            # loaded box another process can steal it before torch
+            # rebinds (observed EADDRINUSE flake). A restart re-mints a
+            # fresh address, so give the gang a retry budget.
+            failure_config=FailureConfig(max_failures=2)))
     result = trainer.fit()
     assert result.error is None, result.error
     assert result.metrics["in_sync"] == 1.0  # DDP kept replicas identical

@@ -51,6 +51,14 @@ TARGETS = (
     "ray_tpu/tune/search.py",
     "ray_tpu/tune/tuner.py",
     "ray_tpu/llm/serve.py",
+    # The data plane (PR 15): the streaming executor's memory-budget lock
+    # + the datasource/file IO paths had never been scanned.
+    "ray_tpu/data/execution.py",
+    "ray_tpu/data/dataset.py",
+    "ray_tpu/data/datasource.py",
+    "ray_tpu/data/avro.py",
+    "ray_tpu/data/tfrecord.py",
+    "ray_tpu/data/preprocessors.py",
 )
 
 SEND_LOCKS = {"send_lock", "flush_lock", "head_lock"}

@@ -54,14 +54,21 @@ SCOPES = {
         "TensorChannel._copy_leaf",
         "TensorChannel._native_copy",
     ),
-    # The arena's tagged-object encoder (what a C++ worker reads raw)
-    # and the write-reservation fill plane (lock-free carve/publish —
-    # raw byte moves only; serialization happens in the callers).
+    # The arena's tagged-object encoder (what a C++ worker reads raw),
+    # the write-reservation fill plane (lock-free carve/publish —
+    # raw byte moves only; serialization happens in the callers), and
+    # the arrow block codec (PR 15): the IPC stream writes straight into
+    # the acquired buffer and re-hydrates over a zero-copy arena view —
+    # a pickle call creeping in reopens the per-block copy the
+    # arena-native data plane exists to close.
     "ray_tpu/core/object_store.py": (
         "SharedMemoryStore.put_tagged",
         "SharedMemoryStore._reserved_create",
         "SharedMemoryStore._carve",
         "_ReservedBuffer.seal",
+        "SharedMemoryStore.put_arrow",
+        "SharedMemoryStore._decode_arrow",
+        "_ArrowKeepalive.__del__",
     ),
     # The direct actor-call frame plane (worker<->worker UDS): routing
     # and shipping only — payload (de)serialization belongs to

@@ -33,7 +33,7 @@ from tools.staticcheck.concurrency import suppressed
 
 TARGET_GLOBS = ("ray_tpu/core/*.py", "ray_tpu/experimental/channel.py",
                 "ray_tpu/train/*.py", "ray_tpu/tune/*.py",
-                "ray_tpu/llm/serve.py")
+                "ray_tpu/llm/serve.py", "ray_tpu/data/*.py")
 
 _FD_CTORS = {
     ("socket", "socket"), ("socket", "create_connection"),
