@@ -141,6 +141,7 @@ def final_line(status: str = "complete"):
         "host": EXTRAS.get("host", {}),
         "many_nodes_scaling": EXTRAS.get("many_nodes_scaling", {}),
         "native_head_ab": EXTRAS.get("native_head_ab", {}),
+        "cluster_scale": EXTRAS.get("cluster_scale", {}),
         "adag_pipeline": EXTRAS.get("adag_pipeline", {}),
         "data_pipeline": EXTRAS.get("data_pipeline", {}),
         "task_events": EXTRAS.get("task_events", {}),
@@ -216,6 +217,20 @@ def final_line(status: str = "complete"):
         # acceptance metric's headline copy (full samples in BENCH_OUT).
         "tphc_s": EXTRAS.get("native_head_ab", {}).get(
             "best", {}).get("on", {}).get("tasks_per_head_cpu_s"),
+        # Control-plane scale-out (head shards): sharded-vs-single rates
+        # at 256 emulated agents + the sharded view-fanout p95 (full
+        # 64/256 curve in BENCH_OUT cluster_scale).
+        "cscale": {
+            "sh256_ts": EXTRAS.get("cluster_scale", {}).get(
+                "curve", {}).get(256, {}).get("sharded", {}).get("tasks_s"),
+            "sg256_ts": EXTRAS.get("cluster_scale", {}).get(
+                "curve", {}).get(256, {}).get("single", {}).get("tasks_s"),
+            "fan_p95_ms": EXTRAS.get("cluster_scale", {}).get(
+                "curve", {}).get(256, {}).get("sharded", {}).get(
+                    "fanout_p95_ms"),
+            "cpu_sublin": EXTRAS.get("cluster_scale", {}).get(
+                "head_cpu_sublinear"),
+        } if EXTRAS.get("cluster_scale") else None,
         "tev_ovh_pct": EXTRAS.get("task_events", {}).get("overhead_pct"),
         "xlang_s": EXTRAS.get("cross_language", {}).get(
             "cpp_tasks_async_s"),
@@ -244,7 +259,7 @@ def final_line(status: str = "complete"):
                     "adag_x", "data_x", "chaos_x", "train_bit",
                     "train_rec_s",
                     "serve_p50_ms", "serve_dvd_x", "serve_kill_p99_ms",
-                    "serve_p99_ms", "serve_drop",
+                    "serve_p99_ms", "serve_drop", "cscale",
                     "n_skipped", "n_missing",
                     "n_metrics", "wall_s", "status", "mc_put_x",
                     "nn_async_x"):
@@ -1048,6 +1063,63 @@ ray_tpu.shutdown()
             EXTRAS["native_head_ab"] = {"error": str(e)[:300],
                                         "samples": samples}
 
+    def sec_cluster_scale():
+        # Control-plane scale-out (head shards): the emulated-agent swarm
+        # (util/agent_emu.py — protocol-complete agents over one selector,
+        # no worker processes) pushes the head to 256 REGISTERED nodes on
+        # one box, far past what OS-process agents afford. Sharded
+        # (head_shards=2) vs single-head A/B at 64 and 256 agents,
+        # COUNTERBALANCED across the two counts (sharded-first at 64,
+        # sharded-last at 256 — the PR 4 lesson: naive A-then-B pairs
+        # read machine drift as signal). view_spread_* is the cluster-view
+        # fan-out latency: first->last agent arrival of one broadcast
+        # version across the whole swarm.
+        runs = ((64, 1200, (2, 0)), (256, 2000, (0, 2)))
+        curve: dict = {}
+        for n_agents, n_tasks, order in runs:
+            for shards in order:
+                budget = min(150, max(90, _remaining() - 30))
+                code = (
+                    "import json\n"
+                    "from ray_tpu.util.many_agents import "
+                    "run_emulated_storm\n"
+                    f"r = run_emulated_storm(n_agents={n_agents}, "
+                    f"n_tasks={n_tasks}, head_shards={shards})\n"
+                    "print('CSCALE', json.dumps(r))\n")
+                out = run_sub(code, timeout=budget,
+                              tag=f"cscale_{n_agents}_{shards}")
+                line = [ln for ln in out.splitlines()
+                        if ln.startswith("CSCALE ")][0]
+                r = json.loads(line[len("CSCALE "):])
+                assert r["correct"] and r["exec_errors"] == 0, r
+                mode = "sharded" if shards else "single"
+                curve.setdefault(n_agents, {})[mode] = {
+                    "tasks_s": r["rate"],
+                    "agents_used": r["agents_used"],
+                    "head_cpu_s": r["head_cpu_s"],
+                    "tasks_per_head_cpu_s": r["tasks_per_head_cpu_s"],
+                    "fanout_p50_ms": r["view_spread_p50_ms"],
+                    "fanout_p95_ms": r["view_spread_p95_ms"],
+                    "tev_shard": r["tev_shard"],
+                    "tev_head": r["tev_head"],
+                }
+        EXTRAS["cluster_scale"] = {
+            "workload": "run_emulated_storm (emulated protocol-complete "
+                        "agents; real head, real tasks, real fan-out)",
+            "order": "64: sharded,single; 256: single,sharded",
+            "curve": curve,
+            # Sublinear head CPU: head seconds per task must not grow
+            # linearly with agent count (the scale-out acceptance gate).
+            "head_cpu_sublinear": bool(
+                curve.get(256, {}).get("sharded", {}).get(
+                    "tasks_per_head_cpu_s", 0)
+                > 0.25 * curve.get(64, {}).get("sharded", {}).get(
+                    "tasks_per_head_cpu_s", 1e9)),
+        }
+        sh = curve.get(256, {}).get("sharded", {})
+        if sh.get("tasks_s"):
+            emit("cluster_scale_256_tasks_s", float(sh["tasks_s"]))
+
     def sec_chaos():
         # Chaos storm (core/chaos.py): the same retryable task storm run
         # under a seeded 1% fault schedule + a mid-storm worker SIGKILL.
@@ -1364,6 +1436,7 @@ ray_tpu.shutdown()
         ("chaos", 150, sec_chaos),
         ("elastic_train", 60, sec_elastic_train),
         ("many_agents", 280, sec_many_agents),  # main run + native-off A/B
+        ("cluster_scale", 320, sec_cluster_scale),  # 64/256 sharded A/B
         ("serve_storm", 180, sec_serve_storm),
     ]
     # Resilience-test hooks: a section that hangs forever and one that

@@ -62,6 +62,15 @@ REGISTERED_SITES: dict[str, str] = {
                              "(the crash window the liveness sweep repairs)",
     "store.publish.kill": "self-SIGKILL between reserve and publish",
     "head.lease_grant.lose": "a node_exec lease batch dropped on send",
+    "head.kill": "the head self-SIGKILLs right after WAL-committing a "
+                 "lease batch and before sending it (restart must replay "
+                 "every committed task and re-admit every journaled "
+                 "stream from the journal alone)",
+    "shard.kill": "a head shard self-SIGKILLs on a dir/tev ingest frame, "
+                  "before the WAL append (the manager's heal pass must "
+                  "re-slice, respawn and WAL-replay it; committed "
+                  "entries survive, the un-acked frame is re-driven by "
+                  "the mirror flusher)",
     "agent.spill_notice.lose": "the lease_spilled notice to the head "
                                "dropped",
     "agent.peer_dial.fail": "agent->agent ctrl dial reports unreachable",

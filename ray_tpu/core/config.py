@@ -189,6 +189,21 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "head_restart_adopt_grace_s": (float, 10.0, "restored actors wait this "
                                    "long for their old worker to be "
                                    "re-registered before respawning"),
+    "head_wal": (bool, True, "when head_persistence_path is set, extend "
+                 "the journal from the durable tables to the full "
+                 "control-plane WAL: in-flight lease grants, object-"
+                 "directory locations, PG reservations and serve stream "
+                 "cursors (the state a head.kill chaos SIGKILL must "
+                 "replay). False keeps PR-8's tables-only journal"),
+    # --- head shards (parity: the reference GCS's service split; object
+    #     directory + task-event ingest shard by id space, lease policy
+    #     stays on the head — core/head_shards.py) ---
+    "head_shards": (int, 0, "spawn N head-shard subprocesses owning "
+                    "disjoint id-space slices of the object directory "
+                    "(durable per-shard WAL mirror) and task-event "
+                    "ingest; the shard map rides the cluster-view "
+                    "broadcast and agents ship task_events straight to "
+                    "the owning shard. 0 = single-head (no shards)"),
     # --- fault injection (test leverage, parity: rpc_chaos.h) ---
     "testing_rpc_failure": (str, "", "'method=max_failures' comma list; drops messages"),
     "testing_delay_us": (str, "", "'method=min:max' comma list; injects delays"),

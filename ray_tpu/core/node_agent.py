@@ -30,6 +30,7 @@ import traceback
 import uuid
 
 from ray_tpu.core import chaos, objxfer, task_events
+from ray_tpu.core.head_shards import SHARD_MAP_KEY, bucket_of
 from ray_tpu.core.config import Config, set_config
 from ray_tpu.core.retry import Backoff
 from ray_tpu.core.ids import ObjectID, WorkerID
@@ -257,6 +258,14 @@ class NodeAgent:
         # lease_spilled notice). Guarded by _lease_lock.
         self._cluster_view: dict[bytes, dict] = {}  # nid -> view entry
         self._cview_version = 0
+        # --- head-shard map (core/head_shards.py): rides the cluster-
+        # view broadcast under a reserved pseudo-key. When present, this
+        # agent ships task_events straight to the owning shard (lazily
+        # dialed, cached channels); any shard failure falls back to the
+        # head's task_events frame — never a lost event.
+        self._shard_map: dict | None = None
+        self._shard_socks: dict[int, tuple] = {}  # sid -> (sock, lock)
+        self._shard_lock = threading.Lock()
         self._peer_fns: dict[bytes, set] = {}  # fn blobs sent per peer
         self._last_spill = 0.0
         # Event-driven uplink deltas: last (idle, backlog) pair pushed to
@@ -547,6 +556,8 @@ class NodeAgent:
                 self._send_head(("heartbeat", self.node_id,
                                  self._load_view()))
                 fr = self._tev_frame(force=True)
+                if fr is not None:
+                    fr = self._ship_tev_shards(fr)
                 if fr is not None:
                     # Cadence floor: surplus ring contents that no worker
                     # drain flushed this period still reach the head.
@@ -1525,10 +1536,16 @@ class NodeAgent:
             # that changed since the last frame we were sent). Fresh
             # information about idle peers may unblock a spill.
             _, version, entries = msg
+            smap = None
             with self._lease_lock:
                 self._cview_version = version
                 for nid, e in entries:
+                    if nid == SHARD_MAP_KEY:
+                        smap = e.get("smap")  # reserved pseudo-entry
+                        continue
                     self._cluster_view[nid] = e
+            if smap is not None:
+                self._adopt_shard_map(smap)
             self._maybe_spill_leases()
         elif op == "lease_reclaim":
             # Head reclaims un-started backlog for idle nodes elsewhere.
@@ -2100,6 +2117,87 @@ class NodeAgent:
                 if self._shutdown:
                     return
 
+    def _adopt_shard_map(self, smap: dict):
+        """Adopt a newer shard map from the view broadcast (epoch-gated:
+        re-slices and respawns bump it; a stale frame must not resurrect
+        a dead shard's channel). Cached channels drop wholesale — ports
+        move on respawn, and redialing a live shard is cheap."""
+        with self._shard_lock:
+            cur = self._shard_map
+            if cur is not None and smap.get("epoch", 0) <= cur.get("epoch", 0):
+                return
+            self._shard_map = smap
+            stale = list(self._shard_socks.values())
+            self._shard_socks = {}
+        for sock, _lk in stale:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _shard_send(self, sid: int, msg) -> bool:
+        """Best-effort send on the (lazily dialed, cached) channel to one
+        head shard; False tells the caller to fall back to the head."""
+        from ray_tpu.core.transport import dial
+        with self._shard_lock:
+            ent = self._shard_socks.get(sid)
+            smap = self._shard_map
+        if ent is None:
+            addr = next(((h, p) for s, h, p in (smap or {}).get("shards", ())
+                         if s == sid), None)
+            if addr is None:
+                return False
+            try:
+                sock = dial(addr, timeout=2.0)
+            except OSError:
+                return False
+            with self._shard_lock:
+                ent = self._shard_socks.setdefault(
+                    sid, (sock, threading.Lock()))
+            if ent[0] is not sock:
+                try:
+                    sock.close()  # lost the install race; use the winner
+                except OSError:
+                    pass
+        sock, lk = ent
+        try:
+            send_msg(sock, msg, lk)
+            return True
+        except OSError:
+            with self._shard_lock:
+                if self._shard_socks.get(sid) is ent:
+                    self._shard_socks.pop(sid, None)
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return False
+
+    def _ship_tev_shards(self, fr):
+        """Route a ("task_events", batch, dropped) frame to the owning
+        head shards by task-id bucket; returns the residue frame for the
+        head (the whole frame when no shard map is adopted, plus any
+        events whose shard send failed — shard death downgrades to the
+        pre-shard head path, never to a lost event)."""
+        with self._shard_lock:
+            smap = self._shard_map
+        if smap is None:
+            return fr
+        _, batch, dropped = fr
+        buckets = smap["buckets"]
+        per: dict[int, list] = {}
+        for ev in batch:
+            tid = ev[0] if ev and isinstance(ev[0], bytes) else b""
+            per.setdefault(buckets[bucket_of(tid)], []).append(ev)
+        residue: list = []
+        for sid, evs in per.items():
+            if not self._shard_send(
+                    sid, ("tev_ingest", self.node_id, evs, 0)):
+                residue.extend(evs)
+        if residue or dropped:
+            return ("task_events", residue, dropped)
+        return None
+
     def _tev_frame(self, force: bool = False):
         """A ("task_events", batch, dropped) frame when a flush is due,
         else None. Riding the select-round batch / heartbeat means the
@@ -2127,6 +2225,8 @@ class NodeAgent:
             out_frames.append(("node_done", lease_dones))
         fr = self._tev_frame()
         if fr is not None:
+            fr = self._ship_tev_shards(fr)
+        if fr is not None:
             out_frames.append(fr)
         if out_frames:
             try:
@@ -2152,6 +2252,13 @@ class NodeAgent:
                     pass
         if self.zygote is not None:
             self.zygote.close()
+        with self._shard_lock:
+            shard_socks = list(self._shard_socks.values())
+        for sock, _lk in shard_socks:
+            try:
+                sock.close()
+            except OSError:
+                pass
         try:
             self.ctrl_srv.close()
         except OSError:

@@ -22,6 +22,7 @@ from ray_tpu._native.build import load_native
 from ray_tpu.core.ids import ObjectID
 from ray_tpu.core.status import (
     GetTimeoutError,
+    ObjectExistsError,
     ObjectStoreFullError,
     RayTpuError,
 )
@@ -162,7 +163,8 @@ class _ReservedBuffer(ObjectBuffer):
         self._sealed = True
         if rc == ERR_EXISTS:
             self.store._release_chunk(self.offset, self.block)
-            raise RayTpuError(f"object {self.object_id} already exists")
+            raise ObjectExistsError(
+                f"object {self.object_id} already exists")
         if rc != OK:
             self.store._release_chunk(self.offset, self.block)
             raise ObjectStoreFullError(
@@ -336,7 +338,7 @@ class SharedMemoryStore:
         rc = self._lib.store_create(self._base, object_id.binary(), data_size,
                                     len(meta), ctypes.byref(off))
         if rc == ERR_EXISTS:
-            raise RayTpuError(f"object {object_id} already exists")
+            raise ObjectExistsError(f"object {object_id} already exists")
         if rc in (ERR_FULL, ERR_TABLE_FULL):
             raise ObjectStoreFullError(
                 f"object store full creating {data_size} bytes (rc={rc})")

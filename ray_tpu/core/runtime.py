@@ -644,6 +644,12 @@ class ObjectDirectory:
         # refs would otherwise pile up O(N^2) ghost callbacks.
         self.ready_cv = threading.Condition()
         self.ready_gen = 0
+        # Optional write-through hooks (head WAL "dir" table + the shard
+        # mirror): on_location(oid, node_id, merged_locs) after a shm
+        # location lands, on_discard(oid) after an entry drops. Called
+        # OUTSIDE self.lock; None (the default) costs one attribute read.
+        self.on_location = None
+        self.on_discard = None
 
     def _pulse_ready(self):
         with self.ready_cv:
@@ -676,15 +682,24 @@ class ObjectDirectory:
     def add_location(self, oid: bytes, node_id: bytes):
         """Merge a replica location into a shm entry, creating it if absent.
         No-op for non-shm entries (inline/err outrank locations)."""
+        hook = self.on_location
+        merged = entry = None
+        cbs: list = []
         with self.lock:
             e = self.entries.get(oid)
             if e is not None:
-                if e[0] == "shm":
+                if e[0] == "shm" and node_id not in e[1]:
                     e[1].add(node_id)
-                return
-            entry = ("shm", {node_id})
-            self.entries[oid] = entry
-            cbs = self.callbacks.pop(oid, [])
+                    merged = sorted(e[1]) if hook is not None else None
+            else:
+                entry = ("shm", {node_id})
+                self.entries[oid] = entry
+                merged = [node_id] if hook is not None else None
+                cbs = self.callbacks.pop(oid, [])
+        if merged is not None:
+            hook(oid, node_id, merged)
+        if entry is None:
+            return
         for cb in cbs:
             cb(entry)
         self._pulse_ready()
@@ -699,8 +714,11 @@ class ObjectDirectory:
         return entry
 
     def discard(self, oid: bytes):
+        hook = self.on_discard
         with self.lock:
-            self.entries.pop(oid, None)
+            e = self.entries.pop(oid, None)
+        if hook is not None and e is not None and e[0] == "shm":
+            hook(oid)
 
 
 class PlacementGroupState:
@@ -950,6 +968,11 @@ class Runtime:
         from ray_tpu.core.persistence import make_store
         self._persist = bool(cfg.head_persistence_path)
         self._pstore = make_store(cfg.head_persistence_path)
+        # Full control-plane WAL (beyond the durable tables): in-flight
+        # lease grants, object-directory locations, PG reservations and
+        # stream specs/cursors — the state a head.kill SIGKILL must
+        # replay. Same store, more tables.
+        self._wal = self._persist and cfg.head_wal
         self.actors: dict[bytes, ActorState] = {}
         self.named_actors: dict[str, bytes] = _JournaledDict(
             "named", self._pstore)
@@ -1083,10 +1106,73 @@ class Runtime:
         if cfg.object_spill_threshold < 1.0:
             threading.Thread(target=self._spill_monitor_loop, daemon=True,
                              name="rtpu-spill-monitor").start()
+        # --- head shards (core/head_shards.py): N subprocesses own
+        # disjoint id-space slices of the object directory (durable
+        # per-shard WAL mirror) and task-event ingest; the head keeps
+        # lease policy and stays the lookup authority. The shard map
+        # rides the cluster-view broadcast as a reserved pseudo-entry.
+        self._shards = None
+        if cfg.head_shards > 0:
+            from ray_tpu.core import head_shards as _head_shards
+            self._shards = _head_shards.ShardManager(
+                cfg.head_shards, cfg.head_persistence_path or None,
+                chaos_env=cfg.to_env())
+            self._publish_shard_map()
+            threading.Thread(target=self._shard_health_loop, daemon=True,
+                             name="rtpu-shard-health").start()
+        if self._wal or self._shards is not None:
+            self.directory.on_location = self._on_dir_location
+            self.directory.on_discard = self._on_dir_discard
         if self._persist:
             self._restore_persisted()
 
+    # ---------------- head shards (manager side) ----------------
+
+    def _on_dir_location(self, oid: bytes, nid: bytes, merged: list):
+        """Directory write-through: the WAL's "dir" table records the
+        full merged location list (restart re-seeds without waiting for
+        agent re-registration inventories); the shard mirror gets the
+        incremental (oid, nid) via the manager's batched flusher."""
+        if self._wal:
+            self._pstore.append("dir", oid, merged)
+        if self._shards is not None:
+            self._shards.dir_add(oid, nid)
+
+    def _on_dir_discard(self, oid: bytes):
+        if self._wal:
+            self._pstore.delete("dir", oid)
+        if self._shards is not None:
+            self._shards.dir_discard(oid)
+
+    def _publish_shard_map(self):
+        """Stamp the current shard map into the cluster view under the
+        reserved pseudo-key — distribution, delta encoding and the
+        cursor-0 full catch-up are the broadcast's existing machinery.
+        Agent-side consumers of real node entries skip it naturally (it
+        has neither a state nor a ctrl address)."""
+        from ray_tpu.core.head_shards import SHARD_MAP_KEY
+        self._cview_update(SHARD_MAP_KEY, smap=self._shards.shard_map())
+
+    def _shard_health_loop(self):
+        while not self._shutdown:
+            time.sleep(1.0)
+            try:
+                shards = self._shards
+                if shards is not None and shards.check_and_heal():
+                    self._publish_shard_map()
+            except Exception:  # noqa: BLE001 — the healer must not die
+                traceback.print_exc()
+
     # ---------------- head restart / persistence restore ----------------
+
+    def _seed_locations(self, located: dict):
+        """Replay {oid: [node_id]} into the directory as shm entries
+        without re-journaling them (direct entry writes, under the
+        directory lock — add_location would write the WAL back)."""
+        with self.directory.lock:
+            for oid, locs in located.items():
+                if locs and oid not in self.directory.entries:
+                    self.directory.entries[oid] = ("shm", set(locs))
 
     def _restore_persisted(self):
         """Replay the persistence journal into head tables (parity:
@@ -1094,12 +1180,20 @@ class Runtime:
         RESTARTING until an agent re-registration adopts their still-running
         worker; unclaimed ones respawn after the adopt grace."""
         tables = self._pstore.load()
+        if self._shards is not None:
+            # Shard mirror re-seed: every shard replayed its own WAL on
+            # boot, so the merged snapshot rebuilds shm locations BEFORE
+            # any agent has re-registered its arena inventory (which
+            # still merges in later, idempotently).
+            self._seed_locations(self._shards.snapshot_all())
         if not tables:
             return
         import cloudpickle
         self.kv.load_silent(tables.get("kv", {}))
         self.fn_table.load_silent(tables.get("fn", {}))
         self.named_actors.load_silent(tables.get("named", {}))
+        # WAL "dir" table: shm locations the dead head had merged.
+        self._seed_locations(tables.get("dir", {}))
         restored_actors = []
         for aid, blob in tables.get("actor", {}).items():
             try:
@@ -1111,18 +1205,43 @@ class Runtime:
             st.restored = True
             self.actors[aid] = st
             restored_actors.append(aid)
-        for pg_id, (bundles, strategy, name) in tables.get("pg", {}).items():
+        for pg_id, rec in tables.get("pg", {}).items():
+            # 3-tuple (pre-WAL) or 4-tuple with the reserved bundle_nodes
+            # rider; placement re-derives when nodes rejoin either way.
+            bundles, strategy, name = rec[0], rec[1], rec[2]
             try:
                 self.create_placement_group(pg_id, bundles, strategy, name)
             except Exception:  # noqa: BLE001 — infeasible until nodes rejoin
                 pass
         dep_tasks: list[tuple] = []
         task_table = tables.get("task", {})
+        # WAL "stream" table: admitted streaming specs (spec, cursor-at-
+        # admit); resubmission regenerates their yields deterministically,
+        # so a reconnected consumer continues at its absolute index.
+        stream_cur = tables.get("stream_cur", {})
+        stream_specs: dict = {}
+        for tid, rec in tables.get("stream", {}).items():
+            stream_specs[tid] = (rec[0] if isinstance(rec, (tuple, list))
+                                 else rec)
+        # WAL "lease" table: grants in flight at the kill. A surviving
+        # agent's dedup ledger may still hold (task, lease_seq) from the
+        # dead head's grant — the replayed spec must re-grant PAST that
+        # seq or the re-send is swallowed and the task hangs forever.
+        lease_table = dict(tables.get("lease", {}))
+        for tid in list(lease_table):
+            if tid not in task_table and tid not in stream_specs:
+                # Task completed; the crash landed between its task-table
+                # delete and the lease delete. Retire the orphan.
+                self._pstore.delete("lease", tid)
+                lease_table.pop(tid)
         # Return ids the replay will actually (re-)produce: only tasks that
         # really resubmitted may vouch for a dependent's dep — a producer
         # whose replay failed must not, or its consumers hang ungated.
         replayed_outputs: set[bytes] = set()
-        for tid, spec in task_table.items():
+        for tid, spec in [*task_table.items(), *stream_specs.items()]:
+            granted = lease_table.get(tid)
+            if granted is not None:
+                spec.lease_seq = max(spec.lease_seq or 0, granted[1])
             if spec.dependencies:
                 # The object directory died with the old head. The deps may
                 # still exist (agents re-register with an arena inventory
@@ -1138,6 +1257,12 @@ class Runtime:
                 replayed_outputs.update(spec.return_ids or [])
             except Exception:  # noqa: BLE001 — drop unreplayable tasks
                 pass
+        if stream_specs:
+            with self.lock:
+                for tid in stream_specs:
+                    st = self._streams.get(tid)
+                    if st is not None and tid in stream_cur:
+                        st["consumed"] = stream_cur[tid]
         grace = self.config.head_restart_adopt_grace_s
         if restored_actors:
 
@@ -3464,6 +3589,13 @@ class Runtime:
             # become plain bytes for the pickle journal.
             self._pstore.append("task", spec.task_id,
                                 _journal_safe_spec(spec))
+        elif self._wal and spec.actor_id is None and spec.streaming:
+            # WAL: an ADMITTED stream survives a head SIGKILL — restore
+            # resubmits the spec (yields regenerate deterministically)
+            # and the reconnected consumer continues at its absolute
+            # index. Retired when the stream is exhausted or abandoned.
+            self._pstore.append("stream", spec.task_id,
+                                (_journal_safe_spec(spec), 0))
         self.task_events.record(
             spec.task_id, spec, "SUBMITTED",
             data=_DRIVER_JOB if spec.owner is None
@@ -3546,6 +3678,20 @@ class Runtime:
                 "parked": [],  # [(idx, cb)] worker-side stream_next waiters
             }
 
+    def _journal_stream_cursor(self, task_id: bytes, consumed: int):
+        """WAL the consumer's cursor so a restarted head restores the
+        stream's consumed mark (abandon-drop bookkeeping stays correct
+        across the restart). No-op unless the full WAL is on."""
+        if self._wal:
+            self._pstore.append("stream_cur", task_id, consumed)
+
+    def _journal_stream_drop(self, task_id: bytes):
+        """Retire a stream's WAL records: it is exhausted or abandoned —
+        no longer 'admitted', so a restart must not resubmit it."""
+        if self._wal:
+            self._pstore.delete("stream", task_id)
+            self._pstore.delete("stream_cur", task_id)
+
     def _stream_append(self, task_id: bytes, rid: bytes):
         with self.lock:
             st = self._streams.get(task_id)
@@ -3580,19 +3726,27 @@ class Runtime:
         else park `cb` until the yield lands or the stream closes. One
         parked entry replaces the thread-per-RPC a blocking wait would
         need (stream_next arrives once per consumed item)."""
+        advanced = 0
+        exhausted = False
         with self.lock:
             st = self._streams.get(task_id)
             if st is None:
                 rid = None
             elif idx < len(st["items"]):
-                st["consumed"] = max(st["consumed"], idx + 1)
+                if idx + 1 > st["consumed"]:
+                    st["consumed"] = advanced = idx + 1
                 rid = st["items"][idx]
             elif st["done"]:
                 self._streams.pop(task_id, None)  # exhausted
+                exhausted = True
                 rid = None
             else:
                 st["parked"].append((idx, cb))
                 return
+        if exhausted:
+            self._journal_stream_drop(task_id)
+        elif advanced:
+            self._journal_stream_cursor(task_id, advanced)
         cb(rid)
 
     def release_stream(self, task_id: bytes):
@@ -3608,6 +3762,7 @@ class Runtime:
             st["cv"].notify_all()
             fired = [(cb, None) for _i, cb in st["parked"]]
             st["parked"] = []
+        self._journal_stream_drop(task_id)  # no longer admitted
         for cb, none in fired:
             cb(none)
         for rid in unread:
@@ -3654,10 +3809,13 @@ class Runtime:
                         f"streaming task {task_id.hex()[:12]} produced no "
                         f"item #{idx} in time")
             if idx < len(st["items"]):
-                st["consumed"] = max(st["consumed"], idx + 1)
+                if idx + 1 > st["consumed"]:
+                    st["consumed"] = idx + 1
+                    self._journal_stream_cursor(task_id, idx + 1)
                 return st["items"][idx]
             # closed and exhausted: drop the state
             self._streams.pop(task_id, None)
+            self._journal_stream_drop(task_id)
             return None
 
     def stream_finished(self, task_id: bytes) -> bool:
@@ -4200,6 +4358,13 @@ class Runtime:
         st.bundle_nodes = assign
         st.state = "CREATED"
         st.bundle_avail = [dict(b) for b in st.bundles]
+        if self._wal:
+            # WAL the landed reservation (4-tuple extends the PR-8 pg
+            # record with bundle placements); restore tolerates both
+            # arities and re-places when nodes rejoin.
+            self._pstore.append("pg", st.pg_id,
+                                (list(st.bundles), st.strategy, st.name,
+                                 list(assign)))
         return True
 
     def _fulfill_pg_ready(self, st: PlacementGroupState):
@@ -4512,6 +4677,18 @@ class Runtime:
             now = time.monotonic()
             for _fid, _blob, spec in per_node[node]:
                 node.lease_sent[spec.task_id] = [now, 0]
+                if self._wal:
+                    # WAL the in-flight grant BEFORE the send: a restart
+                    # replays the task with lease_seq past this grant, so
+                    # a surviving agent's (task, seq) dedup ledger can
+                    # never swallow the re-grant.
+                    self._pstore.append(
+                        "lease", spec.task_id,
+                        (node.node_id, spec.lease_seq or 0))
+            # Crash-consistency probe: grants of this batch are committed
+            # but unsent — recovery must re-drive every one of them from
+            # the journal alone.
+            chaos.kill("head.kill")
             nidx = node.conn._nidx if node.conn is not None else None
             if native and hnat is not None and nidx is not None:
                 # Native grant plane, head half: stage each raw entry
@@ -4811,6 +4988,11 @@ class Runtime:
             holder.leases.pop(task_id, None)
             if self._hnat is not None and not native_popped:
                 self._hnat.inflight_pop(task_id)
+            if self._wal:
+                # Grant retired (completed/failed/requeued): every pop
+                # funnels through here, so this is the WAL "lease"
+                # table's single delete chokepoint.
+                self._pstore.delete("lease", task_id)
         return spec
 
     def _on_lease_return(self, from_nid: bytes, specs: list):
@@ -5970,6 +6152,12 @@ class Runtime:
         ring-buffered like every other process's, but there is no socket
         to flush over — queries pull them in)."""
         self._drain_tev_pending()
+        if self._shards is not None:
+            # Shard-held event slices merge lazily — agents shipped them
+            # to the owning shards, keeping per-event work off the head's
+            # storm path; queries pay the pull instead.
+            for nid, batch, dropped in self._shards.drain_tev():
+                self.task_store.ingest(batch, node=nid, dropped=dropped)
         batch, dropped = task_events.ring().drain(max_events=1 << 20)
         if batch or dropped:
             self.task_store.ingest(batch, node=None, dropped=dropped)
@@ -6016,6 +6204,9 @@ class Runtime:
                 self._cluster_srv.close()
             except OSError:
                 pass
+        if self._shards is not None:
+            self._shards.shutdown()
+        self._pstore.close()
         if getattr(self, "_proto_clients", None) is not None:
             self._proto_clients.close()
         for w in list(self.workers.values()):

@@ -24,6 +24,15 @@ class ObjectStoreFullError(RayTpuError):
     pass
 
 
+class ObjectExistsError(RayTpuError):
+    """An arena create/seal named an object id the store already holds.
+
+    Benign on the task-replay path: a restarted head re-grants any task
+    whose node_done it never saw, and the re-executing worker re-seals a
+    result the FIRST attempt already sealed — that seal must be treated
+    as success (at-least-once execution, exactly-once publication)."""
+
+
 class ObjectLostError(RayTpuError):
     def __init__(self, object_id, msg=""):
         self.object_id = object_id

@@ -1011,7 +1011,7 @@ def _put_with_spill(rt: "WorkerRuntime", oid: ObjectID, value, nbytes: int):
     the head to make room BEFORE crossing the spill threshold (and retries
     once on full). On other nodes the head could not help — the request is
     skipped and the agent arena's eviction is the pressure valve."""
-    from ray_tpu.core.status import ObjectStoreFullError
+    from ray_tpu.core.status import ObjectExistsError, ObjectStoreFullError
     on_head = os.environ.get("RAY_TPU_IS_HEAD_NODE") == "1"
     if on_head and not rt.store.reservation_fits(nbytes):
         stats = rt.store.stats()
@@ -1026,14 +1026,23 @@ def _put_with_spill(rt: "WorkerRuntime", oid: ObjectID, value, nbytes: int):
             rt.store.put_arrow(oid, table)
         else:
             rt.store.put_serialized(oid, value)
+    except ObjectExistsError:
+        # Replayed task: a restarted head re-grants any lease whose
+        # node_done it never saw, so a PRIOR attempt may have sealed this
+        # exact result already. The publication is done — report success
+        # (at-least-once execution, exactly-once publication).
+        return
     except ObjectStoreFullError:
         if not on_head:
             raise
         rt.request("spill", int(nbytes * 1.5) + (1 << 20))
-        if table is not None:
-            rt.store.put_arrow(oid, table)
-        else:
-            rt.store.put_serialized(oid, value)
+        try:
+            if table is not None:
+                rt.store.put_arrow(oid, table)
+            else:
+                rt.store.put_serialized(oid, value)
+        except ObjectExistsError:
+            return
 
 
 GLOBAL: WorkerRuntime | None = None

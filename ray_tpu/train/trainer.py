@@ -135,8 +135,28 @@ class TrainWorker:
                 ip = socket.gethostbyname(socket.gethostname())
             except OSError:
                 ip = "127.0.0.1"
-        s = socket.socket()
-        s.bind(("" if ip.startswith("127.") else ip, 0))
+        # Probe-bind BELOW the kernel's ephemeral floor: bind(0) mints a
+        # port from the ephemeral range (net.ipv4.ip_local_port_range,
+        # 32768+ by default), which any unrelated outgoing connection can
+        # grab in the close -> torch-rebind window — the EADDRINUSE flake
+        # on a busy host. A sub-ephemeral port can only lose a race to
+        # another deliberate binder, and the pid-spread start keeps
+        # concurrent gangs on disjoint probes.
+        bind_ip = "" if ip.startswith("127.") else ip
+        base, span = 20000, 8000
+        start = (os.getpid() * 97) % span
+        for off in range(512):
+            port = base + (start + off) % span
+            s = socket.socket()
+            try:
+                s.bind((bind_ip, port))
+            except OSError:
+                s.close()
+                continue
+            s.close()
+            return f"{ip}:{port}"
+        s = socket.socket()  # range exhausted (pathological): old path
+        s.bind((bind_ip, 0))
         port = s.getsockname()[1]
         s.close()
         return f"{ip}:{port}"

@@ -1,0 +1,359 @@
+"""Emulated-agent swarm: hundreds of control-plane-faithful node agents
+in ONE subprocess.
+
+The real `Cluster` rig (util/many_agents.py) tops out around 64 agents on
+a dev box — each node agent is a full process with a shm arena, a worker
+pool and a native lease loop, so 256 of them exhaust memory and pid
+budgets long before the HEAD becomes the bottleneck. This module inverts
+the ratio: the head under test stays real (and in the parent process, so
+`time.process_time()` isolates head CPU), while the agents collapse into
+one selector loop that speaks the agent wire protocol faithfully:
+
+  * `register_node` with a unique 16-byte node id and {"CPU": cpus}
+  * versioned `heartbeat` load views (inflight churns during a storm, so
+    the head's cluster-view broadcast actually fans out)
+  * lease ingest on BOTH grant planes — `node_exec` (object specs) and
+    `node_exec_raw` (pickled sideband) — with the same (task_id,
+    lease_seq) dedup ledger a real agent keeps
+  * real execution: fn blobs are cloudpickle-loaded and cached by fn_id,
+    args deserialized, results serialized back as inline `node_done`
+    outs — the driver's ObjectRefs resolve to REAL values, so a
+    256-agent storm still asserts end-to-end correctness
+  * task events shipped as ring 6-tuples, routed to head shards by
+    `bucket_of(task_id)` when a shard map has been adopted from the
+    cluster-view broadcast (head fallback otherwise) — the sharded tev
+    ingest plane sees the same traffic shape real agents generate
+  * per-agent cluster-view arrival stamps, aggregated into the
+    view-fanout spread (first-to-last arrival per version) that the
+    `cluster_scale` bench row reports
+
+What is NOT emulated: object arenas (every result rides inline), worker
+pools, the agent<->agent spill plane. Those planes scale with NODES, not
+with the head — this harness exists to load the head's scheduling and
+view-fanout planes, which is exactly the axis the shard subsystem moves.
+
+Protocol with the parent (util/many_agents.py):
+  stdout line "EMU_READY <n>"  — all n agents registered
+  stdin EOF                    — drain, then print one stats JSON line
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pickle
+import selectors
+import socket
+import sys
+import threading
+import time
+
+from ray_tpu.core.head_shards import SHARD_MAP_KEY, bucket_of
+from ray_tpu.core.transport import FrameBuffer, dial, enable_nodelay, send_msg
+
+
+class _EmuAgent:
+    __slots__ = ("nid", "sock", "fbuf", "registered", "executed", "hb_v",
+                 "seen", "next_hb", "done_since_hb")
+
+    def __init__(self, nid: bytes, sock: socket.socket):
+        self.nid = nid
+        self.sock = sock
+        self.fbuf = FrameBuffer()
+        self.registered = False
+        self.executed = 0
+        self.hb_v = 0
+        self.seen: set = set()          # (task_id, lease_seq) dedup ledger
+        self.next_hb = 0.0
+        self.done_since_hb = 0
+
+
+class Swarm:
+    def __init__(self, head_addr, n_agents: int, cpus: float = 1.0,
+                 hb_period: float = 1.0):
+        self.head_addr = head_addr
+        self.n = n_agents
+        self.cpus = cpus
+        self.hb_period = hb_period
+        self.sel = selectors.DefaultSelector()
+        self.agents: list[_EmuAgent] = []
+        self.fn_cache: dict = {}         # fn_id -> callable (shared: same
+        self.fn_blobs: dict = {}         # storm fn on every agent)
+        # Shard routing (process-wide: one TCP channel per shard, like the
+        # head's own mirror flusher — 256 dials per shard would be noise).
+        self.smap: dict | None = None
+        self.shard_socks: dict[int, socket.socket] = {}
+        self.tev_shard = 0
+        self.tev_head = 0
+        self.dedup_hits = 0
+        self.exec_errors = 0
+        # View-fanout accounting: version -> [first_arrival, last, count].
+        self.view_arrivals: dict[int, list] = {}
+        self.view_spreads: list[float] = []
+        self.stop = False
+
+    # ---------------- lifecycle ----------------
+
+    def start(self):
+        for _ in range(self.n):
+            nid = os.urandom(16)
+            sock = dial(self.head_addr, timeout=30.0)
+            enable_nodelay(sock)
+            sock.setblocking(False)
+            ag = _EmuAgent(nid, sock)
+            send_msg(sock, ("register_node", nid, {"CPU": self.cpus},
+                            ("127.0.0.1", 1), "emu", os.getpid(),
+                            [], None, []))
+            self.sel.register(sock, selectors.EVENT_READ, ag)
+            self.agents.append(ag)
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            self._poll(0.05)
+            if all(a.registered for a in self.agents):
+                return
+        raise TimeoutError("emu agents did not all register")
+
+    def run(self):
+        """Serve until stop is set (parent closed stdin)."""
+        base = time.monotonic()
+        for i, ag in enumerate(self.agents):   # staggered heartbeats
+            ag.next_hb = base + self.hb_period * (i / max(1, self.n))
+        while not self.stop:
+            self._poll(0.05)
+            now = time.monotonic()
+            for ag in self.agents:
+                if now >= ag.next_hb:
+                    ag.next_hb = now + self.hb_period
+                    ag.hb_v += 1
+                    view = {"v": ag.hb_v, "idle": 1, "backlog": 0,
+                            "inflight": ag.done_since_hb}
+                    ag.done_since_hb = 0
+                    try:
+                        send_msg(ag.sock, ("heartbeat", ag.nid, view))
+                    except OSError:
+                        pass
+
+    def stats(self) -> dict:
+        spreads = sorted(self.view_spreads)
+
+        def pct(p):
+            if not spreads:
+                return 0.0
+            return spreads[min(len(spreads) - 1, int(p * len(spreads)))]
+
+        return {
+            "executed_total": sum(a.executed for a in self.agents),
+            "agents_used": sum(1 for a in self.agents if a.executed),
+            "dedup_hits": self.dedup_hits,
+            "exec_errors": self.exec_errors,
+            "tev_shard": self.tev_shard,
+            "tev_head": self.tev_head,
+            "view_versions_complete": len(self.view_spreads),
+            "view_spread_p50_ms": round(pct(0.50) * 1e3, 3),
+            "view_spread_p95_ms": round(pct(0.95) * 1e3, 3),
+            "sharded": self.smap is not None,
+        }
+
+    def close(self):
+        for ag in self.agents:
+            try:
+                ag.sock.close()
+            except OSError:
+                pass
+        for s in self.shard_socks.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        self.sel.close()
+
+    # ---------------- frame plumbing ----------------
+
+    def _poll(self, timeout: float):
+        for key, _ in self.sel.select(timeout):
+            ag: _EmuAgent = key.data
+            try:
+                data = ag.sock.recv(1 << 20)
+            except BlockingIOError:
+                continue
+            except OSError:
+                data = b""
+            if not data:
+                try:
+                    self.sel.unregister(ag.sock)
+                except (KeyError, ValueError):
+                    pass
+                continue
+            ag.fbuf.feed(data)
+            for msg in ag.fbuf.frames():
+                self._handle(ag, msg)
+
+    def _handle(self, ag: _EmuAgent, msg):
+        op = msg[0]
+        if op == "batch":
+            for inner in msg[1]:
+                self._handle(ag, inner)
+        elif op == "node_ack":
+            ag.registered = True
+        elif op == "cluster_view":
+            self._on_view(ag, msg[1], msg[2])
+        elif op == "node_exec":
+            self._exec(ag, [(spec.task_id, fn_id, spec.lease_seq or 0,
+                             blob, spec) for fn_id, blob, spec in msg[1]])
+        elif op == "node_exec_raw":
+            self._exec(ag, [(e[0], e[1], e[2] or 0, e[3],
+                             pickle.loads(e[4])) for e in msg[1]])
+        elif op == "shutdown_node":
+            self.stop = True
+        # lease_reclaim / spawn_worker / seq_skip etc.: no backlog, no
+        # workers — nothing to do.
+
+    def _on_view(self, ag: _EmuAgent, version: int, entries):
+        now = time.monotonic()
+        rec = self.view_arrivals.get(version)
+        if rec is None:
+            rec = self.view_arrivals[version] = [now, now, 0]
+        rec[1] = now
+        rec[2] += 1
+        if rec[2] == self.n:
+            self.view_spreads.append(rec[1] - rec[0])
+            del self.view_arrivals[version]
+        for nid, e in entries:
+            if nid == SHARD_MAP_KEY:
+                smap = e.get("smap")
+                if smap is not None and (self.smap is None or
+                                         smap["epoch"] > self.smap["epoch"]):
+                    self.smap = smap
+                    for s in self.shard_socks.values():
+                        try:
+                            s.close()
+                        except OSError:
+                            pass
+                    self.shard_socks.clear()
+
+    # ---------------- execution ----------------
+
+    def _exec(self, ag: _EmuAgent, entries):
+        """entries: (task_id, fn_id, lease_seq, blob|None, spec)."""
+        from ray_tpu.core import serialization
+        import cloudpickle
+        dones = []
+        tev = []
+        for tid, fn_id, seq, blob, spec in entries:
+            if blob is not None and fn_id is not None:
+                self.fn_blobs[fn_id] = blob
+            key = (tid, seq)
+            if key in ag.seen:
+                self.dedup_hits += 1
+                continue
+            ag.seen.add(key)
+            try:
+                fn = self.fn_cache.get(fn_id)
+                if fn is None:
+                    fn = cloudpickle.loads(self.fn_blobs[fn_id])
+                    self.fn_cache[fn_id] = fn
+                args, kwargs = serialization.deserialize(
+                    spec.payload, spec.buffers)
+                payload, bufs, _ = serialization.serialize_value(
+                    fn(*args, **kwargs))
+                status = "inline"
+            except BaseException as exc:  # noqa: BLE001 — becomes an
+                self.exec_errors += 1     # "err" out, like a real worker
+                payload, bufs, _ = serialization.serialize_value(exc)
+                status = "err"
+            outs = [(rid, status, payload, list(bufs))
+                    for rid in spec.return_ids]
+            dones.append((tid, outs))
+            ag.executed += 1
+            ag.done_since_hb += 1
+            tev.append((tid, 0, "FINISHED", time.time(),
+                        (spec.name, spec.method_name), None))
+        if dones:
+            try:
+                send_msg(ag.sock, ("node_done", dones))
+            except OSError:
+                pass
+        if tev:
+            self._ship_tev(ag, tev)
+
+    def _ship_tev(self, ag: _EmuAgent, events):
+        """Route ring events to the owning shard (head fallback) — the
+        same split a real agent's _ship_tev_shards performs."""
+        smap = self.smap
+        residue = events
+        if smap is not None:
+            buckets = smap["buckets"]
+            per_shard: dict[int, list] = {}
+            for ev in events:
+                per_shard.setdefault(buckets[bucket_of(ev[0])],
+                                     []).append(ev)
+            residue = []
+            for sid, evs in per_shard.items():
+                if self._shard_send(sid, ("tev_ingest", ag.nid, evs, 0)):
+                    self.tev_shard += len(evs)
+                else:
+                    residue.extend(evs)
+        if residue:
+            self.tev_head += len(residue)
+            try:
+                send_msg(ag.sock, ("task_events", residue, 0))
+            except OSError:
+                pass
+
+    def _shard_send(self, sid: int, msg) -> bool:
+        sock = self.shard_socks.get(sid)
+        if sock is None:
+            smap = self.smap
+            addr = next(((h, p) for s, h, p in smap["shards"] if s == sid),
+                        None)
+            if addr is None:
+                return False
+            try:
+                sock = dial(addr, timeout=5.0)
+                enable_nodelay(sock)
+            except OSError:
+                return False
+            self.shard_socks[sid] = sock
+        try:
+            send_msg(sock, msg)
+            return True
+        except OSError:
+            self.shard_socks.pop(sid, None)
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return False
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--head", required=True, help="host:port of the head")
+    ap.add_argument("--n", type=int, default=256)
+    ap.add_argument("--cpus", type=float, default=1.0)
+    ap.add_argument("--hb-period", type=float, default=1.0)
+    args = ap.parse_args(argv)
+    host, port = args.head.rsplit(":", 1)
+    swarm = Swarm((host, int(port)), args.n, cpus=args.cpus,
+                  hb_period=args.hb_period)
+    swarm.start()
+    print(f"EMU_READY {args.n}", flush=True)
+
+    def _watch_stdin():
+        try:
+            while sys.stdin.buffer.read(4096):
+                pass
+        except OSError:
+            pass
+        swarm.stop = True
+
+    threading.Thread(target=_watch_stdin, daemon=True,
+                     name="emu-stdin").start()
+    swarm.run()
+    print(json.dumps(swarm.stats()), flush=True)
+    swarm.close()
+
+
+if __name__ == "__main__":
+    main()

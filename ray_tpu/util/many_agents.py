@@ -80,3 +80,94 @@ def run_many_agents(n_agents: int = 16, n_tasks: int = 400,
         }
     finally:
         c.shutdown()
+
+
+def run_emulated_storm(n_agents: int = 256, n_tasks: int = 2000,
+                       head_shards: int = 0,
+                       register_timeout: float = 120.0) -> dict:
+    """256-agent-class head load without 256 OS processes: one real head
+    (in THIS process, so `time.process_time()` is head CPU) plus an
+    emulated-agent swarm (util/agent_emu.py) speaking the real agent wire
+    protocol from a single subprocess. Returns the run_many_agents metric
+    dict extended with the swarm's view-fanout spread percentiles and the
+    shard/head tev routing split.
+
+    `head_shards=N` boots the head with N directory/tev shard processes
+    (core/head_shards.py) — the A/B axis of the cluster_scale bench row:
+    the sharded head should hold tasks_per_head_cpu_s as n_agents grows,
+    because directory WAL/mirror writes and task-event ingest leave its
+    process entirely."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(initialize_head=True,
+                head_node_args={"num_cpus": 1,
+                                "object_store_memory": 64 << 20,
+                                "_system_config": {
+                                    "head_shards": head_shards}})
+    emu = None
+    try:
+        env = dict(os.environ)
+        env.update(c.rt.config.to_env())
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get(
+            "PYTHONPATH", "")
+        emu = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.util.agent_emu",
+             "--head", c.address, "--n", str(n_agents)],
+            env=env, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True)
+        ready = emu.stdout.readline()
+        if not ready.startswith("EMU_READY"):
+            raise RuntimeError(f"emu swarm failed to boot: {ready!r}")
+        c.wait_for_nodes(n_agents + 1, timeout=register_timeout)
+
+        @ray_tpu.remote(num_cpus=1)
+        def f(x):
+            return x + 1
+
+        # Warm wave: fn blob distribution + first-touch of every emu
+        # agent's lease path, off the clock (mirrors run_many_agents).
+        ray_tpu.get([f.remote(i) for i in range(2 * n_agents)],
+                    timeout=register_timeout)
+        t0 = time.perf_counter()
+        c0 = time.process_time()
+        out = ray_tpu.get([f.remote(i) for i in range(n_tasks)],
+                          timeout=300)
+        head_cpu_s = max(1e-9, time.process_time() - c0)
+        rate = n_tasks / (time.perf_counter() - t0)
+        correct = out == list(range(1, n_tasks + 1))
+        from ray_tpu.core.runtime import get_runtime
+        rt = get_runtime()
+        nodes_alive = sum(1 for n in rt.nodes.values()
+                          if n.state == "ALIVE")
+        # Drain the swarm: closing stdin asks it to print its stats line.
+        emu.stdin.close()
+        stats_line = emu.stdout.readline()
+        emu.wait(timeout=30)
+        stats = json.loads(stats_line) if stats_line.strip() else {}
+        return {
+            "rate": round(rate, 1),
+            "n_agents": n_agents,
+            "head_shards": head_shards,
+            "nodes_alive": nodes_alive,
+            "agents_used": stats.get("agents_used", 0),
+            "correct": correct,
+            "head_cpu_s": round(head_cpu_s, 3),
+            "tasks_per_head_cpu_s": round(n_tasks / head_cpu_s, 1),
+            "view_spread_p50_ms": stats.get("view_spread_p50_ms", 0.0),
+            "view_spread_p95_ms": stats.get("view_spread_p95_ms", 0.0),
+            "tev_shard": stats.get("tev_shard", 0),
+            "tev_head": stats.get("tev_head", 0),
+            "exec_errors": stats.get("exec_errors", -1),
+        }
+    finally:
+        if emu is not None and emu.poll() is None:
+            emu.kill()
+        c.shutdown()

@@ -16,6 +16,17 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # Keep pytest output clean: worker log streaming is exercised by its own
 # unit test, not by every fixture cluster.
 os.environ.setdefault("RAY_TPU_LOG_TO_DRIVER", "0")
+# Share one persistent XLA compilation cache across the whole suite. The
+# suite spawns dozens of worker/agent/replica subprocesses that each re-jit
+# the same tiny train/rllib/llm graphs; env vars are inherited, so a single
+# on-disk cache turns every repeat compile into a ~4x-cheaper cache load.
+# Thresholds are zeroed because every entry here is "too small/fast" by the
+# defaults. Safe for graphcheck (fingerprints hash the lowered HLO, which is
+# computed before the cache is consulted) and for perf gates (they compare
+# post-warmup steady state, not first-compile latency).
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/ray_tpu_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -27,6 +38,13 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# Same latching problem for the cache knobs: update the live config for this
+# (already-imported) process; subprocesses re-import jax with the env vars
+# above already in place and pick them up natively.
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ["JAX_COMPILATION_CACHE_DIR"])
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
 
 import pytest  # noqa: E402
 

@@ -458,26 +458,36 @@ def test_streaming_midstream_error(ray_start_isolated):
 
 def test_streaming_consumer_overlaps_producer(ray_start_isolated):
     """next() unblocks per yield — the consumer need not wait for the
-    whole task (the defining property vs num_returns=N)."""
-    import time
-
-    @ray_tpu.remote(num_returns="streaming")
-    def slow():
-        yield "first"
-        time.sleep(3)
-        yield "second"
+    whole task (the defining property vs num_returns=N). Structural
+    proof, not a wall-clock bound: the producer blocks on a gate only
+    the CONSUMER opens after observing the first item, so batch-at-end
+    delivery would time out instead of flaking on a loaded host."""
 
     @ray_tpu.remote
-    def warmup():
-        pass
+    class Gate:
+        def __init__(self):
+            self._open = False
 
-    ray_tpu.get(warmup.remote(), timeout=60)  # cold spawn is seconds here
-    gen = slow.remote()
-    t0 = time.monotonic()
-    first = ray_tpu.get(next(gen), timeout=60)
-    dt = time.monotonic() - t0
-    assert first == "first"
-    assert dt < 2.0, f"first item blocked on the whole task ({dt:.1f}s)"
+        def open(self):
+            self._open = True
+
+        def is_open(self):
+            return self._open
+
+    @ray_tpu.remote(num_returns="streaming")
+    def slow(gate):
+        import time
+
+        yield "first"
+        while not ray_tpu.get(gate.is_open.remote()):
+            time.sleep(0.05)
+        yield "second"
+
+    gate = Gate.remote()
+    ray_tpu.get(gate.is_open.remote(), timeout=60)  # actor is live
+    gen = slow.remote(gate)
+    assert ray_tpu.get(next(gen), timeout=60) == "first"
+    ray_tpu.get(gate.open.remote(), timeout=60)
     assert ray_tpu.get(next(gen), timeout=60) == "second"
 
 

@@ -95,19 +95,30 @@ def lower_graph(spec: GraphSpec) -> LoweredGraph:
     error = None
     jit_fn = spec.jit_fn if spec.jit_fn is not None else jax.jit(
         spec.fn, **jit_kwargs)
-    with warnings.catch_warnings(record=True) as wlog:
-        warnings.simplefilter("always")
-        lowered = jit_fn.lower(*spec.args)
-        stablehlo = lowered.as_text()
-        try:
-            compiled = lowered.compile()
-            hlo = compiled.as_text()
+    # Fingerprints measure a FRESH compile: executables loaded from the
+    # persistent compilation cache report different memory/cost estimates
+    # than a cold XLA run, which would drift `bytes`/`flops` depending on
+    # cache warmth (and graphcheck's own compiles would pollute the cache
+    # the test suite shares). Hermetic: cache off for the compile, restored
+    # after.
+    cache_was = jax.config.jax_enable_compilation_cache
+    jax.config.update("jax_enable_compilation_cache", False)
+    try:
+        with warnings.catch_warnings(record=True) as wlog:
+            warnings.simplefilter("always")
+            lowered = jit_fn.lower(*spec.args)
+            stablehlo = lowered.as_text()
             try:
-                input_shardings = list(compiled.input_shardings[0])
-            except Exception:  # noqa: BLE001 — backend-optional surface
-                input_shardings = None
-        except Exception as e:  # noqa: BLE001 — surfaced as a finding
-            error = f"{type(e).__name__}: {e}"
+                compiled = lowered.compile()
+                hlo = compiled.as_text()
+                try:
+                    input_shardings = list(compiled.input_shardings[0])
+                except Exception:  # noqa: BLE001 — backend-optional surface
+                    input_shardings = None
+            except Exception as e:  # noqa: BLE001 — surfaced as a finding
+                error = f"{type(e).__name__}: {e}"
+    finally:
+        jax.config.update("jax_enable_compilation_cache", cache_was)
     for w in wlog:
         msg = str(w.message)
         if _DONATION_REJECT.search(msg):

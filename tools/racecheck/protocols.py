@@ -35,6 +35,12 @@ invariant over every explored interleaving:
                     positions are delivered exactly once across decode
                     replica death at every chunk boundary, and the
                     admission ledger drains to zero.
+  shard_reslice     core/head_shards.py's ShardState.apply_assign /
+                    dir_merge / replay_wal + ShardManager._reslice_locked:
+                    a WAL'd mirror write racing shard SIGKILL, re-slice,
+                    respawn-replay and a delayed stale assign — committed
+                    dir entries survive, and no bucket is ever owned by
+                    two shards at one epoch.
 
 `run_all` splits the exploration budget across models; every violation
 renders as one `interleaving-violation` Finding anchored at the module
@@ -511,6 +517,132 @@ def build_stream_resume(api):
             "check": check, "cleanup": cleanup}
 
 
+# ---------------- head shard ownership / failover ----------------
+
+
+@model("shard_reslice", "ray_tpu/core/head_shards.py")
+def build_shard_reslice(api):
+    """Shard failover on the real protocol core: a WAL'd directory write
+    stream races the manager's kill-detect -> re-slice -> respawn-replay
+    -> hand-back pass, plus a delayed duplicate assign frame. Invariants:
+    (a) every dir entry whose WAL append RETURNED survives the SIGKILL
+    via `replay_wal` (append-before-merge ordering), and (b) ownership
+    stays epoch-gated — two shards at the same epoch never both own a
+    bucket (`apply_assign` rejects stale epochs)."""
+    from ray_tpu.core.head_shards import N_BUCKETS, ShardManager, ShardState
+
+    class _Killed(Exception):
+        """The shard process died: nothing past this point runs."""
+
+    killed = [False]
+
+    class _WalStore:
+        """In-memory stand-in for the shard's persistence store: append()
+        returning IS the commit point (the real store fsyncs a frame)."""
+
+        def __init__(self, dies: bool = False):
+            self.tables: dict = {}
+            self.committed: list = []
+            self.dies = dies
+
+        def append(self, table, key, value):
+            if self.dies and killed[0]:
+                raise _Killed  # chaos seam sits BEFORE the WAL append
+            self.tables.setdefault(table, {})[key] = value
+            self.committed.append(key)
+
+        def delete(self, table, key):
+            self.tables.get(table, {}).pop(key, None)
+
+        def load(self):
+            return {t: dict(kv) for t, kv in self.tables.items()}
+
+    def owned(sid):
+        return [b for b in range(N_BUCKETS) if b % 2 == sid]
+
+    wal0 = _WalStore(dies=True)
+    shard0 = ShardState(0, wal0)
+    shard0.lock = api.lock(name="shard0.lock")
+    shard0.apply_assign(1, owned(0))
+    shard1 = ShardState(1, _WalStore())
+    shard1.lock = api.lock(name="shard1.lock")
+    shard1.apply_assign(1, owned(1))
+
+    mgr = types.SimpleNamespace()
+    mgr.lock = api.lock(name="mgr.lock")
+    mgr.n_shards = 2
+    mgr.epoch = 1
+    mgr.buckets = [i % 2 for i in range(N_BUCKETS)]
+    mgr.links = {0: shard0, 1: shard1}  # _reslice_locked reads only keys
+    mgr._reslice_locked = types.MethodType(
+        ShardManager._reslice_locked, mgr)
+
+    # Mirror writes aimed at shard-0 buckets (0, 2, 4 — all even).
+    oids = [bytes([b]).ljust(16, b"x") for b in (0, 2, 4)]
+
+    def dir_writer():
+        for i, oid in enumerate(oids):
+            api.point(f"shard0.dir_add.{i}")
+            try:
+                shard0.dir_merge([(oid, b"N1")])
+            except _Killed:
+                return  # un-acked frame: the flusher requeues it
+
+    def heal():
+        api.point("mgr.heal.detect")
+        killed[0] = True  # the health pass saw the SIGKILL
+        with mgr.lock:
+            mgr.epoch += 1
+            mgr.buckets = mgr._reslice_locked(0)
+            survivor_owns = [b for b in range(N_BUCKETS)
+                             if mgr.buckets[b] == 1]
+            e = mgr.epoch
+        shard1.apply_assign(e, survivor_owns)
+        api.point("mgr.heal.respawn")
+        s0 = ShardState(0, _WalStore())
+        s0._store.tables = wal0.load()  # respawn on the same WAL path
+        s0.lock = api.lock(name="shard0v2.lock")
+        s0.replay_wal()
+        mgr.links[0] = s0
+        with mgr.lock:
+            mgr.epoch += 1
+            mgr.buckets = [0 if orig == 0 else cur for orig, cur in zip(
+                [i % mgr.n_shards for i in range(N_BUCKETS)], mgr.buckets)]
+            e = mgr.epoch
+        s0.apply_assign(e, owned(0))
+        shard1.apply_assign(e, owned(1))
+
+    def stale_assign():
+        # A delayed duplicate of the re-slice assign (epoch 2, survivor
+        # owns everything) landing at ANY point — after the hand-back it
+        # must bounce off the epoch gate, or two live shards both own
+        # the even buckets.
+        api.point("stale.assign.arrive")
+        shard1.apply_assign(2, list(range(N_BUCKETS)))
+
+    def check():
+        s0, s1 = mgr.links[0], mgr.links[1]
+        for oid in wal0.committed:
+            assert oid in s0.dir, (
+                f"committed dir entry {oid[:1]!r} lost across the shard "
+                "SIGKILL (WAL append returned but replay missed it)")
+        # Quiescent no-overlap: every assign (including the stale dup)
+        # has landed, so the two LIVE shards' claims must be disjoint —
+        # any overlap means the epoch gate let a stale frame through.
+        both = s0.buckets & s1.buckets
+        assert not both, (
+            f"double ownership (epochs {s0.epoch}/{s1.epoch}): buckets "
+            f"{sorted(both)} owned by shard 0 AND shard 1")
+        assert len(mgr.buckets) == N_BUCKETS and all(
+            sid in mgr.links for sid in mgr.buckets), (
+            "manager bucket table names a shard without a live link")
+
+    return {"threads": [("dir_writer", dir_writer),
+                        ("heal", heal),
+                        ("stale_assign", stale_assign)],
+            "check": check}
+
+
 # ---------------- driver ----------------
 
 
@@ -525,6 +657,7 @@ _CAPS = {
     "ckpt_two_phase": dict(max_schedules=400, pct_schedules=16,
                            max_preemptions=1),
     "stream_resume": dict(max_schedules=2500, pct_schedules=24),
+    "shard_reslice": dict(max_schedules=3000, pct_schedules=24),
 }
 
 

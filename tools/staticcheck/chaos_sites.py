@@ -58,6 +58,13 @@ RECOVERY_SCOPES: tuple = (
     ("ray_tpu/core/runtime.py", "_on_actor_worker_death"),
     ("ray_tpu/core/object_store.py", "release_reservation"),
     ("ray_tpu/core/object_store.py", "reclaim_orphans"),
+    # Head-shard plane: the heal pass (shard SIGKILL -> re-slice ->
+    # respawn-with-replay -> hand-back) and the dir mirror's dead-shard
+    # requeue path; plus the worker-side replayed-task re-seal (a
+    # restarted head re-grants tasks whose node_done it never saw).
+    ("ray_tpu/core/head_shards.py", "check_and_heal"),
+    ("ray_tpu/core/head_shards.py", "_dir_flush_loop"),
+    ("ray_tpu/core/worker.py", "_put_with_spill"),
     # Elastic train plane: the code that turns a killed/hung worker or a
     # torn checkpoint into a committed-manifest resume must stay loud.
     ("ray_tpu/train/trainer.py", "_poll_until_done"),

@@ -37,6 +37,7 @@ from tools.staticcheck import Finding
 # train's elastic checkpoint + watchdog paths).
 TARGETS = (
     "ray_tpu/core/node_agent.py",
+    "ray_tpu/core/head_shards.py",
     "ray_tpu/core/worker.py",
     "ray_tpu/core/runtime.py",
     "ray_tpu/core/object_store.py",

@@ -56,6 +56,21 @@ PICKLE_FRAMED_MESSAGES = {
     "DirectActorCall": {"spec": 1},
     "DirectActorReply": {"dones": 1},
     "DirectActorReply.Done": {"task_id": 1, "outs": 2},
+    # Head-shard plane (core/head_shards.py): pickle framing, map and
+    # snapshot payloads are Python structures until regen.
+    "ShardHello": {"shard_id": 1},
+    "ShardReady": {"shard_id": 1, "n_dir": 2, "n_tev": 3},
+    "ShardAssign": {"epoch": 1, "buckets": 2},
+    "ShardDirAdd": {"entries": 1},
+    "ShardDirAdd.Entry": {"object_id": 1, "node_id": 2},
+    "ShardDirDrop": {"object_ids": 1},
+    "ShardTevIngest": {"node_id": 1, "events": 2, "dropped": 3},
+    "ShardTevDrain": {"req_id": 1},
+    "ShardTevBatch": {"req_id": 1, "batches": 2},
+    "ShardSnapshot": {"req_id": 1},
+    "ShardState": {"req_id": 1, "epoch": 2, "directory": 3,
+                   "tev_pending": 4},
+    "ShardShutdown": {},
 }
 
 # Fields of bound messages that ride the pickle-framing fallback when set
