@@ -148,6 +148,7 @@ def final_line(status: str = "complete"):
         "cross_language": EXTRAS.get("cross_language", {}),
         "chaos_storm": EXTRAS.get("chaos_storm", {}),
         "elastic_train": EXTRAS.get("elastic_train", {}),
+        "multi_tenant": EXTRAS.get("multi_tenant", {}),
         "serve_storm": EXTRAS.get("serve_storm", {}),
         "tpu_mfu_pct": mfu,
         "tpu": TPU,
@@ -1291,6 +1292,101 @@ ray_tpu.shutdown()
             "kill": "train.worker_kill:12 (rank 1, seeded)",
         }
 
+    def sec_multi_tenant():
+        # Multi-tenant fair-share A/B (job ledger + weighted-DRF grant
+        # order): a victim tenant's closed-loop latency run executed (a)
+        # alone, (b) against a seeded hostile task storm (chaos site
+        # job.hostile: 1500-task burst + giant puts) with fair_share ON,
+        # and (c) the same storm with fair_share OFF. Acceptance: ON
+        # holds the victim's p99 + throughput within 20% of alone; OFF
+        # shows the collapse fair-share prevents (the storm's key is
+        # created first, so submission-order granting starves the
+        # victim until the whole burst drains).
+        tmpl = r"""
+import json, time
+import ray_tpu
+from ray_tpu.core import chaos
+from ray_tpu.core.jobs import hostile_tick
+
+FAIR, STORM = %(fair)s, %(storm)s
+rt = ray_tpu.init(num_cpus=4, _system_config={"fair_share": FAIR})
+rt.jobs.register("victim")
+rt.jobs.register("hostile")
+
+@ray_tpu.remote(num_cpus=1)
+def victim_step():
+    time.sleep(0.5)
+    return 1
+
+@ray_tpu.remote(num_cpus=1)
+def hog():
+    time.sleep(0.02)
+    return 1
+
+# Warm the worker pool first (spawn is on-demand + rate-limited): the
+# A/B measures scheduling policy, not cold-start.
+ray_tpu.get([hog.remote() for _ in range(8)], timeout=120)
+
+if STORM:
+    chaos.configure("job.hostile:1", seed=11)
+    fired = hostile_tick(
+        lambda: hog.options(_job_id="hostile").remote(),
+        put=lambda n: ray_tpu.put(b"x" * n),
+        burst=1500, put_bytes=1 << 20)
+    assert fired, "job.hostile chaos site did not arm"
+    chaos.configure("")
+
+N, W = 12, 2
+lat, pending, t0s = [], [], {}
+i = 0
+t_start = time.time()
+while len(lat) < N:
+    while i < N and len(pending) < W:
+        r = victim_step.options(_job_id="victim").remote()
+        t0s[r] = time.time(); pending.append(r); i += 1
+    done, pending = ray_tpu.wait(pending, num_returns=1, timeout=120)
+    for r in done:
+        ray_tpu.get(r)
+        lat.append(time.time() - t0s.pop(r))
+wall = time.time() - t_start
+lat.sort()
+snap = {row["job_id"]: row for row in rt.job_state()}
+print("MT_RES", json.dumps({
+    "p99_ms": round(lat[max(0, int(len(lat) * 0.99) - 1)] * 1000, 1),
+    "p50_ms": round(lat[len(lat) // 2] * 1000, 1),
+    "tput_s": round(N / wall, 2),
+    "victim_finished": snap.get("victim", {}).get("finished", 0),
+    "hostile_submitted": snap.get("hostile", {}).get("submitted", 0)}))
+ray_tpu.shutdown()
+"""
+
+        def run(fair, storm, tag):
+            out = run_sub(tmpl % {"fair": fair, "storm": storm},
+                          timeout=120, tag=f"multi_tenant_{tag}")
+            return json.loads([ln for ln in out.splitlines()
+                               if ln.startswith("MT_RES")][0][7:])
+
+        alone = run(True, False, "alone")
+        fair_on = run(True, True, "fair_on")
+        fair_off = run(False, True, "fair_off")
+        emit("multi_tenant_victim_p99_ms", fair_on["p99_ms"])
+        p99_x = (fair_on["p99_ms"] / alone["p99_ms"]
+                 if alone["p99_ms"] else 0.0)
+        tput_x = (fair_on["tput_s"] / alone["tput_s"]
+                  if alone["tput_s"] else 0.0)
+        EXTRAS["multi_tenant"] = {
+            "storm": "job.hostile:1 (seed 11): 1500x 20ms tasks + 1MiB "
+                     "put, hostile tenant, 4-CPU head",
+            "victim": "12x 500ms tasks, closed loop window 2",
+            "alone": alone, "fair_on": fair_on, "fair_off": fair_off,
+            "fair_on_p99_x_vs_alone": round(p99_x, 3),
+            "fair_on_tput_x_vs_alone": round(tput_x, 3),
+            "fair_off_p99_x_vs_alone": round(
+                fair_off["p99_ms"] / alone["p99_ms"]
+                if alone["p99_ms"] else 0.0, 2),
+            "fair_on_within_20pct": bool(p99_x <= 1.2 and tput_x >= 0.8),
+        }
+
     def sec_serve_storm():
         # Disaggregated LLM serving plane (llm/serve.py, ROADMAP item 1):
         # the same open-loop arrival curve (requests fire on a fixed QPS
@@ -1435,6 +1531,7 @@ ray_tpu.shutdown()
         ("client", 90, sec_client),
         ("chaos", 150, sec_chaos),
         ("elastic_train", 60, sec_elastic_train),
+        ("multi_tenant", 75, sec_multi_tenant),  # fair-share A/B
         ("many_agents", 280, sec_many_agents),  # main run + native-off A/B
         ("cluster_scale", 320, sec_cluster_scale),  # 64/256 sharded A/B
         ("serve_storm", 180, sec_serve_storm),

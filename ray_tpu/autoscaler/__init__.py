@@ -223,10 +223,12 @@ class Autoscaler:
 
     def __init__(self, config: AutoscalingConfig,
                  provider: NodeProvider | None = None, runtime=None):
+        from ray_tpu.autoscaler.policy import ScalePolicy
         from ray_tpu.core.runtime import get_runtime
         self.rt = runtime or get_runtime()
         self.config = config
         self.provider = provider or FakeNodeProvider(self.rt)
+        self.policy = ScalePolicy(self.rt)
         self.managed: dict[str, str] = {}  # node_id -> node_type
         self._idle_since: dict[str, float] = {}
         self._hints: list[dict] = []
@@ -248,7 +250,13 @@ class Autoscaler:
         demand: list[dict] = []
         with rt.lock:
             for spec in list(rt.task_queue):
-                demand.append(rt._resources_of(spec))
+                req = rt._resources_of(spec)
+                jid = getattr(spec, "job_id", None) or "driver"
+                # Quota-parked work is demand only when policy says so
+                # (autoscaler_quota_demand — quotas are admission
+                # ceilings, not reservations).
+                if self.policy.include_queued(jid, req):
+                    demand.append(req)
             for aid in list(rt.actors_waiting_resources):
                 st = rt.actors.get(aid)
                 if st is not None:
@@ -261,6 +269,9 @@ class Autoscaler:
                     demand.extend(dict(b) for b in st.bundles)
         with self._lock:
             demand.extend(self._hints)
+        # Beyond the queued-task view: drained scale-up requests (elastic
+        # trainer capacity-wait) and the serve shed-rate signal.
+        demand.extend(self.policy.extra_demand())
         return [d for d in demand if d]
 
     # ---- reconcile ----
@@ -355,16 +366,17 @@ class Autoscaler:
                     break
             if not placed:
                 unmet.append(req)
-        for req in unmet:
-            for tname, tcfg in self.config.node_types.items():
-                if (counts.get(tname, 0) < tcfg.max_workers
-                        and _fits(dict(tcfg.resources), req)):
-                    nid = self._launch(tname, tcfg)
-                    if nid:
-                        counts[tname] = counts.get(tname, 0) + 1
-                    break
+        # Slice-aware pack: fewest launches covering all unmet demand
+        # (the policy's best-fit-decreasing over slice-shaped types).
+        for tname in self.policy.plan_launches(
+                unmet, self.config.node_types, counts):
+            nid = self._launch(tname, self.config.node_types[tname])
+            if nid:
+                counts[tname] = counts.get(tname, 0) + 1
 
-        # Scale down idle managed nodes.
+        # Scale down idle managed nodes, draining residual leases through
+        # the lease-spill/return path first so queued-not-started work
+        # requeues instead of riding the node-death replay.
         now = time.monotonic()
         for n in alive:
             nid = n["node_id"]
@@ -376,6 +388,9 @@ class Autoscaler:
                 continue
             since = self._idle_since.setdefault(nid, now)
             if now - since > self.config.idle_timeout_s:
+                drain = getattr(self.rt, "drain_node_leases", None)
+                if drain is not None:
+                    drain(nid)
                 self.provider.terminate_node(nid)
                 self.managed.pop(nid, None)
                 self._idle_since.pop(nid, None)

@@ -1,0 +1,162 @@
+"""Slice-aware scale policy: the autoscaler's demand + placement brain.
+
+Parity: the reference autoscaler v2's demand calculator
+(`python/ray/autoscaler/v2/scheduler.py` — ResourceDemandScheduler's
+"which node types, how many" answer) specialized for a TPU cluster where
+node types are SLICE-SHAPED (a v5p host contributes its chips as one
+atomic inventory unit, launcher.py NodeTypeSpec) and demand has three
+extra sources beyond the queued-task view:
+
+  * queued-beyond-quota leases — the head's job ledger refuses a charge
+    and the lease parks; whether that parked work should attract new
+    nodes is policy (`autoscaler_quota_demand`): quotas here are
+    admission ceilings (Borg-style), so by default parked work still
+    counts as demand and the ceiling re-checks against the grown
+    cluster's shares;
+  * explicit scale requests — the elastic trainer's capacity-wait
+    (train/trainer.py) and any worker-side `request("scale_up", ...)`
+    land in the head's scale-request queue and are drained here;
+  * serve shed rate — `ray_tpu_serve_shed_total` climbing faster than
+    `autoscaler_shed_rate_threshold`/s over `autoscaler_shed_window_s`
+    means admission control is rejecting traffic the cluster could
+    absorb with another replica's worth of capacity.
+
+Placement is a best-fit-decreasing pack over slice-shaped node types
+(fewest wasted TPU chips first, then CPUs), replacing the reconciler's
+one-launch-per-unmet-request first fit — without the pack, 4 queued
+1-chip tasks launch 4 hosts where one 4-chip host suffices.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def _shed_total(rt) -> float:
+    """Cluster-wide `ray_tpu_serve_shed_total` right now: the head's own
+    registry plus every live worker's shipped snapshot (replica processes
+    shed; their counters ride the event-flush metric deltas)."""
+    total = 0.0
+    try:
+        from ray_tpu.util.metrics import _LOCK, _REGISTRY
+        with _LOCK:
+            m = _REGISTRY.get("ray_tpu_serve_shed_total")
+        if m is not None:
+            with m._lock:
+                total += sum(m._values.values())
+        for per in rt.worker_metric_snapshots().values():
+            snap = per.get("ray_tpu_serve_shed_total")
+            if snap:
+                total += sum(snap.get("values", {}).values())
+    except Exception:  # noqa: BLE001 — a torn scrape must not stop scaling
+        pass
+    return total
+
+
+class ScalePolicy:
+    """Stateless-ish demand/placement policy consulted by the reconciler
+    each tick. Holds only the shed-rate window samples."""
+
+    def __init__(self, rt, cfg=None):
+        self.rt = rt
+        self.cfg = cfg or rt.config
+        self._shed_samples: list[tuple[float, float]] = []  # (ts, total)
+
+    # ---- demand sources beyond the queued-task view ----
+
+    def extra_demand(self) -> list[dict]:
+        demand: list[dict] = []
+        take = getattr(self.rt, "take_scale_requests", None)
+        if take is not None:
+            for req in take():
+                demand.extend(dict(b) for b in req.get("bundles", []) if b)
+        demand.extend(self._shed_demand())
+        return demand
+
+    def _shed_demand(self) -> list[dict]:
+        """One replica-shaped bundle per threshold-crossing of the serve
+        shed rate. TPU-shaped when the cluster serves on TPU (any node
+        advertises chips), CPU-shaped otherwise."""
+        window = getattr(self.cfg, "autoscaler_shed_window_s", 30.0)
+        threshold = getattr(self.cfg, "autoscaler_shed_rate_threshold", 1.0)
+        if threshold <= 0:
+            return []
+        now = time.monotonic()
+        total = _shed_total(self.rt)
+        self._shed_samples.append((now, total))
+        while (len(self._shed_samples) > 2
+               and self._shed_samples[1][0] <= now - window):
+            self._shed_samples.pop(0)
+        t0, v0 = self._shed_samples[0]
+        if now - t0 < 1e-3 or total <= v0:
+            return []
+        rate = (total - v0) / (now - t0)
+        if rate < threshold:
+            return []
+        has_tpu = any(n["resources"].get("TPU", 0) > 0
+                      for n in self.rt.nodes_table() if n["alive"])
+        return [{"CPU": 1.0, "TPU": 1.0} if has_tpu else {"CPU": 1.0}]
+
+    # ---- queued-demand quota classification ----
+
+    def include_queued(self, job_id: str, req: dict) -> bool:
+        """Should this queued task count toward scale-up demand? A task
+        parked by its own job's quota only counts when
+        `autoscaler_quota_demand` says ceilings re-check against the
+        grown cluster; capacity-starved tasks always count."""
+        jobs = getattr(self.rt, "jobs", None)
+        if jobs is None or jobs.would_admit(job_id, req):
+            return True
+        return bool(getattr(self.cfg, "autoscaler_quota_demand", True))
+
+    # ---- slice-aware placement ----
+
+    def plan_launches(self, unmet: list[dict], node_types: dict,
+                      counts: dict) -> list[str]:
+        """Pack unmet demand into the fewest slice-shaped launches.
+        Best-fit decreasing: biggest requests place first, each into an
+        already-planned launch when it fits, else onto the node type
+        wasting the fewest TPU chips (then CPUs). Returns node-type names
+        to launch, one entry per node; `counts` caps against
+        max_workers and is NOT mutated."""
+        planned: list[tuple[str, dict]] = []  # (tname, remaining avail)
+        budget = {t: max(0, c.max_workers - counts.get(t, 0))
+                  for t, c in node_types.items()}
+        order = sorted(unmet, key=lambda r: (-r.get("TPU", 0.0),
+                                             -r.get("CPU", 0.0)))
+        for req in order:
+            placed = False
+            for _, avail in planned:
+                if _fits(avail, req):
+                    _sub(avail, req)
+                    placed = True
+                    break
+            if placed:
+                continue
+            best = None
+            for tname, tcfg in node_types.items():
+                if budget.get(tname, 0) <= 0:
+                    continue
+                res = dict(tcfg.resources)
+                if not _fits(res, req):
+                    continue
+                waste = (res.get("TPU", 0.0) - req.get("TPU", 0.0),
+                         res.get("CPU", 0.0) - req.get("CPU", 0.0))
+                if best is None or waste < best[0]:
+                    best = (waste, tname, res)
+            if best is None:
+                continue  # nothing fits (or everything is at max_workers)
+            _, tname, res = best
+            budget[tname] -= 1
+            _sub(res, req)
+            planned.append((tname, res))
+        return [t for t, _ in planned]
+
+
+def _fits(avail: dict, req: dict) -> bool:
+    return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in req.items())
+
+
+def _sub(avail: dict, req: dict) -> None:
+    for k, v in req.items():
+        avail[k] = avail.get(k, 0.0) - v

@@ -16,6 +16,7 @@ import os
 from ray_tpu.core import serialization
 from ray_tpu.core.config import get_config
 from ray_tpu.core.ids import ActorID, TaskID
+from ray_tpu.core.jobs import current_job_id
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.ids import ObjectID
 from ray_tpu.core.remote_function import _promote_large
@@ -97,6 +98,7 @@ class ActorClass:
             scheduling_strategy=opts.get("scheduling_strategy"),
             dependencies=[r.id.binary() for r in refs],
             runtime_env=opts.get("runtime_env"),
+            job_id=current_job_id(opts, rt),
         )
         cspec.methods_meta = self._meta
         if isinstance(rt, Runtime):
@@ -188,6 +190,10 @@ class ActorMethod:
             trace_ctx=trace_ctx,
             streaming=streaming,
             args_ref=args_ref,
+            # Caller-pays attribution: the submitting job owns the call
+            # (actor tasks hold no CPUs, so this only feeds event
+            # retention + the dashboard, not the quota gate).
+            job_id=current_job_id(None, rt),
         )
         if isinstance(rt, Runtime):
             rt.submit_task(spec)

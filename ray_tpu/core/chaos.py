@@ -102,6 +102,10 @@ REGISTERED_SITES: dict[str, str] = {
                          "surviving replica)",
     "serve.prefill.stall": "the prefill worker stalls by a seeded "
                            "jitter before returning its KV handoff",
+    "job.hostile": "the hostile tenant strikes: a seeded task-storm "
+                   "burst plus a giant put attributed to one job "
+                   "(core/jobs.py hostile_tick — the multi_tenant "
+                   "bench's replayable noisy neighbor)",
 }
 
 

@@ -24,7 +24,7 @@ import socket
 import struct
 import threading
 
-from ray_tpu.core import proto_wire, serialization
+from ray_tpu.core import jobs, proto_wire, serialization
 from ray_tpu.core.ids import ObjectID
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.protocol import raytpu_pb2 as pb
@@ -242,6 +242,9 @@ class ClientProtoServer:
             max_retries=0,
             retries_left=0,
             dependencies=deps,
+            # Cross-language clients have no job env; attribute to the
+            # head process's resolved job (usually the default driver).
+            job_id=jobs.current_job_id(rt=rt),
         )
         rt.submit_task(spec)
         reply.submit.return_ids.extend(spec.return_ids)

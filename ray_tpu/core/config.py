@@ -313,6 +313,53 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
                          "objxfer pulls from surviving peers; the "
                          "committed on-disk manifest stays the source of "
                          "truth (arena restore is best-effort)"),
+    # --- multi-tenancy (core/jobs.py job ledger: quotas + weighted-DRF
+    #     fair-share at the head's lease grant; parity: DRF NSDI '11 +
+    #     Borg quota semantics over the reference's JobID attribution) ---
+    "fair_share": (bool, True, "the head's grant loop picks the next "
+                   "lease in weighted dominant-resource-fairness order "
+                   "over the live cluster view, so a task-storm job "
+                   "queues behind its share instead of monopolizing the "
+                   "pump; off = FIFO over scheduling keys (the "
+                   "multi_tenant bench's A/B collapse mode)"),
+    "job_quota_cpu": (float, 0.0, "default per-job CPU ceiling enforced "
+                      "at lease grant (a task that would push the job's "
+                      "charged CPUs over this stays queued); 0 = "
+                      "unlimited. Per-job overrides ride "
+                      "submit_job(quota=...)"),
+    "job_quota_tpu": (float, 0.0, "default per-job TPU-chip ceiling "
+                      "enforced at lease grant; 0 = unlimited"),
+    "job_quota_object_store_bytes": (int, 0, "default per-job object-"
+                                     "store footprint ceiling; a job "
+                                     "beyond it has ITS coldest objects "
+                                     "spilled to disk (per-job blast "
+                                     "radius) instead of cluster-wide "
+                                     "eviction pressure; 0 = unlimited"),
+    "job_default_weight": (float, 1.0, "DRF weight for jobs that don't "
+                           "set one (share = dominant usage fraction / "
+                           "weight; heavier jobs are granted more)"),
+    "task_events_max_per_job": (int, 0, "head-side TaskEventStorage "
+                                "per-job retention cap: settled attempts "
+                                "of a job beyond it are evicted (drop-"
+                                "accounted in dropped_per_job) even when "
+                                "the global task_events_max_tasks bound "
+                                "has room; 0 = no per-job cap"),
+    # --- autoscaler policy core (autoscaler/policy.py: quota-aware
+    #     demand -> slice-shaped node types) ---
+    "autoscaler_quota_demand": (bool, True, "queued-beyond-quota leases "
+                                "count as autoscaler demand (scale up "
+                                "rather than starve an over-quota "
+                                "tenant; its quota still caps what it "
+                                "may hold, so the new capacity serves "
+                                "the other tenants it was crowding)"),
+    "autoscaler_shed_window_s": (float, 30.0, "trailing window over "
+                                 "which serve shed events "
+                                 "(ray_tpu_serve_shed_total) are rated "
+                                 "for scale-up demand"),
+    "autoscaler_shed_rate_threshold": (float, 1.0, "sheds/second over "
+                                       "the window that convert into "
+                                       "one serve-replica-shaped "
+                                       "scale-up bundle"),
     # --- observability ---
     "event_stats": (bool, False, "record per-handler event-loop stats"),
     "export_events": (bool, False, "append task/actor/node state "

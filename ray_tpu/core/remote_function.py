@@ -13,6 +13,7 @@ import os
 from ray_tpu.core import serialization
 from ray_tpu.core.config import get_config
 from ray_tpu.core.ids import ObjectID, TaskID, random_bytes
+from ray_tpu.core.jobs import current_job_id
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.task import TaskSpec
 
@@ -140,6 +141,7 @@ class RemoteFunction:
             runtime_env=opts.get("runtime_env"),
             idempotent=bool(opts.get("idempotent", False)),
             args_ref=args_ref,
+            job_id=current_job_id(opts, rt),
         )
         if isinstance(rt, Runtime):
             rt.submit_task(spec, fn_blob)
@@ -245,6 +247,7 @@ class CppFunction:
             scheduling_strategy=opts.get("scheduling_strategy"),
             dependencies=deps,
             idempotent=bool(opts.get("idempotent", False)),
+            job_id=current_job_id(opts, rt),
         )
         if isinstance(rt, Runtime):
             rt.submit_task(spec)

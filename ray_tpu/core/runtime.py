@@ -34,6 +34,8 @@ import traceback
 import uuid
 
 from ray_tpu.core import chaos, serialization, task_events
+from ray_tpu.core.jobs import (DEFAULT_JOB, current_job_id,
+                               ledger_from_config)
 from ray_tpu.core.config import Config, get_config, set_config
 from ray_tpu.core.ids import ActorID, ObjectID, WorkerID
 from ray_tpu.core.object_store import SharedMemoryStore, default_store_size
@@ -756,10 +758,6 @@ def _kv_key_bytes(k) -> bytes:
     return k.encode() if isinstance(k, str) else k
 
 
-# Shared SUBMITTED data for driver-owned tasks (storage only reads event
-# data dicts, so one constant dict serves every driver submission).
-_DRIVER_JOB = {"job": "driver"}
-
 # Process-global emission ring, bound once (record() runs per task state
 # transition — a ring() call per record showed up in the task storm).
 _TEV_RING = task_events.ring()
@@ -893,6 +891,7 @@ class Runtime:
         task_events.configure(cfg)
         self.task_store = task_events.TaskEventStorage(
             max_tasks=cfg.task_events_max_tasks,
+            max_per_job=getattr(cfg, "task_events_max_per_job", 0),
             export=self.export_events)
         # Arriving event batches park here and merge on a dedicated
         # thread — the listener must never pay the ingest (a storm ships
@@ -1000,6 +999,18 @@ class Runtime:
             import gc
             gc.freeze()
         self._reservations: dict[bytes, tuple] = {}  # task_id -> token
+        # --- multi-tenant job ledger (core/jobs.py): per-job quota
+        # admission at BOTH grant paths (_schedule_now worker/lease pops,
+        # _lease_refill_locked) and the weighted-DRF fair-share order the
+        # grant loops iterate keys in. Charges settle through the same
+        # funnels every lease/assignment pop already goes through.
+        self.jobs = ledger_from_config(cfg)
+        # Scale-up demand the task queues cannot see (elastic trainer
+        # capacity-wait, serve shed pressure, explicit hints) — posted by
+        # request_scale_up, drained by autoscaler/policy.py's collector
+        # each reconcile. Bounded: a hot wait loop must not grow it.
+        self._scale_requests: collections.deque = collections.deque(
+            maxlen=256)
         # Generic pubsub hub (parity: src/ray/pubsub/publisher.h:300 —
         # channelized publisher with per-key subscriptions). Workers
         # subscribe over their head socket; driver-side subscribers are
@@ -1414,56 +1425,80 @@ class Runtime:
             for oid in candidates:
                 if freed >= needed:
                     break
-                with self.refcount._lock:
-                    if oid in self.refcount._pins:
-                        continue  # an in-flight task depends on it
-                prior = self._spilled.get(oid)
-                if prior is not None and os.path.exists(prior):
-                    # Restored earlier: the spill file is still valid, so
-                    # dropping the in-arena copy costs nothing — EXCEPT for
-                    # a just-restored object whose reader (a get/push that
-                    # triggered the restore) may not have read it yet.
-                    if time.monotonic() - self._restored_at.get(
-                            oid, 0.0) < 10.0:
-                        continue
-                    with self.directory.lock:
-                        e = self.directory.entries.get(oid)
-                        if e is None or e[0] != "shm":
-                            continue
-                        e[1].discard(self.head_node_id)
-                    self.store.delete(ObjectID(oid))
-                    freed += os.path.getsize(prior)
-                    continue
-                res = self.store.get_raw(ObjectID(oid), timeout=0)
-                if res is None:
-                    continue
-                data, meta = res
-                path = os.path.join(self.spill_dir, oid.hex())
-                try:
-                    with open(path, "wb") as f:
-                        # Spill file = [u32 meta_len][meta][data]: the
-                        # tagged-object meta (arrow blocks, tensor
-                        # frames, cross-language values) must survive the
-                        # disk round trip or the restored copy decodes as
-                        # the wrong layout.
-                        f.write(struct.pack("<I", len(meta)))
-                        if meta:
-                            f.write(meta)
-                        f.write(data)
-                finally:
-                    data.release()
-                    self.store.release(ObjectID(oid))
-                size = os.path.getsize(path)
-                with self.directory.lock:
-                    e = self.directory.entries.get(oid)
-                    if e is None or e[0] != "shm":
-                        os.unlink(path)
-                        continue
-                    self._spilled[oid] = path
-                    e[1].discard(self.head_node_id)
-                self.store.delete(ObjectID(oid))
-                freed += size
+                freed += self._spill_one_locked(oid)
         return freed >= needed
+
+    def _spill_job_bytes(self, job_id: str, needed: int) -> int:
+        """Per-job blast radius: spill the offending job's coldest
+        head-local objects (the ledger's insertion order is put order)
+        until `needed` bytes are freed — other tenants' hot objects are
+        never touched, so one job's quota breach applies disk pressure
+        only to itself. Returns bytes freed (spill-accounted to the
+        job)."""
+        if needed <= 0:
+            return 0
+        os.makedirs(self.spill_dir, exist_ok=True)
+        freed = 0
+        with self._spill_lock:
+            for oid in self.jobs.coldest_objects(job_id, limit=1024):
+                if freed >= needed:
+                    break
+                freed += self._spill_one_locked(oid)
+        if freed:
+            self.jobs.note_spilled(job_id, freed)
+        return freed
+
+    def _spill_one_locked(self, oid: bytes) -> int:
+        """Spill one head-local shm object to disk (caller holds
+        _spill_lock). Returns bytes freed from the arena — 0 when the
+        object is pinned, already gone, or too freshly restored."""
+        with self.refcount._lock:
+            if oid in self.refcount._pins:
+                return 0  # an in-flight task depends on it
+        prior = self._spilled.get(oid)
+        if prior is not None and os.path.exists(prior):
+            # Restored earlier: the spill file is still valid, so
+            # dropping the in-arena copy costs nothing — EXCEPT for
+            # a just-restored object whose reader (a get/push that
+            # triggered the restore) may not have read it yet.
+            if time.monotonic() - self._restored_at.get(oid, 0.0) < 10.0:
+                return 0
+            with self.directory.lock:
+                e = self.directory.entries.get(oid)
+                if e is None or e[0] != "shm":
+                    return 0
+                e[1].discard(self.head_node_id)
+            self.store.delete(ObjectID(oid))
+            return os.path.getsize(prior)
+        res = self.store.get_raw(ObjectID(oid), timeout=0)
+        if res is None:
+            return 0
+        data, meta = res
+        path = os.path.join(self.spill_dir, oid.hex())
+        try:
+            with open(path, "wb") as f:
+                # Spill file = [u32 meta_len][meta][data]: the
+                # tagged-object meta (arrow blocks, tensor
+                # frames, cross-language values) must survive the
+                # disk round trip or the restored copy decodes as
+                # the wrong layout.
+                f.write(struct.pack("<I", len(meta)))
+                if meta:
+                    f.write(meta)
+                f.write(data)
+        finally:
+            data.release()
+            self.store.release(ObjectID(oid))
+        size = os.path.getsize(path)
+        with self.directory.lock:
+            e = self.directory.entries.get(oid)
+            if e is None or e[0] != "shm":
+                os.unlink(path)
+                return 0
+            self._spilled[oid] = path
+            e[1].discard(self.head_node_id)
+        self.store.delete(ObjectID(oid))
+        return size
 
     def _restore_spilled(self, oid: bytes) -> bool:
         """Bring a spilled object back into the head store (blocking IO —
@@ -1500,7 +1535,9 @@ class Runtime:
         silently destroys owned objects, so every head-store write makes
         room under the spill threshold first. Under pressure, dead
         clients' stranded reservations are reclaimed BEFORE spilling live
-        objects to disk — leaked extents are free headroom."""
+        objects to disk — leaked extents are free headroom. Jobs already
+        past their object quota pay next (per-job blast radius: their
+        coldest objects go to disk before any within-quota tenant's)."""
         stats = self.store.stats()
         cap = stats["capacity"] or 1
         limit = self.config.object_spill_threshold * cap
@@ -1509,10 +1546,28 @@ class Runtime:
                 stats = self.store.stats()
                 if stats["allocated"] + nbytes <= limit:
                     return
-            self._spill_bytes(int(stats["allocated"] + nbytes - limit)
-                              + (4 << 20))
+            needed = int(stats["allocated"] + nbytes - limit) + (4 << 20)
+            for jid, over in self.jobs.over_quota_objects():
+                if needed <= 0:
+                    break
+                needed -= self._spill_job_bytes(jid, min(over, needed))
+            if needed > 0:
+                self._spill_bytes(needed)
 
-    def put_in_store(self, oid: "ObjectID", value) -> None:
+    def _account_put(self, oid: bytes, nbytes: int,
+                     job_id: str | None = None) -> None:
+        """Attribute a sealed head-local object to its tenant; on object
+        quota breach spill that job's OWN coldest objects — the offender
+        pays the disk penalty at its own put site, other tenants' arena
+        residency is untouched."""
+        jid = job_id or current_job_id(rt=self)
+        self.jobs.charge_object(jid, oid, nbytes)
+        over = self.jobs.object_overage(jid)
+        if over > 0:
+            self._spill_job_bytes(jid, over)
+
+    def put_in_store(self, oid: "ObjectID", value,
+                     job_id: str | None = None) -> None:
         from ray_tpu.core.object_store import arrow_block_of
         from ray_tpu.core.status import ObjectStoreFullError
         table = arrow_block_of(value)
@@ -1534,6 +1589,7 @@ class Runtime:
                 self.store.put_arrow(oid, table)
             else:
                 self.store.put_serialized(oid, value)
+        self._account_put(oid.binary(), approx, job_id)
 
     # ---------------- OOM monitor ----------------
 
@@ -2140,7 +2196,10 @@ class Runtime:
                 try:
                     value = serialization.deserialize(arg[0], arg[1])
                     oid = ObjectID.from_random()
-                    self.put_in_store(oid, value)
+                    # arg[2] = client's job id (absent from old clients).
+                    self.put_in_store(
+                        oid, value,
+                        job_id=arg[2] if len(arg) > 2 else None)
                     self.directory.put(oid.binary(),
                                        ("shm", {self.head_node_id}))
                     resp = oid.binary()
@@ -2167,6 +2226,31 @@ class Runtime:
 
             threading.Thread(target=wait_and_reply, daemon=True).start()
             return
+        elif what == "job_register":
+            # JobSupervisor/JobSubmissionClient registrar: (job_id,
+            # weight, quota dict, object_quota), Nones keep defaults.
+            jid, weight, quota, object_quota = arg
+            self.jobs.register(jid, weight=weight, quota=quota,
+                               object_quota=object_quota)
+            resp = True
+        elif what == "job_stop":
+            # Queue/lease teardown can fail hundreds of returns — keep
+            # it off the listener thread (same rule as "state").
+            def stop_and_reply(jid=arg, w=w, req_id=req_id):
+                try:
+                    resp = self.stop_job(jid)
+                except Exception as e:  # noqa: BLE001 — report, don't die
+                    resp = RayTpuError(f"job_stop {jid!r} failed: {e}")
+                try:
+                    w.send(("resp", req_id, resp))
+                except OSError:
+                    pass
+
+            threading.Thread(target=stop_and_reply, daemon=True).start()
+            return
+        elif what == "scale_up":
+            self.request_scale_up(arg[0], source=arg[1])
+            resp = True
         elif what == "cancel":
             resp = self.cancel_task(arg[0], force=arg[1])
         elif what == "kill_actor":
@@ -3128,6 +3212,13 @@ class Runtime:
         # running task — each MAY have started, so replays consume a retry.
         leased = list(node.leases.values())
         node.leases.clear()
+        for spec in leased:
+            # The bulk clear bypasses _pop_lease_locked (so the
+            # _on_lease_fail below finds nothing to pop): settle the
+            # grant's quota charge here or the retry's re-charge trips
+            # the double-grant guard and the key parks forever.
+            self.jobs.settle(getattr(spec, "job_id", None) or DEFAULT_JOB,
+                             spec.task_id)
         if leased:
             self._on_lease_fail(node.node_id, leased)
         # Actors queued for assignment on this node never get a worker now:
@@ -3240,7 +3331,8 @@ class Runtime:
         self.directory.put(oid.binary(), ("shm", {self.head_node_id}))
         return ObjectRef(oid)
 
-    def put_tagged_store(self, oid: "ObjectID", fmt: str, data) -> None:
+    def put_tagged_store(self, oid: "ObjectID", fmt: str, data,
+                         job_id: str | None = None) -> None:
         """Seal (format, bytes) into the head arena with spill headroom —
         the tagged-layout sibling of put_in_store."""
         from ray_tpu.core.status import ObjectStoreFullError
@@ -3251,6 +3343,7 @@ class Runtime:
             if not self._spill_bytes(int(len(data) * 1.5) + (1 << 20)):
                 raise
             self.store.put_tagged(oid, fmt, data)
+        self._account_put(oid.binary(), len(data), job_id)
 
     def put_arg_object(self, value, nbytes) -> bytes:
         """Store one offloaded-args pack (serialization.maybe_offload_args)
@@ -3427,6 +3520,7 @@ class Runtime:
     def _free_object(self, oid: bytes):
         entry = self.directory.lookup(oid)
         self.directory.discard(oid)
+        self.jobs.release_object(oid)
         # Only shm-backed (or unknown — maybe mid-seal) entries touch the
         # native store: a delete miss there linear-probes the slot table,
         # which is pure waste for the inline-result common case.
@@ -3596,10 +3690,14 @@ class Runtime:
             # index. Retired when the stream is exhausted or abandoned.
             self._pstore.append("stream", spec.task_id,
                                 (_journal_safe_spec(spec), 0))
+        # Job attribution of record: the spec's stamped tenant (falling
+        # back to the default driver job) keys the task-event storage's
+        # per-job accounting AND the ledger's submit counters — the
+        # owner-hex pseudo-jobs of the pre-tenancy era are gone.
+        jid = getattr(spec, "job_id", None) or DEFAULT_JOB
+        self.jobs.note_submitted(jid)
         self.task_events.record(
-            spec.task_id, spec, "SUBMITTED",
-            data=_DRIVER_JOB if spec.owner is None
-            else {"job": spec.owner.hex()})
+            spec.task_id, spec, "SUBMITTED", data={"job": jid})
         if spec.streaming:
             self._register_stream(spec.task_id)
             with self.lock:
@@ -3931,6 +4029,157 @@ class Runtime:
             return True
         self._fail_returns(spec, err)
         return True
+
+    # ---------------- multi-tenant job platform ----------------
+
+    def stop_job(self, job_id: str) -> dict:
+        """Tear down a tenant's in-flight footprint at the head (the
+        JobSubmissionClient.stop_job release path — without it a stopped
+        job's queued work still dispatches): mark the ledger stopped so
+        every future charge refuses, fail the job's queued and dep-gated
+        normal tasks with TaskCancelledError, pop its granted-but-
+        unfinished leases (an agent-side zombie execution completes into
+        a popped lease and no-ops, the same staleness contract as node
+        death), and reclaim reservation tails the job's killed client
+        processes stranded in the arena."""
+        from ray_tpu.core.status import TaskCancelledError
+        self.jobs.stop(job_id)
+        to_fail: list = []
+        leases: list = []
+        with self.lock:
+            # Queued specs: sig[3] carries the tenant, so whole keys go.
+            for sig in list(self.task_queues):
+                if (((sig[3] if len(sig) > 3 else None) or DEFAULT_JOB)
+                        != job_id):
+                    continue
+                to_fail.extend(self.task_queues.pop(sig))
+            # Dep-gated specs: tombstone + fail now (same contract as
+            # cancel_task's dep-gated branch — _enqueue_ready drops the
+            # spec when its deps finally arrive).
+            gated: set = set()
+            for items in self.waiting_deps.values():
+                for item in items:
+                    spec = item.get("spec")
+                    if (item.get("kind") != "task" or spec is None
+                            or (getattr(spec, "job_id", None)
+                                or DEFAULT_JOB) != job_id
+                            or spec.task_id in gated):
+                        continue
+                    gated.add(spec.task_id)
+                    self._cancelled.add(spec.task_id)
+                    to_fail.append(spec)
+            # In-flight leases: pop + release the reservation. The
+            # settle rides _pop_lease_locked's funnel; a completion
+            # racing this finds the lease gone and no-ops.
+            for node in self.nodes.values():
+                for tid, spec in list(node.leases.items()):
+                    if (getattr(spec, "job_id", None)
+                            or DEFAULT_JOB) == job_id:
+                        leases.append((tid, node))
+            for tid, node in leases:
+                spec = self._pop_lease_locked(tid, node)
+                self._release_token(self._reservations.pop(tid, None))
+                if spec is not None:
+                    to_fail.append(spec)
+            # Worker-assigned specs (head-local dispatch): one pipelined
+            # behind a running task never started — definite cancel; the
+            # front (RUNNING) spec gets its worker killed, same contract
+            # as cancel_task(force=True): the death handler fails it (no
+            # retry) and its settle/reservation release ride that path.
+            notify: list = []
+            kill: list = []
+            for w in self.workers.values():
+                if w.state != BUSY or not w.assigned:
+                    continue
+                mine = [t for t in w.assigned
+                        if (getattr(t, "job_id", None)
+                            or DEFAULT_JOB) == job_id]
+                if not mine:
+                    continue
+                running = w.assigned[0]
+                for t in mine:
+                    self._cancelled.add(t.task_id)
+                    if t is running:
+                        t.retries_left = 0
+                        kill.append(w)
+                    else:
+                        w.assigned.remove(t)
+                        to_fail.append(t)
+                        notify.append((w, t.task_id))
+        for w, tid in notify:
+            try:
+                w.send(("cancel_task", tid))
+            except OSError:
+                pass  # staticcheck: ok recovery-swallow — worker already dead
+        for w in kill:
+            w.kill()
+        for spec in to_fail:
+            self._fail_returns(spec, TaskCancelledError(
+                f"job {job_id!r} was stopped"))
+        # Reservation tails: the supervisor killed the job's client
+        # processes before this ran; their stranded write-reservation
+        # extents are dead-pid orphans the arena sweep returns.
+        reclaimed = self.store.reclaim_orphans()
+        if to_fail or leases or kill:
+            self._schedule()  # freed capacity: let other tenants in
+        return {"job_id": job_id, "cancelled": len(to_fail) + len(kill),
+                "leases_released": len(leases),
+                "workers_killed": len(kill),
+                "reservations_reclaimed": reclaimed}
+
+    def request_scale_up(self, bundles: list, source: str = "") -> None:
+        """Post scale-up demand the task queues cannot see — the elastic
+        trainer's capacity-wait (PR 9's shrink loop finally gets its
+        scale-UP signal), serve shed pressure, explicit hints. Drained by
+        autoscaler/policy.py each reconcile; the deque bounds a hot wait
+        loop's reposts."""
+        self._scale_requests.append(
+            {"bundles": [dict(b) for b in bundles if b],
+             "source": source, "ts": time.time()})
+
+    def take_scale_requests(self) -> list:
+        """Drain posted scale-up requests (autoscaler policy core)."""
+        out = []
+        while True:
+            try:
+                out.append(self._scale_requests.popleft())
+            except IndexError:
+                return out
+
+    def drain_node_leases(self, node_id_hex: str) -> int:
+        """Scale-down drain: requeue every un-started lease still booked
+        on the node through the same funnel as a lease return, so the
+        autoscaler's terminate never relies on the node-death replay for
+        work that never began there. Only called for nodes the
+        autoscaler is about to terminate (idle by the resource view —
+        anything that raced a grant in requeues here)."""
+        requeued = 0
+        with self.lock:
+            node = next((n for n in self.nodes.values()
+                         if n.node_id.hex() == node_id_hex), None)
+            if node is None:
+                return 0
+            for tid in list(node.leases):
+                spec = self._pop_lease_locked(tid, node)
+                self._release_token(self._reservations.pop(tid, None))
+                if spec is not None:
+                    self._enqueue_task_locked(spec, front=True)
+                    requeued += 1
+        if requeued:
+            self._schedule()
+        return requeued
+
+    def job_state(self) -> list[dict]:
+        """Per-job platform view (/api/jobs): dominant share over the
+        live cluster, quota usage, blast-radius counters, task-event
+        drops."""
+        with self.lock:
+            totals = self._cluster_totals_locked()
+        rows = self.jobs.snapshot(totals)
+        drops = dict(getattr(self.task_store, "dropped_per_job", {}) or {})
+        for row in rows:
+            row["task_event_drops"] = drops.get(row["job_id"], 0)
+        return rows
 
     def _unpin_deps(self, spec: TaskSpec):
         for oid in spec.dependencies or []:
@@ -4445,10 +4694,14 @@ class Runtime:
         for k, v in (spec.resources or {}).items():
             req[k] = req.get(k, 0.0) + v
         strat = spec.scheduling_strategy
+        # The job id rides the key (sig[3]): tenants never share a queue,
+        # which is what lets the grant loops order KEYS by dominant share
+        # and park one tenant's backlog without touching another's.
         return (tuple(sorted(req.items())),
                 strat if isinstance(strat, str) or strat is None
                 else id(strat),
-                _pip_key_of(spec))
+                _pip_key_of(spec),
+                getattr(spec, "job_id", None) or DEFAULT_JOB)
 
     @staticmethod
     def _pip_env_of(spec):
@@ -4538,31 +4791,80 @@ class Runtime:
             except Exception:
                 traceback.print_exc()
 
+    def _cluster_totals_locked(self) -> dict:
+        """Live cluster capacity (alive nodes' totals) — the denominator
+        of every DRF dominant-share computation. Caller holds self.lock."""
+        totals: dict[str, float] = {}
+        for n in self.nodes.values():
+            if n.state != "ALIVE":
+                continue
+            for k, v in n.total.items():
+                totals[k] = totals.get(k, 0.0) + v
+        return totals
+
+    def _sig_order(self, sigs: list) -> list:
+        """Fair-share iteration order for the grant loops: weighted
+        dominant share ascending (DRF — the most-starved tenant's keys
+        first) when `fair_share` is on; submission (dict) order — plain
+        FIFO over keys, the pre-tenancy behavior and the multi_tenant
+        bench's A/B collapse mode — when it is off. The sort is stable,
+        so keys of one job keep their FIFO order. Caller holds
+        self.lock."""
+        if not self.config.fair_share or len(sigs) < 2:
+            return sigs
+        totals = self._cluster_totals_locked()
+        shares: dict[str, float] = {}
+
+        def share(sig) -> float:
+            jid = (sig[3] if len(sig) > 3 else None) or DEFAULT_JOB
+            if jid not in shares:
+                shares[jid] = self.jobs.dominant_share(jid, totals)
+            return shares[jid]
+
+        return sorted(sigs, key=share)
+
     def _schedule_now(self):
         """Dispatch every feasible queued task to an idle worker.
 
         Per-scheduling-key queues (parity: normal_task_submitter.h:58):
         a pass costs O(keys + dispatches), not O(queued tasks) — one failed
         reserve probe parks the entire key, so a 10k-task burst stays cheap
-        on every completion event."""
+        on every completion event.
+
+        Tenancy rides the same structure: keys are visited in weighted-DRF
+        order (_sig_order) and every pop passes the job ledger's quota
+        gate first — a refused charge parks the key exactly like a failed
+        reserve probe, so an over-quota job queues without starving the
+        keys behind it."""
         dispatches = []
         failures = []
         lease_dispatches: list = []  # (node, spec) — agent-local dispatch
         with self.lock:
-            for sig in list(self.task_queues):
+            for sig in self._sig_order(list(self.task_queues)):
                 q = self.task_queues.get(sig)
                 while q:
                     spec = q[0]
+                    jid = getattr(spec, "job_id", None) or DEFAULT_JOB
+                    if not self.jobs.charge(jid, spec.task_id,
+                                            self._resources_of(spec)):
+                        # Quota gate: over quota or job stopped. The key
+                        # parks with its backlog (a completion's settle
+                        # re-runs this pass); autoscaler/policy.py counts
+                        # the parked backlog as queued-beyond-quota
+                        # demand.
+                        break
                     try:
                         res = self._reserve_placement(
                             spec.scheduling_strategy,
                             self._resources_of(spec), spec.dependencies)
                     except Exception as e:  # noqa: BLE001 — an escaping
                         # error would stall the queue, hanging every get()
+                        self.jobs.settle(jid, spec.task_id)
                         q.popleft()
                         failures.append((spec, e))
                         continue
                     if res is None:
+                        self.jobs.settle(jid, spec.task_id)
                         # Key blocked on resources: pipeline the backlog
                         # onto busy same-key workers (they ride those
                         # workers' existing reservations), then next key.
@@ -4596,6 +4898,7 @@ class Runtime:
                         # key. Every key still gets its own probe this pass
                         # — a blocked key must not starve feasible keys
                         # behind it.
+                        self.jobs.settle(jid, spec.task_id)
                         self._rollback_token_locked(token)
                         self._pipeline_locked(sig, q, dispatches)
                         self._request_worker_locked(
@@ -4814,7 +5117,7 @@ class Runtime:
         if budget <= 0:
             return []
         out = []
-        for sig in list(self.task_queues):
+        for sig in self._sig_order(list(self.task_queues)):
             strat, env_key = sig[1], sig[2]
             if strat not in (None, "DEFAULT") or env_key is not None:
                 continue
@@ -4825,6 +5128,13 @@ class Runtime:
             while q and budget > 0:
                 spec = q[0]
                 if not self._lease_ok(spec, env_key):
+                    break
+                # Same quota gate as _schedule_now: the refill is the
+                # second grant site, and a task-storm job must not ride
+                # completion refills past its quota either.
+                jid = getattr(spec, "job_id", None) or DEFAULT_JOB
+                if not self.jobs.charge(jid, spec.task_id,
+                                        self._resources_of(spec)):
                     break
                 q.popleft()
                 budget -= 1
@@ -4986,6 +5296,11 @@ class Runtime:
         holder, spec = self._find_lease_locked(task_id, node)
         if holder is not None:
             holder.leases.pop(task_id, None)
+            # Quota release: every lease pop (completion, failure,
+            # requeue, node death, job stop) funnels through here, so the
+            # ledger's inflight charge can never outlive the grant.
+            self.jobs.settle(getattr(spec, "job_id", None) or DEFAULT_JOB,
+                             task_id)
             if self._hnat is not None and not native_popped:
                 self._hnat.inflight_pop(task_id)
             if self._wal:
@@ -5019,6 +5334,9 @@ class Runtime:
                         or (cur.lease_seq or 0) != (spec.lease_seq or 0)):
                     continue  # already requeued / completed / re-granted
                 holder.leases.pop(spec.task_id, None)
+                self.jobs.settle(
+                    getattr(cur, "job_id", None) or DEFAULT_JOB,
+                    spec.task_id)
                 if self._hnat is not None:
                     self._hnat.inflight_pop(spec.task_id)
                 self._release_token(
@@ -5215,6 +5533,14 @@ class Runtime:
         take no new reservation — the completion handler hands the running
         task's token to the next one in the worker's queue."""
         depth = self.config.max_tasks_in_flight_per_worker
+        if self.config.fair_share and self.jobs.multi_tenant():
+            # A pipelined backlog is invisible to the weighted-DRF grant
+            # order AND the quota gate (it rides the running task's
+            # reservation, uncharged) — a storm job would hold every
+            # worker for depth x task-time while the victim's queued key
+            # waits. With a second live tenant, every grant goes back
+            # through the ordered _schedule_now pass instead.
+            depth = 1
         if depth <= 1 or not q:
             return
         cands = self._sig_workers.get(sig)
@@ -5340,6 +5666,10 @@ class Runtime:
                         break
             if spec is None:
                 return None
+            # Quota release for the worker-dispatch grant path (pipelined
+            # specs were never charged — settle is idempotent).
+            self.jobs.settle(getattr(spec, "job_id", None) or DEFAULT_JOB,
+                             task_id)
             token = self._reservations.pop(task_id, None)
             if (w.assigned and w.state != DEAD and token is not None
                     and w.assigned[0].task_id not in self._reservations):
@@ -5435,6 +5765,8 @@ class Runtime:
                 self._cancelled.discard(task_id)
                 self._reconstructing.discard(task_id)
                 if spec is not None:
+                    self.jobs.note_finished(
+                        getattr(spec, "job_id", None) or DEFAULT_JOB)
                     self.task_events.record(task_id, spec, "FINISHED")
                     if self._persist and not spec.streaming:
                         self._pstore.delete("task", task_id)
@@ -5542,6 +5874,8 @@ class Runtime:
             if entry is not None:
                 spec = entry[1]
         if spec is not None:
+            self.jobs.note_finished(
+                getattr(spec, "job_id", None) or DEFAULT_JOB)
             self.task_events.record(task_id, spec, "FINISHED")
             if self._persist and spec.actor_id is None and not spec.streaming:
                 self._pstore.delete("task", task_id)
@@ -5560,6 +5894,11 @@ class Runtime:
     def _fail_returns(self, spec: TaskSpec, exc: Exception):
         err = exc if isinstance(exc, TaskError) else TaskError(
             exc, str(exc), spec.describe())
+        jid = getattr(spec, "job_id", None) or DEFAULT_JOB
+        # A failed spec may die holding a charge (grant-site exception
+        # paths, job stop); settle is idempotent for the never-charged.
+        self.jobs.settle(jid, spec.task_id)
+        self.jobs.note_finished(jid)
         self.task_events.record(spec.task_id, spec, "FAILED")
         self._unpin_deps(spec)
         if self._persist and spec.actor_id is None and not spec.streaming:
@@ -5943,6 +6282,14 @@ class Runtime:
                 for spec in assigned:
                     self._release_token(
                         self._reservations.pop(spec.task_id, None))
+                    # Settle the worker-dispatch grant's quota charge
+                    # BEFORE the retry requeue: the re-grant's charge
+                    # would hit the double-grant guard and park the key
+                    # forever. Pipelined tails were never charged —
+                    # settle is idempotent.
+                    self.jobs.settle(
+                        getattr(spec, "job_id", None) or DEFAULT_JOB,
+                        spec.task_id)
             # Requeue retriable tasks at the FRONT in original order
             # (reversed appendleft); the rest fail. Pipelined tasks queued
             # behind the running one never started — they requeue without

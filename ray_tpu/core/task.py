@@ -74,6 +74,14 @@ class TaskSpec:
                            # move the task storm). Never meaningful on the
                            # wire: the executing worker is the last
                            # process to hold the spec.
+        "job_id",          # str | None — owning tenant (core/jobs.py
+                           # ledger key; None reads as the default driver
+                           # job). Quota admission and weighted-DRF
+                           # fair-share order key on it at the head's
+                           # grant loop. Appended LAST on purpose:
+                           # _from_tuple backfills missing trailing slots
+                           # with None, so old journals and old peers
+                           # stay readable (raytpu.proto field 22).
     )
 
     # __init__ is generated below with one STORE_ATTR per slot: the
@@ -128,7 +136,8 @@ class ActorCreationSpec:
                  "max_restarts", "restarts_used", "max_concurrency", "is_async",
                  "num_cpus", "num_tpus", "resources", "max_task_retries",
                  "placement_group_id", "bundle_index", "runtime_env",
-                 "dependencies", "methods_meta", "scheduling_strategy")
+                 "dependencies", "methods_meta", "scheduling_strategy",
+                 "job_id")
 
     def __init__(self, **kw):
         for s in self.__slots__:

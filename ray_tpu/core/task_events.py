@@ -253,8 +253,12 @@ class TaskEventStorage:
     and every eviction/overflow is counted, never silent."""
 
     def __init__(self, max_tasks: int = 10000, max_spans: int = 10000,
-                 export=None):
+                 export=None, max_per_job: int = 0):
         self.max_tasks = max(1, int(max_tasks))
+        # Per-job retention ceiling (0 = off): one storming tenant's
+        # history caps out on its own attempts instead of waiting for
+        # the global bound to start job-aware eviction.
+        self.max_per_job = max(0, int(max_per_job))
         self.lock = threading.Lock()
         self.attempts: "collections.OrderedDict[tuple, TaskAttempt]" = (
             collections.OrderedDict())
@@ -294,6 +298,10 @@ class TaskEventStorage:
                     self.attempts[key] = at
                     self._job_counts[at.job] = (
                         self._job_counts.get(at.job, 0) + 1)
+                    if (self.max_per_job
+                            and self._job_counts[at.job]
+                            > self.max_per_job):
+                        self._evict_job_locked(at.job, skip=key)
                 if name is not None and at.name is None:
                     at.name = name
                 if state == "EXEC_SPANS":
@@ -334,6 +342,13 @@ class TaskEventStorage:
                         at.job = data["job"]
                         self._job_counts[at.job] = (
                             self._job_counts.get(at.job, 0) + 1)
+                        # The insertion-time cap check ran against the
+                        # default job; the real tenant only lands here
+                        # (SUBMITTED carries it in data), so re-check.
+                        if (self.max_per_job
+                                and self._job_counts[at.job]
+                                > self.max_per_job):
+                            self._evict_job_locked(at.job, skip=key)
                     for k in ("lease_seq", "spill_hops"):
                         if k in data:
                             at.data[k] = data[k]
@@ -360,6 +375,33 @@ class TaskEventStorage:
             while len(self.attempts) > self.max_tasks:
                 evict.append(self._evict_one_locked())
         del evict  # nothing asynchronous to do with them today
+
+    def _evict_job_locked(self, job: str, skip=None):
+        """Per-job cap eviction: drop this job's oldest attempt
+        (preferring a settled one within a bounded scan window; the
+        window keeps the storm-rate ingest path from going O(n) —
+        nothing else about the global bound changes). `skip` protects
+        the attempt that just triggered the cap."""
+        import itertools
+        victim_key = fallback = None
+        for key, cand in itertools.islice(self.attempts.items(), 256):
+            if cand.job != job or key == skip:
+                continue
+            if fallback is None:
+                fallback = key
+            if cand.terminal:
+                victim_key = key
+                break
+        victim_key = victim_key or fallback
+        if victim_key is None:
+            return  # this job's attempts are all beyond the scan window
+        at = self.attempts.pop(victim_key)
+        self._job_counts[at.job] -= 1
+        if not self._job_counts[at.job]:
+            del self._job_counts[at.job]
+        self.dropped_at_head += 1
+        self.dropped_per_job[at.job] = (
+            self.dropped_per_job.get(at.job, 0) + 1)
 
     def _evict_one_locked(self):
         """Drop one attempt: a settled attempt of the job holding the
@@ -523,7 +565,7 @@ class TaskEventStorage:
             ident = f"{at.task_id.hex()[:8]}#{at.attempt}"
             args = {"task_id": at.task_id.hex(), "attempt": at.attempt,
                     "lease_seq": at.data.get("lease_seq"),
-                    "state": at.state()}
+                    "state": at.state(), "job": at.job}
             sub = at.ts_of("SUBMITTED")
             if sub is not None:
                 x(f"task:{name}", "head", "scheduler", sub, at.last_ts,

@@ -81,6 +81,29 @@ def _sample_loop(server):
         time.sleep(_SAMPLE_INTERVAL_S)
 
 
+def _jobs_view() -> list[dict]:
+    """/api/jobs: the head ledger's per-tenant platform view (dominant
+    share, quota usage, spilled bytes, task-event drops) merged with the
+    submission table's lifecycle rows. Ledger-only tenants (the default
+    driver job, `.options(_job_id=...)` pins) still appear — multi-tenancy
+    is wider than submitted entrypoints."""
+    from ray_tpu.core.runtime import Runtime, get_runtime
+    rows: dict[str, dict] = {}
+    rt = get_runtime()
+    if isinstance(rt, Runtime):
+        for r in rt.job_state():
+            rows[r["job_id"]] = r
+    try:
+        from ray_tpu import job_submission
+        for j in job_submission.list_jobs():
+            row = rows.setdefault(j.submission_id,
+                                  {"job_id": j.submission_id})
+            row.update(j.to_dict())
+    except Exception:  # noqa: BLE001 — no supervisors yet is normal
+        pass
+    return sorted(rows.values(), key=lambda r: r["job_id"])
+
+
 class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *a):  # quiet
         pass
@@ -119,9 +142,7 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/api/placement_groups":
                 self._json(state.list_placement_groups())
             elif path == "/api/jobs":
-                from ray_tpu import job_submission
-                self._json([j.to_dict()
-                            for j in job_submission.list_jobs()])
+                self._json(_jobs_view())
             elif path == "/api/profile":
                 # On-demand stack sampling of a worker (or the head):
                 # /api/profile?worker=<hex|head>&duration=1&format=text

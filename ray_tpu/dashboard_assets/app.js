@@ -67,6 +67,8 @@ const LINK_COLS = {
   node_id: (v) => `#/node?id=${encodeURIComponent(v)}`,
   worker_id: (v) => `#/worker?id=${encodeURIComponent(v)}`,
   task_id: (v) => `#/task?id=${encodeURIComponent(v)}`,
+  job_id: (v) => `#/job?id=${encodeURIComponent(v)}`,
+  job: (v) => `#/job?id=${encodeURIComponent(v)}`,
 };
 
 function cellHTML(c, v) {
@@ -482,7 +484,8 @@ async function viewNodeDetail() {
     const a = ev.args || {};
     if (ev.pid === `node:${id}` && a.task_id)
       seen.set(a.task_id, {
-        task_id: a.task_id, what: ev.name, state: a.state || "",
+        task_id: a.task_id, job: a.job || "", what: ev.name,
+        state: a.state || "",
         ms: ((ev.dur || 0) / 1000).toFixed(3),
       });
   });
@@ -508,7 +511,7 @@ async function viewWorkerDetail() {
     .filter((ev) => ev.tid === `worker:${id}`
             && (ev.args || {}).task_id)
     .map((ev) => ({
-      task_id: ev.args.task_id, phase: ev.name,
+      task_id: ev.args.task_id, job: ev.args.job || "", phase: ev.name,
       state: ev.args.state || "", attempt: ev.args.attempt,
       start: new Date(ev.ts / 1000).toLocaleTimeString(),
       ms: +((ev.dur || 0) / 1000).toFixed(3),
@@ -557,8 +560,52 @@ async function viewTaskDetail() {
     backLink("tasks", "all tasks");
 }
 
+async function viewJobDetail() {
+  const id = hashParam("id");
+  const jobs = await getJSON("/api/jobs");
+  const job = jobs.find((j) => j.job_id === id);
+  if (!job) {
+    $("#main").innerHTML = `<h2 class="drill-title">job ${esc(id)}</h2>` +
+      `<p>(unknown job)</p>` + backLink("jobs", "all jobs");
+    return;
+  }
+  const mib = (v) => ((v || 0) / 1048576).toFixed(1) + " MiB";
+  const quota = job.quota || {};
+  const usage = job.usage || {};
+  const quotaCards = ["CPU", "TPU"].map((r) =>
+    `<div class="card"><b>${usage[r] ?? 0}/${quota[r] || "∞"}</b>
+      <span>${esc(r)} used/quota</span></div>`).join("");
+  $("#main").innerHTML =
+    `<h2 class="drill-title">job ${esc(id)}</h2>` +
+    `<div class="cards">
+      <div class="card"><b>${badge(job.stopped ? "STOPPED" :
+        (job.status || "RUNNING"))}</b><span>status</span></div>
+      <div class="card"><b>${(job.dominant_share ?? 0).toFixed
+        ? (job.dominant_share ?? 0).toFixed(3)
+        : esc(job.dominant_share)}</b><span>dominant share</span></div>
+      <div class="card"><b>${esc(String(job.weight ?? 1))}</b>
+        <span>weight</span></div>
+      ${quotaCards}
+      <div class="card"><b>${mib(job.object_bytes)}${job.object_quota
+        ? " / " + mib(job.object_quota) : ""}</b>
+        <span>object store</span></div>
+      <div class="card"><b>${mib(job.spilled_bytes)}</b>
+        <span>spilled</span></div>
+      <div class="card"><b>${job.task_event_drops ?? 0}</b>
+        <span>task-event drops</span></div>
+      <div class="card"><b>${job.over_quota_waits ?? 0}</b>
+        <span>over-quota waits</span></div>
+      <div class="card"><b>${job.submitted ?? 0}/${job.finished ?? 0}</b>
+        <span>tasks submitted/finished</span></div>
+    </div>` +
+    `<h3>raw</h3>` +
+    renderTable("job_raw", [job]) +
+    backLink("jobs", "all jobs");
+}
+
 const DETAIL_VIEWS = {
   node: viewNodeDetail, worker: viewWorkerDetail, task: viewTaskDetail,
+  job: viewJobDetail,
 };
 
 /* ---------------- router + refresh loop ---------------- */
@@ -568,7 +615,7 @@ let refreshTimer = null;
 async function render() {
   renderNav();
   $("#clock").textContent = new Date().toLocaleTimeString();
-  const detail = location.hash.match(/^#\/(node|worker|task)\?/);
+  const detail = location.hash.match(/^#\/(node|worker|task|job)\?/);
   if (detail) {
     try {
       await DETAIL_VIEWS[detail[1]]();

@@ -58,6 +58,10 @@ class JobSupervisor:
         self.stopped = False
         env = dict(os.environ)
         env.update((runtime_env or {}).get("env_vars", {}))
+        # Every task/actor/put the entrypoint (and its children) submits
+        # is attributed to this job at the head's ledger
+        # (core/jobs.py current_job_id reads this in driver processes).
+        env["RAY_TPU_JOB_ID"] = submission_id
         cwd = (runtime_env or {}).get("working_dir") or None
         self.log_f = open(log_path, "ab")
         self.proc = subprocess.Popen(
@@ -75,10 +79,24 @@ class JobSupervisor:
             status, msg = SUCCEEDED, ""
         else:
             status, msg = FAILED, f"entrypoint exited with code {rc}"
-        if rc is not None and self.end_time is None:
-            self.end_time = time.time()
+        if rc is not None:
+            if self.end_time is None:
+                self.end_time = time.time()
+            # Entrypoint is gone: nothing will write the log again. The
+            # supervisor actor can outlive its job for hours (status
+            # polls keep it alive), and a leaked append fd per finished
+            # job exhausts the head worker's fd table.
+            self._close_log()
         return {"status": status, "message": msg,
                 "start_time": self.start_time, "end_time": self.end_time}
+
+    def _close_log(self) -> None:
+        if self.log_f is not None:
+            try:
+                self.log_f.close()
+            except OSError:
+                pass
+            self.log_f = None
 
     def stop(self) -> bool:
         import signal
@@ -95,10 +113,14 @@ class JobSupervisor:
                     os.killpg(os.getpgid(self.proc.pid), signal.SIGKILL)
                 except ProcessLookupError:
                     pass
+        # The subprocess holds its own dup of the log fd; closing ours
+        # here only drops the supervisor's reference.
+        self._close_log()
         return True
 
     def logs(self) -> str:
-        self.log_f.flush()
+        if self.log_f is not None:
+            self.log_f.flush()
         try:
             with open(self.log_path, "rb") as f:
                 return f.read().decode(errors="replace")
@@ -128,7 +150,14 @@ class JobSubmissionClient:
                 "pass JobSubmissionClient(address='host:port')")
 
     def submit_job(self, *, entrypoint: str, submission_id: str | None = None,
-                   runtime_env: dict | None = None) -> str:
+                   runtime_env: dict | None = None,
+                   quota: dict | None = None, weight: float | None = None,
+                   object_quota: int | None = None) -> str:
+        """Submit an entrypoint as a supervised job. `quota` bounds the
+        job's concurrently-charged resources ({"CPU": n, "TPU": n}; 0 or
+        absent = the cluster default), `object_quota` its head-arena
+        bytes, and `weight` scales its DRF fair-share (2.0 = entitled to
+        twice the share of a weight-1.0 tenant)."""
         from ray_tpu.core.runtime import Runtime, get_runtime
         from ray_tpu.experimental.internal_kv import _internal_kv_put
         submission_id = submission_id or f"raysubmit_{uuid.uuid4().hex[:16]}"
@@ -137,12 +166,42 @@ class JobSubmissionClient:
         if isinstance(rt, Runtime):
             log_dir = os.path.join(rt.session_dir, "logs")
             log_path = os.path.join(log_dir, f"job-{submission_id}.log")
+        # Register at the head BEFORE the entrypoint can submit anything,
+        # so its very first task already admits under the job's quota.
+        self._job_register(rt, submission_id, weight, quota, object_quota)
         sup_cls = ray_tpu.remote(num_cpus=0)(JobSupervisor)
         actor = sup_cls.options(name=f"_job_supervisor:{submission_id}").remote(
             submission_id, entrypoint, runtime_env, log_path)
         ray_tpu.get(actor.status.remote(), timeout=60)  # started
         _internal_kv_put(f"job:{submission_id}", entrypoint.encode())
         return submission_id
+
+    @staticmethod
+    def _job_register(rt, submission_id, weight, quota, object_quota):
+        from ray_tpu.core.runtime import Runtime
+        try:
+            if isinstance(rt, Runtime):
+                rt.jobs.register(submission_id, weight=weight, quota=quota,
+                                 object_quota=object_quota)
+            else:
+                rt.request("job_register",
+                           (submission_id, weight, quota, object_quota),
+                           timeout=30.0)
+        except (AttributeError, ray_tpu.RayTpuError):
+            pass  # pre-ledger head: jobs run unregistered, no quotas
+
+    @staticmethod
+    def _job_release(rt, submission_id):
+        """Tell the head the job is dead: refuse future charges, drain
+        its queued work, release in-flight leases and reservation tails.
+        Without this a stopped job's queued tasks still dispatch."""
+        from ray_tpu.core.runtime import Runtime
+        try:
+            if isinstance(rt, Runtime):
+                return rt.stop_job(submission_id)
+            return rt.request("job_stop", submission_id, timeout=30.0)
+        except (AttributeError, ray_tpu.RayTpuError):
+            return None  # pre-ledger head
 
     def _supervisor(self, submission_id: str):
         return ray_tpu.get_actor(f"_job_supervisor:{submission_id}")
@@ -172,8 +231,15 @@ class JobSubmissionClient:
                            timeout=60)
 
     def stop_job(self, submission_id: str) -> bool:
-        return ray_tpu.get(self._supervisor(submission_id).stop.remote(),
-                           timeout=60)
+        from ray_tpu.core.runtime import get_runtime
+        ok = ray_tpu.get(self._supervisor(submission_id).stop.remote(),
+                         timeout=60)
+        # Killing the entrypoint process tree is not enough: work the job
+        # already submitted is still queued/leased at the head and would
+        # keep dispatching (and its dead clients' write reservations
+        # would strand arena bytes). Release it all now.
+        self._job_release(get_runtime(), submission_id)
+        return ok
 
     def delete_job(self, submission_id: str):
         self.stop_job(submission_id)

@@ -1591,11 +1591,16 @@ class InferenceEngine:
 
     def _sync_device_state(self):
         if self._dev_dirty or self._dev is None:
-            self._dev = (jnp.asarray(self.last_tokens),
-                         jnp.asarray(self.lengths),
-                         jnp.asarray(self.active))
+            # .copy() before upload: on the CPU backend jnp.asarray can
+            # alias the host buffer zero-copy, and the window jits DONATE
+            # these args — XLA would reuse the memory and scribble over
+            # self.last_tokens/lengths/active behind the host's back
+            # (active slots silently flipping off, requests stranded).
+            self._dev = (jnp.asarray(self.last_tokens.copy()),
+                         jnp.asarray(self.lengths.copy()),
+                         jnp.asarray(self.active.copy()))
             if self._spec:
-                self._dev_hist = jnp.asarray(self.hist)
+                self._dev_hist = jnp.asarray(self.hist.copy())
             self._dev_dirty = False
 
     def _sync_guides(self):
@@ -1823,7 +1828,9 @@ class InferenceEngine:
                 return None  # pool-starved: plain window binds per-token
         self._sync_device_state()
         if self._dev_hist is None:
-            self._dev_hist = jnp.asarray(self.hist)
+            # .copy(): the spec window donates hist; a zero-copy upload
+            # would hand self.hist's buffer to XLA (see _sync_device_state)
+            self._dev_hist = jnp.asarray(self.hist.copy())
         tables = self._build_tables()
         key = (tables.shape[1], iters)
         fn = self._spec_window_fns.get(key)

@@ -337,6 +337,12 @@ class JaxTrainer:
         if lo is None:
             return n
         best = self._fit_now()
+        if best < n:
+            # Capacity-wait is the autoscaler's scale-UP signal (the
+            # counterpart to the shrink loop): post the missing workers'
+            # bundles so the policy core can launch slice-shaped nodes
+            # while we wait.
+            self._request_scale_up(n - best)
         if wait_s > 0:
             bo = Backoff(deadline_s=wait_s)
             while best < n and bo.sleep():
@@ -349,6 +355,26 @@ class JaxTrainer:
                 f"{best} (fail-fast beats burning the failure budget on "
                 f"placement timeouts)")
         return min(best, n)
+
+    def _request_scale_up(self, missing: int) -> None:
+        """Post `missing` per-worker bundles to the head's scale-request
+        queue (drained by autoscaler/policy.py). Works from the driver
+        (direct Runtime call) and from workers (head request); a
+        pre-autoscaler head just ignores it."""
+        req = {k: v for k, v in self._per_worker_req().items() if v > 0}
+        if not req:
+            return
+        bundles = [dict(req) for _ in range(max(1, int(missing)))]
+        try:
+            from ray_tpu.core.runtime import Runtime, get_runtime
+            rt = get_runtime()
+            if isinstance(rt, Runtime):
+                rt.request_scale_up(bundles, source="train.capacity_wait")
+            else:
+                rt.request("scale_up", (bundles, "train.capacity_wait"),
+                           timeout=10.0)
+        except Exception:  # noqa: BLE001 — a hint, never a failure
+            pass
 
     def _make_group(self, storage_dir: str, n: int):
         req = self._per_worker_req()

@@ -88,9 +88,15 @@ class ClientRuntime(WorkerRuntime):
         return super().request(what, arg, timeout)
 
     def put(self, value):
+        from ray_tpu.core.jobs import current_job_id
         from ray_tpu.core.object_ref import ObjectRef
         payload, bufs, _refs = serialization.serialize_value(value)
-        oid = self.request("client_put", (payload, bufs), timeout=120.0)
+        # Third element = owning job (client processes carry it in
+        # RAY_TPU_JOB_ID); old heads that read only (payload, bufs)
+        # unpack by index and never see it.
+        oid = self.request(
+            "client_put", (payload, bufs, current_job_id(rt=self)),
+            timeout=120.0)
         return ObjectRef(ObjectID(oid), _add_ref=False)
 
     def _get_one(self, ref, timeout=None):

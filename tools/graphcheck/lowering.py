@@ -103,6 +103,18 @@ def lower_graph(spec: GraphSpec) -> LoweredGraph:
     # after.
     cache_was = jax.config.jax_enable_compilation_cache
     jax.config.update("jax_enable_compilation_cache", False)
+    # The flag alone is not enough: compilation_cache.is_cache_used()
+    # latches its answer on the FIRST jitted computation in the process
+    # (jax 0.4.37 `_cache_checked`), so if anything jax ran before
+    # graphcheck in this process with the cache on, compiles here still
+    # read warm entries and report cache-loaded memory estimates.
+    # reset_cache() drops the latch so the disable takes effect; a second
+    # reset in the finally re-latches with the restored flag.
+    try:
+        from jax._src import compilation_cache as _cc
+        _cc.reset_cache()
+    except Exception:  # noqa: BLE001 — private seam, best-effort
+        _cc = None
     try:
         with warnings.catch_warnings(record=True) as wlog:
             warnings.simplefilter("always")
@@ -119,6 +131,11 @@ def lower_graph(spec: GraphSpec) -> LoweredGraph:
                 error = f"{type(e).__name__}: {e}"
     finally:
         jax.config.update("jax_enable_compilation_cache", cache_was)
+        if _cc is not None:
+            try:
+                _cc.reset_cache()
+            except Exception:  # noqa: BLE001 — private seam, best-effort
+                pass
     for w in wlog:
         msg = str(w.message)
         if _DONATION_REJECT.search(msg):

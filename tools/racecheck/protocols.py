@@ -41,6 +41,12 @@ invariant over every explored interleaving:
                     respawn-replay and a delayed stale assign — committed
                     dir entries survive, and no bucket is ever owned by
                     two shards at one epoch.
+  job_ledger        core/jobs.py's JobLedger charge / settle / stop under
+                    concurrent grant sites, a requeue re-charge and a
+                    racing job-kill: charged usage never exceeds quota,
+                    no task_id is ever charged twice concurrently, a
+                    double settle releases exactly once, and a stopped
+                    job admits nothing.
 
 `run_all` splits the exploration budget across models; every violation
 renders as one `interleaving-violation` Finding anchored at the module
@@ -89,11 +95,16 @@ def _mk_spec(task_id: bytes, lease_seq: int, spill_hops: int = 0):
 
 def _mk_head(api):
     """A harness head running the REAL lease bookkeeping methods."""
+    from ray_tpu.core.jobs import JobLedger
     from ray_tpu.core.runtime import NodeState, Runtime
     head = types.SimpleNamespace()
     head.lock = api.lock(name="head.lock")
     head.nodes = {}
     head._reservations = {}
+    # Real ledger: the lease pop funnels settle quota charges through it
+    # (its own lock stays a real threading.Lock — ledger interleavings
+    # get their own dedicated model below).
+    head.jobs = JobLedger()
     head.lease_spills_total = 0
     head._hnat = None           # native head core absent in the model:
     # the (task_id, lease_seq) mirror pops are C-side bookkeeping with
@@ -643,6 +654,68 @@ def build_shard_reslice(api):
             "check": check}
 
 
+# ---------------- job ledger quota gate ----------------
+
+
+@model("job_ledger", "ray_tpu/core/jobs.py")
+def build_job_ledger(api):
+    """The REAL JobLedger under the scheduler: two grant sites racing on
+    the same task_id (schedule-now vs lease refill), a requeue's
+    settle+recharge cycle (with a deliberate double settle), and a job
+    stop landing at any point. Invariants: at most one live charge per
+    task_id, usage == sum of inflight charges (a double settle releases
+    exactly once), usage never past quota, stopped jobs admit nothing."""
+    from ray_tpu.core.jobs import JobLedger
+    led = JobLedger(default_quota={"CPU": 2.0})
+    led.lock = api.lock(name="jobs.lock")
+    led.register("j")
+    t1_grants: list[bool] = []
+    post_stop: list[bool] = []
+
+    def granter(tag):
+        def fn():
+            api.point(f"jobs.charge.{tag}")
+            t1_grants.append(led.charge("j", b"T1", {"CPU": 1.0}))
+        return fn
+
+    def requeuer():
+        api.point("jobs.charge.requeue")
+        if not led.charge("j", b"T2", {"CPU": 2.0}):
+            return
+        api.point("jobs.settle.requeue")
+        led.settle("j", b"T2")
+        led.settle("j", b"T2")  # retry paths double-settle; must no-op
+        api.point("jobs.recharge.requeue")
+        led.charge("j", b"T2", {"CPU": 2.0})
+
+    def stopper():
+        api.point("jobs.stop")
+        led.stop("j")
+        post_stop.append(led.charge("j", b"T3", {"CPU": 0.5}))
+
+    def check():
+        rec = led.jobs["j"]
+        assert sum(t1_grants) <= 1, (
+            f"task T1 charged {sum(t1_grants)}x concurrently "
+            "(double-grant guard broke)")
+        assert post_stop == [False], (
+            "a stopped job admitted a new charge")
+        expect = 0.0
+        for charged in rec.inflight.values():
+            expect += charged.get("CPU", 0.0)
+        assert abs(rec.usage["CPU"] - expect) < 1e-9, (
+            f"usage {rec.usage['CPU']} != inflight sum {expect} "
+            "(a settle leaked or released twice)")
+        assert rec.usage["CPU"] <= 2.0 + 1e-9, (
+            f"usage {rec.usage['CPU']} exceeds quota 2.0")
+
+    return {"threads": [("grant_sched", granter("sched")),
+                        ("grant_refill", granter("refill")),
+                        ("requeue", requeuer),
+                        ("job_kill", stopper)],
+            "check": check}
+
+
 # ---------------- driver ----------------
 
 
@@ -658,6 +731,7 @@ _CAPS = {
                            max_preemptions=1),
     "stream_resume": dict(max_schedules=2500, pct_schedules=24),
     "shard_reslice": dict(max_schedules=3000, pct_schedules=24),
+    "job_ledger": dict(max_schedules=4000, pct_schedules=24),
 }
 
 

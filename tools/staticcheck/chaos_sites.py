@@ -35,7 +35,10 @@ from tools.staticcheck.concurrency import suppressed
 
 TARGET_GLOBS = ("ray_tpu/core/*.py", "ray_tpu/experimental/channel.py",
                 "ray_tpu/train/*.py", "ray_tpu/llm/*.py",
-                "ray_tpu/serve/*.py")
+                "ray_tpu/serve/*.py",
+                # Multi-tenant plane: the job.hostile storm seam lives in
+                # core/jobs.py; scale/stop paths get recovery hygiene.
+                "ray_tpu/autoscaler/*.py", "ray_tpu/job_submission.py")
 
 _CHAOS_FNS = {"site", "kill", "delay"}
 

@@ -60,6 +60,14 @@ TARGETS = (
     "ray_tpu/data/avro.py",
     "ray_tpu/data/tfrecord.py",
     "ray_tpu/data/preprocessors.py",
+    # Multi-tenant plane (PR 20): the job ledger's quota lock sits inside
+    # the grant path, the autoscaler reconciler calls into the runtime
+    # under its own loop, and the job supervisor juggles child-process
+    # pipes — all three are lock/fd territory.
+    "ray_tpu/core/jobs.py",
+    "ray_tpu/autoscaler/__init__.py",
+    "ray_tpu/autoscaler/policy.py",
+    "ray_tpu/job_submission.py",
 )
 
 SEND_LOCKS = {"send_lock", "flush_lock", "head_lock"}

@@ -33,7 +33,10 @@ from tools.staticcheck.concurrency import suppressed
 
 TARGET_GLOBS = ("ray_tpu/core/*.py", "ray_tpu/experimental/channel.py",
                 "ray_tpu/train/*.py", "ray_tpu/tune/*.py",
-                "ray_tpu/llm/serve.py", "ray_tpu/data/*.py")
+                "ray_tpu/llm/serve.py", "ray_tpu/data/*.py",
+                # Multi-tenant plane: supervisor log fds + autoscaler
+                # provider/node-agent spawns.
+                "ray_tpu/autoscaler/*.py", "ray_tpu/job_submission.py")
 
 _FD_CTORS = {
     ("socket", "socket"), ("socket", "create_connection"),

@@ -76,7 +76,7 @@ PICKLE_FRAMED_MESSAGES = {
 # Fields of bound messages that ride the pickle-framing fallback when set
 # (documented in the proto, absent from the generated classes).
 FALLBACK_FIELDS = {
-    "TaskSpec": {"language": 21},
+    "TaskSpec": {"language": 21, "job_id": 22},
     "RegisterNode.WorkerInventory": {"language": 4},
     "AgentFrame": {"cluster_view": 11, "lease_spilled": 12,
                    "task_events": 13, "metrics_update": 14},
